@@ -1,0 +1,107 @@
+"""pathway_tpu — a TPU-native incremental streaming dataflow framework.
+
+Brand-new implementation of the capabilities of Pathway
+(github.com/pathwaycom/pathway, reference mounted at /root/reference):
+declarative Table DSL, unified batch+streaming semantics with retractions,
+IO connectors, temporal operators, vector indexes and an LLM/RAG xpack —
+with the dense hot path (embedders, KNN scoring, rerankers) running on TPU
+via JAX/XLA/Pallas and sharded over device meshes.
+
+Use as: ``import pathway_tpu as pw``.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.api import (
+    ERROR,
+    PENDING,
+    Json,
+    Pointer,
+    PyObjectWrapper,
+    unsafe_make_pointer,
+    wrap_py_object,
+)
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_columns,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.groupbys import GroupedTable
+from pathway_tpu.internals.joins import JoinMode, JoinResult
+from pathway_tpu.internals.parse_graph import G, ParseGraph
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import Table, TableLike
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals.universe import SOLVER, Universe
+from pathway_tpu.run import run, run_all
+from pathway_tpu.udfs import UDF, udf
+
+# dtype aliases matching the reference's pw.* type names
+DateTimeNaive = _dt.DATE_TIME_NAIVE
+DateTimeUtc = _dt.DATE_TIME_UTC
+Duration = _dt.DURATION
+
+from pathway_tpu import debug, io, udfs  # noqa: E402
+
+__version__ = "0.1.0"
+
+_LAZY_MODULES = {
+    "demo": "pathway_tpu.demo",
+    "indexing": "pathway_tpu.stdlib.indexing",
+    "temporal": "pathway_tpu.stdlib.temporal",
+    "ml": "pathway_tpu.stdlib.ml",
+    "stateful": "pathway_tpu.stdlib.stateful",
+    "statistical": "pathway_tpu.stdlib.statistical",
+    "ordered": "pathway_tpu.stdlib.ordered",
+    "graphs": "pathway_tpu.stdlib.graphs",
+    "utils": "pathway_tpu.stdlib.utils",
+    "xpacks": "pathway_tpu.xpacks",
+    "universes": "pathway_tpu.universes",
+    "persistence": "pathway_tpu.persistence",
+    "sql_module": "pathway_tpu.sql_module",
+}
+
+
+def __getattr__(name: str):
+    import importlib
+
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(_LAZY_MODULES[name])
+        globals()[name] = mod
+        return mod
+    if name == "sql":
+        from pathway_tpu.sql_module import sql as _sql
+
+        globals()["sql"] = _sql
+        return _sql
+    if name == "iterate":
+        from pathway_tpu.internals.iterate import iterate as _iterate
+
+        globals()["iterate"] = _iterate
+        return _iterate
+    raise AttributeError(f"module 'pathway_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_LAZY_MODULES.keys()) + ["sql", "iterate"])

@@ -1,0 +1,182 @@
+"""Engine runtime: the per-worker event loop.
+
+Rebuild of the reference's main worker loop (reference: src/engine/
+dataflow.rs:5595-5650 — ``loop { probers; flushers; pollers; step_or_park }``)
+on the batch-per-timestamp scheduler: timestamps are processed strictly in
+order; within a timestamp nodes run in topological order, which guarantees
+every operator sees a consistent prefix of its inputs (the timely progress
+invariant, SURVEY §2.9).
+
+Streaming sources run on their own threads and feed a queue; the loop drains
+it, stamps batches with commit timestamps (monotone, ms-resolution like the
+reference's Timestamp at src/engine/timestamp.rs:140) and steps the graph.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from typing import Any, Callable
+
+from pathway_tpu.engine.nodes import Node, SourceNode
+from pathway_tpu.engine.scope import Scope
+from pathway_tpu.engine.stream import Delta
+
+
+class _Connector:
+    def __init__(self, node: SourceNode, subject, parser):
+        self.node = node
+        self.subject = subject
+        self.parser = parser
+        self.finished = False
+        self.thread: threading.Thread | None = None
+
+
+class Runtime:
+    def __init__(self, terminate_on_error: bool = True):
+        self.scope = Scope(self)
+        self.pending_times: dict[int, set[int]] = {}  # time -> set of node ids
+        self.static_data: list[tuple[SourceNode, list[Delta]]] = []
+        self.connectors: list[_Connector] = []
+        self.event_queue: "queue.Queue[tuple[_Connector, list[Delta] | None]]" = (
+            queue.Queue()
+        )
+        self.clock = 0
+        self.terminate_on_error = terminate_on_error
+        self.error: Exception | None = None
+        self._async_loop = None
+
+    # -- wiring ----------------------------------------------------------
+    def add_static_data(self, node: SourceNode, deltas: list[Delta]) -> None:
+        self.static_data.append((node, deltas))
+
+    def add_connector(self, node: SourceNode, subject, parser) -> None:
+        self.connectors.append(_Connector(node, subject, parser))
+
+    def mark_pending(self, time: int, node: Node) -> None:
+        self.pending_times.setdefault(time, set()).add(node.node_id)
+
+    @property
+    def async_loop(self):
+        if self._async_loop is None:
+            import asyncio
+
+            self._async_loop = asyncio.new_event_loop()
+        return self._async_loop
+
+    # -- stepping ---------------------------------------------------------
+    def _deliver(self, node: Node, time: int, deltas: list[Delta]) -> None:
+        for child, port in node.downstream:
+            child.accept(time, port, deltas)
+
+    def _step_time(self, time: int) -> None:
+        """Run all nodes with pending input at `time`, in topo order."""
+        nodes = self.scope.nodes
+        while True:
+            pending_ids = self.pending_times.get(time)
+            if not pending_ids:
+                break
+            nid = min(pending_ids)
+            pending_ids.discard(nid)
+            node = nodes[nid]
+            batches = node.take(time)
+            out = node.process(time, batches)
+            if out:
+                self._deliver(node, time, out)
+        self.pending_times.pop(time, None)
+        for node in nodes:
+            node.on_time_end(time)
+
+    def _finish(self) -> None:
+        for node in self.scope.nodes:
+            node.on_end()
+        if self._async_loop is not None:
+            self._async_loop.close()
+            self._async_loop = None
+
+    def _inject_static(self) -> None:
+        t = self._next_time()
+        for node, deltas in self.static_data:
+            if deltas:
+                node.accept(t, 0, deltas)
+            else:
+                self.pending_times.setdefault(t, set())
+
+    def _next_time(self) -> int:
+        now_ms = int(_time.time() * 1000)
+        self.clock = max(self.clock + 2, now_ms - (now_ms % 2))  # even: system time
+        return self.clock
+
+    # -- run modes --------------------------------------------------------
+    def run_static(self) -> None:
+        self._inject_static()
+        while self.pending_times:  # nodes may emit at later times (buffers)
+            t = min(self.pending_times)
+            self._step_time(t)
+        self._finish()
+
+    def run(self) -> None:
+        if not self.connectors:
+            self.run_static()
+            return
+        self._run_streaming()
+
+    def _run_streaming(self) -> None:
+        from pathway_tpu.io._connector import run_connector_thread
+
+        self._inject_static()
+        while self.pending_times:
+            t = min(self.pending_times)
+            self._step_time(t)
+
+        for conn in self.connectors:
+            conn.thread = threading.Thread(
+                target=run_connector_thread,
+                args=(conn, self.event_queue),
+                daemon=True,
+            )
+            conn.thread.start()
+
+        active = len(self.connectors)
+        while active > 0:
+            try:
+                conn, deltas = self.event_queue.get(timeout=0.5)
+            except queue.Empty:
+                if self.error and self.terminate_on_error:
+                    raise self.error
+                continue
+            t = self._next_time()
+            if deltas is None:
+                conn.finished = True
+                active -= 1
+            elif deltas:
+                conn.node.accept(t, 0, deltas)
+            # drain everything else already queued into the same commit time
+            while True:
+                try:
+                    conn2, deltas2 = self.event_queue.get_nowait()
+                except queue.Empty:
+                    break
+                if deltas2 is None:
+                    conn2.finished = True
+                    active -= 1
+                elif deltas2:
+                    conn2.node.accept(t, 0, deltas2)
+            for tt in sorted(self.pending_times):
+                if tt <= t:
+                    self._step_time(tt)
+            if self.error and self.terminate_on_error:
+                raise self.error
+        while self.pending_times:
+            t = min(self.pending_times)
+            self._step_time(t)
+        for conn in self.connectors:
+            if conn.thread is not None:
+                conn.thread.join(timeout=5)
+        self._finish()
+
+    def report_error(self, exc: Exception) -> None:
+        if self.terminate_on_error:
+            raise exc
+        self.error = exc

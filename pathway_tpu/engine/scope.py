@@ -1,0 +1,147 @@
+"""Engine scope: the graph-construction API the DSL lowers onto.
+
+Equivalent of the reference's ``trait Graph`` (reference: src/engine/
+graph.rs:664-1011) + the PyO3 ``Scope`` pyclass (src/python_api.rs:2216),
+collapsed into one Python-facing class since our bridge needs no FFI for
+graph *construction* — only the data plane is native/JAX.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine import nodes as N
+from pathway_tpu.engine.stream import Delta
+
+
+class EngineTable:
+    """Handle to a node output inside a scope."""
+
+    __slots__ = ("node", "width")
+
+    def __init__(self, node: N.Node, width: int):
+        self.node = node
+        self.width = width
+
+
+class Scope:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.nodes: list[N.Node] = []
+
+    def register(self, node: N.Node) -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    # -- sources ---------------------------------------------------------
+    def static_table(self, rows: list[tuple[int, tuple]], width: int) -> EngineTable:
+        node = N.SourceNode(self)
+        self.runtime.add_static_data(node, [(k, r, 1) for k, r in rows])
+        return EngineTable(node, width)
+
+    def empty_table(self, width: int) -> EngineTable:
+        node = N.SourceNode(self)
+        self.runtime.add_static_data(node, [])
+        return EngineTable(node, width)
+
+    def connector_table(self, subject, parser, width: int) -> EngineTable:
+        node = N.SourceNode(self, append_only=False)
+        self.runtime.add_connector(node, subject, parser)
+        return EngineTable(node, width)
+
+    # -- stateless transforms --------------------------------------------
+    def rowwise(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
+        return EngineTable(N.RowwiseNode(self, table.node, batch_fn), width)
+
+    def rowwise_memoized(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
+        return EngineTable(N.MemoizedRowwiseNode(self, table.node, batch_fn), width)
+
+    def filter_table(self, table: EngineTable, mask_fn) -> EngineTable:
+        return EngineTable(N.FilterNode(self, table.node, mask_fn), table.width)
+
+    def reindex(self, table: EngineTable, key_fn) -> EngineTable:
+        return EngineTable(N.ReindexNode(self, table.node, key_fn), table.width)
+
+    def flatten(self, table: EngineTable, idx: int) -> EngineTable:
+        return EngineTable(N.FlattenNode(self, table.node, idx), table.width)
+
+    def concat(self, tables: list[EngineTable]) -> EngineTable:
+        width = tables[0].width
+        return EngineTable(N.ConcatNode(self, [t.node for t in tables]), width)
+
+    # -- stateful transforms ---------------------------------------------
+    def join(
+        self,
+        left: EngineTable,
+        right: EngineTable,
+        left_key_fn,
+        right_key_fn,
+        join_type: str = "inner",
+        id_from_left: bool = False,
+        id_from_right: bool = False,
+    ) -> EngineTable:
+        node = N.JoinNode(
+            self,
+            left.node,
+            right.node,
+            left_key_fn,
+            right_key_fn,
+            join_type,
+            left_width=left.width,
+            right_width=right.width,
+            id_from_left=id_from_left,
+            id_from_right=id_from_right,
+        )
+        return EngineTable(node, left.width + right.width)
+
+    def group_by(
+        self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int, key_fn=None
+    ) -> EngineTable:
+        node = N.GroupByNode(self, table.node, grouping_fn, args_fn, reducer_fns, key_fn)
+        return EngineTable(node, n_group_cols + len(reducer_fns))
+
+    def update_rows(self, left: EngineTable, right: EngineTable) -> EngineTable:
+        return EngineTable(N.UpdateRowsNode(self, left.node, right.node), left.width)
+
+    def update_cells(self, left: EngineTable, right: EngineTable, positions) -> EngineTable:
+        return EngineTable(
+            N.UpdateCellsNode(self, left.node, right.node, positions), left.width
+        )
+
+    def ix(self, source: EngineTable, keys: EngineTable, key_fn, optional, strict) -> EngineTable:
+        node = N.IxNode(
+            self, source.node, keys.node, key_fn, optional, strict, source.width
+        )
+        return EngineTable(node, source.width)
+
+    def intersect(self, left: EngineTable, others: list[EngineTable]) -> EngineTable:
+        return EngineTable(
+            N.IntersectNode(self, left.node, [o.node for o in others]), left.width
+        )
+
+    def difference(self, left: EngineTable, right: EngineTable) -> EngineTable:
+        return EngineTable(N.DifferenceNode(self, left.node, right.node), left.width)
+
+    def sort(self, table: EngineTable, key_fn, instance_fn) -> EngineTable:
+        return EngineTable(N.SortNode(self, table.node, key_fn, instance_fn), 2)
+
+    def deduplicate(self, table: EngineTable, instance_fn, value_fn, acceptor) -> EngineTable:
+        return EngineTable(
+            N.DeduplicateNode(self, table.node, instance_fn, value_fn, acceptor),
+            table.width,
+        )
+
+    def stateful_reduce(
+        self, table: EngineTable, grouping_fn, args_fn, combine_many, n_group_cols, key_fn=None
+    ) -> EngineTable:
+        node = N.StatefulReduceNode(
+            self, table.node, grouping_fn, args_fn, combine_many, key_fn
+        )
+        return EngineTable(node, n_group_cols + 1)
+
+    # -- sinks ------------------------------------------------------------
+    def output(self, table: EngineTable, **callbacks) -> None:
+        N.OutputNode(self, table.node, **callbacks)
+
+    def capture(self, table: EngineTable) -> N.CaptureNode:
+        return N.CaptureNode(self, table.node)

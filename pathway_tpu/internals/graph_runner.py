@@ -1,0 +1,158 @@
+"""Graph lowering + execution (reference:
+python/pathway/internals/graph_runner/__init__.py:36 GraphRunner,
+storage_graph.py, expression_evaluator.py — collapsed: our engine scope is
+in-process, so column-path planning reduces to schema-order row tuples).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Callable, Iterable
+
+from pathway_tpu.engine.expression import compile_expression
+from pathway_tpu.engine.runtime import Runtime
+from pathway_tpu.engine.scope import EngineTable
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.parse_graph import G, Operator
+from pathway_tpu.internals.universe import SOLVER
+
+if TYPE_CHECKING:
+    from pathway_tpu.internals.table import Table
+
+
+class LoweringContext:
+    def __init__(self, runtime: Runtime):
+        self.runtime = runtime
+        self.scope = runtime.scope
+        self.engine_tables: dict[int, EngineTable] = {}
+
+    # -- table registry ---------------------------------------------------
+    def set_engine_table(self, table: "Table", et: EngineTable) -> None:
+        self.engine_tables[id(table)] = et
+
+    def engine_table(self, table: "Table") -> EngineTable:
+        try:
+            return self.engine_tables[id(table)]
+        except KeyError:
+            raise RuntimeError(
+                f"table {table._name!r} was not lowered before use"
+            ) from None
+
+    # -- expression compilation -------------------------------------------
+    def _combined_view(
+        self, base: "Table", exprs: Iterable[expr_mod.ColumnExpression]
+    ) -> tuple[EngineTable, Callable[[expr_mod.ColumnReference], Any]]:
+        """Engine input holding base's row (+ id-joined rows of any other
+        same-universe tables referenced by `exprs`) and a ref resolver."""
+        dep_tables: list[Table] = []
+        seen = {id(base)}
+        for e in exprs:
+            for ref in e._deps:
+                t = ref.table
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    dep_tables.append(t)
+        combined = self.engine_table(base)
+        offsets: dict[int, int] = {id(base): 0}
+        width = combined.width
+        for t in dep_tables:
+            if not (
+                SOLVER.query_are_equal(base._universe, t._universe)
+                or SOLVER.query_is_subset(base._universe, t._universe)
+            ):
+                raise ValueError(
+                    f"expression references table {t._name!r} with an unrelated "
+                    f"universe; use .restrict()/.ix() first"
+                )
+            other = self.engine_table(t)
+            combined = self.scope.join(
+                combined,
+                other,
+                lambda k, row: k,
+                lambda k, row: k,
+                "inner",
+                id_from_left=True,
+            )
+            offsets[id(t)] = width
+            width += other.width
+
+        def resolver(ref: expr_mod.ColumnReference):
+            if ref.name == "id":
+                return "id"
+            t = ref.table
+            try:
+                idx = t._column_names.index(ref.name)
+            except ValueError:
+                raise KeyError(
+                    f"no column {ref.name!r} in table {t._name!r} "
+                    f"(columns: {t._column_names})"
+                ) from None
+            return offsets[id(t)] + idx
+
+        return combined, resolver
+
+    def rowwise_eval(
+        self, base: "Table", exprs: list[expr_mod.ColumnExpression]
+    ) -> tuple[EngineTable, Callable]:
+        """Returns (engine_input, fn(keys, rows) -> list of output row tuples)."""
+        combined, resolver = self._combined_view(base, exprs)
+        fns = [compile_expression(e, resolver, self.runtime) for e in exprs]
+
+        def batch_fn(keys, rows):
+            cols = [f(keys, rows) for f in fns]
+            return [tuple(c[i] for c in cols) for i in range(len(keys))]
+
+        return combined, batch_fn
+
+    def mask_eval(
+        self, base: "Table", e: expr_mod.ColumnExpression
+    ) -> tuple[EngineTable, Callable]:
+        combined, resolver = self._combined_view(base, [e])
+        fn = compile_expression(e, resolver, self.runtime)
+        return combined, fn
+
+    def row_fn(
+        self, base: "Table", exprs: list[expr_mod.ColumnExpression]
+    ) -> tuple[EngineTable, Callable]:
+        """Per-row variant: fn(key, row) -> tuple of values (for key fns)."""
+        combined, resolver = self._combined_view(base, exprs)
+        fns = [compile_expression(e, resolver, self.runtime) for e in exprs]
+
+        def one(key, row):
+            return tuple(f([key], [row])[0] for f in fns)
+
+        return combined, one
+
+
+class GraphRunner:
+    """Lower + run the captured graph (reference:
+    graph_runner/__init__.py:86 run_nodes / :96 run_tables / :113 run_outputs)."""
+
+    def __init__(self, parse_graph=None, *, terminate_on_error: bool = True, **kwargs):
+        self.graph = parse_graph or G
+        self.terminate_on_error = terminate_on_error
+
+    def _lower(self, ops: list[Operator], runtime: Runtime) -> LoweringContext:
+        ctx = LoweringContext(runtime)
+        for op in ops:
+            op.lower_fn(ctx)
+        return ctx
+
+    def run_tables(self, *tables: "Table", include_outputs: bool = False):
+        """Run to completion, capturing the given tables' final state +
+        update streams.  Returns list of CaptureNodes."""
+        runtime = Runtime(terminate_on_error=self.terminate_on_error)
+        targets = [t._source for t in tables if t._source is not None]
+        if include_outputs:
+            targets += self.graph.output_operators()
+        ops = self.graph.reachable_operators(targets)
+        ctx = self._lower(ops, runtime)
+        captures = [runtime.scope.capture(ctx.engine_table(t)) for t in tables]
+        runtime.run()
+        return captures
+
+    def run_outputs(self):
+        runtime = Runtime(terminate_on_error=self.terminate_on_error)
+        targets = self.graph.output_operators()
+        ops = self.graph.reachable_operators(targets)
+        self._lower(ops, runtime)
+        runtime.run()

@@ -1,0 +1,223 @@
+"""Groupby/reduce machinery (reference: python/pathway/internals/groupbys.py
++ graph_runner reduce lowering).
+
+``t.groupby(cols).reduce(out=reducer(...))`` lowers to the engine's
+GroupByNode: per-group multisets, affected-group rediff, output keyed by
+``ref_scalar(*grouping_values)`` (reference: Graph::group_by_table,
+graph.rs:885).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import thisclass
+from pathway_tpu.internals.api import ref_scalar
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    ReducerExpression,
+)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.reducers import StatefulReducer
+from pathway_tpu.internals.schema import schema_from_types
+from pathway_tpu.internals.universe import Universe
+
+
+class GroupedTable:
+    def __init__(self, table, grouping: list[ColumnExpression], sort_by=None):
+        self._table = table
+        self._grouping = [expr_mod.smart_coerce(g) for g in grouping]
+        self._sort_by = (
+            table._desugar(expr_mod.smart_coerce(sort_by)) if sort_by is not None else None
+        )
+
+    def _resolve_deferred(self, name: str):
+        return self._table._resolve_deferred(name)
+
+    def reduce(self, *args, **kwargs):
+        from pathway_tpu.internals.table import Table
+
+        base = self._table
+        names: list[str] = []
+        out_exprs: list[ColumnExpression] = []
+        for arg in args:
+            if isinstance(arg, thisclass.ThisColumnReference):
+                names.append(arg.name)
+                out_exprs.append(base._desugar(arg))
+            elif isinstance(arg, ColumnReference):
+                names.append(arg.name)
+                out_exprs.append(arg)
+            else:
+                raise ValueError(
+                    "positional reduce() arguments must be column references"
+                )
+        for n, e in kwargs.items():
+            names.append(n)
+            out_exprs.append(base._desugar(expr_mod.smart_coerce(e)))
+
+        grouping = self._grouping
+        grouping_ids = {id(g) for g in grouping}
+        grouping_refs = {
+            (id(g.table), g.name): j
+            for j, g in enumerate(grouping)
+            if isinstance(g, ColumnReference)
+        }
+
+        # synthetic result namespace: g0..gN grouping cols, r0..rM reducers
+        reducers: list[ReducerExpression] = []
+        gtable_cols: dict[str, dt.DType] = {
+            f"g{j}": g._dtype for j, g in enumerate(grouping)
+        }
+
+        gtable = Table.__new__(Table)  # bare namespace table, never lowered
+        gtable._name = "groupby_result"
+        gtable._column_names = []
+        gtable._schema_cls = None
+
+        def gref(name: str, dtype: dt.DType) -> ColumnReference:
+            r = ColumnReference.__new__(ColumnReference)
+            ColumnExpression.__init__(r)
+            r._table = gtable
+            r._name = name
+            r._dtype = dtype
+            return r
+
+        def rewrite_fn(e: ColumnExpression):
+            if isinstance(e, ReducerExpression):
+                idx = len(reducers)
+                reducers.append(e)
+                return gref(f"r{idx}", e._dtype)
+            if id(e) in grouping_ids:
+                j = grouping.index(e)
+                return gref(f"g{j}", e._dtype)
+            if isinstance(e, ColumnReference):
+                j = grouping_refs.get((id(e.table), e.name))
+                if j is not None:
+                    return gref(f"g{j}", e._dtype)
+                if e.name == "id" and e.table is base:
+                    raise ValueError(
+                        "cannot use id of the source table in reduce(); "
+                        "group by it explicitly"
+                    )
+            return None
+
+        rewritten = [thisclass.rewrite(e, rewrite_fn) for e in out_exprs]
+
+        # validate: no remaining refs outside gtable
+        for e in rewritten:
+            for ref in e._deps:
+                if ref.table is not gtable:
+                    raise ValueError(
+                        f"column {ref.name!r} must be grouped or wrapped in a reducer"
+                    )
+        for i, r in enumerate(reducers):
+            gtable_cols[f"r{i}"] = r._dtype
+
+        stateful = [r for r in reducers if isinstance(r._reducer, StatefulReducer)]
+        if stateful and len(reducers) != len(stateful):
+            raise NotImplementedError(
+                "mixing stateful and plain reducers in one reduce() is not supported yet"
+            )
+
+        out_schema = schema_from_types(
+            **{n: e._dtype for n, e in zip(names, rewritten)}
+        )
+        out = Table(out_schema, Universe())
+        n_group = len(grouping)
+
+        sort_by = self._sort_by
+
+        def lower(ctx):
+            from pathway_tpu.engine.expression import compile_expression
+
+            all_input_exprs = list(grouping) + [
+                a for r in reducers for a in r._args
+            ] + ([sort_by] if sort_by is not None else [])
+            et, resolver = ctx._combined_view(base, all_input_exprs)
+
+            gfns = [compile_expression(g, resolver, ctx.runtime) for g in grouping]
+            arg_fns = [
+                [compile_expression(a, resolver, ctx.runtime) for a in r._args]
+                for r in reducers
+            ]
+            sort_fn = (
+                compile_expression(sort_by, resolver, ctx.runtime)
+                if sort_by is not None
+                else None
+            )
+
+            def grouping_fn(k, row):
+                return tuple(f([k], [row])[0] for f in gfns)
+
+            def args_fn(k, row):
+                # contract: (*args, order_token, row_key) per reducer slot
+                order = sort_fn([k], [row])[0] if sort_fn is not None else k
+                return tuple(
+                    tuple(f([k], [row])[0] for f in fns) + (order, k)
+                    for fns in arg_fns
+                )
+
+            if stateful:
+                assert len(reducers) == 1
+                red = reducers[0]
+                post = getattr(red, "_post_process", None)
+                combine = red._reducer.combine_many
+
+                def combine_rows(state, rows):
+                    # rows: list of (args_combo, diff); combo = ((a1..ak, order, key),)
+                    flat = [(combo[0][:-2], d) for combo, d in rows]
+                    return combine(state, flat)
+
+                get = ctx.scope.stateful_reduce(
+                    et, grouping_fn, args_fn, combine_rows, n_group
+                )
+                if post is not None:
+                    get = ctx.scope.rowwise(
+                        get,
+                        lambda keys, rows: [
+                            r[:-1] + (post(r[-1]),) for r in rows
+                        ],
+                        get.width,
+                    )
+                grouped = get
+            else:
+                reducer_fns = []
+                for r in reducers:
+                    fn = r._reducer.engine_fn()
+                    post = getattr(r, "_post_process", None)
+                    if post is not None:
+                        fn = lambda ms, slot, _f=fn, _p=post: _p(_f(ms, slot))
+                    reducer_fns.append(fn)
+                grouped = ctx.scope.group_by(
+                    et, grouping_fn, args_fn, reducer_fns, n_group
+                )
+
+            # stage 2: evaluate output expressions over gvals + reducer values
+            def out_resolver(ref):
+                if ref.table is gtable:
+                    name = ref.name
+                    if name.startswith("g"):
+                        return int(name[1:])
+                    return n_group + int(name[1:])
+                if ref.name == "id":
+                    return "id"
+                raise KeyError(ref.name)
+
+            out_fns = [
+                compile_expression(e, out_resolver, ctx.runtime) for e in rewritten
+            ]
+
+            def batch_fn(keys, rows):
+                cols = [f(keys, rows) for f in out_fns]
+                return [tuple(c[i] for c in cols) for i in range(len(keys))]
+
+            ctx.set_engine_table(
+                out, ctx.scope.rowwise(grouped, batch_fn, len(out_fns))
+            )
+
+        dep_exprs = list(grouping) + [a for r in reducers for a in r._args]
+        G.add_operator(base._dep_tables(dep_exprs), [out], lower, "groupby_reduce")
+        return out

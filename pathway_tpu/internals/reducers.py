@@ -1,0 +1,294 @@
+"""Reducers (reference: python/pathway/internals/reducers.py +
+src/engine/reduce.rs ``enum Reducer``).
+
+Each DSL reducer lowers to an engine function evaluated over a group's
+multiset of argument combos.  The engine contract (GroupByNode): entries is a
+list of ``(combo_tuple, count)`` where ``combo_tuple[slot]`` is this reducer's
+argument tuple ``(*args, order_token, row_key)`` — the order token (the
+groupby ``sort_by`` value when given, else the row key) drives ordering
+reducers (tuple/earliest/latest/any), the row key backs argmin/argmax.
+Semigroup reducers (sum/count) could use running state; the rediff strategy
+recomputes per touched group, which is exact and fast enough until the C++
+core lands.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import ERROR
+from pathway_tpu.internals.expression import ColumnExpression, ReducerExpression
+
+
+def _entries(ms, slot: int):
+    items = ms.items() if hasattr(ms, "items") else ms
+    for combo, count in items:
+        yield combo[slot], count
+
+
+class Reducer:
+    def __init__(self, name: str, engine_fn_factory: Callable, return_type_fn=None):
+        self.name = name
+        self._factory = engine_fn_factory
+        self._return_type_fn = return_type_fn
+
+    def return_type(self, arg_types: list[dt.DType]) -> dt.DType:
+        if self._return_type_fn is not None:
+            return self._return_type_fn(arg_types)
+        return arg_types[0] if arg_types else dt.ANY
+
+    def engine_fn(self, **kwargs) -> Callable:
+        return self._factory(**kwargs)
+
+    def __call__(self, *args, **kwargs) -> ReducerExpression:
+        return ReducerExpression(self, *args, **kwargs)
+
+    def __repr__(self):
+        return f"pathway.reducers.{self.name}"
+
+
+# -- engine implementations ----------------------------------------------
+
+
+def _count_factory(**kw):
+    def fn(ms, slot):
+        return builtins.sum(count for _, count in _entries(ms, slot))
+
+    return fn
+
+
+def _sum_factory(**kw):
+    def fn(ms, slot):
+        total = None
+        for args, count in _entries(ms, slot):
+            v = args[0]
+            if v is ERROR:
+                return ERROR
+            contrib = v * count
+            total = contrib if total is None else total + contrib
+        return total
+
+    return fn
+
+
+def _min_factory(**kw):
+    def fn(ms, slot):
+        vals = [args[0] for args, _ in _entries(ms, slot)]
+        if builtins.any(v is ERROR for v in vals):
+            return ERROR
+        return builtins.min(vals)
+
+    return fn
+
+
+def _max_factory(**kw):
+    def fn(ms, slot):
+        vals = [args[0] for args, _ in _entries(ms, slot)]
+        if builtins.any(v is ERROR for v in vals):
+            return ERROR
+        return builtins.max(vals)
+
+    return fn
+
+
+def _argmin_factory(**kw):
+    def fn(ms, slot):
+        best = builtins.min(_entries(ms, slot), key=lambda e: (e[0][0], e[0][-1]))
+        return best[0][-1]
+
+    return fn
+
+
+def _argmax_factory(**kw):
+    def fn(ms, slot):
+        best = builtins.max(_entries(ms, slot), key=lambda e: (e[0][0], -e[0][-1]))
+        return best[0][-1]
+
+    return fn
+
+
+def _unique_factory(**kw):
+    def fn(ms, slot):
+        distinct = {args[0] for args, _ in _entries(ms, slot)}
+        if len(distinct) != 1:
+            return ERROR
+        return next(iter(distinct))
+
+    return fn
+
+
+def _any_factory(**kw):
+    def fn(ms, slot):
+        return builtins.min(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))[0][0]
+
+    return fn
+
+
+def _avg_factory(**kw):
+    def fn(ms, slot):
+        total = 0.0
+        n = 0
+        for args, count in _entries(ms, slot):
+            if args[0] is ERROR:
+                return ERROR
+            total += args[0] * count
+            n += count
+        return total / n if n else None
+
+    return fn
+
+
+def _sorted_tuple_factory(skip_nones: bool = False, **kw):
+    def fn(ms, slot):
+        vals = []
+        for args, count in _entries(ms, slot):
+            v = args[0]
+            if skip_nones and v is None:
+                continue
+            vals.extend([v] * count)
+        return builtins.tuple(builtins.sorted(vals))
+
+    return fn
+
+
+def _tuple_factory(skip_nones: bool = False, **kw):
+    def fn(ms, slot):
+        entries = builtins.sorted(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))
+        vals = []
+        for args, count in entries:
+            v = args[0]
+            if skip_nones and v is None:
+                continue
+            vals.extend([v] * count)
+        return builtins.tuple(vals)
+
+    return fn
+
+
+def _ndarray_factory(skip_nones: bool = False, **kw):
+    tup = _tuple_factory(skip_nones=skip_nones)
+
+    def fn(ms, slot):
+        return np.array(tup(ms, slot))
+
+    return fn
+
+
+def _earliest_factory(**kw):
+    def fn(ms, slot):
+        return builtins.min(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))[0][0]
+
+    return fn
+
+
+def _latest_factory(**kw):
+    def fn(ms, slot):
+        return builtins.max(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))[0][0]
+
+    return fn
+
+
+def _sum_return_type(arg_types: list[dt.DType]) -> dt.DType:
+    if not arg_types:
+        return dt.ANY
+    t = arg_types[0]
+    if t in (dt.INT, dt.FLOAT) or isinstance(t, dt._ArrayDType):
+        return t
+    return dt.ANY
+
+
+count = Reducer("count", _count_factory, lambda ts: dt.INT)
+sum = Reducer("sum", _sum_factory, _sum_return_type)
+min = Reducer("min", _min_factory)
+max = Reducer("max", _max_factory)
+argmin = Reducer("argmin", _argmin_factory, lambda ts: dt.POINTER)
+argmax = Reducer("argmax", _argmax_factory, lambda ts: dt.POINTER)
+unique = Reducer("unique", _unique_factory)
+any = Reducer("any", _any_factory)
+avg = Reducer("avg", _avg_factory, lambda ts: dt.FLOAT)
+earliest = Reducer("earliest", _earliest_factory)
+latest = Reducer("latest", _latest_factory)
+ndarray_reducer = Reducer(
+    "ndarray", _ndarray_factory, lambda ts: dt.ANY_ARRAY
+)
+
+
+def sorted_tuple(arg, skip_nones: bool = False) -> ReducerExpression:
+    r = Reducer(
+        "sorted_tuple",
+        lambda **kw: _sorted_tuple_factory(skip_nones=skip_nones),
+        lambda ts: dt.List(ts[0]) if ts else dt.ANY_TUPLE,
+    )
+    return ReducerExpression(r, arg)
+
+
+def tuple(arg, skip_nones: bool = False) -> ReducerExpression:  # noqa: A001
+    r = Reducer(
+        "tuple",
+        lambda **kw: _tuple_factory(skip_nones=skip_nones),
+        lambda ts: dt.List(ts[0]) if ts else dt.ANY_TUPLE,
+    )
+    return ReducerExpression(r, arg)
+
+
+def ndarray(arg, skip_nones: bool = False) -> ReducerExpression:
+    r = Reducer(
+        "ndarray",
+        lambda **kw: _ndarray_factory(skip_nones=skip_nones),
+        lambda ts: dt.ANY_ARRAY,
+    )
+    return ReducerExpression(r, arg)
+
+
+class StatefulReducer(Reducer):
+    """pw.reducers.stateful_many / stateful_single (reference:
+    custom_reducers.py; engine Reducer::Stateful)."""
+
+    def __init__(self, combine_many: Callable, name="stateful_many"):
+        self.combine_many = combine_many
+        super().__init__(name, lambda **kw: None, lambda ts: dt.ANY)
+        self.is_stateful = True
+
+
+def stateful_many(combine_many: Callable) -> Callable:
+    def wrapper(*args) -> ReducerExpression:
+        return ReducerExpression(StatefulReducer(combine_many), *args)
+
+    return wrapper
+
+
+def stateful_single(combine_single: Callable) -> Callable:
+    def combine_many(state, rows):
+        for row, count in rows:
+            if count > 0:
+                for _ in range(count):
+                    state = combine_single(state, *row)
+        return state
+
+    return stateful_many(combine_many)
+
+
+def udf_reducer(reducer_cls):
+    """@pw.reducers.udf_reducer over a BaseCustomAccumulator subclass."""
+
+    def combine_many(state, rows):
+        for row, count in rows:
+            if count <= 0:
+                continue
+            for _ in range(count):
+                neu = reducer_cls.from_row(list(row))
+                state = neu if state is None else state.update(neu)
+        return state
+
+    def wrapper(*args) -> ReducerExpression:
+        expr = ReducerExpression(
+            StatefulReducer(combine_many, name="udf_reducer"), *args
+        )
+        expr._post_process = lambda acc: acc.compute_result() if acc is not None else None
+        return expr
+
+    return wrapper

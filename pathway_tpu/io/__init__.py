@@ -1,0 +1,17 @@
+"""pw.io — IO connector surface (reference: python/pathway/io/, §2.3 of
+SURVEY: one module per system, each constructing engine data storage).
+
+Connectors with external service dependencies (kafka, postgres, s3, ...)
+are stubbed with informative errors until their native backends land.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.io import csv, fs, jsonlines, plaintext, python
+from pathway_tpu.io._subscribe import subscribe
+
+__all__ = ["csv", "fs", "jsonlines", "plaintext", "python", "subscribe"]
+
+
+class OnChangeCallback:  # typing alias used in reference signatures
+    pass

@@ -1,0 +1,38 @@
+"""Connector thread driver (reference: src/connectors/mod.rs:91 Connector —
+per-source thread reading into an mpsc channel drained by the main loop)."""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, Callable
+
+
+def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
+    subject = conn.subject
+    parser = conn.parser
+    pending: list = []
+
+    def emit(message: Any) -> None:
+        deltas = parser(message)
+        if deltas:
+            pending.extend(deltas)
+            if getattr(subject, "_autocommit", True):
+                flush()
+
+    def flush() -> None:
+        if pending:
+            out_queue.put((conn, pending.copy()))
+            pending.clear()
+
+    subject._attach(emit, flush)
+    try:
+        subject.run()
+    except Exception as exc:  # surfaced by the main loop
+        conn.node.scope.runtime.error = exc
+    finally:
+        try:
+            subject.on_stop()
+        except Exception:
+            pass
+        flush()
+        out_queue.put((conn, None))

@@ -1,0 +1,35 @@
+"""pw.io.subscribe (reference: python/pathway/io/_subscribe.py:13)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def subscribe(
+    table: Table,
+    on_change: Callable | None = None,
+    on_end: Callable | None = None,
+    on_time_end: Callable | None = None,
+    *,
+    name: str | None = None,
+    sort_by=None,
+) -> None:
+    """on_change(key, row: dict, time: int, is_addition: bool)."""
+    cols = table.column_names()
+
+    def wrapped_on_change(key, row, time, diff):
+        if on_change is not None:
+            on_change(key, dict(zip(cols, row)), time, diff > 0)
+
+    def lower(ctx):
+        ctx.scope.output(
+            ctx.engine_table(table),
+            on_change=wrapped_on_change if on_change is not None else None,
+            on_time_end=on_time_end,
+            on_end=on_end,
+        )
+
+    G.add_operator([table], [], lower, "subscribe", is_output=True)

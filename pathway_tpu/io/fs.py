@@ -1,0 +1,250 @@
+"""pw.io.fs — filesystem connector (reference: python/pathway/io/fs +
+src/connectors/scanner/filesystem.rs:139 — glob polling with metadata and
+deletion detection).
+
+Static mode materialises matching files once; streaming mode polls the glob
+for new/modified files on a connector thread.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import io as _io
+import json as _json
+import os
+import time
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import Json, ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import Schema, schema_from_types
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+def _iter_paths(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return sorted(out)
+    return sorted(_glob.glob(path))
+
+
+def _parse_file(path: str, fmt: str, value_columns, schema_cols, with_metadata):
+    rows: list[dict] = []
+    if fmt in ("csv", "dsv"):
+        with open(path, newline="") as f:
+            for rec in _csv.DictReader(f):
+                rows.append({k: _coerce(v) for k, v in rec.items()})
+    elif fmt in ("json", "jsonlines"):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(_json.loads(line))
+    elif fmt == "plaintext":
+        with open(path) as f:
+            for line in f:
+                rows.append({"data": line.rstrip("\n")})
+    elif fmt == "plaintext_by_file":
+        with open(path) as f:
+            rows.append({"data": f.read()})
+    elif fmt == "binary":
+        with open(path, "rb") as f:
+            rows.append({"data": f.read()})
+    else:
+        raise ValueError(f"unknown format {fmt!r}")
+    if with_metadata:
+        st = os.stat(path)
+        meta = {
+            "path": os.path.abspath(path),
+            "size": st.st_size,
+            "modified_at": int(st.st_mtime),
+            "seen_at": int(time.time()),
+        }
+        for r in rows:
+            r["_metadata"] = Json(meta)
+    return rows
+
+
+def _coerce(v: str):
+    if v is None:
+        return None
+    try:
+        return int(v)
+    except (ValueError, TypeError):
+        pass
+    try:
+        return float(v)
+    except (ValueError, TypeError):
+        pass
+    if v == "True":
+        return True
+    if v == "False":
+        return False
+    return v
+
+
+class _FsSubject(ConnectorSubject):
+    def __init__(self, path, fmt, schema, with_metadata, mode, refresh_interval=0.2):
+        super().__init__()
+        self.path = path
+        self.fmt = fmt
+        self.schema = schema
+        self.with_metadata = with_metadata
+        self.mode = mode
+        self.refresh_interval = refresh_interval
+        self._seen: dict[str, float] = {}
+        self._emitted: dict[str, list] = {}
+        self._stop = False
+
+    def _scan_once(self):
+        # modified-file diffing + deletion detection (reference:
+        # src/connectors/scanner/filesystem.rs object cache)
+        current = set()
+        for p in _iter_paths(self.path):
+            try:
+                mtime = os.path.getmtime(p)
+            except OSError:
+                continue
+            current.add(p)
+            if self._seen.get(p) == mtime:
+                continue
+            self._seen[p] = mtime
+            for old_row in self._emitted.pop(p, []):
+                self.remove(**old_row)
+            rows = _parse_file(
+                p, self.fmt, None, self.schema.column_names(), self.with_metadata
+            )
+            self._emitted[p] = rows
+            for row in rows:
+                self.next(**row)
+        for p in list(self._emitted):
+            if p not in current:
+                for old_row in self._emitted.pop(p, []):
+                    self.remove(**old_row)
+                self._seen.pop(p, None)
+        self.commit()
+
+    def run(self):
+        self._scan_once()
+        if self.mode == "static":
+            return
+        while not self._stop:
+            time.sleep(self.refresh_interval)
+            self._scan_once()
+
+    def on_stop(self):
+        self._stop = True
+
+
+def _infer_schema(path: str, fmt: str, with_metadata: bool) -> type[Schema]:
+    if fmt in ("plaintext", "plaintext_by_file"):
+        cols: dict[str, Any] = {"data": dt.STR}
+    elif fmt == "binary":
+        cols = {"data": dt.BYTES}
+    else:
+        sample_rows: list[dict] = []
+        for p in _iter_paths(path)[:3]:
+            sample_rows.extend(
+                _parse_file(p, fmt, None, [], False)[:20]
+            )
+        names: list[str] = []
+        for r in sample_rows:
+            for k in r:
+                if k not in names:
+                    names.append(k)
+        cols = {}
+        for name in names:
+            vals = [r.get(name) for r in sample_rows if name in r]
+            cols[name] = dt.lub(*(dt.dtype_of_value(v) for v in vals)) if vals else dt.ANY
+    if with_metadata:
+        cols["_metadata"] = dt.JSON
+    return schema_from_types(**cols)
+
+
+def read(
+    path: str,
+    *,
+    format: str = "csv",
+    schema: type[Schema] | None = None,
+    mode: str = "streaming",
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    refresh_interval: float = 0.2,
+    name: str | None = None,
+    **kwargs,
+) -> Table:
+    if format == "plaintext_by_object":
+        format = "plaintext_by_file"
+    if schema is None:
+        schema = _infer_schema(path, format, with_metadata)
+    elif with_metadata and "_metadata" not in schema.column_names():
+        from pathway_tpu.internals.schema import ColumnDefinition, schema_builder
+
+        cols = dict(schema.columns())
+        cols["_metadata"] = ColumnDefinition(dtype=dt.JSON, name="_metadata")
+        schema = schema_builder(cols)
+    if mode == "static":
+        # materialise immediately as a static table
+        rows = []
+        seq = 0
+        pkeys = schema.primary_key_columns()
+        cols = schema.column_names()
+        defaults = schema.default_values()
+        for p in _iter_paths(path):
+            for row in _parse_file(p, format, None, cols, with_metadata):
+                values = tuple(row.get(c, defaults.get(c)) for c in cols)
+                if pkeys:
+                    key = ref_scalar(*(row[c] for c in pkeys))
+                else:
+                    key = ref_scalar("fs", p, seq)
+                seq += 1
+                rows.append((key, *values))
+        from pathway_tpu.debug import table_from_rows
+
+        return table_from_rows(schema, rows)
+    subject = _FsSubject(path, format, schema, with_metadata, mode, refresh_interval)
+    return python_read(subject, schema=schema)
+
+
+def write(table: Table, filename: str, *, format: str = "csv", name: str | None = None, **kwargs) -> None:
+    cols = table.column_names()
+    state = {"file": None, "writer": None}
+
+    def ensure_open():
+        if state["file"] is None:
+            os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
+            state["file"] = open(filename, "w", newline="")
+            if format == "csv":
+                state["writer"] = _csv.writer(state["file"])
+                state["writer"].writerow(cols + ["time", "diff"])
+        return state["file"]
+
+    def on_change(key, row, time_, diff):
+        f = ensure_open()
+        if format == "csv":
+            state["writer"].writerow(list(row) + [time_, diff])
+        else:
+            payload = dict(zip(cols, row))
+            payload["time"] = time_
+            payload["diff"] = diff
+            f.write(_json.dumps(payload, default=str) + "\n")
+        f.flush()
+
+    def on_end():
+        if state["file"] is None:
+            ensure_open()
+        state["file"].close()
+
+    def lower(ctx):
+        ctx.scope.output(
+            ctx.engine_table(table), on_change=on_change, on_end=on_end
+        )
+
+    G.add_operator([table], [], lower, f"fs_write_{format}", is_output=True)

@@ -1,0 +1,143 @@
+"""pw.io.python — custom Python connectors (reference:
+python/pathway/io/python/__init__.py:49 ConnectorSubject with
+next()/commit()/close() protocol and *COMMIT*/*FINISH* literals :43-46)."""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import Pointer, ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import Schema
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.universe import Universe
+
+COMMIT_LITERAL = "*COMMIT*"
+FINISH_LITERAL = "*FINISH*"
+
+
+class ConnectorSubject:
+    """Subclass and implement run(); push rows with next()/next_json()/...
+
+    The runtime runs ``run()`` on a dedicated thread per source (reference:
+    connector thread, src/connectors/mod.rs:91) and stamps a commit timestamp
+    per flush.
+    """
+
+    _deletions_enabled: bool = True
+
+    def __init__(self, datasource_name: str = "python"):
+        self._emit = None
+        self._flush = None
+        self._autocommit = True
+        self._finished = False
+
+    # wired by the engine runtime
+    def _attach(self, emit, flush) -> None:
+        self._emit = emit
+        self._flush = flush
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+    def start(self) -> None:
+        self.run()
+
+    # -- producer API ------------------------------------------------------
+    def next(self, **kwargs) -> None:
+        if self._finished:
+            return
+        self._emit(("upsert", kwargs))
+
+    def next_json(self, message: dict) -> None:
+        self.next(**message)
+
+    def next_str(self, message: str) -> None:
+        if message == COMMIT_LITERAL:
+            self.commit()
+            return
+        if message == FINISH_LITERAL:
+            # end-of-stream sentinel (reference: io/python/__init__.py:43-46):
+            # later messages are dropped and the final batch is flushed.
+            self._finished = True
+            self.commit()
+            return
+        self.next(data=message)
+
+    def next_bytes(self, message: bytes) -> None:
+        self.next(data=message)
+
+    def _remove(self, key: Pointer, values: dict) -> None:
+        self._emit(("remove", values, key))
+
+    def remove(self, **kwargs) -> None:
+        self._emit(("remove", kwargs, None))
+
+    def commit(self) -> None:
+        if self._flush is not None:
+            self._flush()
+
+    def close(self) -> None:
+        self.commit()
+
+
+def _make_parser(schema: type[Schema]):
+    from pathway_tpu.engine.stream import freeze_row
+
+    cols = schema.column_names()
+    pkeys = schema.primary_key_columns()
+    defaults = schema.default_values()
+    seq = [0]
+    # content -> stack of keys minted for it, so remove() retracts the row
+    # actually inserted (schemas without primary keys mint per-row keys).
+    live_keys: dict[tuple, list] = {}
+
+    def parse(message) -> list[tuple]:
+        kind, values = message[0], message[1]
+        row = tuple(values.get(c, defaults.get(c)) for c in cols)
+        if pkeys:
+            key = ref_scalar(*(values[c] for c in pkeys))
+        elif kind == "remove":
+            if len(message) > 2 and message[2] is not None:
+                key = message[2]
+            else:
+                stack = live_keys.get(freeze_row(row))
+                if not stack:
+                    return []  # nothing to retract
+                key = stack.pop()
+        else:
+            seq[0] += 1
+            key = ref_scalar("py-connector", seq[0], *map(repr, row))
+            live_keys.setdefault(freeze_row(row), []).append(key)
+        diff = -1 if kind == "remove" else 1
+        return [(key, row, diff)]
+
+    return parse
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: type[Schema] | None = None,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs,
+) -> Table:
+    if schema is None:
+        raise ValueError("pw.io.python.read requires a schema")
+    out = Table(schema, Universe())
+    parser = _make_parser(schema)
+    width = len(schema.column_names())
+
+    def lower(ctx):
+        ctx.set_engine_table(
+            out, ctx.scope.connector_table(subject, parser, width)
+        )
+
+    G.add_operator([], [out], lower, "python_connector")
+    return out

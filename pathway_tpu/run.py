@@ -1,0 +1,24 @@
+"""pw.run — execute all captured output operators (reference:
+python/pathway/internals/run.py:12)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level=None,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config=None,
+    runtime_typechecking: bool | None = None,
+    terminate_on_error: bool = True,
+    **kwargs,
+) -> None:
+    GraphRunner(terminate_on_error=terminate_on_error).run_outputs()
+
+
+def run_all(**kwargs) -> None:
+    run(**kwargs)

@@ -1,0 +1,56 @@
+"""Retry strategies for async UDFs (reference:
+python/pathway/internals/udfs/retries.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from abc import ABC, abstractmethod
+
+
+class AsyncRetryStrategy(ABC):
+    @abstractmethod
+    async def invoke(self, async_fn, /, *args, **kwargs): ...
+
+
+class NoRetryStrategy(AsyncRetryStrategy):
+    async def invoke(self, async_fn, /, *args, **kwargs):
+        return await async_fn(*args, **kwargs)
+
+
+class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
+    def __init__(
+        self,
+        max_retries: int = 3,
+        initial_delay: int = 1_000,
+        backoff_factor: float = 2.0,
+        jitter_ms: int = 300,
+    ):
+        self._max_retries = max_retries
+        self._initial_delay = initial_delay / 1000
+        self._backoff_factor = backoff_factor
+        self._jitter = jitter_ms / 1000
+
+    async def invoke(self, async_fn, /, *args, **kwargs):
+        delay = self._initial_delay
+        for attempt in range(self._max_retries + 1):
+            try:
+                return await async_fn(*args, **kwargs)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                if attempt == self._max_retries:
+                    raise
+                await asyncio.sleep(delay + random.random() * self._jitter)
+                delay *= self._backoff_factor
+        raise RuntimeError("unreachable")
+
+
+class FixedDelayRetryStrategy(ExponentialBackoffRetryStrategy):
+    def __init__(self, max_retries: int = 3, delay_ms: int = 1_000):
+        super().__init__(
+            max_retries=max_retries,
+            initial_delay=delay_ms,
+            backoff_factor=1.0,
+            jitter_ms=0,
+        )

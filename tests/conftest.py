@@ -1,0 +1,21 @@
+import os
+
+# Must be set before jax initializes: tests run on a virtual 8-device CPU
+# mesh so multi-chip sharding paths are exercised without TPU hardware.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(autouse=True)
+def _clear_graph():
+    G.clear()
+    yield
+    G.clear()

@@ -1,0 +1,693 @@
+"""Core DSL semantics (modelled on reference python/pathway/tests/test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+    run_table,
+)
+
+
+def test_select_column():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+      2 | 3 | 4
+        """
+    )
+    res = t.select(c=t.a + t.b)
+    expected = T(
+        """
+        | c
+      1 | 3
+      2 | 7
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_select_this():
+    t = T(
+        """
+        | a  | b
+      1 | 10 | 2
+      2 | 30 | 4
+        """
+    )
+    res = t.select(pw.this.a, doubled=pw.this.b * 2)
+    expected = T(
+        """
+        | a  | doubled
+      1 | 10 | 4
+      2 | 30 | 8
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_with_columns():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+        """
+    )
+    res = t.with_columns(c=pw.this.a * 100)
+    expected = T(
+        """
+        | a | b | c
+      1 | 1 | 2 | 100
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        | v
+      1 | 1
+      2 | 5
+      3 | 10
+        """
+    )
+    res = t.filter(t.v > 4)
+    expected = T(
+        """
+        | v
+      2 | 5
+      3 | 10
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_filter_expressions():
+    t = T(
+        """
+        | a | b
+      1 | 1 | x
+      2 | 2 | y
+      3 | 3 | x
+        """
+    )
+    res = t.filter((pw.this.b == "x") & (pw.this.a < 3))
+    assert list(run_table(res).values()) == [(1, "x")]
+
+
+def test_arithmetic():
+    t = T(
+        """
+        | a | b
+      1 | 7 | 2
+        """
+    )
+    res = t.select(
+        add=t.a + t.b,
+        sub=t.a - t.b,
+        mul=t.a * t.b,
+        div=t.a / t.b,
+        floordiv=t.a // t.b,
+        mod=t.a % t.b,
+        pow=t.a**t.b,
+        neg=-t.a,
+    )
+    rows = list(run_table(res).values())
+    assert rows == [(9, 5, 14, 3.5, 3, 1, 49, -7)]
+
+
+def test_comparisons_and_bool():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+      2 | 3 | 3
+        """
+    )
+    res = t.select(
+        lt=t.a < t.b,
+        le=t.a <= t.b,
+        eq=t.a == t.b,
+        ne=t.a != t.b,
+        both=(t.a < t.b) | (t.a == t.b),
+        inv=~(t.a == t.b),
+    )
+    rows = sorted(run_table(res).values())
+    assert rows == sorted([(True, True, False, True, True, True), (False, True, True, False, True, False)])
+
+
+def test_if_else():
+    t = T(
+        """
+        | a
+      1 | 1
+      2 | -2
+        """
+    )
+    res = t.select(sign=pw.if_else(t.a >= 0, "pos", "neg"))
+    assert sorted(run_table(res).values()) == [("neg",), ("pos",)]
+
+
+def test_if_else_lazy_branches():
+    t = T(
+        """
+        | a | b
+      1 | 6 | 2
+      2 | 6 | 0
+        """
+    )
+    res = t.select(d=pw.if_else(t.b != 0, t.a // pw.unwrap(t.b), -1))
+    assert sorted(run_table(res).values()) == [(-1,), (3,)]
+
+
+def test_coalesce():
+    t = T(
+        """
+        | a    | b
+      1 | None | 5
+      2 | 2    | 7
+        """
+    )
+    res = t.select(c=pw.coalesce(t.a, t.b))
+    assert sorted(run_table(res).values()) == [(2,), (5,)]
+
+
+def test_is_none():
+    t = T(
+        """
+        | a
+      1 | None
+      2 | 2
+        """
+    )
+    res = t.select(none=t.a.is_none(), not_none=t.a.is_not_none())
+    assert sorted(run_table(res).values()) == [(False, True), (True, False)]
+
+
+def test_apply():
+    t = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    res = t.select(sq=pw.apply(lambda x: x * x, t.a))
+    assert sorted(run_table(res).values()) == [(1,), (4,)]
+
+
+def test_rename_without_prefix():
+    t = T(
+        """
+        | a | b | c
+      1 | 1 | 2 | 3
+        """
+    )
+    assert run_table(t.without(t.b)) == run_table(t.select(t.a, t.c))
+    r = t.rename_columns(x=t.a)
+    assert r.column_names() == ["x", "b", "c"]
+    p = t.with_prefix("p_")
+    assert p.column_names() == ["p_a", "p_b", "p_c"]
+
+
+def test_concat():
+    t1 = T(
+        """
+        | a
+      1 | 1
+        """
+    )
+    t2 = T(
+        """
+        | a
+      2 | 2
+        """
+    )
+    res = t1.concat(t2)
+    expected = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_concat_reindex():
+    t1 = T(
+        """
+        | a
+      1 | 1
+        """
+    )
+    t2 = T(
+        """
+        | a
+      1 | 2
+        """
+    )
+    res = t1.concat_reindex(t2)
+    assert sorted(run_table(res).values()) == [(1,), (2,)]
+
+
+def test_update_rows():
+    t1 = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    t2 = T(
+        """
+        | a
+      2 | 20
+      3 | 30
+        """
+    )
+    res = t1.update_rows(t2)
+    expected = T(
+        """
+        | a
+      1 | 1
+      2 | 20
+      3 | 30
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_update_cells():
+    t1 = T(
+        """
+        | a | b
+      1 | 1 | x
+      2 | 2 | y
+        """
+    )
+    t2 = T(
+        """
+        | b
+      2 | z
+        """
+    )
+    res = t1.update_cells(t2)
+    expected = T(
+        """
+        | a | b
+      1 | 1 | x
+      2 | 2 | z
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_difference_intersect():
+    t1 = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    t2 = T(
+        """
+        | b
+      2 | 0
+        """
+    )
+    assert list(run_table(t1.difference(t2)).values()) == [(1,)]
+    assert list(run_table(t1.intersect(t2)).values()) == [(2,)]
+
+
+def test_restrict():
+    t1 = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+      3 | 3
+        """
+    )
+    t2 = T(
+        """
+        | b
+      2 | 0
+      3 | 0
+        """
+    )
+    res = t1.restrict(t2)
+    assert sorted(run_table(res).values()) == [(2,), (3,)]
+
+
+def test_flatten():
+    t = T(
+        """
+        | a
+      1 | abc
+        """
+    )
+    split = t.select(parts=pw.apply(lambda s: tuple(s), t.a))
+    res = split.flatten(split.parts)
+    assert sorted(run_table(res).values()) == [("a",), ("b",), ("c",)]
+
+
+def test_with_id_from():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 10
+      2 | 2 | 20
+        """
+    )
+    res = t.with_id_from(t.a)
+    rows = run_table(res)
+    assert sorted(rows.values()) == [(1, 10), (2, 20)]
+    from pathway_tpu.internals.api import ref_scalar
+
+    assert set(rows.keys()) == {ref_scalar(1), ref_scalar(2)}
+
+
+def test_ix():
+    queries = T(
+        """
+        | d
+      1 | 10
+      2 | 20
+        """
+    )
+    data = queries.with_id_from(queries.d).select(v=pw.this.d * 7)
+    target = queries.select(ptr=queries.pointer_from(queries.d))
+    res = target.select(v=data.ix(target.ptr).v)
+    assert sorted(run_table(res).values()) == [(70,), (140,)]
+
+
+def test_groupby_sum_count():
+    t = T(
+        """
+        | k | v
+      1 | a | 1
+      2 | a | 2
+      3 | b | 5
+        """
+    )
+    res = t.groupby(t.k).reduce(
+        t.k,
+        s=pw.reducers.sum(t.v),
+        c=pw.reducers.count(),
+    )
+    assert sorted(run_table(res).values()) == [("a", 3, 2), ("b", 5, 1)]
+
+
+def test_groupby_min_max_avg():
+    t = T(
+        """
+        | k | v
+      1 | a | 1
+      2 | a | 4
+      3 | b | 5
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        pw.this.k,
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        av=pw.reducers.avg(pw.this.v),
+    )
+    assert sorted(run_table(res).values()) == [("a", 1, 4, 2.5), ("b", 5, 5, 5.0)]
+
+
+def test_groupby_argmin_argmax():
+    t = T(
+        """
+        | k | v
+      1 | a | 3
+      2 | a | 1
+      3 | a | 2
+        """
+    )
+    res2 = t.groupby(t.k).reduce(am=pw.reducers.argmin(t.v))
+    rows = list(run_table(res2).values())
+    t_rows = run_table(t)
+    assert [t_rows[r[0]] for r in rows] == [("a", 1)]
+
+
+def test_reduce_global():
+    t = T(
+        """
+        | v
+      1 | 1
+      2 | 2
+      3 | 3
+        """
+    )
+    res = t.reduce(s=pw.reducers.sum(t.v))
+    assert list(run_table(res).values()) == [(6,)]
+
+
+def test_groupby_sorted_tuple():
+    t = T(
+        """
+        | k | v
+      1 | a | 3
+      2 | a | 1
+        """
+    )
+    res = t.groupby(t.k).reduce(vals=pw.reducers.sorted_tuple(t.v))
+    assert list(run_table(res).values()) == [((1, 3),)]
+
+
+def test_groupby_unique_any():
+    t = T(
+        """
+        | k | u | v
+      1 | a | 7 | 1
+      2 | a | 7 | 2
+        """
+    )
+    res = t.groupby(t.k).reduce(u=pw.reducers.unique(t.u))
+    assert list(run_table(res).values()) == [(7,)]
+
+
+def test_join_inner():
+    t1 = T(
+        """
+        | k | a
+      1 | x | 1
+      2 | y | 2
+        """
+    )
+    t2 = T(
+        """
+        | k | b
+      1 | x | 10
+      2 | z | 30
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k).select(t1.k, t1.a, t2.b)
+    assert sorted(run_table(res).values()) == [("x", 1, 10)]
+
+
+def test_join_left():
+    t1 = T(
+        """
+        | k | a
+      1 | x | 1
+      2 | y | 2
+        """
+    )
+    t2 = T(
+        """
+        | k | b
+      1 | x | 10
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k, how="left").select(t1.a, t2.b)
+    assert sorted(run_table(res).values(), key=repr) == [(1, 10), (2, None)]
+
+
+def test_join_outer():
+    t1 = T(
+        """
+        | k | a
+      1 | x | 1
+      2 | y | 2
+        """
+    )
+    t2 = T(
+        """
+        | k | b
+      1 | x | 10
+      2 | z | 30
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k, how="outer").select(t1.a, t2.b)
+    assert sorted(run_table(res).values(), key=repr) == [(1, 10), (2, None), (None, 30)]
+
+
+def test_join_this_select():
+    t1 = T(
+        """
+        | k | a
+      1 | x | 1
+        """
+    )
+    t2 = T(
+        """
+        | k | b
+      1 | x | 10
+        """
+    )
+    res = t1.join(t2, pw.left.k == pw.right.k).select(pw.this.k, pw.this.a, pw.this.b)
+    assert list(run_table(res).values()) == [("x", 1, 10)]
+
+
+def test_join_expression_keys():
+    t1 = T(
+        """
+        | a
+      1 | 2
+        """
+    )
+    t2 = T(
+        """
+        | b
+      1 | 4
+        """
+    )
+    res = t1.join(t2, t1.a * 2 == t2.b).select(t1.a, t2.b)
+    assert list(run_table(res).values()) == [(2, 4)]
+
+
+def test_sort():
+    t = T(
+        """
+        | v
+      1 | 30
+      2 | 10
+      3 | 20
+        """
+    )
+    res = t.sort(key=t.v)
+    rows = run_table(res)
+    t_rows = run_table(t)
+    by_val = {row[0]: k for k, row in t_rows.items()}
+    assert rows[by_val[10]][0] is None
+    assert rows[by_val[10]][1] == by_val[20]
+    assert rows[by_val[20]] == (by_val[10], by_val[30])
+    assert rows[by_val[30]][1] is None
+
+
+def test_deduplicate():
+    t = T(
+        """
+        | v
+      1 | 1
+      2 | 2
+      3 | 1
+      4 | 5
+        """
+    )
+    res = t.deduplicate(value=t.v, acceptor=lambda new, old: new > old)
+    vals = list(run_table(res).values())
+    assert vals == [(5,)]
+
+
+def test_groupby_expression_output():
+    t = T(
+        """
+        | k | v
+      1 | a | 1
+      2 | a | 2
+        """
+    )
+    res = t.groupby(t.k).reduce(
+        doubled=pw.reducers.sum(t.v) * 2,
+        labeled=pw.this.k + "!",
+    )
+    assert list(run_table(res).values()) == [(6, "a!")]
+
+
+def test_cast_and_declare():
+    t = T(
+        """
+        | a
+      1 | 1
+        """
+    )
+    res = t.select(f=pw.cast(float, t.a), s=pw.cast(str, t.a))
+    assert list(run_table(res).values()) == [(1.0, "1")]
+
+
+def test_make_tuple_and_get():
+    t = T(
+        """
+        | a | b
+      1 | 1 | 2
+        """
+    )
+    res = t.select(tup=pw.make_tuple(t.a, t.b))
+    res2 = res.select(first=res.tup[0], second=res.tup.get(5, default=-1))
+    assert list(run_table(res2).values()) == [(1, -1)]
+
+
+def test_str_namespace():
+    t = T(
+        """
+        | s
+      1 | Hello
+        """
+    )
+    res = t.select(
+        up=t.s.str.upper(),
+        low=t.s.str.lower(),
+        n=t.s.str.len(),
+        sw=t.s.str.startswith("He"),
+    )
+    assert list(run_table(res).values()) == [("HELLO", "hello", 5, True)]
+
+
+def test_num_namespace():
+    t = T(
+        """
+        | x
+      1 | -3.7
+        """
+    )
+    res = t.select(a=t.x.num.abs(), r=t.x.num.round(1))
+    assert list(run_table(res).values()) == [(3.7, -3.7)]
+
+
+def test_pointer_from_join():
+    t1 = T(
+        """
+        | k | v
+      1 | a | 1
+        """
+    )
+    summary = t1.groupby(t1.k).reduce(t1.k, s=pw.reducers.sum(t1.v))
+    enriched = t1.select(t1.k, t1.v, total=summary.ix(t1.pointer_from(t1.k)).s)
+    assert list(run_table(enriched).values()) == [("a", 1, 1)]
+
+
+def test_empty_table():
+    t = pw.Table.empty(a=int)
+    assert run_table(t) == {}
+
+
+def test_same_universe_cross_ref():
+    t1 = T(
+        """
+        | a
+      1 | 1
+      2 | 2
+        """
+    )
+    t2 = t1.select(b=t1.a * 10)
+    res = t1.select(t1.a, t2.b)
+    assert sorted(run_table(res).values()) == [(1, 10), (2, 20)]

@@ -1,0 +1,78 @@
+"""Test helpers mirroring the reference Tier-1 pattern (reference:
+python/pathway/tests/utils.py — T :531, assert_table_equality)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.debug import table_from_markdown
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+T = table_from_markdown
+
+
+def _normalize(v: Any) -> Any:
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return ("ndarray", v.shape, tuple(np.asarray(v).ravel().tolist()))
+    if isinstance(v, float) and v == int(v):
+        return v
+    if isinstance(v, tuple):
+        return tuple(_normalize(x) for x in v)
+    return v
+
+
+def _run_two(t1: pw.Table, t2: pw.Table):
+    caps = GraphRunner().run_tables(t1, t2)
+    return caps[0], caps[1]
+
+
+def assert_table_equality(t1: pw.Table, t2: pw.Table) -> None:
+    c1, c2 = _run_two(t1, t2)
+    cols1 = t1.column_names()
+    cols2 = t2.column_names()
+    assert sorted(cols1) == sorted(cols2), f"columns differ: {cols1} vs {cols2}"
+    order2 = [cols2.index(c) for c in cols1]
+    rows1 = {k: tuple(_normalize(v) for v in row) for k, row in c1.state.rows.items()}
+    rows2 = {
+        k: tuple(_normalize(row[i]) for i in order2)
+        for k, row in c2.state.rows.items()
+    }
+    assert rows1 == rows2, f"tables differ:\n{rows1}\nvs\n{rows2}"
+
+
+def assert_table_equality_wo_index(t1: pw.Table, t2: pw.Table) -> None:
+    c1, c2 = _run_two(t1, t2)
+    cols1 = t1.column_names()
+    cols2 = t2.column_names()
+    assert sorted(cols1) == sorted(cols2), f"columns differ: {cols1} vs {cols2}"
+    order2 = [cols2.index(c) for c in cols1]
+    rows1 = sorted(
+        (tuple(_normalize(v) for v in row) for row in c1.state.rows.values()),
+        key=repr,
+    )
+    rows2 = sorted(
+        (
+            tuple(_normalize(row[i]) for i in order2)
+            for row in c2.state.rows.values()
+        ),
+        key=repr,
+    )
+    assert rows1 == rows2, f"tables differ:\n{rows1}\nvs\n{rows2}"
+
+
+# reference aliases
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def run_table(t: pw.Table) -> dict:
+    [cap] = GraphRunner().run_tables(t)
+    return dict(cap.state.rows)
+
+
+def run_update_stream(t: pw.Table) -> list:
+    [cap] = GraphRunner().run_tables(t)
+    return list(cap.updates)
