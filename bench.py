@@ -1,0 +1,80 @@
+"""Headline benchmark: embedding ingest throughput (docs/s/chip).
+
+North-star config from BASELINE.json: VectorStoreServer batch indexing with
+a bge-small-class embedder, target >= 10k docs/s on TPU v5e-8, i.e. 1250
+docs/s/chip. This bench drives the flagship path end to end on whatever
+device is default (the driver runs it on one real TPU chip): hash-tokenize →
+jitted bf16 encoder forward (bucketed shapes) → sharded-capable KNN index
+add. Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+TARGET_PER_CHIP = 10_000 / 8  # BASELINE.json north-star on v5e-8
+
+
+def make_docs(n: int, words: int = 90, seed: int = 0) -> list[str]:
+    rng = np.random.default_rng(seed)
+    vocab = [f"token{i}" for i in range(5000)]
+    return [
+        " ".join(vocab[j] for j in rng.integers(0, len(vocab), size=words))
+        for i in range(n)
+    ]
+
+
+def main() -> None:
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops import KnnShard
+
+    batch_size = 256
+    enc = SentenceEncoder(EncoderConfig.bge_small(), batch_size=batch_size)
+    # pre-size the index: each capacity is a distinct XLA executable, so
+    # growth reshapes mid-benchmark would measure recompiles, not ingest
+    index = KnnShard(
+        enc.embed_dim, "cos", precision="default", capacity=1 << 17
+    )
+
+    docs = make_docs(4 * batch_size)
+    # warm up compilation (one pass per shape) before timing
+    emb0 = enc.encode_device(docs[:batch_size])
+    index.add(list(range(batch_size)), emb0)
+
+    deadline = time.perf_counter() + 12.0
+    done = 0
+    t0 = time.perf_counter()
+    key_base = batch_size
+    while time.perf_counter() < deadline:
+        chunk = docs[:batch_size]
+        # device-resident pipeline: encoder output feeds the index without
+        # a host round-trip; host tokenization overlaps device compute
+        embs = enc.encode_device(chunk)
+        index.add(list(range(key_base, key_base + len(chunk))), embs)
+        key_base += len(chunk)
+        done += len(chunk)
+    index.vectors.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    # sanity: the index must answer queries over what was ingested
+    hits = index.search(np.asarray(embs[:4]), k=3)
+    assert all(len(h) == 3 for h in hits)
+
+    docs_per_s = done / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "embed_ingest_docs_per_s_per_chip",
+                "value": round(docs_per_s, 1),
+                "unit": "docs/s",
+                "vs_baseline": round(docs_per_s / TARGET_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

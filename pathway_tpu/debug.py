@@ -59,6 +59,10 @@ def _markdown_rows(table_def: str, split_on_whitespace: bool = True):
             continue
         if sep == "|":
             toks = [t.strip() for t in line.split("|")]
+        elif len(cols) == 1 and not has_id_col:
+            # single unlabeled column: the whole line is one value (spaces
+            # included) — matches reference table_from_markdown behavior
+            toks = [line.strip()]
         else:
             toks = line.split()
         if has_id_col:

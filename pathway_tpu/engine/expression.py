@@ -15,6 +15,8 @@ import asyncio
 import math
 from typing import Any, Callable
 
+import numpy as _np
+
 from pathway_tpu.internals import expression as expr
 from pathway_tpu.internals.api import ERROR, Json, Pointer, ref_scalar
 
@@ -76,12 +78,17 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
         ef = compile_expression(e._else, resolver, runtime)
 
         def eval_ifelse(keys, rows):
-            mask = cf(keys, rows)
+            raw = cf(keys, rows)
             n = len(keys)
+            # normalize numpy bools; non-booleans (None/Error) poison the row
+            mask = [
+                bool(m) if isinstance(m, (bool, _np.bool_)) else None
+                for m in raw
+            ]
             out: list[Any] = [None] * n
             t_idx = [i for i in range(n) if mask[i] is True]
             f_idx = [i for i in range(n) if mask[i] is False]
-            e_idx = [i for i in range(n) if mask[i] is not True and mask[i] is not False]
+            e_idx = [i for i in range(n) if mask[i] is None]
             if t_idx:
                 vals = tf([keys[i] for i in t_idx], [rows[i] for i in t_idx])
                 for i, v in zip(t_idx, vals):
@@ -384,7 +391,8 @@ def _compile_async_apply(e: expr.AsyncApplyExpression, resolver, runtime) -> Eva
 
             return await asyncio.gather(*(one(i) for i in range(n)))
 
-        loop = runtime.async_loop if runtime is not None else asyncio.new_event_loop()
-        return list(loop.run_until_complete(run_all()))
+        if runtime is not None:
+            return list(runtime.async_loop.run_until_complete(run_all()))
+        return list(asyncio.run(run_all()))
 
     return eval_async

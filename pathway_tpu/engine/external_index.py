@@ -1,0 +1,118 @@
+"""External-index dataflow operator: as-of-time index/query stream sync.
+
+Re-derivation of the reference's external-index operator
+(/root/reference/src/engine/dataflow/operators/external_index.rs:81-163):
+index diffs and queries are merged and batched by logical time, so every
+query sees exactly the index state as of its timestamp; query retractions
+replay the memoized answer so downstream multisets cancel exactly. The
+reference broadcasts index diffs to every worker (each holds a full copy,
+:95-106); our index adapters may instead be mesh-sharded
+(pathway_tpu.parallel.sharded_knn) — the time-batching semantics here are
+unchanged, the sharding lives inside the adapter.
+
+Two modes (stdlib/indexing/data_index.py:46-473 in the reference):
+* as_of_now: answer once at query insertion time, never revisit;
+* revising: maintained — when index updates arrive, affected answers are
+  retracted and re-emitted.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol, Sequence
+
+from pathway_tpu.engine.nodes import Node
+from pathway_tpu.engine.stream import Delta, Key, Row, consolidate, negate
+
+
+class ExternalIndexAdapter(Protocol):
+    """Host adapter owning the actual index (KNN shard, BM25, HNSW...)."""
+
+    def add(self, key: Key, data: Any, filter_data: Any | None) -> None: ...
+
+    def remove(self, key: Key) -> None: ...
+
+    def search(
+        self, queries: Sequence[tuple[Any, int, Any]]
+    ) -> list[tuple[tuple, tuple]]:
+        """queries: [(query_data, limit, filter)] -> per query
+        (matched_keys_tuple, scores_tuple)."""
+        ...
+
+
+class ExternalIndexNode(Node):
+    """Port 0: index stream; port 1: query stream.
+
+    Output rows: query_row + (matched_ids: tuple, scores: tuple). Output key
+    is the query key.
+    """
+
+    def __init__(
+        self,
+        scope,
+        index_node,
+        query_node,
+        adapter: ExternalIndexAdapter,
+        index_fn: Callable[[Key, Row], tuple[Any, Any]],  # -> (data, filter_data)
+        query_fn: Callable[[Key, Row], tuple[Any, int, Any]],  # -> (data, limit, filter)
+        mode: str = "as_of_now",  # or "revising"
+    ):
+        super().__init__(scope, [index_node, query_node])
+        self.adapter = adapter
+        self.index_fn = index_fn
+        self.query_fn = query_fn
+        self.mode = mode
+        # memoized answers: query key -> (query_row, result_cols)
+        self.answers: dict[Key, tuple[Row, tuple]] = {}
+        # live queries (revising mode): key -> row
+        self.live: dict[Key, Row] = {}
+
+    def process(self, time, batches):
+        index_deltas = consolidate(batches[0])
+        query_deltas = consolidate(batches[1])
+        out: list[Delta] = []
+
+        # 1. apply index updates first — queries at time t see the index
+        #    as of t (reference: batch merge by time, external_index.rs:112).
+        #    Removes run before adds: a same-key update may arrive as
+        #    (+new, -old) within one consolidated batch, and add-then-remove
+        #    would delete the live row.
+        index_changed = bool(index_deltas)
+        for k, row, d in index_deltas:
+            if d < 0:
+                self.adapter.remove(k)
+        for k, row, d in index_deltas:
+            if d > 0:
+                data, fdata = self.index_fn(k, row)
+                self.adapter.add(k, data, fdata)
+
+        # 2. retractions of queries replay the memoized answer
+        to_answer: list[tuple[Key, Row]] = []
+        for k, row, d in query_deltas:
+            if d < 0:
+                memo = self.answers.pop(k, None)
+                self.live.pop(k, None)
+                if memo is not None:
+                    out.append((k, memo[0] + memo[1], -1))
+            else:
+                to_answer.append((k, row))
+
+        # 3. revising mode: index changes re-answer all live queries
+        if self.mode == "revising" and index_changed and self.live:
+            for k, row in self.live.items():
+                memo = self.answers.pop(k, None)
+                if memo is not None:
+                    out.append((k, memo[0] + memo[1], -1))
+                to_answer.append((k, row))
+
+        # 4. answer new queries against the as-of-t index, batched
+        if to_answer:
+            qspecs = [self.query_fn(k, row) for k, row in to_answer]
+            results = self.adapter.search(qspecs)
+            for (k, row), res in zip(to_answer, results):
+                result_cols = (tuple(res[0]), tuple(res[1]))
+                self.answers[k] = (row, result_cols)
+                if self.mode == "revising":
+                    self.live[k] = row
+                out.append((k, row + result_cols, 1))
+
+        return consolidate(out)

@@ -21,6 +21,8 @@ import itertools
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
+import numpy as _np
+
 from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
 from pathway_tpu.engine.stream import (
     Delta,
@@ -29,6 +31,7 @@ from pathway_tpu.engine.stream import (
     Row,
     TableState,
     consolidate,
+    freeze_row,
     negate,
 )
 
@@ -95,7 +98,6 @@ class RowwiseNode(Node):
     def __init__(self, scope, input_node, batch_fn: Callable[[list[Key], list[Row]], list[Row]]):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
-        self._memo: dict[tuple[Key, Row], Row] = {}
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
@@ -119,7 +121,7 @@ class MemoizedRowwiseNode(Node):
     def __init__(self, scope, input_node, batch_fn):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
-        self._memo: dict[Key, tuple[Row, Row]] = {}
+        self._memo: dict[Key, tuple[tuple, Row]] = {}  # key -> (frozen_in, out)
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
@@ -130,7 +132,7 @@ class MemoizedRowwiseNode(Node):
         for k, row, d in deltas:
             if d < 0:
                 memo = self._memo.get(k)
-                if memo is not None and memo[0] == row:
+                if memo is not None and memo[0] == freeze_row(row):
                     out.append((k, memo[1], d))
                     del self._memo[k]
                 else:
@@ -143,7 +145,7 @@ class MemoizedRowwiseNode(Node):
             )
             for (k, row, d), nr in zip(to_compute, new_rows):
                 if d > 0:
-                    self._memo[k] = (row, nr)
+                    self._memo[k] = (freeze_row(row), nr)
                 out.append((k, nr, d))
         return consolidate(out)
 
@@ -158,7 +160,13 @@ class FilterNode(Node):
         if not deltas:
             return []
         mask = self.mask_fn([d[0] for d in deltas], [d[1] for d in deltas])
-        return [d for d, m in zip(deltas, mask) if m is True]
+        # accept numpy bools from UDF-produced masks; anything non-boolean
+        # (None, Error) drops the row, matching engine filter semantics
+        return [
+            d
+            for d, m in zip(deltas, mask)
+            if isinstance(m, (bool, _np.bool_)) and bool(m)
+        ]
 
 
 class ReindexNode(Node):
@@ -187,7 +195,9 @@ class FlattenNode(Node):
             val = row[self.flatten_idx]
             if val is None:
                 continue
-            items = list(val) if not isinstance(val, str) else list(val)
+            # strings flatten into characters, matching the reference
+            # (dataflow.rs flatten_table: Value::String -> chars)
+            items = list(val)
             for i, item in enumerate(items):
                 new_row = row[: self.flatten_idx] + (item,) + row[self.flatten_idx + 1 :]
                 out.append((ref_scalar(k, i), new_row, d))
@@ -248,6 +258,8 @@ class JoinNode(GroupDiffNode):
         right_width: int | None = None,
         id_from_left: bool = False,
         id_from_right: bool = False,
+        left_id_fn=None,
+        right_id_fn=None,
         exact_match: bool = False,
     ):
         super().__init__(scope, [left_node, right_node])
@@ -260,6 +272,10 @@ class JoinNode(GroupDiffNode):
         self.right_width = right_width
         self.id_from_left = id_from_left
         self.id_from_right = id_from_right
+        # id= with a pointer-valued column: output ids are the expression's
+        # VALUES on that side, not the side's row ids
+        self.left_id_fn = left_id_fn
+        self.right_id_fn = right_id_fn
 
     def group_of(self, port, key, row):
         return self.left_key_fn(key, row) if port == 0 else self.right_key_fn(key, row)
@@ -278,18 +294,35 @@ class JoinNode(GroupDiffNode):
         if lrows and rrows:
             for (lk, lrow), lc in lrows.items():
                 for (rk, rrow), rc in rrows.items():
-                    out.append((self._out_key(lk, rk), lrow + rrow, lc * rc))
+                    out.append(
+                        (self._out_key(lk, lrow, rk, rrow), lrow + rrow, lc * rc)
+                    )
         if not rrows and lrows and jt in ("left", "outer"):
             pad = (None,) * (self.right_width or 0)
             for (lk, lrow), lc in lrows.items():
-                out.append((self._out_key(lk, None), lrow + pad, lc))
+                out.append((self._out_key(lk, lrow, None, None), lrow + pad, lc))
         if not lrows and rrows and jt in ("right", "outer"):
             pad = (None,) * (self.left_width or 0)
             for (rk, rrow), rc in rrows.items():
-                out.append((self._out_key(None, rk), pad + rrow, rc))
+                out.append((self._out_key(None, None, rk, rrow), pad + rrow, rc))
         return out
 
-    def _out_key(self, lk, rk) -> Key:
+    def _out_key(self, lk, lrow, rk, rrow) -> Key:
+        if self.left_id_fn is not None:
+            if lk is None:
+                # reference errors when id= cannot be produced for a row
+                raise ValueError(
+                    "join id= references the left side but an outer/right "
+                    "join produced a row with no left match"
+                )
+            return self.left_id_fn(lk, lrow)
+        if self.right_id_fn is not None:
+            if rk is None:
+                raise ValueError(
+                    "join id= references the right side but an outer/left "
+                    "join produced a row with no right match"
+                )
+            return self.right_id_fn(rk, rrow)
         if self.id_from_left and lk is not None:
             return lk
         if self.id_from_right and rk is not None:
@@ -600,6 +633,26 @@ class StatefulReduceNode(Node):
                 out.append((gkey, gvals + (old,), -1))
             if new is not None:
                 out.append((gkey, gvals + (new,), 1))
+        return consolidate(out)
+
+
+class ForgetImmediatelyNode(Node):
+    """Pass rows through and retract them at the next engine timestamp
+    (reference: Table._forget_immediately — used by as-of-now query flows so
+    transient queries don't accumulate in downstream state)."""
+
+    def __init__(self, scope, input_node):
+        super().__init__(scope, [input_node])
+        self._to_retract: dict[int, list[Delta]] = {}
+
+    def process(self, time, batches):
+        out = list(self._to_retract.pop(time, []))
+        cur = consolidate(batches[0])
+        if cur:
+            out.extend(cur)
+            nt = time + 1
+            self._to_retract.setdefault(nt, []).extend(negate(cur))
+            self.scope.runtime.mark_pending(nt, self)
         return consolidate(out)
 
 

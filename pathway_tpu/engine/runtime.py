@@ -31,6 +31,7 @@ class _Connector:
         self.parser = parser
         self.finished = False
         self.thread: threading.Thread | None = None
+        self.force_flush = lambda: None  # set by run_connector_thread
 
 
 class Runtime:
@@ -140,32 +141,42 @@ class Runtime:
 
         active = len(self.connectors)
         while active > 0:
+            # autocommit cadence for subjects blocked in run(): flush their
+            # pending rows even though no emit fired the timer
+            for conn in self.connectors:
+                if not conn.finished:
+                    conn.force_flush()
             try:
-                conn, deltas = self.event_queue.get(timeout=0.5)
+                entries = [self.event_queue.get(timeout=0.5)]
             except queue.Empty:
                 if self.error and self.terminate_on_error:
                     raise self.error
                 continue
-            t = self._next_time()
-            if deltas is None:
-                conn.finished = True
-                active -= 1
-            elif deltas:
-                conn.node.accept(t, 0, deltas)
-            # drain everything else already queued into the same commit time
             while True:
                 try:
-                    conn2, deltas2 = self.event_queue.get_nowait()
+                    entries.append(self.event_queue.get_nowait())
                 except queue.Empty:
                     break
-                if deltas2 is None:
-                    conn2.finished = True
+            # every queue entry is one connector commit and gets its OWN
+            # timestamp (reference: each flush advances the commit Timestamp,
+            # connectors/mod.rs) — merging commits could cancel an insert
+            # with a later retraction before downstream ever observed it
+            for conn, deltas in entries:
+                if deltas is None:
+                    conn.finished = True
                     active -= 1
-                elif deltas2:
-                    conn2.node.accept(t, 0, deltas2)
-            for tt in sorted(self.pending_times):
-                if tt <= t:
-                    self._step_time(tt)
+                elif deltas:
+                    conn.node.accept(self._next_time(), 0, deltas)
+            # step strictly in time order, re-reading pending_times each
+            # round: stepping may schedule NEW times (forget-immediately
+            # retractions at t+1) that must run before later commits.
+            # Cutoff clock+1 also flushes those retractions promptly even
+            # on finish-only drains.
+            while self.pending_times:
+                tt = min(self.pending_times)
+                if tt > self.clock + 1:
+                    break
+                self._step_time(tt)
             if self.error and self.terminate_on_error:
                 raise self.error
         while self.pending_times:

@@ -56,6 +56,16 @@ class Scope:
     def rowwise_memoized(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
         return EngineTable(N.MemoizedRowwiseNode(self, table.node, batch_fn), width)
 
+    def rowwise_auto(
+        self, table: EngineTable, batch_fn, width: int, deterministic: bool
+    ) -> EngineTable:
+        """Plain rowwise for pure expressions; memoized when the expressions
+        contain non-deterministic UDFs so retractions replay stored outputs
+        (reference: `deterministic` flag, graph.rs:751)."""
+        if deterministic:
+            return self.rowwise(table, batch_fn, width)
+        return self.rowwise_memoized(table, batch_fn, width)
+
     def filter_table(self, table: EngineTable, mask_fn) -> EngineTable:
         return EngineTable(N.FilterNode(self, table.node, mask_fn), table.width)
 
@@ -79,6 +89,8 @@ class Scope:
         join_type: str = "inner",
         id_from_left: bool = False,
         id_from_right: bool = False,
+        left_id_fn=None,
+        right_id_fn=None,
     ) -> EngineTable:
         node = N.JoinNode(
             self,
@@ -91,6 +103,8 @@ class Scope:
             right_width=right.width,
             id_from_left=id_from_left,
             id_from_right=id_from_right,
+            left_id_fn=left_id_fn,
+            right_id_fn=right_id_fn,
         )
         return EngineTable(node, left.width + right.width)
 
@@ -138,6 +152,27 @@ class Scope:
             self, table.node, grouping_fn, args_fn, combine_many, key_fn
         )
         return EngineTable(node, n_group_cols + 1)
+
+    def forget_immediately(self, table: EngineTable) -> EngineTable:
+        return EngineTable(
+            N.ForgetImmediatelyNode(self, table.node), table.width
+        )
+
+    def external_index(
+        self,
+        index: EngineTable,
+        queries: EngineTable,
+        adapter,
+        index_fn,
+        query_fn,
+        mode: str = "as_of_now",
+    ) -> EngineTable:
+        from pathway_tpu.engine.external_index import ExternalIndexNode
+
+        node = ExternalIndexNode(
+            self, index.node, queries.node, adapter, index_fn, query_fn, mode
+        )
+        return EngineTable(node, queries.width + 2)
 
     # -- sinks ------------------------------------------------------------
     def output(self, table: EngineTable, **callbacks) -> None:

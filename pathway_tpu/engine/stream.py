@@ -76,8 +76,6 @@ class TableState:
                     pending_add[key] = row
                 else:
                     self.rows[key] = row
-                    if diff > 1:
-                        self.rows[key] = row
             elif diff < 0:
                 if key in self.rows:
                     del self.rows[key]

@@ -55,19 +55,33 @@ def _value_to_bytes(value: Any) -> bytes:
     if isinstance(value, bytes):
         return b"Y" + value
     if isinstance(value, tuple):
-        return b"T" + b"\x1f".join(_value_to_bytes(v) for v in value)
+        return b"T" + _concat_lp([_value_to_bytes(v) for v in value])
     if isinstance(value, np.ndarray):
-        return b"A" + value.tobytes()
+        # dtype + shape + data: keeps [1,2] distinct from [[1],[2]] etc.
+        return b"A" + _concat_lp(
+            [
+                value.dtype.str.encode(),
+                np.asarray(value.shape, dtype=np.int64).tobytes(),
+                value.tobytes(),
+            ]
+        )
     if isinstance(value, Json):
         return b"J" + _json.dumps(value.value, sort_keys=True, default=str).encode()
     return b"O" + repr(value).encode()
+
+
+def _concat_lp(parts: list[bytes]) -> bytes:
+    """Length-prefixed concatenation — injective, unlike separator joins."""
+    return struct.pack("<I", len(parts)) + b"".join(
+        struct.pack("<I", len(p)) + p for p in parts
+    )
 
 
 def ref_scalar(*args: Any, optional: bool = False) -> Pointer:
     """Deterministic pointer from values (reference: python_api ref_scalar)."""
     if optional and any(a is None for a in args):
         return None  # type: ignore[return-value]
-    return _hash_bytes(b"\x1e".join(_value_to_bytes(a) for a in args))
+    return _hash_bytes(_concat_lp([_value_to_bytes(a) for a in args]))
 
 
 _unsafe_counter = [0]

@@ -191,6 +191,20 @@ class ColumnExpression:
                 stack.extend(e._subexpressions())
         return tuple(out)
 
+    @property
+    def _is_deterministic(self) -> bool:
+        """False if any apply in the tree is declared non-deterministic —
+        such expressions must replay memoized outputs on retraction
+        (reference: `deterministic` flag, graph.rs:751 + dataflow.rs:1480
+        map_named_async_with_consistent_deletions)."""
+        stack: list[ColumnExpression] = [self]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, ApplyExpression) and not e._deterministic:
+                return False
+            stack.extend(e._subexpressions())
+        return True
+
 
 def _to_string(x):
     return str(x)
@@ -357,6 +371,9 @@ class ConvertExpression(ColumnExpression):
         self._expr = smart_coerce(expr)
         self._fun = fun
         self._dtype = target
+
+    def _subexpressions(self):
+        return (self._expr,)
 
 
 class DeclareTypeExpression(ColumnExpression):

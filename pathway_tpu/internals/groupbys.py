@@ -27,12 +27,20 @@ from pathway_tpu.internals.universe import Universe
 
 
 class GroupedTable:
-    def __init__(self, table, grouping: list[ColumnExpression], sort_by=None):
+    def __init__(
+        self,
+        table,
+        grouping: list[ColumnExpression],
+        sort_by=None,
+        id_from_first_group_col: bool = False,
+    ):
         self._table = table
         self._grouping = [expr_mod.smart_coerce(g) for g in grouping]
         self._sort_by = (
             table._desugar(expr_mod.smart_coerce(sort_by)) if sort_by is not None else None
         )
+        # groupby(id=ptr_col): output row ids ARE the grouping values
+        self._id_from_first_group_col = id_from_first_group_col
 
     def _resolve_deferred(self, name: str):
         return self._table._resolve_deferred(name)
@@ -129,6 +137,8 @@ class GroupedTable:
         n_group = len(grouping)
 
         sort_by = self._sort_by
+        id_from_first = self._id_from_first_group_col
+        key_fn = (lambda gvals: gvals[0]) if id_from_first else None
 
         def lower(ctx):
             from pathway_tpu.engine.expression import compile_expression
@@ -138,16 +148,55 @@ class GroupedTable:
             ] + ([sort_by] if sort_by is not None else [])
             et, resolver = ctx._combined_view(base, all_input_exprs)
 
-            gfns = [compile_expression(g, resolver, ctx.runtime) for g in grouping]
-            arg_fns = [
-                [compile_expression(a, resolver, ctx.runtime) for a in r._args]
-                for r in reducers
-            ]
-            sort_fn = (
-                compile_expression(sort_by, resolver, ctx.runtime)
-                if sort_by is not None
-                else None
-            )
+            if all(e._is_deterministic for e in all_input_exprs):
+                gfns = [
+                    compile_expression(g, resolver, ctx.runtime) for g in grouping
+                ]
+                arg_fns = [
+                    [compile_expression(a, resolver, ctx.runtime) for a in r._args]
+                    for r in reducers
+                ]
+                sort_fn = (
+                    compile_expression(sort_by, resolver, ctx.runtime)
+                    if sort_by is not None
+                    else None
+                )
+            else:
+                # non-deterministic UDFs feeding a groupby must be computed
+                # ONCE per row and replayed on retraction, else the retraction
+                # keys a different multiset slot (consistent-deletions
+                # semantics, reference dataflow.rs:1480) — pre-materialize all
+                # inputs through a memoized rowwise stage and index by slot
+                base_fns = [
+                    compile_expression(e, resolver, ctx.runtime)
+                    for e in all_input_exprs
+                ]
+
+                def precompute(keys, rows):
+                    cols = [f(keys, rows) for f in base_fns]
+                    return [
+                        tuple(c[i] for c in cols) for i in range(len(keys))
+                    ]
+
+                et = ctx.scope.rowwise_memoized(
+                    et, precompute, len(all_input_exprs)
+                )
+
+                def slot_fn(j):
+                    def f(keys, rows):
+                        return [r[j] for r in rows]
+
+                    return f
+
+                gfns = [slot_fn(j) for j in range(n_group)]
+                arg_fns = []
+                pos = n_group
+                for r in reducers:
+                    arg_fns.append(
+                        [slot_fn(pos + i) for i in range(len(r._args))]
+                    )
+                    pos += len(r._args)
+                sort_fn = slot_fn(pos) if sort_by is not None else None
 
             def grouping_fn(k, row):
                 return tuple(f([k], [row])[0] for f in gfns)
@@ -172,7 +221,7 @@ class GroupedTable:
                     return combine(state, flat)
 
                 get = ctx.scope.stateful_reduce(
-                    et, grouping_fn, args_fn, combine_rows, n_group
+                    et, grouping_fn, args_fn, combine_rows, n_group, key_fn=key_fn
                 )
                 if post is not None:
                     get = ctx.scope.rowwise(
@@ -192,7 +241,7 @@ class GroupedTable:
                         fn = lambda ms, slot, _f=fn, _p=post: _p(_f(ms, slot))
                     reducer_fns.append(fn)
                 grouped = ctx.scope.group_by(
-                    et, grouping_fn, args_fn, reducer_fns, n_group
+                    et, grouping_fn, args_fn, reducer_fns, n_group, key_fn=key_fn
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values
@@ -215,7 +264,11 @@ class GroupedTable:
                 return [tuple(c[i] for c in cols) for i in range(len(keys))]
 
             ctx.set_engine_table(
-                out, ctx.scope.rowwise(grouped, batch_fn, len(out_fns))
+                out,
+                ctx.scope.rowwise_auto(
+                    grouped, batch_fn, len(out_fns),
+                    all(e._is_deterministic for e in rewritten),
+                ),
             )
 
         dep_exprs = list(grouping) + [a for r in reducers for a in r._args]

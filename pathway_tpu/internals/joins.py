@@ -29,11 +29,15 @@ class JoinMode(enum.Enum):
 
 
 class JoinResult:
-    def __init__(self, left, right, on, *, id=None, how="inner"):
+    def __init__(self, left, right, on, *, id=None, how="inner", exact_match=False):
         self._left = left
         self._right = right
         self._how = how
         self._id = id
+        # exact_match promises every left row matches exactly once
+        # (reference: joins.py exact_match — keeps right columns
+        # non-optional); types are dynamic here so it is metadata only
+        self._exact_match = exact_match
         self._on: list[tuple[ColumnExpression, ColumnExpression]] = []
         for cond in on:
             cond = thisclass.desugar(cond, left_table=left, right_table=right)
@@ -71,6 +75,11 @@ class JoinResult:
                     and lhs.name == name
                     and rhs.name == name
                 ):
+                    if self._how in ("right", "outer"):
+                        # padded side carries None — unify across both sides
+                        return expr_mod.coalesce(
+                            self._left[name], self._right[name]
+                        )
                     return self._left[name]
             raise ValueError(
                 f"column {name!r} exists in both sides of the join; "
@@ -131,14 +140,33 @@ class JoinResult:
         rw = len(right._column_names)
         id_from_left = False
         id_from_right = False
+        id_expr = None      # pointer-valued expression supplying output ids
+        id_expr_side = None
         if self._id is not None:
             idref = self._id
             if isinstance(idref, thisclass.ThisColumnReference):
                 idref = self._desugar(idref)
-            if idref.table is left:
+            id_deps = idref._deps
+            dep_tables = {d.table for d in id_deps}
+            if (
+                isinstance(idref, ColumnReference)
+                and idref.name == "id"
+                and idref.table is left
+            ):
                 id_from_left = True
-            elif idref.table is right:
+            elif (
+                isinstance(idref, ColumnReference)
+                and idref.name == "id"
+                and idref.table is right
+            ):
                 id_from_right = True
+            elif dep_tables <= {left}:
+                # ids come from the VALUES of a left-side pointer expression
+                id_expr, id_expr_side = idref, "left"
+            elif dep_tables <= {right}:
+                id_expr, id_expr_side = idref, "right"
+            else:
+                raise ValueError("join id= must reference one side of the join")
 
         out_schema = schema_from_types(**{n: e._dtype for n, e in zip(names, exprs)})
         universe = (
@@ -184,6 +212,21 @@ class JoinResult:
             def rkey(k, row):
                 return tuple(f([k], [row])[0] for f in rfns)
 
+            left_id_fn = right_id_fn = None
+            if id_expr is not None:
+                side_table = left if id_expr_side == "left" else right
+                idf = compile_expression(
+                    id_expr, side_resolver(side_table), ctx.runtime
+                )
+
+                def _id_fn(k, row):
+                    return idf([k], [row])[0]
+
+                if id_expr_side == "left":
+                    left_id_fn = _id_fn
+                else:
+                    right_id_fn = _id_fn
+
             joined = ctx.scope.join(
                 let,
                 ret,
@@ -192,6 +235,8 @@ class JoinResult:
                 how,
                 id_from_left=id_from_left,
                 id_from_right=id_from_right,
+                left_id_fn=left_id_fn,
+                right_id_fn=right_id_fn,
             )
 
             def out_resolver(ref):
@@ -211,7 +256,13 @@ class JoinResult:
                 cols = [f(keys, rows) for f in fns]
                 return [tuple(c[i] for c in cols) for i in range(len(keys))]
 
-            ctx.set_engine_table(out, ctx.scope.rowwise(joined, batch_fn, len(fns)))
+            ctx.set_engine_table(
+                out,
+                ctx.scope.rowwise_auto(
+                    joined, batch_fn, len(fns),
+                    all(e._is_deterministic for e in exprs),
+                ),
+            )
 
         G.add_operator([left, right], [out], lower, f"join_{how}")
         return out

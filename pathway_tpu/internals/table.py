@@ -161,9 +161,13 @@ class Table(TableLike):
         out = Table(self._output_schema(names, exprs), self._universe)
         self_ = self
 
+        deterministic = all(e._is_deterministic for e in exprs)
+
         def lower(ctx):
             inp, fn = ctx.rowwise_eval(self_, exprs)
-            ctx.set_engine_table(out, ctx.scope.rowwise(inp, fn, len(exprs)))
+            ctx.set_engine_table(
+                out, ctx.scope.rowwise_auto(inp, fn, len(exprs), deterministic)
+            )
 
         G.add_operator(self._dep_tables(exprs), [out], lower, "select")
         return out
@@ -336,6 +340,19 @@ class Table(TableLike):
     def groupby(self, *args, id=None, instance=None, sort_by=None, **kwargs):
         from pathway_tpu.internals.groupbys import GroupedTable
 
+        if kwargs:
+            raise TypeError(
+                f"groupby() got unexpected keyword arguments {sorted(kwargs)}"
+            )
+        if id is not None:
+            # reference semantics (table.py groupby id=): group by a Pointer
+            # column whose values become the output row ids
+            if args:
+                raise ValueError("groupby() takes either positional columns or id=")
+            grouping = [self._desugar(expr_mod.smart_coerce(id))]
+            return GroupedTable(
+                self, grouping, sort_by=sort_by, id_from_first_group_col=True
+            )
         grouping = [self._desugar(a) for a in args]
         if instance is not None:
             grouping.append(self._desugar(expr_mod.smart_coerce(instance)))
@@ -378,23 +395,52 @@ class Table(TableLike):
         return out
 
     # -- joins -------------------------------------------------------------
-    def join(self, other: "Table", *on, id=None, how="inner", **kwargs):
+    _ALLOWED_JOIN_KWARGS = {"left_instance", "right_instance", "exact_match"}
+
+    def join(
+        self,
+        other: "Table",
+        *on,
+        id=None,
+        how="inner",
+        left_instance=None,
+        right_instance=None,
+        exact_match: bool = False,
+        **kwargs,
+    ):
         from pathway_tpu.internals.joins import JoinResult
 
+        if kwargs:
+            raise TypeError(
+                f"join() got unexpected keyword arguments {sorted(kwargs)}"
+            )
+        on = list(on)
+        if (left_instance is None) != (right_instance is None):
+            raise ValueError(
+                "left_instance and right_instance must be given together"
+            )
+        if left_instance is not None:
+            # instance partitioning = an extra equality condition
+            on.append(
+                self._desugar(expr_mod.smart_coerce(left_instance))
+                == other._desugar(expr_mod.smart_coerce(right_instance))
+            )
         how_str = how.value if hasattr(how, "value") else str(how)
-        return JoinResult(self, other, on, id=id, how=how_str)
+        return JoinResult(
+            self, other, on, id=id, how=how_str, exact_match=exact_match
+        )
 
     def join_inner(self, other, *on, id=None, **kwargs):
-        return self.join(other, *on, id=id, how="inner")
+        return self.join(other, *on, id=id, how="inner", **kwargs)
 
     def join_left(self, other, *on, id=None, **kwargs):
-        return self.join(other, *on, id=id, how="left")
+        return self.join(other, *on, id=id, how="left", **kwargs)
 
     def join_right(self, other, *on, id=None, **kwargs):
-        return self.join(other, *on, id=id, how="right")
+        return self.join(other, *on, id=id, how="right", **kwargs)
 
     def join_outer(self, other, *on, id=None, **kwargs):
-        return self.join(other, *on, id=id, how="outer")
+        return self.join(other, *on, id=id, how="outer", **kwargs)
 
     # -- asof / temporal entry points (stdlib.temporal wires the real ones) --
     def windowby(self, time_expr, *, window, instance=None, behavior=None, **kwargs):
@@ -618,6 +664,21 @@ class Table(TableLike):
             )
 
         G.add_operator([self], [out], lower, "flatten")
+        return out
+
+    def _forget_immediately(self) -> "Table":
+        """Rows pass through and are retracted at the next timestamp
+        (reference: internals/table.py _forget_immediately — as-of-now
+        query plumbing, stdlib/indexing/data_index.py:46-120)."""
+        out = Table(self._schema_cls, Universe())
+        self_ = self
+
+        def lower(ctx):
+            ctx.set_engine_table(
+                out, ctx.scope.forget_immediately(ctx.engine_table(self_))
+            )
+
+        G.add_operator([self], [out], lower, "forget_immediately")
         return out
 
     def sort(self, key: ColumnExpression, instance: ColumnExpression | None = None) -> "Table":

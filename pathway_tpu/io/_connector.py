@@ -4,6 +4,8 @@ per-source thread reading into an mpsc channel drained by the main loop)."""
 from __future__ import annotations
 
 import queue
+import threading
+import time as _time
 from typing import Any, Callable
 
 
@@ -11,18 +13,46 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     subject = conn.subject
     parser = conn.parser
     pending: list = []
+    lock = threading.Lock()
+    # timer-based autocommit (reference: commit_duration cadence in the
+    # worker poller, connectors/mod.rs): rows accumulate into one commit
+    # until `autocommit_duration_ms` elapses or the subject commits
+    # explicitly — this is what gives downstream batched UDFs whole
+    # logical-time batches instead of row-at-a-time dribbles. The runtime's
+    # main loop calls `conn.force_flush` on its own cadence so rows are not
+    # stranded while the subject blocks waiting for input.
+    duration_ms = getattr(subject, "_autocommit_duration_ms", None)
+    last_flush = _time.monotonic()
 
     def emit(message: Any) -> None:
         deltas = parser(message)
         if deltas:
-            pending.extend(deltas)
-            if getattr(subject, "_autocommit", True):
+            with lock:
+                pending.extend(deltas)
+            if duration_ms is None:
+                flush()
+            elif (_time.monotonic() - last_flush) * 1000.0 >= duration_ms:
                 flush()
 
     def flush() -> None:
-        if pending:
-            out_queue.put((conn, pending.copy()))
-            pending.clear()
+        nonlocal last_flush
+        last_flush = _time.monotonic()
+        with lock:
+            if pending:
+                out_queue.put((conn, pending.copy()))
+                pending.clear()
+
+    def force_flush() -> None:
+        # called from the runtime loop's cadence; respects the autocommit
+        # window so steady sources still batch up to duration_ms
+        if (
+            duration_ms is not None
+            and (_time.monotonic() - last_flush) * 1000.0 < duration_ms
+        ):
+            return
+        flush()
+
+    conn.force_flush = force_flush
 
     subject._attach(emit, flush)
     try:

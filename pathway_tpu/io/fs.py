@@ -116,18 +116,24 @@ class _FsSubject(ConnectorSubject):
             if self._seen.get(p) == mtime:
                 continue
             self._seen[p] = mtime
-            for old_row in self._emitted.pop(p, []):
-                self.remove(**old_row)
+            for old_key, old_row in self._emitted.pop(p, []):
+                self._remove(old_key, old_row)
             rows = _parse_file(
                 p, self.fmt, None, self.schema.column_names(), self.with_metadata
             )
-            self._emitted[p] = rows
-            for row in rows:
-                self.next(**row)
+            # stable per-(path, line) keys so deleting a file retracts ITS
+            # rows even when identical content exists in other files
+            keyed = [
+                (ref_scalar("fs", os.path.abspath(p), i), row)
+                for i, row in enumerate(rows)
+            ]
+            self._emitted[p] = keyed
+            for key, row in keyed:
+                self._upsert(key, row)
         for p in list(self._emitted):
             if p not in current:
-                for old_row in self._emitted.pop(p, []):
-                    self.remove(**old_row)
+                for old_key, old_row in self._emitted.pop(p, []):
+                    self._remove(old_key, old_row)
                 self._seen.pop(p, None)
         self.commit()
 
@@ -210,7 +216,9 @@ def read(
 
         return table_from_rows(schema, rows)
     subject = _FsSubject(path, format, schema, with_metadata, mode, refresh_interval)
-    return python_read(subject, schema=schema)
+    return python_read(
+        subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms
+    )
 
 
 def write(table: Table, filename: str, *, format: str = "csv", name: str | None = None, **kwargs) -> None:

@@ -31,7 +31,6 @@ class ConnectorSubject:
     def __init__(self, datasource_name: str = "python"):
         self._emit = None
         self._flush = None
-        self._autocommit = True
         self._finished = False
 
     # wired by the engine runtime
@@ -72,6 +71,13 @@ class ConnectorSubject:
     def next_bytes(self, message: bytes) -> None:
         self.next(data=message)
 
+    def _upsert(self, key: Pointer, values: dict) -> None:
+        """Insert/update with an explicit stable key (used by connectors
+        that track object identity themselves, e.g. fs path+line)."""
+        if self._finished:
+            return
+        self._emit(("upsert", values, key))
+
     def _remove(self, key: Pointer, values: dict) -> None:
         self._emit(("remove", values, key))
 
@@ -93,23 +99,42 @@ def _make_parser(schema: type[Schema]):
     pkeys = schema.primary_key_columns()
     defaults = schema.default_values()
     seq = [0]
+    # primary-keyed sources are upsert sessions (reference: SessionType::
+    # Upsert, connectors/adaptors.rs:176): re-inserting a live key must
+    # retract the previous row first, or multiset operators double-count
+    live_rows: dict[Pointer, tuple] = {}
     # content -> stack of keys minted for it, so remove() retracts the row
     # actually inserted (schemas without primary keys mint per-row keys).
     live_keys: dict[tuple, list] = {}
 
     def parse(message) -> list[tuple]:
         kind, values = message[0], message[1]
+        explicit_key = message[2] if len(message) > 2 else None
         row = tuple(values.get(c, defaults.get(c)) for c in cols)
         if pkeys:
             key = ref_scalar(*(values[c] for c in pkeys))
-        elif kind == "remove":
-            if len(message) > 2 and message[2] is not None:
-                key = message[2]
+            if kind == "remove":
+                prev = live_rows.pop(key, None)
+                return [(key, prev if prev is not None else row, -1)]
+            out = []
+            prev = live_rows.get(key)
+            if prev is not None:
+                out.append((key, prev, -1))
+            live_rows[key] = row
+            out.append((key, row, 1))
+            return out
+        if kind == "remove":
+            if explicit_key is not None:
+                key = explicit_key
             else:
                 stack = live_keys.get(freeze_row(row))
                 if not stack:
                     return []  # nothing to retract
                 key = stack.pop()
+        elif explicit_key is not None:
+            # explicit-key rows are removed by key, never by content — they
+            # must not enter the content->key stacks (leak + mis-retraction)
+            key = explicit_key
         else:
             seq[0] += 1
             key = ref_scalar("py-connector", seq[0], *map(repr, row))
@@ -130,6 +155,7 @@ def read(
 ) -> Table:
     if schema is None:
         raise ValueError("pw.io.python.read requires a schema")
+    subject._autocommit_duration_ms = autocommit_duration_ms
     out = Table(schema, Universe())
     parser = _make_parser(schema)
     width = len(schema.column_names())

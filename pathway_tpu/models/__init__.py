@@ -1,0 +1,28 @@
+"""pathway_tpu.models — TPU-resident models for the LLM xpack hot paths.
+
+The reference runs local models on CPU/GPU torch via sentence-transformers
+(/root/reference/python/pathway/xpacks/llm/embedders.py:270
+SentenceTransformerEmbedder) and transformers pipelines (llms.py:441
+HFPipelineChat). Here the equivalents are Flax modules compiled by XLA for
+TPU: a BERT-class sentence encoder (bge-small geometry) and a cross-encoder
+reranker sharing the same backbone. Weights are either randomly initialized
+(benchmarks, tests) or loaded from local HF checkpoints when present —
+this environment has no network egress, so no downloads ever happen here.
+"""
+
+from pathway_tpu.models.encoder import (
+    EncoderConfig,
+    TransformerEncoder,
+    SentenceEncoder,
+)
+from pathway_tpu.models.cross_encoder import CrossEncoder
+from pathway_tpu.models.tokenizer import HashTokenizer, get_tokenizer
+
+__all__ = [
+    "EncoderConfig",
+    "TransformerEncoder",
+    "SentenceEncoder",
+    "CrossEncoder",
+    "HashTokenizer",
+    "get_tokenizer",
+]

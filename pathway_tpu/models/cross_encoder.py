@@ -1,0 +1,112 @@
+"""Cross-encoder reranker: (query, document) pair -> relevance score.
+
+TPU-native replacement for the reference's CrossEncoderReranker
+(/root/reference/python/pathway/xpacks/llm/rerankers.py:186 —
+sentence-transformers CrossEncoder on torch). Same backbone as the sentence
+encoder, but the pair is concatenated [CLS] q [SEP] d [SEP] and a scalar head
+reads the CLS position. Whole candidate lists are scored in one jitted call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from pathway_tpu.models.encoder import EncoderConfig, _Block, _bucket
+from pathway_tpu.models.tokenizer import get_tokenizer
+
+
+class CrossEncoderModel(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        cfg = self.config
+        L = ids.shape[1]
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype, name="tok_embed")(ids)
+        pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype, name="pos_embed")(
+            jnp.arange(L)[None, :]
+        )
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos)
+        attn_mask = nn.make_attention_mask(mask, mask, dtype=cfg.dtype)
+        for i in range(cfg.layers):
+            x = _Block(cfg, name=f"block_{i}")(x, attn_mask)
+        cls = x[:, 0, :].astype(jnp.float32)
+        h = nn.tanh(nn.Dense(cfg.hidden, name="pool")(cls))
+        return nn.Dense(1, name="score")(h)[:, 0]
+
+
+class CrossEncoder:
+    """Host-facing scorer: (query, list[doc]) -> np.ndarray of scores."""
+
+    def __init__(
+        self,
+        config: EncoderConfig | None = None,
+        *,
+        tokenizer_path: str | None = None,
+        seed: int = 0,
+        batch_size: int = 64,
+        params: Any = None,
+    ):
+        self.config = config or EncoderConfig.bge_small()
+        self.tokenizer = get_tokenizer(
+            tokenizer_path,
+            vocab_size=self.config.vocab_size,
+            max_length=self.config.max_len,
+        )
+        self.model = CrossEncoderModel(self.config)
+        self.batch_size = batch_size
+        if params is None:
+            rng = jax.random.PRNGKey(seed)
+            ids = jnp.zeros((1, 8), jnp.int32)
+            mask = jnp.ones((1, 8), jnp.int32)
+            params = self.model.init(rng, ids, mask)["params"]
+        self.params = params
+        self._forward = jax.jit(
+            lambda params, ids, mask: self.model.apply({"params": params}, ids, mask)
+        )
+
+    def score(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        pairs = list(pairs)
+        if not pairs:
+            return np.zeros((0,), np.float32)
+        # tokenize q and d separately, join with SEP — stays tokenizer-agnostic
+        out = np.empty((len(pairs),), np.float32)
+        for start in range(0, len(pairs), self.batch_size):
+            chunk = pairs[start : start + self.batch_size]
+            ids, mask = self._encode_pairs(chunk)
+            scores = self._forward(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            out[start : start + len(chunk)] = np.asarray(scores, np.float32)[: len(chunk)]
+        return out
+
+    def _encode_pairs(self, pairs):
+        q_ids, q_mask = self.tokenizer([q for q, _ in pairs])
+        d_ids, d_mask = self.tokenizer([d for _, d in pairs])
+        max_len = self.config.max_len
+        seqs = []
+        for qi, qm, di, dm in zip(q_ids, q_mask, d_ids, d_mask):
+            # [CLS] q [SEP] d [SEP]: query keeps its CLS...SEP envelope, the
+            # doc drops its CLS and keeps its own tokenizer's SEP — works for
+            # both the hash tokenizer and HF tokenizers (whose special ids
+            # differ; we never inject our own constants into HF sequences)
+            qs = [int(t) for t, m in zip(qi, qm) if m]
+            ds = [int(t) for t, m in zip(di, dm) if m][1:]
+            seqs.append((qs + ds)[:max_len])
+        longest = max(len(s) for s in seqs)
+        Lb = _bucket(longest, 16, max_len)
+        nb = _bucket(len(seqs), 8, self.batch_size)
+        ids = np.zeros((nb, Lb), np.int32)
+        mask = np.zeros((nb, Lb), np.int32)
+        for i, s in enumerate(seqs):
+            s = s[:Lb]
+            ids[i, : len(s)] = s
+            mask[i, : len(s)] = 1
+        return ids, mask
+
+    def __call__(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        return self.score(pairs)

@@ -1,0 +1,96 @@
+"""Tokenizers for the TPU sentence encoder.
+
+`HashTokenizer` is a deterministic, dependency-free hashing tokenizer
+(lowercase word + sub-word shingles hashed into the vocab) used for
+benchmarks and tests — embedding *throughput* does not depend on tokenizer
+quality, only on token counts. When a local HuggingFace tokenizer checkpoint
+is available (offline — this environment has zero egress), `get_tokenizer`
+returns it instead so real checkpoints produce real embeddings.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+PAD_ID = 0
+CLS_ID = 1
+SEP_ID = 2
+_RESERVED = 3
+
+
+def _hash_token(tok: str, vocab_size: int) -> int:
+    h = int.from_bytes(hashlib.blake2b(tok.encode(), digest_size=8).digest(), "little")
+    return _RESERVED + (h % (vocab_size - _RESERVED))
+
+
+class HashTokenizer:
+    """Deterministic hashing tokenizer with a BERT-style output contract."""
+
+    def __init__(self, vocab_size: int = 30522, max_length: int = 512):
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+
+    def _tokens(self, text: str) -> list[int]:
+        ids = []
+        for word in text.lower().split():
+            if len(word) <= 6:
+                ids.append(_hash_token(word, self.vocab_size))
+            else:
+                # sub-word shingles approximate BPE granularity so long
+                # words cost proportionally more tokens, like real BPE
+                for i in range(0, len(word), 6):
+                    ids.append(_hash_token(("##" if i else "") + word[i : i + 6], self.vocab_size))
+        return ids
+
+    def __call__(
+        self, texts: list[str], max_length: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Returns (ids [n, L], mask [n, L]) padded to the longest sequence
+        (callers bucket-pad to jit-stable shapes)."""
+        max_len = max_length or self.max_length
+        seqs = []
+        for t in texts:
+            ids = [CLS_ID] + self._tokens(t)[: max_len - 2] + [SEP_ID]
+            seqs.append(ids)
+        longest = max((len(s) for s in seqs), default=1)
+        ids_arr = np.full((len(texts), longest), PAD_ID, np.int32)
+        mask = np.zeros((len(texts), longest), np.int32)
+        for i, s in enumerate(seqs):
+            ids_arr[i, : len(s)] = s
+            mask[i, : len(s)] = 1
+        return ids_arr, mask
+
+
+class _HFTokenizerAdapter:
+    def __init__(self, tok, max_length: int):
+        self.tok = tok
+        self.vocab_size = tok.vocab_size
+        self.max_length = max_length
+
+    def __call__(self, texts, max_length=None):
+        enc = self.tok(
+            list(texts),
+            truncation=True,
+            max_length=max_length or self.max_length,
+            padding="longest",
+            return_tensors="np",
+        )
+        return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+
+def get_tokenizer(model_name_or_path: str | None = None, *, vocab_size: int = 30522,
+                  max_length: int = 512):
+    """Local HF tokenizer if `model_name_or_path` resolves offline, else hash."""
+    if model_name_or_path is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(
+                model_name_or_path, local_files_only=True
+            )
+            return _HFTokenizerAdapter(tok, max_length)
+        except Exception:
+            pass
+    return HashTokenizer(vocab_size=vocab_size, max_length=max_length)

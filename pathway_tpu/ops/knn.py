@@ -1,0 +1,227 @@
+"""HBM-resident brute-force KNN shard.
+
+TPU-native re-design of the reference's BruteForceKNNIndex
+(/root/reference/src/external_integration/brute_force_knn_integration.rs:22-237):
+the reference keeps a row-major Array2<f64> on the host, grows/shrinks it
+geometrically and scores queries with ndarray dot on CPU. Here the vector
+store lives in device HBM as a padded f32[capacity, d] buffer with a
+validity mask; capacity doubles on growth (powers of two only, so XLA sees
+a small, stable set of shapes — no recompilation storms); deletes are O(1)
+slot-free-list operations; scoring is a fused matmul + top-k on the MXU
+(pathway_tpu.ops.topk) with queries padded to power-of-two batch sizes.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.ops.topk import chunked_topk_scores
+
+_MIN_CAPACITY = 128
+
+
+class Metric(enum.Enum):
+    COS = "cos"
+    L2SQ = "l2sq"
+    DOT = "dot"
+
+
+def _next_pow2(n: int) -> int:
+    p = _MIN_CAPACITY
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.lru_cache(maxsize=None)
+def _search_fn(k: int, metric: str, chunk: int, precision: str):
+    @jax.jit
+    def search(queries, vectors, valid, sq_norms):
+        queries = queries.astype(jnp.float32)
+        if metric == "cos":
+            n = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+            queries = queries / jnp.maximum(n, 1e-30)
+        sq = sq_norms if metric == "l2sq" else None
+        return chunked_topk_scores(
+            queries, vectors, valid, k,
+            chunk=chunk, sq_norms=sq,
+            metric="l2sq" if metric == "l2sq" else "dot",
+            precision=precision,
+        )
+
+    return search
+
+
+@functools.partial(
+    jax.jit, static_argnames=("normalize",), donate_argnums=(0, 1, 2)
+)
+def _write_slots(vectors, valid, sq_norms, slots, new_vecs, new_valid, *,
+                 normalize: bool = False):
+    new_vecs = new_vecs.astype(jnp.float32)
+    if normalize:
+        n = jnp.linalg.norm(new_vecs, axis=-1, keepdims=True)
+        new_vecs = new_vecs / jnp.maximum(n, 1e-30)
+    vectors = vectors.at[slots].set(new_vecs)
+    valid = valid.at[slots].set(new_valid)
+    sq_norms = sq_norms.at[slots].set(jnp.sum(new_vecs * new_vecs, axis=-1))
+    return vectors, valid, sq_norms
+
+
+class KnnShard:
+    """One device shard of a brute-force index: add/remove/search.
+
+    Host side owns the key↔slot mapping (the reference's KeyToU64IdMapper,
+    external_integration/mod.rs); the device side only sees dense slots.
+    """
+
+    def __init__(
+        self,
+        dimension: int,
+        metric: Metric | str = Metric.COS,
+        *,
+        chunk: int = 8192,
+        precision: str = "highest",
+        capacity: int = _MIN_CAPACITY,
+        device: Any | None = None,
+    ):
+        self.dimension = int(dimension)
+        self.metric = Metric(metric)
+        self.chunk = chunk
+        self.precision = precision
+        self.device = device
+        # pre-size to the expected corpus size to avoid growth reshapes
+        # (each distinct capacity is a fresh XLA executable)
+        self.capacity = _next_pow2(capacity)
+        self.key_to_slot: dict[Any, int] = {}
+        self.slot_to_key: dict[int, Any] = {}
+        self.free_slots: list[int] = list(range(self.capacity - 1, -1, -1))
+        self.vectors = jnp.zeros((self.capacity, self.dimension), jnp.float32)
+        self.valid = jnp.zeros((self.capacity,), bool)
+        self.sq_norms = jnp.zeros((self.capacity,), jnp.float32)
+
+    def __len__(self) -> int:
+        return len(self.key_to_slot)
+
+    # -- mutation ---------------------------------------------------------
+    def _grow_to(self, n: int) -> None:
+        new_cap = _next_pow2(n)
+        if new_cap <= self.capacity:
+            return
+        pad = new_cap - self.capacity
+        self.vectors = jnp.concatenate(
+            [self.vectors, jnp.zeros((pad, self.dimension), jnp.float32)]
+        )
+        self.valid = jnp.concatenate([self.valid, jnp.zeros((pad,), bool)])
+        self.sq_norms = jnp.concatenate(
+            [self.sq_norms, jnp.zeros((pad,), jnp.float32)]
+        )
+        self.free_slots = (
+            list(range(new_cap - 1, self.capacity - 1, -1)) + self.free_slots
+        )
+        self.capacity = new_cap
+
+    def _prepare(self, vecs):
+        """Shape/dtype check; keeps device arrays on device. Normalization
+        for cos happens on device inside the jitted write/search fns."""
+        if not isinstance(vecs, jax.Array):
+            vecs = np.asarray(vecs, dtype=np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None, :]
+        if vecs.shape[-1] != self.dimension:
+            raise ValueError(
+                f"vector dimension {vecs.shape[-1]} != index dimension {self.dimension}"
+            )
+        return vecs
+
+    def add(self, keys: Sequence[Any], vecs) -> None:
+        """Upsert vectors; accepts numpy or device-resident jax arrays (the
+        latter avoids a host round-trip when chaining from a jitted encoder)."""
+        vecs = self._prepare(vecs)
+        if len(keys) != vecs.shape[0]:
+            raise ValueError("keys/vectors length mismatch")
+        self._grow_to(len(self.key_to_slot) + len(keys))
+        slots = []
+        for key in keys:
+            slot = self.key_to_slot.get(key)
+            if slot is None:
+                slot = self.free_slots.pop()
+                self.key_to_slot[key] = slot
+                self.slot_to_key[slot] = key
+            slots.append(slot)
+        slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        self.vectors, self.valid, self.sq_norms = _write_slots(
+            self.vectors, self.valid, self.sq_norms,
+            slots_arr, jnp.asarray(vecs), jnp.ones((len(slots),), bool),
+            normalize=self.metric is Metric.COS,
+        )
+
+    def remove(self, keys: Sequence[Any]) -> None:
+        slots = []
+        for key in keys:
+            slot = self.key_to_slot.pop(key, None)
+            if slot is None:
+                continue
+            del self.slot_to_key[slot]
+            self.free_slots.append(slot)
+            slots.append(slot)
+        if not slots:
+            return
+        slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
+        self.vectors, self.valid, self.sq_norms = _write_slots(
+            self.vectors, self.valid, self.sq_norms,
+            slots_arr,
+            jnp.zeros((len(slots), self.dimension), jnp.float32),
+            jnp.zeros((len(slots),), bool),
+        )
+
+    # -- search -----------------------------------------------------------
+    def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
+        """Return per-query [(key, score)] sorted by descending score.
+
+        Scores: cos/dot similarity, or negated squared L2 distance.
+        Queries are padded to a power-of-two batch so the jitted kernel
+        sees a bounded shape set.
+        """
+        queries = self._prepare(queries)
+        n = queries.shape[0]
+        if n == 0 or not self.key_to_slot:
+            return [[] for _ in range(n)]
+        # top_k per scored block cannot exceed the block width
+        k_eff = min(k, self.capacity, self.chunk)
+        padded_n = 1
+        while padded_n < n:
+            padded_n *= 2
+        if padded_n != n:
+            pad = [(0, padded_n - n), (0, 0)]
+            queries = (
+                jnp.pad(queries, pad)
+                if isinstance(queries, jax.Array)
+                else np.pad(queries, pad)
+            )
+        fn = _search_fn(k_eff, self.metric.value, self.chunk, self.precision)
+        vals, idx = fn(
+            jnp.asarray(queries), self.vectors, self.valid, self.sq_norms
+        )
+        vals = np.asarray(vals)[:n]
+        idx = np.asarray(idx)[:n]
+        out: list[list[tuple[Any, float]]] = []
+        for qi in range(n):
+            hits = []
+            for vv, slot in zip(vals[qi], idx[qi]):
+                if not np.isfinite(vv):
+                    continue
+                key = self.slot_to_key.get(int(slot))
+                if key is None:
+                    continue
+                hits.append((key, float(vv)))
+                if len(hits) == k:
+                    break
+            out.append(hits)
+        return out

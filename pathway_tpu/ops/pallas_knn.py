@@ -1,0 +1,112 @@
+"""Pallas TPU kernel: fused KNN scoring — matmul + running top-k.
+
+Single pass over the database shard in VMEM-sized blocks: each grid step
+computes a [Q, BLOCK] score tile on the MXU and folds it into a running
+[Q, K] top-k held in VMEM scratch, so the full [Q, capacity] score matrix
+never exists in HBM. This is the TPU replacement for the reference's
+batched `index.dot(query)` + k_smallest loop
+(/root/reference/src/external_integration/brute_force_knn_integration.rs:150-214),
+which bounds memory by query-batching instead; we bound it by db-blocking,
+which keeps query batches intact for the MXU.
+
+Top-k inside the kernel is K-step selection (max + mask-out), K static and
+small; `jax.lax.top_k` does not lower inside Pallas TPU kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float("-inf")
+
+
+def _knn_kernel(q_ref, db_ref, mask_ref, out_v_ref, out_i_ref, sv_ref, si_ref,
+                *, k: int, block: int):
+    j = pl.program_id(0)
+    nb = pl.num_programs(0)
+
+    @pl.when(j == 0)
+    def _init():
+        sv_ref[:] = jnp.full(sv_ref.shape, NEG_INF, jnp.float32)
+        si_ref[:] = jnp.zeros(si_ref.shape, jnp.int32)
+
+    scores = jnp.dot(
+        q_ref[:], db_ref[:].T,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ) + mask_ref[:]                                       # [Q, B]
+    q = scores.shape[0]
+    base = j * block
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (q, block), 1) + base
+
+    cand_v = jnp.concatenate([sv_ref[:], scores], axis=1)  # [Q, K+B]
+    cand_i = jnp.concatenate([si_ref[:], col_ids], axis=1)
+    width = k + block
+    iota = jax.lax.broadcasted_iota(jnp.int32, (q, width), 1)
+
+    new_v = []
+    new_i = []
+    for _ in range(k):
+        m = jnp.max(cand_v, axis=1)                        # [Q]
+        am = jnp.argmax(cand_v, axis=1)                    # [Q]
+        hit = iota == am[:, None]
+        sel_i = jnp.sum(jnp.where(hit, cand_i, 0), axis=1)
+        new_v.append(m)
+        new_i.append(sel_i)
+        cand_v = jnp.where(hit, NEG_INF, cand_v)
+    sv_ref[:] = jnp.stack(new_v, axis=1)
+    si_ref[:] = jnp.stack(new_i, axis=1)
+
+    @pl.when(j == nb - 1)
+    def _flush():
+        out_v_ref[:] = sv_ref[:]
+        out_i_ref[:] = si_ref[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "block", "interpret")
+)
+def pallas_topk_scores(
+    queries: jax.Array,    # [Q, D] f32
+    database: jax.Array,   # [cap, D] f32
+    add_mask: jax.Array,   # [cap] f32 additive (0 valid, -inf invalid)
+    *,
+    k: int,
+    block: int = 1024,
+    interpret: bool = False,
+):
+    """Fused scored top-k: returns (values [Q, k], indices [Q, k])."""
+    q, d = queries.shape
+    cap = database.shape[0]
+    assert cap % block == 0, "capacity must be a multiple of block"
+    nb = cap // block
+
+    kernel = functools.partial(_knn_kernel, k=k, block=block)
+    out_v, out_i = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((q, d), lambda j: (0, 0)),
+            pl.BlockSpec((block, d), lambda j: (j, 0)),
+            pl.BlockSpec((1, block), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((q, k), lambda j: (0, 0)),
+            pl.BlockSpec((q, k), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q, k), jnp.float32),
+            jax.ShapeDtypeStruct((q, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((q, k), jnp.float32),
+            pltpu.VMEM((q, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries, database, add_mask[None, :])
+    return out_v, out_i

@@ -1,0 +1,32 @@
+"""pathway_tpu.parallel — device meshes, shardings and collectives.
+
+The reference scales by running N identical timely workers per process and a
+TCP mesh between processes (/root/reference/src/engine/dataflow/config.rs:63-127,
+SURVEY §2.9) — data parallelism only, communication via its own channel
+fabric. The TPU-native equivalent lives here: a `jax.sharding.Mesh` over the
+chips, named-axis shardings (dp/tp/sp), XLA collectives over ICI for the
+data plane (all_gather/psum inside shard_map), and a sharded KNN index that
+replaces the reference's broadcast-replicated external index
+(external_index.rs:95 — full index copy per worker) with an HBM shard per
+chip and a global top-k tree reduction (SURVEY §5).
+"""
+
+from pathway_tpu.parallel.mesh import best_factorization, make_mesh
+from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk
+from pathway_tpu.parallel.train import (
+    TrainState,
+    contrastive_train_step,
+    create_train_state,
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "best_factorization",
+    "ShardedKnnIndex",
+    "sharded_topk",
+    "TrainState",
+    "create_train_state",
+    "contrastive_train_step",
+    "make_sharded_train_step",
+]

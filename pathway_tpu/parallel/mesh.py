@@ -1,0 +1,48 @@
+"""Device mesh construction.
+
+Maps the reference's worker topology (PATHWAY_THREADS × PATHWAY_PROCESSES,
+/root/reference/src/engine/dataflow/config.rs:88-127) onto a
+`jax.sharding.Mesh`: the "dp" axis plays the role of the key-sharded worker
+set (rows/index shards), "tp" shards model weights inside one replica.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def best_factorization(n: int, max_tp: int = 8) -> tuple[int, int]:
+    """Factor n devices into (dp, tp): largest tp ≤ max_tp dividing n, with
+    dp carrying the rest. tp stays small — weight sharding buys memory, not
+    throughput, for encoder-class models; dp carries the ingest scale."""
+    tp = 1
+    for cand in range(min(max_tp, n), 0, -1):
+        if n % cand == 0:
+            tp = cand
+            break
+    # prefer dp-heavy splits: cap tp at sqrt(n) unless that leaves nothing
+    while tp > 1 and n // tp < tp and n % (tp // 2) == 0 and tp % 2 == 0:
+        tp //= 2
+    return n // tp, tp
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axes: tuple[str, ...] = ("dp", "tp"),
+    shape: tuple[int, ...] | None = None,
+) -> Mesh:
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    devices = devices[:n]
+    if shape is None:
+        if len(axes) == 1:
+            shape = (n,)
+        elif len(axes) == 2:
+            shape = best_factorization(n)
+        else:
+            raise ValueError("pass `shape` explicitly for >2 mesh axes")
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} does not cover {n} devices")
+    return Mesh(np.asarray(devices).reshape(shape), axes)

@@ -1,0 +1,136 @@
+"""Sharded training step for the flagship sentence encoder.
+
+The reference performs no training — its local models are frozen torch
+checkpoints (embedders.py:270). A TPU-native framework that owns the
+embedder must also own its fine-tuning loop (contrastive InfoNCE over
+in-batch negatives, the standard recipe for bge-class retrievers), designed
+mesh-first:
+
+* dp: batch sharded over the data axis; gradients all-reduced by XLA (the
+  `psum` is implicit in jit once shardings are annotated);
+* tp: attention heads + MLP hidden sharded over the model axis
+  (Megatron-style column/row parallel pairs, expressed as NamedSharding
+  rules on the param tree — XLA inserts the collectives);
+* sp: activations sharded over sequence inside attention blocks via
+  sharding constraints on the token dimension (long-context analog).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.models.encoder import EncoderConfig, TransformerEncoder
+
+
+class TrainState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def param_sharding_rules(path: tuple[str, ...], leaf) -> P:
+    """Megatron-style tp rules keyed on our encoder's param tree paths.
+
+    - attention q/k/v DenseGeneral kernels [hidden, heads, head_dim]:
+      shard heads (column-parallel);
+    - attention out kernel [heads, head_dim, hidden]: shard heads
+      (row-parallel — XLA inserts the psum);
+    - mlp_in kernel [hidden, mlp]: shard mlp dim (column-parallel);
+    - mlp_out kernel [mlp, hidden]: shard mlp dim (row-parallel);
+    - embeddings, layernorms, biases: replicated.
+    """
+    names = set(path)
+    if "attention" in names:
+        if "out" in names and path[-1] == "kernel":
+            return P("tp", None, None)
+        if path[-1] == "kernel":
+            return P(None, "tp", None)
+        return P()
+    if "mlp_in" in names and path[-1] == "kernel":
+        return P(None, "tp")
+    if "mlp_out" in names and path[-1] == "kernel":
+        return P("tp", None)
+    return P()
+
+
+def make_param_shardings(mesh: Mesh, params) -> Any:
+    def one(path, leaf):
+        spec = param_sharding_rules(tuple(str(p.key) for p in path), leaf)
+        if len(spec) > len(getattr(leaf, "shape", ())):
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def create_train_state(
+    config: EncoderConfig,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+    learning_rate: float = 1e-4,
+) -> tuple[TrainState, TransformerEncoder, optax.GradientTransformation]:
+    model = TransformerEncoder(config)
+    rng = jax.random.PRNGKey(seed)
+    params = model.init(
+        rng, jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32)
+    )["params"]
+    tx = optax.adamw(learning_rate)
+    shardings = make_param_shardings(mesh, params)
+    params = jax.device_put(params, shardings)
+    opt_state = tx.init(params)
+    state = TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    return state, model, tx
+
+
+def contrastive_loss(q_emb, d_emb, temperature: float = 0.05):
+    """InfoNCE over in-batch negatives: row i's positive is column i."""
+    logits = q_emb @ d_emb.T / temperature
+    labels = jnp.arange(logits.shape[0])
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def contrastive_train_step(model, tx, state: TrainState, batch, *, mesh=None):
+    """One InfoNCE step. batch = dict(q_ids, q_mask, d_ids, d_mask)."""
+
+    def loss_fn(params):
+        q_emb = model.apply({"params": params}, batch["q_ids"], batch["q_mask"])
+        d_emb = model.apply({"params": params}, batch["d_ids"], batch["d_mask"])
+        return contrastive_loss(q_emb, d_emb)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return (
+        TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+        loss,
+    )
+
+
+def make_sharded_train_step(model, tx, mesh: Mesh):
+    """jit the train step over the mesh: batch on dp, params on tp rules.
+
+    The returned fn takes (state, batch dict of np/jnp arrays [n, L]) and
+    runs one step; XLA inserts the dp gradient all-reduce and the tp
+    collectives implied by the param shardings.
+    """
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        batch = {
+            k: jax.lax.with_sharding_constraint(v, batch_sharding)
+            for k, v in batch.items()
+        }
+        return contrastive_train_step(model, tx, state, batch, mesh=mesh)
+
+    return step

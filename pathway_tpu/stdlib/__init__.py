@@ -1,0 +1,2 @@
+"""pathway_tpu.stdlib — standard library (reference:
+python/pathway/stdlib/, SURVEY §2.7)."""

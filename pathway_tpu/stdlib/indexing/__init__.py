@@ -1,0 +1,43 @@
+"""pw.indexing — retrieval indexes (reference:
+python/pathway/stdlib/indexing/__init__.py; SURVEY §2.4).
+
+TPU-first: KNN retrieval runs on fused MXU matmul+top-k shards
+(pathway_tpu.ops) that can be mesh-sharded (pathway_tpu.parallel) instead
+of the reference's per-worker replicated host indexes.
+"""
+
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.colnames import _INDEX_REPLY, _MATCHED_ID, _SCORE
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    UsearchKnn,
+    UsearchKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    default_brute_force_knn_document_index,
+    default_usearch_knn_document_index,
+)
+from pathway_tpu.stdlib.indexing.full_text_document_index import (
+    default_full_text_document_index,
+)
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "InnerIndexFactory",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "UsearchKnn",
+    "UsearchKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "default_brute_force_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_full_text_document_index",
+]

@@ -1,0 +1,129 @@
+"""Full-text BM25 index (reference:
+python/pathway/stdlib/indexing/bm25.py:41 TantivyBM25 — tantivy-backed in
+the native core, src/external_integration/tantivy_integration.rs:16).
+
+Here the inverted index is an in-process posting-list structure (term ->
+{doc: tf}) scored with Okapi BM25. Class names keep reference parity so
+templates configuring `TantivyBM25` run unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.stdlib.indexing._filters import compile_filter
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
+
+_WORD_RE = re.compile(r"[A-Za-z0-9_]+")
+
+
+def _tokenize(text: str) -> list[str]:
+    return [w.lower() for w in _WORD_RE.findall(str(text))]
+
+
+class _Bm25Adapter:
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        self.k1 = k1
+        self.b = b
+        self.postings: dict[str, dict[Any, int]] = {}
+        self.doc_len: dict[Any, int] = {}
+        self.meta: dict[Any, Any] = {}
+
+    def add(self, key, data, filter_data) -> None:
+        if key in self.doc_len:
+            self.remove(key)
+        toks = _tokenize(data)
+        self.doc_len[key] = len(toks)
+        self.meta[key] = filter_data
+        for tok in toks:
+            d = self.postings.setdefault(tok, {})
+            d[key] = d.get(key, 0) + 1
+
+    def remove(self, key) -> None:
+        if key not in self.doc_len:
+            return
+        del self.doc_len[key]
+        self.meta.pop(key, None)
+        for tok, d in list(self.postings.items()):
+            if key in d:
+                del d[key]
+                if not d:
+                    del self.postings[tok]
+
+    def _scores(self, query: str) -> dict[Any, float]:
+        n = len(self.doc_len)
+        if n == 0:
+            return {}
+        avg_len = sum(self.doc_len.values()) / n
+        scores: dict[Any, float] = {}
+        for tok in _tokenize(query):
+            plist = self.postings.get(tok)
+            if not plist:
+                continue
+            idf = math.log(1.0 + (n - len(plist) + 0.5) / (len(plist) + 0.5))
+            for key, tf in plist.items():
+                dl = self.doc_len[key]
+                denom = tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
+                scores[key] = scores.get(key, 0.0) + idf * tf * (self.k1 + 1) / denom
+        return scores
+
+    def search(self, queries):
+        out = []
+        for qdata, limit, filt in queries:
+            pred = compile_filter(filt) if isinstance(filt, str) else filt
+            scored = sorted(
+                self._scores(str(qdata)).items(), key=lambda kv: (-kv[1], repr(kv[0]))
+            )
+            hits = []
+            for key, score in scored:
+                if pred is not None:
+                    try:
+                        if not pred(self.meta.get(key)):
+                            continue
+                    except Exception:
+                        continue
+                hits.append((key, score))
+                if len(hits) == limit:
+                    break
+            out.append(
+                (
+                    tuple(k for k, _ in hits),
+                    tuple(s for _, s in hits),
+                )
+            )
+        return out
+
+
+@dataclass(frozen=True)
+class TantivyBM25(InnerIndex):
+    """BM25 text index (reference name kept for config compatibility)."""
+
+    ram_budget: int = 50_000_000  # accepted, unused (no tantivy here)
+    in_memory_index: bool = True
+    k1: float = 1.2
+    b: float = 0.75
+
+    def make_adapter(self):
+        return _Bm25Adapter(k1=self.k1, b=self.b)
+
+
+@dataclass
+class TantivyBM25Factory(InnerIndexFactory):
+    ram_budget: int = 50_000_000
+    in_memory_index: bool = True
+
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> InnerIndex:
+        return TantivyBM25(
+            data_column=data_column,
+            metadata_column=metadata_column,
+            ram_budget=self.ram_budget,
+            in_memory_index=self.in_memory_index,
+        )

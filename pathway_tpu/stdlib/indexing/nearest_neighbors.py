@@ -1,0 +1,186 @@
+"""KNN inner indexes (reference:
+python/pathway/stdlib/indexing/nearest_neighbors.py — BruteForceKnn :170,
+USearchKnn :65 and their factories).
+
+Both front-ends here are backed by the TPU brute-force shard
+(pathway_tpu.ops.KnnShard — padded HBM buffer, fused MXU matmul + top-k;
+Pallas variant in ops/pallas_knn.py). The reference's USearchKnn wraps a
+host-CPU HNSW (usearch_integration.rs:20); at vector-search scales that fit
+one HBM the fused brute-force scan is both exact and faster on TPU, so
+`UsearchKnn` is an API-compatible alias with HNSW-specific knobs accepted
+and ignored. Mesh-sharded capacity lives in
+pathway_tpu.parallel.ShardedKnnIndex and is selected with `mesh=`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.expression import ColumnExpression, ColumnReference
+from pathway_tpu.stdlib.indexing._filters import compile_filter
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
+
+
+class _KnnAdapter:
+    """ExternalIndexAdapter over a (sharded) KNN shard with filter-aware
+    over-querying (reference: DerivedFilteredSearchIndex retries with
+    growing k when a filter starves results, external_integration/mod.rs)."""
+
+    def __init__(self, dimension: int, metric: str, mesh=None, capacity: int = 128):
+        if mesh is not None:
+            from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+
+            self.shard = ShardedKnnIndex(dimension, mesh, metric=metric)
+        else:
+            from pathway_tpu.ops.knn import KnnShard
+
+            self.shard = KnnShard(dimension, metric, capacity=capacity)
+        self.meta: dict[Any, Any] = {}
+
+    def add(self, key, data, filter_data) -> None:
+        vec = np.asarray(data, dtype=np.float32)
+        self.shard.add([key], vec[None, :] if vec.ndim == 1 else vec)
+        self.meta[key] = filter_data
+
+    def remove(self, key) -> None:
+        self.shard.remove([key])
+        self.meta.pop(key, None)
+
+    def search(self, queries):
+        out = []
+        for qdata, limit, filt in queries:
+            vec = np.asarray(qdata, dtype=np.float32)[None, :]
+            pred = compile_filter(filt) if isinstance(filt, str) else filt
+            if pred is None:
+                hits = self.shard.search(vec, limit)[0]
+            else:
+                # over-query, growing k until the filter stops starving us
+                k = max(limit * 4, limit)
+                n_total = len(self.shard)
+                while True:
+                    raw = self.shard.search(vec, min(k, n_total))[0]
+                    hits = [
+                        (key, score)
+                        for key, score in raw
+                        if self._match(pred, key)
+                    ][:limit]
+                    if len(hits) >= limit or len(raw) >= n_total:
+                        break
+                    k *= 4
+            out.append(
+                (
+                    tuple(key for key, _ in hits),
+                    tuple(score for _, score in hits),
+                )
+            )
+        return out
+
+    def _match(self, pred, key) -> bool:
+        meta = self.meta.get(key)
+        try:
+            return bool(pred(meta))
+        except Exception:
+            return False
+
+
+def _calculate_embeddings(column: ColumnReference, embedder):
+    """Apply an embedder UDF to a text column, materializing the embedded
+    column on the column's table (reference: nearest_neighbors.py:52)."""
+    if embedder is None:
+        return column
+    table = column.table.with_columns(_pw_embedded_column=embedder(column))
+    return table._pw_embedded_column
+
+
+@dataclass(frozen=True)
+class _EmbeddingKnn(InnerIndex):
+    dimensions: int = 0
+    reserved_space: int = 128
+    metric: str = "cos"  # cos | l2sq | dot
+    embedder: Any = None
+    mesh: Any = None
+
+    def make_adapter(self):
+        return _KnnAdapter(
+            self.dimensions, self.metric,
+            mesh=self.mesh, capacity=self.reserved_space,
+        )
+
+    def _lower_query(self, query_column, number_of_matches, metadata_filter, mode):
+        query_column = _calculate_embeddings(query_column, self.embedder)
+        return super()._lower_query(
+            query_column, number_of_matches, metadata_filter, mode
+        )
+
+
+@dataclass(frozen=True)
+class BruteForceKnn(_EmbeddingKnn):
+    """Exact KNN on the TPU shard (reference: nearest_neighbors.py:170;
+    native core brute_force_knn_integration.rs:22)."""
+
+
+@dataclass(frozen=True)
+class UsearchKnn(_EmbeddingKnn):
+    """API-parity alias (reference: nearest_neighbors.py:65). HNSW knobs
+    are accepted for compatibility; search is the exact TPU scan."""
+
+    connectivity: int = 0
+    expansion_add: int = 0
+    expansion_search: int = 0
+
+
+@dataclass
+class BruteForceKnnFactory(InnerIndexFactory):
+    dimensions: int | None = None
+    reserved_space: int = 128
+    metric: str = "cos"
+    embedder: Any = None
+    mesh: Any = None
+
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> InnerIndex:
+        return BruteForceKnn(
+            data_column=_calculate_embeddings(data_column, self.embedder),
+            metadata_column=metadata_column,
+            dimensions=self.dimensions or 0,
+            reserved_space=self.reserved_space,
+            metric=self.metric,
+            embedder=self.embedder,
+            mesh=self.mesh,
+        )
+
+
+@dataclass
+class UsearchKnnFactory(InnerIndexFactory):
+    dimensions: int | None = None
+    reserved_space: int = 128
+    metric: str = "cos"
+    connectivity: int = 0
+    expansion_add: int = 0
+    expansion_search: int = 0
+    embedder: Any = None
+    mesh: Any = None
+
+    def build_inner_index(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnExpression | None = None,
+    ) -> InnerIndex:
+        return UsearchKnn(
+            data_column=_calculate_embeddings(data_column, self.embedder),
+            metadata_column=metadata_column,
+            dimensions=self.dimensions or 0,
+            reserved_space=self.reserved_space,
+            metric=self.metric,
+            connectivity=self.connectivity,
+            expansion_add=self.expansion_add,
+            expansion_search=self.expansion_search,
+            embedder=self.embedder,
+            mesh=self.mesh,
+        )

@@ -1,0 +1,5 @@
+"""pathway_tpu.stdlib.utils (reference: python/pathway/stdlib/utils)."""
+
+from pathway_tpu.stdlib.utils.col import unpack_col
+
+__all__ = ["unpack_col"]

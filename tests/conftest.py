@@ -2,12 +2,21 @@ import os
 
 # Must be set before jax initializes: tests run on a virtual 8-device CPU
 # mesh so multi-chip sharding paths are exercised without TPU hardware.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Force CPU as the default backend: the environment's TPU plugin rewrites
+# JAX_PLATFORMS at import time (env vars alone don't stick), so override via
+# jax.config after import. Tests need the 8-device virtual mesh; set
+# PATHWAY_TPU_TEST_REAL=1 to run against the real chip instead.
+if os.environ.get("PATHWAY_TPU_TEST_REAL") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,77 @@
+"""Regression tests for review-confirmed bugs (round 1 code review)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import _value_to_bytes, ref_scalar
+
+
+def test_ref_scalar_injective_separators():
+    assert ref_scalar("a\x1eSb") != ref_scalar("a", "b")
+    assert ref_scalar(("a", "b")) != ref_scalar("a\x1fSb")
+    assert ref_scalar("a", "b") != ref_scalar("ab")
+
+
+def test_value_to_bytes_ndarray_shape():
+    a = np.array([1.0, 2.0])
+    b = np.array([[1.0], [2.0]])
+    assert _value_to_bytes(a) != _value_to_bytes(b)
+    assert _value_to_bytes(a) != _value_to_bytes(a.astype(np.float32))
+
+
+def test_outer_join_unified_key_column():
+    t1 = pw.debug.table_from_markdown(
+        """
+        k | a
+        1 | 10
+        2 | 20
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        k | b
+        2 | 200
+        3 | 300
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k, how="outer").select(pw.this.k)
+    captures = pw.internals.graph_runner.GraphRunner().run_tables(res)
+    ks = sorted(row[0] for row in captures[0].state.rows.values())
+    assert ks == [1, 2, 3]  # right-only row must carry k=3, not None
+
+
+def test_nondeterministic_udf_retraction_replays_memo():
+    """A non-deterministic UDF's output must be retracted with the SAME value
+    it originally produced (reference: consistent-deletions semantics)."""
+    calls = [0]
+
+    @pw.udf(deterministic=False)
+    def tag(v: int) -> int:
+        calls[0] += 1
+        return calls[0]
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v=10)
+            self.commit()
+            self.remove(k=1, v=10)
+            self.commit()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    tagged = t.select(pw.this.k, tag=tag(pw.this.v))
+    events = []
+    pw.io.subscribe(
+        tagged,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["tag"], is_addition)
+        ),
+    )
+    pw.run()
+    # the insert and its retraction must carry the same tag value
+    assert len(events) == 2
+    assert events[0][0] == events[1][0]
+    assert events[0][1] is True and events[1][1] is False

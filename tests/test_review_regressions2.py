@@ -1,0 +1,222 @@
+"""Regression tests for the second review batch (round 1)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values())
+
+
+def test_filter_accepts_numpy_bool():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+
+    @pw.udf(deterministic=True)
+    def np_gt(v: int) -> bool:
+        return np.bool_(v > 1)
+
+    out = t.filter(np_gt(pw.this.v))
+    assert _rows(out) == [(2,), (3,)]
+
+
+def test_if_else_accepts_numpy_bool():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        1
+        5
+        """
+    )
+
+    @pw.udf(deterministic=True)
+    def np_big(v: int) -> bool:
+        return np.bool_(v > 3)
+
+    out = t.select(r=pw.if_else(np_big(pw.this.v), pw.this.v * 10, 0))
+    assert _rows(out) == [(0,), (50,)]
+
+
+def test_upsert_retracts_previous_row():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v=10)
+            self.commit()
+            self.next(k=1, v=20)
+            self.commit()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    agg = t.reduce(c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v))
+    res = {}
+    pw.io.subscribe(
+        agg,
+        on_change=lambda key, row, time, is_addition: res.update(
+            {"last": (row["c"], row["s"], is_addition)}
+        ),
+    )
+    pw.run()
+    assert res["last"] == (1, 20, True)  # not double-counted
+
+
+def test_nondeterministic_udf_in_reducer_args():
+    calls = [0]
+
+    @pw.udf  # deterministic=False by default
+    def tag(v: int) -> int:
+        calls[0] += 1
+        return calls[0] * 100
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v=1)
+            self.next(k=2, v=2)
+            self.commit()
+            self.remove(k=1, v=1)
+            self.commit()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    agg = t.reduce(s=pw.reducers.sum(tag(pw.this.v)))
+    final = {}
+    pw.io.subscribe(
+        agg,
+        on_change=lambda key, row, time, is_addition: final.update(
+            {"s": row["s"]} if is_addition else {}
+        ),
+    )
+    pw.run()
+    # after retraction of row k=1, the sum must equal the surviving row's
+    # original tag (its first-computed value), not a recomputed one
+    assert final["s"] == 200
+
+
+def test_memoized_rowwise_with_ndarray_column():
+    @pw.udf
+    def vec(v: int) -> np.ndarray:
+        return np.asarray([v, v], dtype=np.float32)
+
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v=7)
+            self.commit()
+            self.remove(k=1, v=7)
+            self.commit()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    sel = t.select(pw.this.k, e=vec(pw.this.v))
+    events = []
+    pw.io.subscribe(
+        sel,
+        on_change=lambda key, row, time, is_addition: events.append(is_addition),
+    )
+    pw.run()  # must not raise "truth value of an array is ambiguous"
+    assert events == [True, False]
+
+
+def test_join_id_from_pointer_column_values():
+    t1 = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+    t1 = t1.with_columns(p=t1.pointer_from(t1.a))
+    t2 = pw.debug.table_from_markdown(
+        """
+        b
+        1
+        2
+        """
+    )
+    joined = t1.join(t2, t1.a == t2.b, id=t1.p).select(pw.this.a)
+    captures = GraphRunner().run_tables(joined)
+    keys = set(captures[0].state.rows.keys())
+    from pathway_tpu.internals.api import ref_scalar
+
+    assert keys == {ref_scalar(1), ref_scalar(2)}
+
+
+def test_groupby_id_kwarg_sets_output_ids():
+    t = pw.debug.table_from_markdown(
+        """
+        a | v
+        1 | 10
+        1 | 20
+        2 | 30
+        """
+    )
+    t = t.with_columns(p=t.pointer_from(t.a))
+    agg = t.groupby(id=pw.this.p).reduce(s=pw.reducers.sum(pw.this.v))
+    captures = GraphRunner().run_tables(agg)
+    from pathway_tpu.internals.api import ref_scalar
+
+    got = {k: row for k, row in captures[0].state.rows.items()}
+    assert got == {ref_scalar(1): (30,), ref_scalar(2): (30,)}
+
+
+def test_join_rejects_unknown_kwargs():
+    t1 = pw.debug.table_from_markdown("a\n1")
+    t2 = pw.debug.table_from_markdown("b\n1")
+    try:
+        t1.join(t2, t1.a == t2.b, bogus=True)
+    except TypeError:
+        pass
+    else:
+        raise AssertionError("expected TypeError for unknown join kwarg")
+
+
+def test_fs_remove_with_duplicate_content(tmp_path):
+    # two files with identical content; deleting one must retract ITS row
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "a.txt").write_text("same\n")
+    (d / "b.txt").write_text("same\n")
+
+    import threading
+
+    t = pw.io.fs.read(
+        str(d), format="plaintext", mode="streaming",
+        autocommit_duration_ms=10, refresh_interval=0.05,
+    )
+    counts = t.reduce(c=pw.reducers.count())
+    seen = []
+    done = threading.Event()
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            seen.append(row["c"])
+            if row["c"] == 2:
+                (d / "a.txt").unlink()
+            if row["c"] == 1 and 2 in seen:
+                done.set()
+
+    pw.io.subscribe(counts, on_change=on_change)
+
+    def stop_later():
+        done.wait(timeout=10)
+        t._source  # keep ref
+
+    runner = threading.Thread(target=pw.run, daemon=True)
+    runner.start()
+    assert done.wait(timeout=10), f"never saw count drop back to 1; saw {seen}"
