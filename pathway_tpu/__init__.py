@@ -40,6 +40,7 @@ from pathway_tpu.internals.expression import (
     unwrap,
 )
 from pathway_tpu.internals.groupbys import GroupedTable
+from pathway_tpu.internals.iterate import iterate
 from pathway_tpu.internals.joins import JoinMode, JoinResult
 from pathway_tpu.internals.parse_graph import G, ParseGraph
 from pathway_tpu.internals.schema import (
@@ -63,6 +64,8 @@ DateTimeUtc = _dt.DATE_TIME_UTC
 Duration = _dt.DURATION
 
 from pathway_tpu import debug, io, udfs  # noqa: E402
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 
 __version__ = "0.1.0"
 

@@ -70,6 +70,11 @@ class Node:
     def on_time_end(self, time: int) -> None:
         pass
 
+    def on_input_closed(self) -> None:
+        """Called once when all inputs are exhausted, BEFORE on_end: nodes
+        holding buffered state (time buffers) flush here so the final
+        batches still flow through the graph."""
+
     def on_end(self) -> None:
         pass
 

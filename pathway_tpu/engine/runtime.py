@@ -90,6 +90,17 @@ class Runtime:
             node.on_time_end(time)
 
     def _finish(self) -> None:
+        # phase 1: input closure — buffers flush their held rows, which
+        # must still flow through the graph before on_end callbacks fire.
+        # Loop until quiescent: an upstream buffer's flush may land inside
+        # a DOWNSTREAM buffer that then needs its own closure flush.
+        for _ in range(len(self.scope.nodes) + 1):
+            for node in self.scope.nodes:
+                node.on_input_closed()
+            if not self.pending_times:
+                break
+            while self.pending_times:
+                self._step_time(min(self.pending_times))
         for node in self.scope.nodes:
             node.on_end()
         if self._async_loop is not None:

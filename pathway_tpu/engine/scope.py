@@ -153,6 +153,21 @@ class Scope:
         )
         return EngineTable(node, n_group_cols + 1)
 
+    def buffer(self, table: EngineTable, gate_fn) -> EngineTable:
+        from pathway_tpu.engine.time_gate import BufferNode
+
+        return EngineTable(BufferNode(self, table.node, gate_fn), table.width)
+
+    def freeze(self, table: EngineTable, gate_fn) -> EngineTable:
+        from pathway_tpu.engine.time_gate import FreezeNode
+
+        return EngineTable(FreezeNode(self, table.node, gate_fn), table.width)
+
+    def forget(self, table: EngineTable, gate_fn) -> EngineTable:
+        from pathway_tpu.engine.time_gate import ForgetNode
+
+        return EngineTable(ForgetNode(self, table.node, gate_fn), table.width)
+
     def forget_immediately(self, table: EngineTable) -> EngineTable:
         return EngineTable(
             N.ForgetImmediatelyNode(self, table.node), table.width

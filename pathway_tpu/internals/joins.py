@@ -227,7 +227,8 @@ class JoinResult:
                 else:
                     right_id_fn = _id_fn
 
-            joined = ctx.scope.join(
+            joined = self_._engine_join(
+                ctx,
                 let,
                 ret,
                 lkey,
@@ -266,6 +267,24 @@ class JoinResult:
 
         G.add_operator([left, right], [out], lower, f"join_{how}")
         return out
+
+    def _engine_join(
+        self, ctx, let, ret, lkey, rkey, how, *,
+        id_from_left, id_from_right, left_id_fn, right_id_fn,
+    ):
+        """Engine-join construction hook; temporal joins override this
+        (stdlib/temporal) while reusing the select/desugaring machinery."""
+        return ctx.scope.join(
+            let,
+            ret,
+            lkey,
+            rkey,
+            how,
+            id_from_left=id_from_left,
+            id_from_right=id_from_right,
+            left_id_fn=left_id_fn,
+            right_id_fn=right_id_fn,
+        )
 
     def _desugar(self, e):
         def fn(x):

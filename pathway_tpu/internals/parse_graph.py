@@ -8,6 +8,7 @@ the reachable subgraph onto an engine Runtime (graph_runner.py).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 from typing import TYPE_CHECKING, Any, Callable
 
@@ -82,6 +83,19 @@ class ParseGraph:
     def clear(self) -> None:
         self.operators.clear()
         self.cache.clear()
+
+    @contextlib.contextmanager
+    def scoped(self):
+        """Capture operators declared inside the block into a private list
+        instead of the global graph (reference: iterate subscopes,
+        parse_graph.py Scope :27). Yields the list; on exit the global
+        operator list is restored."""
+        saved = self.operators
+        self.operators = []
+        try:
+            yield self.operators
+        finally:
+            self.operators = saved
 
 
 G = ParseGraph()

@@ -450,6 +450,47 @@ class Table(TableLike):
             self, time_expr, window=window, instance=instance, behavior=behavior
         )
 
+    def interval_join(self, other, self_time, other_time, interval, *on, **kwargs):
+        from pathway_tpu.stdlib.temporal import interval_join as _ij
+
+        return _ij(self, other, self_time, other_time, interval, *on, **kwargs)
+
+    def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
+        return self.interval_join(other, self_time, other_time, interval, *on, how="inner", **kw)
+
+    def interval_join_left(self, other, self_time, other_time, interval, *on, **kw):
+        return self.interval_join(other, self_time, other_time, interval, *on, how="left", **kw)
+
+    def interval_join_right(self, other, self_time, other_time, interval, *on, **kw):
+        return self.interval_join(other, self_time, other_time, interval, *on, how="right", **kw)
+
+    def interval_join_outer(self, other, self_time, other_time, interval, *on, **kw):
+        return self.interval_join(other, self_time, other_time, interval, *on, how="outer", **kw)
+
+    def asof_join(self, other, self_time, other_time, *on, **kwargs):
+        from pathway_tpu.stdlib.temporal import asof_join as _aj
+
+        return _aj(self, other, self_time, other_time, *on, **kwargs)
+
+    def asof_join_left(self, other, self_time, other_time, *on, **kw):
+        return self.asof_join(other, self_time, other_time, *on, how="left", **kw)
+
+    def asof_join_right(self, other, self_time, other_time, *on, **kw):
+        return self.asof_join(other, self_time, other_time, *on, how="right", **kw)
+
+    def asof_join_outer(self, other, self_time, other_time, *on, **kw):
+        return self.asof_join(other, self_time, other_time, *on, how="outer", **kw)
+
+    def asof_now_join(self, other, *on, **kwargs):
+        from pathway_tpu.stdlib.temporal import asof_now_join as _anj
+
+        return _anj(self, other, *on, **kwargs)
+
+    def window_join(self, other, self_time, other_time, window, *on, **kwargs):
+        from pathway_tpu.stdlib.temporal import window_join as _wj
+
+        return _wj(self, other, self_time, other_time, window, *on, **kwargs)
+
     # -- concat / update ---------------------------------------------------
     def concat(self, *others: "Table") -> "Table":
         out = Table(
@@ -665,6 +706,36 @@ class Table(TableLike):
 
         G.add_operator([self], [out], lower, "flatten")
         return out
+
+    def _time_gate(self, kind: str, threshold, time_expr) -> "Table":
+        threshold_e = self._desugar(expr_mod.smart_coerce(threshold))
+        time_e = self._desugar(expr_mod.smart_coerce(time_expr))
+        out = Table(self._schema_cls, Universe())
+        self_ = self
+
+        def lower(ctx):
+            et, fn = ctx.row_fn(self_, [threshold_e, time_e])
+            ctx.set_engine_table(out, getattr(ctx.scope, kind)(et, fn))
+
+        G.add_operator(
+            self._dep_tables([threshold_e, time_e]), [out], lower, kind
+        )
+        return out
+
+    def _buffer(self, threshold, time_expr) -> "Table":
+        """Hold rows until the operator watermark reaches `threshold`
+        (reference: Table._buffer -> time_column.rs postpone_core)."""
+        return self._time_gate("buffer", threshold, time_expr)
+
+    def _freeze(self, threshold, time_expr) -> "Table":
+        """Ignore updates arriving after `threshold` passed (reference:
+        Table._freeze -> TimeColumnFreeze)."""
+        return self._time_gate("freeze", threshold, time_expr)
+
+    def _forget(self, threshold, time_expr, mark_forgetting: bool = True) -> "Table":
+        """Retract rows once the watermark passes `threshold` (reference:
+        Table._forget -> TimeColumnForget)."""
+        return self._time_gate("forget", threshold, time_expr)
 
     def _forget_immediately(self) -> "Table":
         """Rows pass through and are retracted at the next timestamp

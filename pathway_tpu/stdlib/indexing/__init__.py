@@ -16,6 +16,7 @@ from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     UsearchKnn,
     UsearchKnnFactory,
 )
+from pathway_tpu.stdlib.indexing.lsh_knn import LshKnn, LshKnnFactory
 from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
 from pathway_tpu.stdlib.indexing.vector_document_index import (
     default_brute_force_knn_document_index,
@@ -33,6 +34,8 @@ __all__ = [
     "BruteForceKnnFactory",
     "UsearchKnn",
     "UsearchKnnFactory",
+    "LshKnn",
+    "LshKnnFactory",
     "TantivyBM25",
     "TantivyBM25Factory",
     "HybridIndex",

@@ -1,0 +1,6 @@
+"""pw.ml (reference: python/pathway/stdlib/ml/ — LSH KNN index,
+classifiers, smart_table_ops)."""
+
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+__all__ = ["KNNIndex", "classifiers"]

@@ -1,0 +1,89 @@
+"""pw.temporal — windows, temporal joins, behaviors (reference:
+python/pathway/stdlib/temporal/__init__.py; SURVEY §2.7)."""
+
+from pathway_tpu.stdlib.temporal._asof_join import (
+    AsofJoinResult,
+    Direction,
+    asof_join,
+    asof_join_left,
+    asof_join_outer,
+    asof_join_right,
+)
+from pathway_tpu.stdlib.temporal._asof_now_join import (
+    AsofNowJoinResult,
+    asof_now_join,
+    asof_now_join_inner,
+    asof_now_join_left,
+)
+from pathway_tpu.stdlib.temporal._interval_join import (
+    Interval,
+    IntervalJoinResult,
+    interval,
+    interval_join,
+    interval_join_inner,
+    interval_join_left,
+    interval_join_outer,
+    interval_join_right,
+)
+from pathway_tpu.stdlib.temporal._window import (
+    Window,
+    session,
+    sliding,
+    tumbling,
+    windowby,
+)
+from pathway_tpu.stdlib.temporal._window_join import (
+    window_join,
+    window_join_inner,
+    window_join_left,
+    window_join_outer,
+    window_join_right,
+)
+from pathway_tpu.stdlib.temporal.temporal_behavior import (
+    Behavior,
+    CommonBehavior,
+    ExactlyOnceBehavior,
+    apply_temporal_behavior,
+    common_behavior,
+    exactly_once_behavior,
+)
+from pathway_tpu.stdlib.temporal.time_utils import inactivity_detection, utc_now
+
+__all__ = [
+    "AsofJoinResult",
+    "AsofNowJoinResult",
+    "Behavior",
+    "CommonBehavior",
+    "Direction",
+    "ExactlyOnceBehavior",
+    "Interval",
+    "IntervalJoinResult",
+    "Window",
+    "apply_temporal_behavior",
+    "asof_join",
+    "asof_join_left",
+    "asof_join_outer",
+    "asof_join_right",
+    "asof_now_join",
+    "asof_now_join_inner",
+    "asof_now_join_left",
+    "common_behavior",
+    "exactly_once_behavior",
+    "inactivity_detection",
+    "interval",
+    "interval_join",
+    "interval_join_inner",
+    "interval_join_left",
+    "interval_join_outer",
+    "interval_join_right",
+    "session",
+    "sliding",
+    "tumbling",
+    "utc_now",
+    "window_join",
+    "window_join_inner",
+    "window_join_left",
+    "window_join_outer",
+    "window_join_right",
+    "windowby",
+]

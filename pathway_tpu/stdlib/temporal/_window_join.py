@@ -1,0 +1,89 @@
+"""Window join (reference:
+python/pathway/stdlib/temporal/_window_join.py, 1,217 LoC): joins rows of
+two tables whose times fall into the same window. Both sides get window
+assignments (tumbling/sliding via the shared assignment function; session
+via the concat trick), then a regular equality join on (window, *on)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import make_tuple
+from pathway_tpu.internals.joins import JoinResult
+from pathway_tpu.stdlib.temporal._window import Window, _SlidingWindow
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import apply_with_type
+
+
+def _assign_side(table, time_expr, window: _SlidingWindow, name: str):
+    assign = window._assign_fn()
+    time_e = table._desugar(expr_mod.smart_coerce(time_expr))
+    target = table.with_columns(
+        _pw_window=apply_with_type(
+            lambda key: assign(None, key), dt.ANY, time_e
+        ),
+    )
+    target = target.flatten(target["_pw_window"])
+    return target
+
+
+class WindowJoinResult(JoinResult):
+    """Remaps user references on the ORIGINAL tables onto the
+    window-assigned copies (reference: WindowJoinResult, _window.py:149)."""
+
+    def __init__(self, left, right, on, *, how, orig_left, orig_right):
+        super().__init__(left, right, on, how=how)
+        self._orig_left = orig_left
+        self._orig_right = orig_right
+
+    def select(self, *args, **kwargs):
+        from pathway_tpu.stdlib.temporal._interval_join import rebind
+
+        def fix(e):
+            e = rebind(e, self._orig_left, self._left)
+            return rebind(e, self._orig_right, self._right)
+
+        args = tuple(
+            fix(a) if hasattr(a, "_dtype") else a for a in args
+        )
+        kwargs = {k: fix(expr_mod.smart_coerce(v)) for k, v in kwargs.items()}
+        return super().select(*args, **kwargs)
+
+
+def window_join(
+    self_table, other_table, self_time, other_time, window: Window, *on,
+    how: str = "inner",
+) -> JoinResult:
+    if not isinstance(window, _SlidingWindow):
+        raise NotImplementedError(
+            "window_join currently supports tumbling/sliding windows"
+        )
+    how_str = how.value if hasattr(how, "value") else str(how)
+    left = _assign_side(self_table, self_time, window, "left")
+    right = _assign_side(other_table, other_time, window, "right")
+    conds = [left["_pw_window"] == right["_pw_window"]]
+    from pathway_tpu.stdlib.temporal._interval_join import rebind
+
+    for cond in on:
+        cond = rebind(cond, self_table, left)
+        cond = rebind(cond, other_table, right)
+        conds.append(cond)
+    return WindowJoinResult(
+        left, right, conds, how=how_str,
+        orig_left=self_table, orig_right=other_table,
+    )
+
+
+def window_join_inner(*args, **kwargs):
+    return window_join(*args, how="inner", **kwargs)
+
+
+def window_join_left(*args, **kwargs):
+    return window_join(*args, how="left", **kwargs)
+
+
+def window_join_right(*args, **kwargs):
+    return window_join(*args, how="right", **kwargs)
+
+
+def window_join_outer(*args, **kwargs):
+    return window_join(*args, how="outer", **kwargs)

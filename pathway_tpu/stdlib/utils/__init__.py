@@ -1,5 +1,15 @@
 """pathway_tpu.stdlib.utils (reference: python/pathway/stdlib/utils)."""
 
-from pathway_tpu.stdlib.utils.col import unpack_col
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.col import apply_all_rows, unpack_col
+from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
 
-__all__ = ["unpack_col"]
+__all__ = [
+    "AsyncTransformer",
+    "apply_all_rows",
+    "argmax_rows",
+    "argmin_rows",
+    "pandas_transformer",
+    "unpack_col",
+]

@@ -1,0 +1,22 @@
+"""pw.universes — universe promises (reference:
+python/pathway/internals/universes.py: promise_are_pairwise_disjoint,
+promise_is_subset_of, promise_are_equal)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.universe import SOLVER
+
+
+def promise_is_subset_of(subset, superset) -> None:
+    SOLVER.register_subset(subset._universe, superset._universe)
+
+
+def promise_are_equal(*tables) -> None:
+    for t in tables[1:]:
+        SOLVER.register_as_equal(tables[0]._universe, t._universe)
+
+
+def promise_are_pairwise_disjoint(*tables) -> None:
+    """Disjointness is used by concat validation; the solver treats
+    unrelated universes as disjoint by default, so this is a no-op marker
+    kept for reference API parity."""

@@ -1,0 +1,73 @@
+"""AsyncTransformer tests (reference pattern:
+python/pathway/tests/test_async_transformer.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values(), key=repr)
+
+
+class OutputSchema(pw.Schema):
+    ret: int
+
+
+def test_async_transformer_successful():
+    t = pw.debug.table_from_markdown(
+        """
+        value
+        1
+        2
+        3
+        """
+    )
+
+    class Doubler(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict:
+            return {"ret": value * 2}
+
+    result = Doubler(input_table=t).successful
+    assert _rows(result) == [(2,), (4,), (6,)]
+
+
+def test_async_transformer_failures_split():
+    t = pw.debug.table_from_markdown(
+        """
+        value
+        1
+        2
+        """
+    )
+
+    class Flaky(pw.AsyncTransformer, output_schema=OutputSchema):
+        async def invoke(self, value: int) -> dict:
+            if value == 2:
+                raise RuntimeError("boom")
+            return {"ret": value}
+
+    tf = Flaky(input_table=t)
+    assert _rows(tf.successful) == [(1,)]
+    assert len(_rows(tf.failed)) == 1
+
+
+def test_pandas_transformer():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        2
+        """
+    )
+
+    class Out(pw.Schema):
+        b: int
+
+    @pw.pandas_transformer(output_schema=Out)
+    def double(df):
+        out = df[["a"]].rename(columns={"a": "b"})
+        out["b"] = out["b"] * 2
+        return out
+
+    assert _rows(double(t)) == [(2,), (4,)]

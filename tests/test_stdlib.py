@@ -1,0 +1,164 @@
+"""stdlib breadth tests: ordered.diff, statistical.interpolate, graphs,
+ml LSH index, stateful.deduplicate, demo."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values(), key=repr)
+
+
+def test_ordered_diff():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        2 | 13
+        3 | 19
+        """
+    )
+    res = t.diff(t.t, t.v)
+    assert _rows(res) == [(3,), (6,), (None,)]
+
+
+def test_statistical_interpolate():
+    t = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 1.0
+        2 |
+        3 | 3.0
+        """
+    )
+    res = pw.statistical.interpolate(t, t.t, t.v)
+    rows = sorted(_rows(res))
+    assert rows == [(1, 1.0), (2, 2.0), (3, 3.0)]
+
+
+def test_bellman_ford():
+    vertices = pw.debug.table_from_markdown(
+        """
+        name | is_source
+        a    | True
+        b    | False
+        c    | False
+        """
+    )
+    edge_names = pw.debug.table_from_markdown(
+        """
+        un | vn | dist
+        a  | b  | 2.0
+        b  | c  | 3.0
+        a  | c  | 10.0
+        """
+    )
+    edges = edge_names.select(
+        u=vertices.pointer_from(edge_names.un),
+        v=vertices.pointer_from(edge_names.vn),
+        dist=edge_names.dist,
+    )
+    vertices = vertices.with_id(vertices.pointer_from(vertices.name))
+    res = pw.graphs.bellman_ford(vertices, edges)
+    dists = sorted(row[1] for row in _rows(res))
+    assert dists == [0.0, 2.0, 5.0]
+
+
+def test_lsh_knn_index():
+    rng = np.random.default_rng(0)
+    docs = pw.debug.table_from_markdown(
+        """
+        name
+        a
+        b
+        c
+        """
+    )
+    vecs = {"a": (0.0, 0.0), "b": (10.0, 10.0), "c": (0.5, 0.0)}
+    docs = docs.with_columns(
+        emb=pw.apply_with_type(lambda n: vecs[n], tuple, pw.this.name)
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        qname
+        qa
+        """
+    ).with_columns(
+        emb=pw.apply_with_type(lambda n: (0.1, 0.1), tuple, pw.this.qname)
+    )
+    index = pw.ml.index.KNNIndex(
+        docs.emb, docs, n_dimensions=2, n_or=8, n_and=4, bucket_length=5.0
+    )
+    res = index.get_nearest_items(queries.emb, k=2).select(
+        pw.this.qname, pw.this.name
+    )
+    rows = _rows(res)
+    assert rows[0][0] == "qa"
+    # nearest two of (0.1,0.1): a (0,0) then c (0.5,0)
+    assert rows[0][1] == ("a", "c")
+
+
+def test_stateful_deduplicate():
+    t = pw.debug.table_from_markdown(
+        """
+        v
+        1
+        3
+        2
+        5
+        """
+    )
+    res = pw.stateful.deduplicate(
+        t, value=t.v, acceptor=lambda new, cur: new > cur
+    )
+    # only increasing values are accepted: 1, 3, 5; final state = 5
+    assert [r[0] for r in _rows(res)] == [5]
+
+
+def test_indexing_lsh_knn_inner_index():
+    docs = pw.debug.table_from_markdown(
+        """
+        name
+        a
+        b
+        """
+    )
+    vecs = {"a": (0.0, 0.0), "b": (10.0, 10.0)}
+    docs = docs.with_columns(
+        emb=pw.apply_with_type(lambda n: vecs[n], tuple, pw.this.name)
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        q
+        1
+        """
+    ).with_columns(emb=pw.apply_with_type(lambda q: (1.0, 1.0), tuple, pw.this.q))
+    inner = pw.indexing.LshKnn(
+        data_column=docs.emb, dimensions=2, n_or=8, n_and=4, bucket_length=8.0
+    )
+    res = inner.query(queries.emb, number_of_matches=1)
+    rows = _rows(res.select(reply=res["_pw_index_reply"]))
+    assert len(rows[0][0]) == 1
+
+
+def test_pagerank_runs():
+    edges = pw.debug.table_from_markdown(
+        """
+        un | vn
+        a  | b
+        b  | c
+        c  | a
+        """
+    )
+    edges = edges.select(
+        u=edges.pointer_from(edges.un), v=edges.pointer_from(edges.vn)
+    )
+    res = pw.graphs.pagerank(edges, steps=3)
+    rows = _rows(res)
+    assert len(rows) == 3
+    assert all(isinstance(r[1], float) and r[1] > 0 for r in rows)
+    # symmetric 3-cycle: all ranks converge to 1.0
+    assert all(abs(r[1] - 1.0) < 0.2 for r in rows)

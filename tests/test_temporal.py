@@ -1,0 +1,252 @@
+"""Temporal suite tests (reference pattern: python/pathway/tests/temporal/
+— static tables + event-time columns, windowby/reduce compared to oracle;
+streaming behavior tests use _time-style deterministic replay)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values())
+
+
+def test_tumbling_window():
+    t = pw.debug.table_from_markdown(
+        """
+        k | t
+        a | 1
+        a | 3
+        a | 6
+        b | 11
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [
+        ("a", 0, 5, 2),
+        ("a", 5, 10, 1),
+        ("b", 10, 15, 1),
+    ]
+
+
+def test_sliding_window():
+    t = pw.debug.table_from_markdown(
+        """
+        t
+        3
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [(0, 4, 1), (2, 6, 1)]
+
+
+def test_session_window():
+    t = pw.debug.table_from_markdown(
+        """
+        k | t
+        a | 1
+        a | 2
+        a | 10
+        a | 11
+        b | 3
+        """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=5), instance=t.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [
+        ("a", 1, 2, 2),
+        ("a", 10, 11, 2),
+        ("b", 3, 3, 1),
+    ]
+
+
+def test_interval_join_inner():
+    t1 = pw.debug.table_from_markdown(
+        """
+        k | t
+        a | 10
+        a | 20
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        k | t | v
+        a | 11 | 100
+        a | 15 | 200
+        a | 25 | 300
+        """
+    )
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 2), t1.k == t2.k
+    ).select(lt=t1.t, rt=t2.t, v=t2.v)
+    assert _rows(res) == [(10, 11, 100)]
+
+
+def test_interval_join_left_padding():
+    t1 = pw.debug.table_from_markdown(
+        """
+        t
+        10
+        50
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        t | v
+        11 | 100
+        """
+    )
+    res = pw.temporal.interval_join_left(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 2)
+    ).select(lt=t1.t, v=t2.v)
+    assert _rows(res) == [(10, 100), (50, None)]
+
+
+def test_asof_join_backward():
+    trades = pw.debug.table_from_markdown(
+        """
+        sym | t | px
+        A   | 10 | 1
+        A   | 20 | 2
+        """
+    )
+    quotes = pw.debug.table_from_markdown(
+        """
+        sym | t | bid
+        A   | 8  | 95
+        A   | 15 | 96
+        A   | 30 | 99
+        """
+    )
+    res = pw.temporal.asof_join(
+        trades, quotes, trades.t, quotes.t, trades.sym == quotes.sym
+    ).select(t=trades.t, px=trades.px, bid=quotes.bid)
+    assert _rows(res) == [(10, 1, 95), (20, 2, 96)]
+
+
+def test_asof_now_join_not_revised():
+    """Left rows answered against right state at arrival; later right
+    updates must NOT revise past answers."""
+    import threading
+
+    gate = threading.Event()
+
+    class Rates(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(cur="usd", rate=100)
+            self.commit()
+            gate.wait(timeout=5)
+            self.next(cur="usd", rate=200)
+            self.commit()
+
+    class Queries(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time
+
+            time.sleep(0.3)
+            self.next(qid=1, cur="usd")
+            self.commit()
+            time.sleep(0.2)
+            gate.set()
+
+    class RS(pw.Schema):
+        cur: str = pw.column_definition(primary_key=True)
+        rate: int
+
+    class QS(pw.Schema):
+        qid: int = pw.column_definition(primary_key=True)
+        cur: str
+
+    rates = pw.io.python.read(Rates(), schema=RS, autocommit_duration_ms=None)
+    queries = pw.io.python.read(Queries(), schema=QS, autocommit_duration_ms=None)
+    res = pw.temporal.asof_now_join(
+        queries, rates, queries.cur == rates.cur
+    ).select(qid=queries.qid, rate=rates.rate)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["rate"], is_addition)
+        ),
+    )
+    pw.run()
+    assert events == [(100, True)]  # answered once, never revised
+
+
+def test_window_join():
+    t1 = pw.debug.table_from_markdown(
+        """
+        t | a
+        1 | x
+        7 | y
+        """
+    )
+    t2 = pw.debug.table_from_markdown(
+        """
+        t | b
+        2 | p
+        8 | q
+        """
+    )
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(a=t1.a, b=t2.b)
+    assert _rows(res) == [("x", "p"), ("y", "q")]
+
+
+def test_exactly_once_behavior_streaming():
+    """With exactly_once behavior, each window emits one final result when
+    the watermark passes window end (+shift) — no intermediate updates."""
+
+    class Events(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(t=1)
+            self.commit()
+            self.next(t=2)
+            self.commit()
+            self.next(t=7)  # advances watermark past window [0, 5)
+            self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    events_t = pw.io.python.read(Events(), schema=S, autocommit_duration_ms=None)
+    res = events_t.windowby(
+        events_t.t,
+        window=pw.temporal.tumbling(duration=5),
+        behavior=pw.temporal.exactly_once_behavior(),
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (row["start"], row["c"], is_addition)
+        ),
+    )
+    pw.run()
+    # window [0,5) must appear exactly once, with final count 2, after its
+    # end passed; no (.., 1, True) intermediate for that window
+    w0 = [u for u in updates if u[0] == 0]
+    assert w0 == [(0, 2, True)]
