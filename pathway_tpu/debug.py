@@ -125,12 +125,19 @@ def table_from_markdown(
             vals = [vals[idx] for _, vals in raw_rows]
             dtypes[c] = dt.lub(*(dt.dtype_of_value(v) for v in vals)) if vals else dt.ANY
         schema = schema_from_types(**dtypes)
+    else:
+        # explicit schema: markdown may give a column subset; the rest
+        # take schema defaults (reference table_from_markdown behavior)
+        value_cols = schema.column_names()
     pk = schema.primary_key_columns() if id_from is None else list(id_from)
+    defaults = schema.default_values()
 
     rows = []
     for i, (label, vals) in enumerate(raw_rows):
         by_name = dict(zip(cols, vals))
-        values = tuple(by_name[c] for c in value_cols)
+        values = tuple(
+            by_name.get(c, defaults.get(c)) for c in value_cols
+        )
         if pk:
             key = ref_scalar(*(by_name[c] for c in pk))
         elif label is not None:

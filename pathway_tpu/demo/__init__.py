@@ -71,6 +71,28 @@ def range_stream(
     )
 
 
+def _schema_coercer(schema: type[Schema]):
+    """Coerce CSV strings per the declared column types — guessing int/float
+    would corrupt str columns like \"0123\"."""
+    hints = schema.typehints()
+
+    def coerce(col: str, v):
+        if v is None:
+            return None
+        t = hints.get(col)
+        if t is dt.INT:
+            return int(v)
+        if t is dt.FLOAT:
+            return float(v)
+        if t is dt.BOOL:
+            return v in ("True", "true", "1")
+        if t is dt.STR:
+            return str(v)
+        return _coerce(v)
+
+    return coerce
+
+
 def replay_csv(
     path: str,
     *,
@@ -80,12 +102,13 @@ def replay_csv(
     """Replay a CSV file row by row at `input_rate` rows/s (reference:
     demo/__init__.py replay_csv)."""
     cols = schema.column_names()
+    coerce = _schema_coercer(schema)
 
     class _Replay(ConnectorSubject):
         def run(self):
             with open(path, newline="") as f:
                 for rec in _csv.DictReader(f):
-                    self.next(**{c: _coerce(rec.get(c)) for c in cols})
+                    self.next(**{c: coerce(c, rec.get(c)) for c in cols})
                     if input_rate > 0:
                         time.sleep(1.0 / input_rate)
             self.commit()
@@ -106,13 +129,14 @@ def replay_csv_with_time(
     (reference: demo/__init__.py replay_csv_with_time)."""
     cols = schema.column_names()
     unit_s = {"s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}[unit]
+    coerce = _schema_coercer(schema)
 
     class _Replay(ConnectorSubject):
         def run(self):
             prev_t = None
             with open(path, newline="") as f:
                 for rec in _csv.DictReader(f):
-                    row = {c: _coerce(rec.get(c)) for c in cols}
+                    row = {c: coerce(c, rec.get(c)) for c in cols}
                     t = float(row[time_column])
                     if prev_t is not None and t > prev_t:
                         time.sleep((t - prev_t) * unit_s / speedup)

@@ -297,18 +297,18 @@ class JoinNode(GroupDiffNode):
         out: list[Delta] = []
         jt = self.join_type
         if lrows and rrows:
-            for (lk, lrow), lc in lrows.items():
-                for (rk, rrow), rc in rrows.items():
+            for (lk, lrow), lc in lrows:
+                for (rk, rrow), rc in rrows:
                     out.append(
                         (self._out_key(lk, lrow, rk, rrow), lrow + rrow, lc * rc)
                     )
         if not rrows and lrows and jt in ("left", "outer"):
             pad = (None,) * (self.right_width or 0)
-            for (lk, lrow), lc in lrows.items():
+            for (lk, lrow), lc in lrows:
                 out.append((self._out_key(lk, lrow, None, None), lrow + pad, lc))
         if not lrows and rrows and jt in ("right", "outer"):
             pad = (None,) * (self.left_width or 0)
-            for (rk, rrow), rc in rrows.items():
+            for (rk, rrow), rc in rrows:
                 out.append((self._out_key(None, None, rk, rrow), pad + rrow, rc))
         return out
 

@@ -116,12 +116,16 @@ class MultisetState:
         for key, row, diff in deltas:
             self.apply_one(key, row, diff)
 
-    def get(self, key: Key) -> dict[Row, int]:
-        return {entry[0]: entry[1] for entry in self.data.get(key, {}).values()}
+    def get(self, key: Key) -> list[tuple[Row, int]]:
+        """[(row, count)] — a list, not a dict: rows may hold unhashable
+        values (ndarrays); the frozen form is an internal detail."""
+        return [
+            (entry[0], entry[1]) for entry in self.data.get(key, {}).values()
+        ]
 
     def items(self):
         for key, d in self.data.items():
-            yield key, {entry[0]: entry[1] for entry in d.values()}
+            yield key, [(entry[0], entry[1]) for entry in d.values()]
 
 
 def _row_hashable(row: Row) -> bool:

@@ -63,11 +63,11 @@ class TemporalJoinNode(GroupDiffNode):
 
     def output_of_group(self, jk) -> list[Delta]:
         lefts = []
-        for (lk, lrow), c in self.left.get(jk).items():
+        for (lk, lrow), c in self.left.get(jk):
             t = self.left_time_fn(lk, lrow)
             lefts.extend([(lk, lrow, t)] * max(c, 0))
         rights = []
-        for (rk, rrow), c in self.right.get(jk).items():
+        for (rk, rrow), c in self.right.get(jk):
             t = self.right_time_fn(rk, rrow)
             rights.extend([(rk, rrow, t)] * max(c, 0))
         out = []
@@ -103,7 +103,8 @@ class AsofNowJoinNode(Node):
         self.right_width = right_width
         self.id_from_left = id_from_left
         self.right = MultisetState()
-        self.answers: dict[Key, list[Delta]] = {}
+        # key -> [unit_deltas (per one left copy), live_count]
+        self.answers: dict[Key, list] = {}
 
     def process(self, time, batches):
         left_deltas = consolidate(batches[0])
@@ -116,23 +117,28 @@ class AsofNowJoinNode(Node):
         # replay (same ordering rule as external_index.py)
         for lk, lrow, d in left_deltas:
             if d < 0:
-                memo = self.answers.pop(lk, None)
+                memo = self.answers.get(lk)
                 if memo is not None:
-                    out.extend((k, r, -dd) for k, r, dd in memo)
+                    unit, count = memo
+                    n = min(-d, count)
+                    out.extend((k, r, -dd * n) for k, r, dd in unit)
+                    memo[1] -= n
+                    if memo[1] <= 0:
+                        del self.answers[lk]
         for lk, lrow, d in left_deltas:
             if d < 0:
                 continue
             jk = self.left_key_fn(lk, lrow)
             rrows = self.right.get(jk)
-            produced: list[Delta] = []
+            unit: list[Delta] = []
             if rrows:
-                for (rk, rrow), c in rrows.items():
+                for (rk, rrow), c in rrows:
                     key = lk if self.id_from_left else ref_scalar(lk, rk)
-                    produced.append((key, lrow + rrow, max(c, 0)))
+                    unit.append((key, lrow + rrow, max(c, 0)))
             elif self.mode in ("left", "outer"):
                 pad = (None,) * self.right_width
                 key = lk if self.id_from_left else ref_scalar(lk, None)
-                produced.append((key, lrow + pad, 1))
-            self.answers[lk] = produced
-            out.extend(produced)
+                unit.append((key, lrow + pad, 1))
+            self.answers[lk] = [unit, d]
+            out.extend((k, r, dd * d) for k, r, dd in unit)
         return consolidate(out)

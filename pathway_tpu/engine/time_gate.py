@@ -138,9 +138,8 @@ class ForgetNode(_WatermarkNode):
         if self.watermark is not None:
             while self.heap and self.heap[0][0].value <= self.watermark:
                 _, _, k, row = heapq.heappop(self.heap)
-                live = self.live.get(k)
                 count = 0
-                for lrow, c in live.items():
+                for lrow, c in self.live.get(k):
                     if freeze_row(lrow) == freeze_row(row):
                         count = c
                         break

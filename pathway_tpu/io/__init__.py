@@ -7,10 +7,10 @@ are stubbed with informative errors until their native backends land.
 
 from __future__ import annotations
 
-from pathway_tpu.io import csv, fs, jsonlines, plaintext, python
+from pathway_tpu.io import csv, fs, http, jsonlines, plaintext, python
 from pathway_tpu.io._subscribe import subscribe
 
-__all__ = ["csv", "fs", "jsonlines", "plaintext", "python", "subscribe"]
+__all__ = ["csv", "fs", "http", "jsonlines", "plaintext", "python", "subscribe"]
 
 
 class OnChangeCallback:  # typing alias used in reference signatures

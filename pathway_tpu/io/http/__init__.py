@@ -1,0 +1,18 @@
+"""pw.io.http — HTTP streaming client + REST server connector (reference:
+python/pathway/io/http/__init__.py:28 client; _server.py:624
+rest_connector + :329 PathwayWebserver)."""
+
+from pathway_tpu.io.http._server import (
+    EndpointDocumentation,
+    PathwayWebserver,
+    rest_connector,
+)
+from pathway_tpu.io.http._client import read, write
+
+__all__ = [
+    "PathwayWebserver",
+    "EndpointDocumentation",
+    "rest_connector",
+    "read",
+    "write",
+]

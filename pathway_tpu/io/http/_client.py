@@ -1,0 +1,83 @@
+"""HTTP streaming client connector (reference:
+python/pathway/io/http/__init__.py:28 — poll an endpoint into a table;
+write: POST each row to an endpoint)."""
+
+from __future__ import annotations
+
+import json as _json
+import time
+import urllib.request
+from typing import Any
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import Schema
+from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+class _HttpPollSubject(ConnectorSubject):
+    def __init__(self, url, refresh_interval, headers):
+        super().__init__()
+        self.url = url
+        self.refresh_interval = refresh_interval
+        self.headers = headers or {}
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            req = urllib.request.Request(self.url, headers=self.headers)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    body = resp.read().decode()
+            except Exception:
+                time.sleep(self.refresh_interval)
+                continue
+            for line in body.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self.next(**_json.loads(line))
+                except Exception:
+                    self.next(data=line)
+            self.commit()
+            time.sleep(self.refresh_interval)
+
+    def on_stop(self):
+        self._stop = True
+
+
+def read(
+    url: str,
+    *,
+    schema: type[Schema] | None = None,
+    method: str = "GET",
+    refresh_interval: float = 5.0,
+    headers: dict | None = None,
+    format: str = "json",
+    **kwargs,
+):
+    subject = _HttpPollSubject(url, refresh_interval, headers)
+    return python_read(subject, schema=schema)
+
+
+def write(table, url: str, *, method: str = "POST", headers: dict | None = None,
+          format: str = "json", **kwargs) -> None:
+    cols = table.column_names()
+    hdrs = {"Content-Type": "application/json", **(headers or {})}
+
+    def on_change(key, row, time_, diff):
+        if diff <= 0:
+            return
+        payload = _json.dumps(dict(zip(cols, row)), default=str).encode()
+        req = urllib.request.Request(
+            url, data=payload, method=method, headers=hdrs
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30).read()
+        except Exception:
+            pass  # reference logs and continues
+
+    def lower(ctx):
+        ctx.scope.output(ctx.engine_table(table), on_change=on_change)
+
+    G.add_operator([table], [], lower, "http_write", is_output=True)

@@ -1,0 +1,249 @@
+"""REST server connector (reference: python/pathway/io/http/_server.py —
+PathwayWebserver :329, rest_connector :624, RestServerSubject :525).
+
+One aiohttp application (owned by a PathwayWebserver) serves any number of
+routes; each route is a connector: an incoming request becomes a row in the
+queries table, the caller's response future resolves when the paired
+response-writer table produces the row with the same id."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json as _json
+import threading
+from typing import Any, Sequence
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.api import Json, Pointer, ref_scalar
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.schema import Schema
+from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+@dataclasses.dataclass
+class EndpointDocumentation:
+    summary: str | None = None
+    description: str | None = None
+    tags: Sequence[str] = ()
+    method_types: Sequence[str] | None = None
+
+
+class PathwayWebserver:
+    """Shared aiohttp server; routes register before pw.run() starts it
+    (reference: _server.py:329)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080,
+                 with_cors: bool = False, with_schema_endpoint: bool = True):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: list[tuple[str, tuple[str, ...], Any, Any]] = []
+        self._openapi: dict[str, Any] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.with_schema_endpoint = with_schema_endpoint
+
+    def _register_route(self, route, methods, handler, docs) -> None:
+        self._routes.append((route, methods, handler, docs))
+        self._openapi[route] = {
+            m.lower(): {
+                "summary": getattr(docs, "summary", None) or route,
+                "responses": {"200": {"description": "OK"}},
+            }
+            for m in methods
+        }
+
+    def _ensure_started(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _run(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        for route, methods, handler, _docs in self._routes:
+            for m in methods:
+                app.router.add_route(m, route, handler)
+        if self.with_schema_endpoint:
+            async def schema_handler(request):
+                return web.json_response(
+                    {"openapi": "3.0.3", "paths": self._openapi}
+                )
+
+            app.router.add_route("GET", "/_schema", schema_handler)
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()
+
+
+class RestServerSubject(ConnectorSubject):
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: tuple[str, ...],
+        schema: type[Schema],
+        delete_completed_queries: bool,
+        request_validator=None,
+        documentation=None,
+    ):
+        super().__init__()
+        self.webserver = webserver
+        self.route = route
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self._tasks: dict[Pointer, asyncio.Future] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        webserver._register_route(
+            route, methods, self._handle, documentation
+        )
+
+    def run(self):
+        self.webserver._ensure_started()
+        # stays alive for the whole pipeline; requests drive next()/commit
+        self._shutdown = threading.Event()
+        self._shutdown.wait()
+
+    def on_stop(self):
+        if hasattr(self, "_shutdown"):
+            self._shutdown.set()
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        cols = self.schema.column_names()
+        defaults = self.schema.default_values()
+        if request.method == "GET":
+            payload = dict(request.query)
+        else:
+            try:
+                payload = await request.json()
+            except Exception:
+                payload = {}
+        if self.request_validator is not None:
+            try:
+                err = self.request_validator(payload)
+                if err is not None:
+                    return web.json_response({"error": str(err)}, status=400)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=400)
+        missing = [
+            c for c in cols if c not in payload and c not in defaults
+        ]
+        if missing:
+            return web.json_response(
+                {"error": f"missing fields: {missing}"}, status=400
+            )
+        values = {c: payload.get(c, defaults.get(c)) for c in cols}
+        # JSON-typed columns wrap payload fragments
+        for c, typ in self.schema.typehints().items():
+            if typ is dt.JSON and values.get(c) is not None and not isinstance(values[c], Json):
+                values[c] = Json(values[c])
+        with self._lock:
+            self._seq += 1
+            key = ref_scalar("rest", self.route, self._seq)
+        future: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._tasks[key] = future
+        self._upsert(key, values)
+        self.commit()
+        try:
+            result = await asyncio.wait_for(future, timeout=120)
+        except asyncio.TimeoutError:
+            return web.json_response({"error": "timeout"}, status=504)
+        finally:
+            self._tasks.pop(key, None)
+            if self.delete_completed_queries:
+                self._remove(key, values)
+                self.commit()
+        return web.json_response(result)
+
+    def _resolve(self, key: Pointer, value: Any) -> None:
+        future = self._tasks.get(key)
+        loop = self.webserver._loop
+        if future is not None and loop is not None:
+            def _set():
+                if not future.done():
+                    future.set_result(value)
+
+            loop.call_soon_threadsafe(_set)
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    schema: type[Schema] | None = None,
+    methods: Sequence[str] = ("POST",),
+    autocommit_duration_ms: int | None = 1500,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool | None = None,
+    request_validator=None,
+    documentation: EndpointDocumentation | None = None,
+):
+    """Returns (queries_table, response_writer) (reference: _server.py:624).
+
+    response_writer(table) — table keyed like queries with a `result`
+    column; writing it resolves the matching pending HTTP request.
+    """
+    if webserver is None:
+        webserver = PathwayWebserver(
+            host=host or "0.0.0.0", port=port or 8080
+        )
+    if delete_completed_queries is None:
+        delete_completed_queries = (
+            not keep_queries if keep_queries is not None else False
+        )
+    if schema is None:
+        raise ValueError("rest_connector requires a schema")
+
+    subject = RestServerSubject(
+        webserver,
+        route,
+        tuple(m.upper() for m in methods),
+        schema,
+        delete_completed_queries,
+        request_validator,
+        documentation,
+    )
+    queries = python_read(
+        subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms
+    )
+
+    def response_writer(response_table) -> None:
+        cols = response_table.column_names()
+
+        def on_change(key, row, time_, diff):
+            if diff <= 0:
+                return
+            data = dict(zip(cols, row))
+            result = data.get("result", data)
+            if isinstance(result, Json):
+                result = result.value
+            subject._resolve(key, result)
+
+        def lower(ctx):
+            ctx.scope.output(
+                ctx.engine_table(response_table), on_change=on_change
+            )
+
+        G.add_operator(
+            [response_table], [], lower, "rest_response", is_output=True
+        )
+
+    return queries, response_writer

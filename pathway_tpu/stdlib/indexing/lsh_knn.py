@@ -29,6 +29,19 @@ class LshKnn(InnerIndex):
         )
         from pathway_tpu.stdlib.ml.index import _build_reply_table
 
+        if mode == "as_of_now":
+            # pure-dataflow index revises by nature; as-of-now contract is
+            # met by making the query transient (answered at t, retracted
+            # at t+1, never revised) — the same shape DataIndex uses
+            from pathway_tpu.stdlib.temporal._interval_join import rebind
+
+            qt = query_column.table
+            transient = qt._forget_immediately()
+            query_column = rebind(query_column, qt, transient)
+            if hasattr(metadata_filter, "_dtype"):
+                metadata_filter = rebind(metadata_filter, qt, transient)
+            if hasattr(number_of_matches, "_dtype"):
+                number_of_matches = rebind(number_of_matches, qt, transient)
         query_column = _calculate_embeddings(query_column, self.embedder)
         reply = _build_reply_table(
             self.data_column,

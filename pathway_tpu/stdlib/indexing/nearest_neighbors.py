@@ -92,7 +92,7 @@ def _calculate_embeddings(column: ColumnReference, embedder):
     if embedder is None:
         return column
     table = column.table.with_columns(_pw_embedded_column=embedder(column))
-    return table._pw_embedded_column
+    return table["_pw_embedded_column"]
 
 
 @dataclass(frozen=True)

@@ -88,22 +88,14 @@ class InnerIndex(ABC):
         q_names = query_table._column_names
 
         def lower(ctx):
-            def table_resolver(table):
-                def resolver(ref):
-                    if ref.name == "id":
-                        return "id"
-                    if ref.table is not table:
-                        raise KeyError(
-                            f"index expressions must reference {table._name}"
-                        )
-                    return table._column_names.index(ref.name)
-
-                return resolver
-
-            it = ctx.engine_table(index_table)
-            qt = ctx.engine_table(query_table)
-            i_res = table_resolver(index_table)
-            q_res = table_resolver(query_table)
+            # _combined_view resolves refs to other same-universe tables
+            # (e.g. metadata on the pre-embedding table) via id-joins
+            index_exprs = [data_expr] + ([meta_expr] if meta_expr is not None else [])
+            it, i_res = ctx._combined_view(index_table, index_exprs)
+            query_exprs = [query_column, limit_expr] + (
+                [filter_expr] if filter_expr is not None else []
+            )
+            qt, q_res = ctx._combined_view(query_table, query_exprs)
             data_fn = compile_expression(data_expr, i_res, ctx.runtime)
             meta_fn = (
                 compile_expression(meta_expr, i_res, ctx.runtime)
@@ -136,10 +128,14 @@ class InnerIndex(ABC):
                 it, qt, adapter, index_fn, query_fn, mode
             )
 
-            # engine row: query_row + (ids, scores) -> query cols + reply
+            # engine row: combined_query_row + (ids, scores) -> the query
+            # table's own columns + reply (combined view may carry extra
+            # joined columns past the base table's width)
+            n_q = len(q_names)
+
             def shape_fn(keys, rows):
                 return [
-                    r[:-2] + (tuple(zip(r[-2], r[-1])),) for r in rows
+                    r[:n_q] + (tuple(zip(r[-2], r[-1])),) for r in rows
                 ]
 
             ctx.set_engine_table(

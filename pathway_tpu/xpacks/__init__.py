@@ -1,0 +1,5 @@
+"""pathway_tpu.xpacks — extension packs (reference: python/pathway/xpacks)."""
+
+from pathway_tpu.xpacks import llm
+
+__all__ = ["llm"]

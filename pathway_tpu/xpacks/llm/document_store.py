@@ -1,0 +1,58 @@
+"""DocumentStore (reference:
+python/pathway/xpacks/llm/document_store.py:32-529 — the retriever-factory
+driven sibling of VectorStoreServer: same parse/split pipeline, but the
+index is built by an AbstractRetrieverFactory, so BM25/hybrid/KNN all fit)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing.retrievers import InnerIndexFactory
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+
+class DocumentStore(VectorStoreServer):
+    """reference: document_store.py:32. Accepts `retriever_factory`
+    (pw.indexing.*Factory) instead of a fixed embedder-KNN index."""
+
+    def __init__(
+        self,
+        *docs,
+        retriever_factory: InnerIndexFactory,
+        parser: Callable | None = None,
+        splitter: Callable | None = None,
+        doc_post_processors: Sequence[Callable] | None = None,
+    ):
+        self.retriever_factory = retriever_factory
+        # embedder only probed for dimension in the base class; the factory
+        # owns embedding here, so bypass with a 1-dim stub then rebuild the
+        # index from the factory
+        class _Stub:
+            def get_embedding_dimension(self):
+                return 1
+
+        super().__init__(
+            *docs,
+            embedder=_Stub(),
+            parser=parser,
+            splitter=splitter,
+            doc_post_processors=doc_post_processors,
+        )
+
+    def _build_index(self, chunked_docs):
+        from pathway_tpu.internals import dtype as dt
+        from pathway_tpu.internals.api import Json
+        from pathway_tpu.internals.expression import apply_with_type
+
+        return self.retriever_factory.build_index(
+            chunked_docs.text,
+            chunked_docs,
+            metadata_column=apply_with_type(
+                lambda d: Json(d.value["metadata"]), dt.JSON, chunked_docs.data
+            ),
+        )
+
+
+class SlidesDocumentStore(DocumentStore):
+    """reference: document_store.py SlidesDocumentStore."""

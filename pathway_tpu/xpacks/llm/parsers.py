@@ -1,0 +1,154 @@
+"""Document parsers (reference: python/pathway/xpacks/llm/parsers.py:53-928).
+
+Parsers are UDFs: bytes -> list[(text, metadata_dict)]. ParseUtf8 is pure;
+the heavier ones (unstructured, pypdf, vision pipelines) gate on their
+libraries and degrade with a clear ImportError."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.udfs import UDF
+
+
+def _as_text(contents) -> str:
+    if isinstance(contents, bytes):
+        return contents.decode("utf-8", errors="replace")
+    return str(contents)
+
+
+class ParseUtf8(UDF):
+    """reference: parsers.py:53 (a.k.a. Utf8Parser)."""
+
+    def __init__(self, **kwargs):
+        async def parse(contents) -> list:
+            return [(_as_text(contents), {})]
+
+        super().__init__(parse, return_type=list, deterministic=True)
+
+
+Utf8Parser = ParseUtf8
+
+
+class ParseUnstructured(UDF):
+    """reference: parsers.py ParseUnstructured — unstructured-io backed."""
+
+    def __init__(self, mode: str = "single", post_processors=None, **kwargs):
+        try:
+            from unstructured.partition.auto import partition  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ParseUnstructured requires the `unstructured` package"
+            ) from e
+        self.mode = mode
+        self.extra = kwargs
+
+        async def parse(contents, **kw) -> list:
+            import io
+
+            from unstructured.partition.auto import partition
+
+            elements = partition(
+                file=io.BytesIO(
+                    contents if isinstance(contents, bytes) else str(contents).encode()
+                ),
+                **self.extra,
+            )
+            if self.mode == "single":
+                return [("\n\n".join(str(e) for e in elements), {})]
+            return [
+                (str(e), dict(getattr(e, "metadata", None) and e.metadata.to_dict() or {}))
+                for e in elements
+            ]
+
+        super().__init__(parse, return_type=list, deterministic=True)
+
+
+UnstructuredParser = ParseUnstructured
+
+
+class PypdfParser(UDF):
+    """reference: parsers.py PypdfParser."""
+
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+        try:
+            import pypdf  # noqa: F401
+        except ImportError as e:
+            raise ImportError("PypdfParser requires the `pypdf` package") from e
+        self.apply_text_cleanup = apply_text_cleanup
+
+        async def parse(contents) -> list:
+            import io
+
+            import pypdf
+
+            reader = pypdf.PdfReader(io.BytesIO(contents))
+            out = []
+            for i, page in enumerate(reader.pages):
+                text = page.extract_text() or ""
+                if self.apply_text_cleanup:
+                    text = " ".join(text.split())
+                out.append((text, {"page": i}))
+            return out
+
+        super().__init__(parse, return_type=list, deterministic=True)
+
+
+class ImageParser(UDF):
+    """reference: parsers.py ImageParser — vision-LLM image description."""
+
+    def __init__(self, llm=None, parse_prompt: str | None = None, **kwargs):
+        if llm is None:
+            raise ValueError("ImageParser requires a vision-capable llm")
+        self.llm = llm
+        self.parse_prompt = parse_prompt or "Describe this image."
+
+        async def parse(contents) -> list:
+            import base64
+
+            b64 = base64.b64encode(contents).decode()
+            messages = [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": self.parse_prompt},
+                        {
+                            "type": "image_url",
+                            "image_url": {"url": f"data:image/png;base64,{b64}"},
+                        },
+                    ],
+                }
+            ]
+            text = self.llm.func(messages)
+            import inspect
+
+            if inspect.iscoroutine(text):
+                text = await text
+            return [(text, {})]
+
+        super().__init__(parse, return_type=list, deterministic=True)
+
+
+class SlideParser(ImageParser):
+    """reference: parsers.py SlideParser — vision-LLM slide parsing."""
+
+
+class OpenParse(UDF):
+    """reference: parsers.py OpenParse — table/vision pdf pipeline."""
+
+    def __init__(self, **kwargs):
+        try:
+            import openparse  # noqa: F401
+        except ImportError as e:
+            raise ImportError("OpenParse requires the `openparse` package") from e
+
+        async def parse(contents) -> list:
+            import io
+
+            import openparse
+
+            parser = openparse.DocumentParser()
+            doc = parser.parse(io.BytesIO(contents))
+            return [(node.text, {}) for node in doc.nodes]
+
+        super().__init__(parse, return_type=list, deterministic=True)

@@ -1,0 +1,35 @@
+"""Regression: ForgetNode expiry path (keep_results=False) — review found
+this crashed and no test exercised it."""
+
+import pathway_tpu as pw
+
+
+def test_window_cutoff_drops_old_results():
+    class Events(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(t=1)
+            self.commit()
+            self.next(t=20)  # watermark far past window [0,5) + cutoff
+            self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    events = pw.io.python.read(Events(), schema=S, autocommit_duration_ms=None)
+    res = events.windowby(
+        events.t,
+        window=pw.temporal.tumbling(duration=5),
+        behavior=pw.temporal.common_behavior(cutoff=2, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (row["start"], is_addition)
+        ),
+    )
+    pw.run()
+    # window [0,5): inserted when t=1 arrived, RETRACTED once watermark
+    # passed end+cutoff (keep_results=False drops expired results)
+    assert (0, True) in updates
+    assert (0, False) in updates

@@ -1,0 +1,74 @@
+"""REST connector tests (reference pattern:
+python/pathway/tests/test_server.py — real webserver, HTTP round trips)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+
+_PORT = [8901]
+
+
+def _next_port():
+    _PORT[0] += 1
+    return _PORT[0]
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_rest_connector_roundtrip():
+    port = _next_port()
+
+    class QuerySchema(pw.Schema):
+        value: int
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1",
+        port=port,
+        schema=QuerySchema,
+        autocommit_duration_ms=None,
+        delete_completed_queries=True,
+    )
+    answers = queries.select(result=pw.this.value * 2)
+    response_writer(answers)
+
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+
+    out = _post(f"http://127.0.0.1:{port}/", {"value": 21})
+    assert out == 42
+    out = _post(f"http://127.0.0.1:{port}/", {"value": 5})
+    assert out == 10
+
+
+def test_rest_connector_missing_field_400():
+    port = _next_port()
+
+    class QuerySchema(pw.Schema):
+        value: int
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=QuerySchema,
+        autocommit_duration_ms=None,
+    )
+    response_writer(queries.select(result=pw.this.value))
+    threading.Thread(target=pw.run, daemon=True).start()
+    time.sleep(1.0)
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(f"http://127.0.0.1:{port}/", {"wrong": 1})
+    assert e.value.code == 400

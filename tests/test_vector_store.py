@@ -1,0 +1,136 @@
+"""VectorStoreServer tests (reference pattern:
+python/pathway/xpacks/llm/tests/test_vector_store.py — fake deterministic
+embedder, exercise retrieve/statistics/inputs in-thread)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.xpacks.llm.mocks import DeterministicMockEmbedder
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return list(captures[0].state.rows.values())
+
+
+def _answered(table):
+    """First insertion per key — matches serving semantics: the response
+    writer resolves a query's future on its FIRST answer; the as-of-now
+    retraction at the next timestamp never reaches the client."""
+    captures = GraphRunner().run_tables(table)
+    seen = set()
+    out = []
+    for key, row, _, d in captures[0].updates:
+        if d > 0 and key not in seen:
+            seen.add(key)
+            out.append(row)
+    return out
+
+
+def _docs_source():
+    import json
+
+    t = pw.debug.table_from_markdown(
+        """
+        data                          | meta
+        the cat sat on the mat        | a.txt
+        dogs are loyal friendly pets  | b.txt
+        """
+    )
+    return t.select(
+        data=pw.this.data,
+        _metadata=pw.apply_with_type(
+            lambda p: pw.Json(
+                {"path": p, "modified_at": 1, "seen_at": 2}
+            ),
+            pw.Json,
+            pw.this.meta,
+        ),
+    )
+
+
+def _server():
+    return VectorStoreServer(
+        _docs_source(), embedder=DeterministicMockEmbedder(dimension=12)
+    )
+
+
+def test_retrieve_query():
+    server = _server()
+    queries = pw.debug.table_from_markdown(
+        """
+        query | k
+        the cat sat on the mat | 1
+        """,
+        schema=VectorStoreServer.RetrieveQuerySchema,
+    )
+    res = server.retrieve_query(queries)
+    rows = _answered(res)
+    assert len(rows) == 1
+    results = rows[0][0].value
+    assert len(results) == 1
+    assert results[0]["text"] == "the cat sat on the mat"
+    assert results[0]["dist"] < 1e-5  # identical text -> distance ~0
+
+
+def test_statistics_query():
+    server = _server()
+    queries = pw.debug.table_from_markdown(
+        """
+        dummy
+        1
+        """
+    ).select()
+    res = server.statistics_query(queries)
+    rows = _rows(res)
+    stats = rows[0][0].value
+    assert stats["file_count"] == 2
+    assert stats["last_modified"] == 1
+    assert stats["last_indexed"] == 2
+
+
+def test_inputs_query_with_glob():
+    server = _server()
+    queries = pw.debug.table_from_markdown(
+        """
+        q
+        1
+        """
+    ).select(
+        metadata_filter=pw.apply_with_type(lambda q: None, str, pw.this.q),
+        filepath_globpattern=pw.apply_with_type(lambda q: "a*", str, pw.this.q),
+    )
+    res = server.inputs_query(queries)
+    rows = _rows(res)
+    metas = rows[0][0].value
+    assert len(metas) == 1
+    assert metas[0]["path"] == "a.txt"
+
+
+def test_retrieve_with_metadata_filter():
+    server = _server()
+    queries = pw.debug.table_from_markdown(
+        """
+        query | k
+        pets | 5
+        """,
+        schema=VectorStoreServer.RetrieveQuerySchema,
+    ).with_columns(filepath_globpattern="b*")
+    res = server.retrieve_query(queries)
+    rows = _answered(res)
+    results = rows[0][0].value
+    assert len(results) == 1
+    assert "dogs" in results[0]["text"]
+
+
+def test_splitter_in_pipeline():
+    splitter = TokenCountSplitter(min_tokens=2, max_tokens=4)
+    server = VectorStoreServer(
+        _docs_source(),
+        embedder=DeterministicMockEmbedder(dimension=8),
+        splitter=splitter.func,
+    )
+    chunked = server._graph["chunked_docs"]
+    rows = _rows(chunked.select(pw.this.text))
+    assert len(rows) > 2  # docs got split into multiple chunks
