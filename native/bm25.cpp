@@ -1,0 +1,133 @@
+// Native BM25 full-text index — C++ core for pathway_tpu.stdlib.indexing
+// (the tantivy-equivalent; reference native core:
+// src/external_integration/tantivy_integration.rs). C ABI over opaque
+// handles; Python side at pathway_tpu/native/__init__.py.
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Posting {
+    std::unordered_map<int64_t, int32_t> tf;  // doc -> term frequency
+};
+
+struct Bm25Index {
+    double k1;
+    double b;
+    std::unordered_map<std::string, Posting> postings;
+    std::unordered_map<int64_t, int32_t> doc_len;
+    int64_t total_len = 0;
+
+    void tokenize(const char* text, std::vector<std::string>& out) const {
+        out.clear();
+        std::string cur;
+        for (const char* p = text; *p; ++p) {
+            unsigned char c = static_cast<unsigned char>(*p);
+            if (std::isalnum(c) || c == '_') {
+                cur.push_back(static_cast<char>(std::tolower(c)));
+            } else if (!cur.empty()) {
+                out.push_back(cur);
+                cur.clear();
+            }
+        }
+        if (!cur.empty()) out.push_back(cur);
+    }
+
+    void remove_doc(int64_t key) {
+        auto it = doc_len.find(key);
+        if (it == doc_len.end()) return;
+        total_len -= it->second;
+        doc_len.erase(it);
+        for (auto pit = postings.begin(); pit != postings.end();) {
+            pit->second.tf.erase(key);
+            if (pit->second.tf.empty()) {
+                pit = postings.erase(pit);
+            } else {
+                ++pit;
+            }
+        }
+    }
+
+    void add_doc(int64_t key, const char* text) {
+        remove_doc(key);
+        std::vector<std::string> toks;
+        tokenize(text, toks);
+        doc_len[key] = static_cast<int32_t>(toks.size());
+        total_len += static_cast<int64_t>(toks.size());
+        for (const auto& t : toks) {
+            postings[t].tf[key] += 1;
+        }
+    }
+
+    // returns up to k (key, score) pairs, best first
+    int64_t search(const char* query, int64_t k, int64_t* out_keys,
+                   double* out_scores) const {
+        if (doc_len.empty() || k <= 0) return 0;
+        const double n = static_cast<double>(doc_len.size());
+        const double avg_len = static_cast<double>(total_len) / n;
+        std::vector<std::string> toks;
+        tokenize(query, toks);
+        std::unordered_map<int64_t, double> scores;
+        for (const auto& t : toks) {
+            auto pit = postings.find(t);
+            if (pit == postings.end()) continue;
+            const double df = static_cast<double>(pit->second.tf.size());
+            const double idf = std::log(1.0 + (n - df + 0.5) / (df + 0.5));
+            for (const auto& [key, tf] : pit->second.tf) {
+                const double dl = static_cast<double>(doc_len.at(key));
+                const double denom =
+                    tf + k1 * (1.0 - b + b * dl / avg_len);
+                scores[key] += idf * tf * (k1 + 1.0) / denom;
+            }
+        }
+        std::vector<std::pair<double, int64_t>> ranked;
+        ranked.reserve(scores.size());
+        for (const auto& [key, s] : scores) ranked.emplace_back(s, key);
+        std::sort(ranked.begin(), ranked.end(),
+                  [](const auto& a, const auto& b2) {
+                      if (a.first != b2.first) return a.first > b2.first;
+                      return a.second < b2.second;
+                  });
+        const int64_t out_n =
+            std::min<int64_t>(k, static_cast<int64_t>(ranked.size()));
+        for (int64_t i = 0; i < out_n; ++i) {
+            out_keys[i] = ranked[static_cast<size_t>(i)].second;
+            out_scores[i] = ranked[static_cast<size_t>(i)].first;
+        }
+        return out_n;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bm25_new(double k1, double b) { return new Bm25Index{k1, b}; }
+
+void bm25_free(void* h) { delete static_cast<Bm25Index*>(h); }
+
+void bm25_add(void* h, int64_t key, const char* text) {
+    static_cast<Bm25Index*>(h)->add_doc(key, text);
+}
+
+void bm25_remove(void* h, int64_t key) {
+    static_cast<Bm25Index*>(h)->remove_doc(key);
+}
+
+int64_t bm25_len(void* h) {
+    return static_cast<int64_t>(static_cast<Bm25Index*>(h)->doc_len.size());
+}
+
+int64_t bm25_search(void* h, const char* query, int64_t k, int64_t* out_keys,
+                    double* out_scores) {
+    return static_cast<Bm25Index*>(h)->search(query, k, out_keys, out_scores);
+}
+
+}  // extern "C"

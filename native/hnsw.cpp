@@ -1,0 +1,255 @@
+// Native HNSW approximate-nearest-neighbor index — C++ core replacing the
+// reference's usearch FFI (src/external_integration/usearch_integration.rs).
+// Cosine/L2/IP metrics, incremental add/remove (soft delete), C ABI.
+//
+// Standard HNSW (Malkov & Yashunin): layered proximity graphs; greedy
+// descent from the top layer, beam search (ef) at layer 0.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+enum Metric : int32_t { COS = 0, L2SQ = 1, IP = 2 };
+
+struct HnswIndex {
+    int32_t dim;
+    Metric metric;
+    int32_t M;          // max neighbors per layer (2*M at layer 0)
+    int32_t ef_build;
+    int32_t ef_search;
+    std::mt19937_64 rng{42};
+
+    std::vector<std::vector<float>> vecs;          // slot -> vector
+    std::vector<int64_t> keys;                     // slot -> user key
+    std::vector<bool> alive;
+    std::vector<int32_t> levels;                   // slot -> top level
+    // slot -> level -> neighbor slots
+    std::vector<std::vector<std::vector<int32_t>>> links;
+    std::unordered_map<int64_t, int32_t> key_to_slot;
+    int32_t entry = -1;
+    int32_t max_level = -1;
+    int64_t alive_count = 0;
+
+    float dist(const float* a, const float* b) const {
+        float acc = 0.f;
+        switch (metric) {
+            case L2SQ: {
+                for (int32_t i = 0; i < dim; ++i) {
+                    const float d = a[i] - b[i];
+                    acc += d * d;
+                }
+                return acc;
+            }
+            case IP:
+            case COS: {  // vectors pre-normalized for COS at insert/query
+                for (int32_t i = 0; i < dim; ++i) acc += a[i] * b[i];
+                return -acc;  // smaller = closer
+            }
+        }
+        return acc;
+    }
+
+    int32_t random_level() {
+        const double r = std::uniform_real_distribution<double>(0.0, 1.0)(rng);
+        const double ml = 1.0 / std::log(std::max(2, M));
+        return static_cast<int32_t>(-std::log(r + 1e-12) * ml);
+    }
+
+    // beam search on one level; returns (dist, slot) max-heap trimmed to ef
+    void search_layer(const float* q, int32_t ep, int32_t level, int32_t ef,
+                      std::vector<std::pair<float, int32_t>>& out) const {
+        std::priority_queue<std::pair<float, int32_t>> best;  // max-heap
+        std::priority_queue<std::pair<float, int32_t>,
+                            std::vector<std::pair<float, int32_t>>,
+                            std::greater<>> cand;             // min-heap
+        std::unordered_set<int32_t> seen;
+        const float d0 = dist(q, vecs[static_cast<size_t>(ep)].data());
+        best.emplace(d0, ep);
+        cand.emplace(d0, ep);
+        seen.insert(ep);
+        while (!cand.empty()) {
+            auto [dc, c] = cand.top();
+            if (dc > best.top().first && static_cast<int32_t>(best.size()) >= ef)
+                break;
+            cand.pop();
+            for (int32_t nb : links[static_cast<size_t>(c)][static_cast<size_t>(level)]) {
+                if (!seen.insert(nb).second) continue;
+                const float d = dist(q, vecs[static_cast<size_t>(nb)].data());
+                if (static_cast<int32_t>(best.size()) < ef ||
+                    d < best.top().first) {
+                    best.emplace(d, nb);
+                    cand.emplace(d, nb);
+                    if (static_cast<int32_t>(best.size()) > ef) best.pop();
+                }
+            }
+        }
+        out.clear();
+        while (!best.empty()) {
+            out.push_back(best.top());
+            best.pop();
+        }
+        std::reverse(out.begin(), out.end());  // closest first
+    }
+
+    void connect(int32_t slot, int32_t level,
+                 std::vector<std::pair<float, int32_t>>& neighbors) {
+        const int32_t cap = level == 0 ? 2 * M : M;
+        auto& my = links[static_cast<size_t>(slot)][static_cast<size_t>(level)];
+        for (const auto& [d, nb] : neighbors) {
+            if (static_cast<int32_t>(my.size()) >= cap) break;
+            my.push_back(nb);
+            auto& theirs =
+                links[static_cast<size_t>(nb)][static_cast<size_t>(level)];
+            theirs.push_back(slot);
+            if (static_cast<int32_t>(theirs.size()) > cap) {
+                // shrink: keep the `cap` closest to nb
+                const float* nbv = vecs[static_cast<size_t>(nb)].data();
+                std::sort(theirs.begin(), theirs.end(),
+                          [&](int32_t x, int32_t y) {
+                              return dist(nbv, vecs[static_cast<size_t>(x)].data()) <
+                                     dist(nbv, vecs[static_cast<size_t>(y)].data());
+                          });
+                theirs.resize(static_cast<size_t>(cap));
+            }
+        }
+    }
+
+    void add(int64_t key, const float* vec_in) {
+        std::vector<float> v(vec_in, vec_in + dim);
+        if (metric == COS) {
+            float n = 0.f;
+            for (float x : v) n += x * x;
+            n = std::sqrt(n);
+            if (n > 0.f)
+                for (auto& x : v) x /= n;
+        }
+        auto it = key_to_slot.find(key);
+        if (it != key_to_slot.end()) {
+            // upsert: replace vector in place (links stay — acceptable ANN
+            // degradation, same trade usearch makes)
+            const int32_t slot = it->second;
+            vecs[static_cast<size_t>(slot)] = std::move(v);
+            if (!alive[static_cast<size_t>(slot)]) {
+                alive[static_cast<size_t>(slot)] = true;
+                ++alive_count;
+            }
+            return;
+        }
+        const int32_t slot = static_cast<int32_t>(vecs.size());
+        const int32_t level = random_level();
+        vecs.push_back(std::move(v));
+        keys.push_back(key);
+        alive.push_back(true);
+        levels.push_back(level);
+        links.emplace_back(static_cast<size_t>(level) + 1);
+        key_to_slot[key] = slot;
+        ++alive_count;
+
+        if (entry < 0) {
+            entry = slot;
+            max_level = level;
+            return;
+        }
+        const float* q = vecs[static_cast<size_t>(slot)].data();
+        int32_t ep = entry;
+        std::vector<std::pair<float, int32_t>> found;
+        for (int32_t lv = max_level; lv > level; --lv) {
+            search_layer(q, ep, lv, 1, found);
+            if (!found.empty()) ep = found[0].second;
+        }
+        for (int32_t lv = std::min(level, max_level); lv >= 0; --lv) {
+            search_layer(q, ep, lv, ef_build, found);
+            connect(slot, lv, found);
+            if (!found.empty()) ep = found[0].second;
+        }
+        if (level > max_level) {
+            max_level = level;
+            entry = slot;
+        }
+    }
+
+    void remove(int64_t key) {
+        auto it = key_to_slot.find(key);
+        if (it == key_to_slot.end()) return;
+        if (alive[static_cast<size_t>(it->second)]) {
+            alive[static_cast<size_t>(it->second)] = false;
+            --alive_count;
+        }
+    }
+
+    int64_t search(const float* q_in, int64_t k, int64_t* out_keys,
+                   double* out_scores) const {
+        if (entry < 0 || alive_count == 0 || k <= 0) return 0;
+        std::vector<float> q(q_in, q_in + dim);
+        if (metric == COS) {
+            float n = 0.f;
+            for (float x : q) n += x * x;
+            n = std::sqrt(n);
+            if (n > 0.f)
+                for (auto& x : q) x /= n;
+        }
+        int32_t ep = entry;
+        std::vector<std::pair<float, int32_t>> found;
+        for (int32_t lv = max_level; lv > 0; --lv) {
+            search_layer(q.data(), ep, lv, 1, found);
+            if (!found.empty()) ep = found[0].second;
+        }
+        const int32_t ef =
+            std::max<int32_t>(ef_search, static_cast<int32_t>(k) * 2);
+        search_layer(q.data(), ep, 0, ef, found);
+        int64_t out_n = 0;
+        for (const auto& [d, slot] : found) {
+            if (!alive[static_cast<size_t>(slot)]) continue;
+            out_keys[out_n] = keys[static_cast<size_t>(slot)];
+            // -d is the similarity for cos/ip (d = -dot) and the negated
+            // squared distance for l2 — larger is better in both, matching
+            // the TPU brute-force score convention
+            out_scores[out_n] = -static_cast<double>(d);
+            ++out_n;
+            if (out_n == k) break;
+        }
+        return out_n;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hnsw_new(int32_t dim, int32_t metric, int32_t M, int32_t ef_build,
+               int32_t ef_search) {
+    auto* h = new HnswIndex();
+    h->dim = dim;
+    h->metric = static_cast<Metric>(metric);
+    h->M = M > 0 ? M : 16;
+    h->ef_build = ef_build > 0 ? ef_build : 128;
+    h->ef_search = ef_search > 0 ? ef_search : 64;
+    return h;
+}
+
+void hnsw_free(void* h) { delete static_cast<HnswIndex*>(h); }
+
+void hnsw_add(void* h, int64_t key, const float* vec) {
+    static_cast<HnswIndex*>(h)->add(key, vec);
+}
+
+void hnsw_remove(void* h, int64_t key) {
+    static_cast<HnswIndex*>(h)->remove(key);
+}
+
+int64_t hnsw_len(void* h) { return static_cast<HnswIndex*>(h)->alive_count; }
+
+int64_t hnsw_search(void* h, const float* q, int64_t k, int64_t* out_keys,
+                    double* out_scores) {
+    return static_cast<HnswIndex*>(h)->search(q, k, out_keys, out_scores);
+}
+
+}  // extern "C"

@@ -64,6 +64,15 @@ DateTimeUtc = _dt.DATE_TIME_UTC
 Duration = _dt.DURATION
 
 from pathway_tpu import debug, io, udfs  # noqa: E402
+from pathway_tpu.internals.config import (  # noqa: E402
+    PathwayConfig,
+    get_pathway_config,
+    set_license_key,
+    set_monitoring_config,
+)
+from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
+from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.sql_module import sql  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 

@@ -1,0 +1,92 @@
+"""pathway CLI (reference: python/pathway/cli.py — `pathway spawn` :166,
+`spawn-from-env` :284, `replay` :252).
+
+`spawn` launches a pipeline program; --processes N sets PATHWAY_PROCESSES /
+PATHWAY_PROCESS_ID per child, which on TPU maps to jax.distributed hosts
+(SURVEY §2.9) rather than timely TCP workers."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import subprocess
+import sys
+
+
+def _spawn(args) -> int:
+    env = dict(os.environ)
+    env["PATHWAY_THREADS"] = str(args.threads)
+    env["PATHWAY_PROCESSES"] = str(args.processes)
+    env["PATHWAY_FIRST_PORT"] = str(args.first_port)
+    program = args.program
+    if args.processes > 1:
+        procs = []
+        for pid in range(args.processes):
+            child_env = dict(env)
+            child_env["PATHWAY_PROCESS_ID"] = str(pid)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, program, *args.arguments], env=child_env
+                )
+            )
+        rc = 0
+        for p in procs:
+            rc = rc or p.wait()
+        return rc
+    env["PATHWAY_PROCESS_ID"] = "0"
+    os.environ.update(env)
+    sys.argv = [program, *args.arguments]
+    runpy.run_path(program, run_name="__main__")
+    return 0
+
+
+def _replay(args) -> int:
+    os.environ["PATHWAY_REPLAY_STORAGE"] = args.record_path
+    os.environ["PATHWAY_SNAPSHOT_ACCESS"] = args.mode
+    sys.argv = [args.program, *args.arguments]
+    runpy.run_path(args.program, run_name="__main__")
+    return 0
+
+
+def _spawn_from_env(args) -> int:
+    command = os.environ.get("PATHWAY_SPAWN_ARGS", "")
+    if not command:
+        print("PATHWAY_SPAWN_ARGS is not set", file=sys.stderr)
+        return 1
+    parts = command.split()
+    return main(["spawn", *parts])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    spawn = sub.add_parser("spawn", help="run a pathway program")
+    spawn.add_argument("--threads", "-t", type=int, default=1)
+    spawn.add_argument("--processes", "-n", type=int, default=1)
+    spawn.add_argument("--first-port", type=int, default=10000)
+    spawn.add_argument("--record", action="store_true")
+    spawn.add_argument("--record-path", default="record")
+    spawn.add_argument("program")
+    spawn.add_argument("arguments", nargs=argparse.REMAINDER)
+    spawn.set_defaults(fn=_spawn)
+
+    replay = sub.add_parser("replay", help="replay a recorded stream")
+    replay.add_argument("--record-path", required=True)
+    replay.add_argument(
+        "--mode", choices=["replay", "speedrun"], default="replay"
+    )
+    replay.add_argument("program")
+    replay.add_argument("arguments", nargs=argparse.REMAINDER)
+    replay.set_defaults(fn=_replay)
+
+    sfe = sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_ARGS")
+    sfe.set_defaults(fn=_spawn_from_env)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -685,6 +685,7 @@ class OutputNode(Node):
         deltas = consolidate(batches[0])
         if deltas:
             self._seen_time = True
+            self.scope.runtime.stats.on_output(len(deltas))
             if self._on_batch is not None:
                 self._on_batch(time, deltas)
             if self._on_change is not None:

@@ -35,7 +35,13 @@ class _Connector:
 
 
 class Runtime:
-    def __init__(self, terminate_on_error: bool = True):
+    def __init__(
+        self,
+        terminate_on_error: bool = True,
+        persistence=None,
+        with_http_server: bool = False,
+        monitoring_level=None,
+    ):
         self.scope = Scope(self)
         self.pending_times: dict[int, set[int]] = {}  # time -> set of node ids
         self.static_data: list[tuple[SourceNode, list[Delta]]] = []
@@ -45,15 +51,23 @@ class Runtime:
         )
         self.clock = 0
         self.terminate_on_error = terminate_on_error
+        self.persistence = persistence
+        self.with_http_server = with_http_server
+        self.monitoring_level = monitoring_level
         self.error: Exception | None = None
         self._async_loop = None
+        from pathway_tpu.internals.monitoring import ProberStats
+
+        self.stats = ProberStats()
 
     # -- wiring ----------------------------------------------------------
     def add_static_data(self, node: SourceNode, deltas: list[Delta]) -> None:
         self.static_data.append((node, deltas))
 
-    def add_connector(self, node: SourceNode, subject, parser) -> None:
-        self.connectors.append(_Connector(node, subject, parser))
+    def add_connector(self, node: SourceNode, subject, parser, name=None) -> None:
+        conn = _Connector(node, subject, parser)
+        conn.name = name or f"connector_{len(self.connectors)}"
+        self.connectors.append(conn)
 
     def mark_pending(self, time: int, node: Node) -> None:
         self.pending_times.setdefault(time, set()).add(node.node_id)
@@ -137,10 +151,46 @@ class Runtime:
     def _run_streaming(self) -> None:
         from pathway_tpu.io._connector import run_connector_thread
 
+        if self.with_http_server:
+            # reference: metrics at port 20000 + process_id (http_server.rs)
+            from pathway_tpu.internals.config import get_pathway_config
+            from pathway_tpu.internals.monitoring import start_http_server
+
+            start_http_server(
+                self.stats, 20000 + get_pathway_config().process_id
+            )
+        if self.monitoring_level is not None:
+            from pathway_tpu.internals.monitoring import (
+                MonitoringLevel,
+                start_monitor_printer,
+            )
+
+            if self.monitoring_level not in (
+                MonitoringLevel.NONE,
+                MonitoringLevel.AUTO,
+            ):
+                start_monitor_printer(self.stats)
+
         self._inject_static()
         while self.pending_times:
             t = min(self.pending_times)
             self._step_time(t)
+
+        if self.persistence is not None:
+            # replay journaled input (reference: Entry::Snapshot path,
+            # connectors/mod.rs:101-130) — each journaled commit becomes a
+            # fresh timestamp in arrival order, then subjects seek to their
+            # stored scan state before going live
+            for conn in self.connectors:
+                journal = self.persistence.load_journal(conn.name)
+                for _orig_time, deltas in journal:
+                    t = self._next_time()
+                    conn.node.accept(t, 0, deltas)
+                    while self.pending_times and min(self.pending_times) <= self.clock + 1:
+                        self._step_time(min(self.pending_times))
+                state = self.persistence.load_subject_state(conn.name)
+                if state is not None and hasattr(conn.subject, "seek"):
+                    conn.subject.seek(state)
 
         for conn in self.connectors:
             conn.thread = threading.Thread(
@@ -172,12 +222,24 @@ class Runtime:
             # timestamp (reference: each flush advances the commit Timestamp,
             # connectors/mod.rs) — merging commits could cancel an insert
             # with a later retraction before downstream ever observed it
-            for conn, deltas in entries:
+            for conn, deltas, state in entries:
                 if deltas is None:
                     conn.finished = True
                     active -= 1
                 elif deltas:
-                    conn.node.accept(self._next_time(), 0, deltas)
+                    t = self._next_time()
+                    self.stats.on_ingest(conn.name, len(deltas))
+                    if self.persistence is not None:
+                        # write-ahead: the commit is durable before the
+                        # engine observes it (reference: input_snapshot.rs);
+                        # the subject state was captured atomically with
+                        # this very batch at flush time
+                        self.persistence.journal_batch(conn.name, t, deltas)
+                        if state is not None:
+                            self.persistence.save_subject_state(
+                                conn.name, state
+                            )
+                    conn.node.accept(t, 0, deltas)
             # step strictly in time order, re-reading pending_times each
             # round: stepping may schedule NEW times (forget-immediately
             # retractions at t+1) that must run before later commits.

@@ -44,9 +44,9 @@ class Scope:
         self.runtime.add_static_data(node, [])
         return EngineTable(node, width)
 
-    def connector_table(self, subject, parser, width: int) -> EngineTable:
+    def connector_table(self, subject, parser, width: int, name=None) -> EngineTable:
         node = N.SourceNode(self, append_only=False)
-        self.runtime.add_connector(node, subject, parser)
+        self.runtime.add_connector(node, subject, parser, name=name)
         return EngineTable(node, width)
 
     # -- stateless transforms --------------------------------------------

@@ -1,0 +1,88 @@
+"""Environment-first configuration (reference:
+python/pathway/internals/config.py:57-97 PathwayConfig — PATHWAY_* env
+vars; engine mirror src/engine/dataflow/config.rs:88 Config::from_env).
+
+On TPU the worker topology maps to the device mesh (SURVEY §2.9):
+PATHWAY_THREADS ~ data-parallel shards within a host, PATHWAY_PROCESSES ~
+hosts in the jax.distributed cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_field(name: str, default: str | None = None):
+    return field(default_factory=lambda: os.environ.get(name, default))
+
+
+def _env_bool_field(name: str, default: str = "false"):
+    def factory() -> bool:
+        value = os.environ.get(name, default).lower()
+        if value in ("1", "true", "yes"):
+            return True
+        if value in ("0", "false", "no"):
+            return False
+        raise ValueError(f"unexpected value for {name!r}: {value!r}")
+
+    return field(default_factory=factory)
+
+
+def _env_int_field(name: str, default: int):
+    return field(
+        default_factory=lambda: int(os.environ.get(name, str(default)) or default)
+    )
+
+
+@dataclass
+class PathwayConfig:
+    threads: int = _env_int_field("PATHWAY_THREADS", 1)
+    processes: int = _env_int_field("PATHWAY_PROCESSES", 1)
+    process_id: int = _env_int_field("PATHWAY_PROCESS_ID", 0)
+    first_port: int = _env_int_field("PATHWAY_FIRST_PORT", 10000)
+    run_id: str | None = _env_field("PATHWAY_RUN_ID")
+    license_key: str | None = _env_field("PATHWAY_LICENSE_KEY")
+    monitoring_server: str | None = _env_field("PATHWAY_MONITORING_SERVER")
+    replay_storage: str | None = _env_field("PATHWAY_REPLAY_STORAGE")
+    snapshot_access: str | None = _env_field("PATHWAY_SNAPSHOT_ACCESS")
+    persistence_mode: str | None = _env_field("PATHWAY_PERSISTENCE_MODE")
+    continue_after_replay: bool = _env_bool_field("PATHWAY_CONTINUE_AFTER_REPLAY")
+    ignore_asserts: bool = _env_bool_field("PATHWAY_IGNORE_ASSERTS")
+    runtime_typechecking: bool = _env_bool_field("PATHWAY_RUNTIME_TYPECHECKING")
+    terminate_on_error: bool = _env_bool_field(
+        "PATHWAY_TERMINATE_ON_ERROR", "true"
+    )
+
+    @property
+    def replay_config(self):
+        if self.replay_storage is None:
+            return None
+        from pathway_tpu import persistence
+
+        return persistence.Config(
+            backend=persistence.Backend.filesystem(self.replay_storage)
+        )
+
+
+pathway_config = PathwayConfig()
+
+
+def get_pathway_config() -> PathwayConfig:
+    return pathway_config
+
+
+def set_license_key(key: str | None) -> None:
+    """reference: pw.set_license_key — entitlements are not enforced in
+    this build (no keygen.sh round trips); the key is recorded for config
+    surface parity."""
+    pathway_config.license_key = key
+
+
+def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
+    pathway_config.monitoring_server = server_endpoint
+
+
+def _check_entitlements(*entitlements: str) -> bool:
+    """reference: internals/config.py:105 — always granted here."""
+    return True

@@ -127,9 +127,34 @@ class GraphRunner:
     """Lower + run the captured graph (reference:
     graph_runner/__init__.py:86 run_nodes / :96 run_tables / :113 run_outputs)."""
 
-    def __init__(self, parse_graph=None, *, terminate_on_error: bool = True, **kwargs):
+    def __init__(
+        self,
+        parse_graph=None,
+        *,
+        terminate_on_error: bool = True,
+        persistence_config=None,
+        with_http_server: bool = False,
+        monitoring_level=None,
+        **kwargs,
+    ):
         self.graph = parse_graph or G
         self.terminate_on_error = terminate_on_error
+        self.persistence_config = persistence_config
+        self.with_http_server = with_http_server
+        self.monitoring_level = monitoring_level
+
+    def _make_runtime(self) -> Runtime:
+        persistence = None
+        if self.persistence_config is not None:
+            from pathway_tpu.persistence import PersistenceManager
+
+            persistence = PersistenceManager(self.persistence_config)
+        return Runtime(
+            terminate_on_error=self.terminate_on_error,
+            persistence=persistence,
+            with_http_server=self.with_http_server,
+            monitoring_level=self.monitoring_level,
+        )
 
     def _lower(self, ops: list[Operator], runtime: Runtime) -> LoweringContext:
         ctx = LoweringContext(runtime)
@@ -140,7 +165,7 @@ class GraphRunner:
     def run_tables(self, *tables: "Table", include_outputs: bool = False):
         """Run to completion, capturing the given tables' final state +
         update streams.  Returns list of CaptureNodes."""
-        runtime = Runtime(terminate_on_error=self.terminate_on_error)
+        runtime = self._make_runtime()
         targets = [t._source for t in tables if t._source is not None]
         if include_outputs:
             targets += self.graph.output_operators()
@@ -151,7 +176,7 @@ class GraphRunner:
         return captures
 
     def run_outputs(self):
-        runtime = Runtime(terminate_on_error=self.terminate_on_error)
+        runtime = self._make_runtime()
         targets = self.graph.output_operators()
         ops = self.graph.reachable_operators(targets)
         self._lower(ops, runtime)

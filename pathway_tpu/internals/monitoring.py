@@ -1,0 +1,121 @@
+"""Monitoring: ProberStats counters + live text dashboard + OpenMetrics
+HTTP endpoint (reference: python/pathway/internals/monitoring.py rich TUI;
+src/engine/http_server.rs:21 Prometheus endpoint at port
+20000+process_id exposing input_latency_ms / output_latency_ms and
+per-connector counters)."""
+
+from __future__ import annotations
+
+import enum
+import http.server
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class MonitoringLevel(enum.Enum):
+    AUTO = 0
+    AUTO_ALL = 1
+    NONE = 2
+    IN_OUT = 3
+    ALL = 4
+
+
+@dataclass
+class ConnectorStats:
+    name: str = ""
+    rows: int = 0
+    batches: int = 0
+    last_commit_ts: float = 0.0
+
+
+@dataclass
+class ProberStats:
+    """reference: graph.rs:554 ProberStats — input/output frontier lag."""
+
+    connectors: dict[str, ConnectorStats] = field(default_factory=dict)
+    outputs_emitted: int = 0
+    last_output_ts: float = 0.0
+    started_at: float = field(default_factory=time.time)
+
+    def on_ingest(self, name: str, n_rows: int) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.rows += n_rows
+        st.batches += 1
+        st.last_commit_ts = time.time()
+
+    def on_output(self, n_rows: int) -> None:
+        self.outputs_emitted += n_rows
+        self.last_output_ts = time.time()
+
+    def input_latency_ms(self) -> float:
+        if not self.connectors:
+            return 0.0
+        newest = max(s.last_commit_ts for s in self.connectors.values())
+        return max(0.0, (time.time() - newest) * 1000.0) if newest else 0.0
+
+    def output_latency_ms(self) -> float:
+        if not self.last_output_ts:
+            return 0.0
+        return max(0.0, (time.time() - self.last_output_ts) * 1000.0)
+
+    def render_openmetrics(self) -> str:
+        lines = [
+            "# TYPE input_latency_ms gauge",
+            f"input_latency_ms {self.input_latency_ms():.1f}",
+            "# TYPE output_latency_ms gauge",
+            f"output_latency_ms {self.output_latency_ms():.1f}",
+            "# TYPE connector_rows_total counter",
+        ]
+        for st in self.connectors.values():
+            lines.append(
+                f'connector_rows_total{{connector="{st.name}"}} {st.rows}'
+            )
+        lines.append("# TYPE output_rows_total counter")
+        lines.append(f"output_rows_total {self.outputs_emitted}")
+        return "\n".join(lines) + "\n"
+
+    def render_text(self) -> str:
+        up = time.time() - self.started_at
+        rows = [f"uptime {up:6.1f}s  outputs {self.outputs_emitted}"]
+        for st in self.connectors.values():
+            rows.append(
+                f"  {st.name:<30} rows={st.rows:<8} batches={st.batches}"
+            )
+        return "\n".join(rows)
+
+
+def start_http_server(stats: ProberStats, port: int) -> threading.Thread:
+    """OpenMetrics endpoint (reference: http_server.rs — port
+    20000 + process_id)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = stats.render_openmetrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return thread
+
+
+def start_monitor_printer(
+    stats: ProberStats, interval: float = 2.0
+) -> threading.Thread:
+    def loop():
+        while True:
+            time.sleep(interval)
+            print(stats.render_text(), file=sys.stderr)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    return thread

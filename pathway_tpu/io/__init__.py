@@ -10,7 +10,52 @@ from __future__ import annotations
 from pathway_tpu.io import csv, fs, http, jsonlines, plaintext, python
 from pathway_tpu.io._subscribe import subscribe
 
-__all__ = ["csv", "fs", "http", "jsonlines", "plaintext", "python", "subscribe"]
+__all__ = [
+    "airbyte",
+    "bigquery",
+    "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
+    "fs",
+    "gdrive",
+    "http",
+    "jsonlines",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
+    "null",
+    "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
+    "python",
+    "redpanda",
+    "s3",
+    "s3_csv",
+    "slack",
+    "sqlite",
+    "subscribe",
+]
+
+_LAZY_CONNECTORS = {
+    "airbyte", "bigquery", "debezium", "deltalake", "elasticsearch",
+    "gdrive", "kafka", "logstash", "minio", "mongodb", "nats", "null",
+    "postgres", "pubsub", "pyfilesystem", "redpanda", "s3", "s3_csv",
+    "slack", "sqlite",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_CONNECTORS:
+        import importlib
+
+        mod = importlib.import_module(f"pathway_tpu.io.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)
 
 
 class OnChangeCallback:  # typing alias used in reference signatures

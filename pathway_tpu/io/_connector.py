@@ -39,7 +39,15 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
         last_flush = _time.monotonic()
         with lock:
             if pending:
-                out_queue.put((conn, pending.copy()))
+                # subject scan state captured WITH the batch: on restore,
+                # the journaled prefix and the seek state agree (a snapshot
+                # taken later could claim rows the journal never got)
+                state = (
+                    subject.snapshot_state()
+                    if hasattr(subject, "snapshot_state")
+                    else None
+                )
+                out_queue.put((conn, pending.copy(), state))
                 pending.clear()
 
     def force_flush() -> None:
@@ -65,4 +73,4 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
         except Exception:
             pass
         flush()
-        out_queue.put((conn, None))
+        out_queue.put((conn, None, None))

@@ -1,0 +1,34 @@
+"""Helper for connectors whose client libraries are not installed in this
+environment: the full reference parameter surface is kept, and the missing
+dependency is reported at call time (the reference behaves the same — its
+connector modules import their client lazily and fail with an ImportError
+naming the package)."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+def require(module: str, package_hint: str | None = None):
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise ImportError(
+            f"this connector requires the `{package_hint or module}` package"
+        ) from e
+
+
+def gated_fn(system: str, module: str, package_hint: str | None = None):
+    def fn(*args, **kwargs):
+        require(module, package_hint)
+        raise NotImplementedError(
+            f"pw.io.{system}: client `{module}` is present but this "
+            f"connector's transport is not wired in this build yet"
+        )
+
+    fn.__name__ = system
+    fn.__doc__ = (
+        f"pw.io.{system} (reference parity surface; requires `{package_hint or module}`)"
+    )
+    return fn

@@ -1,0 +1,22 @@
+"""pw.io.airbyte — 300+ sources via airbyte connectors (reference:
+python/pathway/io/airbyte + vendored third_party/airbyte_serverless; runs
+connector images via local Docker or GCP Cloud Run). Requires a container
+runtime; surface kept for template compatibility."""
+
+from __future__ import annotations
+
+
+def read(config_file_path: str, streams: list[str], *, mode: str = "streaming",
+         execution_type: str = "local", enforce_method=None,
+         refresh_interval_ms: int = 60000, name=None, **kwargs):
+    import shutil
+
+    if shutil.which("docker") is None:
+        raise RuntimeError(
+            "pw.io.airbyte requires a local Docker runtime (or Cloud Run "
+            "credentials) to execute Airbyte connector images"
+        )
+    raise NotImplementedError(
+        "pw.io.airbyte: docker present, but the airbyte-serverless driver "
+        "is not wired in this build"
+    )

@@ -1,0 +1,26 @@
+"""pw.io.deltalake — connector surface (reference: python/pathway/io/deltalake (native DeltaTableReader/Writer data_storage.rs:1902/:1611)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def read(*args, schema=None, mode="streaming", autocommit_duration_ms=1500,
+         name=None, **kwargs):
+    require('deltalake')
+    raise NotImplementedError(
+        "pw.io.deltalake.read: client library found, but no deltalake service "
+        "transport is wired in this build"
+    )
+
+
+def write(table, *args, name=None, **kwargs):
+    require('deltalake')
+    raise NotImplementedError(
+        "pw.io.deltalake.write: client library found, but no deltalake service "
+        "transport is wired in this build"
+    )

@@ -1,0 +1,17 @@
+"""pw.io.elasticsearch — connector surface (reference: python/pathway/io/elasticsearch (native ElasticSearchWriter data_storage.rs:1328)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('elasticsearch')
+    raise NotImplementedError(
+        "pw.io.elasticsearch.write: client library found, but no elasticsearch service "
+        "transport is wired in this build"
+    )

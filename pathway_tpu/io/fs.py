@@ -115,7 +115,6 @@ class _FsSubject(ConnectorSubject):
             current.add(p)
             if self._seen.get(p) == mtime:
                 continue
-            self._seen[p] = mtime
             for old_key, old_row in self._emitted.pop(p, []):
                 self._remove(old_key, old_row)
             rows = _parse_file(
@@ -127,9 +126,12 @@ class _FsSubject(ConnectorSubject):
                 (ref_scalar("fs", os.path.abspath(p), i), row)
                 for i, row in enumerate(rows)
             ]
-            self._emitted[p] = keyed
             for key, row in keyed:
                 self._upsert(key, row)
+            # scan state recorded only AFTER the rows are emitted, so a
+            # flush snapshot can never claim a file whose rows it lacks
+            self._emitted[p] = keyed
+            self._seen[p] = mtime
         for p in list(self._emitted):
             if p not in current:
                 for old_key, old_row in self._emitted.pop(p, []):
@@ -147,6 +149,15 @@ class _FsSubject(ConnectorSubject):
 
     def on_stop(self):
         self._stop = True
+
+    # -- persistence hooks (reference: Reader::seek, data_storage.rs:394;
+    # scanner object cache, scanner/filesystem.rs) -------------------------
+    def snapshot_state(self):
+        return {"seen": dict(self._seen), "emitted": dict(self._emitted)}
+
+    def seek(self, state) -> None:
+        self._seen = dict(state.get("seen", {}))
+        self._emitted = dict(state.get("emitted", {}))
 
 
 def _infer_schema(path: str, fmt: str, with_metadata: bool) -> type[Schema]:
@@ -217,7 +228,10 @@ def read(
         return table_from_rows(schema, rows)
     subject = _FsSubject(path, format, schema, with_metadata, mode, refresh_interval)
     return python_read(
-        subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms
+        subject,
+        schema=schema,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=name or f"fs:{path}",
     )
 
 

@@ -15,35 +15,49 @@ from pathway_tpu.io.python import ConnectorSubject, read as python_read
 
 
 class _HttpPollSubject(ConnectorSubject):
-    def __init__(self, url, refresh_interval, headers):
+    def __init__(self, url, refresh_interval, headers, method="GET"):
         super().__init__()
         self.url = url
         self.refresh_interval = refresh_interval
         self.headers = headers or {}
+        self.method = method
         self._stop = False
+        self._seen_lines: set[str] = set()
 
     def run(self):
         while not self._stop:
-            req = urllib.request.Request(self.url, headers=self.headers)
+            req = urllib.request.Request(
+                self.url, headers=self.headers, method=self.method
+            )
             try:
                 with urllib.request.urlopen(req, timeout=30) as resp:
                     body = resp.read().decode()
             except Exception:
                 time.sleep(self.refresh_interval)
                 continue
+            emitted = False
             for line in body.splitlines():
                 line = line.strip()
-                if not line:
-                    continue
+                if not line or line in self._seen_lines:
+                    continue  # only NEW lines become rows across polls
+                self._seen_lines.add(line)
+                emitted = True
                 try:
                     self.next(**_json.loads(line))
                 except Exception:
                     self.next(data=line)
-            self.commit()
+            if emitted:
+                self.commit()
             time.sleep(self.refresh_interval)
 
     def on_stop(self):
         self._stop = True
+
+    def snapshot_state(self):
+        return {"seen_lines": set(self._seen_lines)}
+
+    def seek(self, state):
+        self._seen_lines = set(state.get("seen_lines", ()))
 
 
 def read(
@@ -56,8 +70,8 @@ def read(
     format: str = "json",
     **kwargs,
 ):
-    subject = _HttpPollSubject(url, refresh_interval, headers)
-    return python_read(subject, schema=schema)
+    subject = _HttpPollSubject(url, refresh_interval, headers, method=method)
+    return python_read(subject, schema=schema, name=f"http:{url}")
 
 
 def write(table, url: str, *, method: str = "POST", headers: dict | None = None,

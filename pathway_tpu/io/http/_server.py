@@ -128,7 +128,21 @@ class RestServerSubject(ConnectorSubject):
         cols = self.schema.column_names()
         defaults = self.schema.default_values()
         if request.method == "GET":
-            payload = dict(request.query)
+            # query-string values are strings — coerce to the schema types
+            hints = self.schema.typehints()
+            payload = {}
+            for key, value in request.query.items():
+                t = hints.get(key)
+                try:
+                    if t is dt.INT:
+                        value = int(value)
+                    elif t is dt.FLOAT:
+                        value = float(value)
+                    elif t is dt.BOOL:
+                        value = value.lower() in ("1", "true", "yes")
+                except (TypeError, ValueError):
+                    pass
+                payload[key] = value
         else:
             try:
                 payload = await request.json()

@@ -1,0 +1,17 @@
+"""pw.io.logstash — connector surface (reference: python/pathway/io/logstash (HTTP transport over pw.io.http.write)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('requests')
+    raise NotImplementedError(
+        "pw.io.logstash.write: client library found, but no logstash service "
+        "transport is wired in this build"
+    )

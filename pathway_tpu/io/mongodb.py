@@ -1,0 +1,17 @@
+"""pw.io.mongodb — connector surface (reference: python/pathway/io/mongodb (native MongoWriter data_storage.rs:2187, Bson formatter data_format.rs:1982)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('pymongo')
+    raise NotImplementedError(
+        "pw.io.mongodb.write: client library found, but no mongodb service "
+        "transport is wired in this build"
+    )

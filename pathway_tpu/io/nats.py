@@ -1,0 +1,26 @@
+"""pw.io.nats — connector surface (reference: python/pathway/io/nats (native NatsReader/Writer data_storage.rs:2226/:2300)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def read(*args, schema=None, mode="streaming", autocommit_duration_ms=1500,
+         name=None, **kwargs):
+    require('nats')
+    raise NotImplementedError(
+        "pw.io.nats.read: client library found, but no nats service "
+        "transport is wired in this build"
+    )
+
+
+def write(table, *args, name=None, **kwargs):
+    require('nats')
+    raise NotImplementedError(
+        "pw.io.nats.write: client library found, but no nats service "
+        "transport is wired in this build"
+    )

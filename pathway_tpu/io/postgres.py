@@ -1,0 +1,17 @@
+"""pw.io.postgres — connector surface (reference: python/pathway/io/postgres (native PsqlWriter data_storage.rs:1072; snapshot/updates formatters data_format.rs:1632,1691)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('psycopg2')
+    raise NotImplementedError(
+        "pw.io.postgres.write: client library found, but no postgres service "
+        "transport is wired in this build"
+    )

@@ -1,0 +1,17 @@
+"""pw.io.pubsub — connector surface (reference: python/pathway/io/pubsub).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('google.cloud.pubsub_v1')
+    raise NotImplementedError(
+        "pw.io.pubsub.write: client library found, but no pubsub service "
+        "transport is wired in this build"
+    )

@@ -159,10 +159,14 @@ def read(
     out = Table(schema, Universe())
     parser = _make_parser(schema)
     width = len(schema.column_names())
+    persistent_name = name or kwargs.get("persistent_id")
 
     def lower(ctx):
         ctx.set_engine_table(
-            out, ctx.scope.connector_table(subject, parser, width)
+            out,
+            ctx.scope.connector_table(
+                subject, parser, width, name=persistent_name
+            ),
         )
 
     G.add_operator([], [out], lower, "python_connector")

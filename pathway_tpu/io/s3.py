@@ -1,0 +1,20 @@
+"""pw.io.s3 — connector surface (reference: python/pathway/io/s3 (native S3 scanner scanner/s3.rs:268)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def read(*args, schema=None, mode="streaming", autocommit_duration_ms=1500,
+         name=None, **kwargs):
+    require('boto3')
+    raise NotImplementedError(
+        "pw.io.s3.read: client library found, but no s3 service "
+        "transport is wired in this build"
+    )
+
+

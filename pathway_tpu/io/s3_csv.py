@@ -1,0 +1,20 @@
+"""pw.io.s3_csv — connector surface (reference: python/pathway/io/s3_csv).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def read(*args, schema=None, mode="streaming", autocommit_duration_ms=1500,
+         name=None, **kwargs):
+    require('boto3')
+    raise NotImplementedError(
+        "pw.io.s3_csv.read: client library found, but no s3_csv service "
+        "transport is wired in this build"
+    )
+
+

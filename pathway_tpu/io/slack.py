@@ -1,0 +1,17 @@
+"""pw.io.slack — connector surface (reference: python/pathway/io/slack (webhook output)).
+
+Client transport gated on its library; the configuration surface matches
+the reference so templates parse and fail only at run time with a clear
+dependency error."""
+
+from __future__ import annotations
+
+from pathway_tpu.io._gated import require
+
+
+def write(table, *args, name=None, **kwargs):
+    require('requests')
+    raise NotImplementedError(
+        "pw.io.slack.write: client library found, but no slack service "
+        "transport is wired in this build"
+    )

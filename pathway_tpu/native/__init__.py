@@ -1,0 +1,189 @@
+"""Native C++ runtime components — build + ctypes bindings.
+
+The reference's native core is Rust (tantivy BM25, usearch HNSW,
+brute-force ndarray KNN — src/external_integration/). Here the host-side
+index runtimes are C++ (native/bm25.cpp, native/hnsw.cpp) compiled once
+into a shared library and bound via ctypes; the dense brute-force path
+stays on TPU (pathway_tpu.ops). Pure-Python fallbacks keep everything
+working when no toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Any, Sequence
+
+import numpy as np
+
+_REPO_NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_BUILD_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_build"
+)
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_TRIED = False
+
+
+def _sources() -> list[str]:
+    src_dir = _REPO_NATIVE
+    if not os.path.isdir(src_dir):
+        # installed layout: sources shipped next to this package
+        src_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+    return [
+        os.path.join(src_dir, "bm25.cpp"),
+        os.path.join(src_dir, "hnsw.cpp"),
+    ]
+
+
+def _build() -> str | None:
+    sources = _sources()
+    if not all(os.path.exists(s) for s in sources):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, "libpathway_native.so")
+    stamp = os.path.join(_BUILD_DIR, "build.stamp")
+    newest_src = max(os.path.getmtime(s) for s in sources)
+    if os.path.exists(out) and os.path.exists(stamp):
+        if os.path.getmtime(stamp) >= newest_src:
+            return out
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        "-o", out, *sources,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    except Exception:
+        return None
+    with open(stamp, "w") as f:
+        f.write("ok")
+    return out
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Compile-on-first-use; None when no toolchain (callers fall back)."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.bm25_new.restype = ctypes.c_void_p
+        lib.bm25_new.argtypes = [ctypes.c_double, ctypes.c_double]
+        lib.bm25_free.argtypes = [ctypes.c_void_p]
+        lib.bm25_add.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p]
+        lib.bm25_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bm25_len.restype = ctypes.c_int64
+        lib.bm25_len.argtypes = [ctypes.c_void_p]
+        lib.bm25_search.restype = ctypes.c_int64
+        lib.bm25_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.hnsw_new.restype = ctypes.c_void_p
+        lib.hnsw_new.argtypes = [ctypes.c_int32] * 5
+        lib.hnsw_free.argtypes = [ctypes.c_void_p]
+        lib.hnsw_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+        ]
+        lib.hnsw_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.hnsw_len.restype = ctypes.c_int64
+        lib.hnsw_len.argtypes = [ctypes.c_void_p]
+        lib.hnsw_search.restype = ctypes.c_int64
+        lib.hnsw_search.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeBm25:
+    """ctypes wrapper over the C++ BM25 index. int64 handles are minted
+    per key by the caller (KeyToU64IdMapper pattern, reference
+    external_integration/mod.rs)."""
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.bm25_new(k1, b)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.bm25_free(self._h)
+            self._h = None
+
+    def add(self, key: int, text: str) -> None:
+        self._lib.bm25_add(self._h, key, text.encode("utf-8", "replace"))
+
+    def remove(self, key: int) -> None:
+        self._lib.bm25_remove(self._h, key)
+
+    def __len__(self) -> int:
+        return self._lib.bm25_len(self._h)
+
+    def search(self, query: str, k: int) -> list[tuple[int, float]]:
+        n = max(k, 0)
+        keys = (ctypes.c_int64 * n)()
+        scores = (ctypes.c_double * n)()
+        got = self._lib.bm25_search(
+            self._h, query.encode("utf-8", "replace"), n, keys, scores
+        )
+        return [(keys[i], scores[i]) for i in range(got)]
+
+
+_METRICS = {"cos": 0, "l2sq": 1, "ip": 2, "dot": 2}
+
+
+class NativeHnsw:
+    """ctypes wrapper over the C++ HNSW ANN index (usearch equivalent)."""
+
+    def __init__(self, dim: int, metric: str = "cos", *, M: int = 16,
+                 ef_build: int = 128, ef_search: int = 64):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.dim = dim
+        self._h = lib.hnsw_new(dim, _METRICS[metric], M, ef_build, ef_search)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hnsw_free(self._h)
+            self._h = None
+
+    def add(self, key: int, vec) -> None:
+        v = np.ascontiguousarray(vec, dtype=np.float32)
+        self._lib.hnsw_add(
+            self._h, key, v.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        )
+
+    def remove(self, key: int) -> None:
+        self._lib.hnsw_remove(self._h, key)
+
+    def __len__(self) -> int:
+        return self._lib.hnsw_len(self._h)
+
+    def search(self, vec, k: int) -> list[tuple[int, float]]:
+        v = np.ascontiguousarray(vec, dtype=np.float32)
+        n = max(k, 0)
+        keys = (ctypes.c_int64 * n)()
+        scores = (ctypes.c_double * n)()
+        got = self._lib.hnsw_search(
+            self._h, v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, keys, scores,
+        )
+        return [(keys[i], scores[i]) for i in range(got)]

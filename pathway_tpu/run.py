@@ -17,7 +17,12 @@ def run(
     terminate_on_error: bool = True,
     **kwargs,
 ) -> None:
-    GraphRunner(terminate_on_error=terminate_on_error).run_outputs()
+    GraphRunner(
+        terminate_on_error=terminate_on_error,
+        persistence_config=persistence_config,
+        with_http_server=with_http_server,
+        monitoring_level=monitoring_level,
+    ).run_outputs()
 
 
 def run_all(**kwargs) -> None:

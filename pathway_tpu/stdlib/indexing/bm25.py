@@ -25,6 +25,77 @@ def _tokenize(text: str) -> list[str]:
     return [w.lower() for w in _WORD_RE.findall(str(text))]
 
 
+class _NativeBm25Adapter:
+    """C++ posting lists (native/bm25.cpp) behind the adapter contract;
+    128-bit Pointers are mapped to dense int64 ids (reference:
+    KeyToU64IdMapper, external_integration/mod.rs)."""
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        from pathway_tpu.native import NativeBm25
+
+        self.index = NativeBm25(k1, b)
+        self.key_to_id: dict[Any, int] = {}
+        self.id_to_key: dict[int, Any] = {}
+        self.meta: dict[Any, Any] = {}
+        self._next = 0
+
+    def _id(self, key) -> int:
+        i = self.key_to_id.get(key)
+        if i is None:
+            i = self._next
+            self._next += 1
+            self.key_to_id[key] = i
+            self.id_to_key[i] = key
+        return i
+
+    def add(self, key, data, filter_data) -> None:
+        self.index.add(self._id(key), str(data))
+        self.meta[key] = filter_data
+
+    def remove(self, key) -> None:
+        i = self.key_to_id.get(key)
+        if i is not None:
+            self.index.remove(i)
+        self.meta.pop(key, None)
+
+    def search(self, queries):
+        out = []
+        n_total = len(self.index)
+        for qdata, limit, filt in queries:
+            pred = compile_filter(filt) if isinstance(filt, str) else filt
+            k = limit if pred is None else max(limit * 4, limit)
+            hits: list = []
+            while True:
+                asked = min(k, max(n_total, 1))
+                raw = self.index.search(str(qdata), asked)
+                hits = []
+                for i, score in raw:
+                    key = self.id_to_key.get(i)
+                    if key is None:
+                        continue
+                    if pred is not None:
+                        try:
+                            if not pred(self.meta.get(key)):
+                                continue
+                        except Exception:
+                            continue
+                    hits.append((key, score))
+                    if len(hits) == limit:
+                        break
+                # stop growing once satisfied OR the index returned fewer
+                # candidates than asked (it has no more matching docs)
+                if pred is None or len(hits) >= limit or len(raw) < asked:
+                    break
+                k *= 4
+            out.append(
+                (
+                    tuple(key for key, _ in hits),
+                    tuple(s for _, s in hits),
+                )
+            )
+        return out
+
+
 class _Bm25Adapter:
     def __init__(self, k1: float = 1.2, b: float = 0.75):
         self.k1 = k1
@@ -108,6 +179,10 @@ class TantivyBM25(InnerIndex):
     b: float = 0.75
 
     def make_adapter(self):
+        from pathway_tpu.native import available
+
+        if available():
+            return _NativeBm25Adapter(k1=self.k1, b=self.b)
         return _Bm25Adapter(k1=self.k1, b=self.b)
 
 

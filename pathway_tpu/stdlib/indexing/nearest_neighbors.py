@@ -24,6 +24,81 @@ from pathway_tpu.stdlib.indexing._filters import compile_filter
 from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
 
 
+class _HnswAdapter:
+    """C++ HNSW ANN (native/hnsw.cpp — the usearch equivalent,
+    usearch_integration.rs:20) behind the adapter contract."""
+
+    def __init__(self, dimension: int, metric: str, *, connectivity: int = 16,
+                 expansion_add: int = 128, expansion_search: int = 64):
+        from pathway_tpu.native import NativeHnsw
+
+        self.index = NativeHnsw(
+            dimension,
+            metric,
+            M=connectivity or 16,
+            ef_build=expansion_add or 128,
+            ef_search=expansion_search or 64,
+        )
+        self.key_to_id: dict[Any, int] = {}
+        self.id_to_key: dict[int, Any] = {}
+        self.meta: dict[Any, Any] = {}
+        self._next = 0
+
+    def _id(self, key) -> int:
+        i = self.key_to_id.get(key)
+        if i is None:
+            i = self._next
+            self._next += 1
+            self.key_to_id[key] = i
+            self.id_to_key[i] = key
+        return i
+
+    def add(self, key, data, filter_data) -> None:
+        self.index.add(self._id(key), np.asarray(data, dtype=np.float32))
+        self.meta[key] = filter_data
+
+    def remove(self, key) -> None:
+        i = self.key_to_id.get(key)
+        if i is not None:
+            self.index.remove(i)
+        self.meta.pop(key, None)
+
+    def search(self, queries):
+        out = []
+        for qdata, limit, filt in queries:
+            vec = np.asarray(qdata, dtype=np.float32)
+            pred = compile_filter(filt) if isinstance(filt, str) else filt
+            k = limit if pred is None else max(limit * 4, limit)
+            n_total = len(self.index)
+            while True:
+                asked = min(k, max(n_total, 1))
+                raw = self.index.search(vec, asked)
+                hits = []
+                for i, score in raw:
+                    key = self.id_to_key.get(i)
+                    if key is None:
+                        continue
+                    if pred is not None:
+                        try:
+                            if not pred(self.meta.get(key)):
+                                continue
+                        except Exception:
+                            continue
+                    hits.append((key, score))
+                    if len(hits) == limit:
+                        break
+                if pred is None or len(hits) >= limit or len(raw) < asked:
+                    break
+                k *= 4
+            out.append(
+                (
+                    tuple(key for key, _ in hits),
+                    tuple(s for _, s in hits),
+                )
+            )
+        return out
+
+
 class _KnnAdapter:
     """ExternalIndexAdapter over a (sharded) KNN shard with filter-aware
     over-querying (reference: DerivedFilteredSearchIndex retries with
@@ -124,12 +199,26 @@ class BruteForceKnn(_EmbeddingKnn):
 
 @dataclass(frozen=True)
 class UsearchKnn(_EmbeddingKnn):
-    """API-parity alias (reference: nearest_neighbors.py:65). HNSW knobs
-    are accepted for compatibility; search is the exact TPU scan."""
+    """HNSW ANN (reference: nearest_neighbors.py:65, native core
+    usearch_integration.rs). Backed by the C++ HNSW (native/hnsw.cpp);
+    falls back to the exact TPU scan when no toolchain is present."""
 
     connectivity: int = 0
     expansion_add: int = 0
     expansion_search: int = 0
+
+    def make_adapter(self):
+        from pathway_tpu.native import available
+
+        if available():
+            return _HnswAdapter(
+                self.dimensions,
+                self.metric,
+                connectivity=self.connectivity,
+                expansion_add=self.expansion_add,
+                expansion_search=self.expansion_search,
+            )
+        return super().make_adapter()
 
 
 @dataclass

@@ -127,11 +127,14 @@ class OpenAIEmbedder(_RemoteEmbedder):
                 "OpenAIEmbedder requires the `openai` package"
             ) from e
 
+        client_box: list = []  # one pooled client reused across all calls
+
         async def embed(text: str, **call_kwargs) -> list:
             import openai
 
-            client = openai.AsyncOpenAI(api_key=api_key)
-            ret = await client.embeddings.create(
+            if not client_box:
+                client_box.append(openai.AsyncOpenAI(api_key=api_key))
+            ret = await client_box[0].embeddings.create(
                 input=[text or "."], model=model, **call_kwargs
             )
             return ret.data[0].embedding

@@ -52,12 +52,17 @@ class OpenAIChat(BaseChat):
             raise ImportError("OpenAIChat requires the `openai` package") from e
         self.kwargs = {"model": model, **kwargs}
 
+        client_box: list = []  # one pooled client reused across all calls
+
         async def chat(messages, **call_kwargs) -> str:
             import openai
 
-            client = openai.AsyncOpenAI(api_key=api_key, base_url=base_url)
+            if not client_box:
+                client_box.append(
+                    openai.AsyncOpenAI(api_key=api_key, base_url=base_url)
+                )
             merged = {**self.kwargs, **call_kwargs}
-            ret = await client.chat.completions.create(
+            ret = await client_box[0].chat.completions.create(
                 messages=_normalize_messages(messages), **merged
             )
             return ret.choices[0].message.content

@@ -272,10 +272,10 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         from pathway_tpu.xpacks.llm.servers import QASummaryRestServer
 
         self.server = QASummaryRestServer(host, port, self, **rest_kwargs)
-        for route, callable_fn, additional_endpoint_kwargs in self._pending_endpoints:
-            self.server.serve_callable(
-                route, **additional_endpoint_kwargs
-            )(callable_fn)
+        for route, callable_fn, schema, extra in self._pending_endpoints:
+            self.server.serve_callable(route, schema=schema, **extra)(
+                callable_fn
+            )
 
     def serve_callable(self, route: str, schema=None, **additional_endpoint_kwargs):
         """Decorator: expose a python callable on `route` (reference: :512)."""
@@ -283,7 +283,7 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         def decorator(callable_fn):
             if self.server is None:
                 self._pending_endpoints.append(
-                    (route, callable_fn, additional_endpoint_kwargs)
+                    (route, callable_fn, schema, additional_endpoint_kwargs)
                 )
             else:
                 self.server.serve_callable(

@@ -29,6 +29,7 @@ class BaseRestServer:
             methods=methods,
             autocommit_duration_ms=50,
             delete_completed_queries=True,
+            **{**self.rest_kwargs, **kwargs},
         )
         writer(handler(queries))
 
@@ -74,11 +75,26 @@ class BaseRestServer:
 
     def run(self, threaded: bool = False, with_cache: bool = False,
             cache_backend=None, terminate_on_error: bool = True, **kwargs):
+        persistence_config = None
+        if with_cache and cache_backend is not None:
+            import pathway_tpu as pw_mod
+
+            persistence_config = pw_mod.persistence.Config(
+                backend=cache_backend
+            )
+
+        def target():
+            pw.run(
+                terminate_on_error=terminate_on_error,
+                persistence_config=persistence_config,
+                **kwargs,
+            )
+
         if threaded:
-            t = threading.Thread(target=pw.run, daemon=True)
+            t = threading.Thread(target=target, daemon=True)
             t.start()
             return t
-        pw.run()
+        target()
 
     run_server = run
 

@@ -1,0 +1,142 @@
+"""Persistence / recovery tests (reference pattern:
+integration_tests/wordcount/ — run a wordcount pipeline as a subprocess
+with fs persistent storage, kill it mid-stream, restart, assert
+exactly-once-looking output after resume; test_recovery.py:38)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORDCOUNT = textwrap.dedent(
+    """
+    import os, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pdir, docs_dir, out_path, kill_after = sys.argv[1:5]
+
+    words = pw.io.fs.read(
+        docs_dir, format="plaintext", mode="streaming",
+        autocommit_duration_ms=10, refresh_interval=0.05,
+        name="words",
+    )
+    counts = words.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+
+    import json
+    seen = {{}}
+    def on_change(key, row, time_, diff):
+        if diff > 0:
+            seen[row["word"]] = row["c"]
+        elif row["word"] in seen and seen[row["word"]] == row["c"]:
+            del seen[row["word"]]
+        with open(out_path, "w") as f:
+            json.dump(seen, f)
+
+    pw.io.subscribe(counts, on_change=on_change)
+
+    if float(kill_after) > 0:
+        def killer():
+            time.sleep(float(kill_after))
+            os._exit(17)  # hard kill: no cleanup, journal must carry us
+        threading.Thread(target=killer, daemon=True).start()
+    else:
+        def stopper():
+            time.sleep(2.0)
+            os._exit(0)
+        threading.Thread(target=stopper, daemon=True).start()
+
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pdir)
+        )
+    )
+    """
+)
+
+
+def _run_wordcount(tmp, kill_after: float) -> int:
+    script = os.path.join(tmp, "wc.py")
+    with open(script, "w") as f:
+        f.write(_WORDCOUNT.format(repo=os.getcwd()))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            script,
+            os.path.join(tmp, "pstorage"),
+            os.path.join(tmp, "docs"),
+            os.path.join(tmp, "out.json"),
+            str(kill_after),
+        ],
+        capture_output=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return proc.returncode
+
+
+def test_wordcount_kill_and_recover(tmp_path):
+    tmp = str(tmp_path)
+    docs = os.path.join(tmp, "docs")
+    os.makedirs(docs)
+    with open(os.path.join(docs, "f1.txt"), "w") as f:
+        f.write("alpha\nbeta\nalpha\n")
+
+    # phase 1: run and hard-kill mid-stream
+    rc = _run_wordcount(tmp, kill_after=1.5)
+    assert rc == 17
+
+    # between runs: new data arrives
+    with open(os.path.join(docs, "f2.txt"), "w") as f:
+        f.write("alpha\ngamma\n")
+
+    # phase 2: restart — journal replays f1, scan state skips re-reading it,
+    # f2 is picked up fresh
+    rc = _run_wordcount(tmp, kill_after=0)
+    assert rc == 0
+
+    with open(os.path.join(tmp, "out.json")) as f:
+        counts = json.load(f)
+    assert counts == {"alpha": 3, "beta": 1, "gamma": 1}
+
+
+def test_persistence_backend_journal_roundtrip(tmp_path):
+    import pathway_tpu as pw
+    from pathway_tpu.persistence import PersistenceManager
+
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(tmp_path))
+    )
+    mgr = PersistenceManager(cfg)
+    mgr.journal_batch("c1", 2, [(1, ("a",), 1)])
+    mgr.journal_batch("c1", 4, [(1, ("a",), -1), (2, ("b",), 1)])
+    mgr.save_subject_state("c1", {"pos": 7})
+
+    mgr2 = PersistenceManager(cfg)
+    journal = mgr2.load_journal("c1")
+    assert journal == [
+        (2, [(1, ("a",), 1)]),
+        (4, [(1, ("a",), -1), (2, ("b",), 1)]),
+    ]
+    assert mgr2.load_subject_state("c1") == {"pos": 7}
+
+
+def test_torn_journal_tail_dropped(tmp_path):
+    import pathway_tpu as pw
+    from pathway_tpu.persistence import PersistenceManager
+
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(tmp_path))
+    )
+    mgr = PersistenceManager(cfg)
+    mgr.journal_batch("c1", 2, [(1, ("a",), 1)])
+    # simulate crash mid-append: garbage partial record at the tail
+    mgr.backend.append("journal/c1", (999).to_bytes(8, "little") + b"par")
+    journal = PersistenceManager(cfg).load_journal("c1")
+    assert journal == [(2, [(1, ("a",), 1)])]

@@ -1,0 +1,89 @@
+"""pw.sql tests (reference pattern: python/pathway/tests/test_sql.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values(), key=repr)
+
+
+def _t():
+    return pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+
+
+def test_sql_select_where():
+    res = pw.sql("SELECT a, b FROM tab WHERE a > 1", tab=_t())
+    assert _rows(res) == [(2, 20), (3, 30)]
+
+
+def test_sql_select_star_and_exprs():
+    res = pw.sql("SELECT *, a + b AS s FROM tab", tab=_t())
+    assert _rows(res) == [(1, 10, 11), (2, 20, 22), (3, 30, 33)]
+
+
+def test_sql_group_by():
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        x | 1
+        x | 2
+        y | 5
+        """
+    )
+    res = pw.sql(
+        "SELECT k, SUM(v) AS total, COUNT(*) AS c FROM t GROUP BY k", t=t
+    )
+    assert _rows(res) == [("x", 3, 2), ("y", 5, 1)]
+
+
+def test_sql_having():
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        x | 1
+        x | 2
+        y | 5
+        """
+    )
+    res = pw.sql(
+        "SELECT k, SUM(v) AS total FROM t GROUP BY k HAVING SUM(v) > 4", t=t
+    )
+    assert _rows(res) == [("y", 5)]
+
+
+def test_sql_join():
+    left = pw.debug.table_from_markdown(
+        """
+        k | v
+        1 | a
+        2 | b
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        k2 | w
+        1  | x
+        2  | y
+        """
+    )
+    res = pw.sql(
+        "SELECT v, w FROM l JOIN r ON l.k = r.k2", l=left, r=right
+    )
+    assert _rows(res) == [("a", "x"), ("b", "y")]
+
+
+def test_sql_union_all():
+    res = pw.sql(
+        "SELECT a FROM t WHERE a = 1 UNION ALL SELECT a FROM t WHERE a = 3",
+        t=_t(),
+    )
+    assert _rows(res) == [(1,), (3,)]
