@@ -72,6 +72,18 @@ from pathway_tpu.internals.config import (  # noqa: E402
 )
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    enable_interactive_mode,
+    live,
+)
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.sql_module import sql  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402

@@ -48,7 +48,8 @@ class Node:
         for port, inp in enumerate(inputs):
             inp.downstream.append((self, port))
         self.pending: dict[int, list[list[Delta]]] = {}
-        self.trace = None  # user stack frame for error attribution
+        # user stack frame that declared this operator (error attribution)
+        self.trace = getattr(scope.runtime, "current_trace", None)
 
     # -- scheduling -------------------------------------------------------
     def accept(self, time: int, port: int, deltas: list[Delta]) -> None:

@@ -56,6 +56,7 @@ class Runtime:
         self.monitoring_level = monitoring_level
         self.error: Exception | None = None
         self._async_loop = None
+        self.current_trace = None
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
@@ -96,7 +97,20 @@ class Runtime:
             pending_ids.discard(nid)
             node = nodes[nid]
             batches = node.take(time)
-            out = node.process(time, batches)
+            try:
+                out = node.process(time, batches)
+            except Exception as exc:
+                from pathway_tpu.internals.api import EngineErrorWithTrace
+
+                if node.trace is not None and not isinstance(
+                    exc, EngineErrorWithTrace
+                ):
+                    raise EngineErrorWithTrace(
+                        exc,
+                        f"{node.trace.filename}:{node.trace.lineno} "
+                        f"in {node.trace.name}: {node.trace.line}",
+                    ) from exc
+                raise
             if out:
                 self._deliver(node, time, out)
         self.pending_times.pop(time, None)

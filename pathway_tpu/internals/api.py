@@ -235,7 +235,10 @@ class EngineError(Exception):
 
 class EngineErrorWithTrace(Exception):
     def __init__(self, error: Exception, trace: Any = None):
-        super().__init__(str(error))
+        msg = str(error)
+        if trace is not None:
+            msg = f"{msg}\noccurred in operator declared at {trace}"
+        super().__init__(msg)
         self.error = error
         self.trace = trace
 

@@ -158,8 +158,15 @@ class GraphRunner:
 
     def _lower(self, ops: list[Operator], runtime: Runtime) -> LoweringContext:
         ctx = LoweringContext(runtime)
-        for op in ops:
-            op.lower_fn(ctx)
+        try:
+            for op in ops:
+                # nodes created during this lower inherit the operator's
+                # user frame for error attribution (reference:
+                # EngineErrorWithTrace, graph_runner/__init__.py:217-229)
+                runtime.current_trace = op.trace
+                op.lower_fn(ctx)
+        finally:
+            runtime.current_trace = None
         return ctx
 
     def run_tables(self, *tables: "Table", include_outputs: bool = False):

@@ -1,0 +1,67 @@
+"""Experimental live-REPL mode (reference:
+python/pathway/internals/interactive.py:222 — `pw.enable_interactive_mode`
+keeps a background run alive and lets the REPL inspect live tables)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+_state: dict[str, Any] = {"enabled": False, "thread": None}
+
+
+class LiveTableHandle:
+    """Snapshot accessor over a live table (refreshed by the background
+    run). pw.io.subscribe delivers rows as {column: value} dicts."""
+
+    def __init__(self, table):
+        self.table = table
+        self._rows: dict = {}
+        import pathway_tpu as pw
+
+        def on_change(key, row, time_, is_addition):
+            if is_addition:
+                self._rows[key] = row
+            else:
+                self._rows.pop(key, None)
+
+        pw.io.subscribe(self.table, on_change=on_change)
+
+    def snapshot(self) -> list[dict]:
+        return list(self._rows.values())
+
+    def __repr__(self):
+        cols = self.table.column_names()
+        lines = [" | ".join(cols)] + [
+            " | ".join(str(row.get(c)) for c in cols)
+            for row in self.snapshot()
+        ]
+        return "\n".join(lines)
+
+
+def interactive_mode_enabled() -> bool:
+    return bool(_state["enabled"])
+
+
+def enable_interactive_mode() -> None:
+    """pw.run() will start on a background daemon thread, leaving the REPL
+    responsive; inspect tables via pw.live(table) handles."""
+    _state["enabled"] = True
+
+
+def live(table) -> LiveTableHandle:
+    """Register a live view; call BEFORE pw.run()."""
+    return LiveTableHandle(table)
+
+
+def start() -> threading.Thread:
+    import pathway_tpu as pw
+
+    t = threading.Thread(
+        target=lambda: pw.run(_interactive_bypass=True), daemon=True
+    )
+    t.start()
+    _state["thread"] = t
+    time.sleep(0.2)
+    return t

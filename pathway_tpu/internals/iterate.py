@@ -119,7 +119,9 @@ class IterateNode(Node):
                 t, rt.scope.static_table(rows, len(t._column_names))
             )
         for op in self.body_ops:
+            rt.current_trace = op.trace
             op.lower_fn(ctx)
+        rt.current_trace = None
         captures = {
             name: rt.scope.capture(ctx.engine_table(t))
             for name, t in self.result_tables.items()

@@ -39,6 +39,7 @@ class Operator:
         self.lower_fn = lower_fn
         self.name = name
         self.is_output = is_output
+        self.trace = _user_frame()
         for t in outputs:
             t._source = self
 
@@ -99,6 +100,20 @@ class ParseGraph:
 
 
 G = ParseGraph()
+
+
+def _user_frame():
+    """First stack frame outside this package — the user line that declared
+    the operator (reference: internals/trace.py; re-raise at
+    graph_runner/__init__.py:217-229)."""
+    import traceback
+
+    pkg = __name__.split(".")[0]
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        fname = frame.filename.replace("\\", "/")
+        if f"/{pkg}/" not in fname and "<frozen" not in fname:
+            return frame
+    return None
 
 
 def clear_graph() -> None:

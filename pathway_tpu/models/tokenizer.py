@@ -31,17 +31,31 @@ class HashTokenizer:
     def __init__(self, vocab_size: int = 30522, max_length: int = 512):
         self.vocab_size = vocab_size
         self.max_length = max_length
+        # word -> ids memo: corpora repeat words heavily, and hashing is
+        # the host-side cost that must overlap device compute
+        self._word_cache: dict[str, list[int]] = {}
+
+    def _word_ids(self, word: str) -> list[int]:
+        ids = self._word_cache.get(word)
+        if ids is not None:
+            return ids
+        if len(word) <= 6:
+            ids = [_hash_token(word, self.vocab_size)]
+        else:
+            # sub-word shingles approximate BPE granularity so long
+            # words cost proportionally more tokens, like real BPE
+            ids = [
+                _hash_token(("##" if i else "") + word[i : i + 6], self.vocab_size)
+                for i in range(0, len(word), 6)
+            ]
+        if len(self._word_cache) < 500_000:
+            self._word_cache[word] = ids
+        return ids
 
     def _tokens(self, text: str) -> list[int]:
-        ids = []
+        ids: list[int] = []
         for word in text.lower().split():
-            if len(word) <= 6:
-                ids.append(_hash_token(word, self.vocab_size))
-            else:
-                # sub-word shingles approximate BPE granularity so long
-                # words cost proportionally more tokens, like real BPE
-                for i in range(0, len(word), 6):
-                    ids.append(_hash_token(("##" if i else "") + word[i : i + 6], self.vocab_size))
+            ids.extend(self._word_ids(word))
         return ids
 
     def __call__(

@@ -11,6 +11,7 @@ replaces the reference's broadcast-replicated external index
 chip and a global top-k tree reduction (SURVEY §5).
 """
 
+from pathway_tpu.parallel.distributed import global_mesh, initialize_from_env
 from pathway_tpu.parallel.mesh import best_factorization, make_mesh
 from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex, sharded_topk
 from pathway_tpu.parallel.train import (
@@ -23,6 +24,8 @@ from pathway_tpu.parallel.train import (
 __all__ = [
     "make_mesh",
     "best_factorization",
+    "global_mesh",
+    "initialize_from_env",
     "ShardedKnnIndex",
     "sharded_topk",
     "TrainState",

@@ -1,0 +1,46 @@
+"""Multi-host initialization: PATHWAY_* topology -> jax.distributed.
+
+The reference scales across processes with a timely TCP mesh configured by
+PATHWAY_PROCESSES / PATHWAY_PROCESS_ID / PATHWAY_FIRST_PORT
+(/root/reference/src/engine/dataflow/config.rs:63-127, `pathway spawn`
+cli.py:96-103). The TPU-native equivalent: the same env vars bootstrap
+`jax.distributed.initialize`, after which the global device mesh spans all
+hosts and XLA collectives ride ICI/DCN — no TCP dataplane of our own
+(SURVEY §2.9 communication backend)."""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize_from_env(coordinator_host: str = "127.0.0.1") -> bool:
+    """Initialize jax.distributed from PATHWAY_* env. Returns True if a
+    multi-process cluster was initialized, False for single-process runs.
+
+    Launch with `pathway spawn -n N program.py` (each child gets
+    PATHWAY_PROCESS_ID) or any launcher exporting the same variables.
+    """
+    import jax
+
+    from pathway_tpu.internals.config import get_pathway_config
+
+    cfg = get_pathway_config()
+    if cfg.processes <= 1:
+        return False
+    coordinator = os.environ.get(
+        "PATHWAY_COORDINATOR",
+        f"{coordinator_host}:{cfg.first_port}",
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=cfg.processes,
+        process_id=cfg.process_id,
+    )
+    return True
+
+
+def global_mesh(axes=("dp", "tp"), shape=None):
+    """Mesh over ALL devices of the (possibly multi-host) cluster."""
+    from pathway_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes=axes, shape=shape)

@@ -15,8 +15,17 @@ def run(
     persistence_config=None,
     runtime_typechecking: bool | None = None,
     terminate_on_error: bool = True,
+    _interactive_bypass: bool = False,
     **kwargs,
 ) -> None:
+    from pathway_tpu.internals.interactive import (
+        interactive_mode_enabled,
+        start as _interactive_start,
+    )
+
+    if interactive_mode_enabled() and not _interactive_bypass:
+        _interactive_start()
+        return
     GraphRunner(
         terminate_on_error=terminate_on_error,
         persistence_config=persistence_config,

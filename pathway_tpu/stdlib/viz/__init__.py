@@ -1,0 +1,56 @@
+"""pw.viz — live table visualization (reference:
+python/pathway/stdlib/viz/table_viz.py:165 + plotting.py:138 — Bokeh/Panel
+dashboards, Table.plot, show). Bokeh/Panel gate lazily; `show`/`_repr_html_`
+degrade to a static HTML/text snapshot without them."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def table_to_pandas(table):
+    """Materialize a (static) table into a pandas DataFrame — one shared
+    implementation (pathway_tpu.debug.table_to_pandas)."""
+    from pathway_tpu.debug import table_to_pandas as _impl
+
+    return _impl(table)
+
+
+def table_viz(table, **kwargs):
+    """Render a table snapshot (reference: table_viz.py). With Panel
+    installed returns a live widget; otherwise a DataFrame."""
+    try:
+        import panel as pn
+
+        df = table_to_pandas(table)
+        return pn.widgets.Tabulator(df, **kwargs)
+    except ImportError:
+        return table_to_pandas(table)
+
+
+def plot(table, plotting_function: Callable | None = None, sorting_col=None):
+    """reference: plotting.py Table.plot — live Bokeh plot over a table."""
+    try:
+        import bokeh.plotting as bp
+    except ImportError as e:
+        raise ImportError("pw.Table.plot requires the `bokeh` package") from e
+    df = table_to_pandas(table)
+    fig = bp.figure(height=300)
+    if plotting_function is not None:
+        return plotting_function(bp.ColumnDataSource(df))
+    num_cols = [c for c in df.columns if df[c].dtype.kind in "if"]
+    for c in num_cols:
+        fig.line(list(range(len(df))), df[c], legend_label=c)
+    return fig
+
+
+def show(table, **kwargs):
+    """reference: table_viz.py show — display in notebook/panel server."""
+    widget = table_viz(table, **kwargs)
+    try:
+        from IPython.display import display
+
+        display(widget)
+    except ImportError:
+        print(widget)
+    return widget

@@ -1,0 +1,88 @@
+"""Row transformer (legacy class API) + viz tests (reference pattern:
+python/pathway/tests/test_row_transformer.py)."""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values(), key=repr)
+
+
+def test_transformer_computed_attribute():
+    @pw.transformer
+    class doubler:
+        class numbers:
+            val = pw.input_attribute()
+
+            @pw.output_attribute
+            def doubled(self) -> int:
+                return self.val * 2
+
+            @pw.output_attribute
+            def plus_one(self) -> int:
+                return self.val + 1
+
+    t = pw.debug.table_from_markdown(
+        """
+        val
+        1
+        2
+        """
+    )
+    out = doubler(numbers=t).numbers
+    assert _rows(out) == [(2, 2), (4, 3)]
+
+
+def test_transformer_pointer_chasing():
+    @pw.transformer
+    class follower:
+        class sources:
+            target = pw.input_attribute()
+
+            @pw.output_attribute
+            def target_val(self):
+                return self.transformer().values[self.target].v
+
+        class values:
+            v = pw.input_attribute()
+
+    values = pw.debug.table_from_markdown(
+        """
+        v
+        10
+        20
+        """
+    )
+    keys = list(
+        GraphRunner().run_tables(values)[0].state.rows.keys()
+    )
+    sources = pw.debug.table_from_markdown(
+        """
+        i
+        0
+        1
+        """
+    )
+    sources = sources.select(
+        target=pw.apply_with_type(lambda i: keys[i], pw.Pointer, pw.this.i)
+    )
+    out = follower(sources=sources, values=values).sources
+    got = sorted(r[0] for r in _rows(out))
+    assert got == [10, 20]
+
+
+def test_viz_table_to_pandas():
+    from pathway_tpu.stdlib.viz import table_to_pandas
+
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    df = table_to_pandas(t)
+    assert sorted(df["a"].tolist()) == [1, 2]
+    assert set(df.columns) == {"a", "b"}
