@@ -72,6 +72,10 @@ from pathway_tpu.internals.config import (  # noqa: E402
 )
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.error_log import (  # noqa: E402
+    global_error_log,
+    remove_errors_from_table,
+)
 from pathway_tpu.internals.interactive import (  # noqa: E402
     enable_interactive_mode,
     live,

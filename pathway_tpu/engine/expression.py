@@ -363,7 +363,11 @@ def _compile_apply(e: expr.ApplyExpression, resolver, runtime) -> EvalFn:
                 continue
             try:
                 out.append(fun(*args, **kwargs))
-            except Exception:
+            except Exception as exc:
+                if runtime is not None:
+                    runtime.log_data_error(
+                        f"{type(exc).__name__}: {exc}", keys[i]
+                    )
                 out.append(ERROR)
         return out
 

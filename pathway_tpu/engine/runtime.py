@@ -57,6 +57,11 @@ class Runtime:
         self.error: Exception | None = None
         self._async_loop = None
         self.current_trace = None
+        # per-row data errors (reference: ErrorLog, dataflow.rs:551;
+        # Graph::error_log graph.rs:983): rows poison to Error values and
+        # the message lands in the global error-log table
+        self.error_log_node = None
+        self._error_log_seq = 0
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
@@ -278,3 +283,15 @@ class Runtime:
         if self.terminate_on_error:
             raise exc
         self.error = exc
+
+    def log_data_error(self, message: str, key=None) -> None:
+        if self.error_log_node is None:
+            return
+        from pathway_tpu.internals.api import ref_scalar
+
+        self._error_log_seq += 1
+        row_key = ref_scalar("error_log", self._error_log_seq)
+        deltas = [(row_key, (message, repr(key)), 1)]
+        # deliver at the next timestamp so the erroring batch finishes first
+        t = self.clock + 1
+        self.error_log_node.accept(t, 0, deltas)

@@ -18,18 +18,21 @@ class LiveTableHandle:
     def __init__(self, table):
         self.table = table
         self._rows: dict = {}
+        self._lock = threading.Lock()
         import pathway_tpu as pw
 
         def on_change(key, row, time_, is_addition):
-            if is_addition:
-                self._rows[key] = row
-            else:
-                self._rows.pop(key, None)
+            with self._lock:
+                if is_addition:
+                    self._rows[key] = row
+                else:
+                    self._rows.pop(key, None)
 
         pw.io.subscribe(self.table, on_change=on_change)
 
     def snapshot(self) -> list[dict]:
-        return list(self._rows.values())
+        with self._lock:
+            return list(self._rows.values())
 
     def __repr__(self):
         cols = self.table.column_names()
@@ -55,11 +58,12 @@ def live(table) -> LiveTableHandle:
     return LiveTableHandle(table)
 
 
-def start() -> threading.Thread:
+def start(**run_kwargs) -> threading.Thread:
     import pathway_tpu as pw
 
     t = threading.Thread(
-        target=lambda: pw.run(_interactive_bypass=True), daemon=True
+        target=lambda: pw.run(_interactive_bypass=True, **run_kwargs),
+        daemon=True,
     )
     t.start()
     _state["thread"] = t

@@ -107,6 +107,22 @@ def _bucket(n: int, floor: int, cap: int) -> int:
     return min(b, cap)
 
 
+def pad_batch(ids: np.ndarray, mask: np.ndarray, max_len: int, batch_cap: int):
+    """Pad (ids, mask) to the bounded pow2 (batch, seq) shape set jit
+    relies on. Returns (ids_p, mask_p, n_valid_rows)."""
+    n, L = ids.shape
+    Lb = _bucket(L, 16, max_len)
+    nb = _bucket(n, 8, batch_cap)
+    if n > nb:
+        raise ValueError(f"batch of {n} exceeds batch capacity {batch_cap}")
+    ids_p = np.zeros((nb, Lb), np.int32)
+    mask_p = np.zeros((nb, Lb), np.int32)
+    L_eff = min(L, Lb)
+    ids_p[:n, :L_eff] = ids[:, :L_eff]
+    mask_p[:n, :L_eff] = mask[:, :L_eff]
+    return ids_p, mask_p, n
+
+
 class SentenceEncoder:
     """Host-facing batched encoder: list[str] -> np.ndarray [n, hidden]."""
 
@@ -158,32 +174,17 @@ class SentenceEncoder:
         consumers (e.g. KnnShard.add) avoids the host round-trip and lets
         host tokenization of the next batch overlap device compute."""
         texts = list(texts)
-        if len(texts) > self.batch_size:
-            raise ValueError(
-                f"encode_device takes at most batch_size={self.batch_size} texts"
-            )
         ids, mask = self.tokenizer(texts)
-        n, L = ids.shape
-        Lb = _bucket(L, 16, self.config.max_len)
-        nb = _bucket(n, 8, self.batch_size)
-        ids_p = np.zeros((nb, Lb), np.int32)
-        mask_p = np.zeros((nb, Lb), np.int32)
-        L_eff = min(L, Lb)
-        ids_p[:n, :L_eff] = ids[:, :L_eff]
-        mask_p[:n, :L_eff] = mask[:, :L_eff]
+        ids_p, mask_p, n = pad_batch(
+            ids, mask, self.config.max_len, self.batch_size
+        )
         emb = self._forward(self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
         return emb[:n]
 
     def _encode_batch(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
-        n, L = ids.shape
-        # pad to a bounded (batch, seq) shape set: pow2 buckets
-        Lb = _bucket(L, 16, self.config.max_len)
-        nb = _bucket(n, 8, self.batch_size)
-        ids_p = np.zeros((nb, Lb), np.int32)
-        mask_p = np.zeros((nb, Lb), np.int32)
-        L_eff = min(L, Lb)
-        ids_p[:n, :L_eff] = ids[:, :L_eff]
-        mask_p[:n, :L_eff] = mask[:, :L_eff]
+        ids_p, mask_p, n = pad_batch(
+            ids, mask, self.config.max_len, self.batch_size
+        )
         emb = self._forward(self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
         return np.asarray(emb[:n], np.float32)
 

@@ -1,0 +1,95 @@
+"""Fused serving-path query engine: tokenize -> encode -> top-k in ONE
+XLA executable with ONE packed result readback.
+
+Latency budget (SURVEY §7 hard part 6): per-query cost is dominated by
+dispatch + result readback, not FLOPs — so the whole path (encoder forward
++ fused matmul/top-k over the index shard) compiles into a single
+executable, and scores+indices pack into one f32 buffer so the host pays
+exactly one device-to-host transfer per query batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.ops.topk import chunked_topk_scores
+
+
+class QueryEngine:
+    """encode+search for a SentenceEncoder + KnnShard pair. The jitted
+    executable is owned by the engine instance, so dropping the engine
+    releases the model params and compiled closures."""
+
+    def __init__(self, encoder, shard, *, k: int = 6):
+        self.encoder = encoder
+        self.shard = shard
+        self.k = k
+        model = encoder.model
+        chunk = shard.chunk
+        precision = shard.precision
+        k_eff = min(k, shard.chunk)
+
+        @jax.jit
+        def run(params, ids, mask, vectors, valid):
+            emb = model.apply({"params": params}, ids, mask)  # [q,d] unit
+            vals, idx = chunked_topk_scores(
+                emb, vectors, valid, k_eff, chunk=chunk, metric="dot",
+                precision=precision,
+            )
+            # pack scores and indices into ONE buffer: a single readback
+            return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=1)
+
+        self._fn = run
+
+    def query(self, texts: Sequence[str]) -> list[list[tuple[Any, float]]]:
+        texts = list(texts)
+        if not texts or not self.shard.key_to_slot:
+            return [[] for _ in texts]
+        out: list[list[tuple[Any, float]]] = []
+        cap = self.encoder.batch_size
+        for start in range(0, len(texts), cap):
+            out.extend(self._query_batch(texts[start : start + cap]))
+        return out
+
+    def _query_batch(self, texts: list[str]):
+        from pathway_tpu.models.encoder import pad_batch
+
+        ids, mask = self.encoder.tokenizer(texts)
+        ids_p, mask_p, n = pad_batch(
+            ids, mask, self.encoder.config.max_len, self.encoder.batch_size
+        )
+        # f32 packing is exact for slot ids < 2^24 (16.7M rows/shard);
+        # larger shards must fall back to the two-buffer path
+        assert self.shard.capacity < (1 << 24), (
+            "QueryEngine packed readback supports shards < 16.7M rows"
+        )
+        k_eff = min(self.k, self.shard.chunk)
+        packed = self._fn(
+            self.encoder.params,
+            jnp.asarray(ids_p),
+            jnp.asarray(mask_p),
+            self.shard.vectors,
+            self.shard.valid,
+        )
+        packed = np.asarray(packed)[:n]  # the ONE readback
+        vals = packed[:, :k_eff]
+        idx = packed[:, k_eff:].astype(np.int64)
+        out = []
+        for qi in range(n):
+            hits = []
+            for vv, slot in zip(vals[qi], idx[qi]):
+                if not np.isfinite(vv):
+                    continue
+                key = self.shard.slot_to_key.get(int(slot))
+                if key is None:
+                    continue
+                hits.append((key, float(vv)))
+                if len(hits) == self.k:
+                    break
+            out.append(hits)
+        return out

@@ -24,7 +24,13 @@ def run(
     )
 
     if interactive_mode_enabled() and not _interactive_bypass:
-        _interactive_start()
+        _interactive_start(
+            persistence_config=persistence_config,
+            terminate_on_error=terminate_on_error,
+            monitoring_level=monitoring_level,
+            with_http_server=with_http_server,
+            **kwargs,
+        )
         return
     GraphRunner(
         terminate_on_error=terminate_on_error,

@@ -1,7 +1,7 @@
 """pw.ml (reference: python/pathway/stdlib/ml/ — LSH KNN index,
 classifiers, smart_table_ops)."""
 
-from pathway_tpu.stdlib.ml import classifiers
+from pathway_tpu.stdlib.ml import classifiers, hmm, smart_table_ops
 from pathway_tpu.stdlib.ml.index import KNNIndex
 
-__all__ = ["KNNIndex", "classifiers"]
+__all__ = ["KNNIndex", "classifiers", "hmm", "smart_table_ops"]

@@ -1,0 +1,60 @@
+"""Incremental HMM decoding (reference: python/pathway/stdlib/ml/hmm.py:210
+— create_hmm_reducer over a networkx DiGraph whose nodes carry
+`calc_emission_log_ppb` and edges `log_transition_ppb`; used inside
+windowby/reduce to maintain the decoded state as observations stream in)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.reducers import Reducer, _entries
+
+
+def create_hmm_reducer(
+    graph,
+    beam_size: int | None = None,
+    num_results_kept: int | None = None,
+):
+    """Returns a reducer decoding the most likely CURRENT state via Viterbi
+    over the group's observations in arrival order."""
+    states = list(graph.nodes)
+    emission = {s: graph.nodes[s]["calc_emission_log_ppb"] for s in states}
+    transitions: dict[Any, list[tuple[Any, float]]] = {s: [] for s in states}
+    for u, v, data in graph.edges(data=True):
+        transitions[u].append((v, data["log_transition_ppb"]))
+
+    def factory(**kw):
+        def fn(ms, slot):
+            pairs = [
+                (combo[-2], combo[0])
+                for combo, count in _entries(ms, slot)
+                for _ in range(max(count, 0))
+            ]
+            try:
+                obs = sorted(pairs, key=lambda t: t[0])
+            except TypeError:  # mixed-type order tokens
+                obs = sorted(pairs, key=lambda t: repr(t[0]))
+            if not obs:
+                return None
+            # Viterbi with optional beam pruning
+            scores = {s: emission[s](obs[0][1]) for s in states}
+            for _, observation in obs[1:]:
+                nxt: dict[Any, float] = {}
+                for s, sc in scores.items():
+                    for t, logp in transitions[s]:
+                        cand = sc + logp + emission[t](observation)
+                        if t not in nxt or cand > nxt[t]:
+                            nxt[t] = cand
+                if beam_size is not None and len(nxt) > beam_size:
+                    keep = sorted(nxt, key=nxt.get, reverse=True)[:beam_size]
+                    nxt = {s: nxt[s] for s in keep}
+                scores = nxt or {
+                    s: float("-inf") for s in states
+                }
+            return max(scores, key=scores.get)
+
+        return fn
+
+    return Reducer("hmm", factory, lambda ts: dt.ANY)

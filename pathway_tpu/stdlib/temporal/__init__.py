@@ -27,6 +27,7 @@ from pathway_tpu.stdlib.temporal._interval_join import (
 )
 from pathway_tpu.stdlib.temporal._window import (
     Window,
+    intervals_over,
     session,
     sliding,
     tumbling,
@@ -71,6 +72,7 @@ __all__ = [
     "exactly_once_behavior",
     "inactivity_detection",
     "interval",
+    "intervals_over",
     "interval_join",
     "interval_join_inner",
     "interval_join_left",

@@ -233,6 +233,57 @@ def _default_origin_for(key):
     return 0
 
 
+@dataclasses.dataclass
+class _IntervalsOverWindow(Window):
+    """One window per row of `at`, spanning [at+lower, at+upper]
+    (reference: _window.py:515 — built on interval_join)."""
+
+    at: Any
+    lower_bound: Any
+    upper_bound: Any
+    is_outer: bool
+
+    def _apply(self, table, key, behavior, instance):
+        from pathway_tpu.stdlib.temporal._interval_join import interval, interval_join
+
+        if behavior is not None:
+            raise NotImplementedError(
+                "behaviors are not supported for intervals_over windows"
+            )
+        at = self.at
+        at_table = at.table
+        if at_table is table:
+            at_table = at_table.copy()
+            at = at_table[at.name]
+        inst_expr = (
+            expr_mod.smart_coerce(instance)
+            if instance is not None
+            else expr_mod.ColumnConstExpression(None)
+        )
+        joined = interval_join(
+            at_table,
+            table,
+            at,
+            key,
+            interval(self.lower_bound, self.upper_bound),
+            how="left" if self.is_outer else "inner",
+        ).select(
+            _pw_window=at_table[at.name],
+            _pw_window_start=at_table[at.name] + self.lower_bound,
+            _pw_window_end=at_table[at.name] + self.upper_bound,
+            _pw_instance=inst_expr,
+            _pw_key=key,
+            *table,
+        )
+        return joined.groupby(
+            joined["_pw_window"],
+            joined["_pw_window_start"],
+            joined["_pw_window_end"],
+            joined["_pw_instance"],
+            sort_by=joined["_pw_key"],
+        )
+
+
 # -- public constructors (reference: _window.py:595-865) -------------------
 
 
@@ -258,6 +309,14 @@ def sliding(hop, duration=None, ratio: int | None = None, origin=None) -> Window
 def tumbling(duration, origin=None) -> Window:
     """Non-overlapping windows of length `duration`."""
     return _SlidingWindow(hop=duration, duration=duration, origin=origin, ratio=None)
+
+
+def intervals_over(*, at, lower_bound, upper_bound, is_outer: bool = True) -> Window:
+    """A window per row of `at` covering [at+lower_bound, at+upper_bound]
+    (reference: _window.py:795)."""
+    return _IntervalsOverWindow(
+        at=at, lower_bound=lower_bound, upper_bound=upper_bound, is_outer=is_outer
+    )
 
 
 def windowby(table, time_expr, *, window: Window, behavior=None, instance=None):

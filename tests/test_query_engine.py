@@ -1,0 +1,39 @@
+"""Fused QueryEngine correctness vs the two-step encode+search path."""
+
+import numpy as np
+
+from pathway_tpu.models import EncoderConfig, SentenceEncoder
+from pathway_tpu.ops import KnnShard, QueryEngine
+
+
+def test_query_engine_matches_two_step():
+    enc = SentenceEncoder(EncoderConfig.tiny(), batch_size=16)
+    docs = [
+        "the cat sat on the mat",
+        "dogs are loyal pets",
+        "quantum computing with qubits",
+        "a feline rested on a rug",
+    ]
+    embs = enc.encode(docs)
+    shard = KnnShard(enc.embed_dim, "cos")
+    shard.add(list(range(len(docs))), embs)
+
+    engine = QueryEngine(enc, shard, k=2)
+    queries = ["cat on a mat", "qubit computer"]
+    fused = engine.query(queries)
+
+    q_emb = enc.encode(queries)
+    two_step = shard.search(q_emb, 2)
+
+    for f, t in zip(fused, two_step):
+        assert [k for k, _ in f] == [k for k, _ in t]
+        np.testing.assert_allclose(
+            [s for _, s in f], [s for _, s in t], rtol=1e-3, atol=1e-3
+        )
+
+
+def test_query_engine_empty_index():
+    enc = SentenceEncoder(EncoderConfig.tiny())
+    shard = KnnShard(enc.embed_dim, "cos")
+    engine = QueryEngine(enc, shard, k=3)
+    assert engine.query(["anything"]) == [[]]

@@ -1,0 +1,135 @@
+"""intervals_over window, fuzzy join, HMM reducer, error log tests."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(captures[0].state.rows.values(), key=repr)
+
+
+def test_intervals_over_window():
+    data = pw.debug.table_from_markdown(
+        """
+        t | v
+        1 | 10
+        3 | 30
+        6 | 60
+        """
+    )
+    probes = pw.debug.table_from_markdown(
+        """
+        pt
+        2
+        6
+        """
+    )
+    res = pw.temporal.windowby(
+        data,
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.pt, lower_bound=-2, upper_bound=0
+        ),
+    ).reduce(
+        loc=pw.this._pw_window,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    got = {r[0]: r[1] for r in _rows(res)}
+    # window [pt-2, pt]: pt=2 covers t=1 (10); pt=6 covers t=6 (60)
+    assert got == {2: 10, 6: 60}
+
+
+def test_fuzzy_match_tables():
+    left = pw.debug.table_from_markdown(
+        """
+        name
+        Johnny Smith
+        Alice Jones
+        """
+    )
+    right = pw.debug.table_from_markdown(
+        """
+        fullname
+        smith johnny
+        jones alice
+        """
+    )
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    matches = fuzzy_match_tables(left, right)
+    rows = _rows(matches.select(pw.this.weight))
+    assert len(rows) == 2
+    assert all(w > 0 for (w,) in rows)
+    # verify correct pairing via joined names
+    joined = matches.join(left, matches.left_id == left.id).select(
+        name=left.name, rid=matches.right_id
+    )
+    joined = joined.join(right, joined.rid == right.id).select(
+        joined.name, right.fullname
+    )
+    pairs = dict(_rows(joined))
+    assert pairs["Johnny Smith"] == "smith johnny"
+    assert pairs["Alice Jones"] == "jones alice"
+
+
+def test_hmm_reducer():
+    import networkx as nx
+
+    g = nx.DiGraph()
+    g.add_node("HUNGRY", calc_emission_log_ppb=lambda o: np.log(0.9) if o == "GRUMPY" else np.log(0.1))
+    g.add_node("FULL", calc_emission_log_ppb=lambda o: np.log(0.3) if o == "GRUMPY" else np.log(0.7))
+    for u in ("HUNGRY", "FULL"):
+        for v in ("HUNGRY", "FULL"):
+            g.add_edge(u, v, log_transition_ppb=np.log(0.5))
+
+    t = pw.debug.table_from_markdown(
+        """
+        seq | obs
+        1   | GRUMPY
+        2   | GRUMPY
+        3   | HAPPY
+        """
+    )
+    from pathway_tpu.stdlib.ml.hmm import create_hmm_reducer
+
+    hmm = create_hmm_reducer(g)
+    res = t.groupby(sort_by=pw.this.seq).reduce(state=hmm(pw.this.obs))
+    # last observation HAPPY dominates -> FULL
+    assert _rows(res) == [("FULL",)]
+
+
+def test_global_error_log_and_remove_errors():
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(v=1)
+            self.next(v=0)
+            self.commit()
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.io.python.read(Subj(), schema=S, autocommit_duration_ms=None)
+
+    def inv(v):
+        return 10 // v  # v=0 raises
+
+    out = t.select(r=pw.apply_with_type(inv, int, pw.this.v))
+    clean = pw.remove_errors_from_table(out)
+    log = pw.global_error_log()
+
+    clean_rows = []
+    log_rows = []
+    pw.io.subscribe(
+        clean,
+        on_change=lambda key, row, time, is_addition: clean_rows.append(row["r"]),
+    )
+    pw.io.subscribe(
+        log,
+        on_change=lambda key, row, time, is_addition: log_rows.append(row["message"]),
+    )
+    pw.run()
+    assert clean_rows == [10]
+    assert len(log_rows) == 1 and "ZeroDivisionError" in log_rows[0]

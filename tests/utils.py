@@ -76,3 +76,68 @@ def run_table(t: pw.Table) -> dict:
 def run_update_stream(t: pw.Table) -> list:
     [cap] = GraphRunner().run_tables(t)
     return list(cap.updates)
+
+
+def wait_result_with_checker(
+    checker,
+    timeout: float = 30,
+    *,
+    target=None,
+    step: float = 0.1,
+):
+    """Streaming-test fixture (reference: tests/utils.py:599 — run the
+    pipeline on a thread and poll `checker()` until it holds or timeout).
+    `target` defaults to pw.run."""
+    import threading
+    import time
+
+    error: list = []
+
+    def guarded():
+        try:
+            (target or pw.run)()
+        except Exception as exc:  # surfaced in the final assertion
+            error.append(exc)
+
+    runner = threading.Thread(target=guarded, daemon=True)
+    runner.start()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if error:
+            raise AssertionError(f"pipeline failed: {error[0]!r}") from error[0]
+        try:
+            if checker():
+                return True
+        except Exception:
+            pass
+        time.sleep(step)
+    detail = f"; pipeline error: {error[0]!r}" if error else ""
+    raise AssertionError(
+        f"checker {checker!r} did not pass in {timeout}s{detail}"
+    )
+
+
+class FileLinesNumberChecker:
+    """reference: tests/utils.py FileLinesNumberChecker."""
+
+    def __init__(self, path, n_lines: int):
+        self.path = path
+        self.n_lines = n_lines
+
+    def __call__(self) -> bool:
+        try:
+            with open(self.path) as f:
+                return sum(1 for _ in f) >= self.n_lines
+        except FileNotFoundError:
+            return False
+
+
+class CsvLinesNumberChecker(FileLinesNumberChecker):
+    """reference: tests/utils.py CsvLinesNumberChecker (header excluded)."""
+
+    def __call__(self) -> bool:
+        try:
+            with open(self.path) as f:
+                return sum(1 for _ in f) - 1 >= self.n_lines
+        except FileNotFoundError:
+            return False
