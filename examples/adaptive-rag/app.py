@@ -1,0 +1,41 @@
+"""Adaptive RAG template (reference: templates/adaptive-rag — dynamic-k
+retrieval with geometric context growth + optional cross-encoder
+reranking). Offline-capable via mocks; see app.yaml."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+)
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+
+def run(config_path: str | None = None):
+    config_path = config_path or os.path.join(
+        os.path.dirname(__file__), "app.yaml"
+    )
+    with open(config_path) as f:
+        cfg = pw.load_yaml(f)
+
+    docs = pw.io.fs.read(
+        cfg["docs_path"], format="binary", with_metadata=True,
+        mode="streaming", autocommit_duration_ms=100,
+    )
+    store = VectorStoreServer(docs, embedder=cfg["embedder"])
+    rag = AdaptiveRAGQuestionAnswerer(
+        llm=cfg["llm"],
+        indexer=store,
+        n_starting_documents=cfg.get("n_starting_documents", 2),
+        factor=cfg.get("factor", 2),
+        max_iterations=cfg.get("max_iterations", 3),
+    )
+    rag.build_server(host=cfg["host"], port=cfg["port"])
+    pw.run()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else None)

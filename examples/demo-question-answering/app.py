@@ -1,0 +1,44 @@
+"""demo-question-answering template (reference:
+docs/2.developers/6.ai-pipelines + templates/demo-question-answering):
+YAML-configured RAG service — documents folder -> vector store -> REST QA.
+
+Run: python app.py  (serves on the configured host/port)
+The default app.yaml uses deterministic mocks so it runs offline; swap the
+embedder/llm entries for OpenAI/SentenceTransformer classes in production.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+
+def run(config_path: str | None = None):
+    config_path = config_path or os.path.join(
+        os.path.dirname(__file__), "app.yaml"
+    )
+    with open(config_path) as f:
+        cfg = pw.load_yaml(f)
+
+    docs = pw.io.fs.read(
+        cfg["docs_path"], format="binary", with_metadata=True,
+        mode="streaming", autocommit_duration_ms=100,
+    )
+    store = VectorStoreServer(
+        docs,
+        embedder=cfg["embedder"],
+        splitter=cfg["splitter"].func if hasattr(cfg["splitter"], "func") else cfg["splitter"],
+    )
+    rag = BaseRAGQuestionAnswerer(
+        llm=cfg["llm"], indexer=store, search_topk=cfg.get("search_topk", 6)
+    )
+    rag.build_server(host=cfg["host"], port=cfg["port"])
+    pw.run()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else None)

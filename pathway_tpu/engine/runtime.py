@@ -62,6 +62,7 @@ class Runtime:
         # the message lands in the global error-log table
         self.error_log_node = None
         self._error_log_seq = 0
+        self._error_log_seen: set = set()
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
@@ -287,6 +288,14 @@ class Runtime:
     def log_data_error(self, message: str, key=None) -> None:
         if self.error_log_node is None:
             return
+        # one entry per (row, message): retraction replays and upsert
+        # re-evaluations re-raise the same exception and must not grow the
+        # log unboundedly (bounded memo, drop-dedupe past the cap)
+        ident = (key, message)
+        if ident in self._error_log_seen:
+            return
+        if len(self._error_log_seen) < 100_000:
+            self._error_log_seen.add(ident)
         from pathway_tpu.internals.api import ref_scalar
 
         self._error_log_seq += 1

@@ -63,11 +63,15 @@ def _count_factory(**kw):
 
 def _sum_factory(**kw):
     def fn(ms, slot):
+        # None entries are skipped (outer temporal windows pad unmatched
+        # rows with None); an all-None group sums to None
         total = None
         for args, count in _entries(ms, slot):
             v = args[0]
             if v is ERROR:
                 return ERROR
+            if v is None:
+                continue
             contrib = v * count
             total = contrib if total is None else total + contrib
         return total
@@ -80,7 +84,8 @@ def _min_factory(**kw):
         vals = [args[0] for args, _ in _entries(ms, slot)]
         if builtins.any(v is ERROR for v in vals):
             return ERROR
-        return builtins.min(vals)
+        vals = [v for v in vals if v is not None]
+        return builtins.min(vals) if vals else None
 
     return fn
 
@@ -90,7 +95,8 @@ def _max_factory(**kw):
         vals = [args[0] for args, _ in _entries(ms, slot)]
         if builtins.any(v is ERROR for v in vals):
             return ERROR
-        return builtins.max(vals)
+        vals = [v for v in vals if v is not None]
+        return builtins.max(vals) if vals else None
 
     return fn
 
@@ -135,6 +141,8 @@ def _avg_factory(**kw):
         for args, count in _entries(ms, slot):
             if args[0] is ERROR:
                 return ERROR
+            if args[0] is None:
+                continue
             total += args[0] * count
             n += count
         return total / n if n else None

@@ -33,12 +33,19 @@ class QueryEngine:
         chunk = shard.chunk
         precision = shard.precision
         k_eff = min(k, shard.chunk)
+        from pathway_tpu.ops.knn import Metric
+
+        # encoder outputs are L2-normalized, so cos == dot on the query
+        # side; l2sq shards score with their cached squared norms
+        metric = "l2sq" if shard.metric is Metric.L2SQ else "dot"
+        use_sq = metric == "l2sq"
 
         @jax.jit
-        def run(params, ids, mask, vectors, valid):
+        def run(params, ids, mask, vectors, valid, sq_norms):
             emb = model.apply({"params": params}, ids, mask)  # [q,d] unit
             vals, idx = chunked_topk_scores(
-                emb, vectors, valid, k_eff, chunk=chunk, metric="dot",
+                emb, vectors, valid, k_eff, chunk=chunk, metric=metric,
+                sq_norms=sq_norms if use_sq else None,
                 precision=precision,
             )
             # pack scores and indices into ONE buffer: a single readback
@@ -65,9 +72,10 @@ class QueryEngine:
         )
         # f32 packing is exact for slot ids < 2^24 (16.7M rows/shard);
         # larger shards must fall back to the two-buffer path
-        assert self.shard.capacity < (1 << 24), (
-            "QueryEngine packed readback supports shards < 16.7M rows"
-        )
+        if self.shard.capacity >= (1 << 24):
+            raise ValueError(
+                "QueryEngine packed readback supports shards < 16.7M rows"
+            )
         k_eff = min(self.k, self.shard.chunk)
         packed = self._fn(
             self.encoder.params,
@@ -75,6 +83,7 @@ class QueryEngine:
             jnp.asarray(mask_p),
             self.shard.vectors,
             self.shard.valid,
+            self.shard.sq_norms,
         )
         packed = np.asarray(packed)[:n]  # the ONE readback
         vals = packed[:, :k_eff]

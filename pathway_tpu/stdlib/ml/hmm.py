@@ -38,22 +38,39 @@ def create_hmm_reducer(
                 obs = sorted(pairs, key=lambda t: repr(t[0]))
             if not obs:
                 return None
-            # Viterbi with optional beam pruning
+            # Viterbi with backpointers and optional beam pruning
             scores = {s: emission[s](obs[0][1]) for s in states}
+            back: list[dict[Any, Any]] = []
             for _, observation in obs[1:]:
                 nxt: dict[Any, float] = {}
+                prev: dict[Any, Any] = {}
                 for s, sc in scores.items():
                     for t, logp in transitions[s]:
                         cand = sc + logp + emission[t](observation)
                         if t not in nxt or cand > nxt[t]:
                             nxt[t] = cand
+                            prev[t] = s
                 if beam_size is not None and len(nxt) > beam_size:
                     keep = sorted(nxt, key=nxt.get, reverse=True)[:beam_size]
                     nxt = {s: nxt[s] for s in keep}
-                scores = nxt or {
-                    s: float("-inf") for s in states
-                }
-            return max(scores, key=scores.get)
+                    prev = {s: prev[s] for s in keep}
+                if not nxt:
+                    nxt = {s: float("-inf") for s in states}
+                    prev = {s: s for s in states}
+                scores = nxt
+                back.append(prev)
+            current = max(scores, key=scores.get)
+            if num_results_kept is None:
+                return current
+            # decode the tail of the most likely path (reference:
+            # num_results_kept — keep the last N decoded states)
+            path = [current]
+            s = current
+            for prev in reversed(back):
+                s = prev.get(s, s)
+                path.append(s)
+            path.reverse()
+            return tuple(path[-num_results_kept:])
 
         return fn
 

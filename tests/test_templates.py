@@ -1,0 +1,76 @@
+"""Template end-to-end tests: the demo-question-answering and adaptive-rag
+example apps serve real HTTP with mock models (BASELINE.json configs 3-4)."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _write_config(tmp_path, template: str, port: int) -> str:
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "knowledge.txt").write_text(
+        "pathway tpu is a streaming dataflow framework with native "
+        "tpu retrieval and incremental consistency"
+    )
+    src = os.path.join("examples", template, "app.yaml")
+    cfg = open(src).read()
+    cfg = cfg.replace("./docs", str(docs))
+    cfg = cfg.replace("port: 8000", f"port: {port}")
+    cfg = cfg.replace("port: 8001", f"port: {port}")
+    out = tmp_path / "app.yaml"
+    out.write_text(cfg)
+    return str(out)
+
+
+def test_demo_question_answering_template(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join("examples", "demo-question-answering"))
+    import importlib
+
+    app = importlib.import_module("app")
+    config = _write_config(tmp_path, "demo-question-answering", 8951)
+    threading.Thread(target=app.run, args=(config,), daemon=True).start()
+    time.sleep(2.0)
+    out = _post(
+        "http://127.0.0.1:8951/v2/answer",
+        {"prompt": "what is pathway tpu"},
+    )
+    assert "streaming dataflow framework" in out["response"]
+    sys.path.pop(0)
+    del sys.modules["app"]
+
+
+def test_adaptive_rag_template(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join("examples", "adaptive-rag"))
+    import importlib
+
+    app = importlib.import_module("app")
+    config = _write_config(tmp_path, "adaptive-rag", 8952)
+    threading.Thread(target=app.run, args=(config,), daemon=True).start()
+    time.sleep(2.0)
+    out = _post(
+        "http://127.0.0.1:8952/v2/answer",
+        {"prompt": "pathway tpu streaming dataflow framework"},
+    )
+    assert out["response"] is not None
+    sys.path.pop(0)
+    del sys.modules["app"]
