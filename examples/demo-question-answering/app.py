@@ -31,7 +31,7 @@ def run(config_path: str | None = None):
     store = VectorStoreServer(
         docs,
         embedder=cfg["embedder"],
-        splitter=cfg["splitter"].func if hasattr(cfg["splitter"], "func") else cfg["splitter"],
+        splitter=cfg.get("splitter"),
     )
     rag = BaseRAGQuestionAnswerer(
         llm=cfg["llm"], indexer=store, search_topk=cfg.get("search_topk", 6)

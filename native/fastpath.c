@@ -1,0 +1,354 @@
+/* Native engine fast path — CPython C-API implementations of the
+ * per-row hot loops of the incremental engine (profiling: freeze_row,
+ * consolidate and key-byte building dominate the Python engine's
+ * wordcount profile). The reference keeps these loops in Rust
+ * (src/engine/dataflow.rs arrangements, value.rs key hashing); here they
+ * are a C extension bound through pathway_tpu.native.
+ *
+ * Exposed functions:
+ *   consolidate(deltas)        -> list[(key,row,diff)] summed, zero-dropped
+ *   freeze_rows(rows)          -> list of hashable stand-ins (fast path:
+ *                                 row already hashable -> returned as-is)
+ *   value_bytes(args_tuple)    -> bytes — the injective length-prefixed
+ *                                 serialization behind ref_scalar
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* -- helpers ----------------------------------------------------------- */
+
+static PyObject *freeze_value_py = NULL; /* python fallback for exotic values */
+
+static PyObject *
+freeze_one(PyObject *v)
+{
+    /* fast path: hashable scalars pass through unchanged */
+    Py_hash_t h = PyObject_Hash(v);
+    if (h != -1 || !PyErr_Occurred()) {
+        Py_INCREF(v);
+        return v;
+    }
+    PyErr_Clear();
+    if (freeze_value_py == NULL) {
+        PyObject *mod = PyImport_ImportModule("pathway_tpu.engine.stream");
+        if (mod == NULL)
+            return NULL;
+        freeze_value_py = PyObject_GetAttrString(mod, "freeze_value");
+        Py_DECREF(mod);
+        if (freeze_value_py == NULL)
+            return NULL;
+    }
+    return PyObject_CallOneArg(freeze_value_py, v);
+}
+
+static PyObject *
+freeze_row_c(PyObject *row)
+{
+    Py_hash_t h = PyObject_Hash(row);
+    if (h != -1 || !PyErr_Occurred()) {
+        Py_INCREF(row);
+        return row;
+    }
+    PyErr_Clear();
+    if (!PyTuple_Check(row)) {
+        return freeze_one(row);
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(row);
+    PyObject *out = PyTuple_New(n);
+    if (out == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *fv = freeze_one(PyTuple_GET_ITEM(row, i));
+        if (fv == NULL) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyTuple_SET_ITEM(out, i, fv);
+    }
+    return out;
+}
+
+/* -- consolidate -------------------------------------------------------- */
+
+static PyObject *
+fast_consolidate(PyObject *self, PyObject *arg)
+{
+    PyObject *seq = PySequence_Fast(arg, "consolidate expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    /* ident(key, frozen_row) -> [key, row, diff] */
+    PyObject *acc = PyDict_New();
+    PyObject *order = PyList_New(0); /* deterministic output order */
+    if (acc == NULL || order == NULL)
+        goto fail;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *delta = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(delta) || PyTuple_GET_SIZE(delta) != 3) {
+            PyErr_SetString(PyExc_TypeError,
+                            "delta must be (key, row, diff)");
+            goto fail;
+        }
+        PyObject *key = PyTuple_GET_ITEM(delta, 0);
+        PyObject *row = PyTuple_GET_ITEM(delta, 1);
+        PyObject *diff = PyTuple_GET_ITEM(delta, 2);
+
+        PyObject *frow = freeze_row_c(row);
+        if (frow == NULL)
+            goto fail;
+        PyObject *ident = PyTuple_Pack(2, key, frow);
+        Py_DECREF(frow);
+        if (ident == NULL)
+            goto fail;
+
+        PyObject *slot = PyDict_GetItemWithError(acc, ident);
+        if (slot == NULL && PyErr_Occurred()) {
+            Py_DECREF(ident);
+            goto fail;
+        }
+        if (slot == NULL) {
+            slot = PyList_New(3);
+            if (slot == NULL) {
+                Py_DECREF(ident);
+                goto fail;
+            }
+            Py_INCREF(key);
+            PyList_SET_ITEM(slot, 0, key);
+            Py_INCREF(row);
+            PyList_SET_ITEM(slot, 1, row);
+            Py_INCREF(diff);
+            PyList_SET_ITEM(slot, 2, diff);
+            if (PyDict_SetItem(acc, ident, slot) < 0 ||
+                PyList_Append(order, slot) < 0) {
+                Py_DECREF(slot);
+                Py_DECREF(ident);
+                goto fail;
+            }
+            Py_DECREF(slot);
+        } else {
+            PyObject *cur = PyList_GET_ITEM(slot, 2);
+            PyObject *sum = PyNumber_Add(cur, diff);
+            if (sum == NULL) {
+                Py_DECREF(ident);
+                goto fail;
+            }
+            PyList_SetItem(slot, 2, sum); /* steals sum */
+        }
+        Py_DECREF(ident);
+    }
+
+    PyObject *result = PyList_New(0);
+    if (result == NULL)
+        goto fail;
+    Py_ssize_t m = PyList_GET_SIZE(order);
+    for (Py_ssize_t i = 0; i < m; i++) {
+        PyObject *slot = PyList_GET_ITEM(order, i);
+        PyObject *diff = PyList_GET_ITEM(slot, 2);
+        int nz = PyObject_IsTrue(diff);
+        if (nz < 0) {
+            Py_DECREF(result);
+            goto fail;
+        }
+        if (nz) {
+            PyObject *t = PyTuple_Pack(3, PyList_GET_ITEM(slot, 0),
+                                       PyList_GET_ITEM(slot, 1), diff);
+            if (t == NULL || PyList_Append(result, t) < 0) {
+                Py_XDECREF(t);
+                Py_DECREF(result);
+                goto fail;
+            }
+            Py_DECREF(t);
+        }
+    }
+    Py_DECREF(acc);
+    Py_DECREF(order);
+    Py_DECREF(seq);
+    return result;
+
+fail:
+    Py_XDECREF(acc);
+    Py_XDECREF(order);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* -- freeze_rows -------------------------------------------------------- */
+
+static PyObject *
+fast_freeze_rows(PyObject *self, PyObject *arg)
+{
+    PyObject *seq = PySequence_Fast(arg, "freeze_rows expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *f = freeze_row_c(PySequence_Fast_GET_ITEM(seq, i));
+        if (f == NULL) {
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, f);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+/* -- value_bytes: injective serialization for ref_scalar ---------------- */
+
+typedef struct {
+    char *buf;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} Buf;
+
+static int
+buf_ensure(Buf *b, Py_ssize_t extra)
+{
+    if (b->len + extra <= b->cap)
+        return 0;
+    Py_ssize_t ncap = b->cap * 2;
+    while (ncap < b->len + extra)
+        ncap *= 2;
+    char *nb = PyMem_Realloc(b->buf, ncap);
+    if (nb == NULL) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    b->buf = nb;
+    b->cap = ncap;
+    return 0;
+}
+
+static int
+buf_put(Buf *b, const void *data, Py_ssize_t n)
+{
+    if (buf_ensure(b, n) < 0)
+        return -1;
+    memcpy(b->buf + b->len, data, n);
+    b->len += n;
+    return 0;
+}
+
+static int
+buf_put_u32(Buf *b, uint32_t v)
+{
+    return buf_put(b, &v, 4);
+}
+
+static PyObject *value_to_bytes_py = NULL; /* python fallback */
+
+static int
+serialize_value(Buf *b, PyObject *v)
+{
+    /* mirrors pathway_tpu.internals.api._value_to_bytes for the scalar
+     * fast paths; composite/exotic values defer to the Python function */
+    if (v == Py_None)
+        return buf_put(b, "\x00", 1);
+    if (PyBool_Check(v)) {
+        char t[2] = {'B', v == Py_True ? 1 : 0};
+        return buf_put(b, t, 2);
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        if (buf_put(b, "F", 1) < 0)
+            return -1;
+        return buf_put(b, &d, 8);
+    }
+    if (PyUnicode_Check(v)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (s == NULL)
+            return -1;
+        if (buf_put(b, "S", 1) < 0)
+            return -1;
+        return buf_put(b, s, n);
+    }
+    if (PyBytes_Check(v)) {
+        if (buf_put(b, "Y", 1) < 0)
+            return -1;
+        return buf_put(b, PyBytes_AS_STRING(v), PyBytes_GET_SIZE(v));
+    }
+    /* ints (incl. Pointer subclass) and everything else -> python impl */
+    if (value_to_bytes_py == NULL) {
+        PyObject *mod = PyImport_ImportModule("pathway_tpu.internals.api");
+        if (mod == NULL)
+            return -1;
+        value_to_bytes_py = PyObject_GetAttrString(mod, "_value_to_bytes");
+        Py_DECREF(mod);
+        if (value_to_bytes_py == NULL)
+            return -1;
+    }
+    PyObject *bytes = PyObject_CallOneArg(value_to_bytes_py, v);
+    if (bytes == NULL)
+        return -1;
+    int rc = buf_put(b, PyBytes_AS_STRING(bytes), PyBytes_GET_SIZE(bytes));
+    Py_DECREF(bytes);
+    return rc;
+}
+
+static PyObject *
+fast_value_bytes(PyObject *self, PyObject *args_tuple)
+{
+    if (!PyTuple_Check(args_tuple)) {
+        PyErr_SetString(PyExc_TypeError, "value_bytes expects a tuple");
+        return NULL;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(args_tuple);
+    Buf b = {PyMem_Malloc(256), 0, 256};
+    if (b.buf == NULL)
+        return PyErr_NoMemory();
+    if (buf_put_u32(&b, (uint32_t)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        /* length-prefix each serialized value (injective concat) */
+        Py_ssize_t mark = b.len;
+        if (buf_put_u32(&b, 0) < 0)
+            goto fail;
+        if (serialize_value(&b, PyTuple_GET_ITEM(args_tuple, i)) < 0)
+            goto fail;
+        uint32_t plen = (uint32_t)(b.len - mark - 4);
+        memcpy(b.buf + mark, &plen, 4);
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.buf, b.len);
+    PyMem_Free(b.buf);
+    return out;
+fail:
+    PyMem_Free(b.buf);
+    return NULL;
+}
+
+/* -- integer int path for serialize (avoid python fallback for ints) ---- */
+
+/* module def ------------------------------------------------------------ */
+
+static PyMethodDef methods[] = {
+    {"consolidate", fast_consolidate, METH_O,
+     "Sum multiplicities of identical (key,row) pairs, drop zeros."},
+    {"freeze_rows", fast_freeze_rows, METH_O,
+     "Hashable stand-ins for a batch of rows."},
+    {"value_bytes", fast_value_bytes, METH_O,
+     "Injective length-prefixed serialization of a value tuple."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "fastpath",
+    "Native engine fast path (consolidate/freeze/key bytes).", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit_fastpath(void)
+{
+    return PyModule_Create(&moduledef);
+}

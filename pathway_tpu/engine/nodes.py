@@ -340,10 +340,12 @@ class GroupByNode(GroupDiffNode):
     """Incremental groupby+reduce (reference: Graph::group_by_table
     graph.rs:885; reducers src/engine/reduce.rs).
 
-    ``reducer_fns`` is a list of callables ``(multiset_of_arg_tuples) -> value``;
-    semigroup reducers additionally supply an incremental ``combine`` used via
-    per-group running state when the group's multiset only grows.
-    """
+    ``reducer_specs`` entries are either ``("full", fn)`` — fn(entries,
+    slot) over the group's multiset — or ``("abelian", update, finish,
+    init)`` maintaining O(1) running state per group (the reference's
+    semigroup fast path, reduce.rs:40): abelian slots never rescan the
+    multiset, and when EVERY slot is abelian the multiset isn't even
+    stored."""
 
     def __init__(
         self,
@@ -351,53 +353,71 @@ class GroupByNode(GroupDiffNode):
         input_node,
         grouping_fn,          # (key, row) -> tuple of grouping values
         args_fn,              # (key, row) -> tuple of reducer arg combos
-        reducer_fns,          # list of fn(entries, slot) -> value
+        reducer_specs,        # list of ("full", fn) | ("abelian", upd, fin, init)
         key_fn=None,          # grouping values -> output Pointer
     ):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
         self.args_fn = args_fn
-        self.reducer_fns = reducer_fns
+        self.specs = [
+            s if isinstance(s, tuple) else ("full", s) for s in reducer_specs
+        ]
+        self.need_ms = any(s[0] == "full" for s in self.specs)
         self.key_fn = key_fn or (lambda gvals: ref_scalar(*gvals))
-        # frozen gvals -> (gvals, {frozen_args: [args, count]})
-        self.groups: dict[Any, tuple[tuple, dict[tuple, list]]] = {}
+        # frozen gvals -> [gvals, ms_or_None, abelian_states, total_count]
+        self.groups: dict[Any, list] = {}
 
     def group_of(self, port, key, row):
-        from pathway_tpu.engine.stream import freeze_row
-
         return freeze_row(self.grouping_fn(key, row))
 
     def apply_updates(self, batches):
-        from pathway_tpu.engine.stream import freeze_row
-
+        specs = self.specs
         for k, row, d in batches[0]:
             gvals = self.grouping_fn(k, row)
             gfrozen = freeze_row(gvals)
             args = self.args_fn(k, row)
             entry = self.groups.get(gfrozen)
             if entry is None:
-                entry = (gvals, {})
+                entry = [
+                    gvals,
+                    {} if self.need_ms else None,
+                    [s[3] if s[0] == "abelian" else None for s in specs],
+                    0,
+                ]
                 self.groups[gfrozen] = entry
-            ms = entry[1]
-            afrozen = freeze_row(args)
-            slot = ms.get(afrozen)
-            if slot is None:
-                slot = [args, 0]
-                ms[afrozen] = slot
-            slot[1] += d
-            if slot[1] == 0:
-                del ms[afrozen]
-                if not ms:
-                    del self.groups[gfrozen]
+            entry[3] += d
+            states = entry[2]
+            for i, spec in enumerate(specs):
+                if spec[0] == "abelian":
+                    states[i] = spec[1](states[i], args[i], d)
+            if self.need_ms:
+                ms = entry[1]
+                afrozen = freeze_row(args)
+                slot = ms.get(afrozen)
+                if slot is None:
+                    slot = [args, 0]
+                    ms[afrozen] = slot
+                slot[1] += d
+                if slot[1] == 0:
+                    del ms[afrozen]
+            if entry[3] == 0 and not (self.need_ms and entry[1]):
+                del self.groups[gfrozen]
 
     def output_of_group(self, gfrozen) -> list[Delta]:
         entry = self.groups.get(gfrozen)
-        if entry is None or not entry[1]:
+        if entry is None or entry[3] <= 0:
             return []
-        gvals = entry[0]
-        entries = [(slot[0], slot[1]) for slot in entry[1].values()]
-        values = tuple(fn(entries, i) for i, fn in enumerate(self.reducer_fns))
-        return [(self.key_fn(gvals), gvals + values, 1)]
+        gvals, ms, states, _total = entry
+        entries = None
+        values = []
+        for i, spec in enumerate(self.specs):
+            if spec[0] == "abelian":
+                values.append(spec[2](states[i]))
+            else:
+                if entries is None:
+                    entries = [(slot[0], slot[1]) for slot in ms.values()]
+                values.append(spec[1](entries, i))
+        return [(self.key_fn(gvals), gvals + tuple(values), 1)]
 
 
 class UpdateRowsNode(GroupDiffNode):

@@ -46,7 +46,7 @@ def freeze_row(row: Row) -> tuple:
     return tuple(freeze_value(v) for v in row)
 
 
-def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
+def _consolidate_py(deltas: Iterable[Delta]) -> list[Delta]:
     """Sum multiplicities of identical (key, row) pairs, drop zeros."""
     acc: dict[tuple, int] = {}
     rows: dict[tuple, tuple] = {}
@@ -57,6 +57,35 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
     return [
         (ident[0], rows[ident], diff) for ident, diff in acc.items() if diff != 0
     ]
+
+
+_consolidate_impl = None
+
+
+def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
+    """Native C fast path when a toolchain exists (native/fastpath.c — the
+    engine's hottest loop), else the Python implementation. Resolved
+    lazily on first use so importing the package never compiles."""
+    global _consolidate_impl
+    if _consolidate_impl is None:
+        impl = _consolidate_py
+        try:
+            from pathway_tpu.native import get_fastpath
+
+            fp = get_fastpath()
+            if fp is not None:
+                native_fn = fp.consolidate
+
+                def impl(deltas):  # noqa: F811
+                    return native_fn(
+                        deltas
+                        if isinstance(deltas, (list, tuple))
+                        else list(deltas)
+                    )
+        except Exception:
+            pass
+        _consolidate_impl = impl
+    return _consolidate_impl(deltas)
 
 
 class TableState:

@@ -77,11 +77,32 @@ def _concat_lp(parts: list[bytes]) -> bytes:
     )
 
 
+_native_value_bytes = None
+_native_checked = False
+
+
+def _args_bytes(args: tuple) -> bytes:
+    global _native_value_bytes, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from pathway_tpu.native import get_fastpath
+
+            fp = get_fastpath()
+            if fp is not None:
+                _native_value_bytes = fp.value_bytes
+        except Exception:
+            _native_value_bytes = None
+    if _native_value_bytes is not None:
+        return _native_value_bytes(args)
+    return _concat_lp([_value_to_bytes(a) for a in args])
+
+
 def ref_scalar(*args: Any, optional: bool = False) -> Pointer:
     """Deterministic pointer from values (reference: python_api ref_scalar)."""
     if optional and any(a is None for a in args):
         return None  # type: ignore[return-value]
-    return _hash_bytes(_concat_lp([_value_to_bytes(a) for a in args]))
+    return _hash_bytes(_args_bytes(args))
 
 
 _unsafe_counter = [0]

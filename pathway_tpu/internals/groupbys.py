@@ -233,15 +233,26 @@ class GroupedTable:
                     )
                 grouped = get
             else:
-                reducer_fns = []
+                reducer_specs = []
                 for r in reducers:
-                    fn = r._reducer.engine_fn()
+                    spec = r._reducer.engine_spec(**r._kwargs)
                     post = getattr(r, "_post_process", None)
                     if post is not None:
-                        fn = lambda ms, slot, _f=fn, _p=post: _p(_f(ms, slot))
-                    reducer_fns.append(fn)
+                        if spec[0] == "abelian":
+                            _, upd, fin, init = spec
+                            spec = (
+                                "abelian", upd,
+                                lambda s, _f=fin, _p=post: _p(_f(s)), init,
+                            )
+                        else:
+                            fn = spec[1]
+                            spec = (
+                                "full",
+                                lambda ms, slot, _f=fn, _p=post: _p(_f(ms, slot)),
+                            )
+                    reducer_specs.append(spec)
                 grouped = ctx.scope.group_by(
-                    et, grouping_fn, args_fn, reducer_fns, n_group, key_fn=key_fn
+                    et, grouping_fn, args_fn, reducer_specs, n_group, key_fn=key_fn
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values

@@ -31,10 +31,20 @@ def _entries(ms, slot: int):
 
 
 class Reducer:
-    def __init__(self, name: str, engine_fn_factory: Callable, return_type_fn=None):
+    def __init__(
+        self,
+        name: str,
+        engine_fn_factory: Callable,
+        return_type_fn=None,
+        abelian_factory: Callable | None = None,
+    ):
         self.name = name
         self._factory = engine_fn_factory
         self._return_type_fn = return_type_fn
+        # abelian reducers (count/sum/avg) maintain O(1) running state per
+        # group instead of rescanning the multiset (reference: semigroup
+        # fast path, src/engine/reduce.rs:40 SemigroupReducerImpl)
+        self._abelian_factory = abelian_factory
 
     def return_type(self, arg_types: list[dt.DType]) -> dt.DType:
         if self._return_type_fn is not None:
@@ -43,6 +53,13 @@ class Reducer:
 
     def engine_fn(self, **kwargs) -> Callable:
         return self._factory(**kwargs)
+
+    def engine_spec(self, **kwargs):
+        """("abelian", update(state, combo, diff), finish(state), init) when
+        incremental maintenance applies, else ("full", fn)."""
+        if self._abelian_factory is not None:
+            return ("abelian",) + self._abelian_factory(**kwargs)
+        return ("full", self._factory(**kwargs))
 
     def __call__(self, *args, **kwargs) -> ReducerExpression:
         return ReducerExpression(self, *args, **kwargs)
@@ -59,6 +76,62 @@ def _count_factory(**kw):
         return builtins.sum(count for _, count in _entries(ms, slot))
 
     return fn
+
+
+def _count_abelian(**kw):
+    def update(state, combo, diff):
+        return state + diff
+
+    return (update, lambda s: s, 0)
+
+
+def _sum_abelian(**kw):
+    # state: [n_numeric, total, err_count] — n_numeric tracks live numeric
+    # rows so full retraction returns None (matching the full reducer),
+    # not a stale 0
+    def update(state, combo, diff):
+        v = combo[0]
+        if state is None:
+            state = [0, None, 0]
+        if v is ERROR:
+            state[2] += diff
+        elif v is not None:
+            contrib = v * diff
+            state[1] = contrib if state[1] is None else state[1] + contrib
+            state[0] += diff
+        return state
+
+    def finish(state):
+        if state is None:
+            return None
+        if state[2] > 0:
+            return ERROR
+        return state[1] if state[0] > 0 else None
+
+    return (update, finish, None)
+
+
+def _avg_abelian(**kw):
+    # state: [total, n, err_count]
+    def update(state, combo, diff):
+        v = combo[0]
+        if state is None:
+            state = [0.0, 0, 0]
+        if v is ERROR:
+            state[2] += diff
+        elif v is not None:
+            state[0] += v * diff
+            state[1] += diff
+        return state
+
+    def finish(state):
+        if state is None:
+            return None
+        if state[2] > 0:
+            return ERROR  # error poison outranks emptiness (full-reducer parity)
+        return state[0] / state[1] if state[1] else None
+
+    return (update, finish, None)
 
 
 def _sum_factory(**kw):
@@ -209,15 +282,15 @@ def _sum_return_type(arg_types: list[dt.DType]) -> dt.DType:
     return dt.ANY
 
 
-count = Reducer("count", _count_factory, lambda ts: dt.INT)
-sum = Reducer("sum", _sum_factory, _sum_return_type)
+count = Reducer("count", _count_factory, lambda ts: dt.INT, abelian_factory=_count_abelian)
+sum = Reducer("sum", _sum_factory, _sum_return_type, abelian_factory=_sum_abelian)
 min = Reducer("min", _min_factory)
 max = Reducer("max", _max_factory)
 argmin = Reducer("argmin", _argmin_factory, lambda ts: dt.POINTER)
 argmax = Reducer("argmax", _argmax_factory, lambda ts: dt.POINTER)
 unique = Reducer("unique", _unique_factory)
 any = Reducer("any", _any_factory)
-avg = Reducer("avg", _avg_factory, lambda ts: dt.FLOAT)
+avg = Reducer("avg", _avg_factory, lambda ts: dt.FLOAT, abelian_factory=_avg_abelian)
 earliest = Reducer("earliest", _earliest_factory)
 latest = Reducer("latest", _latest_factory)
 ndarray_reducer = Reducer(

@@ -109,6 +109,53 @@ def available() -> bool:
     return get_lib() is not None
 
 
+_FASTPATH = None
+_FASTPATH_TRIED = False
+
+
+def get_fastpath():
+    """CPython extension with the engine's per-row hot loops
+    (native/fastpath.c); None when no toolchain — callers fall back to the
+    pure-Python implementations."""
+    global _FASTPATH, _FASTPATH_TRIED
+    with _LOCK:
+        if _FASTPATH_TRIED:
+            return _FASTPATH
+        _FASTPATH_TRIED = True
+        src_dir = _REPO_NATIVE if os.path.isdir(_REPO_NATIVE) else os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "src"
+        )
+        src = os.path.join(src_dir, "fastpath.c")
+        if not os.path.exists(src):
+            return None
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        out = os.path.join(_BUILD_DIR, "fastpath" + suffix)
+        if not (
+            os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            include = sysconfig.get_paths()["include"]
+            cmd = [
+                "gcc", "-O3", "-shared", "-fPIC",
+                f"-I{include}", "-o", out, src,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            except Exception:
+                return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("fastpath", out)
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+        _FASTPATH = mod
+        return _FASTPATH
+
+
 class NativeBm25:
     """ctypes wrapper over the C++ BM25 index. int64 handles are minted
     per key by the caller (KeyToU64IdMapper pattern, reference

@@ -93,3 +93,31 @@ def test_usearch_knn_uses_native(monkeypatch):
     assert len(reply) == 1
     # matched id resolves to doc 'a'
     docs_capture = GraphRunner().run_tables(docs.select(pw.this.name))
+
+
+def test_fastpath_consolidate_and_value_bytes():
+    from pathway_tpu.native import get_fastpath
+
+    fp = get_fastpath()
+    if fp is None:
+        pytest.skip("no toolchain")
+    out = fp.consolidate(
+        [(1, ("a",), 1), (1, ("a",), 2), (2, ("b",), 1), (1, ("a",), -3)]
+    )
+    assert out == [(2, ("b",), 1)]
+    # ndarray rows freeze to the same stand-ins as the python impl
+    from pathway_tpu.engine.stream import freeze_row
+
+    row = (np.array([1.0, 2.0]), "x")
+    assert fp.freeze_rows([row])[0] == freeze_row(row)
+    # byte-identical serialization vs the python reference impl
+    from pathway_tpu.internals.api import _concat_lp, _value_to_bytes
+
+    for args in [
+        ("a", 1, 2.5, None, True, b"z"),
+        ("a\x1eSb",),
+        ("a", "b"),
+        (("nested", 1), 7),
+    ]:
+        want = _concat_lp([_value_to_bytes(a) for a in args])
+        assert fp.value_bytes(args) == want

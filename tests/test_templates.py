@@ -3,22 +3,40 @@ example apps serve real HTTP with mock models (BASELINE.json configs 3-4)."""
 
 import json
 import os
+import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def _post(url, payload, timeout=15):
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"},
-        method="POST",
-    )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post_with_retries(url, payload, deadline_s=20):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            req = urllib.request.Request(
+                url,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, ConnectionError) as exc:
+            last = exc
+            time.sleep(0.25)
+    raise AssertionError(f"server never answered: {last!r}")
 
 
 def _write_config(tmp_path, template: str, port: int) -> str:
@@ -28,7 +46,7 @@ def _write_config(tmp_path, template: str, port: int) -> str:
         "pathway tpu is a streaming dataflow framework with native "
         "tpu retrieval and incremental consistency"
     )
-    src = os.path.join("examples", template, "app.yaml")
+    src = os.path.join(_REPO_ROOT, "examples", template, "app.yaml")
     cfg = open(src).read()
     cfg = cfg.replace("./docs", str(docs))
     cfg = cfg.replace("port: 8000", f"port: {port}")
@@ -38,39 +56,35 @@ def _write_config(tmp_path, template: str, port: int) -> str:
     return str(out)
 
 
-def test_demo_question_answering_template(tmp_path):
+def _run_template(tmp_path, template: str):
+    import importlib
     import sys
 
-    sys.path.insert(0, os.path.join("examples", "demo-question-answering"))
-    import importlib
+    port = _free_port()
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "examples", template))
+    try:
+        app = importlib.import_module("app")
+        config = _write_config(tmp_path, template, port)
+        threading.Thread(target=app.run, args=(config,), daemon=True).start()
+        return port
+    finally:
+        sys.path.pop(0)
+        sys.modules.pop("app", None)
 
-    app = importlib.import_module("app")
-    config = _write_config(tmp_path, "demo-question-answering", 8951)
-    threading.Thread(target=app.run, args=(config,), daemon=True).start()
-    time.sleep(2.0)
-    out = _post(
-        "http://127.0.0.1:8951/v2/answer",
+
+def test_demo_question_answering_template(tmp_path):
+    port = _run_template(tmp_path, "demo-question-answering")
+    out = _post_with_retries(
+        f"http://127.0.0.1:{port}/v2/answer",
         {"prompt": "what is pathway tpu"},
     )
     assert "streaming dataflow framework" in out["response"]
-    sys.path.pop(0)
-    del sys.modules["app"]
 
 
 def test_adaptive_rag_template(tmp_path):
-    import sys
-
-    sys.path.insert(0, os.path.join("examples", "adaptive-rag"))
-    import importlib
-
-    app = importlib.import_module("app")
-    config = _write_config(tmp_path, "adaptive-rag", 8952)
-    threading.Thread(target=app.run, args=(config,), daemon=True).start()
-    time.sleep(2.0)
-    out = _post(
-        "http://127.0.0.1:8952/v2/answer",
+    port = _run_template(tmp_path, "adaptive-rag")
+    out = _post_with_retries(
+        f"http://127.0.0.1:{port}/v2/answer",
         {"prompt": "pathway tpu streaming dataflow framework"},
     )
     assert out["response"] is not None
-    sys.path.pop(0)
-    del sys.modules["app"]
