@@ -43,7 +43,14 @@ def freeze_value(v: Any) -> Any:
 
 
 def freeze_row(row: Row) -> tuple:
-    return tuple(freeze_value(v) for v in row)
+    # fast path: an already-hashable row IS its own frozen form (per-value
+    # freezing only rewrites unhashable values, which would have made the
+    # row unhashable too)
+    try:
+        hash(row)
+        return row
+    except TypeError:
+        return tuple(freeze_value(v) for v in row)
 
 
 def _consolidate_py(deltas: Iterable[Delta]) -> list[Delta]:
