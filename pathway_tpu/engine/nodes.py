@@ -662,6 +662,44 @@ class StatefulReduceNode(Node):
         return consolidate(out)
 
 
+class GradualBroadcastNode(GroupDiffNode):
+    """Append an apportioned threshold column (reference:
+    src/engine/dataflow/operators/gradual_broadcast.rs): the threshold
+    table carries one (lower, value, upper) triplet; every left row gets
+    ``apx_value = min(lower + frac(key)*(upper-lower), value)`` — a fixed
+    per-key point in [lower, upper] exposed gradually as `value` sweeps,
+    so downstream cutoffs move row-by-row instead of all at once."""
+
+    def __init__(self, scope, left_node, threshold_node, triplet_fn):
+        super().__init__(scope, [left_node, threshold_node])
+        self.triplet_fn = triplet_fn  # (key,row) -> (lower, value, upper)
+        self.left = TableState()
+        self.threshold: tuple | None = None
+
+    def group_of(self, port, key, row):
+        return 0  # single group: threshold changes rediff everything
+
+    def apply_updates(self, batches):
+        self.left.apply(batches[0])
+        for k, row, d in batches[1]:
+            if d > 0:
+                self.threshold = self.triplet_fn(k, row)
+
+    def output_of_group(self, _g) -> list[Delta]:
+        if self.threshold is None:
+            return []
+        lower, value, upper = self.threshold
+        span = upper - lower
+        out = []
+        for k, row in self.left.rows.items():
+            frac = (int(k) & ((1 << 64) - 1)) / float(1 << 64)
+            apx = lower + frac * span if span else lower
+            if apx > value:
+                apx = value
+            out.append((k, row + (apx,), 1))
+        return out
+
+
 class ForgetImmediatelyNode(Node):
     """Pass rows through and retract them at the next engine timestamp
     (reference: Table._forget_immediately — used by as-of-now query flows so

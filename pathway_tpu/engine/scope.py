@@ -168,6 +168,14 @@ class Scope:
 
         return EngineTable(ForgetNode(self, table.node, gate_fn), table.width)
 
+    def gradual_broadcast(
+        self, left: EngineTable, threshold: EngineTable, triplet_fn
+    ) -> EngineTable:
+        node = N.GradualBroadcastNode(
+            self, left.node, threshold.node, triplet_fn
+        )
+        return EngineTable(node, left.width + 1)
+
     def forget_immediately(self, table: EngineTable) -> EngineTable:
         return EngineTable(
             N.ForgetImmediatelyNode(self, table.node), table.width

@@ -737,6 +737,39 @@ class Table(TableLike):
         Table._forget -> TimeColumnForget)."""
         return self._time_gate("forget", threshold, time_expr)
 
+    def _gradual_broadcast(
+        self, threshold_table, lower_column, value_column, upper_column
+    ) -> "Table":
+        """Append `apx_value` apportioning a slowly-changing threshold
+        (reference: table.py:631 -> gradual_broadcast.rs)."""
+        exprs = [
+            threshold_table._desugar(expr_mod.smart_coerce(c))
+            for c in (lower_column, value_column, upper_column)
+        ]
+        schema_cols = dict(self.schema.typehints())
+        schema_cols["apx_value"] = dt.ANY
+        out = Table(schema_from_types(**schema_cols), self._universe)
+        self_ = self
+
+        def lower(ctx):
+            let = ctx.engine_table(self_)
+            tet, resolver = ctx._combined_view(threshold_table, exprs)
+            from pathway_tpu.engine.expression import compile_expression
+
+            fns = [compile_expression(e, resolver, ctx.runtime) for e in exprs]
+
+            def triplet_fn(k, row):
+                return tuple(f([k], [row])[0] for f in fns)
+
+            ctx.set_engine_table(
+                out, ctx.scope.gradual_broadcast(let, tet, triplet_fn)
+            )
+
+        G.add_operator(
+            [self, threshold_table], [out], lower, "gradual_broadcast"
+        )
+        return out
+
     def _forget_immediately(self) -> "Table":
         """Rows pass through and are retracted at the next timestamp
         (reference: internals/table.py _forget_immediately — as-of-now

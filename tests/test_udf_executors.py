@@ -1,0 +1,107 @@
+"""UDF executor semantics + gradual_broadcast tests (reference pattern:
+python/pathway/tests/test_udf.py — capacity/timeout/retry/cache)."""
+
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from utils import T, run_table
+
+
+def _rows(t):
+    return sorted(run_table(t).values(), key=repr)
+
+
+def test_async_udf_capacity_limits_concurrency():
+    peak = [0]
+    active = [0]
+
+    @pw.udf(executor=pw.udfs.async_executor(capacity=2))
+    async def slow(v: int) -> int:
+        import asyncio
+
+        active[0] += 1
+        peak[0] = max(peak[0], active[0])
+        await asyncio.sleep(0.05)
+        active[0] -= 1
+        return v
+
+    t = T("v\n1\n2\n3\n4\n5\n6")
+    res = t.select(r=slow(pw.this.v))
+    assert sorted(r[0] for r in _rows(res)) == [1, 2, 3, 4, 5, 6]
+    assert peak[0] <= 2
+
+
+def test_async_udf_retry_strategy():
+    attempts = [0]
+
+    @pw.udf(
+        executor=pw.udfs.async_executor(
+            retry_strategy=pw.udfs.FixedDelayRetryStrategy(
+                max_retries=4, delay_ms=1
+            )
+        )
+    )
+    async def flaky(v: int) -> int:
+        attempts[0] += 1
+        if attempts[0] < 3:
+            raise RuntimeError("transient")
+        return v * 10
+
+    t = T("v\n7")
+    res = t.select(r=flaky(pw.this.v))
+    assert _rows(res) == [(70,)]
+    assert attempts[0] == 3
+
+
+def test_udf_in_memory_cache():
+    calls = [0]
+
+    @pw.udf(deterministic=True, cache_strategy=pw.udfs.InMemoryCache())
+    def costly(v: int) -> int:
+        calls[0] += 1
+        return v + 1
+
+    t = T("v\n1\n1\n1\n2")
+    res = t.select(r=costly(pw.this.v))
+    assert sorted(r[0] for r in _rows(res)) == [2, 2, 2, 3]
+    assert calls[0] == 2  # one evaluation per distinct input
+
+
+def test_async_udf_timeout_produces_error():
+    @pw.udf(executor=pw.udfs.async_executor(timeout=0.02))
+    async def too_slow(v: int) -> int:
+        import asyncio
+
+        await asyncio.sleep(1.0)
+        return v
+
+    t = T("v\n1")
+    res = t.select(r=too_slow(pw.this.v))
+    from pathway_tpu.internals.api import ERROR
+
+    assert _rows(res) == [(ERROR,)]
+
+
+def test_gradual_broadcast_apportions_threshold():
+    rows = T("\n".join(["v"] + [str(i) for i in range(20)]))
+    # value == upper: every key exposes its own apportioned point
+    thresholds = T("lo | val | hi\n0.0 | 1.0 | 1.0")
+    res = rows._gradual_broadcast(
+        thresholds, thresholds.lo, thresholds.val, thresholds.hi
+    )
+    vals = [r[0] for r in _rows(res.select(pw.this.apx_value))]
+    assert len(vals) == 20
+    assert all(0.0 <= v <= 1.0 for v in vals)
+    assert len(set(vals)) > 10  # spread across the hash space
+
+
+def test_gradual_broadcast_caps_at_value():
+    rows = T("v\n1\n2\n3")
+    thresholds = T("lo | val | hi\n0.0 | 0.0 | 1.0")
+    res = rows._gradual_broadcast(
+        thresholds, thresholds.lo, thresholds.val, thresholds.hi
+    )
+    vals = [r[0] for r in _rows(res.select(pw.this.apx_value))]
+    assert vals == [0.0, 0.0, 0.0]  # value at lower bound caps everything
