@@ -93,6 +93,15 @@ class GroupedTable:
             r._dtype = dtype
             return r
 
+        def _same_structure(a: ColumnExpression, b: ColumnExpression) -> bool:
+            # reduce() may repeat the grouping expression as a new object
+            # (reference: groupbys.py matches by expression structure)
+            if type(a) is not type(b) or repr(a) != repr(b):
+                return False
+            return [id(r.table) for r in a._deps] == [
+                id(r.table) for r in b._deps
+            ]
+
         def rewrite_fn(e: ColumnExpression):
             if isinstance(e, ReducerExpression):
                 idx = len(reducers)
@@ -101,6 +110,10 @@ class GroupedTable:
             if id(e) in grouping_ids:
                 j = grouping.index(e)
                 return gref(f"g{j}", e._dtype)
+            if not isinstance(e, ColumnReference):
+                for j, g in enumerate(grouping):
+                    if _same_structure(e, g):
+                        return gref(f"g{j}", e._dtype)
             if isinstance(e, ColumnReference):
                 j = grouping_refs.get((id(e.table), e.name))
                 if j is not None:
