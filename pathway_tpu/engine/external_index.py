@@ -66,6 +66,26 @@ class ExternalIndexNode(Node):
         # live queries (revising mode): key -> row
         self.live: dict[Key, Row] = {}
 
+    # -- operator snapshots -------------------------------------------------
+    def state_dict(self):
+        if not hasattr(self.adapter, "snapshot_state"):
+            raise RuntimeError(
+                "OPERATOR_PERSISTING requires a snapshot-capable index "
+                f"adapter; {type(self.adapter).__name__} has no "
+                "snapshot_state/load_state — use journal persistence "
+                "(PERSISTING) for this pipeline"
+            )
+        return {
+            "answers": self.answers,
+            "live": self.live,
+            "adapter": self.adapter.snapshot_state(),
+        }
+
+    def load_state(self, state) -> None:
+        self.answers = state["answers"]
+        self.live = state["live"]
+        self.adapter.load_state(state["adapter"])
+
     def process(self, time, batches):
         index_deltas = consolidate(batches[0])
         query_deltas = consolidate(batches[1])

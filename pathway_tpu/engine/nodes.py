@@ -79,6 +79,18 @@ class Node:
     def on_end(self) -> None:
         pass
 
+    # -- operator snapshots (reference: persistence/operator_snapshot.rs,
+    # Persist trait; engine/dataflow/persist.rs) -------------------------
+    STATE_ATTRS: tuple = ()
+
+    def state_dict(self):
+        """Picklable operator state at a commit boundary."""
+        return {a: getattr(self, a) for a in self.STATE_ATTRS}
+
+    def load_state(self, state) -> None:
+        for a, v in state.items():
+            setattr(self, a, v)
+
     def name(self) -> str:
         return type(self).__name__
 
@@ -124,6 +136,8 @@ class MemoizedRowwiseNode(Node):
     replay identical values even for non-deterministic fns (reference:
     map_named_async_with_consistent_deletions, dataflow.rs:1480)."""
 
+
+    STATE_ATTRS = ("_memo",)
     def __init__(self, scope, input_node, batch_fn):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
@@ -252,6 +266,8 @@ class JoinNode(GroupDiffNode):
     """Incremental join — inner/left/right/outer (reference: Graph::join_tables
     graph.rs:480 JoinType; dataflow.rs join impl)."""
 
+
+    STATE_ATTRS = ("left", "right")
     def __init__(
         self,
         scope,
@@ -347,6 +363,8 @@ class GroupByNode(GroupDiffNode):
     multiset, and when EVERY slot is abelian the multiset isn't even
     stored."""
 
+
+    STATE_ATTRS = ("groups",)
     def __init__(
         self,
         scope,
@@ -424,6 +442,8 @@ class UpdateRowsNode(GroupDiffNode):
     """right rows override left rows on the same key (reference:
     Graph::update_rows_table)."""
 
+
+    STATE_ATTRS = ("left", "right")
     def __init__(self, scope, left_node, right_node):
         super().__init__(scope, [left_node, right_node])
         self.left = TableState()
@@ -448,6 +468,8 @@ class UpdateCellsNode(GroupDiffNode):
     """Override selected columns from right where a right row exists
     (reference: Table.update_cells / Graph::update_cells)."""
 
+
+    STATE_ATTRS = ("left", "right")
     def __init__(self, scope, left_node, right_node, positions: list[int]):
         # positions[i] = column index in left row replaced by right row col i
         super().__init__(scope, [left_node, right_node])
@@ -477,6 +499,8 @@ class IxNode(GroupDiffNode):
     """Pointer-indexing: for each keys-table row, look up source row by the
     pointer in column ``key_col_idx`` (reference: Graph::ix_table)."""
 
+
+    STATE_ATTRS = ("source", "keys", "keys_by_target")
     def __init__(self, scope, source_node, keys_node, key_fn, optional=False, strict=True, source_width=0):
         super().__init__(scope, [source_node, keys_node])
         self.key_fn = key_fn  # (key,row) -> Pointer looked up in source
@@ -522,6 +546,8 @@ class IxNode(GroupDiffNode):
 class IntersectNode(GroupDiffNode):
     """Restrict left to keys present in all other inputs."""
 
+
+    STATE_ATTRS = ("left", "others")
     def __init__(self, scope, left_node, other_nodes):
         super().__init__(scope, [left_node, *other_nodes])
         self.left = TableState()
@@ -542,6 +568,8 @@ class IntersectNode(GroupDiffNode):
 
 
 class DifferenceNode(GroupDiffNode):
+
+    STATE_ATTRS = ("left", "right")
     def __init__(self, scope, left_node, right_node):
         super().__init__(scope, [left_node, right_node])
         self.left = TableState()
@@ -564,6 +592,8 @@ class SortNode(GroupDiffNode):
     """Maintains prev/next pointers per instance (reference:
     src/engine/dataflow/operators/prev_next.rs)."""
 
+
+    STATE_ATTRS = ("by_instance",)
     def __init__(self, scope, input_node, key_fn, instance_fn):
         super().__init__(scope, [input_node])
         self.key_fn = key_fn          # (key,row) -> sort key value
@@ -602,6 +632,8 @@ class DeduplicateNode(Node):
     Graph::deduplicate, stdlib/stateful/deduplicate.py).  Ignores
     retractions — stateful-reducer semantics."""
 
+
+    STATE_ATTRS = ("current",)
     def __init__(self, scope, input_node, instance_fn, value_fn, acceptor):
         super().__init__(scope, [input_node])
         self.instance_fn = instance_fn
@@ -636,6 +668,8 @@ class StatefulReduceNode(Node):
     """pw.reducers.stateful_many over groups (reference:
     src/engine/dataflow/operators/stateful_reduce.rs). Insert-only."""
 
+
+    STATE_ATTRS = ("state",)
     def __init__(self, scope, input_node, grouping_fn, args_fn, combine_many, key_fn=None):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
@@ -670,6 +704,8 @@ class GradualBroadcastNode(GroupDiffNode):
     per-key point in [lower, upper] exposed gradually as `value` sweeps,
     so downstream cutoffs move row-by-row instead of all at once."""
 
+
+    STATE_ATTRS = ("left", "threshold")
     def __init__(self, scope, left_node, threshold_node, triplet_fn):
         super().__init__(scope, [left_node, threshold_node])
         self.triplet_fn = triplet_fn  # (key,row) -> (lower, value, upper)
@@ -705,6 +741,8 @@ class ForgetImmediatelyNode(Node):
     (reference: Table._forget_immediately — used by as-of-now query flows so
     transient queries don't accumulate in downstream state)."""
 
+
+    STATE_ATTRS = ("_to_retract",)
     def __init__(self, scope, input_node):
         super().__init__(scope, [input_node])
         self._to_retract: dict[int, list[Delta]] = {}

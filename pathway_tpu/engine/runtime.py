@@ -63,6 +63,8 @@ class Runtime:
         self.error_log_node = None
         self._error_log_seq = 0
         self._error_log_seen: set = set()
+        self._operator_subject_states: dict = {}
+        self._last_snapshot = 0.0
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
@@ -196,7 +198,33 @@ class Runtime:
             t = min(self.pending_times)
             self._step_time(t)
 
-        if self.persistence is not None:
+        if self.persistence is not None and self.persistence.mode == "OPERATOR_PERSISTING":
+            # operator-state snapshots (reference: OperatorPersisting,
+            # operator_snapshot.rs): restore every stateful node's state at
+            # the last commit cut and seek subjects — no input replay
+            snap = self.persistence.load_operator_snapshot()
+            if snap is not None:
+                node_states, subject_states, fingerprint = snap
+                current = [node.name() for node in self.scope.nodes]
+                if fingerprint != current:
+                    raise RuntimeError(
+                        "operator snapshot does not match this pipeline's "
+                        "graph shape — the program changed since the "
+                        f"snapshot was taken (stored {len(fingerprint)} "
+                        f"nodes, current {len(current)}); clear the "
+                        "persistence directory or revert the pipeline"
+                    )
+                for node, state in zip(self.scope.nodes, node_states):
+                    if state:
+                        node.load_state(state)
+                # idle connectors must keep their restored positions in the
+                # NEXT snapshot too, or a second restart rereads them
+                self._operator_subject_states.update(subject_states)
+                for conn in self.connectors:
+                    state = subject_states.get(conn.name)
+                    if state is not None and hasattr(conn.subject, "seek"):
+                        conn.subject.seek(state)
+        elif self.persistence is not None:
             # replay journaled input (reference: Entry::Snapshot path,
             # connectors/mod.rs:101-130) — each journaled commit becomes a
             # fresh timestamp in arrival order, then subjects seek to their
@@ -242,14 +270,21 @@ class Runtime:
             # timestamp (reference: each flush advances the commit Timestamp,
             # connectors/mod.rs) — merging commits could cancel an insert
             # with a later retraction before downstream ever observed it
+            operator_mode = (
+                self.persistence is not None
+                and self.persistence.mode == "OPERATOR_PERSISTING"
+            )
+            drained_subject_states: dict = {}
+            saw_data = False
             for conn, deltas, state in entries:
                 if deltas is None:
                     conn.finished = True
                     active -= 1
                 elif deltas:
+                    saw_data = True
                     t = self._next_time()
                     self.stats.on_ingest(conn.name, len(deltas))
-                    if self.persistence is not None:
+                    if self.persistence is not None and not operator_mode:
                         # write-ahead: the commit is durable before the
                         # engine observes it (reference: input_snapshot.rs);
                         # the subject state was captured atomically with
@@ -259,6 +294,8 @@ class Runtime:
                             self.persistence.save_subject_state(
                                 conn.name, state
                             )
+                    if state is not None:
+                        drained_subject_states[conn.name] = state
                     conn.node.accept(t, 0, deltas)
             # step strictly in time order, re-reading pending_times each
             # round: stepping may schedule NEW times (forget-immediately
@@ -270,6 +307,23 @@ class Runtime:
                 if tt > self.clock + 1:
                     break
                 self._step_time(tt)
+            if operator_mode and saw_data:
+                # snapshot AFTER the commit's effects are fully applied:
+                # node states + source scan positions form one consistent
+                # cut (reference: tracker.rs commit protocol). Rate-limited
+                # by snapshot_interval_ms — full-state pickling per commit
+                # is O(state); the consistent cut makes skipping safe.
+                self._operator_subject_states.update(drained_subject_states)
+                now = _time.monotonic()
+                if (
+                    now - self._last_snapshot
+                ) * 1000.0 >= self.persistence.snapshot_interval_ms:
+                    self._last_snapshot = now
+                    self.persistence.save_operator_snapshot(
+                        [node.state_dict() for node in self.scope.nodes],
+                        dict(self._operator_subject_states),
+                        [node.name() for node in self.scope.nodes],
+                    )
             if self.error and self.terminate_on_error:
                 raise self.error
         while self.pending_times:

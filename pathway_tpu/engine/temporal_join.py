@@ -24,6 +24,8 @@ class TemporalJoinNode(GroupDiffNode):
     Unmatched-side padding for left/right/outer modes is the match_fn's
     responsibility (it sees the mode)."""
 
+    STATE_ATTRS = ("left", "right")
+
     def __init__(
         self,
         scope,
@@ -82,6 +84,8 @@ class AsofNowJoinNode(Node):
     """One-shot left join: a left insertion is answered against the CURRENT
     right state and never revised; left retractions replay the memoized
     answer (reference: _asof_now_join.py semantics)."""
+
+    STATE_ATTRS = ("right", "answers")
 
     def __init__(
         self,

@@ -45,6 +45,8 @@ class _WatermarkNode(Node):
 class BufferNode(_WatermarkNode):
     """Hold rows until watermark >= threshold (reference: postpone_core)."""
 
+    STATE_ATTRS = ("watermark", "stash")
+
     def __init__(self, scope, input_node, gate_fn):
         super().__init__(scope, input_node, gate_fn)
         # frozen (key,row) -> [key, row, diff, threshold]
@@ -95,6 +97,8 @@ class FreezeNode(_WatermarkNode):
     """Drop updates arriving after their cutoff threshold passed
     (reference: TimeColumnFreeze / ignore_late)."""
 
+    STATE_ATTRS = ("watermark",)
+
     def process(self, time, batches):
         deltas = consolidate(batches[0])
         gated = [(d, self.gate_fn(d[0], d[1])) for d in deltas]
@@ -115,6 +119,8 @@ class ForgetNode(_WatermarkNode):
     """Pass rows through, then retract them once watermark >= threshold
     (reference: TimeColumnForget). Used with keep_results=False semantics —
     downstream state genuinely loses expired rows."""
+
+    STATE_ATTRS = ("watermark", "live", "heap", "_seq")
 
     def __init__(self, scope, input_node, gate_fn):
         super().__init__(scope, input_node, gate_fn)

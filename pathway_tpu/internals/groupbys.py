@@ -95,11 +95,29 @@ class GroupedTable:
 
         def _same_structure(a: ColumnExpression, b: ColumnExpression) -> bool:
             # reduce() may repeat the grouping expression as a new object
-            # (reference: groupbys.py matches by expression structure)
+            # (reference: groupbys.py matches by expression structure).
+            # Applies/UDFs are excluded: their reprs elide function identity
+            # and arguments, so repr equality would false-positive.
             if type(a) is not type(b) or repr(a) != repr(b):
                 return False
-            return [id(r.table) for r in a._deps] == [
-                id(r.table) for r in b._deps
+            if not (a._is_deterministic and b._is_deterministic):
+                return False
+
+            def has_apply(e):
+                from pathway_tpu.internals.expression import ApplyExpression
+
+                stack = [e]
+                while stack:
+                    x = stack.pop()
+                    if isinstance(x, ApplyExpression):
+                        return True
+                    stack.extend(x._subexpressions())
+                return False
+
+            if has_apply(a) or has_apply(b):
+                return False
+            return [(id(r.table), r.name) for r in a._deps] == [
+                (id(r.table), r.name) for r in b._deps
             ]
 
         def rewrite_fn(e: ColumnExpression):

@@ -133,6 +133,8 @@ class PersistenceManager:
 
     def __init__(self, config: Config):
         self.backend = config.backend._backend
+        self.mode = (config.persistence_mode or "PERSISTING").upper()
+        self.snapshot_interval_ms = config.snapshot_interval_ms
         self.lock = threading.Lock()
 
     # -- journaling (write-ahead, called before the engine steps) ----------
@@ -166,4 +168,18 @@ class PersistenceManager:
 
     def load_subject_state(self, conn_name: str) -> Any | None:
         raw = self.backend.read(f"subject_state/{conn_name}")
+        return pickle.loads(raw) if raw else None
+
+    # -- operator snapshots (reference: operator_snapshot.rs) --------------
+    def save_operator_snapshot(
+        self, node_states: list, subject_states: dict, fingerprint: list
+    ) -> None:
+        with self.lock:
+            self.backend.write(
+                "operator_snapshot",
+                pickle.dumps((node_states, subject_states, fingerprint)),
+            )
+
+    def load_operator_snapshot(self):
+        raw = self.backend.read("operator_snapshot")
         return pickle.loads(raw) if raw else None

@@ -37,6 +37,8 @@ class _NativeBm25Adapter:
         self.key_to_id: dict[Any, int] = {}
         self.id_to_key: dict[int, Any] = {}
         self.meta: dict[Any, Any] = {}
+        # raw texts retained for operator snapshots (C++ postings rebuild)
+        self.texts: dict[Any, str] = {}
         self._next = 0
 
     def _id(self, key) -> int:
@@ -51,12 +53,21 @@ class _NativeBm25Adapter:
     def add(self, key, data, filter_data) -> None:
         self.index.add(self._id(key), str(data))
         self.meta[key] = filter_data
+        self.texts[key] = str(data)
 
     def remove(self, key) -> None:
         i = self.key_to_id.get(key)
         if i is not None:
             self.index.remove(i)
         self.meta.pop(key, None)
+        self.texts.pop(key, None)
+
+    def snapshot_state(self):
+        return {"texts": dict(self.texts), "meta": dict(self.meta)}
+
+    def load_state(self, state) -> None:
+        for key, text in state["texts"].items():
+            self.add(key, text, state["meta"].get(key))
 
     def search(self, queries):
         out = []
@@ -103,6 +114,18 @@ class _Bm25Adapter:
         self.postings: dict[str, dict[Any, int]] = {}
         self.doc_len: dict[Any, int] = {}
         self.meta: dict[Any, Any] = {}
+
+    def snapshot_state(self):
+        return {
+            "postings": self.postings,
+            "doc_len": self.doc_len,
+            "meta": self.meta,
+        }
+
+    def load_state(self, state) -> None:
+        self.postings = state["postings"]
+        self.doc_len = state["doc_len"]
+        self.meta = state["meta"]
 
     def add(self, key, data, filter_data) -> None:
         if key in self.doc_len:

@@ -42,6 +42,9 @@ class _HnswAdapter:
         self.key_to_id: dict[Any, int] = {}
         self.id_to_key: dict[int, Any] = {}
         self.meta: dict[Any, Any] = {}
+        # raw vectors retained for operator snapshots (the HNSW graph
+        # itself is rebuilt on restore)
+        self.vecs: dict[Any, Any] = {}
         self._next = 0
 
     def _id(self, key) -> int:
@@ -54,14 +57,24 @@ class _HnswAdapter:
         return i
 
     def add(self, key, data, filter_data) -> None:
-        self.index.add(self._id(key), np.asarray(data, dtype=np.float32))
+        vec = np.asarray(data, dtype=np.float32)
+        self.index.add(self._id(key), vec)
         self.meta[key] = filter_data
+        self.vecs[key] = vec
 
     def remove(self, key) -> None:
         i = self.key_to_id.get(key)
         if i is not None:
             self.index.remove(i)
         self.meta.pop(key, None)
+        self.vecs.pop(key, None)
+
+    def snapshot_state(self):
+        return {"vecs": dict(self.vecs), "meta": dict(self.meta)}
+
+    def load_state(self, state) -> None:
+        for key, vec in state["vecs"].items():
+            self.add(key, vec, state["meta"].get(key))
 
     def search(self, queries):
         out = []
@@ -123,6 +136,20 @@ class _KnnAdapter:
     def remove(self, key) -> None:
         self.shard.remove([key])
         self.meta.pop(key, None)
+
+    # -- operator-snapshot hooks -------------------------------------------
+    def snapshot_state(self):
+        keys = list(self.shard.key_to_slot)
+        vecs = np.asarray(self.shard.vectors)
+        rows = np.stack(
+            [vecs[self.shard.key_to_slot[k]] for k in keys]
+        ) if keys else np.zeros((0, self.shard.dimension), np.float32)
+        return {"keys": keys, "vectors": rows, "meta": dict(self.meta)}
+
+    def load_state(self, state) -> None:
+        if state["keys"]:
+            self.shard.add(state["keys"], state["vectors"])
+        self.meta = dict(state["meta"])
 
     def search(self, queries):
         out = []
