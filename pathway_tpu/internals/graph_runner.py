@@ -183,8 +183,16 @@ class GraphRunner:
         return captures
 
     def run_outputs(self):
+        from pathway_tpu.internals.config import get_pathway_config
+        from pathway_tpu.internals.telemetry import Telemetry
+
+        telemetry = Telemetry.create(
+            get_pathway_config().monitoring_server
+        )
         runtime = self._make_runtime()
         targets = self.graph.output_operators()
         ops = self.graph.reachable_operators(targets)
-        self._lower(ops, runtime)
-        runtime.run()
+        with telemetry.span("graph_runner.build", n_operators=len(ops)):
+            self._lower(ops, runtime)
+        with telemetry.span("graph_runner.run"):
+            runtime.run()

@@ -1,0 +1,72 @@
+"""OpenTelemetry tracing surface (reference:
+python/pathway/internals/graph_runner/telemetry.py, 140 LoC — spans
+`graph_runner.build` / `graph_runner.run` around lowering and execution;
+engine side src/engine/telemetry.rs exports OTLP).
+
+Only the OTel API is required: with no SDK configured the spans are
+no-ops; installing `opentelemetry-sdk` + an exporter activates them
+without code changes (`pw.set_monitoring_config(server_endpoint=...)`
+records the OTLP endpoint for the SDK bootstrap)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+
+class Telemetry:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    @classmethod
+    def create(cls, endpoint: str | None = None) -> "Telemetry":
+        try:
+            from opentelemetry import trace
+
+            if endpoint is not None:
+                cls._try_bootstrap_sdk(endpoint)
+            tracer = trace.get_tracer("pathway_tpu")
+        except ImportError:
+            tracer = None
+        return cls(tracer)
+
+    _sdk_bootstrapped = False
+
+    @classmethod
+    def _try_bootstrap_sdk(cls, endpoint: str) -> None:
+        # once per process: OTel ignores later set_tracer_provider calls,
+        # so repeats would only leak batch-export threads + gRPC channels
+        if cls._sdk_bootstrapped:
+            return
+        cls._sdk_bootstrapped = True
+        try:
+            from opentelemetry import trace
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                OTLPSpanExporter,
+            )
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+            provider = TracerProvider(
+                resource=Resource.create({"service.name": "pathway_tpu"})
+            )
+            provider.add_span_processor(
+                BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+            )
+            trace.set_tracer_provider(provider)
+        except ImportError:
+            pass  # API-only install: spans stay no-ops
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        if self.tracer is None:
+            yield None
+            return
+        with self.tracer.start_as_current_span(name) as s:
+            for k, v in attributes.items():
+                try:
+                    s.set_attribute(k, v)
+                except Exception:
+                    pass
+            yield s

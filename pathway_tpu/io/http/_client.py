@@ -74,22 +74,59 @@ def read(
     return python_read(subject, schema=schema, name=f"http:{url}")
 
 
-def write(table, url: str, *, method: str = "POST", headers: dict | None = None,
-          format: str = "json", **kwargs) -> None:
+def write(
+    table,
+    url: str,
+    *,
+    method: str = "POST",
+    headers: dict | None = None,
+    format: str = "json",
+    n_retries: int = 0,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int = 30_000,
+    payload_fn=None,
+    response_check=None,
+    include_special_fields: bool = True,
+    **kwargs,
+) -> None:
+    """POST every row CHANGE (inserts and retractions) to `url` with
+    `time`/`diff` fields appended (reference: io/http write — the payload
+    downstream needs to mirror table state). `payload_fn(row_dict) ->
+    bytes | None` customizes the body (None skips the change);
+    `response_check(body_bytes)` may log/raise on API-level failures."""
+    import logging
+
     cols = table.column_names()
     hdrs = {"Content-Type": "application/json", **(headers or {})}
+    timeout_s = request_timeout_ms / 1000.0
+    log = logging.getLogger("pathway_tpu.io.http")
 
     def on_change(key, row, time_, diff):
-        if diff <= 0:
-            return
-        payload = _json.dumps(dict(zip(cols, row)), default=str).encode()
+        data = dict(zip(cols, row))
+        if include_special_fields:
+            data["time"] = time_
+            data["diff"] = diff
+        if payload_fn is not None:
+            payload = payload_fn(data, diff)
+            if payload is None:
+                return
+        else:
+            payload = _json.dumps(data, default=str).encode()
         req = urllib.request.Request(
             url, data=payload, method=method, headers=hdrs
         )
-        try:
-            urllib.request.urlopen(req, timeout=30).read()
-        except Exception:
-            pass  # reference logs and continues
+        for attempt in range(n_retries + 1):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    body = resp.read()
+                if response_check is not None:
+                    response_check(body)
+                return
+            except Exception as exc:
+                if attempt == n_retries:
+                    log.warning("http write to %s failed: %r", url, exc)
+                else:
+                    time.sleep(min(0.1 * (2 ** attempt), 2.0))
 
     def lower(ctx):
         ctx.scope.output(ctx.engine_table(table), on_change=on_change)

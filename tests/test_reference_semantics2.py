@@ -1,0 +1,158 @@
+"""Second reference-semantics battery: Json, schemas, dtypes, universes,
+outer temporal joins, misc table ops."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from utils import T, run_table
+
+
+def _rows(t):
+    return sorted(run_table(t).values(), key=repr)
+
+
+def test_json_navigation():
+    t = T("k\n1").select(
+        j=pw.apply_with_type(
+            lambda k: pw.Json({"a": {"b": [1, 2, 3]}, "s": "x"}),
+            pw.Json,
+            pw.this.k,
+        )
+    )
+    res = t.select(
+        b1=t.j.get("a").get("b").get(1),
+        s=t.j.get("s"),
+        missing=t.j.get("nope", default=42),
+    )
+    [(b1, s, missing)] = _rows(res)
+    assert getattr(b1, "value", b1) == 2
+    assert getattr(s, "value", s) == "x"
+    assert getattr(missing, "value", missing) == 42
+
+
+def test_json_as_conversions():
+    t = T("k\n1").select(
+        j=pw.apply_with_type(
+            lambda k: pw.Json({"n": 7, "f": 2.5, "b": True}), pw.Json, pw.this.k
+        )
+    )
+    res = t.select(
+        n=t.j.get("n").as_int(),
+        f=t.j.get("f").as_float(),
+        b=t.j.get("b").as_bool(),
+    )
+    assert _rows(res) == [(7, 2.5, True)]
+
+
+def test_schema_defaults_and_primary_key():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str = pw.column_definition(default_value="missing")
+
+    t = pw.debug.table_from_markdown("k\n1\n2", schema=S)
+    assert _rows(t.select(pw.this.v)) == [("missing",), ("missing",)]
+    # primary-keyed rows share ids across equal markdown inputs
+    t2 = pw.debug.table_from_markdown("k\n1\n2", schema=S)
+    assert set(run_table(t)) == set(run_table(t2))
+
+
+def test_schema_from_types_and_builder():
+    s1 = pw.schema_from_types(a=int, b=str)
+    assert s1.column_names() == ["a", "b"]
+    s2 = pw.schema_builder(
+        {
+            "x": pw.column_definition(dtype=float),
+            "y": pw.column_definition(dtype=int, primary_key=True),
+        }
+    )
+    assert s2.primary_key_columns() == ["y"]
+
+
+def test_deduplicate_acceptor():
+    t = T("v\n5\n3\n9\n7")
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, cur: new > cur)
+    assert [r[0] for r in _rows(res)] == [9]
+
+
+def test_interval_join_outer_pads_both_sides():
+    a = T("t\n1\n100")
+    b = T("t | v\n2 | 7\n200 | 8")
+    res = pw.temporal.interval_join_outer(
+        a, b, a.t, b.t, pw.temporal.interval(-3, 3)
+    ).select(lt=a.t, rv=b.v)
+    assert _rows(res) == [(1, 7), (100, None), (None, 8)]
+
+
+def test_window_join_left():
+    a = T("t | x\n1 | p\n11 | q")
+    b = T("t | y\n2 | z")
+    res = pw.temporal.window_join_left(
+        a, b, a.t, b.t, pw.temporal.tumbling(duration=5)
+    ).select(x=a.x, y=b.y)
+    assert _rows(res) == [("p", "z"), ("q", None)]
+
+
+def test_from_columns_and_having():
+    a = T("x\n1\n2")
+    packed = pw.Table.from_columns(u=a.x, w=a.x * 10)
+    assert _rows(packed) == [(1, 10), (2, 20)]
+
+    keyed = a.with_id(a.pointer_from(a.x))
+    # _having keeps rows of `keyed` whose id appears in the indexer column
+    p = T("v\n2")
+    picker = p.select(ptr=p.pointer_from(p.v))
+    res = keyed._having(picker.ptr)
+    assert _rows(res) == [(2,)]
+
+
+def test_restrict_and_with_universe_of():
+    base = T("k | v\n1 | a\n2 | b")
+    base = base.with_id(base.pointer_from(base.k))
+    sub = T("k\n1")
+    sub = sub.with_id(sub.pointer_from(sub.k))
+    pw.universes.promise_is_subset_of(sub, base)
+    res = base.restrict(sub)
+    assert _rows(res.select(pw.this.v)) == [("a",)]
+
+
+def test_split_expression():
+    t = T("v\n1\n5\n9")
+    big, small = t.split(pw.this.v > 4)
+    assert sorted(r[0] for r in _rows(big)) == [5, 9]
+    assert sorted(r[0] for r in _rows(small)) == [1]
+
+
+def test_cast_and_parse_strings():
+    t = T("s | n\n12 | 3")
+    res = t.select(
+        i=t.s.str.parse_int(),
+        f=pw.cast(float, t.n),
+    )
+    assert _rows(res) == [(12, 3.0)]
+
+
+def test_ndarray_column_flow():
+    t = T("k\n1\n2")
+    res = t.select(
+        arr=pw.apply_with_type(
+            lambda k: np.ones(3) * k, np.ndarray, pw.this.k
+        )
+    )
+    out = res.select(s=pw.apply_with_type(lambda a: float(a.sum()), float, res.arr))
+    assert _rows(out) == [(3.0,), (6.0,)]
+
+
+def test_groupby_instance_kwarg():
+    t = T("g | i | v\na | 1 | 10\na | 2 | 20\nb | 1 | 30")
+    res = t.groupby(t.g, instance=t.i).reduce(
+        t.g, s=pw.reducers.sum(t.v)
+    )
+    assert _rows(res) == [("a", 10), ("a", 20), ("b", 30)]
+
+
+def test_empty_table_ops():
+    e = pw.Table.empty(a=int, b=str)
+    agg = e.reduce(c=pw.reducers.count())
+    res = _rows(agg)
+    assert res == [] or res == [(0,)]
