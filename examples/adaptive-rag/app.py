@@ -21,8 +21,12 @@ def run(config_path: str | None = None):
     with open(config_path) as f:
         cfg = pw.load_yaml(f)
 
+    from pathway_tpu.internals.yaml_loader import resolve_config_path
+
+    docs_path = resolve_config_path(cfg["docs_path"], config_path)
+
     docs = pw.io.fs.read(
-        cfg["docs_path"], format="binary", with_metadata=True,
+        docs_path, format="binary", with_metadata=True,
         mode="streaming", autocommit_duration_ms=100,
     )
     store = VectorStoreServer(docs, embedder=cfg["embedder"])

@@ -225,11 +225,37 @@ class FlattenNode(Node):
 
 
 class ConcatNode(Node):
+    """Union of disjoint-id inputs (reference: Graph::concat — universes
+    must be disjoint; a colliding id is a hard error, not a silent
+    overwrite, and the user is pointed at concat_reindex). Live ids are
+    tracked across timestamps so streaming collisions are caught too."""
+
+    STATE_ATTRS = ("live",)
+
     def __init__(self, scope, input_nodes):
         super().__init__(scope, list(input_nodes))
+        self.live: dict = {}  # key -> [frozen_row, count]
 
     def process(self, time, batches):
-        return consolidate(itertools.chain.from_iterable(batches))
+        out = consolidate(itertools.chain.from_iterable(batches))
+        for k, row, d in out:
+            slot = self.live.get(k)
+            if d > 0:
+                fr = freeze_row(row)
+                if slot is None:
+                    slot = [fr, 0]
+                    self.live[k] = slot
+                if slot[0] != fr or slot[1] + d > 1:
+                    raise ValueError(
+                        "concat received overlapping row ids — input "
+                        "universes are not disjoint; use concat_reindex"
+                    )
+                slot[1] += d
+            elif slot is not None:
+                slot[1] += d
+                if slot[1] <= 0:
+                    del self.live[k]
+        return out
 
 
 class GroupDiffNode(Node):

@@ -181,11 +181,18 @@ def schema_from_pandas(df, *, id_from=None, name: str = "schema_from_pandas") ->
     cols = {}
     for cname in df.columns:
         series = df[cname]
-        if np.issubdtype(series.dtype, np.integer):
+        # extension dtypes (pandas StringDtype/Int64 etc.) are not numpy
+        # dtypes and crash np.issubdtype — use the dtype kind; nullable
+        # EXTENSION columns (Int64 carrying pd.NA) fall to value inference
+        # so they type as Optional (numpy float NaN stays plain FLOAT)
+        kind = getattr(series.dtype, "kind", None)
+        is_ext = not isinstance(series.dtype, np.dtype)
+        ext_na = is_ext and len(series) and bool(series.isna().any())
+        if kind in ("i", "u") and not ext_na:
             t: Any = dt.INT
-        elif np.issubdtype(series.dtype, np.floating):
+        elif kind == "f" and not ext_na:
             t = dt.FLOAT
-        elif series.dtype == bool:
+        elif kind == "b" and not ext_na:
             t = dt.BOOL
         else:
             t = dt.lub(*(dt.dtype_of_value(v) for v in series)) if len(series) else dt.ANY

@@ -96,6 +96,16 @@ def _resolve(value: Any, variables: dict[str, Any]) -> Any:
     return value
 
 
+def resolve_config_path(path: str, config_path: str) -> str:
+    """Resolve a path from a template config relative to the config
+    file's own directory (shared by the example apps)."""
+    if os.path.isabs(path):
+        return path
+    return os.path.join(
+        os.path.dirname(os.path.abspath(config_path)), path
+    )
+
+
 def load_yaml(stream: str | IO) -> Any:
     """Parse a template: `$name:` top-level keys define variables (resolved
     in order); `!dotted.path` tags instantiate objects with the nested

@@ -1,0 +1,144 @@
+"""Third reference-semantics battery: join/universe/concat edge cases,
+temporal behaviors under streaming, debug round-trips."""
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from utils import T, run_table
+
+
+def _rows(t):
+    return sorted(run_table(t).values(), key=repr)
+
+
+def test_join_id_from_left_preserves_universe():
+    left = T("k | v\n1 | a\n2 | b")
+    left = left.with_id(left.pointer_from(left.k))
+    right = T("k | w\n1 | x\n2 | y")
+    joined = left.join(right, left.k == right.k, id=left.id).select(
+        left.v, right.w
+    )
+    assert set(run_table(joined)) == set(run_table(left))
+
+
+def test_concat_disjoint_and_same_schema():
+    a = T("k | v\n1 | 1")
+    a = a.with_id(a.pointer_from(a.k))
+    b = T("k | v\n2 | 2")
+    b = b.with_id(b.pointer_from(b.k))
+    both = pw.Table.concat(a, b)
+    assert sorted(r[1] for r in _rows(both)) == [1, 2]
+
+
+def test_concat_colliding_ids_raises():
+    # markdown tables mint ids from row position: index-0 rows collide
+    a = T("v\n1")
+    b = T("v\n2")
+    both = pw.Table.concat(a, b)
+    with pytest.raises(Exception, match="concat_reindex"):
+        _rows(both)
+
+
+def test_concat_reindex_allows_key_collisions():
+    a = T("v\n7")
+    b = T("v\n7")  # same content -> same minted keys
+    both = pw.Table.concat_reindex(a, b)
+    assert sorted(r[0] for r in _rows(both)) == [7, 7]
+
+
+def test_with_id_from():
+    t = T("a | b\n1 | x\n2 | y")
+    res = t.with_id_from(t.a)
+    from pathway_tpu.internals.api import ref_scalar
+
+    keys = set(run_table(res))
+    assert keys == {ref_scalar(1), ref_scalar(2)}
+
+
+def test_table_from_pandas_roundtrip():
+    df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+    t = pw.debug.table_from_pandas(df)
+    out = pw.debug.table_to_pandas(t, include_id=False)
+    assert sorted(out["a"].tolist()) == [1, 2]
+    assert set(out.columns) == {"a", "b"}
+
+
+def test_compute_and_print_smoke(capsys):
+    t = T("a\n1")
+    pw.debug.compute_and_print(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "a" in out and "1" in out
+
+
+def test_streaming_buffer_delay_behavior():
+    """delay buffers window output until watermark passes start+delay."""
+
+    class Events(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(t=1)
+            self.commit()
+            self.next(t=2)
+            self.commit()
+            self.next(t=9)
+            self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    events = pw.io.python.read(Events(), schema=S, autocommit_duration_ms=None)
+    res = events.windowby(
+        events.t,
+        window=pw.temporal.tumbling(duration=4),
+        behavior=pw.temporal.common_behavior(delay=6),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (row["start"], row["c"], is_addition)
+        ),
+    )
+    pw.run()
+    # window [0,4): rows released only once watermark >= 0+6 (t=9 arrival);
+    # the count arrives as ONE final value, no intermediate c=1
+    w0 = [u for u in updates if u[0] == 0]
+    assert w0 == [(0, 2, True)]
+
+
+def test_inactivity_columns_shape():
+    # inactivity_detection wires utc_now; just validate the declaration
+    # shape without running the infinite stream
+    t = T("ts\n100")
+    inact, resumed = pw.temporal.inactivity_detection(t.ts, 1000)
+    assert inact.column_names() == ["inactive_since"]
+    assert resumed.column_names() == ["resumed_at"]
+    pw.internals.parse_graph.G.clear()
+
+
+def test_unpack_col():
+    t = T("k\n1").select(tup=pw.make_tuple(7, "x"))
+    from pathway_tpu.stdlib.utils import unpack_col
+
+    res = unpack_col(t.tup, "a", "b")
+    assert _rows(res) == [(7, "x")]
+
+
+def test_argmax_rows_filter():
+    t = T("g | v\na | 1\na | 5\nb | 3")
+    from pathway_tpu.stdlib.utils.filtering import argmax_rows
+
+    res = argmax_rows(t, t.g, what=t.v)
+    assert _rows(res.select(pw.this.g, pw.this.v)) == [("a", 5), ("b", 3)]
+
+
+def test_sql_distinct():
+    t = T("a\n1\n1\n2")
+    res = pw.sql("SELECT DISTINCT a FROM t", t=t)
+    assert _rows(res) == [(1,), (2,)]
+
+
+def test_coalesce_all_none():
+    t = T("k | a | b\n1 | |")
+    res = t.select(c=pw.coalesce(t.a, t.b))
+    assert _rows(res) == [(None,)]
