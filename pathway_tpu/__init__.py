@@ -72,6 +72,23 @@ from pathway_tpu.internals.config import (  # noqa: E402
 )
 from pathway_tpu.internals.monitoring import MonitoringLevel  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.compat import (  # noqa: E402
+    BaseCustomAccumulator,
+    PersistenceMode,
+    SchemaProperties,
+    Type,
+    assert_table_has_schema,
+    groupby,
+    iterate_universe,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+    local_error_log,
+    schema_from_csv,
+    table_transformer,
+)
 from pathway_tpu.internals.error_log import (  # noqa: E402
     global_error_log,
     remove_errors_from_table,
@@ -91,8 +108,37 @@ from pathway_tpu.internals.row_transformer import (  # noqa: E402
 from pathway_tpu.sql_module import sql  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
+from pathway_tpu import udfs as asynchronous  # noqa: E402  (reference alias)
+from pathway_tpu.internals.interactive import LiveTableHandle as LiveTable  # noqa: E402
+
+# UDF aliases (reference: udf_async/UDFAsync/UDFSync deprecated spellings)
+UDFSync = UDF
+UDFAsync = UDF
+
+
+def udf_async(fun=None, **kwargs):
+    """reference: pw.udf_async — async-executor UDF decorator."""
+    from pathway_tpu.udfs import AsyncExecutor, udf as _udf
+
+    kwargs.setdefault("executor", AsyncExecutor())
+    return _udf(fun, **kwargs) if fun is not None else _udf(**kwargs)
 
 __version__ = "0.1.0"
+
+_LAZY_ATTRS = {
+    # join-result classes exposed at top level (reference __all__)
+    "IntervalJoinResult": ("pathway_tpu.stdlib.temporal", "IntervalJoinResult"),
+    "AsofJoinResult": ("pathway_tpu.stdlib.temporal", "AsofJoinResult"),
+    "WindowJoinResult": (
+        "pathway_tpu.stdlib.temporal._window_join", "WindowJoinResult",
+    ),
+    "Joinable": ("pathway_tpu.internals.table", "Table"),
+    "OuterJoinResult": ("pathway_tpu.internals.joins", "JoinResult"),
+    "GroupedJoinResult": ("pathway_tpu.internals.groupbys", "GroupedTable"),
+    "TableSlice": ("pathway_tpu.internals.table", "_TableSlice"),
+    "viz": ("pathway_tpu.stdlib.viz", None),
+    "window": ("pathway_tpu.stdlib.temporal", None),
+}
 
 _LAZY_MODULES = {
     "demo": "pathway_tpu.demo",
@@ -118,6 +164,12 @@ def __getattr__(name: str):
         mod = importlib.import_module(_LAZY_MODULES[name])
         globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        mod_name, attr = _LAZY_ATTRS[name]
+        mod = importlib.import_module(mod_name)
+        value = mod if attr is None else getattr(mod, attr)
+        globals()[name] = value
+        return value
     if name == "sql":
         from pathway_tpu.sql_module import sql as _sql
 
