@@ -373,3 +373,8 @@ def udf_reducer(reducer_cls):
         return expr
 
     return wrapper
+
+
+# deprecated reference spellings (reference: reducers.py int_sum/npsum)
+int_sum = sum
+npsum = sum
