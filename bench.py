@@ -1,52 +1,65 @@
-"""Headline benchmark: embedding ingest throughput (docs/s/chip).
+"""Headline benchmarks: embedding ingest throughput + RAG query latency.
 
-North-star config from BASELINE.json: VectorStoreServer batch indexing with
-a bge-small-class embedder, target >= 10k docs/s on TPU v5e-8, i.e. 1250
-docs/s/chip. This bench drives the flagship path end to end on whatever
-device is default (the driver runs it on one real TPU chip): hash-tokenize →
-jitted bf16 encoder forward (bucketed shapes) → sharded-capable KNN index
-add. Prints ONE JSON line.
+North-star configs from BASELINE.json:
+  * VectorStoreServer batch indexing, bge-small-class embedder — target
+    >= 10k docs/s on TPU v5e-8 (1250 docs/s/chip).
+  * RAG query p50 < 50 ms @ 1M docs.
+
+This bench drives the flagship path end to end on whatever device is default
+(the driver runs it on one real TPU chip): REAL WordPiece tokenization
+(BertTokenizerFast over the trained vocab; a cached HF checkpoint's own
+tokenizer+weights are used when resolvable offline) → jitted bf16 encoder
+forward (bucketed shapes) → HBM-resident KNN index add → fused query engine.
+
+Prints one JSON line per metric; the first line is the primary metric.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
 TARGET_PER_CHIP = 10_000 / 8  # BASELINE.json north-star on v5e-8
+RAG_TARGET_P50_MS = 50.0
 
 
 def make_docs(n: int, words: int = 90, seed: int = 0) -> list[str]:
+    """English-like documents drawn from the trained WordPiece vocab's full
+    words, so tokenization cost and subword fragmentation are realistic."""
     rng = np.random.default_rng(seed)
-    vocab = [f"token{i}" for i in range(5000)]
+    vocab_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "pathway_tpu", "models", "assets", "wordpiece_vocab.txt",
+    )
+    try:
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = [
+                w for w in (line.strip() for line in f)
+                if w.isalpha() and len(w) > 2
+            ][:20000]
+    except OSError:
+        vocab = [f"token{i}" for i in range(5000)]
     return [
         " ".join(vocab[j] for j in rng.integers(0, len(vocab), size=words))
         for i in range(n)
     ]
 
 
-def main() -> None:
-    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+def bench_ingest(enc, docs: list[str], batch_size: int) -> dict:
     from pathway_tpu.ops import KnnShard
 
-    batch_size = 256
-    enc = SentenceEncoder(EncoderConfig.bge_small(), batch_size=batch_size)
     # pre-size the index: each capacity is a distinct XLA executable, so
     # growth reshapes mid-benchmark would measure recompiles, not ingest
-    index = KnnShard(
-        enc.embed_dim, "cos", precision="default", capacity=1 << 17
-    )
+    index = KnnShard(enc.embed_dim, "cos", precision="default", capacity=1 << 17)
 
-    # distinct documents per batch: cycling one batch would overstate
-    # host tokenizer cache hits
-    n_batches = 128
-    docs = make_docs(n_batches * batch_size)
     # warm up compilation (one pass per shape) before timing
     emb0 = enc.encode_device(docs[:batch_size])
     index.add(list(range(batch_size)), emb0)
 
+    n_batches = len(docs) // batch_size
     deadline = time.perf_counter() + 12.0
     done = 0
     t0 = time.perf_counter()
@@ -70,16 +83,101 @@ def main() -> None:
     assert all(len(h) == 3 for h in hits)
 
     docs_per_s = done / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "embed_ingest_docs_per_s_per_chip",
-                "value": round(docs_per_s, 1),
-                "unit": "docs/s",
-                "vs_baseline": round(docs_per_s / TARGET_PER_CHIP, 3),
-            }
-        )
+    return {
+        "metric": "embed_ingest_docs_per_s_per_chip",
+        "value": round(docs_per_s, 1),
+        "unit": "docs/s",
+        "vs_baseline": round(docs_per_s / TARGET_PER_CHIP, 3),
+    }
+
+
+def bench_rag(enc, n_docs: int, n_queries: int = 100, k: int = 6) -> dict:
+    """Query latency over an HBM-resident index of n_docs vectors: p50/p95
+    end-to-end plus the device-compute-only split (on a tunneled dev chip
+    result readback adds a fixed ~100 ms that local hardware does not pay)."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.ops import KnnShard, QueryEngine
+
+    dim = enc.embed_dim
+    index = KnnShard(dim, "cos", precision="default", capacity=n_docs)
+    rng = np.random.default_rng(0)
+    block = 65536
+    for start in range(0, n_docs, block):
+        n = min(block, n_docs - start)
+        vecs = rng.normal(size=(n, dim)).astype(np.float32)
+        index.add(list(range(start, start + n)), vecs)
+    index.vectors.block_until_ready()
+
+    queries = [
+        f"how do i connect a streaming source to the vector index variant {i}"
+        for i in range(n_queries)
+    ]
+    engine = QueryEngine(enc, index, k=k)
+    engine.query(queries[:1])  # compile the fused executable
+
+    lat = []
+    for q in queries:
+        t0 = time.perf_counter()
+        engine.query([q])
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[int(len(lat) * 0.95)]
+
+    # Transport-floor split: on a tunneled dev chip every device→host
+    # readback pays a fixed ~100+ ms that local hardware does not; measure
+    # that floor with a trivial same-shape readback and report the marginal
+    # as device compute (block_until_ready does NOT wait on this tunnel, so
+    # timing it would read ~0 regardless of the work).
+    import jax
+
+    k_eff = min(k, 8192)
+    dummy = jnp.zeros((8, 2 * k_eff), jnp.float32)
+    trivial = jax.jit(lambda x: x + 1.0)
+    np.asarray(trivial(dummy))
+    floor = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(trivial(dummy))
+        floor.append((time.perf_counter() - t0) * 1000.0)
+    floor.sort()
+    floor_p50 = floor[len(floor) // 2]
+
+    return {
+        "metric": "rag_query_p50_ms",
+        "value": round(p50, 2),
+        "unit": "ms",
+        "p95_ms": round(p95, 2),
+        "transport_floor_p50_ms": round(floor_p50, 2),
+        "device_compute_p50_ms": round(max(p50 - floor_p50, 0.0), 2),
+        "n_docs": n_docs,
+        "k": k,
+        "vs_baseline": round(RAG_TARGET_P50_MS / p50, 3),
+    }
+
+
+def main() -> None:
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+    batch_size = 256
+    # Real checkpoint when the HF cache has it; otherwise random weights with
+    # the real WordPiece tokenizer — identical compute and tokenize cost.
+    enc = SentenceEncoder(
+        EncoderConfig.bge_small(),
+        checkpoint="BAAI/bge-small-en-v1.5",
+        batch_size=batch_size,
     )
+    tok_kind = type(enc.tokenizer).__name__
+
+    docs = make_docs(128 * batch_size)
+    ingest = bench_ingest(enc, docs, batch_size)
+    ingest["tokenizer"] = tok_kind
+    print(json.dumps(ingest), flush=True)
+
+    n_docs = int(os.environ.get("BENCH_RAG_DOCS", "1000000"))
+    rag = bench_rag(enc, n_docs)
+    print(json.dumps(rag), flush=True)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,9 @@ class Runtime:
         self._error_log_seq = 0
         self._error_log_seen: set = set()
         self._operator_subject_states: dict = {}
+        # stateful connectors with engine-accepted rows not yet claimed by
+        # their published scan state (blocks operator snapshots)
+        self._uncovered: set[str] = set()
         self._last_snapshot = 0.0
         from pathway_tpu.internals.monitoring import ProberStats
 
@@ -231,12 +234,23 @@ class Runtime:
             # stored scan state before going live
             for conn in self.connectors:
                 journal = self.persistence.load_journal(conn.name)
-                for _orig_time, deltas in journal:
-                    t = self._next_time()
-                    conn.node.accept(t, 0, deltas)
-                    while self.pending_times and min(self.pending_times) <= self.clock + 1:
-                        self._step_time(min(self.pending_times))
-                state = self.persistence.load_subject_state(conn.name)
+                last_state = None
+                for _orig_time, deltas, entry_state in journal:
+                    if deltas:
+                        t = self._next_time()
+                        conn.node.accept(t, 0, deltas)
+                        while self.pending_times and min(self.pending_times) <= self.clock + 1:
+                            self._step_time(min(self.pending_times))
+                    if entry_state is not None:
+                        last_state = entry_state
+                # states are embedded in journal entries (atomic with the
+                # rows they claim); the standalone state file is the
+                # pre-embedding fallback
+                state = (
+                    last_state
+                    if last_state is not None
+                    else self.persistence.load_subject_state(conn.name)
+                )
                 if state is not None and hasattr(conn.subject, "seek"):
                     conn.subject.seek(state)
 
@@ -276,26 +290,41 @@ class Runtime:
             )
             drained_subject_states: dict = {}
             saw_data = False
-            for conn, deltas, state in entries:
+            for conn, deltas, state, journal_rows in entries:
                 if deltas is None:
                     conn.finished = True
                     active -= 1
-                elif deltas:
+                    continue
+                if (
+                    self.persistence is not None
+                    and not operator_mode
+                    and journal_rows
+                ):
+                    # journal_rows arrive only when consistent with `state`:
+                    # stateless subjects journal write-ahead at every flush;
+                    # stateful subjects journal at subject commit boundaries
+                    # where the captured scan state claims exactly the
+                    # journaled prefix — carried in the same atomic append
+                    # (see io/_connector.py)
+                    self.persistence.journal_batch(
+                        conn.name, self.clock, journal_rows, state
+                    )
+                if state is not None:
+                    drained_subject_states[conn.name] = state
+                    self._uncovered.discard(conn.name)
+                elif (
+                    deltas
+                    and self.persistence is not None
+                    and hasattr(conn.subject, "snapshot_state")
+                ):
+                    # rows accepted whose effects a stateful subject's last
+                    # published state does not claim yet — an operator
+                    # snapshot taken now would double-count them on restore
+                    self._uncovered.add(conn.name)
+                if deltas:
                     saw_data = True
                     t = self._next_time()
                     self.stats.on_ingest(conn.name, len(deltas))
-                    if self.persistence is not None and not operator_mode:
-                        # write-ahead: the commit is durable before the
-                        # engine observes it (reference: input_snapshot.rs);
-                        # the subject state was captured atomically with
-                        # this very batch at flush time
-                        self.persistence.journal_batch(conn.name, t, deltas)
-                        if state is not None:
-                            self.persistence.save_subject_state(
-                                conn.name, state
-                            )
-                    if state is not None:
-                        drained_subject_states[conn.name] = state
                     conn.node.accept(t, 0, deltas)
             # step strictly in time order, re-reading pending_times each
             # round: stepping may schedule NEW times (forget-immediately
@@ -313,9 +342,14 @@ class Runtime:
                 # cut (reference: tracker.rs commit protocol). Rate-limited
                 # by snapshot_interval_ms — full-state pickling per commit
                 # is O(state); the consistent cut makes skipping safe.
+                # Skipped while any stateful subject has forwarded rows its
+                # published scan state does not claim yet (mid-scan timer
+                # flushes) — the next subject commit clears the set.
                 self._operator_subject_states.update(drained_subject_states)
                 now = _time.monotonic()
-                if (
+                if self._uncovered:
+                    pass
+                elif (
                     now - self._last_snapshot
                 ) * 1000.0 >= self.persistence.snapshot_interval_ms:
                     self._last_snapshot = now

@@ -1,19 +1,47 @@
 """Connector thread driver (reference: src/connectors/mod.rs:91 Connector —
-per-source thread reading into an mpsc channel drained by the main loop)."""
+per-source thread reading into an mpsc channel drained by the main loop).
+
+Queue protocol: each entry is ``(conn, deltas, state, journal_rows)``.
+``deltas`` are the rows the engine should accept this cycle (None = source
+finished). ``journal_rows`` are the rows persistence should append to the
+input journal with this entry, and ``state`` the subject scan state to save
+alongside. For stateful (rescannable) subjects these are only populated at
+subject-driven commit boundaries, where the subject's bookkeeping is up to
+date on its own thread — so the saved state claims exactly the journaled
+prefix. Mid-scan timer flushes forward rows for latency but defer journaling
+to the next boundary; a crash in between is recovered by rescan from the
+last consistent state (same stable keys), never by double-replay.
+Stateless subjects (no ``snapshot_state``) cannot rescan, so their rows are
+journaled write-ahead at every flush, exactly as before.
+"""
 
 from __future__ import annotations
 
 import queue
 import threading
 import time as _time
-from typing import Any, Callable
+from typing import Any
+
+# uncommitted-row backlog above which a stateful subject's rows are
+# journaled without a scan state (degrading recovery to at-least-once)
+# rather than growing host memory without bound
+_BACKLOG_CAP = 1_000_000
 
 
 def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     subject = conn.subject
     parser = conn.parser
     pending: list = []
+    # rows forwarded to the engine but not yet covered by a journal entry
+    # (stateful subjects only; tracked only when persistence is configured)
+    unjournaled: list = []
     lock = threading.Lock()
+    has_state = hasattr(subject, "snapshot_state")
+    runtime = getattr(getattr(conn, "node", None), "scope", None)
+    runtime = getattr(runtime, "runtime", None)
+    persisting = getattr(runtime, "persistence", None) is not None
+    warned_backlog = False
+    forwarded_since_boundary = 0
     # timer-based autocommit (reference: commit_duration cadence in the
     # worker poller, connectors/mod.rs): rows accumulate into one commit
     # until `autocommit_duration_ms` elapses or the subject commits
@@ -24,31 +52,80 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     duration_ms = getattr(subject, "_autocommit_duration_ms", None)
     last_flush = _time.monotonic()
 
+    def timer_flush() -> None:
+        nonlocal last_flush, warned_backlog, forwarded_since_boundary
+        last_flush = _time.monotonic()
+        with lock:
+            if not pending:
+                return
+            batch = pending.copy()
+            pending.clear()
+            forwarded_since_boundary += len(batch)
+            if has_state and persisting:
+                # the subject may be mid-scan on its own thread, so its
+                # bookkeeping can lag these rows — journaling them now with
+                # a concurrently captured state double-counts on restore
+                # (journal replay + rescan re-emitting the same keys)
+                unjournaled.extend(batch)
+                if len(unjournaled) > _BACKLOG_CAP:
+                    # subject never commits: journal stateless (at-least-once
+                    # for this span) rather than grow host memory unboundedly
+                    if not warned_backlog:
+                        warned_backlog = True
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "connector %s emitted %d rows without a "
+                            "commit() boundary; journaling them without a "
+                            "scan state (recovery degrades to "
+                            "at-least-once for this span). Stateful "
+                            "subjects should call commit() regularly.",
+                            getattr(conn, "name", "?"),
+                            len(unjournaled),
+                        )
+                    out_queue.put((conn, batch, None, unjournaled.copy()))
+                    unjournaled.clear()
+                else:
+                    out_queue.put((conn, batch, None, []))
+            elif has_state:
+                # no persistence configured: nothing to journal
+                out_queue.put((conn, batch, None, []))
+            else:
+                out_queue.put((conn, batch, None, batch))
+
+    def commit_flush() -> None:
+        # subject-driven boundary (subject.commit() / end of run()): runs on
+        # the subject thread after its bookkeeping was updated, so the
+        # captured state claims exactly journal ∪ backlog ∪ this batch
+        nonlocal last_flush, forwarded_since_boundary
+        last_flush = _time.monotonic()
+        with lock:
+            batch = pending.copy()
+            pending.clear()
+            if has_state:
+                journal_rows = unjournaled + batch
+                unjournaled.clear()
+                # publish a state even with an empty journal batch when rows
+                # were forwarded since the last boundary (operator-snapshot
+                # mode needs the state to cover them)
+                dirty = bool(journal_rows) or forwarded_since_boundary > 0
+                forwarded_since_boundary = 0
+                if dirty:
+                    state = subject.snapshot_state()
+                    out_queue.put((conn, batch, state, journal_rows))
+            elif batch:
+                out_queue.put((conn, batch, None, batch))
+
     def emit(message: Any) -> None:
         deltas = parser(message)
         if deltas:
             with lock:
                 pending.extend(deltas)
-            if duration_ms is None:
-                flush()
-            elif (_time.monotonic() - last_flush) * 1000.0 >= duration_ms:
-                flush()
-
-    def flush() -> None:
-        nonlocal last_flush
-        last_flush = _time.monotonic()
-        with lock:
-            if pending:
-                # subject scan state captured WITH the batch: on restore,
-                # the journaled prefix and the seek state agree (a snapshot
-                # taken later could claim rows the journal never got)
-                state = (
-                    subject.snapshot_state()
-                    if hasattr(subject, "snapshot_state")
-                    else None
-                )
-                out_queue.put((conn, pending.copy(), state))
-                pending.clear()
+            if (
+                duration_ms is None
+                or (_time.monotonic() - last_flush) * 1000.0 >= duration_ms
+            ):
+                timer_flush()
 
     def force_flush() -> None:
         # called from the runtime loop's cadence; respects the autocommit
@@ -58,11 +135,11 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
             and (_time.monotonic() - last_flush) * 1000.0 < duration_ms
         ):
             return
-        flush()
+        timer_flush()
 
     conn.force_flush = force_flush
 
-    subject._attach(emit, flush)
+    subject._attach(emit, commit_flush)
     try:
         subject.run()
     except Exception as exc:  # surfaced by the main loop
@@ -72,5 +149,5 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
             subject.on_stop()
         except Exception:
             pass
-        flush()
-        out_queue.put((conn, None, None))
+        commit_flush()
+        out_queue.put((conn, None, None, []))

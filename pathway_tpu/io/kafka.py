@@ -38,14 +38,23 @@ class _KafkaSubject(ConnectorSubject):
         self._stop = False
         self._offsets: dict = {}
 
+    # commit cadence: the connector protocol journals a stateful subject's
+    # rows only at its commit() boundaries (io/_connector.py), so offsets
+    # must be committed regularly — on idle polls and every N messages
+    _COMMIT_EVERY = 1000
+
     def run(self):
         ck = _require_kafka()
         consumer = ck.Consumer(self.settings)
         consumer.subscribe(self.topics)
+        since_commit = 0
         try:
             while not self._stop:
                 msg = consumer.poll(0.5)
                 if msg is None or msg.error():
+                    if since_commit:
+                        self.commit()
+                        since_commit = 0
                     continue
                 raw = msg.value()
                 self._offsets[(msg.topic(), msg.partition())] = msg.offset()
@@ -59,7 +68,13 @@ class _KafkaSubject(ConnectorSubject):
                     self.next_str(
                         raw.decode() if isinstance(raw, bytes) else raw
                     )
+                since_commit += 1
+                if since_commit >= self._COMMIT_EVERY:
+                    self.commit()
+                    since_commit = 0
         finally:
+            if since_commit:
+                self.commit()
             consumer.close()
 
     def on_stop(self):

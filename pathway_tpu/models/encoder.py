@@ -69,7 +69,9 @@ class _Block(nn.Module):
         )(x, x, mask=mask)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x + attn_out)
         h = nn.Dense(cfg.mlp, dtype=cfg.dtype, name="mlp_in")(x)
-        h = nn.gelu(h)
+        # erf-based gelu: HF BERT uses the exact form; the approximate tanh
+        # form drifts ~1e-3 and breaks checkpoint parity
+        h = nn.gelu(h, approximate=False)
         h = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="mlp_out")(h)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x + h)
         return x
@@ -88,7 +90,13 @@ class TransformerEncoder(nn.Module):
         pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype, name="pos_embed")(
             jnp.arange(L)[None, :]
         )
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos)
+        # single-segment encoding: BERT's token_type embedding collapses to
+        # one learned row added everywhere (kept as a 2-row table so HF
+        # checkpoints load losslessly)
+        typ = nn.Embed(2, cfg.hidden, dtype=cfg.dtype, name="type_embed")(
+            jnp.zeros((1, 1), jnp.int32)
+        )
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(tok + pos + typ)
         attn_mask = nn.make_attention_mask(mask, mask, dtype=cfg.dtype)
         for i in range(cfg.layers):
             x = _Block(cfg, name=f"block_{i}")(x, attn_mask)
@@ -130,13 +138,30 @@ class SentenceEncoder:
         self,
         config: EncoderConfig | None = None,
         *,
+        checkpoint: str | None = None,
         tokenizer_path: str | None = None,
         seed: int = 0,
         batch_size: int = 256,
         params: Any = None,
     ):
+        tokenizer = None
+        if checkpoint is not None and params is None:
+            # Real HF weights when the checkpoint resolves offline (e.g.
+            # "BAAI/bge-small-en-v1.5" in a populated HF cache); falls back
+            # to random init + the trained WordPiece vocab otherwise.
+            try:
+                from pathway_tpu.models.hf_loader import load_bert_encoder
+                from pathway_tpu.models.tokenizer import _HFTokenizerAdapter
+
+                config, params, hf_tok = load_bert_encoder(checkpoint)
+                tokenizer = _HFTokenizerAdapter(hf_tok, config.max_len)
+            except OSError:
+                # checkpoint not in the local HF cache (zero-egress hosts):
+                # random init + trained WordPiece vocab. Any other exception
+                # is a real loader/geometry bug and must surface.
+                pass
         self.config = config or EncoderConfig.bge_small()
-        self.tokenizer = get_tokenizer(
+        self.tokenizer = tokenizer or get_tokenizer(
             tokenizer_path,
             vocab_size=self.config.vocab_size,
             max_length=self.config.max_len,

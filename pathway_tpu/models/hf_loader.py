@@ -1,0 +1,116 @@
+"""HF BERT checkpoint → Flax TransformerEncoder weight loader.
+
+The reference embeds with real sentence-transformers checkpoints
+(/root/reference/python/pathway/xpacks/llm/embedders.py:270-329, torch).
+Here the torch state dict of any BERT-family encoder (bge-small/base,
+all-MiniLM, etc.) is name-mapped into the params of
+pathway_tpu.models.encoder.TransformerEncoder, whose forward was written to
+be numerically identical to HF `BertModel` + mean-pool + L2-normalize
+(bge-style sentence embedding).
+
+Loading is strictly offline (`local_files_only=True`) — this environment has
+zero egress; on hosts with a populated HF cache `load_bert_encoder("BAAI/
+bge-small-en-v1.5")` produces the real production weights. The numerical
+parity contract is pinned by tests/test_hf_parity.py against a locally
+constructed, seeded torch BertModel of the same geometry.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.models.encoder import EncoderConfig
+
+
+def bert_state_dict_to_flax(state_dict: dict[str, Any], config: EncoderConfig):
+    """Map a torch `BertModel` state dict onto TransformerEncoder params.
+
+    Accepts torch tensors or numpy arrays as values. Returns a nested dict
+    suitable for `model.apply({"params": params}, ...)` (f32 leaves).
+    """
+
+    def g(name: str) -> np.ndarray:
+        t = state_dict[name]
+        if hasattr(t, "detach"):
+            t = t.detach().cpu().numpy()
+        return np.asarray(t, np.float32)
+
+    H, heads = config.hidden, config.heads
+    hd = H // heads
+
+    def dense(prefix: str) -> dict[str, np.ndarray]:
+        # torch Linear stores weight [out, in]; flax kernel is [in, out]
+        return {"kernel": g(prefix + ".weight").T, "bias": g(prefix + ".bias")}
+
+    def qkv(prefix: str) -> dict[str, np.ndarray]:
+        # flax DenseGeneral per-head kernel [in, heads, head_dim]
+        return {
+            "kernel": g(prefix + ".weight").T.reshape(H, heads, hd),
+            "bias": g(prefix + ".bias").reshape(heads, hd),
+        }
+
+    def ln(prefix: str) -> dict[str, np.ndarray]:
+        return {"scale": g(prefix + ".weight"), "bias": g(prefix + ".bias")}
+
+    params: dict[str, Any] = {
+        "tok_embed": {"embedding": g("embeddings.word_embeddings.weight")},
+        "pos_embed": {"embedding": g("embeddings.position_embeddings.weight")},
+        "type_embed": {"embedding": g("embeddings.token_type_embeddings.weight")},
+        "ln_embed": ln("embeddings.LayerNorm"),
+    }
+    for i in range(config.layers):
+        p = f"encoder.layer.{i}."
+        params[f"block_{i}"] = {
+            "attention": {
+                "query": qkv(p + "attention.self.query"),
+                "key": qkv(p + "attention.self.key"),
+                "value": qkv(p + "attention.self.value"),
+                "out": {
+                    # torch weight [H, H] maps heads*head_dim -> H; flax out
+                    # kernel is [heads, head_dim, H]
+                    "kernel": g(p + "attention.output.dense.weight").T.reshape(
+                        heads, hd, H
+                    ),
+                    "bias": g(p + "attention.output.dense.bias"),
+                },
+            },
+            "ln_attn": ln(p + "attention.output.LayerNorm"),
+            "mlp_in": dense(p + "intermediate.dense"),
+            "mlp_out": dense(p + "output.dense"),
+            "ln_mlp": ln(p + "output.LayerNorm"),
+        }
+    return params
+
+
+def config_from_hf(hf_config) -> EncoderConfig:
+    """EncoderConfig matching an HF `BertConfig`."""
+    return EncoderConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden=hf_config.hidden_size,
+        layers=hf_config.num_hidden_layers,
+        heads=hf_config.num_attention_heads,
+        mlp=hf_config.intermediate_size,
+        max_len=hf_config.max_position_embeddings,
+    )
+
+
+def load_bert_encoder(model_name_or_path: str):
+    """Load a local HF BERT checkpoint: returns (config, params, tokenizer).
+
+    Raises OSError when the checkpoint is not available offline — callers
+    fall back to random init + the trained WordPiece vocab.
+    """
+    from transformers import AutoConfig, AutoModel, AutoTokenizer
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path, local_files_only=True)
+    model = AutoModel.from_pretrained(model_name_or_path, local_files_only=True)
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+    config = config_from_hf(hf_cfg)
+    sd = model.state_dict()
+    # strip the "bert." prefix some checkpoints carry
+    if any(k.startswith("bert.") for k in sd):
+        sd = {k[len("bert."):]: v for k, v in sd.items() if k.startswith("bert.")}
+    params = bert_state_dict_to_flax(sd, config)
+    return config, params, tokenizer

@@ -86,7 +86,7 @@ class KnnShard:
         dimension: int,
         metric: Metric | str = Metric.COS,
         *,
-        chunk: int = 8192,
+        chunk: int | None = None,  # None = auto-scale to the scores budget
         precision: str = "highest",
         capacity: int = _MIN_CAPACITY,
         device: Any | None = None,
@@ -194,7 +194,7 @@ class KnnShard:
         if n == 0 or not self.key_to_slot:
             return [[] for _ in range(n)]
         # top_k per scored block cannot exceed the block width
-        k_eff = min(k, self.capacity, self.chunk)
+        k_eff = min(k, self.capacity, self.chunk or 8192)
         padded_n = 1
         while padded_n < n:
             padded_n *= 2

@@ -32,7 +32,7 @@ class QueryEngine:
         model = encoder.model
         chunk = shard.chunk
         precision = shard.precision
-        k_eff = min(k, shard.chunk)
+        k_eff = min(k, shard.capacity, shard.chunk or 8192)
         from pathway_tpu.ops.knn import Metric
 
         # encoder outputs are L2-normalized, so cos == dot on the query
@@ -76,7 +76,7 @@ class QueryEngine:
             raise ValueError(
                 "QueryEngine packed readback supports shards < 16.7M rows"
             )
-        k_eff = min(self.k, self.shard.chunk)
+        k_eff = min(self.k, self.shard.capacity, self.shard.chunk or 8192)
         packed = self._fn(
             self.encoder.params,
             jnp.asarray(ids_p),

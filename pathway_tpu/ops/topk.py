@@ -38,13 +38,30 @@ def merge_topk(vals_a, idx_a, vals_b, idx_b, k: int):
     return top_vals, top_idx
 
 
+_SCORES_BUDGET_BYTES = 1 << 28  # 256 MB of f32 scores per block
+
+
+def auto_chunk(cap: int, n_queries: int) -> int:
+    """Largest pow2 block whose [q, chunk] f32 score matrix fits the budget.
+
+    Small fixed chunks serialize the scan into latency-bound steps (a 1M-row
+    index in 8192-row blocks is 128 sequential tiny matmuls ≈ 100+ ms); one
+    block per ~256 MB keeps the MXU busy and the merge tree shallow.
+    """
+    rows = max(8192, _SCORES_BUDGET_BYTES // (4 * max(n_queries, 1)))
+    b = 8192
+    while b * 2 <= rows:
+        b *= 2
+    return min(b, cap)
+
+
 def chunked_topk_scores(
     queries: jax.Array,   # [q, d] f32
     database: jax.Array,  # [cap, d] f32
     valid: jax.Array,     # [cap] bool
     k: int,
     *,
-    chunk: int = 8192,
+    chunk: int | None = None,
     sq_norms: jax.Array | None = None,  # [cap] f32, for l2 metric
     metric: str = "dot",
     precision: str = "highest",
@@ -66,6 +83,8 @@ def chunked_topk_scores(
     """
     q, d = queries.shape
     cap = database.shape[0]
+    if chunk is None:
+        chunk = auto_chunk(cap, q)
     if cap <= chunk:
         scores = _block_scores(queries, database, sq_norms, metric, precision)
         return masked_topk(scores, valid[None, :], k)

@@ -138,8 +138,13 @@ class PersistenceManager:
         self.lock = threading.Lock()
 
     # -- journaling (write-ahead, called before the engine steps) ----------
-    def journal_batch(self, conn_name: str, time: int, deltas: list) -> None:
-        payload = pickle.dumps((time, deltas))
+    def journal_batch(
+        self, conn_name: str, time: int, deltas: list, state: Any = None
+    ) -> None:
+        # the subject scan state rides INSIDE the journal entry: one atomic
+        # append, so the journaled prefix and the state that claims it can
+        # never diverge across a crash (two separate writes could)
+        payload = pickle.dumps((time, deltas, state))
         header = len(payload).to_bytes(8, "little")
         with self.lock:
             self.backend.append(f"journal/{conn_name}", header + payload)
@@ -151,7 +156,7 @@ class PersistenceManager:
             )
 
     # -- restore ------------------------------------------------------------
-    def load_journal(self, conn_name: str) -> list[tuple[int, list]]:
+    def load_journal(self, conn_name: str) -> list[tuple[int, list, Any]]:
         raw = self.backend.read(f"journal/{conn_name}")
         if not raw:
             return []
@@ -162,7 +167,10 @@ class PersistenceManager:
             pos += 8
             if pos + n > len(raw):
                 break  # torn tail from a crash mid-append: drop it
-            out.append(pickle.loads(raw[pos : pos + n]))
+            entry = pickle.loads(raw[pos : pos + n])
+            if len(entry) == 2:  # pre-state journal format
+                entry = (*entry, None)
+            out.append(entry)
             pos += n
         return out
 

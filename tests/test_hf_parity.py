@@ -1,0 +1,188 @@
+"""Numerical parity: Flax TransformerEncoder vs torch HF BertModel.
+
+The reference's SentenceTransformerEmbedder runs real HF checkpoints in
+torch (/root/reference/python/pathway/xpacks/llm/embedders.py:270-329). Our
+loader (pathway_tpu/models/hf_loader.py) must map any BERT-family state dict
+onto the Flax encoder with no numerical drift. This environment has zero
+egress and no cached checkpoint, so the oracle is a locally constructed,
+seeded torch `BertModel` with the exact bge-small-en-v1.5 geometry — the
+weight-mapping and forward-pass math being verified are identical to what a
+real checkpoint exercises.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from pathway_tpu.models.encoder import EncoderConfig, TransformerEncoder
+from pathway_tpu.models.hf_loader import bert_state_dict_to_flax, config_from_hf
+from pathway_tpu.models.tokenizer import wordpiece_tokenizer
+
+SENTENCES = [
+    "the quick brown fox jumps over the lazy dog",
+    "a streaming dataflow framework for real time analytics",
+    "tensor processing units multiply matrices in systolic arrays",
+    "incremental computation maintains results under insertions and deletions",
+    "the embedding model maps each sentence to a dense vector",
+    "nearest neighbor search retrieves the most similar documents",
+    "checkpointing allows the pipeline to resume after failures",
+    "windows group events by time for aggregation",
+] * 4  # 32 sentences
+
+
+def _bge_small_torch(seed: int = 0):
+    cfg = transformers.BertConfig(
+        vocab_size=30522,
+        hidden_size=384,
+        num_hidden_layers=12,
+        num_attention_heads=12,
+        intermediate_size=1536,
+        max_position_embeddings=512,
+    )
+    torch.manual_seed(seed)
+    model = transformers.BertModel(cfg)
+    model.eval()
+    return cfg, model
+
+
+def _torch_sentence_embed(model, ids, mask):
+    """HF BertModel + mean-pool + L2 normalize (bge pooling) in torch."""
+    with torch.no_grad():
+        out = model(
+            input_ids=torch.from_numpy(ids).long(),
+            attention_mask=torch.from_numpy(mask).long(),
+        ).last_hidden_state
+        m = torch.from_numpy(mask).unsqueeze(-1).float()
+        pooled = (out * m).sum(1) / m.sum(1).clamp(min=1.0)
+        pooled = torch.nn.functional.normalize(pooled, dim=-1)
+    return pooled.numpy()
+
+
+def test_bge_small_parity_cosine():
+    hf_cfg, torch_model = _bge_small_torch()
+    config = config_from_hf(hf_cfg)
+    # f32 activations for an exact comparison (flagship runs bf16 on TPU)
+    config = EncoderConfig(
+        vocab_size=config.vocab_size,
+        hidden=config.hidden,
+        layers=config.layers,
+        heads=config.heads,
+        mlp=config.mlp,
+        max_len=config.max_len,
+        dtype=jnp.float32,
+    )
+    params = bert_state_dict_to_flax(torch_model.state_dict(), config)
+
+    tok = wordpiece_tokenizer(max_length=64)
+    ids, mask = tok(SENTENCES)
+
+    ours = np.asarray(
+        TransformerEncoder(config).apply(
+            {"params": params}, jnp.asarray(ids), jnp.asarray(mask)
+        )
+    )
+    theirs = _torch_sentence_embed(torch_model, ids, mask)
+
+    cos = np.sum(ours * theirs, axis=-1)  # both L2-normalized
+    assert cos.shape == (len(SENTENCES),)
+    assert np.all(cos >= 0.999), f"min cosine {cos.min()}"
+    # embeddings are unit-norm
+    np.testing.assert_allclose(np.linalg.norm(ours, axis=-1), 1.0, atol=1e-5)
+
+
+def test_loader_roundtrip_shapes():
+    hf_cfg, torch_model = _bge_small_torch(seed=1)
+    config = config_from_hf(hf_cfg)
+    params = bert_state_dict_to_flax(torch_model.state_dict(), config)
+    assert params["tok_embed"]["embedding"].shape == (30522, 384)
+    assert params["type_embed"]["embedding"].shape == (2, 384)
+    assert params["block_0"]["attention"]["query"]["kernel"].shape == (384, 12, 32)
+    assert params["block_11"]["attention"]["out"]["kernel"].shape == (12, 32, 384)
+    # init-tree compatibility: converted params drop into the module's own tree
+    import jax
+
+    model = TransformerEncoder(config)
+    ref = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                     jnp.ones((1, 8), jnp.int32))["params"]
+    ref_shapes = jax.tree.map(lambda a: a.shape, ref)
+    got_shapes = jax.tree.map(lambda a: a.shape, params)
+    assert ref_shapes == got_shapes
+
+
+def test_wordpiece_tokenizer_real():
+    tok = wordpiece_tokenizer(max_length=32)
+    ids, mask = tok(["streaming dataflow computation", "the the the"])
+    assert ids.shape == mask.shape and ids.shape[0] == 2
+    # CLS/SEP framing and no UNK explosion on plain English
+    assert ids[0, 0] == 2 and ids[0][mask[0].sum() - 1] == 3
+    unk_rate = float(np.mean(ids[mask.astype(bool)] == 1))
+    assert unk_rate < 0.05
+
+
+def test_wordpiece_matches_hf_fast_tokenizer():
+    """Our memoized WordPiece must be token-identical to BertTokenizerFast
+    over the same vocab — normalization, punctuation splitting, greedy
+    longest-match, truncation included."""
+    from pathway_tpu.models.tokenizer import _VOCAB_ASSET
+    from pathway_tpu.models.wordpiece import WordPieceTokenizer
+
+    hf = wordpiece_tokenizer(max_length=16)
+    ours = WordPieceTokenizer(_VOCAB_ASSET, max_length=16)
+
+    cases = [
+        "The quick brown fox jumps over the lazy dog.",
+        "hello,world!  multiple   spaces\tand\ttabs",
+        "CamelCase UPPERCASE lowercase MiXeD",
+        "numbers 12345 and hyphen-ated words",
+        "accented: café naïve résumé Zürich",
+        "punctuation!!! ... (parens) [brackets] {braces} a+b=c",
+        "a",
+        "",
+        "supercalifragilisticexpialidocious antidisestablishmentarianism",
+        "unicode: 你好 world — em-dash and 'quotes'",
+        "very long sentence " * 20,  # exercises truncation mid-word
+        "trailing space ",
+        "\n\nleading newlines",
+        "x" * 150,  # beyond max_input_chars_per_word -> [UNK]
+    ]
+    for text in cases:
+        ids_hf, mask_hf = hf([text])
+        ids_us, mask_us = ours([text])
+        assert ids_hf.tolist() == ids_us.tolist(), f"ids diverge on {text!r}"
+        assert mask_hf.tolist() == mask_us.tolist(), f"mask diverges on {text!r}"
+
+    # batch padding parity
+    batch = cases[:6]
+    ids_hf, mask_hf = hf(batch)
+    ids_us, mask_us = ours(batch)
+    assert ids_hf.tolist() == ids_us.tolist()
+    assert mask_hf.tolist() == mask_us.tolist()
+
+
+def test_wordpiece_memo_speed():
+    """The memoized path must beat the HF fast tokenizer on repeated-word
+    corpora (single-core streaming hot path)."""
+    import time
+
+    from pathway_tpu.models.tokenizer import _VOCAB_ASSET
+    from pathway_tpu.models.wordpiece import WordPieceTokenizer
+
+    ours = WordPieceTokenizer(_VOCAB_ASSET, max_length=512)
+    rng = np.random.default_rng(0)
+    with open(_VOCAB_ASSET, encoding="utf-8") as f:
+        words = [w for w in (l.strip() for l in f) if w.isalpha() and len(w) > 2][:5000]
+    docs = [
+        " ".join(words[j] for j in rng.integers(0, len(words), size=90))
+        for _ in range(512)
+    ]
+    ours(docs[:64])  # warm the memo
+    t0 = time.perf_counter()
+    ours(docs)
+    dt = time.perf_counter() - t0
+    assert len(docs) / dt > 4000, f"memoized WordPiece too slow: {len(docs)/dt:.0f} docs/s"

@@ -121,14 +121,14 @@ def test_persistence_backend_journal_roundtrip(tmp_path):
     )
     mgr = PersistenceManager(cfg)
     mgr.journal_batch("c1", 2, [(1, ("a",), 1)])
-    mgr.journal_batch("c1", 4, [(1, ("a",), -1), (2, ("b",), 1)])
+    mgr.journal_batch("c1", 4, [(1, ("a",), -1), (2, ("b",), 1)], {"pos": 7})
     mgr.save_subject_state("c1", {"pos": 7})
 
     mgr2 = PersistenceManager(cfg)
     journal = mgr2.load_journal("c1")
     assert journal == [
-        (2, [(1, ("a",), 1)]),
-        (4, [(1, ("a",), -1), (2, ("b",), 1)]),
+        (2, [(1, ("a",), 1)], None),
+        (4, [(1, ("a",), -1), (2, ("b",), 1)], {"pos": 7}),
     ]
     assert mgr2.load_subject_state("c1") == {"pos": 7}
 
@@ -145,7 +145,7 @@ def test_torn_journal_tail_dropped(tmp_path):
     # simulate crash mid-append: garbage partial record at the tail
     mgr.backend.append("journal/c1", (999).to_bytes(8, "little") + b"par")
     journal = PersistenceManager(cfg).load_journal("c1")
-    assert journal == [(2, [(1, ("a",), 1)])]
+    assert journal == [(2, [(1, ("a",), 1)], None)]
 
 
 def test_wordcount_operator_snapshot_recover(tmp_path):
@@ -219,3 +219,85 @@ def test_index_adapter_snapshot_roundtrip():
     q = _Bm25Adapter()
     q.load_state(p.snapshot_state())
     assert q.search([("fox", 2, None)]) == p.search([("fox", 2, None)])
+
+
+def test_midscan_force_flush_defers_journaling():
+    """A runtime-cadence flush while a stateful subject is mid-scan must NOT
+    journal rows (the subject's bookkeeping may lag them); the next
+    subject-driven commit journals the backlog atomically with a state that
+    claims it (ADVICE r1: snapshot race broke exactly-once)."""
+    import queue
+    import threading
+
+    from pathway_tpu.io._connector import run_connector_thread
+
+    class _Subject:
+        _autocommit_duration_ms = None  # flush per emit
+
+        def __init__(self):
+            self.bookkept = []
+            self.mid_scan = threading.Event()
+            self.resume = threading.Event()
+
+        def _attach(self, emit, flush):
+            self._emit = emit
+            self._flush = flush
+
+        def run(self):
+            # emit two rows, then pause BEFORE updating bookkeeping —
+            # modelling fs._scan_once between upserts and _seen/_emitted
+            self._emit(("row", "a"))
+            self._emit(("row", "b"))
+            self.mid_scan.set()
+            assert self.resume.wait(5)
+            self.bookkept = ["a", "b"]
+            self._flush()  # subject commit boundary
+
+        def on_stop(self):
+            pass
+
+        def snapshot_state(self):
+            return {"bookkept": list(self.bookkept)}
+
+    class _Conn:
+        pass
+
+    import types
+
+    subject = _Subject()
+    conn = _Conn()
+    conn.subject = subject
+    # persistence configured -> the thread tracks the unjournaled backlog
+    conn.node = types.SimpleNamespace(
+        scope=types.SimpleNamespace(
+            runtime=types.SimpleNamespace(persistence=object())
+        )
+    )
+    conn.parser = lambda msg: [(msg[1], (msg[1],), 1)]
+    q: "queue.Queue" = queue.Queue()
+    t = threading.Thread(target=run_connector_thread, args=(conn, q), daemon=True)
+    t.start()
+    assert subject.mid_scan.wait(5)
+    # runtime-cadence flush while the subject is mid-scan (pending is empty
+    # here — per-emit flushes already forwarded the rows — so this pins that
+    # force_flush never fabricates a journal entry mid-scan)
+    conn.force_flush()
+    entries = [q.get(timeout=5), q.get(timeout=5)]  # the two emit flushes
+    subject.resume.set()
+    t.join(timeout=5)
+    # drain the boundary entry and the finish sentinel
+    while True:
+        entry = q.get(timeout=5)
+        entries.append(entry)
+        if entry[1] is None:
+            break
+
+    data_entries = [e for e in entries if e[1] is not None]
+    # mid-scan flushes forwarded rows but journaled nothing, carried no state
+    assert [d[0] for e in data_entries[:2] for d in e[1]] == ["a", "b"]
+    for e in data_entries[:2]:
+        assert e[2] is None and e[3] == []
+    # commit boundary journaled the backlog atomically with a claiming state
+    boundary = data_entries[-1]
+    assert boundary[2] == {"bookkept": ["a", "b"]}
+    assert [d[0] for d in boundary[3]] == ["a", "b"]
