@@ -179,6 +179,19 @@ def main() -> None:
     rag = bench_rag(enc, n_docs)
     print(json.dumps(rag), flush=True)
 
+    # relational plane: streaming wordcount through the sharded native
+    # group-by executor (prints its own JSON line)
+    import importlib.util
+
+    rel_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "bench_relational.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_relational", rel_path)
+    rel = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rel)
+    rel.main(200_000)
+
 
 if __name__ == "__main__":
     main()

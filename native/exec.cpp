@@ -1,0 +1,709 @@
+/* Sharded native group-by executor — the multi-worker relational engine
+ * core (reference: N timely workers each owning a key shard with exchange
+ * at groupby boundaries, src/engine/dataflow.rs:5538, dataflow/shard.rs;
+ * semigroup reducers, src/engine/reduce.rs:40).
+ *
+ * Model: a GroupStore holds W shard-local hash maps (W = PATHWAY_THREADS).
+ * Each delta batch is processed in three phases:
+ *   1. extract (GIL): grouping values are serialized to injective byte
+ *      keys, reducer args to tagged scalars, diffs to i64. Unsupported
+ *      values raise Fallback — the node migrates to the Python path.
+ *   2. apply (GIL RELEASED): rows are partitioned by hash(key) % W and W
+ *      threads update their shard maps independently — the in-process
+ *      equivalent of the reference's exchange + per-worker state. This is
+ *      where multi-core scaling happens.
+ *   3. emit (GIL): new groups get their output Pointer minted by the
+ *      Python key_fn (once per group lifetime); before/after reducer
+ *      values that changed become retract/insert delta pairs.
+ *
+ * Reducers: count / sum (int-exact, float-promoting, ERROR-poisoning,
+ * None-skipping) / avg — the abelian set from internals/reducers.py.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+PyObject *FallbackError = nullptr;
+
+/* ---- tagged scalar for reducer args ---------------------------------- */
+
+enum ValTag : uint8_t { V_NONE, V_ERR, V_INT, V_FLT };
+
+struct Val {
+    ValTag tag;
+    int64_t i;
+    double f;
+};
+
+/* ---- per-spec reducer state ------------------------------------------ */
+
+enum Code : uint8_t { C_COUNT, C_SUM, C_AVG };
+
+struct SState {
+    int64_t cnt = 0;     /* numeric contributions (sum/avg) or row count */
+    __int128 isum = 0;   /* exact for any i64 args at any realistic count */
+    double fsum = 0.0;
+    bool isfloat = false;
+    int64_t err = 0;
+};
+
+struct Group {
+    int64_t total = 0;       /* multiset row count of the group */
+    PyObject *gvals = nullptr;   /* owned: grouping-values tuple */
+    PyObject *out_key = nullptr; /* owned: output Pointer (minted lazily) */
+    std::vector<SState> st;
+};
+
+struct Shard {
+    std::unordered_map<std::string, Group> groups;
+};
+
+struct GroupStore {
+    int n_shards;
+    std::vector<uint8_t> codes;
+    std::vector<Shard> shards;
+};
+
+void store_destructor(PyObject *capsule)
+{
+    auto *s = static_cast<GroupStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.GroupStore"));
+    if (s == nullptr)
+        return;
+    for (auto &sh : s->shards)
+        for (auto &kv : sh.groups) {
+            Py_XDECREF(kv.second.gvals);
+            Py_XDECREF(kv.second.out_key);
+        }
+    delete s;
+}
+
+GroupStore *get_store(PyObject *capsule)
+{
+    return static_cast<GroupStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.GroupStore"));
+}
+
+/* ---- injective serialization of grouping tuples ----------------------
+ * Internal to the store (output keys come from the Python key_fn), so the
+ * format only needs injectivity, not parity with api._value_to_bytes. */
+
+bool ser_value(std::string &out, PyObject *v)
+{
+    if (v == Py_None) {
+        out.push_back('\x01');
+        return true;
+    }
+    /* numeric normalization: Python dict keys make True == 1 == 1.0 the
+     * same group, so bools and integral floats serialize as ints */
+    if (PyBool_Check(v)) {
+        int64_t i = v == Py_True ? 1 : 0;
+        out.push_back('I');
+        out.append(reinterpret_cast<char *>(&i), 8);
+        return true;
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        if (d == (double)(int64_t)d && d >= -9.2e18 && d <= 9.2e18) {
+            int64_t i = (int64_t)d;
+            out.push_back('I');
+            out.append(reinterpret_cast<char *>(&i), 8);
+            return true;
+        }
+        out.push_back('F');
+        out.append(reinterpret_cast<char *>(&d), 8);
+        return true;
+    }
+    if (PyLong_Check(v)) {
+        int overflow = 0;
+        int64_t i = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (!overflow) {
+            out.push_back('I');
+            out.append(reinterpret_cast<char *>(&i), 8);
+            return true;
+        }
+        /* 128-bit Pointers and big ints: hex digest via Python */
+        PyObject *hex = PyNumber_ToBase(v, 16);
+        if (hex == nullptr)
+            return false;
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(hex, &n);
+        if (s == nullptr) {
+            Py_DECREF(hex);
+            return false;
+        }
+        uint32_t len = (uint32_t)n;
+        out.push_back('H');
+        out.append(reinterpret_cast<char *>(&len), 4);
+        out.append(s, n);
+        Py_DECREF(hex);
+        return true;
+    }
+    if (PyUnicode_Check(v)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (s == nullptr)
+            return false;
+        uint32_t len = (uint32_t)n;
+        out.push_back('S');
+        out.append(reinterpret_cast<char *>(&len), 4);
+        out.append(s, n);
+        return true;
+    }
+    if (PyBytes_Check(v)) {
+        uint32_t len = (uint32_t)PyBytes_GET_SIZE(v);
+        out.push_back('Y');
+        out.append(reinterpret_cast<char *>(&len), 4);
+        out.append(PyBytes_AS_STRING(v), len);
+        return true;
+    }
+    return false; /* tuples/arrays/Json etc.: Python path */
+}
+
+bool ser_gvals(std::string &out, PyObject *gvals)
+{
+    if (!PyTuple_Check(gvals))
+        return false;
+    Py_ssize_t n = PyTuple_GET_SIZE(gvals);
+    uint32_t un = (uint32_t)n;
+    out.append(reinterpret_cast<char *>(&un), 4);
+    for (Py_ssize_t i = 0; i < n; i++)
+        if (!ser_value(out, PyTuple_GET_ITEM(gvals, i)))
+            return false;
+    return true;
+}
+
+/* ---- reducer math ----------------------------------------------------- */
+
+inline void apply_spec(uint8_t code, SState &s, const Val &v, int64_t diff)
+{
+    switch (code) {
+    case C_COUNT:
+        s.cnt += diff;
+        break;
+    case C_SUM:
+    case C_AVG:
+        switch (v.tag) {
+        case V_NONE:
+            break;
+        case V_ERR:
+            s.err += diff;
+            break;
+        case V_INT:
+            s.isum += (__int128)v.i * (__int128)diff;
+            s.cnt += diff;
+            break;
+        case V_FLT:
+            s.fsum += v.f * (double)diff;
+            s.isfloat = true;
+            s.cnt += diff;
+            break;
+        }
+        break;
+    }
+}
+
+/* exact Python int from __int128 (rare >i64 path goes via decimal text) */
+PyObject *pylong_from_i128(__int128 v)
+{
+    if (v >= INT64_MIN && v <= INT64_MAX)
+        return PyLong_FromLongLong((int64_t)v);
+    char buf[48];
+    char *p = buf + sizeof(buf);
+    *--p = '\0';
+    bool neg = v < 0;
+    unsigned __int128 u = neg ? (unsigned __int128)(-v) : (unsigned __int128)v;
+    do {
+        *--p = (char)('0' + (int)(u % 10));
+        u /= 10;
+    } while (u != 0);
+    if (neg)
+        *--p = '-';
+    return PyLong_FromString(p, nullptr, 10);
+}
+
+/* finish: build the Python value for one spec state (GIL held) */
+PyObject *finish_spec(uint8_t code, const SState &s, PyObject *error_obj)
+{
+    switch (code) {
+    case C_COUNT:
+        return PyLong_FromLongLong(s.cnt);
+    case C_SUM:
+        if (s.err > 0) {
+            Py_INCREF(error_obj);
+            return error_obj;
+        }
+        if (s.cnt <= 0)
+            Py_RETURN_NONE;
+        if (s.isfloat)
+            return PyFloat_FromDouble(s.fsum + (double)s.isum);
+        return pylong_from_i128(s.isum);
+    case C_AVG:
+        if (s.err > 0) {
+            Py_INCREF(error_obj);
+            return error_obj;
+        }
+        if (s.cnt <= 0)
+            Py_RETURN_NONE;
+        return PyFloat_FromDouble((s.fsum + (double)s.isum) / (double)s.cnt);
+    }
+    Py_RETURN_NONE;
+}
+
+/* semantic equality of FINISHED values (not raw state): a batch that moves
+ * the state without moving the output (e.g. a None/0-contributing row)
+ * must emit nothing — the Python path's consolidate() would cancel the
+ * retract/insert pair and downstream subscribers never see it */
+inline bool finish_equal(uint8_t code, const SState &a, const SState &b)
+{
+    switch (code) {
+    case C_COUNT:
+        return a.cnt == b.cnt;
+    case C_SUM: {
+        bool aerr = a.err > 0, berr = b.err > 0;
+        if (aerr || berr)
+            return aerr && berr;
+        bool anone = a.cnt <= 0, bnone = b.cnt <= 0;
+        if (anone || bnone)
+            return anone && bnone;
+        if (!a.isfloat && !b.isfloat)
+            return a.isum == b.isum;
+        /* numeric equality across int/float, matching Python 5 == 5.0 */
+        return a.fsum + (double)a.isum == b.fsum + (double)b.isum;
+    }
+    case C_AVG: {
+        bool aerr = a.err > 0, berr = b.err > 0;
+        if (aerr || berr)
+            return aerr && berr;
+        bool anone = a.cnt <= 0, bnone = b.cnt <= 0;
+        if (anone || bnone)
+            return anone && bnone;
+        return (a.fsum + (double)a.isum) / (double)a.cnt ==
+               (b.fsum + (double)b.isum) / (double)b.cnt;
+    }
+    }
+    return false;
+}
+
+/* ---- store_new(n_shards, codes_tuple) --------------------------------- */
+
+PyObject *store_new(PyObject *, PyObject *args)
+{
+    int n_shards;
+    PyObject *codes;
+    if (!PyArg_ParseTuple(args, "iO", &n_shards, &codes))
+        return nullptr;
+    if (n_shards < 1)
+        n_shards = 1;
+    auto *s = new GroupStore();
+    s->n_shards = n_shards;
+    s->shards.resize(n_shards);
+    Py_ssize_t nc = PySequence_Size(codes);
+    for (Py_ssize_t i = 0; i < nc; i++) {
+        PyObject *c = PySequence_GetItem(codes, i);
+        const char *cs = PyUnicode_AsUTF8(c);
+        uint8_t code = C_COUNT;
+        if (cs != nullptr && strcmp(cs, "sum") == 0)
+            code = C_SUM;
+        else if (cs != nullptr && strcmp(cs, "avg") == 0)
+            code = C_AVG;
+        else if (cs == nullptr || strcmp(cs, "count") != 0) {
+            Py_XDECREF(c);
+            delete s;
+            PyErr_SetString(PyExc_ValueError, "unknown reducer code");
+            return nullptr;
+        }
+        s->codes.push_back(code);
+        Py_DECREF(c);
+    }
+    return PyCapsule_New(s, "pwexec.GroupStore", store_destructor);
+}
+
+PyObject *store_len(PyObject *, PyObject *arg)
+{
+    GroupStore *s = get_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    int64_t n = 0;
+    for (auto &sh : s->shards)
+        n += (int64_t)sh.groups.size();
+    return PyLong_FromLongLong(n);
+}
+
+/* ---- process_batch(store, gvals_list, valcols, diffs, key_fn, error) -- */
+
+struct RowExtract {
+    uint32_t shard;
+    std::string key;
+    int64_t diff;
+    std::vector<Val> vals; /* one per spec */
+};
+
+struct Affected {
+    Group *g;
+    std::string key;      /* for erase */
+    int32_t first_row;    /* gvals source for groups created this batch */
+    int64_t before_total;
+    std::vector<SState> before;
+    bool created;
+};
+
+PyObject *process_batch(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *gvals_list, *valcols, *diffs, *key_fn, *error_obj;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &capsule, &gvals_list, &valcols,
+                          &diffs, &key_fn, &error_obj))
+        return nullptr;
+    GroupStore *store = get_store(capsule);
+    if (store == nullptr)
+        return nullptr;
+    const int W = store->n_shards;
+    const size_t n_specs = store->codes.size();
+
+    Py_ssize_t n = PyList_Size(gvals_list);
+    if (n < 0)
+        return nullptr;
+
+    /* phase 1: extract (GIL held) — no state is mutated, so Fallback here
+     * leaves the store untouched and the Python path can replay the batch */
+    std::vector<RowExtract> rows(n);
+    std::hash<std::string> hasher;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        RowExtract &r = rows[i];
+        PyObject *gv = PyList_GET_ITEM(gvals_list, i);
+        if (!ser_gvals(r.key, gv)) {
+            /* any serialization failure (incl. surrogate-escaped strings
+             * that are not UTF-8 encodable) routes to the Python path,
+             * which handles those values */
+            PyErr_Clear();
+            PyErr_SetString(FallbackError, "unsupported grouping value");
+            return nullptr;
+        }
+        r.shard = (uint32_t)(hasher(r.key) % (size_t)W);
+        PyObject *d = PyList_GET_ITEM(diffs, i);
+        int overflow = 0;
+        r.diff = PyLong_AsLongLongAndOverflow(d, &overflow);
+        if (overflow || (r.diff == -1 && PyErr_Occurred())) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(FallbackError, "diff overflow");
+            return nullptr;
+        }
+        r.vals.resize(n_specs);
+        for (size_t sidx = 0; sidx < n_specs; sidx++) {
+            Val &v = r.vals[sidx];
+            PyObject *col = PyTuple_GET_ITEM(valcols, (Py_ssize_t)sidx);
+            if (col == Py_None || store->codes[sidx] == C_COUNT) {
+                v.tag = V_NONE;
+                continue;
+            }
+            PyObject *item = PyList_GET_ITEM(col, i);
+            if (item == Py_None) {
+                v.tag = V_NONE;
+            } else if (item == error_obj) {
+                v.tag = V_ERR;
+            } else if (PyFloat_Check(item)) {
+                v.tag = V_FLT;
+                v.f = PyFloat_AS_DOUBLE(item);
+            } else if (PyLong_Check(item)) {
+                int ovf = 0;
+                v.i = PyLong_AsLongLongAndOverflow(item, &ovf);
+                if (ovf) {
+                    PyErr_SetString(FallbackError, "sum arg beyond i64");
+                    return nullptr;
+                }
+                v.tag = V_INT;
+            } else {
+                PyErr_SetString(FallbackError, "non-numeric reducer arg");
+                return nullptr;
+            }
+        }
+    }
+
+    /* phase 2: apply (GIL released) — shard-partitioned parallel update */
+    std::vector<std::vector<Affected>> affected((size_t)W);
+    {
+        std::vector<std::vector<int32_t>> shard_rows((size_t)W);
+        for (Py_ssize_t i = 0; i < n; i++)
+            shard_rows[rows[i].shard].push_back((int32_t)i);
+
+        auto work = [&](int w) {
+            Shard &sh = store->shards[(size_t)w];
+            auto &aff = affected[(size_t)w];
+            std::unordered_map<std::string, size_t> touched;
+            for (int32_t ri : shard_rows[(size_t)w]) {
+                RowExtract &r = rows[(size_t)ri];
+                auto it = sh.groups.find(r.key);
+                bool created = false;
+                if (it == sh.groups.end()) {
+                    it = sh.groups.emplace(r.key, Group{}).first;
+                    it->second.st.resize(n_specs);
+                    created = true;
+                }
+                Group &g = it->second;
+                auto t = touched.find(r.key);
+                if (t == touched.end()) {
+                    touched.emplace(r.key, aff.size());
+                    aff.push_back(Affected{&g, r.key, ri,
+                                           created ? 0 : g.total, g.st,
+                                           created});
+                }
+                g.total += r.diff;
+                for (size_t sidx = 0; sidx < n_specs; sidx++)
+                    apply_spec(store->codes[sidx], g.st[sidx], r.vals[sidx],
+                               r.diff);
+            }
+        };
+
+        Py_BEGIN_ALLOW_THREADS
+        if (W > 1 && n >= 2048) {
+            std::vector<std::thread> threads;
+            threads.reserve((size_t)W);
+            for (int w = 0; w < W; w++)
+                threads.emplace_back(work, w);
+            for (auto &t : threads)
+                t.join();
+        } else {
+            for (int w = 0; w < W; w++)
+                work(w);
+        }
+        Py_END_ALLOW_THREADS
+    }
+
+    /* phase 3: emit (GIL held) */
+    PyObject *out = PyList_New(0);
+    if (out == nullptr)
+        return nullptr;
+    for (int w = 0; w < W; w++) {
+        for (Affected &a : affected[(size_t)w]) {
+            Group &g = *a.g;
+            /* mint gvals/out_key refs for groups created this batch */
+            if (g.gvals == nullptr) {
+                g.gvals = PyList_GET_ITEM(gvals_list, a.first_row);
+                Py_INCREF(g.gvals);
+                g.out_key = PyObject_CallOneArg(key_fn, g.gvals);
+                if (g.out_key == nullptr) {
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+            }
+            bool before_live = a.before_total > 0;
+            bool after_live = g.total > 0;
+            bool changed = before_live != after_live;
+            if (!changed && after_live) {
+                for (size_t sidx = 0; sidx < n_specs && !changed; sidx++)
+                    changed = !finish_equal(store->codes[sidx],
+                                            a.before[sidx], g.st[sidx]);
+            }
+            if (changed) {
+                Py_ssize_t ng = PyTuple_GET_SIZE(g.gvals);
+                auto emit = [&](const std::vector<SState> &st, long dir) -> int {
+                    PyObject *row =
+                        PyTuple_New(ng + (Py_ssize_t)n_specs);
+                    if (row == nullptr)
+                        return -1;
+                    for (Py_ssize_t j = 0; j < ng; j++) {
+                        PyObject *x = PyTuple_GET_ITEM(g.gvals, j);
+                        Py_INCREF(x);
+                        PyTuple_SET_ITEM(row, j, x);
+                    }
+                    for (size_t sidx = 0; sidx < n_specs; sidx++) {
+                        PyObject *v = finish_spec(store->codes[sidx],
+                                                  st[sidx], error_obj);
+                        if (v == nullptr) {
+                            Py_DECREF(row);
+                            return -1;
+                        }
+                        PyTuple_SET_ITEM(row, ng + (Py_ssize_t)sidx, v);
+                    }
+                    PyObject *delta = Py_BuildValue("(OOl)", g.out_key, row,
+                                                    dir);
+                    Py_DECREF(row);
+                    if (delta == nullptr)
+                        return -1;
+                    int rc = PyList_Append(out, delta);
+                    Py_DECREF(delta);
+                    return rc;
+                };
+                if (before_live && emit(a.before, -1) < 0) {
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                if (after_live && emit(g.st, 1) < 0) {
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+            }
+            if (g.total == 0) {
+                /* fully retracted group: release refs and erase */
+                Py_XDECREF(g.gvals);
+                Py_XDECREF(g.out_key);
+                store->shards[(size_t)w].groups.erase(a.key);
+            }
+        }
+    }
+    return out;
+}
+
+/* ---- dump/load for operator snapshots and Python-path migration ------- */
+
+PyObject *store_dump(PyObject *, PyObject *arg)
+{
+    GroupStore *s = get_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr)
+        return nullptr;
+    for (auto &sh : s->shards) {
+        for (auto &kv : sh.groups) {
+            Group &g = kv.second;
+            PyObject *states = PyList_New((Py_ssize_t)g.st.size());
+            if (states == nullptr) {
+                Py_DECREF(out);
+                return nullptr;
+            }
+            for (size_t i = 0; i < g.st.size(); i++) {
+                SState &st = g.st[i];
+                PyObject *isum = pylong_from_i128(st.isum);
+                if (isum == nullptr) {
+                    Py_DECREF(states);
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                PyObject *t = Py_BuildValue(
+                    "(LNdOL)", (long long)st.cnt, isum, st.fsum,
+                    st.isfloat ? Py_True : Py_False, (long long)st.err);
+                if (t == nullptr) {
+                    Py_DECREF(states);
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                PyList_SET_ITEM(states, (Py_ssize_t)i, t);
+            }
+            PyObject *entry = Py_BuildValue(
+                "(OOLO)", g.gvals ? g.gvals : Py_None,
+                g.out_key ? g.out_key : Py_None, (long long)g.total, states);
+            Py_DECREF(states);
+            if (entry == nullptr || PyList_Append(out, entry) < 0) {
+                Py_XDECREF(entry);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            Py_DECREF(entry);
+        }
+    }
+    return out;
+}
+
+PyObject *store_load(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *entries;
+    if (!PyArg_ParseTuple(args, "OO", &capsule, &entries))
+        return nullptr;
+    GroupStore *s = get_store(capsule);
+    if (s == nullptr)
+        return nullptr;
+    std::hash<std::string> hasher;
+    Py_ssize_t n = PyList_Size(entries);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *entry = PyList_GET_ITEM(entries, i);
+        PyObject *gvals, *out_key, *states;
+        long long total;
+        if (!PyArg_ParseTuple(entry, "OOLO", &gvals, &out_key, &total,
+                              &states))
+            return nullptr;
+        std::string key;
+        if (!ser_gvals(key, gvals)) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(FallbackError,
+                                "unsupported grouping value in snapshot");
+            return nullptr;
+        }
+        Shard &sh = s->shards[hasher(key) % (size_t)s->n_shards];
+        Group &g = sh.groups[key];
+        g.total = total;
+        Py_INCREF(gvals);
+        g.gvals = gvals;
+        Py_INCREF(out_key);
+        g.out_key = out_key;
+        Py_ssize_t ns = PyList_Size(states);
+        g.st.resize((size_t)ns);
+        for (Py_ssize_t j = 0; j < ns; j++) {
+            long long cnt, err;
+            double fsum;
+            PyObject *isum_obj, *isfloat;
+            if (!PyArg_ParseTuple(PyList_GET_ITEM(states, j), "LOdOL", &cnt,
+                                  &isum_obj, &fsum, &isfloat, &err))
+                return nullptr;
+            SState &st = g.st[(size_t)j];
+            st.cnt = cnt;
+            int ovf = 0;
+            long long i64 = PyLong_AsLongLongAndOverflow(isum_obj, &ovf);
+            if (!ovf) {
+                st.isum = i64;
+            } else {
+                /* >i64 snapshot value: parse the decimal text into i128 */
+                PyObject *txt = PyObject_Str(isum_obj);
+                if (txt == nullptr)
+                    return nullptr;
+                const char *p = PyUnicode_AsUTF8(txt);
+                bool neg = *p == '-';
+                if (neg)
+                    p++;
+                __int128 acc = 0;
+                for (; *p >= '0' && *p <= '9'; p++)
+                    acc = acc * 10 + (*p - '0');
+                st.isum = neg ? -acc : acc;
+                Py_DECREF(txt);
+            }
+            st.fsum = fsum;
+            st.isfloat = isfloat == Py_True;
+            st.err = err;
+        }
+    }
+    Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"store_new", store_new, METH_VARARGS,
+     "store_new(n_shards, codes) -> capsule"},
+    {"store_len", store_len, METH_O, "number of live groups"},
+    {"store_dump", store_dump, METH_O,
+     "picklable [(gvals, out_key, total, states)]"},
+    {"store_load", store_load, METH_VARARGS, "restore a dumped store"},
+    {"process_batch", process_batch, METH_VARARGS,
+     "process_batch(store, gvals, valcols, diffs, key_fn, error) -> deltas"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT,
+    "pwexec",
+    "Sharded native group-by executor.",
+    -1,
+    methods,
+};
+
+} // namespace
+
+PyMODINIT_FUNC PyInit_pwexec(void)
+{
+    PyObject *m = PyModule_Create(&moduledef);
+    if (m == nullptr)
+        return nullptr;
+    FallbackError =
+        PyErr_NewException("pwexec.Fallback", PyExc_Exception, nullptr);
+    Py_INCREF(FallbackError);
+    PyModule_AddObject(m, "Fallback", FallbackError);
+    return m;
+}

@@ -230,7 +230,7 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
 
         def eval_tuple(keys, rows):
             cols = [fn(keys, rows) for fn in fns]
-            return [tuple(c[i] for c in cols) for i in range(len(keys))]
+            return list(zip(*cols)) if cols else [()] * len(keys)
 
         return eval_tuple
 

@@ -25,6 +25,7 @@ import numpy as _np
 
 from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
 from pathway_tpu.engine.stream import (
+    ConsolidatedList,
     Delta,
     Key,
     MultisetState,
@@ -57,13 +58,30 @@ class Node:
             return
         slot = self.pending.get(time)
         if slot is None:
+            # per-port list of delivered batches: a single delivery keeps
+            # its (possibly ConsolidatedList) identity so downstream
+            # consolidate() calls pass through instead of re-hashing
             slot = [[] for _ in range(max(self.n_inputs, 1))]
             self.pending[time] = slot
             self.scope.runtime.mark_pending(time, self)
-        slot[port].extend(deltas)
+        slot[port].append(deltas)
 
     def take(self, time: int) -> list[list[Delta]]:
-        return self.pending.pop(time, [[] for _ in range(max(self.n_inputs, 1))])
+        slot = self.pending.pop(time, None)
+        if slot is None:
+            return [[] for _ in range(max(self.n_inputs, 1))]
+        out = []
+        for batches in slot:
+            if not batches:
+                out.append([])
+            elif len(batches) == 1:
+                out.append(batches[0])
+            else:
+                merged: list[Delta] = []
+                for b in batches:
+                    merged.extend(b)
+                out.append(merged)
+        return out
 
     def process(self, time: int, batches: list[list[Delta]]) -> list[Delta]:
         raise NotImplementedError
@@ -397,44 +415,153 @@ class GroupByNode(GroupDiffNode):
         input_node,
         grouping_fn,          # (key, row) -> tuple of grouping values
         args_fn,              # (key, row) -> tuple of reducer arg combos
-        reducer_specs,        # list of ("full", fn) | ("abelian", upd, fin, init)
+        reducer_specs,        # list of ("full", fn) | ("abelian", upd, fin, init[, code])
         key_fn=None,          # grouping values -> output Pointer
+        grouping_batch=None,  # (keys, rows) -> list of gvals tuples
+        args_batch=None,      # (keys, rows) -> list of arg-combo tuples
+        native_args=None,     # per spec: batch column fn | None (count)
     ):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
         self.args_fn = args_fn
+        # batch-wise evaluation: expression evaluators are column-oriented,
+        # so computing grouping/arg columns once per batch skips two Python
+        # closure calls per row (the relational-plane hot loop)
+        self.grouping_batch = grouping_batch or (
+            lambda keys, rows: [grouping_fn(k, r) for k, r in zip(keys, rows)]
+        )
+        self.args_batch = args_batch or (
+            lambda keys, rows: [args_fn(k, r) for k, r in zip(keys, rows)]
+        )
         self.specs = [
             s if isinstance(s, tuple) else ("full", s) for s in reducer_specs
         ]
         self.need_ms = any(s[0] == "full" for s in self.specs)
         self.key_fn = key_fn or (lambda gvals: ref_scalar(*gvals))
-        # frozen gvals -> [gvals, ms_or_None, abelian_states, total_count]
+        # sharded native executor (native/exec.cpp): the multi-worker
+        # relational core — PATHWAY_THREADS C++ threads over key shards,
+        # GIL released during the apply phase. Eligible when every reducer
+        # has a native code and args are single columns; ineligible or
+        # unsupported-value batches fall back to the Python path below.
+        self.native_codes = [s[4] if len(s) > 4 else None for s in self.specs]
+        self.native_args = native_args
+        self._native_ok = (
+            not self.need_ms
+            and len(self.specs) > 0
+            and all(c is not None for c in self.native_codes)
+            and native_args is not None
+        )
+        self._exec = None
+        self._store = None
+        # frozen gvals -> [gvals, ms_or_None, abelian_states, total_count,
+        #                  cached_output_key] — the output Pointer is a
+        # content hash of the grouping values, stable for the group's
+        # lifetime; hashing it once per group (not twice per batch) keeps
+        # blake2b off the rediff hot path
         self.groups: dict[Any, list] = {}
 
     def group_of(self, port, key, row):
         return freeze_row(self.grouping_fn(key, row))
 
-    def apply_updates(self, batches):
+    # -- native path ------------------------------------------------------
+    def _native_setup(self) -> bool:
+        if self._store is not None:
+            return True
+        from pathway_tpu.native import get_pwexec
+
+        ex = get_pwexec()
+        if ex is None:
+            self._native_ok = False
+            return False
+        from pathway_tpu.internals.config import get_pathway_config
+
+        n_shards = max(1, get_pathway_config().threads)
+        self._exec = ex
+        self._store = ex.store_new(n_shards, tuple(self.native_codes))
+        return True
+
+    def _native_state_to_py(self, code, st):
+        cnt, isum, fsum, isfloat, err = st
+        if code == "count":
+            return cnt
+        value = fsum + isum if isfloat else isum
+        if code == "sum":
+            return [cnt, value, err]
+        return [float(fsum + isum), cnt, err]  # avg
+
+    def _migrate_to_python(self) -> None:
+        """Convert C++ store state to the Python groups dict (one-way: a
+        batch with values the native path can't represent permanently
+        demotes this node)."""
+        dumped = self._exec.store_dump(self._store)
+        for gvals, out_key, total, states in dumped:
+            ab = [
+                self._native_state_to_py(code, st)
+                for code, st in zip(self.native_codes, states)
+            ]
+            self.groups[freeze_row(gvals)] = [gvals, None, ab, total, out_key]
+        self._store = None
+        self._native_ok = False
+
+    def process(self, time, batches):
+        batch = consolidate(batches[0])
+        if not batch:
+            return []
+        keys = [d[0] for d in batch]
+        rows = [d[1] for d in batch]
+        if self._native_ok and self._native_setup():
+            gvals_list = self.grouping_batch(keys, rows)
+            valcols = tuple(
+                f(keys, rows) if f is not None else None
+                for f in self.native_args
+            )
+            diffs = [d[2] for d in batch]
+            try:
+                # distinct groups emit distinct rows, so the output is
+                # already in net form
+                return ConsolidatedList(
+                    self._exec.process_batch(
+                        self._store,
+                        list(gvals_list),
+                        valcols,
+                        diffs,
+                        self.key_fn,
+                        ERROR,
+                    )
+                )
+            except self._exec.Fallback:
+                self._migrate_to_python()
+        gvals_list = self.grouping_batch(keys, rows)
+        args_list = self.args_batch(keys, rows)
+        gfrozen_list = [freeze_row(g) for g in gvals_list]
+        affected = dict.fromkeys(gfrozen_list)  # ordered, unique
+        out_of = self.output_of_group
+        before: list[Delta] = []
+        for g in affected:
+            before.extend(out_of(g))
         specs = self.specs
-        for k, row, d in batches[0]:
-            gvals = self.grouping_fn(k, row)
-            gfrozen = freeze_row(gvals)
-            args = self.args_fn(k, row)
-            entry = self.groups.get(gfrozen)
+        need_ms = self.need_ms
+        groups = self.groups
+        abelian_idx = [i for i, s in enumerate(specs) if s[0] == "abelian"]
+        for i, (k, row, d) in enumerate(batch):
+            gfrozen = gfrozen_list[i]
+            args = args_list[i]
+            entry = groups.get(gfrozen)
             if entry is None:
+                gvals = gvals_list[i]
                 entry = [
                     gvals,
-                    {} if self.need_ms else None,
+                    {} if need_ms else None,
                     [s[3] if s[0] == "abelian" else None for s in specs],
                     0,
+                    self.key_fn(gvals),
                 ]
-                self.groups[gfrozen] = entry
+                groups[gfrozen] = entry
             entry[3] += d
             states = entry[2]
-            for i, spec in enumerate(specs):
-                if spec[0] == "abelian":
-                    states[i] = spec[1](states[i], args[i], d)
-            if self.need_ms:
+            for j in abelian_idx:
+                states[j] = specs[j][1](states[j], args[j], d)
+            if need_ms:
                 ms = entry[1]
                 afrozen = freeze_row(args)
                 slot = ms.get(afrozen)
@@ -444,14 +571,51 @@ class GroupByNode(GroupDiffNode):
                 slot[1] += d
                 if slot[1] == 0:
                     del ms[afrozen]
-            if entry[3] == 0 and not (self.need_ms and entry[1]):
-                del self.groups[gfrozen]
+            if entry[3] == 0 and not (need_ms and entry[1]):
+                del groups[gfrozen]
+        after: list[Delta] = []
+        for g in affected:
+            after.extend(out_of(g))
+        return consolidate(after + negate(before))
+
+    # operator snapshots: native stores dump to a picklable list; loading a
+    # python-format snapshot (or native into a python-only build) demotes
+    # the node so state never splits across the two representations
+    def state_dict(self):
+        if self._store is not None:
+            return {"__native__": self._exec.store_dump(self._store)}
+        return {a: getattr(self, a) for a in self.STATE_ATTRS}
+
+    def load_state(self, state) -> None:
+        native = state.get("__native__") if isinstance(state, dict) else None
+        if native is not None:
+            if self._native_ok and self._native_setup():
+                self._exec.store_load(self._store, native)
+            else:
+                for gvals, out_key, total, states in native:
+                    ab = [
+                        self._native_state_to_py(code, st)
+                        for code, st in zip(self.native_codes, states)
+                    ]
+                    self.groups[freeze_row(gvals)] = [
+                        gvals, None, ab, total, out_key,
+                    ]
+            return
+        for a, v in state.items():
+            setattr(self, a, v)
+        # pre-cached-key snapshots stored 4-element entries; pad with the
+        # recomputed output key so output_of_group's unpack stays valid
+        for entry in self.groups.values():
+            if len(entry) == 4:
+                entry.append(self.key_fn(entry[0]))
+        if self.groups:
+            self._native_ok = False
 
     def output_of_group(self, gfrozen) -> list[Delta]:
         entry = self.groups.get(gfrozen)
         if entry is None or entry[3] <= 0:
             return []
-        gvals, ms, states, _total = entry
+        gvals, ms, states, _total, out_key = entry
         entries = None
         values = []
         for i, spec in enumerate(self.specs):
@@ -461,7 +625,7 @@ class GroupByNode(GroupDiffNode):
                 if entries is None:
                     entries = [(slot[0], slot[1]) for slot in ms.values()]
                 values.append(spec[1](entries, i))
-        return [(self.key_fn(gvals), gvals + tuple(values), 1)]
+        return [(out_key, gvals + tuple(values), 1)]
 
 
 class UpdateRowsNode(GroupDiffNode):

@@ -14,6 +14,7 @@ reference's Timestamp at src/engine/timestamp.rs:140) and steps the graph.
 
 from __future__ import annotations
 
+import heapq as _heapq
 import queue
 import threading
 import time as _time
@@ -44,6 +45,10 @@ class Runtime:
     ):
         self.scope = Scope(self)
         self.pending_times: dict[int, set[int]] = {}  # time -> set of node ids
+        # min-heap over pending timestamps: the scheduler pops times in
+        # order without rescanning the dict (min() over a dict of T
+        # pending commits made the loop O(T^2) under bursty ingest)
+        self._time_heap: list[int] = []
         self.static_data: list[tuple[SourceNode, list[Delta]]] = []
         self.connectors: list[_Connector] = []
         self.event_queue: "queue.Queue[tuple[_Connector, list[Delta] | None]]" = (
@@ -82,7 +87,19 @@ class Runtime:
         self.connectors.append(conn)
 
     def mark_pending(self, time: int, node: Node) -> None:
-        self.pending_times.setdefault(time, set()).add(node.node_id)
+        slot = self.pending_times.get(time)
+        if slot is None:
+            slot = set()
+            self.pending_times[time] = slot
+            _heapq.heappush(self._time_heap, time)
+        slot.add(node.node_id)
+
+    def _min_pending(self) -> int:
+        heap = self._time_heap
+        pending = self.pending_times
+        while heap and heap[0] not in pending:
+            _heapq.heappop(heap)  # lazily drop already-stepped times
+        return heap[0]
 
     @property
     def async_loop(self):
@@ -139,7 +156,7 @@ class Runtime:
             if not self.pending_times:
                 break
             while self.pending_times:
-                self._step_time(min(self.pending_times))
+                self._step_time(self._min_pending())
         for node in self.scope.nodes:
             node.on_end()
         if self._async_loop is not None:
@@ -152,7 +169,9 @@ class Runtime:
             if deltas:
                 node.accept(t, 0, deltas)
             else:
-                self.pending_times.setdefault(t, set())
+                if t not in self.pending_times:
+                    self.pending_times[t] = set()
+                    _heapq.heappush(self._time_heap, t)
 
     def _next_time(self) -> int:
         now_ms = int(_time.time() * 1000)
@@ -163,7 +182,7 @@ class Runtime:
     def run_static(self) -> None:
         self._inject_static()
         while self.pending_times:  # nodes may emit at later times (buffers)
-            t = min(self.pending_times)
+            t = self._min_pending()
             self._step_time(t)
         self._finish()
 
@@ -198,7 +217,7 @@ class Runtime:
 
         self._inject_static()
         while self.pending_times:
-            t = min(self.pending_times)
+            t = self._min_pending()
             self._step_time(t)
 
         if self.persistence is not None and self.persistence.mode == "OPERATOR_PERSISTING":
@@ -239,8 +258,8 @@ class Runtime:
                     if deltas:
                         t = self._next_time()
                         conn.node.accept(t, 0, deltas)
-                        while self.pending_times and min(self.pending_times) <= self.clock + 1:
-                            self._step_time(min(self.pending_times))
+                        while self.pending_times and self._min_pending() <= self.clock + 1:
+                            self._step_time(self._min_pending())
                     if entry_state is not None:
                         last_state = entry_state
                 # states are embedded in journal entries (atomic with the
@@ -332,7 +351,7 @@ class Runtime:
             # Cutoff clock+1 also flushes those retractions promptly even
             # on finish-only drains.
             while self.pending_times:
-                tt = min(self.pending_times)
+                tt = self._min_pending()
                 if tt > self.clock + 1:
                     break
                 self._step_time(tt)
@@ -361,7 +380,7 @@ class Runtime:
             if self.error and self.terminate_on_error:
                 raise self.error
         while self.pending_times:
-            t = min(self.pending_times)
+            t = self._min_pending()
             self._step_time(t)
         for conn in self.connectors:
             if conn.thread is not None:

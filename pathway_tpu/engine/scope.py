@@ -109,9 +109,14 @@ class Scope:
         return EngineTable(node, left.width + right.width)
 
     def group_by(
-        self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int, key_fn=None
+        self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int,
+        key_fn=None, grouping_batch=None, args_batch=None, native_args=None,
     ) -> EngineTable:
-        node = N.GroupByNode(self, table.node, grouping_fn, args_fn, reducer_fns, key_fn)
+        node = N.GroupByNode(
+            self, table.node, grouping_fn, args_fn, reducer_fns, key_fn,
+            grouping_batch=grouping_batch, args_batch=args_batch,
+            native_args=native_args,
+        )
         return EngineTable(node, n_group_cols + len(reducer_fns))
 
     def update_rows(self, left: EngineTable, right: EngineTable) -> EngineTable:

@@ -66,6 +66,14 @@ def _consolidate_py(deltas: Iterable[Delta]) -> list[Delta]:
     ]
 
 
+class ConsolidatedList(list):
+    """A delta batch already in net form (no duplicate (key, row) pairs, no
+    zero diffs). consolidate() passes these through — node outputs are
+    consolidated once at the producer and not re-consolidated per hop."""
+
+    __slots__ = ()
+
+
 _consolidate_impl = None
 
 
@@ -74,6 +82,12 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
     engine's hottest loop), else the Python implementation. Resolved
     lazily on first use so importing the package never compiles."""
     global _consolidate_impl
+    if type(deltas) is ConsolidatedList:
+        # fresh copy: the upstream batch object is shared by every consumer
+        # (fan-out delivery), so callers that sort/mutate their view must
+        # not alias siblings' data. A pointer-copy is still far cheaper
+        # than re-hashing the batch.
+        return ConsolidatedList(deltas)
     if _consolidate_impl is None:
         impl = _consolidate_py
         try:
@@ -92,7 +106,7 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
         except Exception:
             pass
         _consolidate_impl = impl
-    return _consolidate_impl(deltas)
+    return ConsolidatedList(_consolidate_impl(deltas))
 
 
 class TableState:

@@ -99,7 +99,7 @@ class LoweringContext:
 
         def batch_fn(keys, rows):
             cols = [f(keys, rows) for f in fns]
-            return [tuple(c[i] for c in cols) for i in range(len(keys))]
+            return list(zip(*cols)) if cols else [()] * len(keys)
 
         return combined, batch_fn
 

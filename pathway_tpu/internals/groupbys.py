@@ -205,9 +205,7 @@ class GroupedTable:
 
                 def precompute(keys, rows):
                     cols = [f(keys, rows) for f in base_fns]
-                    return [
-                        tuple(c[i] for c in cols) for i in range(len(keys))
-                    ]
+                    return list(zip(*cols)) if cols else [()] * len(keys)
 
                 et = ctx.scope.rowwise_memoized(
                     et, precompute, len(all_input_exprs)
@@ -240,6 +238,50 @@ class GroupedTable:
                     for fns in arg_fns
                 )
 
+            # column-oriented batch variants: one evaluator call per column
+            # per batch instead of two closure calls per row
+            def grouping_batch(keys, rows):
+                if not gfns:
+                    return [()] * len(keys)
+                cols = [f(keys, rows) for f in gfns]
+                return list(zip(*cols))
+
+            def args_batch(keys, rows):
+                n = len(keys)
+                order_col = (
+                    sort_fn(keys, rows) if sort_fn is not None else keys
+                )
+                per_reducer = []
+                for fns in arg_fns:
+                    if fns:
+                        acols = [f(keys, rows) for f in fns]
+                        per_reducer.append(
+                            [
+                                tuple(vals) + (order_col[i], keys[i])
+                                for i, vals in enumerate(zip(*acols))
+                            ]
+                        )
+                    else:
+                        per_reducer.append(
+                            [(order_col[i], keys[i]) for i in range(n)]
+                        )
+                if not per_reducer:  # reduce() with no reducer columns
+                    return [()] * n
+                return list(zip(*per_reducer))
+
+            # single-column arg evaluators for the native executor: one
+            # entry per reducer — None for arg-less reducers (count);
+            # multi-arg reducers make the node ineligible
+            native_args = []
+            for fns in arg_fns:
+                if len(fns) == 0:
+                    native_args.append(None)
+                elif len(fns) == 1:
+                    native_args.append(fns[0])
+                else:
+                    native_args = None
+                    break
+
             if stateful:
                 assert len(reducers) == 1
                 red = reducers[0]
@@ -270,7 +312,9 @@ class GroupedTable:
                     post = getattr(r, "_post_process", None)
                     if post is not None:
                         if spec[0] == "abelian":
-                            _, upd, fin, init = spec
+                            # drops any native code: post-processing needs
+                            # the Python finish path
+                            upd, fin, init = spec[1], spec[2], spec[3]
                             spec = (
                                 "abelian", upd,
                                 lambda s, _f=fin, _p=post: _p(_f(s)), init,
@@ -283,7 +327,9 @@ class GroupedTable:
                             )
                     reducer_specs.append(spec)
                 grouped = ctx.scope.group_by(
-                    et, grouping_fn, args_fn, reducer_specs, n_group, key_fn=key_fn
+                    et, grouping_fn, args_fn, reducer_specs, n_group,
+                    key_fn=key_fn, grouping_batch=grouping_batch,
+                    args_batch=args_batch, native_args=native_args,
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values
@@ -303,7 +349,7 @@ class GroupedTable:
 
             def batch_fn(keys, rows):
                 cols = [f(keys, rows) for f in out_fns]
-                return [tuple(c[i] for c in cols) for i in range(len(keys))]
+                return list(zip(*cols)) if cols else [()] * len(keys)
 
             ctx.set_engine_table(
                 out,

@@ -255,7 +255,7 @@ class JoinResult:
 
             def batch_fn(keys, rows):
                 cols = [f(keys, rows) for f in fns]
-                return [tuple(c[i] for c in cols) for i in range(len(keys))]
+                return list(zip(*cols)) if cols else [()] * len(keys)
 
             ctx.set_engine_table(
                 out,

@@ -55,10 +55,15 @@ class Reducer:
         return self._factory(**kwargs)
 
     def engine_spec(self, **kwargs):
-        """("abelian", update(state, combo, diff), finish(state), init) when
-        incremental maintenance applies, else ("full", fn)."""
+        """("abelian", update(state, combo, diff), finish(state), init[,
+        native_code]) when incremental maintenance applies, else ("full",
+        fn). native_code ("count"/"sum"/"avg") marks specs the sharded C++
+        executor (native/exec.cpp) runs natively."""
         if self._abelian_factory is not None:
-            return ("abelian",) + self._abelian_factory(**kwargs)
+            spec = ("abelian",) + self._abelian_factory(**kwargs)
+            if self.name in ("count", "sum", "avg"):
+                spec = spec + (self.name,)
+            return spec
         return ("full", self._factory(**kwargs))
 
     def __call__(self, *args, **kwargs) -> ReducerExpression:

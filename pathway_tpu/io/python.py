@@ -8,7 +8,7 @@ import json as _json
 from typing import Any
 
 from pathway_tpu.internals import dtype as dt
-from pathway_tpu.internals.api import Pointer, ref_scalar
+from pathway_tpu.internals.api import _KEY_MASK, Pointer, ref_scalar
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema
 from pathway_tpu.internals.table import Table
@@ -92,13 +92,29 @@ class ConnectorSubject:
         self.commit()
 
 
-def _make_parser(schema: type[Schema]):
+_parser_seq = [0]
+
+
+def _make_parser(schema: type[Schema], subject=None):
     from pathway_tpu.engine.stream import freeze_row
 
     cols = schema.column_names()
     pkeys = schema.primary_key_columns()
     defaults = schema.default_values()
     seq = [0]
+    # keyless rows mint salt+counter pointers: deterministic given arrival
+    # order (restart replay preserves it via the journal) and two orders of
+    # magnitude cheaper than hashing row content per row. The salt includes
+    # a per-parser ordinal so same-schema sources in one program never
+    # collide (graph construction order is deterministic per program).
+    _parser_seq[0] += 1
+    key_base = int(
+        ref_scalar("py-connector", _parser_seq[0], *sorted(cols))
+    )
+    col_defaults = [(c, defaults.get(c)) for c in cols]
+    # content->key stacks exist only to serve remove()-by-content; subjects
+    # that declare they never remove skip the bookkeeping entirely
+    track_removals = getattr(subject, "_deletions_enabled", True)
     # primary-keyed sources are upsert sessions (reference: SessionType::
     # Upsert, connectors/adaptors.rs:176): re-inserting a live key must
     # retract the previous row first, or multiset operators double-count
@@ -110,7 +126,7 @@ def _make_parser(schema: type[Schema]):
     def parse(message) -> list[tuple]:
         kind, values = message[0], message[1]
         explicit_key = message[2] if len(message) > 2 else None
-        row = tuple(values.get(c, defaults.get(c)) for c in cols)
+        row = tuple(values.get(c, d) for c, d in col_defaults)
         if pkeys:
             key = ref_scalar(*(values[c] for c in pkeys))
             if kind == "remove":
@@ -137,8 +153,9 @@ def _make_parser(schema: type[Schema]):
             key = explicit_key
         else:
             seq[0] += 1
-            key = ref_scalar("py-connector", seq[0], *map(repr, row))
-            live_keys.setdefault(freeze_row(row), []).append(key)
+            key = Pointer((key_base + seq[0]) & _KEY_MASK)
+            if track_removals:
+                live_keys.setdefault(freeze_row(row), []).append(key)
         diff = -1 if kind == "remove" else 1
         return [(key, row, diff)]
 
@@ -157,7 +174,7 @@ def read(
         raise ValueError("pw.io.python.read requires a schema")
     subject._autocommit_duration_ms = autocommit_duration_ms
     out = Table(schema, Universe())
-    parser = _make_parser(schema)
+    parser = _make_parser(schema, subject)
     width = len(schema.column_names())
     persistent_name = name or kwargs.get("persistent_id")
 

@@ -156,6 +156,53 @@ def get_fastpath():
         return _FASTPATH
 
 
+_PWEXEC = None
+_PWEXEC_TRIED = False
+
+
+def get_pwexec():
+    """CPython extension with the sharded native group-by executor
+    (native/exec.cpp) — the multi-worker relational engine core. None when
+    no toolchain; callers fall back to the Python operator path."""
+    global _PWEXEC, _PWEXEC_TRIED
+    with _LOCK:
+        if _PWEXEC_TRIED:
+            return _PWEXEC
+        _PWEXEC_TRIED = True
+        src_dir = _REPO_NATIVE if os.path.isdir(_REPO_NATIVE) else os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "src"
+        )
+        src = os.path.join(src_dir, "exec.cpp")
+        if not os.path.exists(src):
+            return None
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+        out = os.path.join(_BUILD_DIR, "pwexec" + suffix)
+        if not (
+            os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            include = sysconfig.get_paths()["include"]
+            cmd = [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                f"-I{include}", "-o", out, src,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+            except Exception:
+                return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("pwexec", out)
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+        _PWEXEC = mod
+        return _PWEXEC
+
+
 class NativeBm25:
     """ctypes wrapper over the C++ BM25 index. int64 handles are minted
     per key by the caller (KeyToU64IdMapper pattern, reference

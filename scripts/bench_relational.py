@@ -1,0 +1,82 @@
+"""Relational-plane throughput bench: streaming wordcount rows/s.
+
+The reference's scaling story for this plane is N timely workers over key
+shards (src/engine/dataflow.rs:5538, dataflow/config.rs:88-127). Ours is
+worker-sharded batch execution with C++ inner loops. Run with
+PATHWAY_THREADS=N to measure scaling.
+
+Usage: python scripts/bench_relational.py [n_rows] [distinct_words]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    words = [f"word{i}" for i in range(distinct)]
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False  # append-only: no remove()-by-content
+
+        def run(self):
+            t0 = time.perf_counter()
+            for start in range(0, n_rows, batch):
+                for i in range(start, min(start + batch, n_rows)):
+                    self.next(data=words[(i * 2654435761) % distinct])
+                self.commit()
+            self._gen_elapsed = time.perf_counter() - t0
+
+    class S(pw.Schema):
+        data: str
+
+    src = Source()
+    # huge autocommit window: commits happen at the subject's own commit()
+    # cadence (one per `batch` rows) — the reference-like configuration
+    table = pw.io.python.read(src, schema=S, autocommit_duration_ms=3_600_000)
+    counts = table.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    out = {"n": 0}
+
+    def on_change(key, row, time_, diff):
+        out["n"] += 1
+
+    pw.io.subscribe(counts, on_change=on_change)
+
+    t0 = time.perf_counter()
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    elapsed = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "wordcount_rows_per_s",
+                "value": round(n_rows / elapsed, 1),
+                "unit": "rows/s",
+                "n_rows": n_rows,
+                "distinct": distinct,
+                "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
+                "output_changes": out["n"],
+                "gen_s": round(getattr(src, "_gen_elapsed", 0.0), 2),
+                "elapsed_s": round(elapsed, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
+    main(n, d)

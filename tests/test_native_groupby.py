@@ -1,0 +1,256 @@
+"""Sharded native group-by executor (native/exec.cpp) correctness.
+
+The C++ path must be output-identical to the Python affected-group rediff
+path (same deltas modulo ordering), migrate its state losslessly when a
+batch contains values it can't represent, and round-trip operator
+snapshots. Reference semantics: semigroup reducers, src/engine/reduce.rs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import ERROR, ref_scalar
+from pathway_tpu.native import get_pwexec
+
+pwexec = get_pwexec()
+pytestmark = pytest.mark.skipif(pwexec is None, reason="no native toolchain")
+
+
+def _run_wordsum(monkeypatch, force_python: bool):
+    if force_python:
+        import pathway_tpu.engine.nodes as nodes_mod
+
+        monkeypatch.setattr(
+            "pathway_tpu.native.get_pwexec", lambda: None
+        )
+    t = pw.debug.table_from_markdown(
+        """
+        w     | v
+        apple | 1
+        pear  | 2
+        apple | 3
+        plum  | 5
+        pear  | 2
+        """
+    )
+    r = t.groupby(pw.this.w).reduce(
+        w=pw.this.w,
+        n=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+        a=pw.reducers.avg(pw.this.v),
+    )
+    rows = pw.debug.table_to_pandas(r)
+    return sorted(
+        (row.w, row.n, row.s, row.a) for row in rows.itertuples()
+    )
+
+
+def test_native_matches_python_path(monkeypatch):
+    native = _run_wordsum(monkeypatch, force_python=False)
+    monkeypatch.undo()
+    python = _run_wordsum(monkeypatch, force_python=True)
+    assert native == python == [
+        ("apple", 2, 4, 2.0),
+        ("pear", 2, 4, 2.0),
+        ("plum", 1, 5, 5.0),
+    ]
+
+
+def test_executor_retraction_and_deletion():
+    s = pwexec.store_new(4, ("count", "sum"))
+    key_fn = lambda g: ref_scalar(*g)
+    out = pwexec.process_batch(
+        s, [("a",), ("a",)], (None, [3, 4]), [1, 1], key_fn, ERROR
+    )
+    assert [(r, d) for _, r, d in out] == [(("a", 2, 7), 1)]
+    # retract both rows -> group dies, only the retraction is emitted
+    out = pwexec.process_batch(
+        s, [("a",), ("a",)], (None, [3, 4]), [-1, -1], key_fn, ERROR
+    )
+    assert [(r, d) for _, r, d in out] == [(("a", 2, 7), -1)]
+    assert pwexec.store_len(s) == 0
+
+
+def test_executor_none_error_and_float_promotion():
+    s = pwexec.store_new(2, ("sum",))
+    key_fn = lambda g: ref_scalar(*g)
+    # None args don't contribute; float promotes the sum
+    out = pwexec.process_batch(
+        s, [("g",), ("g",), ("g",)], ([1, None, 2.5],), [1, 1, 1], key_fn, ERROR
+    )
+    assert [(r, d) for _, r, d in out] == [(("g", 3.5), 1)]
+    # ERROR poisons
+    out = pwexec.process_batch(s, [("g",)], ([ERROR],), [1], key_fn, ERROR)
+    (_, row, d) = out[-1]
+    assert row[1] is ERROR and d == 1
+    # retracting the error heals the sum
+    out = pwexec.process_batch(s, [("g",)], ([ERROR],), [-1], key_fn, ERROR)
+    assert out[-1][1] == ("g", 3.5) and out[-1][2] == 1
+
+
+def test_numeric_group_normalization():
+    """True == 1 == 1.0 must land in ONE group (Python dict-key parity)."""
+    s = pwexec.store_new(3, ("count",))
+    key_fn = lambda g: ref_scalar(*g)
+    out = pwexec.process_batch(
+        s, [(1,), (1.0,), (True,)], (None,), [1, 1, 1], key_fn, ERROR
+    )
+    assert pwexec.store_len(s) == 1
+    assert [(r[1], d) for _, r, d in out] == [(3, 1)]
+
+
+def test_midstream_fallback_migration():
+    """A batch with an unsupported grouping value demotes the node to the
+    Python path with state intact."""
+    import pathway_tpu.engine.nodes as nodes_mod
+    from pathway_tpu.engine.stream import freeze_row
+
+    class FakeScope:
+        def __init__(self):
+            self.nodes = []
+            self.runtime = type(
+                "R", (), {"mark_pending": lambda *a: None,
+                          "current_trace": None}
+            )()
+
+        def register(self, node):
+            self.nodes.append(node)
+            return len(self.nodes) - 1
+
+    scope = FakeScope()
+    src = nodes_mod.SourceNode(scope)
+    specs = [("abelian", lambda s, c, d: s + d, lambda s: s, 0, "count")]
+    node = nodes_mod.GroupByNode(
+        scope, src,
+        grouping_fn=lambda k, r: (r[0],),
+        args_fn=lambda k, r: ((k,),),
+        reducer_specs=specs,
+        grouping_batch=lambda ks, rs: [(r[0],) for r in rs],
+        args_batch=lambda ks, rs: [((k,),) for k in ks],
+        native_args=[None],
+    )
+    assert node._native_ok
+    out1 = node.process(2, [[(1, ("x",), 1), (2, ("x",), 1)]])
+    assert node._store is not None
+    # tuple grouping value -> Fallback -> migrate, replay via Python path
+    out2 = node.process(4, [[(3, (("t", 1),), 1), (4, ("x",), 1)]])
+    assert node._store is None and not node._native_ok
+    rows = {tuple(r): d for _, r, d in out2}
+    assert rows[("x", 3)] == 1 and rows[("x", 2)] == -1
+    assert rows[(("t", 1), 1)] == 1
+    # python path continues from migrated state
+    out3 = node.process(6, [[(5, ("x",), -1)]])
+    rows3 = {tuple(r): d for _, r, d in out3}
+    assert rows3[("x", 2)] == 1 and rows3[("x", 3)] == -1
+
+
+def test_native_snapshot_roundtrip():
+    import pathway_tpu.engine.nodes as nodes_mod
+
+    class FakeScope:
+        def __init__(self):
+            self.nodes = []
+            self.runtime = type(
+                "R", (), {"mark_pending": lambda *a: None,
+                          "current_trace": None}
+            )()
+
+        def register(self, node):
+            self.nodes.append(node)
+            return len(self.nodes) - 1
+
+    def make_node(scope):
+        src = nodes_mod.SourceNode(scope)
+        specs = [
+            ("abelian", lambda s, c, d: s + d, lambda s: s, 0, "count"),
+        ]
+        return nodes_mod.GroupByNode(
+            scope, src,
+            grouping_fn=lambda k, r: (r[0],),
+            args_fn=lambda k, r: ((k,),),
+            reducer_specs=specs,
+            grouping_batch=lambda ks, rs: [(r[0],) for r in rs],
+            args_batch=lambda ks, rs: [((k,),) for k in ks],
+            native_args=[None],
+        )
+
+    import pickle
+
+    a = make_node(FakeScope())
+    a.process(2, [[(1, ("x",), 1), (2, ("y",), 1), (3, ("x",), 1)]])
+    state = pickle.loads(pickle.dumps(a.state_dict()))
+    assert "__native__" in state
+
+    b = make_node(FakeScope())
+    b.load_state(state)
+    out = b.process(4, [[(9, ("x",), 1)]])
+    rows = {tuple(r): d for _, r, d in out}
+    assert rows[("x", 3)] == 1 and rows[("x", 2)] == -1
+
+
+def test_bigint_sum_exact():
+    """i64-overflowing accumulations stay exact (review: wrapping isum)."""
+    s = pwexec.store_new(2, ("sum",))
+    key_fn = lambda g: ref_scalar(*g)
+    v = 2**62
+    out = pwexec.process_batch(
+        s, [("g",)] * 3, ([v, v, v],), [1, 1, 1], key_fn, ERROR
+    )
+    assert out[-1][1] == ("g", 3 * 2**62)
+    # dump/load roundtrip preserves the big value
+    d = pwexec.store_dump(s)
+    s2 = pwexec.store_new(2, ("sum",))
+    pwexec.store_load(s2, d)
+    out = pwexec.process_batch(s2, [("g",)], ([1],), [1], key_fn, ERROR)
+    assert out[-1][1] == ("g", 3 * 2**62 + 1)
+
+
+def test_unchanged_output_emits_nothing():
+    """A batch that moves state without moving the finished value emits no
+    deltas (review: spurious retract/insert pairs leaked to subscribers)."""
+    s = pwexec.store_new(2, ("sum", "avg"))
+    key_fn = lambda g: ref_scalar(*g)
+    pwexec.process_batch(s, [("g",)], ([5], [2.0]), [1], key_fn, ERROR)
+    # value-0 row: sum unchanged; arriving avg value equals current mean
+    out = pwexec.process_batch(s, [("g",)], ([0], [2.0]), [1], key_fn, ERROR)
+    assert out == []
+    # count would change though
+    s2 = pwexec.store_new(2, ("count",))
+    pwexec.process_batch(s2, [("g",)], (None,), [1], key_fn, ERROR)
+    out = pwexec.process_batch(s2, [("g",)], (None,), [1], key_fn, ERROR)
+    assert len(out) == 2
+
+
+def test_same_schema_sources_distinct_keys():
+    """Two keyless sources sharing a schema must mint disjoint row ids
+    (review: concat of same-schema streams collided)."""
+    class Subj(pw.io.python.ConnectorSubject):
+        def __init__(self, word):
+            super().__init__()
+            self.word = word
+
+        def run(self):
+            self.next(data=self.word)
+            self.commit()
+
+    class S(pw.Schema):
+        data: str
+
+    a = pw.io.python.read(Subj("x"), schema=S, autocommit_duration_ms=None)
+    b = pw.io.python.read(Subj("y"), schema=S, autocommit_duration_ms=None)
+    both = a.concat(b)
+    seen = []
+    pw.io.subscribe(both, on_change=lambda key, row, t, d: seen.append(row["data"]))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert sorted(seen) == ["x", "y"]
+
+
+def test_surrogate_string_falls_back():
+    """Non-UTF-8-encodable strings route to Fallback, not UnicodeEncodeError."""
+    s = pwexec.store_new(2, ("count",))
+    key_fn = lambda g: ref_scalar(*map(repr, g))
+    with pytest.raises(pwexec.Fallback):
+        pwexec.process_batch(s, [("\udcff",)], (None,), [1], key_fn, ERROR)
