@@ -186,13 +186,17 @@ class GraphRunner:
         from pathway_tpu.internals.config import get_pathway_config
         from pathway_tpu.internals.telemetry import Telemetry
 
-        telemetry = Telemetry.create(
-            get_pathway_config().monitoring_server
-        )
         runtime = self._make_runtime()
+        telemetry = Telemetry.create(
+            get_pathway_config().monitoring_server,
+            stats=getattr(runtime, "stats", None),
+        )
         targets = self.graph.output_operators()
         ops = self.graph.reachable_operators(targets)
         with telemetry.span("graph_runner.build", n_operators=len(ops)):
             self._lower(ops, runtime)
         with telemetry.span("graph_runner.run"):
             runtime.run()
+        flush = getattr(telemetry, "flush", None)
+        if flush is not None:
+            flush(timeout=2.0)

@@ -18,45 +18,32 @@ class Telemetry:
     def __init__(self, tracer):
         self.tracer = tracer
 
+    _otlp_cache: dict = {}
+
     @classmethod
-    def create(cls, endpoint: str | None = None) -> "Telemetry":
+    def create(cls, endpoint: str | None = None, *, stats=None):
+        if endpoint is not None:
+            # hand-rolled OTLP/HTTP JSON exporter (internals/otlp.py):
+            # spans + 60 s process/latency gauges with no OTel SDK needed
+            # (reference: src/engine/telemetry.rs:38-45). One instance per
+            # endpoint per process — repeated pw.run() calls must not each
+            # leak a metrics thread.
+            from pathway_tpu.internals.otlp import OtlpTelemetry
+
+            tel = cls._otlp_cache.get(endpoint)
+            if tel is None:
+                tel = OtlpTelemetry(endpoint, stats=stats)
+                cls._otlp_cache[endpoint] = tel
+            else:
+                tel.stats = stats  # gauge source follows the live runtime
+            return tel
         try:
             from opentelemetry import trace
 
-            if endpoint is not None:
-                cls._try_bootstrap_sdk(endpoint)
             tracer = trace.get_tracer("pathway_tpu")
         except ImportError:
             tracer = None
         return cls(tracer)
-
-    _sdk_bootstrapped = False
-
-    @classmethod
-    def _try_bootstrap_sdk(cls, endpoint: str) -> None:
-        # once per process: OTel ignores later set_tracer_provider calls,
-        # so repeats would only leak batch-export threads + gRPC channels
-        if cls._sdk_bootstrapped:
-            return
-        cls._sdk_bootstrapped = True
-        try:
-            from opentelemetry import trace
-            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
-                OTLPSpanExporter,
-            )
-            from opentelemetry.sdk.resources import Resource
-            from opentelemetry.sdk.trace import TracerProvider
-            from opentelemetry.sdk.trace.export import BatchSpanProcessor
-
-            provider = TracerProvider(
-                resource=Resource.create({"service.name": "pathway_tpu"})
-            )
-            provider.add_span_processor(
-                BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
-            )
-            trace.set_tracer_provider(provider)
-        except ImportError:
-            pass  # API-only install: spans stay no-ops
 
     @contextlib.contextmanager
     def span(self, name: str, **attributes):

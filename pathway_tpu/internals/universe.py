@@ -38,6 +38,7 @@ class UniverseSolver:
     def __init__(self):
         self.parent: dict[int, int] = {}
         self.subsets: set[tuple[int, int]] = set()  # (sub, sup) roots
+        self.disjoint: set[frozenset[int]] = set()  # promised-disjoint roots
 
     def _find(self, uid: int) -> int:
         root = uid
@@ -57,6 +58,48 @@ class UniverseSolver:
 
     def query_are_equal(self, a: Universe, b: Universe) -> bool:
         return self._find(a.uid) == self._find(b.uid)
+
+    def register_disjoint(self, a: Universe, b: Universe) -> None:
+        ra, rb = self._find(a.uid), self._find(b.uid)
+        if ra == rb:
+            raise ValueError(
+                "cannot promise disjointness of equal universes"
+            )
+        self.disjoint.add(frozenset((ra, rb)))
+
+    def _supersets(self, uid: int) -> set[int]:
+        """All registered-superset roots reachable from uid (incl. itself)."""
+        root = self._find(uid)
+        seen = {root}
+        frontier = [root]
+        while frontier:
+            cur = frontier.pop()
+            for a, b in self.subsets:
+                if self._find(a) == cur:
+                    nb = self._find(b)
+                    if nb not in seen:
+                        seen.add(nb)
+                        frontier.append(nb)
+        return seen
+
+    def query_are_disjoint(self, a: Universe, b: Universe) -> bool:
+        """True iff some registered-disjoint pair covers (a, b) — i.e. a
+        and b are (subsets of) universes promised pairwise disjoint.
+        Disjointness is additionally VERIFIED at runtime: concat raises on
+        actual id collisions, so this query is advisory (declaration-time
+        diagnostics), not the safety mechanism."""
+        ra, rb = self._find(a.uid), self._find(b.uid)
+        if ra == rb:
+            return False
+        sups_a = self._supersets(ra)
+        sups_b = self._supersets(rb)
+        for pair in self.disjoint:
+            pa, pb = tuple(pair)
+            if (pa in sups_a and pb in sups_b) or (
+                pb in sups_a and pa in sups_b
+            ):
+                return True
+        return False
 
     def query_is_subset(self, sub: Universe, sup: Universe) -> bool:
         rs, rp = self._find(sub.uid), self._find(sup.uid)

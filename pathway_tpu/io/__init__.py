@@ -18,6 +18,7 @@ __all__ = [
     "deltalake",
     "elasticsearch",
     "fs",
+    "gcs",
     "gdrive",
     "http",
     "jsonlines",
@@ -42,7 +43,7 @@ __all__ = [
 
 _LAZY_CONNECTORS = {
     "airbyte", "bigquery", "debezium", "deltalake", "elasticsearch",
-    "gdrive", "kafka", "logstash", "minio", "mongodb", "nats", "null",
+    "gcs", "gdrive", "kafka", "logstash", "minio", "mongodb", "nats", "null",
     "postgres", "pubsub", "pyfilesystem", "redpanda", "s3", "s3_csv",
     "slack", "sqlite",
 }

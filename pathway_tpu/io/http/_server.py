@@ -29,6 +29,94 @@ class EndpointDocumentation:
     method_types: Sequence[str] | None = None
 
 
+def _openapi_type(dtype) -> dict:
+    """pw dtype -> OpenAPI schema object (reference: _server.py:126-329
+    generates the schema from the route's pw.Schema)."""
+    if dtype is dt.INT:
+        return {"type": "integer", "format": "int64"}
+    if dtype is dt.FLOAT:
+        return {"type": "number", "format": "double"}
+    if dtype is dt.BOOL:
+        return {"type": "boolean"}
+    if dtype is dt.STR:
+        return {"type": "string"}
+    if dtype is dt.BYTES:
+        return {"type": "string", "format": "byte"}
+    if dtype is dt.JSON:
+        return {}  # any JSON value
+    name = getattr(dtype, "name", None) or str(dtype)
+    if "Optional" in name:
+        wrapped = getattr(dtype, "wrapped", None)
+        if callable(wrapped):  # DType.wrapped is a method
+            wrapped = wrapped()
+        if wrapped is not None:
+            inner = _openapi_type(wrapped)
+            inner["nullable"] = True
+            return inner
+    if name.startswith(("List", "Tuple", "Array")):
+        return {"type": "array", "items": {}}
+    return {}
+
+
+def _schema_request_body(schema: type[Schema]) -> dict:
+    hints = schema.typehints()
+    defaults = schema.default_values()
+    props = {}
+    required = []
+    for col in schema.column_names():
+        spec = _openapi_type(hints.get(col))
+        if col in defaults:
+            try:
+                _json.dumps(defaults[col])
+                spec["default"] = defaults[col]
+            except TypeError:
+                pass
+        else:
+            required.append(col)
+        props[col] = spec
+    body: dict[str, Any] = {"type": "object", "properties": props}
+    if required:
+        body["required"] = required
+    return body
+
+
+def _schema_query_params(schema: type[Schema]) -> list[dict]:
+    hints = schema.typehints()
+    defaults = schema.default_values()
+    return [
+        {
+            "name": col,
+            "in": "query",
+            "required": col not in defaults,
+            "schema": _openapi_type(hints.get(col)),
+        }
+        for col in schema.column_names()
+    ]
+
+
+def _validate_payload_types(schema: type[Schema], payload: dict) -> str | None:
+    """Schema-driven request validation: wrong-typed fields are rejected
+    with 400 before they enter the dataflow."""
+    hints = schema.typehints()
+    for col, value in payload.items():
+        t = hints.get(col)
+        if value is None or t is None:
+            continue
+        if t is dt.INT and not (
+            isinstance(value, int) and not isinstance(value, bool)
+        ):
+            return f"field {col!r} must be an integer"
+        if t is dt.FLOAT and not (
+            isinstance(value, (int, float)) and not isinstance(value, bool)
+        ):
+            return f"field {col!r} must be a number"
+        if t is dt.BOOL and not isinstance(value, bool):
+            return f"field {col!r} must be a boolean"
+        if t is dt.STR and not isinstance(value, str):
+            return f"field {col!r} must be a string"
+    return None
+
+
 class PathwayWebserver:
     """Shared aiohttp server; routes register before pw.run() starts it
     (reference: _server.py:329)."""
@@ -45,14 +133,45 @@ class PathwayWebserver:
         self._thread: threading.Thread | None = None
         self.with_schema_endpoint = with_schema_endpoint
 
-    def _register_route(self, route, methods, handler, docs) -> None:
+    def _register_route(self, route, methods, handler, docs, schema=None) -> None:
         self._routes.append((route, methods, handler, docs))
-        self._openapi[route] = {
-            m.lower(): {
+        ops: dict[str, Any] = {}
+        for m in methods:
+            op: dict[str, Any] = {
                 "summary": getattr(docs, "summary", None) or route,
-                "responses": {"200": {"description": "OK"}},
+                "responses": {
+                    "200": {"description": "OK"},
+                    "400": {"description": "Invalid request"},
+                    "504": {"description": "Processing timeout"},
+                },
             }
-            for m in methods
+            desc = getattr(docs, "description", None)
+            if desc:
+                op["description"] = desc
+            tags = list(getattr(docs, "tags", ()) or ())
+            if tags:
+                op["tags"] = tags
+            if schema is not None:
+                if m == "GET":
+                    op["parameters"] = _schema_query_params(schema)
+                else:
+                    op["requestBody"] = {
+                        "required": True,
+                        "content": {
+                            "application/json": {
+                                "schema": _schema_request_body(schema)
+                            }
+                        },
+                    }
+            ops[m.lower()] = op
+        self._openapi[route] = ops
+
+    def openapi_document(self) -> dict:
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway REST connector", "version": "1.0.0"},
+            "servers": [{"url": f"http://{self.host}:{self.port}"}],
+            "paths": self._openapi,
         }
 
     def _ensure_started(self) -> None:
@@ -74,11 +193,10 @@ class PathwayWebserver:
                 app.router.add_route(m, route, handler)
         if self.with_schema_endpoint:
             async def schema_handler(request):
-                return web.json_response(
-                    {"openapi": "3.0.3", "paths": self._openapi}
-                )
+                return web.json_response(self.openapi_document())
 
             app.router.add_route("GET", "/_schema", schema_handler)
+            app.router.add_route("GET", "/openapi.json", schema_handler)
 
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
@@ -109,7 +227,7 @@ class RestServerSubject(ConnectorSubject):
         self._seq = 0
         self._lock = threading.Lock()
         webserver._register_route(
-            route, methods, self._handle, documentation
+            route, methods, self._handle, documentation, schema=schema
         )
 
     def run(self):
@@ -162,6 +280,10 @@ class RestServerSubject(ConnectorSubject):
             return web.json_response(
                 {"error": f"missing fields: {missing}"}, status=400
             )
+        if request.method != "GET":
+            type_err = _validate_payload_types(self.schema, payload)
+            if type_err is not None:
+                return web.json_response({"error": type_err}, status=400)
         values = {c: payload.get(c, defaults.get(c)) for c in cols}
         # JSON-typed columns wrap payload fragments
         for c, typ in self.schema.typehints().items():

@@ -73,6 +73,93 @@ class _FsBackend(_BackendBase):
         return sorted(out)
 
 
+class _ObjectStoreBackend(_BackendBase):
+    """Persistence over an object store (reference: S3 backend,
+    src/persistence/backends/s3.rs:47 — whole-object put/get, no append).
+
+    Appends map to sequential part objects ``<key>.part/<n>``; reads
+    concatenate the base object plus parts in order, so the journal's
+    append-only contract holds on stores without native append. ``client``
+    is anything with ``upload(path, bytes)``, ``download(path) -> bytes |
+    None`` and ``list(prefix) -> [path]`` — the GCS adapter below, or a
+    fake in tests.
+    """
+
+    def __init__(self, client, root: str = ""):
+        self.client = client
+        self.root = root.strip("/")
+
+    def _p(self, key: str) -> str:
+        return f"{self.root}/{key}" if self.root else key
+
+    def write(self, key: str, data: bytes) -> None:
+        # truncate-replace semantics (matching _FsBackend.write): stale
+        # appended parts must not survive a rewrite of the base object
+        delete = getattr(self.client, "delete", None)
+        if delete is not None:
+            for part in self.client.list(self._p(key) + ".part/"):
+                delete(part)
+        self.client.upload(self._p(key), data)
+
+    def append(self, key: str, data: bytes) -> None:
+        part_prefix = self._p(key) + ".part/"
+        existing = self.client.list(part_prefix)
+        self.client.upload(part_prefix + f"{len(existing):08d}", data)
+
+    def read(self, key: str) -> bytes | None:
+        base = self.client.download(self._p(key))
+        parts = sorted(self.client.list(self._p(key) + ".part/"))
+        if base is None and not parts:
+            return None
+        chunks = [base or b""]
+        for p in parts:
+            chunk = self.client.download(p)
+            if chunk is not None:
+                chunks.append(chunk)
+        return b"".join(chunks)
+
+    def list_keys(self, prefix: str) -> list[str]:
+        out = set()
+        for path in self.client.list(self._p(prefix)):
+            rel = path[len(self.root) + 1 :] if self.root else path
+            out.add(rel.split(".part/")[0])
+        return sorted(out)
+
+
+class _GcsClient:
+    """google-cloud-storage adapter for _ObjectStoreBackend."""
+
+    def __init__(self, bucket_name: str, client=None):
+        if client is None:
+            from google.cloud import storage
+
+            client = storage.Client()
+        self.bucket = client.bucket(bucket_name)
+        self._client = client
+        self._bucket_name = bucket_name
+
+    def upload(self, path: str, data: bytes) -> None:
+        self.bucket.blob(path).upload_from_string(data)
+
+    def download(self, path: str) -> bytes | None:
+        blob = self.bucket.blob(path)
+        try:
+            return blob.download_as_bytes()
+        except Exception:
+            return None
+
+    def list(self, prefix: str) -> list[str]:
+        return [b.name for b in self._client.list_blobs(
+            self._bucket_name, prefix=prefix
+        )]
+
+    def delete(self, path: str) -> None:
+        try:
+            self.bucket.blob(path).delete()
+        except Exception:
+            pass  # already gone
+
+
 class _MemoryBackend(_BackendBase):
     def __init__(self):
         self.data: dict[str, bytes] = {}
@@ -109,9 +196,24 @@ class Backend:
         return cls(_MemoryBackend())
 
     @classmethod
+    def gcs(cls, bucket: str, *, root_path: str = "", client=None) -> "Backend":
+        """Google Cloud Storage backend (reference: backends/s3.rs — same
+        object-store model). ``client`` overrides the google-cloud-storage
+        Client (tests inject fakes/emulators)."""
+        return cls(_ObjectStoreBackend(_GcsClient(bucket, client), root_path))
+
+    @classmethod
+    def object_store(cls, client, *, root_path: str = "") -> "Backend":
+        """Persistence over any upload/download/list client (the transport
+        behind gcs(); usable for S3/MinIO-compatible clients too)."""
+        return cls(_ObjectStoreBackend(client, root_path))
+
+    @classmethod
     def s3(cls, root_path: str, bucket_settings=None) -> "Backend":
         raise NotImplementedError(
-            "S3 persistence backend requires boto3; use filesystem()"
+            "S3 persistence backend requires boto3 (absent in this image); "
+            "use Backend.gcs() or Backend.object_store() with an "
+            "S3-compatible client"
         )
 
 

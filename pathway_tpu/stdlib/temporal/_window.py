@@ -246,9 +246,10 @@ class _IntervalsOverWindow(Window):
     def _apply(self, table, key, behavior, instance):
         from pathway_tpu.stdlib.temporal._interval_join import interval, interval_join
 
-        if behavior is not None:
+        if behavior is not None and not isinstance(behavior, CommonBehavior):
             raise NotImplementedError(
-                "behaviors are not supported for intervals_over windows"
+                "intervals_over accepts CommonBehavior "
+                "(pw.temporal.common_behavior) only"
             )
         at = self.at
         at_table = at.table
@@ -275,6 +276,32 @@ class _IntervalsOverWindow(Window):
             _pw_key=key,
             *table,
         )
+        if behavior is not None:
+            # gate the assigned stream through the engine's buffer/freeze
+            # time gates (reference accepts behaviors here, _window.py:
+            # 522-530; semantics mirror the sliding-window behavior path).
+            # Outer rows have no right-side key, so the event time for
+            # lateness is the window location itself.
+            joined = joined.with_columns(
+                _pw_gate_t=expr_mod.coalesce(
+                    joined["_pw_key"], joined["_pw_window"]
+                )
+            )
+            if behavior.cutoff is not None:
+                joined = joined._freeze(
+                    joined["_pw_window_end"] + behavior.cutoff,
+                    joined["_pw_gate_t"],
+                )
+            if behavior.delay is not None:
+                joined = joined._buffer(
+                    joined["_pw_window"] + behavior.delay,
+                    joined["_pw_gate_t"],
+                )
+            if behavior.cutoff is not None and not behavior.keep_results:
+                joined = joined._forget(
+                    joined["_pw_window_end"] + behavior.cutoff,
+                    joined["_pw_gate_t"],
+                )
         return joined.groupby(
             joined["_pw_window"],
             joined["_pw_window_start"],

@@ -17,6 +17,11 @@ def promise_are_equal(*tables) -> None:
 
 
 def promise_are_pairwise_disjoint(*tables) -> None:
-    """Disjointness is used by concat validation; the solver treats
-    unrelated universes as disjoint by default, so this is a no-op marker
-    kept for reference API parity."""
+    """Register pairwise disjointness with the universe solver (reference:
+    universes.py — the solver constrains concat validity). The engine also
+    VERIFIES the promise at runtime: concat raises on id collisions, so a
+    wrong promise surfaces instead of silently corrupting results."""
+    import itertools
+
+    for a, b in itertools.combinations(tables, 2):
+        SOLVER.register_disjoint(a._universe, b._universe)

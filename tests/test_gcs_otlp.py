@@ -1,0 +1,320 @@
+"""GCS persistence backend + pw.io.gcs connector + OTLP exporter.
+
+The fake GCS client is directory-backed so it persists across the
+kill/restart subprocesses, emulating a bucket (reference oracle:
+integration_tests/wordcount over the S3 backend, persistence/backends/s3.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+FAKE_GCS = textwrap.dedent(
+    '''
+    import os
+
+    class FakeBlob:
+        def __init__(self, root, name):
+            self._path = os.path.join(root, name.replace("/", "%2F"))
+            self.name = name
+            self.generation = None
+            if os.path.exists(self._path):
+                self.generation = int(os.path.getmtime(self._path) * 1e6)
+
+        def upload_from_string(self, data):
+            if isinstance(data, str):
+                data = data.encode()
+            with open(self._path, "wb") as f:
+                f.write(data)
+
+        def download_as_bytes(self):
+            with open(self._path, "rb") as f:
+                return f.read()
+
+        def delete(self):
+            os.remove(self._path)
+
+    class FakeBucket:
+        def __init__(self, root):
+            self._root = root
+            os.makedirs(root, exist_ok=True)
+
+        def blob(self, name):
+            return FakeBlob(self._root, name)
+
+    class FakeGcsClient:
+        """Directory-backed stand-in for google.cloud.storage.Client."""
+
+        def __init__(self, base):
+            self._base = base
+
+        def bucket(self, name):
+            return FakeBucket(os.path.join(self._base, name))
+
+
+        def list_blobs(self, bucket_name, prefix=""):
+            root = os.path.join(self._base, bucket_name)
+            if not os.path.isdir(root):
+                return []
+            out = []
+            for fn in sorted(os.listdir(root)):
+                name = fn.replace("%2F", "/")
+                if name.startswith(prefix):
+                    out.append(FakeBlob(root, name))
+            return out
+    '''
+)
+
+_WORDCOUNT_GCS = (
+    FAKE_GCS
+    + textwrap.dedent(
+        """
+        import sys, threading, time, json
+        sys.path.insert(0, {repo!r})
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import pathway_tpu as pw
+
+        base, docs_dir, out_path, kill_after = sys.argv[1:5]
+        client = FakeGcsClient(base)
+
+        words = pw.io.fs.read(
+            docs_dir, format="plaintext", mode="streaming",
+            autocommit_duration_ms=10, refresh_interval=0.05, name="words",
+        )
+        counts = words.groupby(pw.this.data).reduce(
+            word=pw.this.data, c=pw.reducers.count()
+        )
+        seen = {{}}
+        def on_change(key, row, t, diff):
+            if diff > 0:
+                seen[row["word"]] = row["c"]
+            elif seen.get(row["word"]) == row["c"]:
+                del seen[row["word"]]
+            with open(out_path, "w") as f:
+                json.dump(seen, f)
+        pw.io.subscribe(counts, on_change=on_change)
+
+        if float(kill_after) > 0:
+            threading.Thread(
+                target=lambda: (time.sleep(float(kill_after)), os._exit(17)),
+                daemon=True,
+            ).start()
+        else:
+            threading.Thread(
+                target=lambda: (time.sleep(2.0), os._exit(0)), daemon=True
+            ).start()
+
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=pw.persistence.Backend.gcs(
+                    "pw-bucket", root_path="persist", client=client
+                )
+            )
+        )
+        """
+    )
+)
+
+
+def test_object_store_backend_roundtrip(tmp_path):
+    ns = {}
+    exec(FAKE_GCS, ns)
+    import pathway_tpu as pw
+    from pathway_tpu.persistence import PersistenceManager
+
+    client = ns["FakeGcsClient"](str(tmp_path))
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.gcs("b", root_path="p", client=client)
+    )
+    mgr = PersistenceManager(cfg)
+    mgr.journal_batch("c1", 2, [(1, ("a",), 1)])
+    mgr.journal_batch("c1", 4, [(2, ("b",), 1)], {"pos": 3})
+    mgr.save_subject_state("c1", {"pos": 3})
+
+    mgr2 = PersistenceManager(
+        pw.persistence.Config(
+            backend=pw.persistence.Backend.gcs(
+                "b", root_path="p", client=ns["FakeGcsClient"](str(tmp_path))
+            )
+        )
+    )
+    journal = mgr2.load_journal("c1")
+    assert [d for _, d, _ in journal] == [[(1, ("a",), 1)], [(2, ("b",), 1)]]
+    assert journal[-1][2] == {"pos": 3}
+    assert mgr2.load_subject_state("c1") == {"pos": 3}
+
+
+def test_gcs_backend_kill_and_recover(tmp_path):
+    tmp = str(tmp_path)
+    docs = os.path.join(tmp, "docs")
+    os.makedirs(docs)
+    with open(os.path.join(docs, "f1.txt"), "w") as f:
+        f.write("alpha\nbeta\nalpha\n")
+    script = os.path.join(tmp, "wc.py")
+    with open(script, "w") as f:
+        f.write(_WORDCOUNT_GCS.format(repo=os.getcwd()))
+
+    def run(kill_after):
+        return subprocess.run(
+            [sys.executable, script, os.path.join(tmp, "bucket"), docs,
+             os.path.join(tmp, "out.json"), str(kill_after)],
+            capture_output=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        ).returncode
+
+    assert run(1.5) == 17
+    with open(os.path.join(docs, "f2.txt"), "w") as f:
+        f.write("alpha\ngamma\n")
+    assert run(0) == 0
+    with open(os.path.join(tmp, "out.json")) as f:
+        assert json.load(f) == {"alpha": 3, "beta": 1, "gamma": 1}
+
+
+def test_gcs_connector_streaming(tmp_path):
+    ns = {}
+    exec(FAKE_GCS, ns)
+    import pathway_tpu as pw
+
+    client = ns["FakeGcsClient"](str(tmp_path))
+    bucket = client.bucket("data")
+    bucket.blob("in/a.txt").upload_from_string("x\ny\n")
+    bucket.blob("in/b.txt").upload_from_string("x\n")
+
+    t = pw.io.gcs.read(
+        "data", "in/", format="plaintext", mode="static", client=client
+    )
+    counts = t.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    out = {}
+    pw.io.subscribe(
+        counts,
+        on_change=lambda key, row, tt, d: out.__setitem__(row["word"], row["c"])
+        if d > 0 else None,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert out == {"x": 2, "y": 1}
+
+
+def test_gcs_write(tmp_path):
+    ns = {}
+    exec(FAKE_GCS, ns)
+    import pathway_tpu as pw
+
+    client = ns["FakeGcsClient"](str(tmp_path))
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    pw.io.gcs.write(t, "outb", "res", client=client)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    blobs = client.list_blobs("outb", prefix="res/")
+    rows = []
+    for b in blobs:
+        for line in b.download_as_bytes().decode().splitlines():
+            rows.append(json.loads(line))
+    assert sorted((r["a"], r["b"]) for r in rows) == [(1, "x"), (2, "y")]
+    assert all(r["diff"] == 1 for r in rows)
+
+
+def test_otlp_exporter_payloads():
+    """A local HTTP collector receives well-formed OTLP JSON for spans and
+    gauges (reference: telemetry.rs:38-45)."""
+    import http.server
+
+    received = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append((self.path, json.loads(self.rfile.read(n))))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Collector)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from pathway_tpu.internals.otlp import OtlpTelemetry
+
+        tel = OtlpTelemetry(
+            f"http://127.0.0.1:{port}", autostart_metrics=False
+        )
+        with tel.span("graph_runner.run", n_operators=4):
+            pass
+        tel.flush()  # spans export on a background worker
+        assert tel.push_metrics_once()
+    finally:
+        srv.shutdown()
+
+    paths = [p for p, _ in received]
+    assert "/v1/traces" in paths and "/v1/metrics" in paths
+    trace_payload = next(b for p, b in received if p == "/v1/traces")
+    span = trace_payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["name"] == "graph_runner.run"
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["n_operators"] == {"intValue": "4"}
+    res = trace_payload["resourceSpans"][0]["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "pathway_tpu"}} in res
+
+    metric_payload = next(b for p, b in received if p == "/v1/metrics")
+    metrics = metric_payload["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    names = {m["name"] for m in metrics}
+    assert "process.memory.usage" in names
+    for m in metrics:
+        assert m["gauge"]["dataPoints"][0]["asDouble"] >= 0
+
+
+def test_otlp_wired_through_monitoring_config(tmp_path):
+    """pw.set_monitoring_config routes graph-runner spans to the endpoint."""
+    import http.server
+
+    received = []
+
+    class Collector(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers["Content-Length"])
+            received.append((self.path, json.loads(self.rfile.read(n))))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Collector)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    import pathway_tpu as pw
+
+    try:
+        pw.set_monitoring_config(server_endpoint=f"http://127.0.0.1:{port}")
+        t = pw.debug.table_from_markdown("a\n1\n2\n")
+        pw.io.subscribe(t, on_change=lambda *a: None)
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    finally:
+        pw.set_monitoring_config(server_endpoint=None)
+        srv.shutdown()
+    span_names = [
+        s["name"]
+        for p, b in received
+        if p == "/v1/traces"
+        for rs in b["resourceSpans"]
+        for ss in rs["scopeSpans"]
+        for s in ss["spans"]
+    ]
+    assert "graph_runner.build" in span_names
+    assert "graph_runner.run" in span_names

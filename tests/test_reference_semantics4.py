@@ -1,0 +1,292 @@
+"""Fourth reference-semantics battery: joins with ERROR values, universe
+promises, sort/prev-next edge cases, intervals_over behaviors (reference
+Tier-1 pattern: python/pathway/tests/ — markdown tables, static run,
+captured equality)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import ERROR
+from utils import T, run_table
+
+
+def _rows(t):
+    return sorted(run_table(t).values(), key=repr)
+
+
+# -- joins with errors ------------------------------------------------------
+
+def test_join_key_error_row_drops_to_error_log():
+    t = T("a | b\n1 | 2\n0 | 3")
+    # division by zero poisons the join key for row 2
+    left = t.select(k=1 // pw.this.a, b=pw.this.b)
+    right = T("k | w\n1 | x")
+    joined = left.join(right, left.k == right.k).select(left.b, right.w)
+    rows = _rows(joined)
+    # the poisoned row does not match anything; the valid one joins
+    assert rows == [(2, "x")]
+
+
+def test_error_propagates_through_select_arithmetic():
+    t = T("a\n1\n0")
+    r = t.select(v=1 // pw.this.a + 1)
+    vals = {v for (v,) in _rows(r)}
+    assert 2 in vals and any(v is ERROR for v in vals)
+
+
+def test_error_in_groupby_key_isolated():
+    t = T("a | v\n1 | 10\n0 | 20\n1 | 5")
+    g = t.select(k=1 // pw.this.a, v=pw.this.v)
+    r = g.groupby(pw.this.k).reduce(k=pw.this.k, s=pw.reducers.sum(pw.this.v))
+    rows = {k if k is ERROR else k: s for k, s in _rows(r)}
+    assert rows.get(1) == 15  # valid rows unaffected by the poisoned one
+
+
+def test_if_else_with_error_condition():
+    t = T("a\n1\n0")
+    r = t.select(v=pw.if_else(pw.this.a > 0, pw.this.a, -1))
+    assert sorted(v for (v,) in _rows(r)) == [-1, 1]
+
+
+def test_fill_error_with_coalesce_keeps_rows():
+    t = T("a\n2\n0")
+    r = t.select(v=pw.fill_error(1 // pw.this.a, -1))
+    assert sorted(v for (v,) in _rows(r)) == [-1, 0]
+
+
+def test_outer_join_none_fill_on_no_match():
+    left = T("k | v\n1 | a\n2 | b")
+    right = T("k | w\n2 | x\n3 | y")
+    j = left.join_outer(right, left.k == right.k).select(
+        lv=left.v, rw=right.w
+    )
+    assert _rows(j) == sorted(
+        [("a", None), ("b", "x"), (None, "y")], key=repr
+    )
+
+
+def test_join_left_duplicate_right_keys_multiplies():
+    left = T("k | v\n1 | a")
+    right = T("k | w\n1 | x\n1 | y")
+    j = left.join_left(right, left.k == right.k).select(left.v, right.w)
+    assert _rows(j) == [("a", "x"), ("a", "y")]
+
+
+# -- universe promises ------------------------------------------------------
+
+def test_promise_subset_enables_restrict():
+    big = T("k | v\n1 | a\n2 | b\n3 | c")
+    small = big.filter(pw.this.k <= 2)
+    r = big.restrict(small)
+    assert len(_rows(r)) == 2
+
+
+def test_promise_are_equal_enables_with_universe_of():
+    a = T("k | v\n1 | a\n2 | b")
+    b = T("w\nx\ny")
+    # same row count but unrelated universes: promise equality first
+    pw.universes.promise_are_equal(a, b)
+    c = b.with_universe_of(a)
+    assert len(_rows(c)) == 2
+
+
+def test_promise_pairwise_disjoint_registers_with_solver():
+    from pathway_tpu.internals.universe import SOLVER
+
+    a = T("v\n1")
+    b = T("v\n2")
+    c = T("v\n3")
+    pw.universes.promise_are_pairwise_disjoint(a, b, c)
+    assert SOLVER.query_are_disjoint(a._universe, b._universe)
+    assert SOLVER.query_are_disjoint(b._universe, c._universe)
+    assert not SOLVER.query_are_disjoint(a._universe, a._universe)
+
+
+def test_promise_disjoint_on_equal_universes_raises():
+    a = T("v\n1")
+    with pytest.raises(ValueError, match="equal universes"):
+        pw.universes.promise_are_pairwise_disjoint(a, a)
+
+
+def test_subsets_of_disjoint_universes_are_disjoint():
+    from pathway_tpu.internals.universe import SOLVER
+
+    a = T("k | v\n1 | 1\n2 | 2")
+    b = T("k | v\n3 | 3")
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    sub_a = a.filter(pw.this.k == 1)
+    assert SOLVER.query_are_disjoint(sub_a._universe, b._universe)
+
+
+def test_wrong_disjoint_promise_verified_at_runtime():
+    # identical position-minted ids actually collide; the promise is wrong
+    a = T("v\n1")
+    b = T("v\n2")
+    pw.universes.promise_are_pairwise_disjoint(a, b)
+    both = pw.Table.concat(a, b)
+    with pytest.raises(Exception):
+        _rows(both)
+
+
+# -- sort / prev-next edge cases -------------------------------------------
+
+def test_sort_single_row_has_no_neighbors():
+    t = T("v\n5")
+    s = t.sort(pw.this.v)
+    [(prev, nxt)] = _rows(s)
+    assert prev is None and nxt is None
+
+
+def test_sort_chain_walks_in_order():
+    t = T("v\n30\n10\n20")
+    s = t.sort(pw.this.v)
+    enriched = t.with_columns(prev=s.prev, next=s.next)
+    rows = run_table(enriched)
+    by_v = {v: (p, n) for v, p, n in rows.values()}
+    assert by_v[10][0] is None and by_v[30][1] is None
+    # middle element links both ways
+    assert by_v[20][0] is not None and by_v[20][1] is not None
+
+
+def test_sort_with_instance_partitions():
+    t = T("g | v\na | 1\na | 2\nb | 3")
+    s = t.sort(pw.this.v, instance=pw.this.g)
+    enriched = t.with_columns(prev=s.prev, next=s.next)
+    by = {(g, v): (p, n) for g, v, p, n in run_table(enriched).values()}
+    # b's single row is alone in its instance
+    assert by[("b", 3)] == (None, None)
+    assert by[("a", 1)][1] is not None and by[("a", 2)][0] is not None
+
+
+def test_sort_ties_are_deterministic():
+    t = T("v\n1\n1\n1")
+    s = t.sort(pw.this.v)
+    rows = list(run_table(s).values())
+    n_first = sum(1 for p, n in rows if p is None)
+    n_last = sum(1 for p, n in rows if n is None)
+    assert n_first == 1 and n_last == 1  # a single linear chain
+
+
+def test_diff_over_sorted_column():
+    t = T("t | v\n1 | 10\n2 | 15\n3 | 12")
+    from pathway_tpu.stdlib.ordered import diff as _diff
+    d = _diff(t, t.t, pw.this.v)
+    vals = sorted(
+        v for row in run_table(d).values() for v in [row[-1]] if v is not None
+    )
+    assert vals == [-3, 5]
+
+
+# -- intervals_over behaviors ----------------------------------------------
+
+def test_intervals_over_accepts_common_behavior():
+    t = T("t | v\n1 | 10\n3 | 20\n5 | 30")
+    r = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.intervals_over(
+            at=t.t, lower_bound=-2, upper_bound=0
+        ),
+        behavior=pw.temporal.common_behavior(cutoff=100),
+    ).reduce(end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v))
+    assert sorted(run_table(r).values()) == [(1, 10), (3, 30), (5, 50)]
+
+
+def test_intervals_over_behavior_cutoff_streaming():
+    """Late rows beyond the cutoff are ignored; timely rows are not."""
+    t = pw.debug.table_from_markdown(
+        """
+        t  | v  | _time
+        1  | 10 | 2
+        3  | 20 | 4
+        7  | 40 | 6
+        1  | 99 | 20
+        """
+    )
+    r = pw.temporal.windowby(
+        t, t.t,
+        window=pw.temporal.intervals_over(
+            at=t.t, lower_bound=-2, upper_bound=0
+        ),
+        behavior=pw.temporal.common_behavior(cutoff=2),
+    ).reduce(end=pw.this._pw_window_end, s=pw.reducers.sum(pw.this.v))
+    out = dict(sorted(run_table(r).values()))
+    # the t=7 row advanced the watermark to 7, past windows 1 and 3's
+    # cutoffs (end + 2), so the late v=99 row was ignored by both
+    assert out[3] == 30
+    assert out.get(1, 10) == 10
+    assert out[7] == 40
+
+
+def test_intervals_over_rejects_non_common_behavior():
+    t = T("t | v\n1 | 1")
+    with pytest.raises(NotImplementedError):
+        pw.temporal.windowby(
+            t, t.t,
+            window=pw.temporal.intervals_over(
+                at=t.t, lower_bound=-1, upper_bound=0
+            ),
+            behavior=pw.temporal.exactly_once_behavior(),
+        ).reduce(s=pw.reducers.sum(pw.this.v))
+
+
+# -- misc reference edge cases ---------------------------------------------
+
+def test_groupby_after_filter_retracts_cleanly():
+    t = T("k | v\n1 | 5\n1 | 7\n2 | 9")
+    f = t.filter(pw.this.v > 5)
+    r = f.groupby(pw.this.k).reduce(k=pw.this.k, s=pw.reducers.sum(pw.this.v))
+    assert _rows(r) == [(1, 7), (2, 9)]
+
+
+def test_flatten_empty_sequences_drop_rows():
+    t = T("k\n1\n2").select(
+        k=pw.this.k,
+        xs=pw.if_else(pw.this.k == 1, pw.make_tuple(10, 20), pw.make_tuple()),
+    )
+    f = t.flatten(pw.this.xs)
+    assert sorted(x for _, x in _rows(f)) == [10, 20]
+
+
+def test_update_cells_only_touches_matching_rows():
+    base = T("k | v | w\n1 | a | p\n2 | b | q")
+    base = base.with_id(base.pointer_from(base.k))
+    upd = T("k | v\n2 | B")
+    upd = upd.with_id(upd.pointer_from(upd.k))
+    pw.universes.promise_is_subset_of(upd, base)
+    r = base.update_cells(upd)
+    assert sorted(_rows(r)) == [(1, "a", "p"), (2, "B", "q")]
+
+
+def test_ix_missing_key_raises_without_optional():
+    t = T("k | v\n1 | a")
+    t = t.with_id(t.pointer_from(t.k))
+    keys = T("k\n1\n9")
+    with pytest.raises(Exception):
+        _rows(keys.select(v=t.ix(t.pointer_from(keys.k)).v))
+
+
+def test_ix_optional_fills_none():
+    t = T("k | v\n1 | a")
+    t = t.with_id(t.pointer_from(t.k))
+    keys = T("k\n1\n9")
+    r = keys.select(
+        v=t.ix(t.pointer_from(keys.k), optional=True).v
+    )
+    assert sorted(_rows(r), key=repr) == [("a",), (None,)]
+
+
+def test_groupby_sort_by_orders_tuple_reducer():
+    t = T("k | v | o\n1 | a | 3\n1 | b | 1\n1 | c | 2")
+    r = t.groupby(pw.this.k, sort_by=pw.this.o).reduce(
+        k=pw.this.k, xs=pw.reducers.tuple(pw.this.v)
+    )
+    assert _rows(r) == [(1, ("b", "c", "a"))]
+
+
+def test_deduplicate_keeps_latest_accepted():
+    t = T("v | _time\n1 | 2\n5 | 4\n3 | 6")
+    r = pw.stateful.deduplicate(
+        t, value=pw.this.v, acceptor=lambda new, old: new > old
+    )
+    vals = [row[0] for row in run_table(r).values()]
+    assert vals == [5]

@@ -72,3 +72,84 @@ def test_rest_connector_missing_field_400():
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(f"http://127.0.0.1:{port}/", {"wrong": 1})
     assert e.value.code == 400
+
+
+def test_openapi_document_matches_routes():
+    """Served openapi.json reflects registered routes, schema-derived
+    request bodies and GET parameters (reference: _server.py:126)."""
+    port = _next_port()
+
+    class QA(pw.Schema):
+        query: str
+        k: int = pw.column_definition(default_value=3)
+
+    server = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+    queries, response_writer = pw.io.http.rest_connector(
+        webserver=server,
+        route="/v1/answer",
+        schema=QA,
+        methods=("GET", "POST"),
+        autocommit_duration_ms=None,
+        documentation=pw.io.http.EndpointDocumentation(
+            summary="Answer a question", tags=["rag"]
+        ),
+    )
+    response_writer(queries.select(result=pw.this.query))
+
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/openapi.json", timeout=10
+    ) as resp:
+        doc = json.loads(resp.read().decode())
+
+    assert doc["openapi"].startswith("3.")
+    assert set(doc["paths"].keys()) == {"/v1/answer"}
+    ops = doc["paths"]["/v1/answer"]
+    assert set(ops.keys()) == {"get", "post"}
+    assert ops["post"]["summary"] == "Answer a question"
+    assert ops["post"]["tags"] == ["rag"]
+    body = ops["post"]["requestBody"]["content"]["application/json"]["schema"]
+    assert body["properties"]["query"] == {"type": "string"}
+    assert body["properties"]["k"]["type"] == "integer"
+    assert body["properties"]["k"]["default"] == 3
+    assert body["required"] == ["query"]  # k has a default
+    params = {p["name"]: p for p in ops["get"]["parameters"]}
+    assert params["query"]["required"] is True
+    assert params["k"]["required"] is False
+    # /_schema serves the same document
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/_schema", timeout=10
+    ) as resp:
+        assert json.loads(resp.read().decode()) == doc
+
+
+def test_request_type_validation_400():
+    port = _next_port()
+
+    class S(pw.Schema):
+        value: int
+
+    queries, response_writer = pw.io.http.rest_connector(
+        host="127.0.0.1", port=port, schema=S,
+        autocommit_duration_ms=None, delete_completed_queries=True,
+    )
+    response_writer(queries.select(result=pw.this.value * 2))
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"value": "not-an-int"}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+    assert "integer" in json.loads(e.value.read().decode())["error"]
+    # valid request still works
+    assert _post(f"http://127.0.0.1:{port}/", {"value": 4}) == 8
