@@ -243,7 +243,28 @@ buf_put(Buf *b, const void *data, Py_ssize_t n)
 static int
 buf_put_u32(Buf *b, uint32_t v)
 {
-    return buf_put(b, &v, 4);
+    /* explicit little-endian: key bytes must be identical to the Python
+     * path's struct.pack('<I') on every host (api.py requires keys stable
+     * across processes for persistence / multi-host determinism) */
+    unsigned char le[4] = {
+        (unsigned char)(v & 0xff),
+        (unsigned char)((v >> 8) & 0xff),
+        (unsigned char)((v >> 16) & 0xff),
+        (unsigned char)((v >> 24) & 0xff),
+    };
+    return buf_put(b, le, 4);
+}
+
+static int
+buf_put_f64_le(Buf *b, double d)
+{
+    /* matches struct.pack('<d'): IEEE-754 bits emitted little-endian */
+    uint64_t bits;
+    memcpy(&bits, &d, 8);
+    unsigned char le[8];
+    for (int i = 0; i < 8; i++)
+        le[i] = (unsigned char)((bits >> (8 * i)) & 0xff);
+    return buf_put(b, le, 8);
 }
 
 static PyObject *value_to_bytes_py = NULL; /* python fallback */
@@ -263,7 +284,7 @@ serialize_value(Buf *b, PyObject *v)
         double d = PyFloat_AS_DOUBLE(v);
         if (buf_put(b, "F", 1) < 0)
             return -1;
-        return buf_put(b, &d, 8);
+        return buf_put_f64_le(b, d);
     }
     if (PyUnicode_Check(v)) {
         Py_ssize_t n;

@@ -895,26 +895,45 @@ class GradualBroadcastNode(GroupDiffNode):
     so downstream cutoffs move row-by-row instead of all at once."""
 
 
-    STATE_ATTRS = ("left", "threshold")
+    STATE_ATTRS = ("left", "threshold_rows")
     def __init__(self, scope, left_node, threshold_node, triplet_fn):
         super().__init__(scope, [left_node, threshold_node])
         self.triplet_fn = triplet_fn  # (key,row) -> (lower, value, upper)
         self.left = TableState()
-        self.threshold: tuple | None = None
+        # full table state for the threshold side: a retraction-only update
+        # must clear the triplet, and a retract+insert commit must land on
+        # the inserted row regardless of in-batch ordering
+        self.threshold_rows = TableState()
 
     def group_of(self, port, key, row):
         return 0  # single group: threshold changes rediff everything
 
     def apply_updates(self, batches):
         self.left.apply(batches[0])
-        for k, row, d in batches[1]:
-            if d > 0:
-                self.threshold = self.triplet_fn(k, row)
+        if batches[1]:
+            self._legacy_threshold = None
+            self.threshold_rows.apply(batches[1])
+
+    @property
+    def threshold(self) -> tuple | None:
+        for k, row in self.threshold_rows.rows.items():
+            return self.triplet_fn(k, row)
+        return getattr(self, "_legacy_threshold", None)
+
+    def load_state(self, state) -> None:
+        # pre-threshold_rows snapshots stored a bare 'threshold' triplet;
+        # keep serving it until a live threshold-table commit replaces it
+        state = dict(state)
+        legacy = state.pop("threshold", None)
+        super().load_state(state)
+        if legacy is not None and not self.threshold_rows.rows:
+            self._legacy_threshold = tuple(legacy)
 
     def output_of_group(self, _g) -> list[Delta]:
-        if self.threshold is None:
+        threshold = self.threshold
+        if threshold is None:
             return []
-        lower, value, upper = self.threshold
+        lower, value, upper = threshold
         span = upper - lower
         out = []
         for k, row in self.left.rows.items():

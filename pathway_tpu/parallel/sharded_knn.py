@@ -53,21 +53,26 @@ def sharded_topk(
 
     def local(q, db_l, valid_l, *rest):
         sq_l = rest[0] if use_sq else None
+        # per-shard k is bounded by the shard's rows; the merged global
+        # top-k can still honor the full k from other shards' partials
+        # (up to the index's total capacity)
+        k_l = min(k, db_l.shape[0], chunk)
         vals, idx = chunked_topk_scores(
-            q, db_l, valid_l, k,
+            q, db_l, valid_l, k_l,
             chunk=min(chunk, db_l.shape[0]), sq_norms=sq_l,
             metric=metric, precision=precision,
         )
         shard_i = jax.lax.axis_index(axis)
         idx = idx + shard_i * db_l.shape[0]
         # partial top-k exchange + tree merge (the retrieval analog of ring
-        # attention's partial-result merge): [n_shards, q, k] -> [q, k]
+        # attention's partial-result merge): [n_shards, q, k_l] -> [q, k_out]
         all_vals = jax.lax.all_gather(vals, axis)
         all_idx = jax.lax.all_gather(idx, axis)
         n, nq, _ = all_vals.shape
-        av = jnp.transpose(all_vals, (1, 0, 2)).reshape(nq, n * k)
-        ai = jnp.transpose(all_idx, (1, 0, 2)).reshape(nq, n * k)
-        best_v, pos = jax.lax.top_k(av, k)
+        av = jnp.transpose(all_vals, (1, 0, 2)).reshape(nq, n * k_l)
+        ai = jnp.transpose(all_idx, (1, 0, 2)).reshape(nq, n * k_l)
+        k_out = min(k, n * k_l)
+        best_v, pos = jax.lax.top_k(av, k_out)
         best_i = jnp.take_along_axis(ai, pos, axis=-1)
         return best_v, best_i
 
@@ -226,7 +231,10 @@ class ShardedKnnIndex:
         n = queries.shape[0]
         if n == 0 or not self.key_to_slot:
             return [[] for _ in range(n)]
-        k_eff = min(k, self.local_cap, self.chunk)
+        # per-shard partial k is capped inside sharded_topk; the merged
+        # result honors up to min(k, total capacity) — a requested k above
+        # one shard's capacity is no longer silently truncated
+        k_eff = min(k, self.n_shards * min(self.local_cap, self.chunk))
         padded_n = 1
         while padded_n < n:
             padded_n *= 2

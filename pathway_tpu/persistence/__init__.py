@@ -14,12 +14,51 @@ continue exactly once past the last durable commit.
 
 from __future__ import annotations
 
+import io as _io
 import json as _json
 import os
 import pickle
 import threading
 from dataclasses import dataclass, field
 from typing import Any
+
+# Trust boundary: anyone able to write the persistence root can influence
+# what restarts load. Journal entries and subject scan states hold plain
+# engine values, so they are deserialized through an allow-listed
+# unpickler (no arbitrary class resolution -> no code execution on load,
+# matching the reference's non-executable bincode snapshots). Operator
+# snapshots may legitimately contain user-defined reducer state and DO use
+# full pickle — the persistence root must be trusted to the same degree as
+# the program's own code for OPERATOR_PERSISTING mode.
+_SAFE_MODULES = {
+    "collections",
+    "datetime",
+    "pathway_tpu.internals.api",
+}
+# builtins must be name-allowlisted, NOT module-allowlisted: builtins.eval/
+# exec/getattr/__import__ would reopen the code-execution hole
+_SAFE_BUILTINS = {
+    "list", "dict", "set", "frozenset", "tuple", "bytearray", "complex",
+    "bytes", "str", "int", "float", "bool", "range", "slice", "object",
+}
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module == "builtins":
+            if name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+        elif module in _SAFE_MODULES or module.split(".")[0] == "numpy":
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"persistence journal refuses to resolve {module}.{name}; "
+            "only plain engine values are allowed in journal/subject-state "
+            "records"
+        )
+
+
+def _safe_loads(data: bytes):
+    return _SafeUnpickler(_io.BytesIO(data)).load()
 
 
 class _BackendBase:
@@ -269,7 +308,7 @@ class PersistenceManager:
             pos += 8
             if pos + n > len(raw):
                 break  # torn tail from a crash mid-append: drop it
-            entry = pickle.loads(raw[pos : pos + n])
+            entry = _safe_loads(raw[pos : pos + n])
             if len(entry) == 2:  # pre-state journal format
                 entry = (*entry, None)
             out.append(entry)
@@ -278,7 +317,7 @@ class PersistenceManager:
 
     def load_subject_state(self, conn_name: str) -> Any | None:
         raw = self.backend.read(f"subject_state/{conn_name}")
-        return pickle.loads(raw) if raw else None
+        return _safe_loads(raw) if raw else None
 
     # -- operator snapshots (reference: operator_snapshot.rs) --------------
     def save_operator_snapshot(

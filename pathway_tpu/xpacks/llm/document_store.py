@@ -14,7 +14,8 @@ from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
 
 class DocumentStore(VectorStoreServer):
     """reference: document_store.py:32. Accepts `retriever_factory`
-    (pw.indexing.*Factory) instead of a fixed embedder-KNN index."""
+    (pw.indexing.*Factory); index construction is injected into the shared
+    pipeline as a builder strategy — the factory owns embedding."""
 
     def __init__(
         self,
@@ -25,32 +26,27 @@ class DocumentStore(VectorStoreServer):
         doc_post_processors: Sequence[Callable] | None = None,
     ):
         self.retriever_factory = retriever_factory
-        # embedder only probed for dimension in the base class; the factory
-        # owns embedding here, so bypass with a 1-dim stub then rebuild the
-        # index from the factory
-        class _Stub:
-            def get_embedding_dimension(self):
-                return 1
+
+        def build_index(chunked_docs):
+            from pathway_tpu.internals import dtype as dt
+            from pathway_tpu.internals.api import Json
+            from pathway_tpu.internals.expression import apply_with_type
+
+            return retriever_factory.build_index(
+                chunked_docs.text,
+                chunked_docs,
+                metadata_column=apply_with_type(
+                    lambda d: Json(d.value["metadata"]), dt.JSON,
+                    chunked_docs.data,
+                ),
+            )
 
         super().__init__(
             *docs,
-            embedder=_Stub(),
+            index_builder=build_index,
             parser=parser,
             splitter=splitter,
             doc_post_processors=doc_post_processors,
-        )
-
-    def _build_index(self, chunked_docs):
-        from pathway_tpu.internals import dtype as dt
-        from pathway_tpu.internals.api import Json
-        from pathway_tpu.internals.expression import apply_with_type
-
-        return self.retriever_factory.build_index(
-            chunked_docs.text,
-            chunked_docs,
-            metadata_column=apply_with_type(
-                lambda d: Json(d.value["metadata"]), dt.JSON, chunked_docs.data
-            ),
         )
 
 

@@ -67,29 +67,123 @@ class ParseUnstructured(UDF):
 UnstructuredParser = ParseUnstructured
 
 
+def _pdf_unescape(raw: bytes) -> str:
+    """PDF literal-string unescaping ((), \\, octal, \\n...)."""
+    out = []
+    i = 0
+    esc = {
+        ord("n"): "\n", ord("r"): "\r", ord("t"): "\t",
+        ord("b"): "\b", ord("f"): "\f",
+        ord("("): "(", ord(")"): ")", ord("\\"): "\\",
+    }
+    while i < len(raw):
+        c = raw[i]
+        if c == 0x5C and i + 1 < len(raw):  # backslash
+            n = raw[i + 1]
+            if n in esc:
+                out.append(esc[n])
+                i += 2
+                continue
+            if 0x30 <= n <= 0x37:  # octal
+                j = i + 1
+                digits = b""
+                while j < len(raw) and len(digits) < 3 and 0x30 <= raw[j] <= 0x37:
+                    digits += bytes([raw[j]])
+                    j += 1
+                out.append(chr(int(digits, 8)))
+                i = j
+                continue
+            i += 1
+            continue
+        out.append(chr(c))
+        i += 1
+    return "".join(out)
+
+
+def _pdf_content_text(content: bytes) -> str:
+    """Text shown by a content stream: literal strings inside BT..ET via
+    the Tj / TJ / ' / " operators (simple-font PDFs — the common case for
+    machine-generated documents)."""
+    import re
+
+    text_parts: list[str] = []
+    for bt_block in re.findall(rb"BT(.*?)ET", content, re.DOTALL):
+        strings = re.findall(
+            rb"\(((?:[^()\\]|\\.)*)\)\s*(?:Tj|'|\")", bt_block
+        )
+        arrays = re.findall(rb"\[((?:[^\]\\]|\\.)*)\]\s*TJ", bt_block, re.DOTALL)
+        for s in strings:
+            text_parts.append(_pdf_unescape(s))
+        for arr in arrays:
+            for s in re.findall(rb"\(((?:[^()\\]|\\.)*)\)", arr):
+                text_parts.append(_pdf_unescape(s))
+        text_parts.append("\n")
+    return "".join(text_parts)
+
+
+def _builtin_pdf_pages(data: bytes) -> list[str]:
+    """Dependency-free PDF text extraction: each content stream holding
+    BT/ET text blocks is one page, decoded raw or FlateDecode."""
+    import re
+    import zlib
+
+    pages: list[str] = []
+    for m in re.finditer(rb"(?<!end)stream\r?\n", data):
+        start = m.end()
+        end = data.find(b"endstream", start)
+        if end < 0:
+            continue
+        raw = data[start:end].rstrip(b"\r\n")
+        # decompressed candidate FIRST: compressed bytes can contain "BT"/
+        # "ET" by chance, and a break on the raw candidate would drop the
+        # real page; only stop once actual text came out
+        candidates = []
+        try:
+            candidates.append(zlib.decompress(raw))
+        except zlib.error:
+            pass
+        candidates.append(raw)
+        for content in candidates:
+            if b"BT" in content and b"ET" in content:
+                text = _pdf_content_text(content)
+                if text.strip():
+                    pages.append(text)
+                    break
+    return pages
+
+
 class PypdfParser(UDF):
-    """reference: parsers.py PypdfParser."""
+    """reference: parsers.py PypdfParser. Uses pypdf when importable; falls
+    back to the built-in minimal extractor (literal-string Tj/TJ text from
+    raw or Flate streams) so simple PDFs parse with zero dependencies."""
 
     def __init__(self, apply_text_cleanup: bool = True, **kwargs):
         try:
             import pypdf  # noqa: F401
-        except ImportError as e:
-            raise ImportError("PypdfParser requires the `pypdf` package") from e
+
+            self._have_pypdf = True
+        except ImportError:
+            self._have_pypdf = False
         self.apply_text_cleanup = apply_text_cleanup
+        cleanup = (
+            (lambda t: " ".join(t.split())) if apply_text_cleanup else (lambda t: t)
+        )
 
         async def parse(contents) -> list:
-            import io
+            if self._have_pypdf:
+                import io
 
-            import pypdf
+                import pypdf
 
-            reader = pypdf.PdfReader(io.BytesIO(contents))
-            out = []
-            for i, page in enumerate(reader.pages):
-                text = page.extract_text() or ""
-                if self.apply_text_cleanup:
-                    text = " ".join(text.split())
-                out.append((text, {"page": i}))
-            return out
+                reader = pypdf.PdfReader(io.BytesIO(contents))
+                return [
+                    (cleanup(page.extract_text() or ""), {"page": i})
+                    for i, page in enumerate(reader.pages)
+                ]
+            return [
+                (cleanup(text), {"page": i})
+                for i, text in enumerate(_builtin_pdf_pages(contents))
+            ]
 
         super().__init__(parse, return_type=list, deterministic=True)
 

@@ -31,13 +31,23 @@ class VectorStoreServer:
     def __init__(
         self,
         *docs,
-        embedder,
+        embedder=None,
         parser: Callable | None = None,
         splitter: Callable | None = None,
         doc_post_processors: Sequence[Callable] | None = None,
         index_params: dict | None = None,
         mesh=None,
+        index_builder: Callable | None = None,
     ):
+        """Index construction is a strategy: either pass `embedder` (the
+        default brute-force KNN document index is built around it) or
+        inject `index_builder(chunked_docs) -> DataIndex` directly —
+        DocumentStore does the latter with a retriever factory (reference:
+        document_store.py:32-120)."""
+        if (embedder is None) == (index_builder is None):
+            raise ValueError(
+                "provide exactly one of `embedder` or `index_builder`"
+            )
         self.docs = list(docs)
         self.embedder = embedder
         self.parser = parser or ParseUtf8()
@@ -45,7 +55,10 @@ class VectorStoreServer:
         self.doc_post_processors = list(doc_post_processors or [])
         self.index_params = dict(index_params or {})
         self.mesh = mesh
-        if hasattr(embedder, "get_embedding_dimension"):
+        self._index_builder = index_builder
+        if embedder is None:
+            self.embedding_dimension = None
+        elif hasattr(embedder, "get_embedding_dimension"):
             self.embedding_dimension = embedder.get_embedding_dimension()
         else:
             import numpy as np
@@ -158,8 +171,10 @@ class VectorStoreServer:
         )
 
     def _build_index(self, chunked_docs) -> DataIndex:
-        """Overridable index construction (DocumentStore plugs retriever
-        factories here)."""
+        """Index-construction strategy: the injected builder when given,
+        else the embedder-driven brute-force KNN document index."""
+        if self._index_builder is not None:
+            return self._index_builder(chunked_docs)
         return default_brute_force_knn_document_index(
             chunked_docs.text,
             chunked_docs,
