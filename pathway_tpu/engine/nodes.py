@@ -895,7 +895,9 @@ class GradualBroadcastNode(GroupDiffNode):
     so downstream cutoffs move row-by-row instead of all at once."""
 
 
-    STATE_ATTRS = ("left", "threshold_rows")
+    STATE_ATTRS = ("left", "threshold_rows", "_legacy_threshold")
+    _legacy_threshold: tuple | None = None
+
     def __init__(self, scope, left_node, threshold_node, triplet_fn):
         super().__init__(scope, [left_node, threshold_node])
         self.triplet_fn = triplet_fn  # (key,row) -> (lower, value, upper)

