@@ -674,6 +674,551 @@ PyObject *store_load(PyObject *, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* ====================================================================== *
+ *  Sharded native DELTA-JOIN executor (reference: dataflow.rs join impl
+ *  over differential arrangements — join_core computes ΔL⋈R + L'⋈ΔR).
+ *
+ *  Unlike the Python JoinNode (whole-group rediff: O(|L|·|R|) per touched
+ *  join key), this computes the output delta directly:
+ *      Δ(L⋈R) = ΔL ⋈ R_old  +  L_new ⋈ ΔR
+ *  plus pad-row transitions for left/right/outer joins, so work is
+ *  proportional to the OUTPUT change. Shards partition join keys across
+ *  PATHWAY_THREADS; the apply phase runs with the GIL released.
+ *
+ *  Ref-count protocol: phase 2 (no GIL) never touches refcounts — it
+ *  records to_incref (objects newly stored) and to_decref (objects whose
+ *  store entries died). Phase 3 (GIL) INCREFs first, builds the output
+ *  deltas (which borrow from either the store or the still-alive batch
+ *  lists), and DECREFs last.
+ * ====================================================================== */
+
+struct JEntry {
+    PyObject *key;  /* owned (incref'd via to_incref in phase 3) */
+    PyObject *row;  /* owned */
+    int64_t count;
+};
+
+struct JGroup {
+    PyObject *jk = nullptr; /* owned: join-key tuple (for dump/migration) */
+    std::unordered_map<std::string, JEntry> left, right;
+};
+
+struct JShard {
+    std::unordered_map<std::string, JGroup> groups;
+};
+
+enum JType : uint8_t { J_INNER = 0, J_LEFT = 1, J_RIGHT = 2, J_OUTER = 3 };
+enum IdMode : uint8_t {
+    ID_PAIR = 0,
+    ID_FROM_LEFT = 1,
+    ID_FROM_RIGHT = 2,
+    ID_LEFT_FN = 3,
+    ID_RIGHT_FN = 4,
+};
+
+struct JoinStore {
+    int n_shards;
+    uint8_t jt;
+    uint8_t id_mode;
+    int lwidth, rwidth;
+    std::vector<JShard> shards;
+};
+
+void join_store_destructor(PyObject *capsule)
+{
+    auto *s = static_cast<JoinStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.JoinStore"));
+    if (s == nullptr)
+        return;
+    for (auto &sh : s->shards)
+        for (auto &kv : sh.groups) {
+            Py_XDECREF(kv.second.jk);
+            for (auto &e : kv.second.left) {
+                Py_XDECREF(e.second.key);
+                Py_XDECREF(e.second.row);
+            }
+            for (auto &e : kv.second.right) {
+                Py_XDECREF(e.second.key);
+                Py_XDECREF(e.second.row);
+            }
+        }
+    delete s;
+}
+
+JoinStore *get_join_store(PyObject *capsule)
+{
+    return static_cast<JoinStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.JoinStore"));
+}
+
+PyObject *join_store_new(PyObject *, PyObject *args)
+{
+    int n_shards, jt, id_mode, lwidth, rwidth;
+    if (!PyArg_ParseTuple(args, "iiiii", &n_shards, &jt, &id_mode, &lwidth,
+                          &rwidth))
+        return nullptr;
+    if (n_shards < 1)
+        n_shards = 1;
+    auto *s = new JoinStore();
+    s->n_shards = n_shards;
+    s->jt = (uint8_t)jt;
+    s->id_mode = (uint8_t)id_mode;
+    s->lwidth = lwidth;
+    s->rwidth = rwidth;
+    s->shards.resize(n_shards);
+    return PyCapsule_New(s, "pwexec.JoinStore", join_store_destructor);
+}
+
+PyObject *join_store_len(PyObject *, PyObject *arg)
+{
+    JoinStore *s = get_join_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    int64_t n = 0;
+    for (auto &sh : s->shards)
+        n += (int64_t)sh.groups.size();
+    return PyLong_FromLongLong(n);
+}
+
+/* extracted input row for one side */
+struct JRowX {
+    uint32_t shard;
+    std::string jk_bytes;
+    std::string entry_bytes; /* ser(key) + ser(row tuple) */
+    PyObject *jk;            /* borrowed from batch list */
+    PyObject *key;           /* borrowed */
+    PyObject *row;           /* borrowed */
+    int64_t diff;
+};
+
+/* output instruction: null side pointers mean pad-with-Nones */
+struct JEmit {
+    PyObject *lk, *lrow, *rk, *rrow; /* borrowed (see protocol above) */
+    int64_t d;
+};
+
+bool ser_entry(std::string &out, PyObject *key, PyObject *row)
+{
+    if (!ser_value(out, key))
+        return false;
+    return ser_gvals(out, row);
+}
+
+bool extract_side(PyObject *jks, PyObject *keys, PyObject *rows,
+                  PyObject *diffs, int W, std::vector<JRowX> &out)
+{
+    Py_ssize_t n = PyList_Size(jks);
+    if (n < 0)
+        return false;
+    out.resize((size_t)n);
+    std::hash<std::string> hasher;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        JRowX &r = out[(size_t)i];
+        r.jk = PyList_GET_ITEM(jks, i);
+        r.key = PyList_GET_ITEM(keys, i);
+        r.row = PyList_GET_ITEM(rows, i);
+        if (!ser_gvals(r.jk_bytes, r.jk) ||
+            !ser_entry(r.entry_bytes, r.key, r.row)) {
+            PyErr_Clear();
+            PyErr_SetString(FallbackError, "unsupported join value");
+            return false;
+        }
+        r.shard = (uint32_t)(hasher(r.jk_bytes) % (size_t)W);
+        PyObject *d = PyList_GET_ITEM(diffs, i);
+        int overflow = 0;
+        r.diff = PyLong_AsLongLongAndOverflow(d, &overflow);
+        if (overflow || (r.diff == -1 && PyErr_Occurred())) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(FallbackError, "diff overflow");
+            return false;
+        }
+    }
+    return true;
+}
+
+/* per-shard scratch produced by the parallel apply phase */
+struct JShardOut {
+    std::vector<JEmit> emits;
+    std::vector<PyObject *> to_incref;
+    std::vector<PyObject *> to_decref;
+};
+
+/* apply one side's delta rows to a side map; records refcount intents */
+inline void japply(std::unordered_map<std::string, JEntry> &side,
+                   const JRowX &r, JShardOut &o)
+{
+    auto it = side.find(r.entry_bytes);
+    if (it == side.end()) {
+        side.emplace(r.entry_bytes, JEntry{r.key, r.row, r.diff});
+        o.to_incref.push_back(r.key);
+        o.to_incref.push_back(r.row);
+    } else {
+        it->second.count += r.diff;
+        if (it->second.count == 0) {
+            o.to_decref.push_back(it->second.key);
+            o.to_decref.push_back(it->second.row);
+            side.erase(it);
+        }
+    }
+}
+
+PyObject *join_batch(PyObject *, PyObject *args)
+{
+    PyObject *capsule;
+    PyObject *ljks, *lkeys, *lrows, *ldiffs;
+    PyObject *rjks, *rkeys, *rrows, *rdiffs;
+    PyObject *pair_key_fn, *id_fn;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOO", &capsule, &ljks, &lkeys,
+                          &lrows, &ldiffs, &rjks, &rkeys, &rrows, &rdiffs,
+                          &pair_key_fn, &id_fn))
+        return nullptr;
+    JoinStore *store = get_join_store(capsule);
+    if (store == nullptr)
+        return nullptr;
+    const int W = store->n_shards;
+    const bool lpads = store->jt == J_LEFT || store->jt == J_OUTER;
+    const bool rpads = store->jt == J_RIGHT || store->jt == J_OUTER;
+
+    /* phase 1: extract (GIL held; no state mutated — Fallback replayable) */
+    std::vector<JRowX> lx, rx;
+    if (!extract_side(ljks, lkeys, lrows, ldiffs, W, lx) ||
+        !extract_side(rjks, rkeys, rrows, rdiffs, W, rx))
+        return nullptr;
+
+    /* phase 2: apply + delta emission (GIL released) */
+    std::vector<JShardOut> outs((size_t)W);
+    {
+        struct Aff {
+            std::vector<int32_t> l, r;
+        };
+        std::vector<std::unordered_map<std::string, Aff>> touched((size_t)W);
+        std::vector<std::vector<const std::string *>> order((size_t)W);
+        for (size_t i = 0; i < lx.size(); i++) {
+            auto &t = touched[lx[i].shard];
+            auto it = t.find(lx[i].jk_bytes);
+            if (it == t.end()) {
+                it = t.emplace(lx[i].jk_bytes, Aff{}).first;
+                order[lx[i].shard].push_back(&it->first);
+            }
+            it->second.l.push_back((int32_t)i);
+        }
+        for (size_t i = 0; i < rx.size(); i++) {
+            auto &t = touched[rx[i].shard];
+            auto it = t.find(rx[i].jk_bytes);
+            if (it == t.end()) {
+                it = t.emplace(rx[i].jk_bytes, Aff{}).first;
+                order[rx[i].shard].push_back(&it->first);
+            }
+            it->second.r.push_back((int32_t)i);
+        }
+
+        auto work = [&](int w) {
+            JShard &sh = store->shards[(size_t)w];
+            JShardOut &o = outs[(size_t)w];
+            for (const std::string *jkb : order[(size_t)w]) {
+                Aff &aff = touched[(size_t)w][*jkb];
+                auto git = sh.groups.find(*jkb);
+                if (git == sh.groups.end()) {
+                    git = sh.groups.emplace(*jkb, JGroup{}).first;
+                    /* mint the group's jk ref from the first delta row */
+                    PyObject *jk = aff.l.empty() ? rx[(size_t)aff.r[0]].jk
+                                                 : lx[(size_t)aff.l[0]].jk;
+                    git->second.jk = jk;
+                    o.to_incref.push_back(jk);
+                }
+                JGroup &g = git->second;
+                const bool llive0 = !g.left.empty();
+                const bool rlive0 = !g.right.empty();
+
+                /* ΔL × R_old */
+                for (int32_t li : aff.l) {
+                    const JRowX &dl = lx[(size_t)li];
+                    for (auto &e : g.right)
+                        o.emits.push_back(JEmit{dl.key, dl.row, e.second.key,
+                                                e.second.row,
+                                                dl.diff * e.second.count});
+                    if (lpads && !rlive0)
+                        o.emits.push_back(
+                            JEmit{dl.key, dl.row, nullptr, nullptr, dl.diff});
+                }
+                for (int32_t li : aff.l)
+                    japply(g.left, lx[(size_t)li], o);
+
+                /* L_new × ΔR */
+                for (int32_t ri : aff.r) {
+                    const JRowX &dr = rx[(size_t)ri];
+                    for (auto &e : g.left)
+                        o.emits.push_back(JEmit{e.second.key, e.second.row,
+                                                dr.key, dr.row,
+                                                e.second.count * dr.diff});
+                    if (rpads && !llive0)
+                        o.emits.push_back(
+                            JEmit{nullptr, nullptr, dr.key, dr.row, dr.diff});
+                }
+                for (int32_t ri : aff.r)
+                    japply(g.right, rx[(size_t)ri], o);
+
+                /* pad transitions: tracked pads now reflect (L1 vs Rlive0)
+                 * and (R1 vs Llive0); correct for liveness flips */
+                const bool llive1 = !g.left.empty();
+                const bool rlive1 = !g.right.empty();
+                if (lpads && rlive0 != rlive1) {
+                    const int64_t sign = rlive1 ? -1 : 1;
+                    for (auto &e : g.left)
+                        o.emits.push_back(JEmit{e.second.key, e.second.row,
+                                                nullptr, nullptr,
+                                                sign * e.second.count});
+                }
+                if (rpads && llive0 != llive1) {
+                    const int64_t sign = llive1 ? -1 : 1;
+                    for (auto &e : g.right)
+                        o.emits.push_back(JEmit{nullptr, nullptr,
+                                                e.second.key, e.second.row,
+                                                sign * e.second.count});
+                }
+                if (g.left.empty() && g.right.empty()) {
+                    o.to_decref.push_back(g.jk);
+                    sh.groups.erase(git);
+                }
+            }
+        };
+
+        size_t total = lx.size() + rx.size();
+        Py_BEGIN_ALLOW_THREADS
+        if (W > 1 && total >= 2048) {
+            std::vector<std::thread> threads;
+            threads.reserve((size_t)W);
+            for (int w = 0; w < W; w++)
+                threads.emplace_back(work, w);
+            for (auto &t : threads)
+                t.join();
+        } else {
+            for (int w = 0; w < W; w++)
+                work(w);
+        }
+        Py_END_ALLOW_THREADS
+    }
+
+    /* phase 3: refcounts + output materialization (GIL held) */
+    for (auto &o : outs)
+        for (PyObject *p : o.to_incref)
+            Py_INCREF(p);
+
+    PyObject *out = PyList_New(0);
+    bool failed = out == nullptr;
+    const int lw = store->lwidth, rw = store->rwidth;
+    for (auto &o : outs) {
+        if (failed)
+            break;
+        for (JEmit &e : o.emits) {
+            if (e.d == 0)
+                continue;
+            PyObject *row = PyTuple_New(lw + rw);
+            if (row == nullptr) {
+                failed = true;
+                break;
+            }
+            for (int j = 0; j < lw; j++) {
+                PyObject *v =
+                    e.lrow != nullptr ? PyTuple_GET_ITEM(e.lrow, j) : Py_None;
+                Py_INCREF(v);
+                PyTuple_SET_ITEM(row, j, v);
+            }
+            for (int j = 0; j < rw; j++) {
+                PyObject *v =
+                    e.rrow != nullptr ? PyTuple_GET_ITEM(e.rrow, j) : Py_None;
+                Py_INCREF(v);
+                PyTuple_SET_ITEM(row, lw + j, v);
+            }
+            PyObject *okey = nullptr;
+            switch (store->id_mode) {
+            case ID_LEFT_FN:
+                if (e.lk == nullptr) {
+                    PyErr_SetString(
+                        PyExc_ValueError,
+                        "join id= references the left side but an "
+                        "outer/right join produced a row with no left match");
+                    failed = true;
+                } else {
+                    okey = PyObject_CallFunctionObjArgs(id_fn, e.lk, e.lrow,
+                                                        nullptr);
+                }
+                break;
+            case ID_RIGHT_FN:
+                if (e.rk == nullptr) {
+                    PyErr_SetString(
+                        PyExc_ValueError,
+                        "join id= references the right side but an "
+                        "outer/left join produced a row with no right match");
+                    failed = true;
+                } else {
+                    okey = PyObject_CallFunctionObjArgs(id_fn, e.rk, e.rrow,
+                                                        nullptr);
+                }
+                break;
+            case ID_FROM_LEFT:
+                if (e.lk != nullptr) {
+                    okey = e.lk;
+                    Py_INCREF(okey);
+                    break;
+                }
+                /* fall through to pair key */
+                okey = PyObject_CallFunctionObjArgs(
+                    pair_key_fn, e.lk ? e.lk : Py_None,
+                    e.rk ? e.rk : Py_None, nullptr);
+                break;
+            case ID_FROM_RIGHT:
+                if (e.rk != nullptr) {
+                    okey = e.rk;
+                    Py_INCREF(okey);
+                    break;
+                }
+                okey = PyObject_CallFunctionObjArgs(
+                    pair_key_fn, e.lk ? e.lk : Py_None,
+                    e.rk ? e.rk : Py_None, nullptr);
+                break;
+            default:
+                okey = PyObject_CallFunctionObjArgs(
+                    pair_key_fn, e.lk ? e.lk : Py_None,
+                    e.rk ? e.rk : Py_None, nullptr);
+            }
+            if (okey == nullptr) {
+                Py_DECREF(row);
+                failed = true;
+                break;
+            }
+            PyObject *delta = Py_BuildValue("(NNL)", okey, row,
+                                            (long long)e.d);
+            if (delta == nullptr || PyList_Append(out, delta) < 0) {
+                Py_XDECREF(delta);
+                failed = true;
+                break;
+            }
+            Py_DECREF(delta);
+        }
+    }
+
+    for (auto &o : outs)
+        for (PyObject *p : o.to_decref)
+            Py_DECREF(p);
+    if (failed) {
+        Py_XDECREF(out);
+        return nullptr;
+    }
+    return out;
+}
+
+/* dump: [(jk, [(key,row,count) left], [(key,row,count) right])] */
+PyObject *join_store_dump(PyObject *, PyObject *arg)
+{
+    JoinStore *s = get_join_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    PyObject *out = PyList_New(0);
+    if (out == nullptr)
+        return nullptr;
+    auto dump_side = [](std::unordered_map<std::string, JEntry> &side)
+        -> PyObject * {
+        PyObject *lst = PyList_New(0);
+        if (lst == nullptr)
+            return nullptr;
+        for (auto &e : side) {
+            PyObject *t = Py_BuildValue("(OOL)", e.second.key, e.second.row,
+                                        (long long)e.second.count);
+            if (t == nullptr || PyList_Append(lst, t) < 0) {
+                Py_XDECREF(t);
+                Py_DECREF(lst);
+                return nullptr;
+            }
+            Py_DECREF(t);
+        }
+        return lst;
+    };
+    for (auto &sh : s->shards) {
+        for (auto &kv : sh.groups) {
+            PyObject *l = dump_side(kv.second.left);
+            PyObject *r = l != nullptr ? dump_side(kv.second.right) : nullptr;
+            PyObject *entry =
+                r != nullptr
+                    ? Py_BuildValue("(ONN)", kv.second.jk, l, r)
+                    : nullptr;
+            if (entry == nullptr || PyList_Append(out, entry) < 0) {
+                if (entry == nullptr && l != nullptr && r == nullptr)
+                    Py_DECREF(l);
+                Py_XDECREF(entry);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            Py_DECREF(entry);
+        }
+    }
+    return out;
+}
+
+PyObject *join_store_load(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *entries;
+    if (!PyArg_ParseTuple(args, "OO", &capsule, &entries))
+        return nullptr;
+    JoinStore *s = get_join_store(capsule);
+    if (s == nullptr)
+        return nullptr;
+    std::hash<std::string> hasher;
+    Py_ssize_t n = PyList_Size(entries);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *entry = PyList_GET_ITEM(entries, i);
+        PyObject *jk, *lside, *rside;
+        if (!PyArg_ParseTuple(entry, "OOO", &jk, &lside, &rside))
+            return nullptr;
+        std::string jkb;
+        if (!ser_gvals(jkb, jk)) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(FallbackError,
+                                "unsupported join value in snapshot");
+            return nullptr;
+        }
+        JShard &sh = s->shards[hasher(jkb) % (size_t)s->n_shards];
+        JGroup &g = sh.groups[jkb];
+        if (g.jk == nullptr) {
+            Py_INCREF(jk);
+            g.jk = jk;
+        }
+        auto load_side =
+            [](PyObject *lst,
+               std::unordered_map<std::string, JEntry> &side) -> bool {
+            Py_ssize_t m = PyList_Size(lst);
+            if (m < 0)
+                return false;
+            for (Py_ssize_t j = 0; j < m; j++) {
+                PyObject *key, *row;
+                long long count;
+                if (!PyArg_ParseTuple(PyList_GET_ITEM(lst, j), "OOL", &key,
+                                      &row, &count))
+                    return false;
+                std::string eb;
+                if (!ser_entry(eb, key, row)) {
+                    if (!PyErr_Occurred())
+                        PyErr_SetString(FallbackError,
+                                        "unsupported join value in snapshot");
+                    return false;
+                }
+                auto ins = side.emplace(eb, JEntry{key, row, count});
+                if (ins.second) {
+                    Py_INCREF(key);
+                    Py_INCREF(row);
+                } else {
+                    /* re-load into a non-empty store: merge counts */
+                    ins.first->second.count += count;
+                }
+            }
+            return true;
+        };
+        if (!load_side(lside, g.left) || !load_side(rside, g.right))
+            return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
 PyMethodDef methods[] = {
     {"store_new", store_new, METH_VARARGS,
      "store_new(n_shards, codes) -> capsule"},
@@ -683,6 +1228,16 @@ PyMethodDef methods[] = {
     {"store_load", store_load, METH_VARARGS, "restore a dumped store"},
     {"process_batch", process_batch, METH_VARARGS,
      "process_batch(store, gvals, valcols, diffs, key_fn, error) -> deltas"},
+    {"join_store_new", join_store_new, METH_VARARGS,
+     "join_store_new(n_shards, jtype, id_mode, lwidth, rwidth) -> capsule"},
+    {"join_store_len", join_store_len, METH_O, "number of live join keys"},
+    {"join_store_dump", join_store_dump, METH_O,
+     "picklable [(jk, left_entries, right_entries)]"},
+    {"join_store_load", join_store_load, METH_VARARGS,
+     "restore a dumped join store"},
+    {"join_batch", join_batch, METH_VARARGS,
+     "join_batch(store, ljks, lkeys, lrows, ldiffs, rjks, rkeys, rrows, "
+     "rdiffs, pair_key_fn, id_fn) -> deltas"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -306,9 +306,20 @@ class GroupDiffNode(Node):
         return consolidate(after + negate(before))
 
 
+_JOIN_TYPE_CODES = {"inner": 0, "left": 1, "right": 2, "outer": 3}
+
+
 class JoinNode(GroupDiffNode):
     """Incremental join — inner/left/right/outer (reference: Graph::join_tables
-    graph.rs:480 JoinType; dataflow.rs join impl)."""
+    graph.rs:480 JoinType; dataflow.rs join impl).
+
+    The hot path is the sharded native DELTA-join executor (native/exec.cpp
+    JoinStore): output deltas are computed directly as ΔL⋈R + L'⋈ΔR (plus
+    pad transitions), so per-batch work is proportional to the OUTPUT
+    change, not the size of touched join groups; shard maps update in
+    parallel over PATHWAY_THREADS with the GIL released. Batches carrying
+    values the serializer can't represent (ndarrays, Json, ERROR) demote
+    the node to the Python whole-group-rediff path below."""
 
 
     STATE_ATTRS = ("left", "right")
@@ -327,6 +338,8 @@ class JoinNode(GroupDiffNode):
         left_id_fn=None,
         right_id_fn=None,
         exact_match: bool = False,
+        lkey_batch=None,
+        rkey_batch=None,
     ):
         super().__init__(scope, [left_node, right_node])
         self.left_key_fn = left_key_fn
@@ -342,6 +355,21 @@ class JoinNode(GroupDiffNode):
         # VALUES on that side, not the side's row ids
         self.left_id_fn = left_id_fn
         self.right_id_fn = right_id_fn
+        # batch-wise join-key evaluation (column-oriented, one expression
+        # call per batch instead of one closure call per row)
+        self.lkey_batch = lkey_batch or (
+            lambda keys, rows: [left_key_fn(k, r) for k, r in zip(keys, rows)]
+        )
+        self.rkey_batch = rkey_batch or (
+            lambda keys, rows: [right_key_fn(k, r) for k, r in zip(keys, rows)]
+        )
+        self._native_ok = (
+            join_type in _JOIN_TYPE_CODES
+            and left_width is not None
+            and right_width is not None
+        )
+        self._exec = None
+        self._jstore = None
 
     def group_of(self, port, key, row):
         return self.left_key_fn(key, row) if port == 0 else self.right_key_fn(key, row)
@@ -351,6 +379,110 @@ class JoinNode(GroupDiffNode):
             self.left.apply_one(self.left_key_fn(k, row), (k, row), d)
         for k, row, d in batches[1]:
             self.right.apply_one(self.right_key_fn(k, row), (k, row), d)
+
+    # -- native delta-join path -------------------------------------------
+    def _native_setup(self) -> bool:
+        if self._jstore is not None:
+            return True
+        from pathway_tpu.native import get_pwexec
+
+        ex = get_pwexec()
+        if ex is None or not hasattr(ex, "join_batch"):
+            self._native_ok = False
+            return False
+        from pathway_tpu.internals.config import get_pathway_config
+
+        if self.left_id_fn is not None:
+            id_mode = 3
+        elif self.right_id_fn is not None:
+            id_mode = 4
+        elif self.id_from_left:
+            id_mode = 1
+        elif self.id_from_right:
+            id_mode = 2
+        else:
+            id_mode = 0
+        self._exec = ex
+        self._jstore = ex.join_store_new(
+            max(1, get_pathway_config().threads),
+            _JOIN_TYPE_CODES[self.join_type],
+            id_mode,
+            self.left_width,
+            self.right_width,
+        )
+        return True
+
+    def _replay_entries(self, entries) -> None:
+        """Load dumped native join state into the Python MultisetStates."""
+        for jk, lentries, rentries in entries:
+            for key, row, count in lentries:
+                self.left.apply_one(jk, (key, row), count)
+            for key, row, count in rentries:
+                self.right.apply_one(jk, (key, row), count)
+
+    def _migrate_to_python(self) -> None:
+        """Convert the C++ join store into the Python MultisetStates
+        (one-way: a batch with unrepresentable values permanently demotes
+        this node)."""
+        self._replay_entries(self._exec.join_store_dump(self._jstore))
+        self._jstore = None
+        self._native_ok = False
+
+    def process(self, time, batches):
+        lb = consolidate(batches[0])
+        rb = consolidate(batches[1])
+        if not lb and not rb:
+            return []
+        if self._native_ok and self._native_setup():
+            lkeys = [d[0] for d in lb]
+            lrows = [d[1] for d in lb]
+            rkeys = [d[0] for d in rb]
+            rrows = [d[1] for d in rb]
+            try:
+                raw = self._exec.join_batch(
+                    self._jstore,
+                    list(self.lkey_batch(lkeys, lrows)),
+                    lkeys,
+                    lrows,
+                    [d[2] for d in lb],
+                    list(self.rkey_batch(rkeys, rrows)),
+                    rkeys,
+                    rrows,
+                    [d[2] for d in rb],
+                    ref_scalar,
+                    self.left_id_fn or self.right_id_fn,
+                )
+            except self._exec.Fallback:
+                self._migrate_to_python()
+            else:
+                # pad retract + inner insert can target the same (key, row)
+                return consolidate(raw)
+        return super().process(time, [lb, rb])
+
+    # operator snapshots mirror GroupByNode: native stores dump to a
+    # picklable list; loading a python-format snapshot demotes the node
+    def state_dict(self):
+        if self._jstore is not None:
+            return {"__native__": self._exec.join_store_dump(self._jstore)}
+        return {a: getattr(self, a) for a in self.STATE_ATTRS}
+
+    def load_state(self, state) -> None:
+        native = state.get("__native__") if isinstance(state, dict) else None
+        if native is not None:
+            if self._native_ok and self._native_setup():
+                try:
+                    self._exec.join_store_load(self._jstore, native)
+                    return
+                except self._exec.Fallback:
+                    # partially-loaded store is discarded wholesale
+                    self._jstore = None
+            self._replay_entries(native)
+            self._native_ok = False
+            return
+        for a, v in state.items():
+            setattr(self, a, v)
+        if self.left.data or self.right.data:
+            self._native_ok = False
 
     def output_of_group(self, jk) -> list[Delta]:
         lrows = self.left.get(jk)

@@ -91,6 +91,8 @@ class Scope:
         id_from_right: bool = False,
         left_id_fn=None,
         right_id_fn=None,
+        lkey_batch=None,
+        rkey_batch=None,
     ) -> EngineTable:
         node = N.JoinNode(
             self,
@@ -105,6 +107,8 @@ class Scope:
             id_from_right=id_from_right,
             left_id_fn=left_id_fn,
             right_id_fn=right_id_fn,
+            lkey_batch=lkey_batch,
+            rkey_batch=rkey_batch,
         )
         return EngineTable(node, left.width + right.width)
 

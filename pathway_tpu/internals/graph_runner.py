@@ -64,13 +64,18 @@ class LoweringContext:
                     f"universe; use .restrict()/.ix() first"
                 )
             other = self.engine_table(t)
+            # join keys are 1-tuples (not bare Pointers) so the native
+            # delta-join serializer accepts them — id-joins are the hot
+            # path behind every cross-table expression
             combined = self.scope.join(
                 combined,
                 other,
-                lambda k, row: k,
-                lambda k, row: k,
+                lambda k, row: (k,),
+                lambda k, row: (k,),
                 "inner",
                 id_from_left=True,
+                lkey_batch=lambda keys, rows: [(k,) for k in keys],
+                rkey_batch=lambda keys, rows: [(k,) for k in keys],
             )
             offsets[id(t)] = width
             width += other.width

@@ -212,6 +212,16 @@ class JoinResult:
             def rkey(k, row):
                 return tuple(f([k], [row])[0] for f in rfns)
 
+            # column-oriented key evaluation for the engine's batch path
+            # (one compiled-expression call per batch per key column)
+            def lkey_batch(keys, rows):
+                cols = [f(keys, rows) for f in lfns]
+                return list(zip(*cols)) if cols else [()] * len(keys)
+
+            def rkey_batch(keys, rows):
+                cols = [f(keys, rows) for f in rfns]
+                return list(zip(*cols)) if cols else [()] * len(keys)
+
             left_id_fn = right_id_fn = None
             if id_expr is not None:
                 side_table = left if id_expr_side == "left" else right
@@ -238,6 +248,8 @@ class JoinResult:
                 id_from_right=id_from_right,
                 left_id_fn=left_id_fn,
                 right_id_fn=right_id_fn,
+                lkey_batch=lkey_batch,
+                rkey_batch=rkey_batch,
             )
 
             def out_resolver(ref):
@@ -271,6 +283,7 @@ class JoinResult:
     def _engine_join(
         self, ctx, let, ret, lkey, rkey, how, *,
         id_from_left, id_from_right, left_id_fn, right_id_fn,
+        lkey_batch=None, rkey_batch=None,
     ):
         """Engine-join construction hook; temporal joins override this
         (stdlib/temporal) while reusing the select/desugaring machinery."""
@@ -284,6 +297,8 @@ class JoinResult:
             id_from_right=id_from_right,
             left_id_fn=left_id_fn,
             right_id_fn=right_id_fn,
+            lkey_batch=lkey_batch,
+            rkey_batch=rkey_batch,
         )
 
     def _desugar(self, e):
