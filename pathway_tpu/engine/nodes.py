@@ -276,6 +276,51 @@ class ConcatNode(Node):
         return out
 
 
+class ExchangeNode(Node):
+    """Shard-routing boundary for multi-process runs (reference: timely
+    exchange pacts at groupby/join boundaries, dataflow.rs).
+
+    Hash mode partitions each delta batch by a key (the downstream
+    stateful node's grouping/join key) via the process-stable shard hash
+    and all-to-alls the slices over the TCP mesh, so every rank owns a
+    key shard. Broadcast mode replicates the batch to every rank (small
+    sides: external-index build side, gradual_broadcast thresholds).
+    Gather mode routes everything to rank 0 (outputs). Single-process
+    runs never construct this node.
+
+    The runtime marks every ExchangeNode pending at every lockstep
+    timestamp, so ranks participate in the same all-to-alls in the same
+    node-id order even when they hold no local rows for that time.
+    """
+
+    def __init__(self, scope, input_node, key_batch=None, mode="hash"):
+        super().__init__(scope, [input_node])
+        self.key_batch = key_batch
+        self.mode = mode
+
+    def process(self, time, batches):
+        pg = self.scope.runtime.procgroup
+        deltas = consolidate(batches[0])
+        world = pg.world
+        if self.mode == "hash":
+            from pathway_tpu.parallel.procgroup import stable_shard
+
+            per_rank: list[list] = [[] for _ in range(world)]
+            if deltas:
+                pks = self.key_batch(
+                    [d[0] for d in deltas], [d[1] for d in deltas]
+                )
+                for d, pk in zip(deltas, pks):
+                    per_rank[stable_shard(pk, world)].append(d)
+        elif self.mode == "broadcast":
+            per_rank = [list(deltas) for _ in range(world)]
+        else:  # gather -> rank 0
+            per_rank = [[] for _ in range(world)]
+            per_rank[0] = list(deltas)
+        merged = pg.all_to_all(("x", self.node_id, time), per_rank)
+        return consolidate(merged)
+
+
 class GroupDiffNode(Node):
     """Base for stateful nodes using the affected-group rediff strategy."""
 

@@ -76,6 +76,99 @@ class Runtime:
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
+        # multi-process (PATHWAY_PROCESSES>1): TCP mesh + lockstep state
+        self._procgroup = None
+        self._lockstep_seq = 0
+        self._reach_masks: list[int] | None = None
+
+    # -- multi-process plane ----------------------------------------------
+    @property
+    def distributed(self) -> bool:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        return get_pathway_config().processes > 1
+
+    @property
+    def procgroup(self):
+        if self._procgroup is None:
+            from pathway_tpu.internals.config import get_pathway_config
+            from pathway_tpu.parallel.procgroup import ProcessGroup
+
+            c = get_pathway_config()
+            self._procgroup = ProcessGroup(
+                c.process_id, c.processes, c.first_port
+            )
+        return self._procgroup
+
+    def _exchange_reach_masks(self) -> list[int]:
+        """node_id -> bitmask (over scope.exchange_nodes indices) of
+        exchange boundaries reachable downstream of that node, computed
+        once over the static graph in reverse topological order. Lets the
+        lockstep protocol mark only exchanges that can possibly carry
+        data at a timestamp instead of every boundary at every time."""
+        nodes = self.scope.nodes
+        if self._reach_masks is not None and len(self._reach_masks) == len(nodes):
+            return self._reach_masks
+        xidx = {
+            id(xn): i for i, xn in enumerate(self.scope.exchange_nodes)
+        }
+        masks = [0] * len(nodes)
+        for node in reversed(nodes):  # registration order is topological
+            m = xidx.get(id(node))
+            mask = 0 if m is None else (1 << m)
+            for child, _port in node.downstream:
+                mask |= masks[child.node_id]
+            masks[node.node_id] = mask
+        self._reach_masks = masks
+        return masks
+
+    def _step_lockstep(self, bound: int | None = None) -> int:
+        """Step globally-agreed timestamps in order until no rank has
+        pending work (<= bound). One control round-trip per timestamp: the
+        rank-0 master takes the min over every rank's frontier, so all
+        ranks step the same times in the same order. Each frontier entry
+        carries the union of downstream-reachable exchange masks of its
+        pending nodes; every rank marks exactly the masked ExchangeNodes
+        pending at the agreed time, so all ranks join the same all-to-alls
+        — including boundaries where only ANOTHER rank holds rows."""
+        pg = self.procgroup
+        masks = self._exchange_reach_masks()
+        stepped = 0
+        while True:
+            self._lockstep_seq += 1
+            seq = self._lockstep_seq
+            mine = None
+            if self.pending_times:
+                m = self._min_pending()
+                if bound is None or m <= bound:
+                    xmask = 0
+                    for nid in self.pending_times.get(m, ()):
+                        xmask |= masks[nid]
+                    mine = (m, xmask)
+            if pg.rank == 0:
+                fronts = pg.gather0(("f", seq), mine)
+                live = [f for f in fronts if f is not None]
+                if live:
+                    t = min(f[0] for f in live)
+                    xmask = 0
+                    for ft, fm in live:
+                        if ft == t:
+                            xmask |= fm
+                    plan = (t, xmask)
+                else:
+                    plan = None
+                pg.bcast0(("f2", seq), plan)
+            else:
+                pg.gather0(("f", seq), mine)
+                plan = pg.bcast0(("f2", seq))
+            if plan is None:
+                return stepped
+            t, xmask = plan
+            for i, xn in enumerate(self.scope.exchange_nodes):
+                if (xmask >> i) & 1:
+                    self.mark_pending(t, xn)
+            self._step_time(t)
+            stepped += 1
 
     # -- wiring ----------------------------------------------------------
     def add_static_data(self, node: SourceNode, deltas: list[Delta]) -> None:
@@ -150,15 +243,32 @@ class Runtime:
         # must still flow through the graph before on_end callbacks fire.
         # Loop until quiescent: an upstream buffer's flush may land inside
         # a DOWNSTREAM buffer that then needs its own closure flush.
-        for _ in range(len(self.scope.nodes) + 1):
-            for node in self.scope.nodes:
-                node.on_input_closed()
-            if not self.pending_times:
-                break
-            while self.pending_times:
-                self._step_time(self._min_pending())
+        if self.distributed:
+            pg = self.procgroup
+            for i in range(len(self.scope.nodes) + 1):
+                for node in self.scope.nodes:
+                    node.on_input_closed()
+                stepped = self._step_lockstep(None)
+                # closure must repeat while ANY rank still produced work
+                flags = pg.gather0(("fin", i), stepped > 0)
+                more = pg.bcast0(
+                    ("fin2", i), any(flags) if pg.rank == 0 else None
+                )
+                if not more:
+                    break
+        else:
+            for _ in range(len(self.scope.nodes) + 1):
+                for node in self.scope.nodes:
+                    node.on_input_closed()
+                if not self.pending_times:
+                    break
+                while self.pending_times:
+                    self._step_time(self._min_pending())
         for node in self.scope.nodes:
             node.on_end()
+        if self._procgroup is not None:
+            self._procgroup.close()
+            self._procgroup = None
         if self._async_loop is not None:
             self._async_loop.close()
             self._async_loop = None
@@ -180,6 +290,17 @@ class Runtime:
 
     # -- run modes --------------------------------------------------------
     def run_static(self) -> None:
+        if self.distributed:
+            # static rows are the PROGRAM's data, identical in every
+            # process: rank 0 injects, exchanges shard the work. Every
+            # rank adopts rank 0's clock so locally minted times (error
+            # log at clock+1) stay globally ordered.
+            if self.procgroup.rank == 0:
+                self._inject_static()
+            self.clock = self.procgroup.bcast0(("clk",), self.clock)
+            self._step_lockstep(None)
+            self._finish()
+            return
         self._inject_static()
         while self.pending_times:  # nodes may emit at later times (buffers)
             t = self._min_pending()
@@ -187,14 +308,28 @@ class Runtime:
         self._finish()
 
     def run(self) -> None:
-        if not self.connectors:
-            self.run_static()
-            return
-        self._run_streaming()
+        if self.distributed and self.persistence is not None:
+            raise NotImplementedError(
+                "persistence with PATHWAY_PROCESSES>1 is not supported yet; "
+                "run persistence per-process or single-process"
+            )
+        try:
+            if not self.connectors:
+                self.run_static()
+                return
+            if self.distributed:
+                self._run_streaming_distributed()
+                return
+            self._run_streaming()
+        except BaseException:
+            # a failing rank must not leave peers blocked in a collective:
+            # closing the mesh surfaces ConnectionError everywhere
+            if self._procgroup is not None:
+                self._procgroup.close()
+                self._procgroup = None
+            raise
 
-    def _run_streaming(self) -> None:
-        from pathway_tpu.io._connector import run_connector_thread
-
+    def _start_monitoring(self, printer: bool = True) -> None:
         if self.with_http_server:
             # reference: metrics at port 20000 + process_id (http_server.rs)
             from pathway_tpu.internals.config import get_pathway_config
@@ -203,7 +338,7 @@ class Runtime:
             start_http_server(
                 self.stats, 20000 + get_pathway_config().process_id
             )
-        if self.monitoring_level is not None:
+        if self.monitoring_level is not None and printer:
             from pathway_tpu.internals.monitoring import (
                 MonitoringLevel,
                 start_monitor_printer,
@@ -215,6 +350,24 @@ class Runtime:
             ):
                 start_monitor_printer(self.stats)
 
+    def _drain_event_queue(self, timeout: float) -> list:
+        """One bounded wait, then drain everything queued."""
+        entries = []
+        try:
+            entries.append(self.event_queue.get(timeout=timeout))
+        except queue.Empty:
+            pass
+        while True:
+            try:
+                entries.append(self.event_queue.get_nowait())
+            except queue.Empty:
+                break
+        return entries
+
+    def _run_streaming(self) -> None:
+        from pathway_tpu.io._connector import run_connector_thread
+
+        self._start_monitoring()
         self._inject_static()
         while self.pending_times:
             t = self._min_pending()
@@ -288,17 +441,11 @@ class Runtime:
             for conn in self.connectors:
                 if not conn.finished:
                     conn.force_flush()
-            try:
-                entries = [self.event_queue.get(timeout=0.5)]
-            except queue.Empty:
+            entries = self._drain_event_queue(0.5)
+            if not entries:
                 if self.error and self.terminate_on_error:
                     raise self.error
                 continue
-            while True:
-                try:
-                    entries.append(self.event_queue.get_nowait())
-                except queue.Empty:
-                    break
             # every queue entry is one connector commit and gets its OWN
             # timestamp (reference: each flush advances the commit Timestamp,
             # connectors/mod.rs) — merging commits could cancel an insert
@@ -383,6 +530,96 @@ class Runtime:
             t = self._min_pending()
             self._step_time(t)
         for conn in self.connectors:
+            if conn.thread is not None:
+                conn.thread.join(timeout=5)
+        self._finish()
+
+    def _run_streaming_distributed(self) -> None:
+        """Round-based BSP ingest for PATHWAY_PROCESSES>1 (reference: the
+        timely worker loop with exchange + progress channels,
+        dataflow.rs:5595). Each round: every rank drains its local
+        connector commits, the rank-0 clock master assigns each commit a
+        globally ordered even timestamp (rank-major within the round),
+        rows enter their home rank's source nodes, and `_step_lockstep`
+        walks all ranks through the global frontier so ExchangeNodes
+        shard-route rows at stateful boundaries."""
+        from pathway_tpu.io._connector import run_connector_thread
+
+        pg = self.procgroup
+        self._start_monitoring(printer=pg.rank == 0)
+
+        # program-embedded static rows are identical in every process:
+        # rank 0 injects them once, exchanges shard the work; every rank
+        # adopts rank 0's clock so locally minted times stay ordered
+        if pg.rank == 0:
+            self._inject_static()
+        self.clock = pg.bcast0(("clk",), self.clock)
+        self._step_lockstep(None)
+
+        # a source reads on exactly one rank unless it declares itself
+        # partition-aware (fs scanners shard paths; subjects can read
+        # pathway_config.process_id) — reference: per-worker partitioned
+        # reads, data_storage.rs:692
+        live: list[_Connector] = []
+        for conn in self.connectors:
+            if pg.rank != 0 and not getattr(
+                conn.subject, "_distributed_partitioned", False
+            ):
+                conn.finished = True
+                continue
+            live.append(conn)
+        for conn in live:
+            conn.thread = threading.Thread(
+                target=run_connector_thread,
+                args=(conn, self.event_queue),
+                daemon=True,
+            )
+            conn.thread.start()
+
+        active = len(live)
+        round_no = 0
+        while True:
+            round_no += 1
+            for conn in live:
+                if not conn.finished:
+                    conn.force_flush()
+            entries = self._drain_event_queue(0.2)
+            commits = []
+            for conn, deltas, state, journal_rows in entries:
+                if deltas is None:
+                    conn.finished = True
+                    active -= 1
+                elif deltas:
+                    commits.append((conn, deltas))
+            done_local = active == 0
+            if pg.rank == 0:
+                info = pg.gather0(("r", round_no), (len(commits), done_local))
+                counts = [c for c, _ in info]
+                alldone = all(d for _, d in info)
+                base = self._next_time() if sum(counts) else self.clock
+                base, counts, alldone = pg.bcast0(
+                    ("r2", round_no), (base, counts, alldone)
+                )
+            else:
+                pg.gather0(("r", round_no), (len(commits), done_local))
+                base, counts, alldone = pg.bcast0(("r2", round_no))
+            total = sum(counts)
+            my_off = sum(counts[: pg.rank])
+            for i, (conn, deltas) in enumerate(commits):
+                t = base + 2 * (my_off + i)
+                self.stats.on_ingest(conn.name, len(deltas))
+                conn.node.accept(t, 0, deltas)
+            if total:
+                # every rank tracks the master clock so locally minted
+                # times (error log at clock+1) stay globally consistent
+                self.clock = max(self.clock, base + 2 * (total - 1))
+            self._step_lockstep(self.clock + 1)
+            if self.error and self.terminate_on_error:
+                raise self.error
+            if alldone and total == 0:
+                break
+        self._step_lockstep(None)
+        for conn in live:
             if conn.thread is not None:
                 conn.thread.join(timeout=5)
         self._finish()

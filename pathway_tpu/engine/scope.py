@@ -28,10 +28,41 @@ class Scope:
     def __init__(self, runtime):
         self.runtime = runtime
         self.nodes: list[N.Node] = []
+        # multi-process runs: exchange boundaries the lockstep scheduler
+        # must step at every global timestamp (engine/runtime.py)
+        self.exchange_nodes: list[N.ExchangeNode] = []
 
     def register(self, node: N.Node) -> int:
         self.nodes.append(node)
+        if isinstance(node, N.ExchangeNode):
+            self.exchange_nodes.append(node)
         return len(self.nodes) - 1
+
+    # -- multi-process shard routing --------------------------------------
+    # Value-keyed stateful operators group rows from MANY sources under one
+    # key, so in a multi-process run their inputs pass through an
+    # ExchangeNode that hash-routes each row to the rank owning its key
+    # (the reference's exchange pact before reduce/join, dataflow.rs).
+    # Row-id-keyed state (buffers/freeze/forget) needs no exchange: row ids
+    # are globally unique, so per-row state is always local.
+    def _world(self) -> int:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        return max(1, get_pathway_config().processes)
+
+    def _exchange(self, table: EngineTable, key_batch=None, mode="hash") -> EngineTable:
+        if self._world() <= 1:
+            return table
+        return EngineTable(
+            N.ExchangeNode(self, table.node, key_batch, mode), table.width
+        )
+
+    def _exchange_by_id(self, table: EngineTable) -> EngineTable:
+        return self._exchange(table, lambda keys, rows: keys)
+
+    @staticmethod
+    def _rowwise_key(fn):
+        return lambda keys, rows: [fn(k, r) for k, r in zip(keys, rows)]
 
     # -- sources ---------------------------------------------------------
     def static_table(self, rows: list[tuple[int, tuple]], width: int) -> EngineTable:
@@ -77,6 +108,8 @@ class Scope:
 
     def concat(self, tables: list[EngineTable]) -> EngineTable:
         width = tables[0].width
+        # id-collision detection requires same-id rows to co-locate
+        tables = [self._exchange_by_id(t) for t in tables]
         return EngineTable(N.ConcatNode(self, [t.node for t in tables]), width)
 
     # -- stateful transforms ---------------------------------------------
@@ -94,6 +127,13 @@ class Scope:
         lkey_batch=None,
         rkey_batch=None,
     ) -> EngineTable:
+        if self._world() > 1:
+            left = self._exchange(
+                left, lkey_batch or self._rowwise_key(left_key_fn)
+            )
+            right = self._exchange(
+                right, rkey_batch or self._rowwise_key(right_key_fn)
+            )
         node = N.JoinNode(
             self,
             left.node,
@@ -116,6 +156,9 @@ class Scope:
         self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int,
         key_fn=None, grouping_batch=None, args_batch=None, native_args=None,
     ) -> EngineTable:
+        table = self._exchange(
+            table, grouping_batch or self._rowwise_key(grouping_fn)
+        )
         node = N.GroupByNode(
             self, table.node, grouping_fn, args_fn, reducer_fns, key_fn,
             grouping_batch=grouping_batch, args_batch=args_batch,
@@ -124,31 +167,44 @@ class Scope:
         return EngineTable(node, n_group_cols + len(reducer_fns))
 
     def update_rows(self, left: EngineTable, right: EngineTable) -> EngineTable:
+        left = self._exchange_by_id(left)
+        right = self._exchange_by_id(right)
         return EngineTable(N.UpdateRowsNode(self, left.node, right.node), left.width)
 
     def update_cells(self, left: EngineTable, right: EngineTable, positions) -> EngineTable:
+        left = self._exchange_by_id(left)
+        right = self._exchange_by_id(right)
         return EngineTable(
             N.UpdateCellsNode(self, left.node, right.node, positions), left.width
         )
 
     def ix(self, source: EngineTable, keys: EngineTable, key_fn, optional, strict) -> EngineTable:
+        # co-locate each lookup with the source row it targets
+        source = self._exchange_by_id(source)
+        keys = self._exchange(keys, self._rowwise_key(key_fn))
         node = N.IxNode(
             self, source.node, keys.node, key_fn, optional, strict, source.width
         )
         return EngineTable(node, source.width)
 
     def intersect(self, left: EngineTable, others: list[EngineTable]) -> EngineTable:
+        left = self._exchange_by_id(left)
+        others = [self._exchange_by_id(o) for o in others]
         return EngineTable(
             N.IntersectNode(self, left.node, [o.node for o in others]), left.width
         )
 
     def difference(self, left: EngineTable, right: EngineTable) -> EngineTable:
+        left = self._exchange_by_id(left)
+        right = self._exchange_by_id(right)
         return EngineTable(N.DifferenceNode(self, left.node, right.node), left.width)
 
     def sort(self, table: EngineTable, key_fn, instance_fn) -> EngineTable:
+        table = self._exchange(table, self._rowwise_key(instance_fn))
         return EngineTable(N.SortNode(self, table.node, key_fn, instance_fn), 2)
 
     def deduplicate(self, table: EngineTable, instance_fn, value_fn, acceptor) -> EngineTable:
+        table = self._exchange(table, self._rowwise_key(instance_fn))
         return EngineTable(
             N.DeduplicateNode(self, table.node, instance_fn, value_fn, acceptor),
             table.width,
@@ -157,6 +213,7 @@ class Scope:
     def stateful_reduce(
         self, table: EngineTable, grouping_fn, args_fn, combine_many, n_group_cols, key_fn=None
     ) -> EngineTable:
+        table = self._exchange(table, self._rowwise_key(grouping_fn))
         node = N.StatefulReduceNode(
             self, table.node, grouping_fn, args_fn, combine_many, key_fn
         )
@@ -180,6 +237,9 @@ class Scope:
     def gradual_broadcast(
         self, left: EngineTable, threshold: EngineTable, triplet_fn
     ) -> EngineTable:
+        # the (small) threshold table is replicated to every rank; the
+        # broadcast-target side keeps per-row state locally
+        threshold = self._exchange(threshold, mode="broadcast")
         node = N.GradualBroadcastNode(
             self, left.node, threshold.node, triplet_fn
         )
@@ -201,14 +261,29 @@ class Scope:
     ) -> EngineTable:
         from pathway_tpu.engine.external_index import ExternalIndexNode
 
+        # reference semantics: the index is replicated per worker
+        # (broadcast build side); queries are answered where they live
+        index = self._exchange(index, mode="broadcast")
         node = ExternalIndexNode(
             self, index.node, queries.node, adapter, index_fn, query_fn, mode
         )
         return EngineTable(node, queries.width + 2)
 
     # -- sinks ------------------------------------------------------------
+    # outputs gather to rank 0 in multi-process runs: one process owns the
+    # external side effects (files, subscribers), mirroring the reference's
+    # single-writer guidance for fs sinks
     def output(self, table: EngineTable, **callbacks) -> None:
+        table = self._exchange(table, mode="gather")
+        if self._world() > 1:
+            from pathway_tpu.internals.config import get_pathway_config
+
+            if get_pathway_config().process_id != 0:
+                # rows gather to rank 0; other ranks keep the node (graph
+                # shape must match) but must not run side effects — an
+                # on_end here would e.g. truncate the file rank 0 wrote
+                callbacks = {k: None for k in callbacks}
         N.OutputNode(self, table.node, **callbacks)
 
     def capture(self, table: EngineTable) -> N.CaptureNode:
-        return N.CaptureNode(self, table.node)
+        return N.CaptureNode(self, self._exchange(table, mode="gather").node)

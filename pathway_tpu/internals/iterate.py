@@ -158,6 +158,15 @@ def iterate(
 
     if not kwargs:
         raise ValueError("iterate() needs at least one table argument")
+    from pathway_tpu.internals.config import get_pathway_config
+
+    if get_pathway_config().processes > 1:
+        raise NotImplementedError(
+            "pw.iterate is not supported with PATHWAY_PROCESSES>1: the "
+            "fixpoint loop re-steps its subgraph a data-dependent number "
+            "of times per rank, which cannot ride the lockstep exchange "
+            "protocol; run iteration single-process"
+        )
     tables = {name: t for name, t in kwargs.items()}
     placeholders = {
         name: Table(t._schema_cls, Universe()) for name, t in tables.items()

@@ -91,6 +91,10 @@ def _coerce(v: str):
 
 
 class _FsSubject(ConnectorSubject):
+    # multi-process runs: every rank scans, each owns the paths that hash
+    # to it (reference: per-worker partitioned reads, data_storage.rs:692)
+    _distributed_partitioned = True
+
     def __init__(self, path, fmt, schema, with_metadata, mode, refresh_interval=0.2):
         super().__init__()
         self.path = path
@@ -103,11 +107,31 @@ class _FsSubject(ConnectorSubject):
         self._emitted: dict[str, list] = {}
         self._stop = False
 
+    def _owned_paths(self):
+        from pathway_tpu.internals.config import get_pathway_config
+
+        c = get_pathway_config()
+        if c.processes <= 1:
+            yield from _iter_paths(self.path)
+            return
+        from pathway_tpu.parallel.procgroup import stable_shard
+
+        # shard by the path RELATIVE to the source root: absolute paths
+        # differ across ranks with different mounts/cwds, which would let
+        # two ranks own the same file (or none own it)
+        root = self.path if os.path.isdir(self.path) else (
+            os.path.dirname(self.path) or "."
+        )
+        for p in _iter_paths(self.path):
+            rel = os.path.relpath(p, root)
+            if stable_shard(rel, c.processes) == c.process_id:
+                yield p
+
     def _scan_once(self):
         # modified-file diffing + deletion detection (reference:
         # src/connectors/scanner/filesystem.rs object cache)
         current = set()
-        for p in _iter_paths(self.path):
+        for p in self._owned_paths():
             try:
                 mtime = os.path.getmtime(p)
             except OSError:

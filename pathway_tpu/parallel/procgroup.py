@@ -1,0 +1,274 @@
+"""Multi-process communication backend for the relational plane.
+
+The reference scales its relational dataflow across processes with a
+timely TCP mesh: N workers each own a key shard, rows are exchanged at
+groupby/join boundaries, and a global progress protocol keeps timestamps
+consistent (reference: src/engine/dataflow.rs:5506-5650 enter_graph /
+config::Config::from_env, dataflow/config.rs:88-127).
+
+This is the equivalent for the batch-per-timestamp engine: a full TCP
+mesh between `PATHWAY_PROCESSES` ranks carrying
+
+* CONTROL traffic — the rank-0 clock master assigns globally ordered
+  commit timestamps and coordinates the lockstep frontier (the set of
+  pending timestamps that every rank must step through), and
+* DATA traffic — `ExchangeNode` all-to-alls that hash-partition delta
+  batches by their grouping/join key so each rank owns a key shard
+  (engine/nodes.py ExchangeNode).
+
+The dense plane does NOT ride this mesh: tensors move over ICI/DCN via
+XLA collectives (parallel/mesh.py). This mesh is the control+relational
+plane only, matching the reference's split between timely channels and
+its data plane.
+
+Framing: length-prefixed pickle. The mesh links trusted peer processes
+of one pipeline (localhost by default, PATHWAY_HOSTS for multi-host);
+it is not an external protocol surface: the listener binds 127.0.0.1
+unless PATHWAY_HOSTS names remote hosts, and every connection must
+complete an HMAC handshake (blake2b over PATHWAY_MESH_SECRET) before
+any frame is unpickled — an unauthenticated peer is disconnected.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import queue
+from typing import Any
+
+from pathway_tpu.internals.api import _value_to_bytes
+from pathway_tpu.engine.stream import freeze_value
+
+_LEN = struct.Struct("<Q")
+
+
+def stable_shard(value: Any, world: int) -> int:
+    """Deterministic, process-stable partition of a key value: the same
+    injective byte serialization that backs Pointer minting (api.py), so
+    every rank routes a key to the same owner regardless of PYTHONHASHSEED.
+    """
+    import hashlib
+
+    b = _value_to_bytes(freeze_value(value))
+    return int.from_bytes(
+        hashlib.blake2b(b, digest_size=8).digest(), "little"
+    ) % world
+
+
+class ProcessGroup:
+    """Full TCP mesh between the pipeline's ranks.
+
+    Connection setup: rank r listens on ``first_port + r``; every rank
+    connects to all lower ranks and accepts from all higher ranks, then
+    handshakes its rank id. One receiver thread per peer demultiplexes
+    length-prefixed pickled ``(tag, payload)`` frames into per-peer
+    queues; `recv` asserts the expected tag so any protocol desync is a
+    hard error, not silent corruption.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        world: int,
+        first_port: int,
+        hosts: list[str] | None = None,
+        timeout: float = 60.0,
+    ):
+        self.rank = rank
+        self.world = world
+        if hosts is None:
+            env = os.environ.get("PATHWAY_HOSTS", "")
+            hosts = (
+                [h.strip() for h in env.split(",")]
+                if env
+                else ["127.0.0.1"] * world
+            )
+        if len(hosts) != world:
+            raise ValueError(
+                f"PATHWAY_HOSTS lists {len(hosts)} hosts for {world} processes"
+            )
+        self.hosts = hosts
+        self._socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._queues: dict[int, "queue.Queue"] = {
+            p: queue.Queue() for p in range(world) if p != rank
+        }
+        self._recv_threads: list[threading.Thread] = []
+        self._closed = False
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        loopback_only = all(
+            h in ("127.0.0.1", "localhost", "::1") for h in hosts
+        )
+        self._listener.bind(
+            ("127.0.0.1" if loopback_only else "0.0.0.0", first_port + rank)
+        )
+        self._listener.listen(world)
+        self._connect_mesh(first_port, timeout)
+
+    @staticmethod
+    def _auth_token(rank: int) -> bytes:
+        """Per-rank handshake token: blake2b keyed by PATHWAY_MESH_SECRET.
+        Frames are pickle, so no un-authenticated byte may reach
+        pickle.loads — the token gates the connection before any frame is
+        read."""
+        import hashlib
+
+        secret = os.environ.get("PATHWAY_MESH_SECRET", "").encode()
+        return hashlib.blake2b(
+            rank.to_bytes(8, "little"), key=secret or b"pathway-mesh",
+            digest_size=16,
+        ).digest()
+
+    def _connect_mesh(self, first_port: int, timeout: float) -> None:
+        import time as _t
+
+        expected_accepts = self.world - 1 - self.rank
+        accepted: dict[int, socket.socket] = {}
+
+        def acceptor():
+            while len(accepted) < expected_accepts:
+                s, _addr = self._listener.accept()
+                try:
+                    peer = int(_LEN.unpack(_recv_exact(s, _LEN.size))[0])
+                    token = _recv_exact(s, 16)
+                except (EOFError, OSError):
+                    s.close()
+                    continue
+                if (
+                    peer <= self.rank
+                    or peer >= self.world
+                    or token != self._auth_token(peer)
+                ):
+                    s.close()  # unauthenticated or bogus peer
+                    continue
+                accepted[peer] = s
+
+        at = threading.Thread(target=acceptor, daemon=True)
+        at.start()
+        # connect to all lower ranks, retrying while they come up
+        for peer in range(self.rank):
+            deadline = _t.monotonic() + timeout
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (self.hosts[peer], first_port + peer), timeout=5
+                    )
+                    break
+                except OSError:
+                    if _t.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"rank {self.rank}: cannot reach rank {peer}"
+                        )
+                    _t.sleep(0.05)
+            s.sendall(_LEN.pack(self.rank) + self._auth_token(self.rank))
+            self._socks[peer] = s
+        at.join(timeout)
+        if len(accepted) != expected_accepts:
+            raise TimeoutError(
+                f"rank {self.rank}: expected {expected_accepts} peer "
+                f"connections, got {len(accepted)}"
+            )
+        self._socks.update(accepted)
+        for peer, s in self._socks.items():
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_locks[peer] = threading.Lock()
+            t = threading.Thread(
+                target=self._recv_loop, args=(peer, s), daemon=True
+            )
+            t.start()
+            self._recv_threads.append(t)
+
+    def _recv_loop(self, peer: int, s: socket.socket) -> None:
+        q = self._queues[peer]
+        try:
+            while True:
+                head = _recv_exact(s, _LEN.size)
+                (n,) = _LEN.unpack(head)
+                payload = _recv_exact(s, n)
+                q.put(pickle.loads(payload))
+        except (OSError, EOFError, ConnectionError):
+            q.put(None)  # peer gone
+
+    # -- primitives -------------------------------------------------------
+    def send(self, peer: int, tag: Any, obj: Any) -> None:
+        payload = pickle.dumps((tag, obj), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_locks[peer]:
+            self._socks[peer].sendall(_LEN.pack(len(payload)) + payload)
+
+    def recv(self, peer: int, tag: Any) -> Any:
+        got = self._queues[peer].get()
+        if got is None:
+            raise ConnectionError(
+                f"rank {self.rank}: peer {peer} disconnected "
+                f"(waiting for {tag!r})"
+            )
+        got_tag, obj = got
+        if got_tag != tag:
+            raise RuntimeError(
+                f"rank {self.rank}: protocol desync with peer {peer}: "
+                f"expected {tag!r}, got {got_tag!r}"
+            )
+        return obj
+
+    # -- collectives ------------------------------------------------------
+    def gather0(self, tag: Any, obj: Any) -> list[Any] | None:
+        """Rank 0 returns [obj_rank0, ..., obj_rankN-1]; others None."""
+        if self.rank == 0:
+            out = [obj]
+            for peer in range(1, self.world):
+                out.append(self.recv(peer, tag))
+            return out
+        self.send(0, tag, obj)
+        return None
+
+    def bcast0(self, tag: Any, obj: Any = None) -> Any:
+        if self.rank == 0:
+            for peer in range(1, self.world):
+                self.send(peer, tag, obj)
+            return obj
+        return self.recv(0, tag)
+
+    def all_to_all(self, tag: Any, per_rank: list[list]) -> list:
+        """Send per_rank[j] to rank j; return own slot + everything
+        received. Sends first (receiver threads always drain, so blocking
+        sends cannot deadlock), then collects from every peer."""
+        for peer in range(self.world):
+            if peer != self.rank:
+                self.send(peer, tag, per_rank[peer])
+        merged = list(per_rank[self.rank])
+        for peer in range(self.world):
+            if peer != self.rank:
+                merged.extend(self.recv(peer, tag))
+        return merged
+
+    def barrier(self, tag: Any) -> None:
+        self.gather0(("b", tag), None)
+        self.bcast0(("b2", tag), None)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf.extend(chunk)
+    return bytes(buf)
