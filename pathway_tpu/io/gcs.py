@@ -10,55 +10,28 @@ re-emitted with retraction of their previous rows, deleted objects retract.
 
 from __future__ import annotations
 
-import csv as _csv
-import io as _io
 import json as _json
-import time
 from typing import Any
 
 from pathway_tpu.internals import dtype as dt
-from pathway_tpu.internals.api import Json, ref_scalar
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema, schema_from_types
-from pathway_tpu.io.python import ConnectorSubject, read as python_read
+from pathway_tpu.io._objstore import ObjectStoreSubject, parse_object_bytes
+from pathway_tpu.io.python import read as python_read
+
+# back-compat alias: s3.py historically imported the parser from here
+_parse_bytes = parse_object_bytes
 
 
-def _parse_bytes(data: bytes, fmt: str) -> list[dict]:
-    rows: list[dict] = []
-    if fmt in ("csv", "dsv"):
-        for rec in _csv.DictReader(_io.StringIO(data.decode("utf-8", "replace"))):
-            rows.append(dict(rec))
-    elif fmt in ("json", "jsonlines"):
-        for line in data.decode("utf-8", "replace").splitlines():
-            line = line.strip()
-            if line:
-                rows.append(_json.loads(line))
-    elif fmt == "plaintext":
-        for line in data.decode("utf-8", "replace").splitlines():
-            rows.append({"data": line})
-    elif fmt in ("plaintext_by_object", "plaintext_by_file"):
-        rows.append({"data": data.decode("utf-8", "replace")})
-    elif fmt == "binary":
-        rows.append({"data": data})
-    else:
-        raise ValueError(f"unknown format {fmt!r}")
-    return rows
+class _GcsSubject(ObjectStoreSubject):
+    _scheme = "gcs"
 
-
-class _GcsSubject(ConnectorSubject):
     def __init__(self, bucket, prefix, fmt, with_metadata, mode,
                  refresh_interval=5.0, client=None):
-        super().__init__()
+        super().__init__(fmt, with_metadata, mode, refresh_interval)
         self.bucket_name = bucket
         self.prefix = prefix
-        self.fmt = fmt
-        self.with_metadata = with_metadata
-        self.mode = mode
-        self.refresh_interval = refresh_interval
         self._client = client
-        self._seen: dict[str, Any] = {}      # object -> generation
-        self._emitted: dict[str, list] = {}  # object -> [(key, row)]
-        self._stop = False
 
     def _gcs(self):
         if self._client is None:
@@ -67,68 +40,20 @@ class _GcsSubject(ConnectorSubject):
             self._client = storage.Client()
         return self._client
 
-    def _scan_once(self):
-        client = self._gcs()
-        current = set()
-        for blob in client.list_blobs(self.bucket_name, prefix=self.prefix):
-            name = blob.name
+    def _list(self):
+        for blob in self._gcs().list_blobs(
+            self.bucket_name, prefix=self.prefix
+        ):
             gen = getattr(blob, "generation", None) or getattr(
                 blob, "updated", None
             )
-            current.add(name)
-            if self._seen.get(name) == gen:
-                continue
-            try:
-                data = blob.download_as_bytes()
-            except Exception:
-                # object vanished between list and download: the next poll's
-                # deletion path retracts it; don't kill the pipeline
-                continue
-            for old_key, old_row in self._emitted.pop(name, []):
-                self._remove(old_key, old_row)
-            rows = _parse_bytes(data, self.fmt)
-            if self.with_metadata:
-                meta = {
-                    "path": f"gs://{self.bucket_name}/{name}",
-                    "size": len(data),
-                    "seen_at": int(time.time()),
-                }
-                for r in rows:
-                    r["_metadata"] = Json(meta)
-            keyed = [
-                (ref_scalar("gcs", self.bucket_name, name, i), row)
-                for i, row in enumerate(rows)
-            ]
-            for key, row in keyed:
-                self._upsert(key, row)
-            # bookkeeping after emission: flush snapshots stay consistent
-            # (io/_connector.py commit-boundary protocol)
-            self._emitted[name] = keyed
-            self._seen[name] = gen
-        for name in list(self._emitted):
-            if name not in current:
-                for old_key, old_row in self._emitted.pop(name, []):
-                    self._remove(old_key, old_row)
-                self._seen.pop(name, None)
-        self.commit()
+            yield blob.name, gen, {}
 
-    def run(self):
-        self._scan_once()
-        if self.mode == "static":
-            return
-        while not self._stop:
-            time.sleep(self.refresh_interval)
-            self._scan_once()
+    def _get(self, name: str) -> bytes:
+        return self._gcs().bucket(self.bucket_name).blob(name).download_as_bytes()
 
-    def on_stop(self):
-        self._stop = True
-
-    def snapshot_state(self):
-        return {"seen": dict(self._seen), "emitted": dict(self._emitted)}
-
-    def seek(self, state) -> None:
-        self._seen = dict(state.get("seen", {}))
-        self._emitted = dict(state.get("emitted", {}))
+    def _uri(self, name: str) -> str:
+        return f"gs://{self.bucket_name}/{name}"
 
 
 def read(

@@ -1,0 +1,470 @@
+"""Wire-protocol connector transports against in-process mock services.
+
+These connectors carry REAL transports (no client libraries): S3 via a
+SigV4 REST client (io/_s3.py), Elasticsearch via the bulk REST API,
+NATS via the raw wire protocol (io/_nats.py). Each is exercised against
+a local mock server that verifies protocol shape (SigV4 Authorization
+header, ndjson bulk bodies, HPUB headers) — the same seams the
+reference's native Rust transports target (scanner/s3.rs:268,
+data_storage.rs:1328/2226).
+"""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.io._s3 import AwsS3Settings, S3Client
+
+
+# --------------------------------------------------------------------- S3
+
+
+class _MockS3Handler(BaseHTTPRequestHandler):
+    store: dict[str, bytes] = {}
+    requests: list = []
+    secret = "secret"
+    sig_failures: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _verify_sig(self, body: bytes) -> None:
+        """Server-side SigV4 check built from the RAW wire path — catches
+        asymmetric (double-)encoding between URL and canonical request."""
+        import hashlib
+        import hmac as hmac_mod
+        from urllib.parse import parse_qsl, urlsplit
+
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            self.sig_failures.append(("missing-auth", self.path))
+            return
+        from urllib.parse import quote
+
+        split = urlsplit(self.path)
+        cq = "&".join(
+            f"{quote(k, safe='')}={quote(v, safe='')}"
+            for k, v in sorted(parse_qsl(split.query, keep_blank_values=True))
+        )
+        signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        ch = "".join(
+            f"{h}:{self.headers[h.title()] if h != 'host' else self.headers['Host']}\n"
+            for h in signed
+        )
+        payload_hash = hashlib.sha256(body).hexdigest()
+        creq = "\n".join(
+            ["PUT" if self.command == "PUT" else self.command,
+             split.path, cq, ch, ";".join(signed), payload_hash]
+        )
+        amz_date = self.headers["X-Amz-Date"]
+        scope = f"{amz_date[:8]}/us-east-1/s3/aws4_request"
+        sts = "\n".join(
+            ["AWS4-HMAC-SHA256", amz_date, scope,
+             hashlib.sha256(creq.encode()).hexdigest()]
+        )
+
+        def _h(key, msg):
+            return hmac_mod.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _h(("AWS4" + self.secret).encode(), amz_date[:8])
+        k = _h(k, "us-east-1")
+        k = _h(k, "s3")
+        k = _h(k, "aws4_request")
+        expect = hmac_mod.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        if f"Signature={expect}" not in auth:
+            self.sig_failures.append(("mismatch", self.path))
+
+    def _key(self):
+        # path-style: /bucket/key... (stored decoded, like a real bucket)
+        from urllib.parse import unquote
+
+        path = unquote(self.path.split("?")[0])
+        parts = path.lstrip("/").split("/", 1)
+        return parts[1] if len(parts) > 1 else ""
+
+    def do_GET(self):
+        self._verify_sig(b"")
+        self.requests.append(("GET", self.path, dict(self.headers)))
+        if "list-type=2" in self.path:
+            from urllib.parse import parse_qs, urlsplit
+
+            q = parse_qs(urlsplit(self.path).query)
+            prefix = q.get("prefix", [""])[0]
+            items = "".join(
+                f"<Contents><Key>{k}</Key><ETag>\"{hash(v) & 0xffffffff:x}\"</ETag>"
+                f"<Size>{len(v)}</Size>"
+                f"<LastModified>2026-01-01T00:00:{i:02d}Z</LastModified>"
+                f"</Contents>"
+                for i, (k, v) in enumerate(sorted(self.store.items()))
+                if k.startswith(prefix)
+            )
+            body = (
+                '<?xml version="1.0"?><ListBucketResult>'
+                f"<IsTruncated>false</IsTruncated>{items}"
+                "</ListBucketResult>"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        key = self._key()
+        if key in self.store:
+            body = self.store[key]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(n)
+        self._verify_sig(body)
+        self.store[self._key()] = body
+        self.requests.append(("PUT", self.path, dict(self.headers)))
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        self.store.pop(self._key(), None)
+        self.send_response(204)
+        self.end_headers()
+
+
+@pytest.fixture
+def mock_s3():
+    handler = type(
+        "H", (_MockS3Handler,),
+        {"store": {}, "requests": [], "sig_failures": []},
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield handler, f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def _settings(url):
+    return AwsS3Settings(
+        bucket_name="bkt",
+        access_key="AKIATEST",
+        secret_access_key="secret",
+        endpoint=url,
+        with_path_style=True,
+        region="us-east-1",
+    )
+
+
+def test_s3_client_roundtrip_and_sigv4(mock_s3):
+    handler, url = mock_s3
+    c = S3Client(_settings(url))
+    c.put_object("data/a.jsonl", b'{"x": 1}\n')
+    assert c.get_object("data/a.jsonl") == b'{"x": 1}\n'
+    objs = c.list_objects("data/")
+    assert [o.key for o in objs] == ["data/a.jsonl"]
+    auth_headers = [
+        h.get("authorization") or h.get("Authorization")
+        for _, _, h in handler.requests
+    ]
+    assert all(a and a.startswith("AWS4-HMAC-SHA256") for a in auth_headers)
+    assert "Credential=AKIATEST/" in auth_headers[0]
+    # server-side signature recomputation must agree (catches canonical
+    # path/query asymmetries)
+    assert handler.sig_failures == []
+    # keys needing percent-encoding must sign and roundtrip
+    c.put_object("data/my file+x.jsonl", b'{"x": 2}\n')
+    assert c.get_object("data/my file+x.jsonl") == b'{"x": 2}\n'
+    assert handler.sig_failures == []
+    c.delete_object("data/a.jsonl")
+    c.delete_object("data/my file+x.jsonl")
+    assert c.list_objects("") == []
+
+
+def test_s3_read_static(mock_s3):
+    handler, url = mock_s3
+    c = S3Client(_settings(url))
+    c.put_object("in/1.jsonl", b'{"w": "a", "n": 1}\n{"w": "b", "n": 2}\n')
+    c.put_object("in/2.jsonl", b'{"w": "a", "n": 3}\n')
+
+    class S(pw.Schema):
+        w: str
+        n: int
+
+    t = pw.io.s3.read(
+        "in/", "jsonlines", aws_s3_settings=_settings(url),
+        schema=S, mode="static",
+    )
+    agg = t.groupby(pw.this.w).reduce(
+        w=pw.this.w, s=pw.reducers.sum(pw.this.n)
+    )
+    cap = GraphRunner().run_tables(agg)[0]
+    rows = sorted(tuple(r) for r in cap.state.rows.values())
+    assert rows == [("a", 4), ("b", 2)]
+
+
+def test_s3_write_objects(mock_s3):
+    handler, url = mock_s3
+
+    class S(pw.Schema):
+        w: str
+
+    t = pw.debug.table_from_markdown("w\nfoo\nbar")
+    pw.io.s3.write(t, "out/", aws_s3_settings=_settings(url))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    keys = [k for k in handler.store if k.startswith("out/")]
+    assert keys, handler.store.keys()
+    lines = b"".join(handler.store[k] for k in sorted(keys)).decode()
+    words = sorted(json.loads(l)["w"] for l in lines.strip().splitlines())
+    assert words == ["bar", "foo"]
+
+
+def test_minio_surface(mock_s3):
+    handler, url = mock_s3
+    c = S3Client(_settings(url))
+    c.put_object("m/x.jsonl", b'{"v": 7}\n')
+
+    class S(pw.Schema):
+        v: int
+
+    settings = pw.io.minio.MinIOSettings(
+        endpoint=url,
+        bucket_name="bkt",
+        access_key="AKIATEST",
+        secret_access_key="secret",
+    )
+    t = pw.io.minio.read(
+        "m/", settings, format="jsonlines", schema=S, mode="static"
+    )
+    cap = GraphRunner().run_tables(t)[0]
+    assert [tuple(r) for r in cap.state.rows.values()] == [(7,)]
+
+
+# ------------------------------------------------------------ Elasticsearch
+
+
+class _MockEsHandler(BaseHTTPRequestHandler):
+    bulks: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(n)
+        self.bulks.append((self.path, dict(self.headers), body))
+        resp = json.dumps({"errors": False, "items": []}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(resp)))
+        self.end_headers()
+        self.wfile.write(resp)
+
+
+def test_elasticsearch_bulk_write():
+    handler = type("H", (_MockEsHandler,), {"bulks": []})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        t = pw.debug.table_from_markdown("w | n\nfoo | 1\nbar | 2")
+        pw.io.elasticsearch.write(
+            t,
+            f"http://127.0.0.1:{server.server_port}",
+            pw.io.elasticsearch.ElasticSearchAuth.basic("u", "p"),
+            "myindex",
+        )
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert handler.bulks
+        path, headers, body = handler.bulks[0]
+        assert path == "/myindex/_bulk"
+        assert headers.get("Authorization", "").startswith("Basic ")
+        lines = body.decode().strip().splitlines()
+        actions = [json.loads(l) for l in lines[0::2]]
+        docs = [json.loads(l) for l in lines[1::2]]
+        assert all(a == {"index": {}} for a in actions)
+        assert sorted(d["w"] for d in docs) == ["bar", "foo"]
+        assert all(d["diff"] == 1 and "time" in d for d in docs)
+    finally:
+        server.shutdown()
+
+
+# ------------------------------------------------------------------- NATS
+
+
+class _MiniNatsServer:
+    """Tiny NATS server: INFO/CONNECT/SUB/PUB/HPUB/PING, single process.
+    Routes published messages to matching subscribers."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.subs = []  # (conn, subject, sid)
+        self.published = []  # (subject, payload, headers)
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        conn.sendall(b'INFO {"server_name":"mini","headers":true}\r\n')
+        buf = b""
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise EOFError
+                buf += chunk
+            line, buf2 = buf.split(b"\r\n", 1)
+            buf = buf2
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise EOFError
+                buf += chunk
+            out, buf2 = buf[:n], buf[n:]
+            buf = buf2
+            return out
+
+        try:
+            while True:
+                line = read_line()
+                parts = line.split(b" ")
+                if parts[0] == b"CONNECT":
+                    continue
+                if parts[0] == b"PING":
+                    conn.sendall(b"PONG\r\n")
+                    continue
+                if parts[0] == b"SUB":
+                    self.subs.append((conn, parts[1].decode(), parts[2].decode()))
+                    continue
+                if parts[0] == b"PUB":
+                    nbytes = int(parts[-1])
+                    payload = read_exact(nbytes)
+                    read_exact(2)
+                    self._route(parts[1].decode(), payload, b"")
+                    continue
+                if parts[0] == b"HPUB":
+                    hdr_len = int(parts[-2])
+                    total = int(parts[-1])
+                    blob = read_exact(total)
+                    read_exact(2)
+                    self._route(
+                        parts[1].decode(), blob[hdr_len:], blob[:hdr_len]
+                    )
+                    continue
+        except (EOFError, OSError):
+            pass
+
+    def _route(self, subject, payload, hdr_blob):
+        headers = {}
+        if hdr_blob:
+            for h in hdr_blob.split(b"\r\n")[1:]:
+                if b":" in h:
+                    k, _, v = h.partition(b":")
+                    headers[k.decode().strip()] = v.decode().strip()
+        self.published.append((subject, payload, headers))
+        for conn, sub, sid in list(self.subs):
+            if sub == subject:
+                try:
+                    if hdr_blob:
+                        conn.sendall(
+                            f"HMSG {subject} {sid} {len(hdr_blob)} "
+                            f"{len(hdr_blob) + len(payload)}\r\n".encode()
+                            + hdr_blob + payload + b"\r\n"
+                        )
+                    else:
+                        conn.sendall(
+                            f"MSG {subject} {sid} {len(payload)}\r\n".encode()
+                            + payload + b"\r\n"
+                        )
+                except OSError:
+                    pass
+
+    def close(self):
+        self._stop = True
+        for conn, _, _ in self.subs:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)  # push FIN past blocked recv
+                conn.close()
+            except OSError:
+                pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_nats_write_and_read_roundtrip():
+    server = _MiniNatsServer()
+    uri = f"nats://127.0.0.1:{server.port}"
+    try:
+        # writer: rows -> HPUB with pathway headers
+        t = pw.debug.table_from_markdown("w | n\nfoo | 1\nbar | 2")
+        pw.io.nats.write(t, uri, "updates", format="json")
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert len(server.published) == 2
+        subjects = {s for s, _, _ in server.published}
+        assert subjects == {"updates"}
+        docs = sorted(
+            json.loads(p)["w"] for _, p, _ in server.published
+        )
+        assert docs == ["bar", "foo"]
+        for _, _, headers in server.published:
+            assert headers["pathway_diff"] == "1"
+            assert "pathway_time" in headers
+
+        # reader: republish into a fresh pipeline subscribed to the topic
+        pw.internals.parse_graph.G.clear()
+
+        class S(pw.Schema):
+            w: str
+            n: int
+
+        rt = pw.io.nats.read(
+            uri, "updates", schema=S, format="json",
+            autocommit_duration_ms=50,
+        )
+        got = []
+        pw.io.subscribe(
+            rt, on_change=lambda k, row, t_, d: got.append(row["w"])
+        )
+
+        def feed():
+            from pathway_tpu.io._nats import NatsConnection
+
+            time.sleep(0.5)  # let the reader subscribe
+            pub = NatsConnection(uri)
+            pub.publish("updates", json.dumps({"w": "x", "n": 1}).encode())
+            pub.publish("updates", json.dumps({"w": "y", "n": 2}).encode())
+            pub.close()
+            time.sleep(0.7)  # let the reader drain, then end the stream
+            server.close()
+
+        threading.Thread(target=feed, daemon=True).start()
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert sorted(got) == ["x", "y"]
+    finally:
+        server.close()
