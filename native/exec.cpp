@@ -26,6 +26,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -37,17 +38,74 @@ PyObject *FallbackError = nullptr;
 
 /* ---- tagged scalar for reducer args ---------------------------------- */
 
-enum ValTag : uint8_t { V_NONE, V_ERR, V_INT, V_FLT };
+enum ValTag : uint8_t { V_NONE, V_ERR, V_INT, V_FLT, V_STR };
 
 struct Val {
     ValTag tag;
     int64_t i;
     double f;
+    const char *sptr;   /* V_STR: UTF-8 view into the batch object */
+    Py_ssize_t slen;
+    PyObject *obj;      /* borrowed original (joint-multiset storage) */
 };
+
+/* ordered value for min/max multisets: numerics compare numerically
+ * (ints exactly against ints; mixed int/float via double, tag-broken so
+ * 5 and 5.0 stay distinct adjacent entries); strings sort after
+ * numerics by code point (UTF-8 byte order) */
+struct MVal {
+    uint8_t tag; /* V_INT / V_FLT / V_STR */
+    int64_t i = 0;
+    double f = 0.0;
+    std::string s;
+
+    bool operator<(const MVal &o) const {
+        const bool anum = tag != V_STR, bnum = o.tag != V_STR;
+        if (anum != bnum)
+            return anum; /* numerics before strings */
+        if (!anum)
+            return s < o.s;
+        if (tag == V_INT && o.tag == V_INT)
+            return i < o.i;
+        /* exact mixed int/float ordering: x86-64 long double carries a
+         * 64-bit mantissa, so every int64 converts losslessly (doubles
+         * would misorder |int| > 2^53 against nearby floats) */
+        const long double a = tag == V_INT ? (long double)i : (long double)f;
+        const long double b =
+            o.tag == V_INT ? (long double)o.i : (long double)o.f;
+        if (a != b)
+            return a < b;
+        return tag < o.tag; /* 5 (int) before 5.0 (float), stable */
+    }
+    bool num_equal(const MVal &o) const {
+        const bool anum = tag != V_STR, bnum = o.tag != V_STR;
+        if (anum != bnum)
+            return false;
+        if (!anum)
+            return s == o.s;
+        const long double a = tag == V_INT ? (long double)i : (long double)f;
+        const long double b =
+            o.tag == V_INT ? (long double)o.i : (long double)o.f;
+        return a == b;
+    }
+};
+
+inline MVal mval_of(const Val &v)
+{
+    MVal m;
+    m.tag = v.tag;
+    if (v.tag == V_INT)
+        m.i = v.i;
+    else if (v.tag == V_FLT)
+        m.f = v.f;
+    else if (v.tag == V_STR)
+        m.s.assign(v.sptr, (size_t)v.slen);
+    return m;
+}
 
 /* ---- per-spec reducer state ------------------------------------------ */
 
-enum Code : uint8_t { C_COUNT, C_SUM, C_AVG };
+enum Code : uint8_t { C_COUNT, C_SUM, C_AVG, C_MIN, C_MAX };
 
 struct SState {
     int64_t cnt = 0;     /* numeric contributions (sum/avg) or row count */
@@ -55,6 +113,42 @@ struct SState {
     double fsum = 0.0;
     bool isfloat = false;
     int64_t err = 0;
+    std::map<MVal, int64_t> mm; /* min/max: ordered value multiset */
+};
+
+/* cheap before-image of a spec's FINISHED value (capturing full SState
+ * would copy the min/max map per touched group per batch) */
+struct FinSnap {
+    int64_t cnt = 0;
+    __int128 isum = 0;
+    double fsum = 0.0;
+    bool isfloat = false;
+    int64_t err = 0;
+    bool mm_empty = true;
+    MVal best; /* min or max, by code */
+};
+
+inline FinSnap snap_of(uint8_t code, const SState &s)
+{
+    FinSnap out;
+    out.cnt = s.cnt;
+    out.isum = s.isum;
+    out.fsum = s.fsum;
+    out.isfloat = s.isfloat;
+    out.err = s.err;
+    out.mm_empty = s.mm.empty();
+    if (!s.mm.empty())
+        out.best = code == C_MAX ? s.mm.rbegin()->first : s.mm.begin()->first;
+    return out;
+}
+
+/* joint row multiset entry (kept only when a min/max spec exists):
+ * mirrors the Python path's args-combo multiset so demotion can rebuild
+ * it exactly — (key, per-spec arg value, count) */
+struct MsEntry {
+    PyObject *key;                /* owned via deferred incref */
+    std::vector<PyObject *> vals; /* owned; slot per spec (NULL if argless) */
+    int64_t count;
 };
 
 struct Group {
@@ -62,17 +156,38 @@ struct Group {
     PyObject *gvals = nullptr;   /* owned: grouping-values tuple */
     PyObject *out_key = nullptr; /* owned: output Pointer (minted lazily) */
     std::vector<SState> st;
+    std::unordered_map<std::string, MsEntry> ms; /* only when has_ms */
 };
 
 struct Shard {
     std::unordered_map<std::string, Group> groups;
 };
 
+enum SpecKind : uint8_t { K_UNSET = 0, K_NUM = 1, K_STR = 2 };
+
 struct GroupStore {
     int n_shards;
+    bool has_ms = false;
     std::vector<uint8_t> codes;
+    /* per min/max spec: the value kind seen so far. Python min/max raises
+     * TypeError on numeric<->string comparison; rather than diverge (or
+     * crash after demotion), a batch that would mix kinds anywhere in the
+     * store Falls Back in phase 1 — store-level granularity is coarser
+     * than Python's per-group check, which only means we fall back early,
+     * never that we answer differently. */
+    std::vector<uint8_t> kinds;
     std::vector<Shard> shards;
 };
+
+void release_ms(Group &g)
+{
+    for (auto &kv : g.ms) {
+        Py_XDECREF(kv.second.key);
+        for (PyObject *v : kv.second.vals)
+            Py_XDECREF(v);
+    }
+    g.ms.clear();
+}
 
 void store_destructor(PyObject *capsule)
 {
@@ -84,6 +199,7 @@ void store_destructor(PyObject *capsule)
         for (auto &kv : sh.groups) {
             Py_XDECREF(kv.second.gvals);
             Py_XDECREF(kv.second.out_key);
+            release_ms(kv.second);
         }
     delete s;
 }
@@ -208,6 +324,23 @@ inline void apply_spec(uint8_t code, SState &s, const Val &v, int64_t diff)
             s.isfloat = true;
             s.cnt += diff;
             break;
+        default:
+            break;
+        }
+        break;
+    case C_MIN:
+    case C_MAX:
+        if (v.tag == V_NONE)
+            break;
+        if (v.tag == V_ERR) {
+            s.err += diff;
+            break;
+        }
+        {
+            auto it = s.mm.emplace(mval_of(v), 0).first;
+            it->second += diff;
+            if (it->second == 0)
+                s.mm.erase(it);
         }
         break;
     }
@@ -232,8 +365,10 @@ PyObject *pylong_from_i128(__int128 v)
     return PyLong_FromString(p, nullptr, 10);
 }
 
-/* finish: build the Python value for one spec state (GIL held) */
-PyObject *finish_spec(uint8_t code, const SState &s, PyObject *error_obj)
+/* finish: build the Python value for one spec snapshot (GIL held).
+ * FinSnap is the uniform finished-image of a spec — snap_of(current
+ * state) produces the after-image, Affected carries the before-image. */
+PyObject *finish_snap(uint8_t code, const FinSnap &s, PyObject *error_obj)
 {
     switch (code) {
     case C_COUNT:
@@ -256,6 +391,20 @@ PyObject *finish_spec(uint8_t code, const SState &s, PyObject *error_obj)
         if (s.cnt <= 0)
             Py_RETURN_NONE;
         return PyFloat_FromDouble((s.fsum + (double)s.isum) / (double)s.cnt);
+    case C_MIN:
+    case C_MAX:
+        if (s.err > 0) {
+            Py_INCREF(error_obj);
+            return error_obj;
+        }
+        if (s.mm_empty)
+            Py_RETURN_NONE;
+        if (s.best.tag == V_INT)
+            return PyLong_FromLongLong(s.best.i);
+        if (s.best.tag == V_FLT)
+            return PyFloat_FromDouble(s.best.f);
+        return PyUnicode_FromStringAndSize(
+            s.best.s.data(), (Py_ssize_t)s.best.s.size());
     }
     Py_RETURN_NONE;
 }
@@ -264,7 +413,7 @@ PyObject *finish_spec(uint8_t code, const SState &s, PyObject *error_obj)
  * the state without moving the output (e.g. a None/0-contributing row)
  * must emit nothing — the Python path's consolidate() would cancel the
  * retract/insert pair and downstream subscribers never see it */
-inline bool finish_equal(uint8_t code, const SState &a, const SState &b)
+inline bool finish_equal(uint8_t code, const FinSnap &a, const FinSnap &b)
 {
     switch (code) {
     case C_COUNT:
@@ -290,6 +439,15 @@ inline bool finish_equal(uint8_t code, const SState &a, const SState &b)
             return anone && bnone;
         return (a.fsum + (double)a.isum) / (double)a.cnt ==
                (b.fsum + (double)b.isum) / (double)b.cnt;
+    }
+    case C_MIN:
+    case C_MAX: {
+        bool aerr = a.err > 0, berr = b.err > 0;
+        if (aerr || berr)
+            return aerr && berr;
+        if (a.mm_empty || b.mm_empty)
+            return a.mm_empty && b.mm_empty;
+        return a.best.num_equal(b.best);
     }
     }
     return false;
@@ -317,13 +475,20 @@ PyObject *store_new(PyObject *, PyObject *args)
             code = C_SUM;
         else if (cs != nullptr && strcmp(cs, "avg") == 0)
             code = C_AVG;
+        else if (cs != nullptr && strcmp(cs, "min") == 0)
+            code = C_MIN;
+        else if (cs != nullptr && strcmp(cs, "max") == 0)
+            code = C_MAX;
         else if (cs == nullptr || strcmp(cs, "count") != 0) {
             Py_XDECREF(c);
             delete s;
             PyErr_SetString(PyExc_ValueError, "unknown reducer code");
             return nullptr;
         }
+        if (code == C_MIN || code == C_MAX)
+            s->has_ms = true;
         s->codes.push_back(code);
+        s->kinds.push_back(K_UNSET);
         Py_DECREF(c);
     }
     return PyCapsule_New(s, "pwexec.GroupStore", store_destructor);
@@ -340,11 +505,13 @@ PyObject *store_len(PyObject *, PyObject *arg)
     return PyLong_FromLongLong(n);
 }
 
-/* ---- process_batch(store, gvals_list, valcols, diffs, key_fn, error) -- */
+/* -- process_batch(store, gvals_list, keys, valcols, diffs, key_fn, error) */
 
 struct RowExtract {
     uint32_t shard;
     std::string key;
+    std::string ms_key;    /* has_ms: ser(row key) + ser(arg vals) */
+    PyObject *row_key;     /* borrowed */
     int64_t diff;
     std::vector<Val> vals; /* one per spec */
 };
@@ -354,21 +521,23 @@ struct Affected {
     std::string key;      /* for erase */
     int32_t first_row;    /* gvals source for groups created this batch */
     int64_t before_total;
-    std::vector<SState> before;
+    std::vector<FinSnap> before;
     bool created;
 };
 
 PyObject *process_batch(PyObject *, PyObject *args)
 {
-    PyObject *capsule, *gvals_list, *valcols, *diffs, *key_fn, *error_obj;
-    if (!PyArg_ParseTuple(args, "OOOOOO", &capsule, &gvals_list, &valcols,
-                          &diffs, &key_fn, &error_obj))
+    PyObject *capsule, *gvals_list, *keys_list, *valcols, *diffs, *key_fn,
+        *error_obj;
+    if (!PyArg_ParseTuple(args, "OOOOOOO", &capsule, &gvals_list, &keys_list,
+                          &valcols, &diffs, &key_fn, &error_obj))
         return nullptr;
     GroupStore *store = get_store(capsule);
     if (store == nullptr)
         return nullptr;
     const int W = store->n_shards;
     const size_t n_specs = store->codes.size();
+    const bool has_ms = store->has_ms;
 
     Py_ssize_t n = PyList_Size(gvals_list);
     if (n < 0)
@@ -377,6 +546,7 @@ PyObject *process_batch(PyObject *, PyObject *args)
     /* phase 1: extract (GIL held) — no state is mutated, so Fallback here
      * leaves the store untouched and the Python path can replay the batch */
     std::vector<RowExtract> rows(n);
+    std::vector<uint8_t> kinds = store->kinds; /* committed after phase 1 */
     std::hash<std::string> hasher;
     for (Py_ssize_t i = 0; i < n; i++) {
         RowExtract &r = rows[i];
@@ -390,6 +560,7 @@ PyObject *process_batch(PyObject *, PyObject *args)
             return nullptr;
         }
         r.shard = (uint32_t)(hasher(r.key) % (size_t)W);
+        r.row_key = PyList_GET_ITEM(keys_list, i);
         PyObject *d = PyList_GET_ITEM(diffs, i);
         int overflow = 0;
         r.diff = PyLong_AsLongLongAndOverflow(d, &overflow);
@@ -401,12 +572,16 @@ PyObject *process_batch(PyObject *, PyObject *args)
         r.vals.resize(n_specs);
         for (size_t sidx = 0; sidx < n_specs; sidx++) {
             Val &v = r.vals[sidx];
+            const uint8_t code = store->codes[sidx];
+            const bool ordered = code == C_MIN || code == C_MAX;
             PyObject *col = PyTuple_GET_ITEM(valcols, (Py_ssize_t)sidx);
-            if (col == Py_None || store->codes[sidx] == C_COUNT) {
+            v.obj = nullptr;
+            if (col == Py_None || code == C_COUNT) {
                 v.tag = V_NONE;
                 continue;
             }
             PyObject *item = PyList_GET_ITEM(col, i);
+            v.obj = item;
             if (item == Py_None) {
                 v.tag = V_NONE;
             } else if (item == error_obj) {
@@ -414,23 +589,75 @@ PyObject *process_batch(PyObject *, PyObject *args)
             } else if (PyFloat_Check(item)) {
                 v.tag = V_FLT;
                 v.f = PyFloat_AS_DOUBLE(item);
+            } else if (PyBool_Check(item)) {
+                /* bool compares as int in Python min/max and sums */
+                v.tag = V_INT;
+                v.i = item == Py_True ? 1 : 0;
             } else if (PyLong_Check(item)) {
                 int ovf = 0;
                 v.i = PyLong_AsLongLongAndOverflow(item, &ovf);
                 if (ovf) {
-                    PyErr_SetString(FallbackError, "sum arg beyond i64");
+                    PyErr_SetString(FallbackError, "arg beyond i64");
                     return nullptr;
                 }
                 v.tag = V_INT;
+            } else if (ordered && PyUnicode_Check(item)) {
+                v.sptr = PyUnicode_AsUTF8AndSize(item, &v.slen);
+                if (v.sptr == nullptr) {
+                    PyErr_Clear();
+                    PyErr_SetString(FallbackError, "non-UTF8 string arg");
+                    return nullptr;
+                }
+                v.tag = V_STR;
             } else {
-                PyErr_SetString(FallbackError, "non-numeric reducer arg");
+                PyErr_SetString(FallbackError, "unsupported reducer arg");
                 return nullptr;
+            }
+            if (ordered && (v.tag == V_INT || v.tag == V_FLT ||
+                            v.tag == V_STR)) {
+                const uint8_t k = v.tag == V_STR ? K_STR : K_NUM;
+                if (kinds[sidx] != K_UNSET && kinds[sidx] != k) {
+                    /* Python min/max TypeErrors on mixed kinds — route
+                     * the whole node to the Python path for parity */
+                    PyErr_SetString(FallbackError,
+                                    "mixed numeric/string min-max args");
+                    return nullptr;
+                }
+                kinds[sidx] = k;
+            }
+        }
+        if (has_ms) {
+            if (!ser_value(r.ms_key, r.row_key)) {
+                PyErr_Clear();
+                PyErr_SetString(FallbackError, "unsupported row key");
+                return nullptr;
+            }
+            for (size_t sidx = 0; sidx < n_specs; sidx++) {
+                Val &v = r.vals[sidx];
+                if (v.obj == nullptr) {
+                    r.ms_key.push_back('\x00');
+                } else if (!ser_value(r.ms_key, v.obj)) {
+                    if (v.obj == error_obj) {
+                        r.ms_key.push_back('\x02'); /* ERROR sentinel */
+                    } else {
+                        PyErr_Clear();
+                        PyErr_SetString(FallbackError,
+                                        "unsupported reducer arg");
+                        return nullptr;
+                    }
+                }
             }
         }
     }
 
-    /* phase 2: apply (GIL released) — shard-partitioned parallel update */
+    store->kinds = kinds; /* phase 1 passed: no Fallback beyond here */
+
+    /* phase 2: apply (GIL released) — shard-partitioned parallel update.
+     * Refcounts are never touched here: creations/erasures of joint-
+     * multiset entries record intents applied in phase 3. */
     std::vector<std::vector<Affected>> affected((size_t)W);
+    std::vector<std::vector<PyObject *>> to_incref((size_t)W);
+    std::vector<std::vector<PyObject *>> to_decref((size_t)W);
     {
         std::vector<std::vector<int32_t>> shard_rows((size_t)W);
         for (Py_ssize_t i = 0; i < n; i++)
@@ -439,6 +666,8 @@ PyObject *process_batch(PyObject *, PyObject *args)
         auto work = [&](int w) {
             Shard &sh = store->shards[(size_t)w];
             auto &aff = affected[(size_t)w];
+            auto &incs = to_incref[(size_t)w];
+            auto &decs = to_decref[(size_t)w];
             std::unordered_map<std::string, size_t> touched;
             for (int32_t ri : shard_rows[(size_t)w]) {
                 RowExtract &r = rows[(size_t)ri];
@@ -453,14 +682,48 @@ PyObject *process_batch(PyObject *, PyObject *args)
                 auto t = touched.find(r.key);
                 if (t == touched.end()) {
                     touched.emplace(r.key, aff.size());
-                    aff.push_back(Affected{&g, r.key, ri,
-                                           created ? 0 : g.total, g.st,
-                                           created});
+                    Affected a;
+                    a.g = &g;
+                    a.key = r.key;
+                    a.first_row = ri;
+                    a.before_total = created ? 0 : g.total;
+                    a.created = created;
+                    a.before.reserve(n_specs);
+                    for (size_t sidx = 0; sidx < n_specs; sidx++)
+                        a.before.push_back(
+                            snap_of(store->codes[sidx], g.st[sidx]));
+                    aff.push_back(std::move(a));
                 }
                 g.total += r.diff;
                 for (size_t sidx = 0; sidx < n_specs; sidx++)
                     apply_spec(store->codes[sidx], g.st[sidx], r.vals[sidx],
                                r.diff);
+                if (has_ms) {
+                    auto mit = g.ms.find(r.ms_key);
+                    if (mit == g.ms.end()) {
+                        MsEntry e;
+                        e.key = r.row_key;
+                        e.count = r.diff;
+                        incs.push_back(r.row_key);
+                        e.vals.reserve(n_specs);
+                        for (size_t sidx = 0; sidx < n_specs; sidx++) {
+                            PyObject *o = rows[(size_t)ri].vals[sidx].obj;
+                            e.vals.push_back(o);
+                            if (o != nullptr)
+                                incs.push_back(o);
+                        }
+                        g.ms.emplace(r.ms_key, std::move(e));
+                    } else {
+                        mit->second.count += r.diff;
+                        if (mit->second.count == 0) {
+                            decs.push_back(mit->second.key);
+                            for (PyObject *o : mit->second.vals)
+                                if (o != nullptr)
+                                    decs.push_back(o);
+                            g.ms.erase(mit);
+                        }
+                    }
+                }
             }
         };
 
@@ -479,11 +742,14 @@ PyObject *process_batch(PyObject *, PyObject *args)
         Py_END_ALLOW_THREADS
     }
 
-    /* phase 3: emit (GIL held) */
+    /* phase 3: refcount intents first, then emit (GIL held) */
+    for (int w = 0; w < W; w++)
+        for (PyObject *p : to_incref[(size_t)w])
+            Py_INCREF(p);
+
     PyObject *out = PyList_New(0);
-    if (out == nullptr)
-        return nullptr;
-    for (int w = 0; w < W; w++) {
+    bool failed = out == nullptr;
+    for (int w = 0; w < W && !failed; w++) {
         for (Affected &a : affected[(size_t)w]) {
             Group &g = *a.g;
             /* mint gvals/out_key refs for groups created this batch */
@@ -492,21 +758,28 @@ PyObject *process_batch(PyObject *, PyObject *args)
                 Py_INCREF(g.gvals);
                 g.out_key = PyObject_CallOneArg(key_fn, g.gvals);
                 if (g.out_key == nullptr) {
-                    Py_DECREF(out);
-                    return nullptr;
+                    failed = true;
+                    break;
                 }
             }
             bool before_live = a.before_total > 0;
             bool after_live = g.total > 0;
             bool changed = before_live != after_live;
+            std::vector<FinSnap> after;
+            if (after_live) {
+                after.reserve(n_specs);
+                for (size_t sidx = 0; sidx < n_specs; sidx++)
+                    after.push_back(snap_of(store->codes[sidx], g.st[sidx]));
+            }
             if (!changed && after_live) {
                 for (size_t sidx = 0; sidx < n_specs && !changed; sidx++)
                     changed = !finish_equal(store->codes[sidx],
-                                            a.before[sidx], g.st[sidx]);
+                                            a.before[sidx], after[sidx]);
             }
             if (changed) {
                 Py_ssize_t ng = PyTuple_GET_SIZE(g.gvals);
-                auto emit = [&](const std::vector<SState> &st, long dir) -> int {
+                auto emit = [&](const std::vector<FinSnap> &st,
+                                long dir) -> int {
                     PyObject *row =
                         PyTuple_New(ng + (Py_ssize_t)n_specs);
                     if (row == nullptr)
@@ -517,7 +790,7 @@ PyObject *process_batch(PyObject *, PyObject *args)
                         PyTuple_SET_ITEM(row, j, x);
                     }
                     for (size_t sidx = 0; sidx < n_specs; sidx++) {
-                        PyObject *v = finish_spec(store->codes[sidx],
+                        PyObject *v = finish_snap(store->codes[sidx],
                                                   st[sidx], error_obj);
                         if (v == nullptr) {
                             Py_DECREF(row);
@@ -535,15 +808,15 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     return rc;
                 };
                 if (before_live && emit(a.before, -1) < 0) {
-                    Py_DECREF(out);
-                    return nullptr;
+                    failed = true;
+                    break;
                 }
-                if (after_live && emit(g.st, 1) < 0) {
-                    Py_DECREF(out);
-                    return nullptr;
+                if (after_live && emit(after, 1) < 0) {
+                    failed = true;
+                    break;
                 }
             }
-            if (g.total == 0) {
+            if (g.total == 0 && g.ms.empty()) {
                 /* fully retracted group: release refs and erase */
                 Py_XDECREF(g.gvals);
                 Py_XDECREF(g.out_key);
@@ -551,10 +824,22 @@ PyObject *process_batch(PyObject *, PyObject *args)
             }
         }
     }
+
+    for (int w = 0; w < W; w++)
+        for (PyObject *p : to_decref[(size_t)w])
+            Py_DECREF(p);
+    if (failed) {
+        Py_XDECREF(out);
+        return nullptr;
+    }
     return out;
 }
 
-/* ---- dump/load for operator snapshots and Python-path migration ------- */
+/* ---- dump/load for operator snapshots and Python-path migration -------
+ * Entry: (gvals, out_key, total, states[, ms_entries]) — ms_entries
+ * present iff the store tracks the joint row multiset (min/max specs):
+ * [(row_key, (val_or_None per spec), count)]. min/max mm state is NOT
+ * dumped — load rebuilds it from ms_entries. */
 
 PyObject *store_dump(PyObject *, PyObject *arg)
 {
@@ -590,9 +875,50 @@ PyObject *store_dump(PyObject *, PyObject *arg)
                 }
                 PyList_SET_ITEM(states, (Py_ssize_t)i, t);
             }
-            PyObject *entry = Py_BuildValue(
-                "(OOLO)", g.gvals ? g.gvals : Py_None,
-                g.out_key ? g.out_key : Py_None, (long long)g.total, states);
+            PyObject *entry;
+            if (s->has_ms) {
+                PyObject *ms = PyList_New(0);
+                bool ok = ms != nullptr;
+                for (auto &me : g.ms) {
+                    if (!ok)
+                        break;
+                    const MsEntry &e = me.second;
+                    PyObject *vals =
+                        PyTuple_New((Py_ssize_t)e.vals.size());
+                    if (vals == nullptr) {
+                        ok = false;
+                        break;
+                    }
+                    for (size_t j = 0; j < e.vals.size(); j++) {
+                        PyObject *v = e.vals[j] ? e.vals[j] : Py_None;
+                        Py_INCREF(v);
+                        PyTuple_SET_ITEM(vals, (Py_ssize_t)j, v);
+                    }
+                    PyObject *t = Py_BuildValue(
+                        "(ONL)", e.key, vals, (long long)e.count);
+                    if (t == nullptr || PyList_Append(ms, t) < 0) {
+                        Py_XDECREF(t);
+                        ok = false;
+                        break;
+                    }
+                    Py_DECREF(t);
+                }
+                if (!ok) {
+                    Py_XDECREF(ms);
+                    Py_DECREF(states);
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                entry = Py_BuildValue(
+                    "(OOLON)", g.gvals ? g.gvals : Py_None,
+                    g.out_key ? g.out_key : Py_None, (long long)g.total,
+                    states, ms);
+            } else {
+                entry = Py_BuildValue(
+                    "(OOLO)", g.gvals ? g.gvals : Py_None,
+                    g.out_key ? g.out_key : Py_None, (long long)g.total,
+                    states);
+            }
             Py_DECREF(states);
             if (entry == nullptr || PyList_Append(out, entry) < 0) {
                 Py_XDECREF(entry);
@@ -607,8 +933,8 @@ PyObject *store_dump(PyObject *, PyObject *arg)
 
 PyObject *store_load(PyObject *, PyObject *args)
 {
-    PyObject *capsule, *entries;
-    if (!PyArg_ParseTuple(args, "OO", &capsule, &entries))
+    PyObject *capsule, *entries, *error_obj = nullptr;
+    if (!PyArg_ParseTuple(args, "OO|O", &capsule, &entries, &error_obj))
         return nullptr;
     GroupStore *s = get_store(capsule);
     if (s == nullptr)
@@ -617,11 +943,21 @@ PyObject *store_load(PyObject *, PyObject *args)
     Py_ssize_t n = PyList_Size(entries);
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *entry = PyList_GET_ITEM(entries, i);
-        PyObject *gvals, *out_key, *states;
+        PyObject *gvals, *out_key, *states, *ms_list = nullptr;
         long long total;
-        if (!PyArg_ParseTuple(entry, "OOLO", &gvals, &out_key, &total,
-                              &states))
+        if (PyTuple_Check(entry) && PyTuple_GET_SIZE(entry) == 5) {
+            if (!PyArg_ParseTuple(entry, "OOLOO", &gvals, &out_key, &total,
+                                  &states, &ms_list))
+                return nullptr;
+        } else if (!PyArg_ParseTuple(entry, "OOLO", &gvals, &out_key,
+                                     &total, &states))
             return nullptr;
+        if (s->has_ms && ms_list == nullptr) {
+            PyErr_SetString(FallbackError,
+                            "snapshot lacks the joint multiset this "
+                            "min/max store needs");
+            return nullptr;
+        }
         std::string key;
         if (!ser_gvals(key, gvals)) {
             if (!PyErr_Occurred())
@@ -669,6 +1005,128 @@ PyObject *store_load(PyObject *, PyObject *args)
             st.fsum = fsum;
             st.isfloat = isfloat == Py_True;
             st.err = err;
+        }
+        if (s->has_ms && ms_list != nullptr) {
+            /* rebuild the joint multiset AND every min/max spec's ordered
+             * state from the dumped entries (min/max err comes from the
+             * entries too — clear the state-dump copy to avoid doubling) */
+            for (size_t sidx = 0; sidx < s->codes.size(); sidx++)
+                if (s->codes[sidx] == C_MIN || s->codes[sidx] == C_MAX) {
+                    g.st[sidx].err = 0;
+                    g.st[sidx].mm.clear();
+                }
+            Py_ssize_t nm = PyList_Size(ms_list);
+            for (Py_ssize_t j = 0; j < nm; j++) {
+                PyObject *row_key, *vals;
+                long long count;
+                if (!PyArg_ParseTuple(PyList_GET_ITEM(ms_list, j), "OOL",
+                                      &row_key, &vals, &count))
+                    return nullptr;
+                /* pass 1: serialize the entry key (no refcounts yet) */
+                std::string mkey;
+                if (!ser_value(mkey, row_key)) {
+                    if (!PyErr_Occurred())
+                        PyErr_SetString(FallbackError,
+                                        "unsupported row key in snapshot");
+                    return nullptr;
+                }
+                std::vector<PyObject *> raw_vals;
+                for (size_t sidx = 0; sidx < s->codes.size(); sidx++) {
+                    PyObject *v =
+                        PyTuple_GET_ITEM(vals, (Py_ssize_t)sidx);
+                    if (s->codes[sidx] == C_COUNT) { /* argless: None */
+                        mkey.push_back('\x00');
+                        raw_vals.push_back(nullptr);
+                        continue;
+                    }
+                    raw_vals.push_back(v);
+                    if (!ser_value(mkey, v)) {
+                        if (error_obj != nullptr && v == error_obj) {
+                            PyErr_Clear();
+                            mkey.push_back('\x02');
+                        } else {
+                            if (!PyErr_Occurred())
+                                PyErr_SetString(
+                                    FallbackError,
+                                    "unsupported reducer arg in snapshot");
+                            return nullptr;
+                        }
+                    }
+                }
+                /* pass 2: merge-or-insert, then fold into min/max state */
+                auto found = g.ms.find(mkey);
+                if (found != g.ms.end()) {
+                    found->second.count += count;
+                } else {
+                    MsEntry e;
+                    e.key = row_key;
+                    e.count = count;
+                    Py_INCREF(row_key);
+                    for (PyObject *v : raw_vals) {
+                        e.vals.push_back(v);
+                        if (v != nullptr)
+                            Py_INCREF(v);
+                    }
+                    g.ms.emplace(std::move(mkey), std::move(e));
+                }
+                for (size_t sidx = 0; sidx < s->codes.size(); sidx++) {
+                    const uint8_t code = s->codes[sidx];
+                    if (code != C_MIN && code != C_MAX)
+                        continue;
+                    PyObject *v = raw_vals[sidx];
+                    /* extract a Val exactly like process_batch phase 1
+                     * (incl. overflow/encoding checks), then reuse
+                     * apply_spec so the fold cannot drift */
+                    Val vv;
+                    vv.obj = v;
+                    if (v == nullptr || v == Py_None) {
+                        vv.tag = V_NONE;
+                    } else if (error_obj != nullptr && v == error_obj) {
+                        vv.tag = V_ERR;
+                    } else if (PyFloat_Check(v)) {
+                        vv.tag = V_FLT;
+                        vv.f = PyFloat_AS_DOUBLE(v);
+                    } else if (PyBool_Check(v)) {
+                        vv.tag = V_INT;
+                        vv.i = v == Py_True ? 1 : 0;
+                    } else if (PyLong_Check(v)) {
+                        int ovf = 0;
+                        vv.i = PyLong_AsLongLongAndOverflow(v, &ovf);
+                        if (ovf) {
+                            PyErr_SetString(FallbackError,
+                                            "snapshot arg beyond i64");
+                            return nullptr;
+                        }
+                        vv.tag = V_INT;
+                    } else if (PyUnicode_Check(v)) {
+                        vv.sptr = PyUnicode_AsUTF8AndSize(v, &vv.slen);
+                        if (vv.sptr == nullptr) {
+                            PyErr_Clear();
+                            PyErr_SetString(FallbackError,
+                                            "non-UTF8 snapshot arg");
+                            return nullptr;
+                        }
+                        vv.tag = V_STR;
+                    } else {
+                        PyErr_SetString(FallbackError,
+                                        "unsupported snapshot arg");
+                        return nullptr;
+                    }
+                    if (vv.tag == V_INT || vv.tag == V_FLT ||
+                        vv.tag == V_STR) {
+                        const uint8_t k = vv.tag == V_STR ? K_STR : K_NUM;
+                        if (s->kinds[sidx] != K_UNSET &&
+                            s->kinds[sidx] != k) {
+                            PyErr_SetString(
+                                FallbackError,
+                                "mixed numeric/string min-max snapshot");
+                            return nullptr;
+                        }
+                        s->kinds[sidx] = k;
+                    }
+                    apply_spec(code, g.st[sidx], vv, count);
+                }
+            }
         }
     }
     Py_RETURN_NONE;

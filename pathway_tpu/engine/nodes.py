@@ -620,11 +620,19 @@ class GroupByNode(GroupDiffNode):
         # GIL released during the apply phase. Eligible when every reducer
         # has a native code and args are single columns; ineligible or
         # unsupported-value batches fall back to the Python path below.
-        self.native_codes = [s[4] if len(s) > 4 else None for s in self.specs]
+        # abelian specs carry their native code at index 4 (count/sum/avg);
+        # full specs at index 2 (min/max — the C++ store keeps an ordered
+        # value multiset per group plus the joint row multiset so demotion
+        # can rebuild the Python ms exactly)
+        self.native_codes = [
+            (s[4] if len(s) > 4 else None)
+            if s[0] == "abelian"
+            else (s[2] if len(s) > 2 else None)
+            for s in self.specs
+        ]
         self.native_args = native_args
         self._native_ok = (
-            not self.need_ms
-            and len(self.specs) > 0
+            len(self.specs) > 0
             and all(c is not None for c in self.native_codes)
             and native_args is not None
         )
@@ -658,6 +666,8 @@ class GroupByNode(GroupDiffNode):
         return True
 
     def _native_state_to_py(self, code, st):
+        if code in ("min", "max"):
+            return None  # full reducers read the (rebuilt) multiset
         cnt, isum, fsum, isfloat, err = st
         if code == "count":
             return cnt
@@ -666,17 +676,40 @@ class GroupByNode(GroupDiffNode):
             return [cnt, value, err]
         return [float(fsum + isum), cnt, err]  # avg
 
-    def _migrate_to_python(self) -> None:
-        """Convert C++ store state to the Python groups dict (one-way: a
-        batch with values the native path can't represent permanently
-        demotes this node)."""
-        dumped = self._exec.store_dump(self._store)
-        for gvals, out_key, total, states in dumped:
+    def _combos_of(self, key, vals):
+        """Rebuild one args_fn row from a dumped joint-multiset entry:
+        per spec ``(*args, order_token, row_key)`` with order == row key
+        (native eligibility excludes sort_by, groupbys.py)."""
+        return tuple(
+            (key, key) if col is None else (vals[j], key, key)
+            for j, col in enumerate(self.native_args)
+        )
+
+    def _groups_from_native_entries(self, entries) -> None:
+        """Rebuild the Python groups dict from dumped native entries —
+        shared by mid-stream demotion and snapshot-restore demotion so
+        the two paths cannot drift."""
+        for entry in entries:
+            gvals, out_key, total, states = entry[:4]
             ab = [
                 self._native_state_to_py(code, st)
                 for code, st in zip(self.native_codes, states)
             ]
-            self.groups[freeze_row(gvals)] = [gvals, None, ab, total, out_key]
+            ms = None
+            if len(entry) > 4:
+                ms = {}
+                for key, vals, count in entry[4]:
+                    args = self._combos_of(key, vals)
+                    ms[freeze_row(args)] = [args, count]
+            elif self.need_ms:
+                ms = {}
+            self.groups[freeze_row(gvals)] = [gvals, ms, ab, total, out_key]
+
+    def _migrate_to_python(self) -> None:
+        """Convert C++ store state to the Python groups dict (one-way: a
+        batch with values the native path can't represent permanently
+        demotes this node)."""
+        self._groups_from_native_entries(self._exec.store_dump(self._store))
         self._store = None
         self._native_ok = False
 
@@ -700,6 +733,7 @@ class GroupByNode(GroupDiffNode):
                     self._exec.process_batch(
                         self._store,
                         list(gvals_list),
+                        keys,
                         valcols,
                         diffs,
                         self.key_fn,
@@ -767,16 +801,14 @@ class GroupByNode(GroupDiffNode):
         native = state.get("__native__") if isinstance(state, dict) else None
         if native is not None:
             if self._native_ok and self._native_setup():
-                self._exec.store_load(self._store, native)
-            else:
-                for gvals, out_key, total, states in native:
-                    ab = [
-                        self._native_state_to_py(code, st)
-                        for code, st in zip(self.native_codes, states)
-                    ]
-                    self.groups[freeze_row(gvals)] = [
-                        gvals, None, ab, total, out_key,
-                    ]
+                try:
+                    self._exec.store_load(self._store, native, ERROR)
+                    return
+                except self._exec.Fallback:
+                    # partially-loaded store is discarded wholesale
+                    self._store = None
+            self._groups_from_native_entries(native)
+            self._native_ok = False
             return
         for a, v in state.items():
             setattr(self, a, v)

@@ -271,7 +271,9 @@ class GroupedTable:
 
             # single-column arg evaluators for the native executor: one
             # entry per reducer — None for arg-less reducers (count);
-            # multi-arg reducers make the node ineligible
+            # multi-arg reducers make the node ineligible, and so does
+            # sort_by (the native joint multiset reconstructs order
+            # tokens as the row key, which only holds without sort_by)
             native_args = []
             for fns in arg_fns:
                 if len(fns) == 0:
@@ -281,6 +283,8 @@ class GroupedTable:
                 else:
                     native_args = None
                     break
+            if sort_fn is not None:
+                native_args = None
 
             if stateful:
                 assert len(reducers) == 1

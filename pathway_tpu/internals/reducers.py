@@ -57,14 +57,19 @@ class Reducer:
     def engine_spec(self, **kwargs):
         """("abelian", update(state, combo, diff), finish(state), init[,
         native_code]) when incremental maintenance applies, else ("full",
-        fn). native_code ("count"/"sum"/"avg") marks specs the sharded C++
-        executor (native/exec.cpp) runs natively."""
+        fn[, native_code]). native_code marks specs the sharded C++
+        executor (native/exec.cpp) runs natively: count/sum/avg keep O(1)
+        abelian state; min/max keep an ordered value multiset per group
+        (plus the joint row multiset for Python-path migration)."""
         if self._abelian_factory is not None:
             spec = ("abelian",) + self._abelian_factory(**kwargs)
             if self.name in ("count", "sum", "avg"):
                 spec = spec + (self.name,)
             return spec
-        return ("full", self._factory(**kwargs))
+        spec = ("full", self._factory(**kwargs))
+        if self.name in ("min", "max"):
+            spec = spec + (self.name,)
+        return spec
 
     def __call__(self, *args, **kwargs) -> ReducerExpression:
         return ReducerExpression(self, *args, **kwargs)

@@ -16,6 +16,14 @@ from pathway_tpu.internals.api import ERROR, ref_scalar
 from pathway_tpu.native import get_pwexec
 
 pwexec = get_pwexec()
+
+
+def _pb(store, gvals, valcols, diffs, key_fn, error):
+    """Shim for the executor's signature: process_batch also takes the
+    per-row engine keys (joint-multiset identity for min/max stores)."""
+    return pwexec.process_batch(
+        store, gvals, list(range(len(gvals))), valcols, diffs, key_fn, error
+    )
 pytestmark = pytest.mark.skipif(pwexec is None, reason="no native toolchain")
 
 
@@ -62,12 +70,12 @@ def test_native_matches_python_path(monkeypatch):
 def test_executor_retraction_and_deletion():
     s = pwexec.store_new(4, ("count", "sum"))
     key_fn = lambda g: ref_scalar(*g)
-    out = pwexec.process_batch(
+    out = _pb(
         s, [("a",), ("a",)], (None, [3, 4]), [1, 1], key_fn, ERROR
     )
     assert [(r, d) for _, r, d in out] == [(("a", 2, 7), 1)]
     # retract both rows -> group dies, only the retraction is emitted
-    out = pwexec.process_batch(
+    out = _pb(
         s, [("a",), ("a",)], (None, [3, 4]), [-1, -1], key_fn, ERROR
     )
     assert [(r, d) for _, r, d in out] == [(("a", 2, 7), -1)]
@@ -78,16 +86,16 @@ def test_executor_none_error_and_float_promotion():
     s = pwexec.store_new(2, ("sum",))
     key_fn = lambda g: ref_scalar(*g)
     # None args don't contribute; float promotes the sum
-    out = pwexec.process_batch(
+    out = _pb(
         s, [("g",), ("g",), ("g",)], ([1, None, 2.5],), [1, 1, 1], key_fn, ERROR
     )
     assert [(r, d) for _, r, d in out] == [(("g", 3.5), 1)]
     # ERROR poisons
-    out = pwexec.process_batch(s, [("g",)], ([ERROR],), [1], key_fn, ERROR)
+    out = _pb(s, [("g",)], ([ERROR],), [1], key_fn, ERROR)
     (_, row, d) = out[-1]
     assert row[1] is ERROR and d == 1
     # retracting the error heals the sum
-    out = pwexec.process_batch(s, [("g",)], ([ERROR],), [-1], key_fn, ERROR)
+    out = _pb(s, [("g",)], ([ERROR],), [-1], key_fn, ERROR)
     assert out[-1][1] == ("g", 3.5) and out[-1][2] == 1
 
 
@@ -95,7 +103,7 @@ def test_numeric_group_normalization():
     """True == 1 == 1.0 must land in ONE group (Python dict-key parity)."""
     s = pwexec.store_new(3, ("count",))
     key_fn = lambda g: ref_scalar(*g)
-    out = pwexec.process_batch(
+    out = _pb(
         s, [(1,), (1.0,), (True,)], (None,), [1, 1, 1], key_fn, ERROR
     )
     assert pwexec.store_len(s) == 1
@@ -196,7 +204,7 @@ def test_bigint_sum_exact():
     s = pwexec.store_new(2, ("sum",))
     key_fn = lambda g: ref_scalar(*g)
     v = 2**62
-    out = pwexec.process_batch(
+    out = _pb(
         s, [("g",)] * 3, ([v, v, v],), [1, 1, 1], key_fn, ERROR
     )
     assert out[-1][1] == ("g", 3 * 2**62)
@@ -204,7 +212,7 @@ def test_bigint_sum_exact():
     d = pwexec.store_dump(s)
     s2 = pwexec.store_new(2, ("sum",))
     pwexec.store_load(s2, d)
-    out = pwexec.process_batch(s2, [("g",)], ([1],), [1], key_fn, ERROR)
+    out = _pb(s2, [("g",)], ([1],), [1], key_fn, ERROR)
     assert out[-1][1] == ("g", 3 * 2**62 + 1)
 
 
@@ -213,14 +221,14 @@ def test_unchanged_output_emits_nothing():
     deltas (review: spurious retract/insert pairs leaked to subscribers)."""
     s = pwexec.store_new(2, ("sum", "avg"))
     key_fn = lambda g: ref_scalar(*g)
-    pwexec.process_batch(s, [("g",)], ([5], [2.0]), [1], key_fn, ERROR)
+    _pb(s, [("g",)], ([5], [2.0]), [1], key_fn, ERROR)
     # value-0 row: sum unchanged; arriving avg value equals current mean
-    out = pwexec.process_batch(s, [("g",)], ([0], [2.0]), [1], key_fn, ERROR)
+    out = _pb(s, [("g",)], ([0], [2.0]), [1], key_fn, ERROR)
     assert out == []
     # count would change though
     s2 = pwexec.store_new(2, ("count",))
-    pwexec.process_batch(s2, [("g",)], (None,), [1], key_fn, ERROR)
-    out = pwexec.process_batch(s2, [("g",)], (None,), [1], key_fn, ERROR)
+    _pb(s2, [("g",)], (None,), [1], key_fn, ERROR)
+    out = _pb(s2, [("g",)], (None,), [1], key_fn, ERROR)
     assert len(out) == 2
 
 
@@ -253,4 +261,4 @@ def test_surrogate_string_falls_back():
     s = pwexec.store_new(2, ("count",))
     key_fn = lambda g: ref_scalar(*map(repr, g))
     with pytest.raises(pwexec.Fallback):
-        pwexec.process_batch(s, [("\udcff",)], (None,), [1], key_fn, ERROR)
+        _pb(s, [("\udcff",)], (None,), [1], key_fn, ERROR)

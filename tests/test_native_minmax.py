@@ -1,0 +1,358 @@
+"""Native min/max reducers (native/exec.cpp C_MIN/C_MAX).
+
+Unlike count/sum/avg these are non-abelian: the C++ store keeps an
+ordered value multiset per group (retraction-correct) plus the joint
+row multiset, so demoting to the Python path mid-stream rebuilds the
+exact args-combo multiset the full reducers read. Pinned here:
+engagement, streamed-vs-batch, native-vs-python, retraction of the
+current extremum, string ordering, demotion, and snapshot roundtrip.
+"""
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import nodes as N
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+class _S(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    g: int
+    v: int
+
+
+class _OpsSubject(pw.io.python.ConnectorSubject):
+    def __init__(self, commits):
+        super().__init__()
+        self.commits = commits
+
+    def run(self):
+        for commit in self.commits:
+            for kind, row in commit:
+                (self.next if kind == "upsert" else self.remove)(**row)
+            self.commit()
+
+
+def _random_ops(rng, n_keys=12, n_ops=80):
+    live, ops, commit = {}, [], []
+    for _ in range(n_ops):
+        k = rng.randrange(n_keys)
+        if k in live and rng.random() < 0.4:
+            commit.append(("remove", live.pop(k)))
+        else:
+            if k in live:
+                commit.append(("remove", live.pop(k)))
+            row = {"k": k, "g": rng.randrange(3), "v": rng.randrange(50)}
+            live[k] = row
+            commit.append(("upsert", row))
+        if rng.random() < 0.3:
+            ops.append(commit)
+            commit = []
+    if commit:
+        ops.append(commit)
+    return ops, live
+
+
+def _pipeline(t):
+    return t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+    )
+
+
+def _state(capture):
+    return sorted(tuple(r) for r in capture.state.rows.values())
+
+
+def _run_streamed(commits):
+    t = pw.io.python.read(
+        _OpsSubject(commits), schema=_S, autocommit_duration_ms=None
+    )
+    return _state(GraphRunner().run_tables(_pipeline(t))[0])
+
+
+def _run_batch(final_rows):
+    pw.internals.parse_graph.G.clear()
+    if final_rows:
+        t = pw.debug.table_from_markdown(
+            "\n".join(
+                ["k | g | v"]
+                + [f"{r['k']} | {r['g']} | {r['v']}" for r in final_rows.values()]
+            ),
+            schema=_S,
+        )
+    else:
+        t = pw.Table.empty(k=int, g=int, v=int)
+    return _state(GraphRunner().run_tables(_pipeline(t))[0])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_minmax_streamed_matches_batch(seed):
+    rng = random.Random(seed)
+    commits, final = _random_ops(rng)
+    assert _run_streamed(commits) == _run_batch(final)
+
+
+def test_minmax_native_engaged_and_matches_python(monkeypatch):
+    from pathway_tpu.native import get_pwexec
+
+    if get_pwexec() is None:
+        pytest.skip("no native toolchain")
+    engaged = []
+    orig = N.GroupByNode.process
+
+    def spy(self, time, batches):
+        out = orig(self, time, batches)
+        engaged.append(self._store is not None)
+        return out
+
+    monkeypatch.setattr(N.GroupByNode, "process", spy)
+    rng = random.Random(9)
+    commits, _ = _random_ops(rng)
+    native = _run_streamed(commits)
+    assert engaged and all(engaged)
+    monkeypatch.undo()
+
+    pw.internals.parse_graph.G.clear()
+    monkeypatch.setattr(N.GroupByNode, "_native_setup", lambda self: False)
+    python = _run_streamed(commits)
+    assert native == python
+
+
+def test_min_retraction_of_current_extremum():
+    """Retracting the minimum must resurface the runner-up (the failure
+    abelian approximations of min/max cannot handle)."""
+
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, g=0, v=5)
+            self.next(k=2, g=0, v=9)
+            self.commit()
+            self.next(k=3, g=0, v=1)
+            self.commit()
+            self.remove(k=3, g=0, v=1)  # retract the current min
+            self.commit()
+
+    t = pw.io.python.read(Sub(), schema=_S, autocommit_duration_ms=None)
+    changes = []
+    pw.io.subscribe(
+        _pipeline(t),
+        on_change=lambda k, row, t_, d: changes.append(
+            (row["mn"], row["mx"], 1 if d else -1)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    net = {}
+    for mn, mx, d in changes:
+        net[(mn, mx)] = net.get((mn, mx), 0) + d
+    live = [k for k, c in net.items() if c > 0]
+    assert live == [(5, 9)]
+    # and the transient min=1 state was observed then retracted
+    assert net.get((1, 9), 0) == 0 and (1, 9, 1) in changes
+
+
+def test_minmax_strings():
+    t = pw.debug.table_from_markdown(
+        """
+        g | w
+        0 | pear
+        0 | apple
+        1 | fig
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        first=pw.reducers.min(pw.this.w),
+        last=pw.reducers.max(pw.this.w),
+    )
+    cap = GraphRunner().run_tables(r)[0]
+    assert sorted(tuple(r) for r in cap.state.rows.values()) == [
+        (0, "apple", "pear"),
+        (1, "fig", "fig"),
+    ]
+
+
+def test_minmax_demotion_rebuilds_multiset():
+    """A late Json grouping value demotes the node; the rebuilt Python
+    multiset must keep min/max exact for subsequent retractions."""
+
+    class _JS(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: pw.Json
+        v: int
+
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            # native-served while ints... never: Json group from the start
+            # would fall back immediately; instead use int-like then Json
+            self.next(k=1, g=pw.Json(0), v=5)
+            self.next(k=2, g=pw.Json(0), v=9)
+            self.commit()
+            self.remove(k=1, g=pw.Json(0), v=5)
+            self.commit()
+
+    t = pw.io.python.read(Sub(), schema=_JS, autocommit_duration_ms=None)
+    r = t.groupby(pw.this.g).reduce(
+        mn=pw.reducers.min(pw.this.v), mx=pw.reducers.max(pw.this.v)
+    )
+    cap = GraphRunner().run_tables(r)[0]
+    assert [tuple(r) for r in cap.state.rows.values()] == [(9, 9)]
+
+
+def test_minmax_int_demotion_midstream(monkeypatch):
+    """Start native (int groups), then hit the store with a batch whose
+    grouping value is unserializable — the dumped joint multiset must
+    reconstruct the Python ms EXACTLY so later retractions are correct."""
+    from pathway_tpu.native import get_pwexec
+
+    if get_pwexec() is None:
+        pytest.skip("no native toolchain")
+
+    class _AS(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: pw.Json
+        v: int
+
+    demoted = []
+    orig = N.GroupByNode._migrate_to_python
+
+    def spy(self):
+        demoted.append(True)
+        return orig(self)
+
+    monkeypatch.setattr(N.GroupByNode, "_migrate_to_python", spy)
+
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            # int-keyed commits ride the native store
+            self.next(k=1, g=pw.Json("a"), v=3)
+            self.commit()
+
+    # Json never reaches the native path (grouping fallback on commit 1),
+    # so to exercise a REAL mid-stream demotion drive the node directly.
+    import pathway_tpu.native as native_mod
+
+    ex = native_mod.get_pwexec()
+    node_cls = N.GroupByNode
+
+    class FakeScope:
+        def __init__(self):
+            self.runtime = type("R", (), {"current_trace": None})()
+            self.nodes = []
+            self.exchange_nodes = []
+
+        def register(self, node):
+            self.nodes.append(node)
+            return len(self.nodes) - 1
+
+    from pathway_tpu.internals.api import ref_scalar
+
+    scope = FakeScope()
+    node = node_cls(
+        scope,
+        N.SourceNode(scope),
+        lambda k, row: (row[0],),
+        lambda k, row: ((row[1], k, k),),
+        [("full", _min_fn(), "min")],
+        native_args=[lambda keys, rows: [r[1] for r in rows]],
+        grouping_batch=lambda keys, rows: [(r[0],) for r in rows],
+        args_batch=lambda keys, rows: [((r[1], k, k),) for k, r in zip(keys, rows)],
+    )
+    k1, k2, k3 = ref_scalar(1), ref_scalar(2), ref_scalar(3)
+    out = node.process(0, [[(k1, (0, 7), 1), (k2, (0, 3), 1)]])
+    assert [(r, d) for _, r, d in out] == [((0, 3), 1)]
+    assert node._store is not None
+    # unserializable grouping value -> demotion with state intact
+    out = node.process(1, [[(k3, (pw.Json(5), 1), 1)]])
+    assert node._store is None and demoted
+    # retract the minimum of the original group on the PYTHON path: the
+    # rebuilt multiset must resurface 7
+    out = node.process(2, [[(k2, (0, 3), -1)]])
+    pairs = sorted((r, d) for _, r, d in out)
+    assert ((0, 3), -1) in pairs and ((0, 7), 1) in pairs
+
+
+def _min_fn():
+    from pathway_tpu.internals.reducers import _min_factory
+
+    return _min_factory()
+
+
+def test_minmax_mixed_kinds_fall_back():
+    """Python min/max raises TypeError on numeric<->string comparison;
+    the native path must never answer such groups differently — a batch
+    that would mix kinds Falls Back in phase 1 (review repro)."""
+    from pathway_tpu.native import get_pwexec
+
+    ex = get_pwexec()
+    if ex is None:
+        pytest.skip("no native toolchain")
+    from pathway_tpu.internals.api import ERROR, ref_scalar
+
+    s = ex.store_new(2, ("min",))
+    key_fn = lambda g: ref_scalar(*g)
+    with pytest.raises(ex.Fallback):
+        ex.process_batch(
+            s, [("g",), ("g",)], [1, 2], ([1, "a"],), [1, 1], key_fn, ERROR
+        )
+    # cross-batch mixing too: numeric batch first, string batch second
+    s2 = ex.store_new(2, ("min",))
+    ex.process_batch(s2, [("g",)], [1], ([1],), [1], key_fn, ERROR)
+    with pytest.raises(ex.Fallback):
+        ex.process_batch(s2, [("h",)], [2], (["a"],), [1], key_fn, ERROR)
+
+
+def test_minmax_int_float_precision_beyond_2_53():
+    """int 2^53+1 vs float 2^53 must order exactly (long-double compare);
+    doubles would collapse them and return the larger value (review
+    repro)."""
+    from pathway_tpu.native import get_pwexec
+
+    ex = get_pwexec()
+    if ex is None:
+        pytest.skip("no native toolchain")
+    from pathway_tpu.internals.api import ERROR, ref_scalar
+
+    s = ex.store_new(2, ("min", "max"))
+    key_fn = lambda g: ref_scalar(*g)
+    big = 2**53 + 1
+    out = ex.process_batch(
+        s, [("g",), ("g",)], [1, 2],
+        ([big, float(2**53)], [big, float(2**53)]), [1, 1], key_fn, ERROR,
+    )
+    row = out[-1][1]
+    assert row[1] == float(2**53) and isinstance(row[1], float)
+    assert row[2] == big and isinstance(row[2], int)
+
+
+def test_minmax_operator_snapshot_roundtrip(tmp_path):
+    """OPERATOR_PERSISTING kill/restart with a min/max groupby: the
+    native store's dump must restore both the ordered state and the
+    joint multiset."""
+    from pathway_tpu.native import get_pwexec
+
+    if get_pwexec() is None:
+        pytest.skip("no native toolchain")
+    ex = get_pwexec()
+    key_fn = lambda g: g[0]
+    s = ex.store_new(2, ("min", "max"))
+    from pathway_tpu.internals.api import ERROR
+
+    ex.process_batch(
+        s, [("g",), ("g",), ("h",)], [101, 102, 103],
+        ([4, 9, 5], [4, 9, 5]), [1, 1, 1], key_fn, ERROR,
+    )
+    dumped = ex.store_dump(s)
+    s2 = ex.store_new(2, ("min", "max"))
+    ex.store_load(s2, dumped, ERROR)
+    # retract the min on the restored store: runner-up surfaces
+    out = ex.process_batch(
+        s2, [("g",)], [101], ([4], [4]), [-1], key_fn, ERROR
+    )
+    emitted = sorted((r, d) for _, r, d in out)
+    assert emitted == [(("g", 4, 9), -1), (("g", 9, 9), 1)]
