@@ -12,7 +12,6 @@ Use as: ``import pathway_tpu as pw``.
 
 from __future__ import annotations
 
-from pathway_tpu.internals import dtype as _dt
 from pathway_tpu.internals import reducers
 from pathway_tpu.internals.api import (
     ERROR,
@@ -58,10 +57,14 @@ from pathway_tpu.internals.universe import SOLVER, Universe
 from pathway_tpu.run import run, run_all
 from pathway_tpu.udfs import UDF, udf
 
-# dtype aliases matching the reference's pw.* type names
-DateTimeNaive = _dt.DATE_TIME_NAIVE
-DateTimeUtc = _dt.DATE_TIME_UTC
-Duration = _dt.DURATION
+# user-facing datetime classes (reference: internals/datetime_types.py) —
+# usable as schema annotations AND constructors (pw.Duration(days=1));
+# the dtype resolver maps them onto DATE_TIME_NAIVE/UTC/DURATION
+from pathway_tpu.internals.datetime_types import (  # noqa: E402
+    DateTimeNaive,
+    DateTimeUtc,
+    Duration,
+)
 
 from pathway_tpu import debug, io, udfs  # noqa: E402
 from pathway_tpu.internals.config import (  # noqa: E402

@@ -777,7 +777,10 @@ class GroupByNode(GroupDiffNode):
                 afrozen = freeze_row(args)
                 slot = ms.get(afrozen)
                 if slot is None:
-                    slot = [args, 0]
+                    # stamp = (engine time, batch position): the arrival
+                    # order earliest/latest reducers rank by (reference:
+                    # EarliestReducer orders by processing time)
+                    slot = [args, 0, (time, i)]
                     ms[afrozen] = slot
                 slot[1] += d
                 if slot[1] == 0:
@@ -832,7 +835,7 @@ class GroupByNode(GroupDiffNode):
                 values.append(spec[2](states[i]))
             else:
                 if entries is None:
-                    entries = [(slot[0], slot[1]) for slot in ms.values()]
+                    entries = [tuple(slot) for slot in ms.values()]
                 values.append(spec[1](entries, i))
         return [(out_key, gvals + tuple(values), 1)]
 

@@ -216,6 +216,8 @@ _PY_TYPE_MAP: dict[Any, DType] = {
     type(None): NONE,
     datetime.datetime: DATE_TIME_NAIVE,
     datetime.timedelta: DURATION,
+    # pw.DateTimeNaive/DateTimeUtc/Duration (pandas-extending classes,
+    # internals/datetime_types.py) resolve via wrap()'s subclass checks
     np.ndarray: ANY_ARRAY,
     dict: JSON,
     Any: ANY,
@@ -253,6 +255,23 @@ def wrap(input_type: Any) -> DType:
 
     if isinstance(input_type, type) and issubclass(input_type, PointerCls):
         return POINTER
+    if isinstance(input_type, type):
+        # user-facing datetime classes (internals/datetime_types.py):
+        # pw.DateTimeNaive / pw.DateTimeUtc / pw.Duration annotations
+        from pathway_tpu.internals import datetime_types as _dtt
+
+        if issubclass(input_type, _dtt.Duration):
+            return DURATION
+        if issubclass(input_type, _dtt.DateTimeUtc):
+            return DATE_TIME_UTC
+        if issubclass(input_type, _dtt.DateTimeNaive):
+            return DATE_TIME_NAIVE
+        import pandas as _pd
+
+        if issubclass(input_type, _pd.Timedelta):
+            return DURATION
+        if issubclass(input_type, _pd.Timestamp):
+            return DATE_TIME_NAIVE
     return ANY
 
 

@@ -3,10 +3,13 @@ src/engine/reduce.rs ``enum Reducer``).
 
 Each DSL reducer lowers to an engine function evaluated over a group's
 multiset of argument combos.  The engine contract (GroupByNode): entries is a
-list of ``(combo_tuple, count)`` where ``combo_tuple[slot]`` is this reducer's
-argument tuple ``(*args, order_token, row_key)`` — the order token (the
-groupby ``sort_by`` value when given, else the row key) drives ordering
-reducers (tuple/earliest/latest/any), the row key backs argmin/argmax.
+list of ``(combo_tuple, count[, stamp])`` where ``combo_tuple[slot]`` is this
+reducer's argument tuple ``(*args, order_token, row_key)`` — the order token
+(the groupby ``sort_by`` value when given, else the row key) drives the tuple
+reducer's ordering, the row key backs argmin/argmax, and ``stamp`` (the
+engine ``(time, batch position)`` at multiset-entry creation) drives
+earliest/latest, which rank by PROCESSING TIME like the reference
+(EarliestReducer, reduce.rs:594) and ignore ``sort_by``.
 Semigroup reducers (sum/count) could use running state; the rediff strategy
 recomputes per touched group, which is exact and fast enough until the C++
 core lands.
@@ -26,8 +29,8 @@ from pathway_tpu.internals.expression import ColumnExpression, ReducerExpression
 
 def _entries(ms, slot: int):
     items = ms.items() if hasattr(ms, "items") else ms
-    for combo, count in items:
-        yield combo[slot], count
+    for entry in items:  # (combo, count[, stamp])
+        yield entry[0][slot], entry[1]
 
 
 class Reducer:
@@ -241,7 +244,13 @@ def _sorted_tuple_factory(skip_nones: bool = False, **kw):
             if skip_nones and v is None:
                 continue
             vals.extend([v] * count)
-        return builtins.tuple(builtins.sorted(vals))
+        # None sorts FIRST (reference: Value::None is the smallest Value,
+        # value.rs:208; pinned by test_common.py test_tuple_reducer)
+        return builtins.tuple(
+            builtins.sorted(
+                vals, key=lambda v: (0, 0) if v is None else (1, v)
+            )
+        )
 
     return fn
 
@@ -269,16 +278,32 @@ def _ndarray_factory(skip_nones: bool = False, **kw):
     return fn
 
 
+def _stamped_entries(ms, slot: int):
+    """(spec_combo, count, stamp) triples — stamp is the engine (time,
+    batch position) recorded when the multiset entry was created."""
+    items = ms.items() if hasattr(ms, "items") else ms
+    for entry in items:
+        combo, count = entry[0], entry[1]
+        stamp = entry[2] if len(entry) > 2 else (0, 0)
+        yield combo[slot], count, stamp
+
+
 def _earliest_factory(**kw):
+    # reference: EarliestReducer (reduce.rs:594) — the value with the
+    # LOWEST processing time; row key breaks same-batch ties
     def fn(ms, slot):
-        return builtins.min(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))[0][0]
+        return builtins.min(
+            _stamped_entries(ms, slot), key=lambda e: (e[2], e[0][-1])
+        )[0][0]
 
     return fn
 
 
 def _latest_factory(**kw):
     def fn(ms, slot):
-        return builtins.max(_entries(ms, slot), key=lambda e: (e[0][-2], e[0][-1]))[0][0]
+        return builtins.max(
+            _stamped_entries(ms, slot), key=lambda e: (e[2], e[0][-1])
+        )[0][0]
 
     return fn
 

@@ -27,6 +27,11 @@ def _require_kafka():
 
 
 class _KafkaSubject(ConnectorSubject):
+    # multi-process runs: every rank consumes, each owning the topic
+    # partitions that hash to it (reference: per-worker partitioned
+    # consumption, data_storage.rs:692)
+    _distributed_partitioned = True
+
     def __init__(self, rdkafka_settings, topics, *, format="json",
                  schema=None, message_parser=None):
         super().__init__()
@@ -43,10 +48,55 @@ class _KafkaSubject(ConnectorSubject):
     # must be committed regularly — on idle polls and every N messages
     _COMMIT_EVERY = 1000
 
+    def _owned_partitions(self, ck, consumer):
+        """Partition p of topic t belongs to rank p % processes —
+        deterministic, no rebalance coordination. Topics that do not
+        exist yet (metadata error / empty partition set) resolve on a
+        later refresh, matching subscribe()'s metadata-refresh pickup."""
+        from pathway_tpu.internals.config import get_pathway_config
+
+        c = get_pathway_config()
+        owned = []
+        for topic in self.topics:
+            meta = consumer.list_topics(topic, timeout=10)
+            entry = meta.topics.get(topic)
+            if entry is None or entry.error is not None:
+                continue
+            for p in entry.partitions:
+                if p % c.processes == c.process_id:
+                    owned.append(ck.TopicPartition(topic, p))
+        return owned
+
+    def _subscribe(self, ck, consumer) -> None:
+        from pathway_tpu.internals.config import get_pathway_config
+
+        if get_pathway_config().processes <= 1:
+            consumer.subscribe(self.topics)
+            self._manual_assign = False
+            return
+        self._manual_assign = True
+        self._assigned = self._owned_partitions(ck, consumer)
+        consumer.assign(self._assigned)
+
+    def _maybe_reassign(self, ck, consumer) -> None:
+        """Pick up late-created topics and added partitions (refreshed on
+        idle polls; subscribe() consumers get this from rebalances)."""
+        if not self._manual_assign:
+            return
+        owned = self._owned_partitions(ck, consumer)
+        current = {(tp.topic, tp.partition) for tp in self._assigned}
+        fresh = {(tp.topic, tp.partition) for tp in owned}
+        if fresh != current:
+            self._assigned = owned
+            consumer.assign(owned)
+
+    _REASSIGN_EVERY_IDLE = 60  # idle polls (~30 s) between metadata checks
+
     def run(self):
         ck = _require_kafka()
         consumer = ck.Consumer(self.settings)
-        consumer.subscribe(self.topics)
+        self._subscribe(ck, consumer)
+        idle = 0
         since_commit = 0
         try:
             while not self._stop:
@@ -55,7 +105,12 @@ class _KafkaSubject(ConnectorSubject):
                     if since_commit:
                         self.commit()
                         since_commit = 0
+                    idle += 1
+                    if idle >= self._REASSIGN_EVERY_IDLE:
+                        idle = 0
+                        self._maybe_reassign(ck, consumer)
                     continue
+                idle = 0
                 raw = msg.value()
                 self._offsets[(msg.topic(), msg.partition())] = msg.offset()
                 if self.message_parser is not None:

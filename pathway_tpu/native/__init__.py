@@ -21,7 +21,9 @@ import numpy as np
 
 _REPO_NATIVE = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "native")
-_BUILD_DIR = os.path.join(
+# override point for instrumented builds (scripts/sanitize_native.sh
+# compiles the extensions with ASAN/TSAN into a scratch dir)
+_BUILD_DIR = os.environ.get("PATHWAY_NATIVE_BUILD_DIR") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_build"
 )
 _LOCK = threading.Lock()

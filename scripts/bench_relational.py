@@ -136,9 +136,35 @@ def main(n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000) -> No
         flush=True,
     )
     bench_join()
+    # thread-scaling datapoint: same wordcount with PATHWAY_THREADS=4 in a
+    # fresh process (the executor shard count is fixed at store creation).
+    # On the single-core CI sandbox this shows parity; on the multi-core
+    # bench host it shows the shard-thread speedup.
+    if os.environ.get("PATHWAY_THREADS", "1") == "1" and (os.cpu_count() or 1) > 1:
+        import subprocess
+        import sys as _sys
+
+        env = dict(os.environ, PATHWAY_THREADS="4", JAX_PLATFORMS="cpu")
+        rc = subprocess.run(
+            [
+                _sys.executable, os.path.abspath(__file__),
+                str(n_rows), str(distinct), str(batch),
+            ],
+            env=env,
+            timeout=600,
+        ).returncode
+        if rc != 0:
+            print(
+                json.dumps(
+                    {"metric": "wordcount_rows_per_s", "threads": 4,
+                     "error": f"child exited {rc}"}
+                ),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     d = int(sys.argv[2]) if len(sys.argv) > 2 else 5_000
-    main(n, d)
+    b = int(sys.argv[3]) if len(sys.argv) > 3 else 2_000
+    main(n, d, b)

@@ -1,0 +1,54 @@
+#!/bin/bash
+# Sanitizer lane for the native runtime (reference: SURVEY §5 — the
+# reference CI runs its Rust core under miri/sanitizer-class checks; the
+# C/C++ here gets the ASAN/UBSAN + TSAN equivalents).
+#
+#   ./scripts/sanitize_native.sh          # ASAN+UBSAN over the native tests
+#   ./scripts/sanitize_native.sh tsan     # TSAN over the threaded executor
+#
+# The extensions are rebuilt with the chosen sanitizer into a scratch
+# build dir, injected via PATHWAY_NATIVE_BUILD_DIR, and the native test
+# batteries run with the runtime library preloaded. Leak checking is off:
+# CPython interns/arenas are not leaks.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-asan}"
+PYINC=$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+EXT=$(python -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
+BUILD="/tmp/pathway_native_${MODE}"
+mkdir -p "$BUILD"
+
+if [ "$MODE" = "tsan" ]; then
+    SAN="-fsanitize=thread"
+    RUNTIME=$(gcc -print-file-name=libtsan.so)
+    export TSAN_OPTIONS="report_bugs=1 halt_on_error=1"
+else
+    SAN="-fsanitize=address,undefined -fno-sanitize-recover=undefined"
+    RUNTIME=$(gcc -print-file-name=libasan.so)
+    export ASAN_OPTIONS="detect_leaks=0 abort_on_error=1"
+    export UBSAN_OPTIONS="halt_on_error=1"
+fi
+
+echo "== building native extensions with $MODE =="
+g++ -O1 -g -std=c++17 -shared -fPIC -pthread $SAN \
+    -I"$PYINC" -o "$BUILD/pwexec$EXT" native/exec.cpp
+gcc -O1 -g -shared -fPIC $SAN \
+    -I"$PYINC" -o "$BUILD/fastpath$EXT" native/fastpath.c
+g++ -O1 -g -std=c++17 -shared -fPIC $SAN \
+    -o "$BUILD/libpathway_native.so" native/bm25.cpp native/hnsw.cpp
+touch "$BUILD/build.stamp"
+
+echo "== running native batteries under $MODE =="
+# PATHWAY_THREADS=4 exercises the GIL-released shard threads (the TSAN
+# target); the batteries cover groupby/join/minmax incl. fallbacks
+LD_PRELOAD="$RUNTIME" \
+PATHWAY_NATIVE_BUILD_DIR="$BUILD" \
+PATHWAY_THREADS=4 \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_native_groupby.py tests/test_native_join.py \
+    tests/test_native_minmax.py tests/test_native.py \
+    tests/test_consistency_fuzz.py tests/test_native_stress.py -x -q
+
+echo "== $MODE lane clean =="

@@ -1,0 +1,71 @@
+"""Large-batch stress: drives the native executors' ACTUAL thread pool
+(the GIL-released shard threads only spawn for batches >= 2048 rows), so
+the TSAN lane (scripts/sanitize_native.sh tsan) exercises real
+concurrency and the plain suite pins thread-count invariance."""
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _big_pipeline(threads, monkeypatch, n=6000, groups=64):
+    from pathway_tpu.internals import config as C
+
+    monkeypatch.setattr(C.pathway_config, "threads", threads)
+    pw.internals.parse_graph.G.clear()
+    rng = random.Random(42)
+
+    class L(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: int
+        v: int
+
+    class R(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: int
+        w: int
+
+    class LS(pw.io.python.ConnectorSubject):
+        def run(self):
+            # one huge commit -> the executor takes the threaded path
+            for i in range(n):
+                self.next(k=i, g=(i * 2654435761) % groups, v=i % 97)
+            self.commit()
+            # retract a slice in a second large commit
+            for i in range(0, n, 3):
+                self.remove(k=i, g=(i * 2654435761) % groups, v=i % 97)
+            self.commit()
+
+    class RS(pw.io.python.ConnectorSubject):
+        def run(self):
+            for j in range(groups * 40):
+                self.next(k=j, g=j % groups, w=j)
+            self.commit()
+
+    lt = pw.io.python.read(LS(), schema=L, autocommit_duration_ms=None)
+    rt = pw.io.python.read(RS(), schema=R, autocommit_duration_ms=None)
+    agg = lt.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        c=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+    )
+    joined = agg.join(rt, pw.left.g == pw.right.g).select(
+        g=pw.left.g, s=pw.left.s, w=pw.right.w
+    )
+    tot = joined.reduce(
+        n=pw.reducers.count(), sw=pw.reducers.sum(pw.this.w),
+        ss=pw.reducers.sum(pw.this.s),
+    )
+    cap = GraphRunner().run_tables(tot)[0]
+    return sorted(tuple(r) for r in cap.state.rows.values())
+
+
+def test_threaded_executors_match_single_thread(monkeypatch):
+    one = _big_pipeline(1, monkeypatch)
+    four = _big_pipeline(4, monkeypatch)
+    assert one == four and one[0][0] > 0

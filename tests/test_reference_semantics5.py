@@ -1,0 +1,302 @@
+"""Fifth reference-semantics battery: reducer breadth (tuple families,
+earliest/latest, unique/any under retraction), asof_now one-shot joins,
+numeric/datetime edge semantics, and global-reduce lifecycle — behaviors
+the reference pins in python/pathway/tests/test_reducers.py,
+test_asof_now_join.py and test_expressions.py."""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    cap = GraphRunner().run_tables(table)[0]
+    return sorted(
+        (tuple(r) for r in cap.state.rows.values()), key=repr
+    )
+
+
+def _md(txt, schema=None):
+    return pw.debug.table_from_markdown(txt, schema=schema)
+
+
+# ------------------------------------------------------------- reducers
+
+
+def test_tuple_reducer_families():
+    t = _md(
+        """
+        g | v
+        0 | 3
+        0 | 1
+        0 | 2
+        1 | 9
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        st=pw.reducers.sorted_tuple(pw.this.v),
+        nd=pw.reducers.ndarray(pw.this.v),
+    )
+    rows = {row[0]: row[1:] for row in _rows(r)}
+    assert rows[0][0] == (1, 2, 3)
+    assert sorted(rows[0][1].tolist()) == [1, 2, 3]
+    assert rows[1][0] == (9,)
+
+
+def test_tuple_reducer_skip_nones():
+    t = _md(
+        """
+        g | v
+        0 | 3
+        0 |
+        0 | 1
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(
+        with_none=pw.reducers.sorted_tuple(pw.this.v),
+        without=pw.reducers.sorted_tuple(pw.this.v, skip_nones=True),
+    )
+    ((with_none, without),) = _rows(r)
+    assert without == (1, 3)
+    # None sorts first (reference: test_common.py test_tuple_reducer pins
+    # sorted_tuple without skip_nones as (None, -1, 1))
+    assert with_none == (None, 1, 3)
+
+
+def test_earliest_latest_follow_processing_order():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v="first")
+            self.commit()
+            self.next(k=2, v="second")
+            self.commit()
+            self.next(k=3, v="third")
+            self.commit()
+
+    t = pw.io.python.read(Sub(), schema=S, autocommit_duration_ms=None)
+    r = t.reduce(
+        e=pw.reducers.earliest(pw.this.v), l=pw.reducers.latest(pw.this.v)
+    )
+    cap = GraphRunner().run_tables(r)[0]
+    ((e, l),) = [tuple(row) for row in cap.state.rows.values()]
+    # earliest/latest order by engine timestamp of arrival
+    assert e == "first" and l == "third"
+
+
+def test_unique_reducer_allows_duplicates_of_same_value():
+    t = _md(
+        """
+        g | v
+        0 | 7
+        0 | 7
+        1 | 5
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(g=pw.this.g, u=pw.reducers.unique(pw.this.v))
+    assert _rows(r) == [(0, 7), (1, 5)]
+
+
+def test_any_reducer_returns_some_member():
+    t = _md(
+        """
+        g | v
+        0 | 7
+        0 | 9
+        """
+    )
+    r = t.groupby(pw.this.g).reduce(a=pw.reducers.any(pw.this.v))
+    ((a,),) = _rows(r)
+    assert a in (7, 9)
+
+
+def test_global_reduce_empties_to_no_rows():
+    """Retracting every input row must retract the global aggregate row
+    (reference: reduce over an emptied table yields an empty table)."""
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    class Sub(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v=5)
+            self.next(k=2, v=6)
+            self.commit()
+            self.remove(k=1, v=5)
+            self.remove(k=2, v=6)
+            self.commit()
+
+    t = pw.io.python.read(Sub(), schema=S, autocommit_duration_ms=None)
+    r = t.reduce(s=pw.reducers.sum(pw.this.v), c=pw.reducers.count())
+    cap = GraphRunner().run_tables(r)[0]
+    assert list(cap.state.rows.values()) == []
+
+
+def test_min_max_on_bools_and_mixed_int_float():
+    t = _md(
+        """
+        g | b | x
+        0 | True | 1
+        0 | False | 2
+        """,
+        schema=pw.schema_from_types(g=int, b=bool, x=int),
+    )
+    r = t.groupby(pw.this.g).reduce(
+        mn=pw.reducers.min(pw.this.b), mx=pw.reducers.max(pw.this.b)
+    )
+    ((mn, mx),) = _rows(r)
+    assert mn == False and mx == True  # noqa: E712 — bool ordering
+
+
+# ----------------------------------------------------------- asof_now
+
+
+def test_asof_now_join_answers_are_frozen():
+    """A left row is answered against the right state AT ARRIVAL and the
+    answer never revises when the right side later changes (reference:
+    _asof_now_join semantics)."""
+
+    class L(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        j: int
+
+    class R(pw.Schema):
+        j: int = pw.column_definition(primary_key=True)
+        w: str
+
+    events = []
+
+    class LSub(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time
+
+            time.sleep(0.3)  # right side loads first
+            self.next(k=1, j=1)
+            self.commit()
+            time.sleep(0.4)  # right side then CHANGES
+            self.next(k=2, j=1)
+            self.commit()
+
+    class RSub(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time
+
+            self.next(j=1, w="old")
+            self.commit()
+            time.sleep(0.5)
+            self.remove(j=1, w="old")
+            self.next(j=1, w="new")
+            self.commit()
+
+    lt = pw.io.python.read(LSub(), schema=L, autocommit_duration_ms=None)
+    rt = pw.io.python.read(RSub(), schema=R, autocommit_duration_ms=None)
+    j = lt.asof_now_join(rt, pw.left.j == pw.right.j).select(
+        k=pw.left.k, w=pw.right.w
+    )
+    pw.io.subscribe(
+        j, on_change=lambda key, row, t_, d: events.append(
+            (row["k"], row["w"], 1 if d else -1)
+        )
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    net = {}
+    for k, w, d in events:
+        net[(k, w)] = net.get((k, w), 0) + d
+    live = sorted(kw for kw, c in net.items() if c > 0)
+    # k=1 keeps its frozen "old" answer; k=2 sees the updated state
+    assert live == [(1, "old"), (2, "new")], (live, events)
+
+
+# ------------------------------------------------- numeric / datetime
+
+
+def test_integer_division_and_modulo_semantics():
+    t = _md(
+        """
+        a | b
+        7 | 2
+        -7 | 2
+        """
+    )
+    r = t.select(
+        fdiv=pw.this.a // pw.this.b,
+        tdiv=pw.this.a / pw.this.b,
+        mod=pw.this.a % pw.this.b,
+    )
+    rows = _rows(r)
+    assert (-4, -3.5, 1) in rows  # Python floor semantics on negatives
+    assert (3, 3.5, 1) in rows
+
+
+def test_datetime_arithmetic_and_duration():
+    fmt = "%Y-%m-%d %H:%M:%S"
+    t = _md(
+        """
+        a | b
+        2026-01-02 03:04:05 | 2026-01-01 00:00:00
+        """,
+        schema=pw.schema_from_types(a=str, b=str),
+    )
+    r = t.select(
+        a=pw.this.a.dt.strptime(fmt),
+        b=pw.this.b.dt.strptime(fmt),
+    ).select(
+        delta_hours=(pw.this.a - pw.this.b).dt.hours(),
+        shifted=pw.this.b + pw.Duration(days=1),
+    )
+    ((hours, shifted),) = _rows(r)
+    assert hours == 27
+    assert shifted == datetime.datetime(2026, 1, 2)
+
+
+def test_string_edges():
+    t = _md(
+        """
+        s
+        hello_world
+        """
+    )
+    r = t.select(
+        up=pw.this.s.str.upper(),
+        found=pw.this.s.str.find("world"),
+        missing=pw.this.s.str.find("zzz"),
+        sliced=pw.this.s.str.slice(0, 5),
+        replaced=pw.this.s.str.replace("_", " "),
+    )
+    assert _rows(r) == [("HELLO_WORLD", 6, -1, "hello", "hello world")]
+
+
+def test_optional_none_propagation_in_arithmetic():
+    t = _md(
+        """
+        a | b
+        1 | 2
+        3 |
+        """,
+        schema=pw.schema_from_types(
+            a=int, b=pw.internals.dtype.Optional(int)
+        ),
+    )
+    r = t.select(s=pw.this.a + pw.fill_error(pw.coalesce(pw.this.b, 0), 0))
+    assert sorted(_rows(r)) == [(3,), (3,)]
+
+
+def test_pointer_column_roundtrip_and_ix():
+    t = _md(
+        """
+        k | v
+        1 | a
+        2 | b
+        """
+    )
+    withptr = t.select(pw.this.v, ptr=pw.this.id)
+    looked = withptr.select(orig=t.ix(withptr.ptr).v)
+    assert sorted(_rows(looked)) == [("a",), ("b",)]

@@ -455,12 +455,20 @@ def test_nats_write_and_read_roundtrip():
         def feed():
             from pathway_tpu.io._nats import NatsConnection
 
-            time.sleep(0.5)  # let the reader subscribe
+            # wait for the reader's SUB to land (fixed sleeps flake on
+            # loaded single-core CI)
+            deadline = time.monotonic() + 15
+            while not server.subs and time.monotonic() < deadline:
+                time.sleep(0.05)
             pub = NatsConnection(uri)
             pub.publish("updates", json.dumps({"w": "x", "n": 1}).encode())
             pub.publish("updates", json.dumps({"w": "y", "n": 2}).encode())
             pub.close()
-            time.sleep(0.7)  # let the reader drain, then end the stream
+            # wait for the pipeline to observe both rows, then end stream
+            deadline = time.monotonic() + 15
+            while len(got) < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.2)  # let the commit flush settle
             server.close()
 
         threading.Thread(target=feed, daemon=True).start()
