@@ -1,0 +1,24 @@
+"""jax version-compat shims shared by the parallel kernels."""
+
+from __future__ import annotations
+
+
+def compat_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: import location moved (experimental
+    -> top level) and the replication-check kwarg was renamed
+    (check_rep -> check_vma); callers here always disable it (outputs
+    like merged top-k are intentionally unreplicated)."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )

@@ -22,13 +22,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
-
 from pathway_tpu.ops.knn import Metric, _next_pow2
 from pathway_tpu.ops.topk import chunked_topk_scores
+from pathway_tpu.parallel._compat import compat_shard_map
 
 
 def sharded_topk(
@@ -77,18 +73,10 @@ def sharded_topk(
         return best_v, best_i
 
     # all_gather makes the outputs replicated, but the vma checker can't see
-    # that through lax.top_k — disable the check (kwarg name differs across
-    # jax versions)
-    try:
-        smapped = shard_map(
-            local, mesh=mesh, in_specs=tuple(in_specs),
-            out_specs=(P(), P()), check_vma=False,
-        )
-    except TypeError:
-        smapped = shard_map(
-            local, mesh=mesh, in_specs=tuple(in_specs),
-            out_specs=(P(), P()), check_rep=False,
-        )
+    # that through lax.top_k — the shared compat shim disables the check
+    smapped = compat_shard_map(
+        local, mesh, in_specs=tuple(in_specs), out_specs=(P(), P())
+    )
     return smapped(queries, database, valid, *((sq_norms,) if use_sq else ()))
 
 

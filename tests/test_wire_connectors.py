@@ -425,6 +425,10 @@ def test_nats_write_and_read_roundtrip():
         t = pw.debug.table_from_markdown("w | n\nfoo | 1\nbar | 2")
         pw.io.nats.write(t, uri, "updates", format="json")
         pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        # publish is fire-and-forget: wait for the server thread to parse
+        deadline = time.monotonic() + 15
+        while len(server.published) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
         assert len(server.published) == 2
         subjects = {s for s, _, _ in server.published}
         assert subjects == {"updates"}
