@@ -1,5 +1,5 @@
 """pathway_tpu.xpacks — extension packs (reference: python/pathway/xpacks)."""
 
-from pathway_tpu.xpacks import llm
+from pathway_tpu.xpacks import connectors, llm
 
-__all__ = ["llm"]
+__all__ = ["connectors", "llm"]

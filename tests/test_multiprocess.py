@@ -200,6 +200,86 @@ def test_multiprocess_join_groupby(tmp_path):
     assert multi == single and multi, (multi, single)
 
 
+STREAMING_PIPELINE = """
+import time
+import pathway_tpu as pw
+from pathway_tpu.internals.config import get_pathway_config
+
+class S(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    g: int
+    v: int
+
+class RankSubject(pw.io.python.ConnectorSubject):
+    # partition-aware: every rank emits ITS slice of the key space with
+    # live commits, including cross-commit retractions
+    _distributed_partitioned = True
+
+    def run(self):
+        c = get_pathway_config()
+        base = c.process_id * 1000
+        for i in range(8):
+            self.next(k=base + i, g=i % 3, v=10 * c.process_id + i)
+            self.commit()
+            time.sleep(0.02)
+        # retract half of what this rank emitted, in later rounds
+        for i in range(0, 8, 2):
+            self.remove(k=base + i, g=i % 3, v=10 * c.process_id + i)
+            self.commit()
+            time.sleep(0.02)
+
+t = pw.io.python.read(RankSubject(), schema=S, autocommit_duration_ms=None)
+agg = t.groupby(pw.this.g).reduce(
+    g=pw.this.g, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v),
+    mn=pw.reducers.min(pw.this.v),
+)
+pw.io.jsonlines.write(agg, "out_{suffix}.jsonl")
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+"""
+
+
+def _net_rows(path):
+    """Fold the written update stream into its final net state."""
+    net = {}
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            diff = d.pop("diff")
+            d.pop("time")
+            key = tuple(sorted(d.items()))
+            net[key] = net.get(key, 0) + diff
+    return sorted(k for k, c in net.items() if c > 0)
+
+
+def test_multiprocess_live_streaming_with_retractions(tmp_path):
+    """Live commits arrive across BSP rounds on every rank (not just a
+    static scan), with retractions spanning rounds — the lockstep
+    exchange must keep groupby state exact."""
+    prog = tmp_path / "prog_stream.py"
+    prog.write_text(STREAMING_PIPELINE.format(suffix="multi"))
+    _spawn(str(prog), str(tmp_path), 3, timeout=180)
+
+    # the oracle is the deterministic FINAL state, computed directly:
+    # ranks r in {0,1,2}, i in {1,3,5,7} survive
+    expected = {}
+    for r in range(3):
+        for i in range(1, 8, 2):
+            g = i % 3
+            c, s, mn = expected.get(g, (0, 0, None))
+            v = 10 * r + i
+            expected[g] = (c + 1, s + v, v if mn is None else min(mn, v))
+    exp_rows = sorted(
+        (("c", c), ("g", g), ("mn", mn), ("s", s))
+        for g, (c, s, mn) in expected.items()
+    )
+    got = _net_rows(tmp_path / "out_multi.jsonl")
+    assert got == exp_rows, (got, exp_rows)
+
+
 def test_cli_spawn_multiprocess(tmp_path):
     """`pathway spawn -n 2` launches the rank fleet (reference: cli.py
     spawn --processes)."""

@@ -1,0 +1,257 @@
+"""SharePoint connector (xpacks/connectors/sharepoint): certificate
+client-credential auth + SharePoint REST, against mock services.
+
+The mock Azure AD endpoint VERIFIES the RS256 client assertion with the
+test keypair's public key (signature, x5t thumbprint, audience), so the
+JWT construction is pinned — not just the happy path."""
+
+import base64
+import datetime
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+@pytest.fixture(scope="module")
+def keypair(tmp_path_factory):
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    subject = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "pathway-test")]
+    )
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(subject)
+        .issuer_name(subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .sign(key, hashes.SHA256())
+    )
+    pem_path = tmp_path_factory.mktemp("certs") / "app.pem"
+    with open(pem_path, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    thumbprint = cert.fingerprint(hashes.SHA1()).hex()
+    return str(pem_path), thumbprint, key.public_key()
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class _MockSite(BaseHTTPRequestHandler):
+    tree: dict = {}       # folder path -> {"files": [...], "folders": [...]}
+    blobs: dict = {}      # file path -> bytes
+    pubkey = None
+    thumbprint = ""
+    tokens_issued: list = []
+    auth_failures: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, payload: bytes, code=200):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self):  # Azure AD token endpoint
+        from urllib.parse import parse_qs
+
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        n = int(self.headers.get("Content-Length", "0"))
+        form = parse_qs(self.rfile.read(n).decode())
+        assertion = form["client_assertion"][0]
+        head_b64, claims_b64, sig_b64 = assertion.split(".")
+        header = json.loads(_b64url_decode(head_b64))
+        try:
+            self.pubkey.verify(
+                _b64url_decode(sig_b64),
+                f"{head_b64}.{claims_b64}".encode(),
+                padding.PKCS1v15(),
+                hashes.SHA256(),
+            )
+        except Exception:
+            self.auth_failures.append("bad-signature")
+            self._send(b'{"error":"invalid_client"}', 401)
+            return
+        if _b64url_decode(header["x5t"]).hex() != self.thumbprint:
+            self.auth_failures.append("bad-thumbprint")
+            self._send(b'{"error":"invalid_client"}', 401)
+            return
+        token = f"tok-{len(self.tokens_issued)}"
+        self.tokens_issued.append(token)
+        self._send(
+            json.dumps(
+                {"access_token": token, "expires_in": 3600}
+            ).encode()
+        )
+
+    def do_GET(self):  # SharePoint REST
+        from urllib.parse import unquote
+
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("tok-", len("Bearer ")):
+            self._send(b"unauthorized", 401)
+            return
+        path = unquote(self.path)
+        if "GetFolderByServerRelativeUrl" in path:
+            folder = path.split("('", 1)[1].split("')", 1)[0]
+            entry = self.tree.get(folder)
+            if entry is None:
+                self._send(b"{}", 404)
+                return
+            payload = {
+                "d": {
+                    "Files": {"results": entry["files"]},
+                    "Folders": {
+                        "results": [
+                            {"ServerRelativeUrl": f, "Name": f.rsplit("/", 1)[-1]}
+                            for f in entry["folders"]
+                        ]
+                    },
+                }
+            }
+            self._send(json.dumps(payload).encode())
+            return
+        if "GetFileByServerRelativeUrl" in path:
+            fpath = path.split("('", 1)[1].split("')", 1)[0]
+            blob = self.blobs.get(fpath)
+            if blob is None:
+                self._send(b"missing", 404)
+                return
+            self._send(blob)
+            return
+        self._send(b"{}", 404)
+
+
+def test_sharepoint_read_recursive_with_cert_auth(keypair):
+    pem_path, thumbprint, pubkey = keypair
+    handler = type(
+        "H",
+        (_MockSite,),
+        {
+            "pubkey": pubkey,
+            "thumbprint": thumbprint,
+            "tokens_issued": [],
+            "auth_failures": [],
+            "tree": {
+                "/sites/Test/Docs": {
+                    "files": [
+                        {
+                            "ServerRelativeUrl": "/sites/Test/Docs/a.txt",
+                            "Name": "a.txt",
+                            "Length": "5",
+                            "TimeLastModified": "2026-01-01T00:00:00Z",
+                        }
+                    ],
+                    "folders": ["/sites/Test/Docs/sub"],
+                },
+                "/sites/Test/Docs/sub": {
+                    "files": [
+                        {
+                            "ServerRelativeUrl": "/sites/Test/Docs/sub/b.bin",
+                            "Name": "b.bin",
+                            "Length": "4",
+                            "TimeLastModified": "2026-01-02T00:00:00Z",
+                        }
+                    ],
+                    "folders": [],
+                },
+            },
+            "blobs": {
+                "/sites/Test/Docs/a.txt": b"alpha",
+                "/sites/Test/Docs/sub/b.bin": b"beta",
+            },
+        },
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        t = pw.xpacks.connectors.sharepoint.read(
+            base,
+            tenant="tenant-guid",
+            client_id="app-guid",
+            cert_path=pem_path,
+            thumbprint=thumbprint,
+            root_path="/sites/Test/Docs",
+            mode="static",
+            with_metadata=True,
+            _authority=base,
+        )
+        cap = GraphRunner().run_tables(t)[0]
+        rows = sorted(
+            (bytes(r[0]), r[1].value["name"])
+            for r in cap.state.rows.values()
+        )
+        assert rows == [(b"alpha", "a.txt"), (b"beta", "b.bin")]
+        assert handler.tokens_issued and not handler.auth_failures
+    finally:
+        server.shutdown()
+
+
+def test_sharepoint_rejects_wrong_key(keypair, tmp_path):
+    """An assertion signed by a DIFFERENT key must be refused by the
+    (verifying) token endpoint and surface as an auth error."""
+    pem_path, thumbprint, pubkey = keypair
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    other_pem = tmp_path / "other.pem"
+    other_pem.write_bytes(
+        other.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+    )
+    handler = type(
+        "H",
+        (_MockSite,),
+        {
+            "pubkey": pubkey,
+            "thumbprint": thumbprint,
+            "tokens_issued": [],
+            "auth_failures": [],
+            "tree": {},
+            "blobs": {},
+        },
+    )
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        from pathway_tpu.xpacks.connectors.sharepoint import _SharePointClient
+
+        client = _SharePointClient(
+            base, "tenant", "app", str(other_pem), thumbprint,
+            authority=base,
+        )
+        with pytest.raises(Exception):
+            client.list_folder("/sites/Test/Docs")
+        assert handler.auth_failures == ["bad-signature"]
+    finally:
+        server.shutdown()
