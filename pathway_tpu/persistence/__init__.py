@@ -216,6 +216,41 @@ class _GcsClient:
             pass  # already gone
 
 
+class _S3PersistClient:
+    """io/_s3.S3Client adapter for _ObjectStoreBackend.
+
+    Only a definitive 404 maps to "absent": transient transport errors
+    MUST propagate — treating them as missing journals would resume from
+    an empty/truncated journal and replay inputs past the last durable
+    commit (breaking exactly-once), and a swallowed failed delete would
+    leave stale .part objects corrupting the next read's concatenation.
+    """
+
+    def __init__(self, client):
+        self._client = client
+
+    def upload(self, path: str, data: bytes) -> None:
+        self._client.put_object(path, data)
+
+    def download(self, path: str) -> bytes | None:
+        import urllib.error
+
+        try:
+            return self._client.get_object(path)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def list(self, prefix: str) -> list[str]:
+        return [o.key for o in self._client.list_objects(prefix)]
+
+    def delete(self, path: str) -> None:
+        # S3Client.delete_object already treats 404 as success and
+        # re-raises anything else
+        self._client.delete_object(path)
+
+
 class _MemoryBackend(_BackendBase):
     def __init__(self):
         self.data: dict[str, bytes] = {}
@@ -265,12 +300,18 @@ class Backend:
         return cls(_ObjectStoreBackend(client, root_path))
 
     @classmethod
-    def s3(cls, root_path: str, bucket_settings=None) -> "Backend":
-        raise NotImplementedError(
-            "S3 persistence backend requires boto3 (absent in this image); "
-            "use Backend.gcs() or Backend.object_store() with an "
-            "S3-compatible client"
-        )
+    def s3(cls, root_path: str, bucket_settings=None, *, _opener=None) -> "Backend":
+        """S3/MinIO persistence backend (reference:
+        persistence/backends/s3.rs:47) over the dependency-free SigV4
+        client (io/_s3.py). ``bucket_settings`` is an AwsS3Settings;
+        ``root_path`` may be ``s3://bucket/prefix`` or a bare prefix."""
+        from pathway_tpu.io._s3 import AwsS3Settings, S3Client
+        from pathway_tpu.io.s3 import _split_path
+
+        bucket, prefix = _split_path(root_path)
+        settings = (bucket_settings or AwsS3Settings()).with_bucket(bucket)
+        client = _S3PersistClient(S3Client(settings, opener=_opener))
+        return cls(_ObjectStoreBackend(client, prefix))
 
 
 @dataclass
