@@ -1,0 +1,131 @@
+"""Self-contained local stand-ins for the etl-lakehouse demo: a minimal
+S3-compatible HTTP bucket and a capturing PostgreSQL server — so the
+template runs offline when copied out of the repo. Point app.py at real
+services in production; these exist only for the demo run."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    store: dict[str, bytes] = {}
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        from urllib.parse import unquote
+
+        parts = unquote(self.path.split("?")[0]).lstrip("/").split("/", 1)
+        return parts[1] if len(parts) > 1 else ""
+
+    def do_GET(self):
+        if "list-type=2" in self.path:
+            from urllib.parse import parse_qs, urlsplit
+
+            prefix = parse_qs(urlsplit(self.path).query).get("prefix", [""])[0]
+            items = "".join(
+                f"<Contents><Key>{k}</Key><ETag>\"{hash(v) & 0xffffffff:x}\"</ETag>"
+                f"<Size>{len(v)}</Size>"
+                f"<LastModified>2026-01-01T00:00:{i:02d}Z</LastModified>"
+                f"</Contents>"
+                for i, (k, v) in enumerate(sorted(self.store.items()))
+                if k.startswith(prefix)
+            )
+            body = (
+                '<?xml version="1.0"?><ListBucketResult>'
+                f"<IsTruncated>false</IsTruncated>{items}</ListBucketResult>"
+            ).encode()
+        elif self._key() in self.store:
+            body = self.store[self._key()]
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        self.store[self._key()] = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+def start_s3() -> tuple[str, dict]:
+    """-> (endpoint url, backing store dict)"""
+    handler = type("H", (_S3Handler,), {"store": {}})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{server.server_port}", handler.store
+
+
+class CapturingPg:
+    """Accepts the v3 wire protocol (trust auth) and records SQL."""
+
+    def __init__(self):
+        self.queries: list[str] = []
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        buf = b""
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise EOFError
+                buf += chunk
+            out, buf2 = buf[:n], buf[n:]
+            buf = buf2
+            return out
+
+        def send(kind, payload=b""):
+            conn.sendall(kind + struct.pack("!i", len(payload) + 4) + payload)
+
+        try:
+            (length,) = struct.unpack("!i", read_exact(4))
+            read_exact(length - 4)
+            send(b"R", struct.pack("!i", 0))  # trust: AuthenticationOk
+            send(b"Z", b"I")
+            while True:
+                kind = read_exact(1)
+                (mlen,) = struct.unpack("!i", read_exact(4))
+                payload = read_exact(mlen - 4)
+                if kind == b"X":
+                    return
+                if kind == b"Q":
+                    self.queries.append(payload.rstrip(b"\x00").decode())
+                    send(b"C", b"INSERT 0 1\x00")
+                    send(b"Z", b"I")
+        except (EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

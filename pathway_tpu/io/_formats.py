@@ -120,6 +120,16 @@ def _sql_literal(v: Any) -> str:
     return "'" + str(v).replace("'", "''") + "'"
 
 
+def _sql_ident(name: str) -> str:
+    """Double-quote an identifier (each dotted part) so reserved words
+    (user, order, ...) and mixed-case names survive a real PostgreSQL
+    parser — the mock-free failure mode is an upsert keyed on the
+    SESSION user instead of the column."""
+    return ".".join(
+        '"' + part.replace('"', '""') + '"' for part in name.split(".")
+    )
+
+
 class PsqlUpdatesFormatter:
     """reference: data_format.rs:1632 — INSERT per delta carrying time and
     diff columns; consumers reconstruct the update stream."""
@@ -129,11 +139,16 @@ class PsqlUpdatesFormatter:
         self.value_fields = list(value_fields)
 
     def format(self, key, values, time, diff) -> FormatterContext:
-        cols = ",".join([*self.value_fields, "time", "diff"])
+        cols = ",".join(
+            _sql_ident(c) for c in [*self.value_fields, "time", "diff"]
+        )
         vals = ",".join(
             [_sql_literal(v) for v in values] + [str(time), str(diff)]
         )
-        stmt = f"INSERT INTO {self.table_name} ({cols}) VALUES ({vals});\n"
+        stmt = (
+            f"INSERT INTO {_sql_ident(self.table_name)} ({cols}) "
+            f"VALUES ({vals});\n"
+        )
         return FormatterContext([stmt.encode()], key, time, diff)
 
 
@@ -160,28 +175,29 @@ class PsqlSnapshotFormatter:
         by_name = dict(zip(self.value_fields, values))
         if diff < 0:
             cond = " AND ".join(
-                f"{f}={_sql_literal(by_name[f])}"
+                f"{_sql_ident(f)}={_sql_literal(by_name[f])}"
                 for f in self.primary_key_fields
             )
-            stmt = f"DELETE FROM {self.table_name} WHERE {cond};\n"
+            stmt = f"DELETE FROM {_sql_ident(self.table_name)} WHERE {cond};\n"
         else:
-            cols = ",".join(self.value_fields)
+            cols = ",".join(_sql_ident(c) for c in self.value_fields)
             vals = ",".join(_sql_literal(v) for v in values)
-            pk = ",".join(self.primary_key_fields)
+            pk = ",".join(_sql_ident(f) for f in self.primary_key_fields)
             non_pk = [
                 f for f in self.value_fields
                 if f not in self.primary_key_fields
             ]
             if non_pk:
                 update = ",".join(
-                    f"{f}={_sql_literal(by_name[f])}" for f in non_pk
+                    f"{_sql_ident(f)}={_sql_literal(by_name[f])}"
+                    for f in non_pk
                 )
                 conflict = f"DO UPDATE SET {update}"
             else:
                 conflict = "DO NOTHING"
             stmt = (
-                f"INSERT INTO {self.table_name} ({cols}) VALUES ({vals}) "
-                f"ON CONFLICT ({pk}) {conflict};\n"
+                f"INSERT INTO {_sql_ident(self.table_name)} ({cols}) "
+                f"VALUES ({vals}) ON CONFLICT ({pk}) {conflict};\n"
             )
         return FormatterContext([stmt.encode()], key, time, diff)
 

@@ -49,18 +49,21 @@ def test_single_column_formatter_bytes_passthrough():
 def test_psql_updates_formatter():
     f = PsqlUpdatesFormatter("t", ["a", "b"])
     [stmt] = f.format(KEY, (1, "o'brien"), 6, 1).payloads
-    assert stmt == b"INSERT INTO t (a,b,time,diff) VALUES (1,'o''brien',6,1);\n"
+    assert stmt == (
+        b'INSERT INTO "t" ("a","b","time","diff") '
+        b"VALUES (1,'o''brien',6,1);\n"
+    )
 
 
 def test_psql_snapshot_formatter_upsert_and_delete():
     f = PsqlSnapshotFormatter("t", ["a"], ["a", "b"])
     [up] = f.format(KEY, (1, "x"), 6, 1).payloads
     assert up == (
-        b"INSERT INTO t (a,b) VALUES (1,'x') "
-        b"ON CONFLICT (a) DO UPDATE SET b='x';\n"
+        b'INSERT INTO "t" ("a","b") VALUES (1,\'x\') '
+        b'ON CONFLICT ("a") DO UPDATE SET "b"=\'x\';\n'
     )
     [de] = f.format(KEY, (1, "x"), 8, -1).payloads
-    assert de == b"DELETE FROM t WHERE a=1;\n"
+    assert de == b'DELETE FROM "t" WHERE "a"=1;\n'
     with pytest.raises(ValueError, match="primary key"):
         PsqlSnapshotFormatter("t", ["missing"], ["a"])
 

@@ -88,3 +88,27 @@ def test_adaptive_rag_template(tmp_path):
         {"prompt": "pathway tpu streaming dataflow framework"},
     )
     assert out["response"] is not None
+
+
+def test_etl_lakehouse_template():
+    """examples/etl-lakehouse: object store -> incremental aggregates ->
+    Delta Lake + Postgres snapshot, against its self-contained local
+    stand-ins (the template must run when copied out of the repo)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO_ROOT, "examples", "etl-lakehouse", "app.py"),
+        ],
+        capture_output=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=_REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    out = r.stdout.decode()
+    assert "ann | 130 | 2 | 120" in out
+    # reserved-word identifiers arrive QUOTED (real-Postgres safe)
+    assert 'ON CONFLICT ("user") DO UPDATE' in out

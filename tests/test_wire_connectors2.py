@@ -115,8 +115,8 @@ def test_postgres_write_updates():
         assert server.auth == [(b"p", "pw")]
         sql = "".join(server.queries)
         assert sql.startswith("BEGIN;")
-        assert sql.count("INSERT INTO target") == 2
-        assert "(w,n,time,diff)" in sql
+        assert sql.count('INSERT INTO "target"') == 2
+        assert '("w","n","time","diff")' in sql
         assert "'foo'" in sql and "'bar'" in sql
         assert sql.rstrip().endswith("COMMIT;")
     finally:
@@ -154,9 +154,9 @@ def test_postgres_write_snapshot_upserts_and_deletes():
         )
         pw.run(monitoring_level=pw.MonitoringLevel.NONE)
         sql = "".join(server.queries)
-        assert "ON CONFLICT (k) DO UPDATE SET n=1" in sql
-        assert "DELETE FROM snap WHERE k='a'" in sql
-        assert "ON CONFLICT (k) DO UPDATE SET n=5" in sql
+        assert 'ON CONFLICT ("k") DO UPDATE SET "n"=1' in sql
+        assert 'DELETE FROM "snap" WHERE "k"=\'a\'' in sql
+        assert 'ON CONFLICT ("k") DO UPDATE SET "n"=5' in sql
     finally:
         server.close()
 
