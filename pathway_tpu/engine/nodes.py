@@ -18,6 +18,7 @@ dataflow/operators/*.rs) on a batch-at-a-timestamp execution model:
 from __future__ import annotations
 
 import itertools
+import operator
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
@@ -1181,6 +1182,9 @@ class ForgetImmediatelyNode(Node):
         return consolidate(out)
 
 
+_out_order = operator.itemgetter(2, 0)
+
+
 class OutputNode(Node):
     """Terminal node delivering batches to a callback (reference:
     Graph::output_table / subscribe_table, graph.rs:569 SubscribeCallbacks)."""
@@ -1209,7 +1213,10 @@ class OutputNode(Node):
             if self._on_batch is not None:
                 self._on_batch(time, deltas)
             if self._on_change is not None:
-                for k, row, d in sorted(deltas, key=lambda t: (t[2], t[0])):
+                # retractions before insertions, key-ordered (deterministic
+                # callback order); C-level key beats a lambda on the
+                # subscriber hot path
+                for k, row, d in sorted(deltas, key=_out_order):
                     self._on_change(k, row, time, d)
         return []
 
