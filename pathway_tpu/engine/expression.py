@@ -235,10 +235,33 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
         return eval_tuple
 
     if isinstance(e, expr.GetExpression):
+        from pathway_tpu.internals.api import _NAV_MISSING, json_navigate
+        from pathway_tpu.internals import dtype as _dt
+
         of = compile_expression(e._object, resolver, runtime)
         idxf = compile_expression(e._index, resolver, runtime)
         df = compile_expression(e._default, resolver, runtime)
         checked = e._check_if_exists
+        # a None OBJECT continues as null only along JSON navigation
+        # chains (j["absent"]["deep"]); for tuple/list columns a None
+        # object still poisons to ERROR like any bad unchecked access.
+        # Chains are detected structurally too: desugaring rebuilds trees
+        # with construction-time dtypes, so a get-over-get built through
+        # pw.this still types as ANY even when the column is JSON.
+        obj_t = e._object._dtype
+        json_chain = (
+            obj_t is _dt.JSON
+            or (
+                isinstance(obj_t, _dt._OptionalDType)
+                and obj_t._wrapped is _dt.JSON
+            )
+            or (
+                isinstance(e._object, expr.GetExpression)
+                and not isinstance(
+                    obj_t, (_dt._TupleDType, _dt._ListDType)
+                )
+            )
+        )
 
         def eval_get(keys, rows):
             objs = of(keys, rows)
@@ -249,12 +272,21 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
                 if o is ERROR or i is ERROR:
                     out.append(ERROR)
                     continue
-                try:
-                    if isinstance(o, Json):
-                        v = o.value[i]
-                        out.append(Json(v) if isinstance(v, (dict, list)) else v)
+                if o is None and json_chain:
+                    out.append(d if checked else None)
+                    continue
+                if isinstance(o, Json):
+                    # total navigation (reference: test_json.py —
+                    # missing/out-of-range/negative -> null, never
+                    # Error); single source of truth: api.json_navigate
+                    v = json_navigate(o.value, i)
+                    if v is _NAV_MISSING:
+                        out.append(d if checked else None)
                     else:
-                        out.append(o[i])
+                        out.append(Json(v) if isinstance(v, (dict, list)) else v)
+                    continue
+                try:
+                    out.append(o[i])
                 except (KeyError, IndexError, TypeError):
                     out.append(d if checked else ERROR)
             return out

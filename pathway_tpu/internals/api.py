@@ -117,6 +117,29 @@ def sequential_pointer() -> Pointer:
     return Pointer(_unsafe_counter[0])
 
 
+_NAV_MISSING = object()
+
+
+def json_navigate(value: Any, index: Any):
+    """TOTAL JSON navigation (reference: test_json.py pins — missing
+    keys, out-of-range AND negative indices, and non-container values
+    all yield null, never an error; no Python-style wraparound).
+    Returns the raw inner value or _NAV_MISSING. The single source of
+    truth for both expression-level ``j[i]``/``.get`` (engine
+    eval_get) and ``Json`` object accessors."""
+    if isinstance(index, bool):
+        return _NAV_MISSING
+    if isinstance(value, dict):
+        if isinstance(index, (str, int)):
+            return value.get(index, _NAV_MISSING)
+        return _NAV_MISSING
+    if isinstance(value, list):
+        if isinstance(index, int) and 0 <= index < len(value):
+            return value[index]
+        return _NAV_MISSING
+    return _NAV_MISSING
+
+
 class Json:
     """JSON value wrapper (reference: Value::Json)."""
 
@@ -129,13 +152,14 @@ class Json:
 
     # -- navigation ------------------------------------------------------
     def __getitem__(self, key):
-        return Json(self.value[key])
+        v = json_navigate(self.value, key)
+        return Json(None if v is _NAV_MISSING else v)
 
     def get(self, key, default=None):
-        if isinstance(self.value, dict):
-            out = self.value.get(key, default)
-            return Json(out) if not isinstance(out, Json) else out
-        return Json(default)
+        out = json_navigate(self.value, key)
+        if out is _NAV_MISSING:
+            out = default
+        return Json(out) if not isinstance(out, Json) else out
 
     def as_int(self) -> int:
         return int(self.value)

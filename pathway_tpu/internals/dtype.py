@@ -251,10 +251,12 @@ def wrap(input_type: Any) -> DType:
         if len(args) == 2 and args[1] is Ellipsis:
             return List(wrap(args[0]))
         return Tuple(*(wrap(a) for a in args))
-    from pathway_tpu.internals.api import Pointer as PointerCls
+    from pathway_tpu.internals.api import Json as JsonCls, Pointer as PointerCls
 
     if isinstance(input_type, type) and issubclass(input_type, PointerCls):
         return POINTER
+    if isinstance(input_type, type) and issubclass(input_type, JsonCls):
+        return JSON
     if isinstance(input_type, type):
         # user-facing datetime classes (internals/datetime_types.py):
         # pw.DateTimeNaive / pw.DateTimeUtc / pw.Duration annotations

@@ -1,0 +1,152 @@
+"""Sixth reference-semantics battery: window joins, sliding-window
+behaviors under streaming, Json edge navigation, unwrap/require
+expression helpers, concat_reindex under streaming upserts."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    cap = GraphRunner().run_tables(table)[0]
+    return sorted((tuple(r) for r in cap.state.rows.values()), key=repr)
+
+
+def test_window_join_inner_tumbling():
+    lt = pw.debug.table_from_markdown(
+        """
+        t | a
+        1 | x
+        6 | y
+        """
+    )
+    rt = pw.debug.table_from_markdown(
+        """
+        t | b
+        2 | p
+        3 | q
+        11 | r
+        """
+    )
+    j = pw.temporal.window_join(
+        lt, rt, lt.t, rt.t, pw.temporal.tumbling(5)
+    ).select(a=pw.left.a, b=pw.right.b)
+    # window [0,5): x pairs with p and q; [5,10): y alone -> dropped;
+    # [10,15): r alone -> dropped
+    assert _rows(j) == [("x", "p"), ("x", "q")]
+
+
+def test_window_join_left_pads():
+    lt = pw.debug.table_from_markdown("t | a\n1 | x\n6 | y")
+    rt = pw.debug.table_from_markdown("t | b\n2 | p")
+    j = pw.temporal.window_join(
+        lt, rt, lt.t, rt.t, pw.temporal.tumbling(5), how="left"
+    ).select(a=pw.left.a, b=pw.right.b)
+    assert _rows(j) == [("x", "p"), ("y", None)]
+
+
+def test_json_edges():
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(j=pw.Json),
+        [
+            (1, pw.Json({"a": {"b": [10, 20, 30]}, "n": None})),
+        ],
+    )
+    r = t.select(
+        deep=pw.this.j["a"]["b"][1].as_int(),
+        # reference pins NO negative wraparound: [-1] is out of bounds
+        # (test_json_get_array_index_out_of_bounds)
+        neg=pw.this.j["a"]["b"][-1].as_int(),
+        missing=pw.this.j["zzz"]["deep"].as_int(),
+        null_field=pw.this.j["n"].as_int(),
+        dflt=pw.this.j.get("zzz", pw.Json(7)).as_int(),
+    )
+    assert _rows(r) == [(20, None, None, None, 7)]
+
+
+def test_unwrap_and_require():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 2
+        3 |
+        """,
+        schema=pw.schema_from_types(
+            a=int, b=pw.internals.dtype.Optional(int)
+        ),
+    )
+    ok = t.filter(pw.this.b.is_not_none()).select(v=pw.unwrap(pw.this.b))
+    assert _rows(ok) == [(2,)]
+    # require: None in any argument poisons the result to None
+    r = t.select(v=pw.require(pw.this.a + 1, pw.this.b))
+    assert _rows(r) == [(2,), (None,)]
+
+
+def test_concat_reindex_streaming_upserts():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    class A(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v="a1")
+            self.commit()
+            self.remove(k=1, v="a1")
+            self.next(k=1, v="a2")
+            self.commit()
+
+    class B(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, v="b1")  # same key as stream A on purpose
+            self.commit()
+
+    ta = pw.io.python.read(A(), schema=S, autocommit_duration_ms=None)
+    tb = pw.io.python.read(B(), schema=S, autocommit_duration_ms=None)
+    both = ta.concat_reindex(tb)
+    cap = GraphRunner().run_tables(both)[0]
+    vals = sorted(r[1] for r in cap.state.rows.values())
+    assert vals == ["a2", "b1"]
+
+
+def test_sliding_window_count_stream():
+    class S(pw.Schema):
+        t: int
+        v: int
+
+    class Sub(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for tt in [1, 2, 6, 7, 12]:
+                self.next(t=tt, v=1)
+            self.commit()
+
+    src = pw.io.python.read(Sub(), schema=S, autocommit_duration_ms=None)
+    w = src.windowby(
+        pw.this.t, window=pw.temporal.sliding(duration=10, hop=5)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    cap = GraphRunner().run_tables(w)[0]
+    got = sorted(tuple(r) for r in cap.state.rows.values())
+    # windows: [-5,5): t=1,2 -> 2; [0,10): 1,2,6,7 -> 4; [5,15): 6,7,12 -> 3;
+    # [10,20): 12 -> 1
+    assert got == [(-5, 2), (0, 4), (5, 3), (10, 1)]
+
+
+def test_groupby_instance_join_shapes():
+    t = pw.debug.table_from_markdown(
+        """
+        g | i | v
+        a | 0 | 1
+        a | 0 | 2
+        a | 1 | 3
+        b | 0 | 4
+        """
+    )
+    r = t.groupby(pw.this.g, instance=pw.this.i).reduce(
+        g=pw.this.g, i=pw.this.i, s=pw.reducers.sum(pw.this.v)
+    )
+    assert _rows(r) == [("a", 0, 3), ("a", 1, 3), ("b", 0, 4)]
