@@ -542,6 +542,28 @@ PyObject *process_batch(PyObject *, PyObject *args)
     Py_ssize_t n = PyList_Size(gvals_list);
     if (n < 0)
         return nullptr;
+    /* Validate list shapes up front: phase 1 indexes keys/diffs/valcols
+     * with unchecked PyList_GET_ITEM, so a drifting Python caller must be
+     * rejected here rather than read out of bounds in C. */
+    if (!PyList_Check(keys_list) || PyList_Size(keys_list) != n ||
+        !PyList_Check(diffs) || PyList_Size(diffs) != n ||
+        !PyTuple_Check(valcols) ||
+        PyTuple_Size(valcols) != (Py_ssize_t)n_specs) {
+        PyErr_SetString(PyExc_TypeError,
+                        "process_batch: keys/diffs must be lists of the "
+                        "gvals length and valcols a tuple of one column "
+                        "per spec");
+        return nullptr;
+    }
+    for (size_t sidx = 0; sidx < n_specs; sidx++) {
+        PyObject *col = PyTuple_GET_ITEM(valcols, (Py_ssize_t)sidx);
+        if (col != Py_None &&
+            (!PyList_Check(col) || PyList_Size(col) != n)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "process_batch: value column length mismatch");
+            return nullptr;
+        }
+    }
 
     /* phase 1: extract (GIL held) — no state is mutated, so Fallback here
      * leaves the store untouched and the Python path can replay the batch */

@@ -186,6 +186,20 @@ class MongoConnection:
         reply = bson_decode(payload, 5)
         if not reply.get("ok"):
             raise RuntimeError(f"mongodb command failed: {reply}")
+        # MongoDB reports per-document rejections (schema validation,
+        # duplicate key, oversize doc) alongside ok:1 — treating those as
+        # success silently drops rows from the sink.
+        if reply.get("writeErrors"):
+            raise RuntimeError(
+                "mongodb bulk write failed for "
+                f"{len(reply['writeErrors'])} document(s): "
+                f"{reply['writeErrors']}"
+            )
+        if reply.get("writeConcernError"):
+            raise RuntimeError(
+                "mongodb write concern not satisfied: "
+                f"{reply['writeConcernError']}"
+            )
         return reply
 
     def insert_many(self, database: str, collection: str, docs: list[dict]):

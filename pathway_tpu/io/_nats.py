@@ -100,6 +100,7 @@ class NatsConnection:
         Once a MSG/HMSG header arrived, payload reads switch to a long
         deadline and a timeout mid-frame is a hard protocol error (the
         stream would be desynced if we returned)."""
+        base_timeout = self.sock.gettimeout()
         try:
             while True:
                 if timeout is not None:
@@ -144,8 +145,11 @@ class NatsConnection:
                     f"unexpected NATS frame: {line[:80]!r}"
                 )
         finally:
-            if timeout is not None:
-                self.sock.settimeout(None)
+            # Restore the pre-call timeout unconditionally: both the poll
+            # timeout and the mid-frame settimeout(30.0) would otherwise
+            # leak into later publish/flush calls (and settimeout(None)
+            # would leave a hung broker stalling the pipeline forever).
+            self.sock.settimeout(base_timeout)
 
     def flush(self) -> None:
         self._send(b"PING\r\n")

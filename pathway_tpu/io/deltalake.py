@@ -187,7 +187,10 @@ def write(
             ]
             state["version"] = (max(existing) + 1) if existing else 0
             if state["version"] == 0:
-                _write_version(0, _bootstrap_actions())
+                try:
+                    _write_version(0, _bootstrap_actions())
+                except FileExistsError:
+                    pass  # a concurrent writer bootstrapped the table
                 state["version"] = 1
         v = state["version"]
         state["version"] += 1
@@ -223,12 +226,30 @@ def write(
         ]
 
     def _write_version(v: int, actions: list[dict]) -> None:
+        # The Delta protocol requires mutually-exclusive version creation:
+        # two writers must never both claim version N. os.link from a
+        # private tmp file is atomic-exclusive (raises FileExistsError if
+        # a concurrent writer — a second pipeline or an external delta-rs
+        # client — committed N first), unlike os.replace which would
+        # silently clobber the other commit's log entry.
         path = os.path.join(_log_dir(uri), f"{v:020d}.json")
-        tmp = path + ".tmp"
+        tmp = path + f".tmp-{uuid.uuid4().hex}"
         with open(tmp, "w") as f:
             for a in actions:
                 f.write(_json.dumps(a) + "\n")
-        os.replace(tmp, path)
+        try:
+            os.link(tmp, path)
+        finally:
+            os.unlink(tmp)
+
+    def _commit(actions: list[dict]) -> None:
+        while True:
+            v = _next_version()
+            try:
+                _write_version(v, actions)
+                return
+            except FileExistsError:
+                state["version"] = None  # lost the race: re-list and retry
 
     def _flush(force: bool = False):
         if not state["buf"]:
@@ -255,9 +276,7 @@ def write(
         os.makedirs(uri, exist_ok=True)
         path = os.path.join(uri, part)
         pq.write_table(pa.table(arrays), path)
-        v = _next_version()
-        _write_version(
-            v,
+        _commit(
             [
                 {
                     "add": {

@@ -25,8 +25,13 @@ Framing: length-prefixed pickle. The mesh links trusted peer processes
 of one pipeline (localhost by default, PATHWAY_HOSTS for multi-host);
 it is not an external protocol surface: the listener binds 127.0.0.1
 unless PATHWAY_HOSTS names remote hosts, and every connection must
-complete an HMAC handshake (blake2b over PATHWAY_MESH_SECRET) before
-any frame is unpickled — an unauthenticated peer is disconnected.
+complete a mutual challenge-response handshake (keyed blake2b over
+fresh nonces, keyed by PATHWAY_MESH_SECRET) before any frame is
+unpickled — an unauthenticated peer is disconnected, and a recorded
+handshake cannot be replayed. Binding a non-loopback interface without
+an explicitly configured PATHWAY_MESH_SECRET is refused outright:
+frames are pickle, so mesh access is code execution, and a default
+key on an open port would hand that to any network peer.
 """
 
 from __future__ import annotations
@@ -103,6 +108,15 @@ class ProcessGroup:
         loopback_only = all(
             h in ("127.0.0.1", "localhost", "::1") for h in hosts
         )
+        if not loopback_only and not os.environ.get("PATHWAY_MESH_SECRET"):
+            self._listener.close()
+            raise RuntimeError(
+                "PATHWAY_HOSTS names non-loopback hosts but "
+                "PATHWAY_MESH_SECRET is not set. Mesh frames are pickled "
+                "objects, so the listener will not bind a routable "
+                "interface under the built-in default key: set a shared "
+                "PATHWAY_MESH_SECRET on every rank."
+            )
         self._listener.bind(
             ("127.0.0.1" if loopback_only else "0.0.0.0", first_port + rank)
         )
@@ -110,16 +124,27 @@ class ProcessGroup:
         self._connect_mesh(first_port, timeout)
 
     @staticmethod
-    def _auth_token(rank: int) -> bytes:
-        """Per-rank handshake token: blake2b keyed by PATHWAY_MESH_SECRET.
-        Frames are pickle, so no un-authenticated byte may reach
-        pickle.loads — the token gates the connection before any frame is
-        read."""
+    def _mac(role: bytes, nonces: bytes, prover: int, verifier: int) -> bytes:
+        """Keyed MAC for one direction of the handshake. Binds BOTH fresh
+        nonces plus both rank ids (so a transcript cannot be replayed into
+        another session or reflected back at its sender) under
+        PATHWAY_MESH_SECRET. Frames are pickle, so no un-authenticated byte
+        may reach pickle.loads — both directions must verify before any
+        frame is read. The connecting side proves knowledge of the secret
+        FIRST: the listener never emits keyed output to an unauthenticated
+        peer (no MAC oracle). The residual exposure is the initiator's MAC
+        to a host-impersonating listener, which is inherent to 2-party PSK
+        schemes; on untrusted network paths pair the secret with a secure
+        transport."""
         import hashlib
 
         secret = os.environ.get("PATHWAY_MESH_SECRET", "").encode()
         return hashlib.blake2b(
-            rank.to_bytes(8, "little"), key=secret or b"pathway-mesh",
+            role
+            + nonces
+            + prover.to_bytes(8, "little")
+            + verifier.to_bytes(8, "little"),
+            key=secret or b"pathway-mesh",
             digest_size=16,
         ).digest()
 
@@ -129,21 +154,32 @@ class ProcessGroup:
         expected_accepts = self.world - 1 - self.rank
         accepted: dict[int, socket.socket] = {}
 
+        import hmac as _hmac
+
         def acceptor():
             while len(accepted) < expected_accepts:
                 s, _addr = self._listener.accept()
                 try:
+                    s.settimeout(10)
                     peer = int(_LEN.unpack(_recv_exact(s, _LEN.size))[0])
-                    token = _recv_exact(s, 16)
+                    nonce_c = _recv_exact(s, 16)
+                    if peer <= self.rank or peer >= self.world:
+                        raise EOFError
+                    nonce_s = os.urandom(16)
+                    s.sendall(nonce_s)  # challenge only — no keyed output yet
+                    mac_c = _recv_exact(s, 16)
+                    if not _hmac.compare_digest(
+                        mac_c,
+                        self._mac(b"C", nonce_c + nonce_s, peer, self.rank),
+                    ):
+                        raise EOFError
+                    # peer is authenticated; now prove ourselves back
+                    s.sendall(
+                        self._mac(b"S", nonce_c + nonce_s, self.rank, peer)
+                    )
+                    s.settimeout(None)
                 except (EOFError, OSError):
-                    s.close()
-                    continue
-                if (
-                    peer <= self.rank
-                    or peer >= self.world
-                    or token != self._auth_token(peer)
-                ):
-                    s.close()  # unauthenticated or bogus peer
+                    s.close()  # unauthenticated, stalled, or bogus peer
                     continue
                 accepted[peer] = s
 
@@ -164,7 +200,21 @@ class ProcessGroup:
                             f"rank {self.rank}: cannot reach rank {peer}"
                         )
                     _t.sleep(0.05)
-            s.sendall(_LEN.pack(self.rank) + self._auth_token(self.rank))
+            nonce_c = os.urandom(16)
+            s.settimeout(10)
+            s.sendall(_LEN.pack(self.rank) + nonce_c)
+            nonce_s = _recv_exact(s, 16)
+            s.sendall(self._mac(b"C", nonce_c + nonce_s, self.rank, peer))
+            mac_s = _recv_exact(s, 16)
+            if not _hmac.compare_digest(
+                mac_s, self._mac(b"S", nonce_c + nonce_s, peer, self.rank)
+            ):
+                s.close()
+                raise ConnectionError(
+                    f"rank {self.rank}: rank {peer} failed mesh "
+                    "authentication (PATHWAY_MESH_SECRET mismatch?)"
+                )
+            s.settimeout(None)
             self._socks[peer] = s
         at.join(timeout)
         if len(accepted) != expected_accepts:
