@@ -268,12 +268,32 @@ buf_put_f64_le(Buf *b, double d)
 }
 
 static PyObject *value_to_bytes_py = NULL; /* python fallback */
+static PyObject *pointer_type = NULL;      /* api.Pointer, cached */
 
 static int
-serialize_value(Buf *b, PyObject *v)
+load_pointer_type(void)
 {
-    /* mirrors pathway_tpu.internals.api._value_to_bytes for the scalar
-     * fast paths; composite/exotic values defer to the Python function */
+    if (pointer_type != NULL)
+        return 0;
+    PyObject *mod = PyImport_ImportModule("pathway_tpu.internals.api");
+    if (mod == NULL)
+        return -1;
+    pointer_type = PyObject_GetAttrString(mod, "Pointer");
+    Py_DECREF(mod);
+    return pointer_type == NULL ? -1 : 0;
+}
+
+#define SER_MAX_DEPTH 200
+
+static int
+serialize_value_d(Buf *b, PyObject *v, int depth)
+{
+    /* mirrors pathway_tpu.internals.api._value_to_bytes byte-for-byte for
+     * the scalar fast paths; exotic values defer to the Python function.
+     * Past SER_MAX_DEPTH of tuple nesting the Python fallback takes over
+     * (it raises a clean RecursionError instead of blowing the C stack) */
+    if (depth > SER_MAX_DEPTH)
+        goto python_fallback;
     if (v == Py_None)
         return buf_put(b, "\x00", 1);
     if (PyBool_Check(v)) {
@@ -300,7 +320,85 @@ serialize_value(Buf *b, PyObject *v)
             return -1;
         return buf_put(b, PyBytes_AS_STRING(v), PyBytes_GET_SIZE(v));
     }
-    /* ints (incl. Pointer subclass) and everything else -> python impl */
+    if (PyLong_Check(v)) {
+        /* Pointer: "P" + 16-byte LE; other ints: "I" + minimal signed LE
+         * of (bit_length + 8)//8 + 1 bytes — both matching api.py */
+        if (load_pointer_type() < 0)
+            return -1;
+        if (PyObject_TypeCheck(v, (PyTypeObject *)pointer_type)) {
+            int overflow = 0;
+            unsigned char out[17];
+            out[0] = 'P';
+            /* 128-bit value: low 64 bits via mask, high via shift */
+            PyObject *lo64 = NULL, *hi = NULL;
+            static PyObject *mask64 = NULL, *sh64 = NULL;
+            if (mask64 == NULL) {
+                mask64 = PyLong_FromUnsignedLongLong(0xFFFFFFFFFFFFFFFFULL);
+                sh64 = PyLong_FromLong(64);
+                if (mask64 == NULL || sh64 == NULL)
+                    return -1;
+            }
+            lo64 = PyNumber_And(v, mask64);
+            hi = PyNumber_Rshift(v, sh64);
+            if (lo64 == NULL || hi == NULL) {
+                Py_XDECREF(lo64);
+                Py_XDECREF(hi);
+                return -1;
+            }
+            uint64_t lo = PyLong_AsUnsignedLongLong(lo64);
+            uint64_t hiv = PyLong_AsUnsignedLongLong(hi);
+            Py_DECREF(lo64);
+            Py_DECREF(hi);
+            if (PyErr_Occurred())
+                return -1;
+            for (int i = 0; i < 8; i++)
+                out[1 + i] = (unsigned char)((lo >> (8 * i)) & 0xff);
+            for (int i = 0; i < 8; i++)
+                out[9 + i] = (unsigned char)((hiv >> (8 * i)) & 0xff);
+            (void)overflow;
+            return buf_put(b, out, 17);
+        }
+        int overflow = 0;
+        long long sv = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (!overflow && !(sv == -1 && PyErr_Occurred())) {
+            uint64_t uv = sv < 0 ? (uint64_t)0 - (uint64_t)sv : (uint64_t)sv;
+            int bl = 0;
+            while (bl < 64 && (uv >> bl))
+                bl++;
+            int nbytes = (bl + 8) / 8 + 1;
+            unsigned char out[11];
+            out[0] = 'I';
+            uint64_t tw = (uint64_t)sv; /* two's complement bits */
+            for (int i = 0; i < nbytes; i++)
+                out[1 + i] = (unsigned char)(
+                    i < 8 ? (tw >> (8 * i)) & 0xff : (sv < 0 ? 0xff : 0x00));
+            return buf_put(b, out, 1 + nbytes);
+        }
+        PyErr_Clear(); /* >64-bit plain int: python fallback below */
+    } else if (PyTuple_Check(v)) {
+        /* "T" + length-prefixed concat of the parts, recursively */
+        Py_ssize_t n = PyTuple_GET_SIZE(v);
+        if (buf_put(b, "T", 1) < 0 || buf_put_u32(b, (uint32_t)n) < 0)
+            return -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            Py_ssize_t mark = b->len;
+            if (buf_put_u32(b, 0) < 0)
+                return -1;
+            if (serialize_value_d(b, PyTuple_GET_ITEM(v, i), depth + 1) < 0)
+                return -1;
+            uint32_t plen = (uint32_t)(b->len - mark - 4);
+            unsigned char le[4] = {
+                (unsigned char)(plen & 0xff),
+                (unsigned char)((plen >> 8) & 0xff),
+                (unsigned char)((plen >> 16) & 0xff),
+                (unsigned char)((plen >> 24) & 0xff),
+            };
+            memcpy(b->buf + mark, le, 4);
+        }
+        return 0;
+    }
+    /* everything else -> python impl */
+python_fallback:
     if (value_to_bytes_py == NULL) {
         PyObject *mod = PyImport_ImportModule("pathway_tpu.internals.api");
         if (mod == NULL)
@@ -316,6 +414,12 @@ serialize_value(Buf *b, PyObject *v)
     int rc = buf_put(b, PyBytes_AS_STRING(bytes), PyBytes_GET_SIZE(bytes));
     Py_DECREF(bytes);
     return rc;
+}
+
+static int
+serialize_value(Buf *b, PyObject *v)
+{
+    return serialize_value_d(b, v, 0);
 }
 
 static PyObject *
@@ -349,7 +453,621 @@ fail:
     return NULL;
 }
 
-/* -- integer int path for serialize (avoid python fallback for ints) ---- */
+/* -- blake2b (RFC 7693), unkeyed, for 16-byte key digests ---------------
+ * Compact sequential implementation — must produce digests identical to
+ * hashlib.blake2b(data, digest_size=16) so natively minted Pointers equal
+ * the Python path's (persistence + multi-process determinism). */
+
+static const uint64_t b2b_iv[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
+};
+
+static const uint8_t b2b_sigma[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+};
+
+#define B2B_ROTR(x, n) (((x) >> (n)) | ((x) << (64 - (n))))
+
+#define B2B_G(a, b, c, d, x, y)            \
+    do {                                   \
+        v[a] = v[a] + v[b] + (x);          \
+        v[d] = B2B_ROTR(v[d] ^ v[a], 32);  \
+        v[c] = v[c] + v[d];                \
+        v[b] = B2B_ROTR(v[b] ^ v[c], 24);  \
+        v[a] = v[a] + v[b] + (y);          \
+        v[d] = B2B_ROTR(v[d] ^ v[a], 16);  \
+        v[c] = v[c] + v[d];                \
+        v[b] = B2B_ROTR(v[b] ^ v[c], 63);  \
+    } while (0)
+
+static void
+b2b_compress(uint64_t h[8], const unsigned char block[128], uint64_t t,
+             int last)
+{
+    uint64_t v[16], m[16];
+    for (int i = 0; i < 16; i++) {
+        uint64_t w = 0;
+        for (int j = 7; j >= 0; j--)
+            w = (w << 8) | block[i * 8 + j];
+        m[i] = w;
+    }
+    for (int i = 0; i < 8; i++)
+        v[i] = h[i];
+    for (int i = 0; i < 8; i++)
+        v[8 + i] = b2b_iv[i];
+    v[12] ^= t; /* low word of the offset counter; high word stays 0 for
+                 * inputs < 2^64 bytes */
+    if (last)
+        v[14] = ~v[14];
+    for (int r = 0; r < 12; r++) {
+        const uint8_t *s = b2b_sigma[r];
+        B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+        B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+        B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+        B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+        B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+        B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+        B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+        B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+    }
+    for (int i = 0; i < 8; i++)
+        h[i] ^= v[i] ^ v[8 + i];
+}
+
+/* digest16(out, data, n): blake2b-128 of data, no key */
+static void
+b2b_digest16(unsigned char out[16], const unsigned char *data, size_t n)
+{
+    uint64_t h[8];
+    for (int i = 0; i < 8; i++)
+        h[i] = b2b_iv[i];
+    h[0] ^= 0x01010000ULL ^ 16ULL; /* param block: digest_len=16, fanout=1,
+                                    * depth=1 */
+    size_t off = 0;
+    while (n - off > 128) {
+        b2b_compress(h, data + off, (uint64_t)(off + 128), 0);
+        off += 128;
+    }
+    unsigned char last[128];
+    size_t rem = n - off; /* 0..128; empty input -> one zero block */
+    memset(last, 0, sizeof(last));
+    if (rem > 0)
+        memcpy(last, data + off, rem);
+    b2b_compress(h, last, (uint64_t)n, 1);
+    for (int i = 0; i < 16; i++)
+        out[i] = (unsigned char)((h[i / 8] >> (8 * (i % 8))) & 0xff);
+}
+
+static PyObject *
+one_long(void)
+{
+    static PyObject *one = NULL;
+    if (one == NULL)
+        one = PyLong_FromLong(1);
+    return one;
+}
+
+/* -- batch-plane helpers -------------------------------------------------
+ * One C call per delta batch instead of a Python loop per delta: these are
+ * the per-row list/tuple plumbing of every relational node (split deltas
+ * into columns, project row columns, re-zip computed rows, filter by mask,
+ * parse connector upserts, deliver sorted output callbacks). The reference
+ * keeps the same loops inside Rust operators (dataflow.rs); here they are
+ * the C substrate under engine/nodes.py. */
+
+/* split_deltas(deltas) -> (keys, rows, diffs) */
+static PyObject *
+fast_split_deltas(PyObject *self, PyObject *arg)
+{
+    PyObject *seq = PySequence_Fast(arg, "split_deltas expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *keys = PyList_New(n);
+    PyObject *rows = PyList_New(n);
+    PyObject *diffs = PyList_New(n);
+    if (keys == NULL || rows == NULL || diffs == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3) {
+            PyErr_SetString(PyExc_TypeError, "delta must be (key, row, diff)");
+            goto fail;
+        }
+        PyObject *k = PyTuple_GET_ITEM(d, 0);
+        PyObject *r = PyTuple_GET_ITEM(d, 1);
+        PyObject *df = PyTuple_GET_ITEM(d, 2);
+        Py_INCREF(k);
+        PyList_SET_ITEM(keys, i, k);
+        Py_INCREF(r);
+        PyList_SET_ITEM(rows, i, r);
+        Py_INCREF(df);
+        PyList_SET_ITEM(diffs, i, df);
+    }
+    Py_DECREF(seq);
+    PyObject *out = PyTuple_Pack(3, keys, rows, diffs);
+    Py_DECREF(keys);
+    Py_DECREF(rows);
+    Py_DECREF(diffs);
+    return out;
+fail:
+    Py_XDECREF(keys);
+    Py_XDECREF(rows);
+    Py_XDECREF(diffs);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* project_col(rows, j) -> [row[j] for row in rows] */
+static PyObject *
+fast_project_col(PyObject *self, PyObject *args)
+{
+    PyObject *rows;
+    Py_ssize_t j;
+    if (!PyArg_ParseTuple(args, "On", &rows, &j))
+        return NULL;
+    PyObject *seq = PySequence_Fast(rows, "project_col expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(r) || j < 0 || j >= PyTuple_GET_SIZE(r)) {
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_IndexError,
+                            "project_col: row is not a tuple of that width");
+            return NULL;
+        }
+        PyObject *v = PyTuple_GET_ITEM(r, j);
+        Py_INCREF(v);
+        PyList_SET_ITEM(out, i, v);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+/* project_tuples(rows, idx_tuple) -> [tuple(row[j] for j in idx) ...] */
+static PyObject *
+fast_project_tuples(PyObject *self, PyObject *args)
+{
+    PyObject *rows, *idx;
+    if (!PyArg_ParseTuple(args, "OO!", &rows, &PyTuple_Type, &idx))
+        return NULL;
+    Py_ssize_t m = PyTuple_GET_SIZE(idx);
+    Py_ssize_t js[32];
+    if (m > 32) {
+        PyErr_SetString(PyExc_ValueError, "project_tuples: too many columns");
+        return NULL;
+    }
+    for (Py_ssize_t t = 0; t < m; t++) {
+        js[t] = PyLong_AsSsize_t(PyTuple_GET_ITEM(idx, t));
+        if (js[t] == -1 && PyErr_Occurred())
+            return NULL;
+    }
+    PyObject *seq = PySequence_Fast(rows, "project_tuples expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(r)) {
+            PyErr_SetString(PyExc_TypeError, "project_tuples: row not tuple");
+            goto fail;
+        }
+        Py_ssize_t w = PyTuple_GET_SIZE(r);
+        PyObject *tup = PyTuple_New(m);
+        if (tup == NULL)
+            goto fail;
+        for (Py_ssize_t t = 0; t < m; t++) {
+            if (js[t] < 0 || js[t] >= w) {
+                Py_DECREF(tup);
+                PyErr_SetString(PyExc_IndexError, "project_tuples: bad index");
+                goto fail;
+            }
+            PyObject *v = PyTuple_GET_ITEM(r, js[t]);
+            Py_INCREF(v);
+            PyTuple_SET_ITEM(tup, t, v);
+        }
+        PyList_SET_ITEM(out, i, tup);
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(out);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* rezip(deltas, new_rows) -> [(k, new_row, d), ...] */
+static PyObject *
+fast_rezip(PyObject *self, PyObject *args)
+{
+    PyObject *deltas, *new_rows;
+    if (!PyArg_ParseTuple(args, "OO", &deltas, &new_rows))
+        return NULL;
+    PyObject *dseq = PySequence_Fast(deltas, "rezip expects sequences");
+    if (dseq == NULL)
+        return NULL;
+    PyObject *rseq = PySequence_Fast(new_rows, "rezip expects sequences");
+    if (rseq == NULL) {
+        Py_DECREF(dseq);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(dseq);
+    if (PySequence_Fast_GET_SIZE(rseq) != n) {
+        PyErr_SetString(PyExc_ValueError, "rezip: length mismatch");
+        Py_DECREF(dseq);
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    PyObject *out = PyList_New(n);
+    if (out == NULL) {
+        Py_DECREF(dseq);
+        Py_DECREF(rseq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(dseq, i);
+        if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3) {
+            PyErr_SetString(PyExc_TypeError, "delta must be (key, row, diff)");
+            Py_DECREF(out);
+            Py_DECREF(dseq);
+            Py_DECREF(rseq);
+            return NULL;
+        }
+        PyObject *t = PyTuple_Pack(3, PyTuple_GET_ITEM(d, 0),
+                                   PySequence_Fast_GET_ITEM(rseq, i),
+                                   PyTuple_GET_ITEM(d, 2));
+        if (t == NULL) {
+            Py_DECREF(out);
+            Py_DECREF(dseq);
+            Py_DECREF(rseq);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, t);
+    }
+    Py_DECREF(dseq);
+    Py_DECREF(rseq);
+    return out;
+}
+
+/* filter_deltas(deltas, mask) -> [d for d, m in zip(deltas, mask)
+ *                                 if m is True]
+ * Matches engine filter semantics for exact-bool masks: True keeps the
+ * row, False drops it. Any non-bool entry (None, Error, np.bool_) raises
+ * TypeError so the Python caller falls back to its general loop — the C
+ * path never guesses at truthiness. */
+static PyObject *
+fast_filter_deltas(PyObject *self, PyObject *args)
+{
+    PyObject *deltas, *mask;
+    if (!PyArg_ParseTuple(args, "OO", &deltas, &mask))
+        return NULL;
+    PyObject *dseq = PySequence_Fast(deltas, "filter_deltas expects sequences");
+    if (dseq == NULL)
+        return NULL;
+    PyObject *mseq = PySequence_Fast(mask, "filter_deltas expects sequences");
+    if (mseq == NULL) {
+        Py_DECREF(dseq);
+        return NULL;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(dseq);
+    if (PySequence_Fast_GET_SIZE(mseq) != n) {
+        PyErr_SetString(PyExc_ValueError, "filter_deltas: length mismatch");
+        Py_DECREF(dseq);
+        Py_DECREF(mseq);
+        return NULL;
+    }
+    PyObject *out = PyList_New(0);
+    if (out == NULL) {
+        Py_DECREF(dseq);
+        Py_DECREF(mseq);
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *m = PySequence_Fast_GET_ITEM(mseq, i);
+        if (m == Py_True) {
+            if (PyList_Append(out, PySequence_Fast_GET_ITEM(dseq, i)) < 0) {
+                Py_DECREF(out);
+                Py_DECREF(dseq);
+                Py_DECREF(mseq);
+                return NULL;
+            }
+        } else if (m != Py_False) {
+            PyErr_SetString(PyExc_TypeError,
+                            "filter_deltas: non-bool mask entry");
+            Py_DECREF(out);
+            Py_DECREF(dseq);
+            Py_DECREF(mseq);
+            return NULL;
+        }
+    }
+    Py_DECREF(dseq);
+    Py_DECREF(mseq);
+    return out;
+}
+
+/* parse_upserts(msgs, start, cols, defaults, key_base, seq0, mask, ptr_type)
+ *   msgs: list whose entries from `start` on are kwargs dicts of simple
+ *   upserts (the caller segregates other message kinds). Builds one
+ *   (Pointer(key_base+seq & mask), row_tuple, 1) per dict.
+ *   Returns (deltas_list, new_seq). */
+static PyObject *
+fast_parse_upserts(PyObject *self, PyObject *args)
+{
+    PyObject *msgs, *cols, *defaults, *key_base_obj, *mask_obj, *ptr_type;
+    Py_ssize_t start;
+    long long seq0;
+    if (!PyArg_ParseTuple(args, "OnO!O!OLOO", &msgs, &start, &PyTuple_Type,
+                          &cols, &PyTuple_Type, &defaults, &key_base_obj,
+                          &seq0, &mask_obj, &ptr_type))
+        return NULL;
+    PyObject *seq = PySequence_Fast(msgs, "parse_upserts expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t w = PyTuple_GET_SIZE(cols);
+    if (PyTuple_GET_SIZE(defaults) != w) {
+        PyErr_SetString(PyExc_ValueError, "parse_upserts: defaults width");
+        Py_DECREF(seq);
+        return NULL;
+    }
+    PyObject *out = PyList_New(n - start);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    PyObject *one = PyLong_FromLong(1);
+    long long sq = seq0;
+    for (Py_ssize_t i = start; i < n; i++) {
+        PyObject *values = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyDict_Check(values)) {
+            PyErr_SetString(PyExc_TypeError, "parse_upserts: msg not a dict");
+            goto fail;
+        }
+        PyObject *row = PyTuple_New(w);
+        if (row == NULL)
+            goto fail;
+        for (Py_ssize_t c = 0; c < w; c++) {
+            PyObject *v = PyDict_GetItemWithError(
+                values, PyTuple_GET_ITEM(cols, c));
+            if (v == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(row);
+                    goto fail;
+                }
+                v = PyTuple_GET_ITEM(defaults, c);
+            }
+            Py_INCREF(v);
+            PyTuple_SET_ITEM(row, c, v);
+        }
+        sq += 1;
+        /* key = ptr_type((key_base + sq) & mask) — arbitrary-precision
+         * arithmetic through the Python API: key_base is a 128-bit int */
+        PyObject *sq_obj = PyLong_FromLongLong(sq);
+        if (sq_obj == NULL) {
+            Py_DECREF(row);
+            goto fail;
+        }
+        PyObject *raw = PyNumber_Add(key_base_obj, sq_obj);
+        Py_DECREF(sq_obj);
+        PyObject *masked = raw ? PyNumber_And(raw, mask_obj) : NULL;
+        Py_XDECREF(raw);
+        PyObject *key = masked ? PyObject_CallOneArg(ptr_type, masked) : NULL;
+        Py_XDECREF(masked);
+        if (key == NULL) {
+            Py_DECREF(row);
+            goto fail;
+        }
+        PyObject *t = PyTuple_New(3);
+        if (t == NULL) {
+            Py_DECREF(key);
+            Py_DECREF(row);
+            goto fail;
+        }
+        PyTuple_SET_ITEM(t, 0, key);
+        PyTuple_SET_ITEM(t, 1, row);
+        Py_INCREF(one);
+        PyTuple_SET_ITEM(t, 2, one);
+        PyList_SET_ITEM(out, i - start, t);
+    }
+    Py_DECREF(one);
+    Py_DECREF(seq);
+    PyObject *res = Py_BuildValue("(OL)", out, sq);
+    Py_DECREF(out);
+    return res;
+fail:
+    Py_DECREF(one);
+    Py_DECREF(out);
+    Py_DECREF(seq);
+    return NULL;
+}
+
+/* deliver(deltas, time, cb, cols_or_None)
+ * Stable partition of a consolidated batch — all retractions first, then
+ * all insertions, each preserving producer order (which is deterministic:
+ * node outputs are insertion-ordered dicts). Retract-before-insert is the
+ * contract upsert sinks need; producer order within each class keeps the
+ * callback sequence reproducible without a full (diff, key) sort on the
+ * hot path. Calls cb per delta:
+ *   cols is None:  cb(key, row, time, diff)
+ *   cols a tuple:  cb(key, {col: val}, time, diff > 0)   (pw.io.subscribe)
+ */
+static int
+deliver_one(PyObject *d, PyObject *time_obj, PyObject *cb, PyObject *cols,
+            int want_dict)
+{
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *row = PyTuple_GET_ITEM(d, 1);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    PyObject *payload;
+    PyObject *diff_arg;
+    if (want_dict) {
+        if (!PyTuple_Check(row) ||
+            PyTuple_GET_SIZE(row) != PyTuple_GET_SIZE(cols)) {
+            PyErr_SetString(PyExc_ValueError, "deliver: row width");
+            return -1;
+        }
+        payload = PyDict_New();
+        if (payload == NULL)
+            return -1;
+        for (Py_ssize_t c = 0; c < PyTuple_GET_SIZE(cols); c++) {
+            if (PyDict_SetItem(payload, PyTuple_GET_ITEM(cols, c),
+                               PyTuple_GET_ITEM(row, c)) < 0) {
+                Py_DECREF(payload);
+                return -1;
+            }
+        }
+        int pos = PyObject_RichCompareBool(diff, one_long(), Py_GE);
+        if (pos < 0) {
+            Py_DECREF(payload);
+            return -1;
+        }
+        diff_arg = pos ? Py_True : Py_False;
+    } else {
+        payload = row;
+        Py_INCREF(payload);
+        diff_arg = diff;
+    }
+    PyObject *r = PyObject_CallFunctionObjArgs(
+        cb, key, payload, time_obj, diff_arg, NULL);
+    Py_DECREF(payload);
+    if (r == NULL)
+        return -1;
+    Py_DECREF(r);
+    return 0;
+}
+
+static PyObject *
+fast_deliver(PyObject *self, PyObject *args)
+{
+    PyObject *deltas, *time_obj, *cb, *cols;
+    if (!PyArg_ParseTuple(args, "OOOO", &deltas, &time_obj, &cb, &cols))
+        return NULL;
+    int want_dict = cols != Py_None;
+    if (want_dict && !PyTuple_Check(cols)) {
+        PyErr_SetString(PyExc_TypeError, "deliver: cols must be tuple|None");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(deltas, "deliver expects a sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3) {
+            PyErr_SetString(PyExc_TypeError, "delta must be (key, row, diff)");
+            Py_DECREF(seq);
+            return NULL;
+        }
+        long long df = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
+        if (df == -1 && PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+        if (df < 0 && deliver_one(d, time_obj, cb, cols, want_dict) < 0) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+        long long df = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
+        if (df >= 0 && deliver_one(d, time_obj, cb, cols, want_dict) < 0) {
+            Py_DECREF(seq);
+            return NULL;
+        }
+    }
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
+/* ref_scalar(args_tuple) -> Pointer
+ * Full native key mint: injective serialization (value_bytes) + blake2b-128
+ * + Pointer construction. Byte-identical to api.ref_scalar. */
+static PyObject *
+fast_ref_scalar(PyObject *self, PyObject *args_tuple)
+{
+    if (!PyTuple_Check(args_tuple)) {
+        PyErr_SetString(PyExc_TypeError, "ref_scalar expects a tuple");
+        return NULL;
+    }
+    if (load_pointer_type() < 0)
+        return NULL;
+    Py_ssize_t n = PyTuple_GET_SIZE(args_tuple);
+    Buf b = {PyMem_Malloc(256), 0, 256};
+    if (b.buf == NULL)
+        return PyErr_NoMemory();
+    if (buf_put_u32(&b, (uint32_t)n) < 0)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_ssize_t mark = b.len;
+        if (buf_put_u32(&b, 0) < 0)
+            goto fail;
+        if (serialize_value(&b, PyTuple_GET_ITEM(args_tuple, i)) < 0)
+            goto fail;
+        uint32_t plen = (uint32_t)(b.len - mark - 4);
+        unsigned char le[4] = {
+            (unsigned char)(plen & 0xff),
+            (unsigned char)((plen >> 8) & 0xff),
+            (unsigned char)((plen >> 16) & 0xff),
+            (unsigned char)((plen >> 24) & 0xff),
+        };
+        memcpy(b.buf + mark, le, 4);
+    }
+    unsigned char digest[16];
+    b2b_digest16(digest, (const unsigned char *)b.buf, (size_t)b.len);
+    PyMem_Free(b.buf);
+    b.buf = NULL;
+    uint64_t lo = 0, hi = 0;
+    for (int i = 7; i >= 0; i--)
+        lo = (lo << 8) | digest[i];
+    for (int i = 15; i >= 8; i--)
+        hi = (hi << 8) | digest[i];
+    PyObject *lo_o = PyLong_FromUnsignedLongLong(lo);
+    PyObject *hi_o = PyLong_FromUnsignedLongLong(hi);
+    static PyObject *sh64 = NULL;
+    if (sh64 == NULL)
+        sh64 = PyLong_FromLong(64);
+    PyObject *shifted =
+        (lo_o && hi_o && sh64) ? PyNumber_Lshift(hi_o, sh64) : NULL;
+    PyObject *full = shifted ? PyNumber_Or(shifted, lo_o) : NULL;
+    Py_XDECREF(lo_o);
+    Py_XDECREF(hi_o);
+    Py_XDECREF(shifted);
+    if (full == NULL)
+        return NULL;
+    PyObject *key = PyObject_CallOneArg(pointer_type, full);
+    Py_DECREF(full);
+    return key;
+fail:
+    PyMem_Free(b.buf);
+    return NULL;
+}
 
 /* module def ------------------------------------------------------------ */
 
@@ -360,6 +1078,23 @@ static PyMethodDef methods[] = {
      "Hashable stand-ins for a batch of rows."},
     {"value_bytes", fast_value_bytes, METH_O,
      "Injective length-prefixed serialization of a value tuple."},
+    {"split_deltas", fast_split_deltas, METH_O,
+     "split_deltas(deltas) -> (keys, rows, diffs)"},
+    {"project_col", fast_project_col, METH_VARARGS,
+     "project_col(rows, j) -> [row[j] for row in rows]"},
+    {"project_tuples", fast_project_tuples, METH_VARARGS,
+     "project_tuples(rows, idx) -> [tuple(row[j] for j in idx), ...]"},
+    {"rezip", fast_rezip, METH_VARARGS,
+     "rezip(deltas, new_rows) -> [(k, new_row, d), ...]"},
+    {"filter_deltas", fast_filter_deltas, METH_VARARGS,
+     "filter_deltas(deltas, bool_mask) -> kept deltas"},
+    {"parse_upserts", fast_parse_upserts, METH_VARARGS,
+     "parse_upserts(msgs, start, cols, defaults, base, seq0, mask, ptr) "
+     "-> (deltas, new_seq)"},
+    {"deliver", fast_deliver, METH_VARARGS,
+     "deliver(deltas, time, cb, cols|None): sorted output callbacks"},
+    {"ref_scalar", fast_ref_scalar, METH_O,
+     "ref_scalar(args_tuple) -> Pointer (native blake2b-128 key mint)"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -39,6 +39,12 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
         if loc == "id":
             return lambda keys, rows: list(keys)
         idx = loc
+        from pathway_tpu.engine.stream import get_fp
+
+        fp = get_fp()
+        if fp is not None:
+            pc = fp.project_col
+            return lambda keys, rows: pc(rows, idx)
         return lambda keys, rows: [r[idx] for r in rows]
 
     if isinstance(e, expr.ColumnBinaryOpExpression):

@@ -18,7 +18,6 @@ dataflow/operators/*.rs) on a batch-at-a-timestamp execution model:
 from __future__ import annotations
 
 import itertools
-import operator
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
@@ -34,8 +33,21 @@ from pathway_tpu.engine.stream import (
     TableState,
     consolidate,
     freeze_row,
+    get_fp,
     negate,
 )
+
+
+def _split_deltas(deltas):
+    """(keys, rows, diffs) — one C pass when the toolchain is present."""
+    fp = get_fp()
+    if fp is not None:
+        return fp.split_deltas(deltas)
+    return (
+        [d[0] for d in deltas],
+        [d[1] for d in deltas],
+        [d[2] for d in deltas],
+    )
 
 
 class Node:
@@ -142,9 +154,11 @@ class RowwiseNode(Node):
             return []
         # Deterministic replay for retractions: recompute is fine for pure
         # expressions; non-deterministic UDFs route through AsyncApplyNode.
-        keys = [d[0] for d in deltas]
-        rows = [d[1] for d in deltas]
+        keys, rows, _ = _split_deltas(deltas)
         new_rows = self.batch_fn(keys, rows)
+        fp = get_fp()
+        if fp is not None:
+            return consolidate(fp.rezip(deltas, new_rows))
         return consolidate(
             (k, nr, d) for (k, _, d), nr in zip(deltas, new_rows)
         )
@@ -198,14 +212,24 @@ class FilterNode(Node):
         deltas = consolidate(batches[0])
         if not deltas:
             return []
-        mask = self.mask_fn([d[0] for d in deltas], [d[1] for d in deltas])
+        keys, rows, _ = _split_deltas(deltas)
+        mask = self.mask_fn(keys, rows)
+        if isinstance(mask, _np.ndarray):
+            mask = mask.tolist()  # numpy bools -> Python bools
+        fp = get_fp()
+        if fp is not None:
+            try:
+                # a subset of a net-form batch is still net form
+                return ConsolidatedList(fp.filter_deltas(deltas, mask))
+            except TypeError:
+                pass  # non-bool mask entries: general loop below
         # accept numpy bools from UDF-produced masks; anything non-boolean
         # (None, Error) drops the row, matching engine filter semantics
-        return [
+        return ConsolidatedList(
             d
             for d, m in zip(deltas, mask)
             if isinstance(m, (bool, _np.bool_)) and bool(m)
-        ]
+        )
 
 
 class ReindexNode(Node):
@@ -718,15 +742,13 @@ class GroupByNode(GroupDiffNode):
         batch = consolidate(batches[0])
         if not batch:
             return []
-        keys = [d[0] for d in batch]
-        rows = [d[1] for d in batch]
+        keys, rows, diffs = _split_deltas(batch)
         if self._native_ok and self._native_setup():
             gvals_list = self.grouping_batch(keys, rows)
             valcols = tuple(
                 f(keys, rows) if f is not None else None
                 for f in self.native_args
             )
-            diffs = [d[2] for d in batch]
             try:
                 # distinct groups emit distinct rows, so the output is
                 # already in net form
@@ -1182,9 +1204,6 @@ class ForgetImmediatelyNode(Node):
         return consolidate(out)
 
 
-_out_order = operator.itemgetter(2, 0)
-
-
 class OutputNode(Node):
     """Terminal node delivering batches to a callback (reference:
     Graph::output_table / subscribe_table, graph.rs:569 SubscribeCallbacks)."""
@@ -1197,12 +1216,15 @@ class OutputNode(Node):
         on_batch=None,        # fn(time, deltas)
         on_time_end=None,     # fn(time)
         on_end=None,          # fn()
+        dict_cols=None,       # tuple of col names: on_change receives a
+                              # {col: val} dict + bool diff (pw.io.subscribe)
     ):
         super().__init__(scope, [input_node])
         self._on_change = on_change
         self._on_batch = on_batch
         self._on_time_end = on_time_end
         self._on_end = on_end
+        self._dict_cols = tuple(dict_cols) if dict_cols is not None else None
         self._seen_time = False
 
     def process(self, time, batches):
@@ -1213,11 +1235,27 @@ class OutputNode(Node):
             if self._on_batch is not None:
                 self._on_batch(time, deltas)
             if self._on_change is not None:
-                # retractions before insertions, key-ordered (deterministic
-                # callback order); C-level key beats a lambda on the
-                # subscriber hot path
-                for k, row, d in sorted(deltas, key=_out_order):
-                    self._on_change(k, row, time, d)
+                # stable partition: retractions first, then insertions,
+                # each in producer order (deterministic — node outputs are
+                # insertion-ordered). Upsert sinks rely on retract-before-
+                # insert; the C deliver loop also builds the subscriber's
+                # row dicts when dict_cols is set
+                fp = get_fp()
+                if fp is not None:
+                    fp.deliver(deltas, time, self._on_change, self._dict_cols)
+                else:
+                    ordered = [d for d in deltas if d[2] < 0] + [
+                        d for d in deltas if d[2] >= 0
+                    ]
+                    if self._dict_cols is not None:
+                        cols = self._dict_cols
+                        for k, row, d in ordered:
+                            self._on_change(
+                                k, dict(zip(cols, row)), time, d > 0
+                            )
+                    else:
+                        for k, row, d in ordered:
+                            self._on_change(k, row, time, d)
         return []
 
     def on_time_end(self, time):

@@ -75,6 +75,22 @@ class ConsolidatedList(list):
 
 
 _consolidate_impl = None
+_fp_cached: Any = False
+
+
+def get_fp():
+    """The native fastpath extension module, or None without a toolchain.
+    Cached after the first resolution attempt (same policy as
+    consolidate's lazy binding)."""
+    global _fp_cached
+    if _fp_cached is False:
+        try:
+            from pathway_tpu.native import get_fastpath
+
+            _fp_cached = get_fastpath()
+        except Exception:
+            _fp_cached = None
+    return _fp_cached
 
 
 def consolidate(deltas: Iterable[Delta]) -> list[Delta]:

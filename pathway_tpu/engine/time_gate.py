@@ -15,7 +15,7 @@ from __future__ import annotations
 import heapq
 from typing import Callable
 
-from pathway_tpu.engine.nodes import Node
+from pathway_tpu.engine.nodes import Node, _split_deltas
 from pathway_tpu.engine.stream import (
     Delta,
     Key,
@@ -29,10 +29,20 @@ from pathway_tpu.engine.stream import (
 class _WatermarkNode(Node):
     def __init__(self, scope, input_node, gate_fn):
         super().__init__(scope, [input_node])
-        # gate_fn(key, row) -> (threshold, event_time): one evaluation per
-        # row covers both expressions (they usually share subtrees)
+        # gate_fn(key, row) -> (threshold, event_time); gate_fn.batch, when
+        # present, evaluates both expressions column-wise over the whole
+        # batch (no per-row closure on the temporal hot path)
         self.gate_fn = gate_fn
         self.watermark = None
+
+    def _gate(self, deltas) -> list:
+        """[(delta, (threshold, event_time)), ...] for a batch."""
+        gb = getattr(self.gate_fn, "batch", None)
+        if gb is not None:
+            keys, rows, _ = _split_deltas(deltas)
+            thr_col, t_col = gb(keys, rows)
+            return list(zip(deltas, zip(thr_col, t_col)))
+        return [(d, self.gate_fn(d[0], d[1])) for d in deltas]
 
     def _advance(self, gated: list) -> None:
         for (k, row, d), (thr, t) in gated:
@@ -54,7 +64,7 @@ class BufferNode(_WatermarkNode):
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
-        gated = [(d, self.gate_fn(d[0], d[1])) for d in deltas]
+        gated = self._gate(deltas)
         out: list[Delta] = []
         for (k, row, d), (thr, _t) in gated:
             ident = (k, freeze_row(row))
@@ -101,7 +111,7 @@ class FreezeNode(_WatermarkNode):
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
-        gated = [(d, self.gate_fn(d[0], d[1])) for d in deltas]
+        gated = self._gate(deltas)
         out = []
         for (k, row, d), (thr, _t) in gated:
             if (
@@ -130,7 +140,7 @@ class ForgetNode(_WatermarkNode):
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
-        gated = [(d, self.gate_fn(d[0], d[1])) for d in deltas]
+        gated = self._gate(deltas)
         out = []
         for (k, row, d), (thr, _t) in gated:
             out.append((k, row, d))

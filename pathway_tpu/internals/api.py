@@ -77,31 +77,41 @@ def _concat_lp(parts: list[bytes]) -> bytes:
     )
 
 
-_native_value_bytes = None
-_native_checked = False
+_fp_mod: Any = False
+
+
+def _fp():
+    """Shared lazy accessor for the native fastpath module (resolution
+    itself delegates to pathway_tpu.engine.stream.get_fp; the result is
+    memoized here to keep the key-mint hot path import-free)."""
+    global _fp_mod
+    if _fp_mod is False:
+        try:
+            from pathway_tpu.engine.stream import get_fp
+
+            _fp_mod = get_fp()
+        except Exception:
+            _fp_mod = None
+    return _fp_mod
 
 
 def _args_bytes(args: tuple) -> bytes:
-    global _native_value_bytes, _native_checked
-    if not _native_checked:
-        _native_checked = True
-        try:
-            from pathway_tpu.native import get_fastpath
-
-            fp = get_fastpath()
-            if fp is not None:
-                _native_value_bytes = fp.value_bytes
-        except Exception:
-            _native_value_bytes = None
-    if _native_value_bytes is not None:
-        return _native_value_bytes(args)
+    fp = _fp()
+    if fp is not None:
+        return fp.value_bytes(args)
     return _concat_lp([_value_to_bytes(a) for a in args])
 
 
 def ref_scalar(*args: Any, optional: bool = False) -> Pointer:
-    """Deterministic pointer from values (reference: python_api ref_scalar)."""
+    """Deterministic pointer from values (reference: python_api ref_scalar).
+    The native fast path (fastpath.ref_scalar) mints byte-identical keys:
+    same serialization, same blake2b-128 — verified by
+    tests/test_native_keys.py."""
     if optional and any(a is None for a in args):
         return None  # type: ignore[return-value]
+    fp = _fp()
+    if fp is not None:
+        return fp.ref_scalar(args)
     return _hash_bytes(_args_bytes(args))
 
 

@@ -118,13 +118,17 @@ class LoweringContext:
     def row_fn(
         self, base: "Table", exprs: list[expr_mod.ColumnExpression]
     ) -> tuple[EngineTable, Callable]:
-        """Per-row variant: fn(key, row) -> tuple of values (for key fns)."""
+        """Per-row variant: fn(key, row) -> tuple of values (for key fns).
+        ``fn.batch(keys, rows) -> list of per-expr columns`` lets batch
+        consumers (the time-gate operators) evaluate each expression once
+        per batch instead of once per row."""
         combined, resolver = self._combined_view(base, exprs)
         fns = [compile_expression(e, resolver, self.runtime) for e in exprs]
 
         def one(key, row):
             return tuple(f([key], [row])[0] for f in fns)
 
+        one.batch = lambda keys, rows: [f(keys, rows) for f in fns]
         return combined, one
 
 

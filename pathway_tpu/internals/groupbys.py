@@ -179,7 +179,8 @@ class GroupedTable:
             ] + ([sort_by] if sort_by is not None else [])
             et, resolver = ctx._combined_view(base, all_input_exprs)
 
-            if all(e._is_deterministic for e in all_input_exprs):
+            deterministic = all(e._is_deterministic for e in all_input_exprs)
+            if deterministic:
                 gfns = [
                     compile_expression(g, resolver, ctx.runtime) for g in grouping
                 ]
@@ -245,6 +246,38 @@ class GroupedTable:
                     return [()] * len(keys)
                 cols = [f(keys, rows) for f in gfns]
                 return list(zip(*cols))
+
+            # all-plain-column grouping builds the gvals tuples in one C
+            # pass over the rows (the wordcount-class hot path). In the
+            # non-deterministic branch the grouping values occupy slots
+            # 0..n_group-1 of the pre-materialized rows by construction.
+            from pathway_tpu.engine.stream import get_fp
+
+            fp = get_fp()
+            if fp is not None and grouping:
+                g_idx: list[int] | None = []
+                if deterministic:
+                    for g in grouping:
+                        loc = (
+                            resolver(g)
+                            if isinstance(g, ColumnReference)
+                            else None
+                        )
+                        if isinstance(loc, int):
+                            g_idx.append(loc)
+                        else:
+                            g_idx = None
+                            break
+                else:
+                    g_idx = list(range(n_group))
+                if g_idx is not None and len(g_idx) > 32:
+                    g_idx = None  # native projection caps at 32 columns
+                if g_idx is not None:
+                    idxs = tuple(g_idx)
+                    pt = fp.project_tuples
+
+                    def grouping_batch(keys, rows):  # noqa: F811
+                        return pt(rows, idxs)
 
             def args_batch(keys, rows):
                 n = len(keys)
@@ -347,18 +380,49 @@ class GroupedTable:
                     return "id"
                 raise KeyError(ref.name)
 
-            out_fns = [
-                compile_expression(e, out_resolver, ctx.runtime) for e in rewritten
-            ]
+            # identity projection (reduce(word=this.g, c=reducer) in slot
+            # order) needs no rowwise stage at all; an all-plain-column
+            # projection runs as one C pass. Both are the common shapes on
+            # the relational hot path.
+            out_idx: list[int] | None = []
+            for e in rewritten:
+                loc = (
+                    out_resolver(e)
+                    if isinstance(e, ColumnReference)
+                    else None
+                )
+                if isinstance(loc, int):
+                    out_idx.append(loc)
+                else:
+                    out_idx = None
+                    break
+            grouped_width = n_group + len(reducers)
+            if out_idx is not None and out_idx == list(range(grouped_width)):
+                ctx.set_engine_table(out, grouped)
+                return
+            if out_idx is not None and len(out_idx) > 32:
+                out_idx = None  # native projection caps at 32 columns
 
-            def batch_fn(keys, rows):
-                cols = [f(keys, rows) for f in out_fns]
-                return list(zip(*cols)) if cols else [()] * len(keys)
+            if out_idx is not None and fp is not None:
+                idxs_out = tuple(out_idx)
+
+                def batch_fn(keys, rows):
+                    return fp.project_tuples(rows, idxs_out)
+
+            else:
+                out_fns = [
+                    compile_expression(e, out_resolver, ctx.runtime)
+                    for e in rewritten
+                ]
+
+                def batch_fn(keys, rows):  # noqa: F811
+                    cols = [f(keys, rows) for f in out_fns]
+                    return list(zip(*cols)) if cols else [()] * len(keys)
 
             ctx.set_engine_table(
                 out,
                 ctx.scope.rowwise_auto(
-                    grouped, batch_fn, len(out_fns),
+                    grouped, batch_fn, len(rewritten),
                     all(e._is_deterministic for e in rewritten),
                 ),
             )

@@ -31,7 +31,19 @@ _BACKLOG_CAP = 1_000_000
 def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     subject = conn.subject
     parser = conn.parser
-    pending: list = []
+    # parse_batch defers per-message parsing to flush time so runs of
+    # simple upserts go through one C call instead of a Python closure per
+    # row (io/python.py attaches it; other parsers fall back to a loop)
+    parse_batch = getattr(parser, "parse_batch", None)
+    if parse_batch is None:
+
+        def parse_batch(msgs):
+            out: list = []
+            for m in msgs:
+                out.extend(parser(m))
+            return out
+
+    pending: list = []  # raw messages, parsed at flush under `lock`
     # rows forwarded to the engine but not yet covered by a journal entry
     # (stateful subjects only; tracked only when persistence is configured)
     unjournaled: list = []
@@ -52,14 +64,24 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     duration_ms = getattr(subject, "_autocommit_duration_ms", None)
     last_flush = _time.monotonic()
 
+    def take_batch() -> list:
+        """Parse and claim the currently queued messages. Caller holds
+        `lock`. Appends from the subject thread are GIL-atomic, so the
+        snapshot + del-prefix pair never drops a message that lands
+        mid-flush — it simply stays queued for the next flush."""
+        msgs = pending[:]
+        if not msgs:
+            return []
+        del pending[: len(msgs)]
+        return parse_batch(msgs)
+
     def timer_flush() -> None:
         nonlocal last_flush, warned_backlog, forwarded_since_boundary
         last_flush = _time.monotonic()
         with lock:
-            if not pending:
+            batch = take_batch()
+            if not batch:
                 return
-            batch = pending.copy()
-            pending.clear()
             forwarded_since_boundary += len(batch)
             if has_state and persisting:
                 # the subject may be mid-scan on its own thread, so its
@@ -100,8 +122,7 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
         nonlocal last_flush, forwarded_since_boundary
         last_flush = _time.monotonic()
         with lock:
-            batch = pending.copy()
-            pending.clear()
+            batch = take_batch()
             if has_state:
                 journal_rows = unjournaled + batch
                 unjournaled.clear()
@@ -117,22 +138,22 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
                 out_queue.put((conn, batch, None, batch))
 
     def emit(message: Any) -> None:
-        deltas = parser(message)
-        if deltas:
-            with lock:
-                pending.extend(deltas)
-            if (
-                duration_ms is None
-                or (_time.monotonic() - last_flush) * 1000.0 >= duration_ms
-            ):
-                timer_flush()
+        # list.append is GIL-atomic: no lock on the per-row producer path.
+        # duration_ms None disables autocommit entirely (reference:
+        # io/python/__init__.py autocommit_duration_ms=None) — rows then
+        # move only at explicit subject.commit() boundaries.
+        pending.append(message)
+        if (
+            duration_ms is not None
+            and (_time.monotonic() - last_flush) * 1000.0 >= duration_ms
+        ):
+            timer_flush()
 
     def force_flush() -> None:
         # called from the runtime loop's cadence; respects the autocommit
         # window so steady sources still batch up to duration_ms
-        if (
-            duration_ms is not None
-            and (_time.monotonic() - last_flush) * 1000.0 < duration_ms
+        if duration_ms is None or (
+            (_time.monotonic() - last_flush) * 1000.0 < duration_ms
         ):
             return
         timer_flush()

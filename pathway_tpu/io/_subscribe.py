@@ -18,18 +18,17 @@ def subscribe(
     sort_by=None,
 ) -> None:
     """on_change(key, row: dict, time: int, is_addition: bool)."""
-    cols = table.column_names()
-
-    def wrapped_on_change(key, row, time, diff):
-        if on_change is not None:
-            on_change(key, dict(zip(cols, row)), time, diff > 0)
+    cols = tuple(table.column_names())
 
     def lower(ctx):
+        # dict_cols pushes the row-dict building into the OutputNode's C
+        # delivery loop instead of a per-change Python wrapper
         ctx.scope.output(
             ctx.engine_table(table),
-            on_change=wrapped_on_change if on_change is not None else None,
+            on_change=on_change,
             on_time_end=on_time_end,
             on_end=on_end,
+            dict_cols=cols if on_change is not None else None,
         )
 
     G.add_operator([table], [], lower, "subscribe", is_output=True)

@@ -239,8 +239,17 @@ def write(
                 f.write(_json.dumps(a) + "\n")
         try:
             os.link(tmp, path)
+        except OSError as exc:
+            if isinstance(exc, FileExistsError):
+                raise
+            # filesystem without hard links (exFAT, some FUSE/NFS mounts):
+            # fall back to os.replace — single-writer still safe, only the
+            # multi-writer exclusivity guarantee is lost there
+            os.replace(tmp, path)
+            tmp = None
         finally:
-            os.unlink(tmp)
+            if tmp is not None:
+                os.unlink(tmp)
 
     def _commit(actions: list[dict]) -> None:
         while True:

@@ -159,6 +159,49 @@ def _make_parser(schema: type[Schema], subject=None):
         diff = -1 if kind == "remove" else 1
         return [(key, row, diff)]
 
+    # batch parsing: runs of keyless simple upserts (the append-only
+    # streaming hot path) are parsed by one C call per run — row tuples,
+    # defaults and minted keys all built without the per-row closure
+    from pathway_tpu.engine.stream import get_fp
+
+    fp = get_fp()
+    simple = fp is not None and not pkeys and not track_removals
+    cols_t = tuple(cols)
+    defaults_t = tuple(defaults.get(c) for c in cols)
+
+    def parse_batch(messages: list) -> list[tuple]:
+        from pathway_tpu.engine.stream import ConsolidatedList
+
+        out: list[tuple] = []
+        i, n = 0, len(messages)
+        pure = simple
+        while i < n:
+            m = messages[i]
+            if simple and m[0] == "upsert" and len(m) == 2:
+                j = i + 1
+                while j < n:
+                    mj = messages[j]
+                    if mj[0] != "upsert" or len(mj) != 2:
+                        break
+                    j += 1
+                dicts = [messages[t][1] for t in range(i, j)]
+                deltas, seq[0] = fp.parse_upserts(
+                    dicts, 0, cols_t, defaults_t, key_base, seq[0],
+                    _KEY_MASK, Pointer,
+                )
+                out.extend(deltas)
+                i = j
+            else:
+                pure = False
+                out.extend(parse(m))
+                i += 1
+        if pure:
+            # every row minted a fresh key with diff +1: already net form,
+            # the source node's consolidate passes it through untouched
+            return ConsolidatedList(out)
+        return out
+
+    parse.parse_batch = parse_batch
     return parse
 
 

@@ -1,0 +1,111 @@
+"""Native key-mint parity: fastpath.ref_scalar must be byte-identical to
+the Python blake2b mint (persistence + multi-process determinism depend on
+every rank minting the same keys regardless of toolchain availability).
+Reference analog: src/engine/value.rs Key derivation is likewise a single
+stable hash shared by every worker."""
+
+import math
+import random
+
+import pytest
+
+from pathway_tpu.internals.api import (
+    Json,
+    Pointer,
+    _concat_lp,
+    _hash_bytes,
+    _value_to_bytes,
+    ref_scalar,
+)
+
+
+def _py_mint(args: tuple) -> Pointer:
+    return _hash_bytes(_concat_lp([_value_to_bytes(a) for a in args]))
+
+
+def _fp():
+    from pathway_tpu.native import get_fastpath
+
+    fp = get_fastpath()
+    if fp is None:
+        pytest.skip("no native toolchain")
+    return fp
+
+
+def test_ref_scalar_parity_fuzz():
+    fp = _fp()
+    random.seed(1234)
+    cases = []
+    for _ in range(2000):
+        n = random.randrange(0, 5)
+        args = []
+        for _ in range(n):
+            t = random.randrange(9)
+            if t == 0:
+                args.append(None)
+            elif t == 1:
+                args.append(random.choice([True, False]))
+            elif t == 2:
+                args.append(random.randrange(-(2**63), 2**63))
+            elif t == 3:
+                args.append(random.random() * 1e6 - 5e5)
+            elif t == 4:
+                args.append("s" * random.randrange(3) + chr(random.randrange(32, 0x3000)))
+            elif t == 5:
+                args.append(bytes(random.randrange(256) for _ in range(random.randrange(4))))
+            elif t == 6:
+                args.append(Pointer(random.randrange(0, 2**128)))
+            elif t == 7:
+                args.append((random.randrange(100), "x", None))
+            else:
+                # beyond i64: exercises the python-fallback branch inside C
+                args.append(random.randrange(-(2**200), 2**200))
+        cases.append(tuple(args))
+    for args in cases:
+        assert fp.ref_scalar(args) == _py_mint(args), args
+
+
+def test_ref_scalar_parity_edges():
+    fp = _fp()
+    edges = [
+        (),
+        (0,),
+        (-1,),
+        (1,),
+        (255,),
+        (-256,),
+        (2**63 - 1,),
+        (-(2**63),),
+        (2**64,),
+        (-(2**64),),
+        (float("inf"),),
+        (float("-inf"),),
+        (float("nan"),),
+        (0.0,),
+        (-0.0,),
+        ("",),
+        ("\x00",),
+        ("héllo",),
+        (b"",),
+        (b"\x00\xff",),
+        ((),),
+        ((1, (2, (3,))),),
+        (Pointer(0),),
+        (Pointer(2**128 - 1),),
+        (True,),
+        (False,),
+        (None,),
+        (Json({"a": [1, 2]}),),  # python-fallback branch
+        (1, "two", 3.0, None, True, b"x", (7,)),
+    ]
+    for args in edges:
+        assert fp.ref_scalar(args) == _py_mint(args), args
+        assert type(fp.ref_scalar(args)) is Pointer
+
+
+def test_public_ref_scalar_uses_consistent_mint():
+    # whatever path api.ref_scalar takes, it must agree with the pure
+    # python mint and handle optional=None contract
+    assert ref_scalar(1, "a") == _py_mint((1, "a"))
+    assert ref_scalar(1, None, optional=True) is None
+    assert math.isfinite(float(int(ref_scalar("x")) % 2**32))

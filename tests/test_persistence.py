@@ -232,7 +232,8 @@ def test_midscan_force_flush_defers_journaling():
     from pathway_tpu.io._connector import run_connector_thread
 
     class _Subject:
-        _autocommit_duration_ms = None  # flush per emit
+        _autocommit_duration_ms = 0  # zero window: flush per emit
+        # (None would disable autocommit entirely, reference semantics)
 
         def __init__(self):
             self.bookkept = []
