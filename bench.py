@@ -49,6 +49,9 @@ def make_docs(n: int, words: int = 90, seed: int = 0) -> list[str]:
 
 
 def bench_ingest(enc, docs: list[str], batch_size: int) -> dict:
+    import queue as _queue
+    import threading
+
     from pathway_tpu.ops import KnnShard
 
     # pre-size the index: each capacity is a distinct XLA executable, so
@@ -61,22 +64,54 @@ def bench_ingest(enc, docs: list[str], batch_size: int) -> dict:
 
     n_batches = len(docs) // batch_size
     deadline = time.perf_counter() + 12.0
+
+    # tokenize-ahead thread: host tokenization of batch N+1 overlaps device
+    # compute of batch N (fast tokenizers release the GIL). The bounded
+    # queue keeps at most 4 tokenized batches in flight.
+    tok_q: "_queue.Queue" = _queue.Queue(maxsize=4)
+    stop = threading.Event()
+
+    tok_err: list = []
+
+    def tokenizer_ahead():
+        batch_i = 1
+        try:
+            while not stop.is_set():
+                start = (batch_i % n_batches) * batch_size
+                chunk = docs[start : start + batch_size]
+                batch_i += 1
+                toks = enc.tokenizer(chunk)
+                while not stop.is_set():
+                    try:
+                        tok_q.put((toks, len(chunk)), timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+        except Exception as exc:  # surfaced by the consumer's bounded get
+            tok_err.append(exc)
+
+    tt = threading.Thread(target=tokenizer_ahead, daemon=True)
+    tt.start()
+
     done = 0
     t0 = time.perf_counter()
     key_base = batch_size
-    batch_i = 1
+    embs = emb0
     while time.perf_counter() < deadline:
-        start = (batch_i % n_batches) * batch_size
-        chunk = docs[start : start + batch_size]
-        batch_i += 1
-        # device-resident pipeline: encoder output feeds the index without
-        # a host round-trip; host tokenization overlaps device compute
-        embs = enc.encode_device(chunk)
-        index.add(list(range(key_base, key_base + len(chunk))), embs)
-        key_base += len(chunk)
-        done += len(chunk)
+        try:
+            (ids, mask), n = tok_q.get(timeout=5.0)
+        except _queue.Empty:
+            stop.set()
+            raise RuntimeError(
+                "tokenize-ahead thread stalled"
+            ) from (tok_err[0] if tok_err else None)
+        embs = enc.encode_tokens_device(ids, mask)
+        index.add(list(range(key_base, key_base + n)), embs)
+        key_base += n
+        done += n
     index.vectors.block_until_ready()
     elapsed = time.perf_counter() - t0
+    stop.set()
 
     # sanity: the index must answer queries over what was ingested
     hits = index.search(np.asarray(embs[:4]), k=3)
@@ -87,14 +122,19 @@ def bench_ingest(enc, docs: list[str], batch_size: int) -> dict:
         "metric": "embed_ingest_docs_per_s_per_chip",
         "value": round(docs_per_s, 1),
         "unit": "docs/s",
+        "tokenize_ahead": True,
         "vs_baseline": round(docs_per_s / TARGET_PER_CHIP, 3),
     }
 
 
-def bench_rag(enc, n_docs: int, n_queries: int = 100, k: int = 6) -> dict:
-    """Query latency over an HBM-resident index of n_docs vectors: p50/p95
-    end-to-end plus the device-compute-only split (on a tunneled dev chip
-    result readback adds a fixed ~100 ms that local hardware does not pay)."""
+def bench_rag(
+    enc, n_docs: int, n_queries: int = 100, k: int = 6
+) -> tuple[dict, dict]:
+    """Returns (single_query_metrics, under_load_metrics) over an
+    HBM-resident index of n_docs vectors: p50/p95 end-to-end plus the
+    device-compute-only split, then a 32-concurrent-client run through the
+    micro-batcher (on a tunneled dev chip every dispatch round trip pays a
+    fixed ~100 ms that colocated hardware does not)."""
     import jax.numpy as jnp
 
     from pathway_tpu.ops import KnnShard, QueryEngine
@@ -144,7 +184,7 @@ def bench_rag(enc, n_docs: int, n_queries: int = 100, k: int = 6) -> dict:
     floor.sort()
     floor_p50 = floor[len(floor) // 2]
 
-    return {
+    single = {
         "metric": "rag_query_p50_ms",
         "value": round(p50, 2),
         "unit": "ms",
@@ -155,6 +195,72 @@ def bench_rag(enc, n_docs: int, n_queries: int = 100, k: int = 6) -> dict:
         "k": k,
         "vs_baseline": round(RAG_TARGET_P50_MS / p50, 3),
     }
+
+    # -- under concurrent load: 32 clients through the micro-batcher -----
+    # Queries group into micro-batches (one fused dispatch + one packed
+    # readback per group) and several groups' readbacks ride the link
+    # concurrently. On a WAN-tunneled dev chip every request still pays
+    # one ~RTT (measured as transport_floor above: a trivial same-shape
+    # dispatch+readback) — no request/response system can return a result
+    # in less than one round trip — so the colocated bound reported below
+    # is p50 minus that measured floor: the latency the same pipeline pays
+    # when the serving host is attached to the TPU (µs-RTT PCIe/ICI).
+    import threading
+
+    from pathway_tpu.ops import MicroBatcher
+
+    n_clients = 32
+    duration_s = 8.0
+    # warm every batch-size bucket the micro-batches can pad to (16 and 32
+    # via pad_batch/_bucket) so no XLA compile lands inside the timed run
+    engine.query(queries[:16])
+    engine.query(queries[:32])
+    # 10 ms window: wide enough that a full client generation regroups
+    # into one fused dispatch even under host-thread scheduling jitter
+    mb = MicroBatcher(engine, max_wait_ms=10.0, max_batch=32)
+    mb.query(queries[0])
+    lats: list[list[float]] = [[] for _ in range(n_clients)]
+    stop_at = time.perf_counter() + duration_s
+
+    def client(ci: int):
+        i = 0
+        while time.perf_counter() < stop_at:
+            q = queries[(ci * 37 + i) % len(queries)]
+            t0 = time.perf_counter()
+            mb.query(q)
+            lats[ci].append((time.perf_counter() - t0) * 1000.0)
+            i += 1
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    mb.close()
+    all_lats = sorted(x for l in lats for x in l)
+    n_done = len(all_lats)
+    ul_p50 = all_lats[n_done // 2] if n_done else float("nan")
+    ul_p95 = all_lats[int(n_done * 0.95)] if n_done else float("nan")
+    colocated_p50 = max(ul_p50 - floor_p50, 0.0)
+    under_load = {
+        "metric": "rag_under_load_p50_ms",
+        "value": round(ul_p50, 2),
+        "unit": "ms",
+        "p95_ms": round(ul_p95, 2),
+        "qps": round(n_done / wall, 1),
+        "n_clients": n_clients,
+        "n_queries": n_done,
+        "transport_floor_p50_ms": round(floor_p50, 2),
+        "colocated_p50_bound_ms": round(colocated_p50, 2),
+        "n_docs": n_docs,
+        "k": k,
+        "vs_baseline": round(RAG_TARGET_P50_MS / ul_p50, 3) if n_done else 0.0,
+    }
+    return single, under_load
 
 
 def main() -> None:
@@ -176,8 +282,9 @@ def main() -> None:
     print(json.dumps(ingest), flush=True)
 
     n_docs = int(os.environ.get("BENCH_RAG_DOCS", "1000000"))
-    rag = bench_rag(enc, n_docs)
+    rag, under_load = bench_rag(enc, n_docs)
     print(json.dumps(rag), flush=True)
+    print(json.dumps(under_load), flush=True)
 
     # relational plane: streaming wordcount through the sharded native
     # group-by executor (prints its own JSON line)

@@ -1,0 +1,56 @@
+"""multimodal-rag template (reference: docs/2.developers/7.templates/
+.multimodal-rag/article.py + 120.multimodal-rag.md — BASELINE.json config
+#5): a mixed text+image documents folder -> vision parser (images become
+LLM descriptions) -> ONE text embedder + vector store -> REST QA.
+
+Run: python app.py  (serves on the configured host/port)
+The default app.yaml runs fully offline on deterministic mocks; production
+swaps the llm/vision_llm/embedder entries for OpenAIChat (gpt-4o class) /
+SentenceTransformerEmbedder, exactly like the reference template.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.parsers import MultimodalParser
+from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+
+def run(config_path: str | None = None):
+    config_path = config_path or os.path.join(
+        os.path.dirname(__file__), "app.yaml"
+    )
+    with open(config_path) as f:
+        cfg = pw.load_yaml(f)
+
+    from pathway_tpu.internals.yaml_loader import resolve_config_path
+
+    docs_path = resolve_config_path(cfg["docs_path"], config_path)
+
+    docs = pw.io.fs.read(
+        docs_path, format="binary", with_metadata=True,
+        mode="streaming", autocommit_duration_ms=100,
+    )
+    parser = MultimodalParser(
+        llm=cfg["vision_llm"],
+        parse_prompt=cfg.get("parse_prompt"),
+    )
+    store = VectorStoreServer(
+        docs,
+        embedder=cfg["embedder"],
+        parser=parser,
+        splitter=cfg.get("splitter"),
+    )
+    rag = BaseRAGQuestionAnswerer(
+        llm=cfg["llm"], indexer=store, search_topk=cfg.get("search_topk", 6)
+    )
+    rag.build_server(host=cfg["host"], port=cfg["port"])
+    pw.run()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else None)

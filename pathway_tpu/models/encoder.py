@@ -198,8 +198,14 @@ class SentenceEncoder:
         jax array of shape [n, hidden]. Chaining this into device-side
         consumers (e.g. KnnShard.add) avoids the host round-trip and lets
         host tokenization of the next batch overlap device compute."""
-        texts = list(texts)
-        ids, mask = self.tokenizer(texts)
+        ids, mask = self.tokenizer(list(texts))
+        return self.encode_tokens_device(ids, mask)
+
+    def encode_tokens_device(self, ids: np.ndarray, mask: np.ndarray):
+        """Device-encode a pre-tokenized batch (async-dispatched) — the
+        shared padding+forward core. Lets a tokenize-ahead thread overlap
+        host tokenization of batch N+1 with device compute / transfers of
+        batch N — the ingest-throughput lever."""
         ids_p, mask_p, n = pad_batch(
             ids, mask, self.config.max_len, self.batch_size
         )
@@ -207,11 +213,7 @@ class SentenceEncoder:
         return emb[:n]
 
     def _encode_batch(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
-        ids_p, mask_p, n = pad_batch(
-            ids, mask, self.config.max_len, self.batch_size
-        )
-        emb = self._forward(self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
-        return np.asarray(emb[:n], np.float32)
+        return np.asarray(self.encode_tokens_device(ids, mask), np.float32)
 
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         return self.encode(texts)

@@ -10,6 +10,13 @@ mesh-sharded indexes (SURVEY §5 long-context mapping).
 
 from pathway_tpu.ops.topk import masked_topk, merge_topk
 from pathway_tpu.ops.knn import KnnShard, Metric
-from pathway_tpu.ops.query_engine import QueryEngine
+from pathway_tpu.ops.query_engine import MicroBatcher, QueryEngine
 
-__all__ = ["KnnShard", "Metric", "QueryEngine", "masked_topk", "merge_topk"]
+__all__ = [
+    "KnnShard",
+    "Metric",
+    "MicroBatcher",
+    "QueryEngine",
+    "masked_topk",
+    "merge_topk",
+]

@@ -10,6 +10,9 @@ exactly one device-to-host transfer per query batch.
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
 from typing import Any, Sequence
 
 import numpy as np
@@ -63,7 +66,11 @@ class QueryEngine:
             out.extend(self._query_batch(texts[start : start + cap]))
         return out
 
-    def _query_batch(self, texts: list[str]):
+    def dispatch(self, texts: list[str]):
+        """Phase 1: tokenize + launch the fused executable. Returns an
+        opaque (device_array, n) ticket without blocking — dispatch is
+        asynchronous, so the caller can have several tickets in flight
+        (the readbacks overlap on tunneled transports)."""
         from pathway_tpu.models.encoder import pad_batch
 
         ids, mask = self.encoder.tokenizer(texts)
@@ -76,7 +83,6 @@ class QueryEngine:
             raise ValueError(
                 "QueryEngine packed readback supports shards < 16.7M rows"
             )
-        k_eff = min(self.k, self.shard.capacity, self.shard.chunk or 8192)
         packed = self._fn(
             self.encoder.params,
             jnp.asarray(ids_p),
@@ -85,6 +91,12 @@ class QueryEngine:
             self.shard.valid,
             self.shard.sq_norms,
         )
+        return packed, n
+
+    def finish(self, ticket) -> list[list[tuple[Any, float]]]:
+        """Phase 2: the ONE device->host readback + result shaping."""
+        packed, n = ticket
+        k_eff = min(self.k, self.shard.capacity, self.shard.chunk or 8192)
         packed = np.asarray(packed)[:n]  # the ONE readback
         vals = packed[:, :k_eff]
         idx = packed[:, k_eff:].astype(np.int64)
@@ -102,3 +114,140 @@ class QueryEngine:
                     break
             out.append(hits)
         return out
+
+    def _query_batch(self, texts: list[str]):
+        return self.finish(self.dispatch(texts))
+
+
+class _Err:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class MicroBatcher:
+    """Concurrent serving front-end: collect in-flight queries for up to
+    ``max_wait_ms`` (or ``max_batch`` queries), then ONE fused
+    encode+search dispatch and ONE packed readback for the whole group.
+
+    This is the serving-loop analog of the engine's as-of-time index
+    batching (reference: src/engine/dataflow/operators/external_index.rs:
+    112-155 — index and query streams are merged and batched by logical
+    time); here the batch boundary is wall-clock micro-windows over
+    concurrent HTTP clients instead of a logical timestamp.
+
+    Two-stage pipeline: the collector thread tokenizes + dispatches
+    (asynchronous, sub-ms), a pool of readback threads blocks on the
+    device->host transfers — so on a tunneled transport several batches'
+    readbacks ride the link concurrently and throughput is bounded by
+    device work, not one round-trip per batch.
+    """
+
+    def __init__(
+        self,
+        engine: QueryEngine,
+        *,
+        max_wait_ms: float = 2.0,
+        max_batch: int | None = None,
+        readback_workers: int = 4,
+    ):
+        self.engine = engine
+        self.max_batch = max_batch or engine.encoder.batch_size
+        self.max_wait = max_wait_ms / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._tickets: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._collector = threading.Thread(target=self._collect, daemon=True)
+        self._readers = [
+            threading.Thread(target=self._readback, daemon=True)
+            for _ in range(max(1, readback_workers))
+        ]
+        self._collector.start()
+        for t in self._readers:
+            t.start()
+
+    # -- client API -------------------------------------------------------
+    def query(self, text: str, timeout: float | None = 30.0):
+        """Blocking single-query call, safe from many threads: the query
+        rides the next micro-batch. Returns [(key, score), ...]."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        slot: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._q.put((text, slot))
+        res = slot.get(timeout=timeout)
+        if isinstance(res, _Err):
+            raise res.exc
+        return res
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._collector.join(timeout=5)
+        # fail any request that raced past the closed check after the
+        # sentinel: an explicit error now beats an opaque timeout later
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[1].put(_Err(RuntimeError("MicroBatcher is closed")))
+        for _ in self._readers:
+            self._tickets.put(None)
+        for t in self._readers:
+            t.join(timeout=5)
+
+    # -- pipeline stages --------------------------------------------------
+    def _collect(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self.max_wait
+            while len(batch) < self.max_batch:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=rem)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _flush(self, batch: list) -> None:
+        texts = [t for t, _ in batch]
+        slots = [s for _, s in batch]
+        if not self.engine.shard.key_to_slot:
+            for s in slots:
+                s.put([])
+            return
+        try:
+            ticket = self.engine.dispatch(texts)
+        except Exception as exc:
+            for s in slots:
+                s.put(_Err(exc))
+            return
+        self._tickets.put((ticket, slots))
+
+    def _readback(self) -> None:
+        while True:
+            got = self._tickets.get()
+            if got is None:
+                return
+            ticket, slots = got
+            try:
+                results = self.engine.finish(ticket)
+            except Exception as exc:
+                for s in slots:
+                    s.put(_Err(exc))
+                continue
+            for s, r in zip(slots, results):
+                s.put(r)

@@ -27,6 +27,34 @@ class IdentityMockChat(BaseChat):
         super().__init__(chat, return_type=str, deterministic=True)
 
 
+class DeterministicVisionMockChat:
+    """Vision-LLM mock for the multimodal pipeline: given an ImageParser
+    message (prompt + base64 data-url), answers with a deterministic
+    description derived from the image bytes — so template tests can
+    assert that image-derived chunks are indexed and retrieved without any
+    real vision model (CI substrate pattern, SURVEY §4)."""
+
+    captions = {
+        "mock-chart": "a bar chart showing quarterly revenue growth",
+        "mock-slide": "a slide describing the streaming architecture",
+    }
+
+    def func(self, messages):
+        import base64
+
+        content = messages[-1]["content"]
+        url = next(
+            (c["image_url"]["url"] for c in content if c.get("type") == "image_url"),
+            "",
+        )
+        raw = base64.b64decode(url.split(",", 1)[1]) if "," in url else b""
+        for marker, caption in self.captions.items():
+            if marker.encode() in raw:
+                return caption
+        digest = hashlib.blake2b(raw, digest_size=4).hexdigest()
+        return f"an image with fingerprint {digest}"
+
+
 class DeterministicMockEmbedder(UDF):
     """Stable pseudo-random unit vector per text — hashed, so embeddings
     are identical across processes/runs (test_vector_store.py pattern)."""

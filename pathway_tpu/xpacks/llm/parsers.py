@@ -231,6 +231,61 @@ class SlideParser(ImageParser):
     """reference: parsers.py SlideParser — vision-LLM slide parsing."""
 
 
+class MultimodalParser(UDF):
+    """Content-sniffing router for mixed corpora — the parser behind the
+    multimodal-RAG template (reference: docs/2.developers/7.templates/
+    .multimodal-rag/article.py — OpenParse + a vision LLM over documents
+    with images/tables, feeding ONE text embedder). The tpu-native wiring
+    keeps a single text-embedding index: raster images go through the
+    vision ``ImageParser`` (the vision LLM's description becomes the
+    indexed text), PDFs through ``PypdfParser``, everything else through
+    ``ParseUtf8``."""
+
+    _MAGIC = (
+        (b"\x89PNG", "image"),
+        (b"\xff\xd8\xff", "image"),
+        (b"GIF87a", "image"),
+        (b"GIF89a", "image"),
+        (b"%PDF", "pdf"),
+    )
+
+    def __init__(self, llm=None, parse_prompt: str | None = None, **kwargs):
+        if llm is None:
+            raise ValueError(
+                "MultimodalParser requires a vision-capable llm for the "
+                "image route"
+            )
+        image_parser = ImageParser(llm=llm, parse_prompt=parse_prompt)
+        pdf_parser = PypdfParser()
+        text_parser = ParseUtf8()
+
+        async def parse(contents) -> list:
+            import inspect
+
+            data = bytes(contents) if not isinstance(contents, bytes) else contents
+            kind = "text"
+            for magic, k in MultimodalParser._MAGIC:
+                if data.startswith(magic):
+                    kind = k
+                    break
+            # WebP: RIFF container with a WEBP fourcc — plain RIFF alone
+            # is also WAV/AVI, which must NOT route to the vision parser
+            if data[:4] == b"RIFF" and data[8:12] == b"WEBP":
+                kind = "image"
+            route = {
+                "image": image_parser,
+                "pdf": pdf_parser,
+                "text": text_parser,
+            }[kind]
+            res = route.func(data)
+            if inspect.iscoroutine(res):
+                res = await res
+            # tag the modality so retrieval results disclose their source
+            return [(text, {**meta, "modality": kind}) for text, meta in res]
+
+        super().__init__(parse, return_type=list, deterministic=True)
+
+
 class OpenParse(UDF):
     """reference: parsers.py OpenParse — table/vision pdf pipeline."""
 

@@ -90,6 +90,55 @@ def test_adaptive_rag_template(tmp_path):
     assert out["response"] is not None
 
 
+def test_multimodal_rag_template(tmp_path):
+    """examples/multimodal-rag (BASELINE.json config #5): text + image
+    docs through the content-sniffing MultimodalParser — image bytes
+    become deterministic vision-mock captions, everything lands in ONE
+    text-embedded index, and retrieval surfaces image-derived chunks."""
+    import shutil
+
+    template_docs = os.path.join(
+        _REPO_ROOT, "examples", "multimodal-rag", "docs"
+    )
+    port = _free_port()
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    for name in os.listdir(template_docs):
+        shutil.copy(os.path.join(template_docs, name), docs / name)
+    cfg = open(
+        os.path.join(_REPO_ROOT, "examples", "multimodal-rag", "app.yaml")
+    ).read()
+    cfg = cfg.replace("./docs", str(docs))
+    cfg = cfg.replace("port: 8000", f"port: {port}")
+    config = tmp_path / "app.yaml"
+    config.write_text(cfg)
+
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "examples", "multimodal-rag"))
+    try:
+        app = importlib.import_module("app")
+        threading.Thread(target=app.run, args=(str(config),), daemon=True).start()
+    finally:
+        sys.path.pop(0)
+        sys.modules.pop("app", None)
+
+    # image query: the vision mock captioned revenue-chart.png as a bar
+    # chart; retrieval must find that caption and the LLM echo includes it
+    out = _post_with_retries(
+        f"http://127.0.0.1:{port}/v2/answer",
+        {"prompt": "bar chart showing quarterly revenue"},
+    )
+    assert "revenue growth" in out["response"]
+    # text query still routes to the text document
+    out2 = _post_with_retries(
+        f"http://127.0.0.1:{port}/v2/answer",
+        {"prompt": "what does the multimodal pipeline index"},
+    )
+    assert "vector store" in out2["response"] or "image" in out2["response"]
+
+
 def test_etl_lakehouse_template():
     """examples/etl-lakehouse: object store -> incremental aggregates ->
     Delta Lake + Postgres snapshot, against its self-contained local
