@@ -42,7 +42,12 @@ class Runtime:
         persistence=None,
         with_http_server: bool = False,
         monitoring_level=None,
+        local_only: bool = False,
     ):
+        # local_only: never join the process mesh even when
+        # PATHWAY_PROCESSES>1 — used by throwaway inner runtimes (the
+        # iterate fixpoint body) that run a complete local subgraph
+        self.local_only = local_only
         self.scope = Scope(self)
         self.pending_times: dict[int, set[int]] = {}  # time -> set of node ids
         # min-heap over pending timestamps: the scheduler pops times in
@@ -84,6 +89,8 @@ class Runtime:
     # -- multi-process plane ----------------------------------------------
     @property
     def distributed(self) -> bool:
+        if self.local_only:
+            return False
         from pathway_tpu.internals.config import get_pathway_config
 
         return get_pathway_config().processes > 1
@@ -308,11 +315,6 @@ class Runtime:
         self._finish()
 
     def run(self) -> None:
-        if self.distributed and self.persistence is not None:
-            raise NotImplementedError(
-                "persistence with PATHWAY_PROCESSES>1 is not supported yet; "
-                "run persistence per-process or single-process"
-            )
         try:
             if not self.connectors:
                 self.run_static()
@@ -534,6 +536,160 @@ class Runtime:
                 conn.thread.join(timeout=5)
         self._finish()
 
+    # -- multi-process persistence (reference: tracker.rs:47,160-193 — the
+    # commit tracker is per-worker with a global consistent cut: a
+    # snapshot timestamp only advances when every worker durably wrote it)
+
+    def _pname(self, conn_name: str) -> str:
+        """Rank-scoped persistence name: every rank journals its own
+        connectors under its own keyspace on the shared backend (the same
+        program runs on every rank, so unscoped names would collide)."""
+        from pathway_tpu.internals.config import get_pathway_config
+
+        return f"r{get_pathway_config().process_id}/{conn_name}"
+
+    def _bsp_inject_commits(self, pg, commits, done_local, tag) -> bool:
+        """One BSP ingest round: gather per-rank commit counts, let the
+        rank-0 clock master assign globally ordered times (rank-major),
+        inject, and walk the lockstep frontier. Returns alldone (= every
+        rank reported done and no rank contributed a commit)."""
+        if pg.rank == 0:
+            info = pg.gather0(tag, (len(commits), done_local))
+            counts = [c for c, _ in info]
+            alldone = all(d for _, d in info)
+            base = self._next_time() if sum(counts) else self.clock
+            base, counts, alldone = pg.bcast0(
+                (tag[0] + "2", tag[1]), (base, counts, alldone)
+            )
+        else:
+            pg.gather0(tag, (len(commits), done_local))
+            base, counts, alldone = pg.bcast0((tag[0] + "2", tag[1]))
+        total = sum(counts)
+        my_off = sum(counts[: pg.rank])
+        for i, (conn, deltas) in enumerate(commits):
+            t = base + 2 * (my_off + i)
+            self.stats.on_ingest(conn.name, len(deltas))
+            conn.node.accept(t, 0, deltas)
+        if total:
+            self.clock = max(self.clock, base + 2 * (total - 1))
+        self._step_lockstep(self.clock + 1)
+        return alldone and total == 0
+
+    def _replay_journals_distributed(self, pg, live) -> None:
+        """Input-journal restore across the mesh: every rank replays its
+        own rank-scoped journals, one entry per connector per BSP round,
+        so exchanges re-shard the replayed rows exactly like live ingest.
+        Cross-rank interleaving need not match the original run — every
+        commit gets its own fresh timestamp and the dataflow is
+        deterministic per commit order on each connector, which the
+        per-rank journal preserves."""
+        cursors = []
+        for conn in live:
+            entries = self.persistence.load_journal(self._pname(conn.name))
+            last_state = None
+            for _t, _d, s in entries:
+                if s is not None:
+                    last_state = s
+            state = (
+                last_state
+                if last_state is not None
+                else self.persistence.load_subject_state(
+                    self._pname(conn.name)
+                )
+            )
+            cursors.append((conn, entries, state))
+        idx = 0
+        round_no = 0
+        while True:
+            round_no += 1
+            commits = []
+            for conn, entries, _state in cursors:
+                if idx < len(entries) and entries[idx][1]:
+                    commits.append((conn, entries[idx][1]))
+            done_local = all(idx + 1 >= len(e) for _, e, _ in cursors)
+            alldone = self._bsp_inject_commits(
+                pg, commits, done_local, ("jr", round_no)
+            )
+            idx += 1
+            if alldone:
+                break
+        for conn, _entries, state in cursors:
+            if state is not None and hasattr(conn.subject, "seek"):
+                conn.subject.seek(state)
+
+    def _restore_operator_snapshot_distributed(self, pg, live) -> None:
+        """All-or-nothing rank-local snapshot restore: rank 0 reads the
+        commit marker (written only after every rank acked a snapshot
+        tag), every rank loads its own snapshot at that tag, and restore
+        is skipped entirely unless every rank has a matching, fingerprint-
+        compatible snapshot."""
+        tag = (
+            self.persistence.read_marker("snapshot_commit")
+            if pg.rank == 0
+            else None
+        )
+        tag = pg.bcast0(("snaptag",), tag)
+        if tag is not None:
+            # tags stay monotone across restarts: live-loop rounds restart
+            # at 1, so new tags build on the restored one — pruning and
+            # marker ordering remain correct over kill/restart cycles
+            self._snap_tag_base = tag
+        if tag is None:
+            return
+        snap = self.persistence.load_operator_snapshot(
+            key=f"operator_snapshot/r{pg.rank}/{tag}"
+        )
+        ok = snap is not None
+        if ok:
+            _states, _subjects, fingerprint = snap
+            ok = fingerprint == [node.name() for node in self.scope.nodes]
+        flags = pg.gather0(("snapok",), ok)
+        do = pg.bcast0(("snapok2",), all(flags) if pg.rank == 0 else None)
+        if not do:
+            if ok is False and snap is not None:
+                raise RuntimeError(
+                    "operator snapshot does not match this pipeline's "
+                    "graph shape — clear the persistence directory or "
+                    "revert the pipeline"
+                )
+            return
+        node_states, subject_states, _fp = snap
+        for node, state in zip(self.scope.nodes, node_states):
+            if state:
+                node.load_state(state)
+        self._operator_subject_states.update(subject_states)
+        for conn in live:
+            state = subject_states.get(conn.name)
+            if state is not None and hasattr(conn.subject, "seek"):
+                conn.subject.seek(state)
+
+    def _save_operator_snapshot_distributed(self, pg, round_no) -> None:
+        """Two-phase consistent cut: every rank writes its rank-local
+        snapshot tagged with the agreed round, rank 0 collects the acks
+        and only then moves the commit marker — so the marker always
+        names a tag for which every rank's snapshot exists durably."""
+        tag = getattr(self, "_snap_tag_base", 0) + round_no
+        self.persistence.save_operator_snapshot(
+            [node.state_dict() for node in self.scope.nodes],
+            dict(self._operator_subject_states),
+            [node.name() for node in self.scope.nodes],
+            key=f"operator_snapshot/r{pg.rank}/{tag}",
+        )
+        pg.gather0(("snapack", tag), True)
+        if pg.rank == 0:
+            self.persistence.write_marker("snapshot_commit", tag)
+        pg.barrier(("snapbar", tag))
+        # prune every superseded snapshot for this rank (best-effort);
+        # "everything except the just-committed tag" also reclaims stale
+        # higher-numbered tags stranded by earlier runs
+        prefix = f"operator_snapshot/r{pg.rank}/"
+        for key in self.persistence.list_keys(prefix):
+            try:
+                if int(key[len(prefix):].split("/")[0]) != tag:
+                    self.persistence.delete_key(key)
+            except ValueError:
+                pass
+
     def _run_streaming_distributed(self) -> None:
         """Round-based BSP ingest for PATHWAY_PROCESSES>1 (reference: the
         timely worker loop with exchange + progress channels,
@@ -568,6 +724,16 @@ class Runtime:
                 conn.finished = True
                 continue
             live.append(conn)
+
+        operator_mode = (
+            self.persistence is not None
+            and self.persistence.mode == "OPERATOR_PERSISTING"
+        )
+        if operator_mode:
+            self._restore_operator_snapshot_distributed(pg, live)
+        elif self.persistence is not None:
+            self._replay_journals_distributed(pg, live)
+
         for conn in live:
             conn.thread = threading.Thread(
                 target=run_connector_thread,
@@ -585,38 +751,66 @@ class Runtime:
                     conn.force_flush()
             entries = self._drain_event_queue(0.2)
             commits = []
+            saw_data = False
             for conn, deltas, state, journal_rows in entries:
                 if deltas is None:
                     conn.finished = True
                     active -= 1
-                elif deltas:
+                    continue
+                if (
+                    self.persistence is not None
+                    and not operator_mode
+                    and journal_rows
+                ):
+                    # write-ahead, rank-local journal (same consistency
+                    # contract as the single-process path: stateless
+                    # subjects journal every flush; stateful subjects at
+                    # their own commit boundaries with a claiming state)
+                    self.persistence.journal_batch(
+                        self._pname(conn.name), self.clock, journal_rows,
+                        state,
+                    )
+                if state is not None:
+                    self._operator_subject_states[conn.name] = state
+                    self._uncovered.discard(conn.name)
+                elif (
+                    deltas
+                    and self.persistence is not None
+                    and hasattr(conn.subject, "snapshot_state")
+                ):
+                    self._uncovered.add(conn.name)
+                if deltas:
+                    saw_data = True
                     commits.append((conn, deltas))
-            done_local = active == 0
-            if pg.rank == 0:
-                info = pg.gather0(("r", round_no), (len(commits), done_local))
-                counts = [c for c, _ in info]
-                alldone = all(d for _, d in info)
-                base = self._next_time() if sum(counts) else self.clock
-                base, counts, alldone = pg.bcast0(
-                    ("r2", round_no), (base, counts, alldone)
+            alldone = self._bsp_inject_commits(
+                pg, commits, active == 0, ("r", round_no)
+            )
+            if operator_mode:
+                # lockstep snapshot decision: a cut is taken only when
+                # EVERY rank is ready (interval elapsed on the rank-0
+                # pacer, no rank has uncovered stateful rows) and some
+                # rank saw data since the last cut
+                now = _time.monotonic()
+                ready = not self._uncovered
+                flags = pg.gather0(
+                    ("snapq", round_no), (ready, saw_data)
                 )
-            else:
-                pg.gather0(("r", round_no), (len(commits), done_local))
-                base, counts, alldone = pg.bcast0(("r2", round_no))
-            total = sum(counts)
-            my_off = sum(counts[: pg.rank])
-            for i, (conn, deltas) in enumerate(commits):
-                t = base + 2 * (my_off + i)
-                self.stats.on_ingest(conn.name, len(deltas))
-                conn.node.accept(t, 0, deltas)
-            if total:
-                # every rank tracks the master clock so locally minted
-                # times (error log at clock+1) stay globally consistent
-                self.clock = max(self.clock, base + 2 * (total - 1))
-            self._step_lockstep(self.clock + 1)
+                if pg.rank == 0:
+                    do = (
+                        all(r for r, _ in flags)
+                        and any(d for _, d in flags)
+                        and (now - self._last_snapshot) * 1000.0
+                        >= self.persistence.snapshot_interval_ms
+                    )
+                else:
+                    do = None
+                do = pg.bcast0(("snapq2", round_no), do)
+                if do:
+                    self._last_snapshot = now
+                    self._save_operator_snapshot_distributed(pg, round_no)
             if self.error and self.terminate_on_error:
                 raise self.error
-            if alldone and total == 0:
+            if alldone:
                 break
         self._step_lockstep(None)
         for conn in live:

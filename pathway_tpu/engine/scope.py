@@ -46,6 +46,8 @@ class Scope:
     # Row-id-keyed state (buffers/freeze/forget) needs no exchange: row ids
     # are globally unique, so per-row state is always local.
     def _world(self) -> int:
+        if getattr(self.runtime, "local_only", False):
+            return 1  # throwaway inner runtimes never join the mesh
         from pathway_tpu.internals.config import get_pathway_config
 
         return max(1, get_pathway_config().processes)

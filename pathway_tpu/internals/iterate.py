@@ -26,10 +26,16 @@ from pathway_tpu.internals.universe import Universe
 
 
 class _IterateOutputNode(Node):
-    """Reader for one output slot of an IterateNode (fed directly)."""
+    """Reader for one output slot of an IterateNode. Fed directly via
+    accept() (the IterateNode routes per-name outputs itself), but the
+    graph edge from the IterateNode matters: the multi-process lockstep
+    protocol computes downstream-reachable exchange masks over the static
+    graph, and without the edge the ranks would disagree mid-timestep on
+    which exchanges an iterate output can feed (runtime.py
+    _exchange_reach_masks)."""
 
-    def __init__(self, scope):
-        super().__init__(scope, [])
+    def __init__(self, scope, iter_node):
+        super().__init__(scope, [iter_node])
 
     def process(self, time, batches):
         return consolidate(batches[0])
@@ -45,7 +51,6 @@ class IterateNode(Node):
         body_ops: list,
         result_tables: dict[str, Any],  # name -> body output DSL table
         extra_tables: list,             # outer tables used by the body
-        output_nodes: dict[str, _IterateOutputNode],
         iteration_limit: int | None,
     ):
         super().__init__(scope, input_nodes)
@@ -54,11 +59,19 @@ class IterateNode(Node):
         self.body_ops = body_ops
         self.result_tables = result_tables
         self.extra_tables = extra_tables
-        self.output_nodes = output_nodes
+        # set via attach_outputs (output nodes need this node as their
+        # graph input, so they are created after it)
+        self.output_nodes: dict[str, _IterateOutputNode] = {}
         self.iteration_limit = iteration_limit
         self.states = [TableState() for _ in input_nodes]
         # name -> {key: row} last emitted output
-        self.emitted: dict[str, dict] = {name: {} for name in output_nodes}
+        self.emitted: dict[str, dict] = {}
+
+    def attach_outputs(
+        self, output_nodes: dict[str, "_IterateOutputNode"]
+    ) -> None:
+        self.output_nodes = output_nodes
+        self.emitted = {name: {} for name in output_nodes}
 
     def process(self, time, batches):
         for st, batch in zip(self.states, batches):
@@ -107,7 +120,10 @@ class IterateNode(Node):
         from pathway_tpu.engine.runtime import Runtime
         from pathway_tpu.internals.graph_runner import LoweringContext
 
-        rt = Runtime()
+        # local_only: the fixpoint body is a complete local subgraph over
+        # this node's (gathered) state — it must not try to join the
+        # process mesh even under PATHWAY_PROCESSES>1
+        rt = Runtime(local_only=True)
         ctx = LoweringContext(rt)
         for name, ph in self.placeholders.items():
             rows = [(k, row) for k, row in iter_state[name].items()]
@@ -158,15 +174,6 @@ def iterate(
 
     if not kwargs:
         raise ValueError("iterate() needs at least one table argument")
-    from pathway_tpu.internals.config import get_pathway_config
-
-    if get_pathway_config().processes > 1:
-        raise NotImplementedError(
-            "pw.iterate is not supported with PATHWAY_PROCESSES>1: the "
-            "fixpoint loop re-steps its subgraph a data-dependent number "
-            "of times per rank, which cannot ride the lockstep exchange "
-            "protocol; run iteration single-process"
-        )
     tables = {name: t for name, t in kwargs.items()}
     placeholders = {
         name: Table(t._schema_cls, Universe()) for name, t in tables.items()
@@ -212,12 +219,22 @@ def iterate(
     }
 
     def lower(ctx):
-        input_nodes = [ctx.engine_table(t).node for t in tables.values()]
-        input_nodes += [ctx.engine_table(t).node for t in extra_tables]
-        out_nodes = {
-            name: _IterateOutputNode(ctx.scope) for name in outputs
-        }
-        IterateNode(
+        # Multi-process: every input gathers to rank 0, the fixpoint runs
+        # there over the full state, and downstream ExchangeNodes re-shard
+        # the converged output — the iterate scope is a non-partitioned
+        # operator, like the reference's worker-0-reads-then-exchanges
+        # pattern for unpartitioned sources (SURVEY §5). The fixpoint's
+        # data-dependent re-stepping therefore never has to ride the
+        # lockstep exchange protocol mid-iteration.
+        input_nodes = [
+            ctx.scope._exchange(ctx.engine_table(t), mode="gather").node
+            for t in tables.values()
+        ]
+        input_nodes += [
+            ctx.scope._exchange(ctx.engine_table(t), mode="gather").node
+            for t in extra_tables
+        ]
+        iter_node = IterateNode(
             ctx.scope,
             input_nodes,
             list(tables.values()),
@@ -225,9 +242,13 @@ def iterate(
             body_ops,
             result_map,
             extra_tables,
-            out_nodes,
             iteration_limit,
         )
+        out_nodes = {
+            name: _IterateOutputNode(ctx.scope, iter_node)
+            for name in outputs
+        }
+        iter_node.attach_outputs(out_nodes)
         for name, t in outputs.items():
             ctx.set_engine_table(
                 t, EngineTable(out_nodes[name], len(t._column_names))

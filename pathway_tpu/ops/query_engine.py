@@ -35,7 +35,10 @@ class QueryEngine:
         model = encoder.model
         chunk = shard.chunk
         precision = shard.precision
-        k_eff = min(k, shard.capacity, shard.chunk or 8192)
+        # the packed-buffer layout [vals | idx] is baked into the jitted
+        # executable here; finish() must slice with THIS k_eff even if the
+        # shard's capacity grows later
+        k_eff = self.k_eff = min(k, shard.capacity, shard.chunk or 8192)
         from pathway_tpu.ops.knn import Metric
 
         # encoder outputs are L2-normalized, so cos == dot on the query
@@ -96,7 +99,7 @@ class QueryEngine:
     def finish(self, ticket) -> list[list[tuple[Any, float]]]:
         """Phase 2: the ONE device->host readback + result shaping."""
         packed, n = ticket
-        k_eff = min(self.k, self.shard.capacity, self.shard.chunk or 8192)
+        k_eff = self.k_eff  # compiled-in layout, not current capacity
         packed = np.asarray(packed)[:n]  # the ONE readback
         vals = packed[:, :k_eff]
         idx = packed[:, k_eff:].astype(np.int64)
@@ -153,7 +156,11 @@ class MicroBatcher:
         readback_workers: int = 4,
     ):
         self.engine = engine
-        self.max_batch = max_batch or engine.encoder.batch_size
+        # clamp to the encoder's padded batch capacity: _flush dispatches
+        # one batch directly, bypassing query()'s cap-splitting
+        self.max_batch = min(
+            max_batch or engine.encoder.batch_size, engine.encoder.batch_size
+        )
         self.max_wait = max_wait_ms / 1000.0
         self._q: "queue.Queue" = queue.Queue()
         self._tickets: "queue.Queue" = queue.Queue()

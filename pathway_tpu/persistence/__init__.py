@@ -128,6 +128,12 @@ class _FsBackend(_BackendBase):
                     out.append(rel)
         return sorted(out)
 
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._p(key))
+        except FileNotFoundError:
+            pass
+
 
 class _ObjectStoreBackend(_BackendBase):
     """Persistence over an object store (reference: S3 backend,
@@ -180,6 +186,14 @@ class _ObjectStoreBackend(_BackendBase):
             rel = path[len(self.root) + 1 :] if self.root else path
             out.add(rel.split(".part/")[0])
         return sorted(out)
+
+    def delete(self, key: str) -> None:
+        delete = getattr(self.client, "delete", None)
+        if delete is None:
+            return
+        for part in self.client.list(self._p(key) + ".part/"):
+            delete(part)
+        delete(self._p(key))
 
 
 class _GcsClient:
@@ -266,6 +280,9 @@ class _MemoryBackend(_BackendBase):
 
     def list_keys(self, prefix: str) -> list[str]:
         return sorted(k for k in self.data if k.startswith(prefix))
+
+    def delete(self, key: str) -> None:
+        self.data.pop(key, None)
 
 
 class Backend:
@@ -379,14 +396,44 @@ class PersistenceManager:
 
     # -- operator snapshots (reference: operator_snapshot.rs) --------------
     def save_operator_snapshot(
-        self, node_states: list, subject_states: dict, fingerprint: list
+        self,
+        node_states: list,
+        subject_states: dict,
+        fingerprint: list,
+        *,
+        key: str = "operator_snapshot",
     ) -> None:
         with self.lock:
             self.backend.write(
-                "operator_snapshot",
+                key,
                 pickle.dumps((node_states, subject_states, fingerprint)),
             )
 
-    def load_operator_snapshot(self):
-        raw = self.backend.read("operator_snapshot")
+    def load_operator_snapshot(self, *, key: str = "operator_snapshot"):
+        raw = self.backend.read(key)
         return pickle.loads(raw) if raw else None
+
+    # -- multi-process consistent cut (reference: tracker.rs:47,160-193 —
+    # per-worker persistent storage; a snapshot timestamp only advances
+    # when every worker has durably written it) ---------------------------
+    def write_marker(self, name: str, value: Any) -> None:
+        """Tiny commit-marker record (e.g. the globally agreed snapshot
+        tag). Written by rank 0 only AFTER every rank acked its rank-local
+        snapshot, so the marker always names a complete consistent cut."""
+        with self.lock:
+            self.backend.write(f"marker/{name}", pickle.dumps(value))
+
+    def read_marker(self, name: str) -> Any | None:
+        raw = self.backend.read(f"marker/{name}")
+        return _safe_loads(raw) if raw else None
+
+    def delete_key(self, key: str) -> None:
+        """Best-effort cleanup of superseded rank snapshots."""
+        try:
+            with self.lock:
+                self.backend.delete(key)
+        except (AttributeError, OSError):
+            pass
+
+    def list_keys(self, prefix: str) -> list[str]:
+        return self.backend.list_keys(prefix)

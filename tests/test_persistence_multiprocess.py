@@ -1,0 +1,172 @@
+"""Multi-process persistence oracle (reference pattern:
+integration_tests/wordcount/test_recovery.py:38 — kill a persistent
+pipeline mid-stream, restart, assert exactly-once-looking output; here
+with PATHWAY_PROCESSES=2 over the TCP mesh: rank-local journals plus the
+rank-0 commit cut, reference src/persistence/tracker.rs:47,160-193)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+_WORDCOUNT = textwrap.dedent(
+    """
+    import os, sys, threading, time
+    sys.path.insert(0, {repo!r})
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pdir, docs_dir, out_path = sys.argv[1:4]
+
+    words = pw.io.fs.read(
+        docs_dir, format="plaintext", mode="streaming",
+        autocommit_duration_ms=10, refresh_interval=0.05,
+        name="words",
+    )
+    counts = words.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+
+    import json
+    seen = {{}}
+    if (
+        os.environ.get("WC_PERSISTENCE_MODE") == "OPERATOR_PERSISTING"
+        and os.path.exists(out_path)
+    ):
+        # operator-persistence contract: restored node state does NOT
+        # re-notify sinks; sinks keep their own durable state (reference:
+        # tracker.rs per-sink finalized times)
+        with open(out_path) as f:
+            seen = json.load(f)
+    def on_change(key, row, time_, diff):
+        if diff > 0:
+            seen[row["word"]] = row["c"]
+        elif row["word"] in seen and seen[row["word"]] == row["c"]:
+            del seen[row["word"]]
+        with open(out_path, "w") as f:
+            json.dump(seen, f)
+
+    pw.io.subscribe(counts, on_change=on_change)
+
+    def stopper():
+        time.sleep(6.0)
+        os._exit(0)  # bounded run: static docs dir drains quickly
+    threading.Thread(target=stopper, daemon=True).start()
+
+    mode = os.environ.get("WC_PERSISTENCE_MODE", "PERSISTING")
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pdir),
+            persistence_mode=mode,
+            snapshot_interval_ms=100,
+        )
+    )
+    """
+)
+
+
+def _spawn_ranks(tmp, first_port: int, mode: str = "PERSISTING") -> list:
+    script = os.path.join(tmp, "wc.py")
+    with open(script, "w") as f:
+        f.write(_WORDCOUNT.format(repo=os.getcwd()))
+    procs = []
+    for rank in range(2):
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    script,
+                    os.path.join(tmp, "pstorage"),
+                    os.path.join(tmp, "docs"),
+                    os.path.join(tmp, f"out_r{rank}.json"),
+                ],
+                env={
+                    **os.environ,
+                    "JAX_PLATFORMS": "cpu",
+                    "PATHWAY_PROCESSES": "2",
+                    "PATHWAY_PROCESS_ID": str(rank),
+                    "PATHWAY_FIRST_PORT": str(first_port),
+                    "WC_PERSISTENCE_MODE": mode,
+                },
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+        )
+    return procs
+
+
+def _free_port_pair() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _kill_restart_oracle(tmp_path, mode: str):
+    tmp = str(tmp_path)
+    docs = os.path.join(tmp, "docs")
+    os.makedirs(docs)
+    # enough files that BOTH ranks own a path shard (fs shards by rank)
+    for i in range(6):
+        with open(os.path.join(docs, f"f{i}.txt"), "w") as f:
+            f.write("alpha\nbeta\n" if i % 2 == 0 else "alpha\n")
+
+    # phase 1: run 2 ranks, wait until output + durable state prove real
+    # progress (startup includes a multi-second jax import), then hard-kill
+    procs = _spawn_ranks(tmp, _free_port_pair(), mode)
+    out0 = os.path.join(tmp, "out_r0.json")
+    durable = os.path.join(tmp, "pstorage")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        has_out = os.path.exists(out0)
+        has_state = os.path.isdir(durable) and any(
+            os.path.isfile(os.path.join(r, f))
+            for r, _, fs in os.walk(durable)
+            for f in fs
+        )
+        if has_out and has_state:
+            break
+        if any(p.poll() is not None for p in procs):
+            break  # a rank exited early; assertions below will explain
+        time.sleep(0.1)
+    else:
+        errs = []
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=30)
+            errs.append(p.stderr.read().decode()[-2000:])
+        raise AssertionError(f"phase 1 made no durable progress: {errs}")
+    for p in procs:
+        p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=30)
+
+    # between runs: new data arrives
+    with open(os.path.join(docs, "f_new.txt"), "w") as f:
+        f.write("gamma\nalpha\n")
+
+    # phase 2: restart — every rank restores its own rank-scoped state,
+    # scan states skip re-reading claimed files, the new file is fresh
+    procs = _spawn_ranks(tmp, _free_port_pair(), mode)
+    rcs = [p.wait(timeout=90) for p in procs]
+    errs = [p.stderr.read().decode()[-2000:] for p in procs]
+    assert rcs == [0, 0], errs
+
+    # rank 0 holds the gathered output (scope.output gathers to rank 0)
+    with open(os.path.join(tmp, "out_r0.json")) as f:
+        counts = json.load(f)
+    assert counts == {"alpha": 7, "beta": 3, "gamma": 1}, (counts, errs)
+
+
+def test_multiprocess_wordcount_kill_and_recover(tmp_path):
+    _kill_restart_oracle(tmp_path, "PERSISTING")
+
+
+def test_multiprocess_wordcount_operator_snapshot_recover(tmp_path):
+    _kill_restart_oracle(tmp_path, "OPERATOR_PERSISTING")
