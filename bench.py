@@ -263,6 +263,56 @@ def bench_rag(
     return single, under_load
 
 
+def bench_ann() -> dict | None:
+    """ANN quality + speed on the host-side C++ HNSW (f16-quantized,
+    reference bar: usearch f16): recall@10 vs the exact oracle and query
+    throughput over BENCH_ANN_N vectors."""
+    from pathway_tpu.native import NativeHnsw, available
+
+    if not available():
+        return None
+    n = int(os.environ.get("BENCH_ANN_N", "100000"))
+    dim, k, n_queries = 96, 10, 200
+    rng = np.random.default_rng(5)
+    centers = rng.normal(size=(64, dim)).astype(np.float32) * 3.0
+    vectors = centers[rng.integers(0, 64, size=n)] + rng.normal(
+        size=(n, dim)
+    ).astype(np.float32)
+    vectors /= np.linalg.norm(vectors, axis=1, keepdims=True)
+    index = NativeHnsw(dim, "cos", M=16, ef_build=128, ef_search=96)
+    t0 = time.perf_counter()
+    for i in range(n):
+        index.add(i, vectors[i])
+    build_s = time.perf_counter() - t0
+
+    q_idx = rng.integers(0, n, size=n_queries)
+    queries = vectors[q_idx] + 0.05 * rng.normal(
+        size=(n_queries, dim)
+    ).astype(np.float32)
+    queries = (
+        queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    ).astype(np.float32)
+    truth = np.argsort(-(queries @ vectors.T), axis=1)[:, :k]
+    t0 = time.perf_counter()
+    hit = 0
+    for qi in range(n_queries):
+        got = {key for key, _ in index.search(queries[qi], k)}
+        hit += len(got & set(truth[qi].tolist()))
+    search_s = time.perf_counter() - t0
+    recall = hit / (n_queries * k)
+    return {
+        "metric": "ann_recall_at_10",
+        "value": round(recall, 4),
+        "unit": "recall",
+        "n_vectors": n,
+        "dim": dim,
+        "build_s": round(build_s, 1),
+        "queries_per_s": round(n_queries / search_s, 1),
+        "quantization": "f16",
+        "vs_baseline": round(recall / 0.95, 3),
+    }
+
+
 def main() -> None:
     from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
 
@@ -285,6 +335,10 @@ def main() -> None:
     rag, under_load = bench_rag(enc, n_docs)
     print(json.dumps(rag), flush=True)
     print(json.dumps(under_load), flush=True)
+
+    ann = bench_ann()
+    if ann is not None:
+        print(json.dumps(ann), flush=True)
 
     # relational plane: streaming wordcount through the sharded native
     # group-by executor (prints its own JSON line)

@@ -1,9 +1,14 @@
 // Native HNSW approximate-nearest-neighbor index — C++ core replacing the
-// reference's usearch FFI (src/external_integration/usearch_integration.rs).
-// Cosine/L2/IP metrics, incremental add/remove (soft delete), C ABI.
+// reference's usearch FFI (src/external_integration/usearch_integration.rs
+// :20-120 — usearch runs f16-quantized storage by default; so does this
+// index: vectors are stored as IEEE 754 half floats, halving resident
+// memory, with queries decoded to f32 on the fly).
 //
 // Standard HNSW (Malkov & Yashunin): layered proximity graphs; greedy
-// descent from the top layer, beam search (ef) at layer 0.
+// descent from the top layer, beam search (ef) at layer 0, and the
+// paper's neighbor-selection heuristic (a candidate is linked only if it
+// is closer to the new node than to any already-selected neighbor),
+// which is what keeps recall high on clustered data.
 
 #include <algorithm>
 #include <cmath>
@@ -19,6 +24,56 @@ namespace {
 
 enum Metric : int32_t { COS = 0, L2SQ = 1, IP = 2 };
 
+// -- IEEE 754 binary16 <-> binary32, portable bit manipulation ----------
+
+inline uint16_t f32_to_f16(float f) {
+    uint32_t x;
+    std::memcpy(&x, &f, 4);
+    const uint32_t sign = (x >> 16) & 0x8000u;
+    x &= 0x7fffffffu;
+    if (x >= 0x47800000u) {                       // overflow -> inf (or nan)
+        return static_cast<uint16_t>(
+            sign | (x > 0x7f800000u ? 0x7e00u : 0x7c00u));
+    }
+    if (x < 0x38800000u) {                        // subnormal / zero
+        const float magic = 0.5f;
+        float tmp;
+        std::memcpy(&tmp, &x, 4);
+        tmp += magic;
+        uint32_t bits;
+        std::memcpy(&bits, &tmp, 4);
+        return static_cast<uint16_t>(sign | (bits - 0x3f000000u));
+    }
+    uint32_t rounded = x + 0x00000fffu + ((x >> 13) & 1u);
+    return static_cast<uint16_t>(sign | ((rounded - 0x38000000u) >> 13));
+}
+
+inline float f16_to_f32(uint16_t h) {
+    const uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+    const uint32_t em = h & 0x7fffu;
+    uint32_t x;
+    if (em >= 0x7c00u) {                          // inf / nan
+        x = sign | 0x7f800000u | (static_cast<uint32_t>(em & 0x3ffu) << 13);
+    } else if (em == 0) {
+        x = sign;
+    } else if (em < 0x0400u) {                    // subnormal
+        int32_t e = -1;
+        uint32_t m = em;
+        do {
+            ++e;
+            m <<= 1;
+        } while ((m & 0x0400u) == 0);
+        x = sign | (static_cast<uint32_t>(127 - 15 - e) << 23) |
+            (static_cast<uint32_t>(m & 0x3ffu) << 13);
+    } else {
+        x = sign | ((static_cast<uint32_t>(em >> 10) + 112u) << 23) |
+            (static_cast<uint32_t>(em & 0x3ffu) << 13);
+    }
+    float f;
+    std::memcpy(&f, &x, 4);
+    return f;
+}
+
 struct HnswIndex {
     int32_t dim;
     Metric metric;
@@ -27,7 +82,7 @@ struct HnswIndex {
     int32_t ef_search;
     std::mt19937_64 rng{42};
 
-    std::vector<std::vector<float>> vecs;          // slot -> vector
+    std::vector<std::vector<uint16_t>> vecs;       // slot -> f16 vector
     std::vector<int64_t> keys;                     // slot -> user key
     std::vector<bool> alive;
     std::vector<int32_t> levels;                   // slot -> top level
@@ -38,23 +93,31 @@ struct HnswIndex {
     int32_t max_level = -1;
     int64_t alive_count = 0;
 
-    float dist(const float* a, const float* b) const {
+    // f32 query vs f16 stored
+    float dist(const float* a, const uint16_t* b) const {
         float acc = 0.f;
         switch (metric) {
             case L2SQ: {
                 for (int32_t i = 0; i < dim; ++i) {
-                    const float d = a[i] - b[i];
+                    const float d = a[i] - f16_to_f32(b[i]);
                     acc += d * d;
                 }
                 return acc;
             }
             case IP:
             case COS: {  // vectors pre-normalized for COS at insert/query
-                for (int32_t i = 0; i < dim; ++i) acc += a[i] * b[i];
+                for (int32_t i = 0; i < dim; ++i)
+                    acc += a[i] * f16_to_f32(b[i]);
                 return -acc;  // smaller = closer
             }
         }
         return acc;
+    }
+
+    void decode(int32_t slot, std::vector<float>& out) const {
+        const auto& v = vecs[static_cast<size_t>(slot)];
+        out.resize(static_cast<size_t>(dim));
+        for (int32_t i = 0; i < dim; ++i) out[static_cast<size_t>(i)] = f16_to_f32(v[static_cast<size_t>(i)]);
     }
 
     int32_t random_level() {
@@ -63,7 +126,7 @@ struct HnswIndex {
         return static_cast<int32_t>(-std::log(r + 1e-12) * ml);
     }
 
-    // beam search on one level; returns (dist, slot) max-heap trimmed to ef
+    // beam search on one level; returns (dist, slot) closest-first
     void search_layer(const float* q, int32_t ep, int32_t level, int32_t ef,
                       std::vector<std::pair<float, int32_t>>& out) const {
         std::priority_queue<std::pair<float, int32_t>> best;  // max-heap
@@ -99,25 +162,75 @@ struct HnswIndex {
         std::reverse(out.begin(), out.end());  // closest first
     }
 
+    // Malkov & Yashunin Algorithm 4: keep a candidate only if it is
+    // closer to the base than to every already-kept neighbor — spreads
+    // links across clusters instead of piling onto the nearest one.
+    void select_heuristic(const std::vector<std::pair<float, int32_t>>& in,
+                          int32_t cap,
+                          std::vector<std::pair<float, int32_t>>& out) const {
+        out.clear();
+        std::vector<float> cand_vec;
+        std::vector<float> kept_vec;
+        for (const auto& [d, c] : in) {
+            if (static_cast<int32_t>(out.size()) >= cap) break;
+            decode(c, cand_vec);
+            bool good = true;
+            for (const auto& [kd, kslot] : out) {
+                (void)kd;
+                const float d_ck =
+                    dist(cand_vec.data(), vecs[static_cast<size_t>(kslot)].data());
+                if (d_ck < d) {
+                    good = false;
+                    break;
+                }
+            }
+            if (good) out.emplace_back(d, c);
+        }
+        // backfill with closest skipped candidates if underfull
+        if (static_cast<int32_t>(out.size()) < cap) {
+            std::unordered_set<int32_t> have;
+            for (const auto& [d, c] : out) {
+                (void)d;
+                have.insert(c);
+            }
+            for (const auto& [d, c] : in) {
+                if (static_cast<int32_t>(out.size()) >= cap) break;
+                if (have.insert(c).second) out.emplace_back(d, c);
+            }
+        }
+    }
+
     void connect(int32_t slot, int32_t level,
-                 std::vector<std::pair<float, int32_t>>& neighbors) {
+                 const std::vector<std::pair<float, int32_t>>& found) {
         const int32_t cap = level == 0 ? 2 * M : M;
+        std::vector<std::pair<float, int32_t>> chosen;
+        select_heuristic(found, M, chosen);
         auto& my = links[static_cast<size_t>(slot)][static_cast<size_t>(level)];
-        for (const auto& [d, nb] : neighbors) {
-            if (static_cast<int32_t>(my.size()) >= cap) break;
+        std::vector<float> nb_vec;
+        for (const auto& [d, nb] : chosen) {
+            (void)d;
             my.push_back(nb);
             auto& theirs =
                 links[static_cast<size_t>(nb)][static_cast<size_t>(level)];
             theirs.push_back(slot);
             if (static_cast<int32_t>(theirs.size()) > cap) {
-                // shrink: keep the `cap` closest to nb
-                const float* nbv = vecs[static_cast<size_t>(nb)].data();
-                std::sort(theirs.begin(), theirs.end(),
-                          [&](int32_t x, int32_t y) {
-                              return dist(nbv, vecs[static_cast<size_t>(x)].data()) <
-                                     dist(nbv, vecs[static_cast<size_t>(y)].data());
-                          });
-                theirs.resize(static_cast<size_t>(cap));
+                // re-select nb's neighborhood with the same heuristic
+                decode(nb, nb_vec);
+                std::vector<std::pair<float, int32_t>> cands;
+                cands.reserve(theirs.size());
+                for (int32_t t : theirs)
+                    cands.emplace_back(
+                        dist(nb_vec.data(),
+                             vecs[static_cast<size_t>(t)].data()),
+                        t);
+                std::sort(cands.begin(), cands.end());
+                std::vector<std::pair<float, int32_t>> trimmed;
+                select_heuristic(cands, cap, trimmed);
+                theirs.clear();
+                for (const auto& [td, t] : trimmed) {
+                    (void)td;
+                    theirs.push_back(t);
+                }
             }
         }
     }
@@ -131,12 +244,15 @@ struct HnswIndex {
             if (n > 0.f)
                 for (auto& x : v) x /= n;
         }
+        std::vector<uint16_t> h(static_cast<size_t>(dim));
+        for (int32_t i = 0; i < dim; ++i)
+            h[static_cast<size_t>(i)] = f32_to_f16(v[static_cast<size_t>(i)]);
         auto it = key_to_slot.find(key);
         if (it != key_to_slot.end()) {
             // upsert: replace vector in place (links stay — acceptable ANN
             // degradation, same trade usearch makes)
             const int32_t slot = it->second;
-            vecs[static_cast<size_t>(slot)] = std::move(v);
+            vecs[static_cast<size_t>(slot)] = std::move(h);
             if (!alive[static_cast<size_t>(slot)]) {
                 alive[static_cast<size_t>(slot)] = true;
                 ++alive_count;
@@ -145,7 +261,7 @@ struct HnswIndex {
         }
         const int32_t slot = static_cast<int32_t>(vecs.size());
         const int32_t level = random_level();
-        vecs.push_back(std::move(v));
+        vecs.push_back(std::move(h));
         keys.push_back(key);
         alive.push_back(true);
         levels.push_back(level);
@@ -158,7 +274,7 @@ struct HnswIndex {
             max_level = level;
             return;
         }
-        const float* q = vecs[static_cast<size_t>(slot)].data();
+        const float* q = v.data();  // full-precision insert query
         int32_t ep = entry;
         std::vector<std::pair<float, int32_t>> found;
         for (int32_t lv = max_level; lv > level; --lv) {

@@ -89,14 +89,27 @@ def table_from_rows(
 
     def lower(ctx):
         if is_stream:
-            by_time: dict[int, list] = {}
-            for row in rows:
-                key, vals, t, d = row[0], row[1 : 1 + n], row[1 + n], row[2 + n]
-                by_time.setdefault(int(t), []).append((key, tuple(vals), int(d)))
             node_table = ctx.scope.empty_table(n)
             node = node_table.node
-            for t, deltas in by_time.items():
-                node.accept(t, 0, deltas)
+            from pathway_tpu.internals.config import get_pathway_config
+
+            # program-embedded rows are identical on every rank: rank 0
+            # injects once and exchanges shard the work (same contract as
+            # static tables, runtime.run_static distributed path)
+            if (
+                not ctx.scope.runtime.distributed
+                or get_pathway_config().process_id == 0
+            ):
+                by_time: dict[int, list] = {}
+                for row in rows:
+                    key, vals, t, d = (
+                        row[0], row[1 : 1 + n], row[1 + n], row[2 + n],
+                    )
+                    by_time.setdefault(int(t), []).append(
+                        (key, tuple(vals), int(d))
+                    )
+                for t, deltas in by_time.items():
+                    node.accept(t, 0, deltas)
             ctx.set_engine_table(out, node_table)
         else:
             data = [(row[0], tuple(row[1 : 1 + n])) for row in rows]

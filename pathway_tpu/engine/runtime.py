@@ -48,6 +48,10 @@ class Runtime:
         # PATHWAY_PROCESSES>1 — used by throwaway inner runtimes (the
         # iterate fixpoint body) that run a complete local subgraph
         self.local_only = local_only
+        # set by the emulated-rank CI lane (graph_runner._with_companions):
+        # ranks are threads of ONE process sharing connector subject
+        # objects, so every source reads on rank 0 only
+        self._lane_emulated = False
         self.scope = Scope(self)
         self.pending_times: dict[int, set[int]] = {}  # time -> set of node ids
         # min-heap over pending timestamps: the scheduler pops times in
@@ -103,7 +107,11 @@ class Runtime:
 
             c = get_pathway_config()
             self._procgroup = ProcessGroup(
-                c.process_id, c.processes, c.first_port
+                c.process_id, c.processes, c.first_port,
+                # emulated-lane ranks share one process: if a peer thread
+                # dies before the mesh forms, fail fast instead of the
+                # full multi-host connect window
+                timeout=15.0 if self._lane_emulated else 60.0,
             )
         return self._procgroup
 
@@ -246,6 +254,12 @@ class Runtime:
             node.on_time_end(time)
 
     def _finish(self) -> None:
+        # stop the live dashboard first: its loop removes the log handler
+        # and releases stderr (running it past the run garbles later runs)
+        stop = getattr(self, "_dashboard_stop", None)
+        if stop is not None:
+            self._dashboard_stop = None
+            stop()
         # phase 1: input closure — buffers flush their held rows, which
         # must still flow through the graph before on_end callbacks fire.
         # Loop until quiescent: an upstream buffer's flush may land inside
@@ -343,14 +357,16 @@ class Runtime:
         if self.monitoring_level is not None and printer:
             from pathway_tpu.internals.monitoring import (
                 MonitoringLevel,
-                start_monitor_printer,
+                start_dashboard,
             )
 
             if self.monitoring_level not in (
                 MonitoringLevel.NONE,
                 MonitoringLevel.AUTO,
             ):
-                start_monitor_printer(self.stats)
+                # rich live dashboard (reference: monitoring.py TUI);
+                # falls back to the text printer without rich
+                _thread, self._dashboard_stop = start_dashboard(self.stats)
 
     def _drain_event_queue(self, timeout: float) -> list:
         """One bounded wait, then drain everything queued."""
@@ -429,9 +445,14 @@ class Runtime:
                     conn.subject.seek(state)
 
         for conn in self.connectors:
+            # copy the creating thread's context so per-thread config
+            # overlays (emulated-rank CI lane) reach the subject's thread
+            import contextvars as _cv
+
+            _ctx = _cv.copy_context()
             conn.thread = threading.Thread(
-                target=run_connector_thread,
-                args=(conn, self.event_queue),
+                target=_ctx.run,
+                args=(run_connector_thread, conn, self.event_queue),
                 daemon=True,
             )
             conn.thread.start()
@@ -461,6 +482,7 @@ class Runtime:
             for conn, deltas, state, journal_rows in entries:
                 if deltas is None:
                     conn.finished = True
+                    self.stats.on_connector_finished(conn.name)
                     active -= 1
                     continue
                 if (
@@ -718,9 +740,10 @@ class Runtime:
         # reads, data_storage.rs:692
         live: list[_Connector] = []
         for conn in self.connectors:
-            if pg.rank != 0 and not getattr(
+            partitioned = getattr(
                 conn.subject, "_distributed_partitioned", False
-            ):
+            ) and not self._lane_emulated
+            if pg.rank != 0 and not partitioned:
                 conn.finished = True
                 continue
             live.append(conn)
@@ -735,9 +758,30 @@ class Runtime:
             self._replay_journals_distributed(pg, live)
 
         for conn in live:
+            # copy the creating thread's context so per-thread config
+            # overlays (emulated-rank CI lane) reach the subject's thread.
+            # In the emulated lane every source reads on rank 0 only
+            # (subjects are shared objects) — the subject must therefore
+            # see a world of 1 or path-sharding scanners would silently
+            # skip the shards belonging to ranks whose subjects never run.
+            import contextvars as _cv
+
+            if self._lane_emulated:
+                from pathway_tpu.internals.config import (
+                    pop_config_overlay,
+                    push_config_overlay,
+                )
+
+                tok = push_config_overlay(processes=1, process_id=0)
+                try:
+                    _ctx = _cv.copy_context()
+                finally:
+                    pop_config_overlay(tok)
+            else:
+                _ctx = _cv.copy_context()
             conn.thread = threading.Thread(
-                target=run_connector_thread,
-                args=(conn, self.event_queue),
+                target=_ctx.run,
+                args=(run_connector_thread, conn, self.event_queue),
                 daemon=True,
             )
             conn.thread.start()
@@ -755,6 +799,7 @@ class Runtime:
             for conn, deltas, state, journal_rows in entries:
                 if deltas is None:
                     conn.finished = True
+                    self.stats.on_connector_finished(conn.name)
                     active -= 1
                     continue
                 if (

@@ -67,8 +67,43 @@ class PathwayConfig:
 
 pathway_config = PathwayConfig()
 
+# Per-thread overlay used by the emulated-rank CI lane (scripts/
+# ci_lanes.sh): companion ranks run as THREADS of one test process, each
+# seeing its own process_id/processes/first_port while the global config
+# stays untouched. Real multi-process runs never set this.
+import contextvars as _contextvars
+
+_thread_overlay: "_contextvars.ContextVar[dict | None]" = (
+    _contextvars.ContextVar("pathway_config_overlay", default=None)
+)
+
+
+class _OverlaidConfig:
+    __slots__ = ("_base", "_overlay")
+
+    def __init__(self, base: PathwayConfig, overlay: dict):
+        self._base = base
+        self._overlay = overlay
+
+    def __getattr__(self, name):
+        if name in self._overlay:
+            return self._overlay[name]
+        return getattr(self._base, name)
+
+
+def push_config_overlay(**kwargs):
+    """Set per-thread config fields; returns a token for reset."""
+    return _thread_overlay.set(kwargs)
+
+
+def pop_config_overlay(token) -> None:
+    _thread_overlay.reset(token)
+
 
 def get_pathway_config() -> PathwayConfig:
+    overlay = _thread_overlay.get()
+    if overlay:
+        return _OverlaidConfig(pathway_config, overlay)  # type: ignore
     return pathway_config
 
 

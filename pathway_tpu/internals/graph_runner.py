@@ -132,9 +132,21 @@ class LoweringContext:
         return combined, one
 
 
+_lower_lock = None  # serializes lowering across emulated-rank threads
+
+
 class GraphRunner:
     """Lower + run the captured graph (reference:
-    graph_runner/__init__.py:86 run_nodes / :96 run_tables / :113 run_outputs)."""
+    graph_runner/__init__.py:86 run_nodes / :96 run_tables / :113 run_outputs).
+
+    Emulated-rank CI lane: with ``PATHWAY_LANE_PROCESSES=N`` set (and no
+    real multi-process config), every run transparently spawns N-1
+    companion ranks as THREADS of this process — each with a per-thread
+    config overlay (process_id, first_port) and its own Runtime — joined
+    over the real loopback TCP mesh. This re-runs the entire semantics
+    battery through the lockstep exchange protocol (reference CI pattern:
+    the suite re-runs under PATHWAY_THREADS=n / real process forks,
+    python/pathway/tests/utils.py:31-48,599-677)."""
 
     def __init__(
         self,
@@ -178,34 +190,190 @@ class GraphRunner:
             runtime.current_trace = None
         return ctx
 
+    @staticmethod
+    def _lane_world() -> int:
+        import os
+
+        from pathway_tpu.internals.config import get_pathway_config
+
+        try:
+            n = int(os.environ.get("PATHWAY_LANE_PROCESSES", "1") or 1)
+        except ValueError:
+            return 1
+        if n > 1 and get_pathway_config().processes == 1:
+            return n
+        return 1
+
+    def _with_companions(self, ops, rank0_fn, companion_extra=None):
+        """Run rank0_fn() with N-1 companion rank threads when the
+        emulated lane is active; transparent no-op otherwise.
+        companion_extra(runtime, ctx) mirrors any post-lowering graph
+        construction rank 0 performs (captures) — the ranks' graphs must
+        be shape-identical or the lockstep exchange sets diverge."""
+        import threading
+
+        n = self._lane_world()
+        if n <= 1:
+            return rank0_fn()
+        global _lower_lock
+        if _lower_lock is None:
+            _lower_lock = threading.Lock()
+        import socket
+
+        from pathway_tpu.internals.config import (
+            pop_config_overlay,
+            push_config_overlay,
+        )
+
+        def free_port_base() -> int:
+            # need n consecutive free ports (rank r listens on base + r)
+            for _ in range(50):
+                probe = socket.socket()
+                probe.bind(("127.0.0.1", 0))
+                base = probe.getsockname()[1]
+                probe.close()
+                held = []
+                try:
+                    for i in range(n):
+                        s = socket.socket()
+                        s.bind(("127.0.0.1", base + i))
+                        held.append(s)
+                    return base
+                except OSError:
+                    continue
+                finally:
+                    for s in held:
+                        s.close()
+            raise RuntimeError("no consecutive free port range found")
+
+        port = free_port_base()
+        errors: list = []
+        companion_rts: list = []
+
+        def companion(rank: int) -> None:
+            token = push_config_overlay(
+                processes=n, process_id=rank, first_port=port
+            )
+            try:
+                rt = self._make_runtime()
+                rt._lane_emulated = True
+                companion_rts.append(rt)
+                with _lower_lock:
+                    ctx = self._lower(ops, rt)
+                    if companion_extra is not None:
+                        companion_extra(rt, ctx)
+                rt.run()
+            except Exception as exc:  # surfaced on the main thread
+                errors.append((rank, exc))
+            finally:
+                pop_config_overlay(token)
+
+        threads = [
+            threading.Thread(target=companion, args=(r,), daemon=True)
+            for r in range(1, n)
+        ]
+        for t in threads:
+            t.start()
+        token = push_config_overlay(
+            processes=n, process_id=0, first_port=port
+        )
+        rank0_exc: BaseException | None = None
+        result = None
+        try:
+            result = rank0_fn()
+        except BaseException as exc:
+            rank0_exc = exc
+        finally:
+            pop_config_overlay(token)
+            if rank0_exc is not None:
+                # unblock companions stuck in collectives or mesh setup:
+                # closing their sockets surfaces ConnectionError there
+                for rt in companion_rts:
+                    pg = getattr(rt, "_procgroup", None)
+                    if pg is not None:
+                        try:
+                            pg.close()
+                        except Exception:
+                            pass
+            for t in threads:
+                t.join(timeout=120)
+        if rank0_exc is not None:
+            # a companion's real failure beats rank 0's secondary
+            # disconnect error (the raising rank closes the mesh, peers
+            # observe ConnectionError)
+            if errors and isinstance(rank0_exc, ConnectionError):
+                raise errors[0][1]
+            raise rank0_exc
+        if errors:
+            raise errors[0][1]
+        return result
+
     def run_tables(self, *tables: "Table", include_outputs: bool = False):
         """Run to completion, capturing the given tables' final state +
         update streams.  Returns list of CaptureNodes."""
-        runtime = self._make_runtime()
         targets = [t._source for t in tables if t._source is not None]
         if include_outputs:
             targets += self.graph.output_operators()
         ops = self.graph.reachable_operators(targets)
-        ctx = self._lower(ops, runtime)
-        captures = [runtime.scope.capture(ctx.engine_table(t)) for t in tables]
-        runtime.run()
-        return captures
+
+        # captured BEFORE _with_companions pushes the rank-0 overlay
+        lane_active = self._lane_world() > 1
+
+        def rank0():
+            runtime = self._make_runtime()
+            if lane_active:
+                runtime._lane_emulated = True
+                with _lower_lock:
+                    ctx = self._lower(ops, runtime)
+            else:
+                ctx = self._lower(ops, runtime)
+            captures = [
+                runtime.scope.capture(ctx.engine_table(t)) for t in tables
+            ]
+            runtime.run()
+            return captures
+
+        def companion_extra(rt, ctx):
+            # mirror rank 0's capture nodes (gather exchanges included) so
+            # every rank's graph has identical shape; the gathers route all
+            # rows to rank 0, so these captures stay empty
+            for t in tables:
+                rt.scope.capture(ctx.engine_table(t))
+
+        return self._with_companions(ops, rank0, companion_extra)
 
     def run_outputs(self):
         from pathway_tpu.internals.config import get_pathway_config
         from pathway_tpu.internals.telemetry import Telemetry
 
-        runtime = self._make_runtime()
-        telemetry = Telemetry.create(
-            get_pathway_config().monitoring_server,
-            stats=getattr(runtime, "stats", None),
-        )
         targets = self.graph.output_operators()
         ops = self.graph.reachable_operators(targets)
-        with telemetry.span("graph_runner.build", n_operators=len(ops)):
-            self._lower(ops, runtime)
-        with telemetry.span("graph_runner.run"):
-            runtime.run()
-        flush = getattr(telemetry, "flush", None)
-        if flush is not None:
-            flush(timeout=2.0)
+
+        # captured BEFORE _with_companions pushes the rank-0 overlay —
+        # under the overlay the lane looks like real multi-process
+        lane_active = self._lane_world() > 1
+
+        def rank0():
+            runtime = self._make_runtime()
+            telemetry = Telemetry.create(
+                get_pathway_config().monitoring_server,
+                stats=getattr(runtime, "stats", None),
+            )
+            if lane_active:
+                runtime._lane_emulated = True
+                with telemetry.span(
+                    "graph_runner.build", n_operators=len(ops)
+                ), _lower_lock:
+                    self._lower(ops, runtime)
+            else:
+                with telemetry.span(
+                    "graph_runner.build", n_operators=len(ops)
+                ):
+                    self._lower(ops, runtime)
+            with telemetry.span("graph_runner.run"):
+                runtime.run()
+            flush = getattr(telemetry, "flush", None)
+            if flush is not None:
+                flush(timeout=2.0)
+
+        return self._with_companions(ops, rank0)

@@ -156,10 +156,6 @@ class GroupedTable:
             gtable_cols[f"r{i}"] = r._dtype
 
         stateful = [r for r in reducers if isinstance(r._reducer, StatefulReducer)]
-        if stateful and len(reducers) != len(stateful):
-            raise NotImplementedError(
-                "mixing stateful and plain reducers in one reduce() is not supported yet"
-            )
 
         out_schema = schema_from_types(
             **{n: e._dtype for n, e in zip(names, rewritten)}
@@ -319,8 +315,7 @@ class GroupedTable:
             if sort_fn is not None:
                 native_args = None
 
-            if stateful:
-                assert len(reducers) == 1
+            if len(stateful) == len(reducers) == 1:
                 red = reducers[0]
                 post = getattr(red, "_post_process", None)
                 combine = red._reducer.combine_many
@@ -345,8 +340,26 @@ class GroupedTable:
             else:
                 reducer_specs = []
                 for r in reducers:
-                    spec = r._reducer.engine_spec(**r._kwargs)
                     post = getattr(r, "_post_process", None)
+                    if isinstance(r._reducer, StatefulReducer):
+                        # stateful rides the general node as a per-row
+                        # accumulator slot — freely composable with plain
+                        # reducers (reference: reduce.rs:22, Stateful is
+                        # just another Reducer variant). Diffs flow into
+                        # combine_many exactly like the dedicated node's.
+                        combine = r._reducer.combine_many
+
+                        def upd(s, combo, d, _c=combine):
+                            return _c(s, [(combo[:-2], d)])
+
+                        fin = (
+                            (lambda s, _p=post: _p(s))
+                            if post is not None
+                            else (lambda s: s)
+                        )
+                        reducer_specs.append(("abelian", upd, fin, None))
+                        continue
+                    spec = r._reducer.engine_spec(**r._kwargs)
                     if post is not None:
                         if spec[0] == "abelian":
                             # drops any native code: post-processing needs

@@ -1,6 +1,8 @@
-"""Experimental live-REPL mode (reference:
-python/pathway/internals/interactive.py:222 — `pw.enable_interactive_mode`
-keeps a background run alive and lets the REPL inspect live tables)."""
+"""Live-REPL mode (reference: python/pathway/internals/interactive.py:222
+— ``pw.enable_interactive_mode`` keeps a background run alive and lets the
+REPL inspect LIVE tables, including tables first looked at AFTER the run
+started; the reference does this by exporting every worker's tables and
+re-subscribing on demand)."""
 
 from __future__ import annotations
 
@@ -8,31 +10,41 @@ import threading
 import time
 from typing import Any
 
-_state: dict[str, Any] = {"enabled": False, "thread": None}
+_state: dict[str, Any] = {"enabled": False, "thread": None, "started": False}
+# id(table) -> _Recorder attached before the run launched (the engine
+# graph is fixed at run time, so post-start inspection works by recording
+# every reachable table up front — the reference's export-everything move)
+_recorders: dict[int, "_Recorder"] = {}
+
+
+class _Recorder:
+    def __init__(self, table):
+        self.table = table
+        self.rows: dict = {}
+        self.lock = threading.Lock()
+        import pathway_tpu as pw
+
+        def on_change(key, row, time_, is_addition):
+            with self.lock:
+                if is_addition:
+                    self.rows[key] = row
+                else:
+                    self.rows.pop(key, None)
+
+        pw.io.subscribe(self.table, on_change=on_change)
 
 
 class LiveTableHandle:
     """Snapshot accessor over a live table (refreshed by the background
-    run). pw.io.subscribe delivers rows as {column: value} dicts."""
+    run)."""
 
-    def __init__(self, table):
-        self.table = table
-        self._rows: dict = {}
-        self._lock = threading.Lock()
-        import pathway_tpu as pw
-
-        def on_change(key, row, time_, is_addition):
-            with self._lock:
-                if is_addition:
-                    self._rows[key] = row
-                else:
-                    self._rows.pop(key, None)
-
-        pw.io.subscribe(self.table, on_change=on_change)
+    def __init__(self, recorder: _Recorder):
+        self._rec = recorder
+        self.table = recorder.table
 
     def snapshot(self) -> list[dict]:
-        with self._lock:
-            return list(self._rows.values())
+        with self._rec.lock:
+            return list(self._rec.rows.values())
 
     def __repr__(self):
         cols = self.table.column_names()
@@ -49,17 +61,41 @@ def interactive_mode_enabled() -> bool:
 
 def enable_interactive_mode() -> None:
     """pw.run() will start on a background daemon thread, leaving the REPL
-    responsive; inspect tables via pw.live(table) handles."""
+    responsive; inspect tables via pw.live(table) handles — before OR
+    after the run has started."""
     _state["enabled"] = True
 
 
 def live(table) -> LiveTableHandle:
-    """Register a live view; call BEFORE pw.run()."""
-    return LiveTableHandle(table)
+    """Live view of a table. Before the run: registers a recorder. After
+    the run started: attaches to the recorder pre-registered for every
+    reachable table at launch."""
+    rec = _recorders.get(id(table))
+    if rec is None:
+        if _state["started"]:
+            raise RuntimeError(
+                "this table was not reachable when the interactive run "
+                "started; build it before pw.run() (the dataflow graph "
+                "is fixed at launch)"
+            )
+        rec = _recorders[id(table)] = _Recorder(table)
+    return LiveTableHandle(rec)
 
 
 def start(**run_kwargs) -> threading.Thread:
     import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    # record every table in the graph so the REPL can open live views
+    # after the run is already streaming (reference: export_callback per
+    # worker table, interactive.py LiveTableState)
+    for op in list(G.operators):
+        for t in getattr(op, "outputs", []):
+            if id(t) not in _recorders and hasattr(t, "column_names"):
+                try:
+                    _recorders[id(t)] = _Recorder(t)
+                except Exception:
+                    continue  # non-subscribable artifacts stay uninstrumented
 
     t = threading.Thread(
         target=lambda: pw.run(_interactive_bypass=True, **run_kwargs),
@@ -67,5 +103,6 @@ def start(**run_kwargs) -> threading.Thread:
     )
     t.start()
     _state["thread"] = t
+    _state["started"] = True
     time.sleep(0.2)
     return t

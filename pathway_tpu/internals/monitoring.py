@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import enum
 import http.server
+import logging
 import sys
 import threading
 import time
@@ -28,6 +29,15 @@ class ConnectorStats:
     rows: int = 0
     batches: int = 0
     last_commit_ts: float = 0.0
+    last_minibatch: int = 0
+    finished: bool = False
+    # rolling (timestamp, n_rows) window for the last-minute column
+    recent: list = field(default_factory=list)
+
+    def rows_last_minute(self, now: float | None = None) -> int:
+        now = now or time.time()
+        self.recent = [(t, n) for t, n in self.recent if now - t <= 60.0]
+        return sum(n for _, n in self.recent)
 
 
 @dataclass
@@ -43,7 +53,19 @@ class ProberStats:
         st = self.connectors.setdefault(name, ConnectorStats(name=name))
         st.rows += n_rows
         st.batches += 1
+        st.last_minibatch = n_rows
         st.last_commit_ts = time.time()
+        st.recent.append((st.last_commit_ts, n_rows))
+        # prune the rolling window HERE, not only in the dashboard
+        # renderer — without a dashboard the list would grow per commit
+        # forever on the ingest hot path
+        cutoff = st.last_commit_ts - 60.0
+        while st.recent and st.recent[0][0] < cutoff:
+            st.recent.pop(0)
+
+    def on_connector_finished(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.finished = True
 
     def on_output(self, n_rows: int) -> None:
         self.outputs_emitted += n_rows
@@ -106,6 +128,101 @@ def start_http_server(stats: ProberStats, port: int) -> threading.Thread:
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return thread
+
+
+class _LogGraveyard(logging.Handler):
+    """Ring buffer of recent log records for the dashboard's LOGS panel
+    (reference: monitoring.py ConsolePrintingToBuffer/LogsOutput)."""
+
+    def __init__(self, capacity: int = 50):
+        super().__init__()
+        self.capacity = capacity
+        self.records: list[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.records.append(self.format(record))
+        except Exception:
+            return
+        if len(self.records) > self.capacity:
+            self.records = self.records[-self.capacity :]
+
+
+def render_dashboard(stats: ProberStats, graveyard=None):
+    """One rich renderable frame of the live dashboard (reference:
+    python/pathway/internals/monitoring.py:273-class TUI — per-connector
+    rows with minibatch / last-minute / total columns, the input/output
+    latency table, and the log graveyard)."""
+    from rich import box
+    from rich.console import Group
+    from rich.panel import Panel
+    from rich.table import Table
+
+    now = time.time()
+    conn = Table(box=box.SIMPLE, title="connectors")
+    conn.add_column("connector", justify="left")
+    conn.add_column("last minibatch", justify="right")
+    conn.add_column("last minute", justify="right")
+    conn.add_column("since start", justify="right")
+    for st in stats.connectors.values():
+        conn.add_row(
+            st.name,
+            "finished" if st.finished else str(st.last_minibatch),
+            str(st.rows_last_minute(now)),
+            str(st.rows),
+        )
+
+    lat = Table(box=box.SIMPLE, title="latency [ms]")
+    lat.add_column("operator")
+    lat.add_column("latency", justify="right")
+    lat.add_row("input", f"{stats.input_latency_ms():.0f}")
+    lat.add_row("output", f"{stats.output_latency_ms():.0f}")
+    lat.add_row("rows emitted", str(stats.outputs_emitted))
+
+    parts = [conn, lat]
+    if graveyard is not None and graveyard.records:
+        parts.append(
+            Panel(
+                "\n".join(graveyard.records[-12:]),
+                title="LOGS",
+                box=box.MINIMAL,
+            )
+        )
+    return Group(*parts)
+
+
+def start_dashboard(
+    stats: ProberStats, interval: float = 1.0
+):
+    """Live-updating terminal dashboard; returns (thread, stop_fn).
+    Falls back to the plain text printer when rich is unavailable."""
+    try:
+        from rich.console import Console
+        from rich.live import Live
+    except ImportError:
+        return start_monitor_printer(stats, interval), lambda: None
+
+    graveyard = _LogGraveyard()
+    graveyard.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
+    logging.getLogger().addHandler(graveyard)
+    stop = threading.Event()
+
+    def loop():
+        console = Console(stderr=True)
+        with Live(
+            render_dashboard(stats, graveyard),
+            console=console,
+            refresh_per_second=2,
+            transient=True,
+        ) as live:
+            while not stop.is_set():
+                stop.wait(interval)
+                live.update(render_dashboard(stats, graveyard))
+        logging.getLogger().removeHandler(graveyard)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    return thread, stop.set
 
 
 def start_monitor_printer(

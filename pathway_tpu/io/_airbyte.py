@@ -1,0 +1,375 @@
+"""Airbyte source runners — docker-less first (reference:
+python/pathway/third_party/airbyte_serverless/{executable_runner,sources}.py
+and python/pathway/io/airbyte/__init__.py:1-341).
+
+Three execution paths, in preference order:
+
+* ``DeclarativeAirbyteSource`` — interprets a subset of Airbyte's low-code
+  *declarative manifest* (the YAML format behind the majority of the
+  "300+ sources" catalog) directly over stdlib HTTP: no docker, no venv,
+  no third-party packages. Supported manifest subset: streams with an
+  HttpRequester (url_base/path/method/headers/params), a DpathExtractor
+  record selector, offset pagination, and client-side incremental sync on
+  a cursor field.
+* ``ExecutableAirbyteSource`` — drives ANY executable speaking the
+  Airbyte protocol (spec / discover / read over JSON lines), the same
+  contract the reference's executable_runner.py:188-283 implements. The
+  venv (``VenvAirbyteSource``) and docker variants are thin command
+  constructions over it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import subprocess
+import tempfile
+import urllib.parse
+import urllib.request
+from typing import Any, Iterable, Iterator
+
+
+class AirbyteSourceError(Exception):
+    pass
+
+
+INCREMENTAL_SYNC_MODE = "incremental"
+FULL_REFRESH_SYNC_MODE = "full_refresh"
+
+
+def get_configured_catalog(catalog: dict, streams) -> dict:
+    """reference: executable_runner.py:22-38 — pick requested streams,
+    prefer incremental sync, append destination mode."""
+    configured = dict(catalog)
+    configured["streams"] = [
+        {
+            "stream": stream,
+            "sync_mode": (
+                INCREMENTAL_SYNC_MODE
+                if INCREMENTAL_SYNC_MODE in stream.get("supported_sync_modes", [])
+                else FULL_REFRESH_SYNC_MODE
+            ),
+            "destination_sync_mode": "append",
+            "cursor_field": stream.get("default_cursor_field", []),
+        }
+        for stream in catalog.get("streams", [])
+        if not streams or stream["name"] in streams
+    ]
+    return configured
+
+
+class ExecutableAirbyteSource:
+    """Airbyte protocol driver over a subprocess (reference:
+    executable_runner.py:188 ExecutableAirbyteSource — config/catalog/state
+    ride as JSON files, messages stream back as JSON lines; a TRACE error
+    message aborts the sync)."""
+
+    def __init__(
+        self,
+        executable: str,
+        config: dict | None = None,
+        streams: Iterable[str] | str | None = None,
+        env_vars: dict | None = None,
+    ):
+        self.executable = executable
+        self.config = config
+        self.streams = (
+            [s.strip() for s in streams.split(",")]
+            if isinstance(streams, str)
+            else (list(streams) if streams else None)
+        )
+        self.env_vars = dict(os.environ, **(env_vars or {}))
+        self._tmp = tempfile.TemporaryDirectory()
+        self.temp_dir = self._tmp.name
+        self.temp_dir_for_executable = self.temp_dir
+        self._cached_catalog: dict | None = None
+
+    def _run(self, action: str, state=None) -> Iterator[dict]:
+        command = f"{self.executable} {action}"
+
+        def add_argument(name: str, value) -> str:
+            path = os.path.join(self.temp_dir, f"{name}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(value, f)
+            return (
+                f" --{name} "
+                f"{shlex.quote(os.path.join(self.temp_dir_for_executable, name + '.json'))}"
+            )
+
+        if action != "spec":
+            if self.config is None:
+                raise AirbyteSourceError("source config is not defined")
+            command += add_argument("config", self.config)
+        if action == "read":
+            command += add_argument("catalog", self.configured_catalog)
+        if state:
+            command += add_argument("state", state)
+
+        proc = subprocess.Popen(
+            command,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            shell=True,
+            env=self.env_vars,
+        )
+        assert proc.stdout is not None
+        for line in iter(proc.stdout.readline, b""):
+            content = line.decode(errors="replace").strip()
+            if not content:
+                continue
+            try:
+                message = json.loads(content)
+            except ValueError:
+                continue  # connectors may emit non-protocol log lines
+            if message.get("trace", {}).get("error"):
+                proc.kill()
+                raise AirbyteSourceError(
+                    json.dumps(message["trace"]["error"])
+                )
+            yield message
+        proc.wait()
+
+    def _first_message(self, action: str) -> dict:
+        for message in self._run(action):
+            if message.get("type") not in ("LOG", "TRACE"):
+                return message
+        raise AirbyteSourceError(
+            f"no message returned by airbyte source for action {action!r}"
+        )
+
+    @property
+    def spec(self) -> dict:
+        return self._first_message("spec")["spec"]
+
+    @property
+    def catalog(self) -> dict:
+        if self._cached_catalog is None:
+            self._cached_catalog = self._first_message("discover")["catalog"]
+        return json.loads(json.dumps(self._cached_catalog))
+
+    @property
+    def configured_catalog(self) -> dict:
+        return get_configured_catalog(self.catalog, self.streams)
+
+    def extract(self, state=None) -> Iterator[dict]:
+        return self._run("read", state=state)
+
+    def on_stop(self) -> None:
+        self._tmp.cleanup()
+
+
+class VenvAirbyteSource(ExecutableAirbyteSource):
+    """pip-installs ``airbyte-<connector>`` into an isolated venv and runs
+    its console script (reference: sources.py:137 VenvAirbyteSource).
+    Requires network access to PyPI at construction time."""
+
+    def __init__(
+        self,
+        connector: str,
+        config: dict | None = None,
+        streams=None,
+        env_vars: dict | None = None,
+    ):
+        import venv
+
+        self._venv_dir = tempfile.TemporaryDirectory()
+        venv.create(self._venv_dir.name, with_pip=True)
+        pip = os.path.join(self._venv_dir.name, "bin", "pip")
+        proc = subprocess.run(
+            [pip, "install", f"airbyte-{connector}"],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            raise AirbyteSourceError(
+                f"failed to install airbyte-{connector} into a virtual "
+                f"environment: {proc.stdout.decode(errors='replace')[-500:]}"
+                f"{proc.stderr.decode(errors='replace')[-500:]}"
+            )
+        # the package installs a `source-<name>` console script
+        script = os.path.join(self._venv_dir.name, "bin", f"source-{connector}")
+        if not os.path.exists(script):
+            script = os.path.join(self._venv_dir.name, "bin", connector)
+        super().__init__(shlex.quote(script), config, streams, env_vars)
+
+
+class DockerAirbyteSource(ExecutableAirbyteSource):
+    """Runs a connector image via a local docker runtime (reference:
+    sources.py:88 DockerAirbyteSource)."""
+
+    def __init__(
+        self,
+        docker_image: str,
+        config: dict | None = None,
+        streams=None,
+        env_vars: dict | None = None,
+    ):
+        import shutil
+
+        if shutil.which("docker") is None:
+            raise AirbyteSourceError(
+                "pw.io.airbyte: this source needs a local Docker runtime "
+                "(image-only connector); declarative-manifest and "
+                "executable sources run without docker"
+            )
+        super().__init__("", config, streams, env_vars)
+        self.temp_dir_for_executable = "/mnt/temp"
+        self.executable = (
+            f"docker run --rm -i --volume {self.temp_dir}:/mnt/temp "
+            f"{shlex.quote(docker_image)}"
+        )
+
+
+class DeclarativeAirbyteSource:
+    """Minimal interpreter for Airbyte's low-code declarative manifest
+    (https://docs.airbyte.com/connector-development/config-based — the
+    YAML behind most catalog connectors; reference ships it through the
+    airbyte-cdk's source-declarative-manifest runner). Supported subset:
+
+    streams[].retriever.requester: url_base, path, http_method (GET),
+        request_parameters, request_headers — ``{{ config['k'] }}``
+        interpolation in string values;
+    streams[].retriever.record_selector.extractor.field_path;
+    streams[].retriever.paginator: NoPagination or OffsetIncrement
+        (page_size, inject via request_parameter offset_param);
+    streams[].incremental_sync.cursor_field: client-side incremental —
+        only records with cursor strictly above the stored state are
+        emitted, and the new state carries the maximum seen.
+    """
+
+    def __init__(
+        self,
+        manifest: dict,
+        config: dict | None = None,
+        streams=None,
+    ):
+        self.manifest = manifest
+        self.config = config or {}
+        self.streams = list(streams) if streams else None
+
+    # -- interpolation ----------------------------------------------------
+    def _interp(self, value):
+        if isinstance(value, str):
+            out = value
+            for key, cfg_val in self.config.items():
+                out = out.replace("{{ config['%s'] }}" % key, str(cfg_val))
+                out = out.replace('{{ config["%s"] }}' % key, str(cfg_val))
+            return out
+        if isinstance(value, dict):
+            return {k: self._interp(v) for k, v in value.items()}
+        return value
+
+    def _manifest_streams(self) -> list[dict]:
+        return [
+            s
+            for s in self.manifest.get("streams", [])
+            if self.streams is None or s.get("name") in self.streams
+        ]
+
+    @property
+    def catalog(self) -> dict:
+        streams = []
+        for s in self._manifest_streams():
+            modes = [FULL_REFRESH_SYNC_MODE]
+            cursor = (s.get("incremental_sync") or {}).get("cursor_field")
+            if cursor:
+                modes.append(INCREMENTAL_SYNC_MODE)
+            streams.append(
+                {
+                    "name": s["name"],
+                    "json_schema": s.get("json_schema", {}),
+                    "supported_sync_modes": modes,
+                    "default_cursor_field": [cursor] if cursor else [],
+                }
+            )
+        return {"streams": streams}
+
+    @property
+    def configured_catalog(self) -> dict:
+        return get_configured_catalog(self.catalog, self.streams)
+
+    def _fetch(self, url: str, headers: dict) -> Any:
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _records_for_stream(self, s: dict) -> Iterator[dict]:
+        retr = s.get("retriever", {})
+        req = self._interp(retr.get("requester", {}))
+        base = req.get("url_base", "").rstrip("/")
+        path = req.get("path", "")
+        params = dict(req.get("request_parameters", {}) or {})
+        headers = dict(req.get("request_headers", {}) or {})
+        selector = retr.get("record_selector", {})
+        field_path = (selector.get("extractor") or {}).get("field_path", [])
+        paginator = retr.get("paginator") or {"type": "NoPagination"}
+        page_size = int(paginator.get("page_size", 0) or 0)
+        offset_param = paginator.get("offset_param", "offset")
+
+        offset = 0
+        while True:
+            q = dict(params)
+            if paginator.get("type") == "OffsetIncrement":
+                q[offset_param] = str(offset)
+                if page_size:
+                    q["limit"] = str(page_size)
+            url = f"{base}/{path.lstrip('/')}"
+            if q:
+                url += "?" + urllib.parse.urlencode(q)
+            payload = self._fetch(url, headers)
+            records = payload
+            for fp in field_path:
+                if not isinstance(records, dict):
+                    records = []
+                    break
+                records = records.get(fp, [])
+            if not isinstance(records, list):
+                records = [records]
+            yield from (r for r in records if isinstance(r, dict))
+            if paginator.get("type") != "OffsetIncrement":
+                return
+            if not records or (page_size and len(records) < page_size):
+                return
+            offset += len(records)
+
+    def extract(self, state=None) -> Iterator[dict]:
+        """Yields Airbyte protocol messages: RECORD per row + one STATE
+        per stream after its records (STREAM-scoped state)."""
+        stream_states: dict[str, Any] = {}
+        if state:
+            for entry in state.get("global", {}).get("stream_states", []):
+                stream_states[entry["stream_descriptor"]["name"]] = entry.get(
+                    "stream_state", {}
+                )
+        for s in self._manifest_streams():
+            name = s["name"]
+            cursor = (s.get("incremental_sync") or {}).get("cursor_field")
+            prev = (stream_states.get(name) or {}).get(cursor) if cursor else None
+            max_cursor = prev
+            for record in self._records_for_stream(s):
+                if cursor is not None:
+                    value = record.get(cursor)
+                    if value is None:
+                        continue
+                    if prev is not None and value <= prev:
+                        continue  # already delivered in an earlier sync
+                    if max_cursor is None or value > max_cursor:
+                        max_cursor = value
+                yield {
+                    "type": "RECORD",
+                    "record": {"stream": name, "data": record},
+                }
+            if cursor is not None and max_cursor is not None:
+                yield {
+                    "type": "STATE",
+                    "state": {
+                        "type": "STREAM",
+                        "stream": {
+                            "stream_descriptor": {"name": name},
+                            "stream_state": {cursor: max_cursor},
+                        },
+                    },
+                }
+
+    def on_stop(self) -> None:
+        pass

@@ -304,10 +304,19 @@ class ProcessGroup:
             return
         self._closed = True
         for s in self._socks.values():
+            # shutdown BEFORE close: a concurrent recv() in a receiver
+            # thread does not reliably wake on close() alone
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 s.close()
             except OSError:
                 pass
+        # unblock any recv() waiting on a per-peer queue
+        for q in self._queues.values():
+            q.put(None)
         try:
             self._listener.close()
         except OSError:

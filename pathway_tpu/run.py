@@ -15,9 +15,14 @@ def run(
     persistence_config=None,
     runtime_typechecking: bool | None = None,
     terminate_on_error: bool = True,
+    profile: str | None = None,
     _interactive_bypass: bool = False,
     **kwargs,
 ) -> None:
+    """profile: directory path — wraps the run in a jax.profiler trace
+    (XLA device timelines + host events, viewable in TensorBoard /
+    Perfetto), the XLA-profiler analog of the reference's
+    DIFFERENTIAL_LOG_ADDR event stream (SURVEY §5 tracing)."""
     from pathway_tpu.internals.interactive import (
         interactive_mode_enabled,
         start as _interactive_start,
@@ -32,12 +37,19 @@ def run(
             **kwargs,
         )
         return
-    GraphRunner(
+    runner = GraphRunner(
         terminate_on_error=terminate_on_error,
         persistence_config=persistence_config,
         with_http_server=with_http_server,
         monitoring_level=monitoring_level,
-    ).run_outputs()
+    )
+    if profile is not None:
+        import jax
+
+        with jax.profiler.trace(profile):
+            runner.run_outputs()
+        return
+    runner.run_outputs()
 
 
 def run_all(**kwargs) -> None:

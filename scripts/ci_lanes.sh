@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Parallel CI lanes (reference pattern: the whole Python suite re-runs
+# under PATHWAY_THREADS=n and with real multi-process forks —
+# python/pathway/tests/utils.py:31-48,599-677).
+#
+#   lane 1: full suite with PATHWAY_THREADS=4 (native executor shards)
+#   lane 2: full semantics battery with PATHWAY_LANE_PROCESSES=2 —
+#           every GraphRunner run transparently joins 2 emulated ranks
+#           over the real loopback TCP mesh (lockstep exchanges, gather
+#           outputs), re-shaking the batteries for sharding bugs.
+#
+# Lane-2 deselects: suites that already fork REAL rank processes (their
+# children would inherit the lane var on top of real PATHWAY_PROCESSES),
+# serving tests that bind fixed HTTP ports per rank, and wall-clock
+# sensitive perf tests.
+set -e
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "=== lane 1: PATHWAY_THREADS=4 (full suite) ==="
+PATHWAY_THREADS=4 python -m pytest tests/ -x -q
+
+echo "=== lane 2: PATHWAY_LANE_PROCESSES=2 (semantics batteries) ==="
+PATHWAY_LANE_PROCESSES=2 python -m pytest -x -q \
+  --ignore=tests/test_multiprocess.py \
+  --ignore=tests/test_persistence_multiprocess.py \
+  --ignore=tests/test_parallel.py \
+  --ignore=tests/test_rest_server.py \
+  --ignore=tests/test_rag_server.py \
+  --ignore=tests/test_sharded_vector_store.py \
+  --ignore=tests/test_templates.py \
+  --ignore=tests/test_native_stress.py \
+  tests/
+
+echo "=== both lanes green ==="

@@ -1,0 +1,326 @@
+"""pw.io.airbyte end-to-end without docker (VERDICT r2 #7): a declarative
+YAML-manifest source over live HTTP and an executable source speaking the
+real Airbyte protocol (reference: third_party/airbyte_serverless/
+executable_runner.py; io/airbyte/__init__.py)."""
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+@pytest.fixture(autouse=True)
+def _clear_graph():
+    pw.internals.parse_graph.G.clear()
+    yield
+
+
+# -- executable source (full Airbyte protocol over a subprocess) ----------
+
+_FAKE_CONNECTOR = textwrap.dedent(
+    """
+    import argparse, json, sys
+
+    p = argparse.ArgumentParser()
+    p.add_argument("action")
+    p.add_argument("--config")
+    p.add_argument("--catalog")
+    p.add_argument("--state")
+    a = p.parse_args()
+
+    if a.action == "spec":
+        print(json.dumps({"type": "SPEC", "spec": {"title": "fake"}}))
+        sys.exit(0)
+    if a.action == "discover":
+        print(json.dumps({"type": "CATALOG", "catalog": {"streams": [
+            {"name": "users", "json_schema": {},
+             "supported_sync_modes": ["full_refresh", "incremental"],
+             "default_cursor_field": ["uid"]},
+            {"name": "noise", "json_schema": {},
+             "supported_sync_modes": ["full_refresh"]},
+        ]}}))
+        sys.exit(0)
+    assert a.action == "read"
+    catalog = json.load(open(a.catalog))
+    names = [s["stream"]["name"] for s in catalog["streams"]]
+    assert names == ["users"], names  # stream filter must reach the child
+    config = json.load(open(a.config))
+    start = 0
+    if a.state:
+        state = json.load(open(a.state))
+        states = state.get("global", {}).get("stream_states", [])
+        for entry in states:
+            if entry["stream_descriptor"]["name"] == "users":
+                start = entry["stream_state"].get("uid", 0)
+    print(json.dumps({"type": "LOG", "log": {"message": "starting"}}))
+    for uid in range(start + 1, config["n_users"] + 1):
+        print(json.dumps({"type": "RECORD", "record": {
+            "stream": "users", "data": {"uid": uid, "name": f"u{uid}"}}}))
+    print(json.dumps({"type": "STATE", "state": {
+        "type": "STREAM", "stream": {
+            "stream_descriptor": {"name": "users"},
+            "stream_state": {"uid": config["n_users"]}}}}))
+    """
+)
+
+
+def _write_exec_connection(tmp_path, n_users: int) -> str:
+    script = tmp_path / "fake_source.py"
+    script.write_text(_FAKE_CONNECTOR)
+    conn = tmp_path / "connection.yaml"
+    conn.write_text(
+        "source:\n"
+        f"  executable: python {script}\n"
+        "  config:\n"
+        f"    n_users: {n_users}\n"
+    )
+    return str(conn)
+
+
+def test_airbyte_executable_source_e2e(tmp_path):
+    conn = _write_exec_connection(tmp_path, 3)
+    t = pw.io.airbyte.read(conn, streams=["users"], mode="static")
+    cap = GraphRunner().run_tables(t)[0]
+    rows = sorted(
+        row[0].value["uid"] for row in cap.state.rows.values()
+    )
+    assert rows == [1, 2, 3]
+
+
+def test_airbyte_executable_incremental_state(tmp_path):
+    """A sync carrying the recorded Airbyte STATE must only deliver new
+    rows (the incremental contract the subject's snapshot/seek rides)."""
+    conn = _write_exec_connection(tmp_path, 3)
+    t = pw.io.airbyte.read(conn, streams=["users"], mode="static")
+    cap = GraphRunner().run_tables(t)[0]
+    assert len(cap.state.rows) == 3
+
+    from pathway_tpu.io.airbyte import _construct_source
+
+    src = _construct_source(
+        {"executable": f"python {tmp_path / 'fake_source.py'}",
+         "config": {"n_users": 5}},
+        ["users"], None, None, str(tmp_path),
+    )
+    state = {
+        "type": "GLOBAL",
+        "global": {"stream_states": [
+            {"stream_descriptor": {"name": "users"},
+             "stream_state": {"uid": 3}},
+        ]},
+    }
+    uids = [
+        m["record"]["data"]["uid"]
+        for m in src.extract(state)
+        if m.get("type") == "RECORD"
+    ]
+    assert uids == [4, 5]
+
+
+# -- declarative manifest source over live HTTP ---------------------------
+
+def _start_api(items):
+    """Tiny JSON API: /v1/items?offset=N&limit=M over the live item list."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            from urllib.parse import parse_qs, urlparse
+
+            u = urlparse(self.path)
+            if u.path != "/v1/items":
+                self.send_response(404)
+                self.end_headers()
+                return
+            q = parse_qs(u.query)
+            assert q.get("api_key") == ["sekret"], q  # config interpolation
+            offset = int(q.get("offset", ["0"])[0])
+            limit = int(q.get("limit", ["3"])[0])
+            body = json.dumps(
+                {"data": items[offset : offset + limit]}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _manifest(port: int) -> str:
+    return textwrap.dedent(
+        f"""
+        version: "0.1.0"
+        streams:
+          - name: items
+            primary_key: id
+            incremental_sync:
+              cursor_field: id
+            retriever:
+              requester:
+                url_base: http://127.0.0.1:{port}
+                path: /v1/items
+                http_method: GET
+                request_parameters:
+                  api_key: "{{{{ config['api_key'] }}}}"
+              record_selector:
+                extractor:
+                  field_path: ["data"]
+              paginator:
+                type: OffsetIncrement
+                page_size: 3
+        """
+    )
+
+
+def test_airbyte_declarative_manifest_e2e(tmp_path):
+    items = [{"id": i, "label": f"item{i}"} for i in range(1, 8)]
+    srv = _start_api(items)
+    try:
+        manifest_path = tmp_path / "manifest.yaml"
+        manifest_path.write_text(_manifest(srv.server_address[1]))
+        conn = tmp_path / "connection.yaml"
+        conn.write_text(
+            "source:\n"
+            "  manifest_path: manifest.yaml\n"
+            "  config:\n"
+            "    api_key: sekret\n"
+        )
+        t = pw.io.airbyte.read(str(conn), streams=["items"], mode="static")
+        cap = GraphRunner().run_tables(t)[0]
+        ids = sorted(r[0].value["id"] for r in cap.state.rows.values())
+        assert ids == [1, 2, 3, 4, 5, 6, 7]  # paginated in pages of 3
+    finally:
+        srv.shutdown()
+
+
+def test_airbyte_declarative_incremental(tmp_path):
+    items = [{"id": i, "label": f"item{i}"} for i in range(1, 5)]
+    srv = _start_api(items)
+    try:
+        from pathway_tpu.internals.yaml_loader import load_yaml
+        from pathway_tpu.io._airbyte import DeclarativeAirbyteSource
+
+        manifest = load_yaml(_manifest(srv.server_address[1]))
+        src = DeclarativeAirbyteSource(
+            manifest, config={"api_key": "sekret"}, streams=["items"]
+        )
+        msgs = list(src.extract())
+        ids = [m["record"]["data"]["id"] for m in msgs if m["type"] == "RECORD"]
+        assert ids == [1, 2, 3, 4]
+        states = [m["state"] for m in msgs if m["type"] == "STATE"]
+        assert states[-1]["stream"]["stream_state"] == {"id": 4}
+        # new rows arrive; a sync carrying the state yields only them
+        items.extend({"id": i, "label": f"item{i}"} for i in (5, 6))
+        state = {
+            "type": "GLOBAL",
+            "global": {"stream_states": [
+                {"stream_descriptor": {"name": "items"},
+                 "stream_state": {"id": 4}},
+            ]},
+        }
+        ids2 = [
+            m["record"]["data"]["id"]
+            for m in src.extract(state)
+            if m["type"] == "RECORD"
+        ]
+        assert ids2 == [5, 6]
+    finally:
+        srv.shutdown()
+
+
+def test_airbyte_docker_only_still_gated(tmp_path):
+    conn = tmp_path / "connection.yaml"
+    conn.write_text(
+        "source:\n"
+        "  docker_image: airbyte/source-exotic:latest\n"
+        "  config: {}\n"
+    )
+    from pathway_tpu.io._airbyte import AirbyteSourceError
+
+    with pytest.raises((AirbyteSourceError, RuntimeError)):
+        pw.io.airbyte.read(
+            str(conn), streams=["s"], mode="static", enforce_method="docker"
+        )
+
+
+def test_airbyte_full_refresh_streaming_mirrors_source(tmp_path):
+    """Full-refresh (cursor-less) streams under streaming mode must diff
+    each sync against the previous snapshot — the table mirrors the
+    source instead of accumulating a duplicate copy per refresh
+    (reference: io/airbyte/logic.py destination snapshot handling)."""
+    items = [{"id": 1}, {"id": 2}]
+    srv = _start_api(items)
+    try:
+        manifest = textwrap.dedent(
+            f"""
+            streams:
+              - name: items
+                retriever:
+                  requester:
+                    url_base: http://127.0.0.1:{srv.server_address[1]}
+                    path: /v1/items
+                    request_parameters:
+                      api_key: sekret
+                  record_selector:
+                    extractor:
+                      field_path: ["data"]
+            """
+        )
+        (tmp_path / "manifest.yaml").write_text(manifest)
+        conn = tmp_path / "connection.yaml"
+        conn.write_text(
+            "source:\n"
+            "  manifest_path: manifest.yaml\n"
+            "  config: {api_key: sekret}\n"
+        )
+        t = pw.io.airbyte.read(
+            str(conn), streams=["items"], mode="streaming",
+            refresh_interval_ms=150,
+        )
+        rows = {}
+        import threading
+
+        phase2 = threading.Event()
+        done = threading.Event()
+
+        def on_change(key, row, time_, add):
+            if add:
+                rows[key] = row["data"].value
+            else:
+                rows.pop(key, None)
+            ids = sorted(r["id"] for r in rows.values())
+            if ids == [1, 2] and not phase2.is_set():
+                phase2.set()
+                items.pop(0)          # source drops id=1 ...
+                items.append({"id": 3})  # ... and gains id=3
+            elif phase2.is_set() and ids == [2, 3]:
+                done.set()
+
+        pw.io.subscribe(t, on_change=on_change)
+
+        import os as _os
+
+        threading.Thread(
+            target=lambda: (done.wait(timeout=15), None), daemon=True
+        ).start()
+        runner = threading.Thread(
+            target=lambda: pw.run(monitoring_level=pw.MonitoringLevel.NONE),
+            daemon=True,
+        )
+        runner.start()
+        assert done.wait(timeout=15), sorted(
+            r["id"] for r in rows.values()
+        )
+    finally:
+        srv.shutdown()

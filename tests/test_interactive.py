@@ -1,0 +1,67 @@
+"""Interactive live-REPL mode (reference:
+python/pathway/internals/interactive.py:222 — background run + live table
+inspection, including tables first inspected AFTER the run started)."""
+
+import subprocess
+import sys
+import textwrap
+
+_PROG = textwrap.dedent(
+    """
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import pathway_tpu as pw
+
+    pw.enable_interactive_mode()
+
+    class Src(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+        def run(self):
+            for i in range(5):
+                self.next(v=i)
+                self.commit()
+                time.sleep(0.05)
+            time.sleep(3)
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=None)
+    agg = t.reduce(s=pw.reducers.sum(pw.this.v))
+
+    pre = pw.live(t)       # registered before the run
+    pw.run()               # interactive: returns immediately
+    time.sleep(1.0)
+    post = pw.live(agg)    # attached AFTER the run started
+    time.sleep(1.0)
+    rows = post.snapshot()
+    assert rows and rows[0]["s"] == 10, rows
+    assert len(pre.snapshot()) == 5, pre.snapshot()
+    assert "s" in repr(post)
+    # unreachable-at-launch tables are a clear error, not a silent hang
+    t2 = pw.debug.table_from_markdown("x\\n1")
+    try:
+        pw.live(t2)
+    except RuntimeError as e:
+        assert "fixed at launch" in str(e)
+    else:
+        raise AssertionError("expected RuntimeError for late table")
+    print("INTERACTIVE_OK")
+    """
+)
+
+
+def test_interactive_live_views(tmp_path):
+    import os
+
+    script = tmp_path / "prog.py"
+    script.write_text(_PROG.format(repo=os.getcwd()))
+    r = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert "INTERACTIVE_OK" in r.stdout.decode()

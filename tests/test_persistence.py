@@ -88,6 +88,8 @@ def _run_wordcount(tmp, kill_after: float) -> int:
 
 
 def test_wordcount_kill_and_recover(tmp_path):
+    if __import__("os").environ.get("PATHWAY_LANE_PROCESSES"):
+        pytest.skip("kill timing incompatible with the emulated-rank lane")
     tmp = str(tmp_path)
     docs = os.path.join(tmp, "docs")
     os.makedirs(docs)
@@ -149,6 +151,8 @@ def test_torn_journal_tail_dropped(tmp_path):
 
 
 def test_wordcount_operator_snapshot_recover(tmp_path):
+    if __import__("os").environ.get("PATHWAY_LANE_PROCESSES"):
+        pytest.skip("kill timing incompatible with the emulated-rank lane")
     """Same kill/restart scenario, OPERATOR_PERSISTING mode: node states
     restore directly, no journal replay."""
     tmp = str(tmp_path)

@@ -290,3 +290,53 @@ def test_deduplicate_keeps_latest_accepted():
     )
     vals = [row[0] for row in run_table(r).values()]
     assert vals == [5]
+
+
+def test_mixed_stateful_and_plain_reducers():
+    """Stateful reducers compose freely with plain ones in a single
+    reduce() (reference: src/engine/reduce.rs:22 — Stateful is just
+    another Reducer variant)."""
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 5
+        a | 3
+        """
+    )
+    concat = pw.reducers.stateful_many(
+        lambda state, rows: (state or "")
+        + "".join(str(a[0]) for a, d in rows if d > 0)
+    )
+    out = t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+        seen=concat(pw.this.v),
+    )
+    rows = sorted(_rows(out))
+    assert rows == [("a", 6, 3, "123"), ("b", 5, 1, "5")]
+
+
+def test_two_stateful_reducers_in_one_reduce():
+    t = pw.debug.table_from_markdown(
+        """
+        g | v
+        a | 1
+        a | 4
+        b | 2
+        """
+    )
+    acc_sum = pw.reducers.stateful_many(
+        lambda s, rows: (s or 0) + sum(a[0] * d for a, d in rows)
+    )
+    acc_max = pw.reducers.stateful_many(
+        lambda s, rows: max(
+            [a[0] for a, d in rows if d > 0] + ([s] if s is not None else [])
+        )
+    )
+    out = t.groupby(pw.this.g).reduce(
+        g=pw.this.g, s=acc_sum(pw.this.v), m=acc_max(pw.this.v)
+    )
+    assert sorted(_rows(out)) == [("a", 5, 4), ("b", 2, 2)]
