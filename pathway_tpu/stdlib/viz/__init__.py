@@ -137,6 +137,143 @@ class LiveView:
         return self
 
 
+class _SseHub:
+    """Per-view fan-out of diff events to connected SSE clients. Client
+    queues are small and keep-latest: only the newest table snapshot
+    matters for this UI, so a stalled browser never accumulates frames."""
+
+    def __init__(self):
+        import queue as _q
+        import threading
+
+        self._clients: list = []
+        self._lock = threading.Lock()
+        self._q = _q  # module handle for subscriber queues
+
+    def subscribe(self):
+        q = self._q.Queue(maxsize=2)
+        with self._lock:
+            self._clients.append(q)
+        return q
+
+    def unsubscribe(self, q) -> None:
+        with self._lock:
+            if q in self._clients:
+                self._clients.remove(q)
+
+    def has_clients(self) -> bool:
+        with self._lock:
+            return bool(self._clients)
+
+    def publish(self, payload: str) -> None:
+        with self._lock:
+            clients = list(self._clients)
+        for q in clients:
+            while True:
+                try:
+                    q.put_nowait(payload)
+                    break
+                except self._q.Full:
+                    try:
+                        q.get_nowait()  # drop the stalest frame
+                    except self._q.Empty:
+                        pass
+
+
+def _live_page(title: str) -> str:
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{title}</title></head><body>"
+        f"<h3>{title} <small>(streaming)</small></h3>"
+        "<div id='tbl'>connecting…</div>"
+        "<script>"
+        "const es = new EventSource('/stream');"
+        "es.onmessage = (e) => {"
+        "  document.getElementById('tbl').innerHTML = JSON.parse(e.data).html;"
+        "};"
+        "</script></body></html>"
+    )
+
+
+def serve_live_view(view: "LiveView", host: str = "127.0.0.1", port: int = 0):
+    """True streaming dashboard for a LiveView: every table diff PUSHES a
+    Server-Sent-Events message to connected browsers — no client polling
+    (the tpu-native stand-in for the reference's Bokeh/Panel streams,
+    table_viz.py:165; bokeh is not a dependency of this image).
+    Returns the bound (host, port)."""
+    import http.server
+    import json as _json
+    import threading
+
+    hub = _SseHub()
+    prev_update = view._on_update
+    dirty = threading.Event()
+
+    def on_update(v):
+        # subscribe-callback thread: just flag; rendering + fan-out happen
+        # on the publisher thread, coalescing bursts of diffs into one
+        # frame and doing no work at all while no client is connected
+        dirty.set()
+        if prev_update is not None:
+            prev_update(v)
+
+    view._on_update = on_update
+
+    def publisher():
+        import time as _t
+
+        while True:
+            dirty.wait()
+            dirty.clear()
+            if not hub.has_clients():
+                continue
+            hub.publish(
+                _json.dumps(
+                    {"html": view.to_html(), "rows": len(view._rows)}
+                )
+            )
+            _t.sleep(0.2)  # coalesce bursts into ≤5 frames/s
+
+    threading.Thread(target=publisher, daemon=True).start()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/stream":
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                q = hub.subscribe()
+                try:
+                    # initial frame so a fresh client renders immediately
+                    first = _json.dumps({"html": view.to_html()})
+                    self.wfile.write(f"data: {first}\n\n".encode())
+                    self.wfile.flush()
+                    while True:
+                        payload = q.get()
+                        self.wfile.write(f"data: {payload}\n\n".encode())
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+                finally:
+                    hub.unsubscribe(q)
+                return
+            body = _live_page("pathway live table").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    view._sse_server = server
+    return server.server_address
+
+
 def show(table, *, live: bool = False, **kwargs):
     """reference: table_viz.py show — display in notebook/panel server.
     ``live=True`` returns a diff-driven LiveView (register BEFORE pw.run();

@@ -125,43 +125,186 @@ def _pdf_content_text(content: bytes) -> str:
     return "".join(text_parts)
 
 
-def _builtin_pdf_pages(data: bytes) -> list[str]:
-    """Dependency-free PDF text extraction: each content stream holding
-    BT/ET text blocks is one page, decoded raw or FlateDecode."""
+def _pdf_content_runs(content: bytes) -> list[tuple[float, float, str]]:
+    """Positioned text runs [(x, y, text)] from a content stream: tracks
+    the Td/TD/Tm text-positioning operators alongside Tj/TJ shows — the
+    coordinate substrate for table detection (reference analog: OpenParse
+    table pipelines, xpacks/llm/parsers.py OpenParse + openparse_utils)."""
+    import re
+
+    tok = re.compile(
+        rb"\(((?:[^()\\]|\\.)*)\)\s*(?:Tj|'|\")"        # show string
+        rb"|\[((?:[^\]\\]|\\.)*)\]\s*TJ"                  # kerned show
+        rb"|(-?[\d.]+)\s+(-?[\d.]+)\s+(Td|TD)"           # relative move
+        rb"|(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+"
+        rb"(-?[\d.]+)\s+(-?[\d.]+)\s+(-?[\d.]+)\s+Tm",   # absolute matrix
+        re.DOTALL,
+    )
+    runs: list[tuple[float, float, str]] = []
+    for bt_block in re.findall(rb"BT(.*?)ET", content, re.DOTALL):
+        x = y = 0.0
+        for m in tok.finditer(bt_block):
+            if m.group(1) is not None:
+                runs.append((x, y, _pdf_unescape(m.group(1))))
+            elif m.group(2) is not None:
+                text = "".join(
+                    _pdf_unescape(s)
+                    for s in re.findall(
+                        rb"\(((?:[^()\\]|\\.)*)\)", m.group(2)
+                    )
+                )
+                runs.append((x, y, text))
+            elif m.group(5) is not None:
+                x += float(m.group(3))
+                y += float(m.group(4))
+            else:
+                x = float(m.group(10))
+                y = float(m.group(11))
+    return runs
+
+
+def _runs_to_tables(
+    runs: list[tuple[float, float, str]],
+    *,
+    y_tol: float = 3.0,
+    x_tol: float = 6.0,
+    min_rows: int = 2,
+    min_cols: int = 2,
+) -> list[list[list[str]]]:
+    """Cluster positioned runs into tables: lines by y, columns by x
+    positions that align across consecutive multi-run lines."""
+    if not runs:
+        return []
+    # group runs into lines (descending y = top to bottom)
+    lines: list[tuple[float, list[tuple[float, str]]]] = []
+    for x, y, text in runs:
+        if not text.strip():
+            continue
+        for ly, cells in lines:
+            if abs(ly - y) <= y_tol:
+                cells.append((x, text))
+                break
+        else:
+            lines.append((y, [(x, text)]))
+    lines.sort(key=lambda l: -l[0])
+    tables: list[list[list[str]]] = []
+    block: list[list[tuple[float, str]]] = []
+
+    def flush():
+        nonlocal block
+        if len(block) >= min_rows:
+            # columns: union of x starts across the block, merged by x_tol
+            xs: list[float] = []
+            for row in block:
+                for x, _ in row:
+                    if not any(abs(x - e) <= x_tol for e in xs):
+                        xs.append(x)
+            xs.sort()
+            if len(xs) >= min_cols:
+                table = []
+                for row in block:
+                    cells = [""] * len(xs)
+                    for x, text in sorted(row):
+                        ci = min(
+                            range(len(xs)), key=lambda i: abs(xs[i] - x)
+                        )
+                        cells[ci] = (cells[ci] + " " + text).strip()
+                    table.append(cells)
+                tables.append(table)
+        block = []
+
+    for _y, cells in lines:
+        if len(cells) >= min_cols:
+            block.append(cells)
+        else:
+            flush()
+    flush()
+    return tables
+
+
+def _pdf_text_streams(data: bytes):
+    """Yields decoded content streams holding BT/ET text blocks — the one
+    shared stream-walk for page text and table extraction. Per stream,
+    yields a list of candidate decodings, decompressed candidate FIRST:
+    compressed bytes can contain "BT"/"ET" by chance, so consumers should
+    stop at the first candidate that produced real content."""
     import re
     import zlib
 
-    pages: list[str] = []
     for m in re.finditer(rb"(?<!end)stream\r?\n", data):
         start = m.end()
         end = data.find(b"endstream", start)
         if end < 0:
             continue
         raw = data[start:end].rstrip(b"\r\n")
-        # decompressed candidate FIRST: compressed bytes can contain "BT"/
-        # "ET" by chance, and a break on the raw candidate would drop the
-        # real page; only stop once actual text came out
         candidates = []
         try:
             candidates.append(zlib.decompress(raw))
         except zlib.error:
             pass
         candidates.append(raw)
+        texty = [c for c in candidates if b"BT" in c and b"ET" in c]
+        if texty:
+            yield texty
+
+
+def pdf_tables(data: bytes) -> list[list[list[str]]]:
+    """Dependency-free PDF table extraction: positioned text runs
+    clustered into aligned rows/columns across every page."""
+    tables: list[list[list[str]]] = []
+    for candidates in _pdf_text_streams(data):
         for content in candidates:
-            if b"BT" in content and b"ET" in content:
-                text = _pdf_content_text(content)
-                if text.strip():
-                    pages.append(text)
-                    break
+            runs = _pdf_content_runs(content)
+            if runs:
+                tables.extend(_runs_to_tables(runs))
+                break
+    return tables
+
+
+def _md_cell(text: str) -> str:
+    """Markdown-safe cell: literal pipes escape, newlines flatten."""
+    return text.replace("|", "\\|").replace("\n", " ").replace("\r", " ")
+
+
+def _table_to_markdown(table: list[list[str]]) -> str:
+    head, *rest = table
+    lines = ["| " + " | ".join(_md_cell(c) for c in head) + " |"]
+    lines.append("|" + "---|" * len(head))
+    for row in rest:
+        lines.append("| " + " | ".join(_md_cell(c) for c in row) + " |")
+    return "\n".join(lines)
+
+
+def _builtin_pdf_pages(data: bytes) -> list[str]:
+    """Dependency-free PDF text extraction: each content stream holding
+    BT/ET text blocks is one page, decoded raw or FlateDecode."""
+    pages: list[str] = []
+    for candidates in _pdf_text_streams(data):
+        for content in candidates:
+            text = _pdf_content_text(content)
+            if text.strip():
+                pages.append(text)
+                break
     return pages
 
 
 class PypdfParser(UDF):
     """reference: parsers.py PypdfParser. Uses pypdf when importable; falls
     back to the built-in minimal extractor (literal-string Tj/TJ text from
-    raw or Flate streams) so simple PDFs parse with zero dependencies."""
+    raw or Flate streams) so simple PDFs parse with zero dependencies.
 
-    def __init__(self, apply_text_cleanup: bool = True, **kwargs):
+    ``extract_tables=True`` additionally emits one markdown chunk per
+    detected table (positioned-run clustering — the dependency-free
+    analog of the reference's OpenParse table pipeline,
+    parsers.py:53-928 + openparse_utils.py), tagged
+    ``{"kind": "table"}`` so retrieval can disclose the source shape."""
+
+    def __init__(
+        self,
+        apply_text_cleanup: bool = True,
+        extract_tables: bool = False,
+        **kwargs,
+    ):
         try:
             import pypdf  # noqa: F401
 
@@ -169,6 +312,7 @@ class PypdfParser(UDF):
         except ImportError:
             self._have_pypdf = False
         self.apply_text_cleanup = apply_text_cleanup
+        self.extract_tables = extract_tables
         cleanup = (
             (lambda t: " ".join(t.split())) if apply_text_cleanup else (lambda t: t)
         )
@@ -180,14 +324,24 @@ class PypdfParser(UDF):
                 import pypdf
 
                 reader = pypdf.PdfReader(io.BytesIO(contents))
-                return [
+                out = [
                     (cleanup(page.extract_text() or ""), {"page": i})
                     for i, page in enumerate(reader.pages)
                 ]
-            return [
-                (cleanup(text), {"page": i})
-                for i, text in enumerate(_builtin_pdf_pages(contents))
-            ]
+            else:
+                out = [
+                    (cleanup(text), {"page": i})
+                    for i, text in enumerate(_builtin_pdf_pages(contents))
+                ]
+            if self.extract_tables:
+                for ti, table in enumerate(pdf_tables(contents)):
+                    out.append(
+                        (
+                            _table_to_markdown(table),
+                            {"kind": "table", "table": ti},
+                        )
+                    )
+            return out
 
         super().__init__(parse, return_type=list, deterministic=True)
 

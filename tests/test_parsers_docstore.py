@@ -221,3 +221,53 @@ def test_vector_store_requires_exactly_one_strategy():
         VectorStoreServer(
             docs, embedder=lambda t: [0.0], index_builder=lambda c: None
         )
+
+
+def _positioned_pdf(rows):
+    """Minimal one-page PDF with absolutely positioned text runs (Tm) —
+    rows: list of [(x, y, text), ...]."""
+    content = b"BT /F1 10 Tf\n"
+    for x, y, text in rows:
+        content += (
+            f"1 0 0 1 {x} {y} Tm ({text}) Tj\n".encode()
+        )
+    content += b"ET"
+    return (
+        b"%PDF-1.4\n1 0 obj << /Length " + str(len(content)).encode()
+        + b" >>\nstream\n" + content + b"\nendstream\nendobj\n%%EOF"
+    )
+
+
+def test_pdf_table_extraction():
+    from pathway_tpu.xpacks.llm.parsers import pdf_tables
+
+    pdf = _positioned_pdf([
+        (72, 700, "Name"), (200, 700, "Qty"), (300, 700, "Price"),
+        (72, 684, "apples"), (200, 684, "12"), (300, 684, "3.50"),
+        (72, 668, "pears"), (200, 668, "7"), (300, 668, "4.10"),
+        (72, 600, "A trailing paragraph spanning the page."),
+    ])
+    [table] = pdf_tables(pdf)
+    assert table == [
+        ["Name", "Qty", "Price"],
+        ["apples", "12", "3.50"],
+        ["pears", "7", "4.10"],
+    ]
+
+
+def test_pypdf_parser_emits_table_chunks():
+    from pathway_tpu.xpacks.llm.parsers import PypdfParser
+
+    pdf = _positioned_pdf([
+        (72, 700, "City"), (220, 700, "Pop"),
+        (72, 684, "Oslo"), (220, 684, "700k"),
+        (72, 668, "Kyoto"), (220, 668, "1.4M"),
+    ])
+    parser = PypdfParser(extract_tables=True)
+    out = _run_udf(parser, pdf)
+    tables = [(t, m) for t, m in out if m.get("kind") == "table"]
+    assert len(tables) == 1
+    text, meta = tables[0]
+    assert "| City | Pop |" in text and "| Kyoto | 1.4M |" in text
+    # text chunks still present alongside
+    assert any(m.get("kind") != "table" for _, m in out)
