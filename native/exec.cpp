@@ -28,6 +28,7 @@
 #include <cstring>
 #include <map>
 #include <string>
+#include <mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -1699,7 +1700,331 @@ PyObject *join_store_load(PyObject *, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* ---- WordPiece batch tokenizer --------------------------------------
+ * The streaming-ingest hot loop (models/wordpiece.py): whitespace split,
+ * per-word memo lookup and sequence assembly run in C; memo MISSES call
+ * back into the Python tokenizer's exact `_word_ids` (normalization +
+ * punctuation split + greedy longest-match), so token output is
+ * byte-identical to the pure path. Texts containing non-ASCII bytes
+ * return None (the Python path handles them — str.split() whitespace
+ * semantics differ beyond ASCII). */
+
+struct WpStore {
+    std::unordered_map<std::string, std::vector<int32_t>> memo;
+    size_t cap;
+    std::mutex mu; /* serializes concurrent batch calls: the memo-hit
+                    * phase runs with the GIL RELEASED, so the GIL no
+                    * longer guards the map */
+};
+
+void wp_capsule_destructor(PyObject *capsule)
+{
+    delete static_cast<WpStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.wp"));
+}
+
+WpStore *get_wp(PyObject *capsule)
+{
+    return static_cast<WpStore *>(PyCapsule_GetPointer(capsule, "pwexec.wp"));
+}
+
+PyObject *wp_new(PyObject *, PyObject *args)
+{
+    long long cap = 1000000;
+    if (!PyArg_ParseTuple(args, "|L", &cap))
+        return nullptr;
+    auto *st = new WpStore();
+    st->cap = (size_t)cap;
+    return PyCapsule_New(st, "pwexec.wp", wp_capsule_destructor);
+}
+
+PyObject *wp_len(PyObject *, PyObject *capsule)
+{
+    WpStore *st = get_wp(capsule);
+    if (st == nullptr)
+        return nullptr;
+    return PyLong_FromSsize_t((Py_ssize_t)st->memo.size());
+}
+
+inline bool wp_is_ws(unsigned char c)
+{
+    /* str.split() whitespace within ASCII: space, \t-\r, \x1c-\x1f */
+    return c == ' ' || (c >= 0x09 && c <= 0x0d) || (c >= 0x1c && c <= 0x1f);
+}
+
+/* wp_tokenize(store, texts, budget, cls, sep, fallback) ->
+ *   list of bytes (int32 token ids incl. cls/sep, truncated) | None
+ *   (None = text has non-ASCII bytes: caller uses the Python path) */
+PyObject *wp_tokenize(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *texts, *fallback;
+    long long budget, cls_id, sep_id;
+    if (!PyArg_ParseTuple(args, "OOLLLO", &capsule, &texts, &budget,
+                          &cls_id, &sep_id, &fallback))
+        return nullptr;
+    WpStore *st = get_wp(capsule);
+    if (st == nullptr)
+        return nullptr;
+    PyObject *seq = PySequence_Fast(texts, "wp_tokenize expects a sequence");
+    if (seq == nullptr)
+        return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    /* phase A (GIL held): pin the UTF-8 views */
+    std::vector<const char *> tptr((size_t)n);
+    std::vector<Py_ssize_t> tlen((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        tptr[(size_t)i] = PyUnicode_AsUTF8AndSize(
+            PySequence_Fast_GET_ITEM(seq, i), &tlen[(size_t)i]);
+        if (tptr[(size_t)i] == nullptr) {
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+    }
+    /* phase B (GIL RELEASED): memo-only tokenization. After warmup every
+     * word hits the memo and this is the whole batch — the tokenize-ahead
+     * thread genuinely overlaps device dispatch on multi-core hosts.
+     * Texts with a miss or non-ASCII bytes are deferred to phase C. */
+    std::vector<int32_t> flat;
+    flat.reserve((size_t)n * 128);
+    std::vector<size_t> fstart((size_t)n + 1, 0);
+    std::vector<uint8_t> deferred((size_t)n, 0);
+    std::vector<uint8_t> non_ascii((size_t)n, 0);
+    {
+        /* lock ordering: NEVER wait on the store mutex while holding the
+         * GIL (another thread may hold the mutex and need the GIL for its
+         * fallback phase). The mutex is taken inside the allow-threads
+         * region; phase C reacquires the GIL while still holding it. */
+        std::unique_lock<std::mutex> guard(st->mu, std::defer_lock);
+        Py_BEGIN_ALLOW_THREADS
+        guard.lock();
+        std::string word;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            const char *t = tptr[(size_t)i];
+            const Py_ssize_t len = tlen[(size_t)i];
+            bool ascii = true;
+            for (Py_ssize_t j = 0; j < len; j++)
+                if ((unsigned char)t[j] >= 0x80) {
+                    ascii = false;
+                    break;
+                }
+            fstart[(size_t)i] = flat.size();
+            if (!ascii) {
+                non_ascii[(size_t)i] = 1;
+                continue;
+            }
+            const size_t base = flat.size();
+            flat.push_back((int32_t)cls_id);
+            Py_ssize_t j = 0;
+            bool missed = false;
+            while (j < len) {
+                while (j < len && wp_is_ws((unsigned char)t[j]))
+                    j++;
+                Py_ssize_t ws = j;
+                while (j < len && !wp_is_ws((unsigned char)t[j]))
+                    j++;
+                if (j == ws)
+                    break;
+                if ((long long)(flat.size() - base) - 1 >= budget)
+                    break;
+                word.assign(t + ws, (size_t)(j - ws));
+                auto it = st->memo.find(word);
+                if (it == st->memo.end()) {
+                    missed = true;
+                    break;
+                }
+                flat.insert(flat.end(), it->second.begin(),
+                            it->second.end());
+            }
+            if (missed) {
+                deferred[(size_t)i] = 1;
+                flat.resize(base);
+                continue;
+            }
+            if ((long long)(flat.size() - base) > budget + 1)
+                flat.resize(base + (size_t)(budget + 1));
+            flat.push_back((int32_t)sep_id);
+        }
+        fstart[(size_t)n] = flat.size();
+        Py_END_ALLOW_THREADS
+        /* phase C (GIL held, store still locked): texts with misses run
+         * the fallback-calling loop; non-ASCII texts yield None */
+        std::vector<int32_t> ids;
+        std::string word;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (non_ascii[(size_t)i]) {
+                Py_INCREF(Py_None);
+                PyList_SET_ITEM(out, i, Py_None);
+                continue;
+            }
+            if (!deferred[(size_t)i]) {
+                /* fstart is monotone: deferred/non-ascii texts occupy an
+                 * empty span (their flat writes were rolled back) */
+                const size_t lo = fstart[(size_t)i];
+                const size_t hi = fstart[(size_t)i + 1];
+                PyObject *b = PyBytes_FromStringAndSize(
+                    reinterpret_cast<const char *>(flat.data() + lo),
+                    (Py_ssize_t)((hi - lo) * sizeof(int32_t)));
+                if (b == nullptr)
+                    goto fail;
+                PyList_SET_ITEM(out, i, b);
+                continue;
+            }
+            const char *t = tptr[(size_t)i];
+            const Py_ssize_t len = tlen[(size_t)i];
+            ids.clear();
+            ids.push_back((int32_t)cls_id);
+            Py_ssize_t j = 0;
+            while (j < len) {
+                while (j < len && wp_is_ws((unsigned char)t[j]))
+                    j++;
+                Py_ssize_t ws = j;
+                while (j < len && !wp_is_ws((unsigned char)t[j]))
+                    j++;
+                if (j == ws)
+                    break;
+                if ((long long)ids.size() - 1 >= budget)
+                    break;
+                word.assign(t + ws, (size_t)(j - ws));
+                auto it = st->memo.find(word);
+                if (it == st->memo.end()) {
+                    /* memo miss: exact Python tokenization of this word */
+                    PyObject *w = PyUnicode_FromStringAndSize(
+                        t + ws, j - ws);
+                    if (w == nullptr)
+                        goto fail;
+                    PyObject *res = PyObject_CallOneArg(fallback, w);
+                    Py_DECREF(w);
+                    if (res == nullptr)
+                        goto fail;
+                    PyObject *rseq = PySequence_Fast(
+                        res, "fallback must return a sequence");
+                    Py_DECREF(res);
+                    if (rseq == nullptr)
+                        goto fail;
+                    std::vector<int32_t> wids;
+                    Py_ssize_t m = PySequence_Fast_GET_SIZE(rseq);
+                    wids.reserve((size_t)m);
+                    for (Py_ssize_t q = 0; q < m; q++) {
+                        long v = PyLong_AsLong(
+                            PySequence_Fast_GET_ITEM(rseq, q));
+                        if (v == -1 && PyErr_Occurred()) {
+                            Py_DECREF(rseq);
+                            goto fail;
+                        }
+                        wids.push_back((int32_t)v);
+                    }
+                    Py_DECREF(rseq);
+                    if (st->memo.size() < st->cap)
+                        it = st->memo.emplace(word, std::move(wids)).first;
+                    else {
+                        ids.insert(ids.end(), wids.begin(), wids.end());
+                        continue;
+                    }
+                }
+                ids.insert(ids.end(), it->second.begin(), it->second.end());
+            }
+            if ((long long)ids.size() > budget + 1)
+                ids.resize((size_t)(budget + 1));
+            ids.push_back((int32_t)sep_id);
+            PyObject *b = PyBytes_FromStringAndSize(
+                reinterpret_cast<const char *>(ids.data()),
+                (Py_ssize_t)(ids.size() * sizeof(int32_t)));
+            if (b == nullptr)
+                goto fail;
+            PyList_SET_ITEM(out, i, b);
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(out);
+    Py_DECREF(seq);
+    return nullptr;
+}
+
+/* wp_tokenize_padded(store, texts, budget, cls, sep, pad, fallback) ->
+ *   (ids_bytes, mask_bytes, n, longest) — one padded int32 buffer pair
+ *   for the whole batch — or None when any text has non-ASCII bytes
+ *   (caller falls back to the per-row route). */
+PyObject *wp_tokenize_padded(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *texts, *fallback;
+    long long budget, cls_id, sep_id, pad_id;
+    if (!PyArg_ParseTuple(args, "OOLLLLO", &capsule, &texts, &budget,
+                          &cls_id, &sep_id, &pad_id, &fallback))
+        return nullptr;
+    /* reuse wp_tokenize for the per-text id vectors */
+    PyObject *sub_args = Py_BuildValue(
+        "(OOLLLO)", capsule, texts, budget, cls_id, sep_id, fallback);
+    if (sub_args == nullptr)
+        return nullptr;
+    PyObject *rows = wp_tokenize(nullptr, sub_args);
+    Py_DECREF(sub_args);
+    if (rows == nullptr)
+        return nullptr;
+    Py_ssize_t n = PyList_GET_SIZE(rows);
+    Py_ssize_t longest = 1;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = PyList_GET_ITEM(rows, i);
+        if (r == Py_None) {
+            Py_DECREF(rows);
+            Py_RETURN_NONE;
+        }
+        Py_ssize_t m = PyBytes_GET_SIZE(r) / (Py_ssize_t)sizeof(int32_t);
+        if (m > longest)
+            longest = m;
+    }
+    PyObject *ids_b = PyBytes_FromStringAndSize(
+        nullptr, n * longest * (Py_ssize_t)sizeof(int32_t));
+    PyObject *mask_b = PyBytes_FromStringAndSize(
+        nullptr, n * longest * (Py_ssize_t)sizeof(int32_t));
+    if (ids_b == nullptr || mask_b == nullptr) {
+        Py_XDECREF(ids_b);
+        Py_XDECREF(mask_b);
+        Py_DECREF(rows);
+        return nullptr;
+    }
+    auto *ids = reinterpret_cast<int32_t *>(PyBytes_AS_STRING(ids_b));
+    auto *mask = reinterpret_cast<int32_t *>(PyBytes_AS_STRING(mask_b));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *r = PyList_GET_ITEM(rows, i);
+        const auto *src =
+            reinterpret_cast<const int32_t *>(PyBytes_AS_STRING(r));
+        Py_ssize_t m = PyBytes_GET_SIZE(r) / (Py_ssize_t)sizeof(int32_t);
+        int32_t *row_ids = ids + i * longest;
+        int32_t *row_mask = mask + i * longest;
+        for (Py_ssize_t j = 0; j < m; j++) {
+            row_ids[j] = src[j];
+            row_mask[j] = 1;
+        }
+        for (Py_ssize_t j = m; j < longest; j++) {
+            row_ids[j] = (int32_t)pad_id;
+            row_mask[j] = 0;
+        }
+    }
+    Py_DECREF(rows);
+    PyObject *out = Py_BuildValue("(OOnn)", ids_b, mask_b, n, longest);
+    Py_DECREF(ids_b);
+    Py_DECREF(mask_b);
+    return out;
+}
+
 PyMethodDef methods[] = {
+    {"wp_new", wp_new, METH_VARARGS,
+     "wp_new(cache_size) -> wordpiece memo capsule"},
+    {"wp_tokenize_padded", wp_tokenize_padded, METH_VARARGS,
+     "wp_tokenize_padded(store, texts, budget, cls, sep, pad, fallback) "
+     "-> (ids_bytes, mask_bytes, n, longest) | None"},
+    {"wp_len", wp_len, METH_O, "number of memoized words"},
+    {"wp_tokenize", wp_tokenize, METH_VARARGS,
+     "wp_tokenize(store, texts, budget, cls, sep, fallback) -> "
+     "[ids_bytes|None, ...]"},
     {"store_new", store_new, METH_VARARGS,
      "store_new(n_shards, codes) -> capsule"},
     {"store_len", store_len, METH_O, "number of live groups"},

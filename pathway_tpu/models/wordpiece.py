@@ -68,6 +68,11 @@ class WordPieceTokenizer:
         # raw word -> subword ids, covering normalize+split+wordpiece of a
         # whitespace-delimited chunk (the hot-path memo)
         self._cache: dict[str, list[int]] = {}
+        # native batch fast path (native/exec.cpp wp_tokenize): C-side
+        # word memo + sequence assembly for ASCII texts; misses and
+        # non-ASCII texts run the exact Python path. Resolved lazily.
+        self._wp_exec = None
+        self._wp_store = False  # False = not yet resolved
 
     # -- normalization (BertNormalizer semantics) --------------------------
     def _normalize(self, text: str) -> str:
@@ -159,13 +164,55 @@ class WordPieceTokenizer:
         ids.append(self.sep_id)
         return ids
 
+    def _native(self):
+        if self._wp_store is False:
+            self._wp_store = None
+            try:
+                from pathway_tpu.native import get_pwexec
+
+                ex = get_pwexec()
+                if ex is not None and hasattr(ex, "wp_tokenize"):
+                    self._wp_exec = ex
+                    self._wp_store = ex.wp_new(self._cache_size)
+            except Exception:
+                self._wp_store = None
+        return self._wp_store
+
     def __call__(
         self, texts, max_length: int | None = None
     ) -> tuple[np.ndarray, np.ndarray]:
         """(ids [n, L], mask [n, L]) padded to the longest sequence (callers
         bucket-pad to jit-stable shapes)."""
         max_len = max_length or self.max_length
-        seqs = [self.tokenize_ids(t, max_len) for t in texts]
+        texts = list(texts)
+        store = self._native()
+        if store is not None:
+            packed = self._wp_exec.wp_tokenize_padded(
+                store, texts, max_len - 2, self.cls_id, self.sep_id,
+                self.pad_id, self._word_ids,
+            )
+            if packed is not None:
+                ids_b, mask_b, n, longest = packed
+                ids_arr = np.frombuffer(ids_b, np.int32).reshape(n, longest)
+                mask = np.frombuffer(mask_b, np.int32).reshape(n, longest)
+                return ids_arr, mask
+            rows = self._wp_exec.wp_tokenize(
+                store, texts, max_len - 2, self.cls_id, self.sep_id,
+                self._word_ids,
+            )
+            seqs = [
+                np.frombuffer(r, np.int32)
+                if r is not None
+                else np.asarray(
+                    self.tokenize_ids(texts[i], max_len), np.int32
+                )
+                for i, r in enumerate(rows)
+            ]
+        else:
+            seqs = [
+                np.asarray(self.tokenize_ids(t, max_len), np.int32)
+                for t in texts
+            ]
         longest = max((len(s) for s in seqs), default=1)
         ids_arr = np.full((len(texts), longest), self.pad_id, np.int32)
         mask = np.zeros((len(texts), longest), np.int32)
