@@ -56,6 +56,17 @@ class ConnectorSubject:
     def next_json(self, message: dict) -> None:
         self.next(**message)
 
+    def next_batch(self, rows: list[dict]) -> None:
+        """Push many rows in one producer call. The whole list reaches the
+        flush as a single message and (for keyless append-only subjects)
+        is parsed by one C call — the engine-bound ingestion door for
+        sources that already hold rows in memory."""
+        if self._finished or not rows:
+            return
+        # copy: parsing is deferred to flush time on the connector thread,
+        # so a caller-reused buffer must not alias the queued message
+        self._emit(("upsert_batch", list(rows)))
+
     def next_str(self, message: str) -> None:
         if message == COMMIT_LITERAL:
             self.commit()
@@ -125,6 +136,11 @@ def _make_parser(schema: type[Schema], subject=None):
 
     def parse(message) -> list[tuple]:
         kind, values = message[0], message[1]
+        if kind == "upsert_batch":
+            out: list[tuple] = []
+            for row_values in values:
+                out.extend(parse(("upsert", row_values)))
+            return out
         explicit_key = message[2] if len(message) > 2 else None
         row = tuple(values.get(c, d) for c, d in col_defaults)
         if pkeys:
@@ -177,7 +193,15 @@ def _make_parser(schema: type[Schema], subject=None):
         pure = simple
         while i < n:
             m = messages[i]
-            if simple and m[0] == "upsert" and len(m) == 2:
+            if simple and m[0] == "upsert_batch":
+                # pre-batched rows: one C call for the whole list
+                deltas, seq[0] = fp.parse_upserts(
+                    m[1], 0, cols_t, defaults_t, key_base, seq[0],
+                    _KEY_MASK, Pointer,
+                )
+                out.extend(deltas)
+                i += 1
+            elif simple and m[0] == "upsert" and len(m) == 2:
                 j = i + 1
                 while j < n:
                     mj = messages[j]

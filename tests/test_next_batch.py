@@ -1,0 +1,150 @@
+"""ConnectorSubject.next_batch: the batch ingestion door.
+
+A pre-batched list of row dicts must produce exactly the same final state
+as the same rows pushed one next() at a time, across all three parser
+regimes: keyless append-only (C fast path, one parse_upserts call per
+batch message), keyless with removal tracking (Python fallback expansion),
+and primary-keyed upsert sessions.
+
+Reference behavior bar: python/pathway/io/python/__init__.py ConnectorSubject
+(row-at-a-time only — batching is this framework's tpu-native addition, so
+the equivalence oracle below is the spec).
+"""
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _run_counts(subject_cls, schema, rows_arg):
+    pw.internals.parse_graph.G.clear()
+    t = pw.io.python.read(
+        subject_cls(rows_arg), schema=schema, autocommit_duration_ms=None
+    )
+    counts = t.groupby(pw.this.word).reduce(
+        word=pw.this.word, c=pw.reducers.count()
+    )
+    cap = GraphRunner().run_tables(counts)[0]
+    return sorted(tuple(r) for r in cap.state.rows.values())
+
+
+class _WordSchema(pw.Schema):
+    word: str
+
+
+class _BatchSubject(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+
+    def __init__(self, batches):
+        super().__init__()
+        self._batches = batches
+
+    def run(self):
+        for b in self._batches:
+            self.next_batch(b)
+            self.commit()
+
+
+class _RowSubject(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+
+    def __init__(self, batches):
+        super().__init__()
+        self._batches = batches
+
+    def run(self):
+        for b in self._batches:
+            for row in b:
+                self.next(**row)
+            self.commit()
+
+
+def test_next_batch_matches_row_at_a_time():
+    words = ["alpha", "beta", "gamma", "delta"]
+    batches = [
+        [{"word": words[(i * 7 + s) % 4]} for i in range(25)]
+        for s in range(4)
+    ]
+    assert _run_counts(_BatchSubject, _WordSchema, batches) == _run_counts(
+        _RowSubject, _WordSchema, batches
+    )
+
+
+def test_next_batch_with_removal_tracking():
+    """Subjects that keep deletions enabled route batch messages through the
+    Python parse expansion; remove()-by-content must still retract rows
+    that entered via next_batch."""
+
+    class S(pw.io.python.ConnectorSubject):
+        def __init__(self, _):
+            super().__init__()
+
+        def run(self):
+            self.next_batch(
+                [{"word": "keep"}, {"word": "drop"}, {"word": "keep"}]
+            )
+            self.commit()
+            self.remove(word="drop")
+            self.commit()
+
+    out = _run_counts(S, _WordSchema, None)
+    assert out == [("keep", 2)]
+
+
+def test_next_batch_primary_keyed_upserts():
+    """Primary-keyed subjects treat each batch row as an upsert: the last
+    write per key wins, exactly as with next()."""
+
+    class KV(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+    class S(pw.io.python.ConnectorSubject):
+        def __init__(self, _):
+            super().__init__()
+
+        def run(self):
+            self.next_batch([{"k": 1, "v": 10}, {"k": 2, "v": 20}])
+            self.commit()
+            self.next_batch([{"k": 1, "v": 11}, {"k": 3, "v": 30}])
+            self.commit()
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.io.python.read(S(None), schema=KV, autocommit_duration_ms=None)
+    cap = GraphRunner().run_tables(t)[0]
+    rows = sorted(tuple(r) for r in cap.state.rows.values())
+    assert rows == [(1, 11), (2, 20), (3, 30)]
+
+
+def test_next_batch_interleaves_with_next():
+    """Mixed producers in one commit: batch messages and single rows land
+    in arrival order within the same flush."""
+
+    class S(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def __init__(self, _):
+            super().__init__()
+
+        def run(self):
+            self.next(word="solo")
+            self.next_batch([{"word": "batch"}, {"word": "batch"}])
+            self.next(word="solo")
+            self.commit()
+
+    out = _run_counts(S, _WordSchema, None)
+    assert out == [("batch", 2), ("solo", 2)]
+
+
+def test_next_batch_empty_noop():
+    class S(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def __init__(self, _):
+            super().__init__()
+
+        def run(self):
+            self.next_batch([])
+            self.next_batch([{"word": "x"}])
+            self.commit()
+
+    assert _run_counts(S, _WordSchema, None) == [("x", 1)]
