@@ -16,13 +16,20 @@
  *      Python key_fn (once per group lifetime); before/after reducer
  *      values that changed become retract/insert delta pairs.
  *
- * Reducers: count / sum (int-exact, float-promoting, ERROR-poisoning,
- * None-skipping) / avg — the abelian set from internals/reducers.py.
+ * Reducers: the abelian set — count / sum (int-exact, float-promoting,
+ * ERROR-poisoning, None-skipping) / avg — plus ordered min/max (value
+ * multiset per group) and the multiset-valued suite — tuple /
+ * sorted_tuple (+skip_nones variants) / unique / any / argmin / argmax /
+ * earliest / latest — with optional groupby sort_by ordering (reference:
+ * src/engine/reduce.rs:22-594). Multiset-valued ("fp") reducers detect
+ * output changes via GIL-free finished-value fingerprints in phase 2 and
+ * build Python values only for changed groups in phase 3.
  */
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -50,20 +57,35 @@ struct Val {
     PyObject *obj;      /* borrowed original (joint-multiset storage) */
 };
 
-/* ordered value for min/max multisets: numerics compare numerically
- * (ints exactly against ints; mixed int/float via double, tag-broken so
- * 5 and 5.0 stay distinct adjacent entries); strings sort after
- * numerics by code point (UTF-8 byte order) */
+/* ordered value for min/max multisets and the sorted_tuple ordering:
+ * None sorts first (reference: Value::None is the smallest Value,
+ * value.rs:208), numerics compare numerically (ints exactly against
+ * ints; mixed int/float via double, tag-broken so 5 and 5.0 stay
+ * distinct adjacent entries); strings sort after numerics by code point
+ * (UTF-8 byte order) */
 struct MVal {
-    uint8_t tag; /* V_INT / V_FLT / V_STR */
+    uint8_t tag = V_NONE; /* V_NONE / V_ERR / V_INT / V_FLT / V_STR */
     int64_t i = 0;
     double f = 0.0;
     std::string s;
 
+    /* None=0 < numeric=1 < string=2 (V_ERR never enters an ordering:
+     * codes that would compare it fall back to the Python path, which
+     * raises the same TypeError the reference's semantics demand) */
+    int rank() const
+    {
+        if (tag == V_NONE || tag == V_ERR)
+            return 0;
+        return tag == V_STR ? 2 : 1;
+    }
+
     bool operator<(const MVal &o) const {
-        const bool anum = tag != V_STR, bnum = o.tag != V_STR;
-        if (anum != bnum)
-            return anum; /* numerics before strings */
+        const int ra = rank(), rb = o.rank();
+        if (ra != rb)
+            return ra < rb;
+        if (ra == 0)
+            return false; /* Nones tie */
+        const bool anum = ra == 1;
         if (!anum)
             return s < o.s;
         if (tag == V_INT && o.tag == V_INT)
@@ -79,10 +101,12 @@ struct MVal {
         return tag < o.tag; /* 5 (int) before 5.0 (float), stable */
     }
     bool num_equal(const MVal &o) const {
-        const bool anum = tag != V_STR, bnum = o.tag != V_STR;
-        if (anum != bnum)
+        const int ra = rank(), rb = o.rank();
+        if (ra != rb)
             return false;
-        if (!anum)
+        if (ra == 0)
+            return tag == o.tag; /* None==None, ERROR==ERROR */
+        if (ra == 2)
             return s == o.s;
         const long double a = tag == V_INT ? (long double)i : (long double)f;
         const long double b =
@@ -104,9 +128,119 @@ inline MVal mval_of(const Val &v)
     return m;
 }
 
+/* serialize an MVal with the SAME numeric normalization as ser_value
+ * (integral floats and bools collapse onto ints), so fingerprint
+ * equality coincides with Python tuple equality — the condition under
+ * which the Python path's consolidate() cancels a retract/insert pair */
+inline void mval_ser(std::string &out, const MVal &m)
+{
+    switch (m.tag) {
+    case V_NONE:
+        out.push_back('\x01');
+        return;
+    case V_ERR:
+        out.push_back('\x02');
+        return;
+    case V_INT: {
+        out.push_back('I');
+        out.append(reinterpret_cast<const char *>(&m.i), 8);
+        return;
+    }
+    case V_FLT: {
+        double d = m.f;
+        if (d == (double)(int64_t)d && d >= -9.2e18 && d <= 9.2e18) {
+            int64_t i = (int64_t)d;
+            out.push_back('I');
+            out.append(reinterpret_cast<const char *>(&i), 8);
+            return;
+        }
+        out.push_back('F');
+        out.append(reinterpret_cast<const char *>(&d), 8);
+        return;
+    }
+    case V_STR: {
+        uint32_t len = (uint32_t)m.s.size();
+        out.push_back('S');
+        out.append(reinterpret_cast<const char *>(&len), 4);
+        out.append(m.s);
+        return;
+    }
+    }
+}
+
 /* ---- per-spec reducer state ------------------------------------------ */
 
-enum Code : uint8_t { C_COUNT, C_SUM, C_AVG, C_MIN, C_MAX };
+enum Code : uint8_t {
+    C_COUNT,
+    C_SUM,
+    C_AVG,
+    C_MIN,
+    C_MAX,
+    /* multiset-valued reducers (reference: reduce.rs:22-594 Tuple/
+     * SortedTuple/Unique/ArgMin/ArgMax/Earliest/Latest/Any): finished
+     * values are recomputed from the group's joint row multiset at emit
+     * time; change detection runs on GIL-free fingerprints in phase 2 */
+    C_ARGMIN,
+    C_ARGMAX,
+    C_UNIQUE,
+    C_ANY,
+    C_TUPLE,
+    C_TUPLE_SN, /* skip_nones variant */
+    C_STUPLE,
+    C_STUPLE_SN,
+    C_EARLIEST,
+    C_LATEST,
+};
+
+/* codes whose finished value lives in the joint multiset (fp = they use
+ * the fingerprint machinery rather than FinSnap scalar images) */
+inline bool is_fp(uint8_t c) { return c >= C_ARGMIN; }
+/* codes that ORDER arg values — mixed numeric/string args (or an ERROR
+ * arg) would raise TypeError in Python; they fall back instead */
+inline bool orders_args(uint8_t c)
+{
+    return c == C_MIN || c == C_MAX || c == C_ARGMIN || c == C_ARGMAX ||
+           c == C_STUPLE || c == C_STUPLE_SN;
+}
+/* fp codes whose comparisons reject ERROR args (Python raises); min/max
+ * instead count ERROR contributions and poison the output */
+inline bool rejects_error(uint8_t c)
+{
+    return c == C_ARGMIN || c == C_ARGMAX || c == C_STUPLE ||
+           c == C_STUPLE_SN;
+}
+/* codes whose comparisons include None values (argmin/argmax compare
+ * (value, key) tuples, so None is a kind of its own — see SpecKind) */
+inline bool compares_none(uint8_t c) { return c == C_ARGMIN || c == C_ARGMAX; }
+
+/* order-preserving 16-byte big-endian image of a row key (Pointer
+ * subclasses int, always a non-negative 128-bit value). Shared by
+ * process_batch phase 1 and store_load. */
+bool key_ord_of(PyObject *key, std::string &out)
+{
+    if (PyLong_Check(key)) {
+        unsigned char buf[16];
+#if PY_VERSION_HEX >= 0x030D0000
+        if (_PyLong_AsByteArray((PyLongObject *)key, buf, 16, 0, 0, 0) == 0) {
+#else
+        if (_PyLong_AsByteArray((PyLongObject *)key, buf, 16, 0, 0) == 0) {
+#endif
+            out.assign(reinterpret_cast<char *>(buf), 16);
+            return true;
+        }
+        PyErr_Clear();
+    }
+    /* non-int or >128-bit key: slow path via int.to_bytes for parity */
+    PyObject *kb = PyObject_CallMethod(key, "to_bytes", "is", 16, "big");
+    if (kb == nullptr || !PyBytes_Check(kb)) {
+        Py_XDECREF(kb);
+        PyErr_Clear();
+        return false;
+    }
+    out.assign(PyBytes_AS_STRING(kb), (size_t)PyBytes_GET_SIZE(kb));
+    Py_DECREF(kb);
+    return true;
+}
 
 struct SState {
     int64_t cnt = 0;     /* numeric contributions (sum/avg) or row count */
@@ -143,13 +277,20 @@ inline FinSnap snap_of(uint8_t code, const SState &s)
     return out;
 }
 
-/* joint row multiset entry (kept only when a min/max spec exists):
+/* joint row multiset entry (kept when any min/max or fp spec exists):
  * mirrors the Python path's args-combo multiset so demotion can rebuild
- * it exactly — (key, per-spec arg value, count) */
+ * it exactly — (key, per-spec arg value, count[, stamp, order]).
+ * key_ord / mvals / order_mv are GIL-free comparable copies used by the
+ * fp codes' phase-2 fingerprints and emit-time orderings. */
 struct MsEntry {
     PyObject *key;                /* owned via deferred incref */
     std::vector<PyObject *> vals; /* owned; slot per spec (NULL if argless) */
     int64_t count;
+    std::string key_ord;          /* 16-byte big-endian row key (fp codes) */
+    std::vector<MVal> mvals;      /* per-spec comparable copy (fp codes) */
+    int64_t st_t = 0, st_i = 0;   /* creation stamp: (engine time, row idx) */
+    PyObject *order_obj = nullptr; /* owned: sort_by token (when has_order) */
+    MVal order_mv;                /* comparable copy of order_obj */
 };
 
 struct Group {
@@ -164,19 +305,29 @@ struct Shard {
     std::unordered_map<std::string, Group> groups;
 };
 
-enum SpecKind : uint8_t { K_UNSET = 0, K_NUM = 1, K_STR = 2 };
+/* K_NONE participates only in argmin/argmax kind tracking: Python
+ * compares the VALUES there ((None, key) < (5, key) raises TypeError on
+ * the mixed case, while all-None groups order by key), so None is a
+ * third kind that must not mix with numerics or strings. min/max skip
+ * None args entirely and sorted_tuple maps None below every value, so
+ * neither tracks it. */
+enum SpecKind : uint8_t { K_UNSET = 0, K_NUM = 1, K_STR = 2, K_NONE = 3 };
 
 struct GroupStore {
     int n_shards;
     bool has_ms = false;
+    bool has_fp = false;    /* any multiset-valued (fp) spec */
+    bool has_order = false; /* groupby sort_by: an order column rides in */
     std::vector<uint8_t> codes;
-    /* per min/max spec: the value kind seen so far. Python min/max raises
-     * TypeError on numeric<->string comparison; rather than diverge (or
+    /* per ordering spec: the value kind seen so far. Python raises
+     * TypeError on numeric<->string comparison (min/max/argmin/argmax/
+     * sorted_tuple args, and the sort_by column); rather than diverge (or
      * crash after demotion), a batch that would mix kinds anywhere in the
      * store Falls Back in phase 1 — store-level granularity is coarser
      * than Python's per-group check, which only means we fall back early,
      * never that we answer differently. */
     std::vector<uint8_t> kinds;
+    uint8_t order_kind = K_UNSET; /* kind of the sort_by column */
     std::vector<Shard> shards;
 };
 
@@ -184,6 +335,7 @@ void release_ms(Group &g)
 {
     for (auto &kv : g.ms) {
         Py_XDECREF(kv.second.key);
+        Py_XDECREF(kv.second.order_obj);
         for (PyObject *v : kv.second.vals)
             Py_XDECREF(v);
     }
@@ -454,40 +606,318 @@ inline bool finish_equal(uint8_t code, const FinSnap &a, const FinSnap &b)
     return false;
 }
 
-/* ---- store_new(n_shards, codes_tuple) --------------------------------- */
+/* ---- fp codes: fingerprints (GIL-free) + emit values (GIL) ------------
+ *
+ * The finished value of a multiset-valued reducer is a function of the
+ * group's joint row multiset. Change detection must coincide with Python
+ * tuple equality of the OUTPUT (the condition under which the Python
+ * path's consolidate() cancels the retract/insert pair), so phase 2
+ * computes a fingerprint of the finished value — not of the multiset —
+ * from the entries' GIL-free MVal copies, and phase 3 only builds Python
+ * values for groups whose fingerprint moved. */
+
+/* ordering helpers over borrowed MsEntry pointers */
+inline bool tuple_less(const MsEntry *a, const MsEntry *b, bool has_order)
+{
+    if (has_order) {
+        if (a->order_mv < b->order_mv)
+            return true;
+        if (b->order_mv < a->order_mv)
+            return false;
+    }
+    return a->key_ord < b->key_ord;
+}
+
+inline bool stamp_less(const MsEntry *a, const MsEntry *b)
+{
+    if (a->st_t != b->st_t)
+        return a->st_t < b->st_t;
+    if (a->st_i != b->st_i)
+        return a->st_i < b->st_i;
+    return a->key_ord < b->key_ord;
+}
+
+/* choose the entry a single-valued fp code resolves to; nullptr when the
+ * multiset is empty. `entries` may be in any order. */
+const MsEntry *fp_choose(uint8_t code, bool has_order,
+                         const std::vector<const MsEntry *> &entries,
+                         size_t sidx)
+{
+    const MsEntry *best = nullptr;
+    for (const MsEntry *e : entries) {
+        if (best == nullptr) {
+            best = e;
+            continue;
+        }
+        switch (code) {
+        case C_ANY: /* min by (order token, key) — order==key w/o sort_by */
+            if (tuple_less(e, best, has_order))
+                best = e;
+            break;
+        case C_ARGMIN: /* min by (value, key) */
+            if (e->mvals[sidx] < best->mvals[sidx] ||
+                (!(best->mvals[sidx] < e->mvals[sidx]) &&
+                 e->key_ord < best->key_ord))
+                best = e;
+            break;
+        case C_ARGMAX: /* max by value, ties -> SMALLEST key */
+            if (best->mvals[sidx] < e->mvals[sidx] ||
+                (!(e->mvals[sidx] < best->mvals[sidx]) &&
+                 e->key_ord < best->key_ord))
+                best = e;
+            break;
+        case C_EARLIEST:
+            if (stamp_less(e, best))
+                best = e;
+            break;
+        case C_LATEST:
+            if (stamp_less(best, e))
+                best = e;
+            break;
+        }
+    }
+    return best;
+}
+
+/* fingerprint of one spec's finished value over `entries` (borrowed,
+ * any order; count<=0 entries exist but contribute nothing to tuple
+ * expansions, exactly like Python's [v] * negative_count) */
+void fp_fingerprint(std::string &out, uint8_t code, bool has_order,
+                    const std::vector<const MsEntry *> &entries, size_t sidx,
+                    std::vector<const MsEntry *> &scratch)
+{
+    out.clear();
+    switch (code) {
+    case C_TUPLE:
+    case C_TUPLE_SN:
+    case C_STUPLE:
+    case C_STUPLE_SN: {
+        const bool skip_none = code == C_TUPLE_SN || code == C_STUPLE_SN;
+        const bool by_value = code == C_STUPLE || code == C_STUPLE_SN;
+        scratch.clear();
+        for (const MsEntry *e : entries)
+            if (e->count > 0 &&
+                !(skip_none && e->mvals[sidx].tag == V_NONE))
+                scratch.push_back(e);
+        if (by_value)
+            std::sort(scratch.begin(), scratch.end(),
+                      [&](const MsEntry *a, const MsEntry *b) {
+                          if (a->mvals[sidx] < b->mvals[sidx])
+                              return true;
+                          if (b->mvals[sidx] < a->mvals[sidx])
+                              return false;
+                          return a->key_ord < b->key_ord;
+                      });
+        else
+            std::sort(scratch.begin(), scratch.end(),
+                      [&](const MsEntry *a, const MsEntry *b) {
+                          return tuple_less(a, b, has_order);
+                      });
+        /* runs of numerically-equal adjacent values merge (5 then 5.0
+         * yields the same Python tuple under == as 5 then 5) */
+        int64_t run_count = 0;
+        std::string cur, prev;
+        for (const MsEntry *e : scratch) {
+            cur.clear();
+            mval_ser(cur, e->mvals[sidx]);
+            if (run_count > 0 && cur == prev) {
+                run_count += e->count;
+            } else {
+                if (run_count > 0) {
+                    out.append(prev);
+                    out.append(reinterpret_cast<char *>(&run_count), 8);
+                }
+                prev = cur;
+                run_count = e->count;
+            }
+        }
+        if (run_count > 0) {
+            out.append(prev);
+            out.append(reinterpret_cast<char *>(&run_count), 8);
+        }
+        return;
+    }
+    case C_UNIQUE: {
+        /* distinct under Python value equality (5 == 5.0 == True fold
+         * via mval_ser normalization); >1 class -> ERROR */
+        std::string first;
+        bool have = false, multi = false;
+        for (const MsEntry *e : entries) {
+            std::string c;
+            mval_ser(c, e->mvals[sidx]);
+            if (!have) {
+                first = c;
+                have = true;
+            } else if (c != first) {
+                multi = true;
+                break;
+            }
+        }
+        out.push_back(multi ? 'E' : 'U');
+        if (!multi && have)
+            out.append(first);
+        return;
+    }
+    case C_ANY:
+    case C_EARLIEST:
+    case C_LATEST: {
+        const MsEntry *b = fp_choose(code, has_order, entries, sidx);
+        if (b != nullptr)
+            mval_ser(out, b->mvals[sidx]);
+        return;
+    }
+    case C_ARGMIN:
+    case C_ARGMAX: {
+        const MsEntry *b = fp_choose(code, has_order, entries, sidx);
+        if (b != nullptr)
+            out.append(b->key_ord);
+        return;
+    }
+    }
+}
+
+/* build the Python finished value for one fp spec (GIL held). Entries
+ * are borrowed; their PyObjects are alive (phase-3 decrefs run last). */
+PyObject *fp_value(uint8_t code, bool has_order,
+                   const std::vector<const MsEntry *> &entries, size_t sidx,
+                   PyObject *error_obj)
+{
+    switch (code) {
+    case C_TUPLE:
+    case C_TUPLE_SN:
+    case C_STUPLE:
+    case C_STUPLE_SN: {
+        const bool skip_none = code == C_TUPLE_SN || code == C_STUPLE_SN;
+        const bool by_value = code == C_STUPLE || code == C_STUPLE_SN;
+        std::vector<const MsEntry *> live;
+        for (const MsEntry *e : entries)
+            if (e->count > 0 &&
+                !(skip_none && e->mvals[sidx].tag == V_NONE))
+                live.push_back(e);
+        if (by_value)
+            std::sort(live.begin(), live.end(),
+                      [&](const MsEntry *a, const MsEntry *b) {
+                          if (a->mvals[sidx] < b->mvals[sidx])
+                              return true;
+                          if (b->mvals[sidx] < a->mvals[sidx])
+                              return false;
+                          return a->key_ord < b->key_ord;
+                      });
+        else
+            std::sort(live.begin(), live.end(),
+                      [&](const MsEntry *a, const MsEntry *b) {
+                          return tuple_less(a, b, has_order);
+                      });
+        int64_t total = 0;
+        for (const MsEntry *e : live)
+            total += e->count;
+        PyObject *tup = PyTuple_New((Py_ssize_t)total);
+        if (tup == nullptr)
+            return nullptr;
+        Py_ssize_t at = 0;
+        for (const MsEntry *e : live) {
+            PyObject *v = e->vals[sidx] ? e->vals[sidx] : Py_None;
+            for (int64_t c = 0; c < e->count; c++) {
+                Py_INCREF(v);
+                PyTuple_SET_ITEM(tup, at++, v);
+            }
+        }
+        return tup;
+    }
+    case C_UNIQUE: {
+        std::string first, cur;
+        const MsEntry *rep = nullptr;
+        for (const MsEntry *e : entries) {
+            cur.clear();
+            mval_ser(cur, e->mvals[sidx]);
+            if (rep == nullptr) {
+                first = cur;
+                rep = e;
+            } else if (cur != first) {
+                Py_INCREF(error_obj);
+                return error_obj;
+            } else if (e->key_ord < rep->key_ord) {
+                rep = e; /* deterministic representative */
+            }
+        }
+        if (rep == nullptr)
+            Py_RETURN_NONE;
+        PyObject *v = rep->vals[sidx] ? rep->vals[sidx] : Py_None;
+        Py_INCREF(v);
+        return v;
+    }
+    case C_ANY:
+    case C_EARLIEST:
+    case C_LATEST: {
+        const MsEntry *b = fp_choose(code, has_order, entries, sidx);
+        if (b == nullptr)
+            Py_RETURN_NONE;
+        PyObject *v = b->vals[sidx] ? b->vals[sidx] : Py_None;
+        Py_INCREF(v);
+        return v;
+    }
+    case C_ARGMIN:
+    case C_ARGMAX: {
+        const MsEntry *b = fp_choose(code, has_order, entries, sidx);
+        if (b == nullptr)
+            Py_RETURN_NONE;
+        Py_INCREF(b->key);
+        return b->key;
+    }
+    }
+    Py_RETURN_NONE;
+}
+
+/* ---- store_new(n_shards, codes_tuple[, has_order]) -------------------- */
 
 PyObject *store_new(PyObject *, PyObject *args)
 {
     int n_shards;
     PyObject *codes;
-    if (!PyArg_ParseTuple(args, "iO", &n_shards, &codes))
+    int has_order = 0;
+    if (!PyArg_ParseTuple(args, "iO|i", &n_shards, &codes, &has_order))
         return nullptr;
     if (n_shards < 1)
         n_shards = 1;
     auto *s = new GroupStore();
     s->n_shards = n_shards;
+    s->has_order = has_order != 0;
     s->shards.resize(n_shards);
+    static const struct {
+        const char *name;
+        uint8_t code;
+    } kCodes[] = {
+        {"count", C_COUNT},       {"sum", C_SUM},
+        {"avg", C_AVG},           {"min", C_MIN},
+        {"max", C_MAX},           {"argmin", C_ARGMIN},
+        {"argmax", C_ARGMAX},     {"unique", C_UNIQUE},
+        {"any", C_ANY},           {"tuple", C_TUPLE},
+        {"tuple_sn", C_TUPLE_SN}, {"sorted_tuple", C_STUPLE},
+        {"sorted_tuple_sn", C_STUPLE_SN},
+        {"earliest", C_EARLIEST}, {"latest", C_LATEST},
+    };
     Py_ssize_t nc = PySequence_Size(codes);
     for (Py_ssize_t i = 0; i < nc; i++) {
         PyObject *c = PySequence_GetItem(codes, i);
         const char *cs = PyUnicode_AsUTF8(c);
-        uint8_t code = C_COUNT;
-        if (cs != nullptr && strcmp(cs, "sum") == 0)
-            code = C_SUM;
-        else if (cs != nullptr && strcmp(cs, "avg") == 0)
-            code = C_AVG;
-        else if (cs != nullptr && strcmp(cs, "min") == 0)
-            code = C_MIN;
-        else if (cs != nullptr && strcmp(cs, "max") == 0)
-            code = C_MAX;
-        else if (cs == nullptr || strcmp(cs, "count") != 0) {
+        int found = -1;
+        if (cs != nullptr)
+            for (size_t j = 0; j < sizeof(kCodes) / sizeof(kCodes[0]); j++)
+                if (strcmp(cs, kCodes[j].name) == 0) {
+                    found = (int)j;
+                    break;
+                }
+        if (found < 0) {
             Py_XDECREF(c);
             delete s;
             PyErr_SetString(PyExc_ValueError, "unknown reducer code");
             return nullptr;
         }
-        if (code == C_MIN || code == C_MAX)
+        uint8_t code = kCodes[found].code;
+        if (code == C_MIN || code == C_MAX || is_fp(code))
             s->has_ms = true;
+        if (is_fp(code))
+            s->has_fp = true;
         s->codes.push_back(code);
         s->kinds.push_back(K_UNSET);
         Py_DECREF(c);
@@ -506,7 +936,8 @@ PyObject *store_len(PyObject *, PyObject *arg)
     return PyLong_FromLongLong(n);
 }
 
-/* -- process_batch(store, gvals_list, keys, valcols, diffs, key_fn, error) */
+/* -- process_batch(store, gvals_list, keys, valcols, diffs, key_fn,
+ *                  error[, time, ordercol]) ----------------------------- */
 
 struct RowExtract {
     uint32_t shard;
@@ -515,6 +946,9 @@ struct RowExtract {
     PyObject *row_key;     /* borrowed */
     int64_t diff;
     std::vector<Val> vals; /* one per spec */
+    std::string key_ord;   /* fp codes: 16-byte big-endian row key */
+    PyObject *order_obj = nullptr; /* borrowed: sort_by token */
+    MVal order_mv;
 };
 
 struct Affected {
@@ -524,14 +958,22 @@ struct Affected {
     int64_t before_total;
     std::vector<FinSnap> before;
     bool created;
+    /* fp codes: borrowed snapshot of the pre-batch multiset (objects
+     * stay alive through emit — phase-3 decrefs run last) + per-spec
+     * finished-value fingerprints computed GIL-free in phase 2 */
+    std::vector<MsEntry> ms_before;
+    std::vector<std::string> fp_before, fp_after;
 };
 
 PyObject *process_batch(PyObject *, PyObject *args)
 {
     PyObject *capsule, *gvals_list, *keys_list, *valcols, *diffs, *key_fn,
         *error_obj;
-    if (!PyArg_ParseTuple(args, "OOOOOOO", &capsule, &gvals_list, &keys_list,
-                          &valcols, &diffs, &key_fn, &error_obj))
+    long long batch_time = 0;
+    PyObject *ordercol = Py_None;
+    if (!PyArg_ParseTuple(args, "OOOOOOO|LO", &capsule, &gvals_list,
+                          &keys_list, &valcols, &diffs, &key_fn, &error_obj,
+                          &batch_time, &ordercol))
         return nullptr;
     GroupStore *store = get_store(capsule);
     if (store == nullptr)
@@ -539,6 +981,15 @@ PyObject *process_batch(PyObject *, PyObject *args)
     const int W = store->n_shards;
     const size_t n_specs = store->codes.size();
     const bool has_ms = store->has_ms;
+    const bool has_fp = store->has_fp;
+    const bool has_order = store->has_order;
+    if (has_order &&
+        (!PyList_Check(ordercol) ||
+         PyList_Size(ordercol) != PyList_Size(gvals_list))) {
+        PyErr_SetString(PyExc_TypeError,
+                        "process_batch: order column length mismatch");
+        return nullptr;
+    }
 
     Py_ssize_t n = PyList_Size(gvals_list);
     if (n < 0)
@@ -570,6 +1021,7 @@ PyObject *process_batch(PyObject *, PyObject *args)
      * leaves the store untouched and the Python path can replay the batch */
     std::vector<RowExtract> rows(n);
     std::vector<uint8_t> kinds = store->kinds; /* committed after phase 1 */
+    uint8_t order_kind = store->order_kind;
     std::hash<std::string> hasher;
     for (Py_ssize_t i = 0; i < n; i++) {
         RowExtract &r = rows[i];
@@ -596,7 +1048,10 @@ PyObject *process_batch(PyObject *, PyObject *args)
         for (size_t sidx = 0; sidx < n_specs; sidx++) {
             Val &v = r.vals[sidx];
             const uint8_t code = store->codes[sidx];
-            const bool ordered = code == C_MIN || code == C_MAX;
+            /* codes whose value lands in the joint multiset accept the
+             * full scalar set (strings included); sum/avg stay numeric */
+            const bool stores_val =
+                code == C_MIN || code == C_MAX || is_fp(code);
             PyObject *col = PyTuple_GET_ITEM(valcols, (Py_ssize_t)sidx);
             v.obj = nullptr;
             if (col == Py_None || code == C_COUNT) {
@@ -608,6 +1063,13 @@ PyObject *process_batch(PyObject *, PyObject *args)
             if (item == Py_None) {
                 v.tag = V_NONE;
             } else if (item == error_obj) {
+                if (rejects_error(code)) {
+                    /* Python raises TypeError comparing ERROR — route to
+                     * the Python path so the same error surfaces */
+                    PyErr_SetString(FallbackError,
+                                    "ERROR arg in ordering reducer");
+                    return nullptr;
+                }
                 v.tag = V_ERR;
             } else if (PyFloat_Check(item)) {
                 v.tag = V_FLT;
@@ -624,7 +1086,7 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     return nullptr;
                 }
                 v.tag = V_INT;
-            } else if (ordered && PyUnicode_Check(item)) {
+            } else if (stores_val && PyUnicode_Check(item)) {
                 v.sptr = PyUnicode_AsUTF8AndSize(item, &v.slen);
                 if (v.sptr == nullptr) {
                     PyErr_Clear();
@@ -636,18 +1098,62 @@ PyObject *process_batch(PyObject *, PyObject *args)
                 PyErr_SetString(FallbackError, "unsupported reducer arg");
                 return nullptr;
             }
-            if (ordered && (v.tag == V_INT || v.tag == V_FLT ||
-                            v.tag == V_STR)) {
-                const uint8_t k = v.tag == V_STR ? K_STR : K_NUM;
+            if (orders_args(code) &&
+                (v.tag == V_INT || v.tag == V_FLT || v.tag == V_STR ||
+                 (v.tag == V_NONE && compares_none(code)))) {
+                const uint8_t k = v.tag == V_NONE  ? K_NONE
+                                  : v.tag == V_STR ? K_STR
+                                                   : K_NUM;
                 if (kinds[sidx] != K_UNSET && kinds[sidx] != k) {
-                    /* Python min/max TypeErrors on mixed kinds — route
+                    /* Python TypeErrors on mixed-kind comparisons — route
                      * the whole node to the Python path for parity */
                     PyErr_SetString(FallbackError,
-                                    "mixed numeric/string min-max args");
+                                    "mixed-kind ordering args");
                     return nullptr;
                 }
                 kinds[sidx] = k;
             }
+        }
+        if (has_order) {
+            PyObject *item = PyList_GET_ITEM(ordercol, i);
+            r.order_obj = item;
+            MVal &m = r.order_mv;
+            if (PyFloat_Check(item)) {
+                m.tag = V_FLT;
+                m.f = PyFloat_AS_DOUBLE(item);
+            } else if (PyBool_Check(item)) {
+                m.tag = V_INT;
+                m.i = item == Py_True ? 1 : 0;
+            } else if (PyLong_Check(item)) {
+                int ovf = 0;
+                m.i = PyLong_AsLongLongAndOverflow(item, &ovf);
+                if (ovf) {
+                    PyErr_SetString(FallbackError, "sort_by beyond i64");
+                    return nullptr;
+                }
+                m.tag = V_INT;
+            } else if (PyUnicode_Check(item)) {
+                Py_ssize_t sl;
+                const char *sp = PyUnicode_AsUTF8AndSize(item, &sl);
+                if (sp == nullptr) {
+                    PyErr_Clear();
+                    PyErr_SetString(FallbackError, "non-UTF8 sort_by");
+                    return nullptr;
+                }
+                m.tag = V_STR;
+                m.s.assign(sp, (size_t)sl);
+            } else {
+                /* None/ERROR/exotic sort keys raise in Python's sort */
+                PyErr_SetString(FallbackError, "unsupported sort_by value");
+                return nullptr;
+            }
+            const uint8_t k = m.tag == V_STR ? K_STR : K_NUM;
+            if (order_kind != K_UNSET && order_kind != k) {
+                PyErr_SetString(FallbackError,
+                                "mixed numeric/string sort_by values");
+                return nullptr;
+            }
+            order_kind = k;
         }
         if (has_ms) {
             if (!ser_value(r.ms_key, r.row_key)) {
@@ -670,10 +1176,21 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     }
                 }
             }
+            if (has_order) {
+                /* same row re-fed with a different sort token must be a
+                 * distinct multiset entry (Python keys combos on the
+                 * order token too) */
+                mval_ser(r.ms_key, r.order_mv);
+            }
+            if (has_fp && !key_ord_of(r.row_key, r.key_ord)) {
+                PyErr_SetString(FallbackError, "row key not 128-bit");
+                return nullptr;
+            }
         }
     }
 
     store->kinds = kinds; /* phase 1 passed: no Fallback beyond here */
+    store->order_kind = order_kind;
 
     /* phase 2: apply (GIL released) — shard-partitioned parallel update.
      * Refcounts are never touched here: creations/erasures of joint-
@@ -715,6 +1232,13 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     for (size_t sidx = 0; sidx < n_specs; sidx++)
                         a.before.push_back(
                             snap_of(store->codes[sidx], g.st[sidx]));
+                    if (has_fp) {
+                        /* borrowed pre-batch multiset image: objects stay
+                         * alive through emit (phase-3 decrefs run last) */
+                        a.ms_before.reserve(g.ms.size());
+                        for (auto &kv : g.ms)
+                            a.ms_before.push_back(kv.second);
+                    }
                     aff.push_back(std::move(a));
                 }
                 g.total += r.diff;
@@ -735,16 +1259,58 @@ PyObject *process_batch(PyObject *, PyObject *args)
                             if (o != nullptr)
                                 incs.push_back(o);
                         }
+                        if (has_fp) {
+                            e.key_ord = r.key_ord;
+                            e.st_t = (int64_t)batch_time;
+                            e.st_i = (int64_t)ri;
+                            e.mvals.reserve(n_specs);
+                            for (size_t sidx = 0; sidx < n_specs; sidx++)
+                                e.mvals.push_back(
+                                    mval_of(rows[(size_t)ri].vals[sidx]));
+                        }
+                        if (has_order) {
+                            e.order_obj = r.order_obj;
+                            e.order_mv = r.order_mv;
+                            if (e.order_obj != nullptr)
+                                incs.push_back(e.order_obj);
+                        }
                         g.ms.emplace(r.ms_key, std::move(e));
                     } else {
                         mit->second.count += r.diff;
                         if (mit->second.count == 0) {
                             decs.push_back(mit->second.key);
+                            if (mit->second.order_obj != nullptr)
+                                decs.push_back(mit->second.order_obj);
                             for (PyObject *o : mit->second.vals)
                                 if (o != nullptr)
                                     decs.push_back(o);
                             g.ms.erase(mit);
                         }
+                    }
+                }
+            }
+            if (has_fp) {
+                /* finished-value fingerprints, before and after, for every
+                 * fp spec of every touched group — GIL-free */
+                std::vector<const MsEntry *> view, scratch;
+                for (Affected &a : aff) {
+                    a.fp_before.resize(n_specs);
+                    a.fp_after.resize(n_specs);
+                    Group &g = *a.g;
+                    for (size_t sidx = 0; sidx < n_specs; sidx++) {
+                        const uint8_t code = store->codes[sidx];
+                        if (!is_fp(code))
+                            continue;
+                        view.clear();
+                        for (const MsEntry &e : a.ms_before)
+                            view.push_back(&e);
+                        fp_fingerprint(a.fp_before[sidx], code, has_order,
+                                       view, sidx, scratch);
+                        view.clear();
+                        for (auto &kv : g.ms)
+                            view.push_back(&kv.second);
+                        fp_fingerprint(a.fp_after[sidx], code, has_order,
+                                       view, sidx, scratch);
                     }
                 }
             }
@@ -795,13 +1361,27 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     after.push_back(snap_of(store->codes[sidx], g.st[sidx]));
             }
             if (!changed && after_live) {
-                for (size_t sidx = 0; sidx < n_specs && !changed; sidx++)
-                    changed = !finish_equal(store->codes[sidx],
-                                            a.before[sidx], after[sidx]);
+                for (size_t sidx = 0; sidx < n_specs && !changed; sidx++) {
+                    const uint8_t code = store->codes[sidx];
+                    changed = is_fp(code)
+                                  ? a.fp_before[sidx] != a.fp_after[sidx]
+                                  : !finish_equal(code, a.before[sidx],
+                                                  after[sidx]);
+                }
             }
             if (changed) {
                 Py_ssize_t ng = PyTuple_GET_SIZE(g.gvals);
+                /* entry views for fp specs: before from the borrowed
+                 * snapshot, after from the live multiset */
+                std::vector<const MsEntry *> before_view, after_view;
+                if (has_fp) {
+                    for (const MsEntry &e : a.ms_before)
+                        before_view.push_back(&e);
+                    for (auto &kv : g.ms)
+                        after_view.push_back(&kv.second);
+                }
                 auto emit = [&](const std::vector<FinSnap> &st,
+                                const std::vector<const MsEntry *> &view,
                                 long dir) -> int {
                     PyObject *row =
                         PyTuple_New(ng + (Py_ssize_t)n_specs);
@@ -813,8 +1393,12 @@ PyObject *process_batch(PyObject *, PyObject *args)
                         PyTuple_SET_ITEM(row, j, x);
                     }
                     for (size_t sidx = 0; sidx < n_specs; sidx++) {
-                        PyObject *v = finish_snap(store->codes[sidx],
-                                                  st[sidx], error_obj);
+                        const uint8_t code = store->codes[sidx];
+                        PyObject *v =
+                            is_fp(code)
+                                ? fp_value(code, has_order, view, sidx,
+                                           error_obj)
+                                : finish_snap(code, st[sidx], error_obj);
                         if (v == nullptr) {
                             Py_DECREF(row);
                             return -1;
@@ -830,11 +1414,11 @@ PyObject *process_batch(PyObject *, PyObject *args)
                     Py_DECREF(delta);
                     return rc;
                 };
-                if (before_live && emit(a.before, -1) < 0) {
+                if (before_live && emit(a.before, before_view, -1) < 0) {
                     failed = true;
                     break;
                 }
-                if (after_live && emit(after, 1) < 0) {
+                if (after_live && emit(after, after_view, 1) < 0) {
                     failed = true;
                     break;
                 }
@@ -860,9 +1444,12 @@ PyObject *process_batch(PyObject *, PyObject *args)
 
 /* ---- dump/load for operator snapshots and Python-path migration -------
  * Entry: (gvals, out_key, total, states[, ms_entries]) — ms_entries
- * present iff the store tracks the joint row multiset (min/max specs):
- * [(row_key, (val_or_None per spec), count)]. min/max mm state is NOT
- * dumped — load rebuilds it from ms_entries. */
+ * present iff the store tracks the joint row multiset (min/max or fp
+ * specs): [(row_key, (val_or_None per spec), count, (st_t, st_i),
+ * order_or_None)] — the stamp preserves earliest/latest processing-time
+ * ranking and `order` the sort_by token. Legacy 3-tuple entries load
+ * with stamp (0,0) and no order. min/max mm state is NOT dumped — load
+ * rebuilds it from ms_entries. */
 
 PyObject *store_dump(PyObject *, PyObject *arg)
 {
@@ -918,7 +1505,9 @@ PyObject *store_dump(PyObject *, PyObject *arg)
                         PyTuple_SET_ITEM(vals, (Py_ssize_t)j, v);
                     }
                     PyObject *t = Py_BuildValue(
-                        "(ONL)", e.key, vals, (long long)e.count);
+                        "(ONL(LL)O)", e.key, vals, (long long)e.count,
+                        (long long)e.st_t, (long long)e.st_i,
+                        e.order_obj ? e.order_obj : Py_None);
                     if (t == nullptr || PyList_Append(ms, t) < 0) {
                         Py_XDECREF(t);
                         ok = false;
@@ -1040,11 +1629,26 @@ PyObject *store_load(PyObject *, PyObject *args)
                 }
             Py_ssize_t nm = PyList_Size(ms_list);
             for (Py_ssize_t j = 0; j < nm; j++) {
-                PyObject *row_key, *vals;
+                PyObject *row_key, *vals, *stamp = nullptr,
+                                          *order = nullptr;
                 long long count;
-                if (!PyArg_ParseTuple(PyList_GET_ITEM(ms_list, j), "OOL",
-                                      &row_key, &vals, &count))
+                PyObject *ms_entry = PyList_GET_ITEM(ms_list, j);
+                if (PyTuple_Check(ms_entry) &&
+                    PyTuple_GET_SIZE(ms_entry) == 5) {
+                    if (!PyArg_ParseTuple(ms_entry, "OOLOO", &row_key,
+                                          &vals, &count, &stamp, &order))
+                        return nullptr;
+                    if (order == Py_None)
+                        order = nullptr;
+                } else if (!PyArg_ParseTuple(ms_entry, "OOL", &row_key,
+                                             &vals, &count))
+                    return nullptr; /* legacy 3-tuple snapshot */
+                if (s->has_order && order == nullptr) {
+                    PyErr_SetString(FallbackError,
+                                    "snapshot lacks the sort_by tokens "
+                                    "this store needs");
                     return nullptr;
+                }
                 /* pass 1: serialize the entry key (no refcounts yet) */
                 std::string mkey;
                 if (!ser_value(mkey, row_key)) {
@@ -1076,35 +1680,30 @@ PyObject *store_load(PyObject *, PyObject *args)
                         }
                     }
                 }
-                /* pass 2: merge-or-insert, then fold into min/max state */
-                auto found = g.ms.find(mkey);
-                if (found != g.ms.end()) {
-                    found->second.count += count;
-                } else {
-                    MsEntry e;
-                    e.key = row_key;
-                    e.count = count;
-                    Py_INCREF(row_key);
-                    for (PyObject *v : raw_vals) {
-                        e.vals.push_back(v);
-                        if (v != nullptr)
-                            Py_INCREF(v);
-                    }
-                    g.ms.emplace(std::move(mkey), std::move(e));
-                }
+                /* pass 1.5: extract Vals exactly like process_batch phase
+                 * 1 (incl. overflow/encoding/kind checks) for every spec
+                 * that stores values — BEFORE any state mutates, so a
+                 * Fallback here leaves the store loadable by Python */
+                std::vector<Val> vvs(s->codes.size());
+                MVal order_mv;
                 for (size_t sidx = 0; sidx < s->codes.size(); sidx++) {
                     const uint8_t code = s->codes[sidx];
-                    if (code != C_MIN && code != C_MAX)
+                    const bool stores_val =
+                        code == C_MIN || code == C_MAX || is_fp(code);
+                    if (!stores_val)
                         continue;
                     PyObject *v = raw_vals[sidx];
-                    /* extract a Val exactly like process_batch phase 1
-                     * (incl. overflow/encoding checks), then reuse
-                     * apply_spec so the fold cannot drift */
-                    Val vv;
+                    Val &vv = vvs[sidx];
                     vv.obj = v;
                     if (v == nullptr || v == Py_None) {
                         vv.tag = V_NONE;
                     } else if (error_obj != nullptr && v == error_obj) {
+                        if (rejects_error(code)) {
+                            PyErr_SetString(
+                                FallbackError,
+                                "ERROR arg in ordering-reducer snapshot");
+                            return nullptr;
+                        }
                         vv.tag = V_ERR;
                     } else if (PyFloat_Check(v)) {
                         vv.tag = V_FLT;
@@ -1135,19 +1734,119 @@ PyObject *store_load(PyObject *, PyObject *args)
                                         "unsupported snapshot arg");
                         return nullptr;
                     }
-                    if (vv.tag == V_INT || vv.tag == V_FLT ||
-                        vv.tag == V_STR) {
-                        const uint8_t k = vv.tag == V_STR ? K_STR : K_NUM;
+                    if (orders_args(code) &&
+                        (vv.tag == V_INT || vv.tag == V_FLT ||
+                         vv.tag == V_STR ||
+                         (vv.tag == V_NONE && compares_none(code)))) {
+                        const uint8_t k = vv.tag == V_NONE  ? K_NONE
+                                          : vv.tag == V_STR ? K_STR
+                                                            : K_NUM;
                         if (s->kinds[sidx] != K_UNSET &&
                             s->kinds[sidx] != k) {
                             PyErr_SetString(
                                 FallbackError,
-                                "mixed numeric/string min-max snapshot");
+                                "mixed-kind ordering snapshot");
                             return nullptr;
                         }
                         s->kinds[sidx] = k;
                     }
-                    apply_spec(code, g.st[sidx], vv, count);
+                }
+                if (s->has_order) {
+                    if (PyFloat_Check(order)) {
+                        order_mv.tag = V_FLT;
+                        order_mv.f = PyFloat_AS_DOUBLE(order);
+                    } else if (PyBool_Check(order)) {
+                        order_mv.tag = V_INT;
+                        order_mv.i = order == Py_True ? 1 : 0;
+                    } else if (PyLong_Check(order)) {
+                        int ovf = 0;
+                        order_mv.i =
+                            PyLong_AsLongLongAndOverflow(order, &ovf);
+                        if (ovf) {
+                            PyErr_SetString(FallbackError,
+                                            "snapshot sort_by beyond i64");
+                            return nullptr;
+                        }
+                        order_mv.tag = V_INT;
+                    } else if (PyUnicode_Check(order)) {
+                        Py_ssize_t sl;
+                        const char *sp =
+                            PyUnicode_AsUTF8AndSize(order, &sl);
+                        if (sp == nullptr) {
+                            PyErr_Clear();
+                            PyErr_SetString(FallbackError,
+                                            "non-UTF8 snapshot sort_by");
+                            return nullptr;
+                        }
+                        order_mv.tag = V_STR;
+                        order_mv.s.assign(sp, (size_t)sl);
+                    } else {
+                        PyErr_SetString(FallbackError,
+                                        "unsupported snapshot sort_by");
+                        return nullptr;
+                    }
+                    const uint8_t k =
+                        order_mv.tag == V_STR ? K_STR : K_NUM;
+                    if (s->order_kind != K_UNSET && s->order_kind != k) {
+                        PyErr_SetString(
+                            FallbackError,
+                            "mixed numeric/string sort_by snapshot");
+                        return nullptr;
+                    }
+                    s->order_kind = k;
+                    mval_ser(mkey, order_mv);
+                }
+                /* pass 2: merge-or-insert, then fold into min/max state */
+                auto found = g.ms.find(mkey);
+                if (found != g.ms.end()) {
+                    found->second.count += count;
+                } else {
+                    MsEntry e;
+                    e.key = row_key;
+                    e.count = count;
+                    Py_INCREF(row_key);
+                    for (PyObject *v : raw_vals) {
+                        e.vals.push_back(v);
+                        if (v != nullptr)
+                            Py_INCREF(v);
+                    }
+                    if (s->has_fp) {
+                        if (!key_ord_of(row_key, e.key_ord)) {
+                            PyErr_SetString(FallbackError,
+                                            "snapshot row key not 128-bit");
+                            /* e's refs were taken above: release them */
+                            Py_DECREF(row_key);
+                            for (PyObject *v : raw_vals)
+                                if (v != nullptr)
+                                    Py_DECREF(v);
+                            return nullptr;
+                        }
+                        e.mvals.reserve(s->codes.size());
+                        for (size_t sidx = 0; sidx < s->codes.size();
+                             sidx++)
+                            e.mvals.push_back(mval_of(vvs[sidx]));
+                        if (stamp != nullptr && PyTuple_Check(stamp) &&
+                            PyTuple_GET_SIZE(stamp) == 2) {
+                            e.st_t = PyLong_AsLongLong(
+                                PyTuple_GET_ITEM(stamp, 0));
+                            e.st_i = PyLong_AsLongLong(
+                                PyTuple_GET_ITEM(stamp, 1));
+                            if (PyErr_Occurred())
+                                PyErr_Clear();
+                        }
+                    }
+                    if (s->has_order) {
+                        e.order_obj = order;
+                        Py_INCREF(order);
+                        e.order_mv = order_mv;
+                    }
+                    g.ms.emplace(std::move(mkey), std::move(e));
+                }
+                for (size_t sidx = 0; sidx < s->codes.size(); sidx++) {
+                    const uint8_t code = s->codes[sidx];
+                    if (code != C_MIN && code != C_MAX)
+                        continue;
+                    apply_spec(code, g.st[sidx], vvs[sidx], count);
                 }
             }
         }
@@ -2026,13 +2725,14 @@ PyMethodDef methods[] = {
      "wp_tokenize(store, texts, budget, cls, sep, fallback) -> "
      "[ids_bytes|None, ...]"},
     {"store_new", store_new, METH_VARARGS,
-     "store_new(n_shards, codes) -> capsule"},
+     "store_new(n_shards, codes[, has_order]) -> capsule"},
     {"store_len", store_len, METH_O, "number of live groups"},
     {"store_dump", store_dump, METH_O,
      "picklable [(gvals, out_key, total, states)]"},
     {"store_load", store_load, METH_VARARGS, "restore a dumped store"},
     {"process_batch", process_batch, METH_VARARGS,
-     "process_batch(store, gvals, valcols, diffs, key_fn, error) -> deltas"},
+     "process_batch(store, gvals, keys, valcols, diffs, key_fn, error"
+     "[, time, ordercol]) -> deltas"},
     {"join_store_new", join_store_new, METH_VARARGS,
      "join_store_new(n_shards, jtype, id_mode, lwidth, rwidth) -> capsule"},
     {"join_store_len", join_store_len, METH_O, "number of live join keys"},

@@ -622,6 +622,7 @@ class GroupByNode(GroupDiffNode):
         grouping_batch=None,  # (keys, rows) -> list of gvals tuples
         args_batch=None,      # (keys, rows) -> list of arg-combo tuples
         native_args=None,     # per spec: batch column fn | None (count)
+        native_order=None,    # sort_by batch column fn (order tokens)
     ):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
@@ -646,9 +647,11 @@ class GroupByNode(GroupDiffNode):
         # has a native code and args are single columns; ineligible or
         # unsupported-value batches fall back to the Python path below.
         # abelian specs carry their native code at index 4 (count/sum/avg);
-        # full specs at index 2 (min/max — the C++ store keeps an ordered
-        # value multiset per group plus the joint row multiset so demotion
-        # can rebuild the Python ms exactly)
+        # full specs at index 2 (min/max keep an ordered value multiset
+        # per group; tuple/sorted_tuple/unique/any/argmin/argmax/earliest/
+        # latest recompute from the joint row multiset — which also lets
+        # demotion rebuild the Python ms exactly). sort_by rides along as
+        # an order column (native_order) instead of disqualifying the node.
         self.native_codes = [
             (s[4] if len(s) > 4 else None)
             if s[0] == "abelian"
@@ -656,6 +659,7 @@ class GroupByNode(GroupDiffNode):
             for s in self.specs
         ]
         self.native_args = native_args
+        self.native_order = native_order
         self._native_ok = (
             len(self.specs) > 0
             and all(c is not None for c in self.native_codes)
@@ -687,11 +691,14 @@ class GroupByNode(GroupDiffNode):
 
         n_shards = max(1, get_pathway_config().threads)
         self._exec = ex
-        self._store = ex.store_new(n_shards, tuple(self.native_codes))
+        self._store = ex.store_new(
+            n_shards, tuple(self.native_codes),
+            1 if self.native_order is not None else 0,
+        )
         return True
 
     def _native_state_to_py(self, code, st):
-        if code in ("min", "max"):
+        if code not in ("count", "sum", "avg"):
             return None  # full reducers read the (rebuilt) multiset
         cnt, isum, fsum, isfloat, err = st
         if code == "count":
@@ -701,19 +708,23 @@ class GroupByNode(GroupDiffNode):
             return [cnt, value, err]
         return [float(fsum + isum), cnt, err]  # avg
 
-    def _combos_of(self, key, vals):
+    def _combos_of(self, key, vals, order=None):
         """Rebuild one args_fn row from a dumped joint-multiset entry:
-        per spec ``(*args, order_token, row_key)`` with order == row key
-        (native eligibility excludes sort_by, groupbys.py)."""
+        per spec ``(*args, order_token, row_key)`` — the order token is
+        the dumped sort_by value when the store carried one, else the
+        row key (the no-sort_by contract, groupbys.py args_fn)."""
+        token = key if order is None else order
         return tuple(
-            (key, key) if col is None else (vals[j], key, key)
+            (token, key) if col is None else (vals[j], token, key)
             for j, col in enumerate(self.native_args)
         )
 
     def _groups_from_native_entries(self, entries) -> None:
         """Rebuild the Python groups dict from dumped native entries —
         shared by mid-stream demotion and snapshot-restore demotion so
-        the two paths cannot drift."""
+        the two paths cannot drift. Dumped ms entries are (key, vals,
+        count[, stamp, order]); stamps survive so earliest/latest keep
+        their processing-time ranking across demotion."""
         for entry in entries:
             gvals, out_key, total, states = entry[:4]
             ab = [
@@ -723,9 +734,12 @@ class GroupByNode(GroupDiffNode):
             ms = None
             if len(entry) > 4:
                 ms = {}
-                for key, vals, count in entry[4]:
-                    args = self._combos_of(key, vals)
-                    ms[freeze_row(args)] = [args, count]
+                for me in entry[4]:
+                    key, vals, count = me[0], me[1], me[2]
+                    stamp = me[3] if len(me) > 3 else (0, 0)
+                    order = me[4] if len(me) > 4 else None
+                    args = self._combos_of(key, vals, order)
+                    ms[freeze_row(args)] = [args, count, tuple(stamp)]
             elif self.need_ms:
                 ms = {}
             self.groups[freeze_row(gvals)] = [gvals, ms, ab, total, out_key]
@@ -749,6 +763,11 @@ class GroupByNode(GroupDiffNode):
                 f(keys, rows) if f is not None else None
                 for f in self.native_args
             )
+            ordercol = (
+                self.native_order(keys, rows)
+                if self.native_order is not None
+                else None
+            )
             try:
                 # distinct groups emit distinct rows, so the output is
                 # already in net form
@@ -761,6 +780,8 @@ class GroupByNode(GroupDiffNode):
                         diffs,
                         self.key_fn,
                         ERROR,
+                        time,
+                        ordercol,
                     )
                 )
             except self._exec.Fallback:

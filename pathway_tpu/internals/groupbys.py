@@ -300,9 +300,9 @@ class GroupedTable:
 
             # single-column arg evaluators for the native executor: one
             # entry per reducer — None for arg-less reducers (count);
-            # multi-arg reducers make the node ineligible, and so does
-            # sort_by (the native joint multiset reconstructs order
-            # tokens as the row key, which only holds without sort_by)
+            # multi-arg reducers make the node ineligible. sort_by rides
+            # along as a separate order column (native_order) that the
+            # C++ store keys multiset entries and tuple/any orderings on.
             native_args = []
             for fns in arg_fns:
                 if len(fns) == 0:
@@ -312,8 +312,6 @@ class GroupedTable:
                 else:
                     native_args = None
                     break
-            if sort_fn is not None:
-                native_args = None
 
             if len(stateful) == len(reducers) == 1:
                 red = reducers[0]
@@ -380,6 +378,7 @@ class GroupedTable:
                     et, grouping_fn, args_fn, reducer_specs, n_group,
                     key_fn=key_fn, grouping_batch=grouping_batch,
                     args_batch=args_batch, native_args=native_args,
+                    native_order=sort_fn,
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values

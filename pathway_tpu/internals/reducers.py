@@ -33,6 +33,16 @@ def _entries(ms, slot: int):
         yield entry[0][slot], entry[1]
 
 
+# full (non-abelian) reducers the C++ executor runs natively; the _sn
+# variants are the skip_nones tuple forms
+_NATIVE_FULL_CODES = frozenset(
+    {
+        "min", "max", "argmin", "argmax", "unique", "any", "earliest",
+        "latest", "tuple", "tuple_sn", "sorted_tuple", "sorted_tuple_sn",
+    }
+)
+
+
 class Reducer:
     def __init__(
         self,
@@ -62,16 +72,20 @@ class Reducer:
         native_code]) when incremental maintenance applies, else ("full",
         fn[, native_code]). native_code marks specs the sharded C++
         executor (native/exec.cpp) runs natively: count/sum/avg keep O(1)
-        abelian state; min/max keep an ordered value multiset per group
-        (plus the joint row multiset for Python-path migration)."""
+        abelian state; min/max keep an ordered value multiset per group;
+        tuple/sorted_tuple/unique/any/argmin/argmax/earliest/latest are
+        recomputed from the joint row multiset with GIL-free change
+        fingerprints (reference: the full Reducer enum, reduce.rs:22-594).
+        ndarray and stateful reducers stay on the Python path."""
         if self._abelian_factory is not None:
             spec = ("abelian",) + self._abelian_factory(**kwargs)
             if self.name in ("count", "sum", "avg"):
                 spec = spec + (self.name,)
             return spec
         spec = ("full", self._factory(**kwargs))
-        if self.name in ("min", "max"):
-            spec = spec + (self.name,)
+        code = getattr(self, "_native_code", self.name)
+        if code in _NATIVE_FULL_CODES:
+            spec = spec + (code,)
         return spec
 
     def __call__(self, *args, **kwargs) -> ReducerExpression:
@@ -339,6 +353,7 @@ def sorted_tuple(arg, skip_nones: bool = False) -> ReducerExpression:
         lambda **kw: _sorted_tuple_factory(skip_nones=skip_nones),
         lambda ts: dt.List(ts[0]) if ts else dt.ANY_TUPLE,
     )
+    r._native_code = "sorted_tuple_sn" if skip_nones else "sorted_tuple"
     return ReducerExpression(r, arg)
 
 
@@ -348,6 +363,7 @@ def tuple(arg, skip_nones: bool = False) -> ReducerExpression:  # noqa: A001
         lambda **kw: _tuple_factory(skip_nones=skip_nones),
         lambda ts: dt.List(ts[0]) if ts else dt.ANY_TUPLE,
     )
+    r._native_code = "tuple_sn" if skip_nones else "tuple"
     return ReducerExpression(r, arg)
 
 

@@ -63,9 +63,10 @@ class ConnectorSubject:
         sources that already hold rows in memory."""
         if self._finished or not rows:
             return
-        # copy: parsing is deferred to flush time on the connector thread,
-        # so a caller-reused buffer must not alias the queued message
-        self._emit(("upsert_batch", list(rows)))
+        # copy list AND row dicts: parsing is deferred to flush time on
+        # the connector thread, so neither a caller-reused list buffer nor
+        # a caller-reused row dict may alias the queued message
+        self._emit(("upsert_batch", [dict(r) for r in rows]))
 
     def next_str(self, message: str) -> None:
         if message == COMMIT_LITERAL:

@@ -1,0 +1,475 @@
+"""Full native reducer suite (VERDICT r3 #2): tuple/sorted_tuple/unique/
+any/argmin/argmax/earliest/latest + sort_by groupbys on the sharded C++
+executor (native/exec.cpp), with the Fallback-to-Python escape for values
+it can't represent.
+
+Oracle: the Python affected-group rediff path must produce the identical
+change stream (rows, diffs, timestamp order). Reference bar: the full
+Reducer enum with semigroup fast paths, src/engine/reduce.rs:22-594.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import ERROR, ref_scalar
+from pathway_tpu.native import get_pwexec
+
+pwexec = get_pwexec()
+pytestmark = pytest.mark.skipif(pwexec is None, reason="no native toolchain")
+
+
+class _Spy:
+    """Asserts the native executor stayed engaged (no silent demotion) —
+    the VERDICT's 'assert via the executor's stats/counters' criterion."""
+
+    def __init__(self):
+        import pathway_tpu.engine.nodes as nm
+
+        self.nm = nm
+        self.demotions = 0
+        self.engaged = 0
+
+    def __enter__(self):
+        nm = self.nm
+        self._orig_mig = nm.GroupByNode._migrate_to_python
+        self._orig_setup = nm.GroupByNode._native_setup
+        spy = self
+
+        def mig(node):
+            spy.demotions += 1
+            return spy._orig_mig(node)
+
+        def setup(node):
+            ok = spy._orig_setup(node)
+            if ok:
+                spy.engaged += 1
+            return ok
+
+        nm.GroupByNode._migrate_to_python = mig
+        nm.GroupByNode._native_setup = setup
+        return self
+
+    def __exit__(self, *exc):
+        self.nm.GroupByNode._migrate_to_python = self._orig_mig
+        self.nm.GroupByNode._native_setup = self._orig_setup
+
+
+def _force_python():
+    import pathway_tpu.engine.nodes as nm
+
+    orig = nm.GroupByNode._native_setup
+    nm.GroupByNode._native_setup = lambda self: False
+    return lambda: setattr(nm.GroupByNode, "_native_setup", orig)
+
+
+class _KVSchema(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    g: int
+    v: int
+    s: str
+    o: int
+
+
+class _Feed(pw.io.python.ConnectorSubject):
+    """Insert/upsert/retract sequence over two groups across commits."""
+
+    def run(self):
+        self.next(k=1, g=1, v=5, s="b", o=9)
+        self.next(k=2, g=1, v=3, s="a", o=1)
+        self.next(k=5, g=2, v=1, s="z", o=3)
+        self.commit()
+        self.next(k=3, g=1, v=7, s="c", o=5)
+        self.next(k=4, g=2, v=2, s="y", o=2)
+        self.commit()
+        self.remove(k=2, g=1, v=3, s="a", o=1)
+        self.next(k=1, g=1, v=6, s="bb", o=9)  # pk upsert
+        self.commit()
+        self.remove(k=5, g=2, v=1, s="z", o=3)
+        self.remove(k=4, g=2, v=2, s="y", o=2)  # group 2 dies
+        self.commit()
+
+
+def _normalized_events(events):
+    times = sorted({e[1] for e in events})
+    tmap = {t: i for i, t in enumerate(times)}
+    return [(row, tmap[t], d) for row, t, d in events]
+
+
+def _run_full_suite(sort_by: bool, skip_nones: bool = False):
+    pw.internals.parse_graph.G.clear()
+    t = pw.io.python.read(
+        _Feed(), schema=_KVSchema, autocommit_duration_ms=None
+    )
+    gb = (
+        t.groupby(pw.this.g, sort_by=pw.this.o)
+        if sort_by
+        else t.groupby(pw.this.g)
+    )
+    r = gb.reduce(
+        g=pw.this.g,
+        tp=pw.reducers.tuple(pw.this.v, skip_nones=skip_nones),
+        st=pw.reducers.sorted_tuple(pw.this.v, skip_nones=skip_nones),
+        un=pw.reducers.unique(pw.this.g),
+        an=pw.reducers.any(pw.this.s),
+        am=pw.reducers.argmin(pw.this.v),
+        ax=pw.reducers.argmax(pw.this.v),
+        el=pw.reducers.earliest(pw.this.s),
+        lt=pw.reducers.latest(pw.this.s),
+        n=pw.reducers.count(),
+        sm=pw.reducers.sum(pw.this.v),
+    )
+    events = []
+    pw.io.subscribe(
+        r,
+        on_change=lambda key, row, time, diff: events.append(
+            (tuple(sorted(row.items())), time, diff)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return _normalized_events(events)
+
+
+@pytest.mark.parametrize("sort_by", [False, True])
+def test_full_suite_matches_python_change_stream(sort_by):
+    with _Spy() as spy:
+        native = _run_full_suite(sort_by)
+    assert spy.engaged >= 1, "native executor never engaged"
+    assert spy.demotions == 0, "native executor silently demoted"
+    restore = _force_python()
+    try:
+        python = _run_full_suite(sort_by)
+    finally:
+        restore()
+    assert native == python
+
+
+def test_full_suite_under_threads_4(monkeypatch):
+    from pathway_tpu.internals import config as C
+
+    monkeypatch.setattr(C.pathway_config, "threads", 4)
+    with _Spy() as spy:
+        native = _run_full_suite(sort_by=True)
+    assert spy.engaged >= 1 and spy.demotions == 0
+    restore = _force_python()
+    try:
+        python = _run_full_suite(sort_by=True)
+    finally:
+        restore()
+    assert native == python
+
+
+def test_skip_nones_tuple_variants():
+    """tuple/sorted_tuple skip_nones drop None contributions; the plain
+    variants keep them (None sorts FIRST in sorted_tuple)."""
+
+    class S(pw.Schema):
+        g: int
+        v: int | None
+
+    def run(force_python: bool):
+        pw.internals.parse_graph.G.clear()
+        t = pw.debug.table_from_rows(
+            S, [(1, 1, 5), (2, 1, None), (3, 1, 3), (4, 2, None)]
+        )
+        r = t.groupby(pw.this.g).reduce(
+            g=pw.this.g,
+            tp=pw.reducers.tuple(pw.this.v),
+            tps=pw.reducers.tuple(pw.this.v, skip_nones=True),
+            st=pw.reducers.sorted_tuple(pw.this.v),
+            sts=pw.reducers.sorted_tuple(pw.this.v, skip_nones=True),
+        )
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        if force_python:
+            restore = _force_python()
+        try:
+            cap = GraphRunner().run_tables(r)[0]
+        finally:
+            if force_python:
+                restore()
+        return sorted(tuple(row) for row in cap.state.rows.values())
+
+    with _Spy() as spy:
+        native = run(False)
+    assert spy.engaged >= 1 and spy.demotions == 0
+    assert native == run(True)
+    by_g = {row[0]: row for row in native}
+    assert by_g[1][3] == (None, 3, 5)  # sorted_tuple: None first
+    assert by_g[1][4] == (3, 5)        # skip_nones
+    assert by_g[2][2] == ()            # all-None group, skip_nones tuple
+
+
+def test_exotic_value_demotes_with_state_intact():
+    """A tuple-reducer arg the serializer can't represent (a Json-like
+    nested tuple) demotes the node mid-stream; results still match the
+    all-Python run."""
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: int
+        v: pw.internals.dtype.ANY
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, g=1, v=5)
+            self.next(k=2, g=1, v=3)
+            self.commit()
+            self.next(k=3, g=1, v=(1, 2))  # exotic: Fallback
+            self.commit()
+            self.remove(k=1, g=1, v=5)
+            self.commit()
+
+    def run(force_python: bool):
+        pw.internals.parse_graph.G.clear()
+        t = pw.io.python.read(
+            Feed(), schema=S, autocommit_duration_ms=None
+        )
+        r = t.groupby(pw.this.g).reduce(
+            g=pw.this.g,
+            tp=pw.reducers.tuple(pw.this.v),
+            n=pw.reducers.count(),
+        )
+        events = []
+        pw.io.subscribe(
+            r,
+            on_change=lambda key, row, time, diff: events.append(
+                (tuple(sorted(row.items())), time, diff)
+            ),
+        )
+        if force_python:
+            restore = _force_python()
+        try:
+            pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        finally:
+            if force_python:
+                restore()
+        return _normalized_events(events)
+
+    with _Spy() as spy:
+        native = run(False)
+    assert spy.engaged >= 1
+    assert spy.demotions == 1  # the exotic batch demoted exactly once
+    assert native == run(True)
+
+
+def test_error_in_ordering_reducer_raises_like_python():
+    """argmin/sorted_tuple over a column containing ERROR raise the same
+    engine error on both paths (Python TypeErrors comparing ERROR; the
+    native path falls back so the identical error surfaces)."""
+
+    def run(force_python: bool):
+        pw.internals.parse_graph.G.clear()
+        t = pw.debug.table_from_markdown(
+            """
+            k | v
+            1 | 5
+            1 | 0
+            """
+        )
+        t2 = t.select(k=pw.this.k, v=pw.declare_type(int, 1 // pw.this.v))
+        r = t2.groupby(pw.this.k).reduce(
+            k=pw.this.k, st=pw.reducers.sorted_tuple(pw.this.v)
+        )
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        if force_python:
+            restore = _force_python()
+        try:
+            with pytest.raises(Exception, match="not supported between"):
+                GraphRunner().run_tables(r)
+        finally:
+            if force_python:
+                restore()
+
+    run(False)
+    run(True)
+
+
+def test_error_value_flows_through_tuple_unique_latest():
+    """Non-comparing reducers treat ERROR as a value: tuple keeps it,
+    unique of a 2-class group returns ERROR, earliest/latest pick by
+    arrival (matches the Python-path probe pinned in round 4)."""
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        k | v
+        1 | 5
+        1 | 0
+        2 | 3
+        """
+    )
+    t2 = t.select(k=pw.this.k, v=pw.declare_type(int, 1 // pw.this.v))
+    r = t2.groupby(pw.this.k).reduce(
+        k=pw.this.k,
+        tp=pw.reducers.tuple(pw.this.v),
+        un=pw.reducers.unique(pw.this.v),
+        el=pw.reducers.earliest(pw.this.v),
+        lt=pw.reducers.latest(pw.this.v),
+    )
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    with _Spy() as spy:
+        cap = GraphRunner().run_tables(r)[0]
+    assert spy.demotions == 0
+    rows = {row[0]: tuple(row) for row in cap.state.rows.values()}
+    assert rows[2] == (2, (0,), 0, 0, 0)
+    k1 = rows[1]
+    assert set(k1[1]) == {0, ERROR} and k1[2] is ERROR
+    assert {k1[3], k1[4]} == {0, ERROR}
+
+
+def test_native_snapshot_roundtrip_full_suite():
+    """Dump/load preserves multiset entries WITH stamps and sort tokens:
+    a reloaded store continues the change stream identically, including
+    earliest/latest rankings that predate the snapshot."""
+    import pathway_tpu.engine.nodes as nodes_mod
+
+    class FakeScope:
+        def __init__(self):
+            self.nodes = []
+            self.runtime = type(
+                "R", (), {"mark_pending": lambda *a: None,
+                          "current_trace": None}
+            )()
+
+        def register(self, node):
+            self.nodes.append(node)
+            return len(self.nodes) - 1
+
+    def make_node():
+        scope = FakeScope()
+        src = nodes_mod.SourceNode(scope)
+        from pathway_tpu.internals import reducers as R
+
+        specs = [
+            R.tuple(None)._reducer.engine_spec(),
+            R.earliest.engine_spec(),
+            R.latest.engine_spec(),
+            R.argmin.engine_spec(),
+        ]
+        return nodes_mod.GroupByNode(
+            scope, src,
+            grouping_fn=lambda k, r: (r[0],),
+            args_fn=lambda k, r: ((r[1], k, k),) * 4,
+            reducer_specs=specs,
+            grouping_batch=lambda ks, rs: [(r[0],) for r in rs],
+            args_batch=lambda ks, rs: [
+                ((r[1], k, k),) * 4 for k, r in zip(ks, rs)
+            ],
+            native_args=[lambda ks, rs: [r[1] for r in rs]] * 4,
+        )
+
+    a = make_node()
+    assert a._native_ok
+    a.process(2, [[(10, ("x", 7), 1), (11, ("x", 3), 1), (12, ("y", 9), 1)]])
+    a.process(4, [[(13, ("x", 5), 1)]])
+    state = pickle.loads(pickle.dumps(a.state_dict()))
+    assert "__native__" in state
+
+    b = make_node()
+    b.load_state(state)
+    # same next batch must produce the same deltas from both stores
+    batch = [[(14, ("x", 1), 1), (12, ("y", 9), -1)]]
+    out_a = sorted((tuple(r), d) for _, r, d in a.process(6, batch))
+    out_b = sorted((tuple(r), d) for _, r, d in b.process(6, batch))
+    assert out_a == out_b
+    # earliest ranks a pre-snapshot entry first: stamp survived the dump
+    x_after = [r for (r, d) in out_b if d > 0 and r[0] == "x"]
+    assert x_after and x_after[0][2] == 7  # earliest = first-ever insert
+
+
+def test_unchanged_tuple_output_emits_nothing():
+    """Fingerprint suppression: a retract+insert netting to the same
+    finished tuple emits no deltas (key moves, value doesn't)."""
+    s = pwexec.store_new(2, ("tuple",))
+    key_fn = lambda g: ref_scalar(*g)
+
+    def pb(gvals, keys, col, diffs):
+        return pwexec.process_batch(
+            s, gvals, keys, (col,), diffs, key_fn, ERROR, 2, None
+        )
+
+    out = pb([("g",)] * 2, [1, 2], [5, 5], [1, 1])
+    assert len(out) == 1  # initial insert
+    # row 1 leaves, row 3 arrives with the same value: ("g",(5,5)) holds
+    out = pb([("g",)] * 2, [1, 3], [5, 5], [-1, 1])
+    assert out == []
+    # a genuinely new value does emit
+    out = pb([("g",)], [4], [6], [1])
+    assert len(out) == 2
+
+
+def test_argmin_none_mix_falls_back_like_python():
+    """argmin/argmax compare (value, key) tuples, so a group mixing None
+    and numeric values raises TypeError in Python; the native path must
+    fall back (None is its own kind), not answer with the None row."""
+
+    def run(force_python: bool):
+        pw.internals.parse_graph.G.clear()
+
+        class S(pw.Schema):
+            g: int
+            v: int | None
+
+        t = pw.debug.table_from_rows(S, [(1, 1, None), (2, 1, 5)])
+        r = t.groupby(pw.this.g).reduce(
+            g=pw.this.g, am=pw.reducers.argmin(pw.this.v)
+        )
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        if force_python:
+            restore = _force_python()
+        try:
+            with pytest.raises(Exception, match="not supported between"):
+                GraphRunner().run_tables(r)
+        finally:
+            if force_python:
+                restore()
+
+    run(False)
+    run(True)
+
+    # all-None groups DO order (None==None ties break by key): both paths
+    # answer, identically
+    def run_all_none(force_python: bool):
+        pw.internals.parse_graph.G.clear()
+
+        class S(pw.Schema):
+            g: int
+            v: int | None
+
+        t = pw.debug.table_from_rows(S, [(1, 1, None), (2, 1, None)])
+        r = t.groupby(pw.this.g).reduce(
+            g=pw.this.g, am=pw.reducers.argmin(pw.this.v)
+        )
+        from pathway_tpu.internals.graph_runner import GraphRunner
+
+        if force_python:
+            restore = _force_python()
+        try:
+            cap = GraphRunner().run_tables(r)[0]
+        finally:
+            if force_python:
+                restore()
+        return sorted(tuple(r_) for r_ in cap.state.rows.values())
+
+    assert run_all_none(False) == run_all_none(True)
+
+
+def test_sort_by_orders_native_tuple():
+    s = pwexec.store_new(2, ("tuple",), 1)
+    key_fn = lambda g: ref_scalar(*g)
+    out = pwexec.process_batch(
+        s, [("g",)] * 3, [30, 10, 20], ([300, 100, 200],), [1, 1, 1],
+        key_fn, ERROR, 2, [3, 1, 2],
+    )
+    assert out[-1][1] == ("g", (100, 200, 300))  # ordered by sort token
+    # mixed-kind sort tokens fall back (Python's sort would TypeError)
+    with pytest.raises(pwexec.Fallback):
+        pwexec.process_batch(
+            s, [("g",)], [40], ([400],), [1], key_fn, ERROR, 4, ["zz"],
+        )
