@@ -100,6 +100,7 @@ from pathway_tpu.internals.interactive import (  # noqa: E402
     enable_interactive_mode,
     live,
 )
+from pathway_tpu.internals import interactive  # noqa: E402
 from pathway_tpu.internals.row_transformer import (  # noqa: E402
     attribute,
     input_attribute,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import threading
 from typing import Any, Sequence
 
 import numpy as np
@@ -105,6 +106,20 @@ class KnnShard:
         self.vectors = jnp.zeros((self.capacity, self.dimension), jnp.float32)
         self.valid = jnp.zeros((self.capacity,), bool)
         self.sq_norms = jnp.zeros((self.capacity,), jnp.float32)
+        # serializes writers against query launches (update-while-serving):
+        # _write_slots DONATES the current buffers, so a reader must read
+        # the array triple and enqueue its executable before the next
+        # update invalidates those handles. Writers hold this lock; query
+        # paths hold it across read+launch (the launch is asynchronous, so
+        # the critical section is microseconds).
+        self.lock = threading.Lock()
+        # slot-reuse guard for in-flight queries: a hit resolved AFTER its
+        # dispatch must not map a slot freed (and possibly reused) in
+        # between to the new key. remove() stamps freed slots with a
+        # monotonically increasing epoch; readers capture the epoch at
+        # dispatch and drop hits whose slot was freed later.
+        self.remove_epoch = 0
+        self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
@@ -125,6 +140,9 @@ class KnnShard:
         self.free_slots = (
             list(range(new_cap - 1, self.capacity - 1, -1)) + self.free_slots
         )
+        self.slot_freed_epoch = np.concatenate(
+            [self.slot_freed_epoch, np.full(pad, -1, np.int64)]
+        )
         self.capacity = new_cap
 
     def _prepare(self, vecs):
@@ -142,44 +160,49 @@ class KnnShard:
 
     def add(self, keys: Sequence[Any], vecs) -> None:
         """Upsert vectors; accepts numpy or device-resident jax arrays (the
-        latter avoids a host round-trip when chaining from a jitted encoder)."""
+        latter avoids a host round-trip when chaining from a jitted encoder).
+        Safe to call while queries are in flight (update-while-serving)."""
         vecs = self._prepare(vecs)
         if len(keys) != vecs.shape[0]:
             raise ValueError("keys/vectors length mismatch")
-        self._grow_to(len(self.key_to_slot) + len(keys))
-        slots = []
-        for key in keys:
-            slot = self.key_to_slot.get(key)
-            if slot is None:
-                slot = self.free_slots.pop()
-                self.key_to_slot[key] = slot
-                self.slot_to_key[slot] = key
-            slots.append(slot)
-        slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
-        self.vectors, self.valid, self.sq_norms = _write_slots(
-            self.vectors, self.valid, self.sq_norms,
-            slots_arr, jnp.asarray(vecs), jnp.ones((len(slots),), bool),
-            normalize=self.metric is Metric.COS,
-        )
+        with self.lock:
+            self._grow_to(len(self.key_to_slot) + len(keys))
+            slots = []
+            for key in keys:
+                slot = self.key_to_slot.get(key)
+                if slot is None:
+                    slot = self.free_slots.pop()
+                    self.key_to_slot[key] = slot
+                    self.slot_to_key[slot] = key
+                slots.append(slot)
+            slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
+            self.vectors, self.valid, self.sq_norms = _write_slots(
+                self.vectors, self.valid, self.sq_norms,
+                slots_arr, jnp.asarray(vecs), jnp.ones((len(slots),), bool),
+                normalize=self.metric is Metric.COS,
+            )
 
     def remove(self, keys: Sequence[Any]) -> None:
-        slots = []
-        for key in keys:
-            slot = self.key_to_slot.pop(key, None)
-            if slot is None:
-                continue
-            del self.slot_to_key[slot]
-            self.free_slots.append(slot)
-            slots.append(slot)
-        if not slots:
-            return
-        slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
-        self.vectors, self.valid, self.sq_norms = _write_slots(
-            self.vectors, self.valid, self.sq_norms,
-            slots_arr,
-            jnp.zeros((len(slots), self.dimension), jnp.float32),
-            jnp.zeros((len(slots),), bool),
-        )
+        with self.lock:
+            slots = []
+            for key in keys:
+                slot = self.key_to_slot.pop(key, None)
+                if slot is None:
+                    continue
+                del self.slot_to_key[slot]
+                self.free_slots.append(slot)
+                slots.append(slot)
+            if not slots:
+                return
+            self.remove_epoch += 1
+            self.slot_freed_epoch[np.asarray(slots)] = self.remove_epoch
+            slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
+            self.vectors, self.valid, self.sq_norms = _write_slots(
+                self.vectors, self.valid, self.sq_norms,
+                slots_arr,
+                jnp.zeros((len(slots), self.dimension), jnp.float32),
+                jnp.zeros((len(slots),), bool),
+            )
 
     # -- search -----------------------------------------------------------
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
@@ -206,9 +229,11 @@ class KnnShard:
                 else np.pad(queries, pad)
             )
         fn = _search_fn(k_eff, self.metric.value, self.chunk, self.precision)
-        vals, idx = fn(
-            jnp.asarray(queries), self.vectors, self.valid, self.sq_norms
-        )
+        with self.lock:  # read+launch before the next donating update
+            vals, idx = fn(
+                jnp.asarray(queries), self.vectors, self.valid, self.sq_norms
+            )
+            epoch = self.remove_epoch
         vals = np.asarray(vals)[:n]
         idx = np.asarray(idx)[:n]
         out: list[list[tuple[Any, float]]] = []
@@ -217,7 +242,13 @@ class KnnShard:
             for vv, slot in zip(vals[qi], idx[qi]):
                 if not np.isfinite(vv):
                     continue
-                key = self.slot_to_key.get(int(slot))
+                slot = int(slot)
+                # slot freed after our dispatch (possibly reused by a new
+                # key): this hit's key mapping is gone — drop it, matching
+                # removed-row semantics
+                if self.slot_freed_epoch[slot] > epoch:
+                    continue
+                key = self.slot_to_key.get(slot)
                 if key is None:
                     continue
                 hits.append((key, float(vv)))

@@ -46,16 +46,25 @@ class QueryEngine:
         metric = "l2sq" if shard.metric is Metric.L2SQ else "dot"
         use_sq = metric == "l2sq"
 
-        @jax.jit
-        def run(params, ids, mask, vectors, valid, sq_norms):
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("packed",))
+        def run(params, ids, mask, vectors, valid, sq_norms, *, packed):
             emb = model.apply({"params": params}, ids, mask)  # [q,d] unit
             vals, idx = chunked_topk_scores(
                 emb, vectors, valid, k_eff, chunk=chunk, metric=metric,
                 sq_norms=sq_norms if use_sq else None,
                 precision=precision,
             )
-            # pack scores and indices into ONE buffer: a single readback
-            return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=1)
+            if packed:
+                # pack scores and indices into ONE f32 buffer: a single
+                # readback (exact only for slot ids < 2^24)
+                return jnp.concatenate(
+                    [vals, idx.astype(jnp.float32)], axis=1
+                )
+            # two-buffer path for >=16.7M-row shards: i32 indices stay
+            # exact; the host pays a second (concurrent) readback
+            return vals, idx.astype(jnp.int32)
 
         self._fn = run
 
@@ -80,36 +89,56 @@ class QueryEngine:
         ids_p, mask_p, n = pad_batch(
             ids, mask, self.encoder.config.max_len, self.encoder.batch_size
         )
-        # f32 packing is exact for slot ids < 2^24 (16.7M rows/shard);
-        # larger shards must fall back to the two-buffer path
-        if self.shard.capacity >= (1 << 24):
-            raise ValueError(
-                "QueryEngine packed readback supports shards < 16.7M rows"
+        with self.shard.lock:
+            # read the array triple AND enqueue the executable before the
+            # next index update donates (invalidates) these buffers —
+            # update-while-serving safety; the launch is asynchronous so
+            # this section is microseconds. The packed/two-buffer decision
+            # and the remove-epoch are captured under the same lock so a
+            # concurrent growth past 2^24 rows (or a slot-freeing remove)
+            # cannot race this dispatch.
+            # f32 packing is exact for slot ids < 2^24 (16.7M rows/shard);
+            # larger shards take the two-buffer path (i32 indices, second
+            # readback)
+            packed_ok = self.shard.capacity < (1 << 24)
+            result = self._fn(
+                self.encoder.params,
+                jnp.asarray(ids_p),
+                jnp.asarray(mask_p),
+                self.shard.vectors,
+                self.shard.valid,
+                self.shard.sq_norms,
+                packed=packed_ok,
             )
-        packed = self._fn(
-            self.encoder.params,
-            jnp.asarray(ids_p),
-            jnp.asarray(mask_p),
-            self.shard.vectors,
-            self.shard.valid,
-            self.shard.sq_norms,
-        )
-        return packed, n
+            epoch = self.shard.remove_epoch
+        return result, n, packed_ok, epoch
 
     def finish(self, ticket) -> list[list[tuple[Any, float]]]:
-        """Phase 2: the ONE device->host readback + result shaping."""
-        packed, n = ticket
+        """Phase 2: the device->host readback(s) + result shaping — one
+        packed readback below 16.7M rows, two buffers above."""
+        result, n, packed_ok, epoch = ticket
         k_eff = self.k_eff  # compiled-in layout, not current capacity
-        packed = np.asarray(packed)[:n]  # the ONE readback
-        vals = packed[:, :k_eff]
-        idx = packed[:, k_eff:].astype(np.int64)
+        if packed_ok:
+            packed = np.asarray(result)[:n]  # the ONE readback
+            vals = packed[:, :k_eff]
+            idx = packed[:, k_eff:].astype(np.int64)
+        else:
+            vals_dev, idx_dev = result
+            vals = np.asarray(vals_dev)[:n]
+            idx = np.asarray(idx_dev)[:n].astype(np.int64)
         out = []
         for qi in range(n):
             hits = []
             for vv, slot in zip(vals[qi], idx[qi]):
                 if not np.isfinite(vv):
                     continue
-                key = self.shard.slot_to_key.get(int(slot))
+                slot = int(slot)
+                # slot freed after our dispatch (possibly reused by a new
+                # key): the mapping this score belongs to is gone — drop
+                # the hit, matching removed-row semantics
+                if self.shard.slot_freed_epoch[slot] > epoch:
+                    continue
+                key = self.shard.slot_to_key.get(slot)
                 if key is None:
                     continue
                 hits.append((key, float(vv)))

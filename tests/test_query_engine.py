@@ -37,3 +37,125 @@ def test_query_engine_empty_index():
     shard = KnnShard(enc.embed_dim, "cos")
     engine = QueryEngine(enc, shard, k=3)
     assert engine.query(["anything"]) == [[]]
+
+
+def test_two_buffer_readback_past_packed_cap():
+    """Shards at capacity >= 1<<24 exceed f32 slot-id packing; the engine
+    switches to the two-buffer (vals, i32 idx) path and still answers
+    exactly (VERDICT r4 #8: works at a 20M-capacity shard; the packed
+    path stays in use below the cap)."""
+    from pathway_tpu.models.encoder import EncoderConfig
+
+    enc = SentenceEncoder(
+        EncoderConfig(vocab_size=128, hidden=8, layers=1, heads=2, mlp=16,
+                      max_len=16),
+        batch_size=4,
+    )
+    shard = KnnShard(enc.embed_dim, "cos", capacity=20_000_000)
+    assert shard.capacity >= (1 << 24)
+    docs = ["alpha beta", "gamma delta", "epsilon zeta"]
+    embs = enc.encode(docs)
+    # place one doc at a slot ABOVE the f32-exact range to prove i32
+    # indices survive the readback
+    hi_slot = (1 << 24) + 12345
+    shard.key_to_slot["hi"] = hi_slot
+    shard.slot_to_key[hi_slot] = "hi"
+    shard.free_slots.remove(hi_slot)
+    import jax.numpy as jnp
+    from pathway_tpu.ops.knn import _write_slots
+
+    shard.vectors, shard.valid, shard.sq_norms = _write_slots(
+        shard.vectors, shard.valid, shard.sq_norms,
+        jnp.asarray([hi_slot]), jnp.asarray(embs[:1]),
+        jnp.ones((1,), bool), normalize=True,
+    )
+    shard.add(["a", "b"], embs[1:])
+
+    engine = QueryEngine(enc, shard, k=2)
+    hits = engine.query([docs[0]])[0]
+    assert hits and hits[0][0] == "hi"  # exact hi slot round-tripped
+    ticket = engine.dispatch([docs[0]])
+    assert ticket[2] is False  # two-buffer path engaged
+
+    # below the cap the packed path stays in use
+    small = KnnShard(enc.embed_dim, "cos", capacity=1024)
+    small.add(["x"], embs[:1])
+    engine_small = QueryEngine(enc, small, k=2)
+    assert engine_small.dispatch([docs[0]])[2] is True
+
+
+def test_update_while_serving_consistency():
+    """Concurrent add/remove churn against in-flight fused queries: no
+    torn snapshots, no donated-buffer crashes (shard.lock serializes
+    write vs read+launch), and every answer maps to a key that existed."""
+    import threading
+
+    enc = SentenceEncoder(EncoderConfig.tiny(), batch_size=8)
+    shard = KnnShard(enc.embed_dim, "cos", capacity=4096)
+    rng = np.random.default_rng(3)
+    base = rng.normal(size=(256, enc.embed_dim)).astype(np.float32)
+    shard.add(list(range(256)), base)
+    engine = QueryEngine(enc, shard, k=4)
+    engine.query(["warm"])
+
+    stop = threading.Event()
+    errors = []
+
+    def updater():
+        nk = 1000
+        try:
+            while not stop.is_set():
+                vecs = rng.normal(size=(32, enc.embed_dim)).astype(
+                    np.float32
+                )
+                keys = list(range(nk, nk + 32))
+                shard.add(keys, vecs)
+                nk += 32
+                shard.remove(keys[:16])
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    def querier():
+        try:
+            for i in range(30):
+                hits = engine.query([f"query number {i}"])[0]
+                for key, score in hits:
+                    assert isinstance(key, int)
+                    assert np.isfinite(score)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    ut = threading.Thread(target=updater)
+    qs = [threading.Thread(target=querier) for _ in range(3)]
+    ut.start()
+    for q in qs:
+        q.start()
+    for q in qs:
+        q.join(timeout=120)
+    stop.set()
+    ut.join(timeout=30)
+    assert not errors, errors
+
+
+def test_slot_reuse_between_dispatch_and_finish_drops_hit():
+    """A slot freed (and reused by a new key) after dispatch must not map
+    the in-flight score to the NEW key: the remove-epoch guard drops it
+    (removed-row semantics)."""
+    enc = SentenceEncoder(EncoderConfig.tiny(), batch_size=4)
+    shard = KnnShard(enc.embed_dim, "cos", capacity=64)
+    embs = enc.encode(["only document here", "another unrelated text"])
+    shard.add(["old", "other"], embs)
+    engine = QueryEngine(enc, shard, k=1)
+    engine.query(["warm"])
+
+    ticket = engine.dispatch(["only document here"])
+    old_slot = shard.key_to_slot["old"]
+    shard.remove(["old"])
+    shard.add(["new"], embs[1:])  # free list reuses the freed slot
+    assert shard.key_to_slot["new"] == old_slot  # reuse actually happened
+    hits = engine.finish(ticket)[0]
+    assert all(k != "new" for k, _ in hits), hits
+
+    # a fresh query resolves against the updated mapping
+    hits2 = engine.query(["another unrelated text"])[0]
+    assert hits2 and hits2[0][0] in ("new", "other")
