@@ -328,8 +328,10 @@ def bench_load_curve(engine, queries, floor_p50: float) -> dict:
     t0 = time.perf_counter()
     last = None
     for _ in range(m):
-        last = engine.dispatch(batch)[0]  # ticket: (result, n, packed)
-    last.block_until_ready()
+        # ticket: (result, n, packed_ok, epoch); result is one packed
+        # array below the 2^24-row cap, a (vals, idx) tuple above it
+        last = engine.dispatch(batch)[0]
+    (last[0] if isinstance(last, tuple) else last).block_until_ready()
     open_loop = time.perf_counter() - t0
     device_qps = 32 * m / open_loop
     return {

@@ -229,9 +229,20 @@ class DeclarativeAirbyteSource:
     streams[].retriever.requester: url_base, path, http_method (GET),
         request_parameters, request_headers — ``{{ config['k'] }}``
         interpolation in string values;
+    streams[].retriever.requester.authenticator: ApiKeyAuthenticator
+        (header + api_token), BearerAuthenticator (api_token),
+        BasicHttpAuthenticator (username/password) — the section most
+        real catalog manifests need (reference contract:
+        third_party/airbyte_serverless/sources.py declarative sources);
     streams[].retriever.record_selector.extractor.field_path;
-    streams[].retriever.paginator: NoPagination or OffsetIncrement
-        (page_size, inject via request_parameter offset_param);
+    streams[].retriever.paginator: NoPagination, flat OffsetIncrement
+        (page_size, inject via request_parameter offset_param), or the
+        real declarative DefaultPaginator with pagination_strategy in
+        {OffsetIncrement, PageIncrement, CursorPagination} and
+        page_token_option/page_size_option RequestOption injection
+        (request_parameter or header). CursorPagination evaluates
+        ``cursor_value``/``stop_condition`` templates over
+        ``response``/``last_record``;
     streams[].incremental_sync.cursor_field: client-side incremental —
         only records with cursor strictly above the stored state are
         emitted, and the new state carries the maximum seen.
@@ -293,6 +304,73 @@ class DeclarativeAirbyteSource:
         with urllib.request.urlopen(req, timeout=30) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
+    def _apply_auth(self, auth: dict, params: dict, headers: dict) -> None:
+        """Apply the authenticator section to the request (the forms most
+        catalog connectors use). ApiKeyAuthenticator honors
+        request_option.inject_into (header or request_parameter); NoAuth
+        is a no-op, unknown types raise rather than silently sync
+        unauthenticated."""
+        kind = auth.get("type", "")
+        if kind in ("", "NoAuth"):
+            return
+        if kind == "ApiKeyAuthenticator":
+            opt = auth.get("request_option") or {}
+            field = auth.get("header") or opt.get("field_name", "X-Api-Key")
+            token = str(auth.get("api_token", ""))
+            if (
+                auth.get("header") is None
+                and opt.get("inject_into") == "request_parameter"
+            ):
+                params[field] = token
+            else:
+                headers[field] = token
+            return
+        if kind == "BearerAuthenticator":
+            headers["Authorization"] = (
+                f"Bearer {auth.get('api_token', '')}"
+            )
+            return
+        if kind == "BasicHttpAuthenticator":
+            import base64
+
+            cred = f"{auth.get('username', '')}:{auth.get('password', '')}"
+            headers["Authorization"] = (
+                "Basic " + base64.b64encode(cred.encode()).decode()
+            )
+            return
+        raise ValueError(f"unsupported authenticator type {kind!r}")
+
+    @staticmethod
+    def _resolve_template(expr, response, last_record):
+        """Evaluate the declarative template subset CursorPagination
+        uses: ``{{ response['a']['b'] }}`` / ``{{ response.a.b }}`` /
+        ``{{ last_record['k'] }}``, optionally prefixed with ``not``.
+        Non-template values pass through."""
+        if not isinstance(expr, str):
+            return expr
+        text = expr.strip()
+        if not (text.startswith("{{") and text.endswith("}}")):
+            return expr
+        inner = text[2:-2].strip()
+        negate = False
+        if inner.startswith("not "):
+            negate = True
+            inner = inner[4:].strip()
+        root_name, *rest = inner.replace("]", "").replace(
+            "['", "."
+        ).replace('["', ".").replace("'", "").replace('"', "").split(".")
+        value = {"response": response, "last_record": last_record}.get(
+            root_name
+        )
+        for part in rest:
+            if not part:
+                continue
+            if not isinstance(value, dict):
+                value = None
+                break
+            value = value.get(part)
+        return (not value) if negate else value
+
     def _records_for_stream(self, s: dict) -> Iterator[dict]:
         retr = s.get("retriever", {})
         req = self._interp(retr.get("requester", {}))
@@ -300,23 +378,67 @@ class DeclarativeAirbyteSource:
         path = req.get("path", "")
         params = dict(req.get("request_parameters", {}) or {})
         headers = dict(req.get("request_headers", {}) or {})
+        auth = req.get("authenticator")
+        if auth:
+            self._apply_auth(auth, params, headers)
         selector = retr.get("record_selector", {})
         field_path = (selector.get("extractor") or {}).get("field_path", [])
         paginator = retr.get("paginator") or {"type": "NoPagination"}
-        page_size = int(paginator.get("page_size", 0) or 0)
-        offset_param = paginator.get("offset_param", "offset")
+
+        # normalize the two paginator shapes onto (strategy, injection)
+        ptype = paginator.get("type")
+        if ptype == "DefaultPaginator":
+            strategy = paginator.get("pagination_strategy") or {}
+            stype = strategy.get("type", "NoPagination")
+            page_size = int(strategy.get("page_size", 0) or 0)
+            token_opt = paginator.get("page_token_option") or {}
+            size_opt = paginator.get("page_size_option")
+        elif ptype == "OffsetIncrement":  # legacy flat shape
+            strategy = paginator
+            stype = "OffsetIncrement"
+            page_size = int(paginator.get("page_size", 0) or 0)
+            token_opt = {
+                "inject_into": "request_parameter",
+                "field_name": paginator.get("offset_param", "offset"),
+            }
+            size_opt = {
+                "inject_into": "request_parameter",
+                "field_name": "limit",
+            }
+        else:
+            strategy, stype, page_size = {}, "NoPagination", 0
+            token_opt, size_opt = {}, None
+
+        def inject(q: dict, h: dict, opt: dict | None, value) -> None:
+            if not opt or value is None:
+                return
+            field = opt.get("field_name")
+            if not field:
+                return
+            if opt.get("inject_into") == "header":
+                h[field] = str(value)
+            else:
+                q[field] = str(value)
 
         offset = 0
+        page = int(strategy.get("start_from_page", 0) or 0)
+        cursor_token = None
+        first = True
         while True:
             q = dict(params)
-            if paginator.get("type") == "OffsetIncrement":
-                q[offset_param] = str(offset)
-                if page_size:
-                    q["limit"] = str(page_size)
+            h = dict(headers)
+            if stype == "OffsetIncrement":
+                inject(q, h, token_opt, offset)
+            elif stype == "PageIncrement":
+                inject(q, h, token_opt, page)
+            elif stype == "CursorPagination" and not first:
+                inject(q, h, token_opt, cursor_token)
+            if page_size:
+                inject(q, h, size_opt, page_size)
             url = f"{base}/{path.lstrip('/')}"
             if q:
                 url += "?" + urllib.parse.urlencode(q)
-            payload = self._fetch(url, headers)
+            payload = self._fetch(url, h)
             records = payload
             for fp in field_path:
                 if not isinstance(records, dict):
@@ -325,12 +447,28 @@ class DeclarativeAirbyteSource:
                 records = records.get(fp, [])
             if not isinstance(records, list):
                 records = [records]
-            yield from (r for r in records if isinstance(r, dict))
-            if paginator.get("type") != "OffsetIncrement":
+            records = [r for r in records if isinstance(r, dict)]
+            yield from records
+            first = False
+            if stype in ("OffsetIncrement", "PageIncrement"):
+                if not records or (page_size and len(records) < page_size):
+                    return
+                offset += len(records)
+                page += 1
+            elif stype == "CursorPagination":
+                last = records[-1] if records else None
+                stop = strategy.get("stop_condition")
+                if stop is not None and self._resolve_template(
+                    stop, payload, last
+                ):
+                    return
+                cursor_token = self._resolve_template(
+                    strategy.get("cursor_value"), payload, last
+                )
+                if not cursor_token:
+                    return
+            else:
                 return
-            if not records or (page_size and len(records) < page_size):
-                return
-            offset += len(records)
 
     def extract(self, state=None) -> Iterator[dict]:
         """Yields Airbyte protocol messages: RECORD per row + one STATE

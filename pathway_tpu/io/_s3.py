@@ -117,6 +117,7 @@ class S3Client:
         host: str,
         payload_hash: str,
         now: datetime.datetime | None = None,
+        extra_headers: dict[str, str] | None = None,
     ) -> dict[str, str]:
         s = self.s
         now = now or datetime.datetime.now(datetime.timezone.utc)
@@ -127,6 +128,10 @@ class S3Client:
             "x-amz-content-sha256": payload_hash,
             "x-amz-date": amz_date,
         }
+        if extra_headers:
+            headers.update(
+                {k.lower(): v for k, v in extra_headers.items()}
+            )
         if s.session_token:
             headers["x-amz-security-token"] = s.session_token
         if not s.access_key:
@@ -184,6 +189,7 @@ class S3Client:
         key: str = "",
         query: dict[str, str] | None = None,
         body: bytes | None = None,
+        extra_headers: dict[str, str] | None = None,
     ):
         base, host, prefix = self._base()
         query = query or {}
@@ -191,7 +197,10 @@ class S3Client:
         payload_hash = (
             hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
         )
-        headers = self._sign(method, path, query, host, payload_hash)
+        headers = self._sign(
+            method, path, query, host, payload_hash,
+            extra_headers=extra_headers,
+        )
         qs = urllib.parse.urlencode(sorted(query.items()))
         url = base + path + (f"?{qs}" if qs else "")
         req = urllib.request.Request(
@@ -243,6 +252,32 @@ class S3Client:
     def put_object(self, key: str, data: bytes) -> None:
         with self._request("PUT", key, body=data) as resp:
             resp.read()
+
+    def put_object_if_absent(self, key: str, data: bytes) -> None:
+        """Conditional create (``If-None-Match: *``): raises
+        FileExistsError when the key already exists. AWS S3 (since the
+        2024 conditional-writes GA) and MinIO both honor it — the
+        put-if-absent primitive Delta log commits need for
+        mutually-exclusive version creation."""
+        try:
+            with self._request(
+                "PUT", key, body=data, extra_headers={"if-none-match": "*"}
+            ) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code in (409, 412):  # exists (412 AWS/MinIO, 409 GCS-compat)
+                raise FileExistsError(key) from e
+            raise
+
+    def head_object(self, key: str) -> bool:
+        try:
+            with self._request("HEAD", key) as resp:
+                resp.read()
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
 
     def delete_object(self, key: str) -> None:
         try:

@@ -13,13 +13,16 @@ pyarrow is in the image, so this build implements the protocol directly:
   ``add`` actions of each (append-only semantics, like the reference's
   reader at io/deltalake/__init__.py:38).
 
-Local filesystem lakes are supported; S3 lakes raise with a clear
-message (the object-store transport exists in io/_s3.py — wiring the
-log store onto it is future work).
+Storage rides a small store abstraction: local filesystem, or any
+S3-compatible object store through the dependency-free SigV4 transport
+(io/_s3.py) — ``s3://bucket/prefix`` lakes read and write directly on
+object storage like the reference (data_storage.rs:1611,1902), with
+log-commit exclusivity via conditional PUT (``If-None-Match: *``).
 """
 
 from __future__ import annotations
 
+import io as _io
 import json as _json
 import os
 import time
@@ -43,19 +46,109 @@ _DELTA_TYPES = {
 }
 
 
-def _require_local(uri) -> str:
-    uri = os.fspath(uri)
-    if str(uri).startswith(("s3://", "s3a://")):
-        raise NotImplementedError(
-            "pw.io.deltalake: S3-backed lakes are not wired yet in this "
-            "build — use a local path (the reference supports both, "
-            "io/deltalake/__init__.py:52)"
+class _LocalStore:
+    """Lake storage on the local filesystem."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def read(self, rel: str) -> bytes | None:
+        try:
+            with open(os.path.join(self.root, rel), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def write(self, rel: str, data: bytes) -> None:
+        path = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def write_exclusive(self, rel: str, data: bytes) -> None:
+        """Create-if-absent (Delta log commits must be mutually
+        exclusive: two writers must never both claim version N).
+        os.link from a private tmp file is atomic-exclusive; filesystems
+        without hard links fall back to os.replace (single-writer safe)."""
+        path = os.path.join(self.root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp-{uuid.uuid4().hex}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        try:
+            os.link(tmp, path)
+        except OSError as exc:
+            if isinstance(exc, FileExistsError):
+                raise
+            os.replace(tmp, path)
+            tmp = None
+        finally:
+            if tmp is not None:
+                os.unlink(tmp)
+
+    def list_log_versions(self) -> list[int]:
+        log = os.path.join(self.root, "_delta_log")
+        try:
+            names = os.listdir(log)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            int(f.split(".")[0])
+            for f in names
+            if f.endswith(".json") and f.split(".")[0].isdigit()
         )
-    return str(uri)
 
 
-def _log_dir(uri: str) -> str:
-    return os.path.join(uri, "_delta_log")
+class _S3Store:
+    """Lake storage on an S3-compatible object store via the SigV4
+    transport (reference: the delta-rs S3 log store,
+    data_storage.rs:1611)."""
+
+    def __init__(self, uri: str, settings=None, opener=None):
+        from pathway_tpu.io._s3 import AwsS3Settings, S3Client
+
+        rest = uri.split("://", 1)[1]
+        bucket, _, prefix = rest.partition("/")
+        if settings is None:
+            settings = AwsS3Settings.new_from_path(uri)
+        self.client = S3Client(settings.with_bucket(bucket), opener=opener)
+        self.prefix = prefix.strip("/")
+
+    def _key(self, rel: str) -> str:
+        rel = rel.replace(os.sep, "/")
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def read(self, rel: str) -> bytes | None:
+        import urllib.error
+
+        try:
+            return self.client.get_object(self._key(rel))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def write(self, rel: str, data: bytes) -> None:
+        self.client.put_object(self._key(rel), data)
+
+    def write_exclusive(self, rel: str, data: bytes) -> None:
+        self.client.put_object_if_absent(self._key(rel), data)
+
+    def list_log_versions(self) -> list[int]:
+        log_prefix = self._key("_delta_log/")
+        out = []
+        for obj in self.client.list_objects(prefix=log_prefix):
+            name = obj.key.rsplit("/", 1)[-1]
+            if name.endswith(".json") and name.split(".")[0].isdigit():
+                out.append(int(name.split(".")[0]))
+        return sorted(out)
+
+
+def _make_store(uri, s3_connection_settings=None):
+    uri = str(os.fspath(uri))
+    if uri.startswith(("s3://", "s3a://")):
+        return _S3Store(uri, settings=s3_connection_settings)
+    return _LocalStore(uri)
 
 
 def _delta_type(col_dtype) -> str:
@@ -65,9 +158,9 @@ def _delta_type(col_dtype) -> str:
 class _DeltaSubject(ConnectorSubject):
     _deletions_enabled = False  # append-only source (reference contract)
 
-    def __init__(self, uri, columns, mode, refresh_interval=1.0):
+    def __init__(self, store, columns, mode, refresh_interval=1.0):
         super().__init__()
-        self.uri = uri
+        self.store = store
         self.columns = columns
         self.mode = mode
         self.refresh_interval = refresh_interval
@@ -77,38 +170,40 @@ class _DeltaSubject(ConnectorSubject):
     def _scan_versions(self) -> bool:
         import pyarrow.parquet as pq
 
-        log = _log_dir(self.uri)
         advanced = False
         while True:
-            path = os.path.join(log, f"{self._version:020d}.json")
-            if not os.path.exists(path):
+            data = self.store.read(
+                os.path.join("_delta_log", f"{self._version:020d}.json")
+            )
+            if data is None:
                 return advanced
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    action = _json.loads(line)
-                    add = action.get("add")
-                    if add is None:
-                        continue
-                    part = os.path.join(self.uri, add["path"])
-                    table = pq.read_table(part)
-                    cols = [
-                        table.column(c).to_pylist()
-                        if c in table.column_names
-                        else [None] * table.num_rows
-                        for c in self.columns
-                    ]
-                    for i in range(table.num_rows):
-                        key = ref_scalar("delta", add["path"], i)
-                        self._upsert(
-                            key,
-                            {
-                                c: cols[j][i]
-                                for j, c in enumerate(self.columns)
-                            },
-                        )
+            for line in data.decode().splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                action = _json.loads(line)
+                add = action.get("add")
+                if add is None:
+                    continue
+                part = self.store.read(add["path"])
+                if part is None:
+                    continue  # torn listing: the part lands with the log
+                table = pq.read_table(_io.BytesIO(part))
+                cols = [
+                    table.column(c).to_pylist()
+                    if c in table.column_names
+                    else [None] * table.num_rows
+                    for c in self.columns
+                ]
+                for i in range(table.num_rows):
+                    key = ref_scalar("delta", add["path"], i)
+                    self._upsert(
+                        key,
+                        {
+                            c: cols[j][i]
+                            for j, c in enumerate(self.columns)
+                        },
+                    )
             self._version += 1
             advanced = True
 
@@ -139,14 +234,17 @@ def read(
     mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
     refresh_interval: float = 1.0,
+    s3_connection_settings=None,
     name: str | None = None,
     **kwargs,
 ):
-    """Read an append-only table from a Delta Lake (reference:
-    io/deltalake/__init__.py:38)."""
-    uri = _require_local(uri)
+    """Read an append-only table from a Delta Lake — local path or
+    ``s3://bucket/prefix`` (reference: io/deltalake/__init__.py:38, with
+    the same AwsS3Settings-or-path-derived credentials contract :25)."""
+    store = _make_store(uri, s3_connection_settings)
     subject = _DeltaSubject(
-        uri, schema.column_names(), mode, refresh_interval=refresh_interval
+        store, schema.column_names(), mode,
+        refresh_interval=refresh_interval,
     )
     return python_read(
         subject,
@@ -161,14 +259,16 @@ def write(
     uri,
     *,
     min_commit_frequency: int | None = 60_000,
+    s3_connection_settings=None,
     name: str | None = None,
     **kwargs,
 ) -> None:
-    """Write the table's change stream into a Delta Lake (reference:
-    io/deltalake/__init__.py:170 — output rows carry ``time`` and
-    ``diff`` columns; one parquet part + log version per commit window,
-    rate-limited by min_commit_frequency)."""
-    uri = _require_local(uri)
+    """Write the table's change stream into a Delta Lake — local path or
+    ``s3://bucket/prefix`` (reference: io/deltalake/__init__.py:170 —
+    output rows carry ``time`` and ``diff`` columns; one parquet part +
+    log version per commit window, rate-limited by
+    min_commit_frequency)."""
+    store = _make_store(uri, s3_connection_settings)
     cols = table.column_names()
     schema_dtypes = table._schema_cls._dtypes()
     dtypes = [schema_dtypes.get(c) for c in cols]
@@ -177,14 +277,8 @@ def write(
     }
 
     def _next_version() -> int:
-        log = _log_dir(uri)
-        os.makedirs(log, exist_ok=True)
         if state["version"] is None:
-            existing = [
-                int(f.split(".")[0])
-                for f in os.listdir(log)
-                if f.endswith(".json") and f.split(".")[0].isdigit()
-            ]
+            existing = store.list_log_versions()
             state["version"] = (max(existing) + 1) if existing else 0
             if state["version"] == 0:
                 try:
@@ -227,29 +321,14 @@ def write(
 
     def _write_version(v: int, actions: list[dict]) -> None:
         # The Delta protocol requires mutually-exclusive version creation:
-        # two writers must never both claim version N. os.link from a
-        # private tmp file is atomic-exclusive (raises FileExistsError if
-        # a concurrent writer — a second pipeline or an external delta-rs
-        # client — committed N first), unlike os.replace which would
-        # silently clobber the other commit's log entry.
-        path = os.path.join(_log_dir(uri), f"{v:020d}.json")
-        tmp = path + f".tmp-{uuid.uuid4().hex}"
-        with open(tmp, "w") as f:
-            for a in actions:
-                f.write(_json.dumps(a) + "\n")
-        try:
-            os.link(tmp, path)
-        except OSError as exc:
-            if isinstance(exc, FileExistsError):
-                raise
-            # filesystem without hard links (exFAT, some FUSE/NFS mounts):
-            # fall back to os.replace — single-writer still safe, only the
-            # multi-writer exclusivity guarantee is lost there
-            os.replace(tmp, path)
-            tmp = None
-        finally:
-            if tmp is not None:
-                os.unlink(tmp)
+        # two writers must never both claim version N. The store's
+        # write_exclusive raises FileExistsError if a concurrent writer —
+        # a second pipeline or an external delta-rs client — committed N
+        # first (local: atomic os.link; S3: conditional PUT).
+        data = "".join(_json.dumps(a) + "\n" for a in actions).encode()
+        store.write_exclusive(
+            os.path.join("_delta_log", f"{v:020d}.json"), data
+        )
 
     def _commit(actions: list[dict]) -> None:
         while True:
@@ -282,16 +361,17 @@ def write(
         arrays["time"] = [r[len(cols)] for r in rows]
         arrays["diff"] = [r[len(cols) + 1] for r in rows]
         part = f"part-{uuid.uuid4().hex}.parquet"
-        os.makedirs(uri, exist_ok=True)
-        path = os.path.join(uri, part)
-        pq.write_table(pa.table(arrays), path)
+        buf = _io.BytesIO()
+        pq.write_table(pa.table(arrays), buf)
+        data = buf.getvalue()
+        store.write(part, data)
         _commit(
             [
                 {
                     "add": {
                         "path": part,
                         "partitionValues": {},
-                        "size": os.path.getsize(path),
+                        "size": len(data),
                         "modificationTime": int(time.time() * 1000),
                         "dataChange": True,
                     }

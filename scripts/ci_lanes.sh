@@ -9,10 +9,11 @@
 #           over the real loopback TCP mesh (lockstep exchanges, gather
 #           outputs), re-shaking the batteries for sharding bugs.
 #
-# Lane-2 deselects: suites that already fork REAL rank processes (their
-# children would inherit the lane var on top of real PATHWAY_PROCESSES),
-# serving tests that bind fixed HTTP ports per rank, and wall-clock
-# sensitive perf tests.
+# Lane-2 deselects: ONLY suites that fork REAL rank processes (their
+# children would inherit the lane var on top of real PATHWAY_PROCESSES).
+# Serving tests (rest/rag servers, sharded vector store, templates) run
+# IN the lane since round 4 — subjects read on rank 0 only, so each
+# webserver binds once (VERDICT r4 #4).
 set -e
 cd "$(dirname "$0")/.."
 
@@ -21,16 +22,11 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "=== lane 1: PATHWAY_THREADS=4 (full suite) ==="
 PATHWAY_THREADS=4 python -m pytest tests/ -x -q
 
-echo "=== lane 2: PATHWAY_LANE_PROCESSES=2 (semantics batteries) ==="
+echo "=== lane 2: PATHWAY_LANE_PROCESSES=2 (full suite incl. serving) ==="
 PATHWAY_LANE_PROCESSES=2 python -m pytest -x -q \
   --ignore=tests/test_multiprocess.py \
   --ignore=tests/test_persistence_multiprocess.py \
   --ignore=tests/test_parallel.py \
-  --ignore=tests/test_rest_server.py \
-  --ignore=tests/test_rag_server.py \
-  --ignore=tests/test_sharded_vector_store.py \
-  --ignore=tests/test_templates.py \
-  --ignore=tests/test_native_stress.py \
   tests/
 
 echo "=== both lanes green ==="

@@ -335,3 +335,155 @@ def test_airbyte_full_refresh_streaming_mirrors_source(tmp_path):
         # the next test starts (5 retries x 150 ms refresh)
         if runner is not None:
             runner.join(timeout=10)
+
+
+# -- authenticators + cursor pagination (VERDICT r4 #7) --------------------
+
+def _start_cursor_api(items, token="tok-123"):
+    """JSON API with Bearer auth and cursor pagination: /v2/items returns
+    {data: [...], meta: {next: <cursor>}} pages of 2; 401 without auth."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            from urllib.parse import parse_qs, urlparse
+
+            if self.headers.get("Authorization") != f"Bearer {token}":
+                self.send_response(401)
+                self.end_headers()
+                return
+            u = urlparse(self.path)
+            if u.path != "/v2/items":
+                self.send_response(404)
+                self.end_headers()
+                return
+            q = parse_qs(u.query)
+            after = int(q.get("after", ["0"])[0])
+            page = items[after : after + 2]
+            nxt = after + 2 if after + 2 < len(items) else None
+            body = json.dumps(
+                {"data": page, "meta": {"next": nxt}}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_declarative_auth_and_cursor_pagination(tmp_path):
+    """An authed (Bearer) + CursorPagination manifest in the real
+    declarative shape (DefaultPaginator + pagination_strategy +
+    page_token_option) syncs all pages e2e through pw.io.airbyte.read."""
+    items = [{"id": i} for i in range(1, 8)]  # 4 pages of <=2
+    srv = _start_cursor_api(items)
+    try:
+        manifest = textwrap.dedent(
+            f"""
+            version: "0.1.0"
+            streams:
+              - name: items
+                primary_key: id
+                retriever:
+                  requester:
+                    url_base: http://127.0.0.1:{srv.server_port}
+                    path: /v2/items
+                    authenticator:
+                      type: BearerAuthenticator
+                      api_token: "{{{{ config['api_key'] }}}}"
+                  record_selector:
+                    extractor:
+                      field_path: [data]
+                  paginator:
+                    type: DefaultPaginator
+                    page_token_option:
+                      type: RequestOption
+                      inject_into: request_parameter
+                      field_name: after
+                    pagination_strategy:
+                      type: CursorPagination
+                      cursor_value: "{{{{ response['meta']['next'] }}}}"
+                      stop_condition: "{{{{ not response['meta']['next'] }}}}"
+            """
+        )
+        (tmp_path / "manifest.yaml").write_text(manifest)
+        conn = tmp_path / "connection.yaml"
+        conn.write_text(
+            "source:\n"
+            "  manifest_path: manifest.yaml\n"
+            "  config: {api_key: tok-123}\n"
+        )
+        import os as _os
+
+        cwd = _os.getcwd()
+        _os.chdir(tmp_path)
+        try:
+            pw.internals.parse_graph.G.clear()
+            t = pw.io.airbyte.read(
+                str(conn), streams=["items"], mode="static"
+            )
+        finally:
+            _os.chdir(cwd)
+        cap = GraphRunner().run_tables(t)[0]
+        ids = sorted(
+            row[0].value["id"] for row in cap.state.rows.values()
+        )
+        assert ids == list(range(1, 8))
+    finally:
+        srv.shutdown()
+
+
+def test_authenticator_forms():
+    from pathway_tpu.io._airbyte import DeclarativeAirbyteSource
+
+    src = DeclarativeAirbyteSource({"streams": []})
+
+    def apply(auth):
+        params, headers = {}, {}
+        src._apply_auth(auth, params, headers)
+        return params, headers
+
+    assert apply(
+        {"type": "ApiKeyAuthenticator", "header": "X-K", "api_token": "a"}
+    ) == ({}, {"X-K": "a"})
+    # request_option.inject_into=request_parameter routes to the query
+    assert apply(
+        {"type": "ApiKeyAuthenticator", "api_token": "a",
+         "request_option": {"inject_into": "request_parameter",
+                            "field_name": "api_key"}}
+    ) == ({"api_key": "a"}, {})
+    assert apply(
+        {"type": "BearerAuthenticator", "api_token": "t"}
+    ) == ({}, {"Authorization": "Bearer t"})
+    import base64
+
+    assert apply(
+        {"type": "BasicHttpAuthenticator", "username": "u", "password": "p"}
+    ) == ({}, {
+        "Authorization": "Basic " + base64.b64encode(b"u:p").decode()
+    })
+    assert apply({"type": "NoAuth"}) == ({}, {})  # builder default: no-op
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unsupported authenticator"):
+        apply({"type": "OAuthAuthenticator"})
+
+
+def test_cursor_template_resolution():
+    from pathway_tpu.io._airbyte import DeclarativeAirbyteSource
+
+    rt = DeclarativeAirbyteSource._resolve_template
+    resp = {"meta": {"next": "abc"}, "flat": 7}
+    assert rt("{{ response['meta']['next'] }}", resp, None) == "abc"
+    assert rt("{{ response.meta.next }}", resp, None) == "abc"
+    assert rt("{{ response['flat'] }}", resp, None) == 7
+    assert rt("{{ not response['meta']['next'] }}", resp, None) is False
+    assert rt("{{ not response['missing'] }}", resp, None) is True
+    assert rt("{{ last_record['id'] }}", resp, {"id": 9}) == 9
+    assert rt("plain", resp, None) == "plain"

@@ -112,7 +112,5 @@ def test_gated_connectors_raise_importerror():
     with pytest.raises(ImportError, match="confluent-kafka"):
         pw.io.kafka.read({}, "topic", schema=None)
     # postgres/deltalake/s3/nats/mongodb/elasticsearch carry REAL
-    # dependency-free transports now (tests/test_wire_connectors*.py);
-    # only S3-backed delta lakes remain unwired
-    with pytest.raises(NotImplementedError, match="S3-backed"):
-        pw.io.deltalake.write(None, "s3://bucket/lake")
+    # dependency-free transports now (tests/test_wire_connectors*.py),
+    # including S3-backed delta lakes (round 4)

@@ -53,7 +53,13 @@ class _MockS3Handler(BaseHTTPRequestHandler):
             f"{quote(k, safe='')}={quote(v, safe='')}"
             for k, v in sorted(parse_qsl(split.query, keep_blank_values=True))
         )
+        # honor the client's SignedHeaders list (conditional PUTs sign
+        # if-none-match too) rather than assuming the minimal three
         signed = ["host", "x-amz-content-sha256", "x-amz-date"]
+        if "SignedHeaders=" in auth:
+            signed = (
+                auth.split("SignedHeaders=")[1].split(",")[0].split(";")
+            )
         ch = "".join(
             f"{h}:{self.headers[h.title()] if h != 'host' else self.headers['Host']}\n"
             for h in signed
@@ -129,11 +135,27 @@ class _MockS3Handler(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(n)
         self._verify_sig(body)
-        self.store[self._key()] = body
         self.requests.append(("PUT", self.path, dict(self.headers)))
+        # conditional create (If-None-Match: *): 412 when the key exists,
+        # like AWS S3 conditional writes / MinIO
+        if (
+            self.headers.get("If-None-Match") == "*"
+            and self._key() in self.store
+        ):
+            self.send_error(412)
+            return
+        self.store[self._key()] = body
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+    def do_HEAD(self):
+        if self._key() in self.store:
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_error(404)
 
     def do_DELETE(self):
         self.store.pop(self._key(), None)
@@ -569,3 +591,93 @@ def test_nats_write_and_read_roundtrip():
         assert sorted(got) == ["x", "y"]
     finally:
         server.close()
+
+
+def test_deltalake_on_mock_s3_roundtrip(mock_s3):
+    """VERDICT r4 #5: a Delta table written to s3://bucket/prefix through
+    the SigV4 transport reads back identically — parquet parts + JSON log
+    all on object storage, log commits via conditional PUT."""
+    import json as _json
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    handler, url = mock_s3
+    settings = _settings(url)
+    lake = "s3://bkt/lakes/events"
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("w | n\nfoo | 1\nbar | 2\nbaz | 3")
+    pw.io.deltalake.write(
+        t, lake, min_commit_frequency=None,
+        s3_connection_settings=settings,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    # the lake lives in the bucket: log version 0 (protocol+metaData),
+    # version 1 (add), and one parquet part under the prefix
+    log_keys = sorted(
+        k for k in handler.store if k.startswith("lakes/events/_delta_log/")
+    )
+    assert [k.rsplit("/", 1)[-1] for k in log_keys] == [
+        "0" * 20 + ".json",
+        "0" * 19 + "1.json",
+    ]
+    actions = [
+        _json.loads(line)
+        for line in handler.store[log_keys[0]].decode().splitlines()
+    ]
+    assert any("protocol" in a for a in actions)
+    parts = [
+        k for k in handler.store
+        if k.startswith("lakes/events/") and k.endswith(".parquet")
+    ]
+    assert len(parts) == 1
+    assert not handler.sig_failures, handler.sig_failures
+
+    # read it back through the same transport
+    pw.internals.parse_graph.G.clear()
+
+    class S(pw.Schema):
+        w: str
+        n: int
+
+    rt = pw.io.deltalake.read(
+        lake, S, mode="static", s3_connection_settings=settings
+    )
+    total = rt.reduce(s=pw.reducers.sum(pw.this.n), c=pw.reducers.count())
+    cap = GraphRunner().run_tables(total)[0]
+    assert list(cap.state.rows.values()) == [(6, 3)]
+
+    # appending via a second writer continues the log (conditional PUT
+    # claims version 2) and the reader sees both commits
+    pw.internals.parse_graph.G.clear()
+    t2 = pw.debug.table_from_markdown("w | n\nqux | 10")
+    pw.io.deltalake.write(
+        t2, lake, min_commit_frequency=None,
+        s3_connection_settings=settings,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    pw.internals.parse_graph.G.clear()
+    rt2 = pw.io.deltalake.read(
+        lake, S, mode="static", s3_connection_settings=settings
+    )
+    total2 = rt2.reduce(s=pw.reducers.sum(pw.this.n), c=pw.reducers.count())
+    cap2 = GraphRunner().run_tables(total2)[0]
+    assert list(cap2.state.rows.values()) == [(16, 4)]
+
+
+def test_s3_conditional_put_exclusive(mock_s3):
+    handler, url = mock_s3
+    from pathway_tpu.io._s3 import S3Client
+
+    c = S3Client(_settings(url))
+    c.put_object_if_absent("lock/v1", b"a")
+    import pytest as _pytest
+
+    with _pytest.raises(FileExistsError):
+        c.put_object_if_absent("lock/v1", b"b")
+    assert handler.store["lock/v1"] == b"a"
+    assert c.head_object("lock/v1") is True
+    assert c.head_object("lock/v2") is False
