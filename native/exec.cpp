@@ -949,6 +949,7 @@ struct RowExtract {
     std::string key_ord;   /* fp codes: 16-byte big-endian row key */
     PyObject *order_obj = nullptr; /* borrowed: sort_by token */
     MVal order_mv;
+    bool skip = false;     /* ERROR in grouping values: row skipped */
 };
 
 struct Affected {
@@ -971,9 +972,10 @@ PyObject *process_batch(PyObject *, PyObject *args)
         *error_obj;
     long long batch_time = 0;
     PyObject *ordercol = Py_None;
-    if (!PyArg_ParseTuple(args, "OOOOOOO|LO", &capsule, &gvals_list,
+    PyObject *skipped_out = Py_None;
+    if (!PyArg_ParseTuple(args, "OOOOOOO|LOO", &capsule, &gvals_list,
                           &keys_list, &valcols, &diffs, &key_fn, &error_obj,
-                          &batch_time, &ordercol))
+                          &batch_time, &ordercol, &skipped_out))
         return nullptr;
     GroupStore *store = get_store(capsule);
     if (store == nullptr)
@@ -1027,10 +1029,28 @@ PyObject *process_batch(PyObject *, PyObject *args)
         RowExtract &r = rows[i];
         PyObject *gv = PyList_GET_ITEM(gvals_list, i);
         if (!ser_gvals(r.key, gv)) {
-            /* any serialization failure (incl. surrogate-escaped strings
-             * that are not UTF-8 encodable) routes to the Python path,
-             * which handles those values */
             PyErr_Clear();
+            /* ERROR in a grouping value: the row joins no group — it is
+             * skipped and reported for the error log (reference:
+             * test_errors.py "Error value encountered in grouping
+             * columns"). Any other serialization failure (exotic
+             * values, surrogate-escaped strings) routes to the Python
+             * path, which handles those values. */
+            bool has_err = false;
+            if (error_obj != nullptr && PyTuple_Check(gv))
+                for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(gv); j++)
+                    if (PyTuple_GET_ITEM(gv, j) == error_obj) {
+                        has_err = true;
+                        break;
+                    }
+            if (has_err) {
+                r.skip = true;
+                if (skipped_out != Py_None &&
+                    PyList_Append(skipped_out,
+                                  PyList_GET_ITEM(keys_list, i)) < 0)
+                    return nullptr;
+                continue;
+            }
             PyErr_SetString(FallbackError, "unsupported grouping value");
             return nullptr;
         }
@@ -1201,7 +1221,8 @@ PyObject *process_batch(PyObject *, PyObject *args)
     {
         std::vector<std::vector<int32_t>> shard_rows((size_t)W);
         for (Py_ssize_t i = 0; i < n; i++)
-            shard_rows[rows[i].shard].push_back((int32_t)i);
+            if (!rows[i].skip)
+                shard_rows[rows[i].shard].push_back((int32_t)i);
 
         auto work = [&](int w) {
             Shard &sh = store->shards[(size_t)w];

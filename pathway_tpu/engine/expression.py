@@ -57,13 +57,15 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
             lv = lf(keys, rows)
             rv = rf(keys, rows)
             out = []
-            for a, b in zip(lv, rv):
+            for i, (a, b) in enumerate(zip(lv, rv)):
                 if a is ERROR or b is ERROR:
                     out.append(ERROR)
                     continue
                 try:
                     out.append(op(a, b))
-                except Exception:
+                except Exception as exc:
+                    if runtime is not None:
+                        runtime.log_data_error(str(exc), keys[i])
                     out.append(ERROR)
             return out
 
@@ -150,9 +152,15 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
 
     if isinstance(e, (expr.IsNoneExpression, expr.IsNotNoneExpression)):
         f = compile_expression(e._expr, resolver, runtime)
+        # ERROR is absorbing here too: an undecidable value has an
+        # undecidable None-ness (reference: Value::Error propagation)
         if isinstance(e, expr.IsNoneExpression):
-            return lambda keys, rows: [v is None for v in f(keys, rows)]
-        return lambda keys, rows: [v is not None for v in f(keys, rows)]
+            return lambda keys, rows: [
+                ERROR if v is ERROR else v is None for v in f(keys, rows)
+            ]
+        return lambda keys, rows: [
+            ERROR if v is ERROR else v is not None for v in f(keys, rows)
+        ]
 
     if isinstance(e, expr.CastExpression):
         f = compile_expression(e._expr, resolver, runtime)
@@ -302,6 +310,7 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
     if isinstance(e, expr.MethodCallExpression):
         fns = [compile_expression(a, resolver, runtime) for a in e._args]
         fun = e._fun
+        method_propagate_none = getattr(e, "_propagate_none", True)
 
         def eval_method(keys, rows):
             cols = [fn(keys, rows) for fn in fns]
@@ -311,7 +320,7 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
                 if args[0] is ERROR:
                     out.append(ERROR)
                     continue
-                if args[0] is None:
+                if args[0] is None and method_propagate_none:
                     out.append(None)
                     continue
                 if isinstance(args[0], Json):

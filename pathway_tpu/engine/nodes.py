@@ -768,10 +768,11 @@ class GroupByNode(GroupDiffNode):
                 if self.native_order is not None
                 else None
             )
+            skipped: list = []
             try:
                 # distinct groups emit distinct rows, so the output is
                 # already in net form
-                return ConsolidatedList(
+                out = ConsolidatedList(
                     self._exec.process_batch(
                         self._store,
                         list(gvals_list),
@@ -782,11 +783,40 @@ class GroupByNode(GroupDiffNode):
                         ERROR,
                         time,
                         ordercol,
+                        skipped,
                     )
                 )
+                for k in skipped:
+                    self.scope.runtime.log_data_error(
+                        "Error value encountered in grouping columns, "
+                        "skipping the row",
+                        k,
+                    )
+                return out
             except self._exec.Fallback:
                 self._migrate_to_python()
         gvals_list = self.grouping_batch(keys, rows)
+        # reference parity (test_errors.py): rows whose grouping values
+        # are ERROR join no group — skipped and logged
+        if any(
+            any(v is ERROR for v in g) for g in gvals_list
+        ):
+            keep = []
+            for i, g in enumerate(gvals_list):
+                if any(v is ERROR for v in g):
+                    self.scope.runtime.log_data_error(
+                        "Error value encountered in grouping columns, "
+                        "skipping the row",
+                        keys[i],
+                    )
+                else:
+                    keep.append(i)
+            if not keep:
+                return []
+            batch = [batch[i] for i in keep]
+            keys = [keys[i] for i in keep]
+            rows = [rows[i] for i in keep]
+            gvals_list = [gvals_list[i] for i in keep]
         args_list = self.args_batch(keys, rows)
         gfrozen_list = [freeze_row(g) for g in gvals_list]
         affected = dict.fromkeys(gfrozen_list)  # ordered, unique

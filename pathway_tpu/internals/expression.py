@@ -493,14 +493,21 @@ class GetExpression(ColumnExpression):
 
 
 class MethodCallExpression(ColumnExpression):
-    """A .dt/.str/.num namespace method lowered to a native batch function."""
+    """A .dt/.str/.num namespace method lowered to a native batch function.
 
-    def __init__(self, name: str, args: tuple, fun: Callable, return_type: Any):
+    ``propagate_none=False`` lets the function see None subjects —
+    required by methods whose JOB is handling None (num.fill_na)."""
+
+    def __init__(
+        self, name: str, args: tuple, fun: Callable, return_type: Any,
+        propagate_none: bool = True,
+    ):
         super().__init__()
         self._name = name
         self._args = tuple(smart_coerce(a) for a in args)
         self._fun = fun
         self._dtype = dt.wrap(return_type)
+        self._propagate_none = propagate_none
 
     def _subexpressions(self):
         return self._args

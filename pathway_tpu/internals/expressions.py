@@ -172,11 +172,15 @@ class NumericalNamespace(_Namespace):
                 return d
             return x
 
-        return self._method(
+        # propagate_none=False: this method's JOB is receiving the None
+        # (reference: expressions/numerical.py fill_na replaces
+        # None/NaN with the default)
+        return MethodCallExpression(
             "fill_na",
+            (self._expr, smart_coerce(default_value)),
             fun,
             dt.unoptionalize(self._expr._dtype),
-            smart_coerce(default_value),
+            propagate_none=False,
         )
 
 
@@ -216,14 +220,32 @@ class DateTimeNamespace(_Namespace):
     def year(self):
         return self._method("year", lambda d: d.year, dt.INT)
 
-    def timestamp(self, unit="ns"):
-        div = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0}[unit]
+    def timestamp(self, unit=None):
+        """Epoch offset (reference: expressions/date_time.py:384 —
+        float for explicit units; exact int nanoseconds for unit=None,
+        the deprecated legacy default). Computed from exact integer
+        nanoseconds either way: total_seconds() alone loses precision
+        beyond ~104 days."""
+        if unit is not None and unit not in ("ns", "us", "ms", "s"):
+            raise ValueError(
+                f"unit has to be one of 's', 'ms', 'us', 'ns' but is {unit!r}"
+            )
+        div = {None: 1, "ns": 1, "us": 10**3, "ms": 10**6, "s": 10**9}[unit]
 
         def fun(d):
             epoch = _EPOCH_UTC if d.tzinfo is not None else _EPOCH_NAIVE
-            return (d - epoch).total_seconds() / div
+            td = d - epoch
+            ns = (
+                (td.days * 86400 + td.seconds) * 10**9
+                + td.microseconds * 10**3
+            )
+            if unit is None:
+                return ns
+            return ns / div
 
-        return self._method("timestamp", fun, dt.FLOAT)
+        return self._method(
+            "timestamp", fun, dt.INT if unit is None else dt.FLOAT
+        )
 
     def strftime(self, fmt):
         return self._method(

@@ -98,6 +98,15 @@ def rewrite(e: Any, fn: Callable[[ColumnExpression], ColumnExpression | None]) -
             isinstance(v, ColumnExpression) for v in value.values()
         ):
             setattr(new, attr, {k: rewrite(v, fn) for k, v in value.items()})
+    # rebinding children can sharpen their dtypes (pw.this.x is ANY until
+    # the table context resolves it): recompute inferable result dtypes so
+    # int+int comes out INT post-desugar, matching reference inference
+    from pathway_tpu.internals import expression as _expr
+
+    if isinstance(new, _expr.ColumnBinaryOpExpression):
+        new._dtype = _expr._binary_dtype(
+            new._symbol, new._left._dtype, new._right._dtype
+        )
     return new
 
 

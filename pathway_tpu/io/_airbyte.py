@@ -462,11 +462,17 @@ class DeclarativeAirbyteSource:
                     stop, payload, last
                 ):
                     return
-                cursor_token = self._resolve_template(
+                next_token = self._resolve_template(
                     strategy.get("cursor_value"), payload, last
                 )
-                if not cursor_token:
+                if not next_token:
                     return
+                if next_token == cursor_token and not records:
+                    # no stop_condition and the API echoes the same
+                    # cursor with an empty page: terminate rather than
+                    # loop forever
+                    return
+                cursor_token = next_token
             else:
                 return
 

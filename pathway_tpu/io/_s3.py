@@ -269,16 +269,6 @@ class S3Client:
                 raise FileExistsError(key) from e
             raise
 
-    def head_object(self, key: str) -> bool:
-        try:
-            with self._request("HEAD", key) as resp:
-                resp.read()
-            return True
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return False
-            raise
-
     def delete_object(self, key: str) -> None:
         try:
             with self._request("DELETE", key) as resp:

@@ -177,18 +177,35 @@ class _DeltaSubject(ConnectorSubject):
             )
             if data is None:
                 return advanced
-            for line in data.decode().splitlines():
-                line = line.strip()
-                if not line:
-                    continue
-                action = _json.loads(line)
+            actions = [
+                _json.loads(line)
+                for line in data.decode().splitlines()
+                if line.strip()
+            ]
+            # read every referenced part BEFORE emitting any row: a part
+            # not yet visible (eventually-consistent store, torn upload)
+            # must not advance the version — the whole version retries on
+            # the next poll; in static mode a missing part is data loss
+            # and fails loudly
+            parts: dict[str, bytes] = {}
+            for action in actions:
                 add = action.get("add")
                 if add is None:
                     continue
-                part = self.store.read(add["path"])
-                if part is None:
-                    continue  # torn listing: the part lands with the log
-                table = pq.read_table(_io.BytesIO(part))
+                blob = self.store.read(add["path"])
+                if blob is None:
+                    if self.mode == "static":
+                        raise FileNotFoundError(
+                            f"delta part {add['path']!r} referenced by log "
+                            f"version {self._version} is missing"
+                        )
+                    return advanced  # retry this version next refresh
+                parts[add["path"]] = blob
+            for action in actions:
+                add = action.get("add")
+                if add is None:
+                    continue
+                table = pq.read_table(_io.BytesIO(parts[add["path"]]))
                 cols = [
                     table.column(c).to_pylist()
                     if c in table.column_names

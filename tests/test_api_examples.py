@@ -1,0 +1,288 @@
+"""Doctest-style API examples (VERDICT r4 #6): each test is a worked
+example of one public API surface, shaped like the reference's docstring
+examples and tests/test_api.py — runnable documentation that locks the
+user-facing contract."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    cap = GraphRunner().run_tables(table)[0]
+    return sorted(map(tuple, cap.state.rows.values()), key=repr)
+
+
+# ------------------------------------------------------------- debug API
+
+
+def test_compute_and_print(capsys):
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("fruit | n\napple | 3\npear | 5")
+    pw.debug.compute_and_print(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "fruit" in out and "apple" in out and "5" in out
+
+
+def test_table_to_pandas_roundtrip():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a | b\n1 | x\n2 | y")
+    df = pw.debug.table_to_pandas(t)
+    assert sorted(df["a"]) == [1, 2]
+    assert set(df["b"]) == {"x", "y"}
+
+    pw.internals.parse_graph.G.clear()
+    t2 = pw.debug.table_from_pandas(df.reset_index(drop=True))
+    assert sorted(r[0] for r in _rows(t2)) == [1, 2]
+
+
+# ---------------------------------------------------------- table shaping
+
+
+def test_flatten_explodes_sequences():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("who | csv\nann | a,b\nbob | c")
+    parts = t.select(who=pw.this.who, tag=pw.this.csv.str.split(","))
+    flat = parts.flatten(parts.tag)
+    assert _rows(flat) == [("ann", "a"), ("ann", "b"), ("bob", "c")]
+
+
+def test_flatten_string_yields_characters():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("w\nhi")
+    flat = t.flatten(t.w)
+    assert sorted(r[0] for r in _rows(flat)) == ["h", "i"]
+
+
+def test_sort_produces_prev_next_pointers():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("name | score\nann | 30\nbob | 10\ncy | 20")
+    hydrated = t + t.sort(key=pw.this.score)
+    # walk the chain through prev/next pointers
+    rows = {r[0]: r for r in _rows(
+        hydrated.select(
+            name=pw.this.name,
+            prev_name=hydrated.ix(hydrated.prev, optional=True).name,
+            next_name=hydrated.ix(hydrated.next, optional=True).name,
+        )
+    )}
+    assert rows["bob"] == ("bob", None, "cy")
+    assert rows["cy"] == ("cy", "bob", "ann")
+    assert rows["ann"] == ("ann", "cy", None)
+
+
+def test_getitem_projection_forms():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a | b | c\n1 | 2 | 3")
+    two = t[["a", "c"]]
+    assert two.column_names() == ["a", "c"]
+    assert _rows(two) == [(1, 3)]
+    col = t["b"]
+    assert col.name == "b"
+
+
+def test_plus_concats_columns_of_same_universe():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a\n1\n2")
+    u = t.select(b=pw.this.a * 10)
+    both = t + u
+    assert both.column_names() == ["a", "b"]
+    assert _rows(both) == [(1, 10), (2, 20)]
+
+
+def test_copy_and_cast_to_types():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a\n1\n2")
+    c = t.copy()
+    assert c.column_names() == ["a"] and _rows(c) == [(1,), (2,)]
+    if hasattr(t, "cast_to_types"):
+        f = t.cast_to_types(a=float)
+        assert _rows(f) == [(1.0,), (2.0,)]
+
+
+# ------------------------------------------------------------------ joins
+
+
+def test_join_forms_inner_left_right_outer():
+    pw.internals.parse_graph.G.clear()
+    owners = pw.debug.table_from_markdown("owner | pet\nann | dog\nbob | cat")
+    sounds = pw.debug.table_from_markdown(
+        "pet | sound\ndog | woof\nfish | blub"
+    )
+    inner = owners.join(sounds, pw.left.pet == pw.right.pet).select(
+        owner=pw.left.owner, sound=pw.right.sound
+    )
+    assert _rows(inner) == [("ann", "woof")]
+    left = owners.join_left(sounds, pw.left.pet == pw.right.pet).select(
+        owner=pw.left.owner, sound=pw.right.sound
+    )
+    assert _rows(left) == [("ann", "woof"), ("bob", None)]
+    right = owners.join_right(sounds, pw.left.pet == pw.right.pet).select(
+        owner=pw.left.owner, sound=pw.right.sound
+    )
+    assert _rows(right) == [("ann", "woof"), (None, "blub")]
+    outer = owners.join_outer(sounds, pw.left.pet == pw.right.pet).select(
+        owner=pw.left.owner, sound=pw.right.sound
+    )
+    assert _rows(outer) == [
+        ("ann", "woof"), ("bob", None), (None, "blub")
+    ]
+
+
+def test_join_how_keyword():
+    pw.internals.parse_graph.G.clear()
+    a = pw.debug.table_from_markdown("k\n1")
+    b = pw.debug.table_from_markdown("k\n2")
+    out = a.join(b, pw.left.k == pw.right.k, how=pw.JoinMode.OUTER).select(
+        l=pw.left.k, r=pw.right.k
+    )
+    assert _rows(out) == [(1, None), (None, 2)]
+
+
+# ---------------------------------------------------------------- groupby
+
+
+def test_groupby_multiple_keys_and_instance():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown(
+        "dept | role | pay\nsales | jr | 10\nsales | sr | 20\neng | jr | 30"
+    )
+    out = t.groupby(pw.this.dept, pw.this.role).reduce(
+        dept=pw.this.dept, role=pw.this.role, total=pw.reducers.sum(pw.this.pay)
+    )
+    assert _rows(out) == [
+        ("eng", "jr", 30), ("sales", "jr", 10), ("sales", "sr", 20)
+    ]
+
+
+def test_groupby_expression_key():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("v\n1\n2\n3\n4")
+    out = t.groupby(pw.this.v % 2).reduce(
+        parity=pw.this.v % 2, n=pw.reducers.count()
+    )
+    assert _rows(out) == [(0, 2), (1, 2)]
+
+
+def test_argmin_returns_row_pointer_for_ix():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown(
+        "city | temp\nparis | 21\nlima | 12\noslo | 5"
+    )
+    coldest = t.reduce(p=pw.reducers.argmin(pw.this.temp))
+    out = coldest.select(city=t.ix(coldest.p).city)
+    assert _rows(out) == [("oslo",)]
+
+
+def test_reduce_without_groupby_is_global():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("v\n1\n2\n3")
+    out = t.reduce(
+        s=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+        t=pw.reducers.tuple(pw.this.v),
+    )
+    rows = _rows(out)
+    assert len(rows) == 1
+    s, n, tup = rows[0]
+    assert s == 6 and n == 3 and sorted(tup) == [1, 2, 3]
+
+
+# ------------------------------------------------------------------- udfs
+
+
+def test_udf_with_default_arguments():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("v\n1\n2")
+
+    @pw.udf
+    def scale(x: int, factor: int = 10) -> int:
+        return x * factor
+
+    out = t.select(a=scale(pw.this.v), b=scale(pw.this.v, factor=2))
+    assert _rows(out) == [(10, 2), (20, 4)]
+
+
+def test_udf_executor_cache():
+    pw.internals.parse_graph.G.clear()
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+    def expensive(x: int) -> int:
+        calls.append(x)
+        return x + 100
+
+    t = pw.debug.table_from_markdown("v\n5\n5\n5")
+    out = t.select(r=expensive(pw.this.v))
+    assert [r[0] for r in _rows(out)] == [105, 105, 105]
+    assert len(calls) == 1  # cached after the first evaluation
+
+
+# ------------------------------------------------------------------- json
+
+
+def test_json_navigation_and_conversion():
+    pw.internals.parse_graph.G.clear()
+
+    class S(pw.Schema):
+        data: pw.Json
+
+    t = pw.debug.table_from_rows(
+        S,
+        [
+            (1, pw.Json({"user": {"name": "ann", "age": 33}, "tags": ["x"]})),
+        ],
+    )
+    out = t.select(
+        name=pw.this.data["user"]["name"].as_str(),
+        age=pw.this.data["user"]["age"].as_int(),
+        first_tag=pw.this.data["tags"][0].as_str(),
+        missing=pw.this.data.get("nope"),
+    )
+    assert _rows(out) == [("ann", 33, "x", None)]
+
+
+# -------------------------------------------------------------- demo data
+
+
+def test_demo_range_stream_sums():
+    pw.internals.parse_graph.G.clear()
+    t = pw.demo.range_stream(nb_rows=5)
+    total = t.reduce(s=pw.reducers.sum(pw.this.value))
+    events = []
+    pw.io.subscribe(
+        total, on_change=lambda key, row, time, diff: events.append(
+            (row["s"], diff)
+        )
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    live = [s for s, d in events if d > 0]
+    assert live[-1] == 0 + 1 + 2 + 3 + 4
+
+
+# -------------------------------------------------------------- iterate
+
+
+def test_iterate_collatz_fixpoint():
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("n\n6\n7\n1")
+
+    def collatz_step(t):
+        next_n = pw.if_else(
+            pw.this.n == 1,
+            pw.this.n,
+            pw.if_else(
+                pw.this.n % 2 == 0,
+                pw.this.n // 2,
+                3 * pw.this.n + 1,
+            ),
+        )
+        return t.select(n=next_n)
+
+    result = pw.iterate(collatz_step, t=t)
+    # every chain reaches the 1 fixpoint (reference: docs' collatz example)
+    out = result if isinstance(result, pw.Table) else result.t
+    assert _rows(out) == [(1,), (1,), (1,)]

@@ -149,14 +149,6 @@ class _MockS3Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
-    def do_HEAD(self):
-        if self._key() in self.store:
-            self.send_response(200)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-        else:
-            self.send_error(404)
-
     def do_DELETE(self):
         self.store.pop(self._key(), None)
         self.send_response(204)
@@ -679,5 +671,3 @@ def test_s3_conditional_put_exclusive(mock_s3):
     with _pytest.raises(FileExistsError):
         c.put_object_if_absent("lock/v1", b"b")
     assert handler.store["lock/v1"] == b"a"
-    assert c.head_object("lock/v1") is True
-    assert c.head_object("lock/v2") is False
