@@ -376,6 +376,122 @@ class GroupDiffNode(Node):
         return consolidate(after + negate(before))
 
 
+class CheckedReindexNode(GroupDiffNode):
+    """Re-key with duplicate detection (reference: reindex/with_id_from —
+    test_errors.py:684 pins that a key claimed by several distinct source
+    rows yields ONE row of ERROR cells plus a 'duplicated entries for
+    key' warning, instead of silently stacking a multiset under the id).
+    Plain ReindexNode (no per-key state) remains for internal rekeys
+    where duplicates are legal (having/join projections)."""
+
+    STATE_ATTRS = ("groups", "_warned")
+
+    def __init__(self, scope, input_node, key_fn, width: int):
+        super().__init__(scope, [input_node])
+        self.key_fn = key_fn
+        self.width = width
+        # new_key -> {frozen_row: [row, count]}
+        self.groups: dict = {}
+        self._warned: set = set()
+
+    def group_of(self, port, key, row):
+        return self.key_fn(key, row)
+
+    def apply_updates(self, batches) -> None:
+        for k, row, d in batches[0]:
+            nk = self.key_fn(k, row)
+            slots = self.groups.setdefault(nk, {})
+            fr = freeze_row(row)
+            slot = slots.get(fr)
+            if slot is None:
+                slot = slots[fr] = [row, 0]
+            slot[1] += d
+            if slot[1] == 0:
+                del slots[fr]
+            if not slots:
+                del self.groups[nk]
+
+    def output_of_group(self, nk) -> list[Delta]:
+        slots = self.groups.get(nk)
+        if not slots:
+            return []
+        live = [s for s in slots.values() if s[1] != 0]
+        total = sum(s[1] for s in live)
+        if total <= 0:
+            return []
+        if len(live) == 1 and total == 1:
+            return [(nk, live[0][0], 1)]
+        if nk not in self._warned:
+            self._warned.add(nk)
+            import warnings
+
+            warnings.warn(f"duplicated entries for key {nk!r}")
+            self.scope.runtime.log_data_error(
+                f"duplicated entries for key {nk!r}", nk
+            )
+        return [(nk, (ERROR,) * self.width, 1)]
+
+
+class ReuniverseNode(GroupDiffNode):
+    """with_universe_of with the reference's runtime checks
+    (test_errors.py:573): output rows live on OTHER's key set — keys of
+    other missing in self become ERROR rows ('key missing in input
+    table'), keys of self missing in other are dropped ('key missing in
+    output table'); both are logged. Valid promises pass through
+    unchanged."""
+
+    STATE_ATTRS = ("rows", "other_counts")
+
+    def __init__(self, scope, self_node, other_node, width: int):
+        super().__init__(scope, [self_node, other_node])
+        self.width = width
+        self.rows: dict = {}          # key -> [row, count] from self
+        self.other_counts: dict = {}  # key -> count from other
+
+    def group_of(self, port, key, row):
+        return key
+
+    def apply_updates(self, batches) -> None:
+        for k, row, d in batches[0]:
+            slot = self.rows.get(k)
+            if slot is None:
+                slot = self.rows[k] = [row, 0]
+            if d > 0:
+                # only additions carry the current row: an in-batch
+                # update arrives (add new, retract old) and the retract
+                # must not clobber the fresh row (TableState.apply's
+                # any-order defense, stream.py:136)
+                slot[0] = row
+            slot[1] += d
+            if slot[1] == 0:
+                del self.rows[k]
+        for k, _row, d in batches[1]:
+            c = self.other_counts.get(k, 0) + d
+            if c == 0:
+                self.other_counts.pop(k, None)
+            else:
+                self.other_counts[k] = c
+
+    def output_of_group(self, k) -> list[Delta]:
+        # runtime.log_data_error dedups on (key, message): safe to call
+        # on every rediff of an unhealed mismatch
+        in_other = self.other_counts.get(k, 0) > 0
+        slot = self.rows.get(k)
+        in_self = slot is not None and slot[1] > 0
+        if in_other and in_self:
+            return [(k, slot[0], 1)]
+        if in_other:
+            self.scope.runtime.log_data_error(
+                f"key missing in input table: {k!r}", k
+            )
+            return [(k, (ERROR,) * self.width, 1)]
+        if in_self:
+            self.scope.runtime.log_data_error(
+                f"key missing in output table: {k!r}", k
+            )
+        return []
+
+
 _JOIN_TYPE_CODES = {"inner": 0, "left": 1, "right": 2, "outer": 3}
 
 

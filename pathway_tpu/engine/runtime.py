@@ -880,9 +880,15 @@ class Runtime:
         if len(self._error_log_seen) < 100_000:
             self._error_log_seen.add(ident)
         from pathway_tpu.internals.api import ref_scalar
+        from pathway_tpu.internals.config import get_pathway_config
 
         self._error_log_seq += 1
-        row_key = ref_scalar("error_log", self._error_log_seq)
+        # rank-qualified key: every rank mints seq 1, 2, ... — without the
+        # rank the gathered entries collide and overwrite each other
+        row_key = ref_scalar(
+            "error_log", get_pathway_config().process_id,
+            self._error_log_seq,
+        )
         deltas = [(row_key, (message, repr(key)), 1)]
         # deliver at the next timestamp so the erroring batch finishes first
         t = self.clock + 1

@@ -105,6 +105,27 @@ class Scope:
     def reindex(self, table: EngineTable, key_fn) -> EngineTable:
         return EngineTable(N.ReindexNode(self, table.node, key_fn), table.width)
 
+    def reindex_checked(self, table: EngineTable, key_fn) -> EngineTable:
+        """Re-key with duplicate detection (user-facing with_id_from /
+        with_id; reference pins ERROR rows + warning on key conflicts).
+        Rows exchange by the NEW key first so cross-rank duplicates
+        co-locate on one rank's detector."""
+        table = self._exchange(table, self._rowwise_key(key_fn))
+        return EngineTable(
+            N.CheckedReindexNode(self, table.node, key_fn, table.width),
+            table.width,
+        )
+
+    def reuniverse(self, table: EngineTable, other: EngineTable) -> EngineTable:
+        """with_universe_of with runtime promise checks (missing keys
+        become ERROR rows / drops, both logged)."""
+        table = self._exchange_by_id(table)
+        other = self._exchange_by_id(other)
+        return EngineTable(
+            N.ReuniverseNode(self, table.node, other.node, table.width),
+            table.width,
+        )
+
     def flatten(self, table: EngineTable, idx: int) -> EngineTable:
         return EngineTable(N.FlattenNode(self, table.node, idx), table.width)
 

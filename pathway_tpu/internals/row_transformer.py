@@ -145,7 +145,7 @@ def transformer(cls):
                 pcols = input_tables[pname].column_names()
                 layout.append((pname, pcols))
                 if pname != tname:
-                    ptable = ptable.with_universe_of(base)
+                    ptable = ptable._unsafe_promise_universe(base)
                 all_packed_cols.append(ptable["_pw_packed_ids"])
                 all_packed_cols.extend(ptable[c] for c in pcols)
 
@@ -191,9 +191,9 @@ def transformer(cls):
                 sel[n] = GetExpression(flat.rows, i + 1)
             result = flat.select(**sel)
             result = (
-                result.with_id(result["_pw_row_id"])
+                result._with_id_unchecked(result["_pw_row_id"])
                 .without("_pw_row_id")
-                .with_universe_of(table)
+                ._unsafe_promise_universe(table)
             )
             outputs[tname] = result
 

@@ -325,16 +325,39 @@ class Table(TableLike):
         return out
 
     def with_universe_of(self, other: TableLike) -> "Table":
+        """Reindex onto ``other``'s key set, with the reference's runtime
+        checks (test_errors.py:573): keys of other missing here become
+        ERROR rows and keys here missing in other are dropped — both
+        logged to the global error log. A valid promise passes through
+        unchanged."""
+        out = Table(self._schema_cls, other._universe)
+        self_ = self
+
+        def lower(ctx):
+            ctx.set_engine_table(
+                out,
+                ctx.scope.reuniverse(
+                    ctx.engine_table(self_), ctx.engine_table(other)
+                ),
+            )
+
+        G.add_operator([self, other], [out], lower, "with_universe_of")
+        return out
+
+    def _unsafe_promise_universe(self, other: TableLike) -> "Table":
+        """Check-free universe relabel: the caller GUARANTEES the key
+        sets match (the reference's unsafe variant). No state, no
+        runtime verification — internal callers whose universes are
+        equal by construction use this; user code should prefer
+        with_universe_of."""
         out = Table(self._schema_cls, other._universe)
         self_ = self
 
         def lower(ctx):
             ctx.set_engine_table(out, ctx.engine_table(self_))
 
-        G.add_operator([self], [out], lower, "with_universe_of")
+        G.add_operator([self], [out], lower, "promise_universe")
         return out
-
-    _unsafe_promise_universe = with_universe_of
 
     # -- groupby / reduce --------------------------------------------------
     def groupby(self, *args, id=None, instance=None, sort_by=None, **kwargs):
@@ -600,7 +623,7 @@ class Table(TableLike):
 
         def lower(ctx):
             et, fn = ctx.row_fn(self_, exprs)
-            reindexed = ctx.scope.reindex(
+            reindexed = ctx.scope.reindex_checked(
                 et, lambda k, row, f=fn: ref_scalar(*f(k, row))
             )
             if reindexed.width != width:
@@ -613,6 +636,15 @@ class Table(TableLike):
         return out
 
     def with_id(self, new_index: ColumnReference) -> "Table":
+        return self._with_id_impl(new_index, checked=True)
+
+    def _with_id_unchecked(self, new_index: ColumnReference) -> "Table":
+        """Check-free rekey for internal callers whose keys are unique by
+        construction (round-tripped row ids): skips CheckedReindexNode's
+        per-key row state."""
+        return self._with_id_impl(new_index, checked=False)
+
+    def _with_id_impl(self, new_index: ColumnReference, checked: bool) -> "Table":
         e = self._desugar(new_index)
         out = Table(self._schema_cls, Universe())
         self_ = self
@@ -620,9 +652,10 @@ class Table(TableLike):
 
         def lower(ctx):
             et, fn = ctx.row_fn(self_, [e])
-            reindexed = ctx.scope.reindex(
-                et, lambda k, row, f=fn: f(k, row)[0]
+            rekey = (
+                ctx.scope.reindex_checked if checked else ctx.scope.reindex
             )
+            reindexed = rekey(et, lambda k, row, f=fn: f(k, row)[0])
             if reindexed.width != width:
                 reindexed = ctx.scope.rowwise(
                     reindexed, lambda keys, rows: [r[:width] for r in rows], width

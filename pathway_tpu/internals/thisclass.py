@@ -107,6 +107,10 @@ def rewrite(e: Any, fn: Callable[[ColumnExpression], ColumnExpression | None]) -
         new._dtype = _expr._binary_dtype(
             new._symbol, new._left._dtype, new._right._dtype
         )
+    elif isinstance(new, _expr.ColumnUnaryOpExpression):
+        new._dtype = (
+            _expr.dt.BOOL if new._symbol == "~" else new._expr._dtype
+        )
     return new
 
 

@@ -467,10 +467,10 @@ class DeclarativeAirbyteSource:
                 )
                 if not next_token:
                     return
-                if next_token == cursor_token and not records:
-                    # no stop_condition and the API echoes the same
-                    # cursor with an empty page: terminate rather than
-                    # loop forever
+                if next_token == cursor_token:
+                    # an unchanged cursor re-issues the identical request
+                    # (same response forever): terminate rather than loop,
+                    # whether or not the page carried records
                     return
                 cursor_token = next_token
             else:

@@ -89,8 +89,8 @@ def interpolate(table, timestamp, *values, mode=InterpolateMode.LINEAR):
         out_cols[n] = expr_mod.GetExpression(flat.rows, j + 1)
     result = flat.select(**out_cols)
     result = (
-        result.with_id(result["_pw_row_id"])
+        result._with_id_unchecked(result["_pw_row_id"])
         .without("_pw_row_id")
-        .with_universe_of(table)
+        ._unsafe_promise_universe(table)
     )
     return table.with_columns(**{n: result[n] for n in value_names})

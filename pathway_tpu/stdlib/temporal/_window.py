@@ -182,7 +182,7 @@ class _SessionWindow(Window):
                 _pw_window=data.ix(data["_pw_window"])["_pw_window"]
             )
 
-        return iterate(merge_ccs, data=target).with_universe_of(table)
+        return iterate(merge_ccs, data=target)._unsafe_promise_universe(table)
 
     def _apply(self, table, key, behavior, instance):
         group_repr = self._compute_group_repr(table, key, instance)

@@ -85,12 +85,12 @@ def pandas_transformer(output_schema: type[Schema], output_universe: Any = None)
                     else output_universe
                 )
                 result = (
-                    result.with_id(result["_pw_idx"])
+                    result._with_id_unchecked(result["_pw_idx"])
                     .without("_pw_idx")
-                    .with_universe_of(target)
+                    ._unsafe_promise_universe(target)
                 )
             else:
-                result = result.with_id(
+                result = result._with_id_unchecked(
                     result.pointer_from(result["_pw_idx"])
                 ).without("_pw_idx")
             return result

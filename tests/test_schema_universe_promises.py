@@ -70,16 +70,23 @@ def test_with_universe_of_same_keys_relabel():
     assert _rows(joined) == [(10, 5), (20, 6)]
 
 
-def test_with_universe_of_mismatch_is_callers_promise():
-    """KNOWN DIVERGENCE (recorded in PARITY.md): the reference pads
-    missing keys with ERROR rows and logs 'key missing in output table'
-    (test_errors.py:573); this build trusts the caller's promise and
-    keeps the source rows — pinned here so a future runtime check is a
-    deliberate change."""
+def test_with_universe_of_mismatch_pads_and_logs():
+    """Reference parity (test_errors.py:573): keys of `other` missing in
+    self become ERROR rows ('key missing in input table'), keys of self
+    missing in other are dropped ('key missing in output table'); both
+    logged."""
     a = _keyed("k | v\n1 | 10\n2 | 20")
-    c = pw.debug.table_from_markdown("k | w\n3 | 5").with_id_from(pw.this.k)
+    c = pw.debug.table_from_markdown("k | w\n2 | 5\n3 | 6").with_id_from(
+        pw.this.k
+    )
     out = a.with_universe_of(c)
-    assert _rows(out) == [(1, 10), (2, 20)]
+    log = pw.global_error_log()
+    caps = GraphRunner().run_tables(out, log)
+    rows = sorted(map(tuple, caps[0].state.rows.values()), key=repr)
+    assert rows == [(2, 20), (ERROR, ERROR)]  # key 2 kept, 3 padded
+    msgs = sorted(r[0] for r in caps[1].state.rows.values())
+    assert any("missing in input" in m for m in msgs)
+    assert any("missing in output" in m for m in msgs)
 
 
 def test_update_cells_patches_matching_keys():
@@ -120,16 +127,18 @@ def test_concat_disjoint_and_reindex():
     assert sorted(r[1] for r in _rows(out)) == [10, 99]
 
 
-def test_with_id_from_last_write_wins_on_duplicates():
-    """KNOWN DIVERGENCE (recorded in PARITY.md): the reference keeps the
-    duplicate-keyed row with ERROR cells and warns (test_errors.py:684);
-    this build keeps the duplicate as a multiset under one key, and
-    captures resolve to the last row."""
+def test_with_id_from_duplicate_keys_error_and_warn():
+    """Reference parity (test_errors.py:684): a key claimed by several
+    distinct rows yields ONE row of ERROR cells plus a 'duplicated
+    entries' warning; unique keys pass through untouched."""
     pw.internals.parse_graph.G.clear()
-    t = pw.debug.table_from_markdown("k | v\n1 | 10\n1 | 20")
+    t = pw.debug.table_from_markdown("k | v\n1 | 10\n1 | 20\n2 | 30")
     out = t.with_id_from(pw.this.k)
-    got = _rows(out)
-    assert len(got) == 1 and got[0][0] == 1
+    with pytest.warns(UserWarning, match="duplicated entries"):
+        got = _rows(out)
+    assert (2, 30) in got
+    assert (ERROR, ERROR) in got
+    assert len(got) == 2
 
 
 def test_ix_strict_and_optional():
@@ -273,3 +282,40 @@ def test_pointer_from_is_deterministic_and_distinct():
         assert p1 == p2      # same inputs -> same pointer
         assert p1 != q       # different arity -> different pointer
     assert rows[0][1] != rows[1][1]  # different keys -> different pointers
+
+
+def test_with_universe_of_tracks_in_batch_updates():
+    """Review regression (r4): an upstream rediff emits (add new,
+    retract old) in ONE batch; the reuniverse state must keep the NEW
+    row — a retraction arriving after the addition must not clobber
+    it."""
+    pw.internals.parse_graph.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        g: int
+        v: int
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k=1, g=1, v=10)
+            self.next(k=2, g=2, v=5)
+            self.commit()
+            self.next(k=1, g=1, v=32)  # pk upsert: groupby rediffs g=1
+            self.commit()
+
+    t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=None)
+    agg = t.groupby(pw.this.g).reduce(g=pw.this.g, s=pw.reducers.sum(pw.this.v))
+    anchor = t.groupby(pw.this.g).reduce(g=pw.this.g)
+    relabeled = agg.with_universe_of(anchor)
+    final = {}
+
+    def on_change(key, row, time, diff):
+        if diff > 0:
+            final[key] = (row["g"], row["s"])
+        elif final.get(key) == (row["g"], row["s"]):
+            del final[key]
+
+    pw.io.subscribe(relabeled, on_change=on_change)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert sorted(final.values()) == [(1, 32), (2, 5)], final
