@@ -894,6 +894,65 @@ class Table(TableLike):
     def slice(self):
         return _TableSlice(self)
 
+    @property
+    def C(self) -> "_TableSlice":
+        """Column accessor namespace (reference: Joinable.C — reach
+        columns whose names collide with Table methods: ``t.C.select``)."""
+        return _TableSlice(self)
+
+    # -- reference surface conveniences -----------------------------------
+    def debug(self, name: str) -> "Table":
+        """Print this table's change stream during the run, prefixed with
+        ``name`` (reference: Table.debug / DebugOperator)."""
+        from pathway_tpu.io import subscribe as _subscribe
+
+        cols = self.column_names()
+
+        def on_change(key, row, time, diff):
+            sign = "+" if diff > 0 else "-"
+            vals = ", ".join(f"{c}={row.get(c)!r}" for c in cols)
+            print(f"[debug:{name}] {sign} {key!r} {vals} @ {time}")
+
+        _subscribe(self, on_change=on_change)
+        return self
+
+    def eval_type(self, expression) -> Any:
+        """Resolved dtype of ``expression`` against this table (reference:
+        Table.eval_type)."""
+        return self._desugar(expr_mod.smart_coerce(expression))._dtype
+
+    def live(self):
+        """Interactive live view of this table (reference: Table.live —
+        here a LiveTableHandle; pw.enable_interactive_mode first)."""
+        from pathway_tpu.internals.interactive import live as _live
+
+        return _live(self)
+
+    def remove_errors(self) -> "Table":
+        """Drop rows containing ERROR values (method form of
+        pw.remove_errors_from_table; reference: Table.remove_errors)."""
+        from pathway_tpu.internals.error_log import remove_errors_from_table
+
+        return remove_errors_from_table(self)
+
+    def to(self, sink) -> None:
+        """Send this table to a sink (reference: Table.to(DataSink)).
+        Accepts any callable sink factory: ``t.to(lambda tb: pw.io.csv.
+        write(tb, path))`` or a writer partial."""
+        if callable(sink):
+            sink(self)
+            return
+        raise TypeError(
+            "Table.to expects a callable sink (e.g. a pw.io.*.write "
+            "partial); got " + type(sink).__name__
+        )
+
+    def update_id_type(self, id_type, *, id_append_only=None) -> "Table":
+        """Annotate the id column's Pointer type (reference:
+        Table.update_id_type). Ids here are untyped 128-bit Pointers, so
+        this is a schema-level annotation pass-through."""
+        return self.copy()
+
 
 class _TableSlice:
     def __init__(self, table: Table):

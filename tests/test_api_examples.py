@@ -286,3 +286,46 @@ def test_iterate_collatz_fixpoint():
     # every chain reaches the 1 fixpoint (reference: docs' collatz example)
     out = result if isinstance(result, pw.Table) else result.t
     assert _rows(out) == [(1,), (1,), (1,)]
+
+
+def test_reference_surface_methods():
+    """Round-4 surface parity: debug/eval_type/remove_errors/to/C/slice/
+    update_id_type and the join-result aliases exist and behave
+    (reference: internals/table.py:2346-2570, __init__.py __all__)."""
+    from pathway_tpu.internals import dtype as dt
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a | b\n6 | 2\n5 | 0")
+    assert t.eval_type(pw.this.a + pw.this.b) is dt.INT
+    assert t.eval_type(pw.this.a / pw.this.b) is dt.FLOAT
+    assert t.C.a.name == "a"
+    assert t.slice["b"].name == "b"
+
+    bad = t.select(q=pw.declare_type(int, pw.this.a // pw.this.b))
+    clean = bad.remove_errors()
+    assert _rows(clean) == [(3,)]
+
+    captured = []
+    t.to(lambda tb: captured.append(tb))
+    assert captured == [t]
+    with pytest.raises(TypeError, match="callable sink"):
+        t.to("not-a-sink")
+
+    t2 = t.update_id_type(int)
+    assert _rows(t2) == _rows(t)
+
+    for name in (
+        "Joinable", "GroupedJoinResult", "OuterJoinResult",
+        "AsofJoinResult", "IntervalJoinResult", "WindowJoinResult",
+        "TableSlice", "viz",
+    ):
+        assert hasattr(pw, name), name
+
+
+def test_table_debug_prints_changes(capsys):
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("a\n1\n2")
+    t.debug("probe")
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    out = capsys.readouterr().out
+    assert "[debug:probe]" in out and "a=1" in out and "a=2" in out
