@@ -895,10 +895,12 @@ class Table(TableLike):
         return _TableSlice(self)
 
     @property
-    def C(self) -> "_TableSlice":
+    def C(self) -> "_ColumnNamespace":
         """Column accessor namespace (reference: Joinable.C — reach
-        columns whose names collide with Table methods: ``t.C.select``)."""
-        return _TableSlice(self)
+        columns whose names collide with Table methods: ``t.C.select``).
+        Unlike ``slice``, carries no helper methods at all, so even
+        columns named ``keys``/``without`` resolve."""
+        return _ColumnNamespace(self)
 
     # -- reference surface conveniences -----------------------------------
     def debug(self, name: str) -> "Table":
@@ -921,12 +923,14 @@ class Table(TableLike):
         Table.eval_type)."""
         return self._desugar(expr_mod.smart_coerce(expression))._dtype
 
-    def live(self):
+    def live(self, name: str | None = None):
         """Interactive live view of this table (reference: Table.live —
-        here a LiveTableHandle; pw.enable_interactive_mode first)."""
+        here a LiveTableHandle; pw.enable_interactive_mode first).
+        ``name=`` pins a stable identity so the handle re-attaches to the
+        same logical table across REPL reruns."""
         from pathway_tpu.internals.interactive import live as _live
 
-        return _live(self)
+        return _live(self, name=name)
 
     def remove_errors(self) -> "Table":
         """Drop rows containing ERROR values (method form of
@@ -954,22 +958,39 @@ class Table(TableLike):
         return self.copy()
 
 
-class _TableSlice:
+class _ColumnNamespace:
+    """Pure column accessor (Table.C): NOTHING but column resolution, so
+    columns named like helper methods (``keys``, ``without``) still
+    resolve — the collision case C exists to solve."""
+
+    __slots__ = ("_ns_table",)
+
     def __init__(self, table: Table):
-        self._table = table
+        object.__setattr__(self, "_ns_table", table)
 
     def __getattr__(self, name):
-        return self._table[name]
+        try:
+            return self._ns_table[name]
+        except KeyError:
+            # AttributeError keeps hasattr/getattr-with-default protocols
+            # (and introspection machinery probing dunders) working
+            raise AttributeError(name) from None
 
     def __getitem__(self, name):
-        return self._table[name]
+        return self._ns_table[name]
 
+
+class _TableSlice(_ColumnNamespace):
     def without(self, *cols):
         names = {c if isinstance(c, str) else c.name for c in cols}
-        return [self._table[c] for c in self._table._column_names if c not in names]
+        return [
+            self._ns_table[c]
+            for c in self._ns_table._column_names
+            if c not in names
+        ]
 
     def keys(self):
-        return self._table.column_names()
+        return self._ns_table.column_names()
 
 
 def _origin_table(e: ColumnExpression) -> Table:

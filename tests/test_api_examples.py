@@ -329,3 +329,19 @@ def test_table_debug_prints_changes(capsys):
     pw.run(monitoring_level=pw.MonitoringLevel.NONE)
     out = capsys.readouterr().out
     assert "[debug:probe]" in out and "a=1" in out and "a=2" in out
+
+
+def test_C_namespace_resolves_colliding_names():
+    """Review regression (r4): t.C must resolve columns named like
+    helper methods (keys/without/select) and follow attribute
+    protocols (hasattr False for unknown names, not KeyError)."""
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_markdown("keys | without\n1 | 2")
+    assert t.C.keys.name == "keys"
+    assert t.C.without.name == "without"
+    out = t.select(a=t.C.keys + t.C.without)
+    assert _rows(out) == [(3,)]
+    assert not hasattr(t.C, "nope")
+    assert getattr(t.C, "nope", None) is None
+    # slice keeps its helpers
+    assert t.slice.keys() == ["keys", "without"]
