@@ -15,6 +15,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <math.h>
 #include <stdint.h>
 #include <string.h>
 
@@ -1069,6 +1070,267 @@ fail:
     return NULL;
 }
 
+/* -- binop(left, right, code, error_obj, op) -----------------------------
+ * Column-wise binary operator: the expression plane's hot loop. Numeric
+ * elements (bool/int64/float) compute in C with EXACT Python semantics
+ * (floor division/modulo sign rules, int/float promotion, overflow to
+ * Python bigints via per-element fallback). Every non-fast element —
+ * strings, None, big ints, division by zero — falls back to calling the
+ * REAL Python operator on that element, so behavior (including the
+ * exception messages the error log records) is identical to the Python
+ * loop by construction. ERROR in either operand is absorbing.
+ *
+ * Returns (out_list, errs) where errs is [(i, message), ...] for
+ * elements whose operator raised (their out slot is error_obj).
+ *
+ * Op codes: 0:+ 1:- 2:* 3:/ 4:// 5:% 6:< 7:<= 8:> 9:>= 10:== 11:!=
+ *           12:& 13:| 14:^
+ */
+
+enum { B_ADD, B_SUB, B_MUL, B_DIV, B_FDIV, B_MOD, B_LT, B_LE, B_GT,
+       B_GE, B_EQ, B_NE, B_AND, B_OR, B_XOR };
+
+/* tagged numeric view of a cell: 0=not numeric, 1=int(i64), 2=float,
+ * 3=bool (int value in i) */
+static inline int
+num_view(PyObject *v, int64_t *i, double *f)
+{
+    /* CheckExact: int/float SUBCLASSES (np.float64, user types with
+     * overridden operators) must take the python fallback so their
+     * overrides and result types are honored */
+    if (v == Py_True) { *i = 1; return 3; }
+    if (v == Py_False) { *i = 0; return 3; }
+    if (PyFloat_CheckExact(v)) { *f = PyFloat_AS_DOUBLE(v); return 2; }
+    if (PyLong_CheckExact(v)) {
+        int ovf = 0;
+        *i = PyLong_AsLongLongAndOverflow(v, &ovf);
+        if (ovf)
+            return 0; /* bigint: python fallback */
+        return 1;
+    }
+    return 0;
+}
+
+static PyObject *
+fast_binop(PyObject *self, PyObject *args)
+{
+    PyObject *left, *right, *error_obj, *op;
+    int code;
+    if (!PyArg_ParseTuple(args, "OOiOO", &left, &right, &code, &error_obj,
+                          &op))
+        return NULL;
+    if (!PyList_Check(left) || !PyList_Check(right) ||
+        PyList_GET_SIZE(left) != PyList_GET_SIZE(right)) {
+        PyErr_SetString(PyExc_TypeError, "binop expects two equal lists");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(left);
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    PyObject *errs = PyList_New(0);
+    if (errs == NULL) {
+        Py_DECREF(out);
+        return NULL;
+    }
+    for (Py_ssize_t idx = 0; idx < n; idx++) {
+        PyObject *a = PyList_GET_ITEM(left, idx);
+        PyObject *b = PyList_GET_ITEM(right, idx);
+        if (a == error_obj || b == error_obj) {
+            Py_INCREF(error_obj);
+            PyList_SET_ITEM(out, idx, error_obj);
+            continue;
+        }
+        int64_t ai = 0, bi = 0;
+        double af = 0.0, bf = 0.0;
+        int ta = num_view(a, &ai, &af);
+        int tb = num_view(b, &bi, &bf);
+        PyObject *r = NULL;
+        if (ta != 0 && tb != 0) {
+            const int both_int = ta != 2 && tb != 2;
+            if (code >= B_LT && code <= B_NE) {
+                /* comparisons: exact across int/float via long double
+                 * (x86-64: 64-bit mantissa covers every int64) */
+                long double x = ta == 2 ? (long double)af : (long double)ai;
+                long double y = tb == 2 ? (long double)bf : (long double)bi;
+                int cres =
+                    code == B_LT   ? x < y
+                    : code == B_LE ? x <= y
+                    : code == B_GT ? x > y
+                    : code == B_GE ? x >= y
+                    : code == B_EQ ? x == y
+                                   : x != y;
+                r = cres ? Py_True : Py_False;
+                Py_INCREF(r);
+            } else if (code >= B_AND && code <= B_XOR) {
+                if (ta == 3 && tb == 3) {
+                    int cres = code == B_AND   ? (ai && bi)
+                               : code == B_OR  ? (ai || bi)
+                                               : (ai != bi);
+                    r = cres ? Py_True : Py_False;
+                    Py_INCREF(r);
+                } else if (both_int) {
+                    int64_t cres = code == B_AND  ? (ai & bi)
+                                   : code == B_OR ? (ai | bi)
+                                                  : (ai ^ bi);
+                    r = PyLong_FromLongLong(cres);
+                } /* float operand: python fallback (TypeError) */
+            } else if (both_int) {
+                int64_t cres = 0;
+                int ok = 1;
+                switch (code) {
+                case B_ADD:
+                    ok = !__builtin_add_overflow(ai, bi, &cres);
+                    break;
+                case B_SUB:
+                    ok = !__builtin_sub_overflow(ai, bi, &cres);
+                    break;
+                case B_MUL:
+                    ok = !__builtin_mul_overflow(ai, bi, &cres);
+                    break;
+                case B_DIV:
+                    /* (double)a/(double)b is correctly rounded only when
+                     * both operands are exact in double; CPython's
+                     * long_true_divide is correctly rounded for ANY ints,
+                     * so larger operands take the fallback (1-ulp parity,
+                     * review r4) */
+                    if (bi == 0 || ai > (int64_t)1 << 53 ||
+                        ai < -((int64_t)1 << 53) ||
+                        bi > (int64_t)1 << 53 || bi < -((int64_t)1 << 53))
+                        ok = 0;
+                    else
+                        r = PyFloat_FromDouble((double)ai / (double)bi);
+                    break;
+                case B_FDIV:
+                    if (bi == 0 || (ai == INT64_MIN && bi == -1)) {
+                        ok = 0;
+                    } else {
+                        /* Python floor semantics for negatives */
+                        cres = ai / bi;
+                        if ((ai % bi != 0) && ((ai < 0) != (bi < 0)))
+                            cres -= 1;
+                    }
+                    break;
+                case B_MOD:
+                    if (bi == 0 || (ai == INT64_MIN && bi == -1)) {
+                        ok = 0;
+                    } else {
+                        /* result sign follows the divisor */
+                        cres = ai % bi;
+                        if (cres != 0 && ((cres < 0) != (bi < 0)))
+                            cres += bi;
+                    }
+                    break;
+                }
+                if (r == NULL && ok)
+                    r = PyLong_FromLongLong(cres);
+                else if (!ok)
+                    r = NULL; /* overflow / div-zero: python fallback */
+            } else {
+                /* at least one float: promote */
+                double x = ta == 2 ? af : (double)ai;
+                double y = tb == 2 ? bf : (double)bi;
+                switch (code) {
+                case B_ADD:
+                    r = PyFloat_FromDouble(x + y);
+                    break;
+                case B_SUB:
+                    r = PyFloat_FromDouble(x - y);
+                    break;
+                case B_MUL:
+                    r = PyFloat_FromDouble(x * y);
+                    break;
+                case B_DIV:
+                    if (y != 0.0)
+                        r = PyFloat_FromDouble(x / y);
+                    break; /* /0.0 raises in Python: fallback */
+                case B_FDIV:
+                    /* CPython float floor-division is fmod-based, not
+                     * floor(x/y) — underflow/rounding-boundary cases
+                     * diverge (review r4): mirror float_divmod exactly,
+                     * including the half-way correction */
+                    if (y != 0.0) {
+                        double m = fmod(x, y);
+                        double d = (x - m) / y;
+                        if (m != 0.0) {
+                            if ((y < 0.0) != (m < 0.0))
+                                d -= 1.0;
+                        }
+                        if (d != 0.0) {
+                            double fd = floor(d);
+                            if (d - fd > 0.5)
+                                fd += 1.0;
+                            d = fd;
+                        } else {
+                            d = copysign(0.0, x / y);
+                        }
+                        r = PyFloat_FromDouble(d);
+                    }
+                    break;
+                case B_MOD:
+                    if (y != 0.0) {
+                        /* CPython float_rem: zero results take the
+                         * divisor's sign (fmod's -0.0 diverges) */
+                        double m = fmod(x, y);
+                        if (m != 0.0) {
+                            if ((y < 0.0) != (m < 0.0))
+                                m += y;
+                        } else {
+                            m = copysign(0.0, y);
+                        }
+                        r = PyFloat_FromDouble(m);
+                    }
+                    break;
+                }
+            }
+        }
+        if (r == NULL && !PyErr_Occurred()) {
+            /* python fallback: the REAL operator on this element —
+             * strings, None, bigints, div-by-zero all behave (and
+             * raise) exactly like the interpreted loop */
+            r = PyObject_CallFunctionObjArgs(op, a, b, NULL);
+            if (r == NULL) {
+                /* BaseExceptions (KeyboardInterrupt, SystemExit) must
+                 * abort the run, not become ERROR cells */
+                if (!PyErr_ExceptionMatches(PyExc_Exception)) {
+                    Py_DECREF(out);
+                    Py_DECREF(errs);
+                    return NULL;
+                }
+                PyObject *etype, *evalue, *etb;
+                PyErr_Fetch(&etype, &evalue, &etb);
+                PyObject *msg =
+                    evalue ? PyObject_Str(evalue) : PyUnicode_FromString("");
+                Py_XDECREF(etype);
+                Py_XDECREF(evalue);
+                Py_XDECREF(etb);
+                if (msg == NULL) {
+                    Py_DECREF(out);
+                    Py_DECREF(errs);
+                    return NULL;
+                }
+                PyObject *pair = Py_BuildValue("(nN)", idx, msg);
+                if (pair == NULL || PyList_Append(errs, pair) < 0) {
+                    Py_XDECREF(pair);
+                    Py_DECREF(out);
+                    Py_DECREF(errs);
+                    return NULL;
+                }
+                Py_DECREF(pair);
+                Py_INCREF(error_obj);
+                r = error_obj;
+            }
+        }
+        if (r == NULL) {
+            Py_DECREF(out);
+            Py_DECREF(errs);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, idx, r);
+    }
+    return Py_BuildValue("(NN)", out, errs);
+}
+
 /* module def ------------------------------------------------------------ */
 
 static PyMethodDef methods[] = {
@@ -1095,6 +1357,8 @@ static PyMethodDef methods[] = {
      "deliver(deltas, time, cb, cols|None): sorted output callbacks"},
     {"ref_scalar", fast_ref_scalar, METH_O,
      "ref_scalar(args_tuple) -> Pointer (native blake2b-128 key mint)"},
+    {"binop", fast_binop, METH_VARARGS,
+     "binop(left, right, code, error_obj, op) -> (out, [(i, msg), ...])"},
     {NULL, NULL, 0, NULL},
 };
 

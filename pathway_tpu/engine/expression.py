@@ -27,6 +27,15 @@ class ExpressionError(Exception):
     pass
 
 
+# fastpath.c binop op codes (native/fastpath.c fast_binop): the
+# expression plane's numeric hot loop; ** stays on the Python loop
+_C_BINOP_CODES = {
+    "+": 0, "-": 1, "*": 2, "/": 3, "//": 4, "%": 5,
+    "<": 6, "<=": 7, ">": 8, ">=": 9, "==": 10, "!=": 11,
+    "&": 12, "|": 13, "^": 14,
+}
+
+
 def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> EvalFn:
     """resolver(ColumnReference) -> int column index, or "id"."""
 
@@ -52,6 +61,24 @@ def compile_expression(e: expr.ColumnExpression, resolver, runtime=None) -> Eval
         rf = compile_expression(e._right, resolver, runtime)
         op = e._operator
         symbol = e._symbol
+
+        from pathway_tpu.engine.stream import get_fp
+
+        fp = get_fp()
+        ccode = _C_BINOP_CODES.get(symbol) if fp is not None else None
+        if ccode is not None:
+            fbinop = fp.binop
+
+            def eval_binary_c(keys, rows):
+                lv = lf(keys, rows)
+                rv = rf(keys, rows)
+                out, errs = fbinop(lv, rv, ccode, ERROR, op)
+                if errs and runtime is not None:
+                    for i, msg in errs:
+                        runtime.log_data_error(msg, keys[i])
+                return out
+
+            return eval_binary_c
 
         def eval_binary(keys, rows):
             lv = lf(keys, rows)

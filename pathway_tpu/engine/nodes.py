@@ -154,14 +154,23 @@ class RowwiseNode(Node):
             return []
         # Deterministic replay for retractions: recompute is fine for pure
         # expressions; non-deterministic UDFs route through AsyncApplyNode.
-        keys, rows, _ = _split_deltas(deltas)
+        keys, rows, diffs = _split_deltas(deltas)
         new_rows = self.batch_fn(keys, rows)
         fp = get_fp()
-        if fp is not None:
-            return consolidate(fp.rezip(deltas, new_rows))
-        return consolidate(
-            (k, nr, d) for (k, _, d), nr in zip(deltas, new_rows)
+        out = (
+            fp.rezip(deltas, new_rows)
+            if fp is not None
+            else [(k, nr, d) for (k, _, d), nr in zip(deltas, new_rows)]
         )
+        # Pure-insert batches with distinct keys stay net form under any
+        # row mapping — marking them skips the downstream (key,row)
+        # re-hash (a key-set check is ~5x cheaper than consolidate).
+        # Batches carrying retractions CAN collapse: a non-injective
+        # expression maps an update's retract/insert pair onto identical
+        # rows, which consolidate must cancel.
+        if min(diffs, default=1) > 0 and len(set(keys)) == len(keys):
+            return ConsolidatedList(out)
+        return consolidate(out)
 
 
 class MemoizedRowwiseNode(Node):

@@ -187,6 +187,17 @@ class Runtime:
 
     # -- wiring ----------------------------------------------------------
     def add_static_data(self, node: SourceNode, deltas: list[Delta]) -> None:
+        # distinct keys all inserting once are net form already: marking
+        # the batch spares the source node a full (key,row) re-hash — a
+        # key-set check is an order of magnitude cheaper (debug tables and
+        # program-embedded rows hit this; duplicate/retracting data takes
+        # the consolidating path)
+        if deltas and all(d[2] == 1 for d in deltas):
+            keys = {d[0] for d in deltas}
+            if len(keys) == len(deltas):
+                from pathway_tpu.engine.stream import ConsolidatedList
+
+                deltas = ConsolidatedList(deltas)
         self.static_data.append((node, deltas))
 
     def add_connector(self, node: SourceNode, subject, parser, name=None) -> None:

@@ -43,6 +43,51 @@ def _materialize_wordcount(n_rows: int, distinct: int, batch: int):
     return batches, time.perf_counter() - t0
 
 
+def bench_transform(n_rows: int = 200_000) -> None:
+    """Rowwise expression plane: 4 selected columns (6 binary ops) per
+    row through the C binop fast path (native/fastpath.c fast_binop) and
+    net-form batch passthrough."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    pw.internals.parse_graph.G.clear()
+
+    class S(pw.Schema):
+        a: int
+        b: int
+
+    t0 = time.perf_counter()
+    rows = [(i, i % 1000, (i * 7) % 997 + 1) for i in range(n_rows)]
+    gen_s = time.perf_counter() - t0
+    t = pw.debug.table_from_rows(S, rows)
+    out = t.select(
+        s=pw.this.a + pw.this.b,
+        d=pw.this.a - pw.this.b,
+        q=pw.this.a // pw.this.b,
+        c=(pw.this.a > pw.this.b) & (pw.this.b > 10),
+    )
+    t0 = time.perf_counter()
+    GraphRunner().run_tables(out)
+    elapsed = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "transform_rows_per_s",
+                "value": round(n_rows / elapsed, 1),
+                "unit": "rows/s",
+                "n_rows": n_rows,
+                "exprs": 4,
+                "binops": 6,
+                "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
+                "host_cores": os.cpu_count() or 1,
+                "gen_s": round(gen_s, 2),
+                "elapsed_s": round(elapsed, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
 def bench_join(n_rows: int = 60_000, n_keys: int = 300, batch: int = 2_000) -> None:
     """Streaming two-table equi-join through the native delta-join executor
     (native/exec.cpp JoinStore): Δ(L⋈R) = ΔL⋈R + L'⋈ΔR, shard-parallel."""
@@ -327,6 +372,7 @@ def child(n_rows: int, distinct: int, batch: int) -> None:
     runs = [_wordcount_once(n_rows, distinct, batch) for _ in range(2)]
     print(json.dumps(min(runs, key=lambda r: r[0])[1]), flush=True)
     bench_join()
+    bench_transform()
 
 
 def main(n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000) -> None:
