@@ -539,7 +539,14 @@ def main() -> None:
         print(json.dumps(ann), flush=True)
 
     # relational plane: streaming wordcount through the sharded native
-    # group-by executor (prints its own JSON line)
+    # group-by executor (prints its own JSON line). Settle first: the
+    # serving benches' reader/tokenizer threads have just been joined and
+    # XLA host callbacks drain asynchronously — on small hosts their tail
+    # steals cycles from the first relational run.
+    import gc
+
+    gc.collect()
+    time.sleep(3.0)
     import importlib.util
 
     rel_path = os.path.join(
