@@ -1010,6 +1010,8 @@ fast_deliver(PyObject *self, PyObject *args)
 /* ref_scalar(args_tuple) -> Pointer
  * Full native key mint: injective serialization (value_bytes) + blake2b-128
  * + Pointer construction. Byte-identical to api.ref_scalar. */
+static PyObject *mint_key_from_tuple(PyObject *args_tuple);
+
 static PyObject *
 fast_ref_scalar(PyObject *self, PyObject *args_tuple)
 {
@@ -1017,6 +1019,21 @@ fast_ref_scalar(PyObject *self, PyObject *args_tuple)
         PyErr_SetString(PyExc_TypeError, "ref_scalar expects a tuple");
         return NULL;
     }
+    return mint_key_from_tuple(args_tuple);
+}
+
+/* variadic spelling — drop-in for api.ref_scalar(*args) so hot callers
+ * (the join executor's per-output-pair key mint) can invoke the builtin
+ * directly with no Python wrapper frame */
+static PyObject *
+fast_ref_scalar_v(PyObject *self, PyObject *args)
+{
+    return mint_key_from_tuple(args);
+}
+
+static PyObject *
+mint_key_from_tuple(PyObject *args_tuple)
+{
     if (load_pointer_type() < 0)
         return NULL;
     Py_ssize_t n = PyTuple_GET_SIZE(args_tuple);
@@ -1067,6 +1084,132 @@ fast_ref_scalar(PyObject *self, PyObject *args_tuple)
     return key;
 fail:
     PyMem_Free(b.buf);
+    return NULL;
+}
+
+/* parse_pk_upserts(dicts, cols, defaults, pkeys, live_rows) -> deltas
+ * Primary-keyed upsert sessions in one C pass (the CDC/connector hot
+ * path): per row dict, build the row tuple, mint the key from the pk
+ * VALUES (native blake2b — byte-identical to api.ref_scalar), retract
+ * the previous live row for that key, install the new one. live_rows is
+ * the parser's own session dict, shared with the per-message Python
+ * path so mixed batches stay consistent. A pk missing from a dict
+ * raises KeyError exactly like the Python path's values[c]. */
+static PyObject *
+fast_parse_pk_upserts(PyObject *self, PyObject *args)
+{
+    PyObject *dicts, *cols, *defaults, *pkeys, *live_rows;
+    if (!PyArg_ParseTuple(args, "OO!O!O!O!", &dicts, &PyTuple_Type, &cols,
+                          &PyTuple_Type, &defaults, &PyTuple_Type, &pkeys,
+                          &PyDict_Type, &live_rows))
+        return NULL;
+    PyObject *seq = PySequence_Fast(dicts, "parse_pk_upserts: sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t w = PyTuple_GET_SIZE(cols);
+    Py_ssize_t npk = PyTuple_GET_SIZE(pkeys);
+    if (PyTuple_GET_SIZE(defaults) != w) {
+        PyErr_SetString(PyExc_ValueError, "parse_pk_upserts: widths");
+        Py_DECREF(seq);
+        return NULL;
+    }
+    PyObject *out = PyList_New(0);
+    if (out == NULL) {
+        Py_DECREF(seq);
+        return NULL;
+    }
+    PyObject *one = PyLong_FromLong(1);
+    PyObject *neg = PyLong_FromLong(-1);
+    PyObject *pkvals = PyTuple_New(npk);
+    if (one == NULL || neg == NULL || pkvals == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *values = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyDict_Check(values)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "parse_pk_upserts: msg not a dict");
+            goto fail;
+        }
+        PyObject *row = PyTuple_New(w);
+        if (row == NULL)
+            goto fail;
+        for (Py_ssize_t c = 0; c < w; c++) {
+            PyObject *v = PyDict_GetItemWithError(
+                values, PyTuple_GET_ITEM(cols, c));
+            if (v == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(row);
+                    goto fail;
+                }
+                v = PyTuple_GET_ITEM(defaults, c);
+            }
+            Py_INCREF(v);
+            PyTuple_SET_ITEM(row, c, v);
+        }
+        for (Py_ssize_t p = 0; p < npk; p++) {
+            PyObject *v = PyDict_GetItemWithError(
+                values, PyTuple_GET_ITEM(pkeys, p));
+            if (v == NULL) {
+                if (!PyErr_Occurred())
+                    PyErr_SetObject(PyExc_KeyError,
+                                    PyTuple_GET_ITEM(pkeys, p));
+                Py_DECREF(row);
+                goto fail;
+            }
+            Py_INCREF(v);
+            /* pkvals slots are overwritten per row; SET_ITEM drops the
+             * previous ref */
+            PyObject *old = PyTuple_GET_ITEM(pkvals, p);
+            PyTuple_SET_ITEM(pkvals, p, v);
+            Py_XDECREF(old);
+        }
+        PyObject *key = mint_key_from_tuple(pkvals);
+        if (key == NULL) {
+            Py_DECREF(row);
+            goto fail;
+        }
+        PyObject *prev = PyDict_GetItemWithError(live_rows, key);
+        if (prev == NULL && PyErr_Occurred()) {
+            Py_DECREF(key);
+            Py_DECREF(row);
+            goto fail;
+        }
+        if (prev != NULL) {
+            PyObject *t = PyTuple_Pack(3, key, prev, neg);
+            if (t == NULL || PyList_Append(out, t) < 0) {
+                Py_XDECREF(t);
+                Py_DECREF(key);
+                Py_DECREF(row);
+                goto fail;
+            }
+            Py_DECREF(t);
+        }
+        if (PyDict_SetItem(live_rows, key, row) < 0) {
+            Py_DECREF(key);
+            Py_DECREF(row);
+            goto fail;
+        }
+        PyObject *t = PyTuple_Pack(3, key, row, one);
+        Py_DECREF(key);
+        Py_DECREF(row);
+        if (t == NULL || PyList_Append(out, t) < 0) {
+            Py_XDECREF(t);
+            goto fail;
+        }
+        Py_DECREF(t);
+    }
+    Py_DECREF(one);
+    Py_DECREF(neg);
+    Py_DECREF(pkvals);
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_XDECREF(one);
+    Py_XDECREF(neg);
+    Py_XDECREF(pkvals);
+    Py_DECREF(out);
+    Py_DECREF(seq);
     return NULL;
 }
 
@@ -1359,6 +1502,10 @@ static PyMethodDef methods[] = {
      "ref_scalar(args_tuple) -> Pointer (native blake2b-128 key mint)"},
     {"binop", fast_binop, METH_VARARGS,
      "binop(left, right, code, error_obj, op) -> (out, [(i, msg), ...])"},
+    {"parse_pk_upserts", fast_parse_pk_upserts, METH_VARARGS,
+     "parse_pk_upserts(dicts, cols, defaults, pkeys, live_rows) -> deltas"},
+    {"ref_scalar_v", fast_ref_scalar_v, METH_VARARGS,
+     "ref_scalar_v(*args) -> Pointer (variadic native key mint)"},
     {NULL, NULL, 0, NULL},
 };
 

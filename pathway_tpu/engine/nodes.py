@@ -644,7 +644,10 @@ class JoinNode(GroupDiffNode):
                     rkeys,
                     rrows,
                     [d[2] for d in rb],
-                    ref_scalar,
+                    # the raw C variadic mint when available: the join
+                    # emits one pair key per OUTPUT row, so the python
+                    # wrapper frame is a per-output cost
+                    getattr(get_fp(), "ref_scalar_v", None) or ref_scalar,
                     self.left_id_fn or self.right_id_fn,
                 )
             except self._exec.Fallback:

@@ -183,7 +183,14 @@ def _make_parser(schema: type[Schema], subject=None):
 
     fp = get_fp()
     simple = fp is not None and not pkeys and not track_removals
+    # primary-keyed upsert sessions take their own C pass (key mint from
+    # pk values + retract-previous against the shared live_rows session
+    # dict) — the CDC/connector hot path
+    pk_fast = (
+        fp is not None and bool(pkeys) and hasattr(fp, "parse_pk_upserts")
+    )
     cols_t = tuple(cols)
+    pkeys_t = tuple(pkeys or ())
     defaults_t = tuple(defaults.get(c) for c in cols)
 
     def parse_batch(messages: list) -> list[tuple]:
@@ -194,15 +201,20 @@ def _make_parser(schema: type[Schema], subject=None):
         pure = simple
         while i < n:
             m = messages[i]
-            if simple and m[0] == "upsert_batch":
+            if (simple or pk_fast) and m[0] == "upsert_batch":
                 # pre-batched rows: one C call for the whole list
-                deltas, seq[0] = fp.parse_upserts(
-                    m[1], 0, cols_t, defaults_t, key_base, seq[0],
-                    _KEY_MASK, Pointer,
-                )
+                if simple:
+                    deltas, seq[0] = fp.parse_upserts(
+                        m[1], 0, cols_t, defaults_t, key_base, seq[0],
+                        _KEY_MASK, Pointer,
+                    )
+                else:
+                    deltas = fp.parse_pk_upserts(
+                        m[1], cols_t, defaults_t, pkeys_t, live_rows
+                    )
                 out.extend(deltas)
                 i += 1
-            elif simple and m[0] == "upsert" and len(m) == 2:
+            elif (simple or pk_fast) and m[0] == "upsert" and len(m) == 2:
                 j = i + 1
                 while j < n:
                     mj = messages[j]
@@ -210,10 +222,15 @@ def _make_parser(schema: type[Schema], subject=None):
                         break
                     j += 1
                 dicts = [messages[t][1] for t in range(i, j)]
-                deltas, seq[0] = fp.parse_upserts(
-                    dicts, 0, cols_t, defaults_t, key_base, seq[0],
-                    _KEY_MASK, Pointer,
-                )
+                if simple:
+                    deltas, seq[0] = fp.parse_upserts(
+                        dicts, 0, cols_t, defaults_t, key_base, seq[0],
+                        _KEY_MASK, Pointer,
+                    )
+                else:
+                    deltas = fp.parse_pk_upserts(
+                        dicts, cols_t, defaults_t, pkeys_t, live_rows
+                    )
                 out.extend(deltas)
                 i = j
             else:
