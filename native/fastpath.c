@@ -954,8 +954,10 @@ deliver_one(PyObject *d, PyObject *time_obj, PyObject *cb, PyObject *cols,
         Py_INCREF(payload);
         diff_arg = diff;
     }
-    PyObject *r = PyObject_CallFunctionObjArgs(
-        cb, key, payload, time_obj, diff_arg, NULL);
+    /* vectorcall: the per-output-delta dispatch into user callbacks is
+     * the subscribe hot loop — skip the ObjArgs tuple pack */
+    PyObject *stack[4] = {key, payload, time_obj, diff_arg};
+    PyObject *r = PyObject_Vectorcall(cb, stack, 4, NULL);
     Py_DECREF(payload);
     if (r == NULL)
         return -1;

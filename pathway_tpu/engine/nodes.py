@@ -34,6 +34,7 @@ from pathway_tpu.engine.stream import (
     consolidate,
     freeze_row,
     get_fp,
+    is_native_batch,
     negate,
 )
 
@@ -134,6 +135,11 @@ class SourceNode(Node):
         self.append_only = append_only
 
     def process(self, time, batches):
+        # columnar batches from the C parser pass through untouched —
+        # they are net form by construction and materialize lazily at the
+        # first non-native consumer (the fused-chain contract)
+        if is_native_batch(batches[0]):
+            return batches[0]
         return consolidate(batches[0])
 
 
@@ -751,6 +757,8 @@ class GroupByNode(GroupDiffNode):
         args_batch=None,      # (keys, rows) -> list of arg-combo tuples
         native_args=None,     # per spec: batch column fn | None (count)
         native_order=None,    # sort_by batch column fn (order tokens)
+        nb_gidx=None,         # grouping column indices (NativeBatch path)
+        nb_argidx=None,       # per spec: arg column index | None (count)
     ):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
@@ -793,6 +801,21 @@ class GroupByNode(GroupDiffNode):
             and all(c is not None for c in self.native_codes)
             and native_args is not None
         )
+        # fused-chain path: a columnar NativeBatch from the C parser is
+        # taken through extract→apply→emit in ONE C call (zero per-row
+        # Python). Abelian-only stores (count/sum/avg) with plain-column
+        # grouping/args and no sort_by qualify; everything else
+        # materializes the batch into the general native path below.
+        self._nb_ok = (
+            self._native_ok
+            and nb_gidx is not None
+            and nb_argidx is not None
+            and native_order is None
+            and all(c in ("count", "sum", "avg") for c in self.native_codes)
+        )
+        self._nb_gidx = tuple(nb_gidx) if nb_gidx is not None else None
+        self._nb_argidx = tuple(nb_argidx) if nb_argidx is not None else None
+        self._nb_batches = 0  # chain-path spy counter (tests)
         self._exec = None
         self._store = None
         # frozen gvals -> [gvals, ms_or_None, abelian_states, total_count,
@@ -881,6 +904,24 @@ class GroupByNode(GroupDiffNode):
         self._native_ok = False
 
     def process(self, time, batches):
+        if (
+            self._nb_ok
+            and self._native_ok  # demotion (migrate/load_state) clears this
+            and is_native_batch(batches[0])
+            and self._native_setup()
+        ):
+            try:
+                out = self._exec.process_batch_nb(
+                    self._store, batches[0], self._nb_gidx,
+                    self._nb_argidx, self.key_fn, ERROR, time,
+                    ConsolidatedList,
+                )
+                self._nb_batches += 1
+                return out
+            except self._exec.Fallback:
+                # store stays valid (phase 1 mutates nothing): materialize
+                # and run the general path — do NOT demote the node
+                pass
         batch = consolidate(batches[0])
         if not batch:
             return []

@@ -178,7 +178,7 @@ class Scope:
     def group_by(
         self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int,
         key_fn=None, grouping_batch=None, args_batch=None, native_args=None,
-        native_order=None,
+        native_order=None, nb_gidx=None, nb_argidx=None,
     ) -> EngineTable:
         table = self._exchange(
             table, grouping_batch or self._rowwise_key(grouping_fn)
@@ -187,6 +187,7 @@ class Scope:
             self, table.node, grouping_fn, args_fn, reducer_fns, key_fn,
             grouping_batch=grouping_batch, args_batch=args_batch,
             native_args=native_args, native_order=native_order,
+            nb_gidx=nb_gidx, nb_argidx=nb_argidx,
         )
         return EngineTable(node, n_group_cols + len(reducer_fns))
 

@@ -76,6 +76,29 @@ class ConsolidatedList(list):
 
 _consolidate_impl = None
 _fp_cached: Any = False
+_nb_type: Any = False
+
+
+def native_batch_type():
+    """The pwexec.NativeBatch type (columnar zero-Python delta batch), or
+    None without a toolchain. NativeBatch batches flow from the C parser
+    straight into the C group-by executor; every other consumer sees a
+    normal (key, row, diff) sequence via lazy materialization."""
+    global _nb_type
+    if _nb_type is False:
+        try:
+            from pathway_tpu.native import get_pwexec
+
+            ex = get_pwexec()
+            _nb_type = getattr(ex, "NativeBatch", None)
+        except Exception:
+            _nb_type = None
+    return _nb_type
+
+
+def is_native_batch(obj: Any) -> bool:
+    t = native_batch_type()
+    return t is not None and type(obj) is t
 
 
 def get_fp():
@@ -104,6 +127,11 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
         # not alias siblings' data. A pointer-copy is still far cheaper
         # than re-hashing the batch.
         return ConsolidatedList(deltas)
+    if is_native_batch(deltas):
+        # parse output is net form by construction (distinct minted keys,
+        # all +1); materialization is cached on the batch, the wrap gives
+        # this consumer its own mutable view
+        return ConsolidatedList(deltas.materialize())
     if _consolidate_impl is None:
         impl = _consolidate_py
         try:

@@ -374,11 +374,46 @@ class GroupedTable:
                                 lambda ms, slot, _f=fn, _p=post: _p(_f(ms, slot)),
                             )
                     reducer_specs.append(spec)
+                # NativeBatch fused-chain eligibility: deterministic
+                # plain-column grouping and argless/single-plain-column
+                # reducer args, no sort_by — the shapes the columnar C
+                # parse→groupby path (exec.cpp process_batch_nb) executes
+                # with zero per-row Python objects
+                nb_gidx = nb_argidx = None
+                if deterministic and sort_by is None:
+
+                    def _col_idx(e):
+                        if isinstance(e, ColumnReference):
+                            loc = resolver(e)
+                            if isinstance(loc, int):
+                                return loc
+                        return None
+
+                    g_locs = [_col_idx(g) for g in grouping]
+                    a_locs: list[int | None] = []
+                    nb_ok = all(loc is not None for loc in g_locs)
+                    for r in reducers if nb_ok else ():
+                        if len(r._args) == 0:
+                            a_locs.append(None)
+                            continue
+                        loc = (
+                            _col_idx(r._args[0])
+                            if len(r._args) == 1
+                            else None
+                        )
+                        if loc is None:
+                            nb_ok = False
+                            break
+                        a_locs.append(loc)
+                    if nb_ok:
+                        nb_gidx, nb_argidx = tuple(g_locs), tuple(a_locs)
+
                 grouped = ctx.scope.group_by(
                     et, grouping_fn, args_fn, reducer_specs, n_group,
                     key_fn=key_fn, grouping_batch=grouping_batch,
                     args_batch=args_batch, native_args=native_args,
                     native_order=sort_fn,
+                    nb_gidx=nb_gidx, nb_argidx=nb_argidx,
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values

@@ -43,6 +43,8 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
                 out.extend(parser(m))
             return out
 
+    from pathway_tpu.engine.stream import is_native_batch
+
     pending: list = []  # raw messages, parsed at flush under `lock`
     # rows forwarded to the engine but not yet covered by a journal entry
     # (stateful subjects only; tracked only when persistence is configured)
@@ -63,6 +65,15 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
     # stranded while the subject blocks waiting for input.
     duration_ms = getattr(subject, "_autocommit_duration_ms", None)
     last_flush = _time.monotonic()
+
+    def jrows_of(batch):
+        """Journal view of a parsed batch: empty when nothing journals
+        (no persistence configured), materialized (key, row, diff) rows
+        when the batch is a columnar NativeBatch (which carries no
+        picklable rows); the engine always receives the batch itself."""
+        if not persisting:
+            return []
+        return list(batch) if is_native_batch(batch) else batch
 
     def take_batch() -> list:
         """Parse and claim the currently queued messages. Caller holds
@@ -88,7 +99,7 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
                 # bookkeeping can lag these rows — journaling them now with
                 # a concurrently captured state double-counts on restore
                 # (journal replay + rescan re-emitting the same keys)
-                unjournaled.extend(batch)
+                unjournaled.extend(jrows_of(batch))
                 if len(unjournaled) > _BACKLOG_CAP:
                     # subject never commits: journal stateless (at-least-once
                     # for this span) rather than grow host memory unboundedly
@@ -113,7 +124,7 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
                 # no persistence configured: nothing to journal
                 out_queue.put((conn, batch, None, []))
             else:
-                out_queue.put((conn, batch, None, batch))
+                out_queue.put((conn, batch, None, jrows_of(batch)))
 
     def commit_flush() -> None:
         # subject-driven boundary (subject.commit() / end of run()): runs on
@@ -124,18 +135,25 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
         with lock:
             batch = take_batch()
             if has_state:
-                journal_rows = unjournaled + batch
+                journal_rows = unjournaled + jrows_of(batch)
                 unjournaled.clear()
                 # publish a state even with an empty journal batch when rows
                 # were forwarded since the last boundary (operator-snapshot
-                # mode needs the state to cover them)
-                dirty = bool(journal_rows) or forwarded_since_boundary > 0
+                # mode needs the state to cover them). `batch` enters the
+                # condition directly: without persistence journal_rows is
+                # always empty, but a committed batch must still reach the
+                # engine
+                dirty = (
+                    bool(journal_rows)
+                    or bool(batch)
+                    or forwarded_since_boundary > 0
+                )
                 forwarded_since_boundary = 0
                 if dirty:
                     state = subject.snapshot_state()
                     out_queue.put((conn, batch, state, journal_rows))
             elif batch:
-                out_queue.put((conn, batch, None, batch))
+                out_queue.put((conn, batch, None, jrows_of(batch)))
 
     def emit(message: Any) -> None:
         # list.append is GIL-atomic: no lock on the per-row producer path.

@@ -183,6 +183,17 @@ def _make_parser(schema: type[Schema], subject=None):
 
     fp = get_fp()
     simple = fp is not None and not pkeys and not track_removals
+    # columnar fast path: a flush that is entirely simple upserts parses
+    # into a C-owned NativeBatch (exec.cpp) that the group-by executor
+    # consumes with zero per-row Python objects (the fused-chain door)
+    nb_parse = None
+    if simple:
+        try:
+            from pathway_tpu.native import get_pwexec
+
+            nb_parse = getattr(get_pwexec(), "parse_upserts_nb", None)
+        except Exception:
+            nb_parse = None
     # primary-keyed upsert sessions take their own C pass (key mint from
     # pk values + retract-previous against the shared live_rows session
     # dict) — the CDC/connector hot path
@@ -196,6 +207,19 @@ def _make_parser(schema: type[Schema], subject=None):
     def parse_batch(messages: list) -> list[tuple]:
         from pathway_tpu.engine.stream import ConsolidatedList
 
+        if nb_parse is not None and messages:
+            dicts = None
+            if len(messages) == 1 and messages[0][0] == "upsert_batch":
+                dicts = messages[0][1]
+            elif all(m[0] == "upsert" and len(m) == 2 for m in messages):
+                dicts = [m[1] for m in messages]
+            if dicts is not None:
+                res = nb_parse(
+                    dicts, 0, cols_t, defaults_t, key_base, seq[0], Pointer
+                )
+                if res is not None:  # None: value outside the columnar set
+                    nb, seq[0] = res
+                    return nb
         out: list[tuple] = []
         i, n = 0, len(messages)
         pure = simple

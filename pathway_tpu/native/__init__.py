@@ -186,12 +186,23 @@ def get_pwexec():
         ):
             include = sysconfig.get_paths()["include"]
             cmd = [
-                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                "g++", "-O3", "-std=c++20", "-shared", "-fPIC", "-pthread",
                 f"-I{include}", "-o", out, src,
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-            except Exception:
+            except Exception as exc:
+                # a failed build silently drops the whole native executor
+                # (group-by/join fall back to pure Python) — make the
+                # degradation visible, esp. g++ < 10 rejecting -std=c++20
+                import logging
+
+                stderr = getattr(exc, "stderr", None) or b""
+                logging.getLogger(__name__).warning(
+                    "native executor build failed (%s): %s",
+                    exc,
+                    stderr[-500:],
+                )
                 return None
         import importlib.util
 

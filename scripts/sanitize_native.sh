@@ -32,11 +32,11 @@ else
 fi
 
 echo "== building native extensions with $MODE =="
-g++ -O1 -g -std=c++17 -shared -fPIC -pthread $SAN \
+g++ -O1 -g -std=c++20 -shared -fPIC -pthread $SAN \
     -I"$PYINC" -o "$BUILD/pwexec$EXT" native/exec.cpp
 gcc -O1 -g -shared -fPIC $SAN \
     -I"$PYINC" -o "$BUILD/fastpath$EXT" native/fastpath.c
-g++ -O1 -g -std=c++17 -shared -fPIC $SAN \
+g++ -O1 -g -std=c++20 -shared -fPIC $SAN \
     -o "$BUILD/libpathway_native.so" native/bm25.cpp native/hnsw.cpp
 touch "$BUILD/build.stamp"
 

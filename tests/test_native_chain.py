@@ -1,0 +1,296 @@
+"""NativeBatch fused-chain tests — the zero-interpreter steady state.
+
+The reference's hot loop runs every operator natively with no interpreter
+dispatch (reference: src/engine/dataflow.rs:5595-5650 `step_or_park` on the
+timely substrate). Our equivalent is the columnar NativeBatch: the C parser
+(exec.cpp parse_upserts_nb) hands the group-by executor
+(exec.cpp process_batch_nb) a C-owned batch, and no per-row Python object
+exists between ingest and reducer state. These tests pin:
+
+* the chain actually engages on the wordcount shape (spy counter — no
+  silent demotion);
+* results are bit-identical to the Python/tuple paths across value types;
+* every boundary degrades gracefully (non-columnar values, non-abelian
+  reducers, persistence journaling, non-native consumers).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.native import get_pwexec
+
+pytestmark = pytest.mark.skipif(
+    get_pwexec() is None or not hasattr(get_pwexec(), "parse_upserts_nb"),
+    reason="native toolchain unavailable",
+)
+
+
+def _spy_nb_batches(monkeypatch):
+    """Patch GroupByNode.process to record per-node nb-batch counts."""
+    import pathway_tpu.engine.nodes as N
+
+    counts: list[int] = []
+    orig = N.GroupByNode.process
+
+    def process(self, time, batches):
+        out = orig(self, time, batches)
+        counts.append(getattr(self, "_nb_batches", 0))
+        return out
+
+    monkeypatch.setattr(N.GroupByNode, "process", process)
+    return counts
+
+
+def _run_wordcount(rows, autocommit=3_600_000, persistence_config=None):
+    pw.internals.parse_graph.G.clear()
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows)
+            self.commit()
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.python.read(
+        Source(), schema=S, autocommit_duration_ms=autocommit
+    )
+    counts = t.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    live = {}
+
+    def on_change(key, row, time_, diff):
+        if diff:
+            live[key] = row
+        else:
+            live.pop(key, None)
+
+    pw.io.subscribe(counts, on_change=on_change)
+    pw.run(
+        monitoring_level=pw.MonitoringLevel.NONE,
+        persistence_config=persistence_config,
+    )
+    return {r["word"]: r["c"] for r in live.values()}
+
+
+def test_wordcount_chain_engages_and_counts(monkeypatch):
+    nb_counts = _spy_nb_batches(monkeypatch)
+    rows = [{"data": f"w{i % 37}"} for i in range(5_000)]
+    got = _run_wordcount(rows)
+    want = Counter(r["data"] for r in rows)
+    assert got == dict(want)
+    # the spy proves the fused chain ran — no silent demotion to the
+    # tuple path on the flagship shape
+    assert max(nb_counts, default=0) >= 1
+
+
+def test_chain_sum_avg_mixed_numerics():
+    pw.internals.parse_graph.G.clear()
+    rows = [
+        {"k": f"g{i % 5}", "v": [1, 2.5, None, 3, -7][i % 5]}
+        for i in range(1_000)
+    ]
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows)
+            self.commit()
+
+    class S(pw.Schema):
+        k: str
+        v: float | None
+
+    t = pw.io.python.read(Source(), schema=S, autocommit_duration_ms=None)
+    out = t.groupby(pw.this.k).reduce(
+        k=pw.this.k,
+        n=pw.reducers.count(),
+        s=pw.reducers.sum(pw.this.v),
+        a=pw.reducers.avg(pw.this.v),
+    )
+    res = pw.debug.table_to_pandas(out)
+    by_k = {r["k"]: r for _, r in res.iterrows()}
+    for g in range(5):
+        vals = [r["v"] for r in rows if r["k"] == f"g{g}" and r["v"] is not None]
+        row = by_k[f"g{g}"]
+        assert row["n"] == 200
+        if vals:
+            assert row["s"] == pytest.approx(sum(vals))
+            assert row["a"] == pytest.approx(sum(vals) / len(vals))
+
+
+def test_non_abelian_reducer_falls_back_correctly(monkeypatch):
+    """min() makes the store non-abelian: the nb branch must not engage,
+    the materialized path must give exact results."""
+    nb_counts = _spy_nb_batches(monkeypatch)
+    pw.internals.parse_graph.G.clear()
+    rows = [{"k": f"g{i % 3}", "v": (i * 17) % 101} for i in range(300)]
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows)
+            self.commit()
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.python.read(Source(), schema=S, autocommit_duration_ms=None)
+    out = t.groupby(pw.this.k).reduce(
+        k=pw.this.k, lo=pw.reducers.min(pw.this.v)
+    )
+    res = pw.debug.table_to_pandas(out)
+    by_k = {r["k"]: r["lo"] for _, r in res.iterrows()}
+    for g in range(3):
+        assert by_k[f"g{g}"] == min(
+            r["v"] for r in rows if r["k"] == f"g{g}"
+        )
+    assert max(nb_counts, default=0) == 0
+
+
+def test_non_columnar_values_fall_back():
+    """bytes values are outside the columnar set: parse returns the tuple
+    path and results stay exact."""
+    pw.internals.parse_graph.G.clear()
+    rows = [{"k": f"g{i % 3}", "b": bytes([i % 7])} for i in range(100)]
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows)
+            self.commit()
+
+    class S(pw.Schema):
+        k: str
+        b: bytes
+
+    t = pw.io.python.read(Source(), schema=S, autocommit_duration_ms=None)
+    out = t.groupby(pw.this.k).reduce(k=pw.this.k, n=pw.reducers.count())
+    res = pw.debug.table_to_pandas(out)
+    assert {r["k"]: r["n"] for _, r in res.iterrows()} == dict(
+        Counter(r["k"] for r in rows)
+    )
+
+
+def test_bool_and_none_types_survive_materialization():
+    """A bool column rides the columnar batch (NB_BOOL) and must come back
+    as real bools through a non-native consumer (filter → UDF)."""
+    pw.internals.parse_graph.G.clear()
+    rows = [{"f": i % 2 == 0, "x": i if i % 3 else None} for i in range(50)]
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows)
+            self.commit()
+
+    class S(pw.Schema):
+        f: bool
+        x: int | None
+
+    t = pw.io.python.read(Source(), schema=S, autocommit_duration_ms=None)
+
+    @pw.udf
+    def typename(v) -> str:
+        return type(v).__name__
+
+    out = t.select(tf=typename(pw.this.f), tx=typename(pw.this.x))
+    res = pw.debug.table_to_pandas(out)
+    assert set(res["tf"]) == {"bool"}
+    assert set(res["tx"]) == {"int", "NoneType"}
+
+
+def test_chain_with_persistence_journal(tmp_path):
+    """Stateless subjects journal write-ahead: a NativeBatch flush must
+    land picklable (key, row, diff) rows in the journal and replay them
+    on restart without double-counting."""
+    backend = pw.persistence.Backend.filesystem(str(tmp_path))
+    cfg = pw.persistence.Config(backend)
+    rows = [{"data": f"w{i % 7}"} for i in range(200)]
+    got1 = _run_wordcount(rows, persistence_config=cfg)
+    assert got1 == dict(Counter(r["data"] for r in rows))
+    # second run: journal replays the first run's rows, then the source
+    # re-emits (stateless subject) — counts double exactly
+    got2 = _run_wordcount(rows, persistence_config=cfg)
+    assert got2 == {w: 2 * c for w, c in Counter(r["data"] for r in rows).items()}
+
+
+def test_stateful_subject_commit_without_persistence_forwards_rows():
+    """Regression (r5 review): a stateful subject (defines snapshot_state)
+    running WITHOUT persistence must still forward its commit()-flushed
+    batch to the engine — the journal-row emptiness must not be read as
+    'nothing happened'."""
+    pw.internals.parse_graph.G.clear()
+
+    class Stateful(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch([{"data": f"w{i % 3}"} for i in range(30)])
+            self.commit()
+
+        def snapshot_state(self):
+            return {"pos": 30}
+
+        def seek(self, state):
+            pass
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.python.read(
+        Stateful(), schema=S, autocommit_duration_ms=None
+    )
+    out = t.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    res = pw.debug.table_to_pandas(out)
+    assert {r["word"]: r["c"] for _, r in res.iterrows()} == {
+        "w0": 10, "w1": 10, "w2": 10
+    }
+
+
+def test_nb_parse_and_groupby_unit():
+    """Direct unit drive of the C entry points: parse → materialize parity
+    and groupby output vs the tuple path on the same store codes."""
+    from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
+
+    ex = get_pwexec()
+    msgs = [
+        {"k": f"g{i % 4}", "v": float(i), "flag": i % 2 == 0, "x": None}
+        for i in range(64)
+    ]
+    cols = ("k", "v", "flag", "x")
+    res = ex.parse_upserts_nb(
+        msgs, 0, cols, (None,) * 4, int(ref_scalar("unit")), 0, Pointer
+    )
+    assert res is not None
+    nb, seq = res
+    assert seq == 64 and len(nb) == 64
+    mat = nb.materialize()
+    assert mat[5][1] == ("g1", 5.0, False, None)
+    assert mat[5][2] == 1 and isinstance(mat[5][0], Pointer)
+    # distinct keys, monotone seq
+    assert len({d[0] for d in mat}) == 64
+
+    store = ex.store_new(2, ("count", "sum"), 0)
+    out = ex.process_batch_nb(
+        store, nb, (0,), (None, 1), lambda g: ref_scalar(*g), ERROR, 2
+    )
+    got = {r[0]: (r[1], r[2]) for _, r, d in out if d > 0}
+    want_cnt = Counter(m["k"] for m in msgs)
+    for k, (n, s) in got.items():
+        assert n == want_cnt[k]
+        assert s == sum(m["v"] for m in msgs if m["k"] == k)
