@@ -1,0 +1,48 @@
+"""Shared measurement policy for the bench harness (bench.py and
+scripts/bench_relational.py): median-of-runs selection with dispersion
+flagging, and the atomic artifact writer. One module so both measurement
+planes always report under the same policy."""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import tempfile
+
+DISPERSION_FLAG = 0.2
+
+
+def dispersion(values: list[float]) -> float:
+    med = statistics.median(values)
+    return round((max(values) - min(values)) / med, 3) if med else 0.0
+
+
+def median_index(rates: list[float]) -> int:
+    """Index of the run whose rate is the median."""
+    return rates.index(sorted(rates)[len(rates) // 2])
+
+
+def median_of(runs: list[dict], rates: list[float]) -> dict:
+    """The run whose rate is the median, annotated with the spread."""
+    out = dict(runs[median_index(rates)])
+    out["runs"] = [round(r, 1) for r in rates]
+    out["dispersion"] = dispersion(rates)
+    out["unsteady"] = dispersion(rates) > DISPERSION_FLAG
+    return out
+
+
+def write_artifact_atomic(path: str, artifact: list[dict]) -> None:
+    """Rewrite the artifact via temp-file + rename so a crash mid-write
+    can never truncate previously recorded metrics."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".bench_full_", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(artifact, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass

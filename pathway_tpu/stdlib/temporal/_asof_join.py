@@ -21,12 +21,13 @@ class Direction(enum.Enum):
 class AsofJoinResult(IntervalJoinResult):
     def __init__(
         self, left, right, on, *, self_time, other_time, direction, how,
-        defaults=None,
+        defaults=None, orig_left=None, orig_right=None,
     ):
         super().__init__(
             left, right, on,
             self_time=self_time, other_time=other_time,
             iv=None, how=how,
+            orig_left=orig_left, orig_right=orig_right,
         )
         self._direction = direction
         self._defaults = defaults or {}
@@ -148,6 +149,8 @@ def asof_join(
         direction=direction,
         how=how_str,
         defaults=defaults,
+        orig_left=self_table,
+        orig_right=other_table,
     )
 
 

@@ -33,12 +33,33 @@ class IntervalJoinResult(JoinResult):
     def __init__(
         self, left, right, on, *, self_time, other_time, iv: Interval,
         how="inner", behavior: CommonBehavior | None = None,
+        orig_left=None, orig_right=None,
     ):
         super().__init__(left, right, on, how=how)
         self._self_time = left._desugar(expr_mod.smart_coerce(self_time))
         self._other_time = right._desugar(expr_mod.smart_coerce(other_time))
         self._interval = iv
         self._behavior = behavior
+        # behavior gating replaces the join inputs with buffered/frozen
+        # copies; user select/filter expressions still reference the
+        # ORIGINAL tables and are re-pointed here (reference surface:
+        # interval_join(...).select(t1.x, t2.y) works with behaviors)
+        self._orig_left = orig_left if orig_left is not None else left
+        self._orig_right = orig_right if orig_right is not None else right
+
+    def _fix_expr(self, e):
+        e = rebind(e, self._orig_left, self._left)
+        return rebind(e, self._orig_right, self._right)
+
+    def select(self, *args, **kwargs):
+        args = tuple(
+            self._fix_expr(a) if hasattr(a, "_dtype") else a for a in args
+        )
+        kwargs = {
+            k: self._fix_expr(expr_mod.smart_coerce(v))
+            for k, v in kwargs.items()
+        }
+        return super().select(*args, **kwargs)
 
     def _engine_join(
         self, ctx, let, ret, lkey, rkey, how, *,
@@ -166,6 +187,8 @@ def interval_join(
         iv=iv,
         how=how_str,
         behavior=behavior,
+        orig_left=self_table,
+        orig_right=other_table,
     )
 
 

@@ -270,6 +270,9 @@ class _IntervalsOverWindow(Window):
             how="left" if self.is_outer else "inner",
         ).select(
             _pw_window=at_table[at.name],
+            # reference surface (_window.py:558): the probe location rides
+            # into the reduce as _pw_window_location
+            _pw_window_location=at_table[at.name],
             _pw_window_start=at_table[at.name] + self.lower_bound,
             _pw_window_end=at_table[at.name] + self.upper_bound,
             _pw_instance=inst_expr,
@@ -304,6 +307,7 @@ class _IntervalsOverWindow(Window):
                 )
         return joined.groupby(
             joined["_pw_window"],
+            joined["_pw_window_location"],
             joined["_pw_window_start"],
             joined["_pw_window_end"],
             joined["_pw_instance"],
@@ -330,11 +334,29 @@ def sliding(hop, duration=None, ratio: int | None = None, origin=None) -> Window
         raise ValueError(
             "sliding window requires exactly one of duration or ratio"
         )
+    if (
+        not _positive(hop)
+        or (duration is not None and not _positive(duration))
+        or (ratio is not None and ratio <= 0)
+    ):
+        raise ValueError("sliding window hop/duration/ratio must be positive")
     return _SlidingWindow(hop=hop, duration=duration, origin=origin, ratio=ratio)
+
+
+def _positive(span) -> bool:
+    """span > 0 for ints/floats and timedeltas alike."""
+    import datetime
+
+    zero = (
+        datetime.timedelta(0) if isinstance(span, datetime.timedelta) else 0
+    )
+    return span > zero
 
 
 def tumbling(duration, origin=None) -> Window:
     """Non-overlapping windows of length `duration`."""
+    if not _positive(duration):
+        raise ValueError("tumbling window duration must be positive")
     return _SlidingWindow(hop=duration, duration=duration, origin=origin, ratio=None)
 
 

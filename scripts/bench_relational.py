@@ -2,7 +2,8 @@
 
 The reference's scaling story for this plane is N timely workers over key
 shards (src/engine/dataflow.rs:5538, dataflow/config.rs:88-127). Ours is
-worker-sharded batch execution with C++ inner loops.
+worker-sharded batch execution with C++ inner loops plus the NativeBatch
+fused chain (native/exec.cpp): parse → groupby with zero per-row Python.
 
 Engine-bound harness: row dicts are pre-materialized BEFORE the measured
 window and enter the engine through ``ConnectorSubject.next_batch`` (one C
@@ -10,6 +11,9 @@ parse call per batch), so the recorded rows/s measures parse + groupby +
 delivery, not a Python generator loop. ``gen_s`` records the (unmeasured)
 materialization cost for transparency.
 
+Self-defending measurements (round-4 verdict: the driver artifact recorded
+half the engine's real throughput): every metric runs warmup + 3 repeats
+and reports the median with per-run values and dispersion (flagged >20%).
 Artifacts always include the thread-scaling curve (threads=1/4/8) and a
 PATHWAY_PROCESSES=2 wordcount, with ``host_cores`` annotated so a 1-core
 host shows honest parity rather than silence.
@@ -29,6 +33,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from bench_util import median_of as _median_of  # noqa: E402
+
+
+def _print_emit(metric: dict) -> None:
+    print(json.dumps(metric), flush=True)
+
 
 def _materialize_wordcount(n_rows: int, distinct: int, batch: int):
     t0 = time.perf_counter()
@@ -43,13 +53,16 @@ def _materialize_wordcount(n_rows: int, distinct: int, batch: int):
     return batches, time.perf_counter() - t0
 
 
-def bench_transform(n_rows: int = 200_000) -> None:
+def _transform_once(n_rows: int) -> dict:
     """Rowwise expression plane: 4 selected columns (6 binary ops) per
     row through the C binop fast path (native/fastpath.c fast_binop) and
     net-form batch passthrough."""
+    import gc
+
     import pathway_tpu as pw
     from pathway_tpu.internals.graph_runner import GraphRunner
 
+    gc.collect()
     pw.internals.parse_graph.G.clear()
 
     class S(pw.Schema):
@@ -69,31 +82,34 @@ def bench_transform(n_rows: int = 200_000) -> None:
     t0 = time.perf_counter()
     GraphRunner().run_tables(out)
     elapsed = time.perf_counter() - t0
-    print(
-        json.dumps(
-            {
-                "metric": "transform_rows_per_s",
-                "value": round(n_rows / elapsed, 1),
-                "unit": "rows/s",
-                "n_rows": n_rows,
-                "exprs": 4,
-                "binops": 6,
-                "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
-                "host_cores": os.cpu_count() or 1,
-                "gen_s": round(gen_s, 2),
-                "elapsed_s": round(elapsed, 2),
-            }
-        ),
-        flush=True,
-    )
+    return {
+        "metric": "transform_rows_per_s",
+        "value": round(n_rows / elapsed, 1),
+        "unit": "rows/s",
+        "n_rows": n_rows,
+        "exprs": 4,
+        "binops": 6,
+        "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
+        "host_cores": os.cpu_count() or 1,
+        "gen_s": round(gen_s, 2),
+        "elapsed_s": round(elapsed, 2),
+    }
 
 
-def bench_join(n_rows: int = 60_000, n_keys: int = 300, batch: int = 2_000) -> None:
+def bench_transform(n_rows: int = 200_000, emit=_print_emit) -> None:
+    runs = [_transform_once(n_rows) for _ in range(1 + 3)][1:]  # 1 warmup
+    emit(_median_of(runs, [r["value"] for r in runs]))
+
+
+def _join_once(n_rows: int, n_keys: int, batch: int) -> dict:
     """Streaming two-table equi-join through the native delta-join executor
     (native/exec.cpp JoinStore): Δ(L⋈R) = ΔL⋈R + L'⋈ΔR, shard-parallel."""
+    import gc
+
     import pathway_tpu as pw
     from pathway_tpu.internals.graph_runner import GraphRunner
 
+    gc.collect()
     pw.internals.parse_graph.G.clear()
 
     class L(pw.Schema):
@@ -141,30 +157,36 @@ def bench_join(n_rows: int = 60_000, n_keys: int = 300, batch: int = 2_000) -> N
     t0 = time.perf_counter()
     cap = GraphRunner().run_tables(out)[0]
     elapsed = time.perf_counter() - t0
-    print(
-        json.dumps(
-            {
-                "metric": "stream_join_rows_per_s",
-                "value": round(n_rows / elapsed, 1),
-                "unit": "left-rows/s",
-                "n_rows": n_rows,
-                "n_keys": n_keys,
-                "out_rows": len(cap.state.rows),
-                "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
-                "host_cores": os.cpu_count() or 1,
-                "gen_s": round(gen_s, 2),
-                "elapsed_s": round(elapsed, 2),
-            }
-        ),
-        flush=True,
-    )
+    return {
+        "metric": "stream_join_rows_per_s",
+        "value": round(n_rows / elapsed, 1),
+        "unit": "left-rows/s",
+        "n_rows": n_rows,
+        "n_keys": n_keys,
+        "out_rows": len(cap.state.rows),
+        "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
+        "host_cores": os.cpu_count() or 1,
+        "gen_s": round(gen_s, 2),
+        "elapsed_s": round(elapsed, 2),
+    }
+
+
+def bench_join(
+    n_rows: int = 60_000, n_keys: int = 300, batch: int = 2_000,
+    emit=_print_emit,
+) -> None:
+    runs = [_join_once(n_rows, n_keys, batch) for _ in range(1 + 3)][1:]
+    emit(_median_of(runs, [r["value"] for r in runs]))
 
 
 def _wordcount_once(
     n_rows: int, distinct: int, batch: int
 ) -> tuple[float, dict]:
+    import gc
+
     import pathway_tpu as pw
 
+    gc.collect()  # keep prior runs' garbage cycles out of the timed window
     pw.internals.parse_graph.G.clear()
     batches, gen_s = _materialize_wordcount(n_rows, distinct, batch)
 
@@ -271,7 +293,9 @@ def _free_port_base(n: int = 4) -> int:
     raise RuntimeError("no consecutive free port range found")
 
 
-def bench_wordcount_2rank(n_rows: int, distinct: int, batch: int) -> None:
+def bench_wordcount_2rank(
+    n_rows: int, distinct: int, batch: int, emit=_print_emit
+) -> None:
     """PATHWAY_PROCESSES=2 wordcount over the loopback TCP mesh: each rank
     generates its residue-class half, hash-exchange at the groupby
     boundary, outputs gather to rank 0."""
@@ -311,22 +335,16 @@ def bench_wordcount_2rank(n_rows: int, distinct: int, batch: int) -> None:
                 try:
                     out, err = p.communicate(timeout=600)
                 except subprocess.TimeoutExpired:
-                    print(
-                        json.dumps(
-                            {"metric": "wordcount_2rank_rows_per_s",
-                             "error": "timeout"}
-                        ),
-                        flush=True,
+                    emit(
+                        {"metric": "wordcount_2rank_rows_per_s",
+                         "error": "timeout"}
                     )
                     return
                 if p.returncode != 0:
-                    print(
-                        json.dumps(
-                            {"metric": "wordcount_2rank_rows_per_s",
-                             "error": f"rank exited {p.returncode}",
-                             "stderr_tail": err.decode()[-400:]}
-                        ),
-                        flush=True,
+                    emit(
+                        {"metric": "wordcount_2rank_rows_per_s",
+                         "error": f"rank exited {p.returncode}",
+                         "stderr_tail": err.decode()[-400:]}
                     )
                     return
                 last = out.decode().strip().splitlines()[-1]
@@ -339,44 +357,75 @@ def bench_wordcount_2rank(n_rows: int, distinct: int, batch: int) -> None:
                     q.kill()
                     q.communicate()
         elapsed = max(r["elapsed_s"] for r in results)
-        print(
-            json.dumps(
-                {
-                    "metric": "wordcount_2rank_rows_per_s",
-                    "value": round(n_rows / elapsed, 1),
-                    "unit": "rows/s",
-                    "n_rows": n_rows,
-                    "distinct": distinct,
-                    "processes": 2,
-                    "host_cores": os.cpu_count() or 1,
-                    "per_rank_elapsed_s": [
-                        round(r["elapsed_s"], 2) for r in results
-                    ],
-                    "output_changes_rank0": results[0]["changes"],
-                }
-            ),
-            flush=True,
+        emit(
+            {
+                "metric": "wordcount_2rank_rows_per_s",
+                "value": round(n_rows / elapsed, 1),
+                "unit": "rows/s",
+                "n_rows": n_rows,
+                "distinct": distinct,
+                "processes": 2,
+                "host_cores": os.cpu_count() or 1,
+                "per_rank_elapsed_s": [
+                    round(r["elapsed_s"], 2) for r in results
+                ],
+                "output_changes_rank0": results[0]["changes"],
+            }
         )
 
 
-def child(n_rows: int, distinct: int, batch: int) -> None:
-    """One measurement pass at the current PATHWAY_THREADS: best-of-2
-    wordcount (one run warms the native-extension build + import state so a
-    cold start or transient CPU-contention stall isn't recorded as steady
-    state) + the join bench. main() reuses this for the threads=1 baseline
-    so parent and thread-curve children share one measurement policy."""
+def child(n_rows: int, distinct: int, batch: int, emit=_print_emit) -> None:
+    """One measurement pass at the current PATHWAY_THREADS: warmup + 3
+    measured wordcount runs (median + dispersion recorded), then the join
+    and transform benches under the same policy. main() reuses this for
+    the threads=1 baseline so parent and thread-curve children share one
+    measurement policy."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    runs = [_wordcount_once(n_rows, distinct, batch) for _ in range(2)]
-    print(json.dumps(min(runs, key=lambda r: r[0])[1]), flush=True)
-    bench_join()
-    bench_transform()
+    _wordcount_once(n_rows, distinct, batch)  # warmup: build + imports
+    runs = [_wordcount_once(n_rows, distinct, batch)[1] for _ in range(3)]
+    emit(_median_of(runs, [r["value"] for r in runs]))
+    bench_join(emit=emit)
+    bench_transform(emit=emit)
 
 
-def main(n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000) -> None:
-    child(n_rows, distinct, batch)
+def _run_child_capture(args: list[str], env: dict, emit) -> None:
+    """Run a child bench process, re-emitting its JSON lines through the
+    parent's emit so BENCH_full.json holds the full curve. A timeout
+    still salvages whatever lines the child managed to print."""
+    stdout, stderr, exit_code = b"", b"", 0
+    try:
+        proc = subprocess.run(args, env=env, capture_output=True, timeout=900)
+        stdout, stderr, exit_code = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as exc:
+        stdout = exc.stdout or b""
+        stderr = exc.stderr or b""
+        exit_code = -1
+    for line in stdout.decode().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                emit(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    if exit_code != 0:
+        emit(
+            {
+                "metric": "bench_child_error",
+                "argv": args[1:],
+                "exit": exit_code,
+                "stderr_tail": stderr.decode()[-400:],
+            }
+        )
+
+
+def main(
+    n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000,
+    emit=_print_emit,
+) -> None:
+    child(n_rows, distinct, batch, emit=emit)
     # thread-scaling curve: same wordcount with PATHWAY_THREADS=4 and 8 in
     # fresh processes (the executor shard count is fixed at store creation).
     # Always recorded — host_cores in the artifact says whether the host can
@@ -386,24 +435,15 @@ def main(n_rows: int = 200_000, distinct: int = 5_000, batch: int = 2_000) -> No
             env = dict(
                 os.environ, PATHWAY_THREADS=nthreads, JAX_PLATFORMS="cpu"
             )
-            rc = subprocess.run(
+            _run_child_capture(
                 [
                     sys.executable, os.path.abspath(__file__),
                     str(n_rows), str(distinct), str(batch), "--child",
                 ],
-                env=env,
-                timeout=600,
-            ).returncode
-            if rc != 0:
-                print(
-                    json.dumps(
-                        {"metric": "wordcount_rows_per_s",
-                         "threads": int(nthreads),
-                         "error": f"child exited {rc}"}
-                    ),
-                    flush=True,
-                )
-        bench_wordcount_2rank(n_rows, distinct, batch)
+                env,
+                emit,
+            )
+        bench_wordcount_2rank(n_rows, distinct, batch, emit=emit)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,312 @@
+"""Datetime-typed temporal battery — transliteration of the reference's
+datetime window/join cases (reference: python/pathway/tests/temporal/
+test_windows.py:789-914 windows over naive and UTC datetimes;
+test_interval_joins.py:1178 interval joins over timestamps with timedelta
+bounds; test_asof_joins.py:326 asof over timestamps; test_time_utils.py
+inactivity detection). Event times are datetime.datetime, spans are
+datetime.timedelta — the engine must window/join them with the exact
+arithmetic it applies to ints."""
+
+from __future__ import annotations
+
+import datetime
+
+import pandas as pd
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+D = datetime.datetime
+TD = datetime.timedelta
+UTC = datetime.timezone.utc
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(
+        captures[0].state.rows.values(),
+        key=lambda r: tuple((v is None, str(v)) for v in r),
+    )
+
+
+def _dt_table(times, col="t", extra=None):
+    data = {col: list(times)}
+    if extra:
+        for name, vals in extra.items():
+            data[name] = list(vals)
+    return pw.debug.table_from_pandas(pd.DataFrame(data))
+
+
+# ---------------------------------------------------------------------------
+# windows over datetimes
+
+
+def test_tumbling_naive_datetimes():
+    times = [
+        D(2024, 1, 1, 10, 0),
+        D(2024, 1, 1, 10, 20),
+        D(2024, 1, 1, 10, 41),
+        D(2024, 1, 1, 11, 5),
+    ]
+    t = _dt_table(times)
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=TD(minutes=30))
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [
+        (D(2024, 1, 1, 10, 0), 2),
+        (D(2024, 1, 1, 10, 30), 1),
+        (D(2024, 1, 1, 11, 0), 1),
+    ]
+
+
+def test_tumbling_utc_datetimes():
+    times = [
+        D(2024, 1, 1, 10, 0, tzinfo=UTC),
+        D(2024, 1, 1, 10, 20, tzinfo=UTC),
+        D(2024, 1, 1, 10, 41, tzinfo=UTC),
+    ]
+    t = _dt_table(times)
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=TD(minutes=30))
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    got = _rows(res)
+    assert got == [
+        (D(2024, 1, 1, 10, 0, tzinfo=UTC), 2),
+        (D(2024, 1, 1, 10, 30, tzinfo=UTC), 1),
+    ]
+    # tz survives through the window columns
+    assert all(r[0].tzinfo is not None for r in got)
+
+
+def test_sliding_datetimes_with_origin():
+    origin = D(2024, 3, 1)
+    times = [origin + TD(hours=h) for h in (1, 2, 5)]
+    t = _dt_table(times)
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.sliding(
+            hop=TD(hours=2), duration=TD(hours=4), origin=origin
+        ),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [
+        (origin, 2),
+        (origin + TD(hours=2), 2),
+        (origin + TD(hours=4), 1),
+    ]
+
+
+def test_session_datetimes():
+    base = D(2024, 5, 5, 12, 0)
+    times = [
+        base,
+        base + TD(minutes=4),
+        base + TD(minutes=30),
+        base + TD(minutes=33),
+    ]
+    t = _dt_table(times)
+    res = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=TD(minutes=5))
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [
+        (base, base + TD(minutes=4), 2),
+        (base + TD(minutes=30), base + TD(minutes=33), 2),
+    ]
+
+
+def test_window_boundary_event_datetime():
+    # an event exactly on a window boundary opens the NEXT window
+    base = D(2024, 1, 1)
+    times = [base, base + TD(hours=1)]
+    t = _dt_table(times)
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=TD(hours=1))
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [(base, 1), (base + TD(hours=1), 1)]
+
+
+def test_tumbling_duration_zero_timedelta_rejected():
+    with pytest.raises(ValueError):
+        pw.temporal.tumbling(duration=TD(0))
+    with pytest.raises(ValueError):
+        pw.temporal.sliding(hop=TD(0), duration=TD(hours=1))
+
+
+# ---------------------------------------------------------------------------
+# interval join over datetimes
+
+
+def test_interval_join_timedelta_bounds():
+    lt = [D(2024, 1, 1, 12, 0), D(2024, 1, 1, 15, 0)]
+    rt = [
+        D(2024, 1, 1, 12, 20),
+        D(2024, 1, 1, 13, 30),
+        D(2024, 1, 1, 14, 45),
+    ]
+    t1 = _dt_table(lt)
+    t2 = _dt_table(rt, extra={"v": [1, 2, 3]})
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.interval(-TD(minutes=30), TD(minutes=30)),
+    ).select(lt=t1.t, v=t2.v)
+    assert _rows(res) == [
+        (D(2024, 1, 1, 12, 0), 1),
+        (D(2024, 1, 1, 15, 0), 3),
+    ]
+
+
+def test_interval_join_left_datetime_pads():
+    lt = [D(2024, 1, 1), D(2024, 6, 1)]
+    rt = [D(2024, 1, 1, 0, 10)]
+    t1 = _dt_table(lt)
+    t2 = _dt_table(rt, extra={"v": [9]})
+    res = pw.temporal.interval_join_left(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.interval(-TD(hours=1), TD(hours=1)),
+    ).select(lt=t1.t, v=t2.v)
+    assert _rows(res) == [
+        (D(2024, 1, 1), 9),
+        (D(2024, 6, 1), None),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# asof join over datetimes
+
+
+def test_asof_backward_datetimes():
+    trades = [D(2024, 2, 1, 10, 0), D(2024, 2, 1, 10, 5)]
+    quotes = [
+        D(2024, 2, 1, 9, 59),
+        D(2024, 2, 1, 10, 2),
+        D(2024, 2, 1, 10, 30),
+    ]
+    t1 = _dt_table(trades, extra={"px": [100, 101]})
+    t2 = _dt_table(quotes, extra={"bid": [95, 96, 97]})
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, how="inner"
+    ).select(px=t1.px, bid=t2.bid)
+    assert _rows(res) == [(100, 95), (101, 96)]
+
+
+def test_asof_forward_datetimes():
+    t1 = _dt_table([D(2024, 2, 1, 10, 0)], extra={"px": [100]})
+    t2 = _dt_table(
+        [D(2024, 2, 1, 9, 0), D(2024, 2, 1, 11, 0)], extra={"bid": [1, 2]}
+    )
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, how="inner",
+        direction=pw.temporal.Direction.FORWARD,
+    ).select(px=t1.px, bid=t2.bid)
+    assert _rows(res) == [(100, 2)]
+
+
+# ---------------------------------------------------------------------------
+# window join over datetimes
+
+
+def test_window_join_datetimes():
+    lt = [D(2024, 1, 1, 0, 10), D(2024, 1, 1, 2, 0)]
+    rt = [D(2024, 1, 1, 0, 50), D(2024, 1, 1, 3, 0)]
+    t1 = _dt_table(lt, extra={"a": ["x", "y"]})
+    t2 = _dt_table(rt, extra={"b": ["p", "q"]})
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.tumbling(duration=TD(hours=1)),
+    ).select(a=t1.a, b=t2.b)
+    assert _rows(res) == [("x", "p")]
+
+
+def test_session_window_join_datetimes():
+    base = D(2024, 4, 4, 9, 0)
+    lt = [base, base + TD(hours=3)]
+    rt = [base + TD(minutes=10), base + TD(hours=6)]
+    t1 = _dt_table(lt, extra={"a": [1, 2]})
+    t2 = _dt_table(rt, extra={"b": [5, 6]})
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.session(max_gap=TD(minutes=30)),
+        how="outer",
+    ).select(a=t1.a, b=t2.b)
+    assert _rows(res) == [
+        (1, 5),
+        (2, None),
+        (None, 6),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# intervals_over with datetimes
+
+
+def test_intervals_over_datetimes():
+    base = D(2024, 7, 1)
+    data = [base + TD(hours=h) for h in (0, 1, 2, 6)]
+    t = _dt_table(data, extra={"v": [1, 2, 3, 4]})
+    probes = _dt_table([base + TD(hours=1), base + TD(hours=6)], col="at")
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at,
+            lower_bound=-TD(hours=1),
+            upper_bound=TD(hours=1),
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert _rows(res) == [
+        (base + TD(hours=1), 6),
+        (base + TD(hours=6), 4),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# time utils
+
+
+def _mock_utc_now(now_value):
+    """Finite stand-in for the infinite utc_now stream (reference pattern:
+    test_time_utils.py patches utc_now with a deterministic clock)."""
+
+    def fake(refresh_rate=None):
+        return _dt_table([now_value], col="timestamp_utc")
+
+    return fake
+
+
+def test_inactivity_detection_flags_quiet_streams(monkeypatch):
+    from pathway_tpu.stdlib.temporal import time_utils
+
+    pw.internals.parse_graph.G.clear()
+    now = D(2024, 1, 1, 12, 0, tzinfo=UTC)
+    monkeypatch.setattr(time_utils, "utc_now", _mock_utc_now(now))
+    events = _dt_table(
+        [now - TD(seconds=120), now - TD(seconds=30)]
+    )
+    inactivities, resumed = pw.temporal.inactivity_detection(
+        events.t, allowed_inactivity_period=TD(seconds=5)
+    )
+    got = _rows(inactivities)
+    # latest event is 30s old vs a 5s allowance: flagged inactive since
+    # the LAST activity
+    assert got == [(now - TD(seconds=30),)]
+    assert _rows(resumed) == []
+
+
+def test_inactivity_detection_active_stream_resumed(monkeypatch):
+    from pathway_tpu.stdlib.temporal import time_utils
+
+    pw.internals.parse_graph.G.clear()
+    now = D(2024, 1, 1, 12, 0, tzinfo=UTC)
+    monkeypatch.setattr(time_utils, "utc_now", _mock_utc_now(now))
+    events = _dt_table([now - TD(seconds=2)])
+    inactivities, resumed = pw.temporal.inactivity_detection(
+        events.t, allowed_inactivity_period=TD(seconds=5)
+    )
+    assert _rows(inactivities) == []
+    assert _rows(resumed) == [(now - TD(seconds=2),)]

@@ -1,0 +1,654 @@
+"""Temporal join battery — transliteration of the reference's interval/
+asof/window join corpora to this DSL (reference: python/pathway/tests/
+temporal/test_interval_joins.py, test_asof_joins.py, test_window_joins.py).
+Expectations come from in-test oracles over the published semantics:
+
+* interval_join(a, b, ta, tb, interval(lo, up)): match iff
+  lo <= tb - ta <= up (both bounds inclusive); left/right/outer modes pad
+  unmatched rows with None;
+* asof_join backward: each left row takes the latest right row with
+  t_right <= t_left (forward: earliest with t_right >= t_left; nearest:
+  closest by |Δt|, ties broken backward);
+* window_join: rows join iff assigned a common tumbling/sliding window.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(
+        captures[0].state.rows.values(),
+        key=lambda r: tuple((v is None, v) for v in r),
+    )
+
+
+def _markdown_of(cols, rows):
+    lines = [" | ".join(cols)]
+    for r in rows:
+        lines.append(" | ".join("" if v is None else str(v) for v in r))
+    return "\n".join(lines)
+
+
+def _table_of(cols, rows):
+    return pw.debug.table_from_markdown(_markdown_of(cols, rows))
+
+
+# ---------------------------------------------------------------------------
+# interval join oracle
+
+
+def interval_oracle(lts, rts, lo, up, how):
+    """Oracle over (tag, time) rows: [(lt, rt)] pairs with None padding."""
+    out = []
+    matched_r = set()
+    for i, lt in enumerate(lts):
+        hit = False
+        for j, rt in enumerate(rts):
+            if lo <= rt - lt <= up:
+                out.append((lt, rt))
+                matched_r.add(j)
+                hit = True
+        if not hit and how in ("left", "outer"):
+            out.append((lt, None))
+    if how in ("right", "outer"):
+        for j, rt in enumerate(rts):
+            if j not in matched_r:
+                out.append((None, rt))
+    return sorted(out, key=lambda r: tuple((v is None, v) for v in r))
+
+
+MODES = ["inner", "left", "right", "outer"]
+
+
+@pytest.mark.parametrize("how", MODES)
+def test_interval_join_modes_against_oracle(how):
+    lts = [-1, 0, 2, 3, 4, 10]
+    rts = [0, 2, 3, 5, 11]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0), how=how
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == interval_oracle(lts, rts, 0, 0, how)
+
+
+@pytest.mark.parametrize("how", MODES)
+def test_interval_join_shifted_empty_interval(how):
+    # interval(2, 2): exact equality shifted by two
+    lts = [-1, 0, 2, 3, 4, 10]
+    rts = [0, 2, 3, 5, 11]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(2, 2), how=how
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == interval_oracle(lts, rts, 2, 2, how)
+
+
+@pytest.mark.parametrize("bounds", [(-3, -1), (1, 3), (-2, 5)])
+def test_interval_join_non_symmetric_bounds(bounds):
+    lo, up = bounds
+    lts = [0, 5, 10, 15]
+    rts = [1, 4, 7, 12, 16]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(lo, up)
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == interval_oracle(lts, rts, lo, up, "inner")
+
+
+def test_interval_join_bounds_inclusive_both_ends():
+    t1 = _table_of(["t"], [(10,)])
+    t2 = _table_of(["t"], [(8,), (12,), (7,), (13,)])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 2)
+    ).select(rt=t2.t)
+    assert _rows(res) == [(8,), (12,)]
+
+
+def test_interval_join_inverted_interval_raises():
+    t1 = _table_of(["t"], [(1,)])
+    t2 = _table_of(["t"], [(1,)])
+    with pytest.raises((ValueError, TypeError)):
+        pw.temporal.interval_join(
+            t1, t2, t1.t, t2.t, pw.temporal.interval(3, -3)
+        ).select(lt=t1.t)
+        GraphRunner().run_tables(
+            pw.temporal.interval_join(
+                t1, t2, t1.t, t2.t, pw.temporal.interval(3, -3)
+            ).select(lt=t1.t)
+        )
+
+
+@pytest.mark.parametrize("how", MODES)
+def test_interval_join_sharded_on_key(how):
+    lrows = [("a", 0), ("a", 5), ("b", 0), ("c", 2)]
+    rrows = [("a", 1), ("b", 0), ("b", 6), ("d", 0)]
+    t1 = _table_of(["k", "t"], lrows)
+    t2 = _table_of(["k", "t"], rrows)
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1), t1.k == t2.k,
+        how=how,
+    ).select(lk=t1.k, lt=t1.t, rk=t2.k, rt=t2.t)
+
+    def oracle():
+        out = []
+        matched_r = set()
+        for lk, lt in lrows:
+            hit = False
+            for j, (rk, rt) in enumerate(rrows):
+                if lk == rk and -1 <= rt - lt <= 1:
+                    out.append((lk, lt, rk, rt))
+                    matched_r.add(j)
+                    hit = True
+            if not hit and how in ("left", "outer"):
+                out.append((lk, lt, None, None))
+        if how in ("right", "outer"):
+            for j, (rk, rt) in enumerate(rrows):
+                if j not in matched_r:
+                    out.append((None, None, rk, rt))
+        return sorted(out, key=lambda r: tuple((v is None, v) for v in r))
+
+    assert _rows(res) == oracle()
+
+
+def test_interval_join_multiple_equality_keys():
+    lrows = [("a", 1, 0), ("a", 2, 0), ("b", 1, 0)]
+    rrows = [("a", 1, 0), ("a", 2, 5), ("b", 2, 0)]
+    t1 = _table_of(["k", "g", "t"], lrows)
+    t2 = _table_of(["k", "g", "t"], rrows)
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-1, 1),
+        t1.k == t2.k, t1.g == t2.g,
+    ).select(k=t1.k, g=t1.g)
+    assert _rows(res) == [("a", 1)]
+
+
+def test_interval_join_float_bounds():
+    lts = [0.0, 1.0, 2.5]
+    rts = [0.4, 1.6, 2.4]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-0.5, 0.5)
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == interval_oracle(lts, rts, -0.5, 0.5, "inner")
+
+
+def test_interval_join_select_expressions():
+    # select can compute over both sides, not just project
+    t1 = _table_of(["t", "v"], [(0, 10), (5, 20)])
+    t2 = _table_of(["t", "w"], [(1, 1), (6, 2)])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 2)
+    ).select(sum_=t1.v + t2.w, dt=t2.t - t1.t)
+    assert _rows(res) == [(11, 1), (22, 1)]
+
+
+def test_interval_join_outer_pad_coalesce():
+    t1 = _table_of(["t", "v"], [(0, 10), (50, 99)])
+    t2 = _table_of(["t", "w"], [(1, 7)])
+    res = pw.temporal.interval_join_left(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(-2, 2)
+    ).select(v=t1.v, w=pw.coalesce(t2.w, -1))
+    assert _rows(res) == [(10, 7), (99, -1)]
+
+
+def test_interval_join_duplicate_times_multiply():
+    # two identical left rows x two identical right matches = 4 pairs
+    t1 = _table_of(["t", "side"], [(0, "l1"), (0, "l2")])
+    t2 = _table_of(["t", "side"], [(0, "r1"), (0, "r2")])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0)
+    ).select(a=t1.side, b=t2.side)
+    assert _rows(res) == [
+        ("l1", "r1"),
+        ("l1", "r2"),
+        ("l2", "r1"),
+        ("l2", "r2"),
+    ]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_interval_join_oracle_sweep(seed):
+    rng = random.Random(seed)
+    lts = [rng.randint(-20, 20) for _ in range(25)]
+    rts = [rng.randint(-20, 20) for _ in range(25)]
+    lo = rng.randint(-5, 0)
+    up = rng.randint(0, 5)
+    how = MODES[seed % 4]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(lo, up), how=how
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == interval_oracle(lts, rts, lo, up, how)
+
+
+# ---------------------------------------------------------------------------
+# asof join oracle
+
+
+def asof_oracle(lrows, rrows, direction, how):
+    """Oracle over (key, time, payload) rows. Returns
+    [(lt, lv, rt_or_None, rv_or_None)] per left row (left/outer modes),
+    plus unmatched right rows for right/outer."""
+    out = []
+    used_right = set()
+    for lk, lt, lv in lrows:
+        cands = [
+            (j, rt, rv)
+            for j, (rk, rt, rv) in enumerate(rrows)
+            if rk == lk
+            and (
+                (direction == "backward" and rt <= lt)
+                or (direction == "forward" and rt >= lt)
+                or direction == "nearest"
+            )
+        ]
+        if direction == "backward":
+            cands.sort(key=lambda c: c[1])
+            pick = cands[-1] if cands else None
+        elif direction == "forward":
+            cands.sort(key=lambda c: c[1])
+            pick = cands[0] if cands else None
+        else:  # nearest: min |dt|, ties backward (rt <= lt preferred)
+            pick = None
+            if cands:
+                pick = min(
+                    cands, key=lambda c: (abs(c[1] - lt), c[1] > lt, c[1])
+                )
+        if pick is not None:
+            out.append((lt, lv, pick[1], pick[2]))
+            used_right.add(pick[0])
+        elif how in ("left", "outer"):
+            out.append((lt, lv, None, None))
+    if how in ("right", "outer"):
+        for j, (rk, rt, rv) in enumerate(rrows):
+            if j not in used_right:
+                out.append((None, None, rt, rv))
+    return sorted(out, key=lambda r: tuple((v is None, v) for v in r))
+
+
+def test_asof_backward_basic():
+    lrows = [("A", 10, 1), ("A", 20, 2), ("A", 5, 3)]
+    rrows = [("A", 8, 95), ("A", 15, 96), ("A", 30, 99)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k, how="inner"
+    ).select(lt=t1.t, lv=t1.v, rt=t2.t, rv=t2.v)
+    assert _rows(res) == asof_oracle(lrows, rrows, "backward", "inner")
+
+
+def test_asof_backward_left_pads():
+    lrows = [("A", 5, 1), ("A", 10, 2)]
+    rrows = [("A", 8, 95)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join_left(
+        t1, t2, t1.t, t2.t, t1.k == t2.k
+    ).select(lt=t1.t, lv=t1.v, rt=t2.t, rv=t2.v)
+    assert _rows(res) == asof_oracle(lrows, rrows, "backward", "left")
+
+
+def test_asof_forward():
+    lrows = [("A", 10, 1), ("A", 29, 2)]
+    rrows = [("A", 8, 95), ("A", 15, 96), ("A", 30, 99)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k,
+        how="inner", direction=pw.temporal.Direction.FORWARD,
+    ).select(lt=t1.t, lv=t1.v, rt=t2.t, rv=t2.v)
+    assert _rows(res) == asof_oracle(lrows, rrows, "forward", "inner")
+
+
+def test_asof_nearest():
+    lrows = [("A", 10, 1), ("A", 21, 2)]
+    rrows = [("A", 7, 95), ("A", 12, 96), ("A", 40, 99)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k,
+        how="inner", direction=pw.temporal.Direction.NEAREST,
+    ).select(lt=t1.t, lv=t1.v, rt=t2.t, rv=t2.v)
+    assert _rows(res) == asof_oracle(lrows, rrows, "nearest", "inner")
+
+
+def test_asof_exact_tie_goes_backward_match():
+    # right row exactly at left time matches in backward mode
+    lrows = [("A", 10, 1)]
+    rrows = [("A", 10, 7)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k, how="inner"
+    ).select(rv=t2.v)
+    assert _rows(res) == [(7,)]
+
+
+def test_asof_defaults_fill_unmatched():
+    lrows = [("A", 5, 1)]
+    rrows = [("A", 8, 95)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    joined = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k,
+        how="left", defaults={t2.v: -1},
+    ).select(lv=t1.v, rv=t2.v)
+    assert _rows(joined) == [(1, -1)]
+
+
+def test_asof_multiple_keys_partition():
+    lrows = [("A", 10, 1), ("B", 10, 2), ("C", 10, 3)]
+    rrows = [("A", 9, 91), ("B", 8, 92)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join_left(
+        t1, t2, t1.t, t2.t, t1.k == t2.k
+    ).select(k=t1.k, rv=t2.v)
+    assert _rows(res) == [("A", 91), ("B", 92), ("C", None)]
+
+
+@pytest.mark.parametrize(
+    "direction",
+    [
+        pw.temporal.Direction.BACKWARD,
+        pw.temporal.Direction.FORWARD,
+        pw.temporal.Direction.NEAREST,
+    ],
+)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_asof_oracle_sweep(direction, seed):
+    rng = random.Random(seed * 7 + 1)
+    keys = ["a", "b"]
+    lrows = [
+        (rng.choice(keys), rng.randint(0, 40), i) for i in range(20)
+    ]
+    # distinct right times per key: nearest-tie semantics stay unambiguous
+    rrows = []
+    used = set()
+    for i in range(20):
+        k = rng.choice(keys)
+        t = rng.randint(0, 40)
+        if (k, t) in used:
+            continue
+        used.add((k, t))
+        rrows.append((k, t, 100 + i))
+    dname = direction.name.lower()
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.asof_join(
+        t1, t2, t1.t, t2.t, t1.k == t2.k, how="inner", direction=direction
+    ).select(lt=t1.t, lv=t1.v, rt=t2.t, rv=t2.v)
+    want = asof_oracle(lrows, rrows, dname, "inner")
+    got = _rows(res)
+    if dname != "nearest":
+        assert got == want
+    else:
+        # nearest ties between equal |dt| right rows may pick either side
+        # when both exist; compare pair counts and distances
+        assert len(got) == len(want)
+        for (glt, _gv, grt, _grv), (wlt, _wv, wrt, _wrv) in zip(
+            sorted(got), sorted(want)
+        ):
+            assert glt == wlt and abs(grt - glt) == abs(wrt - wlt)
+
+
+# ---------------------------------------------------------------------------
+# window join
+
+
+def window_pairs_oracle(lts, rts, hop, duration, how):
+    def windows(t):
+        k_hi = (t - 0) // hop
+        out = []
+        k = k_hi
+        while k * hop + duration > t:
+            if k * hop <= t:
+                out.append(k)
+            k -= 1
+        return out
+
+    out = []
+    matched_r = set()
+    for lt in lts:
+        hit = False
+        for j, rt in enumerate(rts):
+            common = set(windows(lt)) & set(windows(rt))
+            for _w in common:
+                out.append((lt, rt))
+                matched_r.add(j)
+                hit = True
+        if not hit and how in ("left", "outer"):
+            out.append((lt, None))
+    if how in ("right", "outer"):
+        for j, rt in enumerate(rts):
+            if j not in matched_r:
+                out.append((None, rt))
+    return sorted(out, key=lambda r: tuple((v is None, v) for v in r))
+
+
+@pytest.mark.parametrize("how", MODES)
+def test_window_join_tumbling_modes(how):
+    lts = [1, 4, 7, 12]
+    rts = [2, 8, 9, 20]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5), how=how
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == window_pairs_oracle(lts, rts, 5, 5, how)
+
+
+def test_window_join_sliding_multi_window_pairs():
+    # sliding windows overlap: a pair sharing TWO windows appears twice
+    t1 = _table_of(["t"], [(2,)])
+    t2 = _table_of(["t"], [(3,)])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.sliding(hop=2, duration=4)
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == window_pairs_oracle([2], [3], 2, 4, "inner")
+    assert len(_rows(res)) == 2
+
+
+def test_window_join_with_equality_key():
+    lrows = [("a", 1), ("b", 1)]
+    rrows = [("a", 2), ("c", 2)]
+    t1 = _table_of(["k", "t"], lrows)
+    t2 = _table_of(["k", "t"], rrows)
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5),
+        t1.k == t2.k,
+    ).select(k=t1.k)
+    assert _rows(res) == [("a",)]
+
+
+def test_window_join_left_pads_unmatched():
+    t1 = _table_of(["t", "v"], [(1, 10), (11, 20)])
+    t2 = _table_of(["t", "w"], [(2, 7)])
+    res = pw.temporal.window_join_left(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(v=t1.v, w=t2.w)
+    assert _rows(res) == [(10, 7), (20, None)]
+
+
+def test_window_join_select_expressions():
+    t1 = _table_of(["t", "v"], [(1, 10)])
+    t2 = _table_of(["t", "w"], [(2, 7)])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=5)
+    ).select(s=t1.v + t2.w)
+    assert _rows(res) == [(17,)]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_window_join_oracle_sweep(seed):
+    rng = random.Random(seed + 11)
+    lts = [rng.randint(0, 30) for _ in range(15)]
+    rts = [rng.randint(0, 30) for _ in range(15)]
+    how = MODES[seed % 4]
+    t1 = _table_of(["t"], [(x,) for x in lts])
+    t2 = _table_of(["t"], [(x,) for x in rts])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.tumbling(duration=4), how=how
+    ).select(lt=t1.t, rt=t2.t)
+    assert _rows(res) == window_pairs_oracle(lts, rts, 4, 4, how)
+
+
+# ---------------------------------------------------------------------------
+# typing / validation
+
+
+def test_interval_join_rejects_mismatched_time_types():
+    t1 = _table_of(["t"], [(1,)])
+    t2 = _table_of(["s"], [("x",)])
+    with pytest.raises((TypeError, ValueError, Exception)):
+        r = pw.temporal.interval_join(
+            t1, t2, t1.t, t2.s, pw.temporal.interval(-1, 1)
+        ).select(lt=t1.t)
+        GraphRunner().run_tables(r)
+
+
+def test_no_extra_columns_leak_through_select():
+    t1 = _table_of(["t", "v"], [(0, 1)])
+    t2 = _table_of(["t", "w"], [(0, 2)])
+    res = pw.temporal.interval_join(
+        t1, t2, t1.t, t2.t, pw.temporal.interval(0, 0)
+    ).select(v=t1.v)
+    cols = set(res.column_names())
+    assert cols == {"v"}
+
+
+# ---------------------------------------------------------------------------
+# session window join (reference: test_window_joins.py:406-740 — sessions
+# built over the UNION of both sides' times; all left rows in a session
+# join all right rows in it)
+
+
+def session_join_oracle(lrows, rrows, max_gap, how, keyed=False):
+    """Oracle over (key, t, v) rows. Sessions merge the union of both
+    sides' times per key with gap < max_gap (strict, matching
+    session(max_gap)); output pairs (lv, rv) with None padding."""
+    from collections import defaultdict
+
+    groups = defaultdict(list)
+    for i, (k, t, v) in enumerate(lrows):
+        groups[k if keyed else None].append(("L", t, i))
+    for j, (k, t, v) in enumerate(rrows):
+        groups[k if keyed else None].append(("R", t, j))
+    out = []
+    matched_l, matched_r = set(), set()
+    for _k, events in groups.items():
+        events.sort(key=lambda e: (e[1], e[0], e[2]))
+        sessions = []
+        for e in events:
+            if sessions and e[1] - sessions[-1][-1][1] < max_gap:
+                sessions[-1].append(e)
+            else:
+                sessions.append([e])
+        for sess in sessions:
+            ls = [e[2] for e in sess if e[0] == "L"]
+            rs = [e[2] for e in sess if e[0] == "R"]
+            for li in ls:
+                for rj in rs:
+                    out.append((lrows[li][2], rrows[rj][2]))
+                    matched_l.add(li)
+                    matched_r.add(rj)
+    if how in ("left", "outer"):
+        for i in range(len(lrows)):
+            if i not in matched_l:
+                out.append((lrows[i][2], None))
+    if how in ("right", "outer"):
+        for j in range(len(rrows)):
+            if j not in matched_r:
+                out.append((None, rrows[j][2]))
+    return sorted(out, key=lambda r: tuple((v is None, v) for v in r))
+
+
+@pytest.mark.parametrize("how", MODES)
+@pytest.mark.parametrize("max_gap", [2, 3])
+def test_session_window_join_time_only(how, max_gap):
+    # the reference's canonical session-join scenario shape: two streams
+    # whose union times chain into sessions of varying extent
+    lrows = [(None, 0, 1), (None, 5, 2), (None, 10, 3), (None, 15, 4),
+             (None, 17, 5)]
+    rrows = [(None, -3, 1), (None, 2, 2), (None, 3, 3), (None, 6, 4),
+             (None, 16, 5)]
+    t1 = _table_of(["t", "v"], [(t, v) for _k, t, v in lrows])
+    t2 = _table_of(["t", "v"], [(t, v) for _k, t, v in rrows])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=max_gap), how=how
+    ).select(a=t1.v, b=t2.v)
+    assert _rows(res) == session_join_oracle(lrows, rrows, max_gap, how)
+
+
+@pytest.mark.parametrize("how", MODES)
+def test_session_window_join_sharded(how):
+    lrows = [("a", 0, 1), ("a", 2, 2), ("b", 0, 3), ("c", 9, 4)]
+    rrows = [("a", 1, 1), ("b", 7, 2), ("c", 10, 3), ("d", 0, 4)]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=3),
+        t1.k == t2.k, how=how,
+    ).select(a=t1.v, b=t2.v)
+    assert _rows(res) == session_join_oracle(
+        lrows, rrows, 3, how, keyed=True
+    )
+
+
+def test_session_window_join_predicate():
+    t1 = _table_of(["t", "v"], [(0, 1), (10, 2)])
+    t2 = _table_of(["t", "v"], [(1, 5), (12, 6), (30, 7)])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t,
+        pw.temporal.session(predicate=lambda a, b: b - a <= 2),
+    ).select(a=t1.v, b=t2.v)
+    assert _rows(res) == [(1, 5), (2, 6)]
+
+
+def test_session_window_join_whole_chain_merges():
+    # alternating sides chaining one long session: full cross product
+    lrows = [(None, 0, 1), (None, 2, 2)]
+    rrows = [(None, 1, 8), (None, 3, 9)]
+    t1 = _table_of(["t", "v"], [(t, v) for _k, t, v in lrows])
+    t2 = _table_of(["t", "v"], [(t, v) for _k, t, v in rrows])
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=2)
+    ).select(a=t1.v, b=t2.v)
+    assert _rows(res) == [(1, 8), (1, 9), (2, 8), (2, 9)]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_session_window_join_oracle_sweep(seed):
+    rng = random.Random(seed + 23)
+    keys = ["a", "b"]
+    lrows = [
+        (rng.choice(keys), rng.randint(0, 40), 100 + i)
+        for i in range(12)
+    ]
+    rrows = [
+        (rng.choice(keys), rng.randint(0, 40), 200 + i)
+        for i in range(12)
+    ]
+    how = MODES[seed % 4]
+    t1 = _table_of(["k", "t", "v"], lrows)
+    t2 = _table_of(["k", "t", "v"], rrows)
+    res = pw.temporal.window_join(
+        t1, t2, t1.t, t2.t, pw.temporal.session(max_gap=4),
+        t1.k == t2.k, how=how,
+    ).select(a=t1.v, b=t2.v)
+    assert _rows(res) == session_join_oracle(
+        lrows, rrows, 4, how, keyed=True
+    )

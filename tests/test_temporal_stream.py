@@ -1,0 +1,463 @@
+"""Streaming temporal-behavior battery — transliteration of the
+reference's stream corpora (reference: python/pathway/tests/temporal/
+test_windows_stream.py, test_interval_joins_stream.py,
+test_asof_joins_stream.py, test_asof_now_joins.py).
+
+Each scenario drives a ConnectorSubject that commits in deterministic
+rounds (one engine timestamp per commit) and asserts on the on_change
+update STREAM — not just the final state — because behaviors are about
+WHEN results appear and whether they are later revised or withdrawn:
+
+* no behavior: every commit updates affected windows immediately
+  (retract + insert pairs);
+* common_behavior(delay): updates buffered until the watermark passes
+  t+delay — fewer, batched emissions;
+* common_behavior(cutoff, keep_results=True): events later than cutoff
+  behind the watermark are ignored, but closed windows keep their output;
+* keep_results=False: windows behind the cutoff are withdrawn from the
+  output as the watermark advances;
+* exactly_once: one final emission per window, no intermediates.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def run_windowed_stream(commits, window, behavior, reducer="count"):
+    """Drive `commits` (list of lists of t values) through windowby and
+    record the full update stream as (window_start, value, is_addition)."""
+    pw.internals.parse_graph.G.clear()
+
+    class Events(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for batch in commits:
+                for t in batch:
+                    self.next(t=t)
+                self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    events_t = pw.io.python.read(
+        Events(), schema=S, autocommit_duration_ms=None
+    )
+    red = (
+        {"c": pw.reducers.count()}
+        if reducer == "count"
+        else {"c": pw.reducers.max(pw.this.t)}
+    )
+    res = events_t.windowby(
+        events_t.t, window=window, behavior=behavior
+    ).reduce(start=pw.this._pw_window_start, **red)
+    updates: list[tuple] = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (row["start"], row["c"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return updates
+
+
+def final_state(updates):
+    live: dict = {}
+    for start, v, add in updates:
+        if add:
+            live[(start, v)] = live.get((start, v), 0) + 1
+        else:
+            live[(start, v)] = live.get((start, v), 0) - 1
+    return sorted(k for k, c in live.items() if c > 0)
+
+
+# ---------------------------------------------------------------------------
+# no behavior: eager updates with revisions
+
+
+def test_stream_no_behavior_revises_eagerly():
+    updates = run_windowed_stream(
+        [[1], [2], [7]], pw.temporal.tumbling(duration=5), None
+    )
+    # window 0 appears with c=1, is revised to c=2 (retract+insert),
+    # window 5 appears once
+    assert (0, 1, True) in updates
+    assert (0, 1, False) in updates and (0, 2, True) in updates
+    assert final_state(updates) == [(0, 2), (5, 1)]
+
+
+def test_stream_no_behavior_late_event_still_lands():
+    # without a cutoff, an event far behind the watermark still revises
+    # its (old) window
+    updates = run_windowed_stream(
+        [[1], [100], [2]], pw.temporal.tumbling(duration=5), None
+    )
+    assert final_state(updates) == [(0, 2), (100, 1)]
+
+
+# ---------------------------------------------------------------------------
+# delay: batching
+
+
+def test_stream_delay_buffers_until_watermark():
+    # delay=4: event t=1 not emitted until watermark reaches 5
+    updates = run_windowed_stream(
+        [[1], [2], [3], [20]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(delay=4),
+    )
+    # the three early events coalesce: window 0 appears directly at c=3
+    # (no c=1 / c=2 intermediates)
+    assert (0, 3, True) in updates
+    assert (0, 1, True) not in updates and (0, 2, True) not in updates
+    assert final_state(updates) == [(0, 3), (20, 1)]
+
+
+def test_stream_zero_delay_equals_no_behavior_finals():
+    a = run_windowed_stream(
+        [[1], [2], [7]], pw.temporal.tumbling(duration=5), None
+    )
+    b = run_windowed_stream(
+        [[1], [2], [7]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(delay=0),
+    )
+    assert final_state(a) == final_state(b)
+
+
+# ---------------------------------------------------------------------------
+# cutoff: late events ignored, optionally withdrawing closed windows
+
+
+def test_stream_cutoff_drops_late_events_keep_results():
+    # watermark advances to 20; event t=1 arrives 19 late with cutoff=3:
+    # its window's result must NOT change
+    updates = run_windowed_stream(
+        [[2], [20], [1]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(cutoff=3, keep_results=True),
+    )
+    assert final_state(updates) == [(0, 1), (20, 1)]  # c stays 1
+
+
+def test_stream_cutoff_remove_results_withdraws_closed_windows():
+    updates = run_windowed_stream(
+        [[2], [30]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(cutoff=3, keep_results=False),
+    )
+    # window 0 appeared, then was withdrawn when the watermark passed
+    # its end + cutoff
+    assert (0, 1, True) in updates
+    assert (0, 1, False) in updates
+    assert final_state(updates) == [(30, 1)]
+
+
+def test_stream_cutoff_on_time_events_still_revise():
+    # event inside the cutoff window still updates its window
+    updates = run_windowed_stream(
+        [[2], [4], [6]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(cutoff=10, keep_results=True),
+    )
+    assert final_state(updates) == [(0, 2), (5, 1)]
+
+
+def test_stream_delay_and_cutoff_compose():
+    updates = run_windowed_stream(
+        [[1], [2], [3], [25], [2]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.common_behavior(delay=4, cutoff=3, keep_results=True),
+    )
+    # batched emission c=3; the late retry of t=2 after watermark 25 is
+    # dropped by the cutoff
+    assert (0, 3, True) in updates
+    assert final_state(updates) == [(0, 3), (25, 1)]
+
+
+def test_stream_remove_results_requires_cutoff():
+    with pytest.raises(AssertionError):
+        pw.temporal.common_behavior(keep_results=False)
+
+
+# ---------------------------------------------------------------------------
+# exactly_once
+
+
+def test_stream_exactly_once_single_emission_per_window():
+    updates = run_windowed_stream(
+        [[1], [2], [7], [11]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.exactly_once_behavior(),
+    )
+    w0 = [u for u in updates if u[0] == 0]
+    assert w0 == [(0, 2, True)]
+    # window [5,10): closed when watermark passed 10
+    w5 = [u for u in updates if u[0] == 5]
+    assert w5 == [(5, 1, True)]
+
+
+def test_stream_exactly_once_shift_extends_lateness_window():
+    # shift moves the single emission point to end+shift, which also
+    # extends how late an event may arrive: watermark 6 closes window
+    # [0,5) without shift (late t=2 dropped) but NOT with shift=3
+    # (closure at 8 > 6, so t=2 still counts). End-of-stream flushes
+    # buffered windows either way — the final counts differ.
+    updates_noshift = run_windowed_stream(
+        [[1], [6], [2]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.exactly_once_behavior(),
+    )
+    updates_shift = run_windowed_stream(
+        [[1], [6], [2]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.exactly_once_behavior(shift=3),
+    )
+    assert [u for u in updates_noshift if u[0] == 0] == [(0, 1, True)]
+    assert [u for u in updates_shift if u[0] == 0] == [(0, 2, True)]
+
+
+def test_stream_exactly_once_no_retractions_ever():
+    updates = run_windowed_stream(
+        [[1], [2], [3], [4], [9], [14]],
+        pw.temporal.tumbling(duration=5),
+        pw.temporal.exactly_once_behavior(),
+    )
+    assert all(add for _s, _c, add in updates)
+
+
+# ---------------------------------------------------------------------------
+# interval join under behavior (forgetting)
+
+
+def run_interval_join_stream(l_commits, r_commits, iv, behavior, how="inner"):
+    pw.internals.parse_graph.G.clear()
+
+    class Left(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for batch in l_commits:
+                for t in batch:
+                    self.next(t=t)
+                self.commit()
+
+    class Right(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            import time as _t
+
+            for batch in r_commits:
+                _t.sleep(0.05)  # interleave after left commits
+                for t in batch:
+                    self.next(t=t)
+                self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    lt = pw.io.python.read(Left(), schema=S, autocommit_duration_ms=None)
+    rt = pw.io.python.read(Right(), schema=S, autocommit_duration_ms=None)
+    res = pw.temporal.interval_join(
+        lt, rt, lt.t, rt.t, iv, behavior=behavior, how=how
+    ).select(lt_=lt.t, rt_=rt.t)
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: updates.append(
+            (row["lt_"], row["rt_"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return updates
+
+
+def test_interval_join_stream_matches_without_behavior():
+    updates = run_interval_join_stream(
+        [[0], [10]], [[1], [11]], pw.temporal.interval(-2, 2), None
+    )
+    live = {(l, r) for l, r, a in updates if a}
+    assert live == {(0, 1), (10, 11)}
+
+
+def test_interval_join_stream_cutoff_forgets_old_rows():
+    # with a cutoff, a left row arriving far behind the watermark finds
+    # its old right partner already forgotten
+    updates = run_interval_join_stream(
+        [[0], [100], [1]],
+        [[0], [100]],
+        pw.temporal.interval(-2, 2),
+        pw.temporal.common_behavior(cutoff=10, keep_results=True),
+    )
+    live = [(l, r) for l, r, a in updates if a]
+    assert (0, 0) in live and (100, 100) in live
+    # the late left t=1 must NOT match the forgotten right t=0
+    assert (1, 0) not in live
+
+
+# ---------------------------------------------------------------------------
+# asof_now: requests answered against current state, never revised
+
+
+def test_asof_now_join_answers_are_frozen():
+    pw.internals.parse_graph.G.clear()
+    import threading
+
+    first_answered = threading.Event()
+
+    class Rates(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(cur="usd", rate=1)
+            self.commit()
+            first_answered.wait(timeout=5)
+            self.next(cur="usd", rate=2)
+            self.commit()
+
+    class Queries(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            _t.sleep(0.3)
+            self.next(qid=1, cur="usd")
+            self.commit()
+            _t.sleep(0.3)
+            first_answered.set()
+            _t.sleep(0.3)
+            self.next(qid=2, cur="usd")
+            self.commit()
+
+    class RS(pw.Schema):
+        cur: str = pw.column_definition(primary_key=True)
+        rate: int
+
+    class QS(pw.Schema):
+        qid: int = pw.column_definition(primary_key=True)
+        cur: str
+
+    rates = pw.io.python.read(Rates(), schema=RS, autocommit_duration_ms=None)
+    queries = pw.io.python.read(
+        Queries(), schema=QS, autocommit_duration_ms=None
+    )
+    res = pw.temporal.asof_now_join(
+        queries, rates, queries.cur == rates.cur
+    ).select(qid=queries.qid, rate=rates.rate)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: events.append(
+            (row["qid"], row["rate"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # query 1 answered at rate 1 and NEVER revised; query 2 sees rate 2
+    assert (1, 1, True) in events
+    assert (1, 1, False) not in events and (1, 2, True) not in events
+    assert (2, 2, True) in events
+
+
+def test_asof_now_join_left_unmatched_gets_none():
+    pw.internals.parse_graph.G.clear()
+
+    class Rates(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(cur="usd", rate=1)
+            self.commit()
+
+    class Queries(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            _t.sleep(0.3)
+            self.next(qid=1, cur="eur")
+            self.commit()
+
+    class RS(pw.Schema):
+        cur: str = pw.column_definition(primary_key=True)
+        rate: int
+
+    class QS(pw.Schema):
+        qid: int = pw.column_definition(primary_key=True)
+        cur: str
+
+    rates = pw.io.python.read(Rates(), schema=RS, autocommit_duration_ms=None)
+    queries = pw.io.python.read(
+        Queries(), schema=QS, autocommit_duration_ms=None
+    )
+    res = pw.temporal.asof_now_join_left(
+        queries, rates, queries.cur == rates.cur
+    ).select(qid=queries.qid, rate=rates.rate)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: events.append(
+            (row["qid"], row["rate"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert (1, None, True) in events
+
+
+# ---------------------------------------------------------------------------
+# windowed joins and asof under behaviors — final-state checks
+
+
+def test_asof_join_stream_incremental_revision():
+    """A late right row IN RANGE revises earlier asof answers when no
+    behavior restricts it."""
+    pw.internals.parse_graph.G.clear()
+
+    class Left(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next(t=10, v=1)
+            self.commit()
+
+    class Right(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            import time as _t
+
+            _t.sleep(0.2)
+            self.next(t=5, w=50)
+            self.commit()
+            _t.sleep(0.2)
+            self.next(t=8, w=80)  # closer: must win retroactively
+            self.commit()
+
+    class LS(pw.Schema):
+        t: int
+        v: int
+
+    class RS(pw.Schema):
+        t: int
+        w: int
+
+    lt = pw.io.python.read(Left(), schema=LS, autocommit_duration_ms=None)
+    rt = pw.io.python.read(Right(), schema=RS, autocommit_duration_ms=None)
+    res = pw.temporal.asof_join(
+        lt, rt, lt.t, rt.t, how="left"
+    ).select(v=lt.v, w=rt.w)
+    events = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: events.append(
+            (row["v"], row["w"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    live = {}
+    for v, w, add in events:
+        if add:
+            live[v] = w
+        elif live.get(v) == w:
+            del live[v]
+    assert live == {1: 80}
+    # and the intermediate answer 50 was visible then retracted
+    assert (1, 50, True) in events and (1, 50, False) in events

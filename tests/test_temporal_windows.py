@@ -1,0 +1,512 @@
+"""Window operator battery — transliteration of the reference's windows
+test corpus to this DSL (reference: python/pathway/tests/temporal/
+test_windows.py — tumbling/sliding/session assignment, origins, floats,
+datetimes, intervals_over, argument validation). Expectations are computed
+by in-test oracles or written out by hand from the window definitions:
+
+* tumbling(duration, origin): half-open [start, start+duration) aligned to
+  origin (default 0);
+* sliding(hop, duration, origin): every window [origin + k*hop, +duration)
+  that contains the event;
+* session(max_gap): events whose consecutive gap is < max_gap merge;
+  window start/end are the min/max event times of the merged run;
+* intervals_over(at, lower_bound, upper_bound): one window per `at` row
+  collecting events with at+lower <= t <= at+upper.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.graph_runner import GraphRunner
+
+
+def _rows(table):
+    captures = GraphRunner().run_tables(table)
+    return sorted(
+        captures[0].state.rows.values(),
+        key=lambda r: tuple((v is None, v) for v in r),
+    )
+
+
+def _markdown_of(cols, rows):
+    lines = [" | ".join(cols)]
+    for r in rows:
+        lines.append(" | ".join("" if v is None else str(v) for v in r))
+    return "\n".join(lines)
+
+
+def _table_of(cols, rows):
+    return pw.debug.table_from_markdown(_markdown_of(cols, rows))
+
+
+# ---------------------------------------------------------------------------
+# oracles
+
+
+def tumbling_oracle(times, duration, origin=0):
+    """[(start, end, [times...])] for every non-empty window."""
+    byw = {}
+    for t in times:
+        k = math.floor((t - origin) / duration)
+        start = origin + k * duration
+        byw.setdefault((start, start + duration), []).append(t)
+    return byw
+
+
+def sliding_oracle(times, hop, duration, origin=0):
+    byw = {}
+    for t in times:
+        # windows [origin + k*hop, +duration) containing t
+        k_max = math.floor((t - origin) / hop)
+        k = k_max
+        while origin + k * hop + duration > t:
+            start = origin + k * hop
+            if start <= t:
+                byw.setdefault((start, start + duration), []).append(t)
+            k -= 1
+    return byw
+
+
+def session_oracle(times, max_gap):
+    runs = []
+    for t in sorted(times):
+        if runs and t - runs[-1][-1] < max_gap:
+            runs[-1].append(t)
+        else:
+            runs.append([t])
+    return {(r[0], r[-1]): r for r in runs}
+
+
+# ---------------------------------------------------------------------------
+# tumbling
+
+
+def test_tumbling_counts_and_edges():
+    # events on exact boundaries land in the window they OPEN (half-open)
+    times = [0, 4, 5, 9, 10, 14, 15]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    oracle = tumbling_oracle(times, 5)
+    assert _rows(res) == sorted(
+        (s, e, len(ts)) for (s, e), ts in oracle.items()
+    )
+    # boundary event 5 is in [5,10), not [0,5)
+    assert (0, 5, 2) in _rows(res) and (5, 10, 2) in _rows(res)
+
+
+def test_tumbling_negative_times():
+    times = [-7, -5, -1, 0, 3]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    assert _rows(res) == [(-10, 1), (-5, 2), (0, 2)]
+
+
+def test_tumbling_origin_shifts_grid_and_drops_pre_origin():
+    # reference semantics (test_windows.py:618): the grid starts AT the
+    # origin; events before it belong to no window
+    times = [1, 2, 3, 7, 8]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5, origin=2)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [(2, 2), (7, 2)]  # event t=1 dropped
+
+
+def test_tumbling_float_durations():
+    times = [0.0, 0.49, 0.5, 1.2, 1.49]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=0.5)).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    assert _rows(res) == [(0.0, 2), (0.5, 1), (1.0, 2)]
+
+
+def test_tumbling_instance_partitions():
+    rows = [("a", 1), ("a", 6), ("b", 1), ("b", 2), ("c", 11)]
+    t = _table_of(["k", "t"], rows)
+    res = t.windowby(
+        t.t, window=pw.temporal.tumbling(duration=5), instance=t.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [
+        ("a", 0, 1),
+        ("a", 5, 1),
+        ("b", 0, 2),
+        ("c", 10, 1),
+    ]
+
+
+def test_tumbling_with_other_reducers():
+    rows = [(1, 10), (2, 20), (3, 30), (7, 70)]
+    t = _table_of(["t", "v"], rows)
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        mn=pw.reducers.min(pw.this.v),
+        a=pw.reducers.avg(pw.this.v),
+    )
+    assert _rows(res) == [(0, 60, 30, 10, 20.0), (5, 70, 70, 70, 70.0)]
+
+
+def test_tumbling_window_cols_available_in_this():
+    t = _table_of(["t"], [(3,)])
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=4)).reduce(
+        both=pw.this._pw_window_end - pw.this._pw_window_start,
+    )
+    assert _rows(res) == [(4,)]
+
+
+# ---------------------------------------------------------------------------
+# sliding
+
+
+def test_sliding_overlapping_windows():
+    times = [0, 1, 2, 3, 4, 5, 6]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    oracle = sliding_oracle(times, 2, 4)
+    assert _rows(res) == sorted(
+        (s, e, len(ts)) for (s, e), ts in oracle.items()
+    )
+
+
+def test_sliding_larger_hop_skips_events():
+    # hop > duration: gaps — events between windows appear in none
+    times = [0, 1, 2, 3, 4, 5, 6, 7, 8, 9]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=4, duration=2)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    oracle = sliding_oracle(times, 4, 2)
+    assert _rows(res) == sorted((s, len(ts)) for (s, _e), ts in oracle.items())
+    # events 2, 3 fall between [0,2) and [4,6): never reduced
+    covered = {t for ts in oracle.values() for t in ts}
+    assert 2 not in covered and 3 not in covered
+
+
+def test_sliding_origin():
+    times = [1, 3, 5]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, duration=2, origin=1)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [(1, 1), (3, 1), (5, 1)]
+
+
+def test_sliding_ratio():
+    # ratio=k is sugar for duration = k * hop
+    times = [0, 1, 2, 3]
+    t = _table_of(["t"], [(x,) for x in times])
+    r1 = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, ratio=2)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    t2 = _table_of(["t"], [(x,) for x in times])
+    r2 = t2.windowby(
+        t2.t, window=pw.temporal.sliding(hop=2, duration=4)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(r1) == _rows(r2)
+
+
+def test_sliding_floats():
+    times = [0.3, 0.7, 1.1]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=0.5, duration=1.0)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    oracle = sliding_oracle(times, 0.5, 1.0)
+    got = _rows(res)
+    want = sorted((s, len(ts)) for (s, _e), ts in oracle.items())
+    assert len(got) == len(want)
+    for (gs, gc), (ws, wc) in zip(got, want):
+        assert gs == pytest.approx(ws) and gc == wc
+
+
+def test_sliding_instance_and_value_reducers():
+    rows = [("x", 0, 1), ("x", 1, 2), ("y", 1, 4)]
+    t = _table_of(["k", "t", "v"], rows)
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=1, duration=2), instance=t.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    assert _rows(res) == [
+        ("x", -1, 1),
+        ("x", 0, 3),
+        ("x", 1, 2),
+        ("y", 0, 4),
+        ("y", 1, 4),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# session
+
+
+def test_session_gap_strictness():
+    # gaps strictly smaller than max_gap merge; equal gaps split
+    times = [1.0, 1.1, 1.2, 3.0, 3.4, 3.5]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=0.15)
+    ).reduce(
+        mn=pw.reducers.min(pw.this.t),
+        c=pw.reducers.count(),
+    )
+    got = _rows(res)
+    want = sorted(
+        (min(run), len(run))
+        for run in session_oracle(times, 0.15).values()
+    )
+    assert len(got) == len(want)
+    for (gm, gc), (wm, wc) in zip(got, want):
+        assert gm == pytest.approx(wm) and gc == wc
+    # 3.0 alone (gap to 3.4 is 0.4 >= 0.15)
+    assert any(gc == 1 and abs(gm - 3.0) < 1e-9 for gm, gc in got)
+
+
+def test_session_single_event_windows():
+    times = [0, 10, 20]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.session(max_gap=5)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [(0, 0, 1), (10, 10, 1), (20, 20, 1)]
+
+
+def test_session_chain_merging_transitive():
+    # each consecutive gap below max_gap: one long session even though
+    # first-to-last exceeds the gap many times over
+    times = [0, 4, 8, 12, 16]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.session(max_gap=5)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [(0, 16, 5)]
+
+
+def test_session_predicate():
+    # custom merge predicate instead of max_gap
+    times = [1, 2, 3, 10, 11]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=lambda cur, nxt: nxt - cur <= 1),
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    assert _rows(res) == [(1, 3), (10, 2)]
+
+
+def test_session_instances_do_not_merge_across():
+    rows = [("a", 1), ("a", 2), ("b", 2), ("b", 3)]
+    t = _table_of(["k", "t"], rows)
+    res = t.windowby(
+        t.t, window=pw.temporal.session(max_gap=5), instance=t.k
+    ).reduce(
+        k=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [("a", 1, 2), ("b", 2, 2)]
+
+
+def test_session_duplicate_times():
+    times = [1, 1, 1, 5, 5]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.session(max_gap=2)).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    assert _rows(res) == [(1, 3), (5, 2)]
+
+
+# ---------------------------------------------------------------------------
+# intervals_over
+
+
+def test_intervals_over_basic():
+    data_rows = [(1, 10), (2, 20), (3, 30), (7, 70), (8, 80)]
+    t = _table_of(["t", "v"], data_rows)
+    probes = _table_of(["at"], [(2,), (5,), (8,)])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-2, upper_bound=1
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    # at=2: t in [0,3] -> 10+20+30; at=5: t in [3,6] -> 30; at=8: [6,9] -> 150
+    assert _rows(res) == [(2, 60), (5, 30), (8, 150)]
+
+
+def test_intervals_over_outer_keeps_empty_probes():
+    t = _table_of(["t", "v"], [(1, 10)])
+    probes = _table_of(["at"], [(1,), (100,)])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=1, is_outer=True
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        c=pw.reducers.count(),
+    )
+    got = _rows(res)
+    # outer: probe 100 appears with an empty window
+    assert (1, 1) in got
+    assert any(r[0] == 100 for r in got)
+
+
+def test_intervals_over_inner_drops_empty_probes():
+    t = _table_of(["t", "v"], [(1, 10)])
+    probes = _table_of(["at"], [(1,), (100,)])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=1, is_outer=False
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [(1, 1)]
+
+
+def test_intervals_over_same_table():
+    # probing a table against itself: each row sees its neighborhood
+    times = [0, 2, 4, 6]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=t.t, lower_bound=-2, upper_bound=2
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        c=pw.reducers.count(),
+    )
+    assert _rows(res) == [(0, 2), (2, 3), (4, 3), (6, 2)]
+
+
+def test_intervals_over_tuple_collection():
+    t = _table_of(["t", "v"], [(1, 5), (2, 6), (3, 7)])
+    probes = _table_of(["at"], [(2,)])
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-1, upper_bound=1
+        ),
+    ).reduce(
+        at=pw.this._pw_window_location,
+        vs=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    assert _rows(res) == [(2, (5, 6, 7))]
+
+
+# ---------------------------------------------------------------------------
+# argument validation
+
+
+def test_tumbling_duration_required_positive():
+    with pytest.raises(ValueError):
+        pw.temporal.tumbling(duration=0)
+    with pytest.raises(ValueError):
+        pw.temporal.tumbling(duration=-3)
+    with pytest.raises(ValueError):
+        pw.temporal.sliding(hop=0, duration=1)
+
+
+def test_sliding_requires_duration_or_ratio():
+    with pytest.raises((ValueError, TypeError)):
+        pw.temporal.sliding(hop=2)
+
+
+def test_sliding_rejects_duration_and_ratio_together():
+    with pytest.raises((ValueError, TypeError)):
+        pw.temporal.sliding(hop=2, duration=4, ratio=2)
+
+
+def test_session_requires_exactly_one_of_gap_predicate():
+    with pytest.raises((ValueError, TypeError)):
+        pw.temporal.session()
+    with pytest.raises((ValueError, TypeError)):
+        pw.temporal.session(max_gap=1, predicate=lambda a, b: True)
+
+
+# ---------------------------------------------------------------------------
+# seeded oracle sweeps — the "automatic" battery
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_tumbling_oracle_sweep(seed):
+    import random
+
+    rng = random.Random(seed)
+    times = [rng.randint(-50, 50) for _ in range(60)]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.tumbling(duration=7)).reduce(
+        start=pw.this._pw_window_start, c=pw.reducers.count()
+    )
+    oracle = tumbling_oracle(times, 7)
+    assert _rows(res) == sorted(
+        (s, len(ts)) for (s, _e), ts in oracle.items()
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sliding_oracle_sweep(seed):
+    import random
+
+    rng = random.Random(seed)
+    times = [rng.randint(-30, 30) for _ in range(40)]
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=3, duration=8)
+    ).reduce(start=pw.this._pw_window_start, c=pw.reducers.count())
+    oracle = sliding_oracle(times, 3, 8)
+    assert _rows(res) == sorted(
+        (s, len(ts)) for (s, _e), ts in oracle.items()
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_session_oracle_sweep(seed):
+    import random
+
+    rng = random.Random(seed)
+    times = sorted({rng.randint(0, 200) for _ in range(50)})
+    t = _table_of(["t"], [(x,) for x in times])
+    res = t.windowby(t.t, window=pw.temporal.session(max_gap=4)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        c=pw.reducers.count(),
+    )
+    oracle = session_oracle(times, 4)
+    assert _rows(res) == sorted(
+        (s, e, len(ts)) for (s, e), ts in oracle.items()
+    )
