@@ -1,0 +1,65 @@
+"""slides-search template (reference: docs/2.developers/7.templates
+slide-search app over SlidesDocumentStore + DeckRetriever,
+xpacks/llm/document_store.py:471, question_answering.py:698): index slide
+decks as they land in a folder and serve retrieval + parsed-slide
+metadata over REST — the search-only sibling of the QA templates.
+
+Endpoints:
+  POST /v1/retrieve          {"query": ..., "k": ...}
+  POST /v1/statistics        {}
+  POST /v1/inputs            {}
+  POST /v1/parsed_documents  {}   (slide metadata after parsing)
+
+Run: python app.py  (serves on the configured host/port)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing.nearest_neighbors import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import SlidesDocumentStore
+from pathway_tpu.xpacks.llm.question_answering import DeckRetriever
+from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+
+def run(config_path: str | None = None):
+    config_path = config_path or os.path.join(
+        os.path.dirname(__file__), "app.yaml"
+    )
+    with open(config_path) as f:
+        cfg = pw.load_yaml(f)
+
+    from pathway_tpu.internals.yaml_loader import resolve_config_path
+
+    decks_path = resolve_config_path(cfg["decks_path"], config_path)
+
+    decks = pw.io.fs.read(
+        decks_path, format="binary", with_metadata=True,
+        mode="streaming", autocommit_duration_ms=100,
+    )
+    store = SlidesDocumentStore(
+        decks,
+        retriever_factory=BruteForceKnnFactory(
+            dimensions=cfg.get("dimension"),
+            embedder=cfg["embedder"],
+        ),
+        parser=cfg.get("parser"),
+        splitter=cfg.get("splitter"),
+    )
+    retriever = DeckRetriever(store, search_topk=cfg.get("search_topk", 6))
+
+    server = DocumentStoreServer(cfg["host"], cfg["port"], retriever)
+    server.serve(
+        "/v1/parsed_documents",
+        store.InputsQuerySchema,
+        store.parsed_documents_query,
+        methods=("GET", "POST"),
+    )
+    pw.run()
+
+
+if __name__ == "__main__":
+    run(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -58,6 +58,40 @@ def _spawn_from_env(args) -> int:
     return main(["spawn", *parts])
 
 
+_CONNECTION_TEMPLATE = """\
+source:
+  docker_image: "{image}"
+  config:
+    # connector-specific configuration — run the connector's `spec`
+    # action (or see its docs) for the full schema
+# optional: remote execution through an HTTPS runner
+# remote_runner:
+#   url: https://runner.example.com
+#   token: <bearer token>
+"""
+
+
+def _airbyte_create_source(args) -> int:
+    """Scaffold a connection YAML (reference: python/pathway/cli.py:294
+    `pathway airbyte create-source` over airbyte_serverless
+    ConnectionFromFile.init_yaml_config)."""
+    path = args.connection
+    if not path.endswith((".yaml", ".yml")):
+        path = path + ".yaml"
+    if os.path.exists(path):
+        print(f"{path} already exists; not overwriting", file=sys.stderr)
+        return 1
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(_CONNECTION_TEMPLATE.format(image=args.image))
+    print(
+        f"Connection `{os.path.splitext(os.path.basename(path))[0]}` "
+        f"with source `{args.image}` created successfully"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pathway")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -83,6 +117,19 @@ def main(argv=None) -> int:
 
     sfe = sub.add_parser("spawn-from-env", help="spawn using PATHWAY_SPAWN_ARGS")
     sfe.set_defaults(fn=_spawn_from_env)
+
+    airbyte = sub.add_parser("airbyte", help="airbyte connection tooling")
+    airbyte_sub = airbyte.add_subparsers(dest="airbyte_command", required=True)
+    create = airbyte_sub.add_parser(
+        "create-source", help="scaffold a connection YAML"
+    )
+    create.add_argument("connection", help="connection file path (or name)")
+    create.add_argument(
+        "--image",
+        default="airbyte/source-faker:0.1.4",
+        help="any public Airbyte source docker image",
+    )
+    create.set_defaults(fn=_airbyte_create_source)
 
     args = parser.parse_args(argv)
     return args.fn(args)

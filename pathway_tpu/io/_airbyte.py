@@ -517,3 +517,93 @@ class DeclarativeAirbyteSource:
 
     def on_stop(self) -> None:
         pass
+
+
+class RemoteAirbyteSource:
+    """Airbyte sync through a remote runner endpoint (reference:
+    python/pathway/io/airbyte/__init__.py execution_type="remote" — the
+    reference ships a GCP Cloud Run job runner; this build speaks a
+    provider-neutral HTTPS contract any runner can implement).
+
+    Contract: ``POST {endpoint}/extract`` with JSON body
+    ``{"source": {...}, "streams": [...], "state": <state-or-null>}``
+    (Authorization: Bearer <token> when configured). The runner executes
+    the connector and answers with Airbyte protocol messages as JSON
+    lines (one RECORD/STATE/TRACE document per line) — the same stream a
+    local subprocess would print on stdout."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        source_cfg: dict,
+        streams=None,
+        env_vars: dict | None = None,
+        token: str | None = None,
+        timeout: float = 600.0,
+    ):
+        self.endpoint = endpoint.rstrip("/")
+        self.source_cfg = source_cfg
+        self.streams = (
+            [s.strip() for s in streams.split(",")]
+            if isinstance(streams, str)
+            else (list(streams) if streams else None)
+        )
+        self.env_vars = env_vars or {}
+        self.token = token
+        self.timeout = timeout
+
+    def extract(self, state=None) -> Iterator[dict]:
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps(
+            {
+                "source": self.source_cfg,
+                "streams": self.streams,
+                "env_vars": self.env_vars,
+                "state": state,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.endpoint + "/extract",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")[:500]
+            raise AirbyteSourceError(
+                f"remote runner rejected the sync: HTTP {exc.code} {detail}"
+            ) from exc
+        except urllib.error.URLError as exc:
+            raise AirbyteSourceError(
+                f"remote runner unreachable: {exc.reason}"
+            ) from exc
+        with resp:
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    message = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    raise AirbyteSourceError(
+                        f"remote runner produced a non-JSON line: "
+                        f"{line[:200]!r}"
+                    ) from exc
+                if message.get("type") == "TRACE":
+                    trace = message.get("trace", {})
+                    if trace.get("type") == "ERROR":
+                        raise AirbyteSourceError(
+                            trace.get("error", {}).get(
+                                "message", "remote sync failed"
+                            )
+                        )
+                yield message
+
+    def on_stop(self) -> None:
+        pass

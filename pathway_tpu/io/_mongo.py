@@ -179,6 +179,14 @@ class MongoConnection:
         (length, _rid, _rto, opcode) = struct.unpack(
             "<iiii", self._read_exact(16)
         )
+        # 48MB is MongoDB's own max message size; 21 = header + flagBits +
+        # section byte + minimal document. Anything outside is a corrupt
+        # or non-mongo stream — fail cleanly instead of desyncing.
+        if length < 21 or length > 48 * 1024 * 1024:
+            raise ConnectionError(
+                f"malformed mongodb frame: length={length} "
+                "(stream corrupt or not a mongodb server)"
+            )
         payload = self._read_exact(length - 16)
         if opcode != OP_MSG:
             raise ConnectionError(f"unexpected mongodb opcode {opcode}")

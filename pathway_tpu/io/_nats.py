@@ -118,17 +118,38 @@ class NatsConnection:
                 if line.startswith(b"-ERR"):
                     raise ConnectionError(line.decode(errors="replace"))
                 parts = line.split(b" ")
+
+                def size_of(raw: bytes) -> int:
+                    # malformed/corrupt size fields must fail cleanly —
+                    # a negative or absurd size would silently desync
+                    # the stream (max NATS payload is 64MB)
+                    try:
+                        n = int(raw)
+                    except ValueError:
+                        raise ConnectionError(
+                            f"malformed NATS size field {raw[:40]!r}"
+                        ) from None
+                    if n < 0 or n > 64 * 1024 * 1024:
+                        raise ConnectionError(
+                            f"malformed NATS frame size {n}"
+                        )
+                    return n
+
                 try:
                     if parts[0] == b"MSG":
                         # MSG <subject> <sid> [reply-to] <#bytes>
-                        nbytes = int(parts[-1])
+                        nbytes = size_of(parts[-1])
                         payload = self._read_exact(nbytes)
                         self._read_exact(2)  # trailing \r\n
                         return parts[1].decode(), payload, {}
                     if parts[0] == b"HMSG":
                         # HMSG <subject> <sid> [reply-to] <hdr_len> <total>
-                        hdr_len = int(parts[-2])
-                        total = int(parts[-1])
+                        hdr_len = size_of(parts[-2])
+                        total = size_of(parts[-1])
+                        if hdr_len > total:
+                            raise ConnectionError(
+                                "malformed NATS HMSG: hdr_len > total"
+                            )
                         blob = self._read_exact(total)
                         self._read_exact(2)
                         headers = {}

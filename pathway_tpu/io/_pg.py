@@ -51,9 +51,18 @@ class PgConnection:
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
+    # backend messages are small (errors, command tags); a length beyond
+    # this is a corrupt/desynced stream, not a real frame
+    _MAX_FRAME = 64 * 1024 * 1024
+
     def _read_msg(self) -> tuple[bytes, bytes]:
         kind = self._read_exact(1)
         (length,) = struct.unpack("!i", self._read_exact(4))
+        if length < 4 or length - 4 > self._MAX_FRAME:
+            raise PgError(
+                f"malformed postgres frame: kind={kind!r} length={length} "
+                "(stream corrupt or not a postgres server)"
+            )
         return kind, self._read_exact(length - 4)
 
     def _send_msg(self, kind: bytes, payload: bytes) -> None:

@@ -118,19 +118,49 @@ def read(
 
     from pathway_tpu.io import python as io_python
 
-    if execution_type != "local":
-        raise NotImplementedError(
-            "pw.io.airbyte: only execution_type='local' is supported in "
-            "this build (reference 'remote' runs on GCP Cloud Run)"
+    if execution_type not in ("local", "remote"):
+        raise ValueError(
+            "pw.io.airbyte: execution_type must be 'local' or 'remote'"
         )
     cfg = _load_connection(config_file_path)
-    source = _construct_source(
-        cfg["source"],
-        streams,
-        env_vars,
-        enforce_method,
-        os.path.dirname(os.path.abspath(config_file_path)),
-    )
+    if execution_type == "local" and (
+        "remote_runner_url" in kwargs or "remote_runner_token" in kwargs
+    ):
+        # a remote runner configured while running locally means data
+        # would silently leave the intended execution boundary — refuse
+        raise ValueError(
+            "remote_runner_url/remote_runner_token were given but "
+            "execution_type is 'local'; pass execution_type='remote'"
+        )
+    if execution_type == "remote":
+        # provider-neutral HTTPS runner (the reference's remote mode runs
+        # on GCP Cloud Run — python/pathway/io/airbyte/__init__.py); the
+        # endpoint comes from the kwarg or the connection file's
+        # `remote_runner` section
+        from pathway_tpu.io._airbyte import RemoteAirbyteSource
+
+        runner = kwargs.pop("remote_runner_url", None) or (
+            cfg.get("remote_runner") or {}
+        ).get("url")
+        token = kwargs.pop("remote_runner_token", None) or (
+            cfg.get("remote_runner") or {}
+        ).get("token")
+        if not runner:
+            raise ValueError(
+                "execution_type='remote' needs remote_runner_url= or a "
+                "remote_runner: {url: ...} section in the connection file"
+            )
+        source = RemoteAirbyteSource(
+            runner, cfg["source"], streams, env_vars, token
+        )
+    else:
+        source = _construct_source(
+            cfg["source"],
+            streams,
+            env_vars,
+            enforce_method,
+            os.path.dirname(os.path.abspath(config_file_path)),
+        )
 
     class _AirbyteSubject(io_python.ConnectorSubject):
         _deletions_enabled = False

@@ -1,6 +1,7 @@
 """pathway_tpu.stdlib.utils (reference: python/pathway/stdlib/utils)."""
 
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.bucketing import truncate_to_minutes
 from pathway_tpu.stdlib.utils.col import apply_all_rows, unpack_col
 from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer

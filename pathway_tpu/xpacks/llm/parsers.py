@@ -441,21 +441,127 @@ class MultimodalParser(UDF):
 
 
 class OpenParse(UDF):
-    """reference: parsers.py OpenParse — table/vision pdf pipeline."""
+    """Structured PDF parsing with the reference's OpenParse arg surface
+    (reference: parsers.py:235-395 + openparse_utils.py:1-409) over the
+    dependency-free pipeline in xpacks/llm/openparse_utils.py.
 
-    def __init__(self, **kwargs):
-        try:
-            import openparse  # noqa: F401
-        except ImportError as e:
-            raise ImportError("OpenParse requires the `openparse` package") from e
+    Args:
+        table_args: ``{"parsing_algorithm": "llm"|"pymupdf"|"unitable"|
+            "table-transformers"[, "llm": chat, "prompt": str]}``. The
+            "llm" algorithm routes each detected table through the given
+            chat model with the markdown-table prompt; the model names
+            select the local positional table detector (same markdown
+            output contract). Defaults to the "llm" algorithm with an
+            OpenAI gpt-4o chat (requires OPENAI_API_KEY at call time,
+            exactly like the reference's default).
+        image_args: ``{"parsing_algorithm": "llm", "llm": chat,
+            "prompt": str}`` — only "llm" is supported, as in the
+            reference.
+        parse_images: whether to caption embedded PDF images with the
+            vision LLM and index the captions.
+        processing_pipeline: "pathway_pdf_default" (SimpleIngestionPipeline),
+            "merge_same_page" (SamePageIngestionPipeline), or any object
+            with ``process(nodes)``.
+        cache_strategy: optional pw.udfs.CacheStrategy.
+    """
+
+    def __init__(
+        self,
+        table_args: dict | None = None,
+        image_args: dict | None = None,
+        parse_images: bool = False,
+        processing_pipeline=None,
+        cache_strategy=None,
+        **kwargs,
+    ):
+        import warnings
+
+        from pathway_tpu.xpacks.llm import prompts
+        from pathway_tpu.xpacks.llm.openparse_utils import (
+            IngestionPipeline,
+            PyMuDocumentParser,
+            SamePageIngestionPipeline,
+            SimpleIngestionPipeline,
+        )
+
+        def default_vision_llm():
+            from pathway_tpu.xpacks.llm.llms import OpenAIChat
+
+            return OpenAIChat(model="gpt-4o")
+
+        if table_args is None:
+            table_args = {
+                "parsing_algorithm": "llm",
+                "llm": default_vision_llm(),
+                "prompt": prompts.DEFAULT_MD_TABLE_PARSE_PROMPT,
+            }
+        if parse_images:
+            if image_args is None:
+                warnings.warn(
+                    "`parse_images` is set to `True`, but `image_args` is "
+                    "not specified, defaulting to `gpt-4o`."
+                )
+                image_args = {
+                    "parsing_algorithm": "llm",
+                    "llm": default_vision_llm(),
+                    "prompt": prompts.DEFAULT_IMAGE_PARSE_PROMPT,
+                }
+            elif image_args.get("parsing_algorithm") != "llm":
+                raise ValueError(
+                    "Image parsing is only supported with LLMs. Either "
+                    "change the `parsing_algorithm` to `llm` or set "
+                    "`parse_images` to `False`. "
+                    f"Given args: {image_args}"
+                )
+        elif image_args:
+            warnings.warn(
+                "`parse_images` is set to `False`, but `image_args` is "
+                "specified, skipping image parsing."
+            )
+            image_args = None
+
+        if processing_pipeline is None or (
+            processing_pipeline == "pathway_pdf_default"
+        ):
+            processing_pipeline = SimpleIngestionPipeline()
+        elif processing_pipeline == "merge_same_page":
+            processing_pipeline = SamePageIngestionPipeline()
+        elif isinstance(processing_pipeline, str):
+            raise ValueError(
+                "Invalid `processing_pipeline` set. It must be either one "
+                "of `'pathway_pdf_default'` or `'merge_same_page'`."
+            )
+        elif not isinstance(processing_pipeline, IngestionPipeline) and (
+            not hasattr(processing_pipeline, "process")
+        ):
+            raise ValueError(
+                "`processing_pipeline` must provide a process(nodes) method"
+            )
+
+        self.doc_parser = PyMuDocumentParser(
+            table_args=table_args,
+            image_args=image_args,
+            processing_pipeline=processing_pipeline,
+        )
 
         async def parse(contents) -> list:
-            import io
+            nodes = await self.doc_parser.parse(bytes(contents))
+            return [
+                (
+                    node["text"],
+                    {"kind": node["kind"], "page": node["page"]},
+                )
+                for node in nodes
+            ]
 
-            import openparse
-
-            parser = openparse.DocumentParser()
-            doc = parser.parse(io.BytesIO(contents))
-            return [(node.text, {}) for node in doc.nodes]
-
-        super().__init__(parse, return_type=list, deterministic=True)
+        # LLM-routed parsing is nondeterministic: retraction replay must
+        # reuse the memoized insert-time output or retractions would not
+        # cancel their inserts (consistent-deletions semantics)
+        deterministic = (
+            table_args.get("parsing_algorithm") != "llm"
+            and image_args is None
+        )
+        super().__init__(
+            parse, return_type=list, deterministic=deterministic,
+            cache_strategy=cache_strategy,
+        )

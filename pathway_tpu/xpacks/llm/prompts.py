@@ -77,3 +77,15 @@ def prompt_rerank(query: str, doc: str) -> str:
         "Reply with only the number.\n\n"
         f"Question: {query}\nDocument: {doc}\nScore:"
     )
+
+
+DEFAULT_MD_TABLE_PARSE_PROMPT = (
+    "Explain the given table in markdown format in detail. Do not skip "
+    "details or units. Keep column and row names understandable. If it "
+    "is not a table, return 'No table.'."
+)
+
+DEFAULT_IMAGE_PARSE_PROMPT = (
+    "Explain the given image in detail. If there is text, spell out all "
+    "of the text in the image."
+)

@@ -372,6 +372,12 @@ class DeckRetriever(BaseQuestionAnswerer):
     def list_documents(self, queries):
         return self.indexer.inputs_query(queries)
 
+    # DocumentStoreServer-compatible surface: a DeckRetriever can sit
+    # directly behind the document-store REST routes
+    retrieve_query = retrieve
+    statistics_query = statistics
+    inputs_query = list_documents
+
 
 class RAGClient:
     """HTTP client for RAG servers (reference: :816)."""

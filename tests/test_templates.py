@@ -161,3 +161,107 @@ def test_etl_lakehouse_template():
     assert "ann | 130 | 2 | 120" in out
     # reserved-word identifiers arrive QUOTED (real-Postgres safe)
     assert 'ON CONFLICT ("user") DO UPDATE' in out
+
+
+def test_private_rag_template(tmp_path):
+    """examples/private-rag: adaptive RAG with every model local —
+    answers over HTTP with the offline mocks the template defaults to."""
+    port = _run_template(tmp_path, "private-rag")
+    out = _post_with_retries(
+        f"http://127.0.0.1:{port}/v2/answer",
+        {"prompt": "pathway tpu streaming dataflow framework"},
+    )
+    assert out["response"] is not None
+
+
+def test_slides_search_template(tmp_path):
+    """examples/slides-search: SlidesDocumentStore + DeckRetriever —
+    retrieval and parsed-slide metadata over HTTP."""
+    import importlib
+    import shutil
+    import sys
+
+    template_dir = os.path.join(_REPO_ROOT, "examples", "slides-search")
+    port = _free_port()
+    decks = tmp_path / "decks"
+    decks.mkdir()
+    for name in os.listdir(os.path.join(template_dir, "decks")):
+        shutil.copy(os.path.join(template_dir, "decks", name), decks / name)
+    cfg = open(os.path.join(template_dir, "app.yaml")).read()
+    cfg = cfg.replace("./decks", str(decks))
+    cfg = cfg.replace("port: 8000", f"port: {port}")
+    config = tmp_path / "app.yaml"
+    config.write_text(cfg)
+
+    sys.path.insert(0, template_dir)
+    try:
+        app = importlib.import_module("app")
+        threading.Thread(
+            target=app.run, args=(str(config),), daemon=True
+        ).start()
+    finally:
+        sys.path.pop(0)
+        sys.modules.pop("app", None)
+
+    hits = _post_with_retries(
+        f"http://127.0.0.1:{port}/v1/retrieve",
+        {"query": "tpu architecture overview", "k": 2},
+    )
+    assert len(hits) >= 1
+    texts = json.dumps(hits)
+    assert "architecture" in texts or "dataflow" in texts
+    parsed = _post_with_retries(
+        f"http://127.0.0.1:{port}/v1/parsed_documents", {}
+    )
+    assert any("deck1" in json.dumps(m) for m in parsed)
+    stats = _post_with_retries(
+        f"http://127.0.0.1:{port}/v1/statistics", {}
+    )
+    assert stats["file_count"] >= 1
+
+
+def test_spawn_deploy_example(tmp_path):
+    """examples/projects/spawn-deploy: the CLI spawns 2 ranks over the
+    loopback mesh; rank 0 writes the aggregated per-user totals."""
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.update(
+        N_EVENTS="5000",
+        OUT_DIR=str(tmp_path / "out"),
+        JAX_PLATFORMS="cpu",
+        PATHWAY_FIRST_PORT=str(_free_port()),
+        PYTHONPATH=_REPO_ROOT,
+    )
+    prog = os.path.join(
+        _REPO_ROOT, "examples", "projects", "spawn-deploy", "main.py"
+    )
+    proc = subprocess.run(
+        [
+            _sys.executable, "-m", "pathway_tpu.cli", "spawn",
+            "--processes", "2", prog,
+        ],
+        env=env,
+        capture_output=True,
+        timeout=300,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    out_file = tmp_path / "out" / "counts.jsonl"
+    rows = [
+        json.loads(line)
+        for line in out_file.read_text().splitlines()
+        if line.strip()
+    ]
+    # final state: one live row per user with the global totals
+    live = {}
+    for r in rows:
+        if r.get("diff", 1) > 0:
+            live[r["user"]] = (r["n"], r["total"])
+        else:
+            live.pop(r["user"], None)
+    assert len(live) == 97
+    assert sum(n for n, _t in live.values()) == 5000
+    want_total = sum(i % 13 for i in range(5000))
+    assert sum(t for _n, t in live.values()) == want_total
