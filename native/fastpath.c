@@ -1478,6 +1478,97 @@ fast_binop(PyObject *self, PyObject *args)
 
 /* module def ------------------------------------------------------------ */
 
+/* capture_apply(rows_dict, updates_list, deltas, time)
+ * One C pass over a capture sink's batch: TableState.apply semantics
+ * (upserts arriving as (del, add) in any in-batch order land on the
+ * added row) plus the (key, row, time, diff) update-history append.
+ * The capture sink sees EVERY output row of a pipeline — at join
+ * fanouts this loop is a top-3 cost of the whole run. */
+static PyObject *
+fast_capture_apply(PyObject *self, PyObject *args)
+{
+    PyObject *rows, *updates, *deltas, *time_obj;
+    if (!PyArg_ParseTuple(args, "O!O!OO", &PyDict_Type, &rows,
+                          &PyList_Type, &updates, &deltas, &time_obj))
+        return NULL;
+    PyObject *seq = PySequence_Fast(deltas, "capture_apply: sequence");
+    if (seq == NULL)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *pending = NULL; /* key -> row for in-batch upserts */
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3) {
+            PyErr_SetString(PyExc_TypeError,
+                            "capture_apply: delta must be (key, row, diff)");
+            goto fail;
+        }
+        PyObject *key = PyTuple_GET_ITEM(d, 0);
+        PyObject *row = PyTuple_GET_ITEM(d, 1);
+        PyObject *diff = PyTuple_GET_ITEM(d, 2);
+        long long df = PyLong_AsLongLong(diff);
+        if (df == -1 && PyErr_Occurred())
+            goto fail;
+        /* update history entry */
+        PyObject *u = PyTuple_New(4);
+        if (u == NULL)
+            goto fail;
+        Py_INCREF(key);
+        PyTuple_SET_ITEM(u, 0, key);
+        Py_INCREF(row);
+        PyTuple_SET_ITEM(u, 1, row);
+        Py_INCREF(time_obj);
+        PyTuple_SET_ITEM(u, 2, time_obj);
+        Py_INCREF(diff);
+        PyTuple_SET_ITEM(u, 3, diff);
+        if (PyList_Append(updates, u) < 0) {
+            Py_DECREF(u);
+            goto fail;
+        }
+        Py_DECREF(u);
+        /* table state */
+        if (df > 0) {
+            int have = PyDict_Contains(rows, key);
+            if (have < 0)
+                goto fail;
+            int pend = pending != NULL && PyDict_Contains(pending, key);
+            if (pend < 0)
+                goto fail;
+            if (have && !pend) {
+                if (pending == NULL) {
+                    pending = PyDict_New();
+                    if (pending == NULL)
+                        goto fail;
+                }
+                if (PyDict_SetItem(pending, key, row) < 0)
+                    goto fail;
+            } else if (PyDict_SetItem(rows, key, row) < 0) {
+                goto fail;
+            }
+        } else if (df < 0) {
+            int have = PyDict_Contains(rows, key);
+            if (have < 0)
+                goto fail;
+            if (have && PyDict_DelItem(rows, key) < 0)
+                goto fail;
+        }
+    }
+    if (pending != NULL) {
+        PyObject *key, *row;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(pending, &pos, &key, &row))
+            if (PyDict_SetItem(rows, key, row) < 0)
+                goto fail;
+        Py_DECREF(pending);
+    }
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+fail:
+    Py_XDECREF(pending);
+    Py_DECREF(seq);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"consolidate", fast_consolidate, METH_O,
      "Sum multiplicities of identical (key,row) pairs, drop zeros."},
@@ -1500,6 +1591,8 @@ static PyMethodDef methods[] = {
      "-> (deltas, new_seq)"},
     {"deliver", fast_deliver, METH_VARARGS,
      "deliver(deltas, time, cb, cols|None): sorted output callbacks"},
+    {"capture_apply", fast_capture_apply, METH_VARARGS,
+     "capture_apply(rows, updates, deltas, time): one-pass capture sink"},
     {"ref_scalar", fast_ref_scalar, METH_O,
      "ref_scalar(args_tuple) -> Pointer (native blake2b-128 key mint)"},
     {"binop", fast_binop, METH_VARARGS,

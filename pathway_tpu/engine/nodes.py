@@ -1499,6 +1499,12 @@ class CaptureNode(Node):
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
+        fp = get_fp()
+        if fp is not None and hasattr(fp, "capture_apply"):
+            # the capture sink sees EVERY output row — one C pass does
+            # the TableState apply and the update-history append
+            fp.capture_apply(self.state.rows, self.updates, deltas, time)
+            return []
         self.state.apply(deltas)
         for k, row, d in deltas:
             self.updates.append((k, row, time, d))

@@ -1,0 +1,385 @@
+"""Behavior-matrix battery — the remaining keep/remove × delay × buffer
+combinations from the reference's stream corpus (reference:
+python/pathway/tests/temporal/test_windows_stream.py:291-392 — the
+parametrized battery over common_behavior(delay, cutoff, keep_results)
+— plus interval-join forgetting with instances and asof-join
+delay/cutoff, test_interval_joins_stream.py:100, test_asof_joins_stream.py).
+
+The driver commits deterministic rounds; assertions cover both the final
+state and the presence/absence of withdrawal events — which is the whole
+point of keep_results."""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def run_sliding_stream(commits, behavior, hop=2, duration=4):
+    pw.internals.parse_graph.G.clear()
+
+    class Events(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for batch in commits:
+                for t in batch:
+                    self.next(t=t)
+                self.commit()
+
+    class S(pw.Schema):
+        t: int
+
+    events_t = pw.io.python.read(
+        Events(), schema=S, autocommit_duration_ms=None
+    )
+    res = events_t.windowby(
+        events_t.t,
+        window=pw.temporal.sliding(hop=hop, duration=duration),
+        behavior=behavior,
+    ).reduce(
+        start=pw.this._pw_window_start,
+        c=pw.reducers.count(),
+        hi=pw.reducers.max(pw.this.t),
+    )
+    updates: list[tuple] = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: updates.append(
+            (row["start"], row["c"], row["hi"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return updates
+
+
+def live_windows(updates):
+    live: dict = {}
+    for start, c, hi, add in updates:
+        if add:
+            live[(start, c, hi)] = live.get((start, c, hi), 0) + 1
+        else:
+            live[(start, c, hi)] = live.get((start, c, hi), 0) - 1
+    return sorted(k for k, n in live.items() if n > 0)
+
+
+COMMITS = [[1], [2], [3], [6], [12], [4]]
+# sliding(hop=2, duration=4) over times 1,2,3,6,12 (+ late 4):
+#   window -2: {1}      window 0: {1,2,3}  window 2: {2,3}
+#   window 4 gains {6}  window 6: {6}      window 10: {12}  window 12: {12}
+# the late t=4 (19 behind the 12-watermark) belongs to windows 2 and 4.
+
+
+def test_keep_results_sliding():
+    updates = run_sliding_stream(
+        COMMITS, pw.temporal.common_behavior(cutoff=2, keep_results=True)
+    )
+    got = live_windows(updates)
+    # late t=4 was dropped (cutoff) but closed windows KEPT their results
+    assert ((-2), 1, 1) in got
+    assert (0, 3, 3) in got
+    assert (2, 2, 3) in got  # without the late event it would gain t=4
+    assert (10, 1, 12) in got and (12, 1, 12) in got
+
+
+def test_remove_results_sliding():
+    updates = run_sliding_stream(
+        COMMITS, pw.temporal.common_behavior(cutoff=2, keep_results=False)
+    )
+    got = live_windows(updates)
+    # windows far behind the watermark were WITHDRAWN from the output
+    assert not any(s in (-2, 0) for s, _c, _hi in got)
+    # but they did exist at some point (insert followed by retraction)
+    assert any(s == 0 and add for s, _c, _hi, add in updates)
+    assert any(s == 0 and not add for s, _c, _hi, add in updates)
+    # the newest windows survive
+    assert any(s == 12 for s, _c, _hi in got)
+
+
+def test_non_zero_delay_keep_results_sliding():
+    updates = run_sliding_stream(
+        COMMITS,
+        pw.temporal.common_behavior(delay=2, cutoff=2, keep_results=True),
+    )
+    got = live_windows(updates)
+    assert (0, 3, 3) in got
+    # delay batched the first three commits: window 0 must never have
+    # appeared with c=1
+    assert not any(s == 0 and c == 1 for s, c, _hi, add in updates if add)
+
+
+def test_non_zero_delay_remove_results_sliding():
+    updates = run_sliding_stream(
+        COMMITS,
+        pw.temporal.common_behavior(delay=2, cutoff=2, keep_results=False),
+    )
+    got = live_windows(updates)
+    assert not any(s in (-2, 0) for s, _c, _hi in got)
+    assert any(s == 12 for s, _c, _hi in got)
+
+
+def test_high_delay_high_buffer_keep_results():
+    # delay larger than the whole stream: everything flushes at close,
+    # each window exactly once, with its final value
+    updates = run_sliding_stream(
+        COMMITS,
+        pw.temporal.common_behavior(
+            delay=100, cutoff=100, keep_results=True
+        ),
+    )
+    assert all(add for *_x, add in updates)
+    got = live_windows(updates)
+    # with an enormous cutoff the late t=4 IS accepted: window 2 = {2,3,4}
+    assert (2, 3, 4) in got
+    assert (4, 2, 6) in got
+
+
+def test_zero_cutoff_drops_everything_behind_watermark():
+    updates = run_sliding_stream(
+        [[10], [1]],
+        pw.temporal.common_behavior(cutoff=0, keep_results=True),
+    )
+    got = live_windows(updates)
+    # t=1 is behind the 10-watermark with zero tolerance: its windows
+    # must not exist
+    assert all(s >= 8 for s, _c, _hi in got)
+
+
+# ---------------------------------------------------------------------------
+# interval join forgetting with instances
+
+
+def test_interval_join_stream_forget_with_instance():
+    pw.internals.parse_graph.G.clear()
+    import time as _time
+
+    class Left(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next(k="a", t=0)
+            self.next(k="b", t=0)
+            self.commit()
+            _time.sleep(0.25)
+            self.next(k="a", t=100)
+            self.commit()
+            _time.sleep(0.25)
+            # late rows for both instances: must find their right
+            # partners already forgotten
+            self.next(k="a", t=1)
+            self.next(k="b", t=1)
+            self.commit()
+
+    class Right(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            _time.sleep(0.1)
+            self.next(k="a", t=0)
+            self.next(k="b", t=0)
+            self.commit()
+            _time.sleep(0.25)
+            self.next(k="a", t=100)
+            self.commit()
+
+    class S(pw.Schema):
+        k: str
+        t: int
+
+    lt = pw.io.python.read(Left(), schema=S, autocommit_duration_ms=None)
+    rt = pw.io.python.read(Right(), schema=S, autocommit_duration_ms=None)
+    res = pw.temporal.interval_join(
+        lt, rt, lt.t, rt.t, pw.temporal.interval(-2, 2), lt.k == rt.k,
+        behavior=pw.temporal.common_behavior(cutoff=10, keep_results=True),
+    ).select(k=lt.k, lt_=lt.t, rt_=rt.t)
+    got = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: got.append(
+            (row["k"], row["lt_"], row["rt_"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    live = {(k, l, r) for k, l, r, a in got if a}
+    assert ("a", 0, 0) in live and ("b", 0, 0) in live
+    assert ("a", 100, 100) in live
+    # per-instance forgetting: the late t=1 rows of BOTH instances miss
+    assert ("a", 1, 0) not in live and ("b", 1, 0) not in live
+
+
+# ---------------------------------------------------------------------------
+# asof join under behaviors
+
+
+def _run_asof_stream(l_rounds, r_rounds, behavior):
+    pw.internals.parse_graph.G.clear()
+    import time as _time
+
+    class Left(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for i, batch in enumerate(l_rounds):
+                _time.sleep(0.2 * i + 0.01)
+                for t, v in batch:
+                    self.next(t=t, v=v)
+                self.commit()
+
+    class Right(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for i, batch in enumerate(r_rounds):
+                _time.sleep(0.2 * i + 0.1)
+                for t, v in batch:
+                    self.next(t=t, v=v)
+                self.commit()
+
+    class S(pw.Schema):
+        t: int
+        v: int
+
+    lt = pw.io.python.read(Left(), schema=S, autocommit_duration_ms=None)
+    rt = pw.io.python.read(Right(), schema=S, autocommit_duration_ms=None)
+    res = pw.temporal.asof_join(
+        lt, rt, lt.t, rt.t, how="left", behavior=behavior
+    ).select(lt_=lt.t, lv=lt.v, rv=rt.v)
+    got = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: got.append(
+            (row["lt_"], row["lv"], row["rv"], add)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return got
+
+
+def test_asof_stream_without_behavior_revises():
+    got = _run_asof_stream(
+        [[(10, 1)]],
+        [[(5, 50)], [(8, 80)]],
+        None,
+    )
+    live = {}
+    for lt_, lv, rv, add in got:
+        if add:
+            live[(lt_, lv)] = rv
+        elif live.get((lt_, lv)) == rv:
+            del live[(lt_, lv)]
+    assert live == {(10, 1): 80}
+    assert (10, 1, 50, False) in got  # the earlier answer was revised
+
+
+def test_asof_stream_cutoff_freezes_old_answers():
+    # reference semantics (temporal_behavior applied per side,
+    # time_column.rs — each gate watermarks over ITS OWN input): a right
+    # row far behind the RIGHT side's own watermark is dropped and must
+    # not revise earlier answers
+    got = _run_asof_stream(
+        [[(10, 1)]],
+        [[(5, 50)], [(300, 99)], [(8, 80)]],  # 8 is 292 late on its side
+        pw.temporal.common_behavior(cutoff=20, keep_results=True),
+    )
+    live = {}
+    for lt_, lv, rv, add in got:
+        if add:
+            live[(lt_, lv)] = rv
+        elif live.get((lt_, lv)) == rv:
+            del live[(lt_, lv)]
+    # backward-asof for t=10 considers rt<=10: the on-time 5 answers it;
+    # the late 8 (threshold 28 << watermark 300) is ignored
+    assert live[(10, 1)] == 50
+
+
+def test_asof_stream_in_cutoff_late_row_still_revises():
+    # the counterpart: a late-but-within-cutoff right row DOES revise
+    got = _run_asof_stream(
+        [[(10, 1)]],
+        [[(5, 50)], [(12, 99)], [(8, 80)]],  # 8 is 4 late, cutoff 20
+        pw.temporal.common_behavior(cutoff=20, keep_results=True),
+    )
+    live = {}
+    for lt_, lv, rv, add in got:
+        if add:
+            live[(lt_, lv)] = rv
+        elif live.get((lt_, lv)) == rv:
+            del live[(lt_, lv)]
+    assert live[(10, 1)] == 80
+
+
+# ---------------------------------------------------------------------------
+# mixed reducers through windows in streaming mode
+
+
+def test_windowed_mixed_reducers_stream_consistency():
+    pw.internals.parse_graph.G.clear()
+
+    class Events(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for t, v in [(1, 5), (2, 9), (3, 1), (6, 4), (7, 2)]:
+                self.next(t=t, v=v)
+                self.commit()
+
+    class S(pw.Schema):
+        t: int
+        v: int
+
+    events_t = pw.io.python.read(
+        Events(), schema=S, autocommit_duration_ms=None
+    )
+    res = events_t.windowby(
+        events_t.t, window=pw.temporal.tumbling(duration=5)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+        lo=pw.reducers.min(pw.this.v),
+        hi=pw.reducers.max(pw.this.v),
+        s=pw.reducers.sum(pw.this.v),
+        vs=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    live = {}
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, add: (
+            live.__setitem__(key, row) if add else live.pop(key, None)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    by_start = {r["start"]: r for r in live.values()}
+    assert by_start[0] == {
+        "start": 0, "n": 3, "lo": 1, "hi": 9, "s": 15, "vs": (1, 5, 9)
+    }
+    assert by_start[5] == {
+        "start": 5, "n": 2, "lo": 2, "hi": 4, "s": 6, "vs": (2, 4)
+    }
+
+
+@pytest.mark.parametrize("keep", [True, False])
+def test_exactly_once_vs_common_equivalence_final_counts(keep):
+    """exactly_once is sugar for (delay=end-aligned, cutoff) — final
+    counts of surviving windows agree with a keep_results common
+    behavior of the same cutoff."""
+    updates_eo = run_sliding_stream(
+        [[1], [2], [9]],
+        pw.temporal.exactly_once_behavior(),
+        hop=4,
+        duration=4,
+    )
+    finals_eo = {
+        (s, c) for s, c, _hi, add in updates_eo if add
+    }
+    updates_cb = run_sliding_stream(
+        [[1], [2], [9]],
+        pw.temporal.common_behavior(delay=4, cutoff=4, keep_results=keep),
+        hop=4,
+        duration=4,
+    )
+    live_cb = {(s, c) for s, c, _hi in live_windows(updates_cb)}
+    # window [0,4) finalized at c=2 under both
+    assert (0, 2) in finals_eo
+    if keep:
+        assert (0, 2) in live_cb
