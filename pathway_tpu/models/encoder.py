@@ -189,6 +189,21 @@ class SentenceEncoder:
         self._forward = jax.jit(
             lambda params, ids, mask: self.model.apply({"params": params}, ids, mask)
         )
+        # compact-transfer variant: ids ride as uint16 (vocab < 2^16) and
+        # the contiguous-prefix mask as per-row lengths, rebuilt on
+        # device. Cuts host->device bytes ~4x — on a WAN-tunneled dev
+        # chip the transfer IS the ingest bottleneck; on PCIe it is
+        # simply less traffic.
+        self._forward_compact = jax.jit(
+            lambda params, ids_u16, lengths: self.model.apply(
+                {"params": params},
+                ids_u16.astype(jnp.int32),
+                (
+                    jnp.arange(ids_u16.shape[1], dtype=jnp.int32)[None, :]
+                    < lengths[:, None]
+                ).astype(jnp.int32),
+            )
+        )
 
     @property
     def embed_dim(self) -> int:
@@ -221,7 +236,23 @@ class SentenceEncoder:
         ids_p, mask_p, n = pad_batch(
             ids, mask, self.config.max_len, self.batch_size
         )
-        emb = self._forward(self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
+        # compact transfer when the mask is a contiguous prefix (wordpiece
+        # and HF padders both produce this) and ids fit uint16
+        lengths = mask_p.sum(axis=1, dtype=np.int32)
+        contiguous = bool(
+            (mask_p.cumsum(axis=1)[np.arange(len(lengths)), lengths - 1]
+             == lengths).all()
+        ) if mask_p.shape[1] else True
+        if contiguous and self.config.vocab_size <= 65536:
+            emb = self._forward_compact(
+                self.params,
+                jnp.asarray(ids_p.astype(np.uint16)),
+                jnp.asarray(lengths),
+            )
+        else:
+            emb = self._forward(
+                self.params, jnp.asarray(ids_p), jnp.asarray(mask_p)
+            )
         return emb[:n]
 
     def _encode_batch(self, ids: np.ndarray, mask: np.ndarray) -> np.ndarray:
