@@ -584,5 +584,10 @@ def apply_async(fun, *args, **kwargs) -> AsyncApplyExpression:
     return AsyncApplyExpression(fun, dt.ANY, False, True, args, kwargs)
 
 
-def assert_table_has_columns(*a, **k):  # pragma: no cover - compat shim
-    pass
+def assert_table_has_columns(table, columns) -> None:
+    """Raise AssertionError unless every name in `columns` is a column of
+    `table` (reference: table presence checks used in pipeline glue)."""
+    missing = [c for c in columns if c not in table.column_names()]
+    assert not missing, (
+        f"table is missing columns {missing}; has {table.column_names()}"
+    )
