@@ -173,28 +173,36 @@ def test_asof_now_join_answers_are_frozen():
         w: str
 
     events = []
+    # commit-order gates instead of sleeps: runtime commits get their
+    # timestamps in queue-arrival order, so "commit() returned before the
+    # peer's next commit()" IS the ordering guarantee — robust on a
+    # loaded 1-core CI box where sleep races flake
+    import threading
+
+    r_loaded = threading.Event()
+    l_first_done = threading.Event()
+    r_updated = threading.Event()
 
     class LSub(pw.io.python.ConnectorSubject):
         def run(self):
-            import time
-
-            time.sleep(0.3)  # right side loads first
+            r_loaded.wait(timeout=30)  # right side loads first
             self.next(k=1, j=1)
             self.commit()
-            time.sleep(0.4)  # right side then CHANGES
+            l_first_done.set()
+            r_updated.wait(timeout=30)  # right side then CHANGES
             self.next(k=2, j=1)
             self.commit()
 
     class RSub(pw.io.python.ConnectorSubject):
         def run(self):
-            import time
-
             self.next(j=1, w="old")
             self.commit()
-            time.sleep(0.5)
+            r_loaded.set()
+            l_first_done.wait(timeout=30)
             self.remove(j=1, w="old")
             self.next(j=1, w="new")
             self.commit()
+            r_updated.set()
 
     lt = pw.io.python.read(LSub(), schema=L, autocommit_duration_ms=None)
     rt = pw.io.python.read(RSub(), schema=R, autocommit_duration_ms=None)

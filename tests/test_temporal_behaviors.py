@@ -152,7 +152,14 @@ def test_zero_cutoff_drops_everything_behind_watermark():
 
 def test_interval_join_stream_forget_with_instance():
     pw.internals.parse_graph.G.clear()
-    import time as _time
+    import threading
+
+    # event ping-pong instead of sleeps: commit() enqueues synchronously,
+    # so gate order IS engine timestamp order even on a loaded box
+    l0 = threading.Event()
+    r0 = threading.Event()
+    l1 = threading.Event()
+    r1 = threading.Event()
 
     class Left(pw.io.python.ConnectorSubject):
         _deletions_enabled = False
@@ -161,10 +168,12 @@ def test_interval_join_stream_forget_with_instance():
             self.next(k="a", t=0)
             self.next(k="b", t=0)
             self.commit()
-            _time.sleep(0.25)
+            l0.set()
+            r0.wait(timeout=30)
             self.next(k="a", t=100)
             self.commit()
-            _time.sleep(0.25)
+            l1.set()
+            r1.wait(timeout=30)
             # late rows for both instances: must find their right
             # partners already forgotten
             self.next(k="a", t=1)
@@ -175,13 +184,15 @@ def test_interval_join_stream_forget_with_instance():
         _deletions_enabled = False
 
         def run(self):
-            _time.sleep(0.1)
+            l0.wait(timeout=30)
             self.next(k="a", t=0)
             self.next(k="b", t=0)
             self.commit()
-            _time.sleep(0.25)
+            r0.set()
+            l1.wait(timeout=30)
             self.next(k="a", t=100)
             self.commit()
+            r1.set()
 
     class S(pw.Schema):
         k: str
@@ -213,28 +224,51 @@ def test_interval_join_stream_forget_with_instance():
 
 
 def _run_asof_stream(l_rounds, r_rounds, behavior):
+    """L commits first, then the R rounds in order — gated on events, not
+    sleeps (commit() enqueues synchronously: gate order == timestamps)."""
     pw.internals.parse_graph.G.clear()
-    import time as _time
+    import threading
+
+    sched: list[tuple[str, int]] = []
+    for i in range(max(len(l_rounds), len(r_rounds))):
+        if i < len(l_rounds):
+            sched.append(("L", i))
+        if i < len(r_rounds):
+            sched.append(("R", i))
+    pos = {si: p for p, si in enumerate(sched)}
+    turn = [0]
+    cv = threading.Condition()
+
+    def gate(side, i):
+        with cv:
+            cv.wait_for(lambda: turn[0] == pos[(side, i)], timeout=30)
+
+    def done():
+        with cv:
+            turn[0] += 1
+            cv.notify_all()
 
     class Left(pw.io.python.ConnectorSubject):
         _deletions_enabled = False
 
         def run(self):
             for i, batch in enumerate(l_rounds):
-                _time.sleep(0.2 * i + 0.01)
+                gate("L", i)
                 for t, v in batch:
                     self.next(t=t, v=v)
                 self.commit()
+                done()
 
     class Right(pw.io.python.ConnectorSubject):
         _deletions_enabled = False
 
         def run(self):
             for i, batch in enumerate(r_rounds):
-                _time.sleep(0.2 * i + 0.1)
+                gate("R", i)
                 for t, v in batch:
                     self.next(t=t, v=v)
                 self.commit()
+                done()
 
     class S(pw.Schema):
         t: int

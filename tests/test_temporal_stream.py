@@ -235,28 +235,55 @@ def test_stream_exactly_once_no_retractions_ever():
 
 
 def run_interval_join_stream(l_commits, r_commits, iv, behavior, how="inner"):
+    """Interleaved L/R commits with deterministic ordering: a shared turn
+    counter (commits alternate L0, R0, L1, R1, ...) instead of sleeps —
+    commit() enqueues synchronously, so turn order IS timestamp order
+    even on a loaded CI box."""
     pw.internals.parse_graph.G.clear()
+    import threading
+
+    # explicit global schedule: L0, R0, L1, R1, ... (skipping exhausted
+    # sides), so uneven commit counts never leave a side waiting
+    sched: list[tuple[str, int]] = []
+    for i in range(max(len(l_commits), len(r_commits))):
+        if i < len(l_commits):
+            sched.append(("L", i))
+        if i < len(r_commits):
+            sched.append(("R", i))
+    pos = {si: p for p, si in enumerate(sched)}
+    turn = [0]
+    cv = threading.Condition()
+
+    def take_turn(side, i):
+        with cv:
+            cv.wait_for(lambda: turn[0] == pos[(side, i)], timeout=30)
+
+    def done_turn():
+        with cv:
+            turn[0] += 1
+            cv.notify_all()
 
     class Left(pw.io.python.ConnectorSubject):
         _deletions_enabled = False
 
         def run(self):
-            for batch in l_commits:
+            for i, batch in enumerate(l_commits):
+                take_turn("L", i)
                 for t in batch:
                     self.next(t=t)
                 self.commit()
+                done_turn()
 
     class Right(pw.io.python.ConnectorSubject):
         _deletions_enabled = False
 
         def run(self):
-            import time as _t
-
-            for batch in r_commits:
-                _t.sleep(0.05)  # interleave after left commits
+            for i, batch in enumerate(r_commits):
+                take_turn("R", i)
                 for t in batch:
                     self.next(t=t)
                 self.commit()
+                done_turn()
 
     class S(pw.Schema):
         t: int

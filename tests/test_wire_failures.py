@@ -300,7 +300,7 @@ def test_nats_err_frame_raises():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(ConnectionError, match="authorization violation"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 def test_nats_malformed_size_is_clean_error():
@@ -314,7 +314,7 @@ def test_nats_malformed_size_is_clean_error():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(ConnectionError, match="malformed NATS size"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 def test_nats_negative_size_is_clean_error():
@@ -328,7 +328,7 @@ def test_nats_negative_size_is_clean_error():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(ConnectionError, match="malformed NATS frame size"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 def test_nats_hmsg_header_longer_than_total():
@@ -342,7 +342,7 @@ def test_nats_hmsg_header_longer_than_total():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(ConnectionError, match="hdr_len > total"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 def test_nats_disconnect_mid_payload():
@@ -355,7 +355,7 @@ def test_nats_disconnect_mid_payload():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(EOFError, match="NATS connection closed"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 def test_nats_garbage_frame_is_clean_error():
@@ -369,7 +369,7 @@ def test_nats_garbage_frame_is_clean_error():
     nc = nats_client(srv.port)
     nc.subscribe("x")
     with pytest.raises(ConnectionError, match="unexpected NATS frame"):
-        nc.next_msg(timeout=3.0)
+        nc.next_msg(timeout=20.0)
 
 
 # ---------------------------------------------------------------------------
