@@ -283,16 +283,23 @@ def nats_client(port) -> NatsConnection:
     return NatsConnection(f"nats://127.0.0.1:{port}", timeout=5.0)
 
 
-def nats_handshake(conn: socket.socket) -> None:
+def nats_handshake(conn: socket.socket, until: bytes = b"SUB ") -> None:
+    """INFO, then read until the client's SUB arrives. Buffer-aware: the
+    client may coalesce CONNECT and SUB into one packet, so counting
+    recv() calls would block forever under scheduling jitter."""
     conn.sendall(b'INFO {"server_name":"fault"}\r\n')
-    conn.recv(65536)  # CONNECT [+ PING]
-    conn.sendall(b"PONG\r\n")
+    conn.settimeout(20.0)
+    buf = b""
+    while until not in buf:
+        data = conn.recv(65536)
+        if not data:
+            raise RuntimeError("client disconnected during handshake")
+        buf += data
 
 
 def test_nats_err_frame_raises():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)  # SUB
         conn.sendall(b"-ERR 'authorization violation'\r\n")
         time.sleep(0.3)
 
@@ -306,7 +313,6 @@ def test_nats_err_frame_raises():
 def test_nats_malformed_size_is_clean_error():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)
         conn.sendall(b"MSG x 1 notanumber\r\n")
         time.sleep(0.5)
 
@@ -320,7 +326,6 @@ def test_nats_malformed_size_is_clean_error():
 def test_nats_negative_size_is_clean_error():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)
         conn.sendall(b"MSG x 1 -5\r\n")
         time.sleep(0.5)
 
@@ -334,7 +339,6 @@ def test_nats_negative_size_is_clean_error():
 def test_nats_hmsg_header_longer_than_total():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)
         conn.sendall(b"HMSG x 1 100 10\r\n" + b"0" * 12)
         time.sleep(0.5)
 
@@ -348,7 +352,6 @@ def test_nats_hmsg_header_longer_than_total():
 def test_nats_disconnect_mid_payload():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)
         conn.sendall(b"MSG x 1 100\r\nonly-ten-b")  # 10 of 100 bytes
 
     srv = FaultServer(script)
@@ -361,7 +364,6 @@ def test_nats_disconnect_mid_payload():
 def test_nats_garbage_frame_is_clean_error():
     def script(conn):
         nats_handshake(conn)
-        conn.recv(65536)
         conn.sendall(b"WHATISTHIS x y z\r\n")
         time.sleep(0.3)
 
