@@ -2107,6 +2107,7 @@ struct JShardOut {
     std::vector<JEmit> emits;
     std::vector<PyObject *> to_incref;
     std::vector<PyObject *> to_decref;
+    bool dup_bump = false; /* positive bump of a live (key,row) entry */
 };
 
 /* apply one side's delta rows to a side map; records refcount intents */
@@ -2119,6 +2120,12 @@ inline void japply(std::unordered_map<std::string, JEntry> &side,
         o.to_incref.push_back(r.key);
         o.to_incref.push_back(r.row);
     } else {
+        /* multiplicity bump of an already-live (key, row): the only way
+         * one output pair can be emitted twice in a batch (dL x R_old
+         * and L_new x dR hitting the same 4-tuple) — disqualifies the
+         * caller's net-form shortcut */
+        if (it->second.count > 0 && r.diff > 0)
+            o.dup_bump = true;
         it->second.count += r.diff;
         if (it->second.count == 0) {
             o.to_decref.push_back(it->second.key);
@@ -2381,7 +2388,12 @@ PyObject *join_batch(PyObject *, PyObject *args)
         Py_XDECREF(out);
         return nullptr;
     }
-    return out;
+    bool dup = false;
+    for (auto &o : outs)
+        dup = dup || o.dup_bump;
+    PyObject *res = Py_BuildValue("(OO)", out, dup ? Py_True : Py_False);
+    Py_DECREF(out);
+    return res;
 }
 
 /* dump: [(jk, [(key,row,count) left], [(key,row,count) right])] */

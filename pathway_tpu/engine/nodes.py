@@ -640,7 +640,7 @@ class JoinNode(GroupDiffNode):
             rkeys = [d[0] for d in rb]
             rrows = [d[1] for d in rb]
             try:
-                raw = self._exec.join_batch(
+                raw, dup_bump = self._exec.join_batch(
                     self._jstore,
                     list(self.lkey_batch(lkeys, lrows)),
                     lkeys,
@@ -659,7 +659,24 @@ class JoinNode(GroupDiffNode):
             except self._exec.Fallback:
                 self._migrate_to_python()
             else:
-                # pad retract + inner insert can target the same (key, row)
+                # insert-only INNER batches are net form by construction:
+                # every emitted (pair-key, row) is distinct (distinct
+                # delta entries × distinct store entries) and all diffs
+                # are positive — the streaming-append hot path skips the
+                # full output re-hash. The ONE exception is a positive
+                # multiplicity bump of an already-live (key, row) entry
+                # (ΔL×R_old and L_new×ΔR can then hit the same 4-tuple),
+                # which the executor reports as dup_bump. Pad transitions
+                # (left/right/outer) and retractions can collide
+                # retract+insert on one (key, row), so those still
+                # consolidate.
+                if (
+                    self.join_type == "inner"
+                    and not dup_bump
+                    and all(d[2] > 0 for d in lb)
+                    and all(d[2] > 0 for d in rb)
+                ):
+                    return ConsolidatedList(raw)
                 return consolidate(raw)
         return super().process(time, [lb, rb])
 

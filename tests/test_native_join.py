@@ -255,3 +255,46 @@ def test_join_threads_variants(monkeypatch):
     streamed = _run_streamed(commits_l, commits_r, pipeline)
     batch = _run_batch(final_l, final_r, pipeline)
     assert streamed == batch
+
+
+def test_join_batch_reports_dup_bump_for_multiset_bumps():
+    """A second +1 for an already-live (key, row) on one side can emit
+    the same output pair twice in one batch (dL x R_old and L_new x dR);
+    join_batch reports it so JoinNode falls back to full consolidation
+    instead of mislabeling the output as net form."""
+    from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
+    from pathway_tpu.native import get_pwexec
+
+    ex = get_pwexec()
+    if ex is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    store = ex.join_store_new(1, 0, 0, 1, 1)  # inner, pair keys, w=1/1
+    rk = ref_scalar("r", 1)
+
+    def pair_key(a, b):
+        return ref_scalar(a, b)
+
+    # batch 1: right row enters alone — no bump
+    out, dup = ex.join_batch(
+        store, [], [], [], [], [(7,)], [rk], [("rrow",)], [1], pair_key, None
+    )
+    assert dup is False and out == []
+    # batch 2: the SAME right (key, row) bumps to count 2 while a left
+    # row arrives on the same join key — dup must be reported
+    lk = ref_scalar("l", 1)
+    out2, dup2 = ex.join_batch(
+        store,
+        [(7,)], [lk], [("lrow",)], [1],
+        [(7,)], [rk], [("rrow",)], [1],
+        pair_key, None,
+    )
+    assert dup2 is True
+    # the same pair was emitted twice (dL x R_old and L_new x dR) —
+    # consolidation (which JoinNode now applies) must merge them
+    from pathway_tpu.engine.stream import consolidate
+
+    merged = consolidate(out2)
+    assert len(merged) == 1
+    assert merged[0][1] == ("lrow", "rrow") and merged[0][2] == 2
