@@ -135,7 +135,7 @@ def _ingest_window(enc, docs, batch_size, index, window_s, key_base0):
     import queue as _queue
     import threading
 
-    from pathway_tpu.models.encoder import _bucket
+    from pathway_tpu.models.encoder import _bucket, _seq_bucket
 
     n_batches = len(docs) // batch_size
     tok_q: "_queue.Queue" = _queue.Queue(maxsize=4)
@@ -190,7 +190,7 @@ def _ingest_window(enc, docs, batch_size, index, window_s, key_base0):
         done += n
         real_tokens += int(mask.sum())
         nb = _bucket(ids.shape[0], 8, enc.batch_size)
-        Lb = _bucket(ids.shape[1], 16, enc.config.max_len)
+        Lb = _seq_bucket(ids.shape[1], enc.config.max_len)
         padded_tokens += nb * Lb
     index.vectors.block_until_ready()
     elapsed = time.perf_counter() - t0

@@ -127,11 +127,22 @@ def _bucket(n: int, floor: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _seq_bucket(L: int, cap: int) -> int:
+    """Sequence buckets at multiples of 32 (floor 16): finer than pow2
+    doubling, so a ~90-token batch pads to 96 instead of 128 — ~25% less
+    padded device work per doc at a bounded shape count (<= cap/32
+    executables)."""
+    if L <= 16:
+        return 16
+    return min(((L + 31) // 32) * 32, cap)
+
+
 def pad_batch(ids: np.ndarray, mask: np.ndarray, max_len: int, batch_cap: int):
-    """Pad (ids, mask) to the bounded pow2 (batch, seq) shape set jit
-    relies on. Returns (ids_p, mask_p, n_valid_rows)."""
+    """Pad (ids, mask) to the bounded (batch, seq) shape set jit relies
+    on: pow2 batch buckets x multiple-of-32 sequence buckets. Returns
+    (ids_p, mask_p, n_valid_rows)."""
     n, L = ids.shape
-    Lb = _bucket(L, 16, max_len)
+    Lb = _seq_bucket(L, max_len)
     nb = _bucket(n, 8, batch_cap)
     if n > nb:
         raise ValueError(f"batch of {n} exceeds batch capacity {batch_cap}")
