@@ -1987,8 +1987,19 @@ struct JoinStore {
     uint8_t jt;
     uint8_t id_mode;
     int lwidth, rwidth;
+    PhaseStats phases;
     std::vector<JShard> shards;
 };
+
+PhaseStats g_join_phases; /* process-wide join totals (all stores) */
+
+inline void jphase_add(JoinStore *s, double PhaseStats::*field,
+                       std::chrono::steady_clock::time_point t0)
+{
+    const double dt = _since(t0);
+    s->phases.*field += dt;
+    g_join_phases.*field += dt;
+}
 
 void join_store_destructor(PyObject *capsule)
 {
@@ -2153,10 +2164,17 @@ PyObject *join_batch(PyObject *, PyObject *args)
     const bool rpads = store->jt == J_RIGHT || store->jt == J_OUTER;
 
     /* phase 1: extract (GIL held; no state mutated — Fallback replayable) */
+    auto _t0 = std::chrono::steady_clock::now();
     std::vector<JRowX> lx, rx;
     if (!extract_side(ljks, lkeys, lrows, ldiffs, W, lx) ||
         !extract_side(rjks, rkeys, rrows, rdiffs, W, rx))
         return nullptr;
+    jphase_add(store, &PhaseStats::extract_s, _t0);
+    store->phases.batches += 1;
+    g_join_phases.batches += 1;
+    store->phases.rows += (int64_t)(lx.size() + rx.size());
+    g_join_phases.rows += (int64_t)(lx.size() + rx.size());
+    auto _t1 = std::chrono::steady_clock::now();
 
     /* phase 2: apply + delta emission (GIL released) */
     std::vector<JShardOut> outs((size_t)W);
@@ -2271,6 +2289,8 @@ PyObject *join_batch(PyObject *, PyObject *args)
         }
         Py_END_ALLOW_THREADS
     }
+    jphase_add(store, &PhaseStats::apply_s, _t1);
+    auto _t2 = std::chrono::steady_clock::now();
 
     /* phase 3: refcounts + output materialization (GIL held) */
     for (auto &o : outs)
@@ -2388,6 +2408,7 @@ PyObject *join_batch(PyObject *, PyObject *args)
         Py_XDECREF(out);
         return nullptr;
     }
+    jphase_add(store, &PhaseStats::emit_s, _t2);
     bool dup = false;
     for (auto &o : outs)
         dup = dup || o.dup_bump;
@@ -3566,17 +3587,24 @@ PyObject *process_batch_nb(PyObject *, PyObject *args)
 PyObject *phase_stats(PyObject *, PyObject *)
 {
     return Py_BuildValue(
-        "{s:d,s:d,s:d,s:L,s:L}",
+        "{s:d,s:d,s:d,s:L,s:L,s:{s:d,s:d,s:d,s:L,s:L}}",
         "extract_s", g_phases.extract_s,
         "apply_s", g_phases.apply_s,
         "emit_s", g_phases.emit_s,
         "batches", (long long)g_phases.batches,
-        "rows", (long long)g_phases.rows);
+        "rows", (long long)g_phases.rows,
+        "join",
+        "extract_s", g_join_phases.extract_s,
+        "apply_s", g_join_phases.apply_s,
+        "emit_s", g_join_phases.emit_s,
+        "batches", (long long)g_join_phases.batches,
+        "rows", (long long)g_join_phases.rows);
 }
 
 PyObject *phase_stats_reset(PyObject *, PyObject *)
 {
     g_phases = PhaseStats{};
+    g_join_phases = PhaseStats{};
     Py_RETURN_NONE;
 }
 
