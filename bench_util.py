@@ -34,14 +34,22 @@ def median_of(runs: list[dict], rates: list[float]) -> dict:
 
 def write_artifact_atomic(path: str, artifact: list[dict]) -> None:
     """Rewrite the artifact via temp-file + rename so a crash mid-write
-    can never truncate previously recorded metrics."""
+    can never truncate previously recorded metrics. A failed write (full
+    disk, permissions) is logged loudly — a silently stale artifact would
+    defeat the self-defending-measurement goal — and the temp file is
+    cleaned up; the previous artifact version stays intact either way."""
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".bench_full_", suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
             json.dump(artifact, f, indent=1)
         os.replace(tmp, path)
-    except OSError:
+    except OSError as exc:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "bench artifact write failed (%s stays stale): %s", path, exc
+        )
         try:
             os.unlink(tmp)
         except OSError:

@@ -33,13 +33,28 @@
 #include <atomic>
 #include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <string>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include "pw_blake2b.h"
+
+/* Heterogeneous unordered_map lookup (string_view probe into a
+ * string-keyed map) is a C++20 library feature that libstdc++ only ships
+ * from GCC 11; on older toolchains fall back to materializing the probe
+ * key so the extension still builds (g++ 10 is the floor some images
+ * carry). */
+#if defined(__cpp_lib_generic_unordered_lookup)
+#define PW_SV_FIND(map_, sv_) (map_).find(sv_)
+#else
+#define PW_SV_FIND(map_, sv_) (map_).find(std::string(sv_))
+#endif
 
 namespace {
 
@@ -507,6 +522,201 @@ bool ser_gvals(std::string &out, PyObject *gvals)
         if (!ser_value(out, PyTuple_GET_ITEM(gvals, i)))
             return false;
     return true;
+}
+
+/* blake2b-128: shared single implementation (native/pw_blake2b.h) —
+ * the GIL-free key mint for the fused join/parse paths, byte-identical
+ * to hashlib.blake2b(digest_size=16) and to fastpath.c's ref_scalar. */
+
+/* ---- native key minting (ref_scalar parity, GIL-free) ----------------
+ * api._value_to_bytes layout for the values the fused paths mint from:
+ *   None    -> "\x00"
+ *   Pointer -> "P" + 16-byte LE
+ * wrapped in the length-prefixed tuple concat of api._concat_lp. The
+ * fused join emits ref_scalar(lk, rk) pair keys without a Python frame:
+ * serialize the two sides, blake2b-128, read little-endian. */
+
+inline void pw_put_u32le(std::string &out, uint32_t v)
+{
+    char b[4] = {(char)(v & 0xff), (char)((v >> 8) & 0xff),
+                 (char)((v >> 16) & 0xff), (char)((v >> 24) & 0xff)};
+    out.append(b, 4);
+}
+
+inline unsigned __int128 mint_pair_key128(bool l_some, unsigned __int128 lk,
+                                          bool r_some, unsigned __int128 rk)
+{
+    unsigned char buf[4 + 4 + 17 + 4 + 17];
+    size_t off = 0;
+    auto put_u32 = [&](uint32_t v) {
+        buf[off++] = (unsigned char)(v & 0xff);
+        buf[off++] = (unsigned char)((v >> 8) & 0xff);
+        buf[off++] = (unsigned char)((v >> 16) & 0xff);
+        buf[off++] = (unsigned char)((v >> 24) & 0xff);
+    };
+    auto put_side = [&](bool some, unsigned __int128 k) {
+        if (!some) {
+            put_u32(1);
+            buf[off++] = 0; /* None */
+            return;
+        }
+        put_u32(17);
+        buf[off++] = 'P';
+        for (int i = 0; i < 16; i++)
+            buf[off++] = (unsigned char)((k >> (8 * i)) & 0xff);
+    };
+    put_u32(2);
+    put_side(l_some, lk);
+    put_side(r_some, rk);
+    unsigned char dg[16];
+    pw_b2b_digest16(dg, buf, off);
+    unsigned __int128 out;
+    memcpy(&out, dg, 16);
+    return out;
+}
+
+/* ser_value parity for a 128-bit row key: values below 2^63 take the
+ * int64 'I' branch; larger ones match the 'H' + PyNumber_ToBase(v, 16)
+ * branch ("0x" + minimal lowercase hex) byte for byte, so entries stored
+ * by the nb path land in exactly the map slots the tuple path probes. */
+inline void ser_key128(std::string &out, unsigned __int128 k)
+{
+    if (k < ((unsigned __int128)1 << 63)) {
+        int64_t v = (int64_t)k;
+        out.push_back('I');
+        out.append(reinterpret_cast<const char *>(&v), 8);
+        return;
+    }
+    char hex[36];
+    uint64_t hi = (uint64_t)(k >> 64), lo = (uint64_t)k;
+    int n;
+    if (hi != 0)
+        n = snprintf(hex, sizeof(hex), "0x%llx%016llx",
+                     (unsigned long long)hi, (unsigned long long)lo);
+    else
+        n = snprintf(hex, sizeof(hex), "0x%llx", (unsigned long long)lo);
+    out.push_back('H');
+    pw_put_u32le(out, (uint32_t)n);
+    out.append(hex, (size_t)n);
+}
+
+/* ---- packed row cells (faithful columnar storage) --------------------
+ * The fused join keeps nb-fed store entries as C-owned packed cells
+ * instead of per-row Python tuples: tag byte + payload per cell, the
+ * same tag set the NativeBatch carries (so bool/int and 5.0/5 identity
+ * survives round-trips, unlike the normalized ser_value form). */
+
+enum NbTag : uint8_t {
+    NB_NONE = 0,
+    NB_INT = 1,
+    NB_FLT = 2,
+    NB_STR = 3,
+    NB_BOOL = 4,
+};
+
+/* one packed cell -> new Python value (GIL); advances p */
+inline PyObject *packed_cell_to_py(const char *&p)
+{
+    uint8_t tag = (uint8_t)*p++;
+    switch (tag) {
+    case NB_NONE:
+        Py_RETURN_NONE;
+    case NB_BOOL: {
+        int64_t w;
+        memcpy(&w, p, 8);
+        p += 8;
+        if (w)
+            Py_RETURN_TRUE;
+        Py_RETURN_FALSE;
+    }
+    case NB_INT: {
+        int64_t w;
+        memcpy(&w, p, 8);
+        p += 8;
+        return PyLong_FromLongLong((long long)w);
+    }
+    case NB_FLT: {
+        double d;
+        memcpy(&d, p, 8);
+        p += 8;
+        return PyFloat_FromDouble(d);
+    }
+    default: { /* NB_STR */
+        uint32_t len;
+        memcpy(&len, p, 4);
+        p += 4;
+        const char *s = p;
+        p += len;
+        return PyUnicode_FromStringAndSize(s, (Py_ssize_t)len);
+    }
+    }
+}
+
+/* advance p over one packed cell without materializing it */
+inline void packed_skip_cell(const char *&p)
+{
+    uint8_t tag = (uint8_t)*p++;
+    switch (tag) {
+    case NB_NONE:
+        return;
+    case NB_STR: {
+        uint32_t len;
+        memcpy(&len, p, 4);
+        p += 4 + len;
+        return;
+    }
+    default:
+        p += 8;
+        return;
+    }
+}
+
+/* packed cells -> new row tuple (GIL) */
+inline PyObject *packed_row_to_py(const std::string &cells, int width)
+{
+    PyObject *row = PyTuple_New(width);
+    if (row == nullptr)
+        return nullptr;
+    const char *p = cells.data();
+    for (int j = 0; j < width; j++) {
+        PyObject *v = packed_cell_to_py(p);
+        if (v == nullptr) {
+            Py_DECREF(row);
+            return nullptr;
+        }
+        PyTuple_SET_ITEM(row, j, v);
+    }
+    return row;
+}
+
+inline bool nb_int128_of(PyObject *v, unsigned __int128 *out)
+{
+    if (!PyLong_Check(v))
+        return false;
+    unsigned char buf[16];
+#if PY_VERSION_HEX >= 0x030D0000
+    if (_PyLong_AsByteArray((PyLongObject *)v, buf, 16, 1, 0, 0) != 0) {
+#else
+    if (_PyLong_AsByteArray((PyLongObject *)v, buf, 16, 1, 0) != 0) {
+#endif
+        PyErr_Clear();
+        return false;
+    }
+    memcpy(out, buf, 16);
+    return true;
+}
+
+/* materialize a 128-bit key into a Pointer (GIL) */
+inline PyObject *pointer_from_u128(PyObject *ptr_type, unsigned __int128 k)
+{
+    unsigned char buf[16];
+    memcpy(buf, &k, 16);
+    PyObject *raw = _PyLong_FromByteArray(buf, 16, 1, 0);
+    if (raw == nullptr || ptr_type == nullptr || ptr_type == Py_None)
+        return raw;
+    PyObject *key = PyObject_CallOneArg(ptr_type, raw);
+    Py_DECREF(raw);
+    return key;
 }
 
 /* ---- reducer math ----------------------------------------------------- */
@@ -1426,15 +1636,26 @@ PyObject *process_batch(PyObject *, PyObject *args)
     for (int w = 0; w < W && !failed; w++) {
         for (Affected &a : affected[(size_t)w]) {
             Group &g = *a.g;
-            /* mint gvals/out_key refs for groups created this batch */
-            if (g.gvals == nullptr) {
-                g.gvals = PyList_GET_ITEM(gvals_list, a.first_row);
-                Py_INCREF(g.gvals);
-                g.out_key = PyObject_CallOneArg(key_fn, g.gvals);
-                if (g.out_key == nullptr) {
+            /* mint gvals/out_key refs for groups created this batch.
+             * out_key is minted into a local and committed together with
+             * gvals only on success (and re-minted when a previous batch
+             * failed mid-mint) — a key_fn exception must not leave a
+             * group with gvals set and a null out_key that a later
+             * batch's emit would Py_INCREF. */
+            if (g.out_key == nullptr) {
+                PyObject *gv = g.gvals != nullptr
+                                   ? g.gvals
+                                   : PyList_GET_ITEM(gvals_list, a.first_row);
+                PyObject *ok = PyObject_CallOneArg(key_fn, gv);
+                if (ok == nullptr) {
                     failed = true;
                     break;
                 }
+                if (g.gvals == nullptr) {
+                    Py_INCREF(gv);
+                    g.gvals = gv;
+                }
+                g.out_key = ok;
             }
             bool before_live = a.before_total > 0;
             bool after_live = g.total > 0;
@@ -1956,16 +2177,42 @@ PyObject *store_load(PyObject *, PyObject *args)
  *  store entries died). Phase 3 (GIL) INCREFs first, builds the output
  *  deltas (which borrow from either the store or the still-alive batch
  *  lists), and DECREFs last.
+ *
+ *  Two entry points share ONE store: join_batch (Python delta lists in,
+ *  delta lists out) and join_batch_nb (columnar NativeBatch in, and —
+ *  in the steady streaming state — NativeBatch out). Entries carry a
+ *  tuple rep, a native packed-cell rep, or both; jk/entry serialization
+ *  is byte-identical across the two paths so a store may be fed by any
+ *  mix of them.
+ *
+ *  Replay invariant (both entry points): NO Fallback beyond phase 1.
+ *  Phase 1 mutates nothing, so a Fallback there replays safely on the
+ *  other path; an error after phase 1 leaves the batch half-applied and
+ *  the caller must demote the node rather than replay the batch.
  * ====================================================================== */
 
+/* One (key, row) multiset entry on a join side. Two representations:
+ *  - tuple rep: `key`/`row` own Python objects (tuple-path inserts);
+ *  - native rep: `key128` + `cells` hold a C-owned packed image of the
+ *    row (NativeBatch-path inserts) — no Python object exists for the
+ *    entry until the tuple path, a dump, or a demotion needs one.
+ * An entry has at least one rep; emissions use whichever is present and
+ * the fused emit stays columnar only while every touched entry carries
+ * the native rep. `cells` is shared so emit records survive the entry
+ * being erased mid-batch (retraction storms over nb-fed groups). */
 struct JEntry {
-    PyObject *key;  /* owned (incref'd via to_incref in phase 3) */
-    PyObject *row;  /* owned */
-    int64_t count;
+    PyObject *key = nullptr;  /* owned (incref'd via to_incref in phase 3) */
+    PyObject *row = nullptr;  /* owned */
+    unsigned __int128 key128 = 0;
+    std::shared_ptr<const std::string> cells;
+    int64_t count = 0;
 };
 
 struct JGroup {
-    PyObject *jk = nullptr; /* owned: join-key tuple (for dump/migration) */
+    PyObject *jk = nullptr; /* owned: join-key tuple (for dump/migration);
+                             * nullptr for nb-created groups — jk_cells
+                             * then holds the packed key columns */
+    std::string jk_cells;
     std::unordered_map<std::string, JEntry> left, right;
 };
 
@@ -1987,6 +2234,8 @@ struct JoinStore {
     uint8_t jt;
     uint8_t id_mode;
     int lwidth, rwidth;
+    PyObject *ptr_type = nullptr; /* owned: Pointer class — set by the nb
+                                   * path; materializes native entries */
     PhaseStats phases;
     std::vector<JShard> shards;
 };
@@ -2019,6 +2268,7 @@ void join_store_destructor(PyObject *capsule)
                 Py_XDECREF(e.second.row);
             }
         }
+    Py_XDECREF(s->ptr_type);
     delete s;
 }
 
@@ -2068,9 +2318,37 @@ struct JRowX {
     int64_t diff;
 };
 
-/* output instruction: null side pointers mean pad-with-Nones */
+/* one side of an output instruction: pad-with-Nones, a borrowed Python
+ * (key, row) pair, or a native (key128, packed cells) image. `cells` is
+ * a shared_ptr copy so the record survives its store entry being erased
+ * later in the batch (Python refs survive via the deferred-decref
+ * protocol instead). */
+enum JRefKind : uint8_t { JR_PAD = 0, JR_PY = 1, JR_NATIVE = 2 };
+
+struct JRef {
+    PyObject *k = nullptr, *row = nullptr; /* borrowed (protocol above) */
+    unsigned __int128 key128 = 0;
+    std::shared_ptr<const std::string> cells;
+    uint8_t kind = JR_PAD;
+};
+
+inline JRef jref_of_entry(const JEntry &e)
+{
+    JRef r;
+    if (e.cells) {
+        r.kind = JR_NATIVE;
+        r.key128 = e.key128;
+        r.cells = e.cells;
+    } else {
+        r.kind = JR_PY;
+        r.k = e.key;
+        r.row = e.row;
+    }
+    return r;
+}
+
 struct JEmit {
-    PyObject *lk, *lrow, *rk, *rrow; /* borrowed (see protocol above) */
+    JRef l, r;
     int64_t d;
 };
 
@@ -2127,7 +2405,11 @@ inline void japply(std::unordered_map<std::string, JEntry> &side,
 {
     auto it = side.find(r.entry_bytes);
     if (it == side.end()) {
-        side.emplace(r.entry_bytes, JEntry{r.key, r.row, r.diff});
+        JEntry e;
+        e.key = r.key;
+        e.row = r.row;
+        e.count = r.diff;
+        side.emplace(r.entry_bytes, std::move(e));
         o.to_incref.push_back(r.key);
         o.to_incref.push_back(r.row);
     } else {
@@ -2139,11 +2421,188 @@ inline void japply(std::unordered_map<std::string, JEntry> &side,
             o.dup_bump = true;
         it->second.count += r.diff;
         if (it->second.count == 0) {
-            o.to_decref.push_back(it->second.key);
-            o.to_decref.push_back(it->second.row);
+            if (it->second.key != nullptr) {
+                o.to_decref.push_back(it->second.key);
+                o.to_decref.push_back(it->second.row);
+            }
             side.erase(it);
         }
     }
+}
+
+/* fill row slots [base, base+width) from one side ref (GIL) */
+inline int fill_row_side(PyObject *row, int base, int width, const JRef &ref)
+{
+    if (ref.kind == JR_NATIVE) {
+        const char *p = ref.cells->data();
+        for (int j = 0; j < width; j++) {
+            PyObject *v = packed_cell_to_py(p);
+            if (v == nullptr)
+                return -1;
+            PyTuple_SET_ITEM(row, base + j, v);
+        }
+        return 0;
+    }
+    for (int j = 0; j < width; j++) {
+        PyObject *v =
+            ref.kind == JR_PY ? PyTuple_GET_ITEM(ref.row, j) : Py_None;
+        Py_INCREF(v);
+        PyTuple_SET_ITEM(row, base + j, v);
+    }
+    return 0;
+}
+
+/* side key as a NEW reference: Pointer, or None for pads (GIL) */
+inline PyObject *jref_key_py(const JRef &ref, PyObject *ptr_type)
+{
+    if (ref.kind == JR_PY) {
+        Py_INCREF(ref.k);
+        return ref.k;
+    }
+    if (ref.kind == JR_NATIVE)
+        return pointer_from_u128(ptr_type, ref.key128);
+    Py_RETURN_NONE;
+}
+
+/* Materialize the shard emit records into [(okey, row, d), ...] (GIL).
+ * pair_key_fn == nullptr mints ref_scalar(lk, rk) natively (blake2b
+ * parity) — the join_batch_nb path; join_batch passes its Python fn so
+ * direct callers with custom key fns keep their semantics. The JRef
+ * protocol keeps every referenced object/cell image alive until the
+ * caller runs its deferred decrefs AFTER this returns. */
+PyObject *jemit_tuples(JoinStore *store, std::vector<JShardOut> &outs,
+                       PyObject *pair_key_fn, PyObject *id_fn)
+{
+    PyObject *out = PyList_New(0);
+    bool failed = out == nullptr;
+    const int lw = store->lwidth, rw = store->rwidth;
+    for (auto &o : outs) {
+        if (failed)
+            break;
+        for (JEmit &e : o.emits) {
+            if (e.d == 0)
+                continue;
+            PyObject *row = PyTuple_New(lw + rw);
+            if (row == nullptr) {
+                failed = true;
+                break;
+            }
+            if (fill_row_side(row, 0, lw, e.l) < 0 ||
+                fill_row_side(row, lw, rw, e.r) < 0) {
+                Py_DECREF(row);
+                failed = true;
+                break;
+            }
+            PyObject *okey = nullptr;
+            switch (store->id_mode) {
+            case ID_LEFT_FN:
+                if (e.l.kind == JR_PAD) {
+                    PyErr_SetString(
+                        PyExc_ValueError,
+                        "join id= references the left side but an "
+                        "outer/right join produced a row with no left match");
+                    failed = true;
+                } else {
+                    /* id fns disqualify the nb path, so the side is
+                     * tuple-rep here by construction */
+                    PyObject *stack[2] = {e.l.k, e.l.row};
+                    okey = PyObject_Vectorcall(id_fn, stack, 2, nullptr);
+                }
+                break;
+            case ID_RIGHT_FN:
+                if (e.r.kind == JR_PAD) {
+                    PyErr_SetString(
+                        PyExc_ValueError,
+                        "join id= references the right side but an "
+                        "outer/left join produced a row with no right match");
+                    failed = true;
+                } else {
+                    PyObject *stack[2] = {e.r.k, e.r.row};
+                    okey = PyObject_Vectorcall(id_fn, stack, 2, nullptr);
+                }
+                break;
+            case ID_FROM_LEFT:
+                if (e.l.kind != JR_PAD) {
+                    okey = jref_key_py(e.l, store->ptr_type);
+                    break;
+                }
+                goto pair_key;
+            case ID_FROM_RIGHT:
+                if (e.r.kind != JR_PAD) {
+                    okey = jref_key_py(e.r, store->ptr_type);
+                    break;
+                }
+                goto pair_key;
+            default:
+            pair_key:
+                if (pair_key_fn != nullptr) {
+                    /* vectorcall for the per-output-row key mint: at join
+                     * fanouts this call count equals the OUTPUT size */
+                    PyObject *lk = jref_key_py(e.l, store->ptr_type);
+                    PyObject *rk =
+                        lk != nullptr ? jref_key_py(e.r, store->ptr_type)
+                                      : nullptr;
+                    if (lk == nullptr || rk == nullptr) {
+                        Py_XDECREF(lk);
+                        failed = true;
+                        break;
+                    }
+                    PyObject *stack[2] = {lk, rk};
+                    okey = PyObject_Vectorcall(pair_key_fn, stack, 2,
+                                               nullptr);
+                    Py_DECREF(lk);
+                    Py_DECREF(rk);
+                } else {
+                    /* native ref_scalar(lk, rk) mint (blake2b parity);
+                     * tuple-rep sides surface their 128-bit key value */
+                    unsigned __int128 lk128 = e.l.key128;
+                    unsigned __int128 rk128 = e.r.key128;
+                    bool ok = e.l.kind != JR_PY || nb_int128_of(e.l.k, &lk128);
+                    ok = ok &&
+                         (e.r.kind != JR_PY || nb_int128_of(e.r.k, &rk128));
+                    if (!ok) {
+                        PyErr_SetString(PyExc_TypeError,
+                                        "join key is not a 128-bit int");
+                        /* okey stays null: the shared cleanup below owns
+                         * the row decref (exactly once) */
+                        break;
+                    }
+                    okey = pointer_from_u128(
+                        store->ptr_type,
+                        mint_pair_key128(e.l.kind != JR_PAD, lk128,
+                                         e.r.kind != JR_PAD, rk128));
+                }
+            }
+            if (okey == nullptr) {
+                Py_DECREF(row);
+                failed = true;
+                break;
+            }
+            PyObject *delta = PyTuple_New(3);
+            PyObject *dobj = delta ? PyLong_FromLongLong(e.d) : nullptr;
+            if (delta == nullptr || dobj == nullptr) {
+                Py_XDECREF(delta);
+                Py_DECREF(okey);
+                Py_DECREF(row);
+                failed = true;
+                break;
+            }
+            PyTuple_SET_ITEM(delta, 0, okey);
+            PyTuple_SET_ITEM(delta, 1, row);
+            PyTuple_SET_ITEM(delta, 2, dobj);
+            if (PyList_Append(out, delta) < 0) {
+                Py_DECREF(delta);
+                failed = true;
+                break;
+            }
+            Py_DECREF(delta);
+        }
+    }
+    if (failed) {
+        Py_XDECREF(out);
+        return nullptr;
+    }
+    return out;
 }
 
 PyObject *join_batch(PyObject *, PyObject *args)
@@ -2220,17 +2679,21 @@ PyObject *join_batch(PyObject *, PyObject *args)
                 JGroup &g = git->second;
                 const bool llive0 = !g.left.empty();
                 const bool rlive0 = !g.right.empty();
+                JRef pad;
 
                 /* ΔL × R_old */
                 for (int32_t li : aff.l) {
                     const JRowX &dl = lx[(size_t)li];
+                    JRef dref;
+                    dref.kind = JR_PY;
+                    dref.k = dl.key;
+                    dref.row = dl.row;
                     for (auto &e : g.right)
-                        o.emits.push_back(JEmit{dl.key, dl.row, e.second.key,
-                                                e.second.row,
-                                                dl.diff * e.second.count});
-                    if (lpads && !rlive0)
                         o.emits.push_back(
-                            JEmit{dl.key, dl.row, nullptr, nullptr, dl.diff});
+                            JEmit{dref, jref_of_entry(e.second),
+                                  dl.diff * e.second.count});
+                    if (lpads && !rlive0)
+                        o.emits.push_back(JEmit{dref, pad, dl.diff});
                 }
                 for (int32_t li : aff.l)
                     japply(g.left, lx[(size_t)li], o);
@@ -2238,13 +2701,16 @@ PyObject *join_batch(PyObject *, PyObject *args)
                 /* L_new × ΔR */
                 for (int32_t ri : aff.r) {
                     const JRowX &dr = rx[(size_t)ri];
+                    JRef dref;
+                    dref.kind = JR_PY;
+                    dref.k = dr.key;
+                    dref.row = dr.row;
                     for (auto &e : g.left)
-                        o.emits.push_back(JEmit{e.second.key, e.second.row,
-                                                dr.key, dr.row,
-                                                e.second.count * dr.diff});
-                    if (rpads && !llive0)
                         o.emits.push_back(
-                            JEmit{nullptr, nullptr, dr.key, dr.row, dr.diff});
+                            JEmit{jref_of_entry(e.second), dref,
+                                  e.second.count * dr.diff});
+                    if (rpads && !llive0)
+                        o.emits.push_back(JEmit{pad, dref, dr.diff});
                 }
                 for (int32_t ri : aff.r)
                     japply(g.right, rx[(size_t)ri], o);
@@ -2256,19 +2722,18 @@ PyObject *join_batch(PyObject *, PyObject *args)
                 if (lpads && rlive0 != rlive1) {
                     const int64_t sign = rlive1 ? -1 : 1;
                     for (auto &e : g.left)
-                        o.emits.push_back(JEmit{e.second.key, e.second.row,
-                                                nullptr, nullptr,
+                        o.emits.push_back(JEmit{jref_of_entry(e.second), pad,
                                                 sign * e.second.count});
                 }
                 if (rpads && llive0 != llive1) {
                     const int64_t sign = llive1 ? -1 : 1;
                     for (auto &e : g.right)
-                        o.emits.push_back(JEmit{nullptr, nullptr,
-                                                e.second.key, e.second.row,
+                        o.emits.push_back(JEmit{pad, jref_of_entry(e.second),
                                                 sign * e.second.count});
                 }
                 if (g.left.empty() && g.right.empty()) {
-                    o.to_decref.push_back(g.jk);
+                    if (g.jk != nullptr)
+                        o.to_decref.push_back(g.jk);
                     sh.groups.erase(git);
                 }
             }
@@ -2297,117 +2762,13 @@ PyObject *join_batch(PyObject *, PyObject *args)
         for (PyObject *p : o.to_incref)
             Py_INCREF(p);
 
-    PyObject *out = PyList_New(0);
-    bool failed = out == nullptr;
-    const int lw = store->lwidth, rw = store->rwidth;
-    for (auto &o : outs) {
-        if (failed)
-            break;
-        for (JEmit &e : o.emits) {
-            if (e.d == 0)
-                continue;
-            PyObject *row = PyTuple_New(lw + rw);
-            if (row == nullptr) {
-                failed = true;
-                break;
-            }
-            for (int j = 0; j < lw; j++) {
-                PyObject *v =
-                    e.lrow != nullptr ? PyTuple_GET_ITEM(e.lrow, j) : Py_None;
-                Py_INCREF(v);
-                PyTuple_SET_ITEM(row, j, v);
-            }
-            for (int j = 0; j < rw; j++) {
-                PyObject *v =
-                    e.rrow != nullptr ? PyTuple_GET_ITEM(e.rrow, j) : Py_None;
-                Py_INCREF(v);
-                PyTuple_SET_ITEM(row, lw + j, v);
-            }
-            PyObject *okey = nullptr;
-            /* vectorcall for the per-output-row key mint: at join
-             * fanouts this call count equals the OUTPUT size */
-            PyObject *pair_stack[2] = {e.lk ? e.lk : Py_None,
-                                       e.rk ? e.rk : Py_None};
-            switch (store->id_mode) {
-            case ID_LEFT_FN:
-                if (e.lk == nullptr) {
-                    PyErr_SetString(
-                        PyExc_ValueError,
-                        "join id= references the left side but an "
-                        "outer/right join produced a row with no left match");
-                    failed = true;
-                } else {
-                    PyObject *stack[2] = {e.lk, e.lrow};
-                    okey = PyObject_Vectorcall(id_fn, stack, 2, nullptr);
-                }
-                break;
-            case ID_RIGHT_FN:
-                if (e.rk == nullptr) {
-                    PyErr_SetString(
-                        PyExc_ValueError,
-                        "join id= references the right side but an "
-                        "outer/left join produced a row with no right match");
-                    failed = true;
-                } else {
-                    PyObject *stack[2] = {e.rk, e.rrow};
-                    okey = PyObject_Vectorcall(id_fn, stack, 2, nullptr);
-                }
-                break;
-            case ID_FROM_LEFT:
-                if (e.lk != nullptr) {
-                    okey = e.lk;
-                    Py_INCREF(okey);
-                    break;
-                }
-                okey = PyObject_Vectorcall(pair_key_fn, pair_stack, 2,
-                                           nullptr);
-                break;
-            case ID_FROM_RIGHT:
-                if (e.rk != nullptr) {
-                    okey = e.rk;
-                    Py_INCREF(okey);
-                    break;
-                }
-                okey = PyObject_Vectorcall(pair_key_fn, pair_stack, 2,
-                                           nullptr);
-                break;
-            default:
-                okey = PyObject_Vectorcall(pair_key_fn, pair_stack, 2,
-                                           nullptr);
-            }
-            if (okey == nullptr) {
-                Py_DECREF(row);
-                failed = true;
-                break;
-            }
-            PyObject *delta = PyTuple_New(3);
-            PyObject *dobj = delta ? PyLong_FromLongLong(e.d) : nullptr;
-            if (delta == nullptr || dobj == nullptr) {
-                Py_XDECREF(delta);
-                Py_DECREF(okey);
-                Py_DECREF(row);
-                failed = true;
-                break;
-            }
-            PyTuple_SET_ITEM(delta, 0, okey);
-            PyTuple_SET_ITEM(delta, 1, row);
-            PyTuple_SET_ITEM(delta, 2, dobj);
-            if (PyList_Append(out, delta) < 0) {
-                Py_DECREF(delta);
-                failed = true;
-                break;
-            }
-            Py_DECREF(delta);
-        }
-    }
+    PyObject *out = jemit_tuples(store, outs, pair_key_fn, id_fn);
 
     for (auto &o : outs)
         for (PyObject *p : o.to_decref)
             Py_DECREF(p);
-    if (failed) {
-        Py_XDECREF(out);
+    if (out == nullptr)
         return nullptr;
-    }
     jphase_add(store, &PhaseStats::emit_s, _t2);
     bool dup = false;
     for (auto &o : outs)
@@ -2417,7 +2778,10 @@ PyObject *join_batch(PyObject *, PyObject *args)
     return res;
 }
 
-/* dump: [(jk, [(key,row,count) left], [(key,row,count) right])] */
+/* dump: [(jk, [(key,row,count) left], [(key,row,count) right])] —
+ * native-rep entries (and nb-created group keys) materialize here, so
+ * snapshots and Python-path demotion see ordinary picklable tuples
+ * regardless of which path fed the store. */
 PyObject *join_store_dump(PyObject *, PyObject *arg)
 {
     JoinStore *s = get_join_store(arg);
@@ -2426,14 +2790,32 @@ PyObject *join_store_dump(PyObject *, PyObject *arg)
     PyObject *out = PyList_New(0);
     if (out == nullptr)
         return nullptr;
-    auto dump_side = [](std::unordered_map<std::string, JEntry> &side)
-        -> PyObject * {
+    auto dump_side = [s](std::unordered_map<std::string, JEntry> &side,
+                         int width) -> PyObject * {
         PyObject *lst = PyList_New(0);
         if (lst == nullptr)
             return nullptr;
         for (auto &e : side) {
-            PyObject *t = Py_BuildValue("(OOL)", e.second.key, e.second.row,
-                                        (long long)e.second.count);
+            PyObject *t;
+            if (e.second.cells) {
+                PyObject *key =
+                    pointer_from_u128(s->ptr_type, e.second.key128);
+                if (key == nullptr) {
+                    Py_DECREF(lst);
+                    return nullptr;
+                }
+                PyObject *row = packed_row_to_py(*e.second.cells, width);
+                if (row == nullptr) {
+                    Py_DECREF(key);
+                    Py_DECREF(lst);
+                    return nullptr;
+                }
+                t = Py_BuildValue("(NNL)", key, row,
+                                  (long long)e.second.count);
+            } else {
+                t = Py_BuildValue("(OOL)", e.second.key, e.second.row,
+                                  (long long)e.second.count);
+            }
             if (t == nullptr || PyList_Append(lst, t) < 0) {
                 Py_XDECREF(t);
                 Py_DECREF(lst);
@@ -2445,12 +2827,45 @@ PyObject *join_store_dump(PyObject *, PyObject *arg)
     };
     for (auto &sh : s->shards) {
         for (auto &kv : sh.groups) {
-            PyObject *l = dump_side(kv.second.left);
-            PyObject *r = l != nullptr ? dump_side(kv.second.right) : nullptr;
+            PyObject *jk = kv.second.jk;
+            PyObject *jk_new = nullptr;
+            if (jk == nullptr) {
+                /* nb-created group: rebuild the join-key tuple from its
+                 * packed key cells */
+                const std::string &kc = kv.second.jk_cells;
+                Py_ssize_t nk = 0;
+                {
+                    const char *p = kc.data();
+                    const char *end = p + kc.size();
+                    while (p < end) {
+                        packed_skip_cell(p);
+                        nk++;
+                    }
+                }
+                jk_new = PyTuple_New(nk);
+                if (jk_new == nullptr) {
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                const char *p = kc.data();
+                for (Py_ssize_t j = 0; j < nk; j++) {
+                    PyObject *v = packed_cell_to_py(p);
+                    if (v == nullptr) {
+                        Py_DECREF(jk_new);
+                        Py_DECREF(out);
+                        return nullptr;
+                    }
+                    PyTuple_SET_ITEM(jk_new, j, v);
+                }
+                jk = jk_new;
+            }
+            PyObject *l = dump_side(kv.second.left, s->lwidth);
+            PyObject *r =
+                l != nullptr ? dump_side(kv.second.right, s->rwidth)
+                             : nullptr;
             PyObject *entry =
-                r != nullptr
-                    ? Py_BuildValue("(ONN)", kv.second.jk, l, r)
-                    : nullptr;
+                r != nullptr ? Py_BuildValue("(ONN)", jk, l, r) : nullptr;
+            Py_XDECREF(jk_new);
             if (entry == nullptr || PyList_Append(out, entry) < 0) {
                 if (entry == nullptr && l != nullptr && r == nullptr)
                     Py_DECREF(l);
@@ -2511,7 +2926,11 @@ PyObject *join_store_load(PyObject *, PyObject *args)
                                         "unsupported join value in snapshot");
                     return false;
                 }
-                auto ins = side.emplace(eb, JEntry{key, row, count});
+                JEntry ne;
+                ne.key = key;
+                ne.row = row;
+                ne.count = count;
+                auto ins = side.emplace(eb, std::move(ne));
                 if (ins.second) {
                     Py_INCREF(key);
                     Py_INCREF(row);
@@ -2858,13 +3277,8 @@ PyObject *wp_tokenize_padded(PyObject *, PyObject *args)
  * [(key, row, +1), ...] form and the batch degrades gracefully at any
  * chain boundary (UDFs, temporal gates, exchanges, journals). */
 
-enum NbTag : uint8_t {
-    NB_NONE = 0,
-    NB_INT = 1,
-    NB_FLT = 2,
-    NB_STR = 3,
-    NB_BOOL = 4,
-};
+/* NbTag lives up top (packed-cell helpers reuse it for the join store's
+ * columnar entries). */
 
 struct NbCol {
     std::vector<uint8_t> tag;
@@ -3140,23 +3554,6 @@ bool nb_put(NbCol &c, PyObject *v)
     return false; /* bytes/tuples/ndarrays/Json/subclasses: tuple path */
 }
 
-bool nb_int128_of(PyObject *v, unsigned __int128 *out)
-{
-    if (!PyLong_Check(v))
-        return false;
-    unsigned char buf[16];
-#if PY_VERSION_HEX >= 0x030D0000
-    if (_PyLong_AsByteArray((PyLongObject *)v, buf, 16, 1, 0, 0) != 0) {
-#else
-    if (_PyLong_AsByteArray((PyLongObject *)v, buf, 16, 1, 0) != 0) {
-#endif
-        PyErr_Clear();
-        return false;
-    }
-    memcpy(out, buf, 16);
-    return true;
-}
-
 /* parse_upserts_nb(msgs, start, cols, defaults, key_base, seq0, ptr_type)
  *   Columnar variant of fastpath.parse_upserts: builds a NativeBatch
  *   instead of per-row Python tuples. Keys are (key_base + seq) mod
@@ -3271,6 +3668,804 @@ inline void nb_ser_cell(std::string &out, const NbCol &c, Py_ssize_t i)
     }
 }
 
+/* ---- columnar pack/append helpers (fused join + pk parse) ------------ */
+
+/* faithful packed copy of one nb cell (tag + payload) */
+inline void pack_cell_from_nb(std::string &out, const NbCol &c, Py_ssize_t i)
+{
+    uint8_t tag = c.tag[(size_t)i];
+    out.push_back((char)tag);
+    switch (tag) {
+    case NB_NONE:
+        return;
+    case NB_STR: {
+        uint32_t len = c.len[(size_t)i];
+        out.append(reinterpret_cast<const char *>(&len), 4);
+        out.append(c.arena.data() + (size_t)c.word[(size_t)i], len);
+        return;
+    }
+    default:
+        out.append(reinterpret_cast<const char *>(&c.word[(size_t)i]), 8);
+        return;
+    }
+}
+
+/* append one packed row image (or width Nones when cells == nullptr)
+ * into output columns [base, base+width) — GIL-free */
+inline void append_packed_cells(std::vector<NbCol> &cols, int base,
+                                int width, const std::string *cells)
+{
+    if (cells == nullptr) {
+        for (int j = 0; j < width; j++) {
+            NbCol &c = cols[(size_t)(base + j)];
+            c.tag.push_back(NB_NONE);
+            c.word.push_back(0);
+            c.len.push_back(0);
+        }
+        return;
+    }
+    const char *p = cells->data();
+    for (int j = 0; j < width; j++) {
+        NbCol &c = cols[(size_t)(base + j)];
+        uint8_t tag = (uint8_t)*p++;
+        switch (tag) {
+        case NB_NONE:
+            c.tag.push_back(NB_NONE);
+            c.word.push_back(0);
+            c.len.push_back(0);
+            break;
+        case NB_STR: {
+            uint32_t len;
+            memcpy(&len, p, 4);
+            p += 4;
+            c.tag.push_back(NB_STR);
+            c.word.push_back((int64_t)c.arena.size());
+            c.len.push_back(len);
+            c.arena.append(p, len);
+            p += len;
+            break;
+        }
+        default: {
+            int64_t w;
+            memcpy(&w, p, 8);
+            p += 8;
+            c.tag.push_back(tag);
+            c.word.push_back(w);
+            c.len.push_back(0);
+            break;
+        }
+        }
+    }
+}
+
+/* concatenate one column into another (arena offsets re-based) */
+inline void nbcol_append(NbCol &dst, const NbCol &src)
+{
+    const int64_t base = (int64_t)dst.arena.size();
+    size_t n0 = dst.tag.size();
+    dst.tag.insert(dst.tag.end(), src.tag.begin(), src.tag.end());
+    dst.word.insert(dst.word.end(), src.word.begin(), src.word.end());
+    dst.len.insert(dst.len.end(), src.len.begin(), src.len.end());
+    dst.arena.append(src.arena);
+    for (size_t i = n0; i < dst.tag.size(); i++)
+        if (dst.tag[i] == NB_STR)
+            dst.word[i] += base;
+}
+
+/* ==== join_batch_nb: the fused join step ===============================
+ *
+ * One C call takes a columnar NativeBatch (either or both sides) through
+ * the delta join with zero per-row Python objects: join keys and entry
+ * identities serialize straight from the columns (byte-identical to the
+ * tuple path, so nb- and tuple-fed batches share one store), apply runs
+ * GIL-free and shard-parallel, and when every output row is a +1 over
+ * native-rep entries the OUTPUT is built as a NativeBatch too — pair
+ * keys minted by the in-process blake2b (ref_scalar parity) — so a
+ * downstream fused consumer (exprs/filter projection, group-by, capture)
+ * stays in C. Anything the columnar form cannot express (multiplicity
+ * bumps, pad-transition retractions, tuple-rep store entries) falls back
+ * to materialized (key, row, diff) output for THAT batch only.
+ *
+ * Replay invariant (mirrors process_batch/join_batch): no Fallback
+ * beyond phase 1. Phase 1 mutates nothing, so a Fallback there is
+ * replayable via the tuple path; any later error leaves the batch
+ * half-applied and the CALLER must demote the node instead of replaying
+ * (JoinNode._poison_demote). */
+
+/* extracted nb row for one side */
+struct JRowNb {
+    uint32_t shard;
+    uint32_t row; /* index into the source nb */
+    std::string jk_bytes;
+    std::string entry_bytes;
+    std::shared_ptr<const std::string> cells;
+    unsigned __int128 key128;
+};
+
+inline void japply_nb(std::unordered_map<std::string, JEntry> &side,
+                      const JRowNb &r, JShardOut &o)
+{
+    auto it = side.find(r.entry_bytes);
+    if (it == side.end()) {
+        JEntry e;
+        e.key128 = r.key128;
+        e.cells = r.cells;
+        e.count = 1;
+        side.emplace(r.entry_bytes, std::move(e));
+    } else {
+        if (it->second.count > 0)
+            o.dup_bump = true; /* nb deltas are always +1 */
+        it->second.count += 1;
+    }
+}
+
+bool extract_side_nb(NativeBatchObject *nb, const std::vector<int> &kidx,
+                     int W, std::vector<JRowNb> &out)
+{
+    if (nb == nullptr)
+        return true;
+    const Py_ssize_t n = nb->n;
+    out.resize((size_t)n);
+    SvHash hasher; /* one hasher everywhere: shard placement must agree
+                      across the nb and tuple paths */
+    const int width = nb->width;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        JRowNb &r = out[(size_t)i];
+        r.row = (uint32_t)i;
+        r.key128 = (*nb->keys)[(size_t)i];
+        uint32_t nk = (uint32_t)kidx.size();
+        r.jk_bytes.append(reinterpret_cast<const char *>(&nk), 4);
+        for (int j : kidx)
+            nb_ser_cell(r.jk_bytes, (*nb->cols)[(size_t)j], i);
+        ser_key128(r.entry_bytes, r.key128);
+        uint32_t uw = (uint32_t)width;
+        r.entry_bytes.append(reinterpret_cast<const char *>(&uw), 4);
+        for (int c = 0; c < width; c++)
+            nb_ser_cell(r.entry_bytes, (*nb->cols)[(size_t)c], i);
+        auto cells = std::make_shared<std::string>();
+        cells->reserve((size_t)width * 9);
+        for (int c = 0; c < width; c++)
+            pack_cell_from_nb(*cells, (*nb->cols)[(size_t)c], i);
+        r.cells = std::move(cells);
+        r.shard = (uint32_t)(hasher(r.jk_bytes) % (size_t)W);
+    }
+    return true;
+}
+
+/* join_batch_nb(store, lnb_or_None, rnb_or_None, lkidx, rkidx, ptr_type)
+ * -> NativeBatch (fully fused) | (deltas_list, dup_bump) */
+PyObject *join_batch_nb(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *lnb_obj, *rnb_obj, *lkidx_t, *rkidx_t, *ptr_type;
+    if (!PyArg_ParseTuple(args, "OOOO!O!O", &capsule, &lnb_obj, &rnb_obj,
+                          &PyTuple_Type, &lkidx_t, &PyTuple_Type, &rkidx_t,
+                          &ptr_type))
+        return nullptr;
+    JoinStore *store = get_join_store(capsule);
+    if (store == nullptr)
+        return nullptr;
+    if (store->id_mode == ID_LEFT_FN || store->id_mode == ID_RIGHT_FN) {
+        /* per-row Python id fns cannot run in the fused path; nothing is
+         * mutated yet, so this Fallback is replayable via the tuple path */
+        PyErr_SetString(FallbackError, "nb join path with id= fn");
+        return nullptr;
+    }
+    NativeBatchObject *lnb =
+        lnb_obj == Py_None ? nullptr
+                           : reinterpret_cast<NativeBatchObject *>(lnb_obj);
+    NativeBatchObject *rnb =
+        rnb_obj == Py_None ? nullptr
+                           : reinterpret_cast<NativeBatchObject *>(rnb_obj);
+    if ((lnb_obj != Py_None && Py_TYPE(lnb_obj) != &NativeBatchType) ||
+        (rnb_obj != Py_None && Py_TYPE(rnb_obj) != &NativeBatchType)) {
+        PyErr_SetString(PyExc_TypeError, "join_batch_nb: NativeBatch sides");
+        return nullptr;
+    }
+    if ((lnb != nullptr && lnb->width != store->lwidth) ||
+        (rnb != nullptr && rnb->width != store->rwidth)) {
+        PyErr_SetString(PyExc_ValueError, "join_batch_nb: width mismatch");
+        return nullptr;
+    }
+    auto idx_vec = [](PyObject *t, int width,
+                      std::vector<int> &out) -> bool {
+        Py_ssize_t n = PyTuple_GET_SIZE(t);
+        out.resize((size_t)n);
+        for (Py_ssize_t j = 0; j < n; j++) {
+            long v = PyLong_AsLong(PyTuple_GET_ITEM(t, j));
+            if (v < 0 || v >= width) {
+                PyErr_SetString(PyExc_ValueError, "join_batch_nb: key idx");
+                return false;
+            }
+            out[(size_t)j] = (int)v;
+        }
+        return true;
+    };
+    std::vector<int> lkidx, rkidx;
+    if (!idx_vec(lkidx_t, store->lwidth, lkidx) ||
+        !idx_vec(rkidx_t, store->rwidth, rkidx))
+        return nullptr;
+    if (store->ptr_type == nullptr && ptr_type != Py_None) {
+        Py_INCREF(ptr_type);
+        store->ptr_type = ptr_type;
+    }
+    const int W = store->n_shards;
+    const bool lpads = store->jt == J_LEFT || store->jt == J_OUTER;
+    const bool rpads = store->jt == J_RIGHT || store->jt == J_OUTER;
+
+    /* phase 1: extract — pure C over the columnar images (GIL held; no
+     * state mutated, so failures up to here are replayable) */
+    auto _t0 = std::chrono::steady_clock::now();
+    std::vector<JRowNb> lx, rx;
+    if (!extract_side_nb(lnb, lkidx, W, lx) ||
+        !extract_side_nb(rnb, rkidx, W, rx))
+        return nullptr;
+    jphase_add(store, &PhaseStats::extract_s, _t0);
+    store->phases.batches += 1;
+    g_join_phases.batches += 1;
+    store->phases.rows += (int64_t)(lx.size() + rx.size());
+    g_join_phases.rows += (int64_t)(lx.size() + rx.size());
+    auto _t1 = std::chrono::steady_clock::now();
+
+    /* phase 2: apply + delta emission + (when fusable) columnar output
+     * build, all GIL-free and shard-parallel */
+    std::vector<JShardOut> outs((size_t)W);
+    struct NbShardOut {
+        std::vector<unsigned __int128> keys;
+        std::vector<NbCol> cols;
+        bool fusable = true;
+    };
+    std::vector<NbShardOut> nbouts((size_t)W);
+    const int lw = store->lwidth, rw = store->rwidth;
+    bool fuse_all = true;
+    {
+        struct Aff {
+            std::vector<int32_t> l, r;
+        };
+        std::vector<std::unordered_map<std::string, Aff>> touched((size_t)W);
+        std::vector<std::vector<const std::string *>> order((size_t)W);
+        for (size_t i = 0; i < lx.size(); i++) {
+            auto &t = touched[lx[i].shard];
+            auto it = t.find(lx[i].jk_bytes);
+            if (it == t.end()) {
+                it = t.emplace(lx[i].jk_bytes, Aff{}).first;
+                order[lx[i].shard].push_back(&it->first);
+            }
+            it->second.l.push_back((int32_t)i);
+        }
+        for (size_t i = 0; i < rx.size(); i++) {
+            auto &t = touched[rx[i].shard];
+            auto it = t.find(rx[i].jk_bytes);
+            if (it == t.end()) {
+                it = t.emplace(rx[i].jk_bytes, Aff{}).first;
+                order[rx[i].shard].push_back(&it->first);
+            }
+            it->second.r.push_back((int32_t)i);
+        }
+
+        auto work = [&](int w) {
+            JShard &sh = store->shards[(size_t)w];
+            JShardOut &o = outs[(size_t)w];
+            for (const std::string *jkb : order[(size_t)w]) {
+                Aff &aff = touched[(size_t)w][*jkb];
+                auto git = sh.groups.find(*jkb);
+                if (git == sh.groups.end()) {
+                    git = sh.groups.emplace(*jkb, JGroup{}).first;
+                    /* nb-created group: pack the key columns so dump /
+                     * demotion can rebuild the join-key tuple */
+                    JGroup &ng = git->second;
+                    if (!aff.l.empty()) {
+                        const JRowNb &r0 = lx[(size_t)aff.l[0]];
+                        for (int j : lkidx)
+                            pack_cell_from_nb(ng.jk_cells,
+                                              (*lnb->cols)[(size_t)j],
+                                              (Py_ssize_t)r0.row);
+                    } else {
+                        const JRowNb &r0 = rx[(size_t)aff.r[0]];
+                        for (int j : rkidx)
+                            pack_cell_from_nb(ng.jk_cells,
+                                              (*rnb->cols)[(size_t)j],
+                                              (Py_ssize_t)r0.row);
+                    }
+                }
+                JGroup &g = git->second;
+                const bool llive0 = !g.left.empty();
+                const bool rlive0 = !g.right.empty();
+                JRef pad;
+
+                /* ΔL × R_old */
+                for (int32_t li : aff.l) {
+                    const JRowNb &dl = lx[(size_t)li];
+                    JRef dref;
+                    dref.kind = JR_NATIVE;
+                    dref.key128 = dl.key128;
+                    dref.cells = dl.cells;
+                    for (auto &e : g.right)
+                        o.emits.push_back(JEmit{dref, jref_of_entry(e.second),
+                                                e.second.count});
+                    if (lpads && !rlive0)
+                        o.emits.push_back(JEmit{dref, pad, 1});
+                }
+                for (int32_t li : aff.l)
+                    japply_nb(g.left, lx[(size_t)li], o);
+
+                /* L_new × ΔR */
+                for (int32_t ri : aff.r) {
+                    const JRowNb &dr = rx[(size_t)ri];
+                    JRef dref;
+                    dref.kind = JR_NATIVE;
+                    dref.key128 = dr.key128;
+                    dref.cells = dr.cells;
+                    for (auto &e : g.left)
+                        o.emits.push_back(JEmit{jref_of_entry(e.second), dref,
+                                                e.second.count});
+                    if (rpads && !llive0)
+                        o.emits.push_back(JEmit{pad, dref, 1});
+                }
+                for (int32_t ri : aff.r)
+                    japply_nb(g.right, rx[(size_t)ri], o);
+
+                /* pad transitions (liveness flips) — retractions: they
+                 * disqualify the columnar output but stay exact */
+                const bool llive1 = !g.left.empty();
+                const bool rlive1 = !g.right.empty();
+                if (lpads && rlive0 != rlive1) {
+                    const int64_t sign = rlive1 ? -1 : 1;
+                    for (auto &e : g.left)
+                        o.emits.push_back(JEmit{jref_of_entry(e.second), pad,
+                                                sign * e.second.count});
+                }
+                if (rpads && llive0 != llive1) {
+                    const int64_t sign = llive1 ? -1 : 1;
+                    for (auto &e : g.right)
+                        o.emits.push_back(JEmit{pad, jref_of_entry(e.second),
+                                                sign * e.second.count});
+                }
+                /* insert-only deltas can never empty a group */
+            }
+            NbShardOut &no = nbouts[(size_t)w];
+            /* Fused output requires the NativeBatch invariant of DISTINCT
+             * keys (nb_project passthrough skips the key-set re-check the
+             * materialized path performs): only ID_PAIR guarantees it —
+             * distinct (lk, rk) pairs mint distinct blake2b keys, and
+             * dup_bump flags repeated pairs. id_from_left/right joins
+             * with fanout repeat output ids, so they emit tuples. */
+            if (store->id_mode != ID_PAIR)
+                no.fusable = false;
+            for (const JEmit &e : o.emits)
+                if (!no.fusable || e.d != 1 || e.l.kind == JR_PY ||
+                    e.r.kind == JR_PY || o.dup_bump) {
+                    no.fusable = false;
+                    break;
+                }
+        };
+        auto build = [&](int w) {
+            /* stage B: columnar output build (still GIL-free) */
+            JShardOut &o = outs[(size_t)w];
+            NbShardOut &no = nbouts[(size_t)w];
+            no.cols.resize((size_t)(lw + rw));
+            no.keys.reserve(o.emits.size());
+            for (const JEmit &e : o.emits) {
+                const bool l_some = e.l.kind != JR_PAD;
+                const bool r_some = e.r.kind != JR_PAD;
+                /* only ID_PAIR is fusable (distinct-keys invariant) */
+                no.keys.push_back(mint_pair_key128(l_some, e.l.key128,
+                                                   r_some, e.r.key128));
+                append_packed_cells(no.cols, 0, lw,
+                                    l_some ? e.l.cells.get() : nullptr);
+                append_packed_cells(no.cols, lw, rw,
+                                    r_some ? e.r.cells.get() : nullptr);
+            }
+        };
+
+        size_t total = lx.size() + rx.size();
+        Py_BEGIN_ALLOW_THREADS
+        const bool threaded = W > 1 && total >= 2048;
+        if (threaded) {
+            std::vector<std::thread> threads;
+            threads.reserve((size_t)W);
+            for (int w = 0; w < W; w++)
+                threads.emplace_back(work, w);
+            for (auto &t : threads)
+                t.join();
+        } else {
+            for (int w = 0; w < W; w++)
+                work(w);
+        }
+        for (int w = 0; w < W; w++)
+            fuse_all = fuse_all && nbouts[(size_t)w].fusable &&
+                       !outs[(size_t)w].dup_bump;
+        if (fuse_all) {
+            if (threaded) {
+                std::vector<std::thread> threads;
+                threads.reserve((size_t)W);
+                for (int w = 0; w < W; w++)
+                    threads.emplace_back(build, w);
+                for (auto &t : threads)
+                    t.join();
+            } else {
+                for (int w = 0; w < W; w++)
+                    build(w);
+            }
+        }
+        Py_END_ALLOW_THREADS
+    }
+    jphase_add(store, &PhaseStats::apply_s, _t1);
+    auto _t2 = std::chrono::steady_clock::now();
+
+    /* phase 3 (GIL): assemble the output object. No refcount intents —
+     * the nb path stores no Python objects. */
+    if (fuse_all) {
+        NativeBatchObject *nb = nb_alloc(lw + rw, store->ptr_type);
+        if (nb == nullptr)
+            return nullptr;
+        size_t total_rows = 0;
+        for (auto &no : nbouts)
+            total_rows += no.keys.size();
+        nb->keys->reserve(total_rows);
+        for (auto &no : nbouts) {
+            nb->keys->insert(nb->keys->end(), no.keys.begin(),
+                             no.keys.end());
+            if (no.cols.empty())
+                continue;
+            for (int c = 0; c < lw + rw; c++)
+                nbcol_append((*nb->cols)[(size_t)c], no.cols[(size_t)c]);
+        }
+        nb->n = (Py_ssize_t)nb->keys->size();
+        jphase_add(store, &PhaseStats::emit_s, _t2);
+        return reinterpret_cast<PyObject *>(nb);
+    }
+    PyObject *out = jemit_tuples(store, outs, nullptr, nullptr);
+    if (out == nullptr)
+        return nullptr;
+    jphase_add(store, &PhaseStats::emit_s, _t2);
+    bool dup = false;
+    for (auto &o : outs)
+        dup = dup || o.dup_bump;
+    PyObject *res = Py_BuildValue("(OO)", out, dup ? Py_True : Py_False);
+    Py_DECREF(out);
+    return res;
+}
+
+/* ==== parse_pk_upserts_nb: columnar primary-keyed upsert parse =========
+ *
+ * The CDC-shaped connector hot path (primary_key columns, deletions
+ * disabled) kept per-row Python alive purely for the upsert session
+ * bookkeeping. This variant owns the session in C — pk digest -> packed
+ * row cells — and emits a NativeBatch when every row is a FRESH key, so
+ * the parse → join/groupby chain stays zero-interpreter. The first
+ * obstacle (re-upserted key needing a retraction, non-columnar value,
+ * pk overflow) dumps the C session into the caller's live_rows dict and
+ * returns None: the caller permanently falls back to the tuple pk path,
+ * which then sees exactly the state it would have built itself. */
+
+struct PkStore {
+    std::unordered_map<std::string, std::string> rows;
+};
+
+void pk_store_destructor(PyObject *capsule)
+{
+    delete static_cast<PkStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.PkStore"));
+}
+
+PyObject *pk_session_new(PyObject *, PyObject *)
+{
+    return PyCapsule_New(new PkStore(), "pwexec.PkStore",
+                         pk_store_destructor);
+}
+
+/* value_bytes parity for pk minting (api._value_to_bytes subset over the
+ * columnar value set; anything else demotes to the Python mint) */
+inline bool ser_pk_value(std::string &out, PyObject *v)
+{
+    if (v == Py_None) {
+        out.push_back('\0');
+        return true;
+    }
+    if (PyBool_Check(v)) {
+        out.push_back('B');
+        out.push_back(v == Py_True ? '\x01' : '\x00');
+        return true;
+    }
+    if (PyLong_CheckExact(v)) {
+        int ovf = 0;
+        long long sv = PyLong_AsLongLongAndOverflow(v, &ovf);
+        if (ovf || (sv == -1 && PyErr_Occurred())) {
+            PyErr_Clear();
+            return false;
+        }
+        uint64_t uv = sv < 0 ? (uint64_t)0 - (uint64_t)sv : (uint64_t)sv;
+        int bl = 0;
+        while (bl < 64 && (uv >> bl))
+            bl++;
+        int nbytes = (bl + 8) / 8 + 1;
+        out.push_back('I');
+        uint64_t tw = (uint64_t)sv;
+        for (int i = 0; i < nbytes; i++)
+            out.push_back((char)(i < 8 ? (tw >> (8 * i)) & 0xff
+                                       : (sv < 0 ? 0xff : 0x00)));
+        return true;
+    }
+    if (PyFloat_CheckExact(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        out.push_back('F');
+        out.append(reinterpret_cast<const char *>(&d), 8);
+        return true;
+    }
+    if (PyUnicode_CheckExact(v)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (s == nullptr) {
+            PyErr_Clear();
+            return false;
+        }
+        out.push_back('S');
+        out.append(s, (size_t)n);
+        return true;
+    }
+    return false;
+}
+
+/* dump the C session into live_rows (Pointer -> row tuple); empties the
+ * store. Shared by the demotion path and the caller's explicit demote
+ * (a flush carrying non-upsert messages). */
+bool pk_dump_into(PkStore *store, PyObject *live_rows, PyObject *ptr_type,
+                  Py_ssize_t width)
+{
+    for (auto &kv : store->rows) {
+        unsigned __int128 k;
+        memcpy(&k, kv.first.data(), 16);
+        PyObject *key = pointer_from_u128(ptr_type, k);
+        if (key == nullptr)
+            return false;
+        PyObject *row = packed_row_to_py(kv.second, (int)width);
+        if (row == nullptr) {
+            Py_DECREF(key);
+            return false;
+        }
+        int rc = PyDict_SetItem(live_rows, key, row);
+        Py_DECREF(key);
+        Py_DECREF(row);
+        if (rc < 0)
+            return false;
+    }
+    store->rows.clear();
+    return true;
+}
+
+PyObject *pk_session_dump(PyObject *, PyObject *args)
+{
+    PyObject *capsule, *live_rows, *ptr_type;
+    long long width;
+    if (!PyArg_ParseTuple(args, "OO!OL", &capsule, &PyDict_Type, &live_rows,
+                          &ptr_type, &width))
+        return nullptr;
+    auto *store = static_cast<PkStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.PkStore"));
+    if (store == nullptr)
+        return nullptr;
+    if (!pk_dump_into(store, live_rows, ptr_type, (Py_ssize_t)width))
+        return nullptr;
+    Py_RETURN_NONE;
+}
+
+/* parse_pk_upserts_nb(dicts, cols, defaults, pkeys, capsule, live_rows,
+ *                     ptr_type) -> NativeBatch | None (demoted)  */
+PyObject *parse_pk_upserts_nb(PyObject *, PyObject *args)
+{
+    PyObject *dicts, *cols, *defaults, *pkeys, *capsule, *live_rows,
+        *ptr_type;
+    if (!PyArg_ParseTuple(args, "OO!O!O!OO!O", &dicts, &PyTuple_Type, &cols,
+                          &PyTuple_Type, &defaults, &PyTuple_Type, &pkeys,
+                          &capsule, &PyDict_Type, &live_rows, &ptr_type))
+        return nullptr;
+    auto *store = static_cast<PkStore *>(
+        PyCapsule_GetPointer(capsule, "pwexec.PkStore"));
+    if (store == nullptr)
+        return nullptr;
+    PyObject *seq = PySequence_Fast(dicts, "parse_pk_upserts_nb: sequence");
+    if (seq == nullptr)
+        return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t w = PyTuple_GET_SIZE(cols);
+    Py_ssize_t npk = PyTuple_GET_SIZE(pkeys);
+    if (PyTuple_GET_SIZE(defaults) != w) {
+        Py_DECREF(seq);
+        PyErr_SetString(PyExc_ValueError, "parse_pk_upserts_nb: widths");
+        return nullptr;
+    }
+    NativeBatchObject *nb = nb_alloc((int)w, ptr_type);
+    if (nb == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    std::vector<std::string> digests((size_t)n);
+    std::vector<std::string> packed((size_t)n);
+    std::unordered_map<std::string, int> batch_seen;
+    std::string mintbuf;
+    bool demote = false;
+    for (Py_ssize_t i = 0; i < n && !demote; i++) {
+        PyObject *values = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyDict_Check(values)) {
+            demote = true;
+            break;
+        }
+        for (Py_ssize_t c = 0; c < w; c++) {
+            PyObject *v = PyDict_GetItemWithError(
+                values, PyTuple_GET_ITEM(cols, c));
+            if (v == nullptr) {
+                if (PyErr_Occurred())
+                    PyErr_Clear();
+                v = PyTuple_GET_ITEM(defaults, c);
+            }
+            if (!nb_put((*nb->cols)[(size_t)c], v)) {
+                demote = true;
+                break;
+            }
+        }
+        if (demote)
+            break;
+        /* pk mint: value_bytes(pkvals) + blake2b-128 = ref_scalar parity */
+        mintbuf.clear();
+        pw_put_u32le(mintbuf, (uint32_t)npk);
+        for (Py_ssize_t p = 0; p < npk; p++) {
+            PyObject *v = PyDict_GetItemWithError(
+                values, PyTuple_GET_ITEM(pkeys, p));
+            if (v == nullptr) {
+                /* missing pk: the tuple path raises KeyError — demote and
+                 * let it do exactly that on the replayed batch */
+                if (PyErr_Occurred())
+                    PyErr_Clear();
+                demote = true;
+                break;
+            }
+            size_t mark = mintbuf.size();
+            pw_put_u32le(mintbuf, 0);
+            if (!ser_pk_value(mintbuf, v)) {
+                demote = true;
+                break;
+            }
+            uint32_t plen = (uint32_t)(mintbuf.size() - mark - 4);
+            memcpy(&mintbuf[mark], &plen, 4);
+        }
+        if (demote)
+            break;
+        unsigned char dg[16];
+        pw_b2b_digest16(dg, (const unsigned char *)mintbuf.data(),
+                        mintbuf.size());
+        std::string dkey(reinterpret_cast<const char *>(dg), 16);
+        /* a key already live (in the session or earlier in this batch)
+         * needs a retraction — not representable columnar: demote */
+        if (store->rows.find(dkey) != store->rows.end() ||
+            batch_seen.find(dkey) != batch_seen.end()) {
+            demote = true;
+            break;
+        }
+        batch_seen.emplace(dkey, 1);
+        digests[(size_t)i] = std::move(dkey);
+        for (Py_ssize_t c = 0; c < w; c++)
+            pack_cell_from_nb(packed[(size_t)i], (*nb->cols)[(size_t)c], i);
+        unsigned __int128 k;
+        memcpy(&k, dg, 16);
+        nb->keys->push_back(k);
+    }
+    Py_DECREF(seq);
+    if (PyErr_Occurred()) {
+        Py_DECREF(nb);
+        return nullptr;
+    }
+    if (demote) {
+        Py_DECREF(nb);
+        if (!pk_dump_into(store, live_rows, ptr_type, w))
+            return nullptr;
+        Py_RETURN_NONE;
+    }
+    for (Py_ssize_t i = 0; i < n; i++)
+        store->rows.emplace(std::move(digests[(size_t)i]),
+                            std::move(packed[(size_t)i]));
+    nb->n = (Py_ssize_t)nb->keys->size();
+    return reinterpret_cast<PyObject *>(nb);
+}
+
+/* ---- nb_project(nb, idxs) -> NativeBatch -----------------------------
+ * Columnar projection: the fused form of a select over plain column
+ * references (keys preserved, columns copied/reordered). Keeps a
+ * join/parse NativeBatch in C through the projection hop instead of
+ * materializing per-row tuples at the first RowwiseNode. The kept
+ * columns and key vector are value-copied — a straight memcpy that
+ * profiles at ~0.5% of the fused join bench's batch cost; sharing
+ * immutable columns across batch objects would save it at the price of
+ * shared-ownership plumbing in NativeBatchObject, worth revisiting only
+ * if wide selects ever dominate a profile. */
+PyObject *nb_project(PyObject *, PyObject *args)
+{
+    PyObject *nb_obj, *idxs;
+    if (!PyArg_ParseTuple(args, "O!O!", &NativeBatchType, &nb_obj,
+                          &PyTuple_Type, &idxs))
+        return nullptr;
+    auto *src = reinterpret_cast<NativeBatchObject *>(nb_obj);
+    Py_ssize_t w = PyTuple_GET_SIZE(idxs);
+    NativeBatchObject *out = nb_alloc((int)w, src->ptr_type);
+    if (out == nullptr)
+        return nullptr;
+    for (Py_ssize_t j = 0; j < w; j++) {
+        long v = PyLong_AsLong(PyTuple_GET_ITEM(idxs, j));
+        if (v < 0 || v >= src->width) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_ValueError, "nb_project: idx");
+            return nullptr;
+        }
+        (*out->cols)[(size_t)j] = (*src->cols)[(size_t)v];
+    }
+    *out->keys = *src->keys;
+    out->n = src->n;
+    return reinterpret_cast<PyObject *>(out);
+}
+
+/* ---- capture_apply_nb(rows_dict, updates, nb, time) ------------------
+ * Columnar capture sink expansion: one C pass takes a NativeBatch into
+ * the capture's key->row dict and update history — no intermediate
+ * delta-tuple list, no double traversal. nb batches are insert-only so
+ * the dict op is a plain upsert. */
+PyObject *capture_apply_nb(PyObject *, PyObject *args)
+{
+    PyObject *rows_dict, *updates, *nb_obj;
+    long long time_v;
+    if (!PyArg_ParseTuple(args, "O!O!O!L", &PyDict_Type, &rows_dict,
+                          &PyList_Type, &updates, &NativeBatchType, &nb_obj,
+                          &time_v))
+        return nullptr;
+    auto *nb = reinterpret_cast<NativeBatchObject *>(nb_obj);
+    PyObject *tobj = PyLong_FromLongLong(time_v);
+    PyObject *one = PyLong_FromLong(1);
+    if (tobj == nullptr || one == nullptr) {
+        Py_XDECREF(tobj);
+        Py_XDECREF(one);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < nb->n; i++) {
+        PyObject *key = nb_key_to_py(nb, i);
+        if (key == nullptr)
+            goto fail;
+        PyObject *row = PyTuple_New(nb->width);
+        if (row == nullptr) {
+            Py_DECREF(key);
+            goto fail;
+        }
+        for (int c = 0; c < nb->width; c++) {
+            PyObject *v = nb_cell_to_py((*nb->cols)[(size_t)c], i);
+            if (v == nullptr) {
+                Py_DECREF(key);
+                Py_DECREF(row);
+                goto fail;
+            }
+            PyTuple_SET_ITEM(row, c, v);
+        }
+        if (PyDict_SetItem(rows_dict, key, row) < 0) {
+            Py_DECREF(key);
+            Py_DECREF(row);
+            goto fail;
+        }
+        {
+            PyObject *upd = PyTuple_Pack(4, key, row, tobj, one);
+            Py_DECREF(key);
+            Py_DECREF(row);
+            if (upd == nullptr || PyList_Append(updates, upd) < 0) {
+                Py_XDECREF(upd);
+                goto fail;
+            }
+            Py_DECREF(upd);
+        }
+    }
+    Py_DECREF(tobj);
+    Py_DECREF(one);
+    Py_RETURN_NONE;
+fail:
+    Py_DECREF(tobj);
+    Py_DECREF(one);
+    return nullptr;
+}
+
 /* process_batch_nb(store, nb, g_idxs, arg_idxs, key_fn, error
  *                  [, time, out_type])
  *
@@ -3281,7 +4476,15 @@ inline void nb_ser_cell(std::string &out, const NbCol &c, Py_ssize_t i)
  * (count/sum/avg — no joint multiset, no sort_by); anything else raises
  * Fallback and the node materializes the batch into the general path.
  * out_type (a list subclass, e.g. ConsolidatedList) lets the caller get
- * its net-form batch type back without a post-hoc copy. */
+ * its net-form batch type back without a post-hoc copy.
+ *
+ * Replay invariant (mirrors process_batch): NO Fallback beyond phase 1.
+ * Phase 1 mutates nothing, so a Fallback there is safely replayed via
+ * the materialized path. Any error raised AFTER phase 1 (a key_fn
+ * exception in emit, memory errors) leaves the batch half-applied in
+ * reducer state: the caller must treat the store as poisoned for replay
+ * and demote the node (GroupByNode._poison_demote) instead of retrying
+ * the batch. */
 PyObject *process_batch_nb(PyObject *, PyObject *args)
 {
     PyObject *capsule, *nb_obj, *g_idxs, *arg_idxs, *key_fn, *error_obj;
@@ -3418,7 +4621,7 @@ PyObject *process_batch_nb(PyObject *, PyObject *args)
             for (int32_t ri : shard_rows[(size_t)w]) {
                 NbRow &r = rows[(size_t)ri];
                 std::string_view kv(keybuf.data() + r.koff, r.klen);
-                auto it = sh.groups.find(kv);
+                auto it = PW_SV_FIND(sh.groups, kv);
                 bool created = false;
                 if (it == sh.groups.end()) {
                     it = sh.groups.emplace(std::string(kv), Group{}).first;
@@ -3481,34 +4684,45 @@ PyObject *process_batch_nb(PyObject *, PyObject *args)
     for (int w = 0; w < W && !failed; w++) {
         for (NbAffected &a : affected[(size_t)w]) {
             Group &g = *a.g;
-            if (g.gvals == nullptr) {
-                PyObject *gv = PyTuple_New(ng);
+            /* mint into locals and commit gvals/out_key together only on
+             * success (re-minting when a previous batch failed mid-mint):
+             * a key_fn exception must never leave gvals set with a null
+             * out_key for a later batch to Py_INCREF (latent segfault,
+             * ADVICE r5). */
+            if (g.out_key == nullptr) {
+                PyObject *gv = g.gvals;
                 if (gv == nullptr) {
-                    failed = true;
-                    break;
-                }
-                bool bad = false;
-                for (Py_ssize_t j = 0; j < ng; j++) {
-                    PyObject *x = nb_cell_to_py(
-                        (*nb->cols)[(size_t)gidx[(size_t)j]],
-                        (Py_ssize_t)a.first_row);
-                    if (x == nullptr) {
-                        bad = true;
+                    gv = PyTuple_New(ng);
+                    if (gv == nullptr) {
+                        failed = true;
                         break;
                     }
-                    PyTuple_SET_ITEM(gv, j, x);
+                    bool bad = false;
+                    for (Py_ssize_t j = 0; j < ng; j++) {
+                        PyObject *x = nb_cell_to_py(
+                            (*nb->cols)[(size_t)gidx[(size_t)j]],
+                            (Py_ssize_t)a.first_row);
+                        if (x == nullptr) {
+                            bad = true;
+                            break;
+                        }
+                        PyTuple_SET_ITEM(gv, j, x);
+                    }
+                    if (bad) {
+                        Py_DECREF(gv);
+                        failed = true;
+                        break;
+                    }
                 }
-                if (bad) {
-                    Py_DECREF(gv);
+                PyObject *ok = PyObject_CallOneArg(key_fn, gv);
+                if (ok == nullptr) {
+                    if (gv != g.gvals)
+                        Py_DECREF(gv);
                     failed = true;
                     break;
                 }
                 g.gvals = gv;
-                g.out_key = PyObject_CallOneArg(key_fn, g.gvals);
-                if (g.out_key == nullptr) {
-                    failed = true;
-                    break;
-                }
+                g.out_key = ok;
             }
             bool before_live = a.before_total > 0;
             bool after_live = g.total > 0;
@@ -3658,6 +4872,22 @@ PyMethodDef methods[] = {
     {"join_batch", join_batch, METH_VARARGS,
      "join_batch(store, ljks, lkeys, lrows, ldiffs, rjks, rkeys, rrows, "
      "rdiffs, pair_key_fn, id_fn) -> deltas"},
+    {"join_batch_nb", join_batch_nb, METH_VARARGS,
+     "join_batch_nb(store, lnb, rnb, lkidx, rkidx, ptr_type) -> "
+     "NativeBatch | (deltas, dup_bump) — fused columnar delta join"},
+    {"pk_session_new", pk_session_new, METH_NOARGS,
+     "pk_session_new() -> C-owned primary-key upsert session"},
+    {"pk_session_dump", pk_session_dump, METH_VARARGS,
+     "pk_session_dump(session, live_rows, ptr_type, width) — demote the "
+     "C session into the Python live-rows dict"},
+    {"parse_pk_upserts_nb", parse_pk_upserts_nb, METH_VARARGS,
+     "parse_pk_upserts_nb(dicts, cols, defaults, pkeys, session, "
+     "live_rows, ptr_type) -> NativeBatch | None (demoted)"},
+    {"nb_project", nb_project, METH_VARARGS,
+     "nb_project(nb, idxs) -> NativeBatch — columnar column projection"},
+    {"capture_apply_nb", capture_apply_nb, METH_VARARGS,
+     "capture_apply_nb(rows_dict, updates, nb, time) — one-pass columnar "
+     "capture expansion"},
     {"parse_upserts_nb", parse_upserts_nb, METH_VARARGS,
      "parse_upserts_nb(msgs, start, cols, defaults, key_base, seq0, ptr) "
      "-> (NativeBatch, new_seq) | None"},

@@ -454,103 +454,12 @@ fail:
     return NULL;
 }
 
-/* -- blake2b (RFC 7693), unkeyed, for 16-byte key digests ---------------
- * Compact sequential implementation — must produce digests identical to
- * hashlib.blake2b(data, digest_size=16) so natively minted Pointers equal
- * the Python path's (persistence + multi-process determinism). */
-
-static const uint64_t b2b_iv[8] = {
-    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
-    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
-    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL,
-};
-
-static const uint8_t b2b_sigma[12][16] = {
-    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
-    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
-    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
-    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
-    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
-    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
-    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
-    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
-    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
-    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
-    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
-    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
-};
-
-#define B2B_ROTR(x, n) (((x) >> (n)) | ((x) << (64 - (n))))
-
-#define B2B_G(a, b, c, d, x, y)            \
-    do {                                   \
-        v[a] = v[a] + v[b] + (x);          \
-        v[d] = B2B_ROTR(v[d] ^ v[a], 32);  \
-        v[c] = v[c] + v[d];                \
-        v[b] = B2B_ROTR(v[b] ^ v[c], 24);  \
-        v[a] = v[a] + v[b] + (y);          \
-        v[d] = B2B_ROTR(v[d] ^ v[a], 16);  \
-        v[c] = v[c] + v[d];                \
-        v[b] = B2B_ROTR(v[b] ^ v[c], 63);  \
-    } while (0)
-
-static void
-b2b_compress(uint64_t h[8], const unsigned char block[128], uint64_t t,
-             int last)
-{
-    uint64_t v[16], m[16];
-    for (int i = 0; i < 16; i++) {
-        uint64_t w = 0;
-        for (int j = 7; j >= 0; j--)
-            w = (w << 8) | block[i * 8 + j];
-        m[i] = w;
-    }
-    for (int i = 0; i < 8; i++)
-        v[i] = h[i];
-    for (int i = 0; i < 8; i++)
-        v[8 + i] = b2b_iv[i];
-    v[12] ^= t; /* low word of the offset counter; high word stays 0 for
-                 * inputs < 2^64 bytes */
-    if (last)
-        v[14] = ~v[14];
-    for (int r = 0; r < 12; r++) {
-        const uint8_t *s = b2b_sigma[r];
-        B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
-        B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
-        B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
-        B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
-        B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
-        B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
-        B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
-        B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
-    }
-    for (int i = 0; i < 8; i++)
-        h[i] ^= v[i] ^ v[8 + i];
-}
-
-/* digest16(out, data, n): blake2b-128 of data, no key */
-static void
-b2b_digest16(unsigned char out[16], const unsigned char *data, size_t n)
-{
-    uint64_t h[8];
-    for (int i = 0; i < 8; i++)
-        h[i] = b2b_iv[i];
-    h[0] ^= 0x01010000ULL ^ 16ULL; /* param block: digest_len=16, fanout=1,
-                                    * depth=1 */
-    size_t off = 0;
-    while (n - off > 128) {
-        b2b_compress(h, data + off, (uint64_t)(off + 128), 0);
-        off += 128;
-    }
-    unsigned char last[128];
-    size_t rem = n - off; /* 0..128; empty input -> one zero block */
-    memset(last, 0, sizeof(last));
-    if (rem > 0)
-        memcpy(last, data + off, rem);
-    b2b_compress(h, last, (uint64_t)n, 1);
-    for (int i = 0; i < 16; i++)
-        out[i] = (unsigned char)((h[i / 8] >> (8 * (i % 8))) & 0xff);
-}
+/* blake2b-128: shared single implementation (native/pw_blake2b.h) —
+ * digests identical to hashlib.blake2b(data, digest_size=16) so natively
+ * minted Pointers equal the Python path's (persistence + multi-process
+ * determinism; one copy shared with exec.cpp so the fused join's pair
+ * keys can never drift from ref_scalar). */
+#include "pw_blake2b.h"
 
 static PyObject *
 one_long(void)
@@ -1060,7 +969,7 @@ mint_key_from_tuple(PyObject *args_tuple)
         memcpy(b.buf + mark, le, 4);
     }
     unsigned char digest[16];
-    b2b_digest16(digest, (const unsigned char *)b.buf, (size_t)b.len);
+    pw_b2b_digest16(digest, (const unsigned char *)b.buf, (size_t)b.len);
     PyMem_Free(b.buf);
     b.buf = NULL;
     uint64_t lo = 0, hi = 0;

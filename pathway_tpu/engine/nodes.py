@@ -137,7 +137,15 @@ class SourceNode(Node):
     def process(self, time, batches):
         # columnar batches from the C parser pass through untouched —
         # they are net form by construction and materialize lazily at the
-        # first non-native consumer (the fused-chain contract)
+        # first non-native consumer. THE FUSED-CHAIN CONTRACT: a
+        # NativeBatch is an insert-only net-form delta batch that any
+        # node may consume columnar (group-by via process_batch_nb, join
+        # via join_batch_nb on either input port, plain-column selects
+        # via nb_project) — and a join is also a valid fused PRODUCER:
+        # join_batch_nb re-emits a NativeBatch in the steady streaming
+        # state, so parse→join→exprs→groupby→capture runs with no
+        # per-row Python objects. Every consumer must degrade gracefully
+        # to the materialized (key, row, diff) view.
         if is_native_batch(batches[0]):
             return batches[0]
         return consolidate(batches[0])
@@ -148,13 +156,50 @@ class RowwiseNode(Node):
 
     The workhorse behind select/with_columns (reference: expression_table,
     dataflow.rs) — expressions are evaluated column-wise over the batch.
+
+    ``nb_proj_idx`` marks a pure column projection (every output
+    expression a plain column reference): a columnar NativeBatch input
+    then stays columnar through this node (exec.cpp nb_project — keys
+    preserved, columns copied), keeping a parse/join chain fused through
+    the select hop. Anything else materializes the batch as usual.
     """
 
-    def __init__(self, scope, input_node, batch_fn: Callable[[list[Key], list[Row]], list[Row]]):
+    def __init__(
+        self,
+        scope,
+        input_node,
+        batch_fn: Callable[[list[Key], list[Row]], list[Row]],
+        nb_proj_idx=None,
+    ):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
+        self._nb_proj = tuple(nb_proj_idx) if nb_proj_idx is not None else None
+        self._nb_batches = 0  # chain-path spy counter (tests)
 
     def process(self, time, batches):
+        if self._nb_proj is not None and is_native_batch(batches[0]):
+            from pathway_tpu.native import get_pwexec
+
+            ex = get_pwexec()
+            if ex is not None and hasattr(ex, "nb_project"):
+                try:
+                    out = ex.nb_project(batches[0], self._nb_proj)
+                except Exception:
+                    # stateless, so the materialized path below recomputes
+                    # this batch safely — but a projection that failed once
+                    # will fail every batch: disable it for this node and
+                    # say so, mirroring the native-build degradation log
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "nb_project failed; disabling the fused projection "
+                        "for this node",
+                        exc_info=True,
+                    )
+                    self._nb_proj = None
+                else:
+                    self._nb_batches += 1
+                    return out
         deltas = consolidate(batches[0])
         if not deltas:
             return []
@@ -364,6 +409,26 @@ class ExchangeNode(Node):
 class GroupDiffNode(Node):
     """Base for stateful nodes using the affected-group rediff strategy."""
 
+    # name of the native-store attribute on subclasses that own one
+    # (JoinNode: _jstore; GroupByNode: _store) — used by _poison_demote
+    _NATIVE_STORE_ATTR: str | None = None
+
+    def _poison_demote(self) -> None:
+        """A non-Fallback error escaped the native executor after phase 1:
+        the batch may be half-applied, so the store is poisoned for
+        replay (native/exec.cpp replay invariant). Demote the node —
+        salvage the store's (self-consistent) state into the Python path
+        when possible, discard it otherwise — so no later call can
+        re-apply the batch against it."""
+        attr = self._NATIVE_STORE_ATTR
+        try:
+            if getattr(self, attr) is not None:
+                self._migrate_to_python()
+        except Exception:
+            setattr(self, attr, None)
+        self._native_ok = False
+        self._nb_ok = False
+
     def group_of(self, port: int, key: Key, row: Row):
         raise NotImplementedError
 
@@ -520,10 +585,21 @@ class JoinNode(GroupDiffNode):
     change, not the size of touched join groups; shard maps update in
     parallel over PATHWAY_THREADS with the GIL released. Batches carrying
     values the serializer can't represent (ndarrays, Json, ERROR) demote
-    the node to the Python whole-group-rediff path below."""
+    the node to the Python whole-group-rediff path below.
+
+    Fused-chain path: when the join keys are plain columns (nb_lkidx /
+    nb_rkidx) and an input arrives as a columnar NativeBatch, the batch
+    goes through join_batch_nb — probe/apply/emit with zero per-row
+    Python objects, and the OUTPUT re-emitted as a NativeBatch in the
+    steady streaming state so downstream fused consumers stay in C.
+    Ineligible shapes (id= expressions, non-plain join keys, tuple-delta
+    inputs, multi-process exchanges) use the tuple path above with
+    identical results."""
 
 
     STATE_ATTRS = ("left", "right")
+    _NATIVE_STORE_ATTR = "_jstore"
+
     def __init__(
         self,
         scope,
@@ -541,6 +617,8 @@ class JoinNode(GroupDiffNode):
         exact_match: bool = False,
         lkey_batch=None,
         rkey_batch=None,
+        nb_lkidx=None,
+        nb_rkidx=None,
     ):
         super().__init__(scope, [left_node, right_node])
         self.left_key_fn = left_key_fn
@@ -569,6 +647,23 @@ class JoinNode(GroupDiffNode):
             and left_width is not None
             and right_width is not None
         )
+        # fused-chain eligibility: plain-column join keys on both sides
+        # and no per-row id= Python functions (id_from_left/right are
+        # mintable natively). PATHWAY_NO_NB_JOIN=1 force-disables — the
+        # parity batteries use it to pin fused-vs-tuple bit-identity.
+        import os as _os
+
+        self._nb_ok = (
+            self._native_ok
+            and nb_lkidx is not None
+            and nb_rkidx is not None
+            and left_id_fn is None
+            and right_id_fn is None
+            and not _os.environ.get("PATHWAY_NO_NB_JOIN")
+        )
+        self._nb_lkidx = tuple(nb_lkidx) if nb_lkidx is not None else None
+        self._nb_rkidx = tuple(nb_rkidx) if nb_rkidx is not None else None
+        self._nb_batches = 0  # chain-path spy counter (tests/bench)
         self._exec = None
         self._jstore = None
 
@@ -630,6 +725,44 @@ class JoinNode(GroupDiffNode):
         self._native_ok = False
 
     def process(self, time, batches):
+        if (
+            self._nb_ok
+            and self._native_ok  # demotion (migrate/load_state) clears this
+            and (is_native_batch(batches[0]) or is_native_batch(batches[1]))
+            and (is_native_batch(batches[0]) or not batches[0])
+            and (is_native_batch(batches[1]) or not batches[1])
+            and self._native_setup()
+            and hasattr(self._exec, "join_batch_nb")
+        ):
+            from pathway_tpu.internals.api import Pointer
+
+            try:
+                res = self._exec.join_batch_nb(
+                    self._jstore,
+                    batches[0] if is_native_batch(batches[0]) else None,
+                    batches[1] if is_native_batch(batches[1]) else None,
+                    self._nb_lkidx,
+                    self._nb_rkidx,
+                    Pointer,
+                )
+            except self._exec.Fallback:
+                # phase 1 mutates nothing: replay the same batches on the
+                # tuple path below (which materializes them)
+                pass
+            except Exception:
+                self._poison_demote()
+                raise
+            else:
+                self._nb_batches += 1
+                if is_native_batch(res):
+                    # fully fused: insert-only net form by construction
+                    return res
+                raw, dup_bump = res
+                # nb inputs are insert-only, so the inner-join net-form
+                # reasoning of the tuple path applies verbatim
+                if self.join_type == "inner" and not dup_bump:
+                    return ConsolidatedList(raw)
+                return consolidate(raw)
         lb = consolidate(batches[0])
         rb = consolidate(batches[1])
         if not lb and not rb:
@@ -658,6 +791,12 @@ class JoinNode(GroupDiffNode):
                 )
             except self._exec.Fallback:
                 self._migrate_to_python()
+            except Exception:
+                # non-Fallback past phase 1 (e.g. a key fn raising in
+                # emit): the batch is half-applied — demote so a replay
+                # cannot double-count (native/exec.cpp replay invariant)
+                self._poison_demote()
+                raise
             else:
                 # insert-only INNER batches are net form by construction:
                 # every emitted (pair-key, row) is distinct (distinct
@@ -762,6 +901,8 @@ class GroupByNode(GroupDiffNode):
 
 
     STATE_ATTRS = ("groups",)
+    _NATIVE_STORE_ATTR = "_store"
+
     def __init__(
         self,
         scope,
@@ -939,6 +1080,11 @@ class GroupByNode(GroupDiffNode):
                 # store stays valid (phase 1 mutates nothing): materialize
                 # and run the general path — do NOT demote the node
                 pass
+            except Exception:
+                # non-Fallback past phase 1: half-applied batch — demote
+                # so a replay cannot double-count (replay invariant)
+                self._poison_demote()
+                raise
         batch = consolidate(batches[0])
         if not batch:
             return []
@@ -981,6 +1127,11 @@ class GroupByNode(GroupDiffNode):
                 return out
             except self._exec.Fallback:
                 self._migrate_to_python()
+            except Exception:
+                # non-Fallback past phase 1: half-applied batch — demote
+                # so a replay cannot double-count (replay invariant)
+                self._poison_demote()
+                raise
         gvals_list = self.grouping_batch(keys, rows)
         # reference parity (test_errors.py): rows whose grouping values
         # are ERROR join no group — skipped and logged
@@ -1507,22 +1658,71 @@ class OutputNode(Node):
 
 class CaptureNode(Node):
     """Accumulates final table state + update stream (reference:
-    capture_table_data, python_api.rs:3214 — backbone of compute_and_print)."""
+    capture_table_data, python_api.rs:3214 — backbone of compute_and_print).
+
+    Terminal of the fused chain: columnar NativeBatches are BUFFERED
+    C-owned and expanded into the key->row dict / update history only on
+    first read (or when a tuple-delta batch must apply after them), so
+    the steady streaming state builds no per-row Python objects at the
+    sink either. Readers go through the ``state``/``updates`` properties,
+    which flush pending columnar chunks in arrival order first."""
 
     def __init__(self, scope, input_node):
         super().__init__(scope, [input_node])
-        self.state = TableState()
-        self.updates: list[tuple[Key, Row, int, int]] = []  # key,row,time,diff
+        self._state = TableState()
+        self._updates: list[tuple[Key, Row, int, int]] = []  # key,row,time,diff
+        self._pending: list = []  # unexpanded (NativeBatch, time) chunks
+
+    def _flush_pending(self) -> None:
+        from pathway_tpu.native import get_pwexec
+
+        try:
+            ex = get_pwexec()
+        except Exception:
+            ex = None
+        fp = get_fp()
+        for nb, time in self._pending:
+            if ex is not None and hasattr(ex, "capture_apply_nb"):
+                ex.capture_apply_nb(self._state.rows, self._updates, nb, time)
+            elif fp is not None and hasattr(fp, "capture_apply"):
+                fp.capture_apply(
+                    self._state.rows, self._updates, nb.materialize(), time
+                )
+            else:
+                deltas = nb.materialize()
+                self._state.apply(deltas)
+                for k, row, d in deltas:
+                    self._updates.append((k, row, time, d))
+        self._pending.clear()
+
+    @property
+    def state(self) -> TableState:
+        if self._pending:
+            self._flush_pending()
+        return self._state
+
+    @property
+    def updates(self) -> list:
+        if self._pending:
+            self._flush_pending()
+        return self._updates
 
     def process(self, time, batches):
+        if is_native_batch(batches[0]):
+            self._pending.append((batches[0], time))
+            return []
         deltas = consolidate(batches[0])
+        # tuple deltas (e.g. retractions) must land AFTER buffered
+        # columnar chunks: expand those first, in arrival order
+        if self._pending:
+            self._flush_pending()
         fp = get_fp()
         if fp is not None and hasattr(fp, "capture_apply"):
             # the capture sink sees EVERY output row — one C pass does
             # the TableState apply and the update-history append
-            fp.capture_apply(self.state.rows, self.updates, deltas, time)
+            fp.capture_apply(self._state.rows, self._updates, deltas, time)
             return []
-        self.state.apply(deltas)
+        self._state.apply(deltas)
         for k, row, d in deltas:
-            self.updates.append((k, row, time, d))
+            self._updates.append((k, row, time, d))
         return []

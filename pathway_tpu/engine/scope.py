@@ -83,20 +83,26 @@ class Scope:
         return EngineTable(node, width)
 
     # -- stateless transforms --------------------------------------------
-    def rowwise(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
-        return EngineTable(N.RowwiseNode(self, table.node, batch_fn), width)
+    def rowwise(
+        self, table: EngineTable, batch_fn, width: int, nb_proj_idx=None
+    ) -> EngineTable:
+        return EngineTable(
+            N.RowwiseNode(self, table.node, batch_fn, nb_proj_idx=nb_proj_idx),
+            width,
+        )
 
     def rowwise_memoized(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
         return EngineTable(N.MemoizedRowwiseNode(self, table.node, batch_fn), width)
 
     def rowwise_auto(
-        self, table: EngineTable, batch_fn, width: int, deterministic: bool
+        self, table: EngineTable, batch_fn, width: int, deterministic: bool,
+        nb_proj_idx=None,
     ) -> EngineTable:
         """Plain rowwise for pure expressions; memoized when the expressions
         contain non-deterministic UDFs so retractions replay stored outputs
         (reference: `deterministic` flag, graph.rs:751)."""
         if deterministic:
-            return self.rowwise(table, batch_fn, width)
+            return self.rowwise(table, batch_fn, width, nb_proj_idx=nb_proj_idx)
         return self.rowwise_memoized(table, batch_fn, width)
 
     def filter_table(self, table: EngineTable, mask_fn) -> EngineTable:
@@ -149,6 +155,8 @@ class Scope:
         right_id_fn=None,
         lkey_batch=None,
         rkey_batch=None,
+        nb_lkidx=None,
+        nb_rkidx=None,
     ) -> EngineTable:
         if self._world() > 1:
             left = self._exchange(
@@ -172,6 +180,8 @@ class Scope:
             right_id_fn=right_id_fn,
             lkey_batch=lkey_batch,
             rkey_batch=rkey_batch,
+            nb_lkidx=nb_lkidx,
+            nb_rkidx=nb_rkidx,
         )
         return EngineTable(node, left.width + right.width)
 

@@ -222,6 +222,31 @@ class JoinResult:
                 cols = [f(keys, rows) for f in rfns]
                 return list(zip(*cols)) if cols else [()] * len(keys)
 
+            # NativeBatch fused-chain eligibility: every join condition a
+            # plain column == plain column (the shapes join_batch_nb
+            # extracts straight from the columnar image); anything else —
+            # expressions over the key, pw.this.id — keeps the tuple path
+            def _plain_idx(e, table):
+                if (
+                    isinstance(e, ColumnReference)
+                    and e.table is table
+                    and e.name != "id"
+                    and e.name in table._column_names
+                ):
+                    return table._column_names.index(e.name)
+                return None
+
+            nb_lkidx: tuple | None = tuple(
+                _plain_idx(lhs, left) for lhs, _ in on
+            )
+            nb_rkidx: tuple | None = tuple(
+                _plain_idx(rhs, right) for _, rhs in on
+            )
+            if any(i is None for i in nb_lkidx) or any(
+                i is None for i in nb_rkidx
+            ):
+                nb_lkidx = nb_rkidx = None
+
             left_id_fn = right_id_fn = None
             if id_expr is not None:
                 side_table = left if id_expr_side == "left" else right
@@ -250,6 +275,8 @@ class JoinResult:
                 right_id_fn=right_id_fn,
                 lkey_batch=lkey_batch,
                 rkey_batch=rkey_batch,
+                nb_lkidx=nb_lkidx,
+                nb_rkidx=nb_rkidx,
             )
 
             def out_resolver(ref):
@@ -269,11 +296,26 @@ class JoinResult:
                 cols = [f(keys, rows) for f in fns]
                 return list(zip(*cols)) if cols else [()] * len(keys)
 
+            # a select of plain column references is a pure projection:
+            # a fused join's NativeBatch output then stays columnar
+            # through this hop (RowwiseNode nb_proj_idx -> nb_project)
+            def _proj_idx(e):
+                if isinstance(e, ColumnReference) and e.name != "id":
+                    if e.table is left and e.name in left._column_names:
+                        return left._column_names.index(e.name)
+                    if e.table is right and e.name in right._column_names:
+                        return lw + right._column_names.index(e.name)
+                return None
+
+            proj = tuple(_proj_idx(e) for e in exprs)
+            nb_proj_idx = None if any(i is None for i in proj) else proj
+
             ctx.set_engine_table(
                 out,
                 ctx.scope.rowwise_auto(
                     joined, batch_fn, len(fns),
                     all(e._is_deterministic for e in exprs),
+                    nb_proj_idx=nb_proj_idx,
                 ),
             )
 
@@ -283,7 +325,7 @@ class JoinResult:
     def _engine_join(
         self, ctx, let, ret, lkey, rkey, how, *,
         id_from_left, id_from_right, left_id_fn, right_id_fn,
-        lkey_batch=None, rkey_batch=None,
+        lkey_batch=None, rkey_batch=None, nb_lkidx=None, nb_rkidx=None,
     ):
         """Engine-join construction hook; temporal joins override this
         (stdlib/temporal) while reusing the select/desugaring machinery."""
@@ -299,6 +341,8 @@ class JoinResult:
             right_id_fn=right_id_fn,
             lkey_batch=lkey_batch,
             rkey_batch=rkey_batch,
+            nb_lkidx=nb_lkidx,
+            nb_rkidx=nb_rkidx,
         )
 
     def _desugar(self, e):

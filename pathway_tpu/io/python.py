@@ -200,19 +200,52 @@ def _make_parser(schema: type[Schema], subject=None):
     pk_fast = (
         fp is not None and bool(pkeys) and hasattr(fp, "parse_pk_upserts")
     )
+    # columnar pk fast path: deletions-disabled pk sources own their
+    # upsert session in C (exec.cpp PkStore) and emit NativeBatches while
+    # every key is fresh — the fused parse→join/groupby chain for
+    # CDC-shaped sources. The first retraction-needing or non-columnar
+    # batch dumps the C session into live_rows and permanently falls back
+    # to the tuple pk path (one-way demotion, state never splits).
+    pk_nb = None
+    pk_nb_state = None
+    pk_nb_dump = None
+    if pk_fast and not track_removals:
+        try:
+            from pathway_tpu.native import get_pwexec
+
+            _ex = get_pwexec()
+            if _ex is not None and hasattr(_ex, "parse_pk_upserts_nb"):
+                pk_nb_state = _ex.pk_session_new()
+                pk_nb_dump = _ex.pk_session_dump
+                pk_nb = _ex.parse_pk_upserts_nb
+        except Exception:
+            pk_nb = None
     cols_t = tuple(cols)
     pkeys_t = tuple(pkeys or ())
     defaults_t = tuple(defaults.get(c) for c in cols)
 
+    def _all_upsert_dicts(messages: list):
+        """The flush's row dicts when EVERY message is an upsert (single
+        rows or any number of upsert_batch runs, in order) — the shapes
+        the columnar parsers ingest whole; None otherwise."""
+        if len(messages) == 1 and messages[0][0] == "upsert_batch":
+            return messages[0][1]
+        dicts: list = []
+        for m in messages:
+            if m[0] == "upsert_batch":
+                dicts.extend(m[1])
+            elif m[0] == "upsert" and len(m) == 2:
+                dicts.append(m[1])
+            else:
+                return None
+        return dicts
+
     def parse_batch(messages: list) -> list[tuple]:
+        nonlocal pk_nb
         from pathway_tpu.engine.stream import ConsolidatedList
 
         if nb_parse is not None and messages:
-            dicts = None
-            if len(messages) == 1 and messages[0][0] == "upsert_batch":
-                dicts = messages[0][1]
-            elif all(m[0] == "upsert" and len(m) == 2 for m in messages):
-                dicts = [m[1] for m in messages]
+            dicts = _all_upsert_dicts(messages)
             if dicts is not None:
                 res = nb_parse(
                     dicts, 0, cols_t, defaults_t, key_base, seq[0], Pointer
@@ -220,6 +253,23 @@ def _make_parser(schema: type[Schema], subject=None):
                 if res is not None:  # None: value outside the columnar set
                     nb, seq[0] = res
                     return nb
+        if pk_nb is not None and messages:
+            dicts = _all_upsert_dicts(messages)
+            if dicts is not None:
+                nb = pk_nb(
+                    dicts, cols_t, defaults_t, pkeys_t, pk_nb_state,
+                    live_rows, Pointer,
+                )
+                if nb is not None:
+                    return nb
+                # demoted: session state now lives in live_rows; the
+                # tuple pk path below re-parses this batch against it
+                pk_nb = None
+            else:
+                # a flush carrying non-upsert messages consults live_rows
+                # — move the C session there first, then stay demoted
+                pk_nb_dump(pk_nb_state, live_rows, Pointer, len(cols_t))
+                pk_nb = None
         out: list[tuple] = []
         i, n = 0, len(messages)
         pure = simple

@@ -31,6 +31,17 @@ _LIB: ctypes.CDLL | None = None
 _TRIED = False
 
 
+def _newest_mtime(src_dir: str, src: str) -> float:
+    """Staleness input for an extension build: the source file plus any
+    shared headers it includes (pw_blake2b.h) — a header-only change must
+    trigger a rebuild too."""
+    newest = os.path.getmtime(src)
+    hdr = os.path.join(src_dir, "pw_blake2b.h")
+    if os.path.exists(hdr):
+        newest = max(newest, os.path.getmtime(hdr))
+    return newest
+
+
 def _sources() -> list[str]:
     src_dir = _REPO_NATIVE
     if not os.path.isdir(src_dir):
@@ -135,7 +146,7 @@ def get_fastpath():
         out = os.path.join(_BUILD_DIR, "fastpath" + suffix)
         if not (
             os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)
+            and os.path.getmtime(out) >= _newest_mtime(src_dir, src)
         ):
             include = sysconfig.get_paths()["include"]
             cmd = [
@@ -182,7 +193,7 @@ def get_pwexec():
         out = os.path.join(_BUILD_DIR, "pwexec" + suffix)
         if not (
             os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)
+            and os.path.getmtime(out) >= _newest_mtime(src_dir, src)
         ):
             include = sysconfig.get_paths()["include"]
             cmd = [
@@ -194,7 +205,8 @@ def get_pwexec():
             except Exception as exc:
                 # a failed build silently drops the whole native executor
                 # (group-by/join fall back to pure Python) — make the
-                # degradation visible, esp. g++ < 10 rejecting -std=c++20
+                # degradation visible. g++ 10 works (exec.cpp gates its
+                # C++20 library uses); g++ < 10 rejects -std=c++20
                 import logging
 
                 stderr = getattr(exc, "stderr", None) or b""

@@ -35,7 +35,7 @@ class AsofJoinResult(IntervalJoinResult):
     def _engine_join(
         self, ctx, let, ret, lkey, rkey, how, *,
         id_from_left, id_from_right, left_id_fn, right_id_fn,
-        lkey_batch=None, rkey_batch=None,
+        lkey_batch=None, rkey_batch=None, nb_lkidx=None, nb_rkidx=None,
     ):
         from pathway_tpu.engine.expression import compile_expression
         from pathway_tpu.engine.scope import EngineTable

@@ -71,6 +71,18 @@ def _session_cond_sides(on, self_table, other_table):
             or getattr(b, "table", None) is self_table
         ):
             a, b = b, a
+        # a condition over a derived/aliased table would otherwise be
+        # silently assigned to the left side and produce wrong session
+        # instance keys — reject it instead
+        if (
+            getattr(a, "table", None) is not self_table
+            or getattr(b, "table", None) is not other_table
+        ):
+            raise ValueError(
+                "session window_join conditions must reference the joined "
+                "tables directly (left side == right side); got a condition "
+                "over a derived or aliased table"
+            )
         lrefs.append(a)
         rrefs.append(b)
     return lrefs, rrefs

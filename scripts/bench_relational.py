@@ -97,7 +97,17 @@ def _transform_once(n_rows: int) -> dict:
 
 
 def bench_transform(n_rows: int = 200_000, emit=_print_emit) -> None:
-    runs = [_transform_once(n_rows) for _ in range(1 + 3)][1:]  # 1 warmup
+    """transform_rows_per_s showed dispersion 0.345 in r5 (> the bench's
+    own 20% flag) with only 1 warmup + 3 runs: the first measured run
+    still carried allocator/compile warmup. Steady-state gate: 2 warmups
+    + 5 measured runs, and if the spread still exceeds the flag threshold
+    take 3 more so the recorded median has real support — the full run
+    list and dispersion always land in the artifact."""
+    from bench_util import DISPERSION_FLAG, dispersion
+
+    runs = [_transform_once(n_rows) for _ in range(2 + 5)][2:]
+    if dispersion([r["value"] for r in runs]) > DISPERSION_FLAG:
+        runs += [_transform_once(n_rows) for _ in range(3)]
     emit(_median_of(runs, [r["value"] for r in runs]))
 
 
@@ -160,17 +170,28 @@ def _join_once(n_rows: int, n_keys: int, batch: int) -> dict:
     cap = GraphRunner().run_tables(out)[0]
     elapsed = time.perf_counter() - t0
     phases = read_phases()
+    # The columnar capture sink (this round) defers row materialization
+    # to first read, so `value` measures the streaming run itself — the
+    # number comparable to a production sink that stays columnar. For
+    # honest comparison against pre-columnar-capture artifacts (whose
+    # runs paid per-batch materialization inside the window),
+    # `value_incl_capture` re-includes the deferred expansion cost.
+    t0 = time.perf_counter()
+    out_rows = len(cap.state.rows)
+    capture_s = time.perf_counter() - t0
     return {
         "metric": "stream_join_rows_per_s",
         **({"join_phases": phases} if phases is not None else {}),
         "value": round(n_rows / elapsed, 1),
+        "value_incl_capture": round(n_rows / (elapsed + capture_s), 1),
         "unit": "left-rows/s",
         "n_rows": n_rows,
         "n_keys": n_keys,
-        "out_rows": len(cap.state.rows),
+        "out_rows": out_rows,
         "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
         "host_cores": os.cpu_count() or 1,
         "gen_s": round(gen_s, 2),
+        "capture_materialize_s": round(capture_s, 3),
         "elapsed_s": round(elapsed, 2),
     }
 
@@ -497,12 +518,52 @@ def main(
         bench_wordcount_2rank(n_rows, distinct, batch, emit=emit)
 
 
+_RELATIONAL_METRICS = {
+    "wordcount_rows_per_s",
+    "stream_join_rows_per_s",
+    "transform_rows_per_s",
+    "wordcount_2rank_rows_per_s",
+    "bench_child_error",
+}
+
+
+def main_update_artifact(n_rows: int, distinct: int, batch: int) -> None:
+    """Re-measure the relational plane and splice the fresh metric lines
+    into BENCH_full.json in place of the stale relational entries (the
+    serving/ingest entries are untouched — rerunning those needs the
+    accelerator harness). Keeps the artifact current across
+    relational-only rounds without a full bench.py pass."""
+    from bench_util import write_artifact_atomic
+
+    path = os.path.join(REPO, "BENCH_full.json")
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        artifact = []
+    kept = [
+        m
+        for m in artifact
+        if not (isinstance(m, dict) and m.get("metric") in _RELATIONAL_METRICS)
+    ]
+    fresh: list[dict] = []
+
+    def emit(metric: dict) -> None:
+        _print_emit(metric)
+        fresh.append(metric)
+        write_artifact_atomic(path, kept + fresh)
+
+    main(n_rows, distinct, batch, emit=emit)
+
+
 if __name__ == "__main__":
-    argv = [a for a in sys.argv[1:] if a != "--child"]
+    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(argv[0]) if len(argv) > 0 else 200_000
     d = int(argv[1]) if len(argv) > 1 else 5_000
     b = int(argv[2]) if len(argv) > 2 else 2_000
     if "--child" in sys.argv:
         child(n, d, b)
+    elif "--update-artifact" in sys.argv:
+        main_update_artifact(n, d, b)
     else:
         main(n, d, b)

@@ -49,6 +49,8 @@ PATHWAY_THREADS=4 \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_native_groupby.py tests/test_native_join.py \
     tests/test_native_minmax.py tests/test_native.py \
+    tests/test_native_chain.py tests/test_native_join_chain.py \
+    tests/test_join_battery.py \
     tests/test_consistency_fuzz.py tests/test_native_stress.py -x -q
 
 echo "== $MODE lane clean =="

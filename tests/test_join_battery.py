@@ -1,0 +1,303 @@
+"""Join edge-case battery (r5 verdict Missing #3, ported in spirit from
+the reference Tier-1 corpus `tests/test_joins.py`): outer-join retraction
+storms, joins across universe promises, and id-collision cases — each run
+against BOTH the fused NativeBatch join path and the tuple path
+(PATHWAY_NO_NB_JOIN=1), pinning bit-identical final states and update
+multisets, plus a batch-recompute oracle for the streamed runs.
+
+The storm shape is the dangerous one for the fused store: early commits
+are fresh-key inserts (columnar NativeBatches, native store entries),
+later commits re-upsert live keys (the pk parse demotes and emits tuple
+retract+insert deltas), so tuple retractions must cancel native-rep
+entries exactly.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.api import ref_scalar
+from pathway_tpu.internals.graph_runner import GraphRunner
+from pathway_tpu.native import get_pwexec
+
+pytestmark = pytest.mark.skipif(
+    get_pwexec() is None or not hasattr(get_pwexec(), "join_batch_nb"),
+    reason="native toolchain unavailable",
+)
+
+HOWS = ["inner", "left", "right", "outer"]
+
+
+class LSchema(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    v: int
+
+
+class RSchema(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    w: str
+
+
+def _storm_commits(seed, n_keys=8, n_commits=6, rows_per_commit=10, mk=None):
+    """Deterministic upsert storm: commit 0 is all-fresh keys (columnar),
+    later commits rewrite live keys with new payloads (retract+insert)."""
+    import random
+
+    rng = random.Random(seed)
+    commits = []
+    live = {}
+    for ci in range(n_commits):
+        commit = []
+        for _ in range(rows_per_commit):
+            k = rng.randrange(n_keys) if ci else len(live)
+            row = mk(k, rng)
+            live[k] = row
+            commit.append(row)
+        commits.append(commit)
+    return commits, live
+
+
+class _StormSubject(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+
+    def __init__(self, commits):
+        super().__init__()
+        self._commits = commits
+
+    def run(self):
+        for commit in self._commits:
+            self.next_batch(commit)
+            self.commit()
+
+
+def _mk_left(k, rng):
+    return {"k": k, "j": rng.randrange(4), "v": rng.randrange(100)}
+
+
+def _mk_right(k, rng):
+    return {"k": k, "j": rng.randrange(4), "w": f"s{rng.randrange(6)}"}
+
+
+def _run_storm(how, seed, id_kw=None):
+    pw.internals.parse_graph.G.clear()
+    lcommits, llive = _storm_commits(seed, mk=_mk_left)
+    rcommits, rlive = _storm_commits(seed + 1000, mk=_mk_right)
+    lt = pw.io.python.read(
+        _StormSubject(lcommits), schema=LSchema, autocommit_duration_ms=None
+    )
+    rt = pw.io.python.read(
+        _StormSubject(rcommits), schema=RSchema, autocommit_duration_ms=None
+    )
+    kwargs = {"how": getattr(pw.JoinMode, how.upper())}
+    if id_kw == "left":
+        kwargs["id"] = pw.left.id
+    jr = lt.join(rt, pw.left.j == pw.right.j, **kwargs)
+    out = jr.select(lv=pw.left.v, rw=pw.right.w)
+    cap = GraphRunner().run_tables(out)[0]
+    return cap, llive, rlive
+
+
+def _batch_oracle(how, llive, rlive):
+    """Recompute the expected final output multiset from the final live
+    rows (keys are the pk-minted pointers; pair keys via ref_scalar)."""
+    lrows = {
+        ref_scalar(r["k"]): (r["j"], r["v"]) for r in llive.values()
+    }
+    rrows = {
+        ref_scalar(r["k"]): (r["j"], r["w"]) for r in rlive.values()
+    }
+    out: Counter = Counter()
+    matched_l, matched_r = set(), set()
+    for lk, (lj, lv) in lrows.items():
+        for rk, (rj, rw) in rrows.items():
+            if lj == rj:
+                out[(ref_scalar(lk, rk), (lv, rw))] += 1
+                matched_l.add(lk)
+                matched_r.add(rk)
+    # pads follow join-GROUP liveness (a left group with no right rows),
+    # not per-row matching — with single-column keys they coincide
+    if how in ("left", "outer"):
+        rjs = {rj for rj, _ in rrows.values()}
+        for lk, (lj, lv) in lrows.items():
+            if lj not in rjs:
+                out[(ref_scalar(lk, None), (lv, None))] += 1
+    if how in ("right", "outer"):
+        ljs = {lj for lj, _ in lrows.values()}
+        for rk, (rj, rw) in rrows.items():
+            if rj not in ljs:
+                out[(ref_scalar(None, rk), (None, rw))] += 1
+    return out
+
+
+def _freeze(cap):
+    state = dict(cap.state.rows)
+    upd = Counter((k, r, d) for k, r, _t, d in cap.updates)
+    return state, upd
+
+
+@pytest.mark.parametrize("how", HOWS)
+@pytest.mark.parametrize("seed", [7, 23])
+def test_retraction_storm_fused_equals_tuple_and_oracle(
+    how, seed, monkeypatch
+):
+    cap, llive, rlive = _run_storm(how, seed)
+    nb_state, nb_upd = _freeze(cap)
+
+    # net output multiset (sum of update diffs) must equal the oracle
+    net: Counter = Counter()
+    for (k, r, d), c in nb_upd.items():
+        net[(k, r)] += d * c
+    net = Counter({kr: c for kr, c in net.items() if c})
+    assert net == _batch_oracle(how, llive, rlive)
+
+    # and the tuple path must be bit-identical, update stream included
+    monkeypatch.setenv("PATHWAY_NO_NB_JOIN", "1")
+    cap_t, _, _ = _run_storm(how, seed)
+    t_state, t_upd = _freeze(cap_t)
+    assert t_state == nb_state
+    assert t_upd == nb_upd
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_id_collision_storm_fused_equals_tuple(how, monkeypatch):
+    """id=left.id with join fanout repeats output ids (the reference's
+    id-collision case): both paths must agree on the full update stream
+    and on which row wins the final state."""
+    cap, _, _ = _run_storm(how, 99, id_kw="left")
+    nb_state, nb_upd = _freeze(cap)
+    monkeypatch.setenv("PATHWAY_NO_NB_JOIN", "1")
+    cap_t, _, _ = _run_storm(how, 99, id_kw="left")
+    t_state, t_upd = _freeze(cap_t)
+    assert t_state == nb_state
+    assert t_upd == nb_upd
+
+
+class _USchemaL(pw.Schema):
+    j: int
+    v: int
+
+
+class _USchemaR(pw.Schema):
+    j2: int
+    w: str
+
+
+def _run_universe_join():
+    """Join whose right side went through a universe promise
+    (with_universe_of): the join consumes a re-universed table and the
+    fused path must keep exact semantics through the promise node."""
+    pw.internals.parse_graph.G.clear()
+    rows = [(i % 3, 10 * i) for i in range(12)]
+    base = pw.debug.table_from_rows(
+        _USchemaL, [(i, *r) for i, r in enumerate(rows)]
+    )
+    a = base.select(j=pw.this.j, v=pw.this.v)
+    b = base.select(j2=pw.this.j, w=pw.this.v.to_string())
+    # promise: b lives on a's key set (true — both derive from base)
+    b2 = b.with_universe_of(a)
+    out = a.join(b2, pw.left.j == pw.right.j2).select(
+        lv=pw.left.v, rw=pw.right.w
+    )
+    cap = GraphRunner().run_tables(out)[0]
+    want = Counter(
+        (v1, str(v2))
+        for (j1, v1) in rows
+        for (j2, v2) in rows
+        if j1 == j2
+    )
+    got = Counter(tuple(row) for row in cap.state.rows.values())
+    assert got == want
+    return cap
+
+
+def test_join_across_universe_promise(monkeypatch):
+    cap = _run_universe_join()
+    nb_state = dict(cap.state.rows)
+    monkeypatch.setenv("PATHWAY_NO_NB_JOIN", "1")
+    cap_t = _run_universe_join()
+    assert dict(cap_t.state.rows) == nb_state
+
+
+class _SSchemaL(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    name: str
+    v: int
+
+
+class _SSchemaR(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    name: str
+    w: float
+
+
+def _run_string_key_join():
+    """String join keys ride the columnar path via the arena; mixed-type
+    payloads (float/None) must survive the fused round-trip."""
+    pw.internals.parse_graph.G.clear()
+    rows_l = [
+        {"k": i, "name": f"n{i % 4}", "v": i} for i in range(24)
+    ]
+    rows_r = [
+        {"k": i, "name": f"n{i % 4}", "w": [0.5 * i, None][i % 2]}
+        for i in range(8)
+    ]
+
+    class LS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows_l)
+            self.commit()
+
+    class RS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rows_r)
+            self.commit()
+
+    lt = pw.io.python.read(LS(), schema=_SSchemaL, autocommit_duration_ms=None)
+    rt = pw.io.python.read(RS(), schema=_SSchemaR, autocommit_duration_ms=None)
+    out = lt.join(rt, pw.left.name == pw.right.name).select(
+        v=pw.left.v, w=pw.right.w
+    )
+    cap = GraphRunner().run_tables(out)[0]
+    want = Counter(
+        (lr["v"], rr["w"])
+        for lr in rows_l
+        for rr in rows_r
+        if lr["name"] == rr["name"]
+    )
+    assert Counter(tuple(r) for r in cap.state.rows.values()) == want
+    return cap
+
+
+def test_string_key_join_fused_equals_tuple(monkeypatch):
+    cap = _run_string_key_join()
+    nb_state, nb_upd = _freeze(cap)
+    monkeypatch.setenv("PATHWAY_NO_NB_JOIN", "1")
+    cap_t = _run_string_key_join()
+    t_state, t_upd = _freeze(cap_t)
+    assert t_state == nb_state
+    assert t_upd == nb_upd
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_streamed_storm_matches_python_node_path(how, monkeypatch):
+    """Belt-and-braces: force the WHOLE native join off (not just nb) and
+    compare against the pure-Python whole-group-rediff node."""
+    cap, _, _ = _run_storm(how, 41)
+    nb_state, nb_upd = _freeze(cap)
+
+    import pathway_tpu.engine.nodes as N
+
+    monkeypatch.setattr(N.JoinNode, "_native_setup", lambda self: False)
+    cap_p, _, _ = _run_storm(how, 41)
+    p_state, p_upd = _freeze(cap_p)
+    assert p_state == nb_state
+    assert p_upd == nb_upd
