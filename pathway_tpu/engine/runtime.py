@@ -23,6 +23,7 @@ from typing import Any, Callable
 from pathway_tpu.engine.nodes import Node, SourceNode
 from pathway_tpu.engine.scope import Scope
 from pathway_tpu.engine.stream import Delta
+from pathway_tpu.internals import faults as _faults
 
 
 class _Connector:
@@ -33,6 +34,18 @@ class _Connector:
         self.finished = False
         self.thread: threading.Thread | None = None
         self.force_flush = lambda: None  # set by run_connector_thread
+        # supervision plumbing (io/_connector.py): permanent failure,
+        # watchdog heartbeat, and the scan state the runtime restored at
+        # startup (the restart rollback target until the subject
+        # publishes a fresher one)
+        self.failure: Exception | None = None
+        self.last_activity = _time.monotonic()
+        self.restored_state = None
+        self.watchdog_timeout: float | None = None
+        self._stalled = False
+        self._stall_episodes = 0
+        self._flush_failures = 0
+        self._flush_dead = False
 
 
 class Runtime:
@@ -78,6 +91,10 @@ class Runtime:
         self._error_log_seq = 0
         self._error_log_seen: set = set()
         self._operator_subject_states: dict = {}
+        # connector-health notices from supervisor threads, drained by the
+        # main loop into monitoring counters + the error-log table (the
+        # threads must never touch engine state directly)
+        self._connector_notices: "queue.SimpleQueue" = queue.SimpleQueue()
         # stateful connectors with engine-accepted rows not yet claimed by
         # their published scan state (blocks operator snapshots)
         self._uncovered: set[str] = set()
@@ -235,6 +252,7 @@ class Runtime:
 
     def _step_time(self, time: int) -> None:
         """Run all nodes with pending input at `time`, in topo order."""
+        _faults.fault_point("runtime.step")
         nodes = self.scope.nodes
         while True:
             pending_ids = self.pending_times.get(time)
@@ -425,9 +443,9 @@ class Runtime:
                 # NEXT snapshot too, or a second restart rereads them
                 self._operator_subject_states.update(subject_states)
                 for conn in self.connectors:
-                    state = subject_states.get(conn.name)
-                    if state is not None and hasattr(conn.subject, "seek"):
-                        conn.subject.seek(state)
+                    self._restore_conn_state(
+                        conn, subject_states.get(conn.name)
+                    )
         elif self.persistence is not None:
             # replay journaled input (reference: Entry::Snapshot path,
             # connectors/mod.rs:101-130) — each journaled commit becomes a
@@ -447,15 +465,15 @@ class Runtime:
                 # states are embedded in journal entries (atomic with the
                 # rows they claim); the standalone state file is the
                 # pre-embedding fallback
-                state = (
+                self._restore_conn_state(
+                    conn,
                     last_state
                     if last_state is not None
-                    else self.persistence.load_subject_state(conn.name)
+                    else self.persistence.load_subject_state(conn.name),
                 )
-                if state is not None and hasattr(conn.subject, "seek"):
-                    conn.subject.seek(state)
 
         for conn in self.connectors:
+            self._arm_watchdog(conn)
             # copy the creating thread's context so per-thread config
             # overlays (emulated-rank CI lane) reach the subject's thread
             import contextvars as _cv
@@ -472,10 +490,9 @@ class Runtime:
         while active > 0:
             # autocommit cadence for subjects blocked in run(): flush their
             # pending rows even though no emit fired the timer
-            for conn in self.connectors:
-                if not conn.finished:
-                    conn.force_flush()
+            self._cadence_flush(self.connectors)
             entries = self._drain_event_queue(0.5)
+            self._service_connector_health(self.connectors)
             if not entries:
                 if self.error and self.terminate_on_error:
                     raise self.error
@@ -495,6 +512,7 @@ class Runtime:
                     conn.finished = True
                     self.stats.on_connector_finished(conn.name)
                     active -= 1
+                    self._release_uncovered(conn)
                     continue
                 if (
                     self.persistence is not None
@@ -561,6 +579,9 @@ class Runtime:
                     )
             if self.error and self.terminate_on_error:
                 raise self.error
+        # late notices (final flush failures, demotions) still deserve
+        # error-log rows before the graph closes
+        self._service_connector_health(self.connectors)
         while self.pending_times:
             t = self._min_pending()
             self._step_time(t)
@@ -647,8 +668,7 @@ class Runtime:
             if alldone:
                 break
         for conn, _entries, state in cursors:
-            if state is not None and hasattr(conn.subject, "seek"):
-                conn.subject.seek(state)
+            self._restore_conn_state(conn, state)
 
     def _restore_operator_snapshot_distributed(self, pg, live) -> None:
         """All-or-nothing rank-local snapshot restore: rank 0 reads the
@@ -692,9 +712,7 @@ class Runtime:
                 node.load_state(state)
         self._operator_subject_states.update(subject_states)
         for conn in live:
-            state = subject_states.get(conn.name)
-            if state is not None and hasattr(conn.subject, "seek"):
-                conn.subject.seek(state)
+            self._restore_conn_state(conn, subject_states.get(conn.name))
 
     def _save_operator_snapshot_distributed(self, pg, round_no) -> None:
         """Two-phase consistent cut: every rank writes its rank-local
@@ -769,6 +787,7 @@ class Runtime:
             self._replay_journals_distributed(pg, live)
 
         for conn in live:
+            self._arm_watchdog(conn)
             # copy the creating thread's context so per-thread config
             # overlays (emulated-rank CI lane) reach the subject's thread.
             # In the emulated lane every source reads on rank 0 only
@@ -801,10 +820,9 @@ class Runtime:
         round_no = 0
         while True:
             round_no += 1
-            for conn in live:
-                if not conn.finished:
-                    conn.force_flush()
+            self._cadence_flush(live)
             entries = self._drain_event_queue(0.2)
+            self._service_connector_health(live)
             commits = []
             saw_data = False
             for conn, deltas, state, journal_rows in entries:
@@ -812,6 +830,7 @@ class Runtime:
                     conn.finished = True
                     self.stats.on_connector_finished(conn.name)
                     active -= 1
+                    self._release_uncovered(conn)
                     continue
                 if (
                     self.persistence is not None
@@ -868,11 +887,181 @@ class Runtime:
                 raise self.error
             if alldone:
                 break
+        # late notices (final flush failures, demotions) still deserve
+        # error-log rows before the graph closes
+        self._service_connector_health(live)
         self._step_lockstep(None)
         for conn in live:
             if conn.thread is not None:
                 conn.thread.join(timeout=5)
         self._finish()
+
+    # -- connector supervision (io/_connector.py) --------------------------
+    # Thread half: supervisor threads report through these (thread-safe,
+    # queue-only — never engine state). Main-loop half: _service_connector_
+    # health drains the notices into monitoring counters + the error-log
+    # table and runs the stall watchdog.
+
+    def report_connector_error(self, conn, exc: Exception) -> None:
+        """Single door for a permanently-failed connector thread. With
+        terminate_on_error the main loop raises `exc` on its next pass;
+        otherwise the connector demotes to finished (its thread emits the
+        finish sentinel) and the failure becomes an error-log row."""
+        self._connector_notices.put(
+            (
+                "error",
+                getattr(conn, "name", "?"),
+                f"connector failed permanently: {exc!r}",
+            )
+        )
+        if self.terminate_on_error:
+            self.error = exc
+
+    def report_connector_restart(self, conn, exc: Exception, attempt: int) -> None:
+        self._connector_notices.put(
+            (
+                "restart",
+                getattr(conn, "name", "?"),
+                f"connector restart {attempt} after: {exc!r}",
+            )
+        )
+
+    def report_connector_degraded(self, name: str, message: str) -> None:
+        """At-least-once degradations (e.g. the _BACKLOG_CAP overflow) —
+        a counter plus one error-log row, visible to headless runs."""
+        self._connector_notices.put(("degraded", name, message))
+
+    def _cadence_flush(self, conns) -> None:
+        """force_flush live connectors, tolerating transient flush faults
+        (rows stay pending) but refusing to livelock on a deterministic
+        failure: a non-retryable exception (parse poison) or a run of
+        consecutive failures aborts under terminate_on_error; otherwise
+        the cadence flush is muted for that connector — its rows wait for
+        the subject's next commit, which hits the same poison on the
+        subject thread and demotes the connector for real (finish
+        sentinel and all)."""
+        for conn in conns:
+            if conn.finished or conn._flush_dead:
+                continue
+            try:
+                conn.force_flush()
+                conn._flush_failures = 0
+            except Exception as exc:
+                from pathway_tpu.io._connector import SupervisorPolicy
+
+                conn._flush_failures += 1
+                # same classification the subject-thread supervisor uses,
+                # honoring the connector's retry_on override; a raising
+                # user callback must not escape the main loop
+                try:
+                    retryable = SupervisorPolicy.for_connector(
+                        conn
+                    ).retryable(exc)
+                except Exception as cls_exc:
+                    # a broken user classifier must neither escape the
+                    # main loop nor silently turn failure #1 fatal
+                    from pathway_tpu.udfs.retries import is_retryable
+
+                    retryable = is_retryable(exc)
+                    self.report_connector_degraded(
+                        conn.name,
+                        f"retry_on classifier raised {cls_exc!r}; "
+                        "fell back to default classification",
+                    )
+                fatal = (
+                    getattr(exc, "pw_parse_poison", False)
+                    or not retryable
+                    or conn._flush_failures >= 5
+                )
+                if fatal:
+                    conn._flush_dead = True
+                    if self.terminate_on_error:
+                        self.report_connector_error(conn, exc)
+                    else:
+                        self.report_connector_degraded(
+                            conn.name,
+                            "cadence flush disabled after "
+                            f"{conn._flush_failures} failures: {exc!r}; "
+                            "rows pend until the subject's next commit",
+                        )
+                elif conn._flush_failures == 1:
+                    # once per failure episode (the counter resets on
+                    # success), not per ~0.5s retry — a 30s transient
+                    # outage must not inflate the counter/error log 60x
+                    self.report_connector_degraded(
+                        conn.name, f"flush deferred: {exc!r}"
+                    )
+
+    def _service_connector_health(self, conns) -> None:
+        while True:
+            try:
+                kind, name, msg = self._connector_notices.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "restart":
+                self.stats.on_connector_restart(name)
+            elif kind == "degraded":
+                self.stats.on_connector_degraded(name)
+            else:  # "error"; the watchdog reports stalls directly below
+                self.stats.on_connector_error(name)
+            self.log_data_error(f"[connector-{kind}] {msg}", key=name)
+        # watchdog: a subject that stopped emitting/flushing within its
+        # declared heartbeat window is stalled, not crashed — flag it once
+        # per episode (it may be blocked on a dead upstream forever)
+        now = _time.monotonic()
+        for conn in conns:
+            timeout = conn.watchdog_timeout
+            if timeout is None or conn.finished:
+                continue
+            idle = now - conn.last_activity
+            if idle > timeout:
+                if not conn._stalled:
+                    conn._stalled = True
+                    conn._stall_episodes += 1
+                    self.stats.on_connector_stall(conn.name)
+                    # episode number keeps repeat stalls distinct past
+                    # log_data_error's (key, message) dedupe memo
+                    self.log_data_error(
+                        f"[connector-stall] no progress from {conn.name} "
+                        f"within watchdog window ({timeout}s), episode "
+                        f"{conn._stall_episodes}",
+                        key=conn.name,
+                    )
+            else:
+                conn._stalled = False
+
+    def _release_uncovered(self, conn) -> None:
+        """A finishing connector must not block operator snapshots for
+        the pipeline's remaining lifetime. Clean finishers publish a
+        claiming state right before the sentinel, so this is a no-op for
+        them; a demoted (failed) connector's unclaimed tail weakens its
+        own recovery to at-least-once — surfaced, not silently lost."""
+        if conn.name in self._uncovered:
+            self._uncovered.discard(conn.name)
+            self.report_connector_degraded(
+                conn.name,
+                "connector finished with rows not claimed by its last "
+                "scan state; an operator-snapshot restore may replay "
+                "them (at-least-once)",
+            )
+
+    @staticmethod
+    def _restore_conn_state(conn, state) -> None:
+        """Remember the restored scan state (the supervisor's rollback
+        target until the subject publishes a fresher one) and seek."""
+        if state is None:
+            return
+        conn.restored_state = state
+        if hasattr(conn.subject, "seek"):
+            conn.subject.seek(state)
+
+    def _arm_watchdog(self, conn) -> None:
+        pol = getattr(conn.subject, "_supervisor_policy", None)
+        timeout = getattr(pol, "heartbeat_timeout_s", None)
+        if timeout is None:
+            timeout = getattr(conn.subject, "_watchdog_timeout_s", None)
+        conn.watchdog_timeout = timeout
+        conn.last_activity = _time.monotonic()
 
     def report_error(self, exc: Exception) -> None:
         if self.terminate_on_error:

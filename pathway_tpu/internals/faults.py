@@ -1,0 +1,219 @@
+"""Deterministic fault-injection harness (reference pattern: the
+wordcount integration battery's kill-at-phase loop,
+integration_tests/wordcount/ — generalized into named in-process
+injection points so crash/recovery scenarios replay bit-identically).
+
+Injection points threaded through the hot paths:
+
+    connector.read                  per message a connector subject emits
+    connector.flush                 per connector flush (timer or commit)
+    persistence.journal_write       before a journal batch is appended
+    persistence.journal_write.post  after the append is durable, before
+                                    control returns to the engine loop
+                                    (crash here = journaled, never accepted)
+    persistence.checkpoint          before an operator snapshot / subject
+                                    state write
+    runtime.step                    per engine timestamp step
+
+A *plan* is a schedule of rules. Each rule names a point, when it fires —
+explicit 1-based ``hits``, a modular ``every``, or a seeded probability
+``prob`` (drawn from ``random.Random(seed ^ rule_index)`` so the draw
+sequence replays exactly) — and an action: ``raise`` throws
+:class:`InjectedFault` (retryable unless ``retryable: false``, so the
+connector supervisor's default classifier fails fast on it), ``crash``
+hard-kills the process via ``os._exit`` (default exit code
+``CRASH_EXIT_CODE``). Hit counters are global per point and deterministic
+given the program's emit/commit order — with the one caveat that
+``connector.flush`` also counts wall-clock autocommit flushes, so exact-
+hit plans against it are only fully deterministic when autocommit is
+disabled (``autocommit_duration_ms=None``); the other points count only
+program-ordered events.
+
+Plans come from the ``PATHWAY_FAULT_PLAN`` env var (inline JSON, or a
+path to a JSON file) or programmatically via
+``install_plan()``/``clear_plan()``::
+
+    PATHWAY_FAULT_PLAN='{"seed": 7, "rules": [
+        {"point": "persistence.journal_write", "hits": [2], "action": "crash"}
+    ]}'
+
+The disabled fast path is two attribute loads — safe on per-row paths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from typing import Any
+
+CRASH_EXIT_CODE = 27
+
+POINTS = (
+    "connector.read",
+    "connector.flush",
+    "persistence.journal_write",
+    "persistence.journal_write.post",
+    "persistence.checkpoint",
+    "runtime.step",
+)
+
+_ACTIONS = ("raise", "crash")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a firing ``raise`` rule. ``retryable`` feeds the
+    connector supervisor's default classifier."""
+
+    def __init__(self, point: str, hit: int, retryable: bool = True):
+        super().__init__(f"injected fault at {point} (hit {hit})")
+        self.point = point
+        self.hit = hit
+        self.retryable = retryable
+
+
+class FaultRule:
+    __slots__ = (
+        "point", "hits", "every", "prob", "action", "retryable",
+        "max_fires", "fired", "exit_code", "_rng",
+    )
+
+    def __init__(
+        self,
+        point: str,
+        hits=None,
+        every: int | None = None,
+        prob: float | None = None,
+        action: str = "raise",
+        retryable: bool = True,
+        max_fires: int | None = None,
+        exit_code: int = CRASH_EXIT_CODE,
+    ):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}; use {_ACTIONS}")
+        if point not in POINTS:
+            # a typo'd point would silently never fire, making a crash-
+            # recovery test pass vacuously
+            raise ValueError(
+                f"unknown injection point {point!r}; known points: {POINTS}"
+            )
+        self.point = point
+        self.hits = set(hits) if hits is not None else None
+        self.every = every
+        self.prob = prob
+        self.action = action
+        self.retryable = retryable
+        # crash rules fire at most once by nature; raise rules default to
+        # one fire per listed hit unless max_fires widens/narrows it
+        self.max_fires = max_fires
+        self.fired = 0
+        self.exit_code = exit_code
+        self._rng: random.Random | None = None  # bound by the plan
+
+    def matches(self, hit: int) -> bool:
+        if self.max_fires is not None and self.fired >= self.max_fires:
+            return False
+        if self.hits is not None:
+            return hit in self.hits
+        if self.every is not None:
+            return hit % self.every == 0
+        if self.prob is not None:
+            # one deterministic draw per hit at this point, in hit order
+            return self._rng.random() < self.prob
+        return True  # unconditional: fires on every hit (cap via max_fires)
+
+
+class FaultPlan:
+    """Seeded, thread-safe schedule of fault rules with per-point hit
+    counters. Deterministic: the same program order replays the same
+    fires bit-identically."""
+
+    def __init__(self, rules, seed: int = 0):
+        self.rules = [
+            r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules
+        ]
+        self.seed = seed
+        for i, rule in enumerate(self.rules):
+            rule._rng = random.Random((seed << 8) ^ i)
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: "FaultPlan | str | dict") -> "FaultPlan":
+        if isinstance(spec, FaultPlan):
+            return spec
+        if isinstance(spec, str):
+            spec = json.loads(spec)
+        return cls(spec.get("rules", []), seed=int(spec.get("seed", 0)))
+
+    def on_hit(self, point: str):
+        """Count a hit at `point`; return (rule, hit) if a rule fires."""
+        with self._lock:
+            hit = self._counts.get(point, 0) + 1
+            self._counts[point] = hit
+            for rule in self.rules:
+                if rule.point == point and rule.matches(hit):
+                    rule.fired += 1
+                    return rule, hit
+        return None
+
+    def hit_counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_active: FaultPlan | None = None
+_env_checked = False
+
+
+def install_plan(spec) -> FaultPlan | None:
+    """Install a plan programmatically (FaultPlan, dict spec, or JSON
+    string); None uninstalls. Returns the active plan."""
+    global _active, _env_checked
+    _active = FaultPlan.from_spec(spec) if spec is not None else None
+    _env_checked = True  # programmatic choice wins over the env var
+    return _active
+
+
+def clear_plan() -> None:
+    install_plan(None)
+
+
+def reset() -> None:
+    """Forget any installed plan AND re-read PATHWAY_FAULT_PLAN on the
+    next hit (test isolation helper)."""
+    global _active, _env_checked
+    _active = None
+    _env_checked = False
+
+
+def active_plan() -> FaultPlan | None:
+    global _active, _env_checked
+    if _active is not None or _env_checked:
+        return _active
+    _env_checked = True
+    spec = os.environ.get("PATHWAY_FAULT_PLAN")
+    if spec:
+        if not spec.lstrip().startswith("{"):
+            with open(spec) as f:
+                spec = f.read()
+        _active = FaultPlan.from_spec(spec)
+    return _active
+
+
+def fault_point(point: str, **context: Any) -> None:
+    """Hot-path hook. No-op without an active plan; otherwise counts the
+    hit and executes the first matching rule's action."""
+    if _active is None and _env_checked:
+        return
+    plan = active_plan()
+    if plan is None:
+        return
+    fired = plan.on_hit(point)
+    if fired is None:
+        return
+    rule, hit = fired
+    if rule.action == "crash":
+        os._exit(rule.exit_code)
+    raise InjectedFault(point, hit, retryable=rule.retryable)

@@ -31,6 +31,13 @@ class ConnectorStats:
     last_commit_ts: float = 0.0
     last_minibatch: int = 0
     finished: bool = False
+    # supervision health (engine/runtime.py _service_connector_health):
+    # in-place restarts, permanent failures, watchdog stalls, and
+    # at-least-once degradations (_BACKLOG_CAP overflow, deferred flushes)
+    restarts: int = 0
+    errors: int = 0
+    stalls: int = 0
+    degraded: int = 0
     # rolling (timestamp, n_rows) window for the last-minute column
     recent: list = field(default_factory=list)
 
@@ -67,6 +74,22 @@ class ProberStats:
         st = self.connectors.setdefault(name, ConnectorStats(name=name))
         st.finished = True
 
+    def on_connector_restart(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.restarts += 1
+
+    def on_connector_error(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.errors += 1
+
+    def on_connector_stall(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.stalls += 1
+
+    def on_connector_degraded(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.degraded += 1
+
     def on_output(self, n_rows: int) -> None:
         self.outputs_emitted += n_rows
         self.last_output_ts = time.time()
@@ -94,6 +117,17 @@ class ProberStats:
             lines.append(
                 f'connector_rows_total{{connector="{st.name}"}} {st.rows}'
             )
+        for metric, attr in (
+            ("connector_restarts_total", "restarts"),
+            ("connector_errors_total", "errors"),
+            ("connector_stalls_total", "stalls"),
+            ("connector_degraded_total", "degraded"),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            for st in self.connectors.values():
+                lines.append(
+                    f'{metric}{{connector="{st.name}"}} {getattr(st, attr)}'
+                )
         lines.append("# TYPE output_rows_total counter")
         lines.append(f"output_rows_total {self.outputs_emitted}")
         return "\n".join(lines) + "\n"
@@ -102,9 +136,19 @@ class ProberStats:
         up = time.time() - self.started_at
         rows = [f"uptime {up:6.1f}s  outputs {self.outputs_emitted}"]
         for st in self.connectors.values():
-            rows.append(
-                f"  {st.name:<30} rows={st.rows:<8} batches={st.batches}"
-            )
+            line = f"  {st.name:<30} rows={st.rows:<8} batches={st.batches}"
+            health = []
+            if st.restarts:
+                health.append(f"restarts={st.restarts}")
+            if st.errors:
+                health.append(f"errors={st.errors}")
+            if st.stalls:
+                health.append(f"stalls={st.stalls}")
+            if st.degraded:
+                health.append(f"degraded={st.degraded}")
+            if health:
+                line += "  " + " ".join(health)
+            rows.append(line)
         return "\n".join(rows)
 
 
@@ -164,12 +208,18 @@ def render_dashboard(stats: ProberStats, graveyard=None):
     conn.add_column("last minibatch", justify="right")
     conn.add_column("last minute", justify="right")
     conn.add_column("since start", justify="right")
+    conn.add_column("health", justify="right")
     for st in stats.connectors.values():
+        issues = st.restarts + st.errors + st.stalls + st.degraded
+        health = "ok" if not issues else (
+            f"r{st.restarts} e{st.errors} s{st.stalls} d{st.degraded}"
+        )
         conn.add_row(
             st.name,
             "finished" if st.finished else str(st.last_minibatch),
             str(st.rows_last_minute(now)),
             str(st.rows),
+            health,
         )
 
     lat = Table(box=box.SIMPLE, title="latency [ms]")

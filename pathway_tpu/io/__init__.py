@@ -8,9 +8,11 @@ are stubbed with informative errors until their native backends land.
 from __future__ import annotations
 
 from pathway_tpu.io import csv, fs, http, jsonlines, plaintext, python
+from pathway_tpu.io._connector import SupervisorPolicy
 from pathway_tpu.io._subscribe import subscribe
 
 __all__ = [
+    "SupervisorPolicy",
     "airbyte",
     "bigquery",
     "csv",

@@ -318,6 +318,14 @@ def _make_parser(schema: type[Schema], subject=None):
         return out
 
     parse.parse_batch = parse_batch
+    # primary-keyed sources are upsert sessions. Rescans after a
+    # supervised restart are idempotent ONLY while the subject never
+    # removes (re-inserting a live key retracts the previous row; a
+    # re-scanned remove would retract twice), and the session state makes
+    # ledger compensation unsound either way — the supervisor keys its
+    # restart strategy off both flags.
+    parse.is_pk = bool(pkeys)
+    parse.is_upsert = bool(pkeys) and not track_removals
     return parse
 
 

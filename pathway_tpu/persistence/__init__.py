@@ -22,6 +22,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any
 
+from pathway_tpu.internals import faults as _faults
+
 # Trust boundary: anyone able to write the persistence root can influence
 # what restarts load. Journal entries and subject scan states hold plain
 # engine values, so they are deserialized through an allow-listed
@@ -357,6 +359,9 @@ class PersistenceManager:
     def journal_batch(
         self, conn_name: str, time: int, deltas: list, state: Any = None
     ) -> None:
+        # crash here = rows accepted by the engine this run but never
+        # journaled; restart rescans them from the last durable state
+        _faults.fault_point("persistence.journal_write")
         # the subject scan state rides INSIDE the journal entry: one atomic
         # append, so the journaled prefix and the state that claims it can
         # never diverge across a crash (two separate writes could)
@@ -364,8 +369,12 @@ class PersistenceManager:
         header = len(payload).to_bytes(8, "little")
         with self.lock:
             self.backend.append(f"journal/{conn_name}", header + payload)
+        # crash here = journaled but control never returned to the engine
+        # loop; restart replays the entry exactly once
+        _faults.fault_point("persistence.journal_write.post")
 
     def save_subject_state(self, conn_name: str, state: Any) -> None:
+        _faults.fault_point("persistence.checkpoint")
         with self.lock:
             self.backend.write(
                 f"subject_state/{conn_name}", pickle.dumps(state)
@@ -403,6 +412,9 @@ class PersistenceManager:
         *,
         key: str = "operator_snapshot",
     ) -> None:
+        # crash here = this snapshot never became durable; restart resumes
+        # from the previous consistent cut
+        _faults.fault_point("persistence.checkpoint")
         with self.lock:
             self.backend.write(
                 key,

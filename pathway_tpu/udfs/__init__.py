@@ -32,6 +32,7 @@ from pathway_tpu.udfs.retries import (
     ExponentialBackoffRetryStrategy,
     FixedDelayRetryStrategy,
     NoRetryStrategy,
+    RetryPolicy,
 )
 
 __all__ = [
@@ -51,6 +52,7 @@ __all__ = [
     "ExponentialBackoffRetryStrategy",
     "FixedDelayRetryStrategy",
     "NoRetryStrategy",
+    "RetryPolicy",
     "coerce_async",
     "async_options",
 ]

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Fault-injection matrix runner: sweep injection points × kill phases
+over the crash/recovery battery scenario and print a pass/fail grid.
+
+Each cell runs the scenario as a subprocess twice:
+
+1. with ``PATHWAY_FAULT_PLAN`` set to ``crash`` at the cell's point/hit —
+   the process must die with ``faults.CRASH_EXIT_CODE`` (a cell whose
+   plan never fires is a FAIL: the schedule did not reach the phase);
+2. again without the plan — the resumed run must finish cleanly and
+   produce the exact expected final table.
+
+The scenario is a stateful (``snapshot_state``/``seek``) Python connector
+feeding a group-by, with per-key count + sum reduced downstream. The
+exactly-once audit is structural: every key must appear with count
+exactly 1 (``c`` = 2 ⇒ double-replay; a missing key ⇒ loss). The
+``stateless`` mode drops ``snapshot_state``/``seek`` and keys the schema
+by primary key — resume then re-reads from scratch, which is the
+documented at-least-once contract, so its audit only forbids loss
+(counts may reach 2 for the journal-replayed prefix).
+
+Usage:
+    python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CRASH_EXIT_CODE = 27  # faults.CRASH_EXIT_CODE (no heavy import here)
+
+# (point, scenario mode): which persistence mode exercises the point
+CELLS = [
+    ("connector.read", "persist"),
+    ("connector.flush", "persist"),
+    ("persistence.journal_write", "persist"),
+    ("persistence.journal_write.post", "persist"),
+    ("runtime.step", "persist"),
+    ("persistence.checkpoint", "operator"),
+    ("connector.read", "stateless"),
+]
+
+SCENARIO = r'''
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+mode, pdir, out_path, n_rows = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+)
+
+stateful = mode != "stateless"
+
+
+class Src(pw.io.python.ConnectorSubject):
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+
+    def run(self):
+        import time
+
+        while self.pos < n_rows:
+            i = self.pos
+            self.next(k=i, v=i * 7)
+            self.pos = i + 1
+            if self.pos % 4 == 0:
+                self.commit()
+                if mode == "operator":
+                    # spread commits over several drain rounds so the
+                    # runtime takes more than one operator snapshot and a
+                    # mid-stream checkpoint kill phase is reachable
+                    time.sleep(0.05)
+
+
+if stateful:
+    def _snapshot_state(self):
+        return dict(pos=self.pos)
+
+    def _seek(self, state):
+        self.pos = state["pos"]
+
+    Src.snapshot_state = _snapshot_state
+    Src.seek = _seek
+
+    class S(pw.Schema):
+        k: int
+        v: int
+else:
+    # stateless resume re-reads everything; primary keys keep the raw
+    # table idempotent, but the count audit still sees the journal-
+    # replayed prefix twice (documented at-least-once)
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: int
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="battery"
+)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {{}}
+if mode == "operator" and os.path.exists(out_path):
+    # operator-persistence contract: restored node state does NOT
+    # re-notify sinks; the sink keeps its own durable state
+    with open(out_path) as f:
+        seen = json.load(f)
+
+
+def on_change(key, row, time_, diff):
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)  # a crash mid-write must not tear the file
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+pw.run(
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode=(
+            "OPERATOR_PERSISTING" if mode == "operator" else "PERSISTING"
+        ),
+        snapshot_interval_ms=0,
+    )
+)
+'''
+
+
+@dataclass
+class CellResult:
+    point: str
+    mode: str
+    hit: int
+    ok: bool
+    detail: str
+
+
+def expected_counts(n_rows: int) -> dict:
+    return {str(k): [1, k * 7] for k in range(n_rows)}
+
+
+def _run_scenario(script, mode, tmp, n_rows, plan, timeout):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PATHWAY_FAULT_PLAN", None)
+    if plan is not None:
+        env["PATHWAY_FAULT_PLAN"] = json.dumps(plan)
+    return subprocess.run(
+        [
+            sys.executable,
+            script,
+            mode,
+            os.path.join(tmp, "pstorage"),
+            os.path.join(tmp, "out.json"),
+            str(n_rows),
+        ],
+        capture_output=True,
+        timeout=timeout,
+        env=env,
+    )
+
+
+def run_cell(
+    point: str,
+    mode: str = "persist",
+    hit: int = 2,
+    tmp: str | None = None,
+    n_rows: int = 24,
+    timeout: float = 120,
+) -> CellResult:
+    """One kill-and-resume cycle; see module docstring for the contract."""
+    owns_tmp = tmp is None
+    if owns_tmp:
+        tmpdir = tempfile.TemporaryDirectory(prefix="pw_fault_")
+        tmp = tmpdir.name
+    script = os.path.join(tmp, "scenario.py")
+    with open(script, "w") as f:
+        f.write(SCENARIO.format(repo=REPO))
+
+    def fail(detail):
+        return CellResult(point, mode, hit, False, detail)
+
+    plan = {
+        "seed": 7,
+        "rules": [{"point": point, "hits": [hit], "action": "crash"}],
+    }
+    proc = _run_scenario(script, mode, tmp, n_rows, plan, timeout)
+    if proc.returncode != CRASH_EXIT_CODE:
+        return fail(
+            f"kill phase: expected exit {CRASH_EXIT_CODE}, got "
+            f"{proc.returncode}; stderr: {proc.stderr.decode()[-800:]}"
+        )
+    proc = _run_scenario(script, mode, tmp, n_rows, None, timeout)
+    if proc.returncode != 0:
+        return fail(
+            f"resume phase: exit {proc.returncode}; stderr: "
+            f"{proc.stderr.decode()[-800:]}"
+        )
+    try:
+        with open(os.path.join(tmp, "out.json")) as f:
+            got = json.load(f)
+    except FileNotFoundError:
+        return fail("resume phase wrote no output")
+    want = expected_counts(n_rows)
+    if mode == "stateless":
+        # at-least-once: no loss; the replayed prefix may count twice
+        missing = sorted(set(want) - set(got), key=int)
+        if missing:
+            return fail(f"loss under at-least-once resume: missing {missing}")
+        return CellResult(point, mode, hit, True, "at-least-once ok")
+    if got != want:
+        missing = sorted(set(want) - set(got), key=int)
+        dupes = sorted(k for k, v in got.items() if v[0] != 1)
+        return fail(
+            f"exactly-once violated: missing={missing} dup-counted={dupes} "
+            f"diff-keys={[k for k in got if got[k] != want.get(k)][:5]}"
+        )
+    return CellResult(point, mode, hit, True, "byte-identical resume")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rows", type=int, default=24)
+    ap.add_argument("--hits", default="2", help="comma list of kill phases")
+    ap.add_argument("--timeout", type=float, default=120)
+    args = ap.parse_args(argv)
+    hits = [int(h) for h in args.hits.split(",") if h]
+
+    results: list[CellResult] = []
+    for point, mode in CELLS:
+        for hit in hits:
+            res = run_cell(
+                point, mode=mode, hit=hit, n_rows=args.rows,
+                timeout=args.timeout,
+            )
+            results.append(res)
+            status = "PASS" if res.ok else "FAIL"
+            print(f"{status}  {point:<32} mode={mode:<9} hit={hit}  {res.detail}")
+
+    failed = [r for r in results if not r.ok]
+    print()
+    print(f"{len(results) - len(failed)}/{len(results)} cells green")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,723 @@
+"""Supervised connectors + deterministic fault injection.
+
+Tier-1-safe battery: seeded fault plans (internals/faults.py), in-place
+supervised restart with exactly-once rescan (io/_connector.py), permanent-
+failure demotion through runtime.report_connector_error, the watchdog, the
+_BACKLOG_CAP degradation surfacing, retry_on classification
+(udfs/retries.py), and the subprocess kill-and-resume matrix
+(scripts/fault_matrix.py). All schedules are seeded/deterministic and no
+sleep exceeds ~1s."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import faults
+from pathway_tpu.internals.monitoring import ProberStats
+from pathway_tpu.io import SupervisorPolicy
+from pathway_tpu.udfs import RetryPolicy
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import fault_matrix  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _fast_policy(max_restarts=2, retry_on=None):
+    return SupervisorPolicy(
+        max_restarts=max_restarts,
+        backoff=RetryPolicy(
+            max_retries=max_restarts, initial_delay_ms=5, jitter_ms=0
+        ),
+        retry_on=retry_on,
+    )
+
+
+# ---------------------------------------------------------- fault plans
+
+
+def test_fault_plan_fires_at_listed_hits():
+    plan = faults.FaultPlan(
+        [{"point": "connector.read", "hits": [2, 4]}], seed=1
+    )
+    faults.install_plan(plan)
+    fired = []
+    for i in range(1, 6):
+        try:
+            faults.fault_point("connector.read")
+        except faults.InjectedFault as exc:
+            fired.append((i, exc.hit, exc.retryable))
+    assert fired == [(2, 2, True), (4, 4, True)]
+    assert plan.hit_counts() == {"connector.read": 5}
+
+
+def test_fault_plan_every_and_max_fires():
+    faults.install_plan(
+        {"rules": [{"point": "runtime.step", "every": 3, "max_fires": 2}]}
+    )
+    fired = []
+    for i in range(1, 13):
+        try:
+            faults.fault_point("runtime.step")
+        except faults.InjectedFault:
+            fired.append(i)
+    assert fired == [3, 6]  # capped at two fires
+
+
+def test_fault_plan_points_are_independent_counters():
+    faults.install_plan({"rules": [{"point": "connector.flush", "hits": [1]}]})
+    faults.fault_point("connector.read")  # other point: no fire, own count
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("connector.flush")
+
+
+def test_fault_plan_prob_is_seed_deterministic():
+    def pattern(seed):
+        plan = faults.FaultPlan(
+            [{"point": "runtime.step", "prob": 0.3, "max_fires": 1000}],
+            seed=seed,
+        )
+        faults.install_plan(plan)
+        out = []
+        for i in range(60):
+            try:
+                faults.fault_point("runtime.step")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = pattern(42), pattern(42)
+    assert a == b
+    assert 0 < sum(a) < 60  # actually probabilistic, not all-or-nothing
+
+
+def test_fault_plan_env_roundtrip(monkeypatch):
+    spec = {"rules": [{"point": "connector.read", "hits": [1],
+                       "retryable": False}]}
+    monkeypatch.setenv("PATHWAY_FAULT_PLAN", json.dumps(spec))
+    faults.reset()
+    with pytest.raises(faults.InjectedFault) as ei:
+        faults.fault_point("connector.read")
+    assert ei.value.retryable is False
+    # clear_plan pins "no plan" even though the env var is still set
+    faults.clear_plan()
+    faults.fault_point("connector.read")
+
+
+def test_fault_plan_rejects_unknown_action():
+    with pytest.raises(ValueError):
+        faults.FaultRule("connector.read", action="explode")
+
+
+def test_fault_plan_rejects_unknown_point():
+    # a typo'd point would otherwise never fire and pass tests vacuously
+    with pytest.raises(ValueError, match="unknown injection point"):
+        faults.FaultPlan([{"point": "connecter.read", "hits": [1]}])
+
+
+# ------------------------------------------------------- RetryPolicy
+
+
+def test_retry_policy_sync_invoke_and_schedule():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TimeoutError("transient")
+        return 42
+
+    pol = RetryPolicy(max_retries=3, initial_delay_ms=1, jitter_ms=0)
+    assert pol.invoke_sync(flaky) == 42
+    assert len(calls) == 3
+    # deterministic, capped exponential schedule with seeded jitter
+    import random
+
+    pol2 = RetryPolicy(
+        max_retries=5, initial_delay_ms=100, backoff_factor=2.0,
+        jitter_ms=0, max_delay_ms=250, rng=random.Random(0),
+    )
+    assert [pol2.delay_s(a) for a in range(4)] == [0.1, 0.2, 0.25, 0.25]
+
+
+def test_retry_policy_honors_retryable_attribute_by_default():
+    pol = RetryPolicy(max_retries=5)
+    fatal = faults.InjectedFault("p", 1, retryable=False)
+    assert not pol.should_retry(fatal, 0)
+    assert pol.should_retry(RuntimeError("x"), 0)
+
+
+def test_retry_policy_retry_on_fails_fast():
+    calls = []
+
+    def auth_error():
+        calls.append(1)
+        raise PermissionError("bad credentials")
+
+    pol = RetryPolicy(
+        max_retries=5, initial_delay_ms=1, jitter_ms=0,
+        retry_on=lambda exc: not isinstance(exc, PermissionError),
+    )
+    with pytest.raises(PermissionError):
+        pol.invoke_sync(auth_error)
+    assert len(calls) == 1
+
+
+def test_async_strategies_retry_on():
+    # fail fast on non-retryable classification
+    strat = pw.udfs.ExponentialBackoffRetryStrategy(
+        max_retries=3, initial_delay=1, jitter_ms=0,
+        retry_on=lambda exc: isinstance(exc, TimeoutError),
+    )
+    calls = []
+
+    async def auth_boom():
+        calls.append(1)
+        raise ValueError("schema mismatch")
+
+    with pytest.raises(ValueError):
+        asyncio.run(strat.invoke(auth_boom))
+    assert len(calls) == 1
+
+    # retryable classification still retries
+    tries = []
+
+    async def flaky():
+        tries.append(1)
+        if len(tries) < 3:
+            raise TimeoutError("transient")
+        return "ok"
+
+    assert asyncio.run(strat.invoke(flaky)) == "ok"
+    assert len(tries) == 3
+
+    # default preserves the historical retry-everything behavior
+    legacy = pw.udfs.FixedDelayRetryStrategy(max_retries=2, delay_ms=1)
+    again = []
+
+    async def always():
+        again.append(1)
+        raise ValueError("still broken")
+
+    with pytest.raises(ValueError):
+        asyncio.run(legacy.invoke(always))
+    assert len(again) == 3  # 1 + 2 retries, ValueError retried by default
+
+
+# ------------------------------------------- in-place supervised restart
+
+
+class _S(pw.Schema):
+    k: int
+
+
+class _SPk(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+
+
+def _run_collect(subject, schema, **run_kwargs):
+    rows = pw.io.python.read(
+        subject, schema=schema, autocommit_duration_ms=0, name="src"
+    )
+    events = []
+    pw.io.subscribe(
+        rows,
+        on_change=lambda key, row, time_, diff: events.append(
+            (row["k"], 1 if diff > 0 else -1)
+        ),
+    )
+    pw.run(**run_kwargs)
+    return events
+
+
+class _RescanSrc(pw.io.python.ConnectorSubject):
+    """Stateful, rescannable, fails once mid-span (between commit
+    boundaries) on the first attempt."""
+
+    def __init__(self, n=9, fail_pos=5):
+        super().__init__()
+        self.n = n
+        self.fail_pos = fail_pos
+        self.pos = 0
+        self.attempts = 0
+
+    def run(self):
+        self.attempts += 1
+        while self.pos < self.n:
+            i = self.pos
+            self.next(k=i)
+            self.pos = i + 1
+            if self.pos % 3 == 0:
+                self.commit()
+            if self.attempts == 1 and self.pos == self.fail_pos:
+                raise ConnectionError("transient source failure")
+
+    def snapshot_state(self):
+        return {"pos": self.pos}
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+def test_stateful_rescan_restart_is_exactly_once_keyless():
+    src = _RescanSrc()
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _S)
+    assert src.attempts == 2
+    net = Counter()
+    for k, d in events:
+        net[k] += d
+    # no loss, no double-replay: every key nets exactly one insertion
+    assert dict(net) == {k: 1 for k in range(9)}
+    # the mid-span rows really were re-delivered: forwarded pre-failure,
+    # retracted by the supervisor, re-emitted by the rescan
+    by_key = Counter(events)
+    assert by_key[(4, 1)] == 2 and by_key[(4, -1)] == 1
+
+
+def test_stateful_restart_before_first_commit_is_exactly_once():
+    """A failure BEFORE the first commit boundary rolls back to the
+    subject's captured pre-run position (there is no published state
+    yet) — retract-forwarded + rescan-from-zero, no loss."""
+    src = _RescanSrc(fail_pos=2)  # boundary would be at pos 3
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _S)
+    assert src.attempts == 2
+    net = Counter()
+    for k, d in events:
+        net[k] += d
+    assert dict(net) == {k: 1 for k in range(9)}
+
+
+def test_raising_retry_on_callback_does_not_hang_pipeline():
+    """A user retry_on callback that itself raises is a permanent
+    failure, not a lost finish sentinel: the run must terminate."""
+    src = _CountingSrc()
+    src._supervisor_policy = _fast_policy(
+        retry_on=lambda exc: exc.unknown_attribute  # AttributeError
+    )
+    faults.install_plan({"rules": [{"point": "connector.read", "hits": [3]}]})
+    with pytest.raises(AttributeError):
+        _run_collect(src, _S)
+
+
+def test_stateful_rescan_restart_is_exactly_once_upsert_keys():
+    src = _RescanSrc()
+    src._deletions_enabled = False  # pure upserts: rescan is idempotent
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _SPk)
+    assert src.attempts == 2
+    net = Counter()
+    for k, d in events:
+        net[k] += d
+    assert dict(net) == {k: 1 for k in range(9)}
+
+
+def test_upsert_restart_then_process_restart_loses_nothing(tmp_path):
+    """In-place upsert rescan keeps the forwarded-but-unjournaled ledger:
+    the next boundary must journal the ORIGINAL inserts too, or a later
+    process restart consolidates the rescan's retract/insert pairs to
+    nothing and silently drops the mid-span rows."""
+    cfg = pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(str(tmp_path))
+    )
+    src = _RescanSrc()
+    src._deletions_enabled = False
+    src._supervisor_policy = _fast_policy()
+    rows = pw.io.python.read(
+        src, schema=_SPk, autocommit_duration_ms=0, name="ups"
+    )
+    pw.io.subscribe(rows, on_change=lambda *a: None)
+    pw.run(persistence_config=cfg)
+    assert src.attempts == 2
+
+    # the journal's net content covers every row exactly once
+    from pathway_tpu.persistence import PersistenceManager
+
+    net = Counter()
+    for _t, deltas, _s in PersistenceManager(cfg).load_journal("ups"):
+        for key, row, diff in deltas:
+            net[(key, tuple(row))] += diff
+    assert sorted(net.values()) == [1] * 9, net
+
+    # process restart: replay + seek reproduces the full table
+    pw.internals.parse_graph.G.clear()
+    src2 = _RescanSrc()
+    src2._deletions_enabled = False
+    rows2 = pw.io.python.read(
+        src2, schema=_SPk, autocommit_duration_ms=0, name="ups"
+    )
+    got = []
+    pw.io.subscribe(
+        rows2,
+        on_change=lambda key, row, t, d: got.append(
+            (row["k"], 1 if d > 0 else -1)
+        ),
+    )
+    pw.run(persistence_config=cfg)
+    net2 = Counter()
+    for k, d in got:
+        net2[k] += d
+    assert dict(net2) == {k: 1 for k in range(9)}
+
+
+def test_pk_source_with_deletions_restarts_as_continuation():
+    """pk sessions that may see removes are rescan-unsafe (a re-scanned
+    remove would retract twice): restart continues from the subject's
+    own cursor instead, which is still loss- and duplicate-free here."""
+    src = _RescanSrc()  # _deletions_enabled defaults True
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _SPk)
+    assert src.attempts == 2
+    net = Counter()
+    for k, d in events:
+        net[k] += d
+    assert dict(net) == {k: 1 for k in range(9)}
+    # continuation, not rescan: nothing was retracted or re-delivered
+    assert all(d > 0 for _, d in events)
+
+
+class _CountingSrc(pw.io.python.ConnectorSubject):
+    """Stateless: keeps its own cursor, so a restart continues in place."""
+
+    def __init__(self, n=8):
+        super().__init__()
+        self.n = n
+        self.i = 0
+        self.attempts = 0
+
+    def run(self):
+        self.attempts += 1
+        while self.i < self.n:
+            self.next(k=self.i)
+            self.i += 1
+
+
+def test_injected_transient_fault_recovers_within_budget():
+    # fault plan (not subject code) injects the failure: emit hit 4 raises
+    # a retryable InjectedFault out of subject.run(); the supervisor
+    # restarts and the subject's own cursor resumes exactly
+    faults.install_plan(
+        {"rules": [{"point": "connector.read", "hits": [4]}]}
+    )
+    src = _CountingSrc()
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _S)
+    assert src.attempts == 2
+    assert sorted(k for k, d in events if d > 0) == list(range(8))
+
+
+def test_default_policy_does_not_restart_plain_stateless_subjects():
+    """Re-running a non-rescannable, non-upsert subject is not provably
+    duplicate-free, so without an explicit policy it keeps the historical
+    fail-fast behavior."""
+    src = _CountingSrc()  # no _supervisor_policy attached
+    faults.install_plan({"rules": [{"point": "connector.read", "hits": [3]}]})
+    with pytest.raises(faults.InjectedFault):
+        _run_collect(src, _S)
+    assert src.attempts == 1
+
+
+class _SnapFailSrc(pw.io.python.ConnectorSubject):
+    """snapshot_state itself fails transiently at the first mid-run commit
+    boundary — the compensation ledger must survive the failed boundary so
+    the supervised rescan stays exactly-once."""
+
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+        self.attempts = 0
+        self.snaps = 0
+
+    def run(self):
+        self.attempts += 1
+        while self.pos < 6:
+            self.next(k=self.pos)
+            self.pos += 1
+            if self.pos == 3:
+                self.commit()
+
+    def snapshot_state(self):
+        self.snaps += 1
+        if self.snaps == 2:  # 1 = the supervisor's initial capture
+            raise OSError("snapshot backend hiccup")
+        return {"pos": self.pos}
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+def test_snapshot_failure_mid_boundary_stays_exactly_once():
+    src = _SnapFailSrc()
+    src._supervisor_policy = _fast_policy()
+    events = _run_collect(src, _S)
+    assert src.attempts == 2
+    net = Counter()
+    for k, d in events:
+        net[k] += d
+    assert dict(net) == {k: 1 for k in range(6)}
+
+
+def test_fatal_fault_classification_fails_fast():
+    faults.install_plan(
+        {"rules": [{"point": "connector.read", "hits": [2],
+                    "retryable": False}]}
+    )
+    src = _CountingSrc()
+    src._supervisor_policy = _fast_policy(max_restarts=3)
+    with pytest.raises(faults.InjectedFault):
+        _run_collect(src, _S)
+    assert src.attempts == 1  # no retry for a non-retryable failure
+
+
+class _DoomedSrc(pw.io.python.ConnectorSubject):
+    def __init__(self):
+        super().__init__()
+        self.attempts = 0
+
+    def run(self):
+        self.attempts += 1
+        if self.attempts == 1:
+            for i in range(3):
+                self.next(k=i)
+            self.commit()
+        raise ValueError("permanently broken source")
+
+
+def test_budget_exhausted_terminate_on_error_raises():
+    src = _DoomedSrc()
+    src._supervisor_policy = _fast_policy(max_restarts=1)
+    with pytest.raises(ValueError, match="permanently broken"):
+        _run_collect(src, _S)
+    assert src.attempts == 2  # initial + one restart
+
+
+def test_budget_exhausted_demotes_without_abort():
+    """terminate_on_error=False: the failed connector demotes to finished,
+    the rows it delivered stay, and the failure lands in the error log."""
+    src = _DoomedSrc()
+    src._supervisor_policy = _fast_policy(max_restarts=1)
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=0, name="doomed"
+    )
+    got = []
+    pw.io.subscribe(
+        rows, on_change=lambda key, row, t, diff: got.append(row["k"])
+    )
+    log = pw.global_error_log()
+    log_rows = []
+    pw.io.subscribe(
+        log,
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    pw.run(terminate_on_error=False)  # completes: no abort
+    assert sorted(got) == [0, 1, 2]
+    assert src.attempts == 2
+    errors = [m for m in log_rows if "failed permanently" in m]
+    assert errors and "ValueError" in errors[0]
+    restarts = [m for m in log_rows if "connector-restart" in m]
+    assert len(restarts) == 1
+
+
+class _SleepySrc(pw.io.python.ConnectorSubject):
+    """Stalls (no emits, no flushes) past its watchdog window, then
+    recovers — the runtime must flag the stall without killing the run."""
+
+    _watchdog_timeout_s = 0.15
+
+    def __init__(self):
+        super().__init__()
+
+    def run(self):
+        time.sleep(0.8)
+        self.next(k=1)
+
+
+def test_watchdog_flags_stalled_subject():
+    src = _SleepySrc()
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=10, name="sleepy"
+    )
+    got = []
+    pw.io.subscribe(
+        rows, on_change=lambda key, row, t, diff: got.append(row["k"])
+    )
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    pw.run()
+    assert got == [1]  # the stall resolved; pipeline finished normally
+    assert any("connector-stall" in m for m in log_rows)
+
+
+class _NoCommitSrc(pw.io.python.ConnectorSubject):
+    """Stateful subject that never calls commit(): its backlog overflows
+    _BACKLOG_CAP and recovery degrades to at-least-once for the span."""
+
+    def __init__(self, n=10):
+        super().__init__()
+        self.n = n
+
+    def run(self):
+        for i in range(self.n):
+            self.next(k=i)
+
+    def snapshot_state(self):
+        return {}
+
+
+def test_backlog_cap_degradation_reaches_error_log(monkeypatch):
+    monkeypatch.setattr("pathway_tpu.io._connector._BACKLOG_CAP", 3)
+    src = _NoCommitSrc()
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=0, name="nocommit"
+    )
+    got = []
+    pw.io.subscribe(
+        rows, on_change=lambda key, row, t, diff: got.append(row["k"])
+    )
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.memory()
+        )
+    )
+    assert sorted(got) == list(range(10))  # data still flows
+    assert any(
+        "connector-degraded" in m and "at-least-once" in m for m in log_rows
+    )
+
+
+def _bare_conn(subject, parser):
+    import types
+
+    return types.SimpleNamespace(
+        subject=subject,
+        parser=parser,
+        name="unit",
+        node=types.SimpleNamespace(
+            scope=types.SimpleNamespace(runtime=None)
+        ),
+    )
+
+
+def test_parse_failure_is_nonretryable_and_sentinel_arrives():
+    """A deterministic parse failure may have half-applied stateful parser
+    sessions — it must fail fast (never rescan) AND the finish sentinel
+    must still reach the queue."""
+    import queue
+    import threading
+
+    from pathway_tpu.io._connector import run_connector_thread
+
+    class _Subj(pw.io.python.ConnectorSubject):
+        _autocommit_duration_ms = 0
+
+        def run(self):
+            self._emit(("row", "a"))
+
+    def bad_parser(msg):
+        raise KeyError("schema mismatch")
+
+    conn = _bare_conn(_Subj(), bad_parser)
+    q = queue.Queue()
+    t = threading.Thread(
+        target=run_connector_thread, args=(conn, q), daemon=True
+    )
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+    assert q.get(timeout=5)[1] is None  # finish sentinel
+    assert isinstance(conn.failure, KeyError)
+    assert conn.failure.retryable is False  # classified as poison
+
+
+def test_prologue_failure_still_enqueues_finish_sentinel():
+    """Even a failure resolving the supervisor policy itself must not
+    strand the main loop waiting for the sentinel."""
+    import queue
+    import threading
+
+    from pathway_tpu.io._connector import run_connector_thread
+
+    class _EvilSubject:
+        @property
+        def _supervisor_policy(self):
+            raise RuntimeError("broken policy resolution")
+
+        def run(self):
+            raise AssertionError("never reached")
+
+    conn = _bare_conn(_EvilSubject(), lambda m: [])
+    q = queue.Queue()
+    t = threading.Thread(
+        target=run_connector_thread, args=(conn, q), daemon=True
+    )
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+    assert q.get(timeout=5)[1] is None
+    assert "broken policy" in str(conn.failure)
+
+
+def test_prober_stats_health_counters_render():
+    stats = ProberStats()
+    stats.on_connector_restart("c1")
+    stats.on_connector_restart("c1")
+    stats.on_connector_error("c1")
+    stats.on_connector_stall("c2")
+    stats.on_connector_degraded("c1")
+    text = stats.render_openmetrics()
+    assert 'connector_restarts_total{connector="c1"} 2' in text
+    assert 'connector_errors_total{connector="c1"} 1' in text
+    assert 'connector_stalls_total{connector="c2"} 1' in text
+    assert 'connector_degraded_total{connector="c1"} 1' in text
+    assert "restarts=2" in stats.render_text()
+
+
+# ------------------------------------------------ kill-and-resume battery
+
+
+_BATTERY_CELLS = [
+    ("connector.read", "persist"),
+    ("connector.flush", "persist"),
+    ("persistence.journal_write", "persist"),
+    ("persistence.journal_write.post", "persist"),
+    ("persistence.checkpoint", "operator"),
+    ("connector.read", "stateless"),
+]
+
+
+@pytest.mark.parametrize("point,mode", _BATTERY_CELLS)
+def test_fault_battery_kill_and_resume(tmp_path, point, mode):
+    """Seeded crash at the injection point, then resume: the final table
+    must match the fault-free expectation exactly (exactly-once for the
+    stateful scenario; loss-free at-least-once for the stateless one)."""
+    if os.environ.get("PATHWAY_LANE_PROCESSES"):
+        pytest.skip("subprocess kill timing incompatible with the lane")
+    res = fault_matrix.run_cell(
+        point, mode=mode, hit=2, tmp=str(tmp_path), n_rows=24
+    )
+    assert res.ok, f"{point}/{mode}: {res.detail}"

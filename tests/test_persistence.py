@@ -225,6 +225,62 @@ def test_index_adapter_snapshot_roundtrip():
     assert q.search([("fox", 2, None)]) == p.search([("fox", 2, None)])
 
 
+def _global_pickle(module: str, name: str) -> bytes:
+    """Protocol-0 GLOBAL record: resolves module.name via find_class
+    without needing the target importable in this process."""
+    return f"c{module}\n{name}\n.".encode()
+
+
+def test_safe_unpickler_denies_code_execution_names():
+    """The allow-list is the trust boundary for journal/subject-state
+    loads: builtins must be NAME-gated (eval/exec), numpy must not expose
+    exec wrappers (runstring), and unknown modules never resolve."""
+    import pickle
+
+    from pathway_tpu.persistence import _safe_loads
+
+    denied = [
+        pickle.dumps(eval),  # builtins.eval by reference
+        pickle.dumps(exec),
+        _global_pickle("builtins", "getattr"),
+        _global_pickle("builtins", "__import__"),
+        # numpy is module-prefixed but name-allowlisted: the runstring
+        # exec wrapper must not slip through the numpy branch
+        _global_pickle("numpy.testing._private.utils", "runstring"),
+        _global_pickle("numpy.f2py.diagnose", "run_command"),
+        _global_pickle("os", "system"),
+        _global_pickle("posix", "system"),
+        _global_pickle("subprocess", "Popen"),
+        _global_pickle("totally.unknown.module", "thing"),
+    ]
+    for payload in denied:
+        with pytest.raises(pickle.UnpicklingError):
+            _safe_loads(payload)
+
+
+def test_safe_unpickler_allows_plain_engine_values():
+    import pickle
+    from collections import OrderedDict
+
+    import numpy as np
+
+    from pathway_tpu.persistence import _safe_loads
+
+    values = [
+        (2, [(1, ("a", 3.5, None), 1)], {"pos": 7}),
+        OrderedDict(a=1),
+        {frozenset({1, 2}): b"x"},
+        np.int64(5),
+        np.arange(4, dtype=np.float32),
+    ]
+    for v in values:
+        out = _safe_loads(pickle.dumps(v))
+        if isinstance(v, np.ndarray):
+            assert (out == v).all()
+        else:
+            assert out == v
+
+
 def test_midscan_force_flush_defers_journaling():
     """A runtime-cadence flush while a stateful subject is mid-scan must NOT
     journal rows (the subject's bookkeeping may lag them); the next
