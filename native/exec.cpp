@@ -4402,6 +4402,606 @@ PyObject *nb_project(PyObject *, PyObject *args)
     return reinterpret_cast<PyObject *>(out);
 }
 
+/* ==== columnar exchange: shard/encode/decode/concat ====================
+ *
+ * The multi-rank analogue of the fused chain (reference: timely exchange
+ * pacts are a streamed byte-level concern, dataflow.rs): an ExchangeNode
+ * boundary slices a NativeBatch into per-rank columnar parts
+ * (shard_partition_nb), ships them as typed columnar buffers
+ * (nb_encode/nb_decode) and re-joins received parts (nb_concat) — no
+ * per-row Python object exists anywhere on the path, and the downstream
+ * group-by/join keeps consuming columnar. */
+
+/* one copied cell (arena re-based) — GIL-free */
+inline void nbcol_push_cell(NbCol &dst, const NbCol &src, Py_ssize_t i)
+{
+    uint8_t tag = src.tag[(size_t)i];
+    dst.tag.push_back(tag);
+    if (tag == NB_STR) {
+        uint32_t len = src.len[(size_t)i];
+        dst.word.push_back((int64_t)dst.arena.size());
+        dst.len.push_back(len);
+        dst.arena.append(src.arena.data() + (size_t)src.word[(size_t)i],
+                         len);
+    } else {
+        dst.word.push_back(src.word[(size_t)i]);
+        dst.len.push_back(0);
+    }
+}
+
+/* api._value_to_bytes parity for one nb cell — the INJECTIVE key
+ * serialization behind procgroup.stable_shard (NOT ser_value, whose
+ * normalization collapses 5.0 onto 5: stable_shard hashes the raw
+ * Python value, so the columnar shard mint must too):
+ *   None  -> "\x00"
+ *   bool  -> "B" + \x01/\x00
+ *   int   -> "I" + to_bytes((bit_length+8)//8 + 1, little, signed)
+ *   float -> "F" + 8-byte LE double
+ *   str   -> "S" + utf-8 bytes                                        */
+inline void vb_ser_cell(std::string &out, const NbCol &c, Py_ssize_t i)
+{
+    switch (c.tag[(size_t)i]) {
+    case NB_NONE:
+        out.push_back('\x00');
+        return;
+    case NB_BOOL:
+        out.push_back('B');
+        out.push_back(c.word[(size_t)i] ? '\x01' : '\x00');
+        return;
+    case NB_INT: {
+        int64_t v = c.word[(size_t)i];
+        out.push_back('I');
+        /* two's-complement abs handles INT64_MIN */
+        uint64_t a = v < 0 ? ~(uint64_t)v + 1ULL : (uint64_t)v;
+        int bl = 0;
+        while (bl < 64 && (a >> bl)) /* guard first: a >> 64 is UB */
+            bl++;
+        int nbytes = (bl + 8) / 8 + 1;
+        for (int b = 0; b < nbytes; b++)
+            out.push_back(
+                b < 8 ? (char)(((uint64_t)v >> (8 * b)) & 0xff)
+                      : (v < 0 ? '\xff' : '\x00'));
+        return;
+    }
+    case NB_FLT: {
+        /* word already holds the IEEE-754 bits; struct.pack("<d") parity */
+        int64_t w = c.word[(size_t)i];
+        out.push_back('F');
+        out.append(reinterpret_cast<const char *>(&w), 8);
+        return;
+    }
+    default: { /* NB_STR */
+        out.push_back('S');
+        out.append(c.arena.data() + (size_t)c.word[(size_t)i],
+                   c.len[(size_t)i]);
+        return;
+    }
+    }
+}
+
+/* shard_partition_nb(nb, kidx | None, world) -> [NativeBatch] * world
+ *
+ * Mints each row's shard id with the in-process blake2b-64 over the
+ * exact stable_shard byte image — kidx a tuple of key-column indices
+ * hashes the TUPLE of those values ("T" + length-prefixed cells, the
+ * grouping_batch / lkey_batch pk shape); kidx None hashes the row's own
+ * Pointer ("P" + 16-byte LE, the _exchange_by_id shape) — and emits
+ * per-rank columnar slices without materializing one row object. The
+ * hash+slice loop runs with the GIL released. */
+PyObject *shard_partition_nb(PyObject *, PyObject *args)
+{
+    PyObject *nb_obj, *kidx_obj;
+    int world;
+    if (!PyArg_ParseTuple(args, "O!Oi", &NativeBatchType, &nb_obj,
+                          &kidx_obj, &world))
+        return nullptr;
+    if (world < 1) {
+        PyErr_SetString(PyExc_ValueError, "shard_partition_nb: world");
+        return nullptr;
+    }
+    auto *nb = reinterpret_cast<NativeBatchObject *>(nb_obj);
+    std::vector<int> kidx;
+    bool by_id = (kidx_obj == Py_None);
+    if (!by_id) {
+        if (!PyTuple_Check(kidx_obj)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "shard_partition_nb: kidx must be tuple|None");
+            return nullptr;
+        }
+        Py_ssize_t nk = PyTuple_GET_SIZE(kidx_obj);
+        for (Py_ssize_t j = 0; j < nk; j++) {
+            long v = PyLong_AsLong(PyTuple_GET_ITEM(kidx_obj, j));
+            if (v < 0 || v >= nb->width) {
+                PyErr_SetString(PyExc_ValueError,
+                                "shard_partition_nb: kidx out of range");
+                return nullptr;
+            }
+            kidx.push_back((int)v);
+        }
+    }
+    std::vector<NativeBatchObject *> outs((size_t)world, nullptr);
+    for (int w = 0; w < world; w++) {
+        outs[(size_t)w] = nb_alloc(nb->width, nb->ptr_type);
+        if (outs[(size_t)w] == nullptr) {
+            for (int u = 0; u < w; u++)
+                Py_DECREF(outs[(size_t)u]);
+            return nullptr;
+        }
+    }
+    Py_BEGIN_ALLOW_THREADS;
+    {
+        std::string kb;
+        kb.reserve(64);
+        for (Py_ssize_t i = 0; i < nb->n; i++) {
+            kb.clear();
+            if (by_id) {
+                kb.push_back('P');
+                unsigned __int128 k = (*nb->keys)[(size_t)i];
+                kb.append(reinterpret_cast<const char *>(&k), 16);
+            } else {
+                kb.push_back('T');
+                pw_put_u32le(kb, (uint32_t)kidx.size());
+                for (int c : kidx) {
+                    size_t lp = kb.size();
+                    kb.append(4, '\0');
+                    vb_ser_cell(kb, (*nb->cols)[(size_t)c], i);
+                    uint32_t plen = (uint32_t)(kb.size() - lp - 4);
+                    memcpy(&kb[lp], &plen, 4);
+                }
+            }
+            int s = (int)(pw_b2b_digest8_u64(
+                              reinterpret_cast<const unsigned char *>(
+                                  kb.data()),
+                              kb.size()) %
+                          (uint64_t)world);
+            NativeBatchObject *dst = outs[(size_t)s];
+            dst->keys->push_back((*nb->keys)[(size_t)i]);
+            for (int c = 0; c < nb->width; c++)
+                nbcol_push_cell((*dst->cols)[(size_t)c],
+                                (*nb->cols)[(size_t)c], i);
+        }
+        for (int w = 0; w < world; w++)
+            outs[(size_t)w]->n = (Py_ssize_t)outs[(size_t)w]->keys->size();
+    }
+    Py_END_ALLOW_THREADS;
+    PyObject *res = PyList_New(world);
+    if (res == nullptr) {
+        for (int w = 0; w < world; w++)
+            Py_DECREF(outs[(size_t)w]);
+        return nullptr;
+    }
+    for (int w = 0; w < world; w++)
+        PyList_SET_ITEM(res, w, (PyObject *)outs[(size_t)w]);
+    return res;
+}
+
+/* ---- nb wire codec (exchange v2 typed columnar buffers) --------------
+ * Layout (all little-endian):
+ *   u32 version(=1) | u32 n | u32 width
+ *   keys: n * 16 bytes
+ *   per column:
+ *     u8 has_str | tags: n bytes | words: n * 8 bytes
+ *     [has_str: lens: n * 4 bytes | u64 arena_len | arena bytes]
+ * Pure memcpy both ways — the wire image IS the in-memory image. */
+
+PyObject *nb_encode(PyObject *, PyObject *args)
+{
+    PyObject *nb_obj;
+    if (!PyArg_ParseTuple(args, "O!", &NativeBatchType, &nb_obj))
+        return nullptr;
+    auto *nb = reinterpret_cast<NativeBatchObject *>(nb_obj);
+    size_t n = (size_t)nb->n;
+    std::vector<uint8_t> has_str((size_t)nb->width, 0);
+    size_t total = 12 + n * 16;
+    for (int c = 0; c < nb->width; c++) {
+        const NbCol &col = (*nb->cols)[(size_t)c];
+        uint8_t hs = 0;
+        for (size_t i = 0; i < n; i++)
+            if (col.tag[i] == NB_STR) {
+                hs = 1;
+                break;
+            }
+        has_str[(size_t)c] = hs;
+        total += 1 + n + n * 8 + (hs ? n * 4 + 8 + col.arena.size() : 0);
+    }
+    PyObject *out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)total);
+    if (out == nullptr)
+        return nullptr;
+    char *p = PyBytes_AS_STRING(out);
+    Py_BEGIN_ALLOW_THREADS;
+    {
+        auto put_u32 = [&](uint32_t v) {
+            memcpy(p, &v, 4);
+            p += 4;
+        };
+        put_u32(1u);
+        put_u32((uint32_t)n);
+        put_u32((uint32_t)nb->width);
+        memcpy(p, nb->keys->data(), n * 16);
+        p += n * 16;
+        for (int c = 0; c < nb->width; c++) {
+            const NbCol &col = (*nb->cols)[(size_t)c];
+            *p++ = (char)has_str[(size_t)c];
+            memcpy(p, col.tag.data(), n);
+            p += n;
+            memcpy(p, col.word.data(), n * 8);
+            p += n * 8;
+            if (has_str[(size_t)c]) {
+                memcpy(p, col.len.data(), n * 4);
+                p += n * 4;
+                uint64_t alen = (uint64_t)col.arena.size();
+                memcpy(p, &alen, 8);
+                p += 8;
+                memcpy(p, col.arena.data(), col.arena.size());
+                p += col.arena.size();
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS;
+    return out;
+}
+
+PyObject *nb_decode(PyObject *, PyObject *args)
+{
+    Py_buffer buf;
+    PyObject *ptr_type;
+    if (!PyArg_ParseTuple(args, "y*O", &buf, &ptr_type))
+        return nullptr;
+    const char *p = (const char *)buf.buf;
+    const char *end = p + buf.len;
+    NativeBatchObject *nb = nullptr;
+    uint32_t ver = 0, n = 0, width = 0;
+    auto need = [&](size_t k) { return (size_t)(end - p) >= k; };
+    auto get_u32 = [&](uint32_t *v) {
+        memcpy(v, p, 4);
+        p += 4;
+    };
+    if (!need(12))
+        goto corrupt;
+    get_u32(&ver);
+    get_u32(&n);
+    get_u32(&width);
+    if (ver != 1 || width > (1u << 16) || n > (1u << 30))
+        goto corrupt;
+    nb = nb_alloc((int)width, ptr_type);
+    if (nb == nullptr) {
+        PyBuffer_Release(&buf);
+        return nullptr;
+    }
+    {
+        bool bad = false;
+        Py_BEGIN_ALLOW_THREADS;
+        do {
+            if (!need((size_t)n * 16)) {
+                bad = true;
+                break;
+            }
+            nb->keys->resize(n);
+            memcpy(nb->keys->data(), p, (size_t)n * 16);
+            p += (size_t)n * 16;
+            for (uint32_t c = 0; c < width && !bad; c++) {
+                NbCol &col = (*nb->cols)[c];
+                if (!need(1 + (size_t)n * 9)) {
+                    bad = true;
+                    break;
+                }
+                uint8_t hs = (uint8_t)*p++;
+                col.tag.resize(n);
+                memcpy(col.tag.data(), p, n);
+                p += n;
+                col.word.resize(n);
+                memcpy(col.word.data(), p, (size_t)n * 8);
+                p += (size_t)n * 8;
+                col.len.assign(n, 0);
+                if (hs) {
+                    if (!need((size_t)n * 4 + 8)) {
+                        bad = true;
+                        break;
+                    }
+                    memcpy(col.len.data(), p, (size_t)n * 4);
+                    p += (size_t)n * 4;
+                    uint64_t alen;
+                    memcpy(&alen, p, 8);
+                    p += 8;
+                    if (!need(alen)) {
+                        bad = true;
+                        break;
+                    }
+                    col.arena.assign(p, alen);
+                    p += alen;
+                }
+                /* arena bounds: every NB_STR cell must stay inside */
+                for (uint32_t i = 0; i < n && !bad; i++)
+                    if (col.tag[i] == NB_STR &&
+                        (uint64_t)col.word[i] + col.len[i] >
+                            col.arena.size())
+                        bad = true;
+            }
+        } while (false);
+        Py_END_ALLOW_THREADS;
+        if (bad) {
+            Py_DECREF(nb);
+            goto corrupt;
+        }
+    }
+    nb->n = (Py_ssize_t)n;
+    PyBuffer_Release(&buf);
+    return reinterpret_cast<PyObject *>(nb);
+corrupt:
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "nb_decode: corrupt columnar frame");
+    return nullptr;
+}
+
+/* ---- delta-list wire codec (exchange v2, retraction-bearing) ---------
+ * NativeBatch carries insert-only batches; exchange slices that carry
+ * retractions (group-by updates gathered to rank 0, upsert sessions)
+ * use this codec instead: keys + i32 diffs + the same dtype-tagged
+ * column buffers. Any non-scalar cell (ndarray, Json, tuple, subclass)
+ * makes encode return None and the caller falls back to pickle — the
+ * "pickled segments for object columns only" rule. Layout:
+ *   u32 version(=2) | u32 n | u32 width
+ *   keys: n * 16 | diffs: n * 4 (i32)
+ *   columns as in nb_encode */
+
+PyObject *deltas_encode(PyObject *, PyObject *args)
+{
+    PyObject *lst;
+    if (!PyArg_ParseTuple(args, "O", &lst))
+        return nullptr;
+    PyObject *seq = PySequence_Fast(lst, "deltas_encode: sequence");
+    if (seq == nullptr)
+        return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t w = 0;
+    if (n > 0) {
+        PyObject *d0 = PySequence_Fast_GET_ITEM(seq, 0);
+        if (!PyTuple_Check(d0) || PyTuple_GET_SIZE(d0) != 3 ||
+            !PyTuple_Check(PyTuple_GET_ITEM(d0, 1))) {
+            Py_DECREF(seq);
+            Py_RETURN_NONE;
+        }
+        w = PyTuple_GET_SIZE(PyTuple_GET_ITEM(d0, 1));
+    }
+    std::vector<unsigned __int128> keys;
+    std::vector<int32_t> diffs;
+    std::vector<NbCol> cols((size_t)w);
+    keys.reserve((size_t)n);
+    diffs.reserve((size_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3)
+            goto fallback;
+        PyObject *row = PyTuple_GET_ITEM(d, 1);
+        if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) != w)
+            goto fallback;
+        unsigned __int128 k;
+        if (!nb_int128_of(PyTuple_GET_ITEM(d, 0), &k))
+            goto fallback;
+        long diff = PyLong_AsLong(PyTuple_GET_ITEM(d, 2));
+        if ((diff == -1 && PyErr_Occurred()) || diff < INT32_MIN ||
+            diff > INT32_MAX) {
+            PyErr_Clear();
+            goto fallback;
+        }
+        for (Py_ssize_t c = 0; c < w; c++)
+            if (!nb_put(cols[(size_t)c], PyTuple_GET_ITEM(row, c))) {
+                /* roll the columns back to a consistent length */
+                for (Py_ssize_t u = 0; u < w; u++) {
+                    NbCol &cc = cols[(size_t)u];
+                    while ((Py_ssize_t)cc.tag.size() > i) {
+                        if (cc.tag.back() == NB_STR)
+                            cc.arena.resize((size_t)cc.word.back());
+                        cc.tag.pop_back();
+                        cc.word.pop_back();
+                        cc.len.pop_back();
+                    }
+                }
+                goto fallback;
+            }
+        keys.push_back(k);
+        diffs.push_back((int32_t)diff);
+    }
+    {
+        Py_DECREF(seq);
+        std::vector<uint8_t> has_str((size_t)w, 0);
+        size_t total = 12 + (size_t)n * 20;
+        for (Py_ssize_t c = 0; c < w; c++) {
+            const NbCol &col = cols[(size_t)c];
+            uint8_t hs = 0;
+            for (size_t i = 0; i < (size_t)n; i++)
+                if (col.tag[i] == NB_STR) {
+                    hs = 1;
+                    break;
+                }
+            has_str[(size_t)c] = hs;
+            total += 1 + (size_t)n * 9 +
+                     (hs ? (size_t)n * 4 + 8 + col.arena.size() : 0);
+        }
+        PyObject *out =
+            PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)total);
+        if (out == nullptr)
+            return nullptr;
+        char *p = PyBytes_AS_STRING(out);
+        auto put_u32 = [&](uint32_t v) {
+            memcpy(p, &v, 4);
+            p += 4;
+        };
+        put_u32(2u);
+        put_u32((uint32_t)n);
+        put_u32((uint32_t)w);
+        memcpy(p, keys.data(), (size_t)n * 16);
+        p += (size_t)n * 16;
+        memcpy(p, diffs.data(), (size_t)n * 4);
+        p += (size_t)n * 4;
+        for (Py_ssize_t c = 0; c < w; c++) {
+            const NbCol &col = cols[(size_t)c];
+            *p++ = (char)has_str[(size_t)c];
+            memcpy(p, col.tag.data(), (size_t)n);
+            p += n;
+            memcpy(p, col.word.data(), (size_t)n * 8);
+            p += (size_t)n * 8;
+            if (has_str[(size_t)c]) {
+                memcpy(p, col.len.data(), (size_t)n * 4);
+                p += (size_t)n * 4;
+                uint64_t alen = (uint64_t)col.arena.size();
+                memcpy(p, &alen, 8);
+                p += 8;
+                memcpy(p, col.arena.data(), col.arena.size());
+                p += col.arena.size();
+            }
+        }
+        return out;
+    }
+fallback:
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
+PyObject *deltas_decode(PyObject *, PyObject *args)
+{
+    Py_buffer buf;
+    PyObject *ptr_type;
+    if (!PyArg_ParseTuple(args, "y*O", &buf, &ptr_type))
+        return nullptr;
+    const char *p = (const char *)buf.buf;
+    const char *end = p + buf.len;
+    uint32_t ver = 0, n = 0, width = 0;
+    PyObject *out = nullptr;
+    std::vector<NbCol> cols;
+    const char *keys_p = nullptr, *diffs_p = nullptr;
+    auto need = [&](size_t k) { return (size_t)(end - p) >= k; };
+    if (!need(12))
+        goto corrupt;
+    memcpy(&ver, p, 4);
+    memcpy(&n, p + 4, 4);
+    memcpy(&width, p + 8, 4);
+    p += 12;
+    if (ver != 2 || width > (1u << 16) || n > (1u << 30))
+        goto corrupt;
+    if (!need((size_t)n * 20))
+        goto corrupt;
+    keys_p = p;
+    p += (size_t)n * 16;
+    diffs_p = p;
+    p += (size_t)n * 4;
+    cols.resize(width);
+    for (uint32_t c = 0; c < width; c++) {
+        NbCol &col = cols[c];
+        if (!need(1 + (size_t)n * 9))
+            goto corrupt;
+        uint8_t hs = (uint8_t)*p++;
+        col.tag.resize(n);
+        memcpy(col.tag.data(), p, n);
+        p += n;
+        col.word.resize(n);
+        memcpy(col.word.data(), p, (size_t)n * 8);
+        p += (size_t)n * 8;
+        col.len.assign(n, 0);
+        if (hs) {
+            if (!need((size_t)n * 4 + 8))
+                goto corrupt;
+            memcpy(col.len.data(), p, (size_t)n * 4);
+            p += (size_t)n * 4;
+            uint64_t alen;
+            memcpy(&alen, p, 8);
+            p += 8;
+            if (!need(alen))
+                goto corrupt;
+            col.arena.assign(p, alen);
+            p += alen;
+        }
+        for (uint32_t i = 0; i < n; i++)
+            if (col.tag[i] == NB_STR &&
+                (uint64_t)col.word[i] + col.len[i] > col.arena.size())
+                goto corrupt;
+    }
+    out = PyList_New((Py_ssize_t)n);
+    if (out == nullptr) {
+        PyBuffer_Release(&buf);
+        return nullptr;
+    }
+    for (uint32_t i = 0; i < n; i++) {
+        unsigned __int128 k;
+        memcpy(&k, keys_p + (size_t)i * 16, 16);
+        int32_t diff;
+        memcpy(&diff, diffs_p + (size_t)i * 4, 4);
+        PyObject *key = pointer_from_u128(ptr_type, k);
+        if (key == nullptr)
+            goto fail;
+        PyObject *row = PyTuple_New((Py_ssize_t)width);
+        if (row == nullptr) {
+            Py_DECREF(key);
+            goto fail;
+        }
+        for (uint32_t c = 0; c < width; c++) {
+            PyObject *v = nb_cell_to_py(cols[c], (Py_ssize_t)i);
+            if (v == nullptr) {
+                Py_DECREF(key);
+                Py_DECREF(row);
+                goto fail;
+            }
+            PyTuple_SET_ITEM(row, (Py_ssize_t)c, v);
+        }
+        PyObject *t = Py_BuildValue("(NNi)", key, row, (int)diff);
+        if (t == nullptr)
+            goto fail;
+        PyList_SET_ITEM(out, (Py_ssize_t)i, t);
+    }
+    PyBuffer_Release(&buf);
+    return out;
+fail:
+    Py_DECREF(out);
+    PyBuffer_Release(&buf);
+    return nullptr;
+corrupt:
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "deltas_decode: corrupt frame");
+    return nullptr;
+}
+
+/* nb_concat([nb, ...]) -> NativeBatch — arena-rebased column append;
+ * used by the exchange merge so downstream fused consumers see ONE
+ * columnar batch per timestamp regardless of how many peers fed it. */
+PyObject *nb_concat(PyObject *, PyObject *args)
+{
+    PyObject *lst;
+    if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &lst))
+        return nullptr;
+    Py_ssize_t k = PyList_GET_SIZE(lst);
+    if (k == 0) {
+        PyErr_SetString(PyExc_ValueError, "nb_concat: empty list");
+        return nullptr;
+    }
+    for (Py_ssize_t j = 0; j < k; j++)
+        if (!PyObject_TypeCheck(PyList_GET_ITEM(lst, j), &NativeBatchType)) {
+            PyErr_SetString(PyExc_TypeError, "nb_concat: NativeBatch list");
+            return nullptr;
+        }
+    auto *first = reinterpret_cast<NativeBatchObject *>(PyList_GET_ITEM(lst, 0));
+    for (Py_ssize_t j = 1; j < k; j++)
+        if (reinterpret_cast<NativeBatchObject *>(PyList_GET_ITEM(lst, j))
+                ->width != first->width) {
+            PyErr_SetString(PyExc_ValueError, "nb_concat: width mismatch");
+            return nullptr;
+        }
+    NativeBatchObject *out = nb_alloc(first->width, first->ptr_type);
+    if (out == nullptr)
+        return nullptr;
+    Py_BEGIN_ALLOW_THREADS;
+    for (Py_ssize_t j = 0; j < k; j++) {
+        auto *src =
+            reinterpret_cast<NativeBatchObject *>(PyList_GET_ITEM(lst, j));
+        out->keys->insert(out->keys->end(), src->keys->begin(),
+                          src->keys->end());
+        for (int c = 0; c < first->width; c++)
+            nbcol_append((*out->cols)[(size_t)c], (*src->cols)[(size_t)c]);
+    }
+    out->n = (Py_ssize_t)out->keys->size();
+    Py_END_ALLOW_THREADS;
+    return reinterpret_cast<PyObject *>(out);
+}
+
 /* ---- capture_apply_nb(rows_dict, updates, nb, time) ------------------
  * Columnar capture sink expansion: one C pass takes a NativeBatch into
  * the capture's key->row dict and update history — no intermediate
@@ -4883,6 +5483,20 @@ PyMethodDef methods[] = {
     {"parse_pk_upserts_nb", parse_pk_upserts_nb, METH_VARARGS,
      "parse_pk_upserts_nb(dicts, cols, defaults, pkeys, session, "
      "live_rows, ptr_type) -> NativeBatch | None (demoted)"},
+    {"shard_partition_nb", shard_partition_nb, METH_VARARGS,
+     "shard_partition_nb(nb, kidx|None, world) -> [NativeBatch]*world "
+     "(stable_shard-parity columnar partition, GIL-free)"},
+    {"nb_encode", nb_encode, METH_VARARGS,
+     "nb_encode(nb) -> bytes (exchange v2 typed columnar buffer)"},
+    {"nb_decode", nb_decode, METH_VARARGS,
+     "nb_decode(buffer, ptr_type) -> NativeBatch"},
+    {"nb_concat", nb_concat, METH_VARARGS,
+     "nb_concat([nb, ...]) -> NativeBatch (arena-rebased append)"},
+    {"deltas_encode", deltas_encode, METH_VARARGS,
+     "deltas_encode(deltas) -> bytes | None (typed columnar buffer for "
+     "retraction-bearing slices; None = non-scalar cells, pickle instead)"},
+    {"deltas_decode", deltas_decode, METH_VARARGS,
+     "deltas_decode(buffer, ptr_type) -> [(key, row, diff), ...]"},
     {"nb_project", nb_project, METH_VARARGS,
      "nb_project(nb, idxs) -> NativeBatch — columnar column projection"},
     {"capture_apply_nb", capture_apply_nb, METH_VARARGS,

@@ -110,4 +110,31 @@ static void pw_b2b_digest16(unsigned char out[16], const unsigned char *data,
         out[i] = (unsigned char)((h[i / 8] >> (8 * (i % 8))) & 0xff);
 }
 
+/* pw_b2b_digest8_u64(data, n): little-endian u64 of the blake2b-64
+ * digest, no key — byte-identical to
+ * int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+ * which backs procgroup.stable_shard. The digest length enters the
+ * blake2b parameter block, so this is NOT a truncation of digest16. */
+static uint64_t pw_b2b_digest8_u64(const unsigned char *data, size_t n)
+{
+    uint64_t h[8];
+    int i;
+    for (i = 0; i < 8; i++)
+        h[i] = pw_b2b_iv[i];
+    h[0] ^= 0x01010000ULL ^ 8ULL; /* param block: digest_len=8, fanout=1,
+                                   * depth=1 */
+    size_t off = 0;
+    while (n - off > 128) {
+        pw_b2b_compress(h, data + off, (uint64_t)(off + 128), 0);
+        off += 128;
+    }
+    unsigned char last[128];
+    size_t rem = n - off; /* 0..128; empty input -> one zero block */
+    memset(last, 0, sizeof(last));
+    if (rem > 0)
+        memcpy(last, data + off, rem);
+    pw_b2b_compress(h, last, (uint64_t)n, 1);
+    return h[0];
+}
+
 #endif /* PW_BLAKE2B_H */

@@ -363,47 +363,204 @@ class ConcatNode(Node):
 
 class ExchangeNode(Node):
     """Shard-routing boundary for multi-process runs (reference: timely
-    exchange pacts at groupby/join boundaries, dataflow.rs).
+    exchange pacts at groupby/join boundaries, dataflow.rs — shuffles
+    are a streamed byte-level concern, not an interpreter concern).
 
     Hash mode partitions each delta batch by a key (the downstream
-    stateful node's grouping/join key) via the process-stable shard hash
-    and all-to-alls the slices over the TCP mesh, so every rank owns a
-    key shard. Broadcast mode replicates the batch to every rank (small
-    sides: external-index build side, gradual_broadcast thresholds).
-    Gather mode routes everything to rank 0 (outputs). Single-process
-    runs never construct this node.
+    stateful node's grouping/join key) via the process-stable shard hash,
+    broadcast mode replicates the batch to every rank (small sides:
+    external-index build side, gradual_broadcast thresholds), gather mode
+    routes everything to rank 0 (outputs). Single-process runs never
+    construct this node.
 
-    The runtime marks every ExchangeNode pending at every lockstep
-    timestamp, so ranks participate in the same all-to-alls in the same
-    node-id order even when they hold no local rows for that time.
-    """
+    Columnar path: when the input arrives as a NativeBatch and the shard
+    key is plain columns (``nb_kidx``, or ``"id"`` for row-id routing),
+    slicing happens in C (exec.cpp shard_partition_nb — GIL-free, exact
+    stable_shard parity) and the slices ship as typed columnar buffers;
+    the merged output is ONE NativeBatch (nb_concat), so the fused chain
+    survives the rank boundary. Object columns, UDF outputs, retraction
+    batches and ``PATHWAY_NO_NB_EXCHANGE=1`` degrade to the tuple path
+    (per-row stable_shard_many + pickled slices) with identical routing.
 
-    def __init__(self, scope, input_node, key_batch=None, mode="hash"):
+    Scheduling: the runtime steps all ExchangeNodes of a timestamp as
+    coalesced WAVES (engine/runtime.py _run_exchange_wave) — every rank
+    marks the same lockstep exchange set pending and partitions it into
+    the same waves, so all ranks join the same rendezvous in the same
+    order even when they hold no local rows. process() below is the solo
+    rendezvous for an exchange stepped outside the wave engine; it uses
+    the identical framing, so both schedulers interoperate."""
+
+    def __init__(
+        self, scope, input_node, key_batch=None, mode="hash", nb_kidx=None
+    ):
         super().__init__(scope, [input_node])
         self.key_batch = key_batch
         self.mode = mode
+        # plain-column shard key: tuple of column indices, "id" (route by
+        # the row's own Pointer), or None (tuple path only)
+        self.nb_kidx = nb_kidx
+        import os as _os
 
-    def process(self, time, batches):
-        pg = self.scope.runtime.procgroup
-        deltas = consolidate(batches[0])
-        world = pg.world
+        self._nb_ok = not _os.environ.get("PATHWAY_NO_NB_EXCHANGE")
+        self._nb_batches = 0  # columnar batches through this boundary
+        self._fallbacks = 0   # non-empty batches on the tuple path
+
+    @staticmethod
+    def _pwexec():
+        from pathway_tpu.native import get_pwexec
+
+        try:
+            ex = get_pwexec()
+        except Exception:
+            return None
+        if ex is None or not hasattr(ex, "shard_partition_nb"):
+            return None
+        return ex
+
+    def _slice(self, batch):
+        """Phase 1 (local, no communication): split this boundary's input
+        into (own_part, {peer: part}) — parts are NativeBatch slices on
+        the columnar path, delta lists on the tuple path. Empty parts are
+        dropped from the send map (the coalesced frame's presence header
+        elides them entirely)."""
+        rt = self.scope.runtime
+        pg = rt.procgroup
+        world, rank = pg.world, pg.rank
+        ex = None
+        if (
+            self._nb_ok
+            and is_native_batch(batch)
+            and (self.mode != "hash" or self.nb_kidx is not None)
+        ):
+            ex = self._pwexec()
+        if ex is not None:
+            if self.mode == "hash":
+                kidx = None if self.nb_kidx == "id" else tuple(self.nb_kidx)
+                slices = ex.shard_partition_nb(batch, kidx, world)
+                own = slices[rank]
+                sends = {
+                    p: slices[p]
+                    for p in range(world)
+                    if p != rank and len(slices[p])
+                }
+            elif self.mode == "broadcast":
+                own = batch
+                sends = (
+                    {p: batch for p in range(world) if p != rank}
+                    if len(batch)
+                    else {}
+                )
+            else:  # gather -> rank 0
+                own = batch if rank == 0 else None
+                sends = {0: batch} if rank != 0 and len(batch) else {}
+            if len(batch):
+                self._nb_batches += 1
+            rt.stats.on_exchange_elided(world - 1 - len(sends))
+            return own, sends
+        deltas = consolidate(batch) if batch else []
+        if deltas:
+            self._fallbacks += 1
+            rt.stats.on_exchange_fallback()
         if self.mode == "hash":
-            from pathway_tpu.parallel.procgroup import stable_shard
-
             per_rank: list[list] = [[] for _ in range(world)]
             if deltas:
+                from pathway_tpu.parallel.procgroup import stable_shard_many
+
                 pks = self.key_batch(
                     [d[0] for d in deltas], [d[1] for d in deltas]
                 )
-                for d, pk in zip(deltas, pks):
-                    per_rank[stable_shard(pk, world)].append(d)
+                for d, s in zip(deltas, stable_shard_many(pks, world)):
+                    per_rank[s].append(d)
+            own = per_rank[rank]
+            sends = {
+                p: per_rank[p]
+                for p in range(world)
+                if p != rank and per_rank[p]
+            }
         elif self.mode == "broadcast":
-            per_rank = [list(deltas) for _ in range(world)]
+            own = deltas
+            sends = (
+                {p: deltas for p in range(world) if p != rank}
+                if deltas
+                else {}
+            )
         else:  # gather -> rank 0
-            per_rank = [[] for _ in range(world)]
-            per_rank[0] = list(deltas)
-        merged = pg.all_to_all(("x", self.node_id, time), per_rank)
+            own = deltas if rank == 0 else None
+            sends = {0: deltas} if rank != 0 and deltas else {}
+        rt.stats.on_exchange_elided(world - 1 - len(sends))
+        return own, sends
+
+    def finish_exchange(self, own, parts):
+        """Phase 2: merge the own slice with received peer parts (peer
+        order ascending — the deterministic merge order every rank
+        shares). All-columnar merges stay columnar: downstream fused
+        consumers (groupby/join/select/capture) see ONE NativeBatch.
+        Mixed or tuple merges materialize and consolidate exactly like
+        the pre-columnar per-node all_to_all did."""
+        merged_parts = []
+        if own is not None and len(own):
+            merged_parts.append(own)
+        for p in parts:
+            if len(p):
+                merged_parts.append(p)
+        if not merged_parts:
+            return []
+        if all(is_native_batch(p) for p in merged_parts):
+            if len(merged_parts) == 1:
+                return merged_parts[0]
+            ex = self._pwexec()
+            if ex is not None:
+                return ex.nb_concat(merged_parts)
+        mats = [
+            p.materialize() if is_native_batch(p) else p
+            for p in merged_parts
+        ]
+        merged: list = []
+        for m in mats:
+            merged.extend(m)
+        # every part is net form by protocol (each rank slices a
+        # consolidated batch). When the parts' KEY sets are disjoint —
+        # the steady state: hash slices of content-routed keys, gathers
+        # of key-sharded operator outputs — their concatenation is
+        # already net, and re-consolidating 400k gathered deltas per run
+        # was the single hottest line of the 2-rank profile. One int-set
+        # pass checks disjointness; overlapping keys (cross-rank upsert
+        # pairs, colliding minted keys) take the full consolidation.
+        if len(mats) == 1:
+            return ConsolidatedList(merged)
+        per_part = sum(len({d[0] for d in m}) for m in mats)
+        if len({d[0] for d in merged}) == per_part:
+            return ConsolidatedList(merged)
         return consolidate(merged)
+
+    def process(self, time, batches):
+        # solo rendezvous (wave of one): identical framing to the wave
+        # engine, so an exchange stepped through the generic topo loop on
+        # every rank still lines up peer-to-peer
+        pg = self.scope.runtime.procgroup
+        own, sends = self._slice(batches[0])
+        tag = ("xw", time, ("s", self.node_id))
+        stats = self.scope.runtime.stats
+        gather = self.mode == "gather"
+        enc_cache: dict = {}
+        for peer in range(pg.world):
+            if peer == pg.rank or (gather and peer != 0):
+                continue
+            ent = sends.get(peer)
+            stats.on_exchange_frame(
+                pg.send_exchange(
+                    peer, tag,
+                    [(self.node_id, ent)] if ent is not None else [],
+                    enc_cache,
+                )
+            )
+        parts = []
+        for peer in range(pg.world):
+            if peer == pg.rank or (gather and pg.rank != 0):
+                continue
+            for _nid, part in pg.recv(peer, tag):
+                parts.append(part)
+        return self.finish_exchange(own, parts)
 
 
 class GroupDiffNode(Node):

@@ -106,6 +106,12 @@ class Runtime:
         self._procgroup = None
         self._lockstep_seq = 0
         self._reach_masks: list[int] | None = None
+        # rank bitmask of the current timestamp's frontier contributors
+        # (set around _step_time by the lockstep loop; None = unknown,
+        # every wave keeps the full mesh)
+        self._exchange_contrib: int | None = None
+        self._planned_ok: bool | None = None  # planned-walk eligibility
+        self._upstream_masks: list[int] | None = None
 
     # -- multi-process plane ----------------------------------------------
     @property
@@ -154,6 +160,32 @@ class Runtime:
         self._reach_masks = masks
         return masks
 
+    def _exchange_upstream_masks(self) -> list[int]:
+        """node_id -> bitmask of exchange boundaries UPSTREAM of that
+        node (the node consumes their output, possibly transitively).
+        The wave quiesce must not step a node while any of its upstream
+        exchanges is still waiting for its rendezvous — its inputs are
+        incomplete until that boundary delivers."""
+        nodes = self.scope.nodes
+        if self._upstream_masks is not None and len(
+            self._upstream_masks
+        ) == len(nodes):
+            return self._upstream_masks
+        xidx = {
+            id(xn): i for i, xn in enumerate(self.scope.exchange_nodes)
+        }
+        umasks = [0] * len(nodes)
+        for node in nodes:  # registration order is topological
+            mask = 0
+            for inp in node.inputs:
+                m = xidx.get(id(inp))
+                mask |= umasks[inp.node_id] | (
+                    0 if m is None else (1 << m)
+                )
+            umasks[node.node_id] = mask
+        self._upstream_masks = umasks
+        return umasks
+
     def _step_lockstep(self, bound: int | None = None) -> int:
         """Step globally-agreed timestamps in order until no rank has
         pending work (<= bound). One control round-trip per timestamp: the
@@ -179,14 +211,18 @@ class Runtime:
                     mine = (m, xmask)
             if pg.rank == 0:
                 fronts = pg.gather0(("f", seq), mine)
-                live = [f for f in fronts if f is not None]
+                live = [
+                    (r, f) for r, f in enumerate(fronts) if f is not None
+                ]
                 if live:
-                    t = min(f[0] for f in live)
+                    t = min(f[0] for _, f in live)
                     xmask = 0
-                    for ft, fm in live:
+                    contrib = 0
+                    for r, (ft, fm) in live:
                         if ft == t:
                             xmask |= fm
-                    plan = (t, xmask)
+                            contrib |= 1 << r
+                    plan = (t, xmask, contrib)
                 else:
                     plan = None
                 pg.bcast0(("f2", seq), plan)
@@ -195,11 +231,20 @@ class Runtime:
                 plan = pg.bcast0(("f2", seq))
             if plan is None:
                 return stepped
-            t, xmask = plan
+            t, xmask, contrib = plan
             for i, xn in enumerate(self.scope.exchange_nodes):
                 if (xmask >> i) & 1:
                     self.mark_pending(t, xn)
-            self._step_time(t)
+            # contributor mask: only these ranks held pending work at t
+            # when the plan was agreed, so only they can feed the FIRST
+            # exchange wave — everyone else's wave-1 frames are elided
+            # (engine invariant: wave-1 input derives from local pending
+            # state only; later waves may cascade received data)
+            self._exchange_contrib = contrib
+            try:
+                self._step_time(t)
+            finally:
+                self._exchange_contrib = None
             stepped += 1
 
     # -- wiring ----------------------------------------------------------
@@ -250,37 +295,194 @@ class Runtime:
         for child, port in node.downstream:
             child.accept(time, port, deltas)
 
+    def _step_node(self, time: int, nid: int) -> None:
+        node = self.scope.nodes[nid]
+        batches = node.take(time)
+        try:
+            out = node.process(time, batches)
+        except Exception as exc:
+            from pathway_tpu.internals.api import EngineErrorWithTrace
+
+            if node.trace is not None and not isinstance(
+                exc, EngineErrorWithTrace
+            ):
+                raise EngineErrorWithTrace(
+                    exc,
+                    f"{node.trace.filename}:{node.trace.lineno} "
+                    f"in {node.trace.name}: {node.trace.line}",
+                ) from exc
+            raise
+        if out:
+            self._deliver(node, time, out)
+
     def _step_time(self, time: int) -> None:
-        """Run all nodes with pending input at `time`, in topo order."""
+        """Run all nodes with pending input at `time`, in topo order.
+
+        Distributed runs first walk the timestamp's exchange boundaries
+        as coalesced waves (_step_exchange_waves) — all sends for a wave
+        are enqueued before any recv blocks, empty slices are elided, and
+        the columnar path keeps NativeBatches columnar across the rank
+        boundary — then the generic loop drains whatever remains."""
         _faults.fault_point("runtime.step")
         nodes = self.scope.nodes
+        xids: list[int] = []
+        if self.scope.exchange_nodes and self._procgroup is not None:
+            pend = self.pending_times.get(time)
+            if pend:
+                xids = [
+                    xn.node_id
+                    for xn in self.scope.exchange_nodes
+                    if xn.node_id in pend
+                ]
+        t_start = _time.perf_counter() if xids else 0.0
+        comms_s = self._step_exchange_waves(time, xids) if xids else 0.0
         while True:
             pending_ids = self.pending_times.get(time)
             if not pending_ids:
                 break
             nid = min(pending_ids)
             pending_ids.discard(nid)
-            node = nodes[nid]
-            batches = node.take(time)
-            try:
-                out = node.process(time, batches)
-            except Exception as exc:
-                from pathway_tpu.internals.api import EngineErrorWithTrace
-
-                if node.trace is not None and not isinstance(
-                    exc, EngineErrorWithTrace
-                ):
-                    raise EngineErrorWithTrace(
-                        exc,
-                        f"{node.trace.filename}:{node.trace.lineno} "
-                        f"in {node.trace.name}: {node.trace.line}",
-                    ) from exc
-                raise
-            if out:
-                self._deliver(node, time, out)
+            self._step_node(time, nid)
+        if xids:
+            self.stats.on_exchange_step(
+                comms_s, _time.perf_counter() - t_start - comms_s
+            )
         self.pending_times.pop(time, None)
         for node in nodes:
             node.on_time_end(time)
+
+    def _step_exchange_waves(self, time: int, xids: list[int]) -> float:
+        """Step the timestamp's exchange boundaries as coalesced waves.
+
+        Wave partition: of the pending exchanges, those with no OTHER
+        pending exchange upstream form the next wave. The pending set is
+        the lockstep-agreed exchange mask (identical on every rank) and
+        upstream-ness is static reachability, so every rank derives the
+        same waves in the same order — the data-plane rendezvous needs no
+        extra control traffic. Before each wave, local computation
+        upstream of any remaining exchange is quiesced (topo order within
+        that upstream-closed set), so every wave member's input is
+        complete when sliced. Returns seconds spent in the communication
+        phases (slice/encode/send/recv-wait/merge) for the
+        comms-vs-compute counters."""
+        masks = self._exchange_reach_masks()
+        umasks = self._exchange_upstream_masks()
+        xi = {xn.node_id: i for i, xn in enumerate(self.scope.exchange_nodes)}
+        remaining = set(xids)
+        comms = 0.0
+        wave_no = 0
+        while remaining:
+            wbits = 0
+            for nid in remaining:
+                wbits |= 1 << xi[nid]
+            # quiesce local computation feeding a remaining exchange —
+            # but a node DOWNSTREAM of a remaining exchange has
+            # incomplete inputs until that boundary delivers, so it must
+            # wait for its wave (umask check; topo order holds within
+            # the candidate set: every upstream of a candidate is a
+            # candidate or already stepped)
+            while True:
+                pending_ids = self.pending_times.get(time)
+                cand = (
+                    [
+                        n
+                        for n in pending_ids
+                        if n not in remaining
+                        and masks[n] & wbits
+                        and not umasks[n] & wbits
+                    ]
+                    if pending_ids
+                    else []
+                )
+                if not cand:
+                    break
+                nid = min(cand)
+                pending_ids.discard(nid)
+                self._step_node(time, nid)
+            wave = [
+                nid
+                for nid in sorted(remaining)
+                if not any(
+                    o != nid and masks[o] & (1 << xi[nid]) for o in remaining
+                )
+            ]
+            wave_no += 1
+            t0 = _time.perf_counter()
+            self._run_exchange_wave(time, wave_no, wave)
+            comms += _time.perf_counter() - t0
+            remaining.difference_update(wave)
+        return comms
+
+    def _run_exchange_wave(self, time: int, seq, wave: list[int]) -> None:
+        """One coalesced rendezvous: slice every wave exchange locally,
+        ship ONE typed-columnar frame per peer carrying all their slices
+        (presence header elides the empty ones), then merge received
+        parts and deliver downstream in node-id order. Receiver threads
+        decode incoming frames concurrently, so peers' columnar decodes
+        overlap this rank's merges."""
+        pg = self.procgroup
+        nodes = self.scope.nodes
+        stats = self.stats
+        pend = self.pending_times.get(time)
+        prepared = []
+        for nid in wave:
+            if pend is not None:
+                pend.discard(nid)
+            node = nodes[nid]
+            batches = node.take(time)
+            own, sends = node._slice(batches[0])
+            prepared.append((nid, own, sends))
+        tag = ("xw", time, seq)
+        # gather-mode nodes route to rank 0 only, so for a pure-gather
+        # wave the sender set is static: non-zero ranks never receive and
+        # rank 0 never sends — those all-to-all legs are elided entirely
+        # (no frame at all), not just shipped empty. Any hash/broadcast
+        # member keeps the full mesh (every peer may hold routable rows).
+        gather_only = all(
+            nodes[nid].mode == "gather" for nid in wave
+        )
+        # wave 1 feeds on local pending state only, which the lockstep
+        # plan already named: ranks outside the contributor mask hold
+        # provably empty inputs, so their send legs vanish entirely
+        contrib = self._exchange_contrib if seq == 1 else None
+        enc_cache: dict = {}  # broadcast sides: encode once, ship world-1x
+        for peer in range(pg.world):
+            if peer == pg.rank:
+                continue
+            if (gather_only and peer != 0) or (
+                contrib is not None and not (contrib >> pg.rank) & 1
+            ):
+                stats.on_exchange_elided(1)
+                continue
+            entries = []
+            for nid, _own, sends in prepared:
+                ent = sends.get(peer)
+                if ent is not None:
+                    entries.append((nid, ent))
+            stats.on_exchange_frame(
+                pg.send_exchange(peer, tag, entries, enc_cache)
+            )
+        received: dict[int, list] = {nid: [] for nid, _o, _s in prepared}
+        for peer in range(pg.world):
+            if peer == pg.rank:
+                continue
+            if (gather_only and pg.rank != 0) or (
+                contrib is not None and not (contrib >> peer) & 1
+            ):
+                continue
+            for nid, part in pg.recv(peer, tag):
+                if nid not in received:
+                    raise RuntimeError(
+                        f"rank {pg.rank}: exchange wave desync — peer "
+                        f"{peer} sent node {nid} outside wave {wave} at "
+                        f"time {time}"
+                    )
+                received[nid].append(part)
+        for nid, own, _sends in prepared:
+            node = nodes[nid]
+            out = node.finish_exchange(own, received[nid])
+            if out:
+                self._deliver(node, time, out)
 
     def _finish(self) -> None:
         # stop the live dashboard first: its loop removes the log handler
@@ -602,22 +804,61 @@ class Runtime:
 
         return f"r{get_pathway_config().process_id}/{conn_name}"
 
+    def _planned_walk_eligible(self) -> bool:
+        """True when every commit timestamp's work is confined to that
+        timestamp: no node that can emit at a FUTURE time has an exchange
+        boundary downstream. Then a BSP round's timestamps can be walked
+        from the shared plan with zero per-timestamp control round-trips
+        — the only remaining synchronization is the data-plane waves
+        themselves. ForgetImmediatelyNode (t+1 retractions) and the
+        error-log source (rows minted at clock+1 on whichever rank hits a
+        data error) are the streaming-time future emitters; either one
+        reaching an exchange forces the negotiated frontier."""
+        if self._planned_ok is not None:
+            return self._planned_ok
+        masks = self._exchange_reach_masks()
+        from pathway_tpu.engine.nodes import ForgetImmediatelyNode
+
+        ok = not (
+            self.error_log_node is not None
+            and masks[self.error_log_node.node_id]
+        )
+        if ok:
+            ok = not any(
+                isinstance(node, ForgetImmediatelyNode)
+                and masks[node.node_id]
+                for node in self.scope.nodes
+            )
+        self._planned_ok = ok
+        return ok
+
     def _bsp_inject_commits(self, pg, commits, done_local, tag) -> bool:
-        """One BSP ingest round: gather per-rank commit counts, let the
-        rank-0 clock master assign globally ordered times (rank-major),
-        inject, and walk the lockstep frontier. Returns alldone (= every
+        """One BSP ingest round: gather per-rank commit counts (plus each
+        commit's source exchange mask), let the rank-0 clock master
+        assign globally ordered times (rank-major), inject, and step.
+        Eligible graphs walk the round's timestamps straight off the
+        shared plan — every rank knows every commit's time, owner and
+        exchange mask, so no per-timestamp frontier negotiation happens
+        and a rank whose peer owns the commit doesn't even send wave-1
+        frames (contributor elision). The trailing negotiated loop picks
+        up stragglers and confirms quiescence. Returns alldone (= every
         rank reported done and no rank contributed a commit)."""
+        masks = self._exchange_reach_masks()
+        my_masks = [masks[conn.node.node_id] for conn, _ in commits]
         if pg.rank == 0:
-            info = pg.gather0(tag, (len(commits), done_local))
-            counts = [c for c, _ in info]
-            alldone = all(d for _, d in info)
+            info = pg.gather0(tag, (len(commits), done_local, my_masks))
+            counts = [c for c, _, _ in info]
+            alldone = all(d for _, d, _ in info)
+            xmasks = [m for _, _, m in info]
             base = self._next_time() if sum(counts) else self.clock
-            base, counts, alldone = pg.bcast0(
-                (tag[0] + "2", tag[1]), (base, counts, alldone)
+            base, counts, alldone, xmasks = pg.bcast0(
+                (tag[0] + "2", tag[1]), (base, counts, alldone, xmasks)
             )
         else:
-            pg.gather0(tag, (len(commits), done_local))
-            base, counts, alldone = pg.bcast0((tag[0] + "2", tag[1]))
+            pg.gather0(tag, (len(commits), done_local, my_masks))
+            base, counts, alldone, xmasks = pg.bcast0(
+                (tag[0] + "2", tag[1])
+            )
         total = sum(counts)
         my_off = sum(counts[: pg.rank])
         for i, (conn, deltas) in enumerate(commits):
@@ -626,6 +867,33 @@ class Runtime:
             conn.node.accept(t, 0, deltas)
         if total:
             self.clock = max(self.clock, base + 2 * (total - 1))
+        if total and self._planned_walk_eligible():
+            plan = []
+            off = 0
+            for r, cnt in enumerate(counts):
+                for j in range(cnt):
+                    plan.append((base + 2 * (off + j), xmasks[r][j], 1 << r))
+                off += cnt
+            plan.sort()
+            for t, xmask, contrib in plan:
+                # rank-private stragglers (no exchange downstream) keep
+                # local time order; anything masked waits for the
+                # negotiated loop (impossible on eligible graphs)
+                while self.pending_times:
+                    m = self._min_pending()
+                    if m >= t or any(
+                        masks[nid] for nid in self.pending_times[m]
+                    ):
+                        break
+                    self._step_time(m)
+                for i, xn in enumerate(self.scope.exchange_nodes):
+                    if (xmask >> i) & 1:
+                        self.mark_pending(t, xn)
+                self._exchange_contrib = contrib
+                try:
+                    self._step_time(t)
+                finally:
+                    self._exchange_contrib = None
         self._step_lockstep(self.clock + 1)
         return alldone and total == 0
 
@@ -821,7 +1089,12 @@ class Runtime:
         while True:
             round_no += 1
             self._cadence_flush(live)
-            entries = self._drain_event_queue(0.2)
+            # once every LOCAL connector has finished, this rank only
+            # relays peers' rounds — the long drain pause would charge
+            # 0.2s of pure idle to the round that concludes the run
+            # (and to every shutdown-lagging rank), so drop to a short
+            # poll while waiting for global alldone
+            entries = self._drain_event_queue(0.2 if active else 0.02)
             self._service_connector_health(live)
             commits = []
             saw_data = False

@@ -52,15 +52,21 @@ class Scope:
 
         return max(1, get_pathway_config().processes)
 
-    def _exchange(self, table: EngineTable, key_batch=None, mode="hash") -> EngineTable:
+    def _exchange(
+        self, table: EngineTable, key_batch=None, mode="hash", nb_kidx=None
+    ) -> EngineTable:
+        # nb_kidx: plain-column shard key for the columnar exchange path
+        # (tuple of column indices, or "id" for row-Pointer routing);
+        # None keeps NativeBatch inputs on the tuple fallback
         if self._world() <= 1:
             return table
         return EngineTable(
-            N.ExchangeNode(self, table.node, key_batch, mode), table.width
+            N.ExchangeNode(self, table.node, key_batch, mode, nb_kidx=nb_kidx),
+            table.width,
         )
 
     def _exchange_by_id(self, table: EngineTable) -> EngineTable:
-        return self._exchange(table, lambda keys, rows: keys)
+        return self._exchange(table, lambda keys, rows: keys, nb_kidx="id")
 
     @staticmethod
     def _rowwise_key(fn):
@@ -159,11 +165,17 @@ class Scope:
         nb_rkidx=None,
     ) -> EngineTable:
         if self._world() > 1:
+            # nb_lkidx/nb_rkidx are valid shard keys exactly when the join
+            # keys are plain columns — the same eligibility the fused join
+            # uses; lkey_batch then returns the tuple of those columns, so
+            # columnar and tuple routing agree byte-for-byte
             left = self._exchange(
-                left, lkey_batch or self._rowwise_key(left_key_fn)
+                left, lkey_batch or self._rowwise_key(left_key_fn),
+                nb_kidx=nb_lkidx,
             )
             right = self._exchange(
-                right, rkey_batch or self._rowwise_key(right_key_fn)
+                right, rkey_batch or self._rowwise_key(right_key_fn),
+                nb_kidx=nb_rkidx,
             )
         node = N.JoinNode(
             self,
@@ -190,8 +202,11 @@ class Scope:
         key_fn=None, grouping_batch=None, args_batch=None, native_args=None,
         native_order=None, nb_gidx=None, nb_argidx=None,
     ) -> EngineTable:
+        # nb_gidx (plain-column grouping) doubles as the columnar shard
+        # key: grouping_batch returns the tuple of exactly those columns
         table = self._exchange(
-            table, grouping_batch or self._rowwise_key(grouping_fn)
+            table, grouping_batch or self._rowwise_key(grouping_fn),
+            nb_kidx=nb_gidx,
         )
         node = N.GroupByNode(
             self, table.node, grouping_fn, args_fn, reducer_fns, key_fn,

@@ -55,6 +55,32 @@ class ProberStats:
     outputs_emitted: int = 0
     last_output_ts: float = 0.0
     started_at: float = field(default_factory=time.time)
+    # multi-process exchange plane (engine/runtime.py wave engine +
+    # parallel/procgroup.py v2 frames): coalesced frames/bytes shipped,
+    # per-node empty slices elided from the wire, non-empty batches that
+    # de-optimized to the tuple path, and per-timestamp communication vs
+    # computation wall time
+    exchange_frames: int = 0
+    exchange_bytes: int = 0
+    exchange_empty_elided: int = 0
+    exchange_fallbacks: int = 0
+    exchange_comms_s: float = 0.0
+    exchange_compute_s: float = 0.0
+
+    def on_exchange_frame(self, nbytes: int) -> None:
+        self.exchange_frames += 1
+        self.exchange_bytes += nbytes
+
+    def on_exchange_elided(self, n: int) -> None:
+        if n > 0:
+            self.exchange_empty_elided += n
+
+    def on_exchange_fallback(self) -> None:
+        self.exchange_fallbacks += 1
+
+    def on_exchange_step(self, comms_s: float, compute_s: float) -> None:
+        self.exchange_comms_s += comms_s
+        self.exchange_compute_s += max(0.0, compute_s)
 
     def on_ingest(self, name: str, n_rows: int) -> None:
         st = self.connectors.setdefault(name, ConnectorStats(name=name))
@@ -130,6 +156,20 @@ class ProberStats:
                 )
         lines.append("# TYPE output_rows_total counter")
         lines.append(f"output_rows_total {self.outputs_emitted}")
+        for metric, val in (
+            ("exchange_frames_total", self.exchange_frames),
+            ("exchange_bytes_total", self.exchange_bytes),
+            ("exchange_empty_elided_total", self.exchange_empty_elided),
+            ("exchange_fallbacks_total", self.exchange_fallbacks),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {val}")
+        for metric, val in (
+            ("exchange_comms_seconds_total", self.exchange_comms_s),
+            ("exchange_compute_seconds_total", self.exchange_compute_s),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {val:.6f}")
         return "\n".join(lines) + "\n"
 
     def render_text(self) -> str:

@@ -21,7 +21,16 @@ XLA collectives (parallel/mesh.py). This mesh is the control+relational
 plane only, matching the reference's split between timely channels and
 its data plane.
 
-Framing: length-prefixed pickle. The mesh links trusted peer processes
+Framing: length-prefixed payloads in two formats — v1 control/fallback
+frames are pickle (first byte 0x80), v2 exchange frames are typed
+columnar buffers (magic ``PWX2``): one coalesced frame per peer carries
+every ExchangeNode's slice for a (timestamp, wave) as dtype-tagged raw
+column bytes (exec.cpp nb_encode) plus a small pickled header that names
+the slices present — empty slices ship zero bytes, object/fallback
+slices ride as pickled segments. Receiver threads cap frame sizes at
+PATHWAY_MESH_MAX_FRAME_MB (default 256) so a corrupt length prefix
+raises a clean ConnectionError instead of attempting the allocation.
+The mesh links trusted peer processes
 of one pipeline (localhost by default, PATHWAY_HOSTS for multi-host);
 it is not an external protocol surface: the listener binds 127.0.0.1
 unless PATHWAY_HOSTS names remote hosts, and every connection must
@@ -36,6 +45,7 @@ key on an open port would hand that to any network peer.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import socket
@@ -44,23 +54,62 @@ import threading
 import queue
 from typing import Any
 
-from pathway_tpu.internals.api import _value_to_bytes
-from pathway_tpu.engine.stream import freeze_value
+from pathway_tpu.internals.api import Pointer, _value_to_bytes
+from pathway_tpu.engine.stream import freeze_value, is_native_batch
 
 _LEN = struct.Struct("<Q")
+# exchange v2 frames: typed columnar buffers instead of pickle. The
+# first payload byte discriminates — pickled frames (protocol 2+) always
+# start with 0x80, so the magic can never collide with a v1 frame.
+_V2_MAGIC = b"PWX2"
+_V2_HEAD = struct.Struct("<I")
+
+
+def _max_frame_bytes() -> int:
+    """Receiver-side frame-size cap: a corrupt length prefix must raise a
+    clean ConnectionError, not attempt an unbounded allocation."""
+    try:
+        mb = float(os.environ.get("PATHWAY_MESH_MAX_FRAME_MB", "256"))
+    except ValueError:
+        mb = 256.0
+    return max(1, int(mb * 1024 * 1024))
 
 
 def stable_shard(value: Any, world: int) -> int:
     """Deterministic, process-stable partition of a key value: the same
     injective byte serialization that backs Pointer minting (api.py), so
     every rank routes a key to the same owner regardless of PYTHONHASHSEED.
+    Exact parity with the native columnar mint (exec.cpp
+    shard_partition_nb) is pinned by tests/test_native_exchange.py.
     """
-    import hashlib
-
     b = _value_to_bytes(freeze_value(value))
     return int.from_bytes(
         hashlib.blake2b(b, digest_size=8).digest(), "little"
     ) % world
+
+
+def stable_shard_many(values, world: int) -> list[int]:
+    """Batched stable_shard — one pass, locals bound once; the tuple
+    fallback path of ExchangeNode routes whole batches through this."""
+    b2b = hashlib.blake2b
+    vtb = _value_to_bytes
+    fz = freeze_value
+    fb = int.from_bytes
+    return [
+        fb(b2b(vtb(fz(v)), digest_size=8).digest(), "little") % world
+        for v in values
+    ]
+
+
+class _MeshError:
+    """Receiver-thread verdict queued in place of a frame: recv() raises
+    it as ConnectionError with the real reason (oversized/corrupt frame)
+    instead of a bare 'peer disconnected'."""
+
+    __slots__ = ("message",)
+
+    def __init__(self, message: str):
+        self.message = message
 
 
 class ProcessGroup:
@@ -96,6 +145,7 @@ class ProcessGroup:
                 f"PATHWAY_HOSTS lists {len(hosts)} hosts for {world} processes"
             )
         self.hosts = hosts
+        self._max_frame = _max_frame_bytes()
         self._socks: dict[int, socket.socket] = {}
         self._send_locks: dict[int, threading.Lock] = {}
         self._queues: dict[int, "queue.Queue"] = {
@@ -225,6 +275,14 @@ class ProcessGroup:
         self._socks.update(accepted)
         for peer, s in self._socks.items():
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # deep buffers keep coalesced exchange frames from blocking
+            # the sender while a busy peer's receiver thread is starved
+            # (best-effort: the kernel may clamp)
+            for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+                try:
+                    s.setsockopt(socket.SOL_SOCKET, opt, 4 * 1024 * 1024)
+                except OSError:
+                    pass
             self._send_locks[peer] = threading.Lock()
             t = threading.Thread(
                 target=self._recv_loop, args=(peer, s), daemon=True
@@ -234,20 +292,164 @@ class ProcessGroup:
 
     def _recv_loop(self, peer: int, s: socket.socket) -> None:
         q = self._queues[peer]
+        cap = self._max_frame
         try:
             while True:
                 head = _recv_exact(s, _LEN.size)
                 (n,) = _LEN.unpack(head)
+                if n > cap:
+                    # corrupt (or hostile) length prefix: refuse the
+                    # allocation, poison this link with the reason
+                    q.put(
+                        _MeshError(
+                            f"rank {self.rank}: frame from peer {peer} "
+                            f"declares {n} bytes, over the "
+                            f"PATHWAY_MESH_MAX_FRAME_MB cap ({cap} bytes)"
+                        )
+                    )
+                    q.put(None)  # later recv()s see a dead peer, not a hang
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
                 payload = _recv_exact(s, n)
-                q.put(pickle.loads(payload))
+                try:
+                    if payload[:4] == _V2_MAGIC:
+                        # exchange v2: decode typed columnar buffers HERE,
+                        # on the receiver thread — merge work overlaps the
+                        # main loop's compute
+                        decoded = self._decode_exchange(payload)
+                    else:
+                        decoded = pickle.loads(payload)
+                except Exception as exc:
+                    # a frame that passed the length cap but fails to
+                    # decode (corrupt bytes, stale native build) must
+                    # surface as a clean link error, not a silently dead
+                    # receiver thread that hangs the next recv() forever
+                    q.put(
+                        _MeshError(
+                            f"rank {self.rank}: undecodable frame from "
+                            f"peer {peer}: {exc!r}"
+                        )
+                    )
+                    q.put(None)
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return
+                q.put(decoded)
         except (OSError, EOFError, ConnectionError):
             q.put(None)  # peer gone
 
     # -- primitives -------------------------------------------------------
-    def send(self, peer: int, tag: Any, obj: Any) -> None:
-        payload = pickle.dumps((tag, obj), protocol=pickle.HIGHEST_PROTOCOL)
+    def _send_payload(self, peer: int, payload: bytes) -> None:
         with self._send_locks[peer]:
             self._socks[peer].sendall(_LEN.pack(len(payload)) + payload)
+
+    def send(self, peer: int, tag: Any, obj: Any) -> None:
+        # serialize OUTSIDE the per-peer lock: pickling a large fallback
+        # frame must not serialize concurrent senders to the same peer
+        payload = pickle.dumps((tag, obj), protocol=pickle.HIGHEST_PROTOCOL)
+        self._send_payload(peer, payload)
+
+    # -- exchange v2: coalesced typed-columnar frames ----------------------
+    # One frame carries EVERY exchange node's slice for one (timestamp,
+    # wave): native slices ride as nb_encode columnar buffers (kind 0),
+    # tuple-path/object-column slices as pickled segments (kind 1), empty
+    # slices are elided entirely — the pickled header doubles as the
+    # presence map. Layout:
+    #   b"PWX2" | u32 head_len | pickle((tag, [(node_id, kind, size)...]))
+    #   | blob_0 | blob_1 | ...
+    def send_exchange(
+        self, peer: int, tag: Any, entries: list, enc_cache: dict | None = None
+    ) -> int:
+        """entries: [(node_id, NativeBatch | delta-list), ...]; returns
+        bytes shipped (comms accounting). ``enc_cache`` (id(obj) ->
+        (kind, blob)) lets a wave that ships the SAME object to several
+        peers — broadcast sides — encode it once instead of world-1
+        times; the caller owns the cache's lifetime (one wave), which
+        keeps the id() keys valid."""
+        ex = self._pwexec()
+        meta = []
+        blobs = []
+        for nid, obj in entries:
+            cached = (
+                enc_cache.get(id(obj)) if enc_cache is not None else None
+            )
+            if cached is not None:
+                kind, blob = cached
+            else:
+                if ex is not None and is_native_batch(obj):
+                    blob = ex.nb_encode(obj)
+                    kind = 0
+                else:
+                    # retraction-bearing slices: typed columnar delta
+                    # codec when every cell is scalar, pickle for object
+                    # columns
+                    blob = (
+                        ex.deltas_encode(obj)
+                        if ex is not None and hasattr(ex, "deltas_encode")
+                        else None
+                    )
+                    if blob is not None:
+                        kind = 2
+                    else:
+                        blob = pickle.dumps(
+                            list(obj), protocol=pickle.HIGHEST_PROTOCOL
+                        )
+                        kind = 1
+                if enc_cache is not None:
+                    enc_cache[id(obj)] = (kind, blob)
+            meta.append((nid, kind, len(blob)))
+            blobs.append(blob)
+        head = pickle.dumps((tag, meta), protocol=pickle.HIGHEST_PROTOCOL)
+        payload = b"".join(
+            [_V2_MAGIC, _V2_HEAD.pack(len(head)), head, *blobs]
+        )
+        self._send_payload(peer, payload)
+        return len(payload)
+
+    def _decode_exchange(self, payload: bytes):
+        """(tag, [(node_id, part), ...]) from a v2 frame; parts arrive as
+        NativeBatch (columnar) or delta lists (pickled fallback)."""
+        (hlen,) = _V2_HEAD.unpack_from(payload, 4)
+        off = 4 + _V2_HEAD.size
+        tag, meta = pickle.loads(payload[off:off + hlen])
+        off += hlen
+        ex = self._pwexec()
+        items = []
+        view = memoryview(payload)
+        for nid, kind, size in meta:
+            blob = view[off:off + size]
+            off += size
+            if kind == 0 or kind == 2:
+                if ex is None:  # no toolchain on this rank: cannot happen
+                    raise ConnectionError(
+                        f"rank {self.rank}: received a columnar exchange "
+                        "frame but the native executor is unavailable"
+                    )
+                items.append(
+                    (
+                        nid,
+                        ex.nb_decode(blob, Pointer)
+                        if kind == 0
+                        else ex.deltas_decode(blob, Pointer),
+                    )
+                )
+            else:
+                items.append((nid, pickle.loads(blob)))
+        return (tag, items)
+
+    @staticmethod
+    def _pwexec():
+        from pathway_tpu.native import get_pwexec
+
+        try:
+            return get_pwexec()
+        except Exception:
+            return None
 
     def recv(self, peer: int, tag: Any) -> Any:
         got = self._queues[peer].get()
@@ -256,6 +458,8 @@ class ProcessGroup:
                 f"rank {self.rank}: peer {peer} disconnected "
                 f"(waiting for {tag!r})"
             )
+        if isinstance(got, _MeshError):
+            raise ConnectionError(got.message)
         got_tag, obj = got
         if got_tag != tag:
             raise RuntimeError(

@@ -365,13 +365,77 @@ def _free_port_base(n: int = 4) -> int:
     raise RuntimeError("no consecutive free port range found")
 
 
+def _wordcount_2rank_once(prog: str, td: str, n_rows: int, distinct: int):
+    """One 2-rank run; returns the metric dict (or an error dict)."""
+    port = _free_port_base()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESS_ID=str(rank),
+            PATHWAY_FIRST_PORT=str(port),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, prog],
+                env=env,
+                cwd=td,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    results = []
+    try:
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                return {"metric": "wordcount_2rank_rows_per_s",
+                        "error": "timeout"}
+            if p.returncode != 0:
+                return {"metric": "wordcount_2rank_rows_per_s",
+                        "error": f"rank exited {p.returncode}",
+                        "stderr_tail": err.decode()[-400:]}
+            last = out.decode().strip().splitlines()[-1]
+            results.append(json.loads(last))
+    finally:
+        # a failed/timed-out rank must not orphan its surviving peer
+        # (it would block forever on the mesh accept for the dead rank)
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.communicate()
+    elapsed = max(r["elapsed_s"] for r in results)
+    return {
+        "metric": "wordcount_2rank_rows_per_s",
+        "value": round(n_rows / elapsed, 1),
+        "unit": "rows/s",
+        "n_rows": n_rows,
+        "distinct": distinct,
+        "processes": 2,
+        "host_cores": os.cpu_count() or 1,
+        "per_rank_elapsed_s": [round(r["elapsed_s"], 2) for r in results],
+        "output_changes_rank0": results[0]["changes"],
+    }
+
+
 def bench_wordcount_2rank(
     n_rows: int, distinct: int, batch: int, emit=_print_emit
 ) -> None:
     """PATHWAY_PROCESSES=2 wordcount over the loopback TCP mesh: each rank
-    generates its residue-class half, hash-exchange at the groupby
-    boundary, outputs gather to rank 0."""
+    generates its residue-class half, the NativeBatch stays columnar
+    through the hash exchange at the groupby boundary (exec.cpp
+    shard_partition_nb + the v2 typed-columnar frames), outputs gather to
+    rank 0. Steady-state gate like the other relational metrics: 2
+    warmup runs (mesh + native-build + allocator), 3 measured runs with
+    the 20% dispersion flag, +3 more on a breach so the recorded median
+    has real support."""
     import tempfile
+
+    from bench_util import DISPERSION_FLAG, dispersion
 
     with tempfile.TemporaryDirectory() as td:
         prog = os.path.join(td, "wc2.py")
@@ -381,69 +445,23 @@ def bench_wordcount_2rank(
                     repo=REPO, n_rows=n_rows, distinct=distinct, batch=batch
                 )
             )
-        port = _free_port_base()
-        procs = []
-        for rank in range(2):
-            env = dict(os.environ)
-            env.update(
-                PATHWAY_PROCESSES="2",
-                PATHWAY_PROCESS_ID=str(rank),
-                PATHWAY_FIRST_PORT=str(port),
-                JAX_PLATFORMS="cpu",
-                PYTHONPATH=REPO,
-            )
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, prog],
-                    env=env,
-                    cwd=td,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                )
-            )
-        results = []
-        try:
-            for p in procs:
-                try:
-                    out, err = p.communicate(timeout=600)
-                except subprocess.TimeoutExpired:
-                    emit(
-                        {"metric": "wordcount_2rank_rows_per_s",
-                         "error": "timeout"}
-                    )
-                    return
-                if p.returncode != 0:
-                    emit(
-                        {"metric": "wordcount_2rank_rows_per_s",
-                         "error": f"rank exited {p.returncode}",
-                         "stderr_tail": err.decode()[-400:]}
-                    )
-                    return
-                last = out.decode().strip().splitlines()[-1]
-                results.append(json.loads(last))
-        finally:
-            # a failed/timed-out rank must not orphan its surviving peer
-            # (it would block forever on the mesh accept for the dead rank)
-            for q in procs:
-                if q.poll() is None:
-                    q.kill()
-                    q.communicate()
-        elapsed = max(r["elapsed_s"] for r in results)
-        emit(
-            {
-                "metric": "wordcount_2rank_rows_per_s",
-                "value": round(n_rows / elapsed, 1),
-                "unit": "rows/s",
-                "n_rows": n_rows,
-                "distinct": distinct,
-                "processes": 2,
-                "host_cores": os.cpu_count() or 1,
-                "per_rank_elapsed_s": [
-                    round(r["elapsed_s"], 2) for r in results
-                ],
-                "output_changes_rank0": results[0]["changes"],
-            }
-        )
+
+        def once():
+            return _wordcount_2rank_once(prog, td, n_rows, distinct)
+
+        runs = [once() for _ in range(2 + 3)][2:]
+        bad = next((r for r in runs if "error" in r), None)
+        if bad is not None:
+            emit(bad)
+            return
+        if dispersion([r["value"] for r in runs]) > DISPERSION_FLAG:
+            extra = [once() for _ in range(3)]
+            bad = next((r for r in extra if "error" in r), None)
+            if bad is not None:
+                emit(bad)
+                return
+            runs += extra
+        emit(_median_of(runs, [r["value"] for r in runs]))
 
 
 def child(n_rows: int, distinct: int, batch: int, emit=_print_emit) -> None:

@@ -1,0 +1,561 @@
+"""Columnar exchange battery: the multi-rank analogue of the fused
+chain (ISSUE 3).
+
+Pins:
+* shard parity — exec.cpp ``shard_partition_nb`` mints the exact shard
+  ids of procgroup ``stable_shard`` (tuple keys, by-id keys, every
+  columnar dtype), so columnar and tuple routing interoperate;
+* wire codecs — nb_encode/nb_decode and deltas_encode/deltas_decode
+  round-trip bit-exactly, reject truncated frames, and fall back to
+  pickle for object cells;
+* end-to-end bit-identity — 2-rank wordcount/join/groupby results equal
+  the single-rank run on BOTH the columnar path and the
+  ``PATHWAY_NO_NB_EXCHANGE=1`` tuple path, object-column batches
+  degrade gracefully, and the comms counters show columnar batches
+  flowing and empty all-to-all legs elided;
+* mesh hygiene — the PATHWAY_MESH_MAX_FRAME_MB receiver cap turns a
+  corrupt length prefix into a clean ConnectionError.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pwexec():
+    from pathway_tpu.native import get_pwexec
+
+    return get_pwexec()
+
+
+def _free_port_base(n: int = 4) -> int:
+    for _ in range(50):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        held = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                held.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+    raise RuntimeError("no consecutive free port range found")
+
+
+# ---------------------------------------------------------------------------
+# shard parity + codecs (no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def test_stable_shard_many_matches_scalar():
+    from pathway_tpu.internals.api import Pointer
+    from pathway_tpu.parallel.procgroup import stable_shard, stable_shard_many
+
+    values = [
+        ("word",),
+        ("a", 1),
+        (None,),
+        (1.5, True),
+        Pointer(2**100 + 17),
+        ("",),
+        (-(2**63),),
+    ]
+    for world in (1, 2, 3, 7):
+        assert stable_shard_many(values, world) == [
+            stable_shard(v, world) for v in values
+        ]
+
+
+def _mixed_nb():
+    ex = _pwexec()
+    if ex is None or not hasattr(ex, "shard_partition_nb"):
+        pytest.skip("native toolchain unavailable")
+    from pathway_tpu.internals.api import Pointer
+
+    msgs = [
+        {
+            "a": f"word{i % 7}" * (1 + i % 3),
+            "b": i * 3 - 50,
+            "c": float(i) * 1.5,
+            "d": None if i % 3 else (i % 2 == 0),
+        }
+        for i in range(257)
+    ]
+    msgs.append({"a": "", "b": -(2**63), "c": -0.0, "d": False})
+    msgs.append({"a": "x" * 300, "b": 2**63 - 1, "c": 1e308, "d": None})
+    nb, _seq = ex.parse_upserts_nb(
+        msgs, 0, ("a", "b", "c", "d"), (None, None, None, None),
+        1234567890123456789012345678901234567, 0, Pointer,
+    )
+    assert nb is not None and len(nb) == len(msgs)
+    return ex, nb
+
+
+def test_shard_partition_nb_parity_with_stable_shard():
+    ex, nb = _mixed_nb()
+    from pathway_tpu.parallel.procgroup import stable_shard
+
+    mat = nb.materialize()
+    for world in (2, 3, 5):
+        for kidx in [(0,), (1, 2), (0, 1, 2, 3), (3,)]:
+            parts = ex.shard_partition_nb(nb, kidx, world)
+            assert len(parts) == world
+            expect: list[list] = [[] for _ in range(world)]
+            for k, row, d in mat:
+                pk = tuple(row[i] for i in kidx)
+                expect[stable_shard(pk, world)].append((int(k), row, d))
+            got = [
+                [(int(k), row, d) for k, row, d in p.materialize()]
+                for p in parts
+            ]
+            assert got == expect, (world, kidx)
+
+
+def test_shard_partition_nb_by_id_parity():
+    ex, nb = _mixed_nb()
+    from pathway_tpu.parallel.procgroup import stable_shard
+
+    mat = nb.materialize()
+    for world in (2, 4):
+        parts = ex.shard_partition_nb(nb, None, world)
+        expect: list[list] = [[] for _ in range(world)]
+        for k, row, d in mat:
+            expect[stable_shard(k, world)].append((int(k), d))
+        got = [
+            [(int(k), d) for k, _r, d in p.materialize()] for p in parts
+        ]
+        assert got == expect
+
+
+def test_nb_codec_roundtrip_and_truncation():
+    ex, nb = _mixed_nb()
+    from pathway_tpu.internals.api import Pointer
+
+    enc = ex.nb_encode(nb)
+    dec = ex.nb_decode(enc, Pointer)
+    assert dec.materialize() == nb.materialize()
+    # empty batch round-trips too (the elided-slice degenerate case)
+    empty = ex.shard_partition_nb(nb, (0,), 10_000)
+    empty = next(p for p in empty if len(p) == 0)
+    assert ex.nb_decode(ex.nb_encode(empty), Pointer).materialize() == []
+    for cut in (0, 4, 11, len(enc) // 2, len(enc) - 1):
+        with pytest.raises(ValueError):
+            ex.nb_decode(enc[:cut], Pointer)
+
+
+def test_nb_concat_matches_materialized_union():
+    ex, nb = _mixed_nb()
+    parts = ex.shard_partition_nb(nb, (0,), 3)
+    cat = ex.nb_concat(list(parts))
+    merged = []
+    for p in parts:
+        merged.extend(p.materialize())
+    assert cat.materialize() == merged
+    assert len(cat) == len(nb)
+
+
+def test_deltas_codec_roundtrip_and_object_fallback():
+    ex = _pwexec()
+    if ex is None or not hasattr(ex, "deltas_encode"):
+        pytest.skip("native toolchain unavailable")
+    from pathway_tpu.internals.api import Pointer
+
+    deltas = [
+        (
+            Pointer(2**100 + i),
+            (f"w{i % 5}", i - 30, 1.5 * i, None, i % 2 == 0),
+            (-1) ** i * (1 + i % 3),
+        )
+        for i in range(400)
+    ]
+    enc = ex.deltas_encode(deltas)
+    assert enc is not None
+    assert ex.deltas_decode(enc, Pointer) == deltas
+    assert ex.deltas_decode(ex.deltas_encode([]), Pointer) == []
+    # object cells -> None (the caller pickles instead)
+    assert ex.deltas_encode([(Pointer(1), ((1, 2),), 1)]) is None
+    assert ex.deltas_encode([(Pointer(1), (b"bytes",), 1)]) is None
+    with pytest.raises(ValueError):
+        ex.deltas_decode(enc[: len(enc) - 3], Pointer)
+
+
+# ---------------------------------------------------------------------------
+# mesh frame-size cap
+# ---------------------------------------------------------------------------
+
+
+def _mesh_pair(port):
+    from pathway_tpu.parallel.procgroup import ProcessGroup
+
+    holder = {}
+    errs = []
+
+    def mk1():
+        try:
+            holder[1] = ProcessGroup(1, 2, port)
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+
+    t = threading.Thread(target=mk1, daemon=True)
+    t.start()
+    holder[0] = ProcessGroup(0, 2, port)
+    t.join(30)
+    assert not errs, errs
+    return holder[0], holder[1]
+
+
+def test_frame_size_cap_raises_clean_connection_error(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MESH_MAX_FRAME_MB", "0.01")  # ~10 KB
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        pg0.send(1, "big", b"x" * 200_000)
+        with pytest.raises(ConnectionError, match="PATHWAY_MESH_MAX_FRAME_MB"):
+            pg1.recv(0, "big")
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_corrupt_length_prefix_refused(monkeypatch):
+    monkeypatch.delenv("PATHWAY_MESH_MAX_FRAME_MB", raising=False)
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        import struct
+
+        # a corrupt 2^62-byte length prefix must NOT be allocated
+        pg0._socks[1].sendall(struct.pack("<Q", 1 << 62))
+        with pytest.raises(ConnectionError, match="cap"):
+            pg1.recv(0, "never")
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_exchange_frame_roundtrip_through_mesh():
+    ex = _pwexec()
+    if ex is None or not hasattr(ex, "nb_encode"):
+        pytest.skip("native toolchain unavailable")
+    from pathway_tpu.internals.api import Pointer
+
+    _ex, nb = _mixed_nb()
+    deltas = [(Pointer(7), ("a", 1), -1), (Pointer(8), ("b", 2), 1)]
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        tag = ("xw", 42, 1)
+        pg0.send_exchange(
+            pg0.rank + 1, tag,
+            [(5, nb), (9, deltas), (11, [(Pointer(1), ((1, 2),), 1)])],
+        )
+        items = pg1.recv(0, tag)
+        assert [nid for nid, _ in items] == [5, 9, 11]
+        assert items[0][1].materialize() == nb.materialize()
+        assert items[1][1] == deltas
+        assert items[2][1] == [(Pointer(1), ((1, 2),), 1)]
+        # empty coalesced frame (pure presence header) round-trips
+        pg1.send_exchange(0, ("xw", 43, 1), [])
+        assert pg0.recv(1, ("xw", 43, 1)) == []
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: 2-rank vs single-rank bit identity
+# ---------------------------------------------------------------------------
+
+_BATTERY = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.engine.runtime import Runtime
+
+_insts = []
+_orig_init = Runtime.__init__
+def _spy_init(self, *a, **k):
+    _orig_init(self, *a, **k)
+    _insts.append(self)
+Runtime.__init__ = _spy_init
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+
+words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta"]
+N = 700
+rows = [
+    {{"data": words[(i * 7) % len(words)], "v": i}}
+    for i in range(rank, N, P)
+]
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True
+    def run(self):
+        for s in range(0, len(rows), 100):
+            self.next_batch(rows[s : s + 100])
+            self.commit()
+
+class S(pw.Schema):
+    data: str
+    v: int
+
+t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=3_600_000)
+counts = t.groupby(pw.this.data).reduce(
+    word=pw.this.data, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+rrows = [{{"j": w, "w": (i + 1) * 10}} for i, w in enumerate(words[:5])]
+class RSrc(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        self.next_batch(rrows)
+        self.commit()
+
+class R(pw.Schema):
+    j: str
+    w: int
+
+rt = pw.io.python.read(RSrc(), schema=R, autocommit_duration_ms=3_600_000)
+joined = t.join(rt, pw.left.data == pw.right.j).select(
+    d=pw.left.data, v=pw.left.v, w=pw.right.w
+)
+jagg = joined.groupby(pw.this.d).reduce(
+    d=pw.this.d, sv=pw.reducers.sum(pw.this.v),
+    sw=pw.reducers.sum(pw.this.w), c=pw.reducers.count(),
+)
+
+state = {{"counts": {{}}, "jagg": {{}}}}
+def collector(name):
+    def on_change(key, row, time_, is_add):
+        if is_add:
+            state[name][int(key)] = row
+        else:
+            state[name].pop(int(key), None)
+    return on_change
+
+pw.io.subscribe(counts, on_change=collector("counts"))
+pw.io.subscribe(jagg, on_change=collector("jagg"))
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+rt_main = _insts[0]
+xn = rt_main.scope.exchange_nodes
+st = rt_main.stats
+print(json.dumps({{
+    "rank": rank,
+    "counts": sorted([sorted(r.items()) for r in state["counts"].values()]),
+    "jagg": sorted([sorted(r.items()) for r in state["jagg"].values()]),
+    "nb_batches": sum(x._nb_batches for x in xn),
+    "tuple_fallbacks": sum(x._fallbacks for x in xn),
+    "frames": st.exchange_frames,
+    "bytes": st.exchange_bytes,
+    "elided": st.exchange_empty_elided,
+    "comms_s": st.exchange_comms_s,
+}}))
+"""
+
+_OBJECT_COLUMN = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.engine.runtime import Runtime
+
+_insts = []
+_orig_init = Runtime.__init__
+def _spy_init(self, *a, **k):
+    _orig_init(self, *a, **k)
+    _insts.append(self)
+Runtime.__init__ = _spy_init
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+# tuple-valued column: ineligible for the columnar parser AND for the
+# typed delta codec -> the exchange must take pickled tuple slices
+rows = [
+    (i, (f"k{{i % 5}}", i, ("tag", i % 3)))
+    for i in range(300)
+]
+t = pw.debug.table_from_rows(
+    pw.schema_from_types(k=str, v=int, meta=tuple), [r[1] for r in rows]
+)
+agg = t.groupby(pw.this.k).reduce(
+    k=pw.this.k, s=pw.reducers.sum(pw.this.v), c=pw.reducers.count()
+)
+state = {{}}
+def on_change(key, row, time_, is_add):
+    if is_add:
+        state[int(key)] = row
+    else:
+        state.pop(int(key), None)
+pw.io.subscribe(agg, on_change=on_change)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+rt_main = _insts[0]
+xn = rt_main.scope.exchange_nodes
+print(json.dumps({{
+    "rank": rank,
+    "agg": sorted([sorted(r.items()) for r in state.values()]),
+    "nb_batches": sum(x._nb_batches for x in xn),
+}}))
+"""
+
+
+def _spawn_ranks(program: str, workdir: str, processes: int, extra_env=None):
+    port = _free_port_base()
+    procs = []
+    for rank in range(processes):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(processes),
+            PATHWAY_PROCESS_ID=str(rank),
+            PATHWAY_FIRST_PORT=str(port),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, program],
+                env=env,
+                cwd=workdir,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, (
+                f"rank failed rc={p.returncode}\nstderr:{err.decode()[-2000:]}"
+            )
+            outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    finally:
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+                q.communicate()
+    return outs
+
+
+def _run_battery(tmpdir, processes, extra_env=None, program=_BATTERY):
+    prog = os.path.join(tmpdir, f"prog_{processes}_{len(extra_env or {})}.py")
+    with open(prog, "w") as f:
+        f.write(program.format(repo=REPO))
+    return _spawn_ranks(prog, tmpdir, processes, extra_env)
+
+
+@pytest.fixture(scope="module")
+def battery_results():
+    """One single-rank ground-truth run + the 2-rank columnar and
+    forced-tuple runs, shared across the assertions below."""
+    with tempfile.TemporaryDirectory() as td:
+        single = _run_battery(td, 1)[0]
+        columnar = _run_battery(td, 2)
+        no_nb = _run_battery(td, 2, {"PATHWAY_NO_NB_EXCHANGE": "1"})
+        yield single, columnar, no_nb
+
+
+def test_two_rank_columnar_bit_identical(battery_results):
+    single, columnar, _no_nb = battery_results
+    rank0 = next(r for r in columnar if r["rank"] == 0)
+    assert rank0["counts"] == single["counts"]
+    assert rank0["jagg"] == single["jagg"]
+
+
+def test_two_rank_columnar_path_actually_columnar(battery_results):
+    _single, columnar, _no_nb = battery_results
+    # source batches are NB-parsed, so hash boundaries must run columnar
+    assert sum(r["nb_batches"] for r in columnar) > 0
+    assert all(r["frames"] > 0 and r["bytes"] > 0 for r in columnar)
+
+
+def test_two_rank_empty_all_to_alls_elided(battery_results):
+    _single, columnar, _no_nb = battery_results
+    # pure-gather waves + contributor masks: every run elides legs
+    assert sum(r["elided"] for r in columnar) > 0
+    assert all(r["comms_s"] > 0 for r in columnar)
+
+
+def test_two_rank_no_nb_env_parity(battery_results):
+    single, _columnar, no_nb = battery_results
+    rank0 = next(r for r in no_nb if r["rank"] == 0)
+    assert rank0["counts"] == single["counts"]
+    assert rank0["jagg"] == single["jagg"]
+    # the env var must force the tuple path end-to-end
+    assert all(r["nb_batches"] == 0 for r in no_nb)
+    assert sum(r["tuple_fallbacks"] for r in no_nb) > 0
+
+
+def test_two_rank_object_column_fallback():
+    with tempfile.TemporaryDirectory() as td:
+        single = _run_battery(td, 1, program=_OBJECT_COLUMN)[0]
+        two = _run_battery(td, 2, program=_OBJECT_COLUMN)
+        rank0 = next(r for r in two if r["rank"] == 0)
+        assert rank0["agg"] == single["agg"]
+        # tuple-valued rows can never ride the columnar path
+        assert all(r["nb_batches"] == 0 for r in two)
+
+
+_SMOKE = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+rows = [{{"data": f"w{{i % 3}}"}} for i in range(rank, 90, P)]
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True
+    def run(self):
+        self.next_batch(rows)
+        self.commit()
+
+class S(pw.Schema):
+    data: str
+
+t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=3_600_000)
+counts = t.groupby(pw.this.data).reduce(
+    word=pw.this.data, c=pw.reducers.count()
+)
+state = {{}}
+def on_change(key, row, time_, is_add):
+    if is_add:
+        state[int(key)] = row
+    else:
+        state.pop(int(key), None)
+pw.io.subscribe(counts, on_change=on_change)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+print(json.dumps({{"rank": rank,
+                  "counts": sorted((r["word"], r["c"]) for r in state.values())}}))
+"""
+
+
+def test_exchange_smoke_2rank():
+    """Real 2-process columnar exchange smoke (ci_lanes.sh lane 2 runs
+    exactly this test after the emulated-lane battery)."""
+    with tempfile.TemporaryDirectory() as td:
+        prog = os.path.join(td, "smoke.py")
+        with open(prog, "w") as f:
+            f.write(_SMOKE.format(repo=REPO))
+        outs = _spawn_ranks(prog, td, 2)
+        rank0 = next(r for r in outs if r["rank"] == 0)
+        assert rank0["counts"] == [["w0", 30], ["w1", 30], ["w2", 30]]
