@@ -555,10 +555,11 @@ class ExchangeNode(Node):
                 )
             )
         parts = []
+        dl = pg.op_deadline()  # one deadline for the whole rendezvous
         for peer in range(pg.world):
             if peer == pg.rank or (gather and pg.rank != 0):
                 continue
-            for _nid, part in pg.recv(peer, tag):
+            for _nid, part in pg.recv(peer, tag, deadline=dl):
                 parts.append(part)
         return self.finish_exchange(own, parts)
 

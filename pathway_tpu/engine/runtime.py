@@ -136,6 +136,13 @@ class Runtime:
                 # full multi-host connect window
                 timeout=15.0 if self._lane_emulated else 60.0,
             )
+            # mesh health lands on this rank's OpenMetrics endpoint
+            # (heartbeat misses are counted by procgroup's own threads)
+            self._procgroup.stats = self.stats
+            if self._procgroup.epoch > 0:
+                # this incarnation exists because a supervisor rolled the
+                # mesh back: count the restart on the recovery path
+                self.stats.on_mesh_rank_restart()
         return self._procgroup
 
     def _exchange_reach_masks(self) -> list[int]:
@@ -433,6 +440,10 @@ class Runtime:
             own, sends = node._slice(batches[0])
             prepared.append((nid, own, sends))
         tag = ("xw", time, seq)
+        # kill slot: rank dies with its slices prepared but its wave
+        # frames not (fully) shipped — peers must detect the loss and
+        # abort the epoch instead of deadlocking in their wave recvs
+        _faults.fault_point("mesh.rank_kill", phase="wave_send")
         # gather-mode nodes route to rank 0 only, so for a pure-gather
         # wave the sender set is static: non-zero ranks never receive and
         # rank 0 never sends — those all-to-all legs are elided entirely
@@ -463,6 +474,7 @@ class Runtime:
                 pg.send_exchange(peer, tag, entries, enc_cache)
             )
         received: dict[int, list] = {nid: [] for nid, _o, _s in prepared}
+        wave_dl = pg.op_deadline()  # one deadline for the whole wave
         for peer in range(pg.world):
             if peer == pg.rank:
                 continue
@@ -470,7 +482,7 @@ class Runtime:
                 contrib is not None and not (contrib >> peer) & 1
             ):
                 continue
-            for nid, part in pg.recv(peer, tag):
+            for nid, part in pg.recv(peer, tag, deadline=wave_dl):
                 if nid not in received:
                     raise RuntimeError(
                         f"rank {pg.rank}: exchange wave desync — peer "
@@ -568,13 +580,75 @@ class Runtime:
                 self._run_streaming_distributed()
                 return
             self._run_streaming()
-        except BaseException:
+        except BaseException as exc:
             # a failing rank must not leave peers blocked in a collective:
             # closing the mesh surfaces ConnectionError everywhere
-            if self._procgroup is not None:
-                self._procgroup.close()
+            pg = self._procgroup
+            if pg is not None:
+                # epoch abort: in-flight frames of the dead epoch are
+                # drained and discarded — never delivered to the engine —
+                # before the links come down. No goodbye frame: this rank
+                # is dying of an exception, and peers must classify the
+                # loss as a failure, not a clean shutdown.
+                try:
+                    pg.drain()
+                except Exception:
+                    pass
+                pg.close(goodbye=False)
                 self._procgroup = None
+            if self._is_mesh_error(exc):
+                # mesh_rollbacks_total counts epoch aborts this rank
+                # initiated after detecting a mesh failure — incremented
+                # here (not only in the supervised exit path) so
+                # embedded/unsupervised runs whose stats object outlives
+                # the abort still observe it
+                self.stats.on_mesh_rollback()
+                self._maybe_exit_for_rollback(exc)
             raise
+
+    @staticmethod
+    def _is_mesh_error(exc: BaseException) -> bool:
+        """The single classification of mesh-originated failures (peer
+        crashed, timed out, or went away) — shared by the rollback
+        counter and the supervised-exit decision so the two can never
+        desynchronize."""
+        from pathway_tpu.parallel.procgroup import (
+            MeshPeerFailure,
+            MeshPeerGone,
+            MeshTimeout,
+        )
+
+        return isinstance(exc, (MeshPeerFailure, MeshPeerGone, MeshTimeout))
+
+    def _maybe_exit_for_rollback(self, exc: BaseException) -> None:
+        """Supervised-mesh epoch abort epilogue (caller has already
+        classified ``exc`` as mesh-originated via ``_is_mesh_error``):
+        when a mesh supervisor owns this rank (PATHWAY_MESH_SUPERVISED),
+        exit with MESH_RESTART_EXIT_CODE so the supervisor rolls the
+        whole rank set back to the last committed snapshot at epoch+1.
+        Non-mesh failures (program bugs, connector failures under
+        terminate_on_error) never reach here and propagate normally —
+        the supervisor still restarts on the nonzero exit, but the
+        traceback and code tell the two apart. Never fires in the
+        emulated-rank lane: those "ranks" are threads of the test
+        process, and os._exit would kill the host."""
+        import os as _os
+
+        if self._lane_emulated or not _os.environ.get(
+            "PATHWAY_MESH_SUPERVISED"
+        ):
+            return
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "mesh failure detected; aborting the epoch and requesting a "
+            "rollback restart: %s", exc
+        )
+        from pathway_tpu.io._connector import close_subjects_for_rollback
+        from pathway_tpu.parallel.supervisor import MESH_RESTART_EXIT_CODE
+
+        close_subjects_for_rollback(self.connectors)
+        _os._exit(MESH_RESTART_EXIT_CODE)
 
     def _start_monitoring(self, printer: bool = True) -> None:
         if self.with_http_server:
@@ -955,8 +1029,16 @@ class Runtime:
             # at 1, so new tags build on the restored one — pruning and
             # marker ordering remain correct over kill/restart cycles
             self._snap_tag_base = tag
+            # the restored tag is a committed cut other ranks may still be
+            # reading: the next save's prune must retain it (two-tag
+            # retention window)
+            self._snap_prev_tag = tag
         if tag is None:
             return
+        # kill slot: rank dies mid-restore, after the marker tag was
+        # agreed — peers abort, and the NEXT rollback must still find
+        # every rank's snapshot at this tag intact
+        _faults.fault_point("mesh.rank_kill", phase="restore")
         snap = self.persistence.load_operator_snapshot(
             key=f"operator_snapshot/r{pg.rank}/{tag}"
         )
@@ -981,6 +1063,8 @@ class Runtime:
         self._operator_subject_states.update(subject_states)
         for conn in live:
             self._restore_conn_state(conn, subject_states.get(conn.name))
+        # the committed cut this epoch resumed from (OpenMetrics gauge)
+        self.stats.on_mesh_epoch_committed(pg.epoch)
 
     def _save_operator_snapshot_distributed(self, pg, round_no) -> None:
         """Two-phase consistent cut: every rank writes its rank-local
@@ -994,20 +1078,27 @@ class Runtime:
             [node.name() for node in self.scope.nodes],
             key=f"operator_snapshot/r{pg.rank}/{tag}",
         )
+        # kill slot: rank-local snapshot durable, commit marker not yet
+        # moved — the cut must NOT count as committed, and recovery must
+        # roll back to the previous marker tag
+        _faults.fault_point("mesh.rank_kill", phase="post_snapshot")
         pg.gather0(("snapack", tag), True)
         if pg.rank == 0:
             self.persistence.write_marker("snapshot_commit", tag)
         pg.barrier(("snapbar", tag))
-        # prune every superseded snapshot for this rank (best-effort);
-        # "everything except the just-committed tag" also reclaims stale
-        # higher-numbered tags stranded by earlier runs
-        prefix = f"operator_snapshot/r{pg.rank}/"
-        for key in self.persistence.list_keys(prefix):
-            try:
-                if int(key[len(prefix):].split("/")[0]) != tag:
-                    self.persistence.delete_key(key)
-            except ValueError:
-                pass
+        self.stats.on_mesh_epoch_committed(pg.epoch)
+        # prune superseded snapshots for this rank (best-effort), but
+        # retain the LAST TWO committed tags: a peer crashing between its
+        # restore-read of the marker and this prune must still find the
+        # snapshot it was loading on the next rollback. Stale
+        # higher-numbered tags stranded by earlier crashed runs are
+        # reclaimed as a side effect (they are in no keep set).
+        prev = getattr(self, "_snap_prev_tag", None)
+        keep = {tag} if prev is None else {tag, prev}
+        self.persistence.prune_operator_snapshots(
+            f"operator_snapshot/r{pg.rank}/", keep
+        )
+        self._snap_prev_tag = tag
 
     def _run_streaming_distributed(self) -> None:
         """Round-based BSP ingest for PATHWAY_PROCESSES>1 (reference: the

@@ -14,6 +14,18 @@ Injection points threaded through the hot paths:
     persistence.checkpoint          before an operator snapshot / subject
                                     state write
     runtime.step                    per engine timestamp step
+    mesh.send                       per mesh frame sent (procgroup.py
+                                    send/send_exchange)
+    mesh.recv                       per mesh recv (collectives included)
+    mesh.rank_kill                  phase-tagged kill slots on the
+                                    distributed recovery path: the runtime
+                                    hits it with ``phase=`` context at
+                                    ``wave_send`` (before an exchange
+                                    wave's frames ship), ``post_snapshot``
+                                    (rank-local snapshot written, commit
+                                    marker not yet moved) and ``restore``
+                                    (distributed snapshot restore after
+                                    the marker tag is agreed)
 
 A *plan* is a schedule of rules. Each rule names a point, when it fires —
 explicit 1-based ``hits``, a modular ``every``, or a seeded probability
@@ -28,6 +40,14 @@ given the program's emit/commit order — with the one caveat that
 hit plans against it are only fully deterministic when autocommit is
 disabled (``autocommit_duration_ms=None``); the other points count only
 program-ordered events.
+
+Multi-rank schedules: a rule may carry ``"phase"`` (matches only hits
+whose call-site context has that phase, counted on a per-(point, phase)
+counter so kill-phase schedules stay deterministic regardless of how
+phases interleave) and ``"rank"`` (fires only in the process whose
+``pathway_config.process_id`` matches — one shared ``PATHWAY_FAULT_PLAN``
+can then name its victim rank, which is how the mesh supervisor smoke
+kills exactly one rank of a supervised run).
 
 Plans come from the ``PATHWAY_FAULT_PLAN`` env var (inline JSON, or a
 path to a JSON file) or programmatically via
@@ -57,6 +77,9 @@ POINTS = (
     "persistence.journal_write.post",
     "persistence.checkpoint",
     "runtime.step",
+    "mesh.send",
+    "mesh.recv",
+    "mesh.rank_kill",
 )
 
 _ACTIONS = ("raise", "crash")
@@ -76,7 +99,7 @@ class InjectedFault(RuntimeError):
 class FaultRule:
     __slots__ = (
         "point", "hits", "every", "prob", "action", "retryable",
-        "max_fires", "fired", "exit_code", "_rng",
+        "max_fires", "fired", "exit_code", "phase", "rank", "_rng",
     )
 
     def __init__(
@@ -89,6 +112,8 @@ class FaultRule:
         retryable: bool = True,
         max_fires: int | None = None,
         exit_code: int = CRASH_EXIT_CODE,
+        phase: str | None = None,
+        rank: int | None = None,
     ):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; use {_ACTIONS}")
@@ -109,7 +134,25 @@ class FaultRule:
         self.max_fires = max_fires
         self.fired = 0
         self.exit_code = exit_code
+        # phase-scoped rules count hits on the (point, phase) counter so a
+        # "second wave_send" schedule replays identically no matter how
+        # other phases of the same point interleave with it
+        self.phase = phase
+        self.rank = rank
         self._rng: random.Random | None = None  # bound by the plan
+
+    def applies(self, context: dict | None) -> bool:
+        """Context filters that gate whether a hit is even considered:
+        call-site phase and the firing process's mesh rank."""
+        if self.phase is not None:
+            if context is None or context.get("phase") != self.phase:
+                return False
+        if self.rank is not None:
+            from pathway_tpu.internals.config import get_pathway_config
+
+            if get_pathway_config().process_id != self.rank:
+                return False
+        return True
 
     def matches(self, hit: int) -> bool:
         if self.max_fires is not None and self.fired >= self.max_fires:
@@ -147,15 +190,27 @@ class FaultPlan:
             spec = json.loads(spec)
         return cls(spec.get("rules", []), seed=int(spec.get("seed", 0)))
 
-    def on_hit(self, point: str):
-        """Count a hit at `point`; return (rule, hit) if a rule fires."""
+    def on_hit(self, point: str, context: dict | None = None):
+        """Count a hit at `point`; return (rule, hit) if a rule fires.
+        Hits with a ``phase`` in their context are additionally counted on
+        a per-(point, phase) counter — phase-scoped rules match against
+        THAT counter, so their schedules are deterministic per phase."""
         with self._lock:
             hit = self._counts.get(point, 0) + 1
             self._counts[point] = hit
+            phase_hit = None
+            phase = context.get("phase") if context else None
+            if phase is not None:
+                pkey = f"{point}#{phase}"
+                phase_hit = self._counts.get(pkey, 0) + 1
+                self._counts[pkey] = phase_hit
             for rule in self.rules:
-                if rule.point == point and rule.matches(hit):
+                if rule.point != point or not rule.applies(context):
+                    continue
+                h = phase_hit if rule.phase is not None else hit
+                if h is not None and rule.matches(h):
                     rule.fired += 1
-                    return rule, hit
+                    return rule, h
         return None
 
     def hit_counts(self) -> dict[str, int]:
@@ -204,13 +259,14 @@ def active_plan() -> FaultPlan | None:
 
 def fault_point(point: str, **context: Any) -> None:
     """Hot-path hook. No-op without an active plan; otherwise counts the
-    hit and executes the first matching rule's action."""
+    hit and executes the first matching rule's action. Context keys the
+    rules understand: ``phase`` (kill-phase schedules)."""
     if _active is None and _env_checked:
         return
     plan = active_plan()
     if plan is None:
         return
-    fired = plan.on_hit(point)
+    fired = plan.on_hit(point, context or None)
     if fired is None:
         return
     rule, hit = fired

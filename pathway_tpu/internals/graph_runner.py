@@ -287,12 +287,14 @@ class GraphRunner:
             pop_config_overlay(token)
             if rank0_exc is not None:
                 # unblock companions stuck in collectives or mesh setup:
-                # closing their sockets surfaces ConnectionError there
+                # closing their sockets surfaces ConnectionError there.
+                # Failure-path close: no goodbye frame, so companions
+                # classify the loss as a crash, not a clean shutdown
                 for rt in companion_rts:
                     pg = getattr(rt, "_procgroup", None)
                     if pg is not None:
                         try:
-                            pg.close()
+                            pg.close(goodbye=False)
                         except Exception:
                             pass
             for t in threads:

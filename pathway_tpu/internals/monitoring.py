@@ -66,6 +66,28 @@ class ProberStats:
     exchange_fallbacks: int = 0
     exchange_comms_s: float = 0.0
     exchange_compute_s: float = 0.0
+    # mesh fault tolerance (procgroup detection layer + runtime recovery
+    # path): heartbeat windows a peer missed, post-recovery incarnations
+    # of this rank (epoch > 0 at mesh join), epoch aborts this rank
+    # initiated after detecting a peer failure, and the recovery epoch at
+    # which the newest distributed snapshot cut was committed/restored
+    # (gauge; -1 = never)
+    mesh_heartbeats_missed: int = 0
+    mesh_rank_restarts: int = 0
+    mesh_rollbacks: int = 0
+    mesh_last_committed_epoch: int = -1
+
+    def on_mesh_heartbeat_missed(self, n: int = 1) -> None:
+        self.mesh_heartbeats_missed += n
+
+    def on_mesh_rank_restart(self) -> None:
+        self.mesh_rank_restarts += 1
+
+    def on_mesh_rollback(self) -> None:
+        self.mesh_rollbacks += 1
+
+    def on_mesh_epoch_committed(self, epoch: int) -> None:
+        self.mesh_last_committed_epoch = epoch
 
     def on_exchange_frame(self, nbytes: int) -> None:
         self.exchange_frames += 1
@@ -170,6 +192,17 @@ class ProberStats:
         ):
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} {val:.6f}")
+        for metric, val in (
+            ("mesh_heartbeats_missed_total", self.mesh_heartbeats_missed),
+            ("mesh_rank_restarts_total", self.mesh_rank_restarts),
+            ("mesh_rollbacks_total", self.mesh_rollbacks),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {val}")
+        lines.append("# TYPE mesh_last_committed_epoch gauge")
+        lines.append(
+            f"mesh_last_committed_epoch {self.mesh_last_committed_epoch}"
+        )
         return "\n".join(lines) + "\n"
 
     def render_text(self) -> str:

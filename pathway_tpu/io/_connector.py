@@ -45,6 +45,17 @@ connector demotes to finished and the failure lands in the global
 error-log table. The runtime's watchdog (``_watchdog_timeout_s`` on the
 subject or ``heartbeat_timeout_s`` on the policy) detects stalled — not
 crashed — subjects from the heartbeat every emit/flush refreshes.
+
+Mesh rollback interplay (engine/runtime.py supervised abort path): when
+a multi-rank run detects a peer crash and this rank exits to request a
+rollback restart, subjects are NOT rewound in place — they are arbitrary
+user code blocked in ``run()``. Instead the whole rank set restarts at
+the next mesh epoch and the normal startup restore path seeks every
+subject to the scan state saved in the last committed distributed
+snapshot (exactly the rollback target PR 2's in-place restart uses).
+:func:`close_subjects_for_rollback` gives subjects holding external
+resources (consumers, file locks) one bounded ``on_stop()`` chance
+before the process exits — a courtesy a hard crash does not extend.
 """
 
 from __future__ import annotations
@@ -125,6 +136,32 @@ class SupervisorPolicy:
 def _runtime_of(conn):
     runtime = getattr(getattr(conn, "node", None), "scope", None)
     return getattr(runtime, "runtime", None)
+
+
+def close_subjects_for_rollback(conns, deadline_s: float = 1.0) -> None:
+    """Best-effort ``subject.on_stop()`` fan-out before a mesh rollback
+    exit. Each on_stop runs on its own daemon thread (a subject wedged in
+    teardown must not stall the rollback) and the TOTAL wait is bounded
+    by ``deadline_s`` — stragglers are simply abandoned to the process
+    exit, exactly as a hard crash would."""
+    threads: list[threading.Thread] = []
+    for conn in conns:
+        on_stop = getattr(getattr(conn, "subject", None), "on_stop", None)
+        if on_stop is None or getattr(conn, "finished", False):
+            continue
+
+        def _stop(fn=on_stop):
+            try:
+                fn()
+            except Exception:
+                pass  # the rank is exiting; failures here are moot
+
+        t = threading.Thread(target=_stop, daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = _time.monotonic() + deadline_s
+    for t in threads:
+        t.join(max(0.0, deadline - _time.monotonic()))
 
 
 def _report_permanent(conn, failure: Exception) -> None:

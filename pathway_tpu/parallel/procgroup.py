@@ -41,6 +41,33 @@ handshake cannot be replayed. Binding a non-loopback interface without
 an explicitly configured PATHWAY_MESH_SECRET is refused outright:
 frames are pickle, so mesh access is code execution, and a default
 key on an open port would hand that to any network peer.
+
+Fault tolerance (the detection layer of the mesh rollback-recovery
+model; engine/runtime.py owns the abort path and
+parallel/supervisor.py the respawn):
+
+* every mesh carries a recovery **epoch** (``PATHWAY_MESH_EPOCH``,
+  bumped by the supervisor on every rollback restart) that is bound
+  into the handshake hello AND its MAC — a rank surviving from a dead
+  epoch can neither join nor be joined by the recovered mesh, so
+  in-flight state of the dead epoch can never leak across a rollback;
+* a **heartbeat** thread sends a tiny ``PWHB`` frame to every peer each
+  ``PATHWAY_MESH_HEARTBEAT_S`` (default 2, 0 = off) and every received
+  byte refreshes the peer's liveness clock; a ``recv`` that waits past
+  ``PATHWAY_MESH_PEER_TIMEOUT_S`` (default 10) without any life sign
+  raises :class:`MeshPeerFailure` — crash detection that does not wait
+  for the full collective deadline on lossy/multi-host paths;
+* every collective (``recv``/``gather0``/``bcast0``/``all_to_all``/
+  ``barrier``) observes a hard deadline ``PATHWAY_MESH_OP_TIMEOUT_S``
+  (default 300, 0 = off) and raises :class:`MeshTimeout` naming the
+  peer rank and the pending tag — a logically hung peer (alive but
+  deadlocked) cannot block the mesh forever;
+* ``close()`` ships an orderly-goodbye ``PWBY`` frame first, so a peer
+  that finds the connection gone can distinguish clean shutdown
+  (:class:`MeshPeerGone`) from a crash (:class:`MeshPeerFailure`).
+
+All three error types subclass ConnectionError, which pre-existing
+callers already treat as "the mesh is dead".
 """
 
 from __future__ import annotations
@@ -51,10 +78,12 @@ import pickle
 import socket
 import struct
 import threading
+import time as _time
 import queue
 from typing import Any
 
 from pathway_tpu.internals.api import Pointer, _value_to_bytes
+from pathway_tpu.internals import faults as _faults
 from pathway_tpu.engine.stream import freeze_value, is_native_batch
 
 _LEN = struct.Struct("<Q")
@@ -63,6 +92,32 @@ _LEN = struct.Struct("<Q")
 # start with 0x80, so the magic can never collide with a v1 frame.
 _V2_MAGIC = b"PWX2"
 _V2_HEAD = struct.Struct("<I")
+# control frames of the fault-tolerance layer: 4-byte payloads that the
+# receiver consumes without queueing (neither collides with pickle's
+# 0x80 first byte nor with PWX2)
+_HB_MAGIC = b"PWHB"  # heartbeat: refreshes the peer's liveness clock
+_BYE_MAGIC = b"PWBY"  # orderly goodbye: the peer is shutting down cleanly
+
+
+class MeshTimeout(ConnectionError):
+    """A collective exceeded PATHWAY_MESH_OP_TIMEOUT_S."""
+
+
+class MeshPeerFailure(ConnectionError):
+    """A peer crashed: connection lost (or liveness window exceeded)
+    without an orderly goodbye."""
+
+
+class MeshPeerGone(ConnectionError):
+    """A peer shut down in an orderly fashion (goodbye frame seen) while
+    this rank still expected traffic from it."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _max_frame_bytes() -> int:
@@ -130,9 +185,32 @@ class ProcessGroup:
         first_port: int,
         hosts: list[str] | None = None,
         timeout: float = 60.0,
+        epoch: int | None = None,
     ):
         self.rank = rank
         self.world = world
+        # recovery epoch: the supervisor bumps PATHWAY_MESH_EPOCH on every
+        # rollback restart; the handshake binds it, so a straggler rank
+        # from the dead epoch is rejected instead of poisoning the
+        # recovered mesh with pre-rollback frames
+        if epoch is None:
+            try:
+                epoch = int(os.environ.get("PATHWAY_MESH_EPOCH", "0") or 0)
+            except ValueError:
+                epoch = 0
+        self.epoch = epoch
+        self._op_timeout = _env_float("PATHWAY_MESH_OP_TIMEOUT_S", 300.0)
+        self._hb_interval = _env_float("PATHWAY_MESH_HEARTBEAT_S", 2.0)
+        self._peer_timeout = _env_float("PATHWAY_MESH_PEER_TIMEOUT_S", 10.0)
+        # liveness clocks: monotonic() of the last byte seen from a peer
+        # (heartbeats, data, anything); plain dict stores are GIL-atomic
+        self._last_seen: dict[int, float] = {}
+        self._goodbye: set[int] = set()
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
+        # the runtime attaches its ProberStats here so heartbeat misses
+        # land on the OpenMetrics endpoint; None outside engine runs
+        self.stats = None
         if hosts is None:
             env = os.environ.get("PATHWAY_HOSTS", "")
             hosts = (
@@ -173,12 +251,13 @@ class ProcessGroup:
         self._listener.listen(world)
         self._connect_mesh(first_port, timeout)
 
-    @staticmethod
-    def _mac(role: bytes, nonces: bytes, prover: int, verifier: int) -> bytes:
+    def _mac(self, role: bytes, nonces: bytes, prover: int, verifier: int) -> bytes:
         """Keyed MAC for one direction of the handshake. Binds BOTH fresh
         nonces plus both rank ids (so a transcript cannot be replayed into
-        another session or reflected back at its sender) under
-        PATHWAY_MESH_SECRET. Frames are pickle, so no un-authenticated byte
+        another session or reflected back at its sender) AND the recovery
+        epoch (so a rank surviving from a rolled-back epoch cannot
+        authenticate into the recovered mesh) under PATHWAY_MESH_SECRET.
+        Frames are pickle, so no un-authenticated byte
         may reach pickle.loads — both directions must verify before any
         frame is read. The connecting side proves knowledge of the secret
         FIRST: the listener never emits keyed output to an unauthenticated
@@ -191,6 +270,7 @@ class ProcessGroup:
         secret = os.environ.get("PATHWAY_MESH_SECRET", "").encode()
         return hashlib.blake2b(
             role
+            + self.epoch.to_bytes(8, "little")
             + nonces
             + prover.to_bytes(8, "little")
             + verifier.to_bytes(8, "little"),
@@ -199,8 +279,6 @@ class ProcessGroup:
         ).digest()
 
     def _connect_mesh(self, first_port: int, timeout: float) -> None:
-        import time as _t
-
         expected_accepts = self.world - 1 - self.rank
         accepted: dict[int, socket.socket] = {}
 
@@ -212,8 +290,17 @@ class ProcessGroup:
                 try:
                     s.settimeout(10)
                     peer = int(_LEN.unpack(_recv_exact(s, _LEN.size))[0])
+                    peer_epoch = int(
+                        _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+                    )
                     nonce_c = _recv_exact(s, 16)
                     if peer <= self.rank or peer >= self.world:
+                        raise EOFError
+                    if peer_epoch != self.epoch:
+                        # a straggler from a rolled-back epoch (or a rank
+                        # that missed the bump): refuse before any keyed
+                        # output — its MAC would fail anyway (the epoch is
+                        # bound into the MAC input)
                         raise EOFError
                     nonce_s = os.urandom(16)
                     s.sendall(nonce_s)  # challenge only — no keyed output yet
@@ -237,7 +324,7 @@ class ProcessGroup:
         at.start()
         # connect to all lower ranks, retrying while they come up
         for peer in range(self.rank):
-            deadline = _t.monotonic() + timeout
+            deadline = _time.monotonic() + timeout
             while True:
                 try:
                     s = socket.create_connection(
@@ -245,24 +332,39 @@ class ProcessGroup:
                     )
                     break
                 except OSError:
-                    if _t.monotonic() > deadline:
+                    if _time.monotonic() > deadline:
                         raise TimeoutError(
                             f"rank {self.rank}: cannot reach rank {peer}"
                         )
-                    _t.sleep(0.05)
+                    _time.sleep(0.05)
             nonce_c = os.urandom(16)
             s.settimeout(10)
-            s.sendall(_LEN.pack(self.rank) + nonce_c)
-            nonce_s = _recv_exact(s, 16)
-            s.sendall(self._mac(b"C", nonce_c + nonce_s, self.rank, peer))
-            mac_s = _recv_exact(s, 16)
+            try:
+                s.sendall(
+                    _LEN.pack(self.rank)
+                    + _LEN.pack(self.epoch)
+                    + nonce_c
+                )
+                nonce_s = _recv_exact(s, 16)
+                s.sendall(
+                    self._mac(b"C", nonce_c + nonce_s, self.rank, peer)
+                )
+                mac_s = _recv_exact(s, 16)
+            except (EOFError, OSError) as exc:
+                s.close()
+                raise ConnectionError(
+                    f"rank {self.rank}: rank {peer} rejected the mesh "
+                    "handshake (PATHWAY_MESH_SECRET or PATHWAY_MESH_EPOCH "
+                    f"mismatch? ours is epoch {self.epoch}): {exc!r}"
+                ) from exc
             if not _hmac.compare_digest(
                 mac_s, self._mac(b"S", nonce_c + nonce_s, peer, self.rank)
             ):
                 s.close()
                 raise ConnectionError(
                     f"rank {self.rank}: rank {peer} failed mesh "
-                    "authentication (PATHWAY_MESH_SECRET mismatch?)"
+                    "authentication (PATHWAY_MESH_SECRET or "
+                    "PATHWAY_MESH_EPOCH mismatch?)"
                 )
             s.settimeout(None)
             self._socks[peer] = s
@@ -284,18 +386,65 @@ class ProcessGroup:
                 except OSError:
                     pass
             self._send_locks[peer] = threading.Lock()
+            self._last_seen[peer] = _time.monotonic()
             t = threading.Thread(
                 target=self._recv_loop, args=(peer, s), daemon=True
             )
             t.start()
             self._recv_threads.append(t)
+        if self._hb_interval > 0 and self.world > 1:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True
+            )
+            self._hb_thread.start()
+
+    def _hb_loop(self) -> None:
+        """Ship a PWHB frame to every peer each interval and account
+        missed beats: a peer silent past 1.5 intervals scores one miss
+        per further interval (OpenMetrics mesh_heartbeats_missed_total).
+        Heartbeat SENDS skip peers whose send lock is busy — an in-flight
+        data frame is itself proof of OUR liveness, and blocking behind a
+        multi-GB send would make heartbeats lie about theirs."""
+        payload = _LEN.pack(len(_HB_MAGIC)) + _HB_MAGIC
+        while not self._hb_stop.wait(self._hb_interval):
+            if self._closed:
+                return
+            now = None
+            for peer, s in list(self._socks.items()):
+                # miss accounting FIRST, independent of the send: whether
+                # the PEER is beating has nothing to do with our own send
+                # lock being busy streaming a large frame to it
+                stats = self.stats
+                if stats is not None and peer not in self._goodbye:
+                    now = _time.monotonic() if now is None else now
+                    seen = self._last_seen.get(peer, now)
+                    if now - seen > 1.5 * self._hb_interval:
+                        stats.on_mesh_heartbeat_missed()
+                lock = self._send_locks.get(peer)
+                if lock is None or not lock.acquire(blocking=False):
+                    continue
+                try:
+                    s.sendall(payload)
+                except OSError:
+                    pass  # the receiver path surfaces the death
+                finally:
+                    lock.release()
 
     def _recv_loop(self, peer: int, s: socket.socket) -> None:
         q = self._queues[peer]
         cap = self._max_frame
+        last_seen = self._last_seen
+
+        def alive() -> None:
+            # refreshed per received CHUNK, not per frame: a peer mid-way
+            # through streaming a huge frame is demonstrably alive even
+            # though no frame has completed (and its send lock may be
+            # starving its heartbeats)
+            last_seen[peer] = _time.monotonic()
+
         try:
             while True:
-                head = _recv_exact(s, _LEN.size)
+                head = _recv_exact(s, _LEN.size, on_bytes=alive)
                 (n,) = _LEN.unpack(head)
                 if n > cap:
                     # corrupt (or hostile) length prefix: refuse the
@@ -313,7 +462,14 @@ class ProcessGroup:
                     except OSError:
                         pass
                     return
-                payload = _recv_exact(s, n)
+                payload = _recv_exact(s, n, on_bytes=alive)
+                if payload == _HB_MAGIC:
+                    continue  # liveness already refreshed; nothing queues
+                if payload == _BYE_MAGIC:
+                    # orderly shutdown announced: the EOF that follows is
+                    # a clean goodbye, not a crash
+                    self._goodbye.add(peer)
+                    continue
                 try:
                     if payload[:4] == _V2_MAGIC:
                         # exchange v2: decode typed columnar buffers HERE,
@@ -345,10 +501,21 @@ class ProcessGroup:
 
     # -- primitives -------------------------------------------------------
     def _send_payload(self, peer: int, payload: bytes) -> None:
-        with self._send_locks[peer]:
-            self._socks[peer].sendall(_LEN.pack(len(payload)) + payload)
+        try:
+            with self._send_locks[peer]:
+                self._socks[peer].sendall(
+                    _LEN.pack(len(payload)) + payload
+                )
+        except OSError as exc:
+            # a send into a crashed peer (EPIPE/RST) is a detection event,
+            # not an anonymous socket error
+            raise MeshPeerFailure(
+                f"rank {self.rank}: send to peer {peer} failed "
+                f"({exc!r}) — peer crashed or unreachable"
+            ) from exc
 
     def send(self, peer: int, tag: Any, obj: Any) -> None:
+        _faults.fault_point("mesh.send")
         # serialize OUTSIDE the per-peer lock: pickling a large fallback
         # frame must not serialize concurrent senders to the same peer
         payload = pickle.dumps((tag, obj), protocol=pickle.HIGHEST_PROTOCOL)
@@ -371,6 +538,7 @@ class ProcessGroup:
         peers — broadcast sides — encode it once instead of world-1
         times; the caller owns the cache's lifetime (one wave), which
         keeps the id() keys valid."""
+        _faults.fault_point("mesh.send")
         ex = self._pwexec()
         meta = []
         blobs = []
@@ -451,15 +619,71 @@ class ProcessGroup:
         except Exception:
             return None
 
-    def recv(self, peer: int, tag: Any) -> Any:
-        got = self._queues[peer].get()
+    def op_deadline(self) -> float | None:
+        """One PATHWAY_MESH_OP_TIMEOUT_S deadline, minted at the START of
+        a multi-peer collective and passed to each of its recvs — so the
+        whole collective observes a single hard deadline instead of
+        re-arming per peer (world-1 × timeout for the last one)."""
+        return (
+            _time.monotonic() + self._op_timeout
+            if self._op_timeout > 0
+            else None
+        )
+
+    _NO_DEADLINE = object()  # sentinel: "mint a per-call deadline"
+
+    def recv(self, peer: int, tag: Any, deadline=_NO_DEADLINE) -> Any:
+        _faults.fault_point("mesh.recv")
+        q = self._queues[peer]
+        op_timeout = self._op_timeout
+        if deadline is ProcessGroup._NO_DEADLINE:
+            deadline = self.op_deadline()
+        # liveness checks only make sense when the peer is expected to
+        # beat: an unsupervised pair with heartbeats disabled keeps the
+        # historical blocking get
+        check_liveness = self._hb_interval > 0 and self._peer_timeout > 0
+        if deadline is None and not check_liveness:
+            got = q.get()
+        else:
+            while True:
+                try:
+                    got = q.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    now = _time.monotonic()
+                    if check_liveness and peer not in self._goodbye:
+                        idle = now - self._last_seen.get(peer, now)
+                        if idle > self._peer_timeout:
+                            if self.stats is not None:
+                                self.stats.on_mesh_heartbeat_missed()
+                            raise MeshPeerFailure(
+                                f"rank {self.rank}: peer {peer} sent no "
+                                f"frame or heartbeat for {idle:.1f}s "
+                                "(PATHWAY_MESH_PEER_TIMEOUT_S="
+                                f"{self._peer_timeout:g}) while this rank "
+                                f"waited for {tag!r} — presumed crashed"
+                            )
+                    if deadline is not None and now > deadline:
+                        raise MeshTimeout(
+                            f"rank {self.rank}: collective timed out "
+                            "after PATHWAY_MESH_OP_TIMEOUT_S="
+                            f"{op_timeout:g}s waiting for peer {peer}, "
+                            f"pending tag {tag!r}"
+                        )
         if got is None:
-            raise ConnectionError(
-                f"rank {self.rank}: peer {peer} disconnected "
-                f"(waiting for {tag!r})"
+            if peer in self._goodbye:
+                raise MeshPeerGone(
+                    f"rank {self.rank}: peer {peer} shut down cleanly "
+                    f"(orderly goodbye) while {tag!r} was still pending"
+                )
+            raise MeshPeerFailure(
+                f"rank {self.rank}: peer {peer} disconnected without a "
+                f"goodbye — presumed crashed (waiting for {tag!r})"
             )
         if isinstance(got, _MeshError):
-            raise ConnectionError(got.message)
+            # link-level verdict (oversized/corrupt/undecodable frame):
+            # the peer is unusable — same recovery class as a crash
+            raise MeshPeerFailure(got.message)
         got_tag, obj = got
         if got_tag != tag:
             raise RuntimeError(
@@ -473,8 +697,9 @@ class ProcessGroup:
         """Rank 0 returns [obj_rank0, ..., obj_rankN-1]; others None."""
         if self.rank == 0:
             out = [obj]
+            dl = self.op_deadline()  # one deadline for the whole gather
             for peer in range(1, self.world):
-                out.append(self.recv(peer, tag))
+                out.append(self.recv(peer, tag, deadline=dl))
             return out
         self.send(0, tag, obj)
         return None
@@ -494,19 +719,57 @@ class ProcessGroup:
             if peer != self.rank:
                 self.send(peer, tag, per_rank[peer])
         merged = list(per_rank[self.rank])
+        dl = self.op_deadline()  # one deadline across all peers
         for peer in range(self.world):
             if peer != self.rank:
-                merged.extend(self.recv(peer, tag))
+                merged.extend(self.recv(peer, tag, deadline=dl))
         return merged
 
     def barrier(self, tag: Any) -> None:
         self.gather0(("b", tag), None)
         self.bcast0(("b2", tag), None)
 
-    def close(self) -> None:
+    def drain(self) -> int:
+        """Discard everything queued from every peer — the epoch-abort
+        path calls this so in-flight frames of a dead epoch are dropped
+        (never delivered to the engine) before the mesh closes. Returns
+        the number of discarded frames."""
+        n = 0
+        for q in self._queues.values():
+            while True:
+                try:
+                    if q.get_nowait() is not None:
+                        n += 1
+                except queue.Empty:
+                    break
+        return n
+
+    def close(self, goodbye: bool = True) -> None:
+        """``goodbye=False`` is the failure-path close (runtime epoch
+        abort): the links just drop, so peers classify the loss as a
+        crash (MeshPeerFailure) — announcing an orderly shutdown from a
+        rank that is dying of an exception would point the investigation
+        away from the real failure."""
         if self._closed:
             return
         self._closed = True
+        self._hb_stop.set()
+        if goodbye:
+            # orderly goodbye first: peers that still wait on us can then
+            # report MeshPeerGone (clean shutdown) instead of a crash
+            bye = _LEN.pack(len(_BYE_MAGIC)) + _BYE_MAGIC
+            for peer, s in self._socks.items():
+                lock = self._send_locks.get(peer)
+                try:
+                    if lock is None:
+                        s.sendall(bye)
+                    elif lock.acquire(timeout=0.5):
+                        try:
+                            s.sendall(bye)
+                        finally:
+                            lock.release()
+                except OSError:
+                    pass  # peer already gone
         for s in self._socks.values():
             # shutdown BEFORE close: a concurrent recv() in a receiver
             # thread does not reliably wake on close() alone
@@ -527,11 +790,13 @@ class ProcessGroup:
             pass
 
 
-def _recv_exact(s: socket.socket, n: int) -> bytes:
+def _recv_exact(s: socket.socket, n: int, on_bytes=None) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         chunk = s.recv(n - len(buf))
         if not chunk:
             raise EOFError
+        if on_bytes is not None:
+            on_bytes()
         buf.extend(chunk)
     return bytes(buf)

@@ -1,0 +1,307 @@
+"""Mesh supervisor: rollback-recovery driver for multi-rank runs.
+
+The recovery model is coordinated rollback (Carbone et al., "Lightweight
+Asynchronous Snapshots for Distributed Dataflows" — the same model
+Flink's checkpoint/restart implements; failure semantics as in Naiad,
+Murray et al. SOSP'13): the engine takes lockstep distributed snapshots
+(engine/runtime.py ``_save_operator_snapshot_distributed``) whose commit
+marker only advances once EVERY rank's rank-local snapshot is durable.
+When any rank dies, the surviving ranks *detect* it (procgroup.py
+heartbeats, peer timeouts, bounded collectives), *abort the epoch* —
+drain in-flight frames, close the mesh, exit with
+:data:`MESH_RESTART_EXIT_CODE` instead of deadlocking mid-wave — and
+this supervisor *rolls the mesh back*: it reaps the whole rank set and
+respawns it at ``epoch+1``. The fresh processes re-handshake the mesh
+(the epoch is bound into the procgroup handshake, so a straggler from
+the dead epoch can never rejoin), restore the last committed snapshot
+via the ``snapshot_commit`` marker path, rewind their connectors to the
+saved scan states, and resume. With a durable upsert sink (the
+operator-persistence contract), recovered output is bit-identical to an
+uninterrupted run — pinned by tests/test_fault_injection.py and the
+``scripts/fault_matrix.py`` mesh grid.
+
+Why whole-mesh rollback rather than surgically restarting only the dead
+rank: the surviving ranks' in-memory operator state has advanced past
+the last committed cut (uncommitted timestamps, half-delivered waves),
+and connector subjects are arbitrary user code mid-``run()`` that cannot
+be rewound in place. Rolling every rank back to the committed cut is the
+only state all ranks provably share — exactly the reference semantics of
+asynchronous-barrier-snapshot systems.
+
+Knobs: ``PATHWAY_MESH_MAX_RESTARTS`` (rollback budget, default 3),
+``PATHWAY_MESH_GRACE_S`` (how long survivors get to self-detect and exit
+before SIGKILL, default 20). ``PATHWAY_FAULT_PLAN`` is stripped from
+respawned epochs by default so an injected crash behaves like the
+transient fault it models (override with
+``clear_fault_plan_on_restart=False`` to test deterministic-failure
+budgets).
+
+Usage::
+
+    python -m pathway_tpu.parallel.supervisor --processes 2 -- my_pipe.py
+
+or programmatically::
+
+    from pathway_tpu.parallel.supervisor import MeshSupervisor
+    rc = MeshSupervisor([sys.executable, "my_pipe.py"], processes=2).run()
+
+This module's own imports are deliberately stdlib-only. Note that
+``python -m pathway_tpu.parallel.supervisor`` still executes the package
+``__init__``s (a one-time jax import at supervisor startup); a driver
+that must stay import-light can load this file directly by path —
+``importlib.util.spec_from_file_location`` — which is exactly what
+``scripts/fault_matrix.py`` does to share
+:data:`MESH_RESTART_EXIT_CODE` and :func:`_free_port_base`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Sequence
+
+# a surviving rank that detected a peer failure exits with this code to
+# request a rollback restart (engine/runtime.py's supervised abort path);
+# distinct from faults.CRASH_EXIT_CODE (27), which marks the injected
+# crash itself
+MESH_RESTART_EXIT_CODE = 28
+
+logger = logging.getLogger(__name__)
+
+
+def _free_port_base(n: int) -> int:
+    """A base port with n consecutive free ports — each epoch gets a
+    fresh range so late packets/TIME_WAIT of the dead epoch cannot
+    collide with the recovered mesh's listeners."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        held = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                held.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+    raise RuntimeError("no consecutive free port range found")
+
+
+class MeshSupervisor:
+    """Spawn ``processes`` rank subprocesses running ``command`` and keep
+    the set alive through rollback restarts.
+
+    Every rank gets ``PATHWAY_PROCESSES`` / ``PATHWAY_PROCESS_ID`` /
+    ``PATHWAY_FIRST_PORT`` plus ``PATHWAY_MESH_EPOCH`` (the rollback
+    generation) and ``PATHWAY_MESH_SUPERVISED=1`` (tells the runtime to
+    exit :data:`MESH_RESTART_EXIT_CODE` on a detected mesh failure
+    instead of raising to the user). ``run()`` returns 0 once every rank
+    of some epoch exits cleanly, or the first failing exit code once the
+    restart budget is exhausted."""
+
+    def __init__(
+        self,
+        command: Sequence[str],
+        processes: int | None = None,
+        *,
+        max_restarts: int | None = None,
+        grace_s: float | None = None,
+        env: dict | None = None,
+        clear_fault_plan_on_restart: bool = True,
+        poll_s: float = 0.05,
+    ):
+        if processes is None:
+            processes = int(os.environ.get("PATHWAY_PROCESSES", "2") or 2)
+        if max_restarts is None:
+            max_restarts = int(
+                os.environ.get("PATHWAY_MESH_MAX_RESTARTS", "3") or 3
+            )
+        if grace_s is None:
+            grace_s = float(
+                os.environ.get("PATHWAY_MESH_GRACE_S", "20") or 20
+            )
+        self.command = list(command)
+        self.processes = processes
+        self.max_restarts = max_restarts
+        self.grace_s = grace_s
+        self.env = env
+        self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
+        self.poll_s = poll_s
+        # exposed for tests/observability
+        self.epoch = 0
+        self.restarts_performed = 0
+        self.history: list[list[int]] = []  # per-epoch exit codes
+
+    def _spawn_epoch(self, epoch: int) -> list[subprocess.Popen]:
+        port = _free_port_base(self.processes)
+        procs = []
+        for rank in range(self.processes):
+            env = dict(os.environ)
+            if self.env:
+                env.update(self.env)
+            env.update(
+                PATHWAY_PROCESSES=str(self.processes),
+                PATHWAY_PROCESS_ID=str(rank),
+                PATHWAY_FIRST_PORT=str(port),
+                PATHWAY_MESH_EPOCH=str(epoch),
+                PATHWAY_MESH_SUPERVISED="1",
+            )
+            # emulated-lane inheritance would turn real ranks back into
+            # thread companions
+            env.pop("PATHWAY_LANE_PROCESSES", None)
+            if epoch > 0 and self.clear_fault_plan_on_restart:
+                env.pop("PATHWAY_FAULT_PLAN", None)
+            procs.append(subprocess.Popen(self.command, env=env))
+        return procs
+
+    @staticmethod
+    def _reap(procs: list[subprocess.Popen], grace_s: float) -> list[int]:
+        """Give survivors the grace window to self-detect the failure and
+        exit on their own (their exit code then records WHAT they saw),
+        then SIGKILL stragglers. Returns the final exit codes."""
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline and any(
+            p.poll() is None for p in procs
+        ):
+            time.sleep(0.05)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+        return [p.wait() for p in procs]
+
+    def run(self) -> int:
+        """Returns 0 once every rank of some epoch exits cleanly. The
+        rank set never outlives the supervisor: any exit from this
+        method — including SystemExit from a signal handler or an
+        unexpected exception mid-loop — SIGKILLs the live children, so a
+        stopped deployment cannot leave a detached mesh advancing the
+        shared persistence state behind the operator's back."""
+        procs: list[subprocess.Popen] = []
+        try:
+            return self._run(procs)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signal.SIGKILL)
+                    except OSError:
+                        pass
+            for p in procs:
+                if p.poll() is None:
+                    p.wait()
+
+    def _run(self, procs: list[subprocess.Popen]) -> int:
+        while True:
+            procs[:] = self._spawn_epoch(self.epoch)
+            logger.info(
+                "mesh supervisor: epoch %d up (%d ranks)",
+                self.epoch,
+                self.processes,
+            )
+            failed_rc = None
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = [c for c in codes if c is not None and c != 0]
+                if bad:
+                    failed_rc = bad[0]
+                    break
+                if all(c == 0 for c in codes):
+                    self.history.append([0] * len(procs))
+                    logger.info(
+                        "mesh supervisor: epoch %d finished cleanly",
+                        self.epoch,
+                    )
+                    return 0
+                time.sleep(self.poll_s)
+            codes = self._reap(procs, self.grace_s)
+            self.history.append(codes)
+            if self.restarts_performed >= self.max_restarts:
+                # root-cause code: prefer a failing rank's own exit over
+                # MESH_RESTART_EXIT_CODE (survivors merely REPORTING the
+                # failure) — returning 28 here would tell an outer
+                # orchestrator "retryable rollback request" about a
+                # deterministically failing deployment, and which code
+                # surfaced first is a poll-timing race
+                root = next(
+                    (
+                        c
+                        for c in codes
+                        if c not in (0, MESH_RESTART_EXIT_CODE)
+                    ),
+                    failed_rc,
+                )
+                logger.error(
+                    "mesh supervisor: epoch %d failed (exit codes %s) "
+                    "and the restart budget (%d) is exhausted",
+                    self.epoch,
+                    codes,
+                    self.max_restarts,
+                )
+                return root if root else 1
+            self.restarts_performed += 1
+            self.epoch += 1
+            logger.warning(
+                "mesh supervisor: epoch %d failed (exit codes %s; %d = "
+                "rollback requested) — rolling back to the last committed "
+                "snapshot as epoch %d (restart %d/%d)",
+                self.epoch - 1,
+                codes,
+                MESH_RESTART_EXIT_CODE,
+                self.epoch,
+                self.restarts_performed,
+                self.max_restarts,
+            )
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n\n")[0],
+        usage=(
+            "python -m pathway_tpu.parallel.supervisor "
+            "[--processes N] [--max-restarts M] [--grace S] -- "
+            "program.py [args...]"
+        ),
+    )
+    ap.add_argument("--processes", type=int, default=None)
+    ap.add_argument("--max-restarts", type=int, default=None)
+    ap.add_argument("--grace", type=float, default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given")
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    # a plain `kill <supervisor-pid>` must take the rank set down with
+    # it: SystemExit unwinds through run()'s finally, which reaps the
+    # children (SIGINT already reaches the foreground process group)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    return MeshSupervisor(
+        cmd,
+        args.processes,
+        max_restarts=args.max_restarts,
+        grace_s=args.grace,
+    ).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -449,3 +449,20 @@ class PersistenceManager:
 
     def list_keys(self, prefix: str) -> list[str]:
         return self.backend.list_keys(prefix)
+
+    def prune_operator_snapshots(self, prefix: str, keep: set) -> None:
+        """Best-effort prune of a rank's superseded snapshot tags,
+        retaining every tag in ``keep``. The runtime passes the
+        just-committed tag AND the previously committed one: a rank
+        crashing between its restore-read of the marker and a peer's
+        post-commit prune must still find the snapshot it is loading on
+        the next rollback — deleting all-but-the-newest would race the
+        restore (ISSUE 4 prune-race fix). Non-integer suffixes (foreign
+        keys under the prefix) are left alone."""
+        for key in self.list_keys(prefix):
+            try:
+                tag = int(key[len(prefix):].split("/")[0])
+            except ValueError:
+                continue
+            if tag not in keep:
+                self.delete_key(key)

@@ -22,11 +22,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+# lanes 1/2 run the tier-1 surface (-m 'not slow'); the slow-marked
+# mesh grid is covered by lane 3's supervisor smoke and the full
+# `python scripts/fault_matrix.py --mesh --mesh-no-nb` sweep
 echo "=== lane 1: PATHWAY_THREADS=4 (full suite) ==="
-PATHWAY_THREADS=4 python -m pytest tests/ -x -q
+PATHWAY_THREADS=4 python -m pytest tests/ -x -q -m 'not slow'
 
 echo "=== lane 2: PATHWAY_LANE_PROCESSES=2 (full suite incl. serving) ==="
-PATHWAY_LANE_PROCESSES=2 python -m pytest -x -q \
+PATHWAY_LANE_PROCESSES=2 python -m pytest -x -q -m 'not slow' \
   --ignore=tests/test_multiprocess.py \
   --ignore=tests/test_persistence_multiprocess.py \
   --ignore=tests/test_parallel.py \
@@ -37,4 +40,11 @@ echo "=== lane 2 exempt: real 2-process columnar exchange smoke ==="
 env -u PATHWAY_LANE_PROCESSES python -m pytest -x -q \
   tests/test_native_exchange.py::test_exchange_smoke_2rank
 
-echo "=== both lanes green ==="
+echo "=== lane 3: real-fork 2-rank mesh kill-and-resume smoke ==="
+# one supervised run: a rank-scoped fault plan kills rank 1 mid-wave,
+# the survivor detects + aborts the epoch, the supervisor rolls the mesh
+# back to the last committed snapshot, output stays bit-identical
+env -u PATHWAY_LANE_PROCESSES python -m pytest -x -q \
+  tests/test_fault_injection.py::test_mesh_supervisor_kill_and_resume_smoke
+
+echo "=== all lanes green ==="

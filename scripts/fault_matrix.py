@@ -19,8 +19,27 @@ by primary key — resume then re-reads from scratch, which is the
 documented at-least-once contract, so its audit only forbids loss
 (counts may reach 2 for the journal-replayed prefix).
 
+The MESH grid (``--mesh``; ISSUE 4) runs the 2-rank analogue: a
+partition-aware stateful source on every rank feeds a sharded group-by
+over the TCP mesh under ``OPERATOR_PERSISTING``. Each cell hard-kills
+ONE rank at a ``mesh.rank_kill`` phase (``wave_send`` — slices prepared,
+frames unsent; ``post_snapshot`` — rank snapshot durable, commit marker
+not moved; ``restore`` — mid-restore after the marker tag is agreed) and
+asserts the full recovery contract:
+
+* the victim dies with ``CRASH_EXIT_CODE`` and the SURVIVOR detects the
+  loss and exits ``MESH_RESTART_EXIT_CODE`` within the configured
+  timeouts — no hang, no mid-wave deadlock;
+* the resumed 2-rank run restores the last committed snapshot via the
+  ``snapshot_commit`` marker, rewinds connectors to their saved scan
+  states, and finishes with final captures **bit-identical** to an
+  uninterrupted run (strict exactly-once: every key counted exactly
+  once). ``--mesh-no-nb`` re-runs the grid with
+  ``PATHWAY_NO_NB_EXCHANGE=1`` to pin the forced-tuple exchange path.
+
 Usage:
     python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
+                                   [--mesh] [--mesh-no-nb] [--mesh-only]
 """
 
 from __future__ import annotations
@@ -36,6 +55,24 @@ from dataclasses import dataclass
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 CRASH_EXIT_CODE = 27  # faults.CRASH_EXIT_CODE (no heavy import here)
+
+
+def _load_supervisor_module():
+    """parallel/supervisor.py loaded by FILE PATH: its module body is
+    stdlib-only, and bypassing the package __init__s keeps the full jax
+    import out of this light driver process."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_pw_mesh_supervisor", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_supervisor = _load_supervisor_module()
+MESH_RESTART_EXIT_CODE = _supervisor.MESH_RESTART_EXIT_CODE
+_free_port_base = _supervisor._free_port_base
 
 # (point, scenario mode): which persistence mode exercises the point
 CELLS = [
@@ -154,6 +191,254 @@ class CellResult:
     detail: str
 
 
+# ---------------------------------------------------------------------------
+# mesh grid: 2-rank rank-kill cells (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+# (phase, victim_rank, hit): which mesh.rank_kill phase dies, on which
+# rank, at which phase-scoped hit. "restore" cells are seeded by a prior
+# post_snapshot kill so a committed marker exists to restore from.
+MESH_CELLS = [
+    ("wave_send", 1, 3),
+    ("wave_send", 0, 3),
+    ("post_snapshot", 1, 2),
+    ("restore", 1, 1),
+]
+
+MESH_SCENARIO = r'''
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+pdir, out_base, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+out_path = f"{{out_base}}.r{{rank}}.json"
+
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True  # every rank reads its own key shard
+
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+
+    def run(self):
+        import time
+
+        mine = list(range(rank, n_rows, P))
+        while self.pos < len(mine):
+            i = mine[self.pos]
+            self.next(k=i, v=i * 7)
+            self.pos += 1
+            if self.pos % 4 == 0:
+                self.commit()
+                # spread commits over several BSP rounds so multiple
+                # snapshot cuts commit and every kill phase is reachable
+                time.sleep(0.05)
+
+    def snapshot_state(self):
+        return dict(pos=self.pos)
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="mesh_battery"
+)
+# unique keys: the group-by shards every row across the mesh and the
+# exactly-once audit is structural (c must be exactly 1 per key)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {{}}
+if os.path.exists(out_path):
+    # operator-persistence contract: restored node state does NOT
+    # re-notify sinks; the sink keeps its own durable state
+    with open(out_path) as f:
+        seen = json.load(f)
+
+
+def on_change(key, row, time_, diff):
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)  # a kill mid-write must not tear the file
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+pw.run(
+    monitoring_level=pw.MonitoringLevel.NONE,
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="OPERATOR_PERSISTING",
+        snapshot_interval_ms=0,
+    ),
+)
+'''
+
+
+def _run_mesh_ranks(
+    script, tmp, n_rows, plan, victim, timeout, extra_env=None
+):
+    """One 2-rank run; the fault plan (if any) lands in the victim's env
+    only. Returns [(rc, stderr_tail), ...] by rank."""
+    port = _free_port_base(2)
+    procs = []
+    for rank in range(2):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_PROCESSES": "2",
+            "PATHWAY_PROCESS_ID": str(rank),
+            "PATHWAY_FIRST_PORT": str(port),
+            # survivors self-detect and exit MESH_RESTART_EXIT_CODE
+            # instead of raising — exactly what a supervisor expects
+            "PATHWAY_MESH_SUPERVISED": "1",
+            "PATHWAY_MESH_OP_TIMEOUT_S": "30",
+            "PATHWAY_MESH_HEARTBEAT_S": "0.5",
+            "PATHWAY_MESH_PEER_TIMEOUT_S": "5",
+        }
+        env.pop("PATHWAY_FAULT_PLAN", None)
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        env.update(extra_env or {})
+        if plan is not None and rank == victim:
+            env["PATHWAY_FAULT_PLAN"] = json.dumps(plan)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    script,
+                    os.path.join(tmp, "pstorage"),
+                    os.path.join(tmp, "out"),
+                    str(n_rows),
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE,
+            )
+        )
+    out = []
+    try:
+        for p in procs:
+            _, err = p.communicate(timeout=timeout)
+            out.append((p.returncode, err.decode()[-1500:]))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        raise
+    return out
+
+
+def _mesh_plan(phase: str, hit: int) -> dict:
+    return {
+        "seed": 7,
+        "rules": [
+            {
+                "point": "mesh.rank_kill",
+                "phase": phase,
+                "hits": [hit],
+                "action": "crash",
+            }
+        ],
+    }
+
+
+def run_mesh_cell(
+    phase: str,
+    victim: int = 1,
+    hit: int = 2,
+    tmp: str | None = None,
+    n_rows: int = 40,
+    timeout: float = 180,
+    extra_env: dict | None = None,
+) -> CellResult:
+    """One mesh kill-and-resume cycle: victim dies at the phase, the
+    survivor must detect and exit cleanly (no hang), and the resumed
+    2-rank run must produce final captures bit-identical to an
+    uninterrupted run (see module docstring)."""
+    owns_tmp = tmp is None
+    if owns_tmp:
+        tmpdir = tempfile.TemporaryDirectory(prefix="pw_mesh_fault_")
+        tmp = tmpdir.name
+    script = os.path.join(tmp, "mesh_scenario.py")
+    with open(script, "w") as f:
+        f.write(MESH_SCENARIO.format(repo=REPO))
+    label = f"mesh.rank_kill/{phase}"
+    mode = f"mesh-r{victim}"
+
+    def fail(detail):
+        return CellResult(label, mode, hit, False, detail)
+
+    if phase == "restore":
+        # seed a committed snapshot cut + a crash, so the NEXT start
+        # actually restores (and can be killed mid-restore)
+        res = _run_mesh_ranks(
+            script, tmp, n_rows, _mesh_plan("post_snapshot", 2), victim,
+            timeout, extra_env,
+        )
+        if res[victim][0] != CRASH_EXIT_CODE:
+            return fail(
+                f"restore seed run: victim exit {res[victim][0]} "
+                f"(wanted {CRASH_EXIT_CODE}); stderr: {res[victim][1]}"
+            )
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, _mesh_plan(phase, hit), victim, timeout,
+        extra_env,
+    )
+    if res[victim][0] != CRASH_EXIT_CODE:
+        return fail(
+            f"kill phase: victim exit {res[victim][0]} (wanted "
+            f"{CRASH_EXIT_CODE}); stderr: {res[victim][1]}"
+        )
+    survivor = 1 - victim
+    if res[survivor][0] != MESH_RESTART_EXIT_CODE:
+        return fail(
+            f"survivor exit {res[survivor][0]} (wanted "
+            f"{MESH_RESTART_EXIT_CODE}: detected peer loss + clean epoch "
+            f"abort); stderr: {res[survivor][1]}"
+        )
+    res = _run_mesh_ranks(script, tmp, n_rows, None, victim, timeout,
+                          extra_env)
+    if [rc for rc, _ in res] != [0, 0]:
+        return fail(
+            f"resume phase: exits {[rc for rc, _ in res]}; stderr: "
+            f"{[e for _, e in res]}"
+        )
+    try:
+        with open(os.path.join(tmp, "out.r0.json")) as f:
+            got = json.load(f)
+    except FileNotFoundError:
+        return fail("resume phase wrote no rank-0 output")
+    want = expected_counts(n_rows)
+    if got != want:
+        missing = sorted(set(want) - set(got), key=int)
+        dupes = sorted(k for k, v in got.items() if v[0] != 1)
+        return fail(
+            f"exactly-once violated across rank restart: missing={missing} "
+            f"dup-counted={dupes} "
+            f"diff-keys={[k for k in got if got[k] != want.get(k)][:5]}"
+        )
+    return CellResult(label, mode, hit, True, "bit-identical resume")
+
+
 def expected_counts(n_rows: int) -> dict:
     return {str(k): [1, k * 7] for k in range(n_rows)}
 
@@ -241,19 +526,53 @@ def main(argv=None) -> int:
     ap.add_argument("--rows", type=int, default=24)
     ap.add_argument("--hits", default="2", help="comma list of kill phases")
     ap.add_argument("--timeout", type=float, default=120)
+    ap.add_argument(
+        "--mesh", action="store_true",
+        help="also run the 2-rank mesh rank-kill grid",
+    )
+    ap.add_argument(
+        "--mesh-no-nb", action="store_true",
+        help="re-run the mesh grid with PATHWAY_NO_NB_EXCHANGE=1 "
+        "(forced-tuple exchange path)",
+    )
+    ap.add_argument(
+        "--mesh-only", action="store_true",
+        help="skip the single-process grid",
+    )
     args = ap.parse_args(argv)
     hits = [int(h) for h in args.hits.split(",") if h]
 
     results: list[CellResult] = []
-    for point, mode in CELLS:
-        for hit in hits:
-            res = run_cell(
-                point, mode=mode, hit=hit, n_rows=args.rows,
-                timeout=args.timeout,
-            )
-            results.append(res)
-            status = "PASS" if res.ok else "FAIL"
-            print(f"{status}  {point:<32} mode={mode:<9} hit={hit}  {res.detail}")
+    if not args.mesh_only:
+        for point, mode in CELLS:
+            for hit in hits:
+                res = run_cell(
+                    point, mode=mode, hit=hit, n_rows=args.rows,
+                    timeout=args.timeout,
+                )
+                results.append(res)
+                status = "PASS" if res.ok else "FAIL"
+                print(
+                    f"{status}  {point:<32} mode={mode:<9} hit={hit}  "
+                    f"{res.detail}"
+                )
+
+    if args.mesh or args.mesh_no_nb or args.mesh_only:
+        variants = [("columnar", None)]
+        if args.mesh_no_nb:
+            variants.append(("tuple", {"PATHWAY_NO_NB_EXCHANGE": "1"}))
+        for vname, extra_env in variants:
+            for phase, victim, hit in MESH_CELLS:
+                res = run_mesh_cell(
+                    phase, victim=victim, hit=hit,
+                    timeout=max(args.timeout, 180), extra_env=extra_env,
+                )
+                results.append(res)
+                status = "PASS" if res.ok else "FAIL"
+                print(
+                    f"{status}  {res.point:<32} mode={res.mode}/{vname:<9} "
+                    f"hit={hit}  {res.detail}"
+                )
 
     failed = [r for r in results if not r.ok]
     print()

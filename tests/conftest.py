@@ -23,6 +23,15 @@ import pytest
 from pathway_tpu.internals.parse_graph import G
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long multi-process batteries excluded from the tier-1 "
+        "sweep (-m 'not slow'); run by scripts/ci_lanes.sh and the "
+        "fault-matrix CLI",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _clear_graph():
     G.clear()

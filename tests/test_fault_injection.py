@@ -131,6 +131,57 @@ def test_fault_plan_rejects_unknown_point():
         faults.FaultPlan([{"point": "connecter.read", "hits": [1]}])
 
 
+def test_fault_plan_phase_scoped_counters():
+    """A rule with a phase counts hits on the (point, phase) counter, so
+    its schedule is independent of how other phases interleave."""
+    faults.install_plan(
+        {"rules": [
+            {"point": "mesh.rank_kill", "phase": "wave_send", "hits": [2]},
+        ]}
+    )
+    fired = []
+    # interleave phases: wave_send hits are 1, 2 — the rule fires on the
+    # SECOND wave_send even though it is the fourth overall hit
+    for i, phase in enumerate(
+        ["restore", "wave_send", "post_snapshot", "wave_send", "wave_send"]
+    ):
+        try:
+            faults.fault_point("mesh.rank_kill", phase=phase)
+        except faults.InjectedFault as exc:
+            fired.append((i, phase, exc.hit))
+    assert fired == [(3, "wave_send", 2)]
+    counts = faults.active_plan().hit_counts()
+    assert counts["mesh.rank_kill"] == 5
+    assert counts["mesh.rank_kill#wave_send"] == 3
+
+
+def test_fault_plan_phaseless_rule_ignores_phase_context():
+    faults.install_plan({"rules": [{"point": "mesh.rank_kill", "hits": [2]}]})
+    faults.fault_point("mesh.rank_kill", phase="restore")
+    with pytest.raises(faults.InjectedFault):
+        faults.fault_point("mesh.rank_kill", phase="wave_send")
+
+
+def test_fault_plan_rank_scoped_rule():
+    """One shared PATHWAY_FAULT_PLAN can name its victim rank: the rule
+    only fires in the process whose config process_id matches."""
+    from pathway_tpu.internals.config import (
+        pop_config_overlay,
+        push_config_overlay,
+    )
+
+    faults.install_plan(
+        {"rules": [{"point": "mesh.send", "rank": 1}]}  # every hit, rank 1
+    )
+    faults.fault_point("mesh.send")  # this process is rank 0: no fire
+    tok = push_config_overlay(process_id=1)
+    try:
+        with pytest.raises(faults.InjectedFault):
+            faults.fault_point("mesh.send")
+    finally:
+        pop_config_overlay(tok)
+
+
 # ------------------------------------------------------- RetryPolicy
 
 
@@ -689,11 +740,19 @@ def test_prober_stats_health_counters_render():
     stats.on_connector_error("c1")
     stats.on_connector_stall("c2")
     stats.on_connector_degraded("c1")
+    stats.on_mesh_heartbeat_missed(3)
+    stats.on_mesh_rank_restart()
+    stats.on_mesh_rollback()
+    stats.on_mesh_epoch_committed(2)
     text = stats.render_openmetrics()
     assert 'connector_restarts_total{connector="c1"} 2' in text
     assert 'connector_errors_total{connector="c1"} 1' in text
     assert 'connector_stalls_total{connector="c2"} 1' in text
     assert 'connector_degraded_total{connector="c1"} 1' in text
+    assert "mesh_heartbeats_missed_total 3" in text
+    assert "mesh_rank_restarts_total 1" in text
+    assert "mesh_rollbacks_total 1" in text
+    assert "mesh_last_committed_epoch 2" in text
     assert "restarts=2" in stats.render_text()
 
 
@@ -721,3 +780,157 @@ def test_fault_battery_kill_and_resume(tmp_path, point, mode):
         point, mode=mode, hit=2, tmp=str(tmp_path), n_rows=24
     )
     assert res.ok, f"{point}/{mode}: {res.detail}"
+
+
+# ------------------------------------------------- mesh rollback recovery
+#
+# The 2-rank analogue of the battery above (ISSUE 4): a rank is
+# hard-killed at a mesh.rank_kill phase, the SURVIVOR must detect the
+# loss and abort the epoch cleanly (exit MESH_RESTART_EXIT_CODE — no
+# hang, no mid-wave deadlock), and the resumed 2-rank run must restore
+# the last committed distributed snapshot and produce final captures
+# bit-identical to an uninterrupted run. One wave_send cell per exchange
+# path rides tier-1; the full phase × victim grid is `slow` (run by
+# `python scripts/fault_matrix.py --mesh --mesh-no-nb` and ci_lanes).
+
+
+def _mesh_cell(tmp_path, phase, victim, hit, extra_env=None):
+    if os.environ.get("PATHWAY_LANE_PROCESSES"):
+        pytest.skip("real-fork mesh battery incompatible with the lane")
+    res = fault_matrix.run_mesh_cell(
+        phase, victim=victim, hit=hit, tmp=str(tmp_path), n_rows=40,
+        extra_env=extra_env,
+    )
+    assert res.ok, f"{res.point}/{res.mode}: {res.detail}"
+
+
+def test_mesh_kill_and_resume_wave_send_columnar(tmp_path):
+    _mesh_cell(tmp_path, "wave_send", victim=1, hit=3)
+
+
+def test_mesh_kill_and_resume_wave_send_tuple_path(tmp_path):
+    _mesh_cell(
+        tmp_path, "wave_send", victim=1, hit=3,
+        extra_env={"PATHWAY_NO_NB_EXCHANGE": "1"},
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "phase,victim,hit",
+    [("wave_send", 0, 3), ("post_snapshot", 1, 2), ("restore", 1, 1)],
+)
+def test_mesh_kill_and_resume_full_grid(tmp_path, phase, victim, hit):
+    _mesh_cell(tmp_path, phase, victim, hit)
+
+
+def test_mesh_supervisor_kill_and_resume_smoke(tmp_path):
+    """End-to-end rollback recovery in ONE supervised invocation: a
+    rank-scoped fault plan (shared env) kills rank 1 mid-wave at epoch 0;
+    rank 0 detects the crash and exits MESH_RESTART_EXIT_CODE; the
+    supervisor respawns both ranks at epoch 1 (fresh mesh handshake,
+    fault plan stripped), they restore the committed snapshot cut, rewind
+    their connectors, and finish with output bit-identical to an
+    uninterrupted run. This is ci_lanes.sh lane 3."""
+    if os.environ.get("PATHWAY_LANE_PROCESSES"):
+        pytest.skip("real-fork mesh battery incompatible with the lane")
+    from pathway_tpu.internals.faults import CRASH_EXIT_CODE
+    from pathway_tpu.parallel.supervisor import (
+        MESH_RESTART_EXIT_CODE,
+        MeshSupervisor,
+    )
+
+    tmp = str(tmp_path)
+    script = os.path.join(tmp, "mesh_scenario.py")
+    with open(script, "w") as f:
+        f.write(fault_matrix.MESH_SCENARIO.format(repo=fault_matrix.REPO))
+    n_rows = 40
+    plan = {
+        "seed": 7,
+        "rules": [{
+            "point": "mesh.rank_kill", "phase": "wave_send", "rank": 1,
+            "hits": [3], "action": "crash",
+        }],
+    }
+    sup = MeshSupervisor(
+        [sys.executable, script, os.path.join(tmp, "pstorage"),
+         os.path.join(tmp, "out"), str(n_rows)],
+        processes=2,
+        grace_s=30,
+        env={
+            "PATHWAY_FAULT_PLAN": json.dumps(plan),
+            "PATHWAY_MESH_OP_TIMEOUT_S": "30",
+            "PATHWAY_MESH_HEARTBEAT_S": "0.5",
+            "PATHWAY_MESH_PEER_TIMEOUT_S": "5",
+        },
+    )
+    rc = sup.run()
+    assert rc == 0, sup.history
+    assert sup.restarts_performed == 1, sup.history
+    # epoch 0: rank 1 died by injection, rank 0 requested the rollback
+    assert sup.history[0][1] == CRASH_EXIT_CODE
+    assert sup.history[0][0] == MESH_RESTART_EXIT_CODE
+    assert sup.history[1] == [0, 0]
+    with open(os.path.join(tmp, "out.r0.json")) as f:
+        got = json.load(f)
+    assert got == fault_matrix.expected_counts(n_rows)
+
+
+def test_mesh_supervisor_budget_exhausted_fails_cleanly():
+    """A deterministically failing rank set burns the restart budget and
+    the supervisor reports the failure instead of looping forever."""
+    prog = "import sys; sys.exit(5)"
+    from pathway_tpu.parallel.supervisor import MeshSupervisor
+
+    sup = MeshSupervisor(
+        [sys.executable, "-c", prog], processes=2, max_restarts=1,
+        grace_s=2,
+    )
+    assert sup.run() == 5
+    assert sup.restarts_performed == 1
+    assert len(sup.history) == 2
+
+
+def test_mesh_supervisor_bumps_epoch_and_strips_fault_plan():
+    """Respawned epochs see PATHWAY_MESH_EPOCH=N and (by default) no
+    PATHWAY_FAULT_PLAN — an injected crash behaves like the transient
+    fault it models instead of recurring forever."""
+    prog = (
+        "import os, sys;"
+        "sys.exit(27 if os.environ.get('PATHWAY_FAULT_PLAN')"
+        " and os.environ['PATHWAY_PROCESS_ID'] == '1' else"
+        " int(os.environ['PATHWAY_MESH_EPOCH']) - 1)"
+    )
+    from pathway_tpu.parallel.supervisor import MeshSupervisor
+
+    sup = MeshSupervisor(
+        [sys.executable, "-c", prog], processes=2, grace_s=2,
+        env={"PATHWAY_FAULT_PLAN": '{"rules": []}'},
+    )
+    # epoch 0: rank 1 exits 27 (plan present); epoch 1: plan stripped,
+    # both ranks exit int(epoch)-1 = 0
+    assert sup.run() == 0
+    assert sup.epoch == 1
+    assert sup.restarts_performed == 1
+
+
+def test_operator_snapshot_prune_retains_last_two_tags():
+    """The snapshot prune keeps the just-committed AND the previously
+    committed tag: a peer crashing between its restore-read of the
+    marker and this prune must still find the snapshot it was loading
+    (ISSUE 4 prune-race fix)."""
+    cfg = pw.persistence.Config(backend=pw.persistence.Backend.memory())
+    from pathway_tpu.persistence import PersistenceManager
+
+    mgr = PersistenceManager(cfg)
+    for tag in (3, 5, 8):
+        mgr.save_operator_snapshot(
+            [], {}, [], key=f"operator_snapshot/r0/{tag}"
+        )
+    mgr.backend.write("operator_snapshot/r0/not-a-tag", b"x")
+    mgr.prune_operator_snapshots("operator_snapshot/r0/", {8, 5})
+    assert mgr.list_keys("operator_snapshot/r0/") == [
+        "operator_snapshot/r0/5",
+        "operator_snapshot/r0/8",
+        "operator_snapshot/r0/not-a-tag",  # foreign keys untouched
+    ]

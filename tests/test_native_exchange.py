@@ -219,6 +219,137 @@ def _mesh_pair(port):
     return holder[0], holder[1]
 
 
+def test_collective_timeout_names_peer_and_tag(monkeypatch):
+    """PATHWAY_MESH_OP_TIMEOUT_S bounds every collective: a recv blocked
+    on a silent-but-connected peer raises a ConnectionError naming the
+    peer rank and the pending tag instead of hanging forever."""
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "0.4")
+    monkeypatch.setenv("PATHWAY_MESH_HEARTBEAT_S", "0.1")
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    from pathway_tpu.parallel.procgroup import MeshTimeout
+
+    try:
+        with pytest.raises(MeshTimeout, match=r"peer 1.*\('xw', 99\)"):
+            pg0.recv(1, ("xw", 99))
+        # gather0 on rank 0 recvs from every peer: same bounded deadline
+        with pytest.raises(ConnectionError, match="PATHWAY_MESH_OP_TIMEOUT_S"):
+            pg0.gather0(("g", 1), None)
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_op_timeout_zero_disables_deadline(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "0")
+    monkeypatch.setenv("PATHWAY_MESH_HEARTBEAT_S", "0.05")
+    monkeypatch.setenv("PATHWAY_MESH_PEER_TIMEOUT_S", "30")
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        # no deadline: a late frame is simply delivered
+        t = threading.Timer(0.5, lambda: pg1.send(0, "late", 42))
+        t.start()
+        assert pg0.recv(1, "late") == 42
+        t.join()
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_orderly_goodbye_distinguished_from_crash():
+    """close() ships a goodbye frame: a peer that finds the link gone can
+    tell clean shutdown (MeshPeerGone) from a crash (MeshPeerFailure)."""
+    import socket as _socket
+
+    from pathway_tpu.parallel.procgroup import MeshPeerFailure, MeshPeerGone
+
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        pg1.close()
+        with pytest.raises(MeshPeerGone, match="orderly goodbye"):
+            pg0.recv(1, "after-bye")
+    finally:
+        pg0.close()
+    # crash: the link dies with NO goodbye
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        for s in pg1._socks.values():
+            s.shutdown(_socket.SHUT_RDWR)  # simulated hard death
+        with pytest.raises(MeshPeerFailure, match="without a goodbye"):
+            pg0.recv(1, "dead")
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_heartbeat_silence_detected_before_op_timeout(monkeypatch):
+    """A connected-but-silent peer (no frames, no heartbeats) is declared
+    dead after PATHWAY_MESH_PEER_TIMEOUT_S — much sooner than the
+    collective deadline — and the miss lands on the stats counter."""
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "30")
+    monkeypatch.setenv("PATHWAY_MESH_HEARTBEAT_S", "0.05")
+    monkeypatch.setenv("PATHWAY_MESH_PEER_TIMEOUT_S", "0.3")
+    from pathway_tpu.internals.monitoring import ProberStats
+    from pathway_tpu.parallel.procgroup import MeshPeerFailure
+
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    pg0.stats = ProberStats()
+    try:
+        pg1._hb_stop.set()  # peer alive but silent: stops heartbeating
+        import time as _t
+
+        start = _t.monotonic()
+        with pytest.raises(MeshPeerFailure, match="no frame or heartbeat"):
+            pg0.recv(1, "silent")
+        assert _t.monotonic() - start < 5  # far below the 30s op deadline
+        assert pg0.stats.mesh_heartbeats_missed >= 1
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_epoch_mismatch_rejected_at_handshake():
+    """A rank surviving from a rolled-back epoch cannot join the
+    recovered mesh: the handshake binds PATHWAY_MESH_EPOCH."""
+    from pathway_tpu.parallel.procgroup import ProcessGroup
+
+    port = _free_port_base(2)
+    errs = []
+
+    def mk1():
+        try:
+            ProcessGroup(1, 2, port, epoch=1, timeout=3)
+        except Exception as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=mk1, daemon=True)
+    t.start()
+    with pytest.raises(TimeoutError):
+        ProcessGroup(0, 2, port, epoch=0, timeout=3)
+    t.join(15)
+    assert errs and isinstance(errs[0], ConnectionError)
+    assert "EPOCH" in str(errs[0])
+
+
+def test_drain_discards_inflight_frames():
+    """The epoch-abort path drops queued frames of the dead epoch
+    instead of delivering them to the engine."""
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        pg0.send(1, "t1", {"a": 1})
+        pg0.send(1, "t2", {"a": 2})
+        # wait until the receiver thread queued both
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while pg1._queues[0].qsize() < 2 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert pg1.drain() == 2
+        assert pg1._queues[0].qsize() == 0
+    finally:
+        pg0.close()
+        pg1.close()
+
+
 def test_frame_size_cap_raises_clean_connection_error(monkeypatch):
     monkeypatch.setenv("PATHWAY_MESH_MAX_FRAME_MB", "0.01")  # ~10 KB
     pg0, pg1 = _mesh_pair(_free_port_base(2))
