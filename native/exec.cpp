@@ -2206,6 +2206,10 @@ struct JEntry {
     unsigned __int128 key128 = 0;
     std::shared_ptr<const std::string> cells;
     int64_t count = 0;
+    uint64_t seq = 0; /* per-group insertion order: cross-product emits
+                       * must not follow unordered_map bucket order —
+                       * same-output-key emits (id= fanout joins) would
+                       * pick an encoding/timing-dependent winner */
 };
 
 struct JGroup {
@@ -2214,7 +2218,28 @@ struct JGroup {
                              * then holds the packed key columns */
     std::string jk_cells;
     std::unordered_map<std::string, JEntry> left, right;
+    uint64_t next_seq = 0;
 };
+
+/* one side's live entries in insertion (seq) order — the order the pure
+ * Python MultisetState (insertion-ordered dict) emits, so native and
+ * demoted paths stay bit-identical even under duplicate output keys.
+ * The sort is per affected group per batch; callers skip the call
+ * entirely when no delta consumes the side, and the 0/1-entry case
+ * (unique join keys, the common shape) pays no sort at all. */
+inline void jside_ordered(std::unordered_map<std::string, JEntry> &side,
+                          std::vector<const JEntry *> &out)
+{
+    out.clear();
+    out.reserve(side.size());
+    for (auto &e : side)
+        out.push_back(&e.second);
+    if (out.size() > 1)
+        std::sort(out.begin(), out.end(),
+                  [](const JEntry *a, const JEntry *b) {
+                      return a->seq < b->seq;
+                  });
+}
 
 struct JShard {
     std::unordered_map<std::string, JGroup> groups;
@@ -2401,7 +2426,7 @@ struct JShardOut {
 
 /* apply one side's delta rows to a side map; records refcount intents */
 inline void japply(std::unordered_map<std::string, JEntry> &side,
-                   const JRowX &r, JShardOut &o)
+                   const JRowX &r, JShardOut &o, uint64_t &next_seq)
 {
     auto it = side.find(r.entry_bytes);
     if (it == side.end()) {
@@ -2409,6 +2434,7 @@ inline void japply(std::unordered_map<std::string, JEntry> &side,
         e.key = r.key;
         e.row = r.row;
         e.count = r.diff;
+        e.seq = next_seq++;
         side.emplace(r.entry_bytes, std::move(e));
         o.to_incref.push_back(r.key);
         o.to_incref.push_back(r.row);
@@ -2665,6 +2691,7 @@ PyObject *join_batch(PyObject *, PyObject *args)
         auto work = [&](int w) {
             JShard &sh = store->shards[(size_t)w];
             JShardOut &o = outs[(size_t)w];
+            std::vector<const JEntry *> ord; /* seq-ordered side view */
             for (const std::string *jkb : order[(size_t)w]) {
                 Aff &aff = touched[(size_t)w][*jkb];
                 auto git = sh.groups.find(*jkb);
@@ -2682,38 +2709,42 @@ PyObject *join_batch(PyObject *, PyObject *args)
                 JRef pad;
 
                 /* ΔL × R_old */
+                if (!aff.l.empty())
+                    jside_ordered(g.right, ord);
                 for (int32_t li : aff.l) {
                     const JRowX &dl = lx[(size_t)li];
                     JRef dref;
                     dref.kind = JR_PY;
                     dref.k = dl.key;
                     dref.row = dl.row;
-                    for (auto &e : g.right)
+                    for (const JEntry *e : ord)
                         o.emits.push_back(
-                            JEmit{dref, jref_of_entry(e.second),
-                                  dl.diff * e.second.count});
+                            JEmit{dref, jref_of_entry(*e),
+                                  dl.diff * e->count});
                     if (lpads && !rlive0)
                         o.emits.push_back(JEmit{dref, pad, dl.diff});
                 }
                 for (int32_t li : aff.l)
-                    japply(g.left, lx[(size_t)li], o);
+                    japply(g.left, lx[(size_t)li], o, g.next_seq);
 
                 /* L_new × ΔR */
+                if (!aff.r.empty())
+                    jside_ordered(g.left, ord);
                 for (int32_t ri : aff.r) {
                     const JRowX &dr = rx[(size_t)ri];
                     JRef dref;
                     dref.kind = JR_PY;
                     dref.k = dr.key;
                     dref.row = dr.row;
-                    for (auto &e : g.left)
+                    for (const JEntry *e : ord)
                         o.emits.push_back(
-                            JEmit{jref_of_entry(e.second), dref,
-                                  e.second.count * dr.diff});
+                            JEmit{jref_of_entry(*e), dref,
+                                  e->count * dr.diff});
                     if (rpads && !llive0)
                         o.emits.push_back(JEmit{pad, dref, dr.diff});
                 }
                 for (int32_t ri : aff.r)
-                    japply(g.right, rx[(size_t)ri], o);
+                    japply(g.right, rx[(size_t)ri], o, g.next_seq);
 
                 /* pad transitions: tracked pads now reflect (L1 vs Rlive0)
                  * and (R1 vs Llive0); correct for liveness flips */
@@ -2721,15 +2752,21 @@ PyObject *join_batch(PyObject *, PyObject *args)
                 const bool rlive1 = !g.right.empty();
                 if (lpads && rlive0 != rlive1) {
                     const int64_t sign = rlive1 ? -1 : 1;
-                    for (auto &e : g.left)
-                        o.emits.push_back(JEmit{jref_of_entry(e.second), pad,
-                                                sign * e.second.count});
+                    /* right liveness can only flip via ΔR, so the L_new
+                     * × ΔR block already left ord == ordered g.left
+                     * (g.left untouched since); re-sort only if not */
+                    if (aff.r.empty())
+                        jside_ordered(g.left, ord);
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{jref_of_entry(*e), pad,
+                                                sign * e->count});
                 }
                 if (rpads && llive0 != llive1) {
                     const int64_t sign = llive1 ? -1 : 1;
-                    for (auto &e : g.right)
-                        o.emits.push_back(JEmit{pad, jref_of_entry(e.second),
-                                                sign * e.second.count});
+                    jside_ordered(g.right, ord);
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{pad, jref_of_entry(*e),
+                                                sign * e->count});
                 }
                 if (g.left.empty() && g.right.empty()) {
                     if (g.jk != nullptr)
@@ -2795,26 +2832,32 @@ PyObject *join_store_dump(PyObject *, PyObject *arg)
         PyObject *lst = PyList_New(0);
         if (lst == nullptr)
             return nullptr;
-        for (auto &e : side) {
+        /* insertion (seq) order: the Python MultisetState dicts this
+         * feeds are insertion-ordered, and emission order after a
+         * demotion must match what the native path produced */
+        std::vector<const JEntry *> ord;
+        jside_ordered(side, ord);
+        for (const JEntry *ep : ord) {
+            const JEntry &entry = *ep;
             PyObject *t;
-            if (e.second.cells) {
+            if (entry.cells) {
                 PyObject *key =
-                    pointer_from_u128(s->ptr_type, e.second.key128);
+                    pointer_from_u128(s->ptr_type, entry.key128);
                 if (key == nullptr) {
                     Py_DECREF(lst);
                     return nullptr;
                 }
-                PyObject *row = packed_row_to_py(*e.second.cells, width);
+                PyObject *row = packed_row_to_py(*entry.cells, width);
                 if (row == nullptr) {
                     Py_DECREF(key);
                     Py_DECREF(lst);
                     return nullptr;
                 }
                 t = Py_BuildValue("(NNL)", key, row,
-                                  (long long)e.second.count);
+                                  (long long)entry.count);
             } else {
-                t = Py_BuildValue("(OOL)", e.second.key, e.second.row,
-                                  (long long)e.second.count);
+                t = Py_BuildValue("(OOL)", entry.key, entry.row,
+                                  (long long)entry.count);
             }
             if (t == nullptr || PyList_Append(lst, t) < 0) {
                 Py_XDECREF(t);
@@ -2908,8 +2951,8 @@ PyObject *join_store_load(PyObject *, PyObject *args)
             g.jk = jk;
         }
         auto load_side =
-            [](PyObject *lst,
-               std::unordered_map<std::string, JEntry> &side) -> bool {
+            [](PyObject *lst, std::unordered_map<std::string, JEntry> &side,
+               uint64_t &next_seq) -> bool {
             Py_ssize_t m = PyList_Size(lst);
             if (m < 0)
                 return false;
@@ -2930,6 +2973,7 @@ PyObject *join_store_load(PyObject *, PyObject *args)
                 ne.key = key;
                 ne.row = row;
                 ne.count = count;
+                ne.seq = next_seq++; /* dump order IS insertion order */
                 auto ins = side.emplace(eb, std::move(ne));
                 if (ins.second) {
                     Py_INCREF(key);
@@ -2941,7 +2985,8 @@ PyObject *join_store_load(PyObject *, PyObject *args)
             }
             return true;
         };
-        if (!load_side(lside, g.left) || !load_side(rside, g.right))
+        if (!load_side(lside, g.left, g.next_seq) ||
+            !load_side(rside, g.right, g.next_seq))
             return nullptr;
     }
     Py_RETURN_NONE;
@@ -3783,7 +3828,7 @@ struct JRowNb {
 };
 
 inline void japply_nb(std::unordered_map<std::string, JEntry> &side,
-                      const JRowNb &r, JShardOut &o)
+                      const JRowNb &r, JShardOut &o, uint64_t &next_seq)
 {
     auto it = side.find(r.entry_bytes);
     if (it == side.end()) {
@@ -3791,6 +3836,7 @@ inline void japply_nb(std::unordered_map<std::string, JEntry> &side,
         e.key128 = r.key128;
         e.cells = r.cells;
         e.count = 1;
+        e.seq = next_seq++;
         side.emplace(r.entry_bytes, std::move(e));
     } else {
         if (it->second.count > 0)
@@ -3945,6 +3991,7 @@ PyObject *join_batch_nb(PyObject *, PyObject *args)
         auto work = [&](int w) {
             JShard &sh = store->shards[(size_t)w];
             JShardOut &o = outs[(size_t)w];
+            std::vector<const JEntry *> ord; /* seq-ordered side view */
             for (const std::string *jkb : order[(size_t)w]) {
                 Aff &aff = touched[(size_t)w][*jkb];
                 auto git = sh.groups.find(*jkb);
@@ -3973,36 +4020,40 @@ PyObject *join_batch_nb(PyObject *, PyObject *args)
                 JRef pad;
 
                 /* ΔL × R_old */
+                if (!aff.l.empty())
+                    jside_ordered(g.right, ord);
                 for (int32_t li : aff.l) {
                     const JRowNb &dl = lx[(size_t)li];
                     JRef dref;
                     dref.kind = JR_NATIVE;
                     dref.key128 = dl.key128;
                     dref.cells = dl.cells;
-                    for (auto &e : g.right)
-                        o.emits.push_back(JEmit{dref, jref_of_entry(e.second),
-                                                e.second.count});
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{dref, jref_of_entry(*e),
+                                                e->count});
                     if (lpads && !rlive0)
                         o.emits.push_back(JEmit{dref, pad, 1});
                 }
                 for (int32_t li : aff.l)
-                    japply_nb(g.left, lx[(size_t)li], o);
+                    japply_nb(g.left, lx[(size_t)li], o, g.next_seq);
 
                 /* L_new × ΔR */
+                if (!aff.r.empty())
+                    jside_ordered(g.left, ord);
                 for (int32_t ri : aff.r) {
                     const JRowNb &dr = rx[(size_t)ri];
                     JRef dref;
                     dref.kind = JR_NATIVE;
                     dref.key128 = dr.key128;
                     dref.cells = dr.cells;
-                    for (auto &e : g.left)
-                        o.emits.push_back(JEmit{jref_of_entry(e.second), dref,
-                                                e.second.count});
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{jref_of_entry(*e), dref,
+                                                e->count});
                     if (rpads && !llive0)
                         o.emits.push_back(JEmit{pad, dref, 1});
                 }
                 for (int32_t ri : aff.r)
-                    japply_nb(g.right, rx[(size_t)ri], o);
+                    japply_nb(g.right, rx[(size_t)ri], o, g.next_seq);
 
                 /* pad transitions (liveness flips) — retractions: they
                  * disqualify the columnar output but stay exact */
@@ -4010,15 +4061,21 @@ PyObject *join_batch_nb(PyObject *, PyObject *args)
                 const bool rlive1 = !g.right.empty();
                 if (lpads && rlive0 != rlive1) {
                     const int64_t sign = rlive1 ? -1 : 1;
-                    for (auto &e : g.left)
-                        o.emits.push_back(JEmit{jref_of_entry(e.second), pad,
-                                                sign * e.second.count});
+                    /* right liveness can only flip via ΔR, so the L_new
+                     * × ΔR block already left ord == ordered g.left
+                     * (g.left untouched since); re-sort only if not */
+                    if (aff.r.empty())
+                        jside_ordered(g.left, ord);
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{jref_of_entry(*e), pad,
+                                                sign * e->count});
                 }
                 if (rpads && llive0 != llive1) {
                     const int64_t sign = llive1 ? -1 : 1;
-                    for (auto &e : g.right)
-                        o.emits.push_back(JEmit{pad, jref_of_entry(e.second),
-                                                sign * e.second.count});
+                    jside_ordered(g.right, ord);
+                    for (const JEntry *e : ord)
+                        o.emits.push_back(JEmit{pad, jref_of_entry(*e),
+                                                sign * e->count});
                 }
                 /* insert-only deltas can never empty a group */
             }
@@ -4584,6 +4641,23 @@ PyObject *shard_partition_nb(PyObject *, PyObject *args)
  *     [has_str: lens: n * 4 bytes | u64 arena_len | arena bytes]
  * Pure memcpy both ways — the wire image IS the in-memory image. */
 
+/* memcpy with the empty case made explicit: an empty vector's data() is
+ * null, and memcpy's pointer arguments are declared nonnull even for
+ * zero sizes (UBSan flags the n=0 frame) */
+inline void wire_put(char *&p, const void *src, size_t k)
+{
+    if (k)
+        memcpy(p, src, k);
+    p += k;
+}
+
+inline void wire_get(void *dst, const char *&p, size_t k)
+{
+    if (k)
+        memcpy(dst, p, k);
+    p += k;
+}
+
 PyObject *nb_encode(PyObject *, PyObject *args)
 {
     PyObject *nb_obj;
@@ -4617,23 +4691,18 @@ PyObject *nb_encode(PyObject *, PyObject *args)
         put_u32(1u);
         put_u32((uint32_t)n);
         put_u32((uint32_t)nb->width);
-        memcpy(p, nb->keys->data(), n * 16);
-        p += n * 16;
+        wire_put(p, nb->keys->data(), n * 16);
         for (int c = 0; c < nb->width; c++) {
             const NbCol &col = (*nb->cols)[(size_t)c];
             *p++ = (char)has_str[(size_t)c];
-            memcpy(p, col.tag.data(), n);
-            p += n;
-            memcpy(p, col.word.data(), n * 8);
-            p += n * 8;
+            wire_put(p, col.tag.data(), n);
+            wire_put(p, col.word.data(), n * 8);
             if (has_str[(size_t)c]) {
-                memcpy(p, col.len.data(), n * 4);
-                p += n * 4;
+                wire_put(p, col.len.data(), n * 4);
                 uint64_t alen = (uint64_t)col.arena.size();
                 memcpy(p, &alen, 8);
                 p += 8;
-                memcpy(p, col.arena.data(), col.arena.size());
-                p += col.arena.size();
+                wire_put(p, col.arena.data(), col.arena.size());
             }
         }
     }
@@ -4677,8 +4746,7 @@ PyObject *nb_decode(PyObject *, PyObject *args)
                 break;
             }
             nb->keys->resize(n);
-            memcpy(nb->keys->data(), p, (size_t)n * 16);
-            p += (size_t)n * 16;
+            wire_get(nb->keys->data(), p, (size_t)n * 16);
             for (uint32_t c = 0; c < width && !bad; c++) {
                 NbCol &col = (*nb->cols)[c];
                 if (!need(1 + (size_t)n * 9)) {
@@ -4687,19 +4755,16 @@ PyObject *nb_decode(PyObject *, PyObject *args)
                 }
                 uint8_t hs = (uint8_t)*p++;
                 col.tag.resize(n);
-                memcpy(col.tag.data(), p, n);
-                p += n;
+                wire_get(col.tag.data(), p, n);
                 col.word.resize(n);
-                memcpy(col.word.data(), p, (size_t)n * 8);
-                p += (size_t)n * 8;
+                wire_get(col.word.data(), p, (size_t)n * 8);
                 col.len.assign(n, 0);
                 if (hs) {
                     if (!need((size_t)n * 4 + 8)) {
                         bad = true;
                         break;
                     }
-                    memcpy(col.len.data(), p, (size_t)n * 4);
-                    p += (size_t)n * 4;
+                    wire_get(col.len.data(), p, (size_t)n * 4);
                     uint64_t alen;
                     memcpy(&alen, p, 8);
                     p += 8;
@@ -4830,25 +4895,19 @@ PyObject *deltas_encode(PyObject *, PyObject *args)
         put_u32(2u);
         put_u32((uint32_t)n);
         put_u32((uint32_t)w);
-        memcpy(p, keys.data(), (size_t)n * 16);
-        p += (size_t)n * 16;
-        memcpy(p, diffs.data(), (size_t)n * 4);
-        p += (size_t)n * 4;
+        wire_put(p, keys.data(), (size_t)n * 16);
+        wire_put(p, diffs.data(), (size_t)n * 4);
         for (Py_ssize_t c = 0; c < w; c++) {
             const NbCol &col = cols[(size_t)c];
             *p++ = (char)has_str[(size_t)c];
-            memcpy(p, col.tag.data(), (size_t)n);
-            p += n;
-            memcpy(p, col.word.data(), (size_t)n * 8);
-            p += (size_t)n * 8;
+            wire_put(p, col.tag.data(), (size_t)n);
+            wire_put(p, col.word.data(), (size_t)n * 8);
             if (has_str[(size_t)c]) {
-                memcpy(p, col.len.data(), (size_t)n * 4);
-                p += (size_t)n * 4;
+                wire_put(p, col.len.data(), (size_t)n * 4);
                 uint64_t alen = (uint64_t)col.arena.size();
                 memcpy(p, &alen, 8);
                 p += 8;
-                memcpy(p, col.arena.data(), col.arena.size());
-                p += col.arena.size();
+                wire_put(p, col.arena.data(), col.arena.size());
             }
         }
         return out;
@@ -4892,17 +4951,14 @@ PyObject *deltas_decode(PyObject *, PyObject *args)
             goto corrupt;
         uint8_t hs = (uint8_t)*p++;
         col.tag.resize(n);
-        memcpy(col.tag.data(), p, n);
-        p += n;
+        wire_get(col.tag.data(), p, n);
         col.word.resize(n);
-        memcpy(col.word.data(), p, (size_t)n * 8);
-        p += (size_t)n * 8;
+        wire_get(col.word.data(), p, (size_t)n * 8);
         col.len.assign(n, 0);
         if (hs) {
             if (!need((size_t)n * 4 + 8))
                 goto corrupt;
-            memcpy(col.len.data(), p, (size_t)n * 4);
-            p += (size_t)n * 4;
+            wire_get(col.len.data(), p, (size_t)n * 4);
             uint64_t alen;
             memcpy(&alen, p, 8);
             p += 8;
@@ -4988,10 +5044,19 @@ PyObject *nb_concat(PyObject *, PyObject *args)
     NativeBatchObject *out = nb_alloc(first->width, first->ptr_type);
     if (out == nullptr)
         return nullptr;
+    /* snapshot AND pin the items with the GIL held: PyList_GET_ITEM is
+     * Python API and returns borrowed refs — another thread could mutate
+     * the caller's list (dropping an item's last reference) while this
+     * one runs GIL-free (scripts/lint_gil.py) */
+    std::vector<NativeBatchObject *> srcs((size_t)k);
+    for (Py_ssize_t j = 0; j < k; j++) {
+        srcs[(size_t)j] =
+            reinterpret_cast<NativeBatchObject *>(PyList_GET_ITEM(lst, j));
+        Py_INCREF(srcs[(size_t)j]);
+    }
     Py_BEGIN_ALLOW_THREADS;
     for (Py_ssize_t j = 0; j < k; j++) {
-        auto *src =
-            reinterpret_cast<NativeBatchObject *>(PyList_GET_ITEM(lst, j));
+        NativeBatchObject *src = srcs[(size_t)j];
         out->keys->insert(out->keys->end(), src->keys->begin(),
                           src->keys->end());
         for (int c = 0; c < first->width; c++)
@@ -4999,6 +5064,8 @@ PyObject *nb_concat(PyObject *, PyObject *args)
     }
     out->n = (Py_ssize_t)out->keys->size();
     Py_END_ALLOW_THREADS;
+    for (Py_ssize_t j = 0; j < k; j++)
+        Py_DECREF(srcs[(size_t)j]);
     return reinterpret_cast<PyObject *>(out);
 }
 

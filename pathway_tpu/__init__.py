@@ -130,6 +130,9 @@ def udf_async(fun=None, **kwargs):
 __version__ = "0.1.0"
 
 _LAZY_ATTRS = {
+    # plan doctor (static dataflow-plan analysis)
+    "analyze": ("pathway_tpu.analysis.analyzer", "analyze"),
+    "PlanReport": ("pathway_tpu.analysis.analyzer", "PlanReport"),
     # join-result classes exposed at top level (reference __all__)
     "IntervalJoinResult": ("pathway_tpu.stdlib.temporal", "IntervalJoinResult"),
     "AsofJoinResult": ("pathway_tpu.stdlib.temporal", "AsofJoinResult"),
@@ -145,6 +148,7 @@ _LAZY_ATTRS = {
 }
 
 _LAZY_MODULES = {
+    "analysis": "pathway_tpu.analysis",
     "demo": "pathway_tpu.demo",
     "indexing": "pathway_tpu.stdlib.indexing",
     "temporal": "pathway_tpu.stdlib.temporal",

@@ -1,0 +1,174 @@
+"""Plan-doctor CLI.
+
+    python -m pathway_tpu.analysis [--json] [--processes N]
+        [--require-fused] program.py [prog args...]
+    python -m pathway_tpu.analysis --bench [--json] [--update-artifact]
+
+Doctor options go BEFORE the program path; everything after it is the
+program's own argv (flags included), exactly like ``python script.py``.
+
+Program mode loads the user program with ``Runtime.run`` stubbed out:
+``pw.run()`` still LOWERS the captured graph (cheap, pure construction)
+but never starts connector threads or the process mesh; the captured
+ParseGraph is then analyzed. ``--require-fused`` exits non-zero unless
+the plan verdict is "fused" — the CI gate for "this pipeline must stay
+on the NativeBatch fused chain".
+
+Bench mode analyzes the canonical bench pipeline shapes
+(analysis/bench.py) and, with ``--update-artifact``, annotates the
+matching BENCH_full.json metric lines in place with ``plan_verdict`` so
+future perf regressions triage as "plan degraded" vs "engine slower".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+
+def _analyze_program(args) -> int:
+    from pathway_tpu.analysis.analyzer import analyze
+    from pathway_tpu.engine.runtime import Runtime
+
+    prog = args.program
+    sys.argv = [prog, *args.arguments]
+    sys.path.insert(0, os.path.dirname(os.path.abspath(prog)) or ".")
+    orig_run = Runtime.run
+    orig_init = Runtime.__init__
+    Runtime.run = lambda self, *a, **k: None  # lower, never execute
+    # knob findings must land as knob.* diagnostics in the report, not as
+    # a KnobError traceback out of the user program's own pw.run()
+    seen = {"persistence": False}
+
+    def _init(self, *a, **k):
+        # the program's pw.run(persistence_config=...) reaches Runtime as
+        # persistence= — remember it so the replay pass knows this plan
+        # runs persisted (the analyzer's own scratch Runtime does not)
+        if k.get("persistence") is not None:
+            seen["persistence"] = True
+        return orig_init(self, *a, **{**k, "validate_env": False})
+
+    Runtime.__init__ = _init
+    try:
+        # run_name="__main__" executes the program's `if __name__ ==`
+        # block, so a `sys.exit(main())` tail must not abort the doctor
+        # (with SystemExit(0) a --require-fused gate would vacuously
+        # pass, with no report at all) — the graph is captured, analyze
+        try:
+            runpy.run_path(prog, run_name="__main__")
+        except SystemExit:
+            pass
+    finally:
+        Runtime.run = orig_run
+        Runtime.__init__ = orig_init
+    report = analyze(
+        processes=args.processes,
+        persistence=seen["persistence"] or None,
+    )
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    if args.require_fused and not report.fully_fused:
+        print(
+            f"plan is {report.verdict!r}, not fused (--require-fused)",
+            file=sys.stderr,
+        )
+        return 1
+    if report.errors():
+        return 2
+    return 0
+
+
+def _analyze_bench(args) -> int:
+    from pathway_tpu.analysis.bench import BENCH_METRIC_PLANS, bench_verdicts
+
+    verdicts = bench_verdicts()
+    if args.json:
+        print(json.dumps(verdicts, indent=2))
+    else:
+        for name, verdict in sorted(verdicts.items()):
+            print(f"{name:<24} {verdict}")
+    if args.update_artifact:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        path = os.path.join(repo, "BENCH_full.json")
+        try:
+            with open(path) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            print(f"no artifact at {path}", file=sys.stderr)
+            return 1
+        n = 0
+        for entry in artifact:
+            if not isinstance(entry, dict):
+                continue
+            plan = BENCH_METRIC_PLANS.get(entry.get("metric"))
+            if plan is None:
+                continue
+            name, world = plan
+            entry["plan_verdict"] = verdicts[f"{name}@{world}rank"]
+            n += 1
+        sys.path.insert(0, repo)
+        from bench_util import write_artifact_atomic
+
+        write_artifact_atomic(path, artifact)
+        print(f"annotated {n} metric line(s) in {path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pathway_tpu.analysis",
+        description="Plan Doctor: static dataflow-plan analysis",
+    )
+    parser.add_argument("program", nargs="?", help="pipeline program to analyze")
+    # REMAINDER: everything after the program path is the PROGRAM's argv
+    # (flags included — `doctor prog.py --limit 5` must forward --limit,
+    # not die on 'unrecognized arguments'); doctor options go BEFORE it
+    parser.add_argument(
+        "arguments", nargs=argparse.REMAINDER, help="program arguments"
+    )
+    parser.add_argument("--json", action="store_true", help="JSON report")
+    parser.add_argument(
+        "--processes", type=int, default=None,
+        help="analyze the plan as an N-rank mesh (exchange boundaries)",
+    )
+    parser.add_argument(
+        "--require-fused", action="store_true",
+        help="exit non-zero unless the plan verdict is 'fused' (CI gate)",
+    )
+    parser.add_argument(
+        "--bench", action="store_true",
+        help="analyze the canonical bench pipelines instead of a program",
+    )
+    parser.add_argument(
+        "--update-artifact", action="store_true",
+        help="with --bench: annotate BENCH_full.json lines with "
+             "plan_verdict",
+    )
+    args = parser.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the doctor must DIAGNOSE a broken environment, not crash on it:
+    # config-backed knobs validate lazily (config._load_config), so a
+    # bad PATHWAY_* var raises KnobError out of the analysis/lowering
+    # calls below — caught here instead of crashing the package import
+    from pathway_tpu.analysis.knobs import KnobError
+
+    try:
+        if args.bench:
+            return _analyze_bench(args)
+        if not args.program:
+            parser.error("a program path (or --bench) is required")
+        return _analyze_program(args)
+    except KnobError as e:
+        print(f"[ERROR  ] knob.invalid env\n      {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

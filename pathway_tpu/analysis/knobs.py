@@ -1,0 +1,271 @@
+"""Central registry of every ``PATHWAY_*`` environment knob.
+
+Before this registry the knobs were scattered ``os.environ`` reads across
+config/nodes/procgroup/supervisor/io — a typo (``PATHWAY_THREDS=8``,
+``PATHWAY_NO_NB_JOIN=0`` meaning *on* under truthiness) was silently
+ignored or silently misread. The runtime now validates the environment at
+startup (engine/runtime.py) and rejects unknown or out-of-range values;
+``pw.analyze`` reports the same findings as diagnostics, and the README
+knob table is generated from here (``knob_table_markdown``).
+
+Escape hatch: ``PATHWAY_KNOB_CHECK=0`` downgrades startup rejection to a
+logged warning (for embedding environments that share a process with
+unrelated PATHWAY_* vars).
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+# matches config._env_bool_field: an empty string is NOT a boolean (a
+# `VAR= cmd` shell accident), even though the pure-flag readers
+# (eligibility.env_flag) would defensively treat it as off
+_BOOL_VALUES = ("0", "1", "false", "true", "no", "yes")
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str               # "int" | "float" | "bool" | "str" | "enum"
+    default: Any
+    description: str
+    lo: float | None = None  # inclusive bounds for int/float
+    hi: float | None = None
+    choices: tuple = ()      # for enum
+
+    def check(self, raw: str) -> str | None:
+        """Problem description for a raw env value, or None when valid."""
+        if self.type == "bool":
+            if raw.strip().lower() not in _BOOL_VALUES:
+                return (
+                    f"expected a boolean ({'/'.join(_BOOL_VALUES)}), "
+                    f"got {raw!r}"
+                )
+            return None
+        if self.type in ("int", "float"):
+            try:
+                val = int(raw) if self.type == "int" else float(raw)
+            except ValueError:
+                return f"expected {self.type}, got {raw!r}"
+            if self.lo is not None and val < self.lo:
+                return f"{val} is below the minimum {self.lo}"
+            if self.hi is not None and val > self.hi:
+                return f"{val} is above the maximum {self.hi}"
+            return None
+        if self.type == "enum":
+            if raw not in self.choices:
+                return (
+                    f"expected one of {list(self.choices)}, got {raw!r}"
+                )
+            return None
+        return None  # free-form str
+
+
+def _k(name, type, default, description, lo=None, hi=None, choices=()):
+    return Knob(name, type, default, description, lo, hi, tuple(choices))
+
+
+KNOBS: dict[str, Knob] = {
+    k.name: k
+    for k in [
+        # -- core topology ------------------------------------------------
+        _k("PATHWAY_THREADS", "int", 1,
+           "Native executor shard threads per process (C++ apply phase "
+           "runs GIL-free across them).", lo=1, hi=1024),
+        _k("PATHWAY_PROCESSES", "int", 1,
+           "World size of the process mesh (multi-rank runs).", lo=1,
+           hi=4096),
+        _k("PATHWAY_PROCESS_ID", "int", 0,
+           "This rank's id in [0, PATHWAY_PROCESSES).", lo=0, hi=4095),
+        _k("PATHWAY_FIRST_PORT", "int", 10000,
+           "Base TCP port of the mesh; rank r listens on base + r.",
+           lo=1, hi=65535),
+        _k("PATHWAY_HOSTS", "str", None,
+           "Comma-separated host[:port] list for multi-host meshes "
+           "(default: loopback)."),
+        _k("PATHWAY_COORDINATOR", "str", None,
+           "Coordinator endpoint for jax.distributed initialization."),
+        _k("PATHWAY_SPAWN_ARGS", "str", None,
+           "Arguments for `pathway spawn-from-env`."),
+        # -- run configuration --------------------------------------------
+        _k("PATHWAY_RUN_ID", "str", None, "Run identifier (telemetry)."),
+        _k("PATHWAY_LICENSE_KEY", "str", None,
+           "License key (recorded, not enforced in this build)."),
+        _k("PATHWAY_MONITORING_SERVER", "str", None,
+           "OTLP endpoint for telemetry export."),
+        _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
+           "Abort the run on the first data error instead of poisoning "
+           "rows to ERROR."),
+        _k("PATHWAY_IGNORE_ASSERTS", "bool", False,
+           "Skip runtime assert_table_has_* checks."),
+        _k("PATHWAY_RUNTIME_TYPECHECKING", "bool", False,
+           "Enable runtime dtype checks on column values."),
+        _k("PATHWAY_KNOB_CHECK", "bool", True,
+           "Validate PATHWAY_* env vars at startup; 0 downgrades "
+           "rejection to a warning."),
+        # -- persistence / replay -----------------------------------------
+        _k("PATHWAY_REPLAY_STORAGE", "str", None,
+           "Filesystem path for record/replay storage."),
+        _k("PATHWAY_SNAPSHOT_ACCESS", "enum", None,
+           "Record/replay mode for PATHWAY_REPLAY_STORAGE.",
+           choices=("record", "replay", "speedrun")),
+        _k("PATHWAY_PERSISTENCE_MODE", "str", None,
+           "Persistence mode override (e.g. OPERATOR_PERSISTING)."),
+        _k("PATHWAY_CONTINUE_AFTER_REPLAY", "bool", False,
+           "Keep consuming live data after replay finishes."),
+        _k("PATHWAY_PERSISTENT_STORAGE", "str", None,
+           "Directory for persistent UDF caches (udfs/caches.py)."),
+        # -- NativeBatch fused chain --------------------------------------
+        _k("PATHWAY_NO_NB_JOIN", "bool", False,
+           "Force joins onto the tuple path (fused-vs-tuple parity "
+           "batteries)."),
+        _k("PATHWAY_NO_NB_EXCHANGE", "bool", False,
+           "Force exchanges onto the pickled tuple path."),
+        _k("PATHWAY_NB_STRICT", "bool", False,
+           "Raise NBStrictError (with fusion blame) when a fused-eligible "
+           "node demotes or de-optimizes to the tuple path, instead of "
+           "degrading silently."),
+        _k("PATHWAY_NATIVE_BUILD_DIR", "str", None,
+           "Override the native extension build dir (sanitizer lanes)."),
+        # -- connector supervision ----------------------------------------
+        _k("PATHWAY_CONNECTOR_MAX_RESTARTS", "int", 3,
+           "In-place restart budget per connector subject.", lo=0,
+           hi=1_000_000),
+        _k("PATHWAY_CONNECTOR_BACKOFF_MS", "int", 500,
+           "Base backoff between connector restarts (exponential, "
+           "seeded jitter).", lo=0, hi=3_600_000),
+        # -- fault injection ----------------------------------------------
+        _k("PATHWAY_FAULT_PLAN", "str", None,
+           "Deterministic fault-injection schedule "
+           "(internals/faults.py plan syntax)."),
+        # -- mesh fault tolerance -----------------------------------------
+        _k("PATHWAY_MESH_SECRET", "str", None,
+           "Shared secret MAC'd into the mesh handshake."),
+        _k("PATHWAY_MESH_EPOCH", "int", 0,
+           "Recovery epoch bound into the handshake (set by the "
+           "supervisor on rollback respawns).", lo=0, hi=1_000_000_000),
+        _k("PATHWAY_MESH_HEARTBEAT_S", "float", 2.0,
+           "Heartbeat frame cadence per peer link (0 disables).", lo=0,
+           hi=3600),
+        _k("PATHWAY_MESH_PEER_TIMEOUT_S", "float", 10.0,
+           "Liveness window before a silent peer is declared failed.",
+           lo=0.001, hi=86400),
+        _k("PATHWAY_MESH_OP_TIMEOUT_S", "float", 300.0,
+           "Hard deadline on every mesh collective (0 disables).",
+           lo=0, hi=86400),
+        _k("PATHWAY_MESH_MAX_FRAME_MB", "int", 256,
+           "Receiver-side cap on a single exchange frame.", lo=1,
+           hi=65536),
+        _k("PATHWAY_MESH_SUPERVISED", "bool", False,
+           "Exit MESH_RESTART_EXIT_CODE on mesh failure so the "
+           "supervisor can roll the epoch back."),
+        _k("PATHWAY_MESH_GRACE_S", "float", 20.0,
+           "Supervisor grace period before SIGKILL on rollback.", lo=0,
+           hi=3600),
+        _k("PATHWAY_MESH_MAX_RESTARTS", "int", 3,
+           "Supervisor rollback budget.", lo=0, hi=1_000_000),
+        # -- CI / test harness --------------------------------------------
+        _k("PATHWAY_LANE_PROCESSES", "int", 1,
+           "Emulated-rank CI lane: every run transparently joins N "
+           "thread-ranks over loopback TCP.", lo=1, hi=64),
+        _k("PATHWAY_TPU_TEST_REAL", "bool", False,
+           "Run the test suite against the real TPU chip instead of the "
+           "virtual 8-device CPU mesh."),
+    ]
+}
+
+
+class KnobError(ValueError):
+    """Unknown or out-of-range PATHWAY_* environment variable."""
+
+
+def validate_environment(
+    environ: Mapping[str, str] | None = None,
+) -> list[tuple[str, str, str | None]]:
+    """Scan ``environ`` for PATHWAY_* vars; return a list of
+    ``(name, problem, hint)`` findings (empty when clean)."""
+    environ = os.environ if environ is None else environ
+    findings: list[tuple[str, str, str | None]] = []
+    for name in sorted(environ):
+        if not name.startswith("PATHWAY_"):
+            continue
+        raw = environ[name]
+        knob = KNOBS.get(name)
+        if knob is None:
+            close = difflib.get_close_matches(name, KNOBS, n=1, cutoff=0.75)
+            hint = f"did you mean {close[0]}?" if close else (
+                "see the PATHWAY_* knob table in README.md"
+            )
+            findings.append((name, "unknown knob (typo?)", hint))
+            continue
+        problem = knob.check(raw)
+        if problem is not None:
+            findings.append(
+                (name, problem, f"default: {knob.default!r} — "
+                                f"{knob.description}")
+            )
+    return findings
+
+
+def knob_check_disabled() -> bool:
+    """The PATHWAY_KNOB_CHECK=0 escape hatch: downgrade knob rejection
+    to a warning (embedding environments sharing a process with
+    unrelated PATHWAY_* vars)."""
+    return os.environ.get("PATHWAY_KNOB_CHECK", "1").strip().lower() in (
+        "0", "false", "no",
+    )
+
+
+_checked: tuple | None = None
+
+
+def enforce_environment() -> None:
+    """Startup gate: raise KnobError on unknown/out-of-range PATHWAY_*
+    vars (warn-only under PATHWAY_KNOB_CHECK=0). Memoized per environment
+    snapshot — runtimes are created per run and per emulated rank."""
+    global _checked
+    snapshot = tuple(
+        sorted(
+            (k, v) for k, v in os.environ.items() if k.startswith("PATHWAY_")
+        )
+    )
+    if snapshot == _checked:
+        return
+    findings = validate_environment()
+    if not findings:
+        _checked = snapshot
+        return
+    lines = [
+        f"  {name}: {problem}" + (f" ({hint})" if hint else "")
+        for name, problem, hint in findings
+    ]
+    msg = "invalid PATHWAY_* environment knob(s):\n" + "\n".join(lines)
+    if knob_check_disabled():
+        import logging
+
+        logging.getLogger(__name__).warning(msg)
+        _checked = snapshot
+        return
+    raise KnobError(msg)
+
+
+def knob_table_markdown() -> str:
+    """README knob table, generated from the registry so docs cannot
+    drift from the code."""
+    rows = [
+        "| knob | type | default | description |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        typ = k.type
+        if k.type in ("int", "float") and (k.lo is not None or k.hi is not None):
+            typ = f"{k.type} [{k.lo if k.lo is not None else ''}..{k.hi if k.hi is not None else ''}]"
+        elif k.type == "enum":
+            typ = " \\| ".join(k.choices)
+        default = "" if k.default is None else repr(k.default)
+        rows.append(f"| `{name}` | {typ} | {default} | {k.description} |")
+    return "\n".join(rows) + "\n"

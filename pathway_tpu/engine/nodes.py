@@ -24,6 +24,7 @@ from typing import Any, Callable, Iterable
 import numpy as _np
 
 from pathway_tpu.internals.api import ERROR, Pointer, ref_scalar
+from pathway_tpu.analysis import eligibility as _elig
 from pathway_tpu.engine.stream import (
     ConsolidatedList,
     Delta,
@@ -170,11 +171,19 @@ class RowwiseNode(Node):
         input_node,
         batch_fn: Callable[[list[Key], list[Row]], list[Row]],
         nb_proj_idx=None,
+        nb_blame=(),
+        src_exprs=None,
     ):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
         self._nb_proj = tuple(nb_proj_idx) if nb_proj_idx is not None else None
+        # construction-time fused verdict + blame (analysis/eligibility.py)
+        self.nb_decision = _elig.decide_rowwise_nb(
+            nb_proj_idx=nb_proj_idx, blame=nb_blame
+        )
+        self.src_exprs = src_exprs  # expression provenance (pw.analyze)
         self._nb_batches = 0  # chain-path spy counter (tests)
+        self._nb_fallbacks = 0
 
     def process(self, time, batches):
         if self._nb_proj is not None and is_native_batch(batches[0]):
@@ -184,7 +193,11 @@ class RowwiseNode(Node):
             if ex is not None and hasattr(ex, "nb_project"):
                 try:
                     out = ex.nb_project(batches[0], self._nb_proj)
-                except Exception:
+                except Exception as exc:
+                    if _elig.nb_strict():
+                        raise _elig.strict_error(
+                            self, "fused projection failed", exc
+                        ) from exc
                     # stateless, so the materialized path below recomputes
                     # this batch safely — but a projection that failed once
                     # will fail every batch: disable it for this node and
@@ -197,6 +210,10 @@ class RowwiseNode(Node):
                         exc_info=True,
                     )
                     self._nb_proj = None
+                    # demotion is permanent: count ONE fallback for the
+                    # node, not one per subsequent batch
+                    self._nb_fallbacks += 1
+                    self.scope.runtime.stats.on_nb_fallback()
                 else:
                     self._nb_batches += 1
                     return out
@@ -391,7 +408,8 @@ class ExchangeNode(Node):
     the identical framing, so both schedulers interoperate."""
 
     def __init__(
-        self, scope, input_node, key_batch=None, mode="hash", nb_kidx=None
+        self, scope, input_node, key_batch=None, mode="hash", nb_kidx=None,
+        nb_blame=(),
     ):
         super().__init__(scope, [input_node])
         self.key_batch = key_batch
@@ -399,11 +417,20 @@ class ExchangeNode(Node):
         # plain-column shard key: tuple of column indices, "id" (route by
         # the row's own Pointer), or None (tuple path only)
         self.nb_kidx = nb_kidx
-        import os as _os
-
-        self._nb_ok = not _os.environ.get("PATHWAY_NO_NB_EXCHANGE")
+        # construction-time fused verdict + blame (analysis/eligibility.py
+        # — the same predicate _slice gates the columnar path on)
+        self.nb_decision = _elig.decide_exchange_nb(
+            mode=mode, nb_kidx=nb_kidx, blame=nb_blame
+        )
+        self._nb_ok = not _elig.nb_exchange_forced_off()
         self._nb_batches = 0  # columnar batches through this boundary
-        self._fallbacks = 0   # non-empty batches on the tuple path
+        # non-empty batches that DE-OPTIMIZED to the tuple path: counted
+        # only when the input was statically expected columnar
+        # (eligibility.expects_native_batch) — tuple flow that was never
+        # columnar (e.g. a gather of materialized groupby output) is the
+        # plan's steady state, not a fallback, and pw.analyze verdicts
+        # must agree with this counter
+        self._fallbacks = 0
 
     @staticmethod
     def _pwexec():
@@ -458,7 +485,12 @@ class ExchangeNode(Node):
             rt.stats.on_exchange_elided(world - 1 - len(sends))
             return own, sends
         deltas = consolidate(batch) if batch else []
-        if deltas:
+        if deltas and _elig.expects_native_batch(self.inputs[0]):
+            if _elig.nb_strict() and self.nb_decision.ok:
+                raise _elig.strict_error(
+                    self, "statically-columnar input fell to the pickled "
+                    "tuple exchange path",
+                )
             self._fallbacks += 1
             rt.stats.on_exchange_fallback()
         if self.mode == "hash":
@@ -571,13 +603,42 @@ class GroupDiffNode(Node):
     # (JoinNode: _jstore; GroupByNode: _store) — used by _poison_demote
     _NATIVE_STORE_ATTR: str | None = None
 
-    def _poison_demote(self) -> None:
+    # fused-chain fallback accounting (JoinNode/GroupByNode set these in
+    # their constructors; other GroupDiff subclasses have no fused path)
+    _nb_fallbacks = 0
+    _fallback_demoted = False
+
+    def _count_nb_fallback(self, demoted: bool = False) -> None:
+        """A batch that was expected columnar executed on the tuple path.
+        Counted per batch while the node stays fused-eligible; a PERMANENT
+        demotion (poison / unsupported-value migration) is counted exactly
+        once — without the guard a poison-demoted node would re-count
+        every subsequent batch of the run."""
+        if self._fallback_demoted:
+            return
+        if demoted:
+            self._fallback_demoted = True
+        if not any(_elig.expects_native_batch(i) for i in self.inputs):
+            # the input was never expected columnar (static tables, an
+            # already-broken upstream chain): the tuple path is the plan's
+            # steady state, not a de-optimization
+            return
+        self._nb_fallbacks += 1
+        self.scope.runtime.stats.on_nb_fallback()
+
+    def _poison_demote(self, already_counted: bool = False) -> None:
         """A non-Fallback error escaped the native executor after phase 1:
         the batch may be half-applied, so the store is poisoned for
         replay (native/exec.cpp replay invariant). Demote the node —
         salvage the store's (self-consistent) state into the Python path
         when possible, discard it otherwise — so no later call can
         re-apply the batch against it."""
+        if already_counted:
+            # the triggering batch already counted its fallback on entry
+            # to the tuple path; just freeze the counter
+            self._fallback_demoted = True
+        else:
+            self._count_nb_fallback(demoted=True)
         attr = self._NATIVE_STORE_ATTR
         try:
             if getattr(self, attr) is not None:
@@ -777,6 +838,7 @@ class JoinNode(GroupDiffNode):
         rkey_batch=None,
         nb_lkidx=None,
         nb_rkidx=None,
+        nb_blame=(),
     ):
         super().__init__(scope, [left_node, right_node])
         self.left_key_fn = left_key_fn
@@ -809,19 +871,22 @@ class JoinNode(GroupDiffNode):
         # and no per-row id= Python functions (id_from_left/right are
         # mintable natively). PATHWAY_NO_NB_JOIN=1 force-disables — the
         # parity batteries use it to pin fused-vs-tuple bit-identity.
-        import os as _os
-
-        self._nb_ok = (
-            self._native_ok
-            and nb_lkidx is not None
-            and nb_rkidx is not None
-            and left_id_fn is None
-            and right_id_fn is None
-            and not _os.environ.get("PATHWAY_NO_NB_JOIN")
+        # The predicate + blame live in analysis/eligibility.py, shared
+        # with pw.analyze.
+        self.nb_decision = _elig.decide_join_nb(
+            native_ok=self._native_ok,
+            nb_lkidx=nb_lkidx,
+            nb_rkidx=nb_rkidx,
+            left_id_fn=left_id_fn,
+            right_id_fn=right_id_fn,
+            blame=nb_blame,
         )
+        self._nb_ok = self.nb_decision.ok
         self._nb_lkidx = tuple(nb_lkidx) if nb_lkidx is not None else None
         self._nb_rkidx = tuple(nb_rkidx) if nb_rkidx is not None else None
         self._nb_batches = 0  # chain-path spy counter (tests/bench)
+        self._nb_fallbacks = 0
+        self._fallback_demoted = False
         self._exec = None
         self._jstore = None
 
@@ -883,10 +948,11 @@ class JoinNode(GroupDiffNode):
         self._native_ok = False
 
     def process(self, time, batches):
+        nb_in = is_native_batch(batches[0]) or is_native_batch(batches[1])
         if (
             self._nb_ok
             and self._native_ok  # demotion (migrate/load_state) clears this
-            and (is_native_batch(batches[0]) or is_native_batch(batches[1]))
+            and nb_in
             and (is_native_batch(batches[0]) or not batches[0])
             and (is_native_batch(batches[1]) or not batches[1])
             and self._native_setup()
@@ -903,10 +969,14 @@ class JoinNode(GroupDiffNode):
                     self._nb_rkidx,
                     Pointer,
                 )
-            except self._exec.Fallback:
+            except self._exec.Fallback as fb:
                 # phase 1 mutates nothing: replay the same batches on the
                 # tuple path below (which materializes them)
-                pass
+                if _elig.nb_strict():
+                    raise _elig.strict_error(
+                        self, "columnar batch de-optimized to the tuple "
+                        "path", fb,
+                    ) from fb
             except Exception:
                 self._poison_demote()
                 raise
@@ -921,6 +991,10 @@ class JoinNode(GroupDiffNode):
                 if self.join_type == "inner" and not dup_bump:
                     return ConsolidatedList(raw)
                 return consolidate(raw)
+        if nb_in:
+            # columnar input executing on the tuple path: a fused-chain
+            # de-optimization the analyzer must be able to predict
+            self._count_nb_fallback()
         lb = consolidate(batches[0])
         rb = consolidate(batches[1])
         if not lb and not rb:
@@ -947,13 +1021,23 @@ class JoinNode(GroupDiffNode):
                     getattr(get_fp(), "ref_scalar_v", None) or ref_scalar,
                     self.left_id_fn or self.right_id_fn,
                 )
-            except self._exec.Fallback:
+            except self._exec.Fallback as fb:
+                if _elig.nb_strict() and self.nb_decision.ok:
+                    raise _elig.strict_error(
+                        self, "native join store demoted to the Python "
+                        "path", fb,
+                    ) from fb
+                # permanent demotion: this batch was already counted if it
+                # arrived columnar; later batches must not re-count
+                if not nb_in:
+                    self._count_nb_fallback(demoted=True)
+                self._fallback_demoted = True
                 self._migrate_to_python()
             except Exception:
                 # non-Fallback past phase 1 (e.g. a key fn raising in
                 # emit): the batch is half-applied — demote so a replay
                 # cannot double-count (native/exec.cpp replay invariant)
-                self._poison_demote()
+                self._poison_demote(already_counted=nb_in)
                 raise
             else:
                 # insert-only INNER batches are net form by construction:
@@ -1075,6 +1159,7 @@ class GroupByNode(GroupDiffNode):
         native_order=None,    # sort_by batch column fn (order tokens)
         nb_gidx=None,         # grouping column indices (NativeBatch path)
         nb_argidx=None,       # per spec: arg column index | None (count)
+        nb_blame=(),          # lowering-time ineligibility blame
     ):
         super().__init__(scope, [input_node])
         self.grouping_fn = grouping_fn
@@ -1122,16 +1207,23 @@ class GroupByNode(GroupDiffNode):
         # Python). Abelian-only stores (count/sum/avg) with plain-column
         # grouping/args and no sort_by qualify; everything else
         # materializes the batch into the general native path below.
-        self._nb_ok = (
-            self._native_ok
-            and nb_gidx is not None
-            and nb_argidx is not None
-            and native_order is None
-            and all(c in ("count", "sum", "avg") for c in self.native_codes)
+        # The predicate + blame live in analysis/eligibility.py, shared
+        # with pw.analyze.
+        self.nb_decision = _elig.decide_groupby_nb(
+            native_ok=self._native_ok,
+            nb_gidx=nb_gidx,
+            nb_argidx=nb_argidx,
+            native_order=native_order,
+            native_codes=self.native_codes,
+            blame=nb_blame,
         )
+        self._nb_ok = self.nb_decision.ok
         self._nb_gidx = tuple(nb_gidx) if nb_gidx is not None else None
         self._nb_argidx = tuple(nb_argidx) if nb_argidx is not None else None
         self._nb_batches = 0  # chain-path spy counter (tests)
+        self._nb_fallbacks = 0
+        self._fallback_demoted = False
+        self.src_exprs = None  # expression provenance (pw.analyze)
         self._exec = None
         self._store = None
         # frozen gvals -> [gvals, ms_or_None, abelian_states, total_count,
@@ -1220,10 +1312,11 @@ class GroupByNode(GroupDiffNode):
         self._native_ok = False
 
     def process(self, time, batches):
+        nb_in = is_native_batch(batches[0])
         if (
             self._nb_ok
             and self._native_ok  # demotion (migrate/load_state) clears this
-            and is_native_batch(batches[0])
+            and nb_in
             and self._native_setup()
         ):
             try:
@@ -1234,15 +1327,23 @@ class GroupByNode(GroupDiffNode):
                 )
                 self._nb_batches += 1
                 return out
-            except self._exec.Fallback:
+            except self._exec.Fallback as fb:
                 # store stays valid (phase 1 mutates nothing): materialize
                 # and run the general path — do NOT demote the node
-                pass
+                if _elig.nb_strict():
+                    raise _elig.strict_error(
+                        self, "columnar batch de-optimized to the tuple "
+                        "path", fb,
+                    ) from fb
             except Exception:
                 # non-Fallback past phase 1: half-applied batch — demote
                 # so a replay cannot double-count (replay invariant)
                 self._poison_demote()
                 raise
+        if nb_in:
+            # columnar input executing on the tuple path: a fused-chain
+            # de-optimization the analyzer must be able to predict
+            self._count_nb_fallback()
         batch = consolidate(batches[0])
         if not batch:
             return []
@@ -1283,12 +1384,21 @@ class GroupByNode(GroupDiffNode):
                         k,
                     )
                 return out
-            except self._exec.Fallback:
+            except self._exec.Fallback as fb:
+                if _elig.nb_strict() and self.nb_decision.ok:
+                    raise _elig.strict_error(
+                        self, "native group store demoted to the Python "
+                        "path", fb,
+                    ) from fb
+                # permanent demotion: counted once, not per batch
+                if not nb_in:
+                    self._count_nb_fallback(demoted=True)
+                self._fallback_demoted = True
                 self._migrate_to_python()
             except Exception:
                 # non-Fallback past phase 1: half-applied batch — demote
                 # so a replay cannot double-count (replay invariant)
-                self._poison_demote()
+                self._poison_demote(already_counted=nb_in)
                 raise
         gvals_list = self.grouping_batch(keys, rows)
         # reference parity (test_errors.py): rows whose grouping values

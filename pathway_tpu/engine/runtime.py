@@ -56,7 +56,17 @@ class Runtime:
         with_http_server: bool = False,
         monitoring_level=None,
         local_only: bool = False,
+        validate_env: bool = True,
     ):
+        # startup knob gate: reject unknown / out-of-range PATHWAY_* env
+        # vars (typos were silently ignored before) — registry + escape
+        # hatch in analysis/knobs.py; memoized per env snapshot.
+        # validate_env=False is for the analyzer's scratch lowering: it
+        # REPORTS knob findings as diagnostics instead of raising.
+        if validate_env:
+            from pathway_tpu.analysis.knobs import enforce_environment
+
+            enforce_environment()
         # local_only: never join the process mesh even when
         # PATHWAY_PROCESSES>1 — used by throwaway inner runtimes (the
         # iterate fixpoint body) that run a complete local subgraph
@@ -308,11 +318,14 @@ class Runtime:
         try:
             out = node.process(time, batches)
         except Exception as exc:
+            from pathway_tpu.analysis.eligibility import NBStrictError
             from pathway_tpu.internals.api import EngineErrorWithTrace
 
             if node.trace is not None and not isinstance(
-                exc, EngineErrorWithTrace
+                exc, (EngineErrorWithTrace, NBStrictError)
             ):
+                # NBStrictError already carries the node's provenance +
+                # fusion blame; wrapping would bury the diagnostic
                 raise EngineErrorWithTrace(
                     exc,
                     f"{node.trace.filename}:{node.trace.lineno} "

@@ -53,15 +53,21 @@ class Scope:
         return max(1, get_pathway_config().processes)
 
     def _exchange(
-        self, table: EngineTable, key_batch=None, mode="hash", nb_kidx=None
+        self, table: EngineTable, key_batch=None, mode="hash", nb_kidx=None,
+        nb_blame=(),
     ) -> EngineTable:
         # nb_kidx: plain-column shard key for the columnar exchange path
         # (tuple of column indices, or "id" for row-Pointer routing);
-        # None keeps NativeBatch inputs on the tuple fallback
+        # None keeps NativeBatch inputs on the tuple fallback. nb_blame
+        # carries the lowering-time reason (analysis/eligibility.py) so
+        # pw.analyze can name the expression that forced the tuple path.
         if self._world() <= 1:
             return table
         return EngineTable(
-            N.ExchangeNode(self, table.node, key_batch, mode, nb_kidx=nb_kidx),
+            N.ExchangeNode(
+                self, table.node, key_batch, mode, nb_kidx=nb_kidx,
+                nb_blame=nb_blame,
+            ),
             table.width,
         )
 
@@ -90,26 +96,37 @@ class Scope:
 
     # -- stateless transforms --------------------------------------------
     def rowwise(
-        self, table: EngineTable, batch_fn, width: int, nb_proj_idx=None
+        self, table: EngineTable, batch_fn, width: int, nb_proj_idx=None,
+        nb_blame=(), src_exprs=None,
     ) -> EngineTable:
         return EngineTable(
-            N.RowwiseNode(self, table.node, batch_fn, nb_proj_idx=nb_proj_idx),
+            N.RowwiseNode(
+                self, table.node, batch_fn, nb_proj_idx=nb_proj_idx,
+                nb_blame=nb_blame, src_exprs=src_exprs,
+            ),
             width,
         )
 
-    def rowwise_memoized(self, table: EngineTable, batch_fn, width: int) -> EngineTable:
-        return EngineTable(N.MemoizedRowwiseNode(self, table.node, batch_fn), width)
+    def rowwise_memoized(
+        self, table: EngineTable, batch_fn, width: int, src_exprs=None
+    ) -> EngineTable:
+        node = N.MemoizedRowwiseNode(self, table.node, batch_fn)
+        node.src_exprs = src_exprs
+        return EngineTable(node, width)
 
     def rowwise_auto(
         self, table: EngineTable, batch_fn, width: int, deterministic: bool,
-        nb_proj_idx=None,
+        nb_proj_idx=None, nb_blame=(), src_exprs=None,
     ) -> EngineTable:
         """Plain rowwise for pure expressions; memoized when the expressions
         contain non-deterministic UDFs so retractions replay stored outputs
         (reference: `deterministic` flag, graph.rs:751)."""
         if deterministic:
-            return self.rowwise(table, batch_fn, width, nb_proj_idx=nb_proj_idx)
-        return self.rowwise_memoized(table, batch_fn, width)
+            return self.rowwise(
+                table, batch_fn, width, nb_proj_idx=nb_proj_idx,
+                nb_blame=nb_blame, src_exprs=src_exprs,
+            )
+        return self.rowwise_memoized(table, batch_fn, width, src_exprs=src_exprs)
 
     def filter_table(self, table: EngineTable, mask_fn) -> EngineTable:
         return EngineTable(N.FilterNode(self, table.node, mask_fn), table.width)
@@ -163,19 +180,28 @@ class Scope:
         rkey_batch=None,
         nb_lkidx=None,
         nb_rkidx=None,
+        nb_blame=(),
+        nb_lblame=None,
+        nb_rblame=None,
     ) -> EngineTable:
         if self._world() > 1:
-            # nb_lkidx/nb_rkidx are valid shard keys exactly when the join
-            # keys are plain columns — the same eligibility the fused join
-            # uses; lkey_batch then returns the tuple of those columns, so
-            # columnar and tuple routing agree byte-for-byte
+            # nb_lkidx/nb_rkidx are valid shard keys exactly when that
+            # SIDE's join keys are plain columns — the same eligibility
+            # the fused join uses; lkey_batch then returns the tuple of
+            # those columns, so columnar and tuple routing agree
+            # byte-for-byte. Each exchange carries only its own side's
+            # blame (nb_lblame/nb_rblame; the combined tuple would
+            # misattribute the other side's expression) — callers that
+            # pass only nb_blame get the old combined behavior.
             left = self._exchange(
                 left, lkey_batch or self._rowwise_key(left_key_fn),
                 nb_kidx=nb_lkidx,
+                nb_blame=nb_blame if nb_lblame is None else nb_lblame,
             )
             right = self._exchange(
                 right, rkey_batch or self._rowwise_key(right_key_fn),
                 nb_kidx=nb_rkidx,
+                nb_blame=nb_blame if nb_rblame is None else nb_rblame,
             )
         node = N.JoinNode(
             self,
@@ -194,26 +220,29 @@ class Scope:
             rkey_batch=rkey_batch,
             nb_lkidx=nb_lkidx,
             nb_rkidx=nb_rkidx,
+            nb_blame=nb_blame,
         )
         return EngineTable(node, left.width + right.width)
 
     def group_by(
         self, table: EngineTable, grouping_fn, args_fn, reducer_fns, n_group_cols: int,
         key_fn=None, grouping_batch=None, args_batch=None, native_args=None,
-        native_order=None, nb_gidx=None, nb_argidx=None,
+        native_order=None, nb_gidx=None, nb_argidx=None, nb_blame=(),
+        src_exprs=None,
     ) -> EngineTable:
         # nb_gidx (plain-column grouping) doubles as the columnar shard
         # key: grouping_batch returns the tuple of exactly those columns
         table = self._exchange(
             table, grouping_batch or self._rowwise_key(grouping_fn),
-            nb_kidx=nb_gidx,
+            nb_kidx=nb_gidx, nb_blame=nb_blame,
         )
         node = N.GroupByNode(
             self, table.node, grouping_fn, args_fn, reducer_fns, key_fn,
             grouping_batch=grouping_batch, args_batch=args_batch,
             native_args=native_args, native_order=native_order,
-            nb_gidx=nb_gidx, nb_argidx=nb_argidx,
+            nb_gidx=nb_gidx, nb_argidx=nb_argidx, nb_blame=nb_blame,
         )
+        node.src_exprs = src_exprs
         return EngineTable(node, n_group_cols + len(reducer_fns))
 
     def update_rows(self, left: EngineTable, right: EngineTable) -> EngineTable:

@@ -19,7 +19,10 @@ def _env_field(name: str, default: str | None = None):
 
 def _env_bool_field(name: str, default: str = "false"):
     def factory() -> bool:
-        value = os.environ.get(name, default).lower()
+        # strip() mirrors the knob registry's Knob.check — the two must
+        # accept the same value set or _load_config's except-ValueError
+        # routing re-raises the raw factory error without a KnobError
+        value = os.environ.get(name, default).strip().lower()
         if value in ("1", "true", "yes"):
             return True
         if value in ("0", "false", "no"):
@@ -65,7 +68,48 @@ class PathwayConfig:
         )
 
 
-pathway_config = PathwayConfig()
+# Constructed LAZILY (first get_pathway_config()/attribute access), not
+# at import: `python -m pathway_tpu.analysis` must be able to import the
+# package and DIAGNOSE a broken environment rather than crash before its
+# own error handling runs (runpy imports the package before __main__).
+import threading as _threading
+
+_pathway_config: PathwayConfig | None = None
+_config_lock = _threading.Lock()
+
+
+def _load_config() -> PathwayConfig:
+    global _pathway_config
+    if _pathway_config is None:
+        # double-checked under a lock: connector / emulated-rank threads
+        # racing the first load must not each build an instance (the
+        # loser's would silently discard set_license_key-style mutations
+        # made to the winner's)
+        with _config_lock:
+            if _pathway_config is None:
+                try:
+                    _pathway_config = PathwayConfig()
+                except ValueError:
+                    # a config-backed PATHWAY_* var failed to parse —
+                    # route the failure through the knob registry so the
+                    # user gets the full did-you-mean/range report
+                    # (KnobError) instead of a raw ValueError out of a
+                    # field factory. knobs.py is stdlib-only, so this
+                    # import cannot cycle back here.
+                    from pathway_tpu.analysis.knobs import (
+                        enforce_environment,
+                    )
+
+                    enforce_environment()
+                    raise  # registry considered the env valid: as-is
+    return _pathway_config
+
+
+def __getattr__(name: str):
+    # module attribute access (tests monkeypatch C.pathway_config.*)
+    if name == "pathway_config":
+        return _load_config()
+    raise AttributeError(name)
 
 # Per-thread overlay used by the emulated-rank CI lane (scripts/
 # ci_lanes.sh): companion ranks run as THREADS of one test process, each
@@ -103,19 +147,19 @@ def pop_config_overlay(token) -> None:
 def get_pathway_config() -> PathwayConfig:
     overlay = _thread_overlay.get()
     if overlay:
-        return _OverlaidConfig(pathway_config, overlay)  # type: ignore
-    return pathway_config
+        return _OverlaidConfig(_load_config(), overlay)  # type: ignore
+    return _load_config()
 
 
 def set_license_key(key: str | None) -> None:
     """reference: pw.set_license_key — entitlements are not enforced in
     this build (no keygen.sh round trips); the key is recorded for config
     surface parity."""
-    pathway_config.license_key = key
+    _load_config().license_key = key
 
 
 def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs) -> None:
-    pathway_config.monitoring_server = server_endpoint
+    _load_config().monitoring_server = server_endpoint
 
 
 def _check_entitlements(*entitlements: str) -> bool:

@@ -205,7 +205,8 @@ class GroupedTable:
                     return list(zip(*cols)) if cols else [()] * len(keys)
 
                 et = ctx.scope.rowwise_memoized(
-                    et, precompute, len(all_input_exprs)
+                    et, precompute, len(all_input_exprs),
+                    src_exprs=all_input_exprs,
                 )
 
                 def slot_fn(j):
@@ -378,35 +379,15 @@ class GroupedTable:
                 # plain-column grouping and argless/single-plain-column
                 # reducer args, no sort_by — the shapes the columnar C
                 # parse→groupby path (exec.cpp process_batch_nb) executes
-                # with zero per-row Python objects
-                nb_gidx = nb_argidx = None
-                if deterministic and sort_by is None:
+                # with zero per-row Python objects. The predicate (and
+                # the blame naming the offending expression/reducer)
+                # lives in analysis/eligibility.py, shared with
+                # pw.analyze so analyzer and executor cannot drift.
+                from pathway_tpu.analysis import eligibility as _elig
 
-                    def _col_idx(e):
-                        if isinstance(e, ColumnReference):
-                            loc = resolver(e)
-                            if isinstance(loc, int):
-                                return loc
-                        return None
-
-                    g_locs = [_col_idx(g) for g in grouping]
-                    a_locs: list[int | None] = []
-                    nb_ok = all(loc is not None for loc in g_locs)
-                    for r in reducers if nb_ok else ():
-                        if len(r._args) == 0:
-                            a_locs.append(None)
-                            continue
-                        loc = (
-                            _col_idx(r._args[0])
-                            if len(r._args) == 1
-                            else None
-                        )
-                        if loc is None:
-                            nb_ok = False
-                            break
-                        a_locs.append(loc)
-                    if nb_ok:
-                        nb_gidx, nb_argidx = tuple(g_locs), tuple(a_locs)
+                nb_gidx, nb_argidx, nb_blame = _elig.groupby_nb_indices(
+                    grouping, reducers, sort_by, deterministic, resolver
+                )
 
                 grouped = ctx.scope.group_by(
                     et, grouping_fn, args_fn, reducer_specs, n_group,
@@ -414,6 +395,8 @@ class GroupedTable:
                     args_batch=args_batch, native_args=native_args,
                     native_order=sort_fn,
                     nb_gidx=nb_gidx, nb_argidx=nb_argidx,
+                    nb_blame=nb_blame,
+                    src_exprs=all_input_exprs,
                 )
 
             # stage 2: evaluate output expressions over gvals + reducer values
@@ -471,6 +454,7 @@ class GroupedTable:
                 ctx.scope.rowwise_auto(
                     grouped, batch_fn, len(rewritten),
                     all(e._is_deterministic for e in rewritten),
+                    src_exprs=rewritten,
                 ),
             )
 

@@ -225,27 +225,19 @@ class JoinResult:
             # NativeBatch fused-chain eligibility: every join condition a
             # plain column == plain column (the shapes join_batch_nb
             # extracts straight from the columnar image); anything else —
-            # expressions over the key, pw.this.id — keeps the tuple path
-            def _plain_idx(e, table):
-                if (
-                    isinstance(e, ColumnReference)
-                    and e.table is table
-                    and e.name != "id"
-                    and e.name in table._column_names
-                ):
-                    return table._column_names.index(e.name)
-                return None
+            # expressions over the key, pw.this.id — keeps the tuple
+            # path. The predicate (and the blame naming the offending
+            # expression) lives in analysis/eligibility.py, shared with
+            # pw.analyze so analyzer and executor cannot drift.
+            from pathway_tpu.analysis import eligibility as _elig
 
-            nb_lkidx: tuple | None = tuple(
-                _plain_idx(lhs, left) for lhs, _ in on
+            nb_lkidx, nb_rkidx, nb_lblame, nb_rblame = (
+                _elig.join_key_indices(on, left, right)
             )
-            nb_rkidx: tuple | None = tuple(
-                _plain_idx(rhs, right) for _, rhs in on
+            nb_blame = (
+                nb_lblame + nb_rblame
+                + _elig.join_id_blame(id_expr, id_expr_side)
             )
-            if any(i is None for i in nb_lkidx) or any(
-                i is None for i in nb_rkidx
-            ):
-                nb_lkidx = nb_rkidx = None
 
             left_id_fn = right_id_fn = None
             if id_expr is not None:
@@ -277,6 +269,9 @@ class JoinResult:
                 rkey_batch=rkey_batch,
                 nb_lkidx=nb_lkidx,
                 nb_rkidx=nb_rkidx,
+                nb_blame=nb_blame,
+                nb_lblame=nb_lblame,
+                nb_rblame=nb_rblame,
             )
 
             def out_resolver(ref):
@@ -299,16 +294,9 @@ class JoinResult:
             # a select of plain column references is a pure projection:
             # a fused join's NativeBatch output then stays columnar
             # through this hop (RowwiseNode nb_proj_idx -> nb_project)
-            def _proj_idx(e):
-                if isinstance(e, ColumnReference) and e.name != "id":
-                    if e.table is left and e.name in left._column_names:
-                        return left._column_names.index(e.name)
-                    if e.table is right and e.name in right._column_names:
-                        return lw + right._column_names.index(e.name)
-                return None
-
-            proj = tuple(_proj_idx(e) for e in exprs)
-            nb_proj_idx = None if any(i is None for i in proj) else proj
+            nb_proj_idx, proj_blame = _elig.join_projection_indices(
+                names, exprs, left, right, lw
+            )
 
             ctx.set_engine_table(
                 out,
@@ -316,6 +304,8 @@ class JoinResult:
                     joined, batch_fn, len(fns),
                     all(e._is_deterministic for e in exprs),
                     nb_proj_idx=nb_proj_idx,
+                    nb_blame=proj_blame,
+                    src_exprs=exprs,
                 ),
             )
 
@@ -326,6 +316,7 @@ class JoinResult:
         self, ctx, let, ret, lkey, rkey, how, *,
         id_from_left, id_from_right, left_id_fn, right_id_fn,
         lkey_batch=None, rkey_batch=None, nb_lkidx=None, nb_rkidx=None,
+        nb_blame=(), nb_lblame=None, nb_rblame=None,
     ):
         """Engine-join construction hook; temporal joins override this
         (stdlib/temporal) while reusing the select/desugaring machinery."""
@@ -343,6 +334,9 @@ class JoinResult:
             rkey_batch=rkey_batch,
             nb_lkidx=nb_lkidx,
             nb_rkidx=nb_rkidx,
+            nb_blame=nb_blame,
+            nb_lblame=nb_lblame,
+            nb_rblame=nb_rblame,
         )
 
     def _desugar(self, e):

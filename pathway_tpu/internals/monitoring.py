@@ -66,6 +66,13 @@ class ProberStats:
     exchange_fallbacks: int = 0
     exchange_comms_s: float = 0.0
     exchange_compute_s: float = 0.0
+    # fused-chain de-optimizations at join/groupby/select nodes: batches
+    # that were statically expected columnar (analysis/eligibility.py
+    # expects_native_batch) but executed on the tuple path. A permanent
+    # demotion (poison / unsupported-value migration) counts exactly once
+    # for the node, not once per subsequent batch. pw.analyze "fused"
+    # verdicts must correspond to this staying 0.
+    nb_fallbacks: int = 0
     # mesh fault tolerance (procgroup detection layer + runtime recovery
     # path): heartbeat windows a peer missed, post-recovery incarnations
     # of this rank (epoch > 0 at mesh join), epoch aborts this rank
@@ -99,6 +106,9 @@ class ProberStats:
 
     def on_exchange_fallback(self) -> None:
         self.exchange_fallbacks += 1
+
+    def on_nb_fallback(self) -> None:
+        self.nb_fallbacks += 1
 
     def on_exchange_step(self, comms_s: float, compute_s: float) -> None:
         self.exchange_comms_s += comms_s
@@ -183,6 +193,7 @@ class ProberStats:
             ("exchange_bytes_total", self.exchange_bytes),
             ("exchange_empty_elided_total", self.exchange_empty_elided),
             ("exchange_fallbacks_total", self.exchange_fallbacks),
+            ("nb_fallbacks_total", self.nb_fallbacks),
         ):
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} {val}")

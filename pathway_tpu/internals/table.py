@@ -166,7 +166,10 @@ class Table(TableLike):
         def lower(ctx):
             inp, fn = ctx.rowwise_eval(self_, exprs)
             ctx.set_engine_table(
-                out, ctx.scope.rowwise_auto(inp, fn, len(exprs), deterministic)
+                out,
+                ctx.scope.rowwise_auto(
+                    inp, fn, len(exprs), deterministic, src_exprs=exprs
+                ),
             )
 
         G.add_operator(self._dep_tables(exprs), [out], lower, "select")

@@ -205,7 +205,14 @@ class _DeltaSubject(ConnectorSubject):
                 add = action.get("add")
                 if add is None:
                     continue
-                table = pq.read_table(_io.BytesIO(parts[add["path"]]))
+                # use_threads=False: this runs on a connector thread, and
+                # pyarrow's CPU pool first spawned from a non-main thread
+                # aborts the process at exit ("terminate called without an
+                # active exception", ~30% of runs on pyarrow 22); parts
+                # are small, the pool buys nothing here
+                table = pq.read_table(
+                    _io.BytesIO(parts[add["path"]]), use_threads=False
+                )
                 cols = [
                     table.column(c).to_pylist()
                     if c in table.column_names

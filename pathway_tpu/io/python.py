@@ -326,6 +326,28 @@ def _make_parser(schema: type[Schema], subject=None):
     # restart strategy off both flags.
     parse.is_pk = bool(pkeys)
     parse.is_upsert = bool(pkeys) and not track_removals
+    # static fused-chain capability for pw.analyze + the runtime's
+    # fallback accounting (analysis/eligibility.py source_nb_capability):
+    # can this source emit columnar NativeBatches, and if not, why —
+    # the door exists only for upsert flushes over columnar value types
+    from pathway_tpu.analysis.eligibility import schema_nb_blame
+
+    nb_blame: list[str] = []
+    if nb_parse is None and pk_nb is None:
+        if track_removals:
+            nb_blame.append(
+                "subject allows remove()-by-content (set "
+                "_deletions_enabled = False for the columnar parser)"
+            )
+        elif pkeys and not pk_fast:
+            nb_blame.append("no native toolchain (C parser unavailable)")
+        elif not simple and not pkeys:
+            nb_blame.append("no native toolchain (C parser unavailable)")
+        else:
+            nb_blame.append("columnar parser door unavailable")
+    nb_blame.extend(schema_nb_blame(schema))
+    parse.nb_capable = not nb_blame
+    parse.nb_blame = tuple(nb_blame)
     return parse
 
 

@@ -12,7 +12,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-jnp.inf)
+# plain float, like pallas_knn: a module-scope jnp.float32() would jit a
+# convert_element_type at IMPORT time (slow, and it drags XLA compilation
+# into processes that only need the relational plane — e.g. the ASan CI
+# lane, where jaxlib's C++ exceptions abort under the preloaded runtime);
+# jnp.where/jnp.full coerce it to the array dtype exactly the same way
+NEG_INF = float("-inf")
 
 
 def masked_topk(scores: jax.Array, valid: jax.Array, k: int):

@@ -36,6 +36,7 @@ class AsofJoinResult(IntervalJoinResult):
         self, ctx, let, ret, lkey, rkey, how, *,
         id_from_left, id_from_right, left_id_fn, right_id_fn,
         lkey_batch=None, rkey_batch=None, nb_lkidx=None, nb_rkidx=None,
+        nb_blame=(), nb_lblame=None, nb_rblame=None,
     ):
         from pathway_tpu.engine.expression import compile_expression
         from pathway_tpu.engine.scope import EngineTable

@@ -25,6 +25,11 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 # lanes 1/2 run the tier-1 surface (-m 'not slow'); the slow-marked
 # mesh grid is covered by lane 3's supervisor smoke and the full
 # `python scripts/fault_matrix.py --mesh --mesh-no-nb` sweep
+echo "=== lane 0: native GIL-audit lint (scripts/lint_gil.py) ==="
+# static contract scan over exec.cpp: no Python C-API/refcount calls in
+# GIL-released regions, Fallback-only failures in phase-1 sections
+python scripts/lint_gil.py
+
 echo "=== lane 1: PATHWAY_THREADS=4 (full suite) ==="
 PATHWAY_THREADS=4 python -m pytest tests/ -x -q -m 'not slow'
 
@@ -46,5 +51,11 @@ echo "=== lane 3: real-fork 2-rank mesh kill-and-resume smoke ==="
 # back to the last committed snapshot, output stays bit-identical
 env -u PATHWAY_LANE_PROCESSES python -m pytest -x -q \
   tests/test_fault_injection.py::test_mesh_supervisor_kill_and_resume_smoke
+
+echo "=== lane 4: ASan/UBSan native join/exchange batteries ==="
+# rebuilds exec.cpp with -fsanitize=address,undefined into a scratch
+# build dir and re-runs the join/exchange batteries under it; the script
+# self-skips (exit 0 with a message) when g++ lacks sanitizer support
+env -u PATHWAY_LANE_PROCESSES ./scripts/sanitize_native.sh asan
 
 echo "=== all lanes green ==="

@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Native GIL-audit lint for the C++ executor (ISSUE 5 satellite).
+
+Statically scans ``native/exec.cpp`` (and any extra files passed on the
+command line) for the two contract classes the fused-chain executor
+depends on:
+
+1. **GIL-released regions** (between ``Py_BEGIN_ALLOW_THREADS`` and
+   ``Py_END_ALLOW_THREADS``): no Python C-API call, no refcount macro, no
+   ``return``/``throw`` that would leave the saved thread state dangling.
+   Comments and string literals are blanked before scanning so prose
+   mentioning PyObject doesn't trip the lint; ``Py_BLOCK_THREADS`` /
+   ``Py_UNBLOCK_THREADS`` pairs re-acquire legally and toggle the scan.
+
+2. **Phase-1 Fallback-only sections**: the executor's replay invariant
+   says phase 1 (extract, GIL held, *no state mutated*) may fail ONLY by
+   raising ``FallbackError`` — a non-Fallback error there would make the
+   Python side poison-demote a store that is actually still consistent.
+   Sections are delimited by the canonical marker comments the executor
+   already carries: a comment containing ``phase 1`` opens one, and
+   ``phase 1 passed`` / ``Py_BEGIN_ALLOW_THREADS`` (phase 2 starts)
+   closes it. Inside, ``PyErr_SetString``/``PyErr_Format`` with a
+   ``PyExc_*`` category (instead of ``FallbackError``) and bare ``throw``
+   are flagged. Shape/argument validation BEFORE the phase-1 marker is
+   exempt by construction.
+
+Exit code 0 = clean, 1 = findings (printed one per line, file:line).
+Wired into scripts/ci_lanes.sh.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_FILES = [os.path.join(REPO, "native", "exec.cpp")]
+
+_ALLOWED_IN_RELEASED = {
+    "Py_BEGIN_ALLOW_THREADS",
+    "Py_END_ALLOW_THREADS",
+    "Py_BLOCK_THREADS",
+    "Py_UNBLOCK_THREADS",
+}
+
+_CALL_RE = re.compile(r"\b(Py[A-Za-z0-9_]*)\s*\(")
+_WORD_RE = re.compile(r"\b(Py_[A-Z_]+)\b")
+_RETURN_RE = re.compile(r"\breturn\b")
+_THROW_RE = re.compile(r"\bthrow\b")
+_ERRSET_RE = re.compile(r"\bPyErr_(?:SetString|Format|SetNone)\s*\(\s*(\w+)")
+
+
+def blank_comments_and_strings(src: str) -> tuple[str, str]:
+    """(code, comments): same length/line structure as src; `code` has
+    comments + string/char literals blanked, `comments` has everything
+    BUT comments blanked (for marker scanning)."""
+    code = []
+    comments = []
+    i, n = 0, len(src)
+    state = "code"  # code | line_comment | block_comment | string | char
+    while i < n:
+        c = src[i]
+        nxt = src[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "/":
+                state = "line_comment"
+                code.append("  ")
+                comments.append("//")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block_comment"
+                code.append("  ")
+                comments.append("/*")
+                i += 2
+                continue
+            if c == '"':
+                state = "string"
+                code.append(" ")
+                comments.append(" ")
+                i += 1
+                continue
+            if c == "'":
+                state = "char"
+                code.append(" ")
+                comments.append(" ")
+                i += 1
+                continue
+            code.append(c)
+            comments.append(c if c == "\n" else " ")
+            i += 1
+            continue
+        # non-code states: preserve newlines in both views
+        keep = c if c == "\n" else " "
+        if state == "line_comment":
+            code.append(keep)
+            comments.append(c)
+            if c == "\n":
+                state = "code"
+            i += 1
+            continue
+        if state == "block_comment":
+            code.append(keep)
+            comments.append(c)
+            if c == "*" and nxt == "/":
+                code.append(" ")
+                comments.append("/")
+                i += 2
+                state = "code"
+            else:
+                i += 1
+            continue
+        if state in ("string", "char"):
+            code.append(keep)
+            comments.append(keep)
+            if c == "\\":
+                if nxt == "\n":
+                    code.append("\n")
+                    comments.append("\n")
+                else:
+                    code.append(" ")
+                    comments.append(" ")
+                i += 2
+                continue
+            if (state == "string" and c == '"') or (
+                state == "char" and c == "'"
+            ):
+                state = "code"
+            i += 1
+            continue
+    return "".join(code), "".join(comments)
+
+
+def lint_file(path: str) -> list[str]:
+    with open(path) as f:
+        src = f.read()
+    code, comments = blank_comments_and_strings(src)
+    code_lines = code.splitlines()
+    comment_lines = comments.splitlines()
+    findings: list[str] = []
+    rel = os.path.relpath(path, REPO)
+
+    # -- pass 1: GIL-released regions -------------------------------------
+    released = False
+    blocked = False  # inside Py_BLOCK_THREADS .. Py_UNBLOCK_THREADS
+    begin_line = 0
+    for ln, line in enumerate(code_lines, 1):
+        words = set(_WORD_RE.findall(line))
+        if "Py_BEGIN_ALLOW_THREADS" in words:
+            if released:
+                findings.append(
+                    f"{rel}:{ln}: nested Py_BEGIN_ALLOW_THREADS "
+                    f"(previous at line {begin_line})"
+                )
+            released, blocked, begin_line = True, False, ln
+            continue
+        if "Py_END_ALLOW_THREADS" in words:
+            if not released:
+                findings.append(
+                    f"{rel}:{ln}: Py_END_ALLOW_THREADS without a matching "
+                    f"begin"
+                )
+            released = False
+            continue
+        if released:
+            if "Py_BLOCK_THREADS" in words:
+                blocked = True
+                continue
+            if "Py_UNBLOCK_THREADS" in words:
+                blocked = False
+                continue
+            if blocked:
+                continue  # GIL re-acquired: Python API is legal here
+            if line.strip().startswith("}") and line.rstrip() == "}":
+                # function end at column 0 with an open region
+                if line == "}":
+                    findings.append(
+                        f"{rel}:{ln}: function ends with GIL still "
+                        f"released (begin at line {begin_line})"
+                    )
+                    released = False
+                continue
+            for m in _CALL_RE.finditer(line):
+                name = m.group(1)
+                if name in _ALLOWED_IN_RELEASED:
+                    continue
+                findings.append(
+                    f"{rel}:{ln}: Python C-API call {name}() inside "
+                    f"GIL-released region (begin at line {begin_line})"
+                )
+            for m in _WORD_RE.finditer(line):
+                if m.group(1) in (
+                    "Py_INCREF", "Py_DECREF", "Py_XINCREF", "Py_XDECREF",
+                    "Py_CLEAR",
+                ):
+                    findings.append(
+                        f"{rel}:{ln}: refcount op {m.group(1)} inside "
+                        f"GIL-released region (begin at line {begin_line})"
+                    )
+            if _RETURN_RE.search(line):
+                findings.append(
+                    f"{rel}:{ln}: return inside GIL-released region "
+                    f"(begin at line {begin_line}) — thread state leaks"
+                )
+            if _THROW_RE.search(line):
+                findings.append(
+                    f"{rel}:{ln}: throw inside GIL-released region "
+                    f"(begin at line {begin_line}) — unwinds past "
+                    f"Py_END_ALLOW_THREADS"
+                )
+    if released:
+        findings.append(
+            f"{rel}:{begin_line}: Py_BEGIN_ALLOW_THREADS never closed"
+        )
+
+    # -- pass 2: phase-1 Fallback-only sections ---------------------------
+    in_phase1 = False
+    phase1_line = 0
+    for ln, (cline, mline) in enumerate(
+        zip(code_lines, comment_lines), 1
+    ):
+        marker = mline.lower()
+        # opener BEFORE closer: an opener comment that also mentions the
+        # invariant wording ("phase 1: extract — no Fallback beyond ...")
+        # must open the section, not be misread as its closer and skip
+        # the whole section silently
+        if re.search(r"\bphase 1:", marker):
+            # only the canonical section opener "/* phase 1: extract ..."
+            # counts; passing mentions ("phase 1 indexes ...", "phase 1
+            # passed") must not open a section
+            in_phase1 = True
+            phase1_line = ln
+            continue
+        if "phase 1" in marker and (
+            "passed" in marker or "no fallback beyond" in marker
+        ):
+            in_phase1 = False
+            continue
+        if "Py_BEGIN_ALLOW_THREADS" in cline:
+            in_phase1 = False  # phase 2 (apply) starts
+            continue
+        if not in_phase1:
+            continue
+        m = _ERRSET_RE.search(cline)
+        if m and m.group(1) != "FallbackError":
+            findings.append(
+                f"{rel}:{ln}: non-Fallback error ({m.group(1)}) raised "
+                f"inside a phase-1 section (opened at line {phase1_line}) "
+                f"— phase 1 must fail only via FallbackError (replay "
+                f"invariant: the store is still consistent)"
+            )
+        if _THROW_RE.search(cline):
+            findings.append(
+                f"{rel}:{ln}: C++ throw inside a phase-1 section (opened "
+                f"at line {phase1_line}) — phase 1 must fail only via "
+                f"FallbackError"
+            )
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    files = argv or DEFAULT_FILES
+    all_findings: list[str] = []
+    for path in files:
+        all_findings.extend(lint_file(path))
+    if all_findings:
+        print(f"lint_gil: {len(all_findings)} finding(s)")
+        for f in all_findings:
+            print("  " + f)
+        return 1
+    print(f"lint_gil: clean ({', '.join(os.path.relpath(p, REPO) for p in files)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -63,16 +63,45 @@ def _storm_commits(seed, n_keys=8, n_commits=6, rows_per_commit=10, mk=None):
 
 
 class _StormSubject(pw.io.python.ConnectorSubject):
+    """Storm source with a DETERMINISTIC commit interleaving: the two
+    sides take strict turns (L0, R0, L1, R1, ...) via a shared ticket.
+    The bit-identity assertions compare the exact update streams of two
+    separate runs — with free-running threads the arrival order (and so
+    the timestamp assignment and transient pad emissions) is scheduler
+    noise, which the ASan CI lane's perturbed timing exposed."""
+
     _deletions_enabled = False
 
-    def __init__(self, commits):
+    def __init__(self, commits, sync=None, slot=0):
         super().__init__()
         self._commits = commits
+        self._sync = sync  # (Condition, {"turn": int}) shared by sides
+        self._slot = slot  # 0 commits first each round
 
     def run(self):
-        for commit in self._commits:
+        if self._sync is None:
+            for commit in self._commits:
+                self.next_batch(commit)
+                self.commit()
+            return
+        cond, state = self._sync
+        for i, commit in enumerate(self._commits):
+            with cond:
+                while state["turn"] != 2 * i + self._slot:
+                    # bounded wait: if the peer side's thread died, fail
+                    # the test instead of deadlocking until the CI
+                    # job timeout
+                    if not cond.wait(timeout=60):
+                        raise RuntimeError(
+                            f"storm side {self._slot} timed out waiting "
+                            f"for turn {2 * i + self._slot} (ticket "
+                            f"stuck at {state['turn']} — peer died?)"
+                        )
             self.next_batch(commit)
             self.commit()
+            with cond:
+                state["turn"] += 1
+                cond.notify_all()
 
 
 def _mk_left(k, rng):
@@ -84,14 +113,19 @@ def _mk_right(k, rng):
 
 
 def _run_storm(how, seed, id_kw=None):
+    import threading
+
     pw.internals.parse_graph.G.clear()
     lcommits, llive = _storm_commits(seed, mk=_mk_left)
     rcommits, rlive = _storm_commits(seed + 1000, mk=_mk_right)
+    sync = (threading.Condition(), {"turn": 0})
     lt = pw.io.python.read(
-        _StormSubject(lcommits), schema=LSchema, autocommit_duration_ms=None
+        _StormSubject(lcommits, sync, 0), schema=LSchema,
+        autocommit_duration_ms=None,
     )
     rt = pw.io.python.read(
-        _StormSubject(rcommits), schema=RSchema, autocommit_duration_ms=None
+        _StormSubject(rcommits, sync, 1), schema=RSchema,
+        autocommit_duration_ms=None,
     )
     kwargs = {"how": getattr(pw.JoinMode, how.upper())}
     if id_kw == "left":

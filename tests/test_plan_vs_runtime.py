@@ -1,0 +1,334 @@
+"""Analyzer-vs-runtime agreement battery: for the bench pipelines
+(wordcount, stream_join, groupby; 1-rank and 2-rank), ``pw.analyze``
+fused/degraded verdicts must match the observed runtime fallback
+counters — zero false "fused" verdicts (ISSUE 5 acceptance criterion).
+
+The 1-rank cases lower once, analyze the SAME runtime statically, run
+it, then audit counters. The 2-rank case forks a real loopback mesh and
+each rank audits itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import analyzer as pa
+from pathway_tpu.analysis import bench as pb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _nb_toolchain() -> bool:
+    try:
+        from pathway_tpu.native import get_pwexec
+
+        ex = get_pwexec()
+    except Exception:
+        return False
+    return ex is not None and hasattr(ex, "parse_upserts_nb")
+
+
+needs_nb = pytest.mark.skipif(
+    not _nb_toolchain(), reason="native toolchain (pwexec) unavailable"
+)
+
+
+def _lower_analyze_run(out_table):
+    """Lower the captured graph once, analyze that runtime statically,
+    then run it; returns (runtime, report, capture)."""
+    from pathway_tpu.engine.runtime import Runtime
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    g = pw.internals.parse_graph.G
+    targets = [out_table._source] + g.output_operators()
+    ops = g.reachable_operators(targets)
+    runtime = Runtime()
+    ctx = GraphRunner()._lower(ops, runtime)
+    report = pa.analyze_scope(runtime)
+    cap = runtime.scope.capture(ctx.engine_table(out_table))
+    runtime.run()
+    return runtime, report, cap
+
+
+def _counters(runtime):
+    from pathway_tpu.engine import nodes as N
+
+    joins = [n for n in runtime.scope.nodes if isinstance(n, N.JoinNode)]
+    groupbys = [
+        n for n in runtime.scope.nodes if isinstance(n, N.GroupByNode)
+    ]
+    return joins, groupbys
+
+
+@needs_nb
+@pytest.mark.parametrize(
+    "build", [pb.build_wordcount, pb.build_stream_join, pb.build_groupby],
+    ids=["wordcount", "stream_join", "groupby"],
+)
+def test_fused_verdict_matches_zero_fallbacks_1rank(build):
+    bp = build()
+    runtime, report, cap = _lower_analyze_run(bp.out)
+    assert report.verdict == "fused", report.render()
+    # zero false fused: no fallback counter moved anywhere
+    assert pa.audit_runtime(runtime, report) == []
+    assert runtime.stats.nb_fallbacks == 0
+    assert runtime.stats.exchange_fallbacks == 0
+    # and the fused path actually ran (the verdict is not vacuous)
+    joins, groupbys = _counters(runtime)
+    for n in joins + groupbys:
+        assert n._nb_batches > 0, f"{type(n).__name__} never ran columnar"
+    assert len(cap.state.rows) > 0
+
+
+@needs_nb
+def test_degraded_verdict_matches_fallback_counters_1rank():
+    """A groupby over an expression key: the analyzer must call it
+    degraded AND the runtime must count the de-optimized batches."""
+    pw.internals.parse_graph.G.clear()
+    words = ["a", "b", "c"]
+    rows = [{"data": words[i % 3]} for i in range(120)]
+
+    class Src(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for s in range(0, len(rows), 40):
+                self.next_batch(rows[s : s + 40])
+                self.commit()
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=None)
+    agg = t.groupby(pw.this.data + "!").reduce(c=pw.reducers.count())
+    runtime, report, cap = _lower_analyze_run(agg)
+    assert report.verdict == "degraded"
+    [entry] = [n for n in report.nodes if n["kind"] == "groupby"]
+    assert entry["verdict"] == "degraded"
+    _joins, [gb] = _counters(runtime)
+    assert gb._nb_batches == 0
+    assert gb._nb_fallbacks > 0  # columnar input materialized per batch
+    assert runtime.stats.nb_fallbacks == gb._nb_fallbacks
+    assert pa.audit_runtime(runtime, report) == []  # no FUSED node lied
+
+
+@needs_nb
+def test_outer_join_pad_output_not_false_fused(monkeypatch):
+    """A fused-eligible LEFT join keeps its input processing columnar,
+    but pad transitions (a late right row flipping liveness) emit tuple
+    batches. The analyzer must NOT call the chain downstream of the join
+    fused, the runtime must NOT count those batches as fallbacks, and a
+    strict run must complete — no NBStrictError on a correct pipeline."""
+    monkeypatch.setenv("PATHWAY_NB_STRICT", "1")
+    pw.internals.parse_graph.G.clear()
+
+    class L(pw.Schema):
+        a: int
+        v: int
+
+    class R(pw.Schema):
+        b: int
+        w: int
+
+    class LS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch([{"a": i % 5, "v": i} for i in range(40)])
+            self.commit()
+
+    class RS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.commit()
+            # late right row: retracts the pads minted for a==2 rows
+            self.next_batch([{"b": 2, "w": 20}])
+            self.commit()
+
+    lt = pw.io.python.read(LS(), schema=L, autocommit_duration_ms=None)
+    rt = pw.io.python.read(RS(), schema=R, autocommit_duration_ms=None)
+    out = lt.join_left(rt, lt.a == rt.b).select(
+        v=pw.left.v, w=pw.right.w
+    )
+    runtime, report, cap = _lower_analyze_run(out)
+    assert report.verdict == "degraded", report.render()
+    [entry] = [n for n in report.nodes if n["kind"] == "join"]
+    assert entry["verdict"] == "degraded"
+    [join], _ = _counters(runtime)
+    assert join.nb_decision.ok          # the join ITSELF is fused-eligible
+    assert join._nb_batches > 0         # and consumed columnar input
+    assert join._nb_fallbacks == 0
+    assert runtime.stats.exchange_fallbacks == 0
+    assert pa.audit_runtime(runtime, report) == []
+    assert len(cap.state.rows) == 40    # 32 padded + 8 matched
+
+
+@needs_nb
+def test_forced_tuple_env_matches_degraded_verdict(monkeypatch):
+    monkeypatch.setenv("PATHWAY_NO_NB_JOIN", "1")
+    bp = pb.build_stream_join()
+    runtime, report, cap = _lower_analyze_run(bp.out)
+    assert report.verdict == "degraded"
+    [entry] = [n for n in report.nodes if n["kind"] == "join"]
+    assert any("PATHWAY_NO_NB_JOIN" in r for r in entry["reasons"])
+    joins, _ = _counters(runtime)
+    assert joins[0]._nb_batches == 0
+    assert joins[0]._nb_fallbacks > 0
+    assert pa.audit_runtime(runtime, report) == []
+
+
+# -- 2-rank real-fork agreement ------------------------------------------
+
+_RANK_PROGRAM = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+import pathway_tpu.engine.runtime as rt_mod
+from pathway_tpu.analysis import analyzer as pa
+from pathway_tpu.engine import nodes as N
+
+_insts = []
+_orig = rt_mod.Runtime.__init__
+def _spy(self, *a, **k):
+    _orig(self, *a, **k)
+    _insts.append(self)
+rt_mod.Runtime.__init__ = _spy
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+words = [f"w{{i}}" for i in range(5)]
+rows = [
+    {{"data": words[i % 5], "v": i % 50}} for i in range(rank, 300, P)
+]
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True
+    def run(self):
+        for s in range(0, len(rows), 50):
+            self.next_batch(rows[s : s + 50])
+            self.commit()
+
+class S(pw.Schema):
+    data: str
+    v: int
+
+t = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=3_600_000)
+counts = t.groupby(pw.this.data).reduce(
+    word=pw.this.data, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+rrows = [{{"j": w, "m": i + 1}} for i, w in enumerate(words)]
+class RSrc(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        self.next_batch(rrows)
+        self.commit()
+class R(pw.Schema):
+    j: str
+    m: int
+rt = pw.io.python.read(RSrc(), schema=R, autocommit_duration_ms=3_600_000)
+joined = t.join(rt, pw.left.data == pw.right.j).select(
+    d=pw.left.data, v=pw.left.v, m=pw.right.m
+)
+state = {{}}
+pw.io.subscribe(counts, on_change=lambda *a: None)
+pw.io.subscribe(joined, on_change=lambda *a: None)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+runtime = _insts[0]
+report = pa.analyze_scope(runtime)
+problems = pa.audit_runtime(runtime, report)
+joins = [n for n in runtime.scope.nodes if isinstance(n, N.JoinNode)]
+gbs = [n for n in runtime.scope.nodes if isinstance(n, N.GroupByNode)]
+xs = runtime.scope.exchange_nodes
+print(json.dumps({{
+    "rank": rank,
+    "verdict": report.verdict,
+    "problems": problems,
+    "nb_fallbacks": runtime.stats.nb_fallbacks,
+    "exchange_fallbacks": runtime.stats.exchange_fallbacks,
+    "join_nb_batches": sum(n._nb_batches for n in joins),
+    "gb_nb_batches": sum(n._nb_batches for n in gbs),
+    "x_nb_batches": sum(x._nb_batches for x in xs),
+    "n_exchanges": len(xs),
+}}))
+"""
+
+
+def _free_port_base(n: int = 4) -> int:
+    import socket
+
+    for _ in range(50):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no consecutive free port range found")
+
+
+@needs_nb
+def test_fused_verdict_matches_zero_fallbacks_2rank():
+    with tempfile.TemporaryDirectory() as td:
+        prog = os.path.join(td, "prog.py")
+        with open(prog, "w") as f:
+            f.write(_RANK_PROGRAM.format(repo=REPO))
+        port = _free_port_base()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("PATHWAY_LANE_PROCESSES", None)
+            env.update(
+                PATHWAY_PROCESSES="2",
+                PATHWAY_PROCESS_ID=str(rank),
+                PATHWAY_FIRST_PORT=str(port),
+                JAX_PLATFORMS="cpu",
+                PYTHONPATH=REPO,
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, prog], env=env, cwd=td,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                )
+            )
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                assert p.returncode == 0, err.decode()[-2000:]
+                outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+        finally:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+                    q.communicate()
+        for r in outs:
+            assert r["verdict"] == "fused", r
+            assert r["problems"] == [], r
+            assert r["nb_fallbacks"] == 0, r
+            assert r["exchange_fallbacks"] == 0, r
+            assert r["n_exchanges"] > 0
+        # the fused multi-rank chain actually carried columnar batches
+        assert sum(r["x_nb_batches"] for r in outs) > 0
+        assert sum(r["gb_nb_batches"] for r in outs) > 0
+        assert sum(r["join_nb_batches"] for r in outs) > 0
