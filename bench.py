@@ -478,65 +478,257 @@ def bench_load_curve(engine, queries, floor_p50: float) -> dict:
     }
 
 
-def bench_latency_model(load_curve: dict, window_ms: float = 10.0) -> dict:
-    """Pipelined-latency model validated against the measured curve
-    (replaces round 3/4's subtraction-based 'colocated bound', which the
-    512-client run beat — an un-pipelined RTT floor is not a floor under
-    pipelining).
+def bench_latency_model(
+    load_curve: dict, window_ms: float = 10.0, max_batch: int = 32
+) -> dict:
+    """Pipelined closed-loop latency model validated against the measured
+    curve. Round 5's model ``L(N) = max(RTT + window/2 + S, N/C)`` was
+    exact uncongested (rel_err 0.04 at 32 clients) but its error GREW
+    with load (0.21 at 128, 0.56 at 512) because it ignores window
+    pipelining: with D = N/B batches in flight the tunnel round trips
+    overlap (per-query transport latency amortizes toward RTT/D), the
+    window closes on max_batch instead of the timer (window wait shrinks
+    toward B/N of the timer), and the closed-loop pipeline overlaps
+    tokenize+dispatch with device execution that the OPEN-loop capacity
+    probe serializes — so measured saturated qps exceeds the probe's C.
 
-    Closed-loop model (Little's law is exact: L = N/qps):
-        L(N) = max(L0, N / C)
-    where L0 = RTT + window/2 + S is the uncongested pipeline latency
-    (one overlapped round trip + half the batching window + device
-    service) and C the open-loop device capacity. The model is validated
-    on mean latency at every measured client count, then re-evaluated
-    with RTT ≈ 0 to predict the colocated deployment the tunnel cannot
-    measure directly."""
+    Extended model (Little's law L = N/qps stays exact):
+
+        D(N)  = clamp(N/B, 1, R(N))          # in-flight window depth
+        Wf(N) = window * min(1, B/N)         # early-close window wait
+        L(N)  = max(Wf/2 + S + RTT*(1+(D-1)*rho)/D,  N / (kappa*C))
+
+    with two calibrated transport/pipeline parameters recorded in the
+    artifact: ``kappa`` (pipelined-capacity ratio — saturated closed-loop
+    qps over the serialized open-loop probe) and ``rho`` (transport
+    overlap loss: 0 = round trips overlap perfectly at depth D, 1 = no
+    overlap), fit on the measured means by grid search. R(N) is the
+    bench driver's readback-pool size (max(4, N/16)). The colocated
+    prediction re-evaluates with RTT ~ 0 (PCIe/ICI attach), where rho
+    drops out entirely."""
     rtt = load_curve["transport_floor_p50_ms"]
     S = load_curve["device_ms_per_batch32"]
     C = load_curve["device_capacity_qps"]
-    L0 = rtt + window_ms / 2.0 + S
+    measured = [
+        pt for pt in load_curve["curve"] if pt.get("mean_ms")
+    ]
+    kappa = max(
+        1.0, max((pt["qps"] for pt in measured), default=C) / C
+    )
+    c_pipe = kappa * C
+
+    def model_ms(n: float, rho: float, rtt_ms: float) -> float:
+        readers = max(4, n // 16)
+        depth = max(1.0, min(n / max_batch, readers))
+        wait = window_ms * min(1.0, max_batch / n)
+        pipe = (
+            wait / 2.0
+            + S
+            + rtt_ms * (1.0 + (depth - 1.0) * rho) / depth
+        )
+        return max(pipe, n / c_pipe * 1000.0)
+
+    def mean_err(rho: float) -> float:
+        errs = [
+            abs(model_ms(pt["n_clients"], rho, rtt) - pt["mean_ms"])
+            / pt["mean_ms"]
+            for pt in measured
+        ]
+        return sum(errs) / len(errs) if errs else 0.0
+
+    rho = min(
+        (i / 200.0 for i in range(201)), key=mean_err
+    ) if measured else 1.0
+
     points = []
     errs = []
     for pt in load_curve["curve"]:
         n = pt["n_clients"]
         measured_mean = pt["mean_ms"]
-        model_ms = max(L0, n / C * 1000.0)
+        m = model_ms(n, rho, rtt)
         if not measured_mean:  # a run that completed zero queries
             points.append(
                 {
                     "n_clients": n,
-                    "model_mean_ms": round(model_ms, 2),
+                    "model_mean_ms": round(m, 2),
                     "measured_mean_ms": None,
                 }
             )
             continue
-        err = abs(model_ms - measured_mean) / measured_mean
+        err = abs(m - measured_mean) / measured_mean
         errs.append(err)
         points.append(
             {
                 "n_clients": n,
-                "model_mean_ms": round(model_ms, 2),
+                "model_mean_ms": round(m, 2),
                 "measured_mean_ms": measured_mean,
                 "rel_err": round(err, 3),
             }
         )
     colocated_L0 = window_ms / 2.0 + S  # RTT ~ microseconds on PCIe/ICI
+    # colocated closed-loop sweep: the predicted qps-vs-clients curve at
+    # RTT ~ 0 and the knee (highest qps holding p50 under the 15 ms bar)
+    colocated_curve = []
+    knee = None
+    for n in (16, 32, 64, 96, 128, 192, 256):
+        L = model_ms(n, rho, 0.0)
+        qps = n / L * 1000.0
+        colocated_curve.append(
+            {
+                "n_clients": n,
+                "model_mean_ms": round(L, 2),
+                "model_qps": round(qps, 1),
+            }
+        )
+        if L < 15.0:
+            knee = {"n_clients": n, "p50_ms": round(L, 2),
+                    "qps": round(qps, 1)}
     return {
         "metric": "rag_latency_model",
         "value": round(colocated_L0, 2),
         "unit": "ms (predicted colocated p50, uncongested)",
-        "model": "L(N) = max(RTT + window/2 + S, N/C); closed-loop L = N/qps",
+        "model": (
+            "L(N) = max(W*min(1,B/N)/2 + S + RTT*(1+(D-1)*rho)/D, "
+            "N/(kappa*C)), D = clamp(N/B, 1, R); closed-loop L = N/qps"
+        ),
         "inputs": {
             "rtt_ms": rtt,
             "window_ms": window_ms,
+            "max_batch": max_batch,
             "device_ms_per_batch32": S,
             "device_capacity_qps": C,
+            "kappa_pipelined_capacity_ratio": round(kappa, 3),
+            "rho_transport_overlap_loss": round(rho, 3),
         },
+        # honesty note: kappa/rho are fit on the SAME measured points the
+        # errors below are computed on (in-sample), so mean_rel_err is a
+        # goodness-of-fit figure, not out-of-sample validation; the
+        # colocated line extrapolates to RTT~0 where rho drops out and
+        # stays flagged `projected` until a colocated host measures it
+        "calibration": (
+            "in-sample: kappa from max measured qps / open-loop C, rho "
+            "grid-fit on the measured means"
+        ),
         "validation": points,
         "mean_rel_err": round(sum(errs) / len(errs), 3) if errs else None,
         "colocated_p50_model_ms": round(colocated_L0, 2),
-        "colocated_capacity_qps": C,
+        "colocated_capacity_qps": round(c_pipe, 1),
+        "colocated_curve": colocated_curve,
+        "colocated_knee": knee,
+    }
+
+
+def _colocated_projection(model: dict, n_docs: int) -> dict:
+    """The ``rag_colocated_qps`` entry derived from the validated
+    pipelined model — the projection lane recorded when the bench host's
+    transport floor proves the device is NOT locally attached (a
+    tunneled chip cannot measure colocation; the model, validated on the
+    tunneled curve, predicts it)."""
+    knee = model.get("colocated_knee") or {}
+    return {
+        "metric": "rag_colocated_qps",
+        "value": knee.get("qps"),
+        "unit": "qps",
+        "p50_ms": knee.get("p50_ms"),
+        "n_clients": knee.get("n_clients"),
+        "colocated": False,
+        "projected": True,
+        "source": (
+            "pipelined latency model (rag_latency_model), validated on "
+            "the measured tunneled curve; re-measured live when the "
+            "bench host's transport floor < 2 ms"
+        ),
+        "window_ms": model["inputs"]["window_ms"],
+        "max_batch": model["inputs"]["max_batch"],
+        "n_docs": n_docs,
+        "vs_baseline": (
+            round(knee["qps"] / 5000.0, 3) if knee.get("qps") else None
+        ),
+    }
+
+
+def bench_rag_colocated(
+    engine, queries, floor_p50: float, model: dict, n_docs: int,
+    window_ms: float = 10.0, max_batch: int = 32,
+) -> dict:
+    """Colocated closed-loop serving lane (acceptance bar: >= 5,000
+    qps/chip at < 15 ms p50 for 1M docs). On a host whose transport
+    floor says the device is locally attached (< 2 ms), this measures a
+    real closed-loop sweep through the micro-batching gateway and
+    records the best qps whose p50 clears the latency bar; on a
+    tunneled dev chip the lane records the model projection instead
+    (flagged ``projected``), so the artifact always carries the
+    colocated line and a later colocated run replaces it with a
+    measurement via the same flow."""
+    if floor_p50 >= 2.0:
+        return _colocated_projection(model, n_docs)
+
+    import threading
+
+    from pathway_tpu.ops import MicroBatcher
+
+    best = None
+    curve = []
+    for n_clients in (32, 64, 128, 256):
+        mb = MicroBatcher(
+            engine, max_wait_ms=window_ms, max_batch=max_batch,
+            readback_workers=max(4, n_clients // 16),
+        )
+        mb.query(queries[0])
+        duration_s = 5.0
+        lats: list[list[float]] = [[] for _ in range(n_clients)]
+        stop_at = time.perf_counter() + duration_s
+
+        def client(ci: int):
+            i = 0
+            while time.perf_counter() < stop_at:
+                q = queries[(ci * 37 + i) % len(queries)]
+                t0 = time.perf_counter()
+                mb.query(q, timeout=120.0)
+                lats[ci].append((time.perf_counter() - t0) * 1000.0)
+                i += 1
+
+        threads = [
+            threading.Thread(target=client, args=(ci,))
+            for ci in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        mb.close()
+        all_lats = sorted(x for l in lats for x in l)
+        n_done = len(all_lats)
+        if not n_done:
+            continue
+        p50 = all_lats[n_done // 2]
+        qps = n_done / wall
+        curve.append(
+            {
+                "n_clients": n_clients,
+                "qps": round(qps, 1),
+                "p50_ms": round(p50, 2),
+                "p95_ms": round(all_lats[int(n_done * 0.95)], 2),
+            }
+        )
+        if p50 < 15.0 and (best is None or qps > best[0]):
+            best = (qps, p50, n_clients)
+    return {
+        "metric": "rag_colocated_qps",
+        "value": round(best[0], 1) if best else None,
+        "unit": "qps",
+        "p50_ms": round(best[1], 2) if best else None,
+        "n_clients": best[2] if best else None,
+        "colocated": True,
+        "projected": False,
+        "curve": curve,
+        "window_ms": window_ms,
+        "max_batch": max_batch,
+        "n_docs": n_docs,
+        "transport_floor_p50_ms": round(floor_p50, 2),
+        "vs_baseline": round(best[0] / 5000.0, 3) if best else None,
     }
 
 
@@ -734,7 +926,13 @@ def main() -> None:
     emit(under_load)
     load_curve = bench_load_curve(engine, queries, floor_p50)
     emit(load_curve)
-    emit(bench_latency_model(load_curve))
+    model = bench_latency_model(load_curve)
+    emit(model)
+    emit(
+        bench_rag_colocated(
+            engine, queries, floor_p50, model, n_docs
+        )
+    )
     emit(bench_update_while_serving(engine, index, queries, floor_p50))
 
     ann = bench_ann()
@@ -761,5 +959,82 @@ def main() -> None:
     rel.main(200_000, emit=emit)
 
 
+def main_update_model_artifact() -> None:
+    """Recompute the serving-model entries from the measured curve
+    already recorded in BENCH_full.json and splice them in place
+    (mirrors scripts/bench_relational.py --update-artifact): the
+    ``rag_latency_model`` line is re-derived with the extended pipelined
+    model and the ``rag_colocated_qps`` line is refreshed from it —
+    without re-running the accelerator benches. A line the colocated
+    lane actually MEASURED (``projected: false``) is left untouched; a
+    full ``python bench.py`` pass re-measures everything."""
+    try:
+        with open(_ARTIFACT_PATH) as f:
+            artifact = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print(f"no artifact at {_ARTIFACT_PATH}", file=sys.stderr)
+        raise SystemExit(1)
+    curve = next(
+        (
+            e for e in artifact
+            if isinstance(e, dict) and e.get("metric") == "rag_qps_vs_clients"
+        ),
+        None,
+    )
+    if curve is None:
+        print("no rag_qps_vs_clients entry to model from", file=sys.stderr)
+        raise SystemExit(1)
+    model = bench_latency_model(curve)
+    rag = next(
+        (
+            e for e in artifact
+            if isinstance(e, dict) and e.get("metric") == "rag_query_p50_ms"
+        ),
+        {},
+    )
+    colocated = _colocated_projection(model, rag.get("n_docs", 1_000_000))
+    # a real colocated MEASUREMENT already in the artifact outranks the
+    # projection: keep it in place, only refresh the model line
+    has_measured = any(
+        isinstance(e, dict)
+        and e.get("metric") == "rag_colocated_qps"
+        and e.get("projected") is False
+        for e in artifact
+    )
+    out: list[dict] = []
+    replaced_model = inserted_colocated = False
+    for entry in artifact:
+        metric = entry.get("metric") if isinstance(entry, dict) else None
+        if metric == "rag_latency_model":
+            out.append(model)
+            replaced_model = True
+            if not has_measured and not inserted_colocated:
+                out.append(colocated)
+                inserted_colocated = True
+            continue
+        if metric == "rag_colocated_qps":
+            if entry.get("projected") is False:
+                out.append(entry)
+            continue  # stale projections are superseded
+        out.append(entry)
+    if not replaced_model:
+        out.append(model)
+    if not has_measured and not inserted_colocated:
+        out.append(colocated)
+    write_artifact_atomic(_ARTIFACT_PATH, out)
+    print(
+        json.dumps(
+            {
+                "updated": ["rag_latency_model", "rag_colocated_qps"],
+                "mean_rel_err": model["mean_rel_err"],
+                "colocated_knee": model["colocated_knee"],
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--update-model-artifact" in sys.argv:
+        main_update_model_artifact()
+    else:
+        main()

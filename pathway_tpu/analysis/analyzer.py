@@ -2,7 +2,7 @@
 
 ``analyze(*tables, processes=N)`` lowers the captured ParseGraph onto a
 scratch Runtime (graph construction only — no connector threads, no mesh,
-no data) and runs four passes over the node graph:
+no data) and runs five passes over the node graph:
 
 1. **fusion blame** — per join/groupby/select/exchange node, the SAME
    construction-time ``nb_decision`` the executor gated its columnar path
@@ -18,7 +18,11 @@ no data) and runs four passes over the node graph:
 3. **replay/retraction safety** — non-deterministic UDFs feeding
    exchanged or persisted columns (replay-after-rollback divergence), and
    declared-deterministic UDFs whose code references wall clocks / RNGs.
-4. **knob validation** — the PATHWAY_* registry findings as diagnostics.
+4. **serving/egress sinks** — row-expanding ``on_change`` sinks that pay
+   one Python callback per change (the CaptureNode-style egress
+   de-optimization), with the fix hint pointing at the batched
+   subscribe path.
+5. **knob validation** — the PATHWAY_* registry findings as diagnostics.
 
 ``analyze_scope(runtime)`` runs the same passes over an already-lowered
 runtime (the agreement tests lower once, analyze, run, then compare
@@ -473,7 +477,53 @@ def _replay_pass(
                     )
 
 
-# -- pass 4: knob validation ----------------------------------------------
+# -- pass 4: serving/egress sinks -----------------------------------------
+
+def _sink_pass(runtime, diags: list[Diagnostic]) -> None:
+    """Blame row-expanding serving sinks: an OutputNode delivering
+    through a per-row Python ``on_change`` callback expands every batch
+    row-wise at the egress — the CaptureNode-style de-optimization
+    (ROADMAP item 2) that throttles an otherwise-batched serving path.
+    The batched subscribe path (``on_batch=`` on ``pw.io.subscribe`` /
+    ``rest_connector``'s window fan-out) delivers one callback per
+    batch instead."""
+    from pathway_tpu.engine import nodes as N
+
+    for node in runtime.scope.nodes:
+        if not isinstance(node, N.OutputNode):
+            continue
+        if node._on_change is None or node._on_batch is not None:
+            continue  # batched (or callback-free probe) egress
+        via = (
+            "the C delivery loop builds its row dicts, but the callback "
+            "still fires once per row"
+            if node._dict_cols is not None
+            else "each C-owned batch row expands through a Python "
+            "callback"
+        )
+        diags.append(
+            Diagnostic(
+                code="sink.row-expanding",
+                severity="info",
+                node=_node_label(node),
+                message=(
+                    f"per-row on_change sink: {via} — under load this "
+                    f"egress pays one Python call per change, the same "
+                    f"row expansion that throttles CaptureNode "
+                    f"materialization"
+                ),
+                hint=(
+                    "deliver batched: pass on_batch= to pw.io.subscribe "
+                    "(one callback per delivered batch/window) — the "
+                    "rest_connector response path already fans out this "
+                    "way"
+                ),
+                where=_where(node),
+            )
+        )
+
+
+# -- pass 5: knob validation ----------------------------------------------
 
 def _knob_pass(diags: list[Diagnostic]) -> None:
     from pathway_tpu.analysis.knobs import (
@@ -518,6 +568,7 @@ def analyze_scope(
     entries = _fusion_pass(runtime, diags)
     _exchange_pass(runtime, diags)
     _replay_pass(runtime, diags, persistence=persistence)
+    _sink_pass(runtime, diags)
     _knob_pass(diags)
 
     has_nb_source = any(

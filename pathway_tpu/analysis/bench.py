@@ -174,11 +174,36 @@ def build_transform(n_rows: int = 300) -> BenchPipeline:
     return bp
 
 
+def build_serving() -> BenchPipeline:
+    """rest-gateway serving shape: REST source → select → batched
+    response sink (graph construction only — the webserver binds no
+    port until run). The verdict documents the serving plan's relational
+    shape (a tuple source: request rows are Python dicts with removes;
+    the device work lives in the index adapter, not the fused chain) and
+    pins that the response egress is the BATCHED sink — a
+    ``sink.row-expanding`` diagnostic here is a serving regression."""
+    import pathway_tpu as pw
+
+    pw.internals.parse_graph.G.clear()
+
+    class S(pw.Schema):
+        value: int
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=0)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=S
+    )
+    out = queries.select(result=pw.this.value)
+    writer(out)
+    return BenchPipeline("serving", out, [])
+
+
 BENCH_PIPELINES: dict[str, Callable[[], BenchPipeline]] = {
     "wordcount": build_wordcount,
     "stream_join": build_stream_join,
     "groupby": build_groupby,
     "transform": build_transform,
+    "serving": build_serving,
 }
 
 # BENCH_full.json metric name -> (pipeline, analysis world size)
@@ -187,6 +212,7 @@ BENCH_METRIC_PLANS: dict[str, tuple[str, int]] = {
     "wordcount_2rank_rows_per_s": ("wordcount", 2),
     "stream_join_rows_per_s": ("stream_join", 1),
     "transform_rows_per_s": ("transform", 1),
+    "rag_colocated_qps": ("serving", 1),
 }
 
 

@@ -130,6 +130,25 @@ KNOBS: dict[str, Knob] = {
            "degrading silently."),
         _k("PATHWAY_NATIVE_BUILD_DIR", "str", None,
            "Override the native extension build dir (sanitizer lanes)."),
+        # -- REST serving gateway (io/http/_server.py) --------------------
+        _k("PATHWAY_REST_TIMEOUT_S", "float", 120.0,
+           "Per-request deadline on the REST gateway; timed-out requests "
+           "get 504 and are evicted from the batch window.", lo=0.001,
+           hi=86400),
+        _k("PATHWAY_SERVE_WINDOW_MS", "float", 5.0,
+           "Dynamic batch window of the serving gateway: requests "
+           "coalesce into ONE dataflow commit until the window closes "
+           "(0 = commit per request).", lo=0, hi=60_000),
+        _k("PATHWAY_SERVE_MAX_BATCH", "int", 32,
+           "Close the serving batch window early once this many requests "
+           "are collected.", lo=1, hi=65536),
+        _k("PATHWAY_SERVE_QUEUE_CAP", "int", 2048,
+           "Bounded admission queue of the serving gateway; overflow is "
+           "shed with 503 + Retry-After.", lo=1, hi=10_000_000),
+        _k("PATHWAY_SERVE_WORKERS", "int", 1,
+           "Gateway dispatch workers draining closed batch windows into "
+           "the dataflow (each window stays one atomic commit).", lo=1,
+           hi=64),
         # -- connector supervision ----------------------------------------
         _k("PATHWAY_CONNECTOR_MAX_RESTARTS", "int", 3,
            "In-place restart budget per connector subject.", lo=0,

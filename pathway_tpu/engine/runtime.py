@@ -283,6 +283,13 @@ class Runtime:
         conn = _Connector(node, subject, parser)
         conn.name = name or f"connector_{len(self.connectors)}"
         self.connectors.append(conn)
+        # serving subjects (io/http/_server.py gateway) carry their own
+        # ServeMetrics from construction; mounting it here puts the
+        # request/shed/timeout counters and the latency/batch-occupancy
+        # histograms on this run's OpenMetrics endpoint
+        serve_metrics = getattr(subject, "serve_metrics", None)
+        if serve_metrics is not None:
+            self.stats.mount_serve_metrics(serve_metrics)
 
     def mark_pending(self, time: int, node: Node) -> None:
         slot = self.pending_times.get(time)

@@ -47,6 +47,93 @@ class ConnectorStats:
         return sum(n for _, n in self.recent)
 
 
+# serving histograms (io/http/_server.py gateway): fixed OpenMetrics
+# bucket edges. Latency buckets span sub-ms colocated responses up to
+# the shed/timeout regime; occupancy buckets prove request coalescing is
+# engaging (occupancy > 1 under load is the direct evidence the gateway
+# batches instead of paying one commit per request).
+SERVE_LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+    2500.0, 5000.0, 15000.0,
+)
+SERVE_OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _Histogram:
+    """Minimal cumulative-bucket histogram (OpenMetrics shape)."""
+
+    __slots__ = ("edges", "counts", "total", "sum")
+
+    def __init__(self, edges):
+        self.edges = tuple(edges)
+        self.counts = [0] * (len(self.edges) + 1)  # last = +Inf
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.total += 1
+        self.sum += value
+        for i, edge in enumerate(self.edges):
+            if value <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, labels: str) -> list[str]:
+        sep = "," if labels else ""
+        lines = []
+        cum = 0
+        for edge, n in zip(self.edges, self.counts):
+            cum += n
+            le = f"{edge:g}"
+            lines.append(f'{name}_bucket{{{labels}{sep}le="{le}"}} {cum}')
+        cum += self.counts[-1]
+        lines.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {cum}')
+        lines.append(f"{name}_sum{{{labels}}} {self.sum:.6g}")
+        lines.append(f"{name}_count{{{labels}}} {self.total}")
+        return lines
+
+
+@dataclass
+class ServeMetrics:
+    """Per-route serving gateway instrumentation (io/http/_server.py):
+    request/shed/timeout counters, the request-latency histogram, and
+    the batch-occupancy histogram — the direct evidence that request
+    coalescing is engaging under load. The subject owns this object from
+    construction; the runtime mounts it on ProberStats at add_connector
+    time so the OpenMetrics endpoint serves it."""
+
+    route: str = ""
+    requests: int = 0
+    shed: int = 0
+    timeouts: int = 0
+    commits: int = 0          # batch windows committed into the dataflow
+    latency: _Histogram = field(
+        default_factory=lambda: _Histogram(SERVE_LATENCY_BUCKETS_MS)
+    )
+    occupancy: _Histogram = field(
+        default_factory=lambda: _Histogram(SERVE_OCCUPANCY_BUCKETS)
+    )
+
+    def on_request(self) -> None:
+        self.requests += 1
+
+    def on_shed(self) -> None:
+        self.shed += 1
+
+    def on_timeout(self) -> None:
+        self.timeouts += 1
+
+    def on_latency_ms(self, ms: float) -> None:
+        self.latency.observe(ms)
+
+    def on_window(self, occupancy: int) -> None:
+        """One batch window committed (= one dataflow timestamp, one
+        fused device dispatch downstream)."""
+        self.commits += 1
+        self.occupancy.observe(occupancy)
+
+
 @dataclass
 class ProberStats:
     """reference: graph.rs:554 ProberStats — input/output frontier lag."""
@@ -83,6 +170,14 @@ class ProberStats:
     mesh_rank_restarts: int = 0
     mesh_rollbacks: int = 0
     mesh_last_committed_epoch: int = -1
+    # serving gateway routes (io/http/_server.py): each RestServerSubject
+    # owns a ServeMetrics; the runtime mounts them here at add_connector
+    # time so /metrics serves every route's counters and histograms
+    serve: list = field(default_factory=list)
+
+    def mount_serve_metrics(self, metrics: "ServeMetrics") -> None:
+        if metrics not in self.serve:
+            self.serve.append(metrics)
 
     def on_mesh_heartbeat_missed(self, n: int = 1) -> None:
         self.mesh_heartbeats_missed += n
@@ -214,6 +309,31 @@ class ProberStats:
         lines.append(
             f"mesh_last_committed_epoch {self.mesh_last_committed_epoch}"
         )
+        if self.serve:
+            # samples grouped under their TYPE line, per metric across
+            # all routes (the OpenMetrics grouping contract)
+            for metric, attr in (
+                ("serve_requests_total", "requests"),
+                ("serve_shed_total", "shed"),
+                ("serve_timeouts_total", "timeouts"),
+                ("serve_window_commits_total", "commits"),
+            ):
+                lines.append(f"# TYPE {metric} counter")
+                for sm in self.serve:
+                    lines.append(
+                        f'{metric}{{route="{sm.route}"}} {getattr(sm, attr)}'
+                    )
+            for metric, attr in (
+                ("serve_request_latency_ms", "latency"),
+                ("serve_batch_occupancy", "occupancy"),
+            ):
+                lines.append(f"# TYPE {metric} histogram")
+                for sm in self.serve:
+                    lines.extend(
+                        getattr(sm, attr).render(
+                            metric, f'route="{sm.route}"'
+                        )
+                    )
         return "\n".join(lines) + "\n"
 
     def render_text(self) -> str:

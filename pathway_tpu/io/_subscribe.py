@@ -14,18 +14,37 @@ def subscribe(
     on_end: Callable | None = None,
     on_time_end: Callable | None = None,
     *,
+    on_batch: Callable | None = None,
     name: str | None = None,
     sort_by=None,
 ) -> None:
-    """on_change(key, row: dict, time: int, is_addition: bool)."""
+    """on_change(key, row: dict, time: int, is_addition: bool).
+
+    ``on_batch(time, changes)`` is the batched egress: one callback per
+    delivered batch with ``changes = [(key, row_dict, diff), ...]`` —
+    serving fan-outs and columnar sinks should prefer it over the
+    per-row ``on_change`` (which expands every C-owned batch row-wise
+    through a Python callback; the Plan Doctor's ``sink.row-expanding``
+    diagnostic names exactly that de-optimization).
+    """
     cols = tuple(table.column_names())
 
     def lower(ctx):
+        batch_cb = None
+        if on_batch is not None:
+
+            def batch_cb(time, deltas):
+                on_batch(
+                    time,
+                    [(k, dict(zip(cols, row)), d) for k, row, d in deltas],
+                )
+
         # dict_cols pushes the row-dict building into the OutputNode's C
         # delivery loop instead of a per-change Python wrapper
         ctx.scope.output(
             ctx.engine_table(table),
             on_change=on_change,
+            on_batch=batch_cb,
             on_time_end=on_time_end,
             on_end=on_end,
             dict_cols=cols if on_change is not None else None,

@@ -5,14 +5,23 @@ rest_connector + :329 PathwayWebserver)."""
 from pathway_tpu.io.http._server import (
     EndpointDocumentation,
     PathwayWebserver,
+    RestServerSubject,
     rest_connector,
 )
-from pathway_tpu.io.http._client import read, write
+from pathway_tpu.io.http._client import (
+    HttpError,
+    KeepAliveSession,
+    read,
+    write,
+)
 
 __all__ = [
     "PathwayWebserver",
     "EndpointDocumentation",
+    "RestServerSubject",
     "rest_connector",
+    "KeepAliveSession",
+    "HttpError",
     "read",
     "write",
 ]

@@ -1,17 +1,145 @@
 """HTTP streaming client connector (reference:
 python/pathway/io/http/__init__.py:28 — poll an endpoint into a table;
-write: POST each row to an endpoint)."""
+write: POST each row to an endpoint) + the keep-alive request session the
+serving clients (VectorStoreClient, RAGClient) reuse so a closed-loop
+client pays TCP setup once, not per query."""
 
 from __future__ import annotations
 
+import http.client
 import json as _json
+import threading
 import time
+import urllib.parse
 import urllib.request
 from typing import Any
 
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema
 from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+class HttpError(urllib.error.HTTPError):
+    """Non-2xx response from a keep-alive session request. Subclasses
+    ``urllib.error.HTTPError`` so callers that caught the old
+    urllib-based clients' errors (``e.code``, ``e.read()``) keep
+    working unchanged."""
+
+    def __init__(self, status: int, body: bytes, url: str = ""):
+        import io
+
+        # .status/.code come from HTTPError itself
+        super().__init__(url, status, f"HTTP {status}", None, io.BytesIO(body))
+        self.body = body
+
+    def json(self):
+        return _json.loads(self.body.decode())
+
+
+class KeepAliveSession:
+    """Persistent-connection JSON client over ``http.client``.
+
+    One kept-alive HTTP/1.1 connection PER THREAD (``threading.local``),
+    re-established transparently when the server closes it — concurrent
+    callers sharing one session keep their independent parallelism (no
+    cross-thread lock held over a round trip) while each thread's
+    request stream pays connection setup once. This is what lets a
+    closed-loop client of the batching gateway ride the keep-alive path
+    the server now serves."""
+
+    def __init__(self, url: str, timeout: float = 90.0):
+        if "://" not in url:
+            # scheme-less "host:port" would mis-parse as scheme=host
+            url = "http://" + url
+        parsed = urllib.parse.urlsplit(url)
+        if parsed.scheme not in ("http", "https"):
+            raise ValueError(
+                f"KeepAliveSession supports http(s):// urls, got {url!r}"
+            )
+        self.tls = parsed.scheme == "https"
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or (443 if self.tls else 80)
+        # a base path in the url (reverse-proxy prefix) prepends to
+        # every route, matching the old `url + route` concatenation
+        self.base_path = parsed.path.rstrip("/")
+        self.timeout = timeout
+        self._local = threading.local()
+
+    def _connect(self) -> http.client.HTTPConnection:
+        cls = (
+            http.client.HTTPSConnection
+            if self.tls
+            else http.client.HTTPConnection
+        )
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        conn.connect()
+        return conn
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            finally:
+                self._local.conn = None
+
+    def request_json(self, method: str, route: str, payload=None):
+        body = None
+        headers = {}
+        if payload is not None:
+            body = _json.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        route = self.base_path + route
+        while True:
+            reused = getattr(self._local, "conn", None) is not None
+            conn = self._local.conn if reused else self._connect()
+            self._local.conn = conn
+            sent = False
+            try:
+                conn.request(method, route, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    conn.close()
+                    self._local.conn = None
+                break
+            except (
+                http.client.HTTPException, ConnectionError, OSError
+            ) as exc:
+                conn.close()
+                self._local.conn = None
+                # retry ONLY the stale keep-alive race, where the server
+                # provably never processed the request: a send-phase
+                # failure on a reused socket, or a zero-byte
+                # "closed without response" on a reused socket (the
+                # idle-timeout close raced our request). Anything after
+                # response bytes began — or any fresh-connection failure
+                # — may have been processed server-side, and re-sending
+                # would duplicate a non-idempotent request: propagate.
+                stale = reused and (
+                    not sent
+                    or isinstance(
+                        exc,
+                        (
+                            http.client.RemoteDisconnected,
+                            http.client.BadStatusLine,
+                        ),
+                    )
+                )
+                if not stale:
+                    raise
+        if resp.status >= 400:
+            raise HttpError(resp.status, data)
+        if not data:
+            return None
+        return _json.loads(data.decode())
+
+    def post(self, route: str, payload: dict):
+        return self.request_json("POST", route, payload)
+
+    def get(self, route: str):
+        return self.request_json("GET", route)
 
 
 class _HttpPollSubject(ConnectorSubject):

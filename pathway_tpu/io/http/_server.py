@@ -4,21 +4,56 @@ PathwayWebserver :329, rest_connector :624, RestServerSubject :525).
 One aiohttp application (owned by a PathwayWebserver) serves any number of
 routes; each route is a connector: an incoming request becomes a row in the
 queries table, the caller's response future resolves when the paired
-response-writer table produces the row with the same id."""
+response-writer table produces the row with the same id.
+
+Serving gateway (ROADMAP item 1 — serve at the device bound): requests do
+NOT commit one-by-one. Each admitted request joins the route's dynamic
+batch window; the window closes on ``PATHWAY_SERVE_WINDOW_MS`` elapsed or
+``PATHWAY_SERVE_MAX_BATCH`` collected — whichever first — and the whole
+window enters the dataflow as ONE commit (= one dataflow timestamp = one
+BSP round = one fused KNN+rerank device dispatch downstream, because the
+external-index operator batches queries per timestamp). Responses fan out
+per window through the batched subscribe path (``on_batch``), one
+cross-thread hop per window instead of one per row. Admission is bounded
+(``PATHWAY_SERVE_QUEUE_CAP``): overflow is shed with 503 + ``Retry-After``
+sized from the observed service rate, and shed/timed-out requests are
+evicted from their window so they never occupy a batch slot or a device
+dispatch. aiohttp keeps HTTP/1.1 connections alive, so a closed-loop
+client pays the TCP+TLS setup once, not per query.
+"""
 
 from __future__ import annotations
 
 import asyncio
 import dataclasses
 import json as _json
+import math
+import os
+import queue as _queue
 import threading
+import time as _time
 from typing import Any, Sequence
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.api import Json, Pointer, ref_scalar
+from pathway_tpu.internals.monitoring import ServeMetrics
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema
 from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+
+def _env_knob(name: str, default: float) -> float:
+    """Best-effort env read for the serving knobs; the registry
+    (analysis/knobs.py) validates the same names at runtime startup, so
+    a malformed value is rejected there with a rich KnobError — here it
+    just falls back to the default."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass
@@ -206,7 +241,33 @@ class PathwayWebserver:
         loop.run_forever()
 
 
+class _PendingRequest:
+    """One admitted request riding a batch window."""
+
+    __slots__ = ("key", "values", "future", "admitted_at", "evicted")
+
+    def __init__(self, key, values, future):
+        self.key = key
+        self.values = values
+        self.future = future
+        self.admitted_at = _time.perf_counter()
+        self.evicted = False
+
+
 class RestServerSubject(ConnectorSubject):
+    """Request-coalescing serving gateway over the python connector.
+
+    Pipeline per request: admission (bounded; overflow shed with 503 +
+    Retry-After) → dynamic batch window (closes on
+    ``PATHWAY_SERVE_WINDOW_MS`` or ``PATHWAY_SERVE_MAX_BATCH``, whichever
+    first) → a dispatch worker turns the window into upserts + ONE
+    ``commit()`` (one dataflow timestamp, one fused device dispatch
+    downstream) → the response table's batched subscribe callback
+    resolves the whole window's futures in one cross-thread hop.
+    Timed-out/disconnected requests are evicted from their window before
+    dispatch; ``delete_completed_queries`` retractions are batched and
+    ride the next window's commit instead of paying their own."""
+
     def __init__(
         self,
         webserver: PathwayWebserver,
@@ -216,6 +277,11 @@ class RestServerSubject(ConnectorSubject):
         delete_completed_queries: bool,
         request_validator=None,
         documentation=None,
+        window_ms: float | None = None,
+        max_batch: int | None = None,
+        queue_cap: int | None = None,
+        timeout_s: float | None = None,
+        workers: int | None = None,
     ):
         super().__init__()
         self.webserver = webserver
@@ -226,27 +292,102 @@ class RestServerSubject(ConnectorSubject):
         self._tasks: dict[Pointer, asyncio.Future] = {}
         self._seq = 0
         self._lock = threading.Lock()
+        # gateway knobs: explicit args win, then the serve/REST env knobs
+        self.window_s = (
+            window_ms
+            if window_ms is not None
+            else _env_knob("PATHWAY_SERVE_WINDOW_MS", 5.0)
+        ) / 1000.0
+        self.max_batch = int(
+            max_batch
+            if max_batch is not None
+            else _env_knob("PATHWAY_SERVE_MAX_BATCH", 32)
+        )
+        self.queue_cap = int(
+            queue_cap
+            if queue_cap is not None
+            else _env_knob("PATHWAY_SERVE_QUEUE_CAP", 2048)
+        )
+        self.timeout_s = (
+            timeout_s
+            if timeout_s is not None
+            else _env_knob("PATHWAY_REST_TIMEOUT_S", 120.0)
+        )
+        self.workers = int(
+            workers
+            if workers is not None
+            else _env_knob("PATHWAY_SERVE_WORKERS", 1)
+        )
+        self.serve_metrics = ServeMetrics(route=route)
+        # collecting window (event-loop thread only) + closed-window queue
+        # drained by the dispatch workers
+        self._window: list[_PendingRequest] = []
+        self._window_timer = None
+        self._windows_q: "_queue.Queue" = _queue.Queue()
+        self._commit_lock = threading.Lock()
+        self._inflight = 0  # admitted, unresponded (event-loop thread)
+        # delete_completed_queries retractions batched onto later commits
+        self._removals: list[tuple[Pointer, dict]] = []
+        self._removals_lock = threading.Lock()
+        self._removal_timer = None
+        self._live: dict[Pointer, dict] = {}  # dispatched, not yet removed
+        # rolling (t, n) response counts — the observed service rate that
+        # sizes Retry-After when admission sheds
+        self._recent_done: list[tuple[float, int]] = []
+        self._dispatchers: list[threading.Thread] = []
+        self._gateway_up = False
         webserver._register_route(
             route, methods, self._handle, documentation, schema=schema
         )
 
+    # -- lifecycle --------------------------------------------------------
+    def _ensure_gateway(self) -> None:
+        # raced by the connector thread (run) and the event loop (first
+        # request): the commit lock keeps worker startup single-shot
+        if self._gateway_up:
+            return
+        with self._commit_lock:
+            if self._gateway_up:
+                return
+            for i in range(max(1, self.workers)):
+                t = threading.Thread(
+                    target=self._dispatch_loop,
+                    name=f"pw-serve-{self.route}-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._dispatchers.append(t)
+            self._gateway_up = True
+
     def run(self):
         self.webserver._ensure_started()
-        # stays alive for the whole pipeline; requests drive next()/commit
+        self._ensure_gateway()
+        # stays alive for the whole pipeline; requests drive windows/commits
         self._shutdown = threading.Event()
         self._shutdown.wait()
 
     def on_stop(self):
         if hasattr(self, "_shutdown"):
             self._shutdown.set()
+        if self._gateway_up:
+            self._gateway_up = False
+            for _ in self._dispatchers:
+                self._windows_q.put(None)
+            for t in self._dispatchers:
+                t.join(timeout=2)
+            self._dispatchers.clear()
 
+    # -- request path (webserver event loop) ------------------------------
     async def _handle(self, request):
         from aiohttp import web
 
         cols = self.schema.column_names()
         defaults = self.schema.default_values()
         if request.method == "GET":
-            # query-string values are strings — coerce to the schema types
+            # query-string values are strings — coerce to the schema
+            # types; a value that does not parse as its typed column is a
+            # client error, reported with the offending field (it must
+            # never enter the dataflow as a raw string in a typed column)
             hints = self.schema.typehints()
             payload = {}
             for key, value in request.query.items():
@@ -257,9 +398,23 @@ class RestServerSubject(ConnectorSubject):
                     elif t is dt.FLOAT:
                         value = float(value)
                     elif t is dt.BOOL:
-                        value = value.lower() in ("1", "true", "yes")
+                        low = value.lower()
+                        if low in ("1", "true", "yes"):
+                            value = True
+                        elif low in ("0", "false", "no"):
+                            value = False
+                        else:
+                            raise ValueError(value)
                 except (TypeError, ValueError):
-                    pass
+                    return web.json_response(
+                        {
+                            "error": (
+                                f"field {key!r} must be "
+                                f"{_coercion_target(t)}, got {value!r}"
+                            )
+                        },
+                        status=400,
+                    )
                 payload[key] = value
         else:
             try:
@@ -289,33 +444,196 @@ class RestServerSubject(ConnectorSubject):
         for c, typ in self.schema.typehints().items():
             if typ is dt.JSON and values.get(c) is not None and not isinstance(values[c], Json):
                 values[c] = Json(values[c])
+
+        metrics = self.serve_metrics
+        metrics.on_request()
+        # admission control: bounded in-flight backlog; overflow is shed
+        # rather than queued into latency the client will time out on
+        # anyway (the device is behind the N/C capacity line)
+        if self._inflight >= self.queue_cap:
+            metrics.on_shed()
+            return web.json_response(
+                {"error": "overloaded, retry later"},
+                status=503,
+                headers={"Retry-After": str(self._retry_after_s())},
+            )
         with self._lock:
             self._seq += 1
             key = ref_scalar("rest", self.route, self._seq)
         future: asyncio.Future = asyncio.get_event_loop().create_future()
         self._tasks[key] = future
-        self._upsert(key, values)
-        self.commit()
+        pending = _PendingRequest(key, values, future)
+        self._inflight += 1
+        self._join_window(pending)
         try:
-            result = await asyncio.wait_for(future, timeout=120)
+            result = await asyncio.wait_for(future, timeout=self.timeout_s)
         except asyncio.TimeoutError:
+            # evicted: if the window has not dispatched yet, the request
+            # vanishes before it can occupy a batch slot / device dispatch
+            pending.evicted = True
+            metrics.on_timeout()
             return web.json_response({"error": "timeout"}, status=504)
+        except asyncio.CancelledError:
+            # client disconnected: same eviction semantics as a timeout
+            pending.evicted = True
+            raise
         finally:
+            self._inflight -= 1
             self._tasks.pop(key, None)
-            if self.delete_completed_queries:
-                self._remove(key, values)
-                self.commit()
+        metrics.on_latency_ms(
+            (_time.perf_counter() - pending.admitted_at) * 1000.0
+        )
         return web.json_response(result)
 
-    def _resolve(self, key: Pointer, value: Any) -> None:
-        future = self._tasks.get(key)
+    def _retry_after_s(self) -> int:
+        """Seconds until the current backlog drains at the observed
+        service rate — the Retry-After a shed client should honor."""
+        now = _time.monotonic()
+        with self._lock:  # _resolve_batch appends from the engine thread
+            self._recent_done = [
+                (t, n) for t, n in self._recent_done if now - t <= 10.0
+            ]
+            qps = sum(n for _, n in self._recent_done) / 10.0
+        if qps <= 0:
+            return 1
+        return max(1, min(60, math.ceil(self._inflight / qps)))
+
+    # -- batch window (event-loop thread) ---------------------------------
+    def _join_window(self, pending: _PendingRequest) -> None:
+        self._ensure_gateway()  # first request may beat the run() thread
+        self._window.append(pending)
+        if self.window_s <= 0 or len(self._window) >= self.max_batch:
+            self._close_window(self._window)
+            return
+        if len(self._window) == 1:
+            self._window_timer = asyncio.get_event_loop().call_later(
+                self.window_s, self._close_window, self._window
+            )
+
+    def _close_window(self, window: list) -> None:
+        if window is not self._window:
+            return  # already closed by the max-batch trigger
+        if self._window_timer is not None:
+            self._window_timer.cancel()
+            self._window_timer = None
+        self._window = []
+        self._windows_q.put(window)
+
+    # -- dispatch workers (threads) ---------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            window = self._windows_q.get()
+            if window is None:
+                return
+            try:
+                self._dispatch_window(window)
+            except Exception:
+                # a failing dispatch must fail the window's futures, not
+                # kill the worker (clients would hang to their timeouts)
+                loop = self.webserver._loop
+                if loop is not None:
+                    futures = [
+                        p.future for p in window if not p.evicted
+                    ]
+
+                    def _fail(futures=futures):
+                        for f in futures:
+                            if not f.done():
+                                f.set_exception(
+                                    RuntimeError("gateway dispatch failed")
+                                )
+
+                    loop.call_soon_threadsafe(_fail)
+
+    def _dispatch_window(self, window: list) -> None:
+        """The windowed commit: every live request of the window upserts,
+        batched completed-query retractions piggyback, then ONE commit —
+        the whole window is one dataflow timestamp. The lock keeps
+        concurrent workers' windows atomic (interleaved upserts would
+        merge two windows into one flush)."""
+        with self._commit_lock:
+            live = [p for p in window if not p.evicted]
+            with self._removals_lock:
+                removals, self._removals = self._removals, []
+            if not live and not removals:
+                return
+            try:
+                for p in live:
+                    if self.delete_completed_queries:
+                        # tracked only for the later retraction — an
+                        # unconditional record would grow per request
+                        # forever on keep-queries servers
+                        self._live[p.key] = p.values
+                    self._upsert(p.key, p.values)
+                for key, values in removals:
+                    self._remove(key, values)
+                self.commit()
+            except BaseException:
+                if removals:
+                    # the swapped-out retractions must not vanish with
+                    # the failed dispatch — re-queue them for the next
+                    # window (their keys already left _live)
+                    with self._removals_lock:
+                        self._removals[:0] = removals
+                raise
+            if live:
+                self.serve_metrics.on_window(len(live))
+
+    # -- response fan-in (engine output thread) ---------------------------
+    def _resolve_batch(self, resolved: list[tuple[Pointer, Any]]) -> None:
+        """One delivered response batch (= one window downstream):
+        resolve every future in a single cross-thread hop and queue the
+        completed rows' retractions onto the next commit."""
         loop = self.webserver._loop
-        if future is not None and loop is not None:
+        futures = []
+        for key, result in resolved:
+            future = self._tasks.get(key)
+            if future is not None:
+                futures.append((future, result))
+            if self.delete_completed_queries:
+                values = self._live.pop(key, None)
+                if values is not None:
+                    with self._removals_lock:
+                        self._removals.append((key, values))
+        with self._lock:  # _retry_after_s prunes from the event loop
+            self._recent_done.append((_time.monotonic(), len(resolved)))
+            del self._recent_done[:-256]
+        if loop is not None and futures:
             def _set():
-                if not future.done():
-                    future.set_result(value)
+                for future, result in futures:
+                    if not future.done():
+                        future.set_result(result)
 
             loop.call_soon_threadsafe(_set)
+        if self.delete_completed_queries and self._removals:
+            # under load the retractions ride the next window's commit;
+            # when traffic pauses, a lazy flush (4 windows, min 50 ms)
+            # clears the tail without paying a commit per response batch
+            if loop is not None and self._removal_timer is None:
+                delay = max(4 * self.window_s, 0.05)
+
+                def _arm():
+                    self._removal_timer = loop.call_later(
+                        delay, self._flush_removals
+                    )
+
+                loop.call_soon_threadsafe(_arm)
+
+    def _flush_removals(self) -> None:
+        self._removal_timer = None
+        self._windows_q.put([])  # removal-only window
+
+    def _resolve(self, key: Pointer, value: Any) -> None:
+        """Single-row compatibility shim over the batched fan-in."""
+        self._resolve_batch([(key, value)])
+
+
+def _coercion_target(t) -> str:
+    if t is dt.INT:
+        return "an integer"
+    if t is dt.FLOAT:
+        return "a number"
+    return "a boolean (1/0/true/false/yes/no)"
 
 
 def rest_connector(
@@ -326,16 +644,29 @@ def rest_connector(
     route: str = "/",
     schema: type[Schema] | None = None,
     methods: Sequence[str] = ("POST",),
-    autocommit_duration_ms: int | None = 1500,
+    autocommit_duration_ms: int | None = None,
     keep_queries: bool | None = None,
     delete_completed_queries: bool | None = None,
     request_validator=None,
     documentation: EndpointDocumentation | None = None,
+    window_ms: float | None = None,
+    max_batch: int | None = None,
+    queue_cap: int | None = None,
+    timeout_s: float | None = None,
+    workers: int | None = None,
 ):
     """Returns (queries_table, response_writer) (reference: _server.py:624).
 
     response_writer(table) — table keyed like queries with a `result`
-    column; writing it resolves the matching pending HTTP request.
+    column; writing it resolves the matching pending HTTP requests, one
+    batched callback per delivered window.
+
+    The gateway coalesces requests into batch windows (``window_ms`` /
+    ``max_batch``, defaulting to the registered serve knobs) and
+    commits one dataflow timestamp per window, so
+    ``autocommit_duration_ms`` defaults to None — the window IS the
+    commit cadence, and a timer flush racing a window's upserts would
+    split one window across two timestamps.
     """
     if webserver is None:
         webserver = PathwayWebserver(
@@ -356,26 +687,44 @@ def rest_connector(
         delete_completed_queries,
         request_validator,
         documentation,
+        window_ms=window_ms,
+        max_batch=max_batch,
+        queue_cap=queue_cap,
+        timeout_s=timeout_s,
+        workers=workers,
     )
     queries = python_read(
         subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms
     )
 
     def response_writer(response_table) -> None:
-        cols = response_table.column_names()
+        cols = tuple(response_table.column_names())
+        try:
+            result_idx = cols.index("result")
+        except ValueError:
+            result_idx = None
 
-        def on_change(key, row, time_, diff):
-            if diff <= 0:
-                return
-            data = dict(zip(cols, row))
-            result = data.get("result", data)
-            if isinstance(result, Json):
-                result = result.value
-            subject._resolve(key, result)
+        def on_batch(time_, deltas):
+            # one callback per delivered batch (= one window): the whole
+            # window's futures resolve in a single cross-thread hop —
+            # the batched-subscribe egress, not a per-row callback
+            resolved = []
+            for key, row, diff in deltas:
+                if diff <= 0:
+                    continue
+                if result_idx is not None:
+                    result = row[result_idx]
+                else:
+                    result = dict(zip(cols, row))
+                if isinstance(result, Json):
+                    result = result.value
+                resolved.append((key, result))
+            if resolved:
+                subject._resolve_batch(resolved)
 
         def lower(ctx):
             ctx.scope.output(
-                ctx.engine_table(response_table), on_change=on_change
+                ctx.engine_table(response_table), on_batch=on_batch
             )
 
         G.add_operator(

@@ -380,25 +380,20 @@ class DeckRetriever(BaseQuestionAnswerer):
 
 
 class RAGClient:
-    """HTTP client for RAG servers (reference: :816)."""
+    """HTTP client for RAG servers (reference: :816). One kept-alive
+    connection per client — a closed-loop driver against the batching
+    gateway pays connection setup once, not per query."""
 
     def __init__(self, host: str | None = None, port: int | None = None,
                  url: str | None = None, timeout: int = 90):
+        from pathway_tpu.io.http import KeepAliveSession
+
         self.url = url or f"http://{host}:{port}"
         self.timeout = timeout
+        self._session = KeepAliveSession(self.url, timeout=timeout)
 
     def _post(self, route: str, payload: dict):
-        import json as _json
-        import urllib.request
-
-        req = urllib.request.Request(
-            self.url + route,
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return _json.loads(resp.read().decode())
+        return self._session.post(route, payload)
 
     def answer(self, prompt: str, filters: str | None = None,
                model: str | None = None, return_context_docs: bool = False):

@@ -22,12 +22,14 @@ class BaseRestServer:
         self.rest_kwargs = rest_kwargs
 
     def serve(self, route: str, schema, handler, methods=("POST",), **kwargs):
+        # routes serve through the batching gateway (windowed commits,
+        # bounded admission); the serve knobs (knobs.py) or rest_kwargs
+        # (window_ms/max_batch/queue_cap/timeout_s/workers) tune it
         queries, writer = pw.io.http.rest_connector(
             webserver=self.webserver,
             route=route,
             schema=schema,
             methods=methods,
-            autocommit_duration_ms=50,
             delete_completed_queries=True,
             **{**self.rest_kwargs, **kwargs},
         )
@@ -64,7 +66,6 @@ class BaseRestServer:
                 webserver=self.webserver,
                 route=route,
                 schema=schema,
-                autocommit_duration_ms=50,
                 delete_completed_queries=True,
             )
             transformer = _CallableTransformer(input_table=queries)

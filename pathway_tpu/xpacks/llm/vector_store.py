@@ -333,8 +333,23 @@ class VectorStoreServer:
         **kwargs,
     ):
         """Bind /v1/retrieve, /v1/statistics, /v1/inputs and run
-        (reference: :455)."""
-        webserver = pw.io.http.PathwayWebserver(host=host, port=port)
+        (reference: :455). Routes serve through the batching gateway:
+        concurrent retrieves coalesce into one commit (= one fused
+        KNN dispatch) per batch window; ``window_ms``/``max_batch``/
+        ``queue_cap``/``timeout_s``/``workers`` kwargs override the
+        serve knobs (analysis/knobs.py) per server."""
+        # kept on self so callers (CI smoke, metrics scrapers) can reach
+        # each route's subject and its ServeMetrics via _routes
+        webserver = self.webserver = pw.io.http.PathwayWebserver(
+            host=host, port=port
+        )
+        gateway_kwargs = {
+            k: kwargs.pop(k)
+            for k in (
+                "window_ms", "max_batch", "queue_cap", "timeout_s", "workers"
+            )
+            if k in kwargs
+        }
 
         routes = [
             ("/v1/retrieve", self.RetrieveQuerySchema, self.retrieve_query, ("GET", "POST")),
@@ -347,8 +362,8 @@ class VectorStoreServer:
                 route=route,
                 schema=schema,
                 methods=methods,
-                autocommit_duration_ms=50,
                 delete_completed_queries=True,
+                **gateway_kwargs,
             )
             writer(handler(queries))
 
@@ -365,25 +380,20 @@ class SlidesVectorStoreServer(VectorStoreServer):
 
 
 class VectorStoreClient:
-    """HTTP client for a VectorStoreServer (reference: :629)."""
+    """HTTP client for a VectorStoreServer (reference: :629). Requests
+    ride ONE kept-alive connection — against the batching gateway a
+    closed-loop client pays connection setup once, not per query."""
 
     def __init__(self, host: str | None = None, port: int | None = None,
                  url: str | None = None, timeout: int = 15):
+        from pathway_tpu.io.http import KeepAliveSession
+
         self.url = url or f"http://{host}:{port}"
         self.timeout = timeout
+        self._session = KeepAliveSession(self.url, timeout=timeout)
 
     def _post(self, route: str, payload: dict):
-        import json as _json
-        import urllib.request
-
-        req = urllib.request.Request(
-            self.url + route,
-            data=_json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return _json.loads(resp.read().decode())
+        return self._session.post(route, payload)
 
     def query(self, query: str, k: int = 3, metadata_filter: str | None = None,
               filepath_globpattern: str | None = None):

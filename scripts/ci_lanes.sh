@@ -58,4 +58,10 @@ echo "=== lane 4: ASan/UBSan native join/exchange batteries ==="
 # self-skips (exit 0 with a message) when g++ lacks sanitizer support
 env -u PATHWAY_LANE_PROCESSES ./scripts/sanitize_native.sh asan
 
+echo "=== lane 5: serving gateway smoke (batching + zero drops) ==="
+# starts the batching RAG gateway over a mock index and drives
+# concurrent keep-alive clients: batch occupancy must exceed 1 (request
+# coalescing engaged) and every response must come back correct
+env -u PATHWAY_LANE_PROCESSES python scripts/serve_smoke.py
+
 echo "=== all lanes green ==="

@@ -1,0 +1,142 @@
+"""Serving smoke lane (scripts/ci_lanes.sh lane 5): start the batching
+RAG gateway over a mock index, drive concurrent keep-alive clients, and
+assert the two gateway invariants CI must never lose:
+
+* request coalescing ENGAGES under load — the batch-occupancy histogram
+  records multi-request windows (occupancy > 1), i.e. the server commits
+  windows, not requests;
+* zero dropped responses — every client query gets its own correct
+  answer back (no cross-request mixups, no hangs, no sheds at this
+  load).
+
+Exit 0 on success with a JSON summary line; exit 1 with the failure
+otherwise. Stdlib + repo only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+PORT = int(os.environ.get("SERVE_SMOKE_PORT", "9351"))
+N_CLIENTS = 8
+N_PER_CLIENT = 5
+
+
+def main() -> int:
+    import pathway_tpu as pw
+    from pathway_tpu.xpacks.llm.mocks import DeterministicMockEmbedder
+    from pathway_tpu.xpacks.llm.vector_store import (
+        VectorStoreClient,
+        VectorStoreServer,
+    )
+
+    docs = pw.debug.table_from_markdown(
+        """
+        data
+        pathway is a streaming dataflow framework
+        the gateway coalesces requests into batch windows
+        one commit per window means one device dispatch
+        backpressure sheds overload with retry-after
+        """
+    ).select(data=pw.this.data)
+    server = VectorStoreServer(
+        docs, embedder=DeterministicMockEmbedder(dimension=8)
+    )
+    # a wide-open window relative to client latency so the concurrent
+    # closed-loop clients regroup into shared windows deterministically
+    server.run_server(
+        "127.0.0.1", PORT, threaded=True, window_ms=60.0, max_batch=64
+    )
+    deadline = time.monotonic() + 15.0
+    probe = VectorStoreClient(host="127.0.0.1", port=PORT)
+    while True:
+        try:
+            probe.query("warmup", k=1)
+            break
+        except Exception:
+            if time.monotonic() > deadline:
+                print("gateway never came up", file=sys.stderr)
+                return 1
+            time.sleep(0.25)
+
+    results: dict[tuple[int, int], list] = {}
+    errors: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(N_CLIENTS)
+
+    def client(ci: int) -> None:
+        # one keep-alive session per closed-loop client
+        c = VectorStoreClient(host="127.0.0.1", port=PORT)
+        barrier.wait()
+        for i in range(N_PER_CLIENT):
+            try:
+                hits = c.query(f"window commit dispatch {ci}", k=2)
+            except Exception as exc:
+                with lock:
+                    errors.append((ci, i, repr(exc)))
+                continue
+            with lock:
+                results[(ci, i)] = hits
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(N_CLIENTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+
+    retrieve_subject = server.webserver._routes[0][2].__self__
+    m = retrieve_subject.serve_metrics
+    n_expected = N_CLIENTS * N_PER_CLIENT
+    problems = []
+    if errors:
+        problems.append(f"client errors: {errors[:5]}")
+    if len(results) != n_expected:
+        problems.append(
+            f"dropped responses: {n_expected - len(results)}/{n_expected}"
+        )
+    if any(len(hits) != 2 for hits in results.values()):
+        problems.append("a response came back with the wrong k")
+    # identical queries from one client must get identical answers
+    # (no cross-request mixup); clients whose baseline query errored are
+    # already reported above and skipped here
+    for (ci, _i), hits in results.items():
+        baseline = results.get((ci, 0))
+        if baseline is not None and hits != baseline:
+            problems.append(f"client {ci} got divergent answers")
+            break
+    multi = m.occupancy.total - m.occupancy.counts[0]
+    if multi < 1:
+        problems.append(
+            f"coalescing never engaged: all {m.occupancy.total} windows "
+            "had occupancy 1"
+        )
+    if m.shed or m.timeouts:
+        problems.append(f"shed={m.shed} timeouts={m.timeouts} at smoke load")
+    summary = {
+        "requests": m.requests,
+        "windows": m.occupancy.total,
+        "multi_request_windows": multi,
+        "mean_occupancy": round(m.occupancy.sum / max(1, m.occupancy.total), 2),
+        "shed": m.shed,
+        "timeouts": m.timeouts,
+        "responses": len(results),
+    }
+    if problems:
+        print(json.dumps({"ok": False, "problems": problems, **summary}))
+        return 1
+    print(json.dumps({"ok": True, **summary}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

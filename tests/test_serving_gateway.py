@@ -1,0 +1,460 @@
+"""Async batching gateway tests (io/http/_server.py): one commit per
+batch window under load, bit-identical parity with the per-request path
+on an out-of-order mixed-timeout workload, admission shedding with
+Retry-After, timed-out-request eviction, GET coercion 400s, serve
+metrics, the batched subscribe egress, and the Plan Doctor's
+row-expanding-sink diagnostic."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.monitoring import ProberStats, ServeMetrics
+
+_PORT = [9120]
+
+
+def _next_port():
+    _PORT[0] += 1
+    return _PORT[0]
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _gateway(port, pipeline=None, **kw):
+    """rest_connector echo server; returns (subject, url)."""
+
+    class S(pw.Schema):
+        value: int
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=S, **kw
+    )
+    if pipeline is None:
+        writer(queries.select(result=pw.this.value * 3))
+    else:
+        writer(pipeline(queries))
+    subject = webserver._routes[0][2].__self__
+    return subject, f"http://127.0.0.1:{port}/"
+
+
+def _start_run():
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    return t
+
+
+def _fire(url, values, timeout=15):
+    """Concurrent closed clients; returns {value: (status, result)}."""
+    out = {}
+    lock = threading.Lock()
+
+    def client(v):
+        try:
+            res = _post(url, {"value": v}, timeout=timeout)
+            status = 200
+        except urllib.error.HTTPError as e:
+            res = None
+            status = e.code
+        except Exception as e:  # client-side timeout etc.
+            res = None
+            status = repr(e)
+        with lock:
+            out[v] = (status, res)
+
+    threads = [threading.Thread(target=client, args=(v,)) for v in values]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def test_one_commit_per_window_under_load():
+    """The pinned tentpole invariant: N concurrent requests coalesce
+    into a handful of windows, each window is exactly ONE subject
+    commit, and the occupancy histogram proves multi-request windows."""
+    port = _next_port()
+    subject, url = _gateway(
+        port, window_ms=60.0, max_batch=64, workers=1
+    )
+    commits = [0]
+    orig_commit = subject.commit
+
+    def counting_commit():
+        commits[0] += 1
+        orig_commit()
+
+    subject.commit = counting_commit
+    _start_run()
+
+    n = 48
+    out = _fire(url, range(n))
+    assert all(st == 200 and res == v * 3 for v, (st, res) in out.items())
+    m = subject.serve_metrics
+    assert m.requests == n
+    # every request is accounted to exactly one window, and coalescing
+    # engaged: far fewer commits than requests, occupancy sums to n
+    assert m.occupancy.sum == n
+    assert m.commits == m.occupancy.total == commits[0]
+    assert commits[0] <= n // 4, (commits[0], n)
+    # multi-request windows: at least one window carried > 2 requests
+    # (buckets are cumulative edges 1,2,4,...: everything above the
+    # le=2 bucket had occupancy > 2)
+    assert m.occupancy.total - sum(m.occupancy.counts[:2]) >= 1
+    assert m.shed == 0 and m.timeouts == 0
+
+
+def test_parity_with_per_request_path_out_of_order_mixed_timeouts():
+    """Batched gateway vs per-request path (window 0 / max_batch 1) on
+    an out-of-order, mixed-timeout workload: clients fire concurrently
+    (arrival order is scrambled vs completion order — windows group
+    arbitrary subsets), and the values >= 900 are filtered out of the
+    response table so their clients hit the request deadline while
+    later requests already completed. Every completed response must be
+    bit-identical between the two paths, and exactly the filtered
+    requests 504 on both."""
+
+    def pipeline(queries):
+        return queries.filter(pw.this.value < 900).select(
+            result=pw.this.value * 7 + 1
+        )
+
+    values = list(range(40)) + [900, 901]
+    results = {}
+    for mode, kw in (
+        ("batched", dict(window_ms=25.0, max_batch=16)),
+        ("per_request", dict(window_ms=0.0, max_batch=1)),
+    ):
+        pw.internals.parse_graph.G.clear()
+        port = _next_port()
+        subject, url = _gateway(
+            port, pipeline=pipeline, timeout_s=1.5, **kw
+        )
+        _start_run()
+        results[mode] = _fire(url, values)
+        assert subject.serve_metrics.timeouts == 2, mode
+
+    for v in values:
+        assert results["batched"][v] == results["per_request"][v], v
+        if v < 900:
+            assert results["batched"][v] == (200, v * 7 + 1)
+        else:
+            assert results["batched"][v][0] == 504
+
+
+def test_admission_shedding_503_with_retry_after():
+    port = _next_port()
+    subject, url = _gateway(
+        port, window_ms=600.0, max_batch=1000, queue_cap=4
+    )
+    _start_run()
+
+    n = 16
+    headers = {}
+    out = {}
+    lock = threading.Lock()
+
+    def client(v):
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({"value": v}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                res = (200, json.loads(resp.read().decode()))
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                with lock:
+                    headers[v] = e.headers.get("Retry-After")
+            res = (e.code, None)
+        with lock:
+            out[v] = res
+
+    threads = [threading.Thread(target=client, args=(v,)) for v in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    ok = [v for v, (st, _) in out.items() if st == 200]
+    shed = [v for v, (st, _) in out.items() if st == 503]
+    assert len(ok) + len(shed) == n
+    # the 600 ms window holds admitted requests in flight, so the cap
+    # must have shed the overflow — with 503, a Retry-After >= 1s, and
+    # the shed counter agreeing
+    assert len(shed) >= 1 and len(ok) >= 1
+    assert all(h is not None and int(h) >= 1 for h in headers.values())
+    assert subject.serve_metrics.shed == len(shed)
+    for v in ok:
+        assert out[v][1] == v * 3
+
+
+def test_timed_out_requests_evicted_before_dispatch():
+    """A request that times out while its window is still collecting is
+    evicted: the window dispatches empty — no commit, no device work,
+    no occupancy sample."""
+    port = _next_port()
+    subject, url = _gateway(
+        port, window_ms=800.0, max_batch=1000, timeout_s=0.15
+    )
+    commits = [0]
+    orig_commit = subject.commit
+
+    def counting_commit():
+        commits[0] += 1
+        orig_commit()
+
+    subject.commit = counting_commit
+    _start_run()
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, {"value": 1})
+    assert e.value.code == 504
+    assert subject.serve_metrics.timeouts == 1
+    time.sleep(1.2)  # let the window timer fire and dispatch
+    assert commits[0] == 0
+    assert subject.serve_metrics.occupancy.total == 0
+
+
+def test_get_coercion_failure_returns_400_naming_field():
+    port = _next_port()
+
+    class S(pw.Schema):
+        value: int
+        ratio: float = pw.column_definition(default_value=1.0)
+        flag: bool = pw.column_definition(default_value=False)
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=S, methods=("GET", "POST"),
+        window_ms=0.0,
+    )
+    writer(queries.select(result=pw.this.value * 2))
+    _start_run()
+
+    base = f"http://127.0.0.1:{port}/"
+    for qs, field in (
+        ("value=abc", "value"),
+        ("value=3&ratio=zz", "ratio"),
+        ("value=3&flag=maybe", "flag"),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "?" + qs, timeout=10)
+        assert e.value.code == 400
+        body = json.loads(e.value.read().decode())
+        assert field in body["error"]
+    # valid coercions still work
+    with urllib.request.urlopen(
+        base + "?value=21&ratio=0.5&flag=true", timeout=10
+    ) as resp:
+        assert json.loads(resp.read().decode()) == 42
+
+
+def test_serve_metrics_openmetrics_render():
+    stats = ProberStats()
+    m = ServeMetrics(route="/v1/retrieve")
+    stats.mount_serve_metrics(m)
+    stats.mount_serve_metrics(m)  # idempotent
+    assert len(stats.serve) == 1
+    for _ in range(5):
+        m.on_request()
+    m.on_shed()
+    m.on_timeout()
+    m.on_latency_ms(3.0)
+    m.on_latency_ms(40.0)
+    m.on_window(4)
+    m.on_window(1)
+    text = stats.render_openmetrics()
+    assert 'serve_requests_total{route="/v1/retrieve"} 5' in text
+    assert 'serve_shed_total{route="/v1/retrieve"} 1' in text
+    assert 'serve_timeouts_total{route="/v1/retrieve"} 1' in text
+    assert 'serve_window_commits_total{route="/v1/retrieve"} 2' in text
+    assert "# TYPE serve_request_latency_ms histogram" in text
+    # cumulative buckets: le="5" holds the 3ms sample, le="+Inf" both
+    assert 'serve_request_latency_ms_bucket{route="/v1/retrieve",le="5"} 1' in text
+    assert 'serve_request_latency_ms_bucket{route="/v1/retrieve",le="+Inf"} 2' in text
+    assert 'serve_batch_occupancy_bucket{route="/v1/retrieve",le="4"} 2' in text
+    assert 'serve_batch_occupancy_count{route="/v1/retrieve"} 2' in text
+    assert 'serve_batch_occupancy_sum{route="/v1/retrieve"} 5' in text
+
+
+def test_subscribe_on_batch_delivers_batched_changes():
+    t = pw.debug.table_from_markdown(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    batches = []
+    rows = {}
+
+    def on_batch(time_, changes):
+        batches.append(list(changes))
+        for key, row, diff in changes:
+            assert diff == 1
+            rows[key] = row
+
+    pw.io.subscribe(t, on_batch=on_batch)
+    pw.run()
+    assert sum(len(b) for b in batches) == 3
+    assert sorted((r["a"], r["b"]) for r in rows.values()) == [
+        (1, 10), (2, 20), (3, 30),
+    ]
+
+
+def test_plan_doctor_blames_row_expanding_sink():
+    t = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    pw.io.subscribe(t, on_change=lambda *a: None)
+    report = pw.analyze(t)
+    sink = [d for d in report.diagnostics if d.code == "sink.row-expanding"]
+    assert len(sink) == 1
+    assert "on_batch" in (sink[0].hint or "")
+
+    # the batched egress is clean
+    pw.internals.parse_graph.G.clear()
+    t2 = pw.debug.table_from_markdown(
+        """
+        a
+        1
+        """
+    )
+    pw.io.subscribe(t2, on_batch=lambda *a: None)
+    report2 = pw.analyze(t2)
+    assert not [
+        d for d in report2.diagnostics if d.code == "sink.row-expanding"
+    ]
+
+
+def test_rest_response_sink_is_batched_in_plan():
+    """The gateway's own response path must not trip the sink pass."""
+
+    class S(pw.Schema):
+        value: int
+
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=_next_port())
+    queries, writer = pw.io.http.rest_connector(webserver=webserver, schema=S)
+    writer(queries.select(result=pw.this.value))
+    report = pw.analyze(queries)
+    assert not [
+        d for d in report.diagnostics if d.code == "sink.row-expanding"
+    ]
+
+
+def _load_bench():
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# the measured round-5 tunneled curve (BENCH_full.json) the model must
+# validate against: the OLD model's error GREW with load (0.04 → 0.21 →
+# 0.56); the extended pipelined model must hold it flat
+_ROUND5_CURVE = {
+    "metric": "rag_qps_vs_clients",
+    "curve": [
+        {"n_clients": 32, "qps": 316.2, "mean_ms": 101.17},
+        {"n_clients": 128, "qps": 1458.5, "mean_ms": 87.35},
+        {"n_clients": 512, "qps": 7514.1, "mean_ms": 67.47},
+    ],
+    "device_capacity_qps": 5870.6,
+    "device_ms_per_batch32": 5.45,
+    "transport_floor_p50_ms": 94.8,
+}
+
+
+def test_extended_latency_model_error_flat_under_load():
+    bench = _load_bench()
+    model = bench.bench_latency_model(_ROUND5_CURVE)
+    errs = [p["rel_err"] for p in model["validation"]]
+    assert model["mean_rel_err"] <= 0.10, model["mean_rel_err"]
+    # the high-load point must no longer be the worst one
+    assert errs[-1] <= 0.05, errs
+    assert max(errs) <= 0.15, errs
+    # calibrated transport/pipeline parameters are recorded
+    assert 0.0 < model["inputs"]["rho_transport_overlap_loss"] < 1.0
+    assert model["inputs"]["kappa_pipelined_capacity_ratio"] >= 1.0
+    # colocated prediction clears the acceptance bar: >= 5k qps/chip at
+    # < 15 ms p50
+    knee = model["colocated_knee"]
+    assert knee["qps"] >= 5000.0 and knee["p50_ms"] < 15.0
+
+
+def test_colocated_projection_entry_shape():
+    bench = _load_bench()
+    model = bench.bench_latency_model(_ROUND5_CURVE)
+    entry = bench._colocated_projection(model, 1_000_000)
+    assert entry["metric"] == "rag_colocated_qps"
+    assert entry["projected"] is True and entry["colocated"] is False
+    assert entry["value"] >= 5000.0 and entry["p50_ms"] < 15.0
+    assert entry["n_docs"] == 1_000_000
+    assert entry["vs_baseline"] >= 1.0
+
+
+def test_serve_knobs_registered_and_wired(monkeypatch):
+    from pathway_tpu.analysis.knobs import KNOBS, validate_environment
+
+    for name in (
+        "PATHWAY_REST_TIMEOUT_S", "PATHWAY_SERVE_WINDOW_MS",
+        "PATHWAY_SERVE_MAX_BATCH", "PATHWAY_SERVE_QUEUE_CAP",
+        "PATHWAY_SERVE_WORKERS",
+    ):
+        assert name in KNOBS
+    monkeypatch.setenv("PATHWAY_REST_TIMEOUT_S", "17.5")
+    monkeypatch.setenv("PATHWAY_SERVE_WINDOW_MS", "9")
+    monkeypatch.setenv("PATHWAY_SERVE_MAX_BATCH", "8")
+    monkeypatch.setenv("PATHWAY_SERVE_QUEUE_CAP", "99")
+    monkeypatch.setenv("PATHWAY_SERVE_WORKERS", "2")
+    assert validate_environment() == []
+
+    class S(pw.Schema):
+        value: int
+
+    webserver = pw.io.http.PathwayWebserver(
+        host="127.0.0.1", port=_next_port()
+    )
+    pw.io.http.rest_connector(webserver=webserver, schema=S)
+    subject = webserver._routes[0][2].__self__
+    assert subject.timeout_s == 17.5
+    assert subject.window_s == pytest.approx(0.009)
+    assert subject.max_batch == 8
+    assert subject.queue_cap == 99
+    assert subject.workers == 2
+
+    # out-of-range serve knob is a startup rejection
+    monkeypatch.setenv("PATHWAY_SERVE_MAX_BATCH", "0")
+    findings = validate_environment()
+    assert any(n == "PATHWAY_SERVE_MAX_BATCH" for n, _, _ in findings)
