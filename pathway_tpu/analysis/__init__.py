@@ -11,7 +11,11 @@ persisted columns), and PATHWAY_* knob validation.
 The eligibility predicates in ``analysis.eligibility`` are THE predicates
 the executor nodes use at construction time — analyzer and engine cannot
 drift (the differential-dataflow stance: operator properties must be
-decidable from the plan).
+decidable from the plan). The same stance applied to concurrency:
+``analysis.meshcheck`` exhaustively model-checks the mesh wave/rollback
+protocol by driving the SAME transition table
+(``parallel/protocol.py``) the runtime executes, and multi-rank
+``pw.analyze`` calls report its distributed-safety verdicts.
 
 CLI: ``python -m pathway_tpu.analysis program.py [--json]
 [--processes N] [--require-fused]`` and ``--bench`` to annotate
@@ -35,6 +39,14 @@ _ATTRS = {
     "eligibility": ("pathway_tpu.analysis.eligibility", None),
     "knobs": ("pathway_tpu.analysis.knobs", None),
     "bench": ("pathway_tpu.analysis.bench", None),
+    "meshcheck": ("pathway_tpu.analysis.meshcheck", None),
+    "MeshCheckConfig": (
+        "pathway_tpu.analysis.meshcheck", "MeshCheckConfig",
+    ),
+    "MeshCheckReport": (
+        "pathway_tpu.analysis.meshcheck", "MeshCheckReport",
+    ),
+    "check_mesh": ("pathway_tpu.analysis.meshcheck", "check"),
     "KNOBS": ("pathway_tpu.analysis.knobs", "KNOBS"),
     "KnobError": ("pathway_tpu.analysis.knobs", "KnobError"),
     "knob_table_markdown": (

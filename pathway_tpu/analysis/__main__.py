@@ -3,9 +3,22 @@
     python -m pathway_tpu.analysis [--json] [--processes N]
         [--require-fused] program.py [prog args...]
     python -m pathway_tpu.analysis --bench [--json] [--update-artifact]
+    python -m pathway_tpu.analysis --mesh [--processes N]
+        [--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME]
+        [--json] [program.py]
 
 Doctor options go BEFORE the program path; everything after it is the
 program's own argv (flags included), exactly like ``python script.py``.
+
+Mesh mode runs the exhaustive bounded model checker
+(``analysis/meshcheck.py``) over the wave/rollback protocol: with a
+program, against that plan's ACTUAL exchange topology; without one,
+against the canonical hash→gather shape. It reports state/interleaving
+counts and any violation with a minimal trace rendered as a replayable
+``PATHWAY_FAULT_PLAN`` (``scripts/fault_matrix.py --from-trace`` runs
+it as a real kill-and-resume cell). ``--mesh-mutant`` checks a
+deliberately broken protocol variant — the checker must catch it, which
+is the checker's own regression test.
 
 Program mode loads the user program with ``Runtime.run`` stubbed out:
 ``pw.run()`` still LOWERS the captured graph (cheap, pure construction)
@@ -29,8 +42,15 @@ import runpy
 import sys
 
 
-def _analyze_program(args) -> int:
-    from pathway_tpu.analysis.analyzer import analyze
+def _load_user_program(args) -> bool:
+    """Load the user program with ``Runtime.run`` stubbed out: ``pw.run()``
+    still LOWERS the captured graph (cheap, pure construction) but never
+    starts connector threads or the process mesh. Returns whether the
+    program configured persistence (its ``pw.run(persistence_config=...)``
+    reaches Runtime as ``persistence=`` — the replay pass needs to know,
+    since the analyzer's own scratch Runtime never persists). Shared by
+    program mode and mesh mode so the delicate stub-and-restore dance
+    exists exactly once."""
     from pathway_tpu.engine.runtime import Runtime
 
     prog = args.program
@@ -44,9 +64,6 @@ def _analyze_program(args) -> int:
     seen = {"persistence": False}
 
     def _init(self, *a, **k):
-        # the program's pw.run(persistence_config=...) reaches Runtime as
-        # persistence= — remember it so the replay pass knows this plan
-        # runs persisted (the analyzer's own scratch Runtime does not)
         if k.get("persistence") is not None:
             seen["persistence"] = True
         return orig_init(self, *a, **{**k, "validate_env": False})
@@ -64,9 +81,16 @@ def _analyze_program(args) -> int:
     finally:
         Runtime.run = orig_run
         Runtime.__init__ = orig_init
+    return seen["persistence"]
+
+
+def _analyze_program(args) -> int:
+    from pathway_tpu.analysis.analyzer import analyze
+
+    persisted = _load_user_program(args)
     report = analyze(
         processes=args.processes,
-        persistence=seen["persistence"] or None,
+        persistence=persisted or None,
     )
     if args.json:
         print(report.to_json())
@@ -80,6 +104,89 @@ def _analyze_program(args) -> int:
         return 1
     if report.errors():
         return 2
+    return 0
+
+
+def _lower_program_runtime(args):
+    """Load (via the shared ``_load_user_program`` stub) + lower the
+    user program without executing it; returns the scratch runtime
+    carrying the lowered plan for topology extraction."""
+    from pathway_tpu.engine.runtime import Runtime
+    from pathway_tpu.internals.config import (
+        pop_config_overlay,
+        push_config_overlay,
+    )
+    from pathway_tpu.internals.graph_runner import GraphRunner
+    from pathway_tpu.internals.parse_graph import G
+
+    _load_user_program(args)
+    targets = G.output_operators() or list(G.operators)
+    ops = G.reachable_operators(targets)
+    token = push_config_overlay(
+        processes=args.processes or 2, process_id=0
+    )
+    try:
+        runtime = Runtime(validate_env=False)
+        GraphRunner(G)._lower(ops, runtime)
+    finally:
+        pop_config_overlay(token)
+    return runtime
+
+
+def _analyze_mesh(args) -> int:
+    from pathway_tpu.analysis import meshcheck
+
+    def _env_int(name, default):
+        try:
+            return int(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+
+    world = args.processes or _env_int("PATHWAY_MESHCHECK_RANKS", 3)
+    rounds = (
+        args.mesh_rounds
+        if args.mesh_rounds is not None
+        else _env_int("PATHWAY_MESHCHECK_ROUNDS", 2)
+    )
+    faults = (
+        args.mesh_faults
+        if args.mesh_faults is not None
+        else _env_int("PATHWAY_MESHCHECK_FAULTS", 1)
+    )
+    cap = _env_int("PATHWAY_MESHCHECK_MAX_STATES", 200_000)
+    if args.program:
+        runtime = _lower_program_runtime(args)
+        report = meshcheck.check_runtime_mesh(
+            runtime,
+            processes=world,
+            rounds=rounds,
+            fault_budget=faults,
+            max_states=cap,
+            mutate=args.mesh_mutant,
+        )
+    else:
+        report = meshcheck.check(
+            meshcheck.MeshCheckConfig(
+                world=world,
+                rounds=rounds,
+                fault_budget=faults,
+                max_states=cap,
+                mutate=args.mesh_mutant,
+            )
+        )
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    if report.violations:
+        return 2
+    if not report.complete:
+        print(
+            "state space NOT exhausted (PATHWAY_MESHCHECK_MAX_STATES); "
+            "verdict inconclusive",
+            file=sys.stderr,
+        )
+        return 3
     return 0
 
 
@@ -147,6 +254,28 @@ def main(argv=None) -> int:
         help="analyze the canonical bench pipelines instead of a program",
     )
     parser.add_argument(
+        "--mesh", action="store_true",
+        help="exhaustively model-check the mesh wave/rollback protocol "
+             "(against the program's exchange topology, or the "
+             "canonical one without a program)",
+    )
+    parser.add_argument(
+        "--mesh-rounds", type=int, default=None,
+        help="checker wave depth: BSP rounds per rank "
+             "(default PATHWAY_MESHCHECK_ROUNDS)",
+    )
+    parser.add_argument(
+        "--mesh-faults", type=int, default=None,
+        help="injected-crash budget per interleaving "
+             "(default PATHWAY_MESHCHECK_FAULTS)",
+    )
+    parser.add_argument(
+        "--mesh-mutant", default=None,
+        help="check a deliberately broken protocol variant "
+             "(skip_quiesce | accept_dead_epoch | "
+             "drop_rollback_retraction) — the checker must catch it",
+    )
+    parser.add_argument(
         "--update-artifact", action="store_true",
         help="with --bench: annotate BENCH_full.json lines with "
              "plan_verdict",
@@ -160,10 +289,12 @@ def main(argv=None) -> int:
     from pathway_tpu.analysis.knobs import KnobError
 
     try:
+        if args.mesh:
+            return _analyze_mesh(args)
         if args.bench:
             return _analyze_bench(args)
         if not args.program:
-            parser.error("a program path (or --bench) is required")
+            parser.error("a program path (or --bench/--mesh) is required")
         return _analyze_program(args)
     except KnobError as e:
         print(f"[ERROR  ] knob.invalid env\n      {e}", file=sys.stderr)

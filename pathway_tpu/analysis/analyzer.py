@@ -22,7 +22,13 @@ no data) and runs five passes over the node graph:
    one Python callback per change (the CaptureNode-style egress
    de-optimization), with the fix hint pointing at the batched
    subscribe path.
-5. **knob validation** — the PATHWAY_* registry findings as diagnostics.
+5. **distributed safety** (multi-rank plans) — the mesh verifier
+   (``analysis/meshcheck.py``) exhaustively model-checks the
+   wave/rollback protocol over this plan's ACTUAL exchange topology at
+   the requested rank count: deadlock, frontier divergence,
+   exactly-once across rollback, dead-epoch straggler acceptance —
+   before any real N-rank mesh is ever launched.
+6. **knob validation** — the PATHWAY_* registry findings as diagnostics.
 
 ``analyze_scope(runtime)`` runs the same passes over an already-lowered
 runtime (the agreement tests lower once, analyze, run, then compare
@@ -523,7 +529,117 @@ def _sink_pass(runtime, diags: list[Diagnostic]) -> None:
         )
 
 
-# -- pass 5: knob validation ----------------------------------------------
+# -- pass 5: distributed safety (the mesh verifier) -------------------------
+
+def _mesh_pass(runtime, diags: list[Diagnostic], processes: int) -> None:
+    """Model-check the lowered plan's ACTUAL exchange topology at
+    ``processes`` ranks (analysis/meshcheck.py): exhaustively explore
+    the wave/rollback protocol over the plan's boundaries — deadlock,
+    frontier divergence, exactly-once across rollback, dead-epoch
+    acceptance — so the user gets a distributed-safety verdict before
+    ever launching a real N-rank mesh. The checker drives the SAME
+    transition table (parallel/protocol.py) the runtime executes, so
+    the verdict cannot drift from the engine."""
+    if not runtime.scope.exchange_nodes:
+        return
+    import os
+
+    if os.environ.get(
+        "PATHWAY_MESHCHECK_DOCTOR", "1"
+    ).strip().lower() in ("0", "false", "no"):
+        return
+    from pathway_tpu.analysis import meshcheck
+
+    try:
+        rounds = int(os.environ.get("PATHWAY_MESHCHECK_ROUNDS", "2") or 2)
+        budget = int(os.environ.get("PATHWAY_MESHCHECK_FAULTS", "1") or 1)
+        cap = int(
+            os.environ.get("PATHWAY_MESHCHECK_MAX_STATES", "200000")
+            or 200_000
+        )
+    except ValueError:  # the knob pass reports the bad value itself
+        rounds, budget, cap = 2, 1, 200_000
+    checked_world = min(processes, 8)
+    report = meshcheck.check_runtime_mesh(
+        runtime,
+        processes=checked_world,
+        rounds=rounds,
+        fault_budget=budget,
+        max_states=cap,
+    )
+    # never let a capped check read as full coverage: the verdict names
+    # the world size it actually explored
+    capped = (
+        f" (plan runs {processes} ranks; model checked at "
+        f"{checked_world} — run `python -m pathway_tpu.analysis --mesh "
+        f"--processes {processes}` for the full world)"
+        if checked_world < processes
+        else ""
+    )
+    nodes = ", ".join(
+        f"{_node_label(x)}[{x.mode}]"
+        for x in runtime.scope.exchange_nodes
+    )
+    if report.ok:
+        diags.append(
+            Diagnostic(
+                code="mesh.verified",
+                severity="info",
+                node=nodes,
+                message=(
+                    f"mesh protocol model-checked at "
+                    f"{report.config.world} ranks over this plan's "
+                    f"{len(runtime.scope.exchange_nodes)} exchange "
+                    f"boundary(ies): {report.states} states / "
+                    f"{report.transitions} interleavings explored "
+                    f"(fault budget {report.config.fault_budget}) — no "
+                    f"deadlock, frontier divergence, lost/duplicated "
+                    f"delta, or dead-epoch acceptance" + capped
+                ),
+            )
+        )
+        return
+    if not report.complete and not report.violations:
+        diags.append(
+            Diagnostic(
+                code="mesh.incomplete",
+                severity="warning",
+                node=nodes,
+                message=(
+                    f"mesh model check hit the "
+                    f"PATHWAY_MESHCHECK_MAX_STATES cap ({report.states} "
+                    f"states) before exhausting the space — no violation "
+                    f"found, but the verdict is not exhaustive"
+                ),
+                hint="raise PATHWAY_MESHCHECK_MAX_STATES or lower "
+                     "PATHWAY_MESHCHECK_ROUNDS/_FAULTS",
+            )
+        )
+        return
+    for v in report.violations:
+        plan = v.fault_plan()
+        diags.append(
+            Diagnostic(
+                code=f"mesh.{v.kind}",
+                severity="error",
+                node=nodes,
+                message=(
+                    f"mesh model check found a {v.kind} violation at "
+                    f"{report.config.world} ranks: {v.detail}"
+                ),
+                hint=(
+                    "replay the minimal trace: PATHWAY_FAULT_PLAN='"
+                    + json.dumps(plan, separators=(",", ":"))
+                    + "'"
+                    if plan
+                    else "run python -m pathway_tpu.analysis --mesh "
+                         "for the full trace"
+                ),
+            )
+        )
+
+
+# -- pass 6: knob validation ----------------------------------------------
 
 def _knob_pass(diags: list[Diagnostic]) -> None:
     from pathway_tpu.analysis.knobs import (
@@ -569,6 +685,8 @@ def analyze_scope(
     _exchange_pass(runtime, diags)
     _replay_pass(runtime, diags, persistence=persistence)
     _sink_pass(runtime, diags)
+    if processes > 1:
+        _mesh_pass(runtime, diags, processes)
     _knob_pass(diags)
 
     has_nb_source = any(

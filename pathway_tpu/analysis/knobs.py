@@ -186,6 +186,23 @@ KNOBS: dict[str, Knob] = {
            hi=3600),
         _k("PATHWAY_MESH_MAX_RESTARTS", "int", 3,
            "Supervisor rollback budget.", lo=0, hi=1_000_000),
+        # -- mesh verifier (analysis/meshcheck.py) ------------------------
+        _k("PATHWAY_MESHCHECK_RANKS", "int", 3,
+           "Default symbolic rank count of the mesh model checker "
+           "(python -m pathway_tpu.analysis --mesh).", lo=2, hi=16),
+        _k("PATHWAY_MESHCHECK_ROUNDS", "int", 2,
+           "Wave depth of the checker: BSP ingest rounds per rank in "
+           "the bounded model.", lo=1, hi=8),
+        _k("PATHWAY_MESHCHECK_FAULTS", "int", 1,
+           "Injected-crash budget per explored interleaving (drawn from "
+           "the mesh.rank_kill phases).", lo=0, hi=4),
+        _k("PATHWAY_MESHCHECK_MAX_STATES", "int", 200_000,
+           "Exploration cap; hitting it marks the check INCOMPLETE "
+           "instead of running unbounded.", lo=1_000, hi=100_000_000),
+        _k("PATHWAY_MESHCHECK_DOCTOR", "bool", True,
+           "Run the checker against the lowered plan's exchange "
+           "topology as a Plan Doctor pass when analyzing multi-rank "
+           "plans (0 disables the distributed-safety verdicts)."),
         # -- CI / test harness --------------------------------------------
         _k("PATHWAY_LANE_PROCESSES", "int", 1,
            "Emulated-rank CI lane: every run transparently joins N "

@@ -1,0 +1,1492 @@
+"""Mesh Verifier: exhaustive bounded model checking of the wave/rollback
+protocol (ISSUE 7 tentpole).
+
+The multi-rank engine's correctness rests on a hand-rolled protocol —
+wave-stepped BSP exchange (``PWX2``), heartbeat/timeout failure
+detection (``PWHB``), goodbye-vs-crash classification (``PWBY``),
+epoch-bound handshakes and supervisor rollback — that until this module
+was validated only by an 8-cell fault grid at 2 ranks: a handful of
+interleavings out of the astronomically many a 4/8-rank mesh will hit.
+This checker explores **all** of them, bounded by rank count, round
+depth and fault budget.
+
+Anti-drift, the PR-5 way: the protocol's *decisions* (wave partition,
+quiesce guard, leg elision, frontier agreement, commit-timestamp walk,
+handshake acceptance, liveness verdicts, the supervisor's rollback
+choice) are NOT re-modeled here. They live in
+``pathway_tpu/parallel/protocol.py`` as pure transition functions that
+``engine/runtime.py``, ``parallel/procgroup.py`` and
+``parallel/supervisor.py`` drive through at runtime — and this checker
+drives through the *same objects* (``Transitions`` below binds
+``protocol.TRANSITIONS`` entries; tests/test_meshcheck.py pins the
+identity exactly like test_plan_doctor.py pins the shared ``NBDecision``
+objects). What this module adds is everything around the decisions: the
+per-rank state machine, the network of in-flight frames, the durable
+store, the supervisor, and a deterministic scheduler.
+
+Exploration: DFS over the interleaving graph with full-state hashing,
+plus a partial-order reduction — each scheduler action runs a rank's
+*deterministic* micro-steps to completion atomically (rank-local steps
+and link-appends commute across ranks; the only explored branch points
+are fault firings, frame arrivals vs. failure detection, barrier
+resolution and supervisor moves). When a violation is found under DFS
+the state space is re-searched breadth-first from the root so the
+reported counterexample is a *minimal* interleaving trace; its crash
+choices are rendered as a replayable ``PATHWAY_FAULT_PLAN``
+(``internals/faults.py`` rule syntax — ``scripts/fault_matrix.py
+--from-trace`` runs them as real kill-and-resume grid cells).
+
+Properties checked:
+
+* **deadlock** — a reachable state where no rank can step, no frame can
+  arrive, no failure can be detected and the supervisor has no move
+  (e.g. a quiesced multi-input boundary that can never be released);
+* **frontier divergence** — two same-epoch ranks whose committed
+  timestamp sequences are not prefix-compatible;
+* **exactly-once** — on every *clean* terminal state, every workload
+  delta reached its destination exactly once across any number of
+  rollbacks (missing = lost, count>1 = duplicated — e.g. a dropped
+  rollback retraction);
+* **dead-epoch straggler** — a rank surviving from a rolled-back epoch
+  must never be accepted into the recovered mesh;
+* **wave desync** — a rank receiving an exchange frame it did not
+  expect (send/recv leg asymmetry);
+* **missing snapshot** — the commit marker naming a cut for which some
+  rank's snapshot does not durably exist (two-phase commit violation).
+
+Faults are drawn from the existing ``internals/faults.py`` points: the
+checker crashes ranks at the same phase-tagged ``mesh.rank_kill`` slots
+(``wave_send``, ``post_snapshot``, ``restore``) the engine's fault
+hooks expose, with per-(rank, phase) hit counters matching the plan
+semantics — which is what makes the traces replayable.
+
+Mutation testing: ``mutate=`` swaps in a deliberately broken protocol
+variant (``skip_quiesce``, ``accept_dead_epoch``,
+``drop_rollback_retraction``) — each must be caught with a minimal
+trace, proving the checker can actually see the bug classes it claims
+to rule out.
+
+CLI: ``python -m pathway_tpu.analysis --mesh [--processes N]
+[--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME] [--json]``;
+``check_runtime_mesh`` runs the checker against a *lowered plan's*
+actual exchange topology (the Plan Doctor's distributed-safety pass).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple
+
+from pathway_tpu.parallel import protocol as _proto
+
+CRASH_EXIT_CODE = 27  # faults.CRASH_EXIT_CODE (kept import-light)
+KILLED_EXIT_CODE = 137  # SIGKILL from the supervisor's reap
+
+FAULT_POINT = "mesh.rank_kill"  # the injection point traces replay through
+FAULT_PHASES = ("wave_send", "post_snapshot", "restore")
+
+
+# -- the shared transition table -------------------------------------------
+
+
+class Transitions:
+    """The protocol decisions the model drives through. Default-binds
+    the engine's own ``protocol.TRANSITIONS`` entries (identity pinned
+    by tests), so checker and runtime execute the same functions; a
+    mutant swaps exactly one entry for a deliberately broken variant."""
+
+    NAMES = (
+        "wave_bits",
+        "quiesce_candidates",
+        "wave_partition",
+        "wave_send_targets",
+        "wave_recv_sources",
+        "lockstep_plan",
+        "commit_time",
+        "commit_plan",
+        "hello_accept",
+        "peer_liveness",
+        "classify_peer_loss",
+        "supervisor_decide",
+    )
+
+    def __init__(self, overrides: dict | None = None, *, model_flags=()):
+        for name in self.NAMES:
+            setattr(self, name, _proto.TRANSITIONS[name])
+        for name, fn in (overrides or {}).items():
+            if name not in self.NAMES:
+                raise ValueError(f"unknown transition {name!r}")
+            setattr(self, name, fn)
+        # model-level behavior switches (for bug classes that live in
+        # the recovery machinery around the decisions, e.g. the sink
+        # retraction of rollback-or-retract)
+        self.model_flags = frozenset(model_flags)
+
+
+def _mutant_skip_quiesce(remaining, masks, xi):
+    """Broken wave partition: ships every pending boundary in ONE wave,
+    ignoring upstream exchanges — the quiesce guard (a downstream
+    boundary must wait for its feeder's wave) is skipped."""
+    return sorted(remaining)
+
+
+def _mutant_accept_dead_epoch(
+    acceptor_rank, acceptor_epoch, world, peer_rank, peer_epoch
+):
+    """Broken handshake: rank sanity only, the recovery epoch is NOT
+    checked — a straggler from a rolled-back epoch is let back in."""
+    return not (peer_rank <= acceptor_rank or peer_rank >= world)
+
+
+def get_transitions(mutate: str | None = None) -> Transitions:
+    if mutate is None:
+        return Transitions()
+    if mutate == "skip_quiesce":
+        return Transitions({"wave_partition": _mutant_skip_quiesce})
+    if mutate == "accept_dead_epoch":
+        return Transitions({"hello_accept": _mutant_accept_dead_epoch})
+    if mutate == "drop_rollback_retraction":
+        return Transitions(model_flags=("drop_rollback_retraction",))
+    raise ValueError(
+        f"unknown mutant {mutate!r}; known: skip_quiesce, "
+        "accept_dead_epoch, drop_rollback_retraction"
+    )
+
+
+MUTANT_NAMES = (
+    "skip_quiesce", "accept_dead_epoch", "drop_rollback_retraction",
+)
+
+
+# -- topology / workload ----------------------------------------------------
+
+
+class Exchange(NamedTuple):
+    """One exchange boundary of the modeled plan. ``upstream`` lists the
+    exchange indices whose delivered output can cascade into this one
+    (the wave scheduler's reach/upstream relation)."""
+
+    idx: int
+    mode: str  # "hash" | "gather" | "broadcast"
+    upstream: tuple = ()
+
+
+class Token(NamedTuple):
+    """One symbolic delta. ``hops`` = ((exchange_idx, dest_rank), ...):
+    the route it takes through the exchange topology; the final hop's
+    destination owns its sink entry. ``rnd`` is the source round, which
+    is what the committed-cut reconciliation keys on."""
+
+    tid: tuple
+    rnd: int
+    hops: tuple
+
+
+def canonical_topology() -> tuple[Exchange, ...]:
+    """The shipped protocol's minimal complete shape: a hash boundary (a
+    sharded groupby/join) cascading into a gather boundary (outputs to
+    rank 0) — two waves per timestamp, cascade feeders, pure-gather leg
+    elision."""
+    return (
+        Exchange(0, "hash", ()),
+        Exchange(1, "gather", (0,)),
+    )
+
+
+def _reach_masks(topology: tuple[Exchange, ...]) -> tuple[list[int], list[int]]:
+    """(masks, umasks) over exchange indices, mirroring the runtime's
+    ``_exchange_reach_masks`` / ``_exchange_upstream_masks``: masks[i]
+    includes i itself plus every exchange downstream-reachable from it;
+    umasks[i] is every exchange upstream of i (transitively)."""
+    E = len(topology)
+    down: list[set] = [set() for _ in range(E)]
+    for x in topology:
+        for u in x.upstream:
+            down[u].add(x.idx)
+    masks = [0] * E
+    for i in reversed(range(E)):
+        m = 1 << i
+        for j in sorted(down[i]):
+            m |= masks[j]
+        masks[i] = m
+    umasks = [0] * E
+    for i in range(E):
+        m = 0
+        for u in topology[i].upstream:
+            m |= umasks[u] | (1 << u)
+        umasks[i] = m
+    return masks, umasks
+
+
+def make_workload(
+    topology: tuple[Exchange, ...], world: int, rounds: int,
+    tokens_per_commit: int | None = None,
+) -> tuple:
+    """commits[rank][round] -> tuple[Token]. Each round every rank
+    commits ``tokens_per_commit`` (default ``world``) deltas; entry
+    exchanges (no upstream) seed routes that exercise every leg: hash
+    hop *i* of a commit routes to rank ``(src + i) % world``, a gather
+    hop routes to rank 0, a broadcast hop fans out to every rank. A
+    token's route then follows every downstream chain."""
+    K = world if tokens_per_commit is None else tokens_per_commit
+    entries = [x for x in topology if not x.upstream]
+    down: dict[int, list[int]] = {x.idx: [] for x in topology}
+    for x in topology:
+        for u in x.upstream:
+            down[u].append(x.idx)
+
+    def hop_dest(x: Exchange, src: int, i: int, prev: int) -> list[int]:
+        if x.mode == "gather":
+            return [0]
+        if x.mode == "broadcast":
+            return list(range(world))
+        return [(src + i + prev) % world]
+
+    commits = []
+    for rank in range(world):
+        per_round = []
+        for rnd in range(rounds):
+            toks = []
+            for i in range(K):
+                for e in entries:
+                    # expand every chain path through the topology
+                    paths = [[(e.idx, d)] for d in hop_dest(e, rank, i, 0)]
+                    final_paths = []
+                    frontier = paths
+                    while frontier:
+                        nxt = []
+                        for p in frontier:
+                            last_x, last_d = p[-1]
+                            kids = down[last_x]
+                            if not kids:
+                                final_paths.append(p)
+                                continue
+                            for kid in kids:
+                                for d in hop_dest(
+                                    topology[kid], rank, i, last_d
+                                ):
+                                    nxt.append(p + [(kid, d)])
+                        frontier = nxt
+                    for pi, path in enumerate(final_paths):
+                        toks.append(
+                            Token(
+                                ("t", rank, rnd, i, e.idx, pi),
+                                rnd,
+                                tuple(path),
+                            )
+                        )
+            per_round.append(tuple(toks))
+        commits.append(tuple(per_round))
+    return tuple(commits)
+
+
+@dataclass(frozen=True)
+class MeshCheckConfig:
+    """Bounds of the exploration. ``rounds`` is the wave depth (BSP
+    ingest rounds per rank), ``snap_every`` the snapshot cadence in
+    rounds, ``fault_budget`` how many injected rank crashes one
+    interleaving may contain, drawn from ``fault_phases`` ×
+    ``fault_ranks``."""
+
+    world: int = 3
+    rounds: int = 2
+    tokens_per_commit: int | None = None
+    snap_every: int = 2
+    fault_budget: int = 1
+    fault_phases: tuple = FAULT_PHASES
+    fault_ranks: tuple | None = None
+    max_restarts: int = 2
+    straggler: bool = True
+    max_states: int = 200_000
+    topology: tuple = field(default_factory=canonical_topology)
+    mutate: str | None = None
+    # partial-order reduction strength. Per-rank macro-steps pairwise
+    # commute (disjoint rank state, append-only per-link sends, disjoint
+    # sink keys), so "persistent" explores only the lowest-ranked rank's
+    # enabled actions per state — fault placements, crash/continue
+    # branches, detection races and supervisor moves are all still
+    # exhaustive, but orderings of commuting deterministic steps
+    # collapse to one representative. "full" keeps every ordering
+    # (exact, exponential in world size).
+    por: str = "persistent"
+
+
+# -- model state ------------------------------------------------------------
+
+# rank statuses
+RUN = "run"
+CRASHED = "crashed"          # injected fault fired (exit CRASH_EXIT_CODE)
+EXIT_OK = "exit_ok"          # clean end of input (exit 0)
+EXIT_RESTART = "exit_restart"  # detected a peer loss, epoch abort (exit 28)
+DEAD = "dead"                # reaped by the supervisor
+
+
+class RankState(NamedTuple):
+    status: str
+    epoch: int
+    pc: tuple
+    srcpos: int          # global rounds committed by this rank's source
+    applied: frozenset   # operator state: tokens applied at hash dests
+    committed: tuple     # commit-timestamp sequence this rank stepped
+    fhits: tuple         # sorted ((phase, hits), ...) fault-point counters
+
+
+class Frame(NamedTuple):
+    kind: str            # "xw" | "bye"
+    epoch: int
+    t: int
+    wave: int
+    slices: tuple        # sorted ((exch_idx, (Token, ...)), ...)
+
+
+class StoreState(NamedTuple):
+    marker: int | None   # committed cut = source round count (None = none)
+    snaps: tuple         # sorted (((rank, tag), (applied, srcpos)), ...)
+    sink: tuple          # sorted (((token_id, dest), count), ...)
+
+
+class SupState(NamedTuple):
+    epoch: int
+    restarts: int
+    status: str          # "watch" | "done" | "failed"
+
+
+class State(NamedTuple):
+    ranks: tuple
+    links: tuple         # links[src][dst] = tuple[Frame]
+    store: StoreState
+    sup: SupState
+    budget: int
+    zombies: tuple = ()  # (rank, dead_epoch) stragglers of reaped epochs
+
+
+def _initial_state(cfg: MeshCheckConfig, model=None, preseed: int = 0) -> State:
+    """Root state. ``preseed > 0`` starts from a store a *previous* run
+    committed through ``preseed`` rounds (marker + per-rank snapshots +
+    sink entries) — the restore-at-startup scenario of the fault grid's
+    'restore' cells, which is the only place the restore-phase kill slot
+    is reachable with a fault budget (the supervisor strips the fault
+    plan from rollback respawns)."""
+    ranks = tuple(
+        RankState(RUN, 0, ("restore",), 0, frozenset(), (), ())
+        for _ in range(cfg.world)
+    )
+    links = tuple(
+        tuple(() for _ in range(cfg.world)) for _ in range(cfg.world)
+    )
+    store = StoreState(None, (), ())
+    if preseed:
+        snaps = {}
+        sink = {}
+        for rank in range(cfg.world):
+            applied = frozenset(
+                tok.tid
+                for per_rank in model.commits
+                for rnd in range(min(preseed, cfg.rounds))
+                for tok in per_rank[rnd]
+                if any(
+                    model.topology[x].mode == "hash" and d == rank
+                    for x, d in tok.hops
+                )
+            )
+            snaps[(rank, preseed)] = (applied, preseed)
+        for per_rank in model.commits:
+            for rnd in range(min(preseed, cfg.rounds)):
+                for tok in per_rank[rnd]:
+                    sink[(tok.tid, tok.hops[-1][1])] = 1
+        store = StoreState(
+            preseed, tuple(sorted(snaps.items())),
+            tuple(sorted(sink.items())),
+        )
+    return State(
+        ranks, links, store, SupState(0, 0, "watch"), cfg.fault_budget,
+    )
+
+
+def _set_rank(state: State, r: int, rs: RankState) -> State:
+    ranks = list(state.ranks)
+    ranks[r] = rs
+    return state._replace(ranks=tuple(ranks))
+
+
+def _push_frame(links, src: int, dst: int, frame: Frame):
+    rows = list(links)
+    row = list(rows[src])
+    row[dst] = row[dst] + (frame,)
+    rows[src] = tuple(row)
+    return tuple(rows)
+
+
+def _pop_frame(links, src: int, dst: int):
+    rows = list(links)
+    row = list(rows[src])
+    frame = row[dst][0]
+    row[dst] = row[dst][1:]
+    rows[src] = tuple(row)
+    return tuple(rows), frame
+
+
+def _fhit(rs: RankState, phase: str) -> tuple[RankState, int]:
+    """Count a fault-point hit on the rank's per-phase counter — the
+    exact semantics of faults.py's per-(point, phase) counters, which is
+    what makes crash choices replayable as PATHWAY_FAULT_PLAN rules."""
+    d = dict(rs.fhits)
+    d[phase] = d.get(phase, 0) + 1
+    return rs._replace(fhits=tuple(sorted(d.items()))), d[phase]
+
+
+# -- violations -------------------------------------------------------------
+
+
+@dataclass
+class Violation:
+    kind: str
+    detail: str
+    trace: list = field(default_factory=list)
+
+    def fault_plan(self) -> dict | None:
+        """The trace's crash choices as a replayable PATHWAY_FAULT_PLAN
+        (one phase-scoped, rank-scoped, hit-exact rule per crash)."""
+        rules = [
+            {
+                "point": FAULT_POINT,
+                "phase": step["phase"],
+                "rank": step["rank"],
+                "hits": [step["hit"]],
+                "action": "crash",
+            }
+            for step in self.trace
+            if step.get("action") == "crash"
+        ]
+        return {"seed": 7, "rules": rules} if rules else None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "trace": self.trace,
+            "fault_plan": self.fault_plan(),
+        }
+
+
+@dataclass
+class MeshCheckReport:
+    config: MeshCheckConfig
+    states: int = 0
+    transitions: int = 0
+    terminals: int = 0
+    rollbacks_explored: int = 0
+    complete: bool = True
+    violations: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.complete and not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": "pathway_tpu.meshcheck/v1",
+            "world": self.config.world,
+            "rounds": self.config.rounds,
+            "fault_budget": self.config.fault_budget,
+            "mutate": self.config.mutate,
+            "states": self.states,
+            "transitions": self.transitions,
+            "terminals": self.terminals,
+            "rollbacks_explored": self.rollbacks_explored,
+            "complete": self.complete,
+            "ok": self.ok,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+    def to_json(self, **kw) -> str:
+        kw.setdefault("indent", 2)
+        return json.dumps(self.to_dict(), **kw)
+
+    def render(self) -> str:
+        c = self.config
+        lines = [
+            f"mesh verifier: {c.world} rank(s), {c.rounds} round(s), "
+            f"fault budget {c.fault_budget}"
+            + (f", mutant {c.mutate!r}" if c.mutate else ""),
+            f"  explored {self.states} states / {self.transitions} "
+            f"transitions ({self.terminals} terminal(s), "
+            f"{self.rollbacks_explored} rollback path(s))"
+            + ("" if self.complete else " — INCOMPLETE (state cap hit)"),
+        ]
+        if not self.violations:
+            lines.append(
+                "  no deadlock, frontier divergence, lost/duplicated "
+                "delta, or dead-epoch acceptance found"
+            )
+        for v in self.violations:
+            lines.append(f"  VIOLATION [{v.kind}] {v.detail}")
+            for step in v.trace:
+                lines.append(f"    - {step['label']}")
+            plan = v.fault_plan()
+            if plan:
+                lines.append(
+                    "    replay: PATHWAY_FAULT_PLAN='"
+                    + json.dumps(plan, separators=(",", ":"))
+                    + "'"
+                )
+        return "\n".join(lines)
+
+
+# -- the model --------------------------------------------------------------
+
+
+class MeshModel:
+    """Successor-state generator for one configuration. All iteration
+    orders are deterministic, so two runs explore the identical graph."""
+
+    def __init__(self, cfg: MeshCheckConfig, trans: Transitions):
+        self.cfg = cfg
+        self.t = trans
+        self.topology = cfg.topology
+        self.masks, self.umasks = _reach_masks(cfg.topology)
+        self.xi = {i: i for i in range(len(cfg.topology))}
+        self.commits = make_workload(
+            cfg.topology, cfg.world, cfg.rounds, cfg.tokens_per_commit
+        )
+        # every (token, final_dest) the workload must deliver exactly once
+        expected = []
+        for per_rank in self.commits:
+            for toks in per_rank:
+                for tok in toks:
+                    expected.append((tok.tid, tok.hops[-1][1]))
+        self.expected = frozenset(expected)
+        self.full_xmask = 0
+        for x in cfg.topology:
+            self.full_xmask |= 1 << x.idx
+
+    # -- helpers ----------------------------------------------------------
+
+    def _rank_dead(self, rs: RankState) -> bool:
+        return rs.status in (CRASHED, DEAD, EXIT_RESTART, EXIT_OK)
+
+    def _fault_matches(self, state: State, r: int, phase: str) -> bool:
+        cfg = self.cfg
+        if state.budget <= 0 or phase not in cfg.fault_phases:
+            return False
+        if cfg.fault_ranks is not None and r not in cfg.fault_ranks:
+            return False
+        return True
+
+    # -- per-rank deterministic micro-steps (the macro-step POR) ----------
+
+    def advance(self, state: State, r: int) -> State | None:
+        """Run rank r's deterministic micro-steps until it blocks
+        (barrier / empty-link recv), pauses at a matching fault point,
+        or exits. Returns the new state, or None when the rank cannot
+        make local progress (its next move belongs to another action:
+        frame arrival, barrier resolution, detection)."""
+        rs = state.ranks[r]
+        if rs.status != RUN:
+            return None
+        progressed = False
+        while True:
+            rs = state.ranks[r]
+            pc = rs.pc
+            op = pc[0]
+            if op == "restore":
+                state = self._do_restore(state, r)
+                progressed = True
+                continue
+            if op == "restore_fp":
+                # paused at the restore-phase kill slot: the scheduler
+                # owns the crash/continue branch
+                return state if progressed else None
+            if op == "round":
+                n = 1 if rs.srcpos < self.cfg.rounds else 0
+                state = _set_rank(
+                    state, r, rs._replace(pc=("barrier_plan", n))
+                )
+                progressed = True
+                continue
+            if op in ("barrier_plan", "barrier_snap"):
+                return state if progressed else None
+            if op == "exec":
+                state = self._start_commit(state, r)
+                progressed = True
+                continue
+            if op == "wave_fp":
+                return state if progressed else None
+            if op == "wave_send":
+                state = self._do_wave_send(state, r)
+                progressed = True
+                continue
+            if op == "wave_recv":
+                got = self._try_recv(state, r)
+                if got is None:
+                    return state if progressed else None
+                state = got
+                progressed = True
+                continue
+            if op == "snap":
+                state = self._do_snapshot(state, r)
+                progressed = True
+                continue
+            if op == "snap_fp":
+                return state if progressed else None
+            if op == "closing":
+                state = self._do_close(state, r)
+                return state
+            raise AssertionError(f"unknown pc {pc!r}")
+
+    # -- restore ----------------------------------------------------------
+
+    def _do_restore(self, state: State, r: int) -> State:
+        rs = state.ranks[r]
+        marker = state.store.marker
+        if marker is None:
+            # nothing committed: fresh start (connectors from scratch).
+            # rollback-or-retract: sink entries from dead epochs that the
+            # (empty) cut does not cover are retracted
+            state = self._reconcile_sink(state, r, cut=0)
+            return _set_rank(
+                state, r,
+                rs._replace(
+                    pc=("round",), srcpos=0, applied=frozenset(),
+                    committed=(),
+                ),
+            )
+        snaps = dict(state.store.snaps)
+        snap = snaps.get((r, marker))
+        # two-phase property: the marker only ever names a tag for which
+        # EVERY rank's snapshot exists durably
+        if snap is None:
+            raise _PropertyViolation(
+                "missing-snapshot",
+                f"commit marker names cut {marker} but rank {r} has no "
+                f"durable snapshot at that tag",
+            )
+        applied, srcpos = snap
+        state = self._reconcile_sink(state, r, cut=marker)
+        rs = state.ranks[r]._replace(
+            pc=("restore_fp",), srcpos=srcpos, applied=applied,
+            committed=(),
+        )
+        # the restore-phase kill slot fires only when there IS a marker
+        # to restore (mirrors runtime._restore_operator_snapshot_distributed)
+        rs, hit = _fhit(rs, "restore")
+        state = _set_rank(state, r, rs)
+        if not self._fault_matches(state, r, "restore"):
+            state = _set_rank(
+                state, r, state.ranks[r]._replace(pc=("round",))
+            )
+        return state
+
+    def _reconcile_sink(self, state: State, r: int, cut: int) -> State:
+        """Rollback-or-retract at the exactly-once boundary: on restore,
+        this rank retracts its own sink entries (final-hop deliveries it
+        owns) for tokens the committed cut does not cover — they will be
+        re-delivered by the replay. The drop_rollback_retraction mutant
+        skips this, which is precisely a duplicated-delta bug."""
+        if "drop_rollback_retraction" in self.t.model_flags:
+            return state
+        sink = [
+            ((tid, dest), cnt)
+            for (tid, dest), cnt in state.store.sink
+            # tid = ("t", src, rnd, ...): rnd < cut is committed
+            if not (dest == r and tid[2] >= cut)
+        ]
+        return state._replace(
+            store=state.store._replace(sink=tuple(sorted(sink)))
+        )
+
+    # -- commit execution (the wave walk) ---------------------------------
+
+    def _start_commit(self, state: State, r: int) -> State:
+        rs = state.ranks[r]
+        _op, plan, idx = rs.pc
+        if idx >= len(plan):
+            # round's plan exhausted -> snapshot decision
+            rnd = rs.srcpos  # rounds completed (commit consumed below)
+            take_snap = rnd % self.cfg.snap_every == self.cfg.snap_every - 1
+            if take_snap:
+                pc = ("snap",)
+            else:
+                pc = ("round",)
+            return _set_rank(
+                state, r, rs._replace(pc=pc, srcpos=rs.srcpos + 1)
+            )
+        t, xmask, contrib = plan[idx]
+        owner = None
+        for rr in range(self.cfg.world):
+            if (contrib >> rr) & 1:
+                owner = rr
+        pending: dict[int, tuple] = {}
+        if owner == r:
+            toks = self.commits[r][rs.srcpos]
+            for tok in toks:
+                x0 = tok.hops[0][0]
+                pending[x0] = pending.get(x0, ()) + ((tok, 0),)
+        remaining = frozenset(
+            i for i in range(len(self.topology)) if (xmask >> i) & 1
+        )
+        return _set_rank(
+            state, r,
+            rs._replace(
+                pc=(
+                    "wave_send", plan, idx, remaining,
+                    tuple(sorted(pending.items())), 1,
+                )
+            ),
+        )
+
+    def _wave_of(self, remaining: frozenset) -> list[int]:
+        return self.t.wave_partition(remaining, self.masks, self.xi)
+
+    def _do_wave_send(self, state: State, r: int) -> State:
+        rs = state.ranks[r]
+        _op, plan, idx, remaining, pending, wave_no = rs.pc
+        if not remaining:
+            # commit's waves done: record the committed timestamp
+            t, _x, _c = plan[idx]
+            return _set_rank(
+                state, r,
+                rs._replace(
+                    pc=("exec", plan, idx + 1),
+                    committed=rs.committed + (t,),
+                ),
+            )
+        wave = self._wave_of(remaining)
+        # the wave_send kill slot: slices prepared, frames not shipped
+        rs, hit = _fhit(rs, "wave_send")
+        if self._fault_matches(state, r, "wave_send"):
+            state = _set_rank(
+                state, r,
+                rs._replace(
+                    pc=(
+                        "wave_fp", plan, idx, remaining, pending, wave_no,
+                    )
+                ),
+            )
+            return state
+        state = _set_rank(state, r, rs)
+        return self._ship_wave(state, r)
+
+    def resume_after_fault_point(self, state: State, r: int) -> State:
+        """The scheduler's 'continue' branch at a paused fault point."""
+        rs = state.ranks[r]
+        op = rs.pc[0]
+        if op == "wave_fp":
+            _op, plan, idx, remaining, pending, wave_no = rs.pc
+            state = _set_rank(
+                state, r,
+                rs._replace(
+                    pc=(
+                        "wave_send+", plan, idx, remaining, pending,
+                        wave_no,
+                    )
+                ),
+            )
+            return self._ship_wave(state, r)
+        if op == "snap_fp":
+            return _set_rank(
+                state, r, rs._replace(pc=("barrier_snap", rs.pc[1]))
+            )
+        if op == "restore_fp":
+            return _set_rank(state, r, rs._replace(pc=("round",)))
+        raise AssertionError(f"not at a fault point: {rs.pc!r}")
+
+    def _ship_wave(self, state: State, r: int) -> State:
+        """Send this rank's frames for the current wave and switch to
+        the recv half. Leg elision comes from the shared transition
+        table (wave_send_targets / wave_recv_sources)."""
+        rs = state.ranks[r]
+        _op, plan, idx, remaining, pending, wave_no = rs.pc
+        t, _xmask, contrib_mask = plan[idx]
+        wave = self._wave_of(remaining)
+        gather_only = all(
+            self.topology[x].mode == "gather" for x in wave
+        )
+        contrib = contrib_mask if wave_no == 1 else None
+        world = self.cfg.world
+        targets = self.t.wave_send_targets(world, r, gather_only, contrib)
+        pend = dict(pending)
+        links = state.links
+        for peer in targets:
+            slices = []
+            for x in sorted(wave):
+                toks = tuple(
+                    tok
+                    for tok, hop in pend.get(x, ())
+                    if tok.hops[hop][1] == peer
+                )
+                if toks:
+                    slices.append((x, toks))
+            links = _push_frame(
+                links, r, peer,
+                Frame("xw", rs.epoch, t, wave_no, tuple(slices)),
+            )
+        expect = tuple(
+            self.t.wave_recv_sources(world, r, gather_only, contrib)
+        )
+        rs = rs._replace(
+            pc=(
+                "wave_recv", plan, idx, remaining, pending, wave_no,
+                expect, (),
+            )
+        )
+        return _set_rank(state._replace(links=links), r, rs)
+
+    def _try_recv(self, state: State, r: int) -> State | None:
+        """Consume the next expected wave frame if one is in flight;
+        completes the wave (deliver + cascade) once every expected peer
+        has been heard. Returns None when blocked."""
+        rs = state.ranks[r]
+        (_op, plan, idx, remaining, pending, wave_no, expect, got) = rs.pc
+        if not expect:
+            return self._finish_wave(state, r)
+        peer = expect[0]
+        link = state.links[peer][r]
+        # skip goodbye frames (the peer announced clean shutdown); the
+        # classification of the resulting loss happens in the detect
+        # action, through the shared classify_peer_loss
+        while link and link[0].kind == "bye":
+            links, _ = _pop_frame(state.links, peer, r)
+            state = state._replace(links=links)
+            link = state.links[peer][r]
+        if not link:
+            return None
+        links, frame = _pop_frame(state.links, peer, r)
+        state = state._replace(links=links)
+        t, _xm, _c = plan[idx]
+        if frame.kind != "xw" or frame.t != t or frame.wave != wave_no \
+                or frame.epoch != rs.epoch:
+            raise _PropertyViolation(
+                "wave-desync",
+                f"rank {r} expected (t={t}, wave={wave_no}, epoch="
+                f"{rs.epoch}) from peer {peer}, got (kind={frame.kind}, "
+                f"t={frame.t}, wave={frame.wave}, epoch={frame.epoch}) — "
+                "send/recv legs disagree",
+            )
+        rs = rs._replace(
+            pc=(
+                "wave_recv", plan, idx, remaining, pending, wave_no,
+                expect[1:], got + (frame,),
+            )
+        )
+        return _set_rank(state, r, rs)
+
+    def _finish_wave(self, state: State, r: int) -> State:
+        """All expected frames arrived: deliver this wave's tokens
+        (apply at hash dests, sink at final hops), run the cascade
+        feeders under the quiesce guard, and move to the next wave."""
+        rs = state.ranks[r]
+        (_op, plan, idx, remaining, pending, wave_no, _expect, got) = rs.pc
+        wave = self._wave_of(remaining)
+        pend = {x: list(v) for x, v in pending}
+        # delivered[x] = tokens this rank received/kept for wave member x
+        delivered: dict[int, list] = {x: [] for x in wave}
+        for x in sorted(wave):
+            for tok, hop in pend.pop(x, ()):
+                if tok.hops[hop][1] == r:
+                    delivered[x].append((tok, hop))
+        for frame in got:
+            for x, toks in frame.slices:
+                if x not in delivered:
+                    raise _PropertyViolation(
+                        "wave-desync",
+                        f"rank {r} received exchange {x} outside wave "
+                        f"{sorted(wave)}",
+                    )
+                for tok in toks:
+                    hop = None
+                    for h, (hx, hd) in enumerate(tok.hops):
+                        if hx == x and hd == r:
+                            hop = h
+                    if hop is None:
+                        raise _PropertyViolation(
+                            "wave-desync",
+                            f"rank {r} received token {tok.tid} it does "
+                            f"not own at exchange {x}",
+                        )
+                    delivered[x].append((tok, hop))
+        applied = set(rs.applied)
+        sink = dict(state.store.sink)
+        new_remaining = remaining - set(wave)
+        wbits_left = self.t.wave_bits(new_remaining, self.xi)
+        E = len(self.topology)
+        for x in sorted(delivered):
+            for tok, hop in delivered[x]:
+                if self.topology[x].mode == "hash":
+                    applied.add(tok.tid)
+                if hop + 1 >= len(tok.hops):
+                    key = (tok.tid, r)
+                    sink[key] = sink.get(key, 0) + 1
+                    continue
+                nx = tok.hops[hop + 1][0]
+                # cascade feeder: may this local step run before the
+                # next wave? The quiesce guard decides — driven through
+                # the SAME quiesce_candidates the engine loop uses. The
+                # feeder pseudo-node (the local node between exchange x
+                # and exchange nx) reaches everything nx reaches and
+                # sits downstream of x — exactly the engine's reach/
+                # upstream masks for a child of x feeding nx.
+                feeder = E + x * E + nx
+                size = E + E * E
+                fmasks = list(self.masks) + [0] * (size - E)
+                fumasks = list(self.umasks) + [0] * (size - E)
+                fmasks[feeder] = self.masks[nx]
+                fumasks[feeder] = self.umasks[x] | (1 << x)
+                cand = self.t.quiesce_candidates(
+                    [feeder], new_remaining, fmasks, fumasks, wbits_left
+                )
+                if feeder in cand:
+                    pend.setdefault(nx, []).append((tok, hop + 1))
+                # else: the boundary already shipped (or never will
+                # this timestamp) — the token is stranded, which the
+                # exactly-once audit reports as a lost delta
+        rs = rs._replace(
+            applied=frozenset(applied),
+            pc=(
+                "wave_send", plan, idx, new_remaining,
+                tuple(sorted((x, tuple(v)) for x, v in pend.items() if v)),
+                wave_no + 1,
+            ),
+        )
+        state = state._replace(
+            store=state.store._replace(sink=tuple(sorted(sink.items())))
+        )
+        return _set_rank(state, r, rs)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def _do_snapshot(self, state: State, r: int) -> State:
+        rs = state.ranks[r]
+        tag = rs.srcpos  # the cut: rounds this rank's source committed
+        snaps = dict(state.store.snaps)
+        snaps[(r, tag)] = (rs.applied, rs.srcpos)
+        state = state._replace(
+            store=state.store._replace(snaps=tuple(sorted(snaps.items())))
+        )
+        # kill slot: rank-local snapshot durable, marker not yet moved
+        rs, hit = _fhit(rs, "post_snapshot")
+        if self._fault_matches(state, r, "post_snapshot"):
+            return _set_rank(state, r, rs._replace(pc=("snap_fp", tag)))
+        return _set_rank(state, r, rs._replace(pc=("barrier_snap", tag)))
+
+    # -- closing ------------------------------------------------------------
+
+    def _do_close(self, state: State, r: int) -> State:
+        rs = state.ranks[r]
+        links = state.links
+        for peer in range(self.cfg.world):
+            if peer != r:
+                links = _push_frame(
+                    links, r, peer, Frame("bye", rs.epoch, -1, 0, ())
+                )
+        return _set_rank(
+            state._replace(links=links), r, rs._replace(status=EXIT_OK)
+        )
+
+    # -- barriers (control plane) ------------------------------------------
+
+    def barrier_ready(self, state: State) -> str | None:
+        """A control collective (gather0 + bcast0) resolves only when
+        EVERY rank of the mesh participates — a crashed/exited member
+        makes it hang, which is what the blocked survivors' failure
+        detectors then turn into an epoch abort."""
+        if all(
+            rs.status == RUN and rs.pc[0] == "barrier_plan"
+            for rs in state.ranks
+        ):
+            return "plan"
+        if all(
+            rs.status == RUN and rs.pc[0] == "barrier_snap"
+            for rs in state.ranks
+        ):
+            return "snap"
+        return None
+
+    def resolve_plan_barrier(self, state: State) -> State:
+        """The BSP round's control phase: gather per-rank commit counts
+        + exchange masks, let the shared commit_plan transition assign
+        globally ordered times, hand every rank the same plan."""
+        world = self.cfg.world
+        counts = []
+        xmasks: list[list[int]] = []
+        for rs in state.ranks:
+            n = rs.pc[1]
+            counts.append(n)
+            xmasks.append([self.full_xmask] * n)
+        rnd = state.ranks[0].srcpos
+        total = sum(counts)
+        if total == 0:
+            # alldone: every rank's input is exhausted
+            for r, rs in enumerate(state.ranks):
+                state = _set_rank(state, r, rs._replace(pc=("closing",)))
+            return state
+        base = self.t.commit_time(2 * world * (rnd + 1), 0)
+        plan = tuple(self.t.commit_plan(base, counts, xmasks))
+        for r, rs in enumerate(state.ranks):
+            state = _set_rank(state, r, rs._replace(pc=("exec", plan, 0)))
+        return state
+
+    def resolve_snap_barrier(self, state: State) -> State:
+        """Two-phase commit of the distributed cut: every rank's
+        snapshot ack arrived, rank 0 moves the marker — so the marker
+        always names a tag for which every rank's snapshot exists
+        durably."""
+        tag = state.ranks[0].pc[1]
+        state = state._replace(store=state.store._replace(marker=tag))
+        for r, rs in enumerate(state.ranks):
+            state = _set_rank(state, r, rs._replace(pc=("round",)))
+        return state
+
+    # -- detection ----------------------------------------------------------
+
+    def blocked_on_dead_peer(self, state: State, r: int) -> str | None:
+        """When rank r is blocked and some rank it transitively depends
+        on is dead, the heartbeat/timeout detector will fire (the
+        peer_liveness verdict with unbounded idle). Returns the
+        classification ('crashed'/'gone') of the loss, or None when r is
+        not (yet) entitled to detect anything."""
+        rs = state.ranks[r]
+        if rs.status != RUN:
+            return None
+        pc = rs.pc[0]
+        dead = [
+            p for p, ps in enumerate(state.ranks)
+            if p != r and self._rank_dead(ps)
+        ]
+        if not dead:
+            return None
+        if pc == "wave_recv":
+            expect = rs.pc[6]
+            for peer in expect:
+                ps = state.ranks[peer]
+                if self._rank_dead(ps) and not any(
+                    f.kind == "xw" for f in state.links[peer][r]
+                ):
+                    goodbye = ps.status == EXIT_OK or any(
+                        f.kind == "bye" for f in state.links[peer][r]
+                    )
+                    # liveness verdict through the shared table: a peer
+                    # that will never beat again scores unbounded idle
+                    if self.t.peer_liveness(
+                        float("inf"), 1.0, goodbye
+                    ) == "failed" or goodbye:
+                        return self.t.classify_peer_loss(goodbye)
+            return None
+        if pc in ("barrier_plan", "barrier_snap"):
+            # a collective with a dead member: the op deadline fires
+            ps = state.ranks[dead[0]]
+            return self.t.classify_peer_loss(ps.status == EXIT_OK)
+        return None
+
+    def detect(self, state: State, r: int) -> State:
+        """Epoch abort: the rank drains + discards in-flight frames,
+        drops its links (no goodbye — it is aborting) and exits with the
+        rollback-request code."""
+        links = list(state.links)
+        # inbound frames of the dead epoch are drained and discarded
+        for p in range(self.cfg.world):
+            row = list(links[p])
+            row[r] = ()
+            links[p] = tuple(row)
+        rs = state.ranks[r]._replace(status=EXIT_RESTART)
+        return _set_rank(state._replace(links=tuple(links)), r, rs)
+
+    # -- supervisor ----------------------------------------------------------
+
+    def supervisor_enabled(self, state: State) -> str | None:
+        if state.sup.status != "watch":
+            return None
+        statuses = [rs.status for rs in state.ranks]
+        if any(s in (CRASHED, EXIT_RESTART) for s in statuses):
+            return "reap"
+        if all(s == EXIT_OK for s in statuses):
+            return "finish"
+        return None
+
+    def reap_outcomes(self, state: State) -> list[tuple[str, State]]:
+        """Reap the epoch: SIGKILL still-running ranks (each may instead
+        survive the grace window briefly as a straggler — the model
+        explores that race), collect exit codes, and take the shared
+        supervisor_decide verdict: respawn everyone at epoch+1 from the
+        committed cut, or give up."""
+        outcomes = []
+        running = [
+            r for r, rs in enumerate(state.ranks) if rs.status == RUN
+        ]
+        choices: list[tuple[int | None, str]] = [(None, "reap")]
+        if self.cfg.straggler and not state.zombies:
+            # only non-zero ranks have a straggle vector: a zombie
+            # re-connects to LOWER ranks (acceptors), and the recovered
+            # mesh listens on a fresh port base so nobody dials IT
+            for r in running:
+                if r > 0:
+                    choices.append((r, f"reap(straggler={r})"))
+        for zombie, label in choices:
+            s = state
+            codes = []
+            for r, rs in enumerate(s.ranks):
+                if rs.status == CRASHED:
+                    codes.append(CRASH_EXIT_CODE)
+                elif rs.status == EXIT_RESTART:
+                    codes.append(_proto.MESH_RESTART_EXIT_CODE)
+                elif rs.status == EXIT_OK:
+                    codes.append(0)
+                else:  # still running: SIGKILLed by the reap
+                    codes.append(KILLED_EXIT_CODE)
+            verdict, payload = self.t.supervisor_decide(
+                codes, s.sup.restarts, self.cfg.max_restarts
+            )
+            if verdict == "give_up":
+                s = s._replace(sup=s.sup._replace(status="failed"))
+                outcomes.append((label + "->give_up", s))
+                continue
+            if verdict == "done":  # unreachable here (some code nonzero)
+                s = s._replace(sup=s.sup._replace(status="done"))
+                outcomes.append((label + "->done", s))
+                continue
+            # rollback: respawn ALL ranks at epoch+1 on a fresh port
+            # base; links of the dead epoch vanish with the processes.
+            # PATHWAY_FAULT_PLAN is stripped from respawns (supervisor
+            # default), so the recovered epoch runs fault-free.
+            new_epoch = s.sup.epoch + payload
+            old_epoch = s.sup.epoch
+            ranks = tuple(
+                RankState(RUN, new_epoch, ("restore",), 0, frozenset(),
+                          (), ())
+                for _ in range(self.cfg.world)
+            )
+            links = tuple(
+                tuple(() for _ in range(self.cfg.world))
+                for _ in range(self.cfg.world)
+            )
+            zombies = s.zombies
+            if zombie is not None:
+                zombies = zombies + ((zombie, old_epoch),)
+            s = State(
+                ranks, links, s.store,
+                SupState(new_epoch, s.sup.restarts + 1, "watch"), 0,
+                zombies,
+            )
+            outcomes.append((label + f"->rollback(e{new_epoch})", s))
+        return outcomes
+
+    def finish(self, state: State) -> State:
+        return state._replace(sup=state.sup._replace(status="done"))
+
+    # -- straggler ------------------------------------------------------------
+
+    def straggle(self, state: State, zi: int) -> State:
+        """A straggler process from a reaped epoch attempts to
+        re-handshake into the recovered mesh (it dials its lower-rank
+        peers). The shared hello_accept must refuse it (the epoch is
+        bound into the hello AND its MAC); acceptance is the dead-epoch
+        violation."""
+        rank, dead_epoch = state.zombies[zi]
+        new_epoch = state.sup.epoch
+        if self.t.hello_accept(
+            0, new_epoch, self.cfg.world, rank, dead_epoch
+        ) and dead_epoch != new_epoch:
+            raise _PropertyViolation(
+                "dead-epoch-straggler",
+                f"rank {rank} surviving from rolled-back epoch "
+                f"{dead_epoch} was accepted into the recovered "
+                f"epoch-{new_epoch} mesh — pre-rollback in-flight state "
+                "can now leak across the rollback",
+            )
+        zombies = tuple(
+            z for i, z in enumerate(state.zombies) if i != zi
+        )
+        return state._replace(zombies=zombies)
+
+    # -- properties ------------------------------------------------------------
+
+    def check_invariants(self, state: State) -> None:
+        """Properties checked on every reachable state."""
+        # frontier divergence: same-epoch ranks must commit timestamp
+        # sequences that are prefixes of one another
+        by_epoch: dict[int, list[tuple]] = {}
+        for rs in state.ranks:
+            if rs.status in (RUN, EXIT_OK):
+                by_epoch.setdefault(rs.epoch, []).append(rs.committed)
+        for epoch, seqs in by_epoch.items():
+            seqs = sorted(seqs, key=len)
+            for a, b in zip(seqs, seqs[1:]):
+                if b[: len(a)] != a:
+                    raise _PropertyViolation(
+                        "frontier-divergence",
+                        f"epoch {epoch}: committed timestamp sequences "
+                        f"diverge: {a} vs {b}",
+                    )
+
+    def check_terminal(self, state: State) -> None:
+        """Exactly-once audit on clean terminal states: every workload
+        delta delivered exactly once across any rollbacks."""
+        if state.sup.status != "done":
+            return
+        sink = dict(state.store.sink)
+        missing = sorted(k for k in self.expected if k not in sink)
+        dupes = sorted(
+            k for k, c in sink.items() if c != 1 and k in self.expected
+        )
+        if missing or dupes:
+            raise _PropertyViolation(
+                "exactly-once",
+                f"clean run violated exactly-once: "
+                f"{len(missing)} lost delta(s) "
+                f"(e.g. {missing[:3]}), {len(dupes)} duplicated "
+                f"(e.g. {[(k, sink[k]) for k in dupes[:3]]})",
+            )
+
+    def is_terminal(self, state: State) -> bool:
+        return state.sup.status in ("done", "failed")
+
+
+class _PropertyViolation(Exception):
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind
+        self.detail = detail
+
+
+# -- the scheduler / explorer ----------------------------------------------
+
+
+def _successors(model: MeshModel, state: State) -> list[tuple[dict, Any]]:
+    """All enabled scheduler actions at ``state`` as (label, successor)
+    — successor is a State, or a _PropertyViolation raised through."""
+    out: list[tuple[dict, State]] = []
+    cfg = model.cfg
+    per_rank: list[list[tuple[dict, State]]] = []
+    for r in range(cfg.world):
+        acts: list[tuple[dict, State]] = []
+        rs = state.ranks[r]
+        if rs.status != RUN:
+            per_rank.append(acts)
+            continue
+        pc0 = rs.pc[0]
+        if pc0 in ("wave_fp", "snap_fp", "restore_fp"):
+            phase = {
+                "wave_fp": "wave_send",
+                "snap_fp": "post_snapshot",
+                "restore_fp": "restore",
+            }[pc0]
+            hit = dict(rs.fhits)[phase]
+            crashed = _set_rank(
+                state._replace(budget=state.budget - 1),
+                r, rs._replace(status=CRASHED),
+            )
+            acts.append(
+                (
+                    {
+                        "label": f"crash(rank={r}, phase={phase}, "
+                                 f"hit={hit})",
+                        "action": "crash", "rank": r, "phase": phase,
+                        "hit": hit,
+                    },
+                    crashed,
+                )
+            )
+            acts.append(
+                (
+                    {"label": f"continue(rank={r}, phase={phase})"},
+                    model.resume_after_fault_point(state, r),
+                )
+            )
+        else:
+            nxt = model.advance(state, r)
+            if nxt is not None:
+                acts.append(({"label": f"step(rank={r})"}, nxt))
+            else:
+                # blocked: a frame may arrive (advance handles it once
+                # present) or the failure detector may fire
+                verdict = model.blocked_on_dead_peer(state, r)
+                if verdict is not None:
+                    acts.append(
+                        (
+                            {"label": f"detect(rank={r}, {verdict})"},
+                            model.detect(state, r),
+                        )
+                    )
+        per_rank.append(acts)
+    if cfg.por == "persistent":
+        # persistent-set reduction: rank macro-steps pairwise commute,
+        # so one representative rank's actions per state suffice; its
+        # OWN branches (crash/continue, detect) stay exhaustive, and
+        # every other rank's actions remain enabled in the successors
+        chosen = next((a for a in per_rank if a), None)
+        if chosen:
+            out.extend(chosen)
+    else:
+        for acts in per_rank:
+            out.extend(acts)
+    barrier = model.barrier_ready(state)
+    if barrier == "plan":
+        out.append(
+            ({"label": "control(plan)"}, model.resolve_plan_barrier(state))
+        )
+    elif barrier == "snap":
+        out.append(
+            (
+                {"label": "control(snapshot-commit)"},
+                model.resolve_snap_barrier(state),
+            )
+        )
+    sup = model.supervisor_enabled(state)
+    if sup == "finish":
+        out.append(({"label": "supervisor(finish)"}, model.finish(state)))
+    elif sup == "reap":
+        for label, s in model.reap_outcomes(state):
+            out.append(({"label": f"supervisor({label})"}, s))
+    if state.sup.status == "watch":
+        for zi, (zr, ze) in enumerate(state.zombies):
+            out.append(
+                (
+                    {"label": f"straggle(rank={zr}, dead_epoch={ze})"},
+                    model.straggle(state, zi),
+                )
+            )
+    return out
+
+
+def check(
+    config: MeshCheckConfig | None = None, **kw
+) -> MeshCheckReport:
+    """Exhaustively explore the bounded state space. Returns a report
+    with state/transition counts and any violations (each carrying a
+    minimal trace + replayable fault plan)."""
+    cfg = config or MeshCheckConfig(**kw)
+    trans = get_transitions(cfg.mutate)
+    model = MeshModel(cfg, trans)
+    report = MeshCheckReport(config=cfg)
+    roots = [(_initial_state(cfg), False)]
+    if (
+        cfg.fault_budget > 0
+        and "restore" in cfg.fault_phases
+        and cfg.snap_every <= cfg.rounds
+    ):
+        # second root: a store committed through one snapshot cadence by
+        # a previous run — the restore-at-startup scenario where the
+        # restore-phase kill slot is live (see _initial_state)
+        roots.append(
+            (_initial_state(cfg, model, preseed=cfg.snap_every), True)
+        )
+
+    def explore(order: str) -> Violation | None:
+        """order='dfs': exhaustive count; order='bfs': shortest trace."""
+        seen = {s for s, _ in roots}
+        frontier: list[tuple[State, tuple]] = [
+            (
+                s,
+                ((("label", "start(committed-store)"),),) if pre else (),
+            )
+            for s, pre in roots
+        ]
+        states = transitions = terminals = rollbacks = 0
+        first: Violation | None = None
+        while frontier:
+            if order == "dfs":
+                state, trace = frontier.pop()
+            else:
+                state, trace = frontier.pop(0)
+            states += 1
+            if states > cfg.max_states:
+                report.complete = False
+                break
+            try:
+                model.check_invariants(state)
+                if model.is_terminal(state):
+                    terminals += 1
+                    model.check_terminal(state)
+                    continue
+                succ = _successors(model, state)
+            except _PropertyViolation as v:
+                first = Violation(
+                    v.kind, v.detail,
+                    [dict(s) for s in trace],
+                )
+                break
+            if not succ:
+                blocked = ", ".join(
+                    f"rank {r}@{rs.pc[0]}"
+                    for r, rs in enumerate(state.ranks)
+                    if rs.status == RUN
+                )
+                first = Violation(
+                    "deadlock",
+                    "no rank can step, no frame can arrive, no failure "
+                    f"is detectable ({blocked or 'no live ranks'}; "
+                    f"supervisor {state.sup.status})",
+                    [dict(s) for s in trace],
+                )
+                break
+            for label, nxt in succ:
+                transitions += 1
+                if "rollback" in label["label"]:
+                    rollbacks += 1
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, trace + (tuple(label.items()),)))
+        if order == "dfs":
+            report.states = states
+            report.transitions = transitions
+            report.terminals = terminals
+            report.rollbacks_explored = rollbacks
+        return first
+
+    hit = explore("dfs")
+    if hit is not None:
+        # re-search breadth-first so the reported counterexample is a
+        # MINIMAL interleaving trace (DFS finds deep ones first)
+        minimal = explore("bfs")
+        report.violations.append(minimal or hit)
+    return report
+
+
+# -- Plan Doctor integration ------------------------------------------------
+
+
+def topology_from_runtime(runtime) -> tuple[Exchange, ...]:
+    """Extract the model topology from a lowered runtime's actual
+    exchange graph: one model Exchange per ExchangeNode, with the
+    upstream relation read off the SAME reach masks the wave scheduler
+    partitions with."""
+    xnodes = runtime.scope.exchange_nodes
+    masks = runtime._exchange_reach_masks()
+    out = []
+    for i, xn in enumerate(xnodes):
+        ups = tuple(
+            j
+            for j, other in enumerate(xnodes)
+            if j != i and (masks[other.node_id] >> i) & 1
+        )
+        out.append(Exchange(i, xn.mode, ups))
+    return tuple(out)
+
+
+def check_runtime_mesh(
+    runtime,
+    processes: int,
+    rounds: int = 2,
+    fault_budget: int = 1,
+    max_states: int | None = None,
+    mutate: str | None = None,
+) -> MeshCheckReport:
+    """The Plan Doctor's distributed-safety pass: model-check the
+    *actual lowered plan's* exchange topology at ``processes`` ranks,
+    so a user gets a deadlock/divergence/exactly-once verdict before
+    ever launching a real N-rank mesh."""
+    topology = topology_from_runtime(runtime)
+    if not topology:
+        topology = canonical_topology()
+    cfg = MeshCheckConfig(
+        world=processes,
+        rounds=rounds,
+        fault_budget=fault_budget,
+        topology=topology,
+        mutate=mutate,
+        **(
+            {"max_states": max_states} if max_states is not None else {}
+        ),
+    )
+    return check(cfg)

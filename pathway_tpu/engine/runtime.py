@@ -25,6 +25,15 @@ from pathway_tpu.engine.scope import Scope
 from pathway_tpu.engine.stream import Delta
 from pathway_tpu.internals import faults as _faults
 
+# the mesh protocol's decisions (wave partition, quiesce guard, leg
+# elision, frontier agreement, commit walk) are NOT implemented here:
+# they live in parallel/protocol.py as pure transition functions that
+# this runtime drives through and analysis/meshcheck.py exhaustively
+# model-checks — one shared table, so checker and engine cannot drift
+# (pinned by tests/test_meshcheck.py, like the NBDecision objects of
+# the Plan Doctor)
+from pathway_tpu.parallel import protocol as _proto
+
 
 class _Connector:
     def __init__(self, node: SourceNode, subject, parser):
@@ -228,20 +237,10 @@ class Runtime:
                     mine = (m, xmask)
             if pg.rank == 0:
                 fronts = pg.gather0(("f", seq), mine)
-                live = [
-                    (r, f) for r, f in enumerate(fronts) if f is not None
-                ]
-                if live:
-                    t = min(f[0] for _, f in live)
-                    xmask = 0
-                    contrib = 0
-                    for r, (ft, fm) in live:
-                        if ft == t:
-                            xmask |= fm
-                            contrib |= 1 << r
-                    plan = (t, xmask, contrib)
-                else:
-                    plan = None
+                # frontier agreement is a protocol decision: the shared
+                # transition table (parallel/protocol.py) computes it, so
+                # the model checker explores the identical agreement
+                plan = _proto.lockstep_plan(fronts)
                 pg.bcast0(("f2", seq), plan)
             else:
                 pg.gather0(("f", seq), mine)
@@ -398,26 +397,21 @@ class Runtime:
         remaining = set(xids)
         comms = 0.0
         wave_no = 0
+        # wave partition + quiesce guard are protocol decisions driven
+        # through the shared transition table (parallel/protocol.py) —
+        # the model checker explores these exact functions
         while remaining:
-            wbits = 0
-            for nid in remaining:
-                wbits |= 1 << xi[nid]
+            wbits = _proto.wave_bits(remaining, xi)
             # quiesce local computation feeding a remaining exchange —
             # but a node DOWNSTREAM of a remaining exchange has
             # incomplete inputs until that boundary delivers, so it must
-            # wait for its wave (umask check; topo order holds within
-            # the candidate set: every upstream of a candidate is a
-            # candidate or already stepped)
+            # wait for its wave (umask check inside quiesce_candidates)
             while True:
                 pending_ids = self.pending_times.get(time)
                 cand = (
-                    [
-                        n
-                        for n in pending_ids
-                        if n not in remaining
-                        and masks[n] & wbits
-                        and not umasks[n] & wbits
-                    ]
+                    _proto.quiesce_candidates(
+                        pending_ids, remaining, masks, umasks, wbits
+                    )
                     if pending_ids
                     else []
                 )
@@ -426,13 +420,7 @@ class Runtime:
                 nid = min(cand)
                 pending_ids.discard(nid)
                 self._step_node(time, nid)
-            wave = [
-                nid
-                for nid in sorted(remaining)
-                if not any(
-                    o != nid and masks[o] & (1 << xi[nid]) for o in remaining
-                )
-            ]
+            wave = _proto.wave_partition(remaining, masks, xi)
             wave_no += 1
             t0 = _time.perf_counter()
             self._run_exchange_wave(time, wave_no, wave)
@@ -474,17 +462,17 @@ class Runtime:
         )
         # wave 1 feeds on local pending state only, which the lockstep
         # plan already named: ranks outside the contributor mask hold
-        # provably empty inputs, so their send legs vanish entirely
+        # provably empty inputs, so their send legs vanish entirely.
+        # Which legs exist is a protocol decision (wave_send_targets /
+        # wave_recv_sources mirror each other exactly — an asymmetry is
+        # a deadlock, which is why the model checker owns the predicate)
         contrib = self._exchange_contrib if seq == 1 else None
+        targets = _proto.wave_send_targets(
+            pg.world, pg.rank, gather_only, contrib
+        )
+        stats.on_exchange_elided(pg.world - 1 - len(targets))
         enc_cache: dict = {}  # broadcast sides: encode once, ship world-1x
-        for peer in range(pg.world):
-            if peer == pg.rank:
-                continue
-            if (gather_only and peer != 0) or (
-                contrib is not None and not (contrib >> pg.rank) & 1
-            ):
-                stats.on_exchange_elided(1)
-                continue
+        for peer in targets:
             entries = []
             for nid, _own, sends in prepared:
                 ent = sends.get(peer)
@@ -495,13 +483,9 @@ class Runtime:
             )
         received: dict[int, list] = {nid: [] for nid, _o, _s in prepared}
         wave_dl = pg.op_deadline()  # one deadline for the whole wave
-        for peer in range(pg.world):
-            if peer == pg.rank:
-                continue
-            if (gather_only and pg.rank != 0) or (
-                contrib is not None and not (contrib >> peer) & 1
-            ):
-                continue
+        for peer in _proto.wave_recv_sources(
+            pg.world, pg.rank, gather_only, contrib
+        ):
             for nid, part in pg.recv(peer, tag, deadline=wave_dl):
                 if nid not in received:
                     raise RuntimeError(
@@ -956,19 +940,16 @@ class Runtime:
         total = sum(counts)
         my_off = sum(counts[: pg.rank])
         for i, (conn, deltas) in enumerate(commits):
-            t = base + 2 * (my_off + i)
+            t = _proto.commit_time(base, my_off + i)
             self.stats.on_ingest(conn.name, len(deltas))
             conn.node.accept(t, 0, deltas)
         if total:
-            self.clock = max(self.clock, base + 2 * (total - 1))
+            self.clock = max(self.clock, _proto.commit_time(base, total - 1))
         if total and self._planned_walk_eligible():
-            plan = []
-            off = 0
-            for r, cnt in enumerate(counts):
-                for j in range(cnt):
-                    plan.append((base + 2 * (off + j), xmasks[r][j], 1 << r))
-                off += cnt
-            plan.sort()
+            # the planned walk IS the shared commit_plan transition: every
+            # rank derives the same (time, xmask, owner) sequence from the
+            # gathered round info with zero further control traffic
+            plan = _proto.commit_plan(base, counts, xmasks)
             for t, xmask, contrib in plan:
                 # rank-private stragglers (no exchange downstream) keep
                 # local time order; anything masked waits for the

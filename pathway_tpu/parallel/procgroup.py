@@ -29,7 +29,13 @@ column bytes (exec.cpp nb_encode) plus a small pickled header that names
 the slices present — empty slices ship zero bytes, object/fallback
 slices ride as pickled segments. Receiver threads cap frame sizes at
 PATHWAY_MESH_MAX_FRAME_MB (default 256) so a corrupt length prefix
-raises a clean ConnectionError instead of attempting the allocation.
+raises a clean ConnectionError instead of attempting the allocation,
+and every v2 frame carries a CRC-32 over its header+segments that is
+verified BEFORE the header is unpickled — a corrupted frame (the wire
+fuzz battery in tests/test_native_exchange.py flips/truncates every
+structural region) poisons the link with a clean MeshPeerFailure
+instead of silently mis-routing a slice whose pickled node id decoded
+to a different integer.
 The mesh links trusted peer processes
 of one pipeline (localhost by default, PATHWAY_HOSTS for multi-host);
 it is not an external protocol surface: the listener binds 127.0.0.1
@@ -80,18 +86,28 @@ import struct
 import threading
 import time as _time
 import queue
+import zlib
 from typing import Any
 
 from pathway_tpu.internals.api import Pointer, _value_to_bytes
 from pathway_tpu.internals import faults as _faults
 from pathway_tpu.engine.stream import freeze_value, is_native_batch
 
+# protocol decisions (handshake acceptance, liveness verdicts, the
+# goodbye-vs-crash classification) come from the shared transition table
+# that analysis/meshcheck.py model-checks — see parallel/protocol.py
+from pathway_tpu.parallel import protocol as _proto
+
 _LEN = struct.Struct("<Q")
 # exchange v2 frames: typed columnar buffers instead of pickle. The
 # first payload byte discriminates — pickled frames (protocol 2+) always
 # start with 0x80, so the magic can never collide with a v1 frame.
 _V2_MAGIC = b"PWX2"
-_V2_HEAD = struct.Struct("<I")
+# (head_len, crc32 over head+blobs): the crc gates pickle.loads of the
+# header — without it a single flipped bit inside the pickled node-id
+# table decodes "successfully" to a different exchange id and the slice
+# merges into the wrong boundary (found by the wire fuzz battery)
+_V2_HEAD = struct.Struct("<II")
 # control frames of the fault-tolerance layer: 4-byte payloads that the
 # receiver consumes without queueing (neither collides with pickle's
 # 0x80 first byte nor with PWX2)
@@ -294,13 +310,13 @@ class ProcessGroup:
                         _LEN.unpack(_recv_exact(s, _LEN.size))[0]
                     )
                     nonce_c = _recv_exact(s, 16)
-                    if peer <= self.rank or peer >= self.world:
-                        raise EOFError
-                    if peer_epoch != self.epoch:
-                        # a straggler from a rolled-back epoch (or a rank
-                        # that missed the bump): refuse before any keyed
-                        # output — its MAC would fail anyway (the epoch is
-                        # bound into the MAC input)
+                    if not _proto.hello_accept(
+                        self.rank, self.epoch, self.world, peer, peer_epoch
+                    ):
+                        # bogus rank, or a straggler from a rolled-back
+                        # epoch (or a rank that missed the bump): refuse
+                        # before any keyed output — its MAC would fail
+                        # anyway (the epoch is bound into the MAC input)
                         raise EOFError
                     nonce_s = os.urandom(16)
                     s.sendall(nonce_s)  # challenge only — no keyed output yet
@@ -527,8 +543,8 @@ class ProcessGroup:
     # tuple-path/object-column slices as pickled segments (kind 1), empty
     # slices are elided entirely — the pickled header doubles as the
     # presence map. Layout:
-    #   b"PWX2" | u32 head_len | pickle((tag, [(node_id, kind, size)...]))
-    #   | blob_0 | blob_1 | ...
+    #   b"PWX2" | u32 head_len | u32 crc32(head + blobs)
+    #   | pickle((tag, [(node_id, kind, size)...])) | blob_0 | blob_1 ...
     def send_exchange(
         self, peer: int, tag: Any, entries: list, enc_cache: dict | None = None
     ) -> int:
@@ -573,23 +589,42 @@ class ProcessGroup:
             meta.append((nid, kind, len(blob)))
             blobs.append(blob)
         head = pickle.dumps((tag, meta), protocol=pickle.HIGHEST_PROTOCOL)
+        crc = zlib.crc32(head)
+        for blob in blobs:
+            crc = zlib.crc32(blob, crc)
         payload = b"".join(
-            [_V2_MAGIC, _V2_HEAD.pack(len(head)), head, *blobs]
+            [_V2_MAGIC, _V2_HEAD.pack(len(head), crc), head, *blobs]
         )
         self._send_payload(peer, payload)
         return len(payload)
 
     def _decode_exchange(self, payload: bytes):
         """(tag, [(node_id, part), ...]) from a v2 frame; parts arrive as
-        NativeBatch (columnar) or delta lists (pickled fallback)."""
-        (hlen,) = _V2_HEAD.unpack_from(payload, 4)
+        NativeBatch (columnar) or delta lists (pickled fallback). The
+        frame CRC is verified before ANY byte is unpickled: corruption
+        becomes a clean link error here (the receiver thread wraps this
+        in _MeshError), never a silently mis-routed slice."""
+        hlen, crc = _V2_HEAD.unpack_from(payload, 4)
         off = 4 + _V2_HEAD.size
+        if zlib.crc32(payload[off:]) != crc:
+            raise ValueError(
+                "exchange frame checksum mismatch — frame corrupt"
+            )
+        if hlen > len(payload) - off:
+            raise ValueError("exchange frame header overruns the frame")
         tag, meta = pickle.loads(payload[off:off + hlen])
         off += hlen
         ex = self._pwexec()
         items = []
         view = memoryview(payload)
         for nid, kind, size in meta:
+            if size < 0 or off + size > len(payload):
+                # the crc already rules out corruption; this guards a
+                # buggy sender whose (validly-checksummed) size table
+                # overruns the frame — fail loud, never mis-slice
+                raise ValueError(
+                    "exchange frame segment table overruns the frame"
+                )
             blob = view[off:off + size]
             off += size
             if kind == 0 or kind == 2:
@@ -651,9 +686,14 @@ class ProcessGroup:
                     break
                 except queue.Empty:
                     now = _time.monotonic()
-                    if check_liveness and peer not in self._goodbye:
+                    if check_liveness:
                         idle = now - self._last_seen.get(peer, now)
-                        if idle > self._peer_timeout:
+                        # the liveness verdict is a protocol decision —
+                        # the checker's detection model uses the same one
+                        if _proto.peer_liveness(
+                            idle, self._peer_timeout,
+                            peer in self._goodbye,
+                        ) == "failed":
                             if self.stats is not None:
                                 self.stats.on_mesh_heartbeat_missed()
                             raise MeshPeerFailure(
@@ -671,7 +711,8 @@ class ProcessGroup:
                             f"pending tag {tag!r}"
                         )
         if got is None:
-            if peer in self._goodbye:
+            # goodbye-vs-crash classification: a shared-table decision
+            if _proto.classify_peer_loss(peer in self._goodbye) == "gone":
                 raise MeshPeerGone(
                     f"rank {self.rank}: peer {peer} shut down cleanly "
                     f"(orderly goodbye) while {tag!r} was still pending"

@@ -1,0 +1,283 @@
+"""The mesh protocol's transition table — ONE implementation shared by
+the engine and the model checker.
+
+The reference engine inherits multi-worker correctness from timely
+dataflow's proven progress-tracking protocol (SURVEY §1,
+src/engine/dataflow.rs); our replacement — wave-stepped BSP exchange
+(``PWX2``), heartbeats/timeouts (``PWHB``), goodbye-vs-crash
+classification (``PWBY``), epoch-bound handshakes and supervisor
+rollback — is hand-rolled, so its correctness argument is the
+PR-5 trick applied to concurrency: the protocol's *decisions* live here
+as pure transition functions, the runtime/procgroup/supervisor **drive
+through them** (pinned by tests/test_meshcheck.py the same way
+test_plan_doctor.py pins the shared ``NBDecision`` objects), and
+``analysis/meshcheck.py`` exhaustively model-checks the very same
+functions over all interleavings of N symbolic ranks. A protocol change
+that would make the checker and the engine disagree is impossible by
+construction — there is only one copy of each decision.
+
+Decisions modeled here (callers named per function):
+
+* wave scheduling — which pending exchange boundaries form the next
+  coalesced wave, and which local nodes must quiesce first
+  (``engine/runtime.py _step_exchange_waves``);
+* leg elision — which peers a rank sends to / receives from in a wave
+  (pure-gather legs, wave-1 contributor masks;
+  ``engine/runtime.py _run_exchange_wave``);
+* frontier agreement — the rank-0 master's lockstep plan over gathered
+  frontiers, and the planned commit-timestamp walk of a BSP round
+  (``_step_lockstep`` / ``_bsp_inject_commits``);
+* membership — epoch-bound handshake acceptance
+  (``parallel/procgroup.py`` acceptor/connector);
+* failure detection — peer-liveness verdicts and the goodbye-vs-crash
+  classification of a lost link (``procgroup.recv``);
+* rollback — the supervisor's reap/respawn/give-up decision after an
+  epoch dies (``parallel/supervisor.py``).
+
+This module is deliberately **stdlib-only and import-light**: the
+supervisor is loaded by file path from stdlib-only drivers
+(``scripts/fault_matrix.py``) and pulls this file the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+# a surviving rank that detected a peer failure exits with this code to
+# request a rollback restart; distinct from faults.CRASH_EXIT_CODE (27),
+# which marks an injected crash itself. Defined here (not supervisor.py)
+# so the detection side, the rollback side, and the checker's model all
+# read the same constant.
+MESH_RESTART_EXIT_CODE = 28
+
+
+# -- wave scheduling (engine/runtime.py _step_exchange_waves) --------------
+
+def wave_bits(remaining: Iterable[int], xi: Mapping[int, int]) -> int:
+    """Bitmask (over exchange indices) of the still-unstepped exchange
+    boundaries of the current timestamp."""
+    wbits = 0
+    for nid in remaining:
+        wbits |= 1 << xi[nid]
+    return wbits
+
+
+def quiesce_candidates(
+    pending_ids: Iterable[int],
+    remaining: Iterable[int] | frozenset,
+    masks: Sequence[int],
+    umasks: Sequence[int],
+    wbits: int,
+) -> list[int]:
+    """Local nodes that must run BEFORE the next wave: they feed a
+    remaining exchange (reach-mask hit) but do not themselves sit
+    downstream of one (upstream-mask miss — their inputs are complete).
+    The quiesce guard: a node downstream of a remaining exchange has
+    incomplete inputs until that boundary delivers and must wait for its
+    wave. Topo order holds within the candidate set: every upstream of a
+    candidate is a candidate or already stepped."""
+    remaining = (
+        remaining if isinstance(remaining, (set, frozenset))
+        else set(remaining)
+    )
+    return [
+        n
+        for n in pending_ids
+        if n not in remaining
+        and masks[n] & wbits
+        and not umasks[n] & wbits
+    ]
+
+
+def wave_partition(
+    remaining: Iterable[int], masks: Sequence[int], xi: Mapping[int, int]
+) -> list[int]:
+    """Of the pending exchanges, those with no OTHER pending exchange
+    upstream form the next wave. The pending set is the lockstep-agreed
+    exchange mask (identical on every rank) and upstream-ness is static
+    reachability, so every rank derives the same waves in the same order
+    — the data-plane rendezvous needs no extra control traffic."""
+    rem = sorted(remaining)
+    return [
+        nid
+        for nid in rem
+        if not any(o != nid and masks[o] & (1 << xi[nid]) for o in rem)
+    ]
+
+
+# -- wave leg elision (engine/runtime.py _run_exchange_wave) ---------------
+
+def wave_send_targets(
+    world: int, rank: int, gather_only: bool, contrib: int | None
+) -> list[int]:
+    """Peers this rank ships a wave frame to. Pure-gather waves route to
+    rank 0 only (non-zero peers never receive); a rank outside the
+    wave-1 contributor mask holds provably empty inputs, so ALL its send
+    legs vanish (no frame at all, not an empty frame)."""
+    if contrib is not None and not (contrib >> rank) & 1:
+        return []
+    return [
+        p
+        for p in range(world)
+        if p != rank and not (gather_only and p != 0)
+    ]
+
+
+def wave_recv_sources(
+    world: int, rank: int, gather_only: bool, contrib: int | None
+) -> list[int]:
+    """Peers this rank expects a wave frame FROM — the exact mirror of
+    :func:`wave_send_targets` (every rank derives both sides from the
+    same lockstep state, so a frame is expected iff it is sent; any
+    asymmetry here is a protocol deadlock)."""
+    if gather_only and rank != 0:
+        return []
+    return [
+        p
+        for p in range(world)
+        if p != rank
+        and not (contrib is not None and not (contrib >> p) & 1)
+    ]
+
+
+# -- frontier agreement (engine/runtime.py _step_lockstep) ------------------
+
+def lockstep_plan(
+    fronts: Sequence[tuple[int, int] | None],
+) -> tuple[int, int, int] | None:
+    """The rank-0 clock master's frontier agreement: take the min time
+    over every rank's reported frontier ``(time, xmask)``; the plan is
+    ``(t, union-xmask, contributor-bitmask)`` over exactly the ranks
+    whose frontier is at ``t``. ``None`` = no rank has pending work —
+    the lockstep round ends."""
+    live = [(r, f) for r, f in enumerate(fronts) if f is not None]
+    if not live:
+        return None
+    t = min(f[0] for _, f in live)
+    xmask = 0
+    contrib = 0
+    for r, (ft, fm) in live:
+        if ft == t:
+            xmask |= fm
+            contrib |= 1 << r
+    return (t, xmask, contrib)
+
+
+# -- planned commit-timestamp walk (engine/runtime.py _bsp_inject_commits) --
+
+def commit_time(base: int, offset: int) -> int:
+    """Globally ordered even commit timestamps: rank-major within a BSP
+    round, stride 2 (odd times are reserved for locally minted rows —
+    the error log at clock+1)."""
+    return base + 2 * offset
+
+
+def commit_plan(
+    base: int, counts: Sequence[int], xmasks: Sequence[Sequence[int]]
+) -> list[tuple[int, int, int]]:
+    """The shared plan of one BSP ingest round: every rank knows every
+    commit's globally ordered time, exchange mask and owning rank
+    (``contrib`` = 1 << owner), so eligible graphs walk the round's
+    timestamps with ZERO per-timestamp control round-trips."""
+    plan = []
+    off = 0
+    for r, cnt in enumerate(counts):
+        for j in range(cnt):
+            plan.append((commit_time(base, off + j), xmasks[r][j], 1 << r))
+        off += cnt
+    plan.sort()
+    return plan
+
+
+# -- membership: epoch-bound handshake (parallel/procgroup.py) -------------
+
+def hello_accept(
+    acceptor_rank: int,
+    acceptor_epoch: int,
+    world: int,
+    peer_rank: int,
+    peer_epoch: int,
+) -> bool:
+    """Whether an acceptor admits a connecting peer's hello. Rank must
+    be a higher rank of this world (lower ranks are dialed, not
+    accepted), and the recovery epoch must match exactly: a straggler
+    from a rolled-back epoch can neither join nor be joined by the
+    recovered mesh, so in-flight state of the dead epoch can never leak
+    across a rollback. (The epoch is additionally MAC-bound, so this
+    refusal happens before any keyed output.)"""
+    if peer_rank <= acceptor_rank or peer_rank >= world:
+        return False
+    return peer_epoch == acceptor_epoch
+
+
+# -- failure detection (parallel/procgroup.py recv) ------------------------
+
+def peer_liveness(
+    idle_s: float, peer_timeout_s: float, goodbye: bool
+) -> str:
+    """Liveness verdict for a peer that has sent nothing for ``idle_s``
+    seconds: ``"alive"`` or ``"failed"``. A peer that announced an
+    orderly goodbye is never *failed* (its silence is expected), and a
+    non-positive timeout disables the detector."""
+    if goodbye or peer_timeout_s <= 0:
+        return "alive"
+    return "failed" if idle_s > peer_timeout_s else "alive"
+
+
+def classify_peer_loss(goodbye: bool) -> str:
+    """A lost link is a clean shutdown (``"gone"``) iff the peer shipped
+    its goodbye frame first; otherwise it is a crash (``"crashed"``).
+    Both abort the epoch when traffic was still expected — the
+    classification decides what the failure REPORT says, which is what
+    points the operator's investigation at (or away from) the dead
+    rank."""
+    return "gone" if goodbye else "crashed"
+
+
+# -- rollback: supervisor decision (parallel/supervisor.py) ----------------
+
+def supervisor_decide(
+    codes: Sequence[int], restarts_performed: int, max_restarts: int
+) -> tuple[str, int]:
+    """The supervisor's verdict over a reaped epoch's final exit codes:
+
+    * ``("done", 0)`` — every rank exited cleanly;
+    * ``("rollback", epoch_increment=1)`` — some rank failed and budget
+      remains: reap the set, respawn ALL ranks at epoch+1 from the last
+      committed snapshot cut;
+    * ``("give_up", root_code)`` — budget exhausted; the root cause
+      prefers a failing rank's own exit code over
+      :data:`MESH_RESTART_EXIT_CODE` (survivors merely REPORTING the
+      failure) so an outer orchestrator is not told "retryable rollback
+      request" about a deterministically failing deployment.
+    """
+    if all(c == 0 for c in codes):
+        return ("done", 0)
+    if restarts_performed >= max_restarts:
+        root = next(
+            (c for c in codes if c not in (0, MESH_RESTART_EXIT_CODE)),
+            next((c for c in codes if c != 0), 1),
+        )
+        return ("give_up", root if root else 1)
+    return ("rollback", 1)
+
+
+# -- the transition table ---------------------------------------------------
+# Single source of truth for the anti-drift pins: the engine modules
+# bind their protocol decisions FROM this table at import, and
+# tests/test_meshcheck.py asserts same-object identity between what the
+# runtime drives and what the checker explores.
+TRANSITIONS: dict[str, object] = {
+    "wave_bits": wave_bits,
+    "quiesce_candidates": quiesce_candidates,
+    "wave_partition": wave_partition,
+    "wave_send_targets": wave_send_targets,
+    "wave_recv_sources": wave_recv_sources,
+    "lockstep_plan": lockstep_plan,
+    "commit_time": commit_time,
+    "commit_plan": commit_plan,
+    "hello_accept": hello_accept,
+    "peer_liveness": peer_liveness,
+    "classify_peer_loss": classify_peer_loss,
+    "supervisor_decide": supervisor_decide,
+}

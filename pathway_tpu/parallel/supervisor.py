@@ -65,11 +65,31 @@ import sys
 import time
 from typing import Sequence
 
+# the rollback decision (and the restart exit code it keys on) live in
+# the shared protocol transition table that analysis/meshcheck.py
+# model-checks (parallel/protocol.py). protocol.py is itself
+# stdlib-only, so when THIS module was loaded by file path (the
+# stdlib-light drivers: scripts/fault_matrix.py) it is loaded the same
+# way — never through the package __init__s.
+if __package__:
+    from pathway_tpu.parallel import protocol as _proto
+else:  # pragma: no cover - exercised via scripts/fault_matrix.py
+    import importlib.util as _ilu
+
+    _spec = _ilu.spec_from_file_location(
+        "_pw_mesh_protocol",
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "protocol.py"
+        ),
+    )
+    _proto = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_proto)
+
 # a surviving rank that detected a peer failure exits with this code to
 # request a rollback restart (engine/runtime.py's supervised abort path);
 # distinct from faults.CRASH_EXIT_CODE (27), which marks the injected
 # crash itself
-MESH_RESTART_EXIT_CODE = 28
+MESH_RESTART_EXIT_CODE = _proto.MESH_RESTART_EXIT_CODE
 
 logger = logging.getLogger(__name__)
 
@@ -212,12 +232,9 @@ class MeshSupervisor:
                 self.epoch,
                 self.processes,
             )
-            failed_rc = None
             while True:
                 codes = [p.poll() for p in procs]
-                bad = [c for c in codes if c is not None and c != 0]
-                if bad:
-                    failed_rc = bad[0]
+                if any(c is not None and c != 0 for c in codes):
                     break
                 if all(c == 0 for c in codes):
                     self.history.append([0] * len(procs))
@@ -229,21 +246,23 @@ class MeshSupervisor:
                 time.sleep(self.poll_s)
             codes = self._reap(procs, self.grace_s)
             self.history.append(codes)
-            if self.restarts_performed >= self.max_restarts:
-                # root-cause code: prefer a failing rank's own exit over
-                # MESH_RESTART_EXIT_CODE (survivors merely REPORTING the
-                # failure) — returning 28 here would tell an outer
-                # orchestrator "retryable rollback request" about a
-                # deterministically failing deployment, and which code
-                # surfaced first is a poll-timing race
-                root = next(
-                    (
-                        c
-                        for c in codes
-                        if c not in (0, MESH_RESTART_EXIT_CODE)
-                    ),
-                    failed_rc,
+            # the rollback-vs-give-up verdict over a reaped epoch is a
+            # protocol decision (parallel/protocol.py supervisor_decide,
+            # model-checked by analysis/meshcheck.py): give_up prefers a
+            # failing rank's own exit code over MESH_RESTART_EXIT_CODE
+            # (survivors merely REPORTING the failure) — returning 28
+            # would tell an outer orchestrator "retryable rollback
+            # request" about a deterministically failing deployment
+            verdict, payload = _proto.supervisor_decide(
+                codes, self.restarts_performed, self.max_restarts
+            )
+            if verdict == "done":  # every straggler exited 0 during reap
+                logger.info(
+                    "mesh supervisor: epoch %d finished cleanly",
+                    self.epoch,
                 )
+                return 0
+            if verdict == "give_up":
                 logger.error(
                     "mesh supervisor: epoch %d failed (exit codes %s) "
                     "and the restart budget (%d) is exhausted",
@@ -251,9 +270,9 @@ class MeshSupervisor:
                     codes,
                     self.max_restarts,
                 )
-                return root if root else 1
+                return payload
             self.restarts_performed += 1
-            self.epoch += 1
+            self.epoch += payload
             logger.warning(
                 "mesh supervisor: epoch %d failed (exit codes %s; %d = "
                 "rollback requested) — rolling back to the last committed "

@@ -25,9 +25,12 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 # lanes 1/2 run the tier-1 surface (-m 'not slow'); the slow-marked
 # mesh grid is covered by lane 3's supervisor smoke and the full
 # `python scripts/fault_matrix.py --mesh --mesh-no-nb` sweep
-echo "=== lane 0: native GIL-audit lint (scripts/lint_gil.py) ==="
-# static contract scan over exec.cpp: no Python C-API/refcount calls in
-# GIL-released regions, Fallback-only failures in phase-1 sections
+echo "=== lane 0: native GIL-audit + race-audit lint (scripts/lint_gil.py) ==="
+# static contract scan over the native batteries (exec.cpp, bm25.cpp,
+# hnsw.cpp, fastpath.c): no Python C-API/refcount calls in GIL-released
+# regions, Fallback-only failures in phase-1 sections, and the
+# shared-state race audit over std::thread worker lambdas (writes must
+# be shard-local/atomic/annotated — the static half of lane 6's TSan)
 python scripts/lint_gil.py
 
 echo "=== lane 1: PATHWAY_THREADS=4 (full suite) ==="
@@ -63,5 +66,13 @@ echo "=== lane 5: serving gateway smoke (batching + zero drops) ==="
 # concurrent keep-alive clients: batch occupancy must exceed 1 (request
 # coalescing engaged) and every response must come back correct
 env -u PATHWAY_LANE_PROCESSES python scripts/serve_smoke.py
+
+echo "=== lane 6: ThreadSanitizer native battery ==="
+# rebuilds the native batteries with -fsanitize=thread and re-runs the
+# threaded executor suites under it: the dynamic half of lane 0's race
+# audit (the lint names the shard-local write discipline, TSan checks
+# the actual schedules). Self-skips when g++ lacks TSan support, like
+# lane 4.
+env -u PATHWAY_LANE_PROCESSES ./scripts/sanitize_native.sh tsan
 
 echo "=== all lanes green ==="

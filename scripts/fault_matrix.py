@@ -19,7 +19,7 @@ by primary key — resume then re-reads from scratch, which is the
 documented at-least-once contract, so its audit only forbids loss
 (counts may reach 2 for the journal-replayed prefix).
 
-The MESH grid (``--mesh``; ISSUE 4) runs the 2-rank analogue: a
+The MESH grid (``--mesh``; ISSUE 4) runs the N-rank analogue: a
 partition-aware stateful source on every rank feeds a sharded group-by
 over the TCP mesh under ``OPERATOR_PERSISTING``. Each cell hard-kills
 ONE rank at a ``mesh.rank_kill`` phase (``wave_send`` — slices prepared,
@@ -27,19 +27,31 @@ frames unsent; ``post_snapshot`` — rank snapshot durable, commit marker
 not moved; ``restore`` — mid-restore after the marker tag is agreed) and
 asserts the full recovery contract:
 
-* the victim dies with ``CRASH_EXIT_CODE`` and the SURVIVOR detects the
-  loss and exits ``MESH_RESTART_EXIT_CODE`` within the configured
+* the victim dies with ``CRASH_EXIT_CODE`` and EVERY survivor detects
+  the loss and exits ``MESH_RESTART_EXIT_CODE`` within the configured
   timeouts — no hang, no mid-wave deadlock;
-* the resumed 2-rank run restores the last committed snapshot via the
+* the resumed N-rank run restores the last committed snapshot via the
   ``snapshot_commit`` marker, rewinds connectors to their saved scan
   states, and finishes with final captures **bit-identical** to an
   uninterrupted run (strict exactly-once: every key counted exactly
   once). ``--mesh-no-nb`` re-runs the grid with
   ``PATHWAY_NO_NB_EXCHANGE=1`` to pin the forced-tuple exchange path.
 
+``--mesh-world 4`` (ISSUE 7) widens the grid past the 2-rank minimum:
+phase × victim rank ∈ {0, 1, 3} × {columnar, forced-tuple} — kills and
+resumes a real 4-rank mesh per cell.
+
+``--from-trace FILE`` replays a mesh-verifier counterexample
+(``python -m pathway_tpu.analysis --mesh --json``, or one violation's
+``fault_plan``) as REAL kill-and-resume cells: each crash step of the
+minimal interleaving trace becomes the victim's ``PATHWAY_FAULT_PLAN``
+at the trace's world size — the bridge from the model checker's
+symbolic schedule back to a live mesh.
+
 Usage:
     python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
                                    [--mesh] [--mesh-no-nb] [--mesh-only]
+                                   [--mesh-world N] [--from-trace FILE]
 """
 
 from __future__ import annotations
@@ -205,6 +217,15 @@ MESH_CELLS = [
     ("restore", 1, 1),
 ]
 
+# the 4-rank grid (ISSUE 7): phase × victim rank ∈ {0, 1, 3} — pins
+# kill-and-resume beyond the 2-rank minimum (rank 0 = clock master,
+# rank 1 = a middle rank, rank 3 = the highest/acceptor-only rank)
+MESH_CELLS_4 = [
+    (phase, victim, {"wave_send": 3, "post_snapshot": 2, "restore": 1}[phase])
+    for phase in ("wave_send", "post_snapshot", "restore")
+    for victim in (0, 1, 3)
+]
+
 MESH_SCENARIO = r'''
 import json, os, sys
 sys.path.insert(0, {repo!r})
@@ -294,17 +315,17 @@ pw.run(
 
 
 def _run_mesh_ranks(
-    script, tmp, n_rows, plan, victim, timeout, extra_env=None
+    script, tmp, n_rows, plan, victim, timeout, extra_env=None, world=2
 ):
-    """One 2-rank run; the fault plan (if any) lands in the victim's env
+    """One N-rank run; the fault plan (if any) lands in the victim's env
     only. Returns [(rc, stderr_tail), ...] by rank."""
-    port = _free_port_base(2)
+    port = _free_port_base(world)
     procs = []
-    for rank in range(2):
+    for rank in range(world):
         env = {
             **os.environ,
             "JAX_PLATFORMS": "cpu",
-            "PATHWAY_PROCESSES": "2",
+            "PATHWAY_PROCESSES": str(world),
             "PATHWAY_PROCESS_ID": str(rank),
             "PATHWAY_FIRST_PORT": str(port),
             # survivors self-detect and exit MESH_RESTART_EXIT_CODE
@@ -369,11 +390,17 @@ def run_mesh_cell(
     n_rows: int = 40,
     timeout: float = 180,
     extra_env: dict | None = None,
+    world: int = 2,
+    plan: dict | None = None,
+    label: str | None = None,
+    seed_store: bool = False,
 ) -> CellResult:
-    """One mesh kill-and-resume cycle: victim dies at the phase, the
-    survivor must detect and exit cleanly (no hang), and the resumed
-    2-rank run must produce final captures bit-identical to an
-    uninterrupted run (see module docstring)."""
+    """One mesh kill-and-resume cycle: the victim dies at the phase,
+    EVERY survivor must detect and exit cleanly (no hang), and the
+    resumed N-rank run must produce final captures bit-identical to an
+    uninterrupted run (see module docstring). ``plan`` overrides the
+    victim's fault plan (checker-trace replay); ``phase``/``hit`` then
+    only label the cell."""
     owns_tmp = tmp is None
     if owns_tmp:
         tmpdir = tempfile.TemporaryDirectory(prefix="pw_mesh_fault_")
@@ -381,18 +408,18 @@ def run_mesh_cell(
     script = os.path.join(tmp, "mesh_scenario.py")
     with open(script, "w") as f:
         f.write(MESH_SCENARIO.format(repo=REPO))
-    label = f"mesh.rank_kill/{phase}"
-    mode = f"mesh-r{victim}"
+    label = label or f"mesh.rank_kill/{phase}"
+    mode = f"mesh{world if world != 2 else ''}-r{victim}"
 
     def fail(detail):
         return CellResult(label, mode, hit, False, detail)
 
-    if phase == "restore":
+    if phase == "restore" or seed_store:
         # seed a committed snapshot cut + a crash, so the NEXT start
         # actually restores (and can be killed mid-restore)
         res = _run_mesh_ranks(
             script, tmp, n_rows, _mesh_plan("post_snapshot", 2), victim,
-            timeout, extra_env,
+            timeout, extra_env, world,
         )
         if res[victim][0] != CRASH_EXIT_CODE:
             return fail(
@@ -400,24 +427,27 @@ def run_mesh_cell(
                 f"(wanted {CRASH_EXIT_CODE}); stderr: {res[victim][1]}"
             )
     res = _run_mesh_ranks(
-        script, tmp, n_rows, _mesh_plan(phase, hit), victim, timeout,
-        extra_env,
+        script, tmp, n_rows, plan or _mesh_plan(phase, hit), victim,
+        timeout, extra_env, world,
     )
     if res[victim][0] != CRASH_EXIT_CODE:
         return fail(
             f"kill phase: victim exit {res[victim][0]} (wanted "
             f"{CRASH_EXIT_CODE}); stderr: {res[victim][1]}"
         )
-    survivor = 1 - victim
-    if res[survivor][0] != MESH_RESTART_EXIT_CODE:
-        return fail(
-            f"survivor exit {res[survivor][0]} (wanted "
-            f"{MESH_RESTART_EXIT_CODE}: detected peer loss + clean epoch "
-            f"abort); stderr: {res[survivor][1]}"
-        )
-    res = _run_mesh_ranks(script, tmp, n_rows, None, victim, timeout,
-                          extra_env)
-    if [rc for rc, _ in res] != [0, 0]:
+    for survivor in range(world):
+        if survivor == victim:
+            continue
+        if res[survivor][0] != MESH_RESTART_EXIT_CODE:
+            return fail(
+                f"survivor rank {survivor} exit {res[survivor][0]} "
+                f"(wanted {MESH_RESTART_EXIT_CODE}: detected peer loss "
+                f"+ clean epoch abort); stderr: {res[survivor][1]}"
+            )
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, None, victim, timeout, extra_env, world
+    )
+    if [rc for rc, _ in res] != [0] * world:
         return fail(
             f"resume phase: exits {[rc for rc, _ in res]}; stderr: "
             f"{[e for _, e in res]}"
@@ -441,6 +471,66 @@ def run_mesh_cell(
 
 def expected_counts(n_rows: int) -> dict:
     return {str(k): [1, k * 7] for k in range(n_rows)}
+
+
+def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
+    """Replay mesh-verifier counterexample traces as real grid cells.
+
+    ``path`` is the checker's JSON output (``python -m
+    pathway_tpu.analysis --mesh --json``) or a single violation dict.
+    Every crash step of a violation's minimal trace becomes the
+    victim's ``PATHWAY_FAULT_PLAN`` rule, run at the trace's world
+    size. The trace's schedule SHAPE (phase, victim rank, phase-scoped
+    hit index) is what replays — model rounds and real commit cadence
+    need not align one-to-one, but the kill lands in the same protocol
+    slot, and the cell asserts the full detect/abort/rollback/
+    exactly-once contract around it."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "violations" in doc:
+        world = int(doc.get("world", 2))
+        violations = doc["violations"]
+    else:
+        world = 2
+        violations = [doc] if isinstance(doc, dict) else list(doc)
+    results: list[CellResult] = []
+    for v in violations:
+        plan = v.get("fault_plan")
+        if not plan or not plan.get("rules"):
+            print(
+                f"trace [{v.get('kind', '?')}] has no crash step "
+                "(fault-free counterexample) — nothing to replay"
+            )
+            continue
+        trace = v.get("trace") or []
+        preseeded = bool(trace) and "committed-store" in str(
+            trace[0].get("label", "")
+        )
+        for rule in plan["rules"]:
+            phase = rule.get("phase", "wave_send")
+            victim = int(rule.get("rank", 1))
+            hit = int((rule.get("hits") or [1])[0])
+            single = {
+                "seed": plan.get("seed", 7),
+                "rules": [dict(rule)],
+            }
+            res = run_mesh_cell(
+                phase,
+                victim=victim,
+                hit=hit,
+                timeout=timeout,
+                world=max(2, world),
+                plan=single,
+                label=f"trace[{v.get('kind', '?')}]/{phase}",
+                seed_store=preseeded,
+            )
+            results.append(res)
+            status = "PASS" if res.ok else "FAIL"
+            print(
+                f"{status}  {res.point:<32} mode={res.mode:<9} "
+                f"hit={hit}  {res.detail}"
+            )
+    return results
 
 
 def _run_scenario(script, mode, tmp, n_rows, plan, timeout):
@@ -539,10 +629,28 @@ def main(argv=None) -> int:
         "--mesh-only", action="store_true",
         help="skip the single-process grid",
     )
+    ap.add_argument(
+        "--mesh-world", type=int, default=2, choices=(2, 4),
+        help="mesh grid rank count: 2 (default cells) or 4 "
+        "(phase × victim ∈ {0,1,3})",
+    )
+    ap.add_argument(
+        "--from-trace", default=None, metavar="FILE",
+        help="replay mesh-verifier counterexample traces "
+        "(--mesh --json output) as real kill-and-resume cells",
+    )
     args = ap.parse_args(argv)
     hits = [int(h) for h in args.hits.split(",") if h]
 
     results: list[CellResult] = []
+    if args.from_trace:
+        results.extend(
+            run_trace_cells(args.from_trace, max(args.timeout, 180))
+        )
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
     if not args.mesh_only:
         for point, mode in CELLS:
             for hit in hits:
@@ -561,11 +669,13 @@ def main(argv=None) -> int:
         variants = [("columnar", None)]
         if args.mesh_no_nb:
             variants.append(("tuple", {"PATHWAY_NO_NB_EXCHANGE": "1"}))
+        cells = MESH_CELLS_4 if args.mesh_world == 4 else MESH_CELLS
         for vname, extra_env in variants:
-            for phase, victim, hit in MESH_CELLS:
+            for phase, victim, hit in cells:
                 res = run_mesh_cell(
                     phase, victim=victim, hit=hit,
-                    timeout=max(args.timeout, 180), extra_env=extra_env,
+                    timeout=max(args.timeout, 180 * args.mesh_world // 2),
+                    extra_env=extra_env, world=args.mesh_world,
                 )
                 results.append(res)
                 status = "PASS" if res.ok else "FAIL"

@@ -1,9 +1,11 @@
 #!/usr/bin/env python
-"""Native GIL-audit lint for the C++ executor (ISSUE 5 satellite).
+"""Native GIL-audit + shared-state race lint for the C/C++ batteries
+(ISSUE 5 satellite; race pass + wider default net: ISSUE 7).
 
-Statically scans ``native/exec.cpp`` (and any extra files passed on the
-command line) for the two contract classes the fused-chain executor
-depends on:
+Statically scans the native sources (``native/exec.cpp``,
+``native/bm25.cpp``, ``native/hnsw.cpp``, ``native/fastpath.c`` by
+default; any extra files passed on the command line) for the contract
+classes the fused-chain executor depends on:
 
 1. **GIL-released regions** (between ``Py_BEGIN_ALLOW_THREADS`` and
    ``Py_END_ALLOW_THREADS``): no Python C-API call, no refcount macro, no
@@ -24,8 +26,23 @@ depends on:
    are flagged. Shape/argument validation BEFORE the phase-1 marker is
    exempt by construction.
 
+3. **Shared-state race audit** for the GIL-free shard-parallel regions:
+   every lambda launched on a ``std::thread`` (the executor's worker
+   pools) is scanned for writes to captured state. A write is legal when
+   its root is (a) a local declared inside the lambda (including
+   references bound to a shard-local slot), (b) the worker-index
+   parameter, (c) a captured container subscripted by the worker index
+   (``outs[(size_t)w]`` — the per-shard output slot discipline), or
+   (d) a ``std::atomic`` declared in the enclosing scope. Anything else
+   — a captured scalar accumulated across workers, a shared container
+   mutated without the shard index — is flagged unless the line carries
+   a ``race-audit-ok:`` annotation comment explaining the discipline.
+   This is the static half of the TSan CI lane (ci_lanes.sh lane 6):
+   the lint names the write discipline, the sanitizer checks the
+   dynamic schedule.
+
 Exit code 0 = clean, 1 = findings (printed one per line, file:line).
-Wired into scripts/ci_lanes.sh.
+Wired into scripts/ci_lanes.sh (lane 0).
 """
 
 from __future__ import annotations
@@ -35,7 +52,12 @@ import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_FILES = [os.path.join(REPO, "native", "exec.cpp")]
+DEFAULT_FILES = [
+    os.path.join(REPO, "native", "exec.cpp"),
+    os.path.join(REPO, "native", "bm25.cpp"),
+    os.path.join(REPO, "native", "hnsw.cpp"),
+    os.path.join(REPO, "native", "fastpath.c"),
+]
 
 _ALLOWED_IN_RELEASED = {
     "Py_BEGIN_ALLOW_THREADS",
@@ -256,7 +278,186 @@ def lint_file(path: str) -> list[str]:
                 f"at line {phase1_line}) — phase 1 must fail only via "
                 f"FallbackError"
             )
+
+    # -- pass 3: shared-state race audit ----------------------------------
+    _race_pass(rel, code, comments, findings)
     return findings
+
+
+# -- pass 3: shared-state race audit for std::thread worker lambdas --------
+
+# a declaration introduces a lambda-local name: "TYPE NAME =", "TYPE
+# &NAME =", "auto it = ...", "for (TYPE NAME : ...)" — the two-identifier
+# shape (type token then name) distinguishes it from a plain assignment
+_DECL_RE = re.compile(
+    r"(?:^|[({;]|\bfor\s*\(\s*)\s*"
+    r"(?:const\s+|constexpr\s+|static\s+)*"
+    r"[A-Za-z_][\w:]*(?:\s*<[^<>;]*>)?"     # type (template args allowed)
+    r"(?:\s*::\s*[A-Za-z_]\w*)*"
+    r"(?:\s*[&*])*\s+[&*]*"
+    # declarator list: "view, scratch;" declares BOTH names
+    r"([A-Za-z_]\w*(?:\s*,\s*[&*]*[A-Za-z_]\w*)*)\s*(?:=|;|:|\{|\()"
+)
+_STRUCT_BIND_RE = re.compile(r"auto\s*&?\s*\[([^\]]+)\]\s*=")
+
+# an lvalue chain followed by an assignment/increment: root.member[...] op
+_WRITE_RE = re.compile(
+    r"(?P<lv>[A-Za-z_]\w*"
+    r"(?:\s*(?:\.|->)\s*[A-Za-z_]\w*|\s*\[[^\][]*\])*)"
+    r"\s*(?P<op>\+\+|--|<<=|>>=|[-+*/|&^%]=|=(?![=]))"
+)
+# mutating container calls: root(.member)*.push_back( ... )
+_MUT_CALL_RE = re.compile(
+    r"(?P<root>[A-Za-z_]\w*)"
+    r"(?:\s*(?:\.|->)\s*[A-Za-z_]\w*|\s*\[[^\][]*\])*"
+    r"\s*(?:\.|->)\s*"
+    r"(?:push_back|emplace_back|emplace|insert|erase|clear|resize|"
+    r"reserve|assign|pop_back|append)\s*\("
+)
+# first subscript uses the worker index -> per-shard slot discipline
+_KEYWORDS = {
+    "if", "for", "while", "switch", "return", "else", "do", "sizeof",
+    "new", "delete", "true", "false", "nullptr", "case", "break",
+    "continue", "auto", "const", "static", "constexpr", "throw",
+}
+
+
+def _find_lambda_bodies(code: str) -> list[tuple[str, int, str, str]]:
+    """(name, start_line, first_param_name, body) for EVERY
+    ``auto NAME = [...](...) { ... };`` definition — the same name is
+    commonly re-used for each executor's worker lambda, so every
+    definition is scanned, not just the last."""
+    out: list[tuple[str, int, str, str]] = []
+    for m in re.finditer(
+        r"auto\s+(\w+)\s*=\s*\[[^\]]*\]\s*\(([^)]*)\)", code
+    ):
+        name = m.group(1)
+        params = m.group(2).strip()
+        first_param = ""
+        if params:
+            toks = params.split(",")[0].split()
+            first_param = toks[-1].lstrip("&*") if toks else ""
+        brace = code.find("{", m.end())
+        if brace < 0:
+            continue
+        depth = 0
+        end = brace
+        for i in range(brace, len(code)):
+            c = code[i]
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        body = code[brace + 1:end]
+        start_line = code.count("\n", 0, brace) + 2
+        out.append((name, start_line, first_param, body))
+    return out
+
+
+def _threaded_lambda_names(code: str) -> set[str]:
+    names = set()
+    # launch forms: threads.emplace_back(work, w), std::thread(work, w),
+    # std::thread{work, w}, and the named-variable form
+    # `std::thread t(work, w);`
+    for m in re.finditer(
+        r"(?:\.\s*emplace_back\s*\(|std::thread(?:\s+\w+)?\s*[({])\s*(\w+)",
+        code,
+    ):
+        names.add(m.group(1))
+    return names
+
+
+def _local_names(body: str, first_param: str) -> set[str]:
+    locals_: set[str] = set()
+    if first_param:
+        locals_.add(first_param)
+    for line in body.splitlines():
+        for dm in _DECL_RE.finditer(line):
+            for piece in dm.group(1).split(","):
+                locals_.add(piece.strip().lstrip("&*"))
+        for sb in _STRUCT_BIND_RE.finditer(line):
+            for piece in sb.group(1).split(","):
+                locals_.add(piece.strip().lstrip("&*"))
+    return locals_ - _KEYWORDS
+
+
+def _shard_indexed(lv: str, w: str) -> bool:
+    """True when the lvalue's FIRST subscript is the worker index:
+    ``outs[w]``, ``outs[(size_t)w]``, ``outs[static_cast<size_t>(w)]``."""
+    if not w:
+        return False
+    m = re.match(r"[A-Za-z_]\w*\s*\[([^\]]*)\]", lv)
+    if m is None:
+        return False
+    idx = m.group(1).replace(" ", "")
+    return idx in (
+        w,
+        f"(size_t){w}",
+        f"(std::size_t){w}",
+        f"static_cast<size_t>({w})",
+        f"static_cast<std::size_t>({w})",
+    )
+
+
+def _race_pass(
+    rel: str, code: str, comments: str, findings: list[str]
+) -> None:
+    threaded = _threaded_lambda_names(code)
+    if not threaded:
+        return
+    bodies = _find_lambda_bodies(code)
+    atomics = set(
+        re.findall(r"std::atomic\w*\s*<[^>]*>\s+(\w+)", code)
+    ) | set(re.findall(r"std::atomic_\w+\s+(\w+)", code))
+    comment_lines = comments.splitlines()
+    for name, start_line, w, body in bodies:
+        if name not in threaded:
+            continue
+        locals_ = _local_names(body, w)
+
+        def note(ln: int, what: str, root: str) -> None:
+            mline = (
+                comment_lines[ln - 1] if ln - 1 < len(comment_lines) else ""
+            )
+            prev = (
+                comment_lines[ln - 2] if ln - 2 < len(comment_lines) else ""
+            )
+            if "race-audit-ok" in mline or "race-audit-ok" in prev:
+                return
+            findings.append(
+                f"{rel}:{ln}: {what} to captured {root!r} inside "
+                f"std::thread worker lambda {name!r} (started line "
+                f"{start_line - 1}) — not shard-local (no [{w}] slot), "
+                f"not std::atomic, not lambda-local; racing workers "
+                f"corrupt it (annotate 'race-audit-ok: <why>' if the "
+                f"discipline is provable)"
+            )
+
+        for off, line in enumerate(body.splitlines()):
+            ln = start_line + off
+            for wm in _WRITE_RE.finditer(line):
+                lv = wm.group("lv")
+                root = re.match(r"[A-Za-z_]\w*", lv).group(0)
+                if root in _KEYWORDS or root in locals_:
+                    continue
+                # declaration on this very line (TYPE name = ...):
+                # _DECL_RE already recorded it into locals_ above
+                if _shard_indexed(lv, w) or root in atomics:
+                    continue
+                # `*out = ...` via pointer params etc.: root of a deref
+                # write is the pointee name — treat like the name
+                note(ln, f"write ({wm.group('op').strip()})", root)
+            for cm in _MUT_CALL_RE.finditer(line):
+                root = cm.group("root")
+                if root in _KEYWORDS or root in locals_:
+                    continue
+                full = cm.group(0)
+                if _shard_indexed(full, w) or root in atomics:
+                    continue
+                note(ln, "mutating call", root)
 
 
 def main(argv: list[str]) -> int:

@@ -35,7 +35,14 @@ rm -f "$BUILD/san_probe"
 if [ "$MODE" = "tsan" ]; then
     SAN="-fsanitize=thread"
     RUNTIME=$(gcc -print-file-name=libtsan.so)
-    export TSAN_OPTIONS="report_bugs=1 halt_on_error=1"
+    # allocator_may_return_null: same story as the ASan lane below — the
+    # differential fuzz asks CPython for astronomically large ints, and
+    # CPython's own malloc of that size must return NULL (-> clean
+    # MemoryError) instead of tripping the sanitizer's allocation cap.
+    # The suppressions file silences fd-interceptor noise from the
+    # UNINSTRUMENTED stdlib _socket module (see its comments); the
+    # instrumented native worker threads run unsuppressed.
+    export TSAN_OPTIONS="report_bugs=1 halt_on_error=1 allocator_may_return_null=1 suppressions=$PWD/scripts/tsan_suppressions.txt"
 else
     SAN="-fsanitize=address,undefined -fno-sanitize-recover=undefined"
     RUNTIME=$(gcc -print-file-name=libasan.so)

@@ -1,0 +1,484 @@
+"""Mesh Verifier battery (ISSUE 7): the bounded model checker of the
+wave/rollback protocol, and the anti-drift pins that make its verdicts
+mean something.
+
+Pins:
+* **shared transition table** — engine/runtime.py, parallel/procgroup.py
+  and parallel/supervisor.py drive the SAME function objects
+  (parallel/protocol.py TRANSITIONS) the checker explores: same-object
+  identity, exactly like test_plan_doctor.py pins the shared NBDecision
+  objects. A second implementation of any protocol decision cannot
+  exist without failing here.
+* **protocol self-properties** — the send/recv leg predicates mirror
+  each other exhaustively (an asymmetry IS a deadlock), the commit walk
+  is rank-major/stride-2/sorted, the supervisor verdict prefers root
+  causes over rollback-request codes.
+* **checker smoke (tier-1)** — N=3, small wave depth: the bounded state
+  space is exhausted, zero violations on the shipped protocol, and two
+  runs explore bit-identical state counts.
+* **mutation coverage** — three deliberately broken protocol variants
+  (skip the quiesce guard, accept a dead-epoch hello, drop the rollback
+  retraction) are each caught with a minimal trace whose crash steps
+  load as valid internals/faults.py rules (replayable via
+  ``scripts/fault_matrix.py --from-trace``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from pathway_tpu.analysis import meshcheck as mc
+from pathway_tpu.parallel import protocol as proto
+
+
+# ---------------------------------------------------------------------------
+# anti-drift: one transition table, pinned by object identity
+# ---------------------------------------------------------------------------
+
+
+def test_engine_modules_drive_the_shared_protocol_module():
+    """The runtime, the mesh backend and the supervisor all bind the
+    SAME protocol module object the checker explores — no second copy
+    of any decision exists to drift."""
+    import pathway_tpu.engine.runtime as rt
+    import pathway_tpu.parallel.procgroup as pg
+    import pathway_tpu.parallel.supervisor as sup
+
+    assert rt._proto is proto
+    assert pg._proto is proto
+    assert sup._proto is proto
+    assert mc._proto is proto
+    assert sup.MESH_RESTART_EXIT_CODE == proto.MESH_RESTART_EXIT_CODE == 28
+
+
+def test_checker_transitions_are_the_table_objects():
+    """The checker's default Transitions binds the exact function
+    objects of protocol.TRANSITIONS (which are the module-level
+    functions the engine calls) — flipping one flips both sides, with
+    no second predicate to drift."""
+    t = mc.Transitions()
+    for name in mc.Transitions.NAMES:
+        assert getattr(t, name) is proto.TRANSITIONS[name], name
+        assert proto.TRANSITIONS[name] is getattr(proto, name), name
+
+
+def test_supervisor_loads_protocol_by_file_path_outside_package():
+    """scripts/fault_matrix.py loads supervisor.py by file path to stay
+    import-light; the supervisor must pull protocol.py the same way and
+    expose the same constants."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_t_sup", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.MESH_RESTART_EXIT_CODE == 28
+    codes = [0, 137, 28]
+    assert mod._proto.supervisor_decide(codes, 3, 3) == ("give_up", 137)
+
+
+# ---------------------------------------------------------------------------
+# protocol self-properties (unit checks of the shared table)
+# ---------------------------------------------------------------------------
+
+
+def test_wave_leg_predicates_mirror_exactly():
+    """peer p receives from r iff r sends to p — exhaustively over
+    world<=5, every rank pair, both gather modes, every contributor
+    mask. An asymmetry here is a guaranteed rendezvous deadlock, which
+    is why both sides live in one table."""
+    for world in (2, 3, 5):
+        for gather_only in (False, True):
+            for contrib in [None] + list(range(1, 1 << world)):
+                sends = {
+                    r: set(
+                        proto.wave_send_targets(
+                            world, r, gather_only, contrib
+                        )
+                    )
+                    for r in range(world)
+                }
+                recvs = {
+                    r: set(
+                        proto.wave_recv_sources(
+                            world, r, gather_only, contrib
+                        )
+                    )
+                    for r in range(world)
+                }
+                for r in range(world):
+                    for p in range(world):
+                        if p == r:
+                            continue
+                        assert (p in sends[r]) == (r in recvs[p]), (
+                            world, gather_only, contrib, r, p,
+                        )
+
+
+def test_commit_plan_is_rank_major_stride2_sorted():
+    plan = proto.commit_plan(100, [2, 0, 1], [[3, 3], [], [1]])
+    assert plan == [(100, 3, 1), (102, 3, 1), (104, 1, 4)]
+    assert all(t % 2 == 0 for t, _, _ in plan)
+    assert proto.commit_time(100, 7) == 114
+
+
+def test_lockstep_plan_min_time_and_contributors():
+    assert proto.lockstep_plan([None, None]) is None
+    plan = proto.lockstep_plan([(10, 0b01), None, (10, 0b10), (14, 0b11)])
+    assert plan == (10, 0b11, 0b101)
+
+
+def test_supervisor_decide_root_cause_over_restart_code():
+    d = proto.supervisor_decide
+    assert d([0, 0], 0, 3) == ("done", 0)
+    assert d([28, 27], 0, 3) == ("rollback", 1)
+    # budget exhausted: a real failing code wins over 28 (survivors
+    # merely REPORTING the failure)
+    assert d([28, 27], 3, 3) == ("give_up", 27)
+    assert d([28, 28], 3, 3) == ("give_up", 28)
+
+
+def test_hello_accept_epoch_and_rank_bounds():
+    assert proto.hello_accept(0, 5, 4, 3, 5)
+    assert not proto.hello_accept(0, 5, 4, 3, 4)   # dead epoch
+    assert not proto.hello_accept(2, 5, 4, 1, 5)   # lower ranks dial
+    assert not proto.hello_accept(0, 5, 4, 4, 5)   # out of world
+    assert proto.peer_liveness(99.0, 10.0, False) == "failed"
+    assert proto.peer_liveness(99.0, 10.0, True) == "alive"
+    assert proto.peer_liveness(99.0, 0.0, False) == "alive"
+    assert proto.classify_peer_loss(True) == "gone"
+    assert proto.classify_peer_loss(False) == "crashed"
+
+
+# ---------------------------------------------------------------------------
+# checker smoke: the tier-1 surface of the acceptance criterion
+# ---------------------------------------------------------------------------
+
+
+def test_meshcheck_smoke_3rank_exhaustive_and_clean():
+    """N=3, small wave depth, fault budget 1: the bounded space is
+    exhausted, interleaving counts are reported, the shipped protocol
+    shows zero violations, and rollback recovery paths were actually
+    explored (the verdict is not vacuous)."""
+    rep = mc.check(mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1))
+    assert rep.complete
+    assert rep.ok, rep.render()
+    assert rep.states > 100
+    assert rep.transitions > rep.states
+    assert rep.terminals > 1
+    assert rep.rollbacks_explored > 0  # crashes + recoveries explored
+    d = rep.to_dict()
+    assert d["schema"] == "pathway_tpu.meshcheck/v1"
+    assert d["ok"] and d["complete"] and d["violations"] == []
+
+
+def test_meshcheck_deterministic():
+    a = mc.check(mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1))
+    b = mc.check(mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1))
+    assert (a.states, a.transitions, a.terminals) == (
+        b.states, b.transitions, b.terminals,
+    )
+
+
+def test_meshcheck_faultfree_2_and_4_ranks():
+    for world in (2, 4):
+        rep = mc.check(
+            mc.MeshCheckConfig(
+                world=world, rounds=1, fault_budget=0, straggler=False
+            )
+        )
+        assert rep.ok, rep.render()
+
+
+def test_meshcheck_state_cap_marks_incomplete():
+    rep = mc.check(
+        mc.MeshCheckConfig(
+            world=3, rounds=2, fault_budget=1, max_states=50
+        )
+    )
+    assert not rep.complete
+    assert not rep.ok
+
+
+# ---------------------------------------------------------------------------
+# mutation coverage: the checker can see the bug classes it rules out
+# ---------------------------------------------------------------------------
+
+
+def _validate_fault_plan(plan: dict) -> None:
+    """The trace's crash plan must load as real internals/faults.py
+    rules — that is what makes it replayable by fault_matrix."""
+    from pathway_tpu.internals import faults
+
+    fp = faults.FaultPlan.from_spec(plan)
+    assert fp.rules
+    for rule in fp.rules:
+        assert rule.point == "mesh.rank_kill"
+        assert rule.action == "crash"
+        assert rule.phase in ("wave_send", "post_snapshot", "restore")
+
+
+@pytest.mark.parametrize(
+    "mutant,kinds",
+    [
+        ("skip_quiesce", {"exactly-once", "deadlock", "wave-desync"}),
+        ("accept_dead_epoch", {"dead-epoch-straggler"}),
+        ("drop_rollback_retraction", {"exactly-once"}),
+    ],
+)
+def test_mutant_caught_with_minimal_trace(mutant, kinds):
+    rep = mc.check(
+        mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1, mutate=mutant)
+    )
+    assert rep.violations, f"mutant {mutant} NOT caught"
+    v = rep.violations[0]
+    assert v.kind in kinds, (mutant, v.kind, v.detail)
+    assert v.trace, "violation carries no interleaving trace"
+    plan = v.fault_plan()
+    if plan is not None:
+        _validate_fault_plan(plan)
+    # the mutants that need a crash to surface must ship a replayable
+    # plan; skip_quiesce loses deltas even fault-free
+    if mutant != "skip_quiesce":
+        assert plan is not None
+
+
+def test_skip_quiesce_caught_without_any_fault():
+    """The quiesce-guard mutant is a pure scheduling bug: it must be
+    caught even with a zero fault budget (cascade deltas stranded at
+    the downstream boundary = lost)."""
+    rep = mc.check(
+        mc.MeshCheckConfig(
+            world=3, rounds=1, fault_budget=0, straggler=False,
+            mutate="skip_quiesce",
+        )
+    )
+    assert rep.violations
+    assert rep.violations[0].kind == "exactly-once"
+    assert "lost" in rep.violations[0].detail
+
+
+def test_unknown_mutant_rejected():
+    with pytest.raises(ValueError, match="unknown mutant"):
+        mc.get_transitions("made_up")
+
+
+# ---------------------------------------------------------------------------
+# native race audit (scripts/lint_gil.py pass 3): the static half of
+# the TSan lane must actually see the bug classes it claims to
+# ---------------------------------------------------------------------------
+
+
+_RACY_CPP = r"""
+#include <thread>
+#include <vector>
+#include <atomic>
+static long total;
+static std::atomic<long> atotal;
+void f(int W, std::vector<int> &shared,
+       std::vector<std::vector<int>> &outs)
+{
+    auto work = [&](int w) {
+        int local = 0;
+        std::vector<int> view, scratch;      /* comma declarator list */
+        for (int i = 0; i < 100; i++) {
+            local += i;
+            view.push_back(i);               /* lambda-local: ok */
+            outs[(size_t)w].push_back(i);    /* shard slot: ok */
+            atotal += i;                     /* std::atomic: ok */
+            total += i;                      /* RACE: captured scalar */
+            shared.push_back(i);             /* RACE: shared container */
+            /* race-audit-ok: single-writer by construction (test) */
+            shared[0] = i;
+        }
+        auto &mine = outs[(size_t)w];
+        mine.push_back(local);               /* local ref: ok */
+    };
+    std::thread t0(work, 0);                 /* named-variable launch */
+    std::vector<std::thread> threads;
+    for (int w = 1; w < W; w++)
+        threads.emplace_back(work, w);
+    t0.join();
+    for (auto &t : threads)
+        t.join();
+    (void)scratchless(0);
+}
+"""
+
+
+def test_race_audit_catches_seeded_races_and_honors_escapes(tmp_path):
+    """The shared-state race audit flags exactly the two seeded racing
+    writes — not the lambda-local / shard-slot / atomic writes, and not
+    the `race-audit-ok`-annotated one — and sees lambdas launched via
+    the named-variable `std::thread t(work, 0);` form."""
+    bad = tmp_path / "racy.cpp"
+    bad.write_text(_RACY_CPP.replace("(void)scratchless(0);", ""))
+    lint = os.path.join(REPO, "scripts", "lint_gil.py")
+    res = subprocess.run(
+        [sys.executable, lint, str(bad)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 1, res.stdout
+    findings = [
+        ln for ln in res.stdout.splitlines() if "worker lambda" in ln
+    ]
+    assert len(findings) == 2, res.stdout
+    assert any("'total'" in f for f in findings), res.stdout
+    assert any("'shared'" in f for f in findings), res.stdout
+    for ok_root in ("'view'", "'outs'", "'atotal'", "'mine'", "'local'"):
+        assert not any(ok_root in f for f in findings), res.stdout
+
+
+def test_race_audit_clean_on_disciplined_worker(tmp_path):
+    """A worker that only writes shard slots and locals passes — and a
+    file with no std::thread at all skips the pass entirely."""
+    good = tmp_path / "clean.cpp"
+    good.write_text(
+        "#include <thread>\n#include <vector>\n"
+        "void f(int W, std::vector<std::vector<int>> &outs) {\n"
+        "    auto work = [&](int w) {\n"
+        "        auto &mine = outs[(size_t)w];\n"
+        "        for (int i = 0; i < 9; i++)\n"
+        "            mine.push_back(i);\n"
+        "    };\n"
+        "    std::vector<std::thread> threads;\n"
+        "    for (int w = 0; w < W; w++)\n"
+        "        threads.emplace_back(work, w);\n"
+        "    for (auto &t : threads)\n"
+        "        t.join();\n"
+        "}\n"
+    )
+    lint = os.path.join(REPO, "scripts", "lint_gil.py")
+    res = subprocess.run(
+        [sys.executable, lint, str(good)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout
+
+
+# ---------------------------------------------------------------------------
+# CLI + Plan Doctor integration
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PATHWAY_LANE_PROCESSES", None)
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+
+
+def test_cli_mesh_smoke_and_mutant_exit_codes():
+    res = _run_cli("--mesh", "--processes", "3", "--mesh-rounds", "1")
+    assert res.returncode == 0, res.stderr[-1500:]
+    assert "explored" in res.stdout and "states" in res.stdout
+    assert "no deadlock" in res.stdout
+    res = _run_cli(
+        "--mesh", "--processes", "3", "--mesh-rounds", "1",
+        "--mesh-mutant", "skip_quiesce", "--json",
+    )
+    assert res.returncode == 2, res.stdout[-500:]
+    doc = json.loads(res.stdout)
+    assert doc["schema"] == "pathway_tpu.meshcheck/v1"
+    assert doc["violations"]
+
+
+def test_doctor_mesh_pass_on_multirank_plans(monkeypatch):
+    """pw.analyze(processes=4) runs the checker against the lowered
+    plan's actual exchange topology and reports the distributed-safety
+    verdict; PATHWAY_MESHCHECK_DOCTOR=0 disables the pass."""
+    import pathway_tpu as pw
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str), [("a",), ("b",), ("a",)]
+    )
+    counts = t.groupby(pw.this.data).reduce(c=pw.reducers.count())
+    monkeypatch.setenv("PATHWAY_MESHCHECK_ROUNDS", "1")
+    rep = pw.analyze(counts, processes=4)
+    mesh = [d for d in rep.diagnostics if d.code.startswith("mesh.")]
+    assert len(mesh) == 1 and mesh[0].code == "mesh.verified"
+    assert "4 ranks" in mesh[0].message
+    assert mesh[0].severity == "info"
+    # 1-rank plans never pay for the pass
+    rep1 = pw.analyze(counts, processes=1)
+    assert not [d for d in rep1.diagnostics if d.code.startswith("mesh.")]
+    monkeypatch.setenv("PATHWAY_MESHCHECK_DOCTOR", "0")
+    rep0 = pw.analyze(counts, processes=4)
+    assert not [d for d in rep0.diagnostics if d.code.startswith("mesh.")]
+
+
+def test_doctor_mesh_pass_reports_violations_as_errors(monkeypatch):
+    """A protocol that fails the model check surfaces as an error
+    diagnostic with a replayable fault plan in the hint (exercised via
+    a mutant-driven check of the same plan topology)."""
+    import pathway_tpu as pw
+    from pathway_tpu.analysis import meshcheck
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str), [("a",), ("b",)]
+    )
+    counts = t.groupby(pw.this.data).reduce(c=pw.reducers.count())
+    orig = meshcheck.check_runtime_mesh
+
+    def broken(runtime, **kw):
+        return orig(runtime, mutate="drop_rollback_retraction", **kw)
+
+    monkeypatch.setattr(meshcheck, "check_runtime_mesh", broken)
+    monkeypatch.setenv("PATHWAY_MESHCHECK_ROUNDS", "2")
+    rep = pw.analyze(counts, processes=3)
+    errs = [d for d in rep.diagnostics if d.code.startswith("mesh.")]
+    assert errs and errs[0].severity == "error"
+    assert errs[0].code == "mesh.exactly-once"
+    assert "PATHWAY_FAULT_PLAN" in (errs[0].hint or "")
+
+
+def test_topology_extraction_matches_exchange_graph():
+    """check_runtime_mesh models the plan's REAL exchange nodes: modes
+    and upstream relations read off the same reach masks the wave
+    scheduler uses."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runtime import Runtime
+    from pathway_tpu.internals.config import (
+        pop_config_overlay,
+        push_config_overlay,
+    )
+    from pathway_tpu.internals.graph_runner import GraphRunner
+
+    pw.internals.parse_graph.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(data=str), [("a",), ("b",)]
+    )
+    counts = t.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    pw.io.subscribe(counts, on_change=lambda *a: None)
+    g = pw.internals.parse_graph.G
+    ops = g.reachable_operators(g.output_operators())
+    token = push_config_overlay(processes=3, process_id=0)
+    try:
+        runtime = Runtime(validate_env=False)
+        GraphRunner(g)._lower(ops, runtime)
+    finally:
+        pop_config_overlay(token)
+    topo = mc.topology_from_runtime(runtime)
+    assert len(topo) == len(runtime.scope.exchange_nodes) > 0
+    modes = {x.mode for x in topo}
+    assert modes <= {"hash", "gather", "broadcast"}
+    # a downstream gather must list its upstream hash boundary
+    gathers = [x for x in topo if x.mode == "gather" and x.upstream]
+    hashes = [x for x in topo if x.mode == "hash"]
+    if gathers and hashes:
+        assert any(
+            h.idx in gx.upstream for gx in gathers for h in hashes
+        )

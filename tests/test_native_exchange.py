@@ -406,6 +406,267 @@ def test_exchange_frame_roundtrip_through_mesh():
 
 
 # ---------------------------------------------------------------------------
+# wire-codec robustness battery (ISSUE 7): corrupted PWX2/PWHB/PWBY
+# frames into the procgroup receiver. Contract: every mutation produces
+# a clean ConnectionError / dead-peer sentinel — never a hang, a crash,
+# or a silently mis-decoded frame. (Data-plane payload bytes carry no
+# checksum — that is TCP's job — so the battery corrupts the frame
+# STRUCTURE: length prefixes, magics, header lengths, pickled headers,
+# segment size tables, truncations.)
+# ---------------------------------------------------------------------------
+
+import pickle
+import struct as _struct
+
+_LEN8 = _struct.Struct("<Q")
+
+
+def _raw_frame(pg, peer, payload: bytes, declared_len: int | None = None):
+    """Ship raw bytes to `peer` with a length prefix, bypassing the send
+    path — the receiver-side hardening is the thing under test."""
+    n = len(payload) if declared_len is None else declared_len
+    pg._socks[peer].sendall(_LEN8.pack(n) + payload)
+
+
+def _pwx2_payload(tag=("xw", 1, 1), entries=None, meta=None) -> bytes:
+    """A valid v2 exchange frame built from pickled (kind 1) segments —
+    no native toolchain needed, same framing as send_exchange
+    (PWX2 | u32 head_len | u32 crc32(head+blobs) | head | blobs).
+    ``meta`` overrides the (node_id, kind, size) table — used to build
+    validly-checksummed frames whose size table lies."""
+    import zlib
+
+    entries = entries if entries is not None else [
+        (5, [(i, (f"w{i}", i), 1) for i in range(20)]),
+        (9, [(99, ("x", -1), -1)]),
+    ]
+    blobs = []
+    real_meta = []
+    for nid, deltas in entries:
+        blob = pickle.dumps(list(deltas), protocol=pickle.HIGHEST_PROTOCOL)
+        real_meta.append((nid, 1, len(blob)))
+        blobs.append(blob)
+    head = pickle.dumps(
+        (tag, meta if meta is not None else real_meta),
+        protocol=pickle.HIGHEST_PROTOCOL,
+    )
+    crc = zlib.crc32(head)
+    for blob in blobs:
+        crc = zlib.crc32(blob, crc)
+    return b"".join(
+        [b"PWX2", _struct.pack("<II", len(head), crc), head, *blobs]
+    )
+
+
+def _recv_outcome(pg, peer, tag, timeout_guard=20.0):
+    """recv() under a wall-clock guard: returns ('ok', obj) or
+    ('error', exc). A hang fails the test via the guard."""
+    import time as _t
+
+    start = _t.monotonic()
+    try:
+        obj = pg.recv(peer, tag)
+        out = ("ok", obj)
+    except (ConnectionError, RuntimeError) as exc:
+        out = ("error", exc)
+    assert _t.monotonic() - start < timeout_guard, "receiver hung"
+    return out
+
+
+def test_fuzz_pwx2_bitflips_rejected_by_crc(monkeypatch):
+    """Bit flips ANYWHERE in a v2 frame (magic, header length, crc
+    field, pickled header, segment bytes): the frame CRC must reject
+    every one of them with a clean ConnectionError — this battery is
+    what forced the checksum into the format: without it, a flipped
+    bit inside the pickled node-id table decoded 'successfully' to a
+    different exchange id (slice silently merged into the wrong
+    boundary)."""
+    import random
+
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "10")
+    rng = random.Random(0xC0DEC)
+    payload = _pwx2_payload()
+    # control: the unflipped frame decodes exactly
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        _raw_frame(pg0, 1, payload)
+        kind, got = _recv_outcome(pg1, 0, ("xw", 1, 1))
+        assert kind == "ok"
+        assert [nid for nid, _ in got] == [5, 9]
+    finally:
+        pg0.close()
+        pg1.close()
+    positions = [0, 1, 4, 5, 8, 11] + [
+        rng.randrange(12, len(payload)) for _ in range(14)
+    ]
+    for pos in positions:
+        flipped = bytearray(payload)
+        flipped[pos] ^= 1 << rng.randrange(8)
+        pg0, pg1 = _mesh_pair(_free_port_base(2))
+        try:
+            _raw_frame(pg0, 1, bytes(flipped))
+            kind, got = _recv_outcome(pg1, 0, ("xw", 1, 1))
+            assert kind == "error", (
+                f"flip at byte {pos} decoded silently: {got!r}"
+            )
+            assert isinstance(got, ConnectionError), (pos, got)
+        finally:
+            pg0.close()
+            pg1.close()
+
+
+def test_fuzz_pwx2_truncations(monkeypatch):
+    """Truncated v2 frames: cut mid-magic, mid-header, mid-segment. A
+    self-consistent truncation (prefix matches the short payload) must
+    poison the link cleanly; an EOF mid-frame must land as the
+    dead-peer sentinel."""
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "10")
+    payload = _pwx2_payload()
+    (hlen,) = _struct.unpack_from("<I", payload, 4)
+    cuts = [2, 4, 6, 4 + 4 + hlen - 1, 4 + 4 + hlen, len(payload) - 3]
+    for cut in cuts:
+        pg0, pg1 = _mesh_pair(_free_port_base(2))
+        try:
+            _raw_frame(pg0, 1, payload[:cut])
+            kind, got = _recv_outcome(pg1, 0, ("xw", 1, 1))
+            assert kind == "error", f"cut at {cut} decoded silently"
+            assert isinstance(got, ConnectionError)
+        finally:
+            pg0.close()
+            pg1.close()
+    # EOF mid-frame: prefix declares the full frame, bytes stop short
+    from pathway_tpu.parallel.procgroup import MeshPeerFailure
+
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        _raw_frame(pg0, 1, payload[: len(payload) // 2],
+                   declared_len=len(payload))
+        for s in pg0._socks.values():
+            s.shutdown(socket.SHUT_RDWR)
+        with pytest.raises(MeshPeerFailure):
+            pg1.recv(0, ("xw", 1, 1))
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_fuzz_corrupt_segment_size_table(monkeypatch):
+    """A VALIDLY-CHECKSUMMED v2 header whose size table lies about the
+    shipped blobs (short, long, huge, zero) — the buggy/hostile-sender
+    case the CRC cannot catch — must fail the link cleanly: never index
+    out of the frame or mis-attribute bytes silently."""
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "10")
+    tag = ("xw", 2, 1)
+    entries = [(5, [(1, ("a",), 1)])]
+    blob_len = len(
+        pickle.dumps(entries[0][1], protocol=pickle.HIGHEST_PROTOCOL)
+    )
+    for bad_size in (blob_len - 1, blob_len + 7, 2**31, 0):
+        # the meta= override keeps the CRC valid (computed over the real
+        # blobs) while the size table lies — past the checksum gate, the
+        # segment bounds check / segment decode must reject it
+        payload = _pwx2_payload(
+            tag=tag, entries=entries, meta=[(5, 1, bad_size)]
+        )
+        pg0, pg1 = _mesh_pair(_free_port_base(2))
+        try:
+            _raw_frame(pg0, 1, payload)
+            kind, got = _recv_outcome(pg1, 0, tag)
+            if kind == "ok":
+                pytest.fail(
+                    f"size={bad_size} delivered {got!r} silently"
+                )
+            assert isinstance(got, ConnectionError)
+            assert "checksum" not in str(got), (
+                "lying size table must fail on the segment guards, not "
+                "the checksum — the test frame's CRC is valid"
+            )
+        finally:
+            pg0.close()
+            pg1.close()
+
+
+def test_fuzz_corrupt_control_frames(monkeypatch):
+    """Near-miss PWHB/PWBY magics and corrupt length prefixes: anything
+    that is not exactly a control magic must either fail the link
+    cleanly or be a valid frame — a flipped heartbeat must never be
+    silently treated as one (or worse, queued as data)."""
+    monkeypatch.setenv("PATHWAY_MESH_OP_TIMEOUT_S", "10")
+    for bad in (b"PWHX", b"pwhb", b"PWB\x00", b"PWBYX", b"\x00\x00\x00\x00"):
+        pg0, pg1 = _mesh_pair(_free_port_base(2))
+        try:
+            _raw_frame(pg0, 1, bad)
+            kind, got = _recv_outcome(pg1, 0, "never")
+            assert kind == "error", f"{bad!r} was accepted as {got!r}"
+            assert isinstance(got, ConnectionError)
+        finally:
+            pg0.close()
+            pg1.close()
+    # genuine control frames keep the link healthy: a heartbeat then a
+    # goodbye then real data — data still arrives, then the goodbye
+    # classification fires
+    from pathway_tpu.parallel.procgroup import MeshPeerGone
+
+    pg0, pg1 = _mesh_pair(_free_port_base(2))
+    try:
+        _raw_frame(pg0, 1, b"PWHB")
+        pg0.send(1, "t", 42)
+        assert pg1.recv(0, "t") == 42
+        _raw_frame(pg0, 1, b"PWBY")
+        for s in pg0._socks.values():
+            s.shutdown(socket.SHUT_RDWR)
+        with pytest.raises(MeshPeerGone):
+            pg1.recv(0, "after")
+    finally:
+        pg0.close()
+        pg1.close()
+
+
+def test_fuzz_native_codec_blobs():
+    """nb/deltas wire codecs under structural corruption: truncations at
+    every region boundary and seeded bit flips in the header region must
+    raise ValueError — and any flip that does decode must not change the
+    row count (no silent length mis-decode). Never a crash."""
+    import random
+
+    ex, nb = _mixed_nb()
+    if not hasattr(ex, "deltas_encode"):
+        pytest.skip("native toolchain unavailable")
+    from pathway_tpu.internals.api import Pointer
+
+    rng = random.Random(0xFEED)
+    for enc, dec, n_rows in (
+        (ex.nb_encode(nb), lambda b: ex.nb_decode(b, Pointer), len(nb)),
+        (
+            ex.deltas_encode(
+                [(Pointer(i), (f"w{i}", i, 1.5 * i, None), 1)
+                 for i in range(64)]
+            ),
+            lambda b: ex.deltas_decode(b, Pointer),
+            64,
+        ),
+    ):
+        assert enc is not None
+        for cut in sorted({0, 1, 7, 8, 15, len(enc) // 3, len(enc) - 1}):
+            with pytest.raises(ValueError):
+                dec(enc[:cut])
+        header = min(64, len(enc))
+        for _ in range(24):
+            pos = rng.randrange(header)
+            flipped = bytearray(enc)
+            flipped[pos] ^= 1 << rng.randrange(8)
+            try:
+                out = dec(bytes(flipped))
+            except (ValueError, OverflowError, MemoryError):
+                continue  # clean structural rejection
+            got_n = len(out)
+            assert got_n == n_rows, (
+                f"header flip at byte {pos} silently changed the row "
+                f"count: {got_n} != {n_rows}"
+            )
+
+
+# ---------------------------------------------------------------------------
 # end-to-end: 2-rank vs single-rank bit identity
 # ---------------------------------------------------------------------------
 
