@@ -1033,8 +1033,85 @@ def main_update_model_artifact() -> None:
     )
 
 
+def main_trace() -> None:
+    """``--trace``: run the relational lanes with the flight recorder
+    armed so bench rows can record a per-phase breakdown artifact. The
+    last run's Perfetto trace is kept next to BENCH_full.json
+    (BENCH_trace_relational.json) and a ``trace_profile`` line — the
+    hot-path blame summary (top nodes by self-time with their
+    fused/degraded verdicts, native GIL-free phase totals, event-time
+    lag maxima) — is spliced into the artifact in place. The untraced
+    headline numbers are untouched; the paired overhead lanes live in
+    ``scripts/bench_relational.py --traced-artifact``."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rel_path = os.path.join(repo, "scripts", "bench_relational.py")
+    spec = importlib.util.spec_from_file_location("bench_relational", rel_path)
+    rel = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rel)
+    from pathway_tpu.analysis.profile import profile_trace
+
+    # one traced run PER scenario, each dumped to its own artifact — a
+    # shared path would let the second run overwrite the first and
+    # silently waste it
+    scenarios = {
+        "wordcount": (
+            "BENCH_trace_wordcount.json",
+            lambda: rel._wordcount_once(200_000, 5_000, 2_000),
+        ),
+        "stream_join": (
+            "BENCH_trace_join.json",
+            lambda: rel._join_once(60_000, 300, 2_000),
+        ),
+    }
+    reports = {}
+    artifacts = []
+    try:
+        for name, (fname, run) in scenarios.items():
+            trace_path = os.path.join(repo, fname)
+            os.environ["PATHWAY_TRACE"] = trace_path
+            run()
+            os.environ.pop("PATHWAY_TRACE", None)
+            reports[name] = profile_trace(trace_path, top_k=5)
+            artifacts.append(fname)
+    finally:
+        os.environ.pop("PATHWAY_TRACE", None)
+    first = reports["wordcount"]
+    entry = {
+        "metric": "trace_profile",
+        "value": first["top"][0]["share"] if first["top"] else None,
+        "unit": "top-node self-time share (wordcount)",
+        "artifacts": artifacts,
+        "scenarios": {
+            name: {
+                "wall_s": r["wall_s"],
+                "total_self_s": r["total_self_s"],
+                "native_s": r["native_s"],
+                "lag_max_ms": r["lag_max_ms"],
+                "top": r["top"][:3],
+            }
+            for name, r in reports.items()
+        },
+    }
+    print(json.dumps(entry), flush=True)
+    try:
+        with open(_ARTIFACT_PATH) as f:
+            artifact = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        artifact = []
+    artifact = [
+        e
+        for e in artifact
+        if not (isinstance(e, dict) and e.get("metric") == "trace_profile")
+    ] + [entry]
+    write_artifact_atomic(_ARTIFACT_PATH, artifact)
+
+
 if __name__ == "__main__":
     if "--update-model-artifact" in sys.argv:
         main_update_model_artifact()
+    elif "--trace" in sys.argv:
+        main_trace()
     else:
         main()

@@ -403,6 +403,90 @@ inline void phase_count(GroupStore *s, int64_t n)
     g_phases.rows += n;
 }
 
+/* ---- flight-recorder ring (internals/flight.py) ----------------------
+ * Nanosecond batch timers from the GIL-free regions: each event is a
+ * fixed-size record written into a preallocated per-thread ring buffer
+ * with NO Python C-API calls (scripts/lint_gil.py clean) and no locks.
+ * Slot 0 belongs to whichever thread owns the region entry (the
+ * interpreter thread for serial applies, procgroup receiver threads for
+ * nb_decode); slots 1..N belong to shard workers (worker index + 1).
+ * The Python flight recorder enables the ring via trace_ring_enable()
+ * and drains it between engine steps via trace_ring_drain(); disabled
+ * (the default), the hot paths pay one relaxed atomic load. */
+enum TraceTag : uint16_t {
+    T_GB_APPLY = 1,   /* group-by apply (tuple + nb) */
+    T_JOIN_APPLY = 2, /* delta-join apply (tuple + nb) */
+    T_SHARD_PART = 3, /* columnar exchange partition */
+    T_NB_ENCODE = 4,  /* wire encode */
+    T_NB_DECODE = 5,  /* wire decode (receiver threads) */
+    T_NB_CONCAT = 6,  /* arena-rebased exchange merge */
+};
+
+struct TraceEv {
+    uint64_t t0;
+    uint64_t t1;
+    int64_t rows;
+    uint16_t tag;
+    uint16_t thr;
+};
+
+#define PW_TRACE_RINGS 65 /* slot 0 = region-entry thread, 1..64 workers */
+
+struct TraceRing {
+    std::vector<TraceEv> ev; /* preallocated at enable time */
+    std::atomic<uint64_t> w{0};
+    uint64_t drained = 0; /* reader-only watermark (GIL-held drains) */
+};
+
+std::atomic<int> g_trace_on{0};
+TraceRing g_trace_rings[PW_TRACE_RINGS];
+
+inline bool trace_on()
+{
+    return g_trace_on.load(std::memory_order_relaxed) != 0;
+}
+
+inline uint64_t trace_now_ns()
+{
+    /* steady_clock is CLOCK_MONOTONIC on this toolchain — the same
+     * timebase as Python's time.perf_counter_ns(), so ring events line
+     * up with the engine-side spans without translation */
+    return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+}
+
+/* GIL-free safe: chrono + atomics only. `thr` is the shard worker index
+ * (-1 = the thread that owns the region entry). Writers share a ring
+ * only through the atomic write index, so records never interleave; a
+ * wrap overwrites the oldest event (ring size = the capacity passed to
+ * trace_ring_enable). Lifetime contract: disable only clears the armed
+ * flag — ring storage is NEVER freed while the process may still have
+ * writers in flight (procgroup receiver threads decode frames
+ * asynchronously to engine steps), so a note racing a disable lands in
+ * still-allocated memory and is simply never drained. The only
+ * remaining unsynchronized overlap is a reader scanning a slot that a
+ * writer wraps onto mid-drain, which needs a full ring of writes
+ * within one drain loop; it corrupts at most that one diagnostic
+ * record (durations are clamped >= 0 downstream). */
+inline void trace_note(uint16_t tag, int thr, uint64_t t0, uint64_t t1,
+                       int64_t rows)
+{
+    if (!g_trace_on.load(std::memory_order_acquire))
+        return;
+    TraceRing &r = g_trace_rings[(size_t)((thr + 1) % PW_TRACE_RINGS)];
+    const size_t cap = r.ev.size();
+    if (cap == 0)
+        return;
+    const uint64_t i = r.w.fetch_add(1, std::memory_order_relaxed);
+    TraceEv &e = r.ev[(size_t)(i % cap)];
+    e.t0 = t0;
+    e.t1 = t1;
+    e.rows = rows;
+    e.tag = tag;
+    e.thr = (uint16_t)(thr + 1);
+}
+
 void release_ms(Group &g)
 {
     for (auto &kv : g.ms) {
@@ -1609,17 +1693,29 @@ PyObject *process_batch(PyObject *, PyObject *args)
         };
 
         Py_BEGIN_ALLOW_THREADS
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         if (W > 1 && n >= 2048) {
             std::vector<std::thread> threads;
             threads.reserve((size_t)W);
             for (int w = 0; w < W; w++)
-                threads.emplace_back(work, w);
+                threads.emplace_back(
+                    [&work](int ww) {
+                        const uint64_t t0 =
+                            trace_on() ? trace_now_ns() : 0;
+                        work(ww);
+                        if (t0)
+                            trace_note(T_GB_APPLY, ww, t0,
+                                       trace_now_ns(), -1);
+                    },
+                    w);
             for (auto &t : threads)
                 t.join();
         } else {
             for (int w = 0; w < W; w++)
                 work(w);
         }
+        if (_tr0)
+            trace_note(T_GB_APPLY, -1, _tr0, trace_now_ns(), (int64_t)n);
         Py_END_ALLOW_THREADS
     }
 
@@ -2778,17 +2874,30 @@ PyObject *join_batch(PyObject *, PyObject *args)
 
         size_t total = lx.size() + rx.size();
         Py_BEGIN_ALLOW_THREADS
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         if (W > 1 && total >= 2048) {
             std::vector<std::thread> threads;
             threads.reserve((size_t)W);
             for (int w = 0; w < W; w++)
-                threads.emplace_back(work, w);
+                threads.emplace_back(
+                    [&work](int ww) {
+                        const uint64_t t0 =
+                            trace_on() ? trace_now_ns() : 0;
+                        work(ww);
+                        if (t0)
+                            trace_note(T_JOIN_APPLY, ww, t0,
+                                       trace_now_ns(), -1);
+                    },
+                    w);
             for (auto &t : threads)
                 t.join();
         } else {
             for (int w = 0; w < W; w++)
                 work(w);
         }
+        if (_tr0)
+            trace_note(T_JOIN_APPLY, -1, _tr0, trace_now_ns(),
+                       (int64_t)total);
         Py_END_ALLOW_THREADS
     }
     jphase_add(store, &PhaseStats::apply_s, _t1);
@@ -4116,12 +4225,22 @@ PyObject *join_batch_nb(PyObject *, PyObject *args)
 
         size_t total = lx.size() + rx.size();
         Py_BEGIN_ALLOW_THREADS
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         const bool threaded = W > 1 && total >= 2048;
         if (threaded) {
             std::vector<std::thread> threads;
             threads.reserve((size_t)W);
             for (int w = 0; w < W; w++)
-                threads.emplace_back(work, w);
+                threads.emplace_back(
+                    [&work](int ww) {
+                        const uint64_t t0 =
+                            trace_on() ? trace_now_ns() : 0;
+                        work(ww);
+                        if (t0)
+                            trace_note(T_JOIN_APPLY, ww, t0,
+                                       trace_now_ns(), -1);
+                    },
+                    w);
             for (auto &t : threads)
                 t.join();
         } else {
@@ -4144,6 +4263,9 @@ PyObject *join_batch_nb(PyObject *, PyObject *args)
                     build(w);
             }
         }
+        if (_tr0)
+            trace_note(T_JOIN_APPLY, -1, _tr0, trace_now_ns(),
+                       (int64_t)total);
         Py_END_ALLOW_THREADS
     }
     jphase_add(store, &PhaseStats::apply_s, _t1);
@@ -4587,6 +4709,7 @@ PyObject *shard_partition_nb(PyObject *, PyObject *args)
     }
     Py_BEGIN_ALLOW_THREADS;
     {
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         std::string kb;
         kb.reserve(64);
         for (Py_ssize_t i = 0; i < nb->n; i++) {
@@ -4619,6 +4742,9 @@ PyObject *shard_partition_nb(PyObject *, PyObject *args)
         }
         for (int w = 0; w < world; w++)
             outs[(size_t)w]->n = (Py_ssize_t)outs[(size_t)w]->keys->size();
+        if (_tr0)
+            trace_note(T_SHARD_PART, -1, _tr0, trace_now_ns(),
+                       (int64_t)nb->n);
     }
     Py_END_ALLOW_THREADS;
     PyObject *res = PyList_New(world);
@@ -4684,6 +4810,7 @@ PyObject *nb_encode(PyObject *, PyObject *args)
     char *p = PyBytes_AS_STRING(out);
     Py_BEGIN_ALLOW_THREADS;
     {
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         auto put_u32 = [&](uint32_t v) {
             memcpy(p, &v, 4);
             p += 4;
@@ -4705,6 +4832,9 @@ PyObject *nb_encode(PyObject *, PyObject *args)
                 wire_put(p, col.arena.data(), col.arena.size());
             }
         }
+        if (_tr0)
+            trace_note(T_NB_ENCODE, -1, _tr0, trace_now_ns(),
+                       (int64_t)n);
     }
     Py_END_ALLOW_THREADS;
     return out;
@@ -4740,6 +4870,7 @@ PyObject *nb_decode(PyObject *, PyObject *args)
     {
         bool bad = false;
         Py_BEGIN_ALLOW_THREADS;
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         do {
             if (!need((size_t)n * 16)) {
                 bad = true;
@@ -4783,6 +4914,9 @@ PyObject *nb_decode(PyObject *, PyObject *args)
                         bad = true;
             }
         } while (false);
+        if (_tr0)
+            trace_note(T_NB_DECODE, -1, _tr0, trace_now_ns(),
+                       (int64_t)n);
         Py_END_ALLOW_THREADS;
         if (bad) {
             Py_DECREF(nb);
@@ -5055,14 +5189,21 @@ PyObject *nb_concat(PyObject *, PyObject *args)
         Py_INCREF(srcs[(size_t)j]);
     }
     Py_BEGIN_ALLOW_THREADS;
-    for (Py_ssize_t j = 0; j < k; j++) {
-        NativeBatchObject *src = srcs[(size_t)j];
-        out->keys->insert(out->keys->end(), src->keys->begin(),
-                          src->keys->end());
-        for (int c = 0; c < first->width; c++)
-            nbcol_append((*out->cols)[(size_t)c], (*src->cols)[(size_t)c]);
+    {
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
+        for (Py_ssize_t j = 0; j < k; j++) {
+            NativeBatchObject *src = srcs[(size_t)j];
+            out->keys->insert(out->keys->end(), src->keys->begin(),
+                              src->keys->end());
+            for (int c = 0; c < first->width; c++)
+                nbcol_append((*out->cols)[(size_t)c],
+                             (*src->cols)[(size_t)c]);
+        }
+        out->n = (Py_ssize_t)out->keys->size();
+        if (_tr0)
+            trace_note(T_NB_CONCAT, -1, _tr0, trace_now_ns(),
+                       (int64_t)out->n);
     }
-    out->n = (Py_ssize_t)out->keys->size();
     Py_END_ALLOW_THREADS;
     for (Py_ssize_t j = 0; j < k; j++)
         Py_DECREF(srcs[(size_t)j]);
@@ -5315,17 +5456,29 @@ PyObject *process_batch_nb(PyObject *, PyObject *args)
             }
         };
         Py_BEGIN_ALLOW_THREADS
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
         if (W > 1 && n >= 2048) {
             std::vector<std::thread> threads;
             threads.reserve((size_t)W);
             for (int w = 0; w < W; w++)
-                threads.emplace_back(work, w);
+                threads.emplace_back(
+                    [&work](int ww) {
+                        const uint64_t t0 =
+                            trace_on() ? trace_now_ns() : 0;
+                        work(ww);
+                        if (t0)
+                            trace_note(T_GB_APPLY, ww, t0,
+                                       trace_now_ns(), -1);
+                    },
+                    w);
             for (auto &t : threads)
                 t.join();
         } else {
             for (int w = 0; w < W; w++)
                 work(w);
         }
+        if (_tr0)
+            trace_note(T_GB_APPLY, -1, _tr0, trace_now_ns(), (int64_t)n);
         Py_END_ALLOW_THREADS
     }
 
@@ -5503,6 +5656,95 @@ PyObject *store_phase_stats(PyObject *, PyObject *arg)
         "rows", (long long)s->phases.rows);
 }
 
+/* ---- flight-recorder ring: enable / disable / drain ------------------ */
+
+PyObject *trace_ring_enable(PyObject *, PyObject *args)
+{
+    long cap = 65536;
+    long n_threads = 8;
+    if (!PyArg_ParseTuple(args, "|ll", &cap, &n_threads))
+        return nullptr;
+    if (cap < 16)
+        cap = 16;
+    if (cap > (1 << 24))
+        cap = 1 << 24;
+    if (n_threads < 0)
+        n_threads = 0;
+    if (n_threads > PW_TRACE_RINGS - 1)
+        n_threads = PW_TRACE_RINGS - 1;
+    /* Already armed (another runtime of this process — the emulated
+     * rank lane runs several per process): keep the live buffers.
+     * Touching them under a concurrent writer would be a use-after-
+     * free; the first armer's configuration wins for the overlap. */
+    if (g_trace_on.load(std::memory_order_acquire))
+        Py_RETURN_NONE;
+    /* rings 0..n_threads get capacity; the rest stay empty (writes to
+     * them drop — trace_note's cap==0 check). A ring is allocated
+     * exactly ONCE per process: a straggler note racing the previous
+     * disarm may sit between its cap read and the slot write, so any
+     * reallocation here — even growth — would be a use-after-free with
+     * a stale modulus. The first enable's capacity therefore sticks
+     * for the process lifetime (PATHWAY_TRACE_RING_EVENTS changes need
+     * a fresh process). */
+    for (long k = 0; k < PW_TRACE_RINGS; k++) {
+        TraceRing &r = g_trace_rings[(size_t)k];
+        if (k <= n_threads && r.ev.empty())
+            r.ev.assign((size_t)cap, TraceEv{});
+        r.w.store(0, std::memory_order_release);
+        r.drained = 0;
+    }
+    g_trace_on.store(1, std::memory_order_release);
+    Py_RETURN_NONE;
+}
+
+PyObject *trace_ring_disable(PyObject *, PyObject *)
+{
+    /* flag only — NEVER free the buffers: procgroup receiver threads
+     * may be mid-note (nb_decode runs asynchronously to engine steps),
+     * and a clear()+shrink here would turn that into a use-after-free.
+     * The storage (a few MB, only ever allocated when tracing was
+     * armed) stays until the next enable resizes it. */
+    g_trace_on.store(0, std::memory_order_release);
+    Py_RETURN_NONE;
+}
+
+PyObject *trace_ring_drain(PyObject *, PyObject *)
+{
+    /* GIL-held reader: return events in [drained, wend) as (tag, thr,
+     * t0_ns, t1_ns, rows) and advance the reader-only watermark — the
+     * writer index is never reset, so concurrent writers (receiver
+     * threads) cannot race a reader-side reset. A ring that wrapped
+     * past the watermark yields only its newest `cap` events. */
+    PyObject *out = PyList_New(0);
+    if (out == nullptr)
+        return nullptr;
+    for (size_t k = 0; k < PW_TRACE_RINGS; k++) {
+        TraceRing &r = g_trace_rings[k];
+        const size_t cap = r.ev.size();
+        if (cap == 0)
+            continue;
+        const uint64_t wend = r.w.load(std::memory_order_acquire);
+        uint64_t start = r.drained;
+        if (wend > start + cap)
+            start = wend - cap;
+        for (uint64_t i = start; i < wend; i++) {
+            const TraceEv &e = r.ev[(size_t)(i % cap)];
+            PyObject *t = Py_BuildValue(
+                "(iiKKL)", (int)e.tag, (int)e.thr,
+                (unsigned long long)e.t0, (unsigned long long)e.t1,
+                (long long)e.rows);
+            if (t == nullptr || PyList_Append(out, t) < 0) {
+                Py_XDECREF(t);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            Py_DECREF(t);
+        }
+        r.drained = wend;
+    }
+    return out;
+}
+
 PyMethodDef methods[] = {
     {"wp_new", wp_new, METH_VARARGS,
      "wp_new(cache_size) -> wordpiece memo capsule"},
@@ -5575,6 +5817,14 @@ PyMethodDef methods[] = {
     {"process_batch_nb", process_batch_nb, METH_VARARGS,
      "process_batch_nb(store, nb, g_idxs, arg_idxs, key_fn, error"
      "[, time]) -> deltas (abelian-only fused chain step)"},
+    {"trace_ring_enable", trace_ring_enable, METH_VARARGS,
+     "trace_ring_enable([capacity, n_threads]) — preallocate the "
+     "per-thread flight-recorder rings and arm GIL-free batch timers"},
+    {"trace_ring_disable", trace_ring_disable, METH_NOARGS,
+     "disarm the flight-recorder rings and free their buffers"},
+    {"trace_ring_drain", trace_ring_drain, METH_NOARGS,
+     "trace_ring_drain() -> [(tag, thr, t0_ns, t1_ns, rows)] — drain + "
+     "reset the rings (call between engine steps)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -6,6 +6,14 @@
     python -m pathway_tpu.analysis --mesh [--processes N]
         [--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME]
         [--json] [program.py]
+    python -m pathway_tpu.analysis --profile trace.json [--top K] [--json]
+
+Profile mode (hot-path blame) joins a PATHWAY_TRACE flight-recorder
+trace back onto the plan metadata embedded at dump time — the same
+NBDecision objects the executor gates on — and reports the top-k nodes
+by measured self-time, each with its fused / degraded / row-expanding-
+sink verdict (analysis/profile.py). Exit 0 = valid trace, 2 = schema
+problems.
 
 Doctor options go BEFORE the program path; everything after it is the
 program's own argv (flags included), exactly like ``python script.py``.
@@ -190,6 +198,25 @@ def _analyze_mesh(args) -> int:
     return 0
 
 
+def _analyze_profile(args) -> int:
+    from pathway_tpu.analysis.profile import (
+        profile_trace,
+        render_profile,
+    )
+
+    try:
+        report = profile_trace(args.profile, top_k=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"[ERROR  ] trace.unreadable {args.profile}\n      {exc}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_profile(report))
+    return 0 if report["valid"] else 2
+
+
 def _analyze_bench(args) -> int:
     from pathway_tpu.analysis.bench import BENCH_METRIC_PLANS, bench_verdicts
 
@@ -280,6 +307,16 @@ def main(argv=None) -> int:
         help="with --bench: annotate BENCH_full.json lines with "
              "plan_verdict",
     )
+    parser.add_argument(
+        "--profile", default=None, metavar="TRACE_JSON",
+        help="hot-path blame: profile a PATHWAY_TRACE flight-recorder "
+             "trace — top-k nodes by self-time with fused/degraded/"
+             "row-expanding verdicts",
+    )
+    parser.add_argument(
+        "--top", type=int, default=10,
+        help="with --profile: how many nodes to report (default 10)",
+    )
     args = parser.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # the doctor must DIAGNOSE a broken environment, not crash on it:
@@ -289,6 +326,8 @@ def main(argv=None) -> int:
     from pathway_tpu.analysis.knobs import KnobError
 
     try:
+        if args.profile:
+            return _analyze_profile(args)
         if args.mesh:
             return _analyze_mesh(args)
         if args.bench:

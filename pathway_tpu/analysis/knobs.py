@@ -96,6 +96,20 @@ KNOBS: dict[str, Knob] = {
            "License key (recorded, not enforced in this build)."),
         _k("PATHWAY_MONITORING_SERVER", "str", None,
            "OTLP endpoint for telemetry export."),
+        # -- flight recorder (internals/flight.py) ------------------------
+        _k("PATHWAY_TRACE", "str", None,
+           "Arm the flight recorder and write a Perfetto/Chrome-trace "
+           "JSON to this path (multi-rank runs merge per-rank partials "
+           "into it; feed it to `python -m pathway_tpu.analysis "
+           "--profile`)."),
+        _k("PATHWAY_TRACE_RING_EVENTS", "int", 65536,
+           "Capacity (events per thread) of the native executor's "
+           "GIL-free trace ring buffers.", lo=1024, hi=16_777_216),
+        _k("PATHWAY_TRACE_MAX_EVENTS", "int", 2_000_000,
+           "In-memory event cap of the flight recorder (per rank); a "
+           "long-running traced pipeline keeps the NEWEST events and "
+           "the dump records that the head was capped.", lo=10_000,
+           hi=100_000_000),
         _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
            "Abort the run on the first data error instead of poisoning "
            "rows to ERROR."),

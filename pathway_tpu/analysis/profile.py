@@ -1,0 +1,234 @@
+"""Hot-path blame: join a flight-recorder trace back onto the plan.
+
+``python -m pathway_tpu.analysis --profile trace.json`` turns the Plan
+Doctor's static verdicts into measured ones: the trace's per-node spans
+carry each node's runtime NBDecision verdict (the SAME objects the
+executor gates its columnar paths on — internals/flight.py embeds them
+at dump time), so the profile can say not just "stream_join#7 is 61% of
+self-time" but whether it ran fused, degraded to the tuple path (and
+which expression is to blame), or is a row-expanding sink whose cost is
+materialization, not compute (ROADMAP item 2's `value_incl_capture`
+gap, measured per node).
+
+Also the home of the trace-schema validator shared by the tests and the
+CI trace-smoke lane (scripts/trace_smoke.py): Chrome-trace shape,
+non-negative durations, monotonic per-track timestamps, span nesting.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Any
+
+TOP_K_DEFAULT = 10
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(
+            f"{path}: not a flight-recorder trace (no traceEvents)"
+        )
+    return doc
+
+
+def validate_trace(doc: dict) -> list[str]:
+    """Trace-schema check; returns problems (empty = valid).
+
+    Pins the invariants the tests and the CI smoke lane rely on:
+    * every complete ("X") event carries numeric pid/tid/ts and a
+      non-negative dur;
+    * per (pid, tid) track, timestamps are monotone in file order (the
+      exporter time-sorts, and the merger's clock-offset shift must not
+      reorder a track);
+    * per track, spans nest — a span either contains the next one or is
+      disjoint from it; partial overlap means broken timing. ``native``
+      spans are exempt: ring slot 0 collects duration samples from
+      WHICHEVER thread entered a GIL-free region (main thread encodes
+      while a receiver thread decodes), so its track is a sample stream,
+      not a call stack;
+    * node spans carry the args the profile joins on (node/rows/rep).
+    """
+    problems: list[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    pw = doc.get("pathway", {})
+    if pw.get("schema") != 1:
+        problems.append(f"unknown pathway.schema {pw.get('schema')!r}")
+    last_ts: dict[tuple, float] = {}
+    stacks: dict[tuple, list] = defaultdict(list)
+    eps = 2e-3  # µs: json round-trip slack on span edges
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        if ph == "M":
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append(f"event {i}: non-numeric ts")
+            continue
+        if ts < last_ts.get(key, float("-inf")) - eps:
+            problems.append(
+                f"event {i}: track {key} timestamps not monotonic"
+            )
+        last_ts[key] = ts
+        if ph != "X":
+            continue
+        dur = e.get("dur")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            problems.append(f"event {i}: bad dur {dur!r}")
+            continue
+        if e.get("cat") == "node":
+            args = e.get("args", {})
+            if "node" not in args or "rows" not in args or (
+                "rep" not in args
+            ):
+                problems.append(
+                    f"event {i}: node span missing node/rows/rep args"
+                )
+        if e.get("cat") == "native":
+            continue  # sample stream, not a call stack (see docstring)
+        stack = stacks[key]
+        while stack and ts >= stack[-1][1] - eps:
+            stack.pop()
+        if stack and ts + dur > stack[-1][1] + eps:
+            problems.append(
+                f"event {i}: span ({ts}, +{dur}) partially overlaps an "
+                f"enclosing span on track {key}"
+            )
+        stack.append((ts, ts + dur))
+    return problems
+
+
+def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
+    """Aggregate the trace per node (across ranks) and join the plan
+    metadata. Returns the report dict (render_profile prints it)."""
+    doc = load_trace(path)
+    problems = validate_trace(doc)
+    meta = doc.get("pathway", {}).get("nodes", {})
+    agg: dict[int, dict] = {}
+    wall_per_pid: dict[int, float] = defaultdict(float)
+    native_s: dict[str, float] = defaultdict(float)
+    lag_max: dict[str, float] = {}
+    waves = 0
+    wave_s = 0.0
+    for e in doc["traceEvents"]:
+        cat = e.get("cat")
+        if cat == "node":
+            # malformed node events were already reported by
+            # validate_trace; skipping them here keeps the CLI on its
+            # documented exit-2 path instead of a KeyError traceback
+            args = e.get("args") or {}
+            nid = args.get("node")
+            if nid is None:
+                continue
+            a = agg.setdefault(
+                nid,
+                {"self_s": 0.0, "rows": 0, "batches": 0, "nb_batches": 0},
+            )
+            a["self_s"] += e.get("dur", 0.0) / 1e6
+            a["rows"] += max(0, args.get("rows", 0))
+            a["batches"] += 1
+            if args.get("rep") == "nb":
+                a["nb_batches"] += 1
+        elif cat == "step":
+            wall_per_pid[e.get("pid", 0)] += e.get("dur", 0.0) / 1e6
+        elif cat == "native":
+            # region-entry spans only (tid 100): with PATHWAY_THREADS>1
+            # the per-worker sub-spans (tid 101+) run INSIDE the entry
+            # span — summing both would double-count the phase wall time
+            if e.get("tid") == 100:
+                native_s[e.get("name", "?")] += e.get("dur", 0.0) / 1e6
+        elif cat == "wave":
+            waves += 1
+            wave_s += e.get("dur", 0.0) / 1e6
+        elif cat == "lag":
+            name = e.get("name", "?")
+            lag = e.get("args", {}).get("lag_ms", 0.0)
+            lag_max[name] = max(lag_max.get(name, 0.0), lag)
+    total_self = sum(a["self_s"] for a in agg.values()) or 1e-12
+    rows_out = []
+    for nid, a in agg.items():
+        m = meta.get(str(nid), {})
+        verdict = m.get("verdict")
+        tuple_batches = a["batches"] - a["nb_batches"]
+        if m.get("row_expanding"):
+            measured = "row-expanding sink"
+        elif verdict == "fused" and tuple_batches == 0 and a["batches"]:
+            measured = "fused"
+        elif verdict == "fused":
+            # the static verdict said fused but batches executed on the
+            # tuple path: a MEASURED degradation the static pass missed
+            measured = (
+                f"degraded at runtime ({tuple_batches}/{a['batches']} "
+                "tuple batches)"
+            )
+        elif verdict == "degraded":
+            measured = "degraded"
+        else:
+            measured = "no fused path"
+        rows_out.append(
+            {
+                "node": nid,
+                "label": m.get("label", f"node#{nid}"),
+                "provenance": m.get("provenance"),
+                "self_s": round(a["self_s"], 6),
+                "share": round(a["self_s"] / total_self, 4),
+                "rows": a["rows"],
+                "batches": a["batches"],
+                "nb_batches": a["nb_batches"],
+                "verdict": measured,
+                **({"blame": m["blame"]} if m.get("blame") else {}),
+            }
+        )
+    rows_out.sort(key=lambda r: r["self_s"], reverse=True)
+    return {
+        "path": path,
+        "valid": not problems,
+        "problems": problems,
+        "ranks": doc.get("pathway", {}).get("merged_ranks", [0]),
+        "wall_s": round(max(wall_per_pid.values(), default=0.0), 6),
+        "total_self_s": round(total_self, 6),
+        "waves": waves,
+        "wave_s": round(wave_s, 6),
+        "native_s": {k: round(v, 6) for k, v in sorted(native_s.items())},
+        "lag_max_ms": {k: round(v, 3) for k, v in sorted(lag_max.items())},
+        "top": rows_out[:top_k],
+    }
+
+
+def render_profile(report: dict) -> str:
+    lines = [
+        f"flight-recorder profile: {report['path']}",
+        f"  ranks {report['ranks']}  wall {report['wall_s']:.3f}s  "
+        f"node self-time {report['total_self_s']:.3f}s  "
+        f"waves {report['waves']} ({report['wave_s']:.3f}s)",
+    ]
+    if report["problems"]:
+        lines.append("  SCHEMA PROBLEMS:")
+        lines.extend(f"    {p}" for p in report["problems"][:10])
+    lines.append("  top nodes by self-time:")
+    for r in report["top"]:
+        prov = f"  [{r['provenance']}]" if r.get("provenance") else ""
+        lines.append(
+            f"    {r['share']:>6.1%}  {r['self_s']:>9.4f}s  "
+            f"{r['label']:<24} rows={r['rows']:<9} "
+            f"nb={r['nb_batches']}/{r['batches']}  {r['verdict']}{prov}"
+        )
+        for b in r.get("blame", ()):
+            lines.append(f"            blame: {b}")
+    if report["native_s"]:
+        native = "  ".join(
+            f"{k}={v:.4f}s" for k, v in report["native_s"].items()
+        )
+        lines.append(f"  native (GIL-free): {native}")
+    if report["lag_max_ms"]:
+        lag = "  ".join(
+            f"{k.replace('freshness ', '')}={v:g}ms"
+            for k, v in report["lag_max_ms"].items()
+        )
+        lines.append(f"  event-time lag (max): {lag}")
+    return "\n".join(lines)

@@ -1888,6 +1888,10 @@ class OutputNode(Node):
         if deltas:
             self._seen_time = True
             self.scope.runtime.stats.on_output(len(deltas))
+            # event-time lag watermark: commit→emit freshness against
+            # the connector's flush-time ingest stamp (flight recorder +
+            # OpenMetrics output_lag_ms histogram)
+            self.scope.runtime.note_output_emit(self, time, len(deltas))
             if self._on_batch is not None:
                 self._on_batch(time, deltas)
             if self._on_change is not None:
@@ -1977,9 +1981,14 @@ class CaptureNode(Node):
 
     def process(self, time, batches):
         if is_native_batch(batches[0]):
+            self.scope.runtime.note_output_emit(
+                self, time, len(batches[0])
+            )
             self._pending.append((batches[0], time))
             return []
         deltas = consolidate(batches[0])
+        if deltas:
+            self.scope.runtime.note_output_emit(self, time, len(deltas))
         # tuple deltas (e.g. retractions) must land AFTER buffered
         # columnar chunks: expand those first, in arrival order
         if self._pending:

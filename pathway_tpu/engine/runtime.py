@@ -22,7 +22,7 @@ from typing import Any, Callable
 
 from pathway_tpu.engine.nodes import Node, SourceNode
 from pathway_tpu.engine.scope import Scope
-from pathway_tpu.engine.stream import Delta
+from pathway_tpu.engine.stream import Delta, is_native_batch
 from pathway_tpu.internals import faults as _faults
 
 # the mesh protocol's decisions (wave partition, quiesce guard, leg
@@ -121,6 +121,20 @@ class Runtime:
         from pathway_tpu.internals.monitoring import ProberStats
 
         self.stats = ProberStats()
+        # flight recorder (internals/flight.py): armed by PATHWAY_TRACE,
+        # None otherwise. _prof additionally turns on the cheap per-node
+        # self-time/rows aggregation that feeds the OpenMetrics node
+        # gauges whenever anything is watching (recorder or /metrics).
+        from pathway_tpu.internals.flight import FlightRecorder
+
+        self.recorder = FlightRecorder.from_env(local_only=local_only)
+        self._prof = self.recorder is not None or with_http_server
+        self._node_labels: list[str] | None = None
+        # event-time lag watermarks: commit timestamp -> earliest ingest
+        # stamp (perf_counter_ns at connector flush); sinks report
+        # commit→emit freshness against it (note_output_emit)
+        self._ingest_ns: dict[int, int] = {}
+        self.trace_summary: dict | None = None
         # multi-process (PATHWAY_PROCESSES>1): TCP mesh + lockstep state
         self._procgroup = None
         self._lockstep_seq = 0
@@ -158,6 +172,16 @@ class Runtime:
             # mesh health lands on this rank's OpenMetrics endpoint
             # (heartbeat misses are counted by procgroup's own threads)
             self._procgroup.stats = self.stats
+            # mesh events (decode spans, heartbeat marks) ride the same
+            # recorder; procgroup guards every note on it being set
+            self._procgroup.recorder = self.recorder
+            if self.recorder is not None:
+                self.recorder.note_mark(
+                    "mesh_join",
+                    rank=self._procgroup.rank,
+                    world=self._procgroup.world,
+                    epoch=self._procgroup.epoch,
+                )
             if self._procgroup.epoch > 0:
                 # this incarnation exists because a supervisor rolled the
                 # mesh back: count the restart on the recovery path
@@ -318,9 +342,68 @@ class Runtime:
         for child, port in node.downstream:
             child.accept(time, port, deltas)
 
+    def _node_label(self, nid: int) -> str:
+        labels = self._node_labels
+        if labels is None or len(labels) != len(self.scope.nodes):
+            labels = self._node_labels = [
+                f"{type(n).__name__}#{i}"
+                for i, n in enumerate(self.scope.nodes)
+            ]
+        return labels[nid]
+
+    def note_output_emit(self, node, time: int, rows: int) -> None:
+        """Sink-side half of the event-time lag watermark: freshness =
+        emit time minus the commit's earliest connector ingest stamp.
+        Lands on the OpenMetrics output_lag_ms histogram (and the trace
+        as a Perfetto counter track when the recorder is armed)."""
+        ing = self._ingest_ns.get(time)
+        if ing is None:
+            return
+        now = _time.perf_counter_ns()
+        lag_ms = max(0.0, (now - ing) / 1e6)
+        label = self._node_label(node.node_id)
+        self.stats.on_output_lag(label, lag_ms)
+        rec = self.recorder
+        if rec is not None:
+            rec.note_lag(label, time, now, lag_ms, rows)
+
+    def _note_ingest(self, t: int, conn) -> None:
+        """Adopt the connector's flush-time ingest stamp for commit `t`
+        (io/_connector.py appends one per queue entry); a commit with no
+        stamp (journal replay, static injection) freshens from engine
+        admission instead."""
+        try:
+            ns = conn._ingest_ns.popleft()
+        except (AttributeError, IndexError):
+            ns = _time.perf_counter_ns()
+        prev = self._ingest_ns.get(t)
+        if prev is None or ns < prev:
+            self._ingest_ns[t] = ns
+
     def _step_node(self, time: int, nid: int) -> None:
         node = self.scope.nodes[nid]
         batches = node.take(time)
+        if not self._prof:
+            self._process_node(node, time, batches)
+            return
+        rows = 0
+        for b in batches:
+            try:
+                rows += len(b)
+            except TypeError:
+                pass
+        nb = bool(batches) and is_native_batch(batches[0])
+        t0 = _time.perf_counter_ns()
+        self._process_node(node, time, batches)
+        t1 = _time.perf_counter_ns()
+        self.stats.on_node_step(
+            self._node_label(nid), (t1 - t0) / 1e9, rows, nb
+        )
+        rec = self.recorder
+        if rec is not None:
+            rec.note_node(nid, time, t0, t1, rows, nb)
+
+    def _process_node(self, node: Node, time: int, batches) -> None:
         try:
             out = node.process(time, batches)
         except Exception as exc:
@@ -351,6 +434,8 @@ class Runtime:
         boundary — then the generic loop drains whatever remains."""
         _faults.fault_point("runtime.step")
         nodes = self.scope.nodes
+        rec = self.recorder
+        t_step0 = _time.perf_counter_ns() if rec is not None else 0
         xids: list[int] = []
         if self.scope.exchange_nodes and self._procgroup is not None:
             pend = self.pending_times.get(time)
@@ -376,6 +461,12 @@ class Runtime:
         self.pending_times.pop(time, None)
         for node in nodes:
             node.on_time_end(time)
+        self._ingest_ns.pop(time, None)
+        if rec is not None:
+            rec.note_step(time, t_step0, _time.perf_counter_ns())
+            # keep the native ring from wrapping on long runs: pull its
+            # buffered GIL-free timers after every step
+            rec.drain_native()
 
     def _step_exchange_waves(self, time: int, xids: list[int]) -> float:
         """Step the timestamp's exchange boundaries as coalesced waves.
@@ -438,6 +529,8 @@ class Runtime:
         pg = self.procgroup
         nodes = self.scope.nodes
         stats = self.stats
+        rec = self.recorder
+        t_wave0 = _time.perf_counter_ns() if rec is not None else 0
         pend = self.pending_times.get(time)
         prepared = []
         for nid in wave:
@@ -478,14 +571,19 @@ class Runtime:
                 ent = sends.get(peer)
                 if ent is not None:
                     entries.append((nid, ent))
-            stats.on_exchange_frame(
-                pg.send_exchange(peer, tag, entries, enc_cache)
-            )
+            t_send0 = _time.perf_counter_ns() if rec is not None else 0
+            nbytes = pg.send_exchange(peer, tag, entries, enc_cache)
+            stats.on_exchange_frame(nbytes)
+            if rec is not None:
+                rec.note_send(
+                    peer, t_send0, _time.perf_counter_ns(), nbytes
+                )
         received: dict[int, list] = {nid: [] for nid, _o, _s in prepared}
         wave_dl = pg.op_deadline()  # one deadline for the whole wave
         for peer in _proto.wave_recv_sources(
             pg.world, pg.rank, gather_only, contrib
         ):
+            t_recv0 = _time.perf_counter_ns() if rec is not None else 0
             for nid, part in pg.recv(peer, tag, deadline=wave_dl):
                 if nid not in received:
                     raise RuntimeError(
@@ -494,11 +592,19 @@ class Runtime:
                         f"time {time}"
                     )
                 received[nid].append(part)
+            if rec is not None:
+                rec.note_recv_wait(
+                    peer, t_recv0, _time.perf_counter_ns()
+                )
         for nid, own, _sends in prepared:
             node = nodes[nid]
             out = node.finish_exchange(own, received[nid])
             if out:
                 self._deliver(node, time, out)
+        if rec is not None:
+            rec.note_wave(
+                time, seq, t_wave0, _time.perf_counter_ns(), len(wave)
+            )
 
     def _finish(self) -> None:
         # stop the live dashboard first: its loop removes the log handler
@@ -534,6 +640,8 @@ class Runtime:
                     self._step_time(self._min_pending())
         for node in self.scope.nodes:
             node.on_end()
+        if self.recorder is not None:
+            self._finalize_trace()
         if self._procgroup is not None:
             self._procgroup.close()
             self._procgroup = None
@@ -541,8 +649,86 @@ class Runtime:
             self._async_loop.close()
             self._async_loop = None
 
+    def _finalize_trace(self) -> None:
+        """Shutdown half of the flight recorder: dump this rank's trace,
+        rendezvous the mesh so rank 0 merges after every partial is on
+        disk, and leave the per-node OTLP span export for the graph
+        runner's telemetry drain. Runs once (the recorder detaches) and
+        never takes the pipeline down."""
+        rec, self.recorder = self.recorder, None
+        if rec is None or rec.dumped:
+            return
+        try:
+            rec.drain_native()
+            rec.disarm_native_ring()
+            pg = self._procgroup
+            path = None
+            if rec.world > 1:
+                rec.dump_partial(self.scope)
+                if pg is not None:
+                    # all partials durable before rank 0 merges
+                    pg.gather0(("tracewr",), True)
+                    if pg.rank == 0:
+                        path = rec.merge(self.scope)
+                    pg.bcast0(("tracewr2",), path if pg.rank == 0 else None)
+                elif rec.rank == 0:
+                    # no mesh formed (static local run under a
+                    # multi-process config): merge whatever exists
+                    path = rec.merge(self.scope)
+            else:
+                path = rec.dump(self.scope)
+            self.trace_summary = {
+                "path": path,
+                "node_spans": rec.otlp_node_spans(self.scope),
+            }
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "flight-recorder trace export failed", exc_info=True
+            )
+
+    def _abort_trace(self, exc: BaseException) -> None:
+        """Epoch-abort half: mark the rollback and flush this rank's
+        partial so post-mortem traces survive the supervised exit (the
+        supervisor's fallback merge picks the partials up)."""
+        rec, self.recorder = self.recorder, None
+        if rec is None or rec.dumped:
+            return
+        try:
+            rec.note_mark("rollback", error=repr(exc))
+            rec.drain_native()
+            rec.disarm_native_ring()
+            if rec.world > 1:
+                rec.dump_partial(self.scope)
+            else:
+                rec.dump(self.scope)
+        except Exception:
+            pass
+
+    def _trace_clock_sync(self, pg) -> None:
+        """Sample cross-rank clock offsets during the epoch's clock
+        handshake: rank 0 broadcasts its monotonic-ns reading, every
+        peer records the offset onto its own timebase, and the trace
+        merger shifts each rank's track by it. Loopback meshes see
+        sub-ms skew (send latency); the knob is shared by every rank,
+        so all of them join this round or none do."""
+        rec = self.recorder
+        if rec is None:
+            return
+        if pg.rank == 0:
+            pg.bcast0(("tsync",), _time.perf_counter_ns())
+            rec.clock_offset_ns = 0
+        else:
+            remote = pg.bcast0(("tsync",))
+            rec.clock_offset_ns = remote - _time.perf_counter_ns()
+
     def _inject_static(self) -> None:
         t = self._next_time()
+        if self.static_data:
+            # static rows freshen from injection: commit→emit still
+            # yields a meaningful watermark for program-embedded data
+            self._ingest_ns.setdefault(t, _time.perf_counter_ns())
         for node, deltas in self.static_data:
             if deltas:
                 node.accept(t, 0, deltas)
@@ -566,6 +752,7 @@ class Runtime:
             if self.procgroup.rank == 0:
                 self._inject_static()
             self.clock = self.procgroup.bcast0(("clk",), self.clock)
+            self._trace_clock_sync(self.procgroup)
             self._step_lockstep(None)
             self._finish()
             return
@@ -576,6 +763,8 @@ class Runtime:
         self._finish()
 
     def run(self) -> None:
+        if self.recorder is not None:
+            self.recorder.arm_native_ring()
         try:
             if not self.connectors:
                 self.run_static()
@@ -607,6 +796,9 @@ class Runtime:
                 # embedded/unsupervised runs whose stats object outlives
                 # the abort still observe it
                 self.stats.on_mesh_rollback()
+                # flush this rank's trace partial with the rollback mark
+                # before the supervised exit discards the process
+                self._abort_trace(exc)
                 self._maybe_exit_for_rollback(exc)
             raise
 
@@ -824,6 +1016,7 @@ class Runtime:
                     saw_data = True
                     t = self._next_time()
                     self.stats.on_ingest(conn.name, len(deltas))
+                    self._note_ingest(t, conn)
                     conn.node.accept(t, 0, deltas)
             # step strictly in time order, re-reading pending_times each
             # round: stepping may schedule NEW times (forget-immediately
@@ -942,6 +1135,7 @@ class Runtime:
         for i, (conn, deltas) in enumerate(commits):
             t = _proto.commit_time(base, my_off + i)
             self.stats.on_ingest(conn.name, len(deltas))
+            self._note_ingest(t, conn)
             conn.node.accept(t, 0, deltas)
         if total:
             self.clock = max(self.clock, _proto.commit_time(base, total - 1))
@@ -1066,6 +1260,10 @@ class Runtime:
             self._restore_conn_state(conn, subject_states.get(conn.name))
         # the committed cut this epoch resumed from (OpenMetrics gauge)
         self.stats.on_mesh_epoch_committed(pg.epoch)
+        if self.recorder is not None:
+            self.recorder.note_mark(
+                "epoch_restore", epoch=pg.epoch, tag=tag
+            )
 
     def _save_operator_snapshot_distributed(self, pg, round_no) -> None:
         """Two-phase consistent cut: every rank writes its rank-local
@@ -1088,6 +1286,10 @@ class Runtime:
             self.persistence.write_marker("snapshot_commit", tag)
         pg.barrier(("snapbar", tag))
         self.stats.on_mesh_epoch_committed(pg.epoch)
+        if self.recorder is not None:
+            self.recorder.note_mark(
+                "epoch_commit", epoch=pg.epoch, tag=tag
+            )
         # prune superseded snapshots for this rank (best-effort), but
         # retain the LAST TWO committed tags: a peer crashing between its
         # restore-read of the marker and this prune must still find the
@@ -1121,6 +1323,7 @@ class Runtime:
         if pg.rank == 0:
             self._inject_static()
         self.clock = pg.bcast0(("clk",), self.clock)
+        self._trace_clock_sync(pg)
         self._step_lockstep(None)
 
         # a source reads on exactly one rank unless it declares itself

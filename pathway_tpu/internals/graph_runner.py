@@ -374,8 +374,19 @@ class GraphRunner:
                     self._lower(ops, runtime)
             with telemetry.span("graph_runner.run"):
                 runtime.run()
-            flush = getattr(telemetry, "flush", None)
-            if flush is not None:
-                flush(timeout=2.0)
+            # flush-on-shutdown: short runs must not exit with buffered
+            # spans/gauges unsent (the periodic pusher is on a 60 s
+            # cadence); the flight recorder's per-node aggregate spans
+            # ride the same OTLP channel
+            drain = getattr(telemetry, "drain", None)
+            if drain is not None:
+                summary = getattr(runtime, "trace_summary", None) or {}
+                drain(
+                    node_spans=summary.get("node_spans"), timeout=2.0
+                )
+            else:
+                flush = getattr(telemetry, "flush", None)
+                if flush is not None:
+                    flush(timeout=2.0)
 
         return self._with_companions(ops, rank0)

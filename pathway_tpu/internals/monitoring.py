@@ -58,6 +58,13 @@ SERVE_LATENCY_BUCKETS_MS = (
 )
 SERVE_OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+# event-time lag watermarks (flight recorder, ISSUE 8): commit→emit
+# freshness per output — sub-ms fused chains up to multi-minute backlogs
+LAG_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 5000.0, 30000.0, 300000.0,
+)
+
 
 class _Histogram:
     """Minimal cumulative-bucket histogram (OpenMetrics shape)."""
@@ -92,6 +99,19 @@ class _Histogram:
         lines.append(f"{name}_sum{{{labels}}} {self.sum:.6g}")
         lines.append(f"{name}_count{{{labels}}} {self.total}")
         return lines
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile (upper edge of the bucket holding
+        the q-th observation) — dashboard summaries, not SLO math."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        cum = 0
+        for edge, n in zip(self.edges, self.counts):
+            cum += n
+            if cum >= target:
+                return float(edge)
+        return float(self.edges[-1])
 
 
 @dataclass
@@ -174,6 +194,32 @@ class ProberStats:
     # owns a ServeMetrics; the runtime mounts them here at add_connector
     # time so /metrics serves every route's counters and histograms
     serve: list = field(default_factory=list)
+    # flight-recorder aggregates (engine/runtime.py _step_node when
+    # anything is watching): node label -> [self_s, rows, batches,
+    # nb_batches] — per-node self-time/rows gauges on /metrics and the
+    # dashboard's hot-nodes panel
+    nodes: dict = field(default_factory=dict)
+    # event-time lag watermarks: output label -> freshness histogram
+    # (commit→emit ms against the connector's flush-time ingest stamp)
+    lag: dict = field(default_factory=dict)
+
+    def on_node_step(
+        self, label: str, self_s: float, rows: int, nb: bool
+    ) -> None:
+        agg = self.nodes.get(label)
+        if agg is None:
+            agg = self.nodes[label] = [0.0, 0, 0, 0]
+        agg[0] += self_s
+        agg[1] += rows
+        agg[2] += 1
+        if nb:
+            agg[3] += 1
+
+    def on_output_lag(self, label: str, lag_ms: float) -> None:
+        h = self.lag.get(label)
+        if h is None:
+            h = self.lag[label] = _Histogram(LAG_BUCKETS_MS)
+        h.observe(lag_ms)
 
     def mount_serve_metrics(self, metrics: "ServeMetrics") -> None:
         if metrics not in self.serve:
@@ -309,6 +355,23 @@ class ProberStats:
         lines.append(
             f"mesh_last_committed_epoch {self.mesh_last_committed_epoch}"
         )
+        if self.nodes:
+            for metric, idx, fmt in (
+                ("node_self_seconds_total", 0, "{:.6f}"),
+                ("node_rows_total", 1, "{}"),
+                ("node_batches_total", 2, "{}"),
+                ("node_nb_batches_total", 3, "{}"),
+            ):
+                lines.append(f"# TYPE {metric} counter")
+                for label, agg in self.nodes.items():
+                    lines.append(
+                        f'{metric}{{node="{label}"}} '
+                        + fmt.format(agg[idx])
+                    )
+        if self.lag:
+            lines.append("# TYPE output_lag_ms histogram")
+            for label, h in self.lag.items():
+                lines.extend(h.render("output_lag_ms", f'output="{label}"'))
         if self.serve:
             # samples grouped under their TYPE line, per metric across
             # all routes (the OpenMetrics grouping contract)
@@ -362,6 +425,16 @@ def start_http_server(stats: ProberStats, port: int) -> threading.Thread:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
+            if self.path.split("?", 1)[0] == "/healthz":
+                # liveness probe: flat 200, no metric rendering — k8s
+                # probes must stay cheap and never 500 on a metrics bug
+                body = b"ok\n"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             body = stats.render_openmetrics().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -370,6 +443,9 @@ def start_http_server(stats: ProberStats, port: int) -> threading.Thread:
             self.wfile.write(body)
 
         def log_message(self, *args):
+            # BaseHTTPRequestHandler's default writes one stderr line
+            # per request — a 5s Prometheus scrape interval would bury
+            # the pipeline's real logs
             pass
 
     server = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
@@ -432,8 +508,69 @@ def render_dashboard(stats: ProberStats, graveyard=None):
     lat.add_row("input", f"{stats.input_latency_ms():.0f}")
     lat.add_row("output", f"{stats.output_latency_ms():.0f}")
     lat.add_row("rows emitted", str(stats.outputs_emitted))
+    # event-time lag line (flight recorder watermarks): worst-output
+    # freshness, so one glance says how stale downstream consumers are
+    if stats.lag:
+        worst = max(stats.lag.items(), key=lambda kv: kv[1].quantile(0.5))
+        label, h = worst
+        lat.add_row(
+            f"event-time lag ({label})",
+            f"p50≤{h.quantile(0.5):g} p95≤{h.quantile(0.95):g}",
+        )
 
-    parts = [conn, lat]
+    # whole-pipeline panel: exchange, mesh, fused-chain and serving
+    # families — one screen covers ingest → exchange → compute → serve
+    pipe = Table(box=box.SIMPLE, title="pipeline")
+    pipe.add_column("counter", justify="left")
+    pipe.add_column("value", justify="right")
+    if stats.exchange_frames or stats.exchange_bytes:
+        pipe.add_row(
+            "exchange frames/bytes",
+            f"{stats.exchange_frames}/{stats.exchange_bytes}",
+        )
+        pipe.add_row(
+            "exchange elided/fallbacks",
+            f"{stats.exchange_empty_elided}/{stats.exchange_fallbacks}",
+        )
+        pipe.add_row(
+            "comms/compute [s]",
+            f"{stats.exchange_comms_s:.2f}/{stats.exchange_compute_s:.2f}",
+        )
+    pipe.add_row("nb_fallbacks", str(stats.nb_fallbacks))
+    if (
+        stats.mesh_heartbeats_missed
+        or stats.mesh_rank_restarts
+        or stats.mesh_rollbacks
+        or stats.mesh_last_committed_epoch >= 0
+    ):
+        pipe.add_row(
+            "mesh hb-missed/restarts/rollbacks",
+            f"{stats.mesh_heartbeats_missed}/{stats.mesh_rank_restarts}"
+            f"/{stats.mesh_rollbacks}",
+        )
+        pipe.add_row(
+            "mesh committed epoch", str(stats.mesh_last_committed_epoch)
+        )
+    for sm in stats.serve:
+        pipe.add_row(
+            f"serve {sm.route} req/shed/timeout",
+            f"{sm.requests}/{sm.shed}/{sm.timeouts}",
+        )
+        pipe.add_row(
+            f"serve {sm.route} windows (occ p50)",
+            f"{sm.commits} ({sm.occupancy.quantile(0.5):g})",
+        )
+    if stats.nodes:
+        top = sorted(
+            stats.nodes.items(), key=lambda kv: kv[1][0], reverse=True
+        )[:3]
+        for label, agg in top:
+            pipe.add_row(
+                f"hot {label}",
+                f"{agg[0]:.2f}s / {agg[1]} rows",
+            )
+
+    parts = [conn, lat, pipe]
     if graveyard is not None and graveyard.records:
         parts.append(
             Panel(

@@ -180,12 +180,44 @@ class OtlpTelemetry:
                 self._span_queue.task_done()
 
     def flush(self, timeout: float = 5.0) -> None:
-        """Best-effort wait for queued spans to be exported."""
+        """Best-effort wait for queued spans to be exported. Waits on
+        task COMPLETION (unfinished_tasks, decremented by the worker's
+        task_done after the POST), not queue emptiness — the worker
+        dequeues a span before exporting it, so an empty queue can
+        still have the last span's POST in flight (a caller tearing
+        down its collector right after flush() would lose it)."""
         deadline = time.monotonic() + timeout
-        while (
-            not self._span_queue.empty() and time.monotonic() < deadline
-        ):
+        q = self._span_queue
+        while q.unfinished_tasks and time.monotonic() < deadline:
             time.sleep(0.01)
+
+    def drain(self, node_spans=None, timeout: float = 5.0) -> None:
+        """Flush-on-shutdown (graph_runner calls this after every run):
+        the metrics thread pushes on a 60 s cadence, so a short run
+        would exit with its gauges never exported and its spans still
+        queued — push the gauges once, enqueue the flight recorder's
+        per-node aggregate spans (same OTLP channel as the build/run
+        spans), and wait out the span queue. The periodic thread keeps
+        running — the telemetry object is cached per endpoint and
+        reused by later runs in the same process."""
+        for s in node_spans or ():
+            self._span_queue.put(
+                {
+                    "traceId": self._trace_id,
+                    "spanId": os.urandom(8).hex(),
+                    "name": s["name"],
+                    "kind": 1,
+                    "startTimeUnixNano": str(int(s["start_ns"])),
+                    "endTimeUnixNano": str(int(s["end_ns"])),
+                    "attributes": _attrs(s.get("attrs", {})),
+                    "status": {"code": 1},
+                }
+            )
+        try:
+            self.push_metrics_once()
+        except Exception:
+            pass
+        self.flush(timeout)
 
     # -- spans ------------------------------------------------------------
     @contextlib.contextmanager

@@ -196,6 +196,21 @@ def run_connector_thread(conn, out_queue: "queue.Queue") -> None:
         out_queue.put((conn, None, None, []))
 
 
+def _stamp(conn) -> None:
+    """Event-time lag watermark, connector half: stamp ingest time once
+    per forwarded queue entry (perf_counter_ns, the engine's trace
+    timebase). The runtime pops stamps FIFO as it drains entries and
+    keys commit→emit freshness off them (engine/runtime.py
+    ``_note_ingest``/``note_output_emit``); appends are GIL-atomic, so
+    the subject thread needs no lock."""
+    q = getattr(conn, "_ingest_ns", None)
+    if q is None:
+        import collections
+
+        q = conn._ingest_ns = collections.deque()
+    q.append(_time.perf_counter_ns())
+
+
 def _run_supervised(conn, out_queue: "queue.Queue") -> None:
     subject = conn.subject
     parser = conn.parser
@@ -360,6 +375,7 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
             # force_flush cadence would otherwise refresh last_activity
             # for a dead-blocked subject and defeat the stall watchdog
             heartbeat()
+            _stamp(conn)  # one ingest stamp per forwarded entry
             forwarded_since_boundary += len(batch)
             if track_backlog:
                 # the subject may be mid-scan on its own thread, so its
@@ -415,6 +431,11 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
         heartbeat()
         with lock:
             batch = take_batch()
+            if batch:
+                # stamps pair 1:1 with entries that carry rows — a
+                # state-only boundary ships no stamp (the runtime pops
+                # one per non-empty entry, FIFO)
+                _stamp(conn)
             if has_state:
                 journal_rows = (
                     ledger_rows() + jrows_of(batch) if persisting else []
@@ -501,6 +522,7 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                     (k, r, -d) for (k, r, d) in ledger_rows()
                 ]
                 if comp:
+                    _stamp(conn)
                     out_queue.put((conn, comp, None, []))
                 # engine rolled back to the boundary: the ledger restarts
                 # empty, matching it

@@ -227,6 +227,9 @@ class ProcessGroup:
         # the runtime attaches its ProberStats here so heartbeat misses
         # land on the OpenMetrics endpoint; None outside engine runs
         self.stats = None
+        # flight recorder (internals/flight.py): receiver-thread decode
+        # spans + heartbeat marks ride it; None when tracing is off
+        self.recorder = None
         if hosts is None:
             env = os.environ.get("PATHWAY_HOSTS", "")
             hosts = (
@@ -436,6 +439,10 @@ class ProcessGroup:
                     seen = self._last_seen.get(peer, now)
                     if now - seen > 1.5 * self._hb_interval:
                         stats.on_mesh_heartbeat_missed()
+                        if self.recorder is not None:
+                            self.recorder.note_mark(
+                                "heartbeat_missed", peer=peer
+                            )
                 lock = self._send_locks.get(peer)
                 if lock is None or not lock.acquire(blocking=False):
                     continue
@@ -490,8 +497,20 @@ class ProcessGroup:
                     if payload[:4] == _V2_MAGIC:
                         # exchange v2: decode typed columnar buffers HERE,
                         # on the receiver thread — merge work overlaps the
-                        # main loop's compute
+                        # main loop's compute (the flight recorder gives
+                        # these their own per-peer trace track)
+                        rec = self.recorder
+                        t0 = (
+                            _time.perf_counter_ns()
+                            if rec is not None
+                            else 0
+                        )
                         decoded = self._decode_exchange(payload)
+                        if rec is not None:
+                            rec.note_decode(
+                                peer, t0, _time.perf_counter_ns(),
+                                len(payload),
+                            )
                     else:
                         decoded = pickle.loads(payload)
                 except Exception as exc:
@@ -696,6 +715,10 @@ class ProcessGroup:
                         ) == "failed":
                             if self.stats is not None:
                                 self.stats.on_mesh_heartbeat_missed()
+                            if self.recorder is not None:
+                                self.recorder.note_mark(
+                                    "peer_failed", peer=peer
+                                )
                             raise MeshPeerFailure(
                                 f"rank {self.rank}: peer {peer} sent no "
                                 f"frame or heartbeat for {idle:.1f}s "

@@ -223,6 +223,49 @@ class MeshSupervisor:
             for p in procs:
                 if p.poll() is None:
                     p.wait()
+            self._merge_trace_fallback()
+
+    def _merge_trace_fallback(self) -> None:
+        """Flight-recorder fallback merge: rank 0 normally merges the
+        per-rank trace partials at its own shutdown, but a rolled-back
+        (or crashed-after-dump) epoch leaves partials behind — including
+        the aborting epoch's rollback marks, which are exactly what a
+        post-mortem wants. Best-effort, stdlib-light: flight.py is
+        loaded by file path like protocol.py above, so file-path-loaded
+        supervisors (scripts/fault_matrix.py) never touch the package
+        __init__s."""
+        path = os.environ.get("PATHWAY_TRACE")
+        if not path:
+            return
+        if not any(
+            os.path.exists(f"{path}.r{r}") for r in range(self.processes)
+        ):
+            return
+        try:
+            import importlib.util as _ilu
+
+            spec = _ilu.spec_from_file_location(
+                "_pw_flight",
+                os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)
+                    )),
+                    "internals", "flight.py",
+                ),
+            )
+            flight = _ilu.module_from_spec(spec)
+            spec.loader.exec_module(flight)
+            merged = flight.merge_trace_files(path, self.processes)
+            if merged:
+                logger.info(
+                    "mesh supervisor: merged leftover trace partials "
+                    "into %s", merged,
+                )
+        except Exception:
+            logger.warning(
+                "mesh supervisor: trace partial merge failed",
+                exc_info=True,
+            )
 
     def _run(self, procs: list[subprocess.Popen]) -> int:
         while True:

@@ -75,4 +75,12 @@ echo "=== lane 6: ThreadSanitizer native battery ==="
 # lane 4.
 env -u PATHWAY_LANE_PROCESSES ./scripts/sanitize_native.sh tsan
 
+echo "=== lane 7: flight-recorder trace smoke (2-rank merge + profile) ==="
+# real-fork 2-rank wordcount under PATHWAY_TRACE: both ranks dump
+# partials, rank 0 merges ONE Perfetto-loadable trace (per-rank tracks,
+# wave/mesh events, epoch marks), the merged JSON validates against the
+# trace schema, and the hot-path blame pass (`analysis --profile`)
+# exits 0 naming the top self-time node with its fused/degraded verdict
+env -u PATHWAY_LANE_PROCESSES python scripts/trace_smoke.py
+
 echo "=== all lanes green ==="
