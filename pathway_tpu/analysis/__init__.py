@@ -47,6 +47,13 @@ _ATTRS = {
         "pathway_tpu.analysis.meshcheck", "MeshCheckReport",
     ),
     "check_mesh": ("pathway_tpu.analysis.meshcheck", "check"),
+    "ServeCheckConfig": (
+        "pathway_tpu.analysis.meshcheck", "ServeCheckConfig",
+    ),
+    "ServeCheckReport": (
+        "pathway_tpu.analysis.meshcheck", "ServeCheckReport",
+    ),
+    "check_serving": ("pathway_tpu.analysis.meshcheck", "check_serving"),
     "KNOBS": ("pathway_tpu.analysis.knobs", "KNOBS"),
     "KnobError": ("pathway_tpu.analysis.knobs", "KnobError"),
     "knob_table_markdown": (

@@ -6,6 +6,8 @@
     python -m pathway_tpu.analysis --mesh [--processes N]
         [--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME]
         [--json] [program.py]
+    python -m pathway_tpu.analysis --serve [--serve-requests N]
+        [--mesh-faults F] [--serve-mutant NAME] [--json]
     python -m pathway_tpu.analysis --profile trace.json [--top K] [--json]
 
 Profile mode (hot-path blame) joins a PATHWAY_TRACE flight-recorder
@@ -17,6 +19,15 @@ problems.
 
 Doctor options go BEFORE the program path; everything after it is the
 program's own argv (flags included), exactly like ``python script.py``.
+
+Serve mode (``--serve``) runs the serving-plane verifier
+(``analysis/meshcheck.py check_serving``) over the epoch-survivable
+frontend's park/replay protocol: every interleaving of arrivals, window
+commits, response deliveries, backend crashes and epoch+1 reattaches,
+checking that no admitted request is lost or answered twice across
+rollbacks and that all-parked windows commit nothing. ``--serve-mutant
+replay_committed_window`` must be caught — the serving checker's own
+regression test.
 
 Mesh mode runs the exhaustive bounded model checker
 (``analysis/meshcheck.py``) over the wave/rollback protocol: with a
@@ -48,6 +59,13 @@ import json
 import os
 import runpy
 import sys
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _load_user_program(args) -> bool:
@@ -144,12 +162,6 @@ def _lower_program_runtime(args):
 def _analyze_mesh(args) -> int:
     from pathway_tpu.analysis import meshcheck
 
-    def _env_int(name, default):
-        try:
-            return int(os.environ.get(name, "") or default)
-        except ValueError:
-            return default
-
     world = args.processes or _env_int("PATHWAY_MESHCHECK_RANKS", 3)
     rounds = (
         args.mesh_rounds
@@ -194,6 +206,37 @@ def _analyze_mesh(args) -> int:
             "verdict inconclusive",
             file=sys.stderr,
         )
+        return 3
+    return 0
+
+
+def _analyze_serve(args) -> int:
+    """Serving-plane verifier (ISSUE 9): exhaustively model-check the
+    park/replay protocol of the epoch-survivable frontend — the same
+    ``serve_*`` transitions of parallel/protocol.py the frontend and
+    the gateway breaker drive through at runtime."""
+    from pathway_tpu.analysis import meshcheck
+
+    report = meshcheck.check_serving(
+        meshcheck.ServeCheckConfig(
+            requests=args.serve_requests,
+            fault_budget=(
+                args.mesh_faults
+                if args.mesh_faults is not None
+                else _env_int("PATHWAY_MESHCHECK_FAULTS", 1)
+            ),
+            mutate=args.serve_mutant,
+        )
+    )
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    if report.violations:
+        return 2
+    if not report.complete:
+        print("state space NOT exhausted; verdict inconclusive",
+              file=sys.stderr)
         return 3
     return 0
 
@@ -303,6 +346,21 @@ def main(argv=None) -> int:
              "drop_rollback_retraction) — the checker must catch it",
     )
     parser.add_argument(
+        "--serve", action="store_true",
+        help="exhaustively model-check the serving plane's park/replay "
+             "protocol (epoch-survivable frontend, ISSUE 9): no "
+             "admitted request lost or answered twice across rollbacks",
+    )
+    parser.add_argument(
+        "--serve-requests", type=int, default=3,
+        help="with --serve: symbolic request count (default 3)",
+    )
+    parser.add_argument(
+        "--serve-mutant", default=None,
+        help="with --serve: check a deliberately broken serving variant "
+             "(replay_committed_window) — the checker must catch it",
+    )
+    parser.add_argument(
         "--update-artifact", action="store_true",
         help="with --bench: annotate BENCH_full.json lines with "
              "plan_verdict",
@@ -328,12 +386,16 @@ def main(argv=None) -> int:
     try:
         if args.profile:
             return _analyze_profile(args)
+        if args.serve:
+            return _analyze_serve(args)
         if args.mesh:
             return _analyze_mesh(args)
         if args.bench:
             return _analyze_bench(args)
         if not args.program:
-            parser.error("a program path (or --bench/--mesh) is required")
+            parser.error(
+                "a program path (or --bench/--mesh/--serve) is required"
+            )
         return _analyze_program(args)
     except KnobError as e:
         print(f"[ERROR  ] knob.invalid env\n      {e}", file=sys.stderr)

@@ -163,6 +163,33 @@ KNOBS: dict[str, Knob] = {
            "Gateway dispatch workers draining closed batch windows into "
            "the dataflow (each window stays one atomic commit).", lo=1,
            hi=64),
+        # -- serving through rollback (io/http/_frontend.py + breaker) ----
+        _k("PATHWAY_SERVE_BROWNOUT", "bool", False,
+           "Degraded-answer mode: with the dispatch circuit breaker open "
+           "the gateway answers from the last committed index snapshot "
+           "(brownout_answer hook) with a Degraded: true header instead "
+           "of shedding."),
+        _k("PATHWAY_SERVE_BREAKER_THRESHOLD", "int", 5,
+           "Consecutive dispatch failures or request-deadline breaches "
+           "that open the device-dispatch circuit breaker (0 disables "
+           "it).", lo=0, hi=1_000_000),
+        _k("PATHWAY_SERVE_BREAKER_COOLDOWN_S", "float", 5.0,
+           "Open-breaker cooldown before one probe window half-opens "
+           "it.", lo=0.01, hi=3600),
+        _k("PATHWAY_SERVE_PARK_BUDGET", "int", 1024,
+           "Requests the epoch-survivable frontend will hold parked "
+           "during a rollback before shedding new arrivals.", lo=0,
+           hi=10_000_000),
+        _k("PATHWAY_SERVE_BACKEND_PORT", "int", None,
+           "Set by the mesh supervisor's serving frontend: the gateway "
+           "binds this loopback port instead of its public host:port, "
+           "and the frontend owns the public listener across epochs.",
+           lo=1, hi=65535),
+        _k("PATHWAY_SERVE_PUBLIC_PORT", "int", None,
+           "Set alongside PATHWAY_SERVE_BACKEND_PORT: scopes the "
+           "backend rewrite to the one webserver configured on the "
+           "frontend's public port (other webservers keep their own "
+           "ports).", lo=1, hi=65535),
         # -- connector supervision ----------------------------------------
         _k("PATHWAY_CONNECTOR_MAX_RESTARTS", "int", 3,
            "In-place restart budget per connector subject.", lo=0,

@@ -1490,3 +1490,517 @@ def check_runtime_mesh(
         ),
     )
     return check(cfg)
+
+
+# ===========================================================================
+# Serving-plane checker (ISSUE 9): park/replay across rollback
+# ===========================================================================
+#
+# The epoch-survivable frontend (io/http/_frontend.py) parks every
+# admitted, unresponded request when the backend epoch dies and replays
+# it into epoch+1; the gateway (io/http/_server.py) aborts uncommitted
+# windows on the way down. Those decisions are pure transitions in
+# parallel/protocol.py (serve_admit / serve_park / serve_replay_split /
+# serve_frontend_state) — this checker drives the SAME objects over
+# every interleaving of {arrival, window commit, response delivery,
+# backend crash, epoch+1 reattach} and verifies, on every terminal
+# state, the serving exactly-once contract:
+#
+# * no admitted request is LOST — each ends in exactly one terminal:
+#   a delivered response, or a deadline 503 (expired while parked);
+# * no request is ANSWERED TWICE across any number of rollbacks — a
+#   request whose response was already delivered must never replay
+#   (the ``replay_committed_window`` mutant breaks exactly this filter
+#   and must be caught with a replayable trace);
+# * a window whose members were all parked/evicted commits NOTHING.
+
+SERVE_MUTANT_NAMES = ("replay_committed_window",)
+
+SERVE_FAULT_POINT = "serve.dispatch"
+
+
+class ServeTransitions:
+    """The serving protocol decisions the model drives through —
+    default-binds the engine's own ``protocol.TRANSITIONS`` entries
+    (same-object identity pinned by tests, like :class:`Transitions`)."""
+
+    NAMES = (
+        "serve_frontend_state",
+        "serve_admit",
+        "serve_park",
+        "serve_replay_split",
+        "serve_retry_after",
+        "breaker_decide",
+    )
+
+    def __init__(self, overrides: dict | None = None):
+        for name in self.NAMES:
+            setattr(self, name, _proto.TRANSITIONS[name])
+        for name, fn in (overrides or {}).items():
+            if name not in self.NAMES:
+                raise ValueError(f"unknown serve transition {name!r}")
+            setattr(self, name, fn)
+
+
+def _mutant_replay_committed_window(inflight_ids, responded_ids):
+    """Broken park set: the responded filter is dropped, so a request
+    whose window committed AND whose response was already delivered is
+    parked and replayed at epoch+1 — the client is answered twice."""
+    return sorted(inflight_ids)
+
+
+def get_serve_transitions(mutate: str | None = None) -> ServeTransitions:
+    if mutate is None:
+        return ServeTransitions()
+    if mutate == "replay_committed_window":
+        return ServeTransitions(
+            {"serve_park": _mutant_replay_committed_window}
+        )
+    raise ValueError(
+        f"unknown serve mutant {mutate!r}; known: "
+        + ", ".join(SERVE_MUTANT_NAMES)
+    )
+
+
+@dataclass
+class ServeCheckConfig:
+    requests: int = 3
+    fault_budget: int = 1
+    queue_cap: int = 8
+    park_budget: int = 8
+    # per-request outage budget: how many park/replay cycles a request's
+    # PATHWAY_REST_TIMEOUT_S deadline survives; 0 = expires on its first
+    # park (the deadline-accounting leg). Padded/truncated to `requests`.
+    deadline_budgets: tuple = (1, 1, 0)
+    mutate: str | None = None
+    max_states: int = 100_000
+
+
+@dataclass
+class ServeViolation:
+    kind: str
+    detail: str
+    trace: list = field(default_factory=list)
+
+    def fault_plan(self) -> dict | None:
+        """Crash choices as a replayable PATHWAY_FAULT_PLAN: each crash
+        step names the ``serve.dispatch`` phase slot (``window`` — formed,
+        uncommitted; ``committed`` — committed, responses undelivered)
+        the real gateway exposes, on the rank that owns the gateway."""
+        rules = [
+            {
+                "point": SERVE_FAULT_POINT,
+                "phase": step["phase"],
+                "rank": 0,
+                "hits": [step["hit"]],
+                "action": "crash",
+            }
+            for step in self.trace
+            if step.get("action") == "crash"
+        ]
+        return {"seed": 7, "rules": rules} if rules else None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "trace": self.trace,
+            "fault_plan": self.fault_plan(),
+        }
+
+
+@dataclass
+class ServeCheckReport:
+    config: ServeCheckConfig
+    states: int = 0
+    transitions: int = 0
+    terminals: int = 0
+    rollbacks_explored: int = 0
+    complete: bool = True
+    violations: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.complete and not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": "pathway_tpu.servecheck/v1",
+            "requests": self.config.requests,
+            "fault_budget": self.config.fault_budget,
+            "mutate": self.config.mutate,
+            "states": self.states,
+            "transitions": self.transitions,
+            "terminals": self.terminals,
+            "rollbacks_explored": self.rollbacks_explored,
+            "complete": self.complete,
+            "ok": self.ok,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+    def to_json(self, **kw) -> str:
+        kw.setdefault("indent", 2)
+        return json.dumps(self.to_dict(), **kw)
+
+    def render(self) -> str:
+        c = self.config
+        lines = [
+            f"serving verifier: {c.requests} request(s), fault budget "
+            f"{c.fault_budget}"
+            + (f", mutant {c.mutate!r}" if c.mutate else ""),
+            f"  explored {self.states} states / {self.transitions} "
+            f"transitions ({self.terminals} terminal(s), "
+            f"{self.rollbacks_explored} rollback path(s))"
+            + ("" if self.complete else " — INCOMPLETE (state cap hit)"),
+        ]
+        if not self.violations:
+            lines.append(
+                "  every admitted request reaches exactly one terminal "
+                "(response or deadline 503) across all rollbacks; none "
+                "answered twice; all-parked windows commit nothing"
+            )
+        for v in self.violations:
+            lines.append(f"  VIOLATION [{v.kind}] {v.detail}")
+            for step in v.trace:
+                lines.append(f"    - {step['label']}")
+            plan = v.fault_plan()
+            if plan:
+                lines.append(
+                    "    replay: PATHWAY_FAULT_PLAN='"
+                    + json.dumps(plan, separators=(",", ":"))
+                    + "'"
+                )
+        return "\n".join(lines)
+
+
+# per-request statuses of the serving model
+_S_NEW = "new"            # not yet arrived
+_S_QUEUED = "queued"      # admitted + forwarded, in the collecting window
+_S_COMMITTED = "committed"  # its window committed (backend in-memory)
+_S_RESPONDED = "responded"  # terminal: response delivered
+_S_PARKED = "parked"      # backend lost; future retained at the frontend
+_S_EXPIRED = "expired"    # terminal: deadline 503 while parked/shed
+
+
+class _ServeState(NamedTuple):
+    # per request: (status, terminals_delivered, outage_budget)
+    reqs: tuple
+    backend_up: bool
+    epoch: int
+    crashes_left: int
+    window_hits: int      # serve.dispatch phase="window" hit counter
+    committed_hits: int   # serve.dispatch phase="committed" hit counter
+
+
+class _ServeProperty(Exception):
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind
+        self.detail = detail
+
+
+class _ServeModel:
+    def __init__(self, cfg: ServeCheckConfig, t: ServeTransitions):
+        self.cfg = cfg
+        self.t = t
+        budgets = list(cfg.deadline_budgets) + [1] * cfg.requests
+        self.budgets = tuple(budgets[: cfg.requests])
+
+    def initial(self) -> _ServeState:
+        return _ServeState(
+            reqs=tuple(
+                (_S_NEW, 0, self.budgets[i])
+                for i in range(self.cfg.requests)
+            ),
+            backend_up=True,
+            epoch=0,
+            crashes_left=self.cfg.fault_budget,
+            window_hits=0,
+            committed_hits=0,
+        )
+
+    # -- helpers -----------------------------------------------------------
+    def _frontend_state(self, s: _ServeState) -> str:
+        return self.t.serve_frontend_state(s.backend_up, False)
+
+    def _counts(self, s: _ServeState):
+        inflight = sum(
+            1 for st, _, _ in s.reqs
+            if st in (_S_QUEUED, _S_COMMITTED, _S_PARKED)
+        )
+        parked = sum(1 for st, _, _ in s.reqs if st == _S_PARKED)
+        return inflight, parked
+
+    def _deliver(self, s: _ServeState, i: int, status: str):
+        """One terminal answer (response or 503) to request i — a second
+        delivery is the double-answer violation, returned (not raised)
+        so the violating step lands in the trace."""
+        st, n, b = s.reqs[i]
+        if n >= 1:
+            return _ServeProperty(
+                "double-response",
+                f"request {i} answered twice (prior terminal, then "
+                f"{status!r} after a replay of its committed window)",
+            )
+        reqs = list(s.reqs)
+        reqs[i] = (status, n + 1, b)
+        return s._replace(reqs=tuple(reqs))
+
+    # -- successors --------------------------------------------------------
+    def successors(self, s: _ServeState):
+        """[(label_step, next_state)] — every scheduler choice."""
+        out = []
+        fe_state = self._frontend_state(s)
+        inflight, parked = self._counts(s)
+        # 1. next arrival (arrival order is fixed; interleaving with the
+        # other actions is what's explored)
+        for i, (st, n, b) in enumerate(s.reqs):
+            if st != _S_NEW:
+                continue
+            verdict = self.t.serve_admit(
+                fe_state, inflight, self.cfg.queue_cap, parked,
+                self.cfg.park_budget,
+            )
+            if verdict == "admit":
+                reqs = list(s.reqs)
+                reqs[i] = (_S_QUEUED, n, b)
+                out.append(
+                    (
+                        {"label": f"arrive r{i} -> queued (epoch {s.epoch})"},
+                        s._replace(reqs=tuple(reqs)),
+                    )
+                )
+            elif verdict == "park":
+                reqs = list(s.reqs)
+                reqs[i] = (_S_PARKED, n, b)
+                out.append(
+                    (
+                        {"label": f"arrive r{i} -> parked (recovering)"},
+                        s._replace(reqs=tuple(reqs)),
+                    )
+                )
+            else:  # shed: terminal 503 + Retry-After
+                out.append(
+                    (
+                        {"label": f"arrive r{i} -> shed 503"},
+                        self._deliver(s, i, _S_EXPIRED),
+                    )
+                )
+            break  # only the next unarrived request can arrive
+        if s.backend_up:
+            queued = [
+                i for i, (st, _, _) in enumerate(s.reqs) if st == _S_QUEUED
+            ]
+            # 2. the collecting window closes and commits — ONE commit
+            # for every queued member. An all-parked/evicted window
+            # never reaches here (its live set is empty): the gateway
+            # skips the commit entirely, which the model mirrors by
+            # requiring a non-empty live set.
+            if queued:
+                reqs = list(s.reqs)
+                for i in queued:
+                    st, n, b = reqs[i]
+                    reqs[i] = (_S_COMMITTED, n, b)
+                out.append(
+                    (
+                        {
+                            "label": "window commit "
+                            + ",".join(f"r{i}" for i in queued)
+                            + f" (epoch {s.epoch})"
+                        },
+                        # the real _dispatch_window fires BOTH
+                        # serve.dispatch phases once per dispatched
+                        # window (pre-commit "window", post-commit
+                        # "committed") — the hit counters must track
+                        # WINDOWS, not response deliveries, or the
+                        # rendered fault plan kills at the wrong slot
+                        s._replace(
+                            reqs=tuple(reqs),
+                            window_hits=s.window_hits + 1,
+                            committed_hits=s.committed_hits + 1,
+                        ),
+                    )
+                )
+            # 3. deliver one committed request's response
+            for i, (st, n, b) in enumerate(s.reqs):
+                if st == _S_COMMITTED:
+                    out.append(
+                        (
+                            {"label": f"respond r{i} (epoch {s.epoch})"},
+                            self._deliver(s, i, _S_RESPONDED),
+                        )
+                    )
+            # 4. the backend epoch crashes (rank kill mid-window /
+            # post-commit): in-memory windows are lost; the frontend
+            # parks every admitted, unresponded request — the park set
+            # is the shared serve_park transition (the mutant breaks
+            # its responded filter)
+            if s.crashes_left > 0:
+                has_committed = any(
+                    st == _S_COMMITTED for st, _, _ in s.reqs
+                )
+                phase = "committed" if has_committed else "window"
+                # a committed-phase crash lands AT the firing of the
+                # latest commit (= committed_hits so far); a window-phase
+                # crash lands at the NEXT window's pre-commit firing
+                hit = (
+                    max(1, s.committed_hits)
+                    if has_committed
+                    else s.window_hits + 1
+                )
+                frontend_inflight = {
+                    i
+                    for i, (st, _, _) in enumerate(s.reqs)
+                    if st in (_S_QUEUED, _S_COMMITTED, _S_RESPONDED)
+                }
+                responded = {
+                    i
+                    for i, (st, _, _) in enumerate(s.reqs)
+                    if st == _S_RESPONDED
+                }
+                park = set(
+                    self.t.serve_park(frontend_inflight, responded)
+                )
+                reqs = list(s.reqs)
+                for i in park:
+                    st, n, b = reqs[i]
+                    reqs[i] = (_S_PARKED, n, b)
+                out.append(
+                    (
+                        {
+                            "label": f"CRASH backend epoch {s.epoch} "
+                            f"({phase}); park "
+                            + (
+                                ",".join(f"r{i}" for i in sorted(park))
+                                or "nothing"
+                            ),
+                            "action": "crash",
+                            "phase": phase,
+                            "hit": hit,
+                        },
+                        s._replace(
+                            reqs=tuple(reqs),
+                            backend_up=False,
+                            crashes_left=s.crashes_left - 1,
+                        ),
+                    )
+                )
+        else:
+            # 5. epoch+1 reattaches: the replay-vs-expire verdict over
+            # the parked set is the shared serve_replay_split transition
+            # (deadline accounting: a request out of outage budget gets
+            # a terminal 503, never a dropped connection)
+            parked_ids = [
+                i for i, (st, _, _) in enumerate(s.reqs) if st == _S_PARKED
+            ]
+            deadlines = {
+                i: float(s.reqs[i][2]) for i in parked_ids
+            }
+            replay, expired = self.t.serve_replay_split(
+                parked_ids, 0.5, deadlines
+            )
+            ns = s._replace(backend_up=True, epoch=s.epoch + 1)
+            reqs = list(ns.reqs)
+            for i in replay:
+                st, n, b = reqs[i]
+                reqs[i] = (_S_QUEUED, n, b - 1)
+            ns = ns._replace(reqs=tuple(reqs))
+            for i in expired:
+                ns = self._deliver(ns, i, _S_EXPIRED)
+                if isinstance(ns, _ServeProperty):
+                    break
+            out.append(
+                (
+                    {
+                        "label": f"reattach epoch {s.epoch + 1}: replay "
+                        + (",".join(f"r{i}" for i in replay) or "-")
+                        + "; expire "
+                        + (",".join(f"r{i}" for i in expired) or "-"),
+                    },
+                    ns,
+                )
+            )
+        return out
+
+    def is_terminal(self, s: _ServeState) -> bool:
+        return all(
+            st in (_S_RESPONDED, _S_EXPIRED) for st, _, _ in s.reqs
+        )
+
+    def check_terminal(self, s: _ServeState) -> None:
+        for i, (st, n, b) in enumerate(s.reqs):
+            if n != 1:
+                raise _ServeProperty(
+                    "request-lost" if n == 0 else "double-response",
+                    f"request {i} ended with {n} terminal answer(s) "
+                    f"(status {st!r}) — every admitted request must get "
+                    "exactly one (response, degraded response, or "
+                    "deadline 503)",
+                )
+
+
+def check_serving(cfg: ServeCheckConfig | None = None) -> ServeCheckReport:
+    """Exhaustively explore the serving plane's park/replay protocol.
+    BFS over all interleavings (arrivals × window commits × response
+    deliveries × crashes × reattaches) with full-state memoization —
+    BFS so a violation's trace is minimal by construction.
+
+    Model abstractions: one collecting window at a time (every queued
+    request joins it), and removal-only dispatches
+    (``delete_completed_queries`` retraction flushes) are not modeled —
+    replaying a trace against a keep-queries gateway keeps the
+    ``serve.dispatch`` hit indices exact; under delete-completed mode
+    the kill lands in the same protocol slot but possibly a later
+    window (the fault-matrix contract, same as mesh traces)."""
+    cfg = cfg or ServeCheckConfig()
+    t = get_serve_transitions(cfg.mutate)
+    model = _ServeModel(cfg, t)
+    report = ServeCheckReport(config=cfg)
+    root = model.initial()
+    seen = {root}
+    frontier: list[tuple[_ServeState, tuple]] = [(root, ())]
+    while frontier:
+        next_frontier = []
+        for state, trace in frontier:
+            report.states += 1
+            if report.states > cfg.max_states:
+                report.complete = False
+                return report
+            try:
+                if model.is_terminal(state):
+                    report.terminals += 1
+                    model.check_terminal(state)
+                    continue
+                succs = model.successors(state)
+            except _ServeProperty as p:
+                report.violations.append(
+                    ServeViolation(p.kind, p.detail, list(trace))
+                )
+                return report
+            if not succs:
+                report.violations.append(
+                    ServeViolation(
+                        "serve-deadlock",
+                        "non-terminal state with no possible action",
+                        list(trace),
+                    )
+                )
+                return report
+            for step, ns in succs:
+                report.transitions += 1
+                if step.get("action") == "crash":
+                    report.rollbacks_explored += 1
+                if isinstance(ns, _ServeProperty):
+                    # a delivery violation surfaced while building this
+                    # successor — the violating step closes the trace
+                    report.violations.append(
+                        ServeViolation(
+                            ns.kind, ns.detail, list(trace + (step,))
+                        )
+                    )
+                    return report
+                if ns not in seen:
+                    seen.add(ns)
+                    next_frontier.append((ns, trace + (step,)))
+        frontier = next_frontier
+    return report

@@ -607,6 +607,9 @@ class Runtime:
             )
 
     def _finish(self) -> None:
+        # readiness: inputs are closed, the pipeline is flushing its tail
+        # — /healthz flips to draining so load balancers rotate away
+        self.stats.set_health_state("draining")
         # stop the live dashboard first: its loop removes the log handler
         # and releases stderr (running it past the run garbles later runs)
         stop = getattr(self, "_dashboard_stop", None)
@@ -796,11 +799,42 @@ class Runtime:
                 # embedded/unsupervised runs whose stats object outlives
                 # the abort still observe it
                 self.stats.on_mesh_rollback()
+                # serving plane: abort queued windows (they must commit
+                # NOTHING) and flip /healthz to recovering BEFORE the
+                # trace flush so the park marks land in the partial
+                self._park_serving_for_rollback()
                 # flush this rank's trace partial with the rollback mark
                 # before the supervised exit discards the process
                 self._abort_trace(exc)
                 self._maybe_exit_for_rollback(exc)
             raise
+
+    def _park_serving_for_rollback(self) -> None:
+        """Serving half of the epoch abort (ISSUE 9): every gateway
+        subject aborts its queued-but-undispatched batch windows — their
+        members evicted, so nothing of them commits — and readiness
+        flips to ``recovering``. The requests themselves are parked at
+        the epoch-survivable frontend (io/http/_frontend.py), which
+        holds the real client futures and replays them into epoch+1;
+        this side only guarantees the dying epoch cannot half-commit a
+        window on the way down."""
+        self.stats.set_health_state("recovering")
+        for conn in self.connectors:
+            abort = getattr(
+                conn.subject, "abort_windows_for_rollback", None
+            )
+            if abort is None:
+                continue
+            try:
+                n = abort()
+            except Exception:
+                continue
+            if n and self.recorder is not None:
+                self.recorder.note_mark(
+                    "serve_park",
+                    route=getattr(conn.subject, "route", "?"),
+                    windows_aborted=n,
+                )
 
     @staticmethod
     def _is_mesh_error(exc: BaseException) -> bool:
@@ -892,6 +926,9 @@ class Runtime:
             t = self._min_pending()
             self._step_time(t)
 
+        if self.persistence is not None:
+            # restore/replay window: not yet serving traffic
+            self.stats.set_health_state("recovering")
         if self.persistence is not None and self.persistence.mode == "OPERATOR_PERSISTING":
             # operator-state snapshots (reference: OperatorPersisting,
             # operator_snapshot.rs): restore every stateful node's state at
@@ -944,6 +981,7 @@ class Runtime:
                     else self.persistence.load_subject_state(conn.name),
                 )
 
+        self.stats.set_health_state("serving")
         for conn in self.connectors:
             self._arm_watchdog(conn)
             # copy the creating thread's context so per-thread config
@@ -1344,10 +1382,14 @@ class Runtime:
             self.persistence is not None
             and self.persistence.mode == "OPERATOR_PERSISTING"
         )
+        if self.persistence is not None:
+            # restore/replay window: not yet serving traffic
+            self.stats.set_health_state("recovering")
         if operator_mode:
             self._restore_operator_snapshot_distributed(pg, live)
         elif self.persistence is not None:
             self._replay_journals_distributed(pg, live)
+        self.stats.set_health_state("serving")
 
         for conn in live:
             self._arm_watchdog(conn)

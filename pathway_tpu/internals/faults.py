@@ -26,6 +26,16 @@ Injection points threaded through the hot paths:
                                     marker not yet moved) and ``restore``
                                     (distributed snapshot restore after
                                     the marker tag is agreed)
+    serve.dispatch                  per serving batch window, phase-tagged:
+                                    ``window`` (window formed, upserts not
+                                    yet committed) and ``committed`` (the
+                                    window's commit applied, responses not
+                                    yet delivered) — the serve chaos lane
+                                    kills mid-dispatch here
+    serve.park                      per request parked by the serving
+                                    frontend at backend loss
+    serve.replay                    per parked request replayed into the
+                                    first window of epoch+1
 
 A *plan* is a schedule of rules. Each rule names a point, when it fires —
 explicit 1-based ``hits``, a modular ``every``, or a seeded probability
@@ -80,6 +90,9 @@ POINTS = (
     "mesh.send",
     "mesh.recv",
     "mesh.rank_kill",
+    "serve.dispatch",
+    "serve.park",
+    "serve.replay",
 )
 
 _ACTIONS = ("raise", "crash")

@@ -128,6 +128,13 @@ class ServeMetrics:
     shed: int = 0
     timeouts: int = 0
     commits: int = 0          # batch windows committed into the dataflow
+    # serving-through-rollback instrumentation (ISSUE 9): degraded
+    # answers served while the dispatch breaker is open, windows aborted
+    # (uncommitted) on an epoch rollback, and the breaker's state as a
+    # gauge (0 = closed, 1 = half_open, 2 = open)
+    browned_out: int = 0
+    windows_aborted: int = 0
+    breaker_state: str = "closed"
     latency: _Histogram = field(
         default_factory=lambda: _Histogram(SERVE_LATENCY_BUCKETS_MS)
     )
@@ -143,6 +150,19 @@ class ServeMetrics:
 
     def on_timeout(self) -> None:
         self.timeouts += 1
+
+    def on_brownout(self) -> None:
+        """One request answered degraded (last committed snapshot, no
+        update-fold) instead of shed while the breaker was open."""
+        self.browned_out += 1
+
+    def on_windows_aborted(self, n: int = 1) -> None:
+        """Windows whose dispatch was aborted (committing nothing) when
+        the epoch rolled back — the backend half of request parking."""
+        self.windows_aborted += n
+
+    def set_breaker(self, state: str) -> None:
+        self.breaker_state = state
 
     def on_latency_ms(self, ms: float) -> None:
         self.latency.observe(ms)
@@ -162,6 +182,11 @@ class ProberStats:
     outputs_emitted: int = 0
     last_output_ts: float = 0.0
     started_at: float = field(default_factory=time.time)
+    # readiness state exposed on /healthz (ISSUE 9): "serving" (200 ok),
+    # "draining" (shutdown requested) or "recovering" (epoch restore /
+    # mesh rollback in flight) — both non-serving states answer 503 so a
+    # load balancer rotates traffic away during the blip
+    health_state: str = "serving"
     # multi-process exchange plane (engine/runtime.py wave engine +
     # parallel/procgroup.py v2 frames): coalesced frames/bytes shipped,
     # per-node empty slices elided from the wire, non-empty batches that
@@ -224,6 +249,12 @@ class ProberStats:
     def mount_serve_metrics(self, metrics: "ServeMetrics") -> None:
         if metrics not in self.serve:
             self.serve.append(metrics)
+
+    def set_health_state(self, state: str) -> None:
+        """serving / draining / recovering — the runtime drives this
+        through protocol-visible transitions (run start, _finish,
+        rollback abort, distributed restore)."""
+        self.health_state = state
 
     def on_mesh_heartbeat_missed(self, n: int = 1) -> None:
         self.mesh_heartbeats_missed += n
@@ -380,12 +411,22 @@ class ProberStats:
                 ("serve_shed_total", "shed"),
                 ("serve_timeouts_total", "timeouts"),
                 ("serve_window_commits_total", "commits"),
+                ("serve_browned_out_total", "browned_out"),
+                ("serve_windows_aborted_total", "windows_aborted"),
             ):
                 lines.append(f"# TYPE {metric} counter")
                 for sm in self.serve:
                     lines.append(
                         f'{metric}{{route="{sm.route}"}} {getattr(sm, attr)}'
                     )
+            lines.append("# TYPE serve_breaker_state gauge")
+            for sm in self.serve:
+                level = {"closed": 0, "half_open": 1, "open": 2}.get(
+                    sm.breaker_state, 0
+                )
+                lines.append(
+                    f'serve_breaker_state{{route="{sm.route}"}} {level}'
+                )
             for metric, attr in (
                 ("serve_request_latency_ms", "latency"),
                 ("serve_batch_occupancy", "occupancy"),
@@ -425,11 +466,29 @@ def start_http_server(stats: ProberStats, port: int) -> threading.Thread:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.split("?", 1)[0] == "/healthz":
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
                 # liveness probe: flat 200, no metric rendering — k8s
-                # probes must stay cheap and never 500 on a metrics bug
+                # probes must stay cheap and never 500 on a metrics bug,
+                # and a 503 here during a rollback would make kubelet
+                # KILL the pod mid-recovery (readiness lives on /readyz)
                 body = b"ok\n"
                 self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path == "/readyz":
+                # readiness probe: state-aware — draining/recovering
+                # answer 503 with the state name so a load balancer
+                # rotates traffic away for exactly the rollback blip
+                state = getattr(stats, "health_state", "serving")
+                body = (
+                    b"ok\n" if state == "serving"
+                    else f"{state}\n".encode()
+                )
+                self.send_response(200 if state == "serving" else 503)
                 self.send_header("Content-Type", "text/plain")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

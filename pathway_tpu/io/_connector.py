@@ -240,7 +240,15 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
     has_state = hasattr(subject, "snapshot_state")
     can_seek = has_state and hasattr(subject, "seek")
     runtime = _runtime_of(conn)
-    persisting = getattr(runtime, "persistence", None) is not None
+    # _ephemeral subjects (the REST serving gateway) opt out of input
+    # journaling entirely: their rows are live requests whose futures the
+    # serving frontend owns — replaying a dead epoch's journaled queries
+    # at epoch+1 would double-dispatch the requests the frontend is
+    # already replaying
+    persisting = (
+        getattr(runtime, "persistence", None) is not None
+        and not getattr(subject, "_ephemeral", False)
+    )
     # pure-upsert parsers (primary-keyed, deletions disabled) make rescans
     # idempotent at the engine: re-inserting a live key retracts the
     # previous row, so restart needs no compensation ledger. pk parsers

@@ -14,12 +14,15 @@ from pathway_tpu.io.http._client import (
     read,
     write,
 )
+from pathway_tpu.io.http._frontend import FrontendMetrics, ServingFrontend
 
 __all__ = [
     "PathwayWebserver",
     "EndpointDocumentation",
     "RestServerSubject",
     "rest_connector",
+    "ServingFrontend",
+    "FrontendMetrics",
     "KeepAliveSession",
     "HttpError",
     "read",

@@ -23,13 +23,21 @@ class HttpError(urllib.error.HTTPError):
     """Non-2xx response from a keep-alive session request. Subclasses
     ``urllib.error.HTTPError`` so callers that caught the old
     urllib-based clients' errors (``e.code``, ``e.read()``) keep
-    working unchanged."""
+    working unchanged. ``headers`` carries the response headers (the
+    backpressure contract rides them: ``Retry-After`` on 503 sheds,
+    ``Degraded`` on brownout answers)."""
 
-    def __init__(self, status: int, body: bytes, url: str = ""):
+    def __init__(
+        self, status: int, body: bytes, url: str = "", headers=None
+    ):
+        import email.message
         import io
 
+        hdrs = email.message.Message()
+        for k, v in (headers or {}).items():
+            hdrs[k] = v
         # .status/.code come from HTTPError itself
-        super().__init__(url, status, f"HTTP {status}", None, io.BytesIO(body))
+        super().__init__(url, status, f"HTTP {status}", hdrs, io.BytesIO(body))
         self.body = body
 
     def json(self):
@@ -47,7 +55,13 @@ class KeepAliveSession:
     closed-loop client of the batching gateway ride the keep-alive path
     the server now serves."""
 
-    def __init__(self, url: str, timeout: float = 90.0):
+    def __init__(
+        self,
+        url: str,
+        timeout: float = 90.0,
+        retries: int = 0,
+        max_retry_wait_s: float = 30.0,
+    ):
         if "://" not in url:
             # scheme-less "host:port" would mis-parse as scheme=host
             url = "http://" + url
@@ -63,6 +77,16 @@ class KeepAliveSession:
         # every route, matching the old `url + route` concatenation
         self.base_path = parsed.path.rstrip("/")
         self.timeout = timeout
+        # opt-in bounded retry of the DOCUMENTED backpressure contract:
+        # a 503 carrying Retry-After (admission shed, brownout breaker,
+        # parked-deadline expiry during a rollback) is an explicit
+        # "come back in N seconds" — with retries > 0 the session honors
+        # it, sleeping min(Retry-After, max_retry_wait_s) between
+        # attempts. 503s WITHOUT Retry-After and every other status
+        # still raise immediately: only the server-invited retry is
+        # safe to automate.
+        self.retries = retries
+        self.max_retry_wait_s = max_retry_wait_s
         self._local = threading.local()
 
     def _connect(self) -> http.client.HTTPConnection:
@@ -90,6 +114,31 @@ class KeepAliveSession:
             body = _json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
         route = self.base_path + route
+        attempts = 0
+        while True:
+            resp, data = self._roundtrip(method, route, body, headers)
+            if (
+                resp.status == 503
+                and attempts < self.retries
+                and resp.getheader("Retry-After") is not None
+            ):
+                try:
+                    delay = float(resp.getheader("Retry-After"))
+                except (TypeError, ValueError):
+                    delay = 1.0
+                attempts += 1
+                time.sleep(max(0.0, min(delay, self.max_retry_wait_s)))
+                continue
+            break
+        if resp.status >= 400:
+            raise HttpError(
+                resp.status, data, headers=dict(resp.getheaders())
+            )
+        if not data:
+            return None
+        return _json.loads(data.decode())
+
+    def _roundtrip(self, method, route, body, headers):
         while True:
             reused = getattr(self._local, "conn", None) is not None
             conn = self._local.conn if reused else self._connect()
@@ -129,11 +178,7 @@ class KeepAliveSession:
                 )
                 if not stale:
                     raise
-        if resp.status >= 400:
-            raise HttpError(resp.status, data)
-        if not data:
-            return None
-        return _json.loads(data.decode())
+        return resp, data
 
     def post(self, route: str, payload: dict):
         return self.request_json("POST", route, payload)

@@ -20,6 +20,19 @@ sized from the observed service rate, and shed/timed-out requests are
 evicted from their window so they never occupy a batch slot or a device
 dispatch. aiohttp keeps HTTP/1.1 connections alive, so a closed-loop
 client pays the TCP+TLS setup once, not per query.
+
+Serving through rollback (ISSUE 9): under a mesh supervisor with
+``--serve-frontend``, the PUBLIC listener lives in the supervisor's
+epoch-survivable frontend (``_frontend.py``) and this gateway binds the
+loopback ``PATHWAY_SERVE_BACKEND_PORT`` instead — a mesh rollback then
+parks in-flight requests at the frontend and replays them into
+epoch+1's first windows rather than resetting connections. This module
+adds the epoch-abort half (``abort_windows_for_rollback``: an
+all-parked window commits nothing), stable request keys from the
+frontend's ``X-Pathway-Request-Id``, and a circuit breaker on the
+dispatch path whose open state answers DEGRADED from the last committed
+snapshot (``brownout_answer`` + ``Degraded: true`` header) under
+``PATHWAY_SERVE_BROWNOUT=1`` instead of shedding.
 """
 
 from __future__ import annotations
@@ -35,11 +48,17 @@ import time as _time
 from typing import Any, Sequence
 
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import faults as _faults
 from pathway_tpu.internals.api import Json, Pointer, ref_scalar
 from pathway_tpu.internals.monitoring import ServeMetrics
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.schema import Schema
 from pathway_tpu.io.python import ConnectorSubject, read as python_read
+
+# the dispatch circuit breaker and the brownout/shed verdicts are
+# protocol decisions (parallel/protocol.py breaker_decide) shared with
+# the serving model checker — see ISSUE 9
+from pathway_tpu.parallel import protocol as _proto
 
 
 def _env_knob(name: str, default: float) -> float:
@@ -158,6 +177,27 @@ class PathwayWebserver:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 8080,
                  with_cors: bool = False, with_schema_endpoint: bool = True):
+        self.public_host, self.public_port = host, port
+        # epoch-survivable frontend mode (ISSUE 9): when the mesh
+        # supervisor runs a ServingFrontend it owns the public listener
+        # across rollbacks and hands this epoch's gateway a loopback
+        # backend port via PATHWAY_SERVE_BACKEND_PORT — the pipeline
+        # program keeps naming its public host:port unchanged. The
+        # rewrite applies ONLY to the webserver whose configured port is
+        # the frontend's public port (PATHWAY_SERVE_PUBLIC_PORT): a
+        # program with a second webserver on another port must not have
+        # both rebound onto one backend port (instant EADDRINUSE and a
+        # rollback loop). Without the public-port var (standalone
+        # frontends, older supervisors) every webserver rewrites, as
+        # before.
+        backend = os.environ.get("PATHWAY_SERVE_BACKEND_PORT")
+        public = os.environ.get("PATHWAY_SERVE_PUBLIC_PORT")
+        if backend:
+            try:
+                if not public or int(public) == port:
+                    host, port = "127.0.0.1", int(backend)
+            except ValueError:
+                pass
         self.host = host
         self.port = port
         self.with_cors = with_cors
@@ -268,6 +308,12 @@ class RestServerSubject(ConnectorSubject):
     dispatch; ``delete_completed_queries`` retractions are batched and
     ride the next window's commit instead of paying their own."""
 
+    # serving requests are ephemeral: they must never enter the input
+    # journal (io/_connector.py) — a rolled-back epoch's journaled
+    # queries replayed at epoch+1 would double-dispatch the very
+    # requests the frontend is already replaying with live futures
+    _ephemeral = True
+
     def __init__(
         self,
         webserver: PathwayWebserver,
@@ -282,6 +328,9 @@ class RestServerSubject(ConnectorSubject):
         queue_cap: int | None = None,
         timeout_s: float | None = None,
         workers: int | None = None,
+        brownout_answer=None,
+        breaker_threshold: int | None = None,
+        breaker_cooldown_s: float | None = None,
     ):
         super().__init__()
         self.webserver = webserver
@@ -317,6 +366,36 @@ class RestServerSubject(ConnectorSubject):
             workers
             if workers is not None
             else _env_knob("PATHWAY_SERVE_WORKERS", 1)
+        )
+        # -- brownout + dispatch circuit breaker (ISSUE 9) ---------------
+        # consecutive dispatch failures or request-deadline breaches
+        # open the breaker; while open, requests answer DEGRADED from
+        # the last committed snapshot (brownout_answer, Degraded: true)
+        # under PATHWAY_SERVE_BROWNOUT=1 instead of shedding
+        self.brownout_answer = brownout_answer
+        self.brownout_enabled = str(
+            os.environ.get("PATHWAY_SERVE_BROWNOUT", "0")
+        ).strip().lower() in ("1", "true", "yes")
+        self.breaker_threshold = int(
+            breaker_threshold
+            if breaker_threshold is not None
+            else _env_knob("PATHWAY_SERVE_BREAKER_THRESHOLD", 5)
+        )
+        self.breaker_cooldown_s = (
+            breaker_cooldown_s
+            if breaker_cooldown_s is not None
+            else _env_knob("PATHWAY_SERVE_BREAKER_COOLDOWN_S", 5.0)
+        )
+        self._breaker = "closed"
+        self._breaker_failures = 0  # consecutive, dispatch + deadline
+        self._breaker_opened_at = 0.0
+        self._breaker_lock = threading.Lock()
+        # X-Pathway-Request-Id is honored ONLY behind the
+        # epoch-survivable frontend (loopback backend bind): on a public
+        # gateway the header is client-spoofable — two requests naming
+        # the same id would collide on one dataflow key and future slot
+        self._frontend_mode = bool(
+            os.environ.get("PATHWAY_SERVE_BACKEND_PORT")
         )
         self.serve_metrics = ServeMetrics(route=route)
         # collecting window (event-loop thread only) + closed-window queue
@@ -376,6 +455,86 @@ class RestServerSubject(ConnectorSubject):
             for t in self._dispatchers:
                 t.join(timeout=2)
             self._dispatchers.clear()
+
+    def abort_windows_for_rollback(self) -> int:
+        """Epoch-abort half of request parking (engine/runtime.py calls
+        this before the supervised exit): queued-but-undispatched windows
+        are aborted — every member evicted, so a racing dispatch worker
+        commits NOTHING for them (the all-parked-window invariant) — and
+        their requests are left to the frontend, which holds the real
+        client futures and replays them into epoch+1. Returns the number
+        of windows aborted."""
+        n = 0
+        sentinels = 0
+        while True:
+            try:
+                window = self._windows_q.get_nowait()
+            except _queue.Empty:
+                break
+            if window is None:
+                # a worker stop sentinel (on_stop racing the rollback):
+                # swallowing it would leave a dispatch worker blocked in
+                # get() past its join timeout — put it back
+                sentinels += 1
+                continue
+            for p in window:
+                p.evicted = True
+            if window:
+                n += 1
+        for _ in range(sentinels):
+            self._windows_q.put(None)
+        # the collecting (not yet closed) window parks the same way —
+        # and counts: in the low-traffic case it is often the ONLY
+        # window, and the abort must still be observable
+        if any(not p.evicted for p in self._window):
+            n += 1
+        for p in self._window:
+            p.evicted = True
+        if n:
+            self.serve_metrics.on_windows_aborted(n)
+        return n
+
+    # -- dispatch circuit breaker (protocol.breaker_decide) ----------------
+    def _breaker_now(self) -> str:
+        """Current breaker verdict; transitions open -> half_open after
+        the cooldown so ONE probe window can close it again."""
+        with self._breaker_lock:
+            state = _proto.breaker_decide(
+                self._breaker,
+                self._breaker_failures,
+                self.breaker_threshold,
+                _time.monotonic() - self._breaker_opened_at,
+                self.breaker_cooldown_s,
+            )
+            self._breaker = state
+        if self.serve_metrics.breaker_state != state:
+            self.serve_metrics.set_breaker(state)
+        return state
+
+    def _breaker_record(self, ok: bool) -> None:
+        with self._breaker_lock:
+            if ok:
+                self._breaker_failures = 0
+                self._breaker = "closed"
+            elif self.breaker_threshold > 0:
+                self._breaker_failures += 1
+                if self._breaker != "closed":
+                    # a failing half_open probe (or a failure while
+                    # already open) re-arms the full cooldown
+                    self._breaker = "open"
+                    self._breaker_opened_at = _time.monotonic()
+                elif _proto.breaker_decide(
+                    "closed",
+                    self._breaker_failures,
+                    self.breaker_threshold,
+                    0.0,
+                    self.breaker_cooldown_s,
+                ) == "open":
+                    self._breaker = "open"
+                    self._breaker_opened_at = _time.monotonic()
+        state = self._breaker
+        if self.serve_metrics.breaker_state != state:
+            self.serve_metrics.set_breaker(state)
 
     # -- request path (webserver event loop) ------------------------------
     async def _handle(self, request):
@@ -447,6 +606,42 @@ class RestServerSubject(ConnectorSubject):
 
         metrics = self.serve_metrics
         metrics.on_request()
+        # dispatch circuit breaker (ISSUE 9): consecutive dispatch
+        # failures / deadline breaches opened it — answer DEGRADED from
+        # the last committed snapshot (no update-fold, no device
+        # dispatch) instead of shedding when brownout is on; cooldown
+        # half-opens it so one probe window can close it again
+        if self.breaker_threshold > 0:
+            breaker = self._breaker_now()
+            if breaker == "open":
+                if self.brownout_enabled and self.brownout_answer is not None:
+                    try:
+                        result = await asyncio.get_event_loop()\
+                            .run_in_executor(
+                                None, self.brownout_answer, dict(values)
+                            )
+                    except Exception as exc:
+                        return web.json_response(
+                            {"error": f"brownout answer failed: {exc}"},
+                            status=503,
+                            headers={
+                                "Retry-After": str(self._retry_after_s())
+                            },
+                        )
+                    metrics.on_brownout()
+                    return web.json_response(
+                        result, headers={"Degraded": "true"}
+                    )
+                metrics.on_shed()
+                return web.json_response(
+                    {"error": "device dispatch degraded, retry later"},
+                    status=503,
+                    headers={
+                        "Retry-After": str(
+                            _proto.serve_retry_after(self.breaker_cooldown_s)
+                        )
+                    },
+                )
         # admission control: bounded in-flight backlog; overflow is shed
         # rather than queued into latency the client will time out on
         # anyway (the device is behind the N/C capacity line)
@@ -457,9 +652,23 @@ class RestServerSubject(ConnectorSubject):
                 status=503,
                 headers={"Retry-After": str(self._retry_after_s())},
             )
-        with self._lock:
-            self._seq += 1
-            key = ref_scalar("rest", self.route, self._seq)
+        # the epoch-survivable frontend stamps its own request id so a
+        # request REPLAYED into epoch+1 keys the same dataflow row — an
+        # upsert, idempotent even if the dead epoch's row survived in a
+        # restored snapshot (the park/replay exactly-once boundary).
+        # Only trusted in frontend mode: the loopback bind means the
+        # header can only come from the frontend itself.
+        rid = (
+            request.headers.get("X-Pathway-Request-Id")
+            if self._frontend_mode
+            else None
+        )
+        if rid is not None:
+            key = ref_scalar("rest", self.route, "rid", rid)
+        else:
+            with self._lock:
+                self._seq += 1
+                key = ref_scalar("rest", self.route, self._seq)
         future: asyncio.Future = asyncio.get_event_loop().create_future()
         self._tasks[key] = future
         pending = _PendingRequest(key, values, future)
@@ -472,6 +681,9 @@ class RestServerSubject(ConnectorSubject):
             # vanishes before it can occupy a batch slot / device dispatch
             pending.evicted = True
             metrics.on_timeout()
+            # a deadline breach is a breaker signal: a wedged device
+            # path shows up as timeouts long before dispatch exceptions
+            self._breaker_record(False)
             return web.json_response({"error": "timeout"}, status=504)
         except asyncio.CancelledError:
             # client disconnected: same eviction semantics as a timeout
@@ -528,6 +740,8 @@ class RestServerSubject(ConnectorSubject):
             try:
                 self._dispatch_window(window)
             except Exception:
+                # consecutive dispatch failures open the circuit breaker
+                self._breaker_record(False)
                 # a failing dispatch must fail the window's futures, not
                 # kill the worker (clients would hang to their timeouts)
                 loop = self.webserver._loop
@@ -557,6 +771,10 @@ class RestServerSubject(ConnectorSubject):
                 removals, self._removals = self._removals, []
             if not live and not removals:
                 return
+            # chaos slot: kill with the window formed but its upserts
+            # not yet committed (the all-parked-window invariant: this
+            # window must commit NOTHING at epoch+1 unless replayed)
+            _faults.fault_point("serve.dispatch", phase="window")
             try:
                 for p in live:
                     if self.delete_completed_queries:
@@ -576,6 +794,10 @@ class RestServerSubject(ConnectorSubject):
                     with self._removals_lock:
                         self._removals[:0] = removals
                 raise
+            # chaos slot: window committed in-memory, responses not yet
+            # delivered — the frontend must replay (the rollback cut
+            # discards this commit) without double-answering anyone
+            _faults.fault_point("serve.dispatch", phase="committed")
             if live:
                 self.serve_metrics.on_window(len(live))
 
@@ -584,6 +806,12 @@ class RestServerSubject(ConnectorSubject):
         """One delivered response batch (= one window downstream):
         resolve every future in a single cross-thread hop and queue the
         completed rows' retractions onto the next commit."""
+        # breaker success is RESPONSE DELIVERY, not window commit: a
+        # wedged device path keeps committing windows in-memory while
+        # answers never arrive — commits must not reset the
+        # deadline-breach streak or the breaker could never open for
+        # exactly the scenario it exists for
+        self._breaker_record(True)
         loop = self.webserver._loop
         futures = []
         for key, result in resolved:
@@ -654,6 +882,9 @@ def rest_connector(
     queue_cap: int | None = None,
     timeout_s: float | None = None,
     workers: int | None = None,
+    brownout_answer=None,
+    breaker_threshold: int | None = None,
+    breaker_cooldown_s: float | None = None,
 ):
     """Returns (queries_table, response_writer) (reference: _server.py:624).
 
@@ -692,6 +923,9 @@ def rest_connector(
         queue_cap=queue_cap,
         timeout_s=timeout_s,
         workers=workers,
+        brownout_answer=brownout_answer,
+        breaker_threshold=breaker_threshold,
+        breaker_cooldown_s=breaker_cooldown_s,
     )
     queries = python_read(
         subject, schema=schema, autocommit_duration_ms=autocommit_duration_ms

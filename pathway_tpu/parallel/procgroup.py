@@ -172,6 +172,38 @@ def stable_shard_many(values, world: int) -> list[int]:
     ]
 
 
+def _bind_listener(
+    host: str, port: int, backlog: int = 8, retry_s: float = 3.0
+) -> socket.socket:
+    """Bind the mesh listener with ``SO_REUSEADDR`` (a dead epoch's
+    TIME_WAIT sockets must not block the recovered mesh) and a bounded
+    in-place retry: the supervisor probes the port base before spawning,
+    but the dying epoch's listener can still hold the port for a beat
+    between reap and respawn — every rank must keep ``first_port + r``,
+    so waiting it out briefly beats burning a rollback-budget restart on
+    EADDRINUSE."""
+    deadline = _time.monotonic() + retry_s
+    while True:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, port))
+            s.listen(backlog)
+            return s
+        except OSError:
+            s.close()
+            if _time.monotonic() > deadline:
+                raise
+            _time.sleep(0.05)
+
+
+# struct tcp_info (linux/tcp.h): 8 one-byte fields, then u32s — index 12
+# of the u32 block is tcpi_last_ack_recv (ms since the peer's kernel last
+# ACKed us). TCP_ESTABLISHED = 1.
+_TCP_INFO_LAST_ACK_OFF = 8 + 12 * 4
+_TCP_ESTABLISHED = 1
+
+
 class _MeshError:
     """Receiver-thread verdict queued in place of a frame: recv() raises
     it as ConnectionError with the real reason (oversized/corrupt frame)
@@ -250,13 +282,10 @@ class ProcessGroup:
         }
         self._recv_threads: list[threading.Thread] = []
         self._closed = False
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         loopback_only = all(
             h in ("127.0.0.1", "localhost", "::1") for h in hosts
         )
         if not loopback_only and not os.environ.get("PATHWAY_MESH_SECRET"):
-            self._listener.close()
             raise RuntimeError(
                 "PATHWAY_HOSTS names non-loopback hosts but "
                 "PATHWAY_MESH_SECRET is not set. Mesh frames are pickled "
@@ -264,10 +293,11 @@ class ProcessGroup:
                 "interface under the built-in default key: set a shared "
                 "PATHWAY_MESH_SECRET on every rank."
             )
-        self._listener.bind(
-            ("127.0.0.1" if loopback_only else "0.0.0.0", first_port + rank)
+        self._listener = _bind_listener(
+            "127.0.0.1" if loopback_only else "0.0.0.0",
+            first_port + rank,
+            backlog=world,
         )
-        self._listener.listen(world)
         self._connect_mesh(first_port, timeout)
 
     def _mac(self, role: bytes, nonces: bytes, prover: int, verifier: int) -> bytes:
@@ -673,6 +703,37 @@ class ProcessGroup:
         except Exception:
             return None
 
+    def _transport_alive(self, peer: int) -> bool:
+        """Busy-rank heartbeat fix (ISSUE 9 satellite): a peer whose
+        Python threads are starved — a long GIL-held native dispatch, a
+        fused device call, a multi-second pickle — sends neither frames
+        nor PWHB beats, but its KERNEL still ACKs ours. Probe TCP_INFO:
+        connection ESTABLISHED and an ACK received within the liveness
+        window means the process exists and the host is reachable, so
+        the app-level silence is busyness, not death. A crashed process
+        FINs/RSTs (the receiver thread sees EOF → MeshPeerFailure via
+        the disconnect path, no timer involved) and a dead host stops
+        ACKing, so both real failure classes still fail fast. Non-Linux
+        or probe failure returns False — the historical verdict."""
+        s = self._socks.get(peer)
+        if s is None:
+            return False
+        try:
+            info = s.getsockopt(
+                socket.IPPROTO_TCP, socket.TCP_INFO, 104
+            )
+        except (OSError, AttributeError):
+            return False
+        if len(info) <= _TCP_INFO_LAST_ACK_OFF + 4 or info[0] != _TCP_ESTABLISHED:
+            return False
+        last_ack_ms = int.from_bytes(
+            info[_TCP_INFO_LAST_ACK_OFF:_TCP_INFO_LAST_ACK_OFF + 4],
+            "little",
+        )
+        # the ACK clock only advances while WE send (heartbeats, every
+        # interval) — recent ACKs therefore prove the round trip
+        return last_ack_ms <= self._peer_timeout * 1000.0
+
     def op_deadline(self) -> float | None:
         """One PATHWAY_MESH_OP_TIMEOUT_S deadline, minted at the START of
         a multi-peer collective and passed to each of its recvs — so the
@@ -708,10 +769,19 @@ class ProcessGroup:
                     if check_liveness:
                         idle = now - self._last_seen.get(peer, now)
                         # the liveness verdict is a protocol decision —
-                        # the checker's detection model uses the same one
+                        # the checker's detection model uses the same
+                        # one. The transport probe (only consulted past
+                        # the idle window, so no syscall on the hot
+                        # path) keeps healthy-but-busy ranks alive: a
+                        # GIL-starved peer can't beat, but its kernel
+                        # still ACKs our heartbeats.
                         if _proto.peer_liveness(
                             idle, self._peer_timeout,
                             peer in self._goodbye,
+                            transport_alive=(
+                                idle > self._peer_timeout
+                                and self._transport_alive(peer)
+                            ),
                         ) == "failed":
                             if self.stats is not None:
                                 self.stats.on_mesh_heartbeat_missed()

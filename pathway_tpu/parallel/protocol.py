@@ -213,15 +213,31 @@ def hello_accept(
 # -- failure detection (parallel/procgroup.py recv) ------------------------
 
 def peer_liveness(
-    idle_s: float, peer_timeout_s: float, goodbye: bool
+    idle_s: float,
+    peer_timeout_s: float,
+    goodbye: bool,
+    transport_alive: bool = False,
 ) -> str:
     """Liveness verdict for a peer that has sent nothing for ``idle_s``
     seconds: ``"alive"`` or ``"failed"``. A peer that announced an
     orderly goodbye is never *failed* (its silence is expected), and a
-    non-positive timeout disables the detector."""
+    non-positive timeout disables the detector.
+
+    ``transport_alive`` is the busy-rank escape hatch: app-level silence
+    past the timeout with the peer's TRANSPORT still demonstrably live
+    (TCP ESTABLISHED and its kernel ACKing our heartbeats) means the
+    peer process exists but cannot run Python — a long GIL-held native
+    dispatch or fused device call, not a crash. Declaring it failed
+    would roll back a healthy mesh; a genuinely hung peer is still
+    bounded by the collective deadline (``MeshTimeout``). A crashed
+    process closes its sockets (EOF reaches the receiver thread) and a
+    dead host stops ACKing, so both real failure classes keep
+    ``transport_alive`` False."""
     if goodbye or peer_timeout_s <= 0:
         return "alive"
-    return "failed" if idle_s > peer_timeout_s else "alive"
+    if idle_s <= peer_timeout_s:
+        return "alive"
+    return "alive" if transport_alive else "failed"
 
 
 def classify_peer_loss(goodbye: bool) -> str:
@@ -262,6 +278,113 @@ def supervisor_decide(
     return ("rollback", 1)
 
 
+# -- serving plane: park/replay across rollback (ISSUE 9) -------------------
+# The epoch-survivable frontend (io/http/_frontend.py) and the gateway's
+# brownout breaker (io/http/_server.py) drive through these; the serving
+# model checker (analysis/meshcheck.py check_serving) explores the same
+# functions over every crash interleaving, so "no admitted request is
+# lost or answered twice across a rollback" is checked against the code
+# that actually runs.
+
+SERVE_STATES = ("serving", "draining", "recovering")
+
+
+def serve_frontend_state(backend_up: bool, draining: bool) -> str:
+    """The frontend readiness state exposed on ``/healthz``: draining
+    wins (shutdown was requested — shed everything so an LB rotates us
+    out), otherwise serving iff the backend epoch is attached."""
+    if draining:
+        return "draining"
+    return "serving" if backend_up else "recovering"
+
+
+def serve_admit(
+    state: str,
+    inflight: int,
+    queue_cap: int,
+    parked: int,
+    park_budget: int,
+) -> str:
+    """Admission verdict for one arriving request: ``"admit"`` |
+    ``"park"`` | ``"shed"``. While recovering, arrivals PARK (futures
+    retained, replayed into epoch+1) up to the park budget instead of
+    being shed — a rollback is a latency blip, not an outage; past the
+    budget (or while draining) they shed with 503 + Retry-After."""
+    if state == "draining":
+        return "shed"
+    if state == "recovering":
+        return "park" if parked < park_budget else "shed"
+    return "admit" if inflight < queue_cap else "shed"
+
+
+def serve_park(
+    inflight_ids: Iterable[int], responded_ids: Iterable[int]
+) -> list[int]:
+    """The park set at backend loss: every admitted request without a
+    delivered response. A request whose response was already delivered
+    is TERMINAL — replaying it would answer the client twice (the
+    exactly-once boundary; the ``replay_committed_window`` mutant breaks
+    exactly this and the serving checker must catch it)."""
+    responded = set(responded_ids)
+    return sorted(i for i in inflight_ids if i not in responded)
+
+
+def serve_replay_split(
+    parked: Sequence[int],
+    now_s: float,
+    deadlines_s: Mapping[int, float],
+) -> tuple[list[int], list[int]]:
+    """``(replay, expired)`` over the parked set at re-attach, in parked
+    (arrival) order: requests whose admission deadline budget survived
+    the outage replay into the first window of epoch+1; the rest are
+    answered 503 + Retry-After (deadline accounting — never a dropped
+    connection)."""
+    replay: list[int] = []
+    expired: list[int] = []
+    for rid in parked:
+        if now_s < deadlines_s[rid]:
+            replay.append(rid)
+        else:
+            expired.append(rid)
+    return replay, expired
+
+
+def serve_retry_after(
+    observed_restart_s: float, default_s: float = 1.0, hi: float = 600.0
+) -> int:
+    """Retry-After (whole seconds) for a shed or deadline-expired
+    request, sized by the OBSERVED epoch restart time — clients back off
+    for as long as a rollback actually takes here, not a made-up
+    constant."""
+    est = observed_restart_s if observed_restart_s > 0 else default_s
+    est = min(hi, max(1.0, est))
+    n = int(est)
+    return n if n >= est else n + 1
+
+
+def breaker_decide(
+    state: str,
+    consecutive_failures: int,
+    threshold: int,
+    since_open_s: float,
+    cooldown_s: float,
+) -> str:
+    """Circuit breaker on the device-dispatch path: ``"closed"`` |
+    ``"open"`` | ``"half_open"``. Consecutive dispatch failures or
+    request-deadline breaches reaching ``threshold`` open it (requests
+    then brown out or shed instead of queueing into a failing device
+    path); after ``cooldown_s`` it half-opens to probe with one window —
+    success closes it, failure re-opens. ``threshold <= 0`` disables
+    the breaker entirely."""
+    if threshold <= 0:
+        return "closed"
+    if state == "closed":
+        return "open" if consecutive_failures >= threshold else "closed"
+    if since_open_s >= cooldown_s:
+        return "half_open"
+    return "open"
+
+
 # -- the transition table ---------------------------------------------------
 # Single source of truth for the anti-drift pins: the engine modules
 # bind their protocol decisions FROM this table at import, and
@@ -280,4 +403,10 @@ TRANSITIONS: dict[str, object] = {
     "peer_liveness": peer_liveness,
     "classify_peer_loss": classify_peer_loss,
     "supervisor_decide": supervisor_decide,
+    "serve_frontend_state": serve_frontend_state,
+    "serve_admit": serve_admit,
+    "serve_park": serve_park,
+    "serve_replay_split": serve_replay_split,
+    "serve_retry_after": serve_retry_after,
+    "breaker_decide": breaker_decide,
 }

@@ -97,16 +97,30 @@ logger = logging.getLogger(__name__)
 def _free_port_base(n: int) -> int:
     """A base port with n consecutive free ports — each epoch gets a
     fresh range so late packets/TIME_WAIT of the dead epoch cannot
-    collide with the recovered mesh's listeners."""
+    collide with the recovered mesh's listeners.
+
+    Probes bind with ``SO_REUSEADDR`` — the same option the mesh
+    listeners themselves use — so a range is only rejected for ports
+    another live socket actually owns, not for TIME_WAIT remnants of
+    the epoch we just reaped (which the ranks' own REUSEADDR bind would
+    sail past anyway). The whole range is held until every port proved
+    bindable, shrinking the probe-to-bind race window; the residual
+    race (an unrelated process grabbing a port between our close and
+    the rank's bind) is absorbed by the ranks' bounded bind retry
+    (procgroup ``_bind_listener``)."""
     for _ in range(64):
         probe = socket.socket()
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         probe.bind(("127.0.0.1", 0))
         base = probe.getsockname()[1]
         probe.close()
+        if base + n > 65535:
+            continue
         held = []
         try:
             for i in range(n):
                 s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
                 s.bind(("127.0.0.1", base + i))
                 held.append(s)
             return base
@@ -140,6 +154,8 @@ class MeshSupervisor:
         env: dict | None = None,
         clear_fault_plan_on_restart: bool = True,
         poll_s: float = 0.05,
+        serve_frontend: int | None = None,
+        serve_backend_port: int | None = None,
     ):
         if processes is None:
             processes = int(os.environ.get("PATHWAY_PROCESSES", "2") or 2)
@@ -158,13 +174,61 @@ class MeshSupervisor:
         self.env = env
         self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
         self.poll_s = poll_s
+        # epoch-survivable serving frontend (ISSUE 9): when a public
+        # port is given, the supervisor owns the HTTP listener across
+        # rollbacks — every epoch's gateway binds the loopback backend
+        # port instead (PATHWAY_SERVE_BACKEND_PORT in the rank env) and
+        # in-flight requests park at the frontend through the blip
+        self.serve_frontend_port = serve_frontend
+        self.serve_backend_port = serve_backend_port
+        self.frontend = None
         # exposed for tests/observability
         self.epoch = 0
         self.restarts_performed = 0
         self.history: list[list[int]] = []  # per-epoch exit codes
 
+    def _start_frontend(self) -> None:
+        """Bring the serving frontend up once, before epoch 0: it holds
+        the public listener for the supervisor's whole lifetime while
+        epochs come and go on the backend port. _frontend.py is loaded
+        by file path like protocol.py above (stdlib-only), so
+        file-path-loaded supervisors stay import-light."""
+        if self.serve_frontend_port is None or self.frontend is not None:
+            return
+        if self.serve_backend_port is None:
+            self.serve_backend_port = _free_port_base(1)
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "_pw_serve_frontend",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "io", "http", "_frontend.py",
+            ),
+        )
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        self.frontend = mod.ServingFrontend(
+            host="0.0.0.0",
+            port=self.serve_frontend_port,
+            backend_port=self.serve_backend_port,
+        ).start()
+        logger.info(
+            "mesh supervisor: serving frontend up on :%d (backend :%d)",
+            self.serve_frontend_port,
+            self.serve_backend_port,
+        )
+
     def _spawn_epoch(self, epoch: int) -> list[subprocess.Popen]:
         port = _free_port_base(self.processes)
+        # the serve backend port is FREE at respawn time (the dead
+        # epoch's gateway just released it) — a mesh range swallowing it
+        # would leave epoch+1's gateway with EADDRINUSE while the
+        # frontend's attach probe happily connects to a mesh listener
+        while self.serve_backend_port is not None and (
+            port <= self.serve_backend_port < port + self.processes
+        ):
+            port = _free_port_base(self.processes)
         procs = []
         for rank in range(self.processes):
             env = dict(os.environ)
@@ -177,6 +241,16 @@ class MeshSupervisor:
                 PATHWAY_MESH_EPOCH=str(epoch),
                 PATHWAY_MESH_SUPERVISED="1",
             )
+            if self.serve_backend_port is not None:
+                env["PATHWAY_SERVE_BACKEND_PORT"] = str(
+                    self.serve_backend_port
+                )
+                if self.serve_frontend_port is not None:
+                    # scopes the gateway's backend rewrite to the ONE
+                    # webserver bound to the frontend's public port
+                    env["PATHWAY_SERVE_PUBLIC_PORT"] = str(
+                        self.serve_frontend_port
+                    )
             # emulated-lane inheritance would turn real ranks back into
             # thread companions
             env.pop("PATHWAY_LANE_PROCESSES", None)
@@ -214,6 +288,13 @@ class MeshSupervisor:
         try:
             return self._run(procs)
         finally:
+            if self.frontend is not None:
+                # shed new arrivals (Retry-After) while the rank set
+                # winds down, then release the public listener
+                try:
+                    self.frontend.drain()
+                except Exception:
+                    pass
             for p in procs:
                 if p.poll() is None:
                     try:
@@ -223,6 +304,12 @@ class MeshSupervisor:
             for p in procs:
                 if p.poll() is None:
                     p.wait()
+            if self.frontend is not None:
+                try:
+                    self.frontend.stop()
+                except Exception:
+                    pass
+                self.frontend = None
             self._merge_trace_fallback()
 
     def _merge_trace_fallback(self) -> None:
@@ -268,6 +355,7 @@ class MeshSupervisor:
             )
 
     def _run(self, procs: list[subprocess.Popen]) -> int:
+        self._start_frontend()
         while True:
             procs[:] = self._spawn_epoch(self.epoch)
             logger.info(
@@ -343,6 +431,17 @@ def main(argv: Sequence[str] | None = None) -> int:
     ap.add_argument("--processes", type=int, default=None)
     ap.add_argument("--max-restarts", type=int, default=None)
     ap.add_argument("--grace", type=float, default=None)
+    ap.add_argument(
+        "--serve-frontend", type=int, default=None, metavar="PORT",
+        help="own this public HTTP port across rollbacks: epochs bind a "
+        "loopback backend port (PATHWAY_SERVE_BACKEND_PORT) and "
+        "in-flight requests park/replay through mesh restarts",
+    )
+    ap.add_argument(
+        "--serve-backend-port", type=int, default=None,
+        help="explicit backend port for --serve-frontend (default: a "
+        "free port probed at startup)",
+    )
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = list(args.command)
@@ -362,6 +461,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         args.processes,
         max_restarts=args.max_restarts,
         grace_s=args.grace,
+        serve_frontend=args.serve_frontend,
+        serve_backend_port=args.serve_backend_port,
     ).run()
 
 

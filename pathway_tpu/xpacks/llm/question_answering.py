@@ -385,12 +385,18 @@ class RAGClient:
     gateway pays connection setup once, not per query."""
 
     def __init__(self, host: str | None = None, port: int | None = None,
-                 url: str | None = None, timeout: int = 90):
+                 url: str | None = None, timeout: int = 90,
+                 retries: int = 0):
         from pathway_tpu.io.http import KeepAliveSession
 
         self.url = url or f"http://{host}:{port}"
         self.timeout = timeout
-        self._session = KeepAliveSession(self.url, timeout=timeout)
+        # retries > 0 opts into the session's bounded 503/Retry-After
+        # retry (the documented backpressure contract) instead of
+        # treating a shed/brownout 503 as terminal
+        self._session = KeepAliveSession(
+            self.url, timeout=timeout, retries=retries
+        )
 
     def _post(self, route: str, payload: dict):
         return self._session.post(route, payload)

@@ -346,7 +346,9 @@ class VectorStoreServer:
         gateway_kwargs = {
             k: kwargs.pop(k)
             for k in (
-                "window_ms", "max_batch", "queue_cap", "timeout_s", "workers"
+                "window_ms", "max_batch", "queue_cap", "timeout_s",
+                "workers", "brownout_answer", "breaker_threshold",
+                "breaker_cooldown_s",
             )
             if k in kwargs
         }
@@ -385,12 +387,18 @@ class VectorStoreClient:
     closed-loop client pays connection setup once, not per query."""
 
     def __init__(self, host: str | None = None, port: int | None = None,
-                 url: str | None = None, timeout: int = 15):
+                 url: str | None = None, timeout: int = 15,
+                 retries: int = 0):
         from pathway_tpu.io.http import KeepAliveSession
 
         self.url = url or f"http://{host}:{port}"
         self.timeout = timeout
-        self._session = KeepAliveSession(self.url, timeout=timeout)
+        # retries > 0 opts into the session's bounded 503/Retry-After
+        # retry — the documented backpressure contract (admission sheds,
+        # brownout, parked-deadline expiry during a mesh rollback)
+        self._session = KeepAliveSession(
+            self.url, timeout=timeout, retries=retries
+        )
 
     def _post(self, route: str, payload: dict):
         return self._session.post(route, payload)

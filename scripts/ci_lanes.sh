@@ -83,4 +83,18 @@ echo "=== lane 7: flight-recorder trace smoke (2-rank merge + profile) ==="
 # exits 0 naming the top self-time node with its fused/degraded verdict
 env -u PATHWAY_LANE_PROCESSES python scripts/trace_smoke.py
 
+echo "=== lane 8: serve-through-rollback chaos smoke (kill under load) ==="
+# real-fork 2-rank mesh behind the epoch-survivable serving frontend,
+# driven by concurrent keep-alive clients with Retry-After retries:
+# rank 1 is hard-killed mid-wave (= mid-window-dispatch) under live
+# load, and the cell asserts ZERO dropped connections (every admitted
+# request gets a terminal response), the frontend's exactly-once
+# conservation law, an observed rollback with parked-request replays
+# into epoch+1, and records the recovery-window p99. The full grid
+# (kill phase × victim × {park-replay, brownout}) runs via
+# `python scripts/fault_matrix.py --serve`; the serving park/replay
+# protocol itself is model-checked by `python -m pathway_tpu.analysis
+# --serve` (mutant: --serve-mutant replay_committed_window).
+env -u PATHWAY_LANE_PROCESSES python scripts/serve_chaos_smoke.py
+
 echo "=== all lanes green ==="

@@ -533,6 +533,67 @@ def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
     return results
 
 
+# ---------------------------------------------------------------------------
+# serve grid: kill-under-load serving cells (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+# (mode, phase, victim): park-replay cells kill a rank mid-wave
+# (mesh.rank_kill) or mid-window on the gateway rank (serve.dispatch
+# window/committed); the brownout cell injects deterministic dispatch
+# failures under PATHWAY_SERVE_BROWNOUT=1 with a threshold-1 breaker.
+SERVE_CELLS = [
+    ("park_replay", "wave_send", 1),
+    ("park_replay", "wave_send", 0),
+    ("park_replay", "window", 0),
+    ("park_replay", "committed", 0),
+    ("brownout", "window", 0),
+]
+
+
+def _load_serve_chaos():
+    """scripts/serve_chaos_smoke.py loaded by file path; its heavy
+    imports (the KeepAliveSession client pulls the package) happen
+    lazily inside run_cell, so fault_matrix without --serve stays
+    import-light."""
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "serve_chaos_smoke.py")
+    spec = importlib.util.spec_from_file_location("_pw_serve_chaos", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_serve_cells(timeout: float) -> list[CellResult]:
+    """The serve grid: every cell is a real supervisor + frontend +
+    2-rank mesh under live closed-loop keep-alive load, asserting zero
+    dropped connections, the frontend's exactly-once conservation law,
+    and (park-replay cells) an observed rollback with replays."""
+    chaos = _load_serve_chaos()
+    results: list[CellResult] = []
+    for mode, phase, victim in SERVE_CELLS:
+        summary = chaos.run_cell(
+            mode=mode, phase=phase, victim=victim, timeout=timeout
+        )
+        detail = (
+            f"200s={summary['responses_200']}/{summary['requests']} "
+            f"parked={summary['parked']:g} replayed={summary['replayed']:g} "
+            f"p99={summary['recovery_p99_s']}s"
+            if summary["ok"]
+            else "; ".join(summary.get("problems", ["?"]))[:300]
+        )
+        res = CellResult(
+            f"serve.{mode}/{phase}", f"serve-r{victim}", 1,
+            summary["ok"], detail,
+        )
+        results.append(res)
+        status = "PASS" if res.ok else "FAIL"
+        print(
+            f"{status}  {res.point:<32} mode={res.mode:<9} {res.detail}"
+        )
+    return results
+
+
 def _run_scenario(script, mode, tmp, n_rows, plan, timeout):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("PATHWAY_FAULT_PLAN", None)
@@ -639,6 +700,11 @@ def main(argv=None) -> int:
         help="replay mesh-verifier counterexample traces "
         "(--mesh --json output) as real kill-and-resume cells",
     )
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="run the serve-through-rollback grid (kill phase × victim "
+        "rank × {park-replay, brownout} under live closed-loop load)",
+    )
     args = ap.parse_args(argv)
     hits = [int(h) for h in args.hits.split(",") if h]
 
@@ -647,6 +713,12 @@ def main(argv=None) -> int:
         results.extend(
             run_trace_cells(args.from_trace, max(args.timeout, 180))
         )
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.serve:
+        results.extend(run_serve_cells(max(args.timeout, 240)))
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

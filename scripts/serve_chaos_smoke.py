@@ -1,0 +1,391 @@
+"""Serve-through-rollback chaos lane (scripts/ci_lanes.sh lane 8;
+ISSUE 9 acceptance cell).
+
+One cell = a REAL 2-rank mesh serving live closed-loop keep-alive
+traffic through the epoch-survivable frontend, with a rank hard-killed
+mid-load (``mesh.rank_kill`` mid-wave, or ``serve.dispatch`` mid-window
+on the gateway rank), asserting the contract the tentpole promises:
+
+* **zero dropped connections** — every client request gets a terminal
+  HTTP response (result, degraded result, or deadline 503 +
+  Retry-After); a client-side transport error is a FAIL;
+* **exactly-once audit** — no request is answered twice (each request
+  id sees exactly one terminal), and the frontend's conservation law
+  holds: ``admitted == responses + deadline_expired + timeouts``;
+* **the rollback actually happened** — the frontend observed a backend
+  loss and replayed parked requests into epoch+1 (``serve_parked_total``
+  / ``serve_replayed_total`` >= 1, ``serve_epoch_handoff_seconds`` has a
+  sample);
+* **recovery-window p99 recorded** — per-request latencies are measured
+  across the blip and reported in the summary JSON.
+
+The ``brownout`` mode instead injects deterministic dispatch failures
+(``serve.dispatch`` raise) with a threshold-1 breaker under
+``PATHWAY_SERVE_BROWNOUT=1`` and asserts degraded answers (``Degraded:
+true``) flow instead of sheds.
+
+Clients use :class:`pathway_tpu.io.http.KeepAliveSession` with the
+opt-in bounded ``Retry-After`` retry — the documented backpressure
+contract, not a reimplementation of it.
+
+Exit 0 on success with a JSON summary line. ``scripts/fault_matrix.py
+--serve`` drives :func:`run_cell` over the full grid (kill phase ×
+victim rank × {park-replay, brownout}).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SUPERVISOR = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+
+N_CLIENTS = 6
+N_PER_CLIENT = 10
+
+SCENARIO = r'''
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+
+class S(pw.Schema):
+    value: int
+
+
+# the public host:port is owned by the supervisor's frontend; the
+# gateway transparently binds PATHWAY_SERVE_BACKEND_PORT instead
+webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=%(port)d)
+queries, writer = pw.io.http.rest_connector(
+    webserver=webserver,
+    schema=S,
+    window_ms=20.0,
+    max_batch=64,
+    # brownout cells answer from the "last committed snapshot" — here a
+    # pure function of the request, standing in for a snapshot read
+    brownout_answer=lambda values: values["value"] * 3,
+)
+# a cross-rank leg per window: group by the request's own key so the
+# window's rows hash-exchange across the mesh (rank 1 owns a shard) —
+# killing a rank mid-wave is killing it mid-window-dispatch
+agg = queries.groupby(pw.this.value).reduce(
+    value=pw.this.value, c=pw.reducers.count()
+)
+res = queries.join(agg, queries.value == agg.value, id=queries.id).select(
+    result=queries.value * 3 + 0 * agg.c
+)
+writer(res)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+'''
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fetch_frontend_metrics(port: int) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+def _plan_for(mode: str, phase: str, victim: int) -> tuple[dict, dict]:
+    """(fault plan, extra env) for a cell."""
+    if mode == "brownout":
+        # every window dispatch fails deterministically on the gateway
+        # rank; the threshold-1 breaker opens and brownout answers flow
+        plan = {
+            "seed": 7,
+            "rules": [
+                {
+                    "point": "serve.dispatch",
+                    "phase": "window",
+                    "rank": 0,
+                    "action": "raise",
+                }
+            ],
+        }
+        env = {
+            "PATHWAY_SERVE_BROWNOUT": "1",
+            "PATHWAY_SERVE_BREAKER_THRESHOLD": "1",
+            "PATHWAY_SERVE_BREAKER_COOLDOWN_S": "300",
+        }
+        return plan, env
+    if phase in ("window", "committed"):
+        point = "serve.dispatch"
+        victim = 0  # the gateway's dispatch worker lives on rank 0
+    else:
+        point = "mesh.rank_kill"
+    plan = {
+        "seed": 7,
+        "rules": [
+            {
+                "point": point,
+                "phase": phase,
+                "rank": victim,
+                "hits": [3],
+                "action": "crash",
+            }
+        ],
+    }
+    return plan, {}
+
+
+def run_cell(
+    mode: str = "park_replay",
+    phase: str = "wave_send",
+    victim: int = 1,
+    timeout: float = 240.0,
+    n_clients: int = N_CLIENTS,
+    n_per_client: int = N_PER_CLIENT,
+) -> dict:
+    """One chaos cell; returns a summary dict with ``ok`` and
+    ``problems``. Stdlib + repo only; the supervisor and both ranks are
+    real forked processes."""
+    from pathway_tpu.io.http import HttpError, KeepAliveSession
+
+    public_port = _free_port()
+    plan, extra_env = _plan_for(mode, phase, victim)
+    problems: list[str] = []
+    latencies: list[float] = []
+    statuses: dict[tuple[int, int], int] = {}
+    degraded = [0]
+    transport_errors: list[str] = []
+    lock = threading.Lock()
+
+    with tempfile.TemporaryDirectory(prefix="pw_serve_chaos_") as tmp:
+        scenario = os.path.join(tmp, "serve_scenario.py")
+        with open(scenario, "w") as f:
+            f.write(SCENARIO % {"repo": REPO, "port": public_port})
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_FAULT_PLAN": json.dumps(plan),
+            # fast detection so the blip stays inside the lane budget
+            "PATHWAY_MESH_HEARTBEAT_S": "0.25",
+            "PATHWAY_MESH_PEER_TIMEOUT_S": "2",
+            "PATHWAY_MESH_OP_TIMEOUT_S": "60",
+            "PATHWAY_MESH_GRACE_S": "10",
+            "PATHWAY_MESH_MAX_RESTARTS": "3",
+            # parked requests must survive a full rank respawn (jax
+            # import included) without expiring
+            "PATHWAY_REST_TIMEOUT_S": "90",
+            **extra_env,
+        }
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        env.pop("PATHWAY_TRACE", None)
+        sup = subprocess.Popen(
+            [
+                sys.executable,
+                SUPERVISOR,
+                "--processes", "2",
+                "--serve-frontend", str(public_port),
+                "--", scenario,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+        )
+        try:
+            # wait for the frontend (it binds immediately; the backend
+            # warms up behind it — early requests simply park)
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{public_port}/healthz",
+                        timeout=2,
+                    ):
+                        break
+                except urllib.error.HTTPError:
+                    break  # 503 recovering = frontend is up
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("frontend never came up")
+                    time.sleep(0.25)
+
+            barrier = threading.Barrier(n_clients)
+
+            def client(ci: int) -> None:
+                # the documented backpressure contract: bounded retry
+                # honoring Retry-After on 503 sheds/expiries
+                session = KeepAliveSession(
+                    f"http://127.0.0.1:{public_port}",
+                    timeout=120.0,
+                    retries=3,
+                )
+                barrier.wait()
+                for i in range(n_per_client):
+                    t0 = time.monotonic()
+                    try:
+                        res = session.post("/", {"value": ci * 1000 + i})
+                        status = 200
+                        if res != (ci * 1000 + i) * 3:
+                            with lock:
+                                problems.append(
+                                    f"wrong answer for ({ci},{i}): {res!r}"
+                                )
+                    except HttpError as e:
+                        status = e.code
+                    except Exception as exc:
+                        with lock:
+                            transport_errors.append(
+                                f"({ci},{i}): {exc!r}"
+                            )
+                        continue
+                    with lock:
+                        statuses[(ci, i)] = status
+                        latencies.append(time.monotonic() - t0)
+
+            def probe_degraded() -> None:
+                # brownout proof rides response headers; sample directly
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{public_port}/",
+                    data=json.dumps({"value": 999_999}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        if r.headers.get("Degraded") == "true":
+                            degraded[0] += 1
+                except Exception:
+                    pass
+
+            threads = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            if mode == "brownout":
+                time.sleep(3.0)
+                for _ in range(4):
+                    probe_degraded()
+            for t in threads:
+                t.join(timeout=timeout)
+                if t.is_alive():
+                    problems.append("client thread hung past the budget")
+            metrics = _fetch_frontend_metrics(public_port)
+        finally:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                _, sup_err = sup.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                _, sup_err = sup.communicate()
+
+    # -- assertions --------------------------------------------------------
+    n_expected = n_clients * n_per_client
+    if transport_errors:
+        problems.append(
+            f"DROPPED CONNECTIONS: {len(transport_errors)} "
+            f"(first: {transport_errors[:3]})"
+        )
+    if len(statuses) + len(transport_errors) != n_expected:
+        problems.append(
+            f"unaccounted requests: {n_expected - len(statuses)}"
+        )
+    bad = {
+        k: v for k, v in statuses.items() if v not in (200, 503, 504)
+    }
+    if mode == "brownout":
+        # the first failing window's futures fail server-side (500) —
+        # terminal, and expected exactly while the breaker is closing
+        bad = {k: v for k, v in bad.items() if v != 500}
+    if bad:
+        problems.append(f"non-terminal-contract statuses: {bad}")
+    ok200 = sum(1 for v in statuses.values() if v == 200)
+    if ok200 == 0:
+        problems.append("no request succeeded at all")
+    # frontend conservation (the exactly-once audit surface): every
+    # admitted request reached exactly one terminal
+    adm = metrics.get("serve_frontend_requests_total", 0)
+    resp = metrics.get("serve_frontend_responses_total", 0)
+    expired = metrics.get("serve_deadline_expired_total", 0)
+    fe_timeouts = metrics.get("serve_frontend_timeouts_total", 0)
+    if adm != resp + expired + fe_timeouts:
+        problems.append(
+            f"conservation violated: admitted={adm} != responses={resp} "
+            f"+ expired={expired} + timeouts={fe_timeouts}"
+        )
+    if mode == "park_replay":
+        if metrics.get("serve_backend_losses_total", 0) < 1:
+            problems.append(
+                "no backend loss observed — the kill never landed "
+                f"(supervisor stderr tail: {sup_err.decode()[-600:]})"
+            )
+        if metrics.get("serve_replayed_total", 0) < 1:
+            problems.append("no parked request was replayed")
+        if metrics.get("serve_epoch_handoff_seconds_count", 0) < 1:
+            problems.append("epoch-handoff histogram has no sample")
+    if mode == "brownout" and degraded[0] < 1:
+        problems.append("no Degraded: true response seen under brownout")
+
+    lat_sorted = sorted(latencies)
+    summary = {
+        "ok": not problems,
+        "mode": mode,
+        "phase": phase,
+        "victim": victim,
+        "requests": n_expected,
+        "responses_200": ok200,
+        "statuses": {
+            str(s): sum(1 for v in statuses.values() if v == s)
+            for s in sorted(set(statuses.values()))
+        },
+        "parked": metrics.get("serve_parked_total", 0),
+        "replayed": metrics.get("serve_replayed_total", 0),
+        "deadline_expired": metrics.get("serve_deadline_expired_total", 0),
+        "backend_losses": metrics.get("serve_backend_losses_total", 0),
+        "degraded_responses": degraded[0],
+        "recovery_p99_s": round(
+            lat_sorted[min(len(lat_sorted) - 1, int(0.99 * len(lat_sorted)))],
+            3,
+        )
+        if lat_sorted
+        else None,
+        "recovery_max_s": round(lat_sorted[-1], 3) if lat_sorted else None,
+    }
+    if problems:
+        summary["problems"] = problems
+    return summary
+
+
+def main() -> int:
+    summary = run_cell(mode="park_replay", phase="wave_send", victim=1)
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

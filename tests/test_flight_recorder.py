@@ -227,6 +227,10 @@ _SERVE_CALLS = {
     "on_timeout": (),
     "on_latency_ms": (12.5,),
     "on_window": (4,),
+    # serving-through-rollback (ISSUE 9): brownout answers and windows
+    # aborted at an epoch rollback
+    "on_brownout": (),
+    "on_windows_aborted": (2,),
 }
 
 
