@@ -9,6 +9,8 @@
     python -m pathway_tpu.analysis --serve [--serve-requests N]
         [--mesh-faults F] [--serve-mutant NAME] [--json]
     python -m pathway_tpu.analysis --profile trace.json [--top K] [--json]
+    python -m pathway_tpu.analysis --critical-path trace.json
+        [--top K] [--json]
 
 Profile mode (hot-path blame) joins a PATHWAY_TRACE flight-recorder
 trace back onto the plan metadata embedded at dump time — the same
@@ -16,6 +18,15 @@ NBDecision objects the executor gates on — and reports the top-k nodes
 by measured self-time, each with its fused / degraded / row-expanding-
 sink verdict (analysis/profile.py). Exit 0 = valid trace, 2 = schema
 problems.
+
+Critical-path mode (``--critical-path``; ISSUE 10) walks a merged
+multi-rank trace's wave spans: each wave's wall-clock is attributed to
+(rank, compute / send / recv-wait / decode) legs, per-wave straggler
+spread sums to ``mesh_skew_seconds``, the dominant recv-wait cell names
+the straggler rank joined with its hottest node's NBDecision verdict,
+and ``speedup_if_balanced`` predicts the wall-clock ratio if per-rank
+pre-send work were equalized (analysis/critical_path.py). Same exit
+codes as profile mode.
 
 Doctor options go BEFORE the program path; everything after it is the
 program's own argv (flags included), exactly like ``python script.py``.
@@ -260,6 +271,34 @@ def _analyze_profile(args) -> int:
     return 0 if report["valid"] else 2
 
 
+def _analyze_critical_path(args) -> int:
+    """Wave critical-path mode (ISSUE 10): walk the merged multi-rank
+    trace's wave spans and attribute each wave's wall-clock to
+    (rank, compute/send/recv-wait/decode) legs, with a straggler
+    verdict and a predicted speedup-if-balanced
+    (analysis/critical_path.py). Exit 0 = valid trace (a single-rank
+    trace reports "no waves" but is not an error), 2 = schema problems."""
+    from pathway_tpu.analysis.critical_path import (
+        critical_path,
+        render_critical_path,
+    )
+
+    try:
+        report = critical_path(args.critical_path, top_waves=args.top)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(
+            f"[ERROR  ] trace.unreadable {args.critical_path}\n"
+            f"      {exc}",
+            file=sys.stderr,
+        )
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_critical_path(report))
+    return 0 if report["valid"] else 2
+
+
 def _analyze_bench(args) -> int:
     from pathway_tpu.analysis.bench import BENCH_METRIC_PLANS, bench_verdicts
 
@@ -372,8 +411,16 @@ def main(argv=None) -> int:
              "row-expanding verdicts",
     )
     parser.add_argument(
+        "--critical-path", default=None, metavar="TRACE_JSON",
+        help="wave critical-path analysis of a merged multi-rank trace: "
+             "per-wave (rank, compute/send/recv-wait/decode) "
+             "attribution, mesh_skew_seconds, straggler verdict and "
+             "predicted speedup-if-balanced",
+    )
+    parser.add_argument(
         "--top", type=int, default=10,
-        help="with --profile: how many nodes to report (default 10)",
+        help="with --profile: how many nodes to report; with "
+             "--critical-path: how many worst waves (default 10)",
     )
     args = parser.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -386,6 +433,8 @@ def main(argv=None) -> int:
     try:
         if args.profile:
             return _analyze_profile(args)
+        if args.critical_path:
+            return _analyze_critical_path(args)
         if args.serve:
             return _analyze_serve(args)
         if args.mesh:

@@ -227,6 +227,23 @@ KNOBS: dict[str, Knob] = {
            hi=3600),
         _k("PATHWAY_MESH_MAX_RESTARTS", "int", 3,
            "Supervisor rollback budget.", lo=0, hi=1_000_000),
+        # -- cluster metrics plane (internals/cluster.py) -----------------
+        _k("PATHWAY_CLUSTER_METRICS_PORT", "int", None,
+           "Serve the merged /metrics/cluster view on this port: every "
+           "rank's OpenMetrics endpoint (20000 + rank) is scraped and "
+           "re-labeled with rank=..., plus derived mesh_skew_seconds / "
+           "scaling_efficiency gauges. The MeshSupervisor hosts it "
+           "across rollbacks when it owns the rank set; an unsupervised "
+           "multi-rank run hosts it on rank 0 (which also force-enables "
+           "the per-rank /metrics endpoints).", lo=1, hi=65535),
+        _k("PATHWAY_CLUSTER_SCRAPE_S", "float", 2.0,
+           "Scrape cadence of the cluster metrics aggregator.", lo=0.05,
+           hi=3600),
+        _k("PATHWAY_CLUSTER_BASELINE_ROWS_PER_S", "float", None,
+           "1-rank ingest-throughput baseline: when set, the cluster "
+           "view derives scaling_efficiency = observed rows/s / "
+           "(baseline × world). The N-rank bench lanes compute the same "
+           "number from their own measured 1-rank run.", lo=0.001),
         # -- mesh verifier (analysis/meshcheck.py) ------------------------
         _k("PATHWAY_MESHCHECK_RANKS", "int", 3,
            "Default symbolic rank count of the mesh model checker "

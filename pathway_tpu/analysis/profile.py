@@ -103,13 +103,63 @@ def validate_trace(doc: dict) -> list[str]:
     return problems
 
 
+def aggregate_node_spans(
+    events, by_rank: bool = False
+) -> dict:
+    """Per-node span aggregation shared by the profile and the wave
+    critical-path analyzer (analysis/critical_path.py): key is the node
+    id (across ranks) or ``(pid, node)`` with ``by_rank``. Malformed
+    node events (already reported by validate_trace) are skipped so the
+    CLIs keep their documented exit-2 path instead of a KeyError."""
+    agg: dict = {}
+    for e in events:
+        if e.get("cat") != "node":
+            continue
+        args = e.get("args") or {}
+        nid = args.get("node")
+        if nid is None:
+            continue
+        key = (e.get("pid", 0), nid) if by_rank else nid
+        a = agg.setdefault(
+            key,
+            {"self_s": 0.0, "rows": 0, "batches": 0, "nb_batches": 0},
+        )
+        a["self_s"] += e.get("dur", 0.0) / 1e6
+        a["rows"] += max(0, args.get("rows", 0))
+        a["batches"] += 1
+        if args.get("rep") == "nb":
+            a["nb_batches"] += 1
+    return agg
+
+
+def measured_verdict(meta_entry: dict, agg_entry: dict) -> str:
+    """Join a node's measured batches onto its static NBDecision verdict
+    (embedded at dump time — the SAME objects the executor gates on)."""
+    verdict = meta_entry.get("verdict")
+    tuple_batches = agg_entry["batches"] - agg_entry["nb_batches"]
+    if meta_entry.get("row_expanding"):
+        return "row-expanding sink"
+    if verdict == "fused" and tuple_batches == 0 and agg_entry["batches"]:
+        return "fused"
+    if verdict == "fused":
+        # the static verdict said fused but batches executed on the
+        # tuple path: a MEASURED degradation the static pass missed
+        return (
+            f"degraded at runtime ({tuple_batches}/"
+            f"{agg_entry['batches']} tuple batches)"
+        )
+    if verdict == "degraded":
+        return "degraded"
+    return "no fused path"
+
+
 def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
     """Aggregate the trace per node (across ranks) and join the plan
     metadata. Returns the report dict (render_profile prints it)."""
     doc = load_trace(path)
     problems = validate_trace(doc)
     meta = doc.get("pathway", {}).get("nodes", {})
-    agg: dict[int, dict] = {}
+    agg: dict[int, dict] = aggregate_node_spans(doc["traceEvents"])
     wall_per_pid: dict[int, float] = defaultdict(float)
     native_s: dict[str, float] = defaultdict(float)
     lag_max: dict[str, float] = {}
@@ -117,24 +167,7 @@ def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
     wave_s = 0.0
     for e in doc["traceEvents"]:
         cat = e.get("cat")
-        if cat == "node":
-            # malformed node events were already reported by
-            # validate_trace; skipping them here keeps the CLI on its
-            # documented exit-2 path instead of a KeyError traceback
-            args = e.get("args") or {}
-            nid = args.get("node")
-            if nid is None:
-                continue
-            a = agg.setdefault(
-                nid,
-                {"self_s": 0.0, "rows": 0, "batches": 0, "nb_batches": 0},
-            )
-            a["self_s"] += e.get("dur", 0.0) / 1e6
-            a["rows"] += max(0, args.get("rows", 0))
-            a["batches"] += 1
-            if args.get("rep") == "nb":
-                a["nb_batches"] += 1
-        elif cat == "step":
+        if cat == "step":
             wall_per_pid[e.get("pid", 0)] += e.get("dur", 0.0) / 1e6
         elif cat == "native":
             # region-entry spans only (tid 100): with PATHWAY_THREADS>1
@@ -153,23 +186,7 @@ def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
     rows_out = []
     for nid, a in agg.items():
         m = meta.get(str(nid), {})
-        verdict = m.get("verdict")
-        tuple_batches = a["batches"] - a["nb_batches"]
-        if m.get("row_expanding"):
-            measured = "row-expanding sink"
-        elif verdict == "fused" and tuple_batches == 0 and a["batches"]:
-            measured = "fused"
-        elif verdict == "fused":
-            # the static verdict said fused but batches executed on the
-            # tuple path: a MEASURED degradation the static pass missed
-            measured = (
-                f"degraded at runtime ({tuple_batches}/{a['batches']} "
-                "tuple batches)"
-            )
-        elif verdict == "degraded":
-            measured = "degraded"
-        else:
-            measured = "no fused path"
+        measured = measured_verdict(m, a)
         rows_out.append(
             {
                 "node": nid,

@@ -18,6 +18,7 @@ dataflow/operators/*.rs) on a batch-at-a-timestamp execution model:
 from __future__ import annotations
 
 import itertools
+import time as _time
 from collections import defaultdict
 from typing import Any, Callable, Iterable
 
@@ -584,15 +585,22 @@ class ExchangeNode(Node):
                     peer, tag,
                     [(self.node_id, ent)] if ent is not None else [],
                     enc_cache,
-                )
+                ),
+                peer,
             )
         parts = []
         dl = pg.op_deadline()  # one deadline for the whole rendezvous
         for peer in range(pg.world):
             if peer == pg.rank or (gather and pg.rank != 0):
                 continue
+            # timed like the wave engine's recvs: the fallback path must
+            # feed the same per-peer byte matrix and recv-wait straggler
+            # signal, or a plan ineligible for the planned walk goes
+            # blind on exactly the cluster view built to watch it
+            t0 = _time.perf_counter()
             for _nid, part in pg.recv(peer, tag, deadline=dl):
                 parts.append(part)
+            stats.on_exchange_recv_wait(peer, _time.perf_counter() - t0)
         return self.finish_exchange(own, parts)
 
 

@@ -35,6 +35,14 @@ from pathway_tpu.internals import faults as _faults
 from pathway_tpu.parallel import protocol as _proto
 
 
+# stats of the most recently finished Runtime in this process (set by
+# _finish): the bench scaling lanes read per-rank exchange counters off
+# it after pw.run() returns. In the emulated-rank lane each thread-rank
+# overwrites it in finish order — single-rank-per-process harnesses
+# (the real-fork scaling lanes) are the intended consumers.
+LAST_RUN_STATS = None
+
+
 class _Connector:
     def __init__(self, node: SourceNode, subject, parser):
         self.node = node
@@ -145,6 +153,9 @@ class Runtime:
         self._exchange_contrib: int | None = None
         self._planned_ok: bool | None = None  # planned-walk eligibility
         self._upstream_masks: list[int] | None = None
+        # standalone cluster metrics aggregator (unsupervised rank 0
+        # with PATHWAY_CLUSTER_METRICS_PORT set; internals/cluster.py)
+        self._cluster_agg = None
 
     # -- multi-process plane ----------------------------------------------
     @property
@@ -433,6 +444,9 @@ class Runtime:
         the columnar path keeps NativeBatches columnar across the rank
         boundary — then the generic loop drains whatever remains."""
         _faults.fault_point("runtime.step")
+        # straggler slot (mesh.slow, delay action): a compute-side drag
+        # on this rank, once per timestamp step
+        _faults.fault_point("mesh.slow", phase="step")
         nodes = self.scope.nodes
         rec = self.recorder
         t_step0 = _time.perf_counter_ns() if rec is not None else 0
@@ -515,7 +529,9 @@ class Runtime:
             wave_no += 1
             t0 = _time.perf_counter()
             self._run_exchange_wave(time, wave_no, wave)
-            comms += _time.perf_counter() - t0
+            wave_s = _time.perf_counter() - t0
+            comms += wave_s
+            self.stats.on_exchange_wave(wave_s)
             remaining.difference_update(wave)
         return comms
 
@@ -545,6 +561,10 @@ class Runtime:
         # frames not (fully) shipped — peers must detect the loss and
         # abort the epoch instead of deadlocking in their wave recvs
         _faults.fault_point("mesh.rank_kill", phase="wave_send")
+        # straggler slot (mesh.slow, delay action): stalling here holds
+        # THIS rank's frames back, so every peer's recv-wait attributes
+        # to it — the deterministic straggler the scaling lanes inject
+        _faults.fault_point("mesh.slow", phase="wave_send")
         # gather-mode nodes route to rank 0 only, so for a pure-gather
         # wave the sender set is static: non-zero ranks never receive and
         # rank 0 never sends — those all-to-all legs are elided entirely
@@ -573,7 +593,7 @@ class Runtime:
                     entries.append((nid, ent))
             t_send0 = _time.perf_counter_ns() if rec is not None else 0
             nbytes = pg.send_exchange(peer, tag, entries, enc_cache)
-            stats.on_exchange_frame(nbytes)
+            stats.on_exchange_frame(nbytes, peer)
             if rec is not None:
                 rec.note_send(
                     peer, t_send0, _time.perf_counter_ns(), nbytes
@@ -583,7 +603,10 @@ class Runtime:
         for peer in _proto.wave_recv_sources(
             pg.world, pg.rank, gather_only, contrib
         ):
-            t_recv0 = _time.perf_counter_ns() if rec is not None else 0
+            # always timed (not only under the recorder): per-peer
+            # recv-wait feeds the cluster plane's straggler attribution
+            # and the mesh_skew_seconds derivation on /metrics
+            t_recv0 = _time.perf_counter_ns()
             for nid, part in pg.recv(peer, tag, deadline=wave_dl):
                 if nid not in received:
                     raise RuntimeError(
@@ -592,10 +615,10 @@ class Runtime:
                         f"time {time}"
                     )
                 received[nid].append(part)
+            t_recv1 = _time.perf_counter_ns()
+            stats.on_exchange_recv_wait(peer, (t_recv1 - t_recv0) / 1e9)
             if rec is not None:
-                rec.note_recv_wait(
-                    peer, t_recv0, _time.perf_counter_ns()
-                )
+                rec.note_recv_wait(peer, t_recv0, t_recv1)
         for nid, own, _sends in prepared:
             node = nodes[nid]
             out = node.finish_exchange(own, received[nid])
@@ -651,6 +674,20 @@ class Runtime:
         if self._async_loop is not None:
             self._async_loop.close()
             self._async_loop = None
+        if self._cluster_agg is not None:
+            # one last scrape so the shutdown snapshot (skew, totals) is
+            # complete, then release the /metrics/cluster listener
+            try:
+                self._cluster_agg.stop(final_scrape=True)
+            except Exception:
+                pass
+            self._cluster_agg = None
+        # post-run stats handle for harnesses (scripts/bench_relational
+        # scaling lanes read per-rank recv-wait/comms off it after
+        # pw.run() returns; module-level because the Runtime itself is
+        # not reachable through the public API)
+        global LAST_RUN_STATS
+        LAST_RUN_STATS = self.stats
 
     def _finalize_trace(self) -> None:
         """Shutdown half of the flight recorder: dump this rank's trace,
@@ -713,7 +750,7 @@ class Runtime:
         """Sample cross-rank clock offsets during the epoch's clock
         handshake: rank 0 broadcasts its monotonic-ns reading, every
         peer records the offset onto its own timebase, and the trace
-        merger shifts each rank's track by it. Loopback meshes see
+        CONVERSION shifts each rank's events by it. Loopback meshes see
         sub-ms skew (send latency); the knob is shared by every rank,
         so all of them join this round or none do."""
         rec = self.recorder
@@ -725,6 +762,25 @@ class Runtime:
         else:
             remote = pg.bcast0(("tsync",))
             rec.clock_offset_ns = remote - _time.perf_counter_ns()
+
+    def _trace_clock_resample(self, pg, tag) -> None:
+        """Re-sample the tsync offset at an epoch commit (ISSUE 10
+        satellite): monotonic clocks drift apart over multi-minute runs,
+        so a single handshake-time offset skews late-run span alignment
+        in the merged trace. Each resample opens a NEW offset segment on
+        the recorder — events convert with the offset that was current
+        when they were recorded (per-segment application,
+        internals/flight.py). Same all-or-none contract as the
+        handshake round: PATHWAY_TRACE is shared by every rank."""
+        rec = self.recorder
+        if rec is None:
+            return
+        if pg.rank == 0:
+            pg.bcast0(("tsync", tag), _time.perf_counter_ns())
+            rec.resample_clock_offset(0)
+        else:
+            remote = pg.bcast0(("tsync", tag))
+            rec.resample_clock_offset(remote - _time.perf_counter_ns())
 
     def _inject_static(self) -> None:
         t = self._next_time()
@@ -880,15 +936,52 @@ class Runtime:
         close_subjects_for_rollback(self.connectors)
         _os._exit(MESH_RESTART_EXIT_CODE)
 
+    @staticmethod
+    def _cluster_metrics_port() -> int | None:
+        """PATHWAY_CLUSTER_METRICS_PORT: where the merged
+        /metrics/cluster view is served (by the MeshSupervisor when one
+        owns the rank set, by rank 0 itself otherwise). None = off."""
+        from pathway_tpu.internals.cluster import metrics_port_from_env
+
+        return metrics_port_from_env()
+
     def _start_monitoring(self, printer: bool = True) -> None:
-        if self.with_http_server:
-            # reference: metrics at port 20000 + process_id (http_server.rs)
-            from pathway_tpu.internals.config import get_pathway_config
+        import os as _os
+
+        from pathway_tpu.internals.config import get_pathway_config
+
+        c = get_pathway_config()
+        cluster_port = (
+            self._cluster_metrics_port() if not self.local_only else None
+        )
+        if self.with_http_server or (
+            cluster_port is not None and self.distributed
+        ):
+            # reference: metrics at port 20000 + process_id
+            # (http_server.rs). The cluster knob implies the per-rank
+            # endpoint: the aggregator has nothing to scrape otherwise.
             from pathway_tpu.internals.monitoring import start_http_server
 
-            start_http_server(
-                self.stats, 20000 + get_pathway_config().process_id
+            start_http_server(self.stats, 20000 + c.process_id)
+        if (
+            cluster_port is not None
+            and self.distributed
+            and c.process_id == 0
+            and not _os.environ.get("PATHWAY_MESH_SUPERVISED")
+        ):
+            # standalone cluster aggregation (ISSUE 10): no supervisor
+            # owns the rank set, so rank 0 hosts the merged
+            # /metrics/cluster view for this run's lifetime and the TUI
+            # dashboard gets its per-rank section
+            from pathway_tpu.internals.cluster import (
+                ClusterMetricsAggregator,
             )
+
+            self._cluster_agg = ClusterMetricsAggregator.from_env(
+                cluster_port, world=c.processes
+            )
+            self._cluster_agg.start()
+            self.stats.cluster = self._cluster_agg
         if self.monitoring_level is not None and printer:
             from pathway_tpu.internals.monitoring import (
                 MonitoringLevel,
@@ -906,10 +999,15 @@ class Runtime:
     def _drain_event_queue(self, timeout: float) -> list:
         """One bounded wait, then drain everything queued."""
         entries = []
+        t0 = _time.perf_counter()
         try:
             entries.append(self.event_queue.get(timeout=timeout))
         except queue.Empty:
-            pass
+            # the bounded wait expired with nothing queued: pure idle
+            # (runtime_idle_seconds_total — the third leg of the cluster
+            # view's per-rank comms/compute/idle split; a drain that
+            # returned work is engine time, not idle)
+            self.stats.on_idle(_time.perf_counter() - t0)
         while True:
             try:
                 entries.append(self.event_queue.get_nowait())
@@ -1324,6 +1422,9 @@ class Runtime:
             self.persistence.write_marker("snapshot_commit", tag)
         pg.barrier(("snapbar", tag))
         self.stats.on_mesh_epoch_committed(pg.epoch)
+        # re-sample cross-rank clock offsets at every commit so long
+        # traced runs don't drift out of alignment (per-segment offsets)
+        self._trace_clock_resample(pg, tag)
         if self.recorder is not None:
             self.recorder.note_mark(
                 "epoch_commit", epoch=pg.epoch, tag=tag

@@ -36,6 +36,15 @@ Injection points threaded through the hot paths:
                                     frontend at backend loss
     serve.replay                    per parked request replayed into the
                                     first window of epoch+1
+    mesh.slow                       straggler injection slots on the wave
+                                    path (never crashes — pair with the
+                                    ``delay`` action): the runtime hits it
+                                    with ``phase="wave_send"`` (slices
+                                    prepared, frames about to ship — a
+                                    delay here stalls this rank's sends so
+                                    every peer's recv-wait points at it)
+                                    and ``phase="step"`` (once per engine
+                                    timestamp step — a compute-side drag)
 
 A *plan* is a schedule of rules. Each rule names a point, when it fires —
 explicit 1-based ``hits``, a modular ``every``, or a seeded probability
@@ -44,7 +53,12 @@ sequence replays exactly) — and an action: ``raise`` throws
 :class:`InjectedFault` (retryable unless ``retryable: false``, so the
 connector supervisor's default classifier fails fast on it), ``crash``
 hard-kills the process via ``os._exit`` (default exit code
-``CRASH_EXIT_CODE``). Hit counters are global per point and deterministic
+``CRASH_EXIT_CODE``), ``delay`` sleeps ``delay_ms`` milliseconds and
+returns normally — the straggler injection the N-rank scaling lanes use
+(a ``rank``-scoped ``mesh.slow`` delay rule makes exactly one rank
+deterministically slow, with no crash and no semantic change, so the
+critical-path analyzer's straggler attribution is replayable like every
+other fault). Hit counters are global per point and deterministic
 given the program's emit/commit order — with the one caveat that
 ``connector.flush`` also counts wall-clock autocommit flushes, so exact-
 hit plans against it are only fully deterministic when autocommit is
@@ -93,9 +107,10 @@ POINTS = (
     "serve.dispatch",
     "serve.park",
     "serve.replay",
+    "mesh.slow",
 )
 
-_ACTIONS = ("raise", "crash")
+_ACTIONS = ("raise", "crash", "delay")
 
 
 class InjectedFault(RuntimeError):
@@ -112,7 +127,8 @@ class InjectedFault(RuntimeError):
 class FaultRule:
     __slots__ = (
         "point", "hits", "every", "prob", "action", "retryable",
-        "max_fires", "fired", "exit_code", "phase", "rank", "_rng",
+        "max_fires", "fired", "exit_code", "phase", "rank", "delay_ms",
+        "_rng",
     )
 
     def __init__(
@@ -127,6 +143,7 @@ class FaultRule:
         exit_code: int = CRASH_EXIT_CODE,
         phase: str | None = None,
         rank: int | None = None,
+        delay_ms: float = 0.0,
     ):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; use {_ACTIONS}")
@@ -152,6 +169,9 @@ class FaultRule:
         # other phases of the same point interleave with it
         self.phase = phase
         self.rank = rank
+        # "delay" action: how long a firing rule stalls the caller (the
+        # straggler knob; a non-positive delay makes the rule a no-op)
+        self.delay_ms = float(delay_ms)
         self._rng: random.Random | None = None  # bound by the plan
 
     def applies(self, context: dict | None) -> bool:
@@ -285,4 +305,12 @@ def fault_point(point: str, **context: Any) -> None:
     rule, hit = fired
     if rule.action == "crash":
         os._exit(rule.exit_code)
+    if rule.action == "delay":
+        # straggler injection: stall, never raise — the run's semantics
+        # (and its exactly-once audit) must be bit-identical to fault-free
+        if rule.delay_ms > 0:
+            import time as _time
+
+            _time.sleep(rule.delay_ms / 1000.0)
+        return
     raise InjectedFault(point, hit, retryable=rule.retryable)

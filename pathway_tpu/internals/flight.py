@@ -28,9 +28,11 @@ What gets recorded, per rank:
 Export: one track per rank×thread. Multi-rank runs write per-rank
 partials (``<path>.r<rank>``) that rank 0 merges at shutdown — clock
 offsets between ranks are sampled during the epoch's clock handshake
-(runtime ``("tsync",)`` round) so merged per-track timestamps stay
-monotonic; ``parallel/supervisor.py`` re-merges as a fallback after
-rollback recoveries. All timestamps are ``time.perf_counter_ns()`` /
+(runtime ``("tsync",)`` round) and RESAMPLED at every epoch commit
+(per-segment offsets, so multi-minute runs don't skew late-run span
+alignment as the monotonic clocks drift) so merged per-track
+timestamps stay monotonic; ``parallel/supervisor.py`` re-merges as a
+fallback after rollback recoveries. All timestamps are ``time.perf_counter_ns()`` /
 C++ ``steady_clock`` — the same CLOCK_MONOTONIC timebase.
 
 ``python -m pathway_tpu.analysis --profile trace.json`` joins the trace
@@ -42,6 +44,7 @@ from __future__ import annotations
 import json
 import os
 import time as _time
+from bisect import bisect_right
 from typing import Any
 
 # native ring tags (exec.cpp enum TraceTag)
@@ -98,7 +101,13 @@ class FlightRecorder:
         self.path = path
         self.rank = rank
         self.world = world
-        self.clock_offset_ns = 0  # to rank 0's timebase (tsync sample)
+        # offset to rank 0's timebase, as SEGMENTS: (start_mono_ns,
+        # offset_ns) — the epoch's clock handshake opens segment 0 and
+        # every epoch commit resamples (monotonic clocks drift apart
+        # over multi-minute runs; a single handshake-time offset skews
+        # late-run span alignment in the merged trace). Events convert
+        # with the offset that was current when they were recorded.
+        self._offset_segments: list[tuple[int, int]] = [(0, 0)]
         # bounded (PATHWAY_TRACE_MAX_EVENTS): a long-running traced
         # streaming pipeline must not grow heap without limit until the
         # shutdown dump — the deque keeps the NEWEST events (the tail is
@@ -133,6 +142,60 @@ class FlightRecorder:
 
         c = get_pathway_config()
         return cls(path, rank=c.process_id, world=max(1, c.processes))
+
+    # -- clock offsets ----------------------------------------------------
+    # bound on retained tsync samples: one per epoch commit, so a
+    # commit-per-second pipeline would otherwise grow this without limit
+    # (like the event deque, the NEWEST samples matter — evicted ones
+    # correspond to events the bounded deque has already dropped)
+    _SEGMENT_CAP = 8192
+
+    @property
+    def clock_offset_ns(self) -> int:
+        """The CURRENT offset to rank 0's timebase (latest sample)."""
+        return self._offset_segments[-1][1]
+
+    @clock_offset_ns.setter
+    def clock_offset_ns(self, offset_ns: int) -> None:
+        # the epoch handshake's first tsync sample, anchored at the
+        # sample instant (events before it convert with this offset
+        # unshifted; later samples interpolate forward from here)
+        self._offset_segments = [
+            (_time.perf_counter_ns(), int(offset_ns))
+        ]
+
+    def resample_clock_offset(
+        self, offset_ns: int, at_ns: int | None = None
+    ) -> None:
+        """Record a fresh tsync sample at `at_ns` (now by default).
+        Conversion interpolates LINEARLY between consecutive samples
+        (constant outside them): the linear-drift model keeps
+        multi-minute multi-rank traces aligned without stretching one
+        stale handshake offset over the run, and — unlike a step
+        function — it is continuous and monotone (|Δoffset| between
+        commits is microseconds against seconds of wall, so the
+        conversion slope stays ~1), so a resample can never step a
+        track's converted timestamps backwards. Out-of-order samples
+        are dropped to keep the list sorted."""
+        at = _time.perf_counter_ns() if at_ns is None else int(at_ns)
+        if at <= self._offset_segments[-1][0]:
+            return
+        self._offset_segments.append((at, int(offset_ns)))
+        if len(self._offset_segments) > self._SEGMENT_CAP:
+            # drop the second sample, keeping the first as the baseline
+            # anchor for whatever pre-history the event deque retains
+            del self._offset_segments[1]
+
+    def _offset_at(self, ns: int) -> int:
+        segs = self._offset_segments
+        i = bisect_right(segs, (ns, float("inf"))) - 1
+        if i < 0:
+            return segs[0][1]
+        if i + 1 >= len(segs):
+            return segs[i][1]
+        t0, o0 = segs[i]
+        t1, o1 = segs[i + 1]
+        return o0 + (o1 - o0) * (ns - t0) // (t1 - t0)
 
     # -- hot-path notes ---------------------------------------------------
     # (kind, ...) tuples; perf_counter_ns timestamps throughout
@@ -274,8 +337,10 @@ class FlightRecorder:
     # -- Chrome-trace conversion ------------------------------------------
     def _us(self, ns: int) -> float:
         # ns precision in µs units = 3 decimals; rounding keeps json
-        # reprs short (encode time is part of the measured run)
-        return round((ns + self.clock_offset_ns) / 1000.0, 3)
+        # reprs short (encode time is part of the measured run). The
+        # offset applied is the tsync sample that was CURRENT when the
+        # event was recorded (per-segment; resampled at epoch commits).
+        return round((ns + self._offset_at(ns)) / 1000.0, 3)
 
     def chrome_events(self, scope=None) -> list[dict]:
         """Convert the raw event log into Chrome-trace events (ts/dur in
@@ -495,6 +560,9 @@ class FlightRecorder:
             "capped": capped,
             "dropped_events": self.dropped,
             "clock_offset_ns": self.clock_offset_ns,
+            "offset_segments": [
+                [s, o] for s, o in self._offset_segments
+            ],
             "wall_anchor_ns": self.wall_anchor_ns,
             "mono_anchor_ns": self.mono_anchor_ns,
             "events": self.chrome_events(scope),
@@ -588,6 +656,10 @@ def merge_trace_files(
             nodes = doc.get("nodes", {})
         meta[f"rank{rank}"] = {
             "clock_offset_ns": doc.get("clock_offset_ns", 0),
+            # per-segment tsync samples (resampled at epoch commits);
+            # already applied to the partial's event timestamps at
+            # conversion — recorded here for post-mortems only
+            "offset_segments": doc.get("offset_segments"),
             "wall_anchor_ns": doc.get("wall_anchor_ns"),
         }
     if not ranks:

@@ -198,6 +198,28 @@ class ProberStats:
     exchange_fallbacks: int = 0
     exchange_comms_s: float = 0.0
     exchange_compute_s: float = 0.0
+    # per-peer exchange breakdown (ISSUE 10): the cluster aggregator
+    # relabels these with this rank's id, turning them into the
+    # (rank, peer) byte matrix of the mesh. Bounded cardinality: at most
+    # world-1 peers. The unlabeled totals above stay for dashboard
+    # back-compat.
+    exchange_peer: dict = field(default_factory=dict)  # peer -> [frames, bytes]
+    # recv-wait seconds this rank spent parked on each peer inside
+    # exchange waves — the straggler signal: the SLOW rank waits least,
+    # everyone else's wait points at it (max-min across ranks is the
+    # cluster's mesh_skew_seconds)
+    exchange_recv_wait_s: float = 0.0
+    exchange_peer_wait: dict = field(default_factory=dict)  # peer -> seconds
+    # wave accounting: completed exchange waves and their wall seconds
+    exchange_waves: int = 0
+    exchange_wave_s: float = 0.0
+    # event-loop idle: seconds the main loop spent blocked on an empty
+    # connector queue (per-rank comms/compute/idle on the cluster view)
+    idle_s: float = 0.0
+    # cluster aggregator handle (internals/cluster.py), attached by the
+    # unsupervised rank-0 runtime so the TUI dashboard can render the
+    # per-rank section; None everywhere else
+    cluster: object = None
     # fused-chain de-optimizations at join/groupby/select nodes: batches
     # that were statically expected columnar (analysis/eligibility.py
     # expects_native_batch) but executed on the tuple path. A permanent
@@ -268,9 +290,34 @@ class ProberStats:
     def on_mesh_epoch_committed(self, epoch: int) -> None:
         self.mesh_last_committed_epoch = epoch
 
-    def on_exchange_frame(self, nbytes: int) -> None:
+    def on_exchange_frame(self, nbytes: int, peer: int | None = None) -> None:
         self.exchange_frames += 1
         self.exchange_bytes += nbytes
+        if peer is not None:
+            slot = self.exchange_peer.get(peer)
+            if slot is None:
+                slot = self.exchange_peer[peer] = [0, 0]
+            slot[0] += 1
+            slot[1] += nbytes
+
+    def on_exchange_recv_wait(self, peer: int, seconds: float) -> None:
+        """Seconds this rank blocked in a wave recv on `peer` — per-peer
+        for upstream attribution, totaled for the skew derivation."""
+        if seconds > 0:
+            self.exchange_recv_wait_s += seconds
+            self.exchange_peer_wait[peer] = (
+                self.exchange_peer_wait.get(peer, 0.0) + seconds
+            )
+
+    def on_exchange_wave(self, seconds: float) -> None:
+        self.exchange_waves += 1
+        self.exchange_wave_s += max(0.0, seconds)
+
+    def on_idle(self, seconds: float) -> None:
+        """Main-loop wall time spent waiting on an EMPTY connector queue
+        (a drain that returned work is not idle and is not counted)."""
+        if seconds > 0:
+            self.idle_s += seconds
 
     def on_exchange_elided(self, n: int) -> None:
         if n > 0:
@@ -372,9 +419,36 @@ class ProberStats:
         for metric, val in (
             ("exchange_comms_seconds_total", self.exchange_comms_s),
             ("exchange_compute_seconds_total", self.exchange_compute_s),
+            ("exchange_recv_wait_seconds_total", self.exchange_recv_wait_s),
+            ("exchange_wave_seconds_total", self.exchange_wave_s),
+            ("runtime_idle_seconds_total", self.idle_s),
         ):
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} {val:.6f}")
+        lines.append("# TYPE exchange_waves_total counter")
+        lines.append(f"exchange_waves_total {self.exchange_waves}")
+        if self.exchange_peer:
+            # per-peer byte matrix rows (bounded: world-1 label values);
+            # the cluster aggregator adds the rank label on its side
+            for metric, idx in (
+                ("exchange_peer_frames_total", 0),
+                ("exchange_peer_bytes_total", 1),
+            ):
+                lines.append(f"# TYPE {metric} counter")
+                for peer in sorted(self.exchange_peer):
+                    lines.append(
+                        f'{metric}{{peer="{peer}"}} '
+                        f"{self.exchange_peer[peer][idx]}"
+                    )
+        if self.exchange_peer_wait:
+            lines.append(
+                "# TYPE exchange_peer_recv_wait_seconds_total counter"
+            )
+            for peer in sorted(self.exchange_peer_wait):
+                lines.append(
+                    f'exchange_peer_recv_wait_seconds_total{{peer="{peer}"}}'
+                    f" {self.exchange_peer_wait[peer]:.6f}"
+                )
         for metric, val in (
             ("mesh_heartbeats_missed_total", self.mesh_heartbeats_missed),
             ("mesh_rank_restarts_total", self.mesh_rank_restarts),
@@ -630,6 +704,44 @@ def render_dashboard(stats: ProberStats, graveyard=None):
             )
 
     parts = [conn, lat, pipe]
+    # cluster section (ISSUE 10): when the cluster aggregator is
+    # attached (unsupervised rank 0 with PATHWAY_CLUSTER_METRICS_PORT),
+    # one row per scraped rank — where each rank's wall-clock went —
+    # plus the derived skew/efficiency gauges
+    summary = None
+    if stats.cluster is not None:
+        try:
+            summary = stats.cluster.summary()
+        except Exception:
+            summary = None
+    if summary and summary.get("ranks"):
+        clus = Table(box=box.SIMPLE, title="cluster")
+        clus.add_column("rank", justify="right")
+        clus.add_column("rows", justify="right")
+        clus.add_column("comms [s]", justify="right")
+        clus.add_column("compute [s]", justify="right")
+        clus.add_column("idle [s]", justify="right")
+        clus.add_column("recv-wait [s]", justify="right")
+        for rank in sorted(summary["ranks"]):
+            r = summary["ranks"][rank]
+            clus.add_row(
+                str(rank),
+                str(int(r.get("rows", 0))),
+                f"{r.get('comms_s', 0.0):.2f}",
+                f"{r.get('compute_s', 0.0):.2f}",
+                f"{r.get('idle_s', 0.0):.2f}",
+                f"{r.get('recv_wait_s', 0.0):.2f}",
+            )
+        derived = []
+        if summary.get("skew_s") is not None:
+            derived.append(f"skew {summary['skew_s']:.3f}s")
+        if summary.get("rows_per_s") is not None:
+            derived.append(f"{summary['rows_per_s']:.0f} rows/s")
+        if summary.get("efficiency") is not None:
+            derived.append(f"efficiency {summary['efficiency']:.2f}")
+        if derived:
+            clus.add_row("", "", "", "", "", "  ".join(derived))
+        parts.append(clus)
     if graveyard is not None and graveyard.records:
         parts.append(
             Panel(

@@ -93,6 +93,30 @@ MESH_RESTART_EXIT_CODE = _proto.MESH_RESTART_EXIT_CODE
 
 logger = logging.getLogger(__name__)
 
+_cluster_mod = None
+
+
+def _load_cluster_module():
+    """internals/cluster.py loaded by file path (stdlib-only, like
+    protocol.py above) and cached: the knob parse, port validation and
+    the aggregator class all come from the ONE module the engine
+    runtime also routes through — no drift between the two hosts of
+    the /metrics/cluster view."""
+    global _cluster_mod
+    if _cluster_mod is None:
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "_pw_cluster",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "internals", "cluster.py",
+            ),
+        )
+        _cluster_mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(_cluster_mod)
+    return _cluster_mod
+
 
 def _free_port_base(n: int) -> int:
     """A base port with n consecutive free ports — each epoch gets a
@@ -156,6 +180,7 @@ class MeshSupervisor:
         poll_s: float = 0.05,
         serve_frontend: int | None = None,
         serve_backend_port: int | None = None,
+        cluster_metrics: int | None = None,
     ):
         if processes is None:
             processes = int(os.environ.get("PATHWAY_PROCESSES", "2") or 2)
@@ -182,6 +207,27 @@ class MeshSupervisor:
         self.serve_frontend_port = serve_frontend
         self.serve_backend_port = serve_backend_port
         self.frontend = None
+        # cluster metrics plane (ISSUE 10): like the serving frontend,
+        # the supervisor owns the merged /metrics/cluster listener for
+        # its WHOLE lifetime while epochs come and go — a rollback is a
+        # scrape blip, not a dead dashboard. Default from the shared
+        # PATHWAY_CLUSTER_METRICS_PORT knob (the ranks see the same var
+        # but skip self-hosting under PATHWAY_MESH_SUPERVISED); parse
+        # and bounds live in internals/cluster.py, shared with the
+        # engine runtime's unsupervised host path.
+        if cluster_metrics is None and os.environ.get(
+            "PATHWAY_CLUSTER_METRICS_PORT", ""
+        ).strip():
+            cluster_metrics = _load_cluster_module().metrics_port_from_env()
+        if cluster_metrics is not None and not _load_cluster_module(
+        ).valid_port(cluster_metrics):
+            logger.warning(
+                "cluster metrics disabled: port %r outside 1..65535",
+                cluster_metrics,
+            )
+            cluster_metrics = None
+        self.cluster_metrics_port = cluster_metrics
+        self.cluster = None
         # exposed for tests/observability
         self.epoch = 0
         self.restarts_performed = 0
@@ -219,6 +265,26 @@ class MeshSupervisor:
             self.serve_backend_port,
         )
 
+    def _start_cluster(self) -> None:
+        """Bring the cluster metrics aggregator up once, before epoch 0:
+        it scrapes every rank's OpenMetrics endpoint (20000 + rank) and
+        serves the merged /metrics/cluster view across rollbacks.
+        internals/cluster.py is loaded by file path like protocol.py
+        above (stdlib-only), so file-path-loaded supervisors stay
+        import-light."""
+        if self.cluster_metrics_port is None or self.cluster is not None:
+            return
+        mod = _load_cluster_module()
+        self.cluster = mod.ClusterMetricsAggregator.from_env(
+            self.cluster_metrics_port, world=self.processes
+        ).start()
+        logger.info(
+            "mesh supervisor: cluster metrics up on :%d "
+            "(/metrics/cluster over %d ranks)",
+            self.cluster_metrics_port,
+            self.processes,
+        )
+
     def _spawn_epoch(self, epoch: int) -> list[subprocess.Popen]:
         port = _free_port_base(self.processes)
         # the serve backend port is FREE at respawn time (the dead
@@ -241,6 +307,14 @@ class MeshSupervisor:
                 PATHWAY_MESH_EPOCH=str(epoch),
                 PATHWAY_MESH_SUPERVISED="1",
             )
+            if self.cluster_metrics_port is not None:
+                # ranks must serve their per-rank /metrics endpoints for
+                # the aggregator to scrape; the knob force-enables them
+                # (they skip SELF-hosting the cluster view because
+                # PATHWAY_MESH_SUPERVISED is set — this supervisor owns it)
+                env["PATHWAY_CLUSTER_METRICS_PORT"] = str(
+                    self.cluster_metrics_port
+                )
             if self.serve_backend_port is not None:
                 env["PATHWAY_SERVE_BACKEND_PORT"] = str(
                     self.serve_backend_port
@@ -310,6 +384,14 @@ class MeshSupervisor:
                 except Exception:
                     pass
                 self.frontend = None
+            if self.cluster is not None:
+                # final scrape first: the shutdown snapshot (skew,
+                # totals) should cover the rank set's last breath
+                try:
+                    self.cluster.stop(final_scrape=True)
+                except Exception:
+                    pass
+                self.cluster = None
             self._merge_trace_fallback()
 
     def _merge_trace_fallback(self) -> None:
@@ -356,8 +438,18 @@ class MeshSupervisor:
 
     def _run(self, procs: list[subprocess.Popen]) -> int:
         self._start_frontend()
+        self._start_cluster()
         while True:
             procs[:] = self._spawn_epoch(self.epoch)
+            if self.cluster is not None:
+                # re-resolve rank endpoints for the fresh epoch: ports
+                # are stable (20000 + rank) but scrape health resets and
+                # the view stamps the new epoch, so a rolled-back rank's
+                # pre-rollback counters read as stale, not current
+                self.cluster.set_endpoints(
+                    self.cluster.default_endpoints(self.processes),
+                    epoch=self.epoch,
+                )
             logger.info(
                 "mesh supervisor: epoch %d up (%d ranks)",
                 self.epoch,
@@ -442,6 +534,14 @@ def main(argv: Sequence[str] | None = None) -> int:
         help="explicit backend port for --serve-frontend (default: a "
         "free port probed at startup)",
     )
+    ap.add_argument(
+        "--cluster-metrics", type=int, default=None, metavar="PORT",
+        help="serve the merged /metrics/cluster view on this port across "
+        "rollbacks: every rank's OpenMetrics endpoint (20000 + rank) is "
+        "scraped and re-labeled with rank=..., plus derived "
+        "mesh_skew_seconds / scaling_efficiency gauges (default: the "
+        "PATHWAY_CLUSTER_METRICS_PORT knob)",
+    )
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = list(args.command)
@@ -463,6 +563,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         grace_s=args.grace,
         serve_frontend=args.serve_frontend,
         serve_backend_port=args.serve_backend_port,
+        cluster_metrics=args.cluster_metrics,
     ).run()
 
 

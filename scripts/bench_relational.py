@@ -19,6 +19,13 @@ PATHWAY_PROCESSES=2 wordcount, with ``host_cores`` annotated so a 1-core
 host shows honest parity rather than silence.
 
 Usage: python scripts/bench_relational.py [n_rows] [distinct_words]
+
+N-rank scaling lanes (ISSUE 10): ``--ranks 1,2,4`` runs wordcount and
+stream_join at every requested rank count through the real-fork mesh
+harness and records throughput + ``scaling_efficiency`` (vs the 1-rank
+lane measured in the same session) + ``mesh_skew_seconds`` (cross-rank
+recv-wait spread); ``--ranks 1,2,4 --update-artifact`` splices the
+entries into BENCH_full.json in place.
 """
 
 from __future__ import annotations
@@ -299,6 +306,22 @@ def _wordcount_once(
     return elapsed, metric
 
 
+_RANK_STATS_TAIL = """
+from pathway_tpu.engine import runtime as _rt
+_st = _rt.LAST_RUN_STATS
+_extra = {{}}
+if _st is not None:
+    _extra = dict(
+        recv_wait_s=round(_st.exchange_recv_wait_s, 4),
+        comms_s=round(_st.exchange_comms_s, 4),
+        compute_s=round(_st.exchange_compute_s, 4),
+        idle_s=round(_st.idle_s, 4),
+        waves=_st.exchange_waves,
+    )
+print(json.dumps({{"rank": rank, "elapsed_s": time.perf_counter() - t0,
+                   "changes": out["n"], **_extra}}))
+"""
+
 _RANK_PROGRAM = """
 import json, os, sys, time
 sys.path.insert(0, {repo!r})
@@ -339,9 +362,64 @@ out = {{"n": 0}}
 pw.io.subscribe(counts, on_change=lambda key, row, time_, diff: out.__setitem__("n", out["n"] + 1))
 t0 = time.perf_counter()
 pw.run(monitoring_level=pw.MonitoringLevel.NONE)
-print(json.dumps({{"rank": rank, "elapsed_s": time.perf_counter() - t0,
-                   "changes": out["n"]}}))
-"""
+""" + _RANK_STATS_TAIL
+
+# N-rank streaming join: left stream sharded by residue class across
+# ranks, right (build) side read on rank 0 only (single-reader default)
+# — the join exchange re-shards both sides by key, so this measures the
+# hash all-to-all under real skewless load
+_JOIN_RANK_PROGRAM = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+import pathway_tpu.parallel.mesh  # pre-import jax: keep it out of the timed window
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+n_rows, n_keys, batch = {n_rows}, {n_keys}, {batch}
+
+class L(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    v: int
+
+class R(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    w: int
+
+mine = list(range(rank, n_rows, P))
+left_batches = [
+    [{{"k": i, "j": (i * 2654435761) % n_keys, "v": i}} for i in mine[s:s+batch]]
+    for s in range(0, len(mine), batch)
+]
+right_rows = [{{"k": i, "j": i % n_keys, "w": i}} for i in range(n_keys * 3)]
+
+class LS(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True
+    def run(self):
+        for b in left_batches:
+            self.next_batch(b)
+            self.commit()
+
+class RS(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        self.next_batch(right_rows)
+        self.commit()
+
+lt = pw.io.python.read(LS(), schema=L, autocommit_duration_ms=None)
+rt_t = pw.io.python.read(RS(), schema=R, autocommit_duration_ms=None)
+joined = lt.join(rt_t, pw.left.j == pw.right.j).select(
+    v=pw.left.v, w=pw.right.w
+)
+out = {{"n": 0}}
+pw.io.subscribe(joined, on_change=lambda key, row, time_, diff: out.__setitem__("n", out["n"] + 1))
+t0 = time.perf_counter()
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+""" + _RANK_STATS_TAIL
 
 
 def _free_port_base(n: int = 4) -> int:
@@ -365,19 +443,28 @@ def _free_port_base(n: int = 4) -> int:
     raise RuntimeError("no consecutive free port range found")
 
 
-def _wordcount_2rank_once(prog: str, td: str, n_rows: int, distinct: int):
-    """One 2-rank run; returns the metric dict (or an error dict)."""
-    port = _free_port_base()
+def _mesh_rank_once(
+    prog: str, td: str, metric: str, world: int, extra_env: dict | None = None
+):
+    """One N-rank run of a rank program; returns the per-rank result
+    dicts (or an error metric dict). Each rank prints one JSON line with
+    elapsed_s plus its exchange counters (recv_wait/comms/compute/idle,
+    read off engine.runtime.LAST_RUN_STATS) — the scaling lanes derive
+    mesh_skew_seconds from the cross-rank recv-wait spread."""
+    port = _free_port_base(world)
     procs = []
-    for rank in range(2):
+    for rank in range(world):
         env = dict(os.environ)
         env.update(
-            PATHWAY_PROCESSES="2",
+            PATHWAY_PROCESSES=str(world),
             PATHWAY_PROCESS_ID=str(rank),
             PATHWAY_FIRST_PORT=str(port),
             JAX_PLATFORMS="cpu",
             PYTHONPATH=REPO,
         )
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        if extra_env:
+            env.update(extra_env)
         procs.append(
             subprocess.Popen(
                 [sys.executable, prog],
@@ -393,33 +480,56 @@ def _wordcount_2rank_once(prog: str, td: str, n_rows: int, distinct: int):
             try:
                 out, err = p.communicate(timeout=600)
             except subprocess.TimeoutExpired:
-                return {"metric": "wordcount_2rank_rows_per_s",
-                        "error": "timeout"}
+                return {"metric": metric, "error": "timeout"}
             if p.returncode != 0:
-                return {"metric": "wordcount_2rank_rows_per_s",
+                return {"metric": metric,
                         "error": f"rank exited {p.returncode}",
                         "stderr_tail": err.decode()[-400:]}
             last = out.decode().strip().splitlines()[-1]
             results.append(json.loads(last))
     finally:
-        # a failed/timed-out rank must not orphan its surviving peer
-        # (it would block forever on the mesh accept for the dead rank)
+        # a failed/timed-out rank must not orphan its surviving peers
+        # (they would block forever on the mesh accept for the dead rank)
         for q in procs:
             if q.poll() is None:
                 q.kill()
                 q.communicate()
+    return results
+
+
+def _mesh_metric(
+    metric: str, results: list, n_rows: int, world: int, **fields
+) -> dict:
     elapsed = max(r["elapsed_s"] for r in results)
-    return {
-        "metric": "wordcount_2rank_rows_per_s",
+    waits = [r.get("recv_wait_s") for r in results]
+    out = {
+        "metric": metric,
         "value": round(n_rows / elapsed, 1),
-        "unit": "rows/s",
         "n_rows": n_rows,
-        "distinct": distinct,
-        "processes": 2,
+        "processes": world,
         "host_cores": os.cpu_count() or 1,
         "per_rank_elapsed_s": [round(r["elapsed_s"], 2) for r in results],
         "output_changes_rank0": results[0]["changes"],
+        **fields,
     }
+    if all(w is not None for w in waits) and world > 1:
+        # cumulative per-wave finish spread: the fastest rank's total
+        # recv-wait beyond the slowest's — same derivation as the
+        # cluster view's mesh_skew_seconds gauge (internals/cluster.py)
+        out["mesh_skew_seconds"] = round(max(waits) - min(waits), 4)
+        out["per_rank_recv_wait_s"] = waits
+    return out
+
+
+def _wordcount_2rank_once(prog: str, td: str, n_rows: int, distinct: int):
+    """One 2-rank run; returns the metric dict (or an error dict)."""
+    results = _mesh_rank_once(prog, td, "wordcount_2rank_rows_per_s", 2)
+    if isinstance(results, dict):
+        return results
+    return _mesh_metric(
+        "wordcount_2rank_rows_per_s", results, n_rows, 2,
+        unit="rows/s", distinct=distinct,
+    )
 
 
 def bench_wordcount_2rank(
@@ -462,6 +572,85 @@ def bench_wordcount_2rank(
                 return
             runs += extra
         emit(_median_of(runs, [r["value"] for r in runs]))
+
+
+def bench_scaling(
+    ranks: list[int],
+    n_rows: int,
+    distinct: int,
+    batch: int,
+    emit=_print_emit,
+    join_rows: int = 60_000,
+    n_keys: int = 300,
+) -> None:
+    """``--ranks 1,2,4``: the N-rank scaling-efficiency lanes
+    (ISSUE 10). Each scenario (wordcount, stream_join) runs at every
+    requested rank count through the SAME real-fork subprocess harness
+    — the 1-rank lane is the baseline, so ``scaling_efficiency =
+    value / (N × baseline)`` compares like with like (same process
+    startup, same measurement window). Each N-rank entry also records
+    ``mesh_skew_seconds`` (cross-rank recv-wait spread — the cumulative
+    per-wave finish spread; exact per-wave skew comes from
+    ``analysis --critical-path`` on a traced run) and the per-rank
+    recv-wait vector, so a scaling regression triages straight to
+    "comms-bound" vs "one slow rank". 1 warmup + 3 measured runs per
+    lane (a 4-rank cell is ~4 processes on this host — the full
+    steady-state gate would double the lane's cost for numbers the
+    dispersion field already qualifies)."""
+    import tempfile
+
+    scenarios = [
+        (
+            "wordcount",
+            _RANK_PROGRAM.format(
+                repo=REPO, n_rows=n_rows, distinct=distinct, batch=batch
+            ),
+            "rows/s",
+            n_rows,
+            {"distinct": distinct},
+        ),
+        (
+            "stream_join",
+            _JOIN_RANK_PROGRAM.format(
+                repo=REPO, n_rows=join_rows, n_keys=n_keys, batch=2_000
+            ),
+            "left-rows/s",
+            join_rows,
+            {"n_keys": n_keys},
+        ),
+    ]
+    with tempfile.TemporaryDirectory() as td:
+        for name, src, unit, rows, fields in scenarios:
+            prog = os.path.join(td, f"{name}_scaling.py")
+            with open(prog, "w") as f:
+                f.write(src)
+            baseline = None
+            for world in sorted(set(int(r) for r in ranks)):
+                metric = f"{name}_{world}rank_rows_per_s"
+
+                def once():
+                    res = _mesh_rank_once(prog, td, metric, world)
+                    if isinstance(res, dict):
+                        return res
+                    return _mesh_metric(
+                        metric, res, rows, world, unit=unit, **fields
+                    )
+
+                runs = [once() for _ in range(1 + 3)][1:]
+                bad = next((r for r in runs if "error" in r), None)
+                if bad is not None:
+                    emit(bad)
+                    continue
+                med = _median_of(runs, [r["value"] for r in runs])
+                if world == 1:
+                    baseline = med["value"]
+                    med["role"] = "scaling_baseline"
+                elif baseline:
+                    med["baseline_rows_per_s"] = baseline
+                    med["scaling_efficiency"] = round(
+                        med["value"] / (world * baseline), 4
+                    )
+                emit(med)
 
 
 def bench_traced_overhead(
@@ -629,6 +818,46 @@ _TRACED_METRICS = {
 }
 
 
+def _scaling_metric_names(ranks: list[int]) -> set[str]:
+    return {
+        f"{name}_{world}rank_rows_per_s"
+        for name in ("wordcount", "stream_join")
+        for world in ranks
+    }
+
+
+def main_scaling_artifact(
+    ranks: list[int], n_rows: int, distinct: int, batch: int
+) -> None:
+    """--ranks ... --update-artifact: re-measure ONLY the N-rank scaling
+    lanes and splice their metric lines into BENCH_full.json in place
+    (the single-rank relational entries and everything else untouched;
+    a 2-rank lane replaces the legacy wordcount_2rank entry — same
+    metric name, same harness)."""
+    from bench_util import write_artifact_atomic
+
+    path = os.path.join(REPO, "BENCH_full.json")
+    try:
+        with open(path) as f:
+            artifact = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        artifact = []
+    names = _scaling_metric_names(ranks)
+    kept = [
+        m
+        for m in artifact
+        if not (isinstance(m, dict) and m.get("metric") in names)
+    ]
+    fresh: list[dict] = []
+
+    def emit(metric: dict) -> None:
+        _print_emit(metric)
+        fresh.append(metric)
+        write_artifact_atomic(path, kept + fresh)
+
+    bench_scaling(ranks, n_rows, distinct, batch, emit=emit)
+
+
 def main_traced_artifact(n_rows: int, distinct: int, batch: int) -> None:
     """--traced-artifact: re-measure ONLY the flight-recorder overhead
     lanes and splice the two traced metric lines into BENCH_full.json
@@ -686,15 +915,36 @@ def main_update_artifact(n_rows: int, distinct: int, batch: int) -> None:
 
 
 if __name__ == "__main__":
-    argv = [a for a in sys.argv[1:] if not a.startswith("--")]
+    args = list(sys.argv[1:])
+    ranks = None
+    if "--ranks" in args:
+        # --ranks 1,2,4: the N-rank scaling lanes (value consumed here
+        # so it is not mistaken for the positional n_rows)
+        i = args.index("--ranks")
+        try:
+            ranks = [int(x) for x in args[i + 1].split(",") if x]
+        except (IndexError, ValueError):
+            sys.exit(
+                "usage: bench_relational.py --ranks N[,M,...] "
+                "[--update-artifact]  (e.g. --ranks 1,2,4)"
+            )
+        if not ranks:
+            sys.exit("--ranks needs at least one rank count")
+        del args[i:i + 2]
+    argv = [a for a in args if not a.startswith("--")]
     n = int(argv[0]) if len(argv) > 0 else 200_000
     d = int(argv[1]) if len(argv) > 1 else 5_000
     b = int(argv[2]) if len(argv) > 2 else 2_000
-    if "--child" in sys.argv:
+    if ranks is not None:
+        if "--update-artifact" in args:
+            main_scaling_artifact(ranks, n, d, b)
+        else:
+            bench_scaling(ranks, n, d, b)
+    elif "--child" in args:
         child(n, d, b)
-    elif "--update-artifact" in sys.argv:
+    elif "--update-artifact" in args:
         main_update_artifact(n, d, b)
-    elif "--traced-artifact" in sys.argv:
+    elif "--traced-artifact" in args:
         main_traced_artifact(n, d, b)
     else:
         main(n, d, b)

@@ -97,4 +97,15 @@ echo "=== lane 8: serve-through-rollback chaos smoke (kill under load) ==="
 # --serve` (mutant: --serve-mutant replay_committed_window).
 env -u PATHWAY_LANE_PROCESSES python scripts/serve_chaos_smoke.py
 
+echo "=== lane 9: cluster observatory smoke (4-rank + straggler) ==="
+# real-fork 4-rank wordcount with ONE mesh.slow-injected straggler
+# (rank 2, seeded delay, no crash): the cluster metrics plane must be
+# observable LIVE (/metrics/cluster renders all 4 rank labels, the
+# mesh_skew_seconds gauge and scaling_efficiency while the mesh runs),
+# the merged trace must land, and `analysis --critical-path` must
+# attribute the dominant recv-wait to the injected slow rank. The
+# deterministic straggler cell itself is also replayable standalone via
+# `python scripts/fault_matrix.py --slow`.
+env -u PATHWAY_LANE_PROCESSES python scripts/cluster_smoke.py
+
 echo "=== all lanes green ==="

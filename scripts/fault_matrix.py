@@ -48,10 +48,19 @@ minimal interleaving trace becomes the victim's ``PATHWAY_FAULT_PLAN``
 at the trace's world size — the bridge from the model checker's
 symbolic schedule back to a live mesh.
 
+The ``--slow`` cell (ISSUE 10) exercises the ``mesh.slow`` straggler
+injection the inverse way: a rank-scoped ``delay`` rule drags the
+victim's wave sends, and the cell asserts every rank exits 0 (a delay
+is not a failure), the output stays bit-identical to a fault-free run,
+and the run is measurably slower (a never-firing plan must not pass
+vacuously) — so straggler lanes are deterministic and replayable like
+every crash cell.
+
 Usage:
     python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
                                    [--mesh] [--mesh-no-nb] [--mesh-only]
                                    [--mesh-world N] [--from-trace FILE]
+                                   [--slow]
 """
 
 from __future__ import annotations
@@ -534,6 +543,102 @@ def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
 
 
 # ---------------------------------------------------------------------------
+# straggler cell: mesh.slow delay injection (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def run_slow_cell(
+    timeout: float,
+    world: int = 2,
+    victim: int = 1,
+    n_rows: int = 40,
+    delay_ms: float = 120.0,
+) -> CellResult:
+    """The ``mesh.slow`` straggler cell: a rank-scoped ``delay`` rule
+    stalls the victim's wave sends — the injection the N-rank scaling
+    lanes and the critical-path analyzer's straggler attribution are
+    built on. The contract is the INVERSE of the crash cells: every
+    rank must exit 0 (a delay is not a failure), the final capture must
+    be bit-identical to the baseline run (injection changes timing,
+    never semantics), and the injected run must be measurably slower
+    than the baseline (a plan that never fires would pass vacuously —
+    the crash cells' exit-code check, translated to a delay)."""
+    import time as _time
+
+    tmpdir = tempfile.TemporaryDirectory(prefix="pw_slow_fault_")
+    tmp = tmpdir.name
+    script = os.path.join(tmp, "mesh_scenario.py")
+    with open(script, "w") as f:
+        f.write(MESH_SCENARIO.format(repo=REPO))
+    label = "mesh.slow/wave_send"
+    mode = f"mesh{world if world != 2 else ''}-r{victim}"
+
+    def fail(detail):
+        return CellResult(label, mode, 0, False, detail)
+
+    def timed_run(sub: str, plan):
+        # fresh persistence + capture dirs per run: the slow run must
+        # not restore the baseline's committed snapshot
+        d = os.path.join(tmp, sub)
+        os.makedirs(d, exist_ok=True)
+        t0 = _time.monotonic()
+        res = _run_mesh_ranks(
+            script, d, n_rows, plan, victim, timeout, None, world
+        )
+        elapsed = _time.monotonic() - t0
+        try:
+            with open(os.path.join(d, "out.r0.json")) as f:
+                got = json.load(f)
+        except FileNotFoundError:
+            got = None
+        return res, elapsed, got
+
+    res, base_s, base_out = timed_run("base", None)
+    if [rc for rc, _ in res] != [0] * world:
+        return fail(
+            f"baseline run: exits {[rc for rc, _ in res]}; stderr: "
+            f"{[e for _, e in res]}"
+        )
+    if base_out != expected_counts(n_rows):
+        return fail("baseline run produced wrong counts")
+    plan = {
+        "seed": 7,
+        "rules": [
+            {
+                "point": "mesh.slow",
+                "phase": "wave_send",
+                "action": "delay",
+                "delay_ms": delay_ms,
+            }
+        ],
+    }
+    res, slow_s, slow_out = timed_run("slow", plan)
+    if [rc for rc, _ in res] != [0] * world:
+        return fail(
+            f"straggler run: exits {[rc for rc, _ in res]} (a delay "
+            f"must never crash); stderr: {[e for _, e in res]}"
+        )
+    if slow_out != base_out:
+        return fail(
+            "straggler run diverged from baseline — delay injection "
+            "changed semantics, not just timing"
+        )
+    # the plan fires once per exchange wave on the victim (~35 waves at
+    # n_rows=40: 4-row commits × hash+gather waves per BSP round), so
+    # the expected drag is ~4s at delay_ms=120 — measured 4.3s on the
+    # 1-core CI host — against a 0.5s bar: ~8x margin over timing noise
+    if slow_s < base_s + 0.5:
+        return fail(
+            f"straggler run not measurably slower ({slow_s:.2f}s vs "
+            f"{base_s:.2f}s baseline) — the delay plan never fired"
+        )
+    return CellResult(
+        label, mode, 0, True,
+        f"bit-identical, {slow_s - base_s:.1f}s injected drag",
+    )
+
+
+# ---------------------------------------------------------------------------
 # serve grid: kill-under-load serving cells (ISSUE 9)
 # ---------------------------------------------------------------------------
 
@@ -705,6 +810,13 @@ def main(argv=None) -> int:
         help="run the serve-through-rollback grid (kill phase × victim "
         "rank × {park-replay, brownout} under live closed-loop load)",
     )
+    ap.add_argument(
+        "--slow", action="store_true",
+        help="run the mesh.slow straggler cell (rank-scoped delay "
+        "injection: every rank exits 0, output bit-identical, run "
+        "measurably slower — the deterministic straggler the scaling "
+        "lanes replay)",
+    )
     args = ap.parse_args(argv)
     hits = [int(h) for h in args.hits.split(",") if h]
 
@@ -719,6 +831,15 @@ def main(argv=None) -> int:
         return 1 if failed else 0
     if args.serve:
         results.extend(run_serve_cells(max(args.timeout, 240)))
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.slow:
+        res = run_slow_cell(max(args.timeout, 180))
+        results.append(res)
+        status = "PASS" if res.ok else "FAIL"
+        print(f"{status}  {res.point:<32} mode={res.mode:<9} {res.detail}")
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

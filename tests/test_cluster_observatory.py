@@ -1,0 +1,538 @@
+"""Cluster observatory battery (ISSUE 10): cluster metrics aggregation
+(/metrics/cluster with rank labels + derived skew/efficiency gauges),
+per-peer exchange labels, the mesh.slow straggler injection, per-segment
+trace clock offsets, the 4-rank trace merge, and the wave critical-path
+analyzer's straggler attribution."""
+
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis.critical_path import (
+    critical_path,
+    render_critical_path,
+)
+from pathway_tpu.internals import faults
+from pathway_tpu.internals.cluster import (
+    ClusterMetricsAggregator,
+    parse_openmetrics,
+)
+from pathway_tpu.internals.monitoring import (
+    ProberStats,
+    render_dashboard,
+    start_http_server,
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wordcount(n_rows=3000, batches=6, distinct=40):
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            per = n_rows // batches
+            for b in range(batches):
+                self.next_batch(
+                    [
+                        {"data": f"w{i % distinct}"}
+                        for i in range(b * per, (b + 1) * per)
+                    ]
+                )
+                self.commit()
+
+    class S(pw.Schema):
+        data: str
+
+    t = pw.io.python.read(Source(), schema=S, autocommit_duration_ms=None)
+    counts = t.groupby(pw.this.data).reduce(
+        word=pw.this.data, c=pw.reducers.count()
+    )
+    pw.io.subscribe(counts, on_change=lambda *a: None)
+
+
+def _run_traced(tmp_path, monkeypatch, lane=None):
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("PATHWAY_TRACE", path)
+    if lane is not None:
+        monkeypatch.setenv("PATHWAY_LANE_PROCESSES", str(lane))
+    _wordcount()
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return path
+
+
+# -- OpenMetrics parsing + cluster aggregation ----------------------------
+
+def test_parse_openmetrics_roundtrip():
+    stats = ProberStats()
+    stats.on_ingest("src_a", 42)
+    stats.on_exchange_frame(512, peer=1)
+    stats.on_exchange_recv_wait(1, 0.25)
+    stats.on_output_lag("out", 3.0)
+    samples = parse_openmetrics(stats.render_openmetrics())
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["connector_rows_total"] == [({"connector": "src_a"}, 42.0)]
+    assert by_name["exchange_peer_bytes_total"] == [({"peer": "1"}, 512.0)]
+    assert by_name["exchange_recv_wait_seconds_total"][0][1] == pytest.approx(
+        0.25
+    )
+    # histogram bucket lines parse (le is just a label)
+    assert any("le" in lab for lab, _ in by_name["output_lag_ms_bucket"])
+
+
+def _two_rank_endpoints():
+    """Two live ProberStats-backed /metrics endpoints with distinct
+    counters; returns (endpoints, stats list)."""
+    endpoints, stats = {}, []
+    for rank in range(2):
+        st = ProberStats()
+        st.on_ingest("src", 1000 * (rank + 1))
+        st.on_exchange_frame(256 * (rank + 1), peer=1 - rank)
+        # rank 1 waits 3x longer than rank 0 -> skew = 1.0s
+        st.on_exchange_recv_wait(1 - rank, 0.5 + rank * 1.0)
+        st.on_exchange_wave(0.2)
+        st.on_idle(0.1 * (rank + 1))
+        st.on_exchange_step(0.3, 0.7)
+        port = _free_port()
+        start_http_server(st, port)
+        endpoints[rank] = f"http://127.0.0.1:{port}/metrics"
+        stats.append(st)
+    return endpoints, stats
+
+
+def test_cluster_aggregator_merges_ranks_with_derived_gauges():
+    endpoints, _stats = _two_rank_endpoints()
+    agg = ClusterMetricsAggregator(
+        _free_port(), endpoints, interval_s=60, baseline_rows_per_s=50.0
+    )
+    agg.start()
+    try:
+        assert agg.scrape_once() == 2
+        body = agg.render_cluster()
+        # per-rank relabeling: every curated family shows both ranks
+        assert 'connector_rows_total{rank="0",connector="src"} 1000' in body
+        assert 'connector_rows_total{rank="1",connector="src"} 2000' in body
+        # the byte matrix: (rank, peer) cells
+        assert 'exchange_peer_bytes_total{rank="0",peer="1"} 256' in body
+        assert 'exchange_peer_bytes_total{rank="1",peer="0"} 512' in body
+        # derived: skew = max-min of per-rank recv-wait = 1.0
+        assert "mesh_skew_seconds 1.0" in body
+        assert "cluster_ranks 2" in body
+        # per-rank comms/compute/idle present
+        assert 'exchange_comms_seconds_total{rank="0"}' in body
+        assert 'runtime_idle_seconds_total{rank="1"}' in body
+        # the view is served over HTTP on /metrics/cluster
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{agg.port}/metrics/cluster", timeout=5
+        ) as r:
+            assert r.status == 200
+            assert "mesh_skew_seconds" in r.read().decode()
+        # throughput + efficiency need a second scrape with progress
+        _stats[0].on_ingest("src", 500)
+        time.sleep(0.05)
+        assert agg.scrape_once() == 2
+        body = agg.render_cluster()
+        assert "cluster_rows_per_s" in body
+        assert "scaling_efficiency" in body
+        summary = agg.summary()
+        assert set(summary["ranks"]) == {0, 1}
+        assert summary["skew_s"] == pytest.approx(1.0)
+        assert summary["efficiency"] is not None
+    finally:
+        agg.stop()
+
+
+def test_cluster_aggregator_rank_down_and_reresolve():
+    endpoints, _stats = _two_rank_endpoints()
+    dead_port = _free_port()
+    agg = ClusterMetricsAggregator(
+        _free_port(),
+        {0: endpoints[0], 1: f"http://127.0.0.1:{dead_port}/metrics"},
+        interval_s=60,
+    )
+    agg.start()
+    try:
+        assert agg.scrape_once() == 1
+        body = agg.render_cluster()
+        assert "cluster_ranks 1" in body
+        assert "cluster_ranks_expected 2" in body
+        assert 'connector_rows_total{rank="0",connector="src"}' in body
+        # re-resolve onto the live endpoint (supervisor respawn path):
+        # the fresh epoch is stamped and the rank scrapes again
+        agg.set_endpoints(endpoints, epoch=3)
+        assert agg.scrape_once() == 2
+        body = agg.render_cluster()
+        assert "cluster_ranks 2" in body
+        assert "cluster_epoch 3" in body
+        assert 'connector_rows_total{rank="1",connector="src"} 2000' in body
+    finally:
+        agg.stop()
+
+
+def test_cluster_module_is_stdlib_filepath_loadable():
+    """The supervisor loads internals/cluster.py by file path (no
+    package __init__s) — same contract as protocol.py/_frontend.py."""
+    from pathway_tpu.internals.cluster import load_by_path
+
+    cls = load_by_path()
+    assert cls.__name__ == "ClusterMetricsAggregator"
+    assert cls is not ClusterMetricsAggregator  # independent module
+
+
+def test_supervisor_hosts_cluster_aggregator(monkeypatch):
+    from pathway_tpu.parallel.supervisor import MeshSupervisor
+
+    monkeypatch.delenv("PATHWAY_CLUSTER_METRICS_PORT", raising=False)
+    port = _free_port()
+    sup = MeshSupervisor(["true"], processes=2, cluster_metrics=port)
+    sup._start_cluster()
+    try:
+        assert sup.cluster is not None
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200
+        # respawn path: endpoints re-resolve and the epoch is stamped
+        sup.cluster.set_endpoints(
+            sup.cluster.default_endpoints(2), epoch=1
+        )
+        assert "cluster_epoch 1" in sup.cluster.render_cluster()
+    finally:
+        sup.cluster.stop()
+        sup.cluster = None
+
+
+# -- per-peer exchange labels (satellite) ---------------------------------
+
+def test_per_peer_exchange_labels_keep_unlabeled_totals():
+    stats = ProberStats()
+    stats.on_exchange_frame(100, peer=1)
+    stats.on_exchange_frame(50, peer=2)
+    stats.on_exchange_frame(7)  # legacy call: totals only
+    text = stats.render_openmetrics()
+    assert "exchange_frames_total 3" in text
+    assert "exchange_bytes_total 157" in text
+    assert 'exchange_peer_frames_total{peer="1"} 1' in text
+    assert 'exchange_peer_bytes_total{peer="2"} 50' in text
+    stats.on_exchange_recv_wait(1, 0.5)
+    stats.on_exchange_recv_wait(1, 0.25)
+    text = stats.render_openmetrics()
+    assert "exchange_recv_wait_seconds_total 0.75" in text
+    assert (
+        'exchange_peer_recv_wait_seconds_total{peer="1"} 0.75' in text
+    )
+
+
+# -- mesh.slow straggler injection (satellite) ----------------------------
+
+def test_mesh_slow_delay_rule_sleeps_and_never_raises():
+    faults.install_plan(
+        {
+            "seed": 1,
+            "rules": [
+                {
+                    "point": "mesh.slow",
+                    "phase": "wave_send",
+                    "action": "delay",
+                    "delay_ms": 60,
+                }
+            ],
+        }
+    )
+    try:
+        t0 = time.perf_counter()
+        faults.fault_point("mesh.slow", phase="wave_send")  # fires
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.05, "delay rule did not sleep"
+        t0 = time.perf_counter()
+        faults.fault_point("mesh.slow", phase="step")  # other phase
+        assert time.perf_counter() - t0 < 0.02
+    finally:
+        faults.reset()
+
+
+def test_mesh_slow_rank_filter(monkeypatch):
+    from pathway_tpu.internals.config import (
+        pop_config_overlay,
+        push_config_overlay,
+    )
+
+    faults.install_plan(
+        {
+            "seed": 1,
+            "rules": [
+                {
+                    "point": "mesh.slow",
+                    "rank": 1,
+                    "action": "delay",
+                    "delay_ms": 60,
+                }
+            ],
+        }
+    )
+    try:
+        t0 = time.perf_counter()
+        faults.fault_point("mesh.slow", phase="wave_send")  # rank 0
+        assert time.perf_counter() - t0 < 0.02
+        tok = push_config_overlay(processes=2, process_id=1)
+        try:
+            t0 = time.perf_counter()
+            faults.fault_point("mesh.slow", phase="wave_send")
+            assert time.perf_counter() - t0 >= 0.05
+        finally:
+            pop_config_overlay(tok)
+    finally:
+        faults.reset()
+
+
+def test_mesh_slow_registered_point():
+    assert "mesh.slow" in faults.POINTS
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.FaultRule("mesh.slow", action="stall")
+
+
+# -- per-segment clock offsets (satellite) --------------------------------
+
+def test_clock_offset_segments_apply_per_event(tmp_path):
+    from pathway_tpu.internals.flight import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path / "t.json"), rank=1, world=2)
+    # handshake sample (+1ms) anchored at mono 0; epoch commit at
+    # mono 50_000 resamples to +3ms — conversion interpolates linearly
+    # between the two samples and is constant outside them
+    rec._offset_segments = [(0, 1_000_000)]
+    rec.resample_clock_offset(3_000_000, at_ns=50_000)
+    rec.note_node(0, 1, 10_000, 20_000, 5, True)
+    rec.note_node(0, 2, 60_000, 70_000, 5, True)
+    evs = [e for e in rec.chrome_events() if e.get("cat") == "node"]
+    # at 10_000 (1/5 of the way): 1ms + (2ms * 10/50) = 1.4ms
+    assert evs[0]["ts"] == pytest.approx((10_000 + 1_400_000) / 1000.0)
+    # past the latest sample: the fresh offset applies unmodified
+    assert evs[1]["ts"] == pytest.approx((60_000 + 3_000_000) / 1000.0)
+    # interpolated conversion is monotone across the boundary
+    assert evs[1]["ts"] > evs[0]["ts"]
+    # out-of-order samples are dropped (list stays sorted)
+    rec.resample_clock_offset(9_000_000, at_ns=40_000)
+    assert rec.clock_offset_ns == 3_000_000
+    doc = rec._doc()
+    assert doc["offset_segments"] == [[0, 1_000_000], [50_000, 3_000_000]]
+    # the property setter anchors at the sample instant: events BEFORE
+    # the handshake convert with the first offset unshifted
+    rec2 = FlightRecorder(str(tmp_path / "t2.json"), rank=1, world=2)
+    rec2.clock_offset_ns = 5_000_000
+    rec2.note_node(0, 1, 10_000, 20_000, 5, True)  # long before anchor
+    ev = [e for e in rec2.chrome_events() if e.get("cat") == "node"][0]
+    assert ev["ts"] == pytest.approx((10_000 + 5_000_000) / 1000.0)
+
+
+# -- 4-rank trace merge (satellite) ---------------------------------------
+
+def test_trace_four_rank_merged_and_critical_path_cli(
+    tmp_path, monkeypatch
+):
+    from pathway_tpu.analysis.__main__ import main as cli_main
+    from pathway_tpu.analysis.profile import validate_trace
+
+    path = _run_traced(tmp_path, monkeypatch, lane=4)
+    doc = json.load(open(path))
+    assert validate_trace(doc) == []
+    assert doc["pathway"]["merged_ranks"] == [0, 1, 2, 3]
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1, 2, 3}
+    # monotonic per-track timestamps survive the 4-way offset merge
+    last = {}
+    for e in evs:
+        if e.get("ph") == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last.get(key, float("-inf")) - 2e-3
+        last[key] = e["ts"]
+    # all partials consumed
+    for rank in range(4):
+        assert not os.path.exists(f"{path}.r{rank}")
+    # every rank carries tsync metadata (segments recorded)
+    meta = doc["pathway"]["rank_meta"]
+    for rank in range(4):
+        assert "clock_offset_ns" in meta[f"rank{rank}"]
+        assert meta[f"rank{rank}"]["offset_segments"]
+    # the critical-path CLI exits 0 on the merged result
+    assert cli_main(["--critical-path", path]) == 0
+
+
+# -- critical-path analyzer ------------------------------------------------
+
+def _synthetic_trace(tmp_path):
+    """Two ranks, two waves: rank 1 is slow to send (long busy), rank 0
+    absorbs it as recv-wait — the canonical straggler shape."""
+    def wave(pid, ts, dur, t, n):
+        return {
+            "name": "wave 1", "cat": "wave", "ph": "X", "pid": pid,
+            "tid": 0, "ts": ts, "dur": dur, "args": {"t": t, "exchanges": n},
+        }
+
+    def mesh(pid, name, ts, dur, peer):
+        return {
+            "name": name, "cat": "mesh", "ph": "X", "pid": pid, "tid": 0,
+            "ts": ts, "dur": dur, "args": {"peer": peer},
+        }
+
+    def node(pid, nid, ts, dur, rows):
+        return {
+            "name": f"GroupByNode#{nid}", "cat": "node", "ph": "X",
+            "pid": pid, "tid": 0, "ts": ts, "dur": dur,
+            "args": {"node": nid, "t": 1, "rows": rows, "rep": "nb"},
+        }
+
+    events = []
+    for w, base in enumerate((1000.0, 9000.0)):
+        t = 100 + w
+        # rank 0: sends immediately, then waits ~3ms on rank 1
+        events.append(wave(0, base, 3600.0, t, 1))
+        events.append(mesh(0, "send→1", base + 50, 100.0, 1))
+        events.append(mesh(0, "recv-wait←1", base + 200, 3200.0, 1))
+        # rank 1: 3ms of pre-send work (the straggler), no waiting
+        events.append(node(1, 5, base - 500, 400.0, 900))
+        events.append(wave(1, base, 3500.0, t, 1))
+        events.append(mesh(1, "send→0", base + 3000, 200.0, 0))
+        events.append(mesh(1, "recv-wait←0", base + 3250, 50.0, 0))
+    events.sort(key=lambda e: e["ts"])
+    doc = {
+        "traceEvents": events,
+        "pathway": {
+            "schema": 1,
+            "merged_ranks": [0, 1],
+            "nodes": {
+                "5": {"label": "GroupByNode#5", "verdict": "fused"},
+            },
+        },
+    }
+    p = tmp_path / "synth.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_critical_path_synthetic_straggler(tmp_path):
+    report = critical_path(_synthetic_trace(tmp_path))
+    assert report["valid"], report["problems"]
+    assert report["waves"] == 2
+    s = report["straggler"]
+    assert s["rank"] == 1 and s["waiter"] == 0
+    assert s["upstream_node"]["label"] == "GroupByNode#5"
+    assert s["upstream_node"]["verdict"] == "fused"
+    assert "rank 1" in report["verdict"]
+    # per-wave skew: rank 1 busy ~3.2ms vs rank 0 ~0.15ms, 2 waves
+    assert report["mesh_skew_seconds"] == pytest.approx(0.00605, rel=0.1)
+    assert report["speedup_if_balanced"] > 1.2
+    # legs: rank 0's wall is dominated by recv-wait, rank 1's by compute
+    legs = report["legs"]
+    assert legs[0]["recv_wait_s"] > legs[0]["compute_s"]
+    assert legs[1]["compute_s"] > legs[1]["recv_wait_s"]
+    text = render_critical_path(report)
+    assert "recv-wait matrix" in text and "rank 0 ← rank 1" in text
+
+
+def test_critical_path_single_rank_trace_is_not_an_error(tmp_path, monkeypatch):
+    monkeypatch.delenv("PATHWAY_LANE_PROCESSES", raising=False)
+    path = _run_traced(tmp_path, monkeypatch)
+    report = critical_path(path)
+    assert report["valid"]
+    assert report["waves"] == 0
+    assert "no exchange waves" in report["verdict"]
+    from pathway_tpu.analysis.__main__ import main as cli_main
+
+    assert cli_main(["--critical-path", path]) == 0
+
+
+def test_critical_path_names_injected_slow_rank(tmp_path, monkeypatch):
+    """The acceptance pin (ISSUE 10): a mesh.slow-delayed rank must be
+    named by the analyzer's straggler attribution — here over the
+    emulated 2-rank lane; scripts/cluster_smoke.py pins the real-fork
+    4-rank version in CI."""
+    faults.install_plan(
+        {
+            "seed": 7,
+            "rules": [
+                {
+                    "point": "mesh.slow",
+                    "phase": "wave_send",
+                    "rank": 1,
+                    "action": "delay",
+                    "delay_ms": 25,
+                }
+            ],
+        }
+    )
+    try:
+        path = _run_traced(tmp_path, monkeypatch, lane=2)
+    finally:
+        faults.reset()
+    report = critical_path(path)
+    assert report["valid"], report["problems"]
+    assert report["waves"] > 0
+    s = report["straggler"]
+    assert s is not None and s["rank"] == 1, report["verdict"]
+    assert "rank 1" in report["verdict"]
+    assert report["mesh_skew_seconds"] > 0.02
+    assert report["speedup_if_balanced"] > 1.0
+    # the un-delayed rank's wait leg dominates its compute leg
+    legs = report["legs"]
+    assert legs[0]["recv_wait_s"] > legs[0]["compute_s"]
+
+
+# -- dashboard cluster section --------------------------------------------
+
+def test_dashboard_renders_cluster_section():
+    from rich.console import Console
+
+    class FakeAgg:
+        def summary(self):
+            return {
+                "ranks": {
+                    0: {"rows": 1000, "comms_s": 0.5, "compute_s": 1.5,
+                        "idle_s": 0.2, "recv_wait_s": 0.4},
+                    1: {"rows": 900, "comms_s": 0.6, "compute_s": 1.4,
+                        "idle_s": 0.1, "recv_wait_s": 0.1},
+                },
+                "skew_s": 0.3,
+                "rows_per_s": 123456.0,
+                "efficiency": 0.87,
+            }
+
+    stats = ProberStats()
+    stats.on_ingest("src", 10)
+    stats.cluster = FakeAgg()
+    console = Console(record=True, width=120)
+    console.print(render_dashboard(stats))
+    text = console.export_text()
+    assert "cluster" in text
+    assert "recv-wait" in text
+    assert "skew 0.300s" in text
+    assert "efficiency 0.87" in text
+
+
+def test_dashboard_survives_broken_cluster_handle():
+    from rich.console import Console
+
+    class Broken:
+        def summary(self):
+            raise RuntimeError("scrape thread died")
+
+    stats = ProberStats()
+    stats.on_ingest("src", 10)
+    stats.cluster = Broken()
+    console = Console(record=True, width=120)
+    console.print(render_dashboard(stats))  # must not raise
+    assert "src" in console.export_text()

@@ -213,6 +213,11 @@ _PROBER_CALLS = {
     "on_exchange_fallback": (),
     "on_nb_fallback": (),
     "on_exchange_step": (0.1, 0.2),
+    # cluster observability (ISSUE 10): per-peer recv-wait, wave
+    # counters, and main-loop idle seconds
+    "on_exchange_recv_wait": (1, 0.25),
+    "on_exchange_wave": (0.5,),
+    "on_idle": (0.3,),
     "on_mesh_heartbeat_missed": (),
     "on_mesh_rank_restart": (),
     "on_mesh_rollback": (),
