@@ -185,6 +185,46 @@ def _analyze_mesh(args) -> int:
         else _env_int("PATHWAY_MESHCHECK_FAULTS", 1)
     )
     cap = _env_int("PATHWAY_MESHCHECK_MAX_STATES", 200_000)
+    if args.rescale:
+        # elastic-mesh verification (ISSUE 11): model-check the rescale
+        # transition over all crash interleavings of the rescale window
+        # — a GROW (world -> world+1) and a SHRINK (world -> world-1)
+        # run, each from a committed pre-rescale store. The supervisor
+        # may fire the rescale at any explorable point, so the reap /
+        # re-shard-restore / first-wave phases are all inside the
+        # explored window; snap_every=1 keeps cuts committing around it.
+        targets = [world + 1] + ([world - 1] if world > 1 else [])
+        reports = []
+        for target in targets:
+            report = meshcheck.check(
+                meshcheck.MeshCheckConfig(
+                    world=world,
+                    rounds=rounds,
+                    fault_budget=faults,
+                    max_states=cap,
+                    mutate=args.mesh_mutant,
+                    rescale_to=target,
+                    snap_every=1,
+                )
+            )
+            reports.append(report)
+        if args.json:
+            print(json.dumps(
+                [r.to_dict() for r in reports], indent=2
+            ))
+        else:
+            for r in reports:
+                print(r.render())
+        if any(r.violations for r in reports):
+            return 2
+        if not all(r.complete for r in reports):
+            print(
+                "state space NOT exhausted "
+                "(PATHWAY_MESHCHECK_MAX_STATES); verdict inconclusive",
+                file=sys.stderr,
+            )
+            return 3
+        return 0
     if args.program:
         runtime = _lower_program_runtime(args)
         report = meshcheck.check_runtime_mesh(
@@ -382,7 +422,16 @@ def main(argv=None) -> int:
         "--mesh-mutant", default=None,
         help="check a deliberately broken protocol variant "
              "(skip_quiesce | accept_dead_epoch | "
-             "drop_rollback_retraction) — the checker must catch it",
+             "drop_rollback_retraction | drop_reshard_shard) — the "
+             "checker must catch it",
+    )
+    parser.add_argument(
+        "--rescale", action="store_true",
+        help="with --mesh: model-check the elastic-mesh rescale "
+             "transition (ISSUE 11) — a grow (N->N+1) and a shrink "
+             "(N->N-1) run over all crash interleavings of the rescale "
+             "window, verifying re-sharded restores lose/duplicate no "
+             "deltas and dead-world stragglers are rejected",
     )
     parser.add_argument(
         "--serve", action="store_true",

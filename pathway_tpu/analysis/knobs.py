@@ -244,6 +244,35 @@ KNOBS: dict[str, Knob] = {
            "view derives scaling_efficiency = observed rows/s / "
            "(baseline × world). The N-rank bench lanes compute the same "
            "number from their own measured 1-rank run.", lo=0.001),
+        # -- elastic-mesh autoscaler (parallel/autoscale.py) --------------
+        _k("PATHWAY_AUTOSCALE_MIN", "int", 1,
+           "Smallest world size the autoscaler may shrink the mesh to.",
+           lo=1, hi=4096),
+        _k("PATHWAY_AUTOSCALE_MAX", "int", 8,
+           "Largest world size the autoscaler may grow the mesh to.",
+           lo=1, hi=4096),
+        _k("PATHWAY_AUTOSCALE_COOLDOWN_S", "float", 30.0,
+           "Hold window after every rescale: the policy re-accumulates "
+           "its hysteresis streaks against the NEW world before it may "
+           "rescale again.", lo=0, hi=86400),
+        _k("PATHWAY_AUTOSCALE_INTERVAL_S", "float", 2.0,
+           "Autoscaler observation cadence (one policy step per tick).",
+           lo=0.05, hi=3600),
+        _k("PATHWAY_AUTOSCALE_BUDGET", "int", 4,
+           "Total rescales one supervisor lifetime may perform — a "
+           "flapping load signal cannot thrash the mesh.", lo=0,
+           hi=1000),
+        _k("PATHWAY_AUTOSCALE_GROW_PRESSURE", "float", 1.0,
+           "Serving-pressure threshold (parked requests + new sheds per "
+           "tick) at or above which the grow streak advances.",
+           lo=0.0),
+        _k("PATHWAY_AUTOSCALE_SHRINK_EFFICIENCY", "float", 0.35,
+           "scaling_efficiency below which (with zero serving pressure) "
+           "the shrink streak advances — running wide when narrow "
+           "suffices burns the pod.", lo=0.0, hi=1.0),
+        _k("PATHWAY_AUTOSCALE_HYSTERESIS", "int", 2,
+           "Consecutive ticks a grow/shrink condition must hold before "
+           "the autoscaler acts.", lo=1, hi=1000),
         # -- mesh verifier (analysis/meshcheck.py) ------------------------
         _k("PATHWAY_MESHCHECK_RANKS", "int", 3,
            "Default symbolic rank count of the mesh model checker "

@@ -66,10 +66,25 @@ variant (``skip_quiesce``, ``accept_dead_epoch``,
 trace, proving the checker can actually see the bug classes it claims
 to rule out.
 
+Elastic mesh (ISSUE 11): ``rescale_to=`` arms a one-shot supervisor
+rescale directive the scheduler may fire at ANY explorable point — a
+voluntary reap + respawn into a different world size whose restore
+re-buckets the committed store through the shared ``reshard_keep``
+transition (exactly what ``persistence/reshard.py`` does to the real
+stores; token routes resolve their hash destinations against the
+CURRENT world via ``shard_owner``, so the workload re-partitions like
+the engine's key-routed rows). The terminal audit then additionally
+proves the committed-store half of exactly-once: every hash-hop entry
+applied on exactly one rank of the final world — where a broken
+re-shard (the ``drop_reshard_shard`` mutant) loses or duplicates whole
+shards. Dead-WORLD stragglers are modeled like dead-epoch ones (the
+hello binds both).
+
 CLI: ``python -m pathway_tpu.analysis --mesh [--processes N]
-[--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME] [--json]``;
-``check_runtime_mesh`` runs the checker against a *lowered plan's*
-actual exchange topology (the Plan Doctor's distributed-safety pass).
+[--mesh-rounds D] [--mesh-faults F] [--mesh-mutant NAME] [--rescale]
+[--json]``; ``check_runtime_mesh`` runs the checker against a *lowered
+plan's* actual exchange topology (the Plan Doctor's distributed-safety
+pass).
 """
 
 from __future__ import annotations
@@ -109,6 +124,14 @@ class Transitions:
         "peer_liveness",
         "classify_peer_loss",
         "supervisor_decide",
+        # elastic mesh (ISSUE 11): the stable shard mint's owner
+        # decision, the restore-side re-shard keep filter, and the
+        # supervisor's rescale-target clamp — the exact functions the
+        # engine's stable_shard / persistence re-shard reader /
+        # supervisor drive through
+        "shard_owner",
+        "reshard_keep",
+        "rescale_plan",
     )
 
     def __init__(self, overrides: dict | None = None, *, model_flags=()):
@@ -132,11 +155,21 @@ def _mutant_skip_quiesce(remaining, masks, xi):
 
 
 def _mutant_accept_dead_epoch(
-    acceptor_rank, acceptor_epoch, world, peer_rank, peer_epoch
+    acceptor_rank, acceptor_epoch, world, peer_rank, peer_epoch,
+    peer_world=None,
 ):
-    """Broken handshake: rank sanity only, the recovery epoch is NOT
-    checked — a straggler from a rolled-back epoch is let back in."""
+    """Broken handshake: rank sanity only, neither the recovery epoch
+    nor the world size is checked — a straggler from a rolled-back (or
+    rescaled) epoch is let back in."""
     return not (peer_rank <= acceptor_rank or peer_rank >= world)
+
+
+def _mutant_drop_reshard_shard(h, rank, world):
+    """Broken re-shard reader (ISSUE 11): committed entries the
+    new-world mint assigns to rank 0 are dropped on a world-size change
+    — one whole shard's deltas lost across the rescale, exactly the bug
+    class the re-bucketing's partition property rules out."""
+    return h % world == rank and h % world != 0
 
 
 def get_transitions(mutate: str | None = None) -> Transitions:
@@ -148,14 +181,17 @@ def get_transitions(mutate: str | None = None) -> Transitions:
         return Transitions({"hello_accept": _mutant_accept_dead_epoch})
     if mutate == "drop_rollback_retraction":
         return Transitions(model_flags=("drop_rollback_retraction",))
+    if mutate == "drop_reshard_shard":
+        return Transitions({"reshard_keep": _mutant_drop_reshard_shard})
     raise ValueError(
         f"unknown mutant {mutate!r}; known: skip_quiesce, "
-        "accept_dead_epoch, drop_rollback_retraction"
+        "accept_dead_epoch, drop_rollback_retraction, drop_reshard_shard"
     )
 
 
 MUTANT_NAMES = (
     "skip_quiesce", "accept_dead_epoch", "drop_rollback_retraction",
+    "drop_reshard_shard",
 )
 
 
@@ -173,13 +209,20 @@ class Exchange(NamedTuple):
 
 
 class Token(NamedTuple):
-    """One symbolic delta. ``hops`` = ((exchange_idx, dest_rank), ...):
-    the route it takes through the exchange topology; the final hop's
-    destination owns its sink entry. ``rnd`` is the source round, which
-    is what the committed-cut reconciliation keys on."""
+    """One symbolic delta. ``hops`` = ((exchange_idx, dest_spec), ...):
+    the route it takes through the exchange topology. A dest_spec is
+    ``("h", key_hash)`` for a hash hop — the destination is computed AT
+    DELIVERY TIME as ``shard_owner(key_hash, current_world)``, so the
+    same workload re-partitions across a rescale exactly like the
+    engine's key-routed rows do — or ``("f", rank)`` for a fixed
+    destination (gather → 0; broadcast legs expand per build-time
+    dest). ``skey`` is the token's source-partition hash: which rank's
+    connector commits it, again under the current world. ``rnd`` is the
+    source round the committed-cut reconciliation keys on."""
 
     tid: tuple
     rnd: int
+    skey: int
     hops: tuple
 
 
@@ -223,12 +266,16 @@ def make_workload(
     topology: tuple[Exchange, ...], world: int, rounds: int,
     tokens_per_commit: int | None = None,
 ) -> tuple:
-    """commits[rank][round] -> tuple[Token]. Each round every rank
-    commits ``tokens_per_commit`` (default ``world``) deltas; entry
-    exchanges (no upstream) seed routes that exercise every leg: hash
-    hop *i* of a commit routes to rank ``(src + i) % world``, a gather
-    hop routes to rank 0, a broadcast hop fans out to every rank. A
-    token's route then follows every downstream chain."""
+    """rounds[rnd] -> tuple[Token]. Each round carries
+    ``tokens_per_commit × world`` (default ``world²``) deltas whose
+    source ranks AND hash destinations are key hashes resolved against
+    the CURRENT world at commit/delivery time (``shard_owner``) — the
+    sizing uses ``world`` but ownership is dynamic, so the workload
+    re-partitions across a rescale exactly like the engine's committed
+    stores. Key hashes are chosen to cover every (source, dest) leg at
+    the build world; entry exchanges (no upstream) seed routes, a
+    token's route then follows every downstream chain (gather → fixed
+    rank 0, broadcast → one expanded path per build-world rank)."""
     K = world if tokens_per_commit is None else tokens_per_commit
     entries = [x for x in topology if not x.upstream]
     down: dict[int, list[int]] = {x.idx: [] for x in topology}
@@ -236,49 +283,50 @@ def make_workload(
         for u in x.upstream:
             down[u].append(x.idx)
 
-    def hop_dest(x: Exchange, src: int, i: int, prev: int) -> list[int]:
+    def hop_specs(x: Exchange, skey: int, i: int, depth: int) -> list:
         if x.mode == "gather":
-            return [0]
+            return [("f", 0)]
         if x.mode == "broadcast":
-            return list(range(world))
-        return [(src + i + prev) % world]
+            return [("f", d) for d in range(world)]
+        # hash: a deterministic key hash; varying with (skey, i, depth)
+        # sweeps every (source, dest) pair at the build world
+        return [("h", skey + i + 3 * depth + 7 * x.idx)]
 
-    commits = []
-    for rank in range(world):
-        per_round = []
-        for rnd in range(rounds):
-            toks = []
+    per_round = []
+    for rnd in range(rounds):
+        toks = []
+        for src in range(world):
+            skey = src  # shard_owner(src, world) == src at build world
             for i in range(K):
                 for e in entries:
-                    # expand every chain path through the topology
-                    paths = [[(e.idx, d)] for d in hop_dest(e, rank, i, 0)]
+                    paths = [[(e.idx, s)] for s in hop_specs(e, skey, i, 0)]
                     final_paths = []
                     frontier = paths
                     while frontier:
                         nxt = []
                         for p in frontier:
-                            last_x, last_d = p[-1]
+                            last_x, _spec = p[-1]
                             kids = down[last_x]
                             if not kids:
                                 final_paths.append(p)
                                 continue
                             for kid in kids:
-                                for d in hop_dest(
-                                    topology[kid], rank, i, last_d
+                                for s in hop_specs(
+                                    topology[kid], skey, i, len(p)
                                 ):
-                                    nxt.append(p + [(kid, d)])
+                                    nxt.append(p + [(kid, s)])
                         frontier = nxt
                     for pi, path in enumerate(final_paths):
                         toks.append(
                             Token(
-                                ("t", rank, rnd, i, e.idx, pi),
+                                ("t", rnd, src, i, e.idx, pi),
                                 rnd,
+                                skey,
                                 tuple(path),
                             )
                         )
-            per_round.append(tuple(toks))
-        commits.append(tuple(per_round))
-    return tuple(commits)
+        per_round.append(tuple(toks))
+    return tuple(per_round)
 
 
 @dataclass(frozen=True)
@@ -301,6 +349,15 @@ class MeshCheckConfig:
     max_states: int = 200_000
     topology: tuple = field(default_factory=canonical_topology)
     mutate: str | None = None
+    # elastic mesh (ISSUE 11): a one-shot supervisor rescale directive
+    # to this world size, fireable at ANY explorable point — combined
+    # with the fault budget this explores every crash interleaving of
+    # the rescale window (reap / re-shard restore / first waves).
+    # Restores whose committed cut was taken at a different world size
+    # re-bucket through the shared reshard_keep transition. Broadcast
+    # exchanges are rejected under rescale (their legs expand at build
+    # world); hash/gather topologies — the canonical shape — rescale.
+    rescale_to: int | None = None
     # partial-order reduction strength. Per-rank macro-steps pairwise
     # commute (disjoint rank state, append-only per-link sends, disjoint
     # sink keys), so "persistent" explores only the lowest-ranked rank's
@@ -341,9 +398,14 @@ class Frame(NamedTuple):
 
 
 class StoreState(NamedTuple):
-    marker: int | None   # committed cut = source round count (None = none)
+    # committed cut: (source round count, world size of the cut) — the
+    # world rides in the marker exactly like the engine's
+    # snapshot_commit marker records it (None = nothing committed)
+    marker: tuple | None
     snaps: tuple         # sorted (((rank, tag), (applied, srcpos)), ...)
-    sink: tuple          # sorted (((token_id, dest), count), ...)
+    sink: tuple          # sorted ((token_id, count), ...) — final-hop
+    #                      deliveries, keyed by token only (the dest is
+    #                      world-dependent across a rescale)
 
 
 class SupState(NamedTuple):
@@ -358,7 +420,9 @@ class State(NamedTuple):
     store: StoreState
     sup: SupState
     budget: int
-    zombies: tuple = ()  # (rank, dead_epoch) stragglers of reaped epochs
+    zombies: tuple = ()  # (rank, dead_epoch, dead_world) stragglers
+    # one-shot supervisor rescale directive still to fire (ISSUE 11)
+    rescale_pending: int | None = None
 
 
 def _initial_state(cfg: MeshCheckConfig, model=None, preseed: int = 0) -> State:
@@ -367,7 +431,9 @@ def _initial_state(cfg: MeshCheckConfig, model=None, preseed: int = 0) -> State:
     sink entries) — the restore-at-startup scenario of the fault grid's
     'restore' cells, which is the only place the restore-phase kill slot
     is reachable with a fault budget (the supervisor strips the fault
-    plan from rollback respawns)."""
+    plan from rollback respawns). Under a rescale directive the same
+    preseeded root is what makes the re-shard itself interesting: the
+    committed store holds real entries to re-bucket."""
     ranks = tuple(
         RankState(RUN, 0, ("restore",), 0, frozenset(), (), ())
         for _ in range(cfg.world)
@@ -381,26 +447,24 @@ def _initial_state(cfg: MeshCheckConfig, model=None, preseed: int = 0) -> State:
         sink = {}
         for rank in range(cfg.world):
             applied = frozenset(
-                tok.tid
-                for per_rank in model.commits
+                (tok.tid, h)
                 for rnd in range(min(preseed, cfg.rounds))
-                for tok in per_rank[rnd]
-                if any(
-                    model.topology[x].mode == "hash" and d == rank
-                    for x, d in tok.hops
-                )
+                for tok in model.rounds_tokens[rnd]
+                for h, (x, spec) in enumerate(tok.hops)
+                if model.topology[x].mode == "hash"
+                and model.hop_dest(spec, cfg.world) == rank
             )
             snaps[(rank, preseed)] = (applied, preseed)
-        for per_rank in model.commits:
-            for rnd in range(min(preseed, cfg.rounds)):
-                for tok in per_rank[rnd]:
-                    sink[(tok.tid, tok.hops[-1][1])] = 1
+        for rnd in range(min(preseed, cfg.rounds)):
+            for tok in model.rounds_tokens[rnd]:
+                sink[tok.tid] = 1
         store = StoreState(
-            preseed, tuple(sorted(snaps.items())),
+            (preseed, cfg.world), tuple(sorted(snaps.items())),
             tuple(sorted(sink.items())),
         )
     return State(
         ranks, links, store, SupState(0, 0, "watch"), cfg.fault_budget,
+        (), cfg.rescale_to,
     )
 
 
@@ -444,6 +508,10 @@ class Violation:
     kind: str
     detail: str
     trace: list = field(default_factory=list)
+    # when the checked config carried a rescale directive: the world
+    # transition, so fault_matrix --from-trace replays the trace as a
+    # real kill-and-resume RESCALE cell ({"from": N, "to": M})
+    rescale: dict | None = None
 
     def fault_plan(self) -> dict | None:
         """The trace's crash choices as a replayable PATHWAY_FAULT_PLAN
@@ -462,12 +530,15 @@ class Violation:
         return {"seed": 7, "rules": rules} if rules else None
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "detail": self.detail,
             "trace": self.trace,
             "fault_plan": self.fault_plan(),
         }
+        if self.rescale is not None:
+            out["rescale"] = self.rescale
+        return out
 
 
 @dataclass
@@ -477,6 +548,7 @@ class MeshCheckReport:
     transitions: int = 0
     terminals: int = 0
     rollbacks_explored: int = 0
+    rescales_explored: int = 0
     complete: bool = True
     violations: list = field(default_factory=list)
 
@@ -491,10 +563,12 @@ class MeshCheckReport:
             "rounds": self.config.rounds,
             "fault_budget": self.config.fault_budget,
             "mutate": self.config.mutate,
+            "rescale_to": self.config.rescale_to,
             "states": self.states,
             "transitions": self.transitions,
             "terminals": self.terminals,
             "rollbacks_explored": self.rollbacks_explored,
+            "rescales_explored": self.rescales_explored,
             "complete": self.complete,
             "ok": self.ok,
             "violations": [v.to_dict() for v in self.violations],
@@ -509,16 +583,27 @@ class MeshCheckReport:
         lines = [
             f"mesh verifier: {c.world} rank(s), {c.rounds} round(s), "
             f"fault budget {c.fault_budget}"
+            + (
+                f", rescale -> {c.rescale_to} rank(s)"
+                if c.rescale_to is not None
+                else ""
+            )
             + (f", mutant {c.mutate!r}" if c.mutate else ""),
             f"  explored {self.states} states / {self.transitions} "
             f"transitions ({self.terminals} terminal(s), "
-            f"{self.rollbacks_explored} rollback path(s))"
+            f"{self.rollbacks_explored} rollback path(s), "
+            f"{self.rescales_explored} rescale path(s))"
             + ("" if self.complete else " — INCOMPLETE (state cap hit)"),
         ]
         if not self.violations:
             lines.append(
                 "  no deadlock, frontier divergence, lost/duplicated "
-                "delta, or dead-epoch acceptance found"
+                "delta, dead-epoch or dead-world acceptance found"
+                + (
+                    " across the rescale window"
+                    if c.rescale_to is not None
+                    else ""
+                )
             )
         for v in self.violations:
             lines.append(f"  VIOLATION [{v.kind}] {v.detail}")
@@ -545,23 +630,54 @@ class MeshModel:
         self.cfg = cfg
         self.t = trans
         self.topology = cfg.topology
+        if cfg.rescale_to is not None and any(
+            x.mode == "broadcast" for x in cfg.topology
+        ):
+            raise ValueError(
+                "rescale model checking supports hash/gather exchange "
+                "topologies (broadcast legs expand at build world)"
+            )
         self.masks, self.umasks = _reach_masks(cfg.topology)
         self.xi = {i: i for i in range(len(cfg.topology))}
-        self.commits = make_workload(
+        self.rounds_tokens = make_workload(
             cfg.topology, cfg.world, cfg.rounds, cfg.tokens_per_commit
         )
-        # every (token, final_dest) the workload must deliver exactly once
-        expected = []
-        for per_rank in self.commits:
-            for toks in per_rank:
-                for tok in toks:
-                    expected.append((tok.tid, tok.hops[-1][1]))
-        self.expected = frozenset(expected)
+        self.tok_by_tid = {
+            tok.tid: tok for toks in self.rounds_tokens for tok in toks
+        }
+        # every token must reach its final hop exactly once (the dest is
+        # world-dependent, so the audit keys on the token alone), and
+        # every hash hop must be APPLIED on exactly one rank at terminal
+        # — the committed-store half of exactly-once, which is where a
+        # broken re-shard (lost/duplicated shard) surfaces
+        self.expected = frozenset(
+            tok.tid for toks in self.rounds_tokens for tok in toks
+        )
+        self.applied_expected = frozenset(
+            (tok.tid, h)
+            for toks in self.rounds_tokens
+            for tok in toks
+            for h, (x, _spec) in enumerate(tok.hops)
+            if self.topology[x].mode == "hash"
+        )
         self.full_xmask = 0
         for x in cfg.topology:
             self.full_xmask |= 1 << x.idx
 
     # -- helpers ----------------------------------------------------------
+
+    def hop_dest(self, spec, world: int) -> int:
+        """A hop's destination under the CURRENT world — hash specs
+        resolve through the shared shard_owner transition (the same
+        function stable_shard and the re-shard reader drive)."""
+        kind, v = spec
+        return self.t.shard_owner(v, world) if kind == "h" else v
+
+    def src_of(self, tok: Token, world: int) -> int:
+        """Which rank's source commits this token under the current
+        world — partition-aware connectors shard their reads by the
+        same mint."""
+        return self.t.shard_owner(tok.skey, world)
 
     def _rank_dead(self, rs: RankState) -> bool:
         return rs.status in (CRASHED, DEAD, EXIT_RESTART, EXIT_OK)
@@ -639,6 +755,7 @@ class MeshModel:
 
     def _do_restore(self, state: State, r: int) -> State:
         rs = state.ranks[r]
+        world = len(state.ranks)
         marker = state.store.marker
         if marker is None:
             # nothing committed: fresh start (connectors from scratch).
@@ -652,24 +769,54 @@ class MeshModel:
                     committed=(),
                 ),
             )
+        tag, snap_world = marker
         snaps = dict(state.store.snaps)
-        snap = snaps.get((r, marker))
-        # two-phase property: the marker only ever names a tag for which
-        # EVERY rank's snapshot exists durably
-        if snap is None:
-            raise _PropertyViolation(
-                "missing-snapshot",
-                f"commit marker names cut {marker} but rank {r} has no "
-                f"durable snapshot at that tag",
+        if snap_world == world:
+            snap = snaps.get((r, tag))
+            # two-phase property: the marker only ever names a tag for
+            # which EVERY rank's snapshot exists durably
+            if snap is None:
+                raise _PropertyViolation(
+                    "missing-snapshot",
+                    f"commit marker names cut {tag} but rank {r} has no "
+                    f"durable snapshot at that tag",
+                )
+            applied, srcpos = snap
+        else:
+            # RESCALE restore (ISSUE 11): the cut was taken at a
+            # different world size — read EVERY old rank's snapshot and
+            # re-bucket the union through the shared reshard_keep
+            # transition (exactly what persistence/reshard.py does with
+            # the real stores). The kept sets must form a partition;
+            # the drop_reshard_shard mutant breaks the keep filter and
+            # surfaces as lost deltas in the terminal audit.
+            applied_union = []
+            srcpos = tag
+            for rr in range(snap_world):
+                snap = snaps.get((rr, tag))
+                if snap is None:
+                    raise _PropertyViolation(
+                        "missing-snapshot",
+                        f"commit marker names cut {tag} at world "
+                        f"{snap_world} but rank {rr}'s snapshot is "
+                        "missing — the two-phase cut is broken",
+                    )
+                applied_union.extend(snap[0])
+            applied = frozenset(
+                (tid, h)
+                for (tid, h) in applied_union
+                if self.t.reshard_keep(
+                    self.tok_by_tid[tid].hops[h][1][1], r, world
+                )
             )
-        applied, srcpos = snap
-        state = self._reconcile_sink(state, r, cut=marker)
+        state = self._reconcile_sink(state, r, cut=tag)
         rs = state.ranks[r]._replace(
             pc=("restore_fp",), srcpos=srcpos, applied=applied,
             committed=(),
         )
         # the restore-phase kill slot fires only when there IS a marker
-        # to restore (mirrors runtime._restore_operator_snapshot_distributed)
+        # to restore (mirrors runtime._restore_operator_snapshot_distributed;
+        # on a rescale restore this slot IS the re-shard window)
         rs, hit = _fhit(rs, "restore")
         state = _set_rank(state, r, rs)
         if not self._fault_matches(state, r, "restore"):
@@ -680,17 +827,26 @@ class MeshModel:
 
     def _reconcile_sink(self, state: State, r: int, cut: int) -> State:
         """Rollback-or-retract at the exactly-once boundary: on restore,
-        this rank retracts its own sink entries (final-hop deliveries it
-        owns) for tokens the committed cut does not cover — they will be
-        re-delivered by the replay. The drop_rollback_retraction mutant
-        skips this, which is precisely a duplicated-delta bug."""
+        this rank retracts the sink entries whose final hop IT OWNS
+        under the current world for tokens the committed cut does not
+        cover — they will be re-delivered by the replay. Ownership is
+        evaluated at the CURRENT world: across a rescale the new owner
+        retracts what the old owner wrote (the sink store is shared).
+        The drop_rollback_retraction mutant skips this, which is
+        precisely a duplicated-delta bug."""
         if "drop_rollback_retraction" in self.t.model_flags:
             return state
+        world = len(state.ranks)
         sink = [
-            ((tid, dest), cnt)
-            for (tid, dest), cnt in state.store.sink
-            # tid = ("t", src, rnd, ...): rnd < cut is committed
-            if not (dest == r and tid[2] >= cut)
+            (tid, cnt)
+            for tid, cnt in state.store.sink
+            # tid = ("t", rnd, src, ...): rnd < cut is committed
+            if not (
+                tid[1] >= cut
+                and self.hop_dest(
+                    self.tok_by_tid[tid].hops[-1][1], world
+                ) == r
+            )
         ]
         return state._replace(
             store=state.store._replace(sink=tuple(sorted(sink)))
@@ -713,13 +869,20 @@ class MeshModel:
                 state, r, rs._replace(pc=pc, srcpos=rs.srcpos + 1)
             )
         t, xmask, contrib = plan[idx]
+        world = len(state.ranks)
         owner = None
-        for rr in range(self.cfg.world):
+        for rr in range(world):
             if (contrib >> rr) & 1:
                 owner = rr
         pending: dict[int, tuple] = {}
         if owner == r:
-            toks = self.commits[r][rs.srcpos]
+            # the round's tokens this rank's source owns under the
+            # CURRENT world (partition-aware reads re-shard with it)
+            toks = [
+                tok
+                for tok in self.rounds_tokens[rs.srcpos]
+                if self.src_of(tok, world) == r
+            ]
             for tok in toks:
                 x0 = tok.hops[0][0]
                 pending[x0] = pending.get(x0, ()) + ((tok, 0),)
@@ -804,7 +967,7 @@ class MeshModel:
             self.topology[x].mode == "gather" for x in wave
         )
         contrib = contrib_mask if wave_no == 1 else None
-        world = self.cfg.world
+        world = len(state.ranks)
         targets = self.t.wave_send_targets(world, r, gather_only, contrib)
         pend = dict(pending)
         links = state.links
@@ -814,7 +977,7 @@ class MeshModel:
                 toks = tuple(
                     tok
                     for tok, hop in pend.get(x, ())
-                    if tok.hops[hop][1] == peer
+                    if self.hop_dest(tok.hops[hop][1], world) == peer
                 )
                 if toks:
                     slices.append((x, toks))
@@ -877,6 +1040,7 @@ class MeshModel:
         (apply at hash dests, sink at final hops), run the cascade
         feeders under the quiesce guard, and move to the next wave."""
         rs = state.ranks[r]
+        world = len(state.ranks)
         (_op, plan, idx, remaining, pending, wave_no, _expect, got) = rs.pc
         wave = self._wave_of(remaining)
         pend = {x: list(v) for x, v in pending}
@@ -884,7 +1048,7 @@ class MeshModel:
         delivered: dict[int, list] = {x: [] for x in wave}
         for x in sorted(wave):
             for tok, hop in pend.pop(x, ()):
-                if tok.hops[hop][1] == r:
+                if self.hop_dest(tok.hops[hop][1], world) == r:
                     delivered[x].append((tok, hop))
         for frame in got:
             for x, toks in frame.slices:
@@ -897,7 +1061,7 @@ class MeshModel:
                 for tok in toks:
                     hop = None
                     for h, (hx, hd) in enumerate(tok.hops):
-                        if hx == x and hd == r:
+                        if hx == x and self.hop_dest(hd, world) == r:
                             hop = h
                     if hop is None:
                         raise _PropertyViolation(
@@ -914,10 +1078,12 @@ class MeshModel:
         for x in sorted(delivered):
             for tok, hop in delivered[x]:
                 if self.topology[x].mode == "hash":
-                    applied.add(tok.tid)
+                    # the committed-store half: this (token, hop) entry
+                    # now lives on this rank — snapshots carry it, a
+                    # rescale restore re-buckets it
+                    applied.add((tok.tid, hop))
                 if hop + 1 >= len(tok.hops):
-                    key = (tok.tid, r)
-                    sink[key] = sink.get(key, 0) + 1
+                    sink[tok.tid] = sink.get(tok.tid, 0) + 1
                     continue
                 nx = tok.hops[hop + 1][0]
                 # cascade feeder: may this local step run before the
@@ -975,7 +1141,7 @@ class MeshModel:
     def _do_close(self, state: State, r: int) -> State:
         rs = state.ranks[r]
         links = state.links
-        for peer in range(self.cfg.world):
+        for peer in range(len(state.ranks)):
             if peer != r:
                 links = _push_frame(
                     links, r, peer, Frame("bye", rs.epoch, -1, 0, ())
@@ -1007,7 +1173,7 @@ class MeshModel:
         """The BSP round's control phase: gather per-rank commit counts
         + exchange masks, let the shared commit_plan transition assign
         globally ordered times, hand every rank the same plan."""
-        world = self.cfg.world
+        world = len(state.ranks)
         counts = []
         xmasks: list[list[int]] = []
         for rs in state.ranks:
@@ -1021,7 +1187,10 @@ class MeshModel:
             for r, rs in enumerate(state.ranks):
                 state = _set_rank(state, r, rs._replace(pc=("closing",)))
             return state
-        base = self.t.commit_time(2 * world * (rnd + 1), 0)
+        # base spacing uses the LARGEST world this config can reach so
+        # commit times stay distinct per round across a rescale
+        maxw = max(world, self.cfg.world, self.cfg.rescale_to or 0)
+        base = self.t.commit_time(2 * maxw * (rnd + 1), 0)
         plan = tuple(self.t.commit_plan(base, counts, xmasks))
         for r, rs in enumerate(state.ranks):
             state = _set_rank(state, r, rs._replace(pc=("exec", plan, 0)))
@@ -1033,7 +1202,14 @@ class MeshModel:
         always names a tag for which every rank's snapshot exists
         durably."""
         tag = state.ranks[0].pc[1]
-        state = state._replace(store=state.store._replace(marker=tag))
+        # the marker records the cut's world size next to its tag — the
+        # engine's snapshot_commit marker does the same, which is how a
+        # later restore detects a rescale and takes the re-shard path
+        state = state._replace(
+            store=state.store._replace(
+                marker=(tag, len(state.ranks))
+            )
+        )
         for r, rs in enumerate(state.ranks):
             state = _set_rank(state, r, rs._replace(pc=("round",)))
         return state
@@ -1085,7 +1261,7 @@ class MeshModel:
         rollback-request code."""
         links = list(state.links)
         # inbound frames of the dead epoch are drained and discarded
-        for p in range(self.cfg.world):
+        for p in range(len(state.ranks)):
             row = list(links[p])
             row[r] = ()
             links[p] = tuple(row)
@@ -1109,8 +1285,10 @@ class MeshModel:
         survive the grace window briefly as a straggler — the model
         explores that race), collect exit codes, and take the shared
         supervisor_decide verdict: respawn everyone at epoch+1 from the
-        committed cut, or give up."""
+        committed cut, or give up. Respawns keep the CURRENT world size
+        (a pending rescale fires as its own supervisor action)."""
         outcomes = []
+        world = len(state.ranks)
         running = [
             r for r, rs in enumerate(state.ranks) if rs.status == RUN
         ]
@@ -1151,24 +1329,85 @@ class MeshModel:
             # default), so the recovered epoch runs fault-free.
             new_epoch = s.sup.epoch + payload
             old_epoch = s.sup.epoch
-            ranks = tuple(
-                RankState(RUN, new_epoch, ("restore",), 0, frozenset(),
-                          (), ())
-                for _ in range(self.cfg.world)
-            )
-            links = tuple(
-                tuple(() for _ in range(self.cfg.world))
-                for _ in range(self.cfg.world)
-            )
-            zombies = s.zombies
-            if zombie is not None:
-                zombies = zombies + ((zombie, old_epoch),)
-            s = State(
-                ranks, links, s.store,
-                SupState(new_epoch, s.sup.restarts + 1, "watch"), 0,
-                zombies,
+            s = self._respawn(
+                s, world, new_epoch,
+                restarts=s.sup.restarts + 1,
+                budget=0,
+                zombie=(zombie, old_epoch, world)
+                if zombie is not None else None,
             )
             outcomes.append((label + f"->rollback(e{new_epoch})", s))
+        return outcomes
+
+    def _respawn(
+        self, s: State, new_world: int, new_epoch: int, *,
+        restarts: int, budget: int, zombie=None,
+        clear_rescale: bool = False,
+    ) -> State:
+        """Fresh rank set + empty links at the given world size; the
+        durable store survives (that is the whole point)."""
+        ranks = tuple(
+            RankState(RUN, new_epoch, ("restore",), 0, frozenset(),
+                      (), ())
+            for _ in range(new_world)
+        )
+        links = tuple(
+            tuple(() for _ in range(new_world)) for _ in range(new_world)
+        )
+        zombies = s.zombies
+        if zombie is not None:
+            zombies = zombies + (zombie,)
+        return State(
+            ranks, links, s.store,
+            SupState(new_epoch, restarts, "watch"), budget,
+            zombies,
+            None if clear_rescale else s.rescale_pending,
+        )
+
+    def rescale_outcomes(self, state: State) -> list[tuple[str, State]]:
+        """The supervisor's one-shot rescale directive (ISSUE 11): a
+        VOLUNTARY rollback into a different world size — reap the whole
+        rank set wherever it is (every still-running rank may straggle,
+        like a failure reap), respawn ``rescale_plan(...)`` ranks at
+        epoch+1. The fault budget is PRESERVED so crashes can land
+        inside and after the rescale window — 'all crash interleavings
+        of the rescale window' is exactly this product."""
+        old_world = len(state.ranks)
+        new_world = self.t.rescale_plan(
+            old_world, state.rescale_pending
+        )
+        if new_world == old_world:
+            return [
+                (
+                    "rescale(no-op)",
+                    state._replace(rescale_pending=None),
+                )
+            ]
+        outcomes = []
+        new_epoch = state.sup.epoch + 1
+        choices: list[tuple[int | None, str]] = [
+            (None, f"rescale({old_world}->{new_world})")
+        ]
+        if self.cfg.straggler and not state.zombies:
+            for r, rs in enumerate(state.ranks):
+                if rs.status == RUN and r > 0:
+                    choices.append(
+                        (
+                            r,
+                            f"rescale({old_world}->{new_world}, "
+                            f"straggler={r})",
+                        )
+                    )
+        for zombie, label in choices:
+            s = self._respawn(
+                state, new_world, new_epoch,
+                restarts=state.sup.restarts,
+                budget=state.budget,
+                zombie=(zombie, state.sup.epoch, old_world)
+                if zombie is not None else None,
+                clear_rescale=True,
+            )
+            outcomes.append((label + f"->e{new_epoch}", s))
         return outcomes
 
     def finish(self, state: State) -> State:
@@ -1179,20 +1418,21 @@ class MeshModel:
     def straggle(self, state: State, zi: int) -> State:
         """A straggler process from a reaped epoch attempts to
         re-handshake into the recovered mesh (it dials its lower-rank
-        peers). The shared hello_accept must refuse it (the epoch is
-        bound into the hello AND its MAC); acceptance is the dead-epoch
-        violation."""
-        rank, dead_epoch = state.zombies[zi]
+        peers). The shared hello_accept must refuse it (epoch AND world
+        are bound into the hello AND its MAC); acceptance is the
+        dead-epoch / dead-world violation."""
+        rank, dead_epoch, dead_world = state.zombies[zi]
         new_epoch = state.sup.epoch
+        world = len(state.ranks)
         if self.t.hello_accept(
-            0, new_epoch, self.cfg.world, rank, dead_epoch
-        ) and dead_epoch != new_epoch:
+            0, new_epoch, world, rank, dead_epoch, dead_world
+        ) and (dead_epoch != new_epoch or dead_world != world):
             raise _PropertyViolation(
                 "dead-epoch-straggler",
-                f"rank {rank} surviving from rolled-back epoch "
-                f"{dead_epoch} was accepted into the recovered "
-                f"epoch-{new_epoch} mesh — pre-rollback in-flight state "
-                "can now leak across the rollback",
+                f"rank {rank} surviving from reaped epoch {dead_epoch} "
+                f"(world {dead_world}) was accepted into the recovered "
+                f"epoch-{new_epoch} world-{world} mesh — pre-rollback "
+                "in-flight state can now leak across the transition",
             )
         zombies = tuple(
             z for i, z in enumerate(state.zombies) if i != zi
@@ -1221,7 +1461,11 @@ class MeshModel:
 
     def check_terminal(self, state: State) -> None:
         """Exactly-once audit on clean terminal states: every workload
-        delta delivered exactly once across any rollbacks."""
+        delta delivered exactly once across any rollbacks AND any
+        rescales — at the sink (final-hop deliveries) and in the
+        committed stores (each hash-hop entry applied on exactly one
+        rank of the final world; a broken re-shard loses or duplicates
+        whole shards here)."""
         if state.sup.status != "done":
             return
         sink = dict(state.store.sink)
@@ -1236,6 +1480,27 @@ class MeshModel:
                 f"{len(missing)} lost delta(s) "
                 f"(e.g. {missing[:3]}), {len(dupes)} duplicated "
                 f"(e.g. {[(k, sink[k]) for k in dupes[:3]]})",
+            )
+        counts: dict = {}
+        for rs in state.ranks:
+            for entry in rs.applied:
+                counts[entry] = counts.get(entry, 0) + 1
+        lost = sorted(
+            e for e in self.applied_expected if e not in counts
+        )
+        dup = sorted(
+            e for e, c in counts.items()
+            if c != 1 and e in self.applied_expected
+        )
+        if lost or dup:
+            raise _PropertyViolation(
+                "exactly-once",
+                "committed store violated exactly-once across the "
+                f"world transition: {len(lost)} store entr(ies) lost "
+                f"(e.g. {lost[:3]}), {len(dup)} on several ranks "
+                f"(e.g. {[(e, counts[e]) for e in dup[:3]]}) — a "
+                "re-shard must re-bucket every entry to exactly one "
+                "new owner",
             )
 
     def is_terminal(self, state: State) -> bool:
@@ -1258,7 +1523,7 @@ def _successors(model: MeshModel, state: State) -> list[tuple[dict, Any]]:
     out: list[tuple[dict, State]] = []
     cfg = model.cfg
     per_rank: list[list[tuple[dict, State]]] = []
-    for r in range(cfg.world):
+    for r in range(len(state.ranks)):
         acts: list[tuple[dict, State]] = []
         rs = state.ranks[r]
         if rs.status != RUN:
@@ -1338,11 +1603,25 @@ def _successors(model: MeshModel, state: State) -> list[tuple[dict, Any]]:
     elif sup == "reap":
         for label, s in model.reap_outcomes(state):
             out.append(({"label": f"supervisor({label})"}, s))
+    if (
+        state.sup.status == "watch"
+        and state.rescale_pending is not None
+        and sup != "finish"
+    ):
+        # the one-shot rescale directive may fire at ANY point while
+        # the supervisor watches — reap wherever the ranks are, respawn
+        # the new world; combined with the crash branches this explores
+        # every interleaving of the rescale window
+        for label, s in model.rescale_outcomes(state):
+            out.append(({"label": f"supervisor({label})"}, s))
     if state.sup.status == "watch":
-        for zi, (zr, ze) in enumerate(state.zombies):
+        for zi, (zr, ze, zw) in enumerate(state.zombies):
             out.append(
                 (
-                    {"label": f"straggle(rank={zr}, dead_epoch={ze})"},
+                    {
+                        "label": f"straggle(rank={zr}, dead_epoch={ze}, "
+                                 f"dead_world={zw})"
+                    },
                     model.straggle(state, zi),
                 )
             )
@@ -1364,6 +1643,12 @@ def check(
         cfg.fault_budget > 0
         and "restore" in cfg.fault_phases
         and cfg.snap_every <= cfg.rounds
+    ) or (
+        # a rescale over an EMPTY store is a degenerate re-bucket; the
+        # preseeded root (a cut committed by a previous same-world run)
+        # is what makes the re-shard filter load-bearing
+        cfg.rescale_to is not None
+        and cfg.snap_every <= cfg.rounds
     ):
         # second root: a store committed through one snapshot cadence by
         # a previous run — the restore-at-startup scenario where the
@@ -1382,7 +1667,7 @@ def check(
             )
             for s, pre in roots
         ]
-        states = transitions = terminals = rollbacks = 0
+        states = transitions = terminals = rollbacks = rescales = 0
         first: Violation | None = None
         while frontier:
             if order == "dfs":
@@ -1424,6 +1709,8 @@ def check(
                 transitions += 1
                 if "rollback" in label["label"]:
                     rollbacks += 1
+                if "rescale(" in label["label"]:
+                    rescales += 1
                 if nxt not in seen:
                     seen.add(nxt)
                     frontier.append((nxt, trace + (tuple(label.items()),)))
@@ -1432,6 +1719,7 @@ def check(
             report.transitions = transitions
             report.terminals = terminals
             report.rollbacks_explored = rollbacks
+            report.rescales_explored = rescales
         return first
 
     hit = explore("dfs")
@@ -1439,7 +1727,12 @@ def check(
         # re-search breadth-first so the reported counterexample is a
         # MINIMAL interleaving trace (DFS finds deep ones first)
         minimal = explore("bfs")
-        report.violations.append(minimal or hit)
+        violation = minimal or hit
+        if cfg.rescale_to is not None:
+            violation.rescale = {
+                "from": cfg.world, "to": cfg.rescale_to,
+            }
+        report.violations.append(violation)
     return report
 
 

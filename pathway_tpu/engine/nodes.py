@@ -117,6 +117,32 @@ class Node:
     # Persist trait; engine/dataflow/persist.rs) -------------------------
     STATE_ATTRS: tuple = ()
 
+    # -- elastic-mesh rescale (ISSUE 11) ---------------------------------
+    # How this node's committed state re-partitions when the mesh is
+    # restored into a DIFFERENT world size (persistence/reshard.py):
+    #   "keyed"     — state containers are keyed by the value the
+    #                 upstream exchange sharded on (frozen grouping
+    #                 values, join keys, or row Pointers for id-routed
+    #                 exchanges; rank-local row-keyed state also
+    #                 qualifies: any deterministic unique placement is
+    #                 correct because emissions re-route downstream):
+    #                 union the old ranks' entries, keep those the
+    #                 new-world mint assigns to this rank.
+    #   "union"     — plain first-wins union, no filter: read-side memo
+    #                 state whose entries are inert on ranks that never
+    #                 see their keys (memoized rowwise outputs).
+    #   "replicate" — identical on every old rank (broadcast-fed
+    #                 state): adopt one old copy verbatim.
+    #   "refuse"    — state whose placement cannot be re-derived from a
+    #                 key (release heaps, watermark stashes): a rescale
+    #                 restore fails with an error naming the node
+    #                 rather than guessing.
+    # RESHARD_ATTRS overrides the class policy per state attribute;
+    # nodes owning native store dumps override reshard_state() instead
+    # (entry-level key access).
+    RESHARD: str = "keyed"
+    RESHARD_ATTRS: dict | None = None
+
     def state_dict(self):
         """Picklable operator state at a commit boundary."""
         return {a: getattr(self, a) for a in self.STATE_ATTRS}
@@ -249,6 +275,13 @@ class MemoizedRowwiseNode(Node):
 
 
     STATE_ATTRS = ("_memo",)
+    # rescale: memo entries are read-only replay state keyed by row key;
+    # rows arrive wherever their (re-sharded) source emits them, which
+    # is NOT the row-key mint — keep the full union on every rank so a
+    # replayed retraction always finds its memoized output (extra
+    # entries are inert; the node emits only for arriving rows)
+    RESHARD = "union"
+
     def __init__(self, scope, input_node, batch_fn):
         super().__init__(scope, [input_node])
         self.batch_fn = batch_fn
@@ -1076,6 +1109,42 @@ class JoinNode(GroupDiffNode):
             return {"__native__": self._exec.join_store_dump(self._jstore)}
         return {a: getattr(self, a) for a in self.STATE_ATTRS}
 
+    def reshard_state(self, states: list, keep) -> dict:
+        """Elastic-mesh re-bucket (persistence/reshard.py): the store is
+        keyed by the join key — exactly what the upstream exchanges
+        sharded on — so the union of the old ranks' entries filtered by
+        the new-world mint is this rank's state. Native dumps carry the
+        join key at entry[0]; old ranks' key sets are disjoint (one
+        owner per key at the old world), so concatenation IS the union.
+        A mix of native and python-form snapshots (some old ranks
+        demoted) merges on the python side via the same replay helper
+        demotion uses."""
+        native = [
+            [e for e in s["__native__"] if keep(e[0])]
+            for s in states
+            if "__native__" in s
+        ]
+        py = [s for s in states if "__native__" not in s]
+        if native and not py:
+            return {"__native__": [e for part in native for e in part]}
+        left, right = MultisetState(), MultisetState()
+        for part in native:
+            hold_l, hold_r = self.left, self.right
+            self.left, self.right = left, right
+            try:
+                self._replay_entries(part)
+            finally:
+                self.left, self.right = hold_l, hold_r
+        for s in py:
+            for attr, tgt in (("left", left), ("right", right)):
+                ms = s.get(attr)
+                if ms is None:
+                    continue
+                for jk, d in ms.data.items():
+                    if keep(jk) and jk not in tgt.data:
+                        tgt.data[jk] = d
+        return {"left": left, "right": right}
+
     def load_state(self, state) -> None:
         native = state.get("__native__") if isinstance(state, dict) else None
         if native is not None:
@@ -1487,6 +1556,38 @@ class GroupByNode(GroupDiffNode):
             return {"__native__": self._exec.store_dump(self._store)}
         return {a: getattr(self, a) for a in self.STATE_ATTRS}
 
+    def reshard_state(self, states: list, keep) -> dict:
+        """Elastic-mesh re-bucket (persistence/reshard.py): groups are
+        keyed by the grouping values — the exact value the upstream
+        exchange sharded on (frozen forms hash identically under the
+        mint's canonical serialization) — so union + new-world keep
+        filter re-partitions the store. Native dump entries carry the
+        raw grouping values at entry[0]; mixed native/python snapshots
+        merge on the python side via the demotion replay helper."""
+        native = [
+            [e for e in s["__native__"] if keep(e[0])]
+            for s in states
+            if "__native__" in s
+        ]
+        py = [s for s in states if "__native__" not in s]
+        if native and not py:
+            return {"__native__": [e for part in native for e in part]}
+        merged: dict = {}
+        for part in native:
+            hold = self.groups
+            self.groups = {}
+            try:
+                self._groups_from_native_entries(part)
+                for g, entry in self.groups.items():
+                    merged.setdefault(g, entry)
+            finally:
+                self.groups = hold
+        for s in py:
+            for g, entry in (s.get("groups") or {}).items():
+                if keep(g) and g not in merged:
+                    merged[g] = entry
+        return {"groups": merged}
+
     def load_state(self, state) -> None:
         native = state.get("__native__") if isinstance(state, dict) else None
         if native is not None:
@@ -1590,6 +1691,37 @@ class IxNode(GroupDiffNode):
 
 
     STATE_ATTRS = ("source", "keys", "keys_by_target")
+
+    def reshard_state(self, states: list, keep) -> dict:
+        """Rescale re-bucket with MIXED keying: ``source`` rows and the
+        ``keys_by_target`` index are keyed by the lookup TARGET (what
+        both exchanges co-locate on), but ``keys`` rows are keyed by the
+        query row's own id — so they follow their target's new owner,
+        not their own id's."""
+        source = TableState()
+        keys = TableState()
+        by_target: dict = defaultdict(set)
+        for s in states:
+            src = s.get("source")
+            if src is not None:
+                for k, row in src.rows.items():
+                    if keep(k):
+                        source.rows.setdefault(k, row)
+            for target, qks in (s.get("keys_by_target") or {}).items():
+                if keep(target):
+                    by_target[target] |= set(qks)
+        kept_qks = {qk for qks in by_target.values() for qk in qks}
+        for s in states:
+            krows = s.get("keys")
+            if krows is not None:
+                for qk, row in krows.rows.items():
+                    if qk in kept_qks:
+                        keys.rows.setdefault(qk, row)
+        return {
+            "source": source, "keys": keys,
+            "keys_by_target": by_target,
+        }
+
     def __init__(self, scope, source_node, keys_node, key_fn, optional=False, strict=True, source_width=0):
         super().__init__(scope, [source_node, keys_node])
         self.key_fn = key_fn  # (key,row) -> Pointer looked up in source
@@ -1795,6 +1927,12 @@ class GradualBroadcastNode(GroupDiffNode):
 
 
     STATE_ATTRS = ("left", "threshold_rows", "_legacy_threshold")
+    # rescale: left rows re-bucket by their key (any deterministic
+    # unique placement works — emissions re-route downstream); the
+    # broadcast-fed threshold is identical on every old rank
+    RESHARD_ATTRS = {
+        "threshold_rows": "replicate", "_legacy_threshold": "replicate",
+    }
     _legacy_threshold: tuple | None = None
 
     def __init__(self, scope, left_node, threshold_node, triplet_fn):

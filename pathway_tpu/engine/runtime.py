@@ -1030,8 +1030,43 @@ class Runtime:
         if self.persistence is not None and self.persistence.mode == "OPERATOR_PERSISTING":
             # operator-state snapshots (reference: OperatorPersisting,
             # operator_snapshot.rs): restore every stateful node's state at
-            # the last commit cut and seek subjects — no input replay
-            snap = self.persistence.load_operator_snapshot()
+            # the last commit cut and seek subjects — no input replay.
+            # A snapshot_commit marker means the cut is RANK-SCOPED (a
+            # mesh run, or this path's own dual-write below) — restore
+            # through the re-shard reader at world 1, which is how a
+            # shrink-to-one-rank rescale lands here (ISSUE 11)
+            marker = self.persistence.read_marker("snapshot_commit")
+            if marker is not None:
+                if isinstance(marker, tuple):
+                    tag, snap_world = marker
+                else:
+                    # legacy bare marker: only ever written by an
+                    # N-rank mesh — discover the true world from the
+                    # rank-scoped snapshot keys (decoding it as world 1
+                    # would silently drop every other rank's shard)
+                    tag = marker
+                    snap_world = self._discover_snapshot_world(tag)
+                self._snap_tag_base = tag
+                self._snap_prev_tag = tag
+                # same restore-window kill slot the distributed path
+                # exposes: on a shrink-to-1 THIS is the re-shard window,
+                # and checker traces must land in it
+                _faults.fault_point("mesh.rank_kill", phase="restore")
+                live = list(self.connectors)
+                node_states, subject_states = self._load_resharded_cut(
+                    tag, snap_world, 0, 1, live
+                )
+                for node, st in zip(self.scope.nodes, node_states):
+                    if st:
+                        node.load_state(st)
+                self._operator_subject_states.update(subject_states)
+                for conn in live:
+                    self._restore_conn_state(
+                        conn, subject_states.get(conn.name)
+                    )
+                snap = None
+            else:
+                snap = self.persistence.load_operator_snapshot()
             if snap is not None:
                 node_states, subject_states, fingerprint = snap
                 current = [node.name() for node in self.scope.nodes]
@@ -1181,11 +1216,37 @@ class Runtime:
                     now - self._last_snapshot
                 ) * 1000.0 >= self.persistence.snapshot_interval_ms:
                     self._last_snapshot = now
+                    node_states = [
+                        node.state_dict() for node in self.scope.nodes
+                    ]
+                    fingerprint = [
+                        node.name() for node in self.scope.nodes
+                    ]
+                    # rank-scoped form + commit marker (world 1) — the
+                    # same keyspace the mesh writes, so a later GROW
+                    # rescale re-shards this cut into an N-rank mesh
+                    # and a shrink-to-1 lands here symmetrically
+                    # (ISSUE 11). The pre-rescale flat key is no longer
+                    # written (it collides with the rank directory on
+                    # fs backends); restore still falls back to it for
+                    # stores from older builds.
+                    tag = getattr(self, "_snap_tag_base", 0) + 1
+                    self._snap_tag_base = tag
                     self.persistence.save_operator_snapshot(
-                        [node.state_dict() for node in self.scope.nodes],
+                        node_states,
                         dict(self._operator_subject_states),
-                        [node.name() for node in self.scope.nodes],
+                        fingerprint,
+                        key=f"operator_snapshot/r0/{tag}",
                     )
+                    self.persistence.write_marker(
+                        "snapshot_commit", (tag, 1)
+                    )
+                    prev = getattr(self, "_snap_prev_tag", None)
+                    self.persistence.prune_operator_snapshots(
+                        "operator_snapshot/r0/",
+                        {tag} if prev is None else {tag, prev},
+                    )
+                    self._snap_prev_tag = tag
             if self.error and self.terminate_on_error:
                 raise self.error
         # late notices (final flush failures, demotions) still deserve
@@ -1310,6 +1371,40 @@ class Runtime:
         commit gets its own fresh timestamp and the dataflow is
         deterministic per commit order on each connector, which the
         per-rank journal preserves."""
+        if pg.rank == 0:
+            # rescale guard (ISSUE 11): input journals are rank-scoped,
+            # so ANY world change breaks them — a shrink orphans the
+            # departed ranks' journaled rows, a grow re-partitions
+            # partition-aware reads so new ranks re-read keys the old
+            # ranks already journaled (duplicates). The first run
+            # stamps its world in a marker; every later run must match.
+            # Refuse loudly; OPERATOR_PERSISTING is the rescale path.
+            jworld = self.persistence.read_marker("journal_world")
+            if jworld is None:
+                self.persistence.write_marker("journal_world", pg.world)
+            elif jworld != pg.world:
+                raise RuntimeError(
+                    f"input journals were written by a {jworld}-rank "
+                    f"mesh but this one has {pg.world} ranks — "
+                    "PERSISTING mode journals are rank-scoped and "
+                    "cannot be re-partitioned; rescale requires "
+                    "OPERATOR_PERSISTING (or clear the persistence "
+                    "directory)"
+                )
+            # pre-marker stores: the key layout still exposes a shrink
+            for key in self.persistence.list_keys("journal/r"):
+                try:
+                    r = int(key[len("journal/r"):].split("/")[0])
+                except ValueError:
+                    continue
+                if r >= pg.world:
+                    raise RuntimeError(
+                        f"journaled input for rank {r} exists but this "
+                        f"mesh has only {pg.world} ranks — PERSISTING "
+                        "mode journals are rank-scoped and cannot be "
+                        "re-partitioned; rescale requires "
+                        "OPERATOR_PERSISTING"
+                    )
         cursors = []
         for conn in live:
             entries = self.persistence.load_journal(self._pname(conn.name))
@@ -1348,13 +1443,25 @@ class Runtime:
         commit marker (written only after every rank acked a snapshot
         tag), every rank loads its own snapshot at that tag, and restore
         is skipped entirely unless every rank has a matching, fingerprint-
-        compatible snapshot."""
-        tag = (
+        compatible snapshot.
+
+        Elastic mesh (ISSUE 11): the marker also records the WORLD SIZE
+        of the cut. When it differs from this mesh's world the restore
+        is a RESCALE — every rank reads ALL old ranks' snapshots and
+        re-buckets the committed entries through the stable shard mint
+        at the new world size (persistence/reshard.py; the kept sets
+        form a partition, so no delta is lost or duplicated — the
+        property ``--mesh --rescale`` model-checks)."""
+        marker = (
             self.persistence.read_marker("snapshot_commit")
             if pg.rank == 0
             else None
         )
-        tag = pg.bcast0(("snaptag",), tag)
+        marker = pg.bcast0(("snaptag",), marker)
+        if isinstance(marker, tuple):
+            tag, snap_world = marker
+        else:  # pre-rescale marker format: a bare tag, same world
+            tag, snap_world = marker, pg.world
         if tag is not None:
             # tags stay monotone across restarts: live-loop rounds restart
             # at 1, so new tags build on the restored one — pruning and
@@ -1368,8 +1475,13 @@ class Runtime:
             return
         # kill slot: rank dies mid-restore, after the marker tag was
         # agreed — peers abort, and the NEXT rollback must still find
-        # every rank's snapshot at this tag intact
+        # every rank's snapshot at this tag intact (for a rescale
+        # restore this slot IS the re-shard window: a kill here must
+        # leave the old-world snapshots untouched for the retry)
         _faults.fault_point("mesh.rank_kill", phase="restore")
+        if snap_world != pg.world:
+            self._restore_resharded(pg, live, tag, snap_world)
+            return
         snap = self.persistence.load_operator_snapshot(
             key=f"operator_snapshot/r{pg.rank}/{tag}"
         )
@@ -1401,6 +1513,112 @@ class Runtime:
                 "epoch_restore", epoch=pg.epoch, tag=tag
             )
 
+    def _discover_snapshot_world(self, tag: int) -> int:
+        """World size of a cut whose marker predates the (tag, world)
+        format: legacy bare markers were only written by N-rank meshes,
+        so the rank-scoped snapshot keys at the tag name the world
+        (1 + highest rank present; load_world_snapshots then verifies
+        the set is contiguous)."""
+        top = -1
+        prefix = "operator_snapshot/r"
+        for key in self.persistence.list_keys(prefix):
+            parts = key[len(prefix):].split("/")
+            if len(parts) >= 2 and parts[1] == str(tag):
+                try:
+                    top = max(top, int(parts[0]))
+                except ValueError:
+                    continue
+        if top < 0:
+            raise RuntimeError(
+                f"snapshot_commit marker names tag {tag} but no "
+                "rank-scoped snapshot exists at that tag"
+            )
+        return top + 1
+
+    def _load_resharded_cut(
+        self, tag: int, old_world: int, rank: int, world: int, live
+    ) -> tuple[list, dict]:
+        """ONE implementation of the re-shard read shared by the mesh
+        restore (`_restore_resharded`) and the single-process marker
+        restore: load every old rank's snapshot at the tag, verify +
+        align fingerprints (exchange boundaries appear/disappear at the
+        world==1 boundary), re-bucket per-node state through the mint
+        at (rank, world), and merge connector scan states. Raises
+        RuntimeError on any refusal; callers own the collectives /
+        load_state application around it."""
+        from pathway_tpu.persistence import reshard as _reshard
+
+        fingerprint = [node.name() for node in self.scope.nodes]
+        snaps = _reshard.load_world_snapshots(
+            self.persistence, tag, old_world
+        )
+        for _states, _subjects, fp in snaps:
+            if fp != snaps[0][2]:
+                raise RuntimeError(
+                    "old ranks' snapshots disagree on the graph "
+                    "shape — the cut is inconsistent"
+                )
+        mapping = _reshard.align_fingerprints(snaps[0][2], fingerprint)
+        node_states = [
+            _reshard.reshard_node_state(
+                node,
+                [snap[0][mapping[i]] for snap in snaps],
+                rank, world,
+            )
+            if mapping[i] is not None
+            else None
+            for i, node in enumerate(self.scope.nodes)
+        ]
+        subject_states = _reshard.reshard_subject_states(
+            [conn.name for conn in live], snaps,
+            {conn.name: conn.subject for conn in live},
+        )
+        return node_states, subject_states
+
+    def _restore_resharded(self, pg, live, tag: int, old_world: int) -> None:
+        """Rescale restore: the committed cut was taken at a DIFFERENT
+        world size. Every rank reads all ``old_world`` rank snapshots at
+        the tag and rebuilds its own state by re-bucketing the union
+        through the stable shard mint at the new world
+        (persistence/reshard.py) — deterministic, so all new ranks
+        derive one consistent partition with no extra coordination.
+        All-or-nothing like the fixed-world path: any rank failing to
+        load or re-bucket vetoes the restore for everyone."""
+        problem = None
+        try:
+            node_states, subject_states = self._load_resharded_cut(
+                tag, old_world, pg.rank, pg.world, live
+            )
+        except RuntimeError as exc:
+            problem = str(exc)
+        flags = pg.gather0(("snapok",), problem is None)
+        do = pg.bcast0(
+            ("snapok2",),
+            all(flags) if pg.rank == 0 else None,
+        )
+        if not do:
+            if problem is not None:
+                raise RuntimeError(
+                    f"rescale restore ({old_world}->{pg.world} ranks, "
+                    f"tag {tag}) refused: {problem}"
+                )
+            raise RuntimeError(
+                f"rescale restore ({old_world}->{pg.world} ranks, tag "
+                f"{tag}) refused by a peer rank"
+            )
+        for node, state in zip(self.scope.nodes, node_states):
+            if state:
+                node.load_state(state)
+        self._operator_subject_states.update(subject_states)
+        for conn in live:
+            self._restore_conn_state(conn, subject_states.get(conn.name))
+        self.stats.on_mesh_epoch_committed(pg.epoch)
+        if self.recorder is not None:
+            self.recorder.note_mark(
+                "epoch_restore", epoch=pg.epoch, tag=tag,
+                resharded_from=old_world,
+            )
+
     def _save_operator_snapshot_distributed(self, pg, round_no) -> None:
         """Two-phase consistent cut: every rank writes its rank-local
         snapshot tagged with the agreed round, rank 0 collects the acks
@@ -1419,7 +1637,12 @@ class Runtime:
         _faults.fault_point("mesh.rank_kill", phase="post_snapshot")
         pg.gather0(("snapack", tag), True)
         if pg.rank == 0:
-            self.persistence.write_marker("snapshot_commit", tag)
+            # the marker records the cut's WORLD SIZE next to its tag
+            # (one atomic write): a later restore into a different world
+            # detects the mismatch and takes the re-shard path
+            self.persistence.write_marker(
+                "snapshot_commit", (tag, pg.world)
+            )
         pg.barrier(("snapbar", tag))
         self.stats.on_mesh_epoch_committed(pg.epoch)
         # re-sample cross-rank clock offsets at every commit so long

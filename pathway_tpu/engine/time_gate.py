@@ -27,6 +27,13 @@ from pathway_tpu.engine.stream import (
 
 
 class _WatermarkNode(Node):
+    # elastic-mesh rescale (persistence/reshard.py): release heaps and
+    # watermark stashes are ordered rank-local structures whose
+    # placement cannot be re-derived from a key — a world-size change
+    # refuses restore with an error naming the node instead of guessing
+    # (re-buffering under a merged heap could release a row twice)
+    RESHARD = "refuse"
+
     def __init__(self, scope, input_node, gate_fn):
         super().__init__(scope, [input_node])
         # gate_fn(key, row) -> (threshold, event_time); gate_fn.batch, when

@@ -151,15 +151,20 @@ def render_sample(name: str, labels: dict, value: float) -> str:
 
 
 class _RankState:
-    """Last successful scrape of one rank."""
+    """Last successful scrape of one rank. ``departed`` marks a rank a
+    shrink rescale removed from the world (ISSUE 11): its endpoint is
+    gone, its final-scrape samples are retained and served with
+    ``stale="1"`` so the dashboards keep the departed rank's last
+    totals instead of watching them vanish."""
 
-    __slots__ = ("samples", "scraped_at", "stale", "errors")
+    __slots__ = ("samples", "scraped_at", "stale", "errors", "departed")
 
     def __init__(self):
         self.samples: list[tuple[str, dict, float]] = []
         self.scraped_at: float = 0.0
         self.stale = True
         self.errors = 0
+        self.departed = False
 
 
 class ClusterMetricsAggregator:
@@ -303,9 +308,20 @@ class ClusterMetricsAggregator:
                     self._ranks[r] = _RankState()
                 else:
                     st.stale = True
+                    st.departed = False
             for r in list(self._ranks):
                 if r not in self._endpoints:
-                    del self._ranks[r]
+                    # a shrink rescale removed this rank from the world
+                    # (ISSUE 11): keep its final-scrape samples, marked
+                    # stale + departed, instead of erasing its history
+                    # (the supervisor takes one last scrape before the
+                    # reap so the totals cover the rank's whole life)
+                    st = self._ranks[r]
+                    if st.samples:
+                        st.stale = True
+                        st.departed = True
+                    else:
+                        del self._ranks[r]
             if epoch is not None:
                 self.epoch = epoch
             # a rollback restarts ingest counters from the committed
@@ -364,9 +380,14 @@ class ClusterMetricsAggregator:
 
     # -- derived + rendering ------------------------------------------------
     def _per_rank(self, family: str) -> dict[int, float]:
-        """Sum of a family's samples per rank (labels collapsed)."""
+        """Sum of a family's samples per rank (labels collapsed).
+        Departed ranks (shrink rescale) are excluded: their frozen
+        totals would distort cross-rank derivations (skew) computed
+        over the CURRENT world."""
         out: dict[int, float] = {}
         for rank, st in self._ranks.items():
+            if st.departed:
+                continue
             total = None
             for name, _labels, value in st.samples:
                 if name == family:
@@ -418,6 +439,11 @@ class ClusterMetricsAggregator:
                 f"cluster_ranks_expected {d['ranks_expected']}",
                 "# TYPE cluster_epoch gauge",
                 f"cluster_epoch {self.epoch}",
+                # the CURRENT world size, stamped next to the epoch so a
+                # rescale is visible the scrape after it happens
+                # (departed ranks' retained samples carry stale="1")
+                "# TYPE cluster_world_size gauge",
+                f"cluster_world_size {len(self._endpoints)}",
                 "# TYPE mesh_skew_seconds gauge",
                 f"mesh_skew_seconds {d['mesh_skew_seconds']:.6f}",
             ]

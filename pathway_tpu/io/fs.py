@@ -107,24 +107,29 @@ class _FsSubject(ConnectorSubject):
         self._emitted: dict[str, list] = {}
         self._stop = False
 
-    def _owned_paths(self):
+    def _owns(self, path: str) -> bool:
+        """THE ownership predicate: does this rank scan ``path`` under
+        the current world? Shards by the path RELATIVE to the source
+        root (absolute paths differ across ranks with different
+        mounts/cwds, which would let two ranks own the same file — or
+        none own it). Shared by the live scan (``_owned_paths``) and
+        the rescale re-shard of committed scan state
+        (``reshard_scan_state``), so the two can never drift."""
         from pathway_tpu.internals.config import get_pathway_config
+        from pathway_tpu.parallel.procgroup import stable_shard
 
         c = get_pathway_config()
         if c.processes <= 1:
-            yield from _iter_paths(self.path)
-            return
-        from pathway_tpu.parallel.procgroup import stable_shard
-
-        # shard by the path RELATIVE to the source root: absolute paths
-        # differ across ranks with different mounts/cwds, which would let
-        # two ranks own the same file (or none own it)
+            return True
         root = self.path if os.path.isdir(self.path) else (
             os.path.dirname(self.path) or "."
         )
+        rel = os.path.relpath(path, root)
+        return stable_shard(rel, c.processes) == c.process_id
+
+    def _owned_paths(self):
         for p in _iter_paths(self.path):
-            rel = os.path.relpath(p, root)
-            if stable_shard(rel, c.processes) == c.process_id:
+            if self._owns(p):
                 yield p
 
     def _scan_once(self):
@@ -182,6 +187,25 @@ class _FsSubject(ConnectorSubject):
     def seek(self, state) -> None:
         self._seen = dict(state.get("seen", {}))
         self._emitted = dict(state.get("emitted", {}))
+
+    def reshard_scan_state(self, states: list) -> dict:
+        """Elastic-mesh rescale (persistence/reshard.py): merge every
+        old rank's scan state and keep the paths THIS rank owns under
+        the new world — the SAME ``_owns`` predicate the live scan
+        shards with, so a re-sharded restore never re-reads a committed
+        file and never retracts another rank's rows as 'deleted'. Runs
+        even for a single old state (a 1→N grow must still re-filter
+        the full old coverage per new rank)."""
+        seen: dict = {}
+        emitted: dict = {}
+        for st in states:
+            for p, mtime in st.get("seen", {}).items():
+                if self._owns(p) and p not in seen:
+                    seen[p] = mtime
+            for p, keyed in st.get("emitted", {}).items():
+                if self._owns(p) and p not in emitted:
+                    emitted[p] = keyed
+        return {"seen": seen, "emitted": emitted}
 
 
 def _infer_schema(path: str, fmt: str, with_metadata: bool) -> type[Schema]:

@@ -383,6 +383,16 @@ class ServingFrontend:
         self._had_attach = False
         self._attach_gen = 0  # bumped per successful attach
         self.observed_restart_s = 0.0
+        # elastic mesh (ISSUE 11): a supervisor-initiated rescale
+        # announces itself BEFORE reaping the backend, so the detached
+        # window reads `rescaling` on /healthz and its duration feeds a
+        # SEPARATE EWMA — a rescale restores a re-sharded world (more
+        # state, different cost curve) and must not pollute the crash
+        # recovery estimate that sizes Retry-After for real failures
+        self._rescaling = False
+        self._loss_was_rescale = False
+        self.observed_rescale_s = 0.0
+        self.rescales_seen = 0
         self._attach_event: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -425,7 +435,31 @@ class ServingFrontend:
         asyncio.ensure_future(self._attach_loop())
 
     def state(self) -> str:
-        return _proto.serve_frontend_state(self._backend_up, self._draining)
+        return _proto.serve_frontend_state(
+            self._backend_up, self._draining, self._rescaling
+        )
+
+    def note_rescale(self) -> None:
+        """Called by the supervisor BEFORE it reaps the rank set for a
+        rescale: the upcoming backend loss is planned, so readiness
+        reads ``rescaling`` (not ``recovering``) and the outage duration
+        lands on the rescale EWMA. Thread-safe."""
+        loop = self._loop
+        if loop is not None:
+            loop.call_soon_threadsafe(self._set_rescaling)
+
+    def _set_rescaling(self) -> None:
+        self._rescaling = True
+
+    def _retry_after_s(self) -> float:
+        """The restart-time estimate behind Retry-After: the rescale
+        EWMA while a rescale is in flight (or when it is all we have
+        observed), the crash EWMA otherwise."""
+        if self._rescaling and self.observed_rescale_s > 0:
+            return self.observed_rescale_s
+        if self.observed_restart_s > 0:
+            return self.observed_restart_s
+        return self.observed_rescale_s
 
     def drain(self) -> None:
         """Enter draining: new arrivals shed with Retry-After so a load
@@ -479,13 +513,25 @@ class ServingFrontend:
             self.metrics.on_handoff_s(handoff)
             # EWMA of observed restart time sizes Retry-After for sheds
             # and deadline expiries — clients back off for as long as a
-            # rollback actually takes here
-            self.observed_restart_s = (
-                handoff
-                if self.observed_restart_s <= 0
-                else 0.5 * self.observed_restart_s + 0.5 * handoff
-            )
+            # rollback actually takes here. Rescale handoffs feed their
+            # OWN estimate (a re-sharded restore loads every old rank's
+            # snapshot — different cost curve than a crash respawn)
+            if self._loss_was_rescale:
+                self.rescales_seen += 1
+                self.observed_rescale_s = (
+                    handoff
+                    if self.observed_rescale_s <= 0
+                    else 0.5 * self.observed_rescale_s + 0.5 * handoff
+                )
+            else:
+                self.observed_restart_s = (
+                    handoff
+                    if self.observed_restart_s <= 0
+                    else 0.5 * self.observed_restart_s + 0.5 * handoff
+                )
             self._down_since = None
+        self._rescaling = False
+        self._loss_was_rescale = False
         self._had_attach = True
         self._attach_gen += 1
         self._backend_up = True
@@ -511,6 +557,10 @@ class ServingFrontend:
         self._backend_up = False
         if self._had_attach and first:
             self._down_since = self._loop.time()
+            # classify the loss NOW: a note_rescale that arrives after
+            # the links already dropped must not retroactively relabel
+            # a crash window as a planned rescale
+            self._loss_was_rescale = self._rescaling
             self.metrics.backend_losses += 1
             # the park set at loss: every admitted, unresponded request
             # (the exactly-once boundary — responded ids never replay)
@@ -576,6 +626,8 @@ class ServingFrontend:
                 "backend_port": self.backend_port,
                 "parked": len(self._parked),
                 "observed_restart_s": round(self.observed_restart_s, 3),
+                "observed_rescale_s": round(self.observed_rescale_s, 3),
+                "rescales_seen": self.rescales_seen,
             }
         ).encode()
         await self._write_response(
@@ -597,7 +649,7 @@ class ServingFrontend:
                 keep, ctype="application/json",
                 extra={
                     "Retry-After": str(
-                        _proto.serve_retry_after(self.observed_restart_s)
+                        _proto.serve_retry_after(self._retry_after_s())
                     )
                 },
             )
@@ -721,7 +773,7 @@ class ServingFrontend:
             keep, ctype="application/json",
             extra={
                 "Retry-After": str(
-                    _proto.serve_retry_after(self.observed_restart_s)
+                    _proto.serve_retry_after(self._retry_after_s())
                 )
             },
         )

@@ -146,17 +146,30 @@ def _max_frame_bytes() -> int:
     return max(1, int(mb * 1024 * 1024))
 
 
+def shard_hash(value: Any) -> int:
+    """The stable 64-bit key digest behind :func:`stable_shard` — the
+    world-INDEPENDENT half of the mint. Exposed separately (ISSUE 11)
+    because the elastic-mesh re-shard reader (persistence/reshard.py)
+    re-buckets committed store entries from N to M shards by feeding
+    the same digest through ``protocol.shard_owner`` at the new world
+    size: same bytes, same blake2b, different modulus — a pure
+    re-bucketing, no re-hash of live data."""
+    b = _value_to_bytes(freeze_value(value))
+    return int.from_bytes(
+        hashlib.blake2b(b, digest_size=8).digest(), "little"
+    )
+
+
 def stable_shard(value: Any, world: int) -> int:
     """Deterministic, process-stable partition of a key value: the same
     injective byte serialization that backs Pointer minting (api.py), so
     every rank routes a key to the same owner regardless of PYTHONHASHSEED.
     Exact parity with the native columnar mint (exec.cpp
     shard_partition_nb) is pinned by tests/test_native_exchange.py.
-    """
-    b = _value_to_bytes(freeze_value(value))
-    return int.from_bytes(
-        hashlib.blake2b(b, digest_size=8).digest(), "little"
-    ) % world
+    The owner decision itself is the shared ``protocol.shard_owner``
+    transition the rescale model checker explores (the batched path
+    below inlines the identical modulus for speed — parity pinned)."""
+    return _proto.shard_owner(shard_hash(value), world)
 
 
 def stable_shard_many(values, world: int) -> list[int]:
@@ -304,8 +317,10 @@ class ProcessGroup:
         """Keyed MAC for one direction of the handshake. Binds BOTH fresh
         nonces plus both rank ids (so a transcript cannot be replayed into
         another session or reflected back at its sender) AND the recovery
-        epoch (so a rank surviving from a rolled-back epoch cannot
-        authenticate into the recovered mesh) under PATHWAY_MESH_SECRET.
+        epoch AND the world size (so a rank surviving from a rolled-back
+        or RESCALED epoch cannot authenticate into the recovered mesh —
+        a pre-rescale straggler's slices were minted for a different
+        shard count, ISSUE 11) under PATHWAY_MESH_SECRET.
         Frames are pickle, so no un-authenticated byte
         may reach pickle.loads — both directions must verify before any
         frame is read. The connecting side proves knowledge of the secret
@@ -320,6 +335,7 @@ class ProcessGroup:
         return hashlib.blake2b(
             role
             + self.epoch.to_bytes(8, "little")
+            + self.world.to_bytes(8, "little")
             + nonces
             + prover.to_bytes(8, "little")
             + verifier.to_bytes(8, "little"),
@@ -342,14 +358,20 @@ class ProcessGroup:
                     peer_epoch = int(
                         _LEN.unpack(_recv_exact(s, _LEN.size))[0]
                     )
+                    peer_world = int(
+                        _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+                    )
                     nonce_c = _recv_exact(s, 16)
                     if not _proto.hello_accept(
-                        self.rank, self.epoch, self.world, peer, peer_epoch
+                        self.rank, self.epoch, self.world, peer,
+                        peer_epoch, peer_world,
                     ):
-                        # bogus rank, or a straggler from a rolled-back
-                        # epoch (or a rank that missed the bump): refuse
-                        # before any keyed output — its MAC would fail
-                        # anyway (the epoch is bound into the MAC input)
+                        # bogus rank, a straggler from a rolled-back
+                        # epoch, or a dead-WORLD straggler whose slices
+                        # were minted for a different shard count
+                        # (rescale, ISSUE 11): refuse before any keyed
+                        # output — its MAC would fail anyway (epoch AND
+                        # world are bound into the MAC input)
                         raise EOFError
                     nonce_s = os.urandom(16)
                     s.sendall(nonce_s)  # challenge only — no keyed output yet
@@ -392,6 +414,7 @@ class ProcessGroup:
                 s.sendall(
                     _LEN.pack(self.rank)
                     + _LEN.pack(self.epoch)
+                    + _LEN.pack(self.world)
                     + nonce_c
                 )
                 nonce_s = _recv_exact(s, 16)

@@ -189,7 +189,41 @@ def commit_plan(
     return plan
 
 
-# -- membership: epoch-bound handshake (parallel/procgroup.py) -------------
+# -- sharding: the stable key mint (parallel/procgroup.py stable_shard) ----
+
+def shard_owner(shard_hash: int, world: int) -> int:
+    """Which rank owns a key, given the key's stable 64-bit blake2b
+    digest (``procgroup.shard_hash``; exec.cpp shard_partition_nb
+    computes the identical digest). The hash is world-independent, so
+    re-partitioning a committed store from N to M shards is a pure
+    re-bucketing of the SAME digests — the foundation the elastic-mesh
+    rescale (ISSUE 11) rests on."""
+    return shard_hash % world
+
+
+def reshard_keep(shard_hash: int, rank: int, world: int) -> bool:
+    """Restore-side re-shard filter (persistence/reshard.py): of the
+    union of all old ranks' committed entries, the new rank keeps
+    exactly those the new-world mint assigns to it. Because
+    :func:`shard_owner` is total and single-valued, the kept sets form
+    a partition — every entry lands on exactly one new rank (no lost,
+    no duplicated deltas; the ``drop_reshard_shard`` mutant breaks
+    exactly this and the rescale model checker must catch it)."""
+    return shard_owner(shard_hash, world) == rank
+
+
+def rescale_plan(
+    current: int, target: int, lo: int = 1, hi: int = 4096
+) -> int:
+    """The supervisor's clamp over a requested rescale target: the new
+    world size, bounded to ``[lo, hi]`` and at least 1. An invalid
+    (non-positive) target holds the current world."""
+    if target is None or target < 1:
+        return current
+    return max(max(1, lo), min(hi, target))
+
+
+# -- membership: epoch- and world-bound handshake (parallel/procgroup.py) --
 
 def hello_accept(
     acceptor_rank: int,
@@ -197,6 +231,7 @@ def hello_accept(
     world: int,
     peer_rank: int,
     peer_epoch: int,
+    peer_world: int | None = None,
 ) -> bool:
     """Whether an acceptor admits a connecting peer's hello. Rank must
     be a higher rank of this world (lower ranks are dialed, not
@@ -204,8 +239,17 @@ def hello_accept(
     from a rolled-back epoch can neither join nor be joined by the
     recovered mesh, so in-flight state of the dead epoch can never leak
     across a rollback. (The epoch is additionally MAC-bound, so this
-    refusal happens before any keyed output.)"""
+    refusal happens before any keyed output.)
+
+    ``peer_world`` binds the WORLD SIZE the same way (ISSUE 11): a
+    straggler from a reaped pre-rescale epoch carries the dead world's
+    size and is rejected exactly like a dead-epoch one — its rank id
+    may still be in range after a grow, but its slices were minted for
+    a different shard count and must never merge into the rescaled
+    mesh. ``None`` skips the check (pre-world-binding wire peers)."""
     if peer_rank <= acceptor_rank or peer_rank >= world:
+        return False
+    if peer_world is not None and peer_world != world:
         return False
     return peer_epoch == acceptor_epoch
 
@@ -286,16 +330,24 @@ def supervisor_decide(
 # lost or answered twice across a rollback" is checked against the code
 # that actually runs.
 
-SERVE_STATES = ("serving", "draining", "recovering")
+SERVE_STATES = ("serving", "draining", "recovering", "rescaling")
 
 
-def serve_frontend_state(backend_up: bool, draining: bool) -> str:
+def serve_frontend_state(
+    backend_up: bool, draining: bool, rescaling: bool = False
+) -> str:
     """The frontend readiness state exposed on ``/healthz``: draining
     wins (shutdown was requested — shed everything so an LB rotates us
-    out), otherwise serving iff the backend epoch is attached."""
+    out), otherwise serving iff the backend epoch is attached. A
+    detached backend during a supervisor-initiated rescale reads
+    ``rescaling`` instead of ``recovering`` (ISSUE 11): same park
+    semantics, but operators (and the Retry-After estimator) must tell
+    a planned world-size change apart from a crash rollback."""
     if draining:
         return "draining"
-    return "serving" if backend_up else "recovering"
+    if backend_up:
+        return "serving"
+    return "rescaling" if rescaling else "recovering"
 
 
 def serve_admit(
@@ -306,13 +358,15 @@ def serve_admit(
     park_budget: int,
 ) -> str:
     """Admission verdict for one arriving request: ``"admit"`` |
-    ``"park"`` | ``"shed"``. While recovering, arrivals PARK (futures
-    retained, replayed into epoch+1) up to the park budget instead of
-    being shed — a rollback is a latency blip, not an outage; past the
-    budget (or while draining) they shed with 503 + Retry-After."""
+    ``"park"`` | ``"shed"``. While recovering (or rescaling — same
+    detached-backend window, planned instead of crashed), arrivals PARK
+    (futures retained, replayed into epoch+1) up to the park budget
+    instead of being shed — a rollback is a latency blip, not an
+    outage; past the budget (or while draining) they shed with 503 +
+    Retry-After."""
     if state == "draining":
         return "shed"
-    if state == "recovering":
+    if state in ("recovering", "rescaling"):
         return "park" if parked < park_budget else "shed"
     return "admit" if inflight < queue_cap else "shed"
 
@@ -385,6 +439,64 @@ def breaker_decide(
     return "open"
 
 
+# -- autoscaler policy (parallel/autoscale.py; ISSUE 11) --------------------
+
+def autoscale_decide(
+    world: int,
+    min_world: int,
+    max_world: int,
+    pressure: float,
+    grow_pressure: float,
+    efficiency: float | None,
+    shrink_efficiency: float,
+    grow_streak: int,
+    shrink_streak: int,
+    hysteresis: int,
+    cooldown_remaining_s: float,
+    budget_remaining: int,
+) -> tuple[str, int]:
+    """One autoscaler policy step: ``("grow"|"shrink"|"hold", target)``.
+
+    ``pressure`` is the serving plane's demand signal (parked requests +
+    shed/Retry-After deltas + backlog since the last tick); ``efficiency``
+    the observatory's ``scaling_efficiency`` gauge (None before a
+    baseline exists). Semantics:
+
+    * pressure at/above ``grow_pressure`` for ``hysteresis`` consecutive
+      ticks → grow (double, capped at ``max_world``) — capacity follows
+      load;
+    * zero pressure AND efficiency below ``shrink_efficiency`` for
+      ``hysteresis`` consecutive ticks → shrink (halve, floored at
+      ``min_world``) — running wide when narrow suffices burns the pod;
+    * otherwise hold. A rescale in flight is guarded by the caller's
+      cooldown (``cooldown_remaining_s > 0`` holds — hysteresis streaks
+      must re-accumulate against the NEW world) and by the rescale
+      budget (``budget_remaining <= 0`` holds forever).
+
+    Pure and total: the autoscaler loop owns the streak/cooldown
+    bookkeeping, this function owns every verdict — which is what lets
+    tests pin the policy without a live mesh."""
+    if cooldown_remaining_s > 0 or budget_remaining <= 0:
+        return ("hold", world)
+    if (
+        pressure >= grow_pressure
+        and grow_streak >= hysteresis
+        and world < max_world
+    ):
+        return ("grow", rescale_plan(world, world * 2, min_world, max_world))
+    if (
+        pressure <= 0
+        and efficiency is not None
+        and efficiency < shrink_efficiency
+        and shrink_streak >= hysteresis
+        and world > min_world
+    ):
+        return (
+            "shrink", rescale_plan(world, world // 2, min_world, max_world)
+        )
+    return ("hold", world)
+
+
 # -- the transition table ---------------------------------------------------
 # Single source of truth for the anti-drift pins: the engine modules
 # bind their protocol decisions FROM this table at import, and
@@ -403,6 +515,10 @@ TRANSITIONS: dict[str, object] = {
     "peer_liveness": peer_liveness,
     "classify_peer_loss": classify_peer_loss,
     "supervisor_decide": supervisor_decide,
+    "shard_owner": shard_owner,
+    "reshard_keep": reshard_keep,
+    "rescale_plan": rescale_plan,
+    "autoscale_decide": autoscale_decide,
     "serve_frontend_state": serve_frontend_state,
     "serve_admit": serve_admit,
     "serve_park": serve_park,

@@ -181,6 +181,9 @@ class MeshSupervisor:
         serve_frontend: int | None = None,
         serve_backend_port: int | None = None,
         cluster_metrics: int | None = None,
+        rescale: int | None = None,
+        rescale_ctl: str | None = None,
+        autoscale: bool = False,
     ):
         if processes is None:
             processes = int(os.environ.get("PATHWAY_PROCESSES", "2") or 2)
@@ -228,6 +231,22 @@ class MeshSupervisor:
             cluster_metrics = None
         self.cluster_metrics_port = cluster_metrics
         self.cluster = None
+        # elastic mesh (ISSUE 11): a pending rescale target is a
+        # VOLUNTARY rollback into a different world size — reap the
+        # rank set, respawn M ranks at epoch+1; the fresh ranks restore
+        # the committed cut re-sharded through the stable mint
+        # (persistence/reshard.py). Never charged to the failure
+        # restart budget. One-shot `rescale=` arms a target applied
+        # once the first epoch is up; `rescale_ctl=` names a control
+        # file polled for a target world size (`echo 4 > ctl`);
+        # `autoscale=True` hosts the observatory-driven policy loop
+        # (parallel/autoscale.py) that calls request_rescale itself.
+        self._pending_rescale: int | None = rescale
+        self.rescale_ctl = rescale_ctl
+        self._ctl_seen: str | None = None
+        self.autoscale = autoscale
+        self.autoscaler = None
+        self.rescales_performed = 0
         # exposed for tests/observability
         self.epoch = 0
         self.restarts_performed = 0
@@ -333,6 +352,106 @@ class MeshSupervisor:
             procs.append(subprocess.Popen(self.command, env=env))
         return procs
 
+    # -- elastic mesh (ISSUE 11) -------------------------------------------
+    def request_rescale(self, target: int, reason: str = "manual") -> bool:
+        """Arm a rescale to ``target`` ranks (thread-safe: the
+        autoscaler loop and operators call this; the run loop performs
+        it). The target is clamped through the shared
+        ``protocol.rescale_plan`` transition; a no-op target (equal to
+        the current world after clamping) is ignored. Returns whether a
+        rescale was armed."""
+        new_world = _proto.rescale_plan(self.processes, target)
+        if new_world == self.processes:
+            return False
+        logger.info(
+            "mesh supervisor: rescale %d -> %d ranks armed (%s)",
+            self.processes, new_world, reason,
+        )
+        self._pending_rescale = new_world
+        return True
+
+    def _poll_rescale_ctl(self) -> None:
+        """``--rescale-ctl FILE``: a target world size written to the
+        control file arms a rescale (the rescale_smoke lane drives the
+        2→4→2 sequence through this). Content is re-read per poll;
+        unparsable content is ignored until it changes."""
+        if self.rescale_ctl is None:
+            return
+        try:
+            with open(self.rescale_ctl) as f:
+                raw = f.read().strip()
+        except OSError:
+            return
+        if not raw or raw == self._ctl_seen:
+            return
+        self._ctl_seen = raw
+        try:
+            target = int(raw)
+        except ValueError:
+            logger.warning(
+                "mesh supervisor: rescale control file %r holds %r — "
+                "not a world size", self.rescale_ctl, raw,
+            )
+            return
+        self.request_rescale(target, reason="control file")
+
+    def _perform_rescale(
+        self, procs: list[subprocess.Popen], new_world: int
+    ) -> None:
+        """Execute an armed rescale: a voluntary rollback into a
+        different world size. The serving frontend is told FIRST so the
+        detached-backend window reads ``rescaling`` (and sizes
+        Retry-After from the rescale EWMA, not the crash one); on a
+        shrink the cluster plane takes a final scrape so departed
+        ranks' last samples survive marked stale."""
+        old_world = self.processes
+        logger.warning(
+            "mesh supervisor: rescaling %d -> %d ranks (epoch %d -> %d): "
+            "reaping the rank set at the committed snapshot cut; the "
+            "fresh world restores it re-sharded",
+            old_world, new_world, self.epoch, self.epoch + 1,
+        )
+        if self.frontend is not None:
+            try:
+                self.frontend.note_rescale()
+            except Exception:
+                pass
+        if self.cluster is not None and new_world < old_world:
+            try:
+                self.cluster.scrape_once()
+            except Exception:
+                pass
+        codes = self._reap(procs, 0.0)
+        self.history.append(codes)
+        self.processes = new_world
+        self.epoch += 1
+        self.rescales_performed += 1
+
+    def _start_autoscaler(self) -> None:
+        """Host the observatory-driven autoscaler (parallel/autoscale.py,
+        loaded by file path like protocol.py so file-path-loaded
+        supervisors stay import-light). It watches the cluster metrics
+        plane and the serving frontend this supervisor already owns and
+        calls :meth:`request_rescale` under the registered
+        autoscale knobs (analysis/knobs.py)."""
+        if not self.autoscale or self.autoscaler is not None:
+            return
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "_pw_autoscale",
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "autoscale.py"
+            ),
+        )
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        self.autoscaler = mod.Autoscaler.from_env(self).start()
+        logger.info(
+            "mesh supervisor: autoscaler up (%s)",
+            self.autoscaler.config.describe(),
+        )
+
     @staticmethod
     def _reap(procs: list[subprocess.Popen], grace_s: float) -> list[int]:
         """Give survivors the grace window to self-detect the failure and
@@ -362,6 +481,12 @@ class MeshSupervisor:
         try:
             return self._run(procs)
         finally:
+            if self.autoscaler is not None:
+                try:
+                    self.autoscaler.stop()
+                except Exception:
+                    pass
+                self.autoscaler = None
             if self.frontend is not None:
                 # shed new arrivals (Retry-After) while the rank set
                 # winds down, then release the public listener
@@ -439,12 +564,14 @@ class MeshSupervisor:
     def _run(self, procs: list[subprocess.Popen]) -> int:
         self._start_frontend()
         self._start_cluster()
+        self._start_autoscaler()
         while True:
             procs[:] = self._spawn_epoch(self.epoch)
             if self.cluster is not None:
                 # re-resolve rank endpoints for the fresh epoch: ports
                 # are stable (20000 + rank) but scrape health resets and
-                # the view stamps the new epoch, so a rolled-back rank's
+                # the view stamps the new epoch (and, across a rescale,
+                # the new world size), so a rolled-back rank's
                 # pre-rollback counters read as stale, not current
                 self.cluster.set_endpoints(
                     self.cluster.default_endpoints(self.processes),
@@ -455,6 +582,7 @@ class MeshSupervisor:
                 self.epoch,
                 self.processes,
             )
+            rescaled = False
             while True:
                 codes = [p.poll() for p in procs]
                 if any(c is not None and c != 0 for c in codes):
@@ -466,7 +594,20 @@ class MeshSupervisor:
                         self.epoch,
                     )
                     return 0
+                self._poll_rescale_ctl()
+                pending = self._pending_rescale
+                if pending is not None:
+                    self._pending_rescale = None
+                    new_world = _proto.rescale_plan(
+                        self.processes, pending
+                    )
+                    if new_world != self.processes:
+                        self._perform_rescale(procs, new_world)
+                        rescaled = True
+                        break
                 time.sleep(self.poll_s)
+            if rescaled:
+                continue
             codes = self._reap(procs, self.grace_s)
             self.history.append(codes)
             # the rollback-vs-give-up verdict over a reaped epoch is a
@@ -542,6 +683,30 @@ def main(argv: Sequence[str] | None = None) -> int:
         "mesh_skew_seconds / scaling_efficiency gauges (default: the "
         "PATHWAY_CLUSTER_METRICS_PORT knob)",
     )
+    ap.add_argument(
+        "--rescale", type=int, default=None, metavar="M",
+        help="one-shot elastic rescale: once the mesh is up, roll it "
+        "back into M ranks at epoch+1 — the committed snapshot cut is "
+        "restored re-sharded through the stable mint "
+        "(persistence/reshard.py); requires OPERATOR_PERSISTING "
+        "persistence for stateful pipelines",
+    )
+    ap.add_argument(
+        "--rescale-ctl", default=None, metavar="FILE",
+        help="poll FILE for a target world size: `echo 4 > FILE` "
+        "rescales the running mesh to 4 ranks (the rescale_smoke lane "
+        "drives 2→4→2 through this)",
+    )
+    ap.add_argument(
+        "--autoscale", action="store_true",
+        help="host the observatory-driven autoscaler "
+        "(parallel/autoscale.py): serve backlog/park pressure up grows "
+        "the mesh, scaling_efficiency below threshold shrinks it, under "
+        "the autoscale knobs (PATHWAY_AUTOSCALE_MIN / "
+        "PATHWAY_AUTOSCALE_MAX / PATHWAY_AUTOSCALE_COOLDOWN_S / "
+        "PATHWAY_AUTOSCALE_BUDGET / PATHWAY_AUTOSCALE_HYSTERESIS); "
+        "pairs with --cluster-metrics and --serve-frontend",
+    )
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     cmd = list(args.command)
@@ -564,6 +729,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         serve_frontend=args.serve_frontend,
         serve_backend_port=args.serve_backend_port,
         cluster_metrics=args.cluster_metrics,
+        rescale=args.rescale,
+        rescale_ctl=args.rescale_ctl,
+        autoscale=args.autoscale,
     ).run()
 
 

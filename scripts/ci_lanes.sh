@@ -108,4 +108,19 @@ echo "=== lane 9: cluster observatory smoke (4-rank + straggler) ==="
 # `python scripts/fault_matrix.py --slow`.
 env -u PATHWAY_LANE_PROCESSES python scripts/cluster_smoke.py
 
+echo "=== lane 10: elastic-mesh rescale smoke (2->4->2 under load) ==="
+# real-fork supervised mesh serving concurrent keep-alive clients while
+# a paced wordcount streams under OPERATOR_PERSISTING: the supervisor
+# rescales 2->4 then 4->2 via its control file — ZERO dropped
+# connections (conservation audit admitted == responses + expired +
+# timeouts), /metrics/cluster shows the new world size LIVE
+# (cluster_world_size + 4 live rank labels, departed ranks stale="1"),
+# the frontend reports both handoffs on the rescale EWMA, and the
+# wordcount capture is bit-identical to a fixed-world run (the
+# committed stores re-bucketed 2->4->2 with no key lost/duplicated).
+# The kill-during-rescale grid: `python scripts/fault_matrix.py
+# --rescale`; the transition is model-checked by `python -m
+# pathway_tpu.analysis --mesh --rescale` (mutant drop_reshard_shard).
+env -u PATHWAY_LANE_PROCESSES python scripts/rescale_smoke.py
+
 echo "=== all lanes green ==="

@@ -56,11 +56,21 @@ and the run is measurably slower (a never-firing plan must not pass
 vacuously) — so straggler lanes are deterministic and replayable like
 every crash cell.
 
+The RESCALE grid (``--rescale``; ISSUE 11) runs kill-during-rescale
+cells: a committed world-N cut restored RE-SHARDED into world M
+(persistence/reshard.py — the stable blake2b mint re-buckets every
+committed store entry and scan-state key), with the victim killed in
+the reap / re-shard-restore / first-wave phases × grow (2→3) and
+shrink (3→2). Resume must be bit-identical under the strict
+exactly-once audit. ``--from-trace`` also replays rescale-model
+counterexamples (``analysis --mesh --rescale --json``) as real
+world-transition cells.
+
 Usage:
     python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
                                    [--mesh] [--mesh-no-nb] [--mesh-only]
                                    [--mesh-world N] [--from-trace FILE]
-                                   [--slow]
+                                   [--slow] [--rescale]
 """
 
 from __future__ import annotations
@@ -499,12 +509,48 @@ def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
     if isinstance(doc, dict) and "violations" in doc:
         world = int(doc.get("world", 2))
         violations = doc["violations"]
+    elif isinstance(doc, list) and all(
+        isinstance(d, dict) and "violations" in d for d in doc
+    ):
+        # `--mesh --rescale --json` emits one report per direction
+        # (grow + shrink); flatten their violations
+        world = int(doc[0].get("world", 2)) if doc else 2
+        violations = [v for d in doc for v in d["violations"]]
     else:
         world = 2
         violations = [doc] if isinstance(doc, dict) else list(doc)
     results: list[CellResult] = []
     for v in violations:
         plan = v.get("fault_plan")
+        rescale = v.get("rescale")
+        if rescale:
+            # a rescale-model trace replays as a real kill-and-resume
+            # ACROSS the world transition: the crash rules (if any)
+            # land in the rescaled world at the trace's phase slots
+            rules = (plan or {}).get("rules") or [None]
+            for rule in rules:
+                res = run_rescale_cell(
+                    "grow" if rescale["to"] > rescale["from"] else "shrink",
+                    int(rescale["from"]),
+                    int(rescale["to"]),
+                    kill_phase=(rule or {}).get("phase"),
+                    victim=int((rule or {}).get("rank", 1)),
+                    hit=int(((rule or {}).get("hits") or [1])[0]),
+                    timeout=timeout,
+                    plan=(
+                        {"seed": plan.get("seed", 7), "rules": [dict(rule)]}
+                        if rule
+                        else None
+                    ),
+                    label=f"trace[{v.get('kind', '?')}]/rescale",
+                )
+                results.append(res)
+                status = "PASS" if res.ok else "FAIL"
+                print(
+                    f"{status}  {res.point:<32} mode={res.mode:<9} "
+                    f"hit={res.hit}  {res.detail}"
+                )
+            continue
         if not plan or not plan.get("rules"):
             print(
                 f"trace [{v.get('kind', '?')}] has no crash step "
@@ -540,6 +586,226 @@ def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
                 f"hit={hit}  {res.detail}"
             )
     return results
+
+
+# ---------------------------------------------------------------------------
+# rescale grid: kill-during-rescale cells (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+# The rescale-safe scenario: the source shards its keys by the SAME
+# stable mint the engine's exchanges route with (stable_shard(k, P)),
+# and its scan state is a key-set that re-shards by plain union
+# (reshard_scan_state) — so a world-size change re-partitions reads
+# exactly like the committed stores re-bucket.
+RESCALE_SCENARIO = r'''
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.parallel.procgroup import stable_shard
+
+pdir, out_base, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+out_path = f"{{out_base}}.r{{rank}}.json"
+
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True  # keys sharded by the stable mint
+
+    def __init__(self):
+        super().__init__()
+        self.done = set()
+
+    def run(self):
+        import time
+
+        emitted = 0
+        for k in range(n_rows):
+            if stable_shard(k, P) != rank or k in self.done:
+                continue
+            self.next(k=k, v=k * 7)
+            self.done.add(k)
+            emitted += 1
+            if emitted % 4 == 0:
+                self.commit()
+                # spread commits over several BSP rounds so multiple
+                # snapshot cuts commit and every kill phase is reachable
+                time.sleep(0.05)
+
+    def snapshot_state(self):
+        return dict(done=sorted(self.done))
+
+    def seek(self, state):
+        self.done = set(state["done"])
+
+    def reshard_scan_state(self, states):
+        # scan coverage is a key set: the union over the old ranks is
+        # the committed coverage; this rank re-reads only keys the NEW
+        # mint assigns to it that are not in the union
+        done = set()
+        for st in states:
+            done |= set(st.get("done", ()))
+        return dict(done=sorted(done))
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="rescale_battery"
+)
+# unique keys: the group-by shards every row across the mesh and the
+# exactly-once audit is structural (c must be exactly 1 per key)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {{}}
+if os.path.exists(out_path):
+    with open(out_path) as f:
+        seen = json.load(f)
+
+
+def on_change(key, row, time_, diff):
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)  # a kill mid-write must not tear the file
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+pw.run(
+    monitoring_level=pw.MonitoringLevel.NONE,
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="OPERATOR_PERSISTING",
+        snapshot_interval_ms=0,
+    ),
+)
+'''
+
+# (label, world_from, world_to, kill_phase, victim, hit): the victim is
+# killed in the RESCALED world at the phase — "reap" cells kill nobody
+# post-rescale (the pre-rescale seed kill IS the reap-window fault),
+# "restore" cells die mid-re-shard-restore, "first_wave" cells die in
+# the new world's first wave.
+RESCALE_CELLS = [
+    ("grow", 2, 3, None, 1, 0),
+    ("grow", 2, 3, "restore", 1, 1),
+    ("grow", 2, 3, "wave_send", 2, 1),
+    ("shrink", 3, 2, None, 1, 0),
+    ("shrink", 3, 2, "restore", 1, 1),
+    ("shrink", 3, 2, "wave_send", 0, 1),
+]
+
+
+def run_rescale_cell(
+    direction: str,
+    world_from: int,
+    world_to: int,
+    kill_phase: str | None = None,
+    victim: int = 1,
+    hit: int = 1,
+    n_rows: int = 48,
+    timeout: float = 240,
+    plan: dict | None = None,
+    label: str | None = None,
+) -> CellResult:
+    """One kill-and-resume-ACROSS-WORLD-SIZES cycle:
+
+    1. seed: a ``world_from`` mesh runs under OPERATOR_PERSISTING and is
+       killed at ``post_snapshot`` — a committed cut at world_from
+       exists, the job is unfinished (this is the reap-window fault);
+    2. rescale: a ``world_to`` mesh restores that cut RE-SHARDED
+       (persistence/reshard.py), optionally killed again at the cell's
+       phase (``restore`` = mid-re-shard, ``wave_send`` = first waves of
+       the new world) — the victim must die 27 and every survivor must
+       detect + exit 28;
+    3. resume: clean ``world_to`` runs until exit 0 × world_to; the
+       final rank-0 capture must be bit-identical to an uninterrupted
+       run (strict exactly-once: every key counted exactly once)."""
+    tmpdir = tempfile.TemporaryDirectory(prefix="pw_rescale_fault_")
+    tmp = tmpdir.name
+    script = os.path.join(tmp, "rescale_scenario.py")
+    with open(script, "w") as f:
+        f.write(RESCALE_SCENARIO.format(repo=REPO))
+    label = label or (
+        f"rescale.{direction}/{kill_phase or 'clean'}"
+    )
+    mode = f"{world_from}->{world_to}-r{victim}"
+
+    def fail(detail):
+        return CellResult(label, mode, hit, False, detail)
+
+    # 1. seed a committed cut at world_from (and the reap-window kill)
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, _mesh_plan("post_snapshot", 2), 1,
+        timeout, None, world_from,
+    )
+    if res[1][0] != CRASH_EXIT_CODE:
+        return fail(
+            f"seed run (world {world_from}): victim exit {res[1][0]} "
+            f"(wanted {CRASH_EXIT_CODE}); stderr: {res[1][1]}"
+        )
+    # 2. the rescaled world restores the cut re-sharded
+    if kill_phase is not None or plan is not None:
+        res = _run_mesh_ranks(
+            script, tmp, n_rows,
+            plan or _mesh_plan(kill_phase, hit), victim,
+            timeout, None, world_to,
+        )
+        if res[victim][0] != CRASH_EXIT_CODE:
+            return fail(
+                f"rescale kill (world {world_to}): victim exit "
+                f"{res[victim][0]} (wanted {CRASH_EXIT_CODE}); stderr: "
+                f"{res[victim][1]}"
+            )
+        for survivor in range(world_to):
+            if survivor == victim:
+                continue
+            if res[survivor][0] != MESH_RESTART_EXIT_CODE:
+                return fail(
+                    f"survivor rank {survivor} exit {res[survivor][0]} "
+                    f"(wanted {MESH_RESTART_EXIT_CODE}); stderr: "
+                    f"{res[survivor][1]}"
+                )
+    # 3. clean resume at the new world
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, None, victim, timeout, None, world_to
+    )
+    if [rc for rc, _ in res] != [0] * world_to:
+        return fail(
+            f"resume (world {world_to}): exits {[rc for rc, _ in res]}; "
+            f"stderr: {[e[-400:] for _, e in res]}"
+        )
+    try:
+        with open(os.path.join(tmp, "out.r0.json")) as f:
+            got = json.load(f)
+    except FileNotFoundError:
+        return fail("resume phase wrote no rank-0 output")
+    want = expected_counts(n_rows)
+    if got != want:
+        missing = sorted(set(want) - set(got), key=int)
+        dupes = sorted(k for k, v in got.items() if v[0] != 1)
+        return fail(
+            f"exactly-once violated across the rescale: "
+            f"missing={missing} dup-counted={dupes} "
+            f"diff-keys={[k for k in got if got[k] != want.get(k)][:5]}"
+        )
+    return CellResult(
+        label, mode, hit, True,
+        f"bit-identical across {world_from}->{world_to}",
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -817,6 +1083,14 @@ def main(argv=None) -> int:
         "measurably slower — the deterministic straggler the scaling "
         "lanes replay)",
     )
+    ap.add_argument(
+        "--rescale", action="store_true",
+        help="run the kill-during-rescale grid (ISSUE 11): a committed "
+        "world-N cut restored RE-SHARDED into world M, with the victim "
+        "killed in the reap / re-shard-restore / first-wave phases × "
+        "grow (2->3) and shrink (3->2) — resume must be bit-identical "
+        "under the strict exactly-once audit",
+    )
     args = ap.parse_args(argv)
     hits = [int(h) for h in args.hits.split(",") if h]
 
@@ -840,6 +1114,22 @@ def main(argv=None) -> int:
         results.append(res)
         status = "PASS" if res.ok else "FAIL"
         print(f"{status}  {res.point:<32} mode={res.mode:<9} {res.detail}")
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.rescale:
+        for direction, wf, wt, phase, victim, hit in RESCALE_CELLS:
+            res = run_rescale_cell(
+                direction, wf, wt, kill_phase=phase, victim=victim,
+                hit=hit, timeout=max(args.timeout, 240),
+            )
+            results.append(res)
+            status = "PASS" if res.ok else "FAIL"
+            print(
+                f"{status}  {res.point:<32} mode={res.mode:<9} "
+                f"hit={res.hit}  {res.detail}"
+            )
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

@@ -1,0 +1,515 @@
+"""Elastic-mesh rescale lane (scripts/ci_lanes.sh lane 10; ISSUE 11
+acceptance cell).
+
+One cell = a REAL supervised mesh serving live closed-loop keep-alive
+traffic through the epoch-survivable frontend WHILE a paced wordcount
+pipeline streams under OPERATOR_PERSISTING, rescaled 2 → 4 → 2 ranks
+via the supervisor's control file. Asserts the elastic contract the
+tentpole promises:
+
+* **zero dropped connections** — every client request gets a terminal
+  HTTP response across BOTH rescales (a client-side transport error is
+  a FAIL), and the frontend's conservation law holds:
+  ``admitted == responses + deadline_expired + timeouts``;
+* **the observatory sees it live** — ``/metrics/cluster`` reports
+  ``cluster_world_size 4`` with 4 live rank labels while the grown
+  mesh runs, then ``2`` after the shrink (departed ranks retained
+  ``stale="1"``);
+* **both rescales actually happened** — the frontend observed >= 2
+  backend losses and its ``/healthz`` reports rescale handoffs on the
+  rescale EWMA (crash EWMA untouched);
+* **exactly-once across world sizes** — the wordcount capture is
+  bit-identical to a fixed-world (2-rank, no-rescale) run of the same
+  pipeline: the committed stores and scan states were re-bucketed
+  2 → 4 → 2 with no key lost or duplicated.
+
+Exit 0 on success with a JSON summary line. The kill-during-rescale
+grid runs via ``python scripts/fault_matrix.py --rescale``; the rescale
+transition itself is model-checked by ``python -m pathway_tpu.analysis
+--mesh --rescale`` (mutant: ``--mesh-mutant drop_reshard_shard``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SUPERVISOR = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+
+N_CLIENTS = 4
+N_PER_CLIENT = 30
+N_ROWS = 2400
+
+SCENARIO = r'''
+import json, os, sys
+sys.path.insert(0, %(repo)r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.parallel.procgroup import stable_shard
+
+pdir, out_base, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+out_path = f"{out_base}.r{rank}.json"
+serve = os.environ.get("PW_RESCALE_SMOKE_NO_SERVE", "") != "1"
+
+
+# -- wordcount leg: rescale-safe paced source -> sharded group-by ------------
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True  # keys sharded by the stable mint
+
+    def __init__(self):
+        super().__init__()
+        self.done = set()
+
+    def run(self):
+        import time
+
+        emitted = 0
+        for k in range(n_rows):
+            if stable_shard(k, P) != rank or k in self.done:
+                continue
+            self.next(k=k, v=k * 7)
+            self.done.add(k)
+            emitted += 1
+            if emitted %% 4 == 0:
+                self.commit()
+                # paced so the 2->4 and 4->2 rescales land mid-stream
+                time.sleep(0.05)
+
+    def snapshot_state(self):
+        return dict(done=sorted(self.done))
+
+    def seek(self, state):
+        self.done = set(state["done"])
+
+    def reshard_scan_state(self, states):
+        done = set()
+        for st in states:
+            done |= set(st.get("done", ()))
+        return dict(done=sorted(done))
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="rescale_wordcount"
+)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {}
+if os.path.exists(out_path):
+    with open(out_path) as f:
+        seen = json.load(f)
+
+
+def on_change(key, row, time_, diff):
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+# -- serving leg: keep-alive clients through the frontend --------------------
+if serve:
+    class Q(pw.Schema):
+        value: int
+
+    webserver = pw.io.http.PathwayWebserver(
+        host="127.0.0.1", port=%(port)d
+    )
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=Q, window_ms=20.0, max_batch=64,
+    )
+    # a cross-rank leg per window: the window's rows hash-exchange
+    # across the mesh, so a rescale mid-window is a rescale mid-dispatch
+    agg = queries.groupby(pw.this.value).reduce(
+        value=pw.this.value, c=pw.reducers.count()
+    )
+    res = queries.join(
+        agg, queries.value == agg.value, id=queries.id
+    ).select(result=queries.value * 3 + 0 * agg.c)
+    writer(res)
+
+pw.run(
+    monitoring_level=pw.MonitoringLevel.NONE,
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="OPERATOR_PERSISTING",
+        snapshot_interval_ms=0,
+    ),
+)
+'''
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fetch(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def _metrics_kv(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        name, val = line.rsplit(" ", 1)
+        try:
+            out[name] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+def _cluster_view(port: int) -> tuple[float | None, int]:
+    """(cluster_world_size, live rank-label count) off /metrics/cluster."""
+    try:
+        text = _fetch(f"http://127.0.0.1:{port}/metrics/cluster")
+    except OSError:
+        return None, 0
+    kv = _metrics_kv(text)
+    live = set()
+    for line in text.splitlines():
+        if line.startswith("connector_rows_total{") and 'stale="1"' not in line:
+            for part in line.split("{", 1)[1].split("}", 1)[0].split(","):
+                k, _, v = part.partition("=")
+                if k.strip() == "rank":
+                    live.add(v.strip('"'))
+    return kv.get("cluster_world_size"), len(live)
+
+
+def _wait_world(cport: int, want: int, deadline_s: float) -> bool:
+    """Wait until /metrics/cluster reports the target world size with
+    that many live (non-stale) rank labels."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        world, live = _cluster_view(cport)
+        if world == want and live >= want:
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def expected_counts(n_rows: int) -> dict:
+    return {str(k): [1, k * 7] for k in range(n_rows)}
+
+
+def _run_baseline(tmp: str, n_rows: int, timeout: float) -> dict | None:
+    """The fixed-world reference: the SAME pipeline at 2 ranks, serving
+    leg disabled so the run terminates on its own."""
+    d = os.path.join(tmp, "baseline")
+    os.makedirs(d, exist_ok=True)
+    scenario = os.path.join(d, "scenario.py")
+    with open(scenario, "w") as f:
+        f.write(SCENARIO % {"repo": REPO, "port": 0})
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PW_RESCALE_SMOKE_NO_SERVE": "1",
+    }
+    env.pop("PATHWAY_LANE_PROCESSES", None)
+    env.pop("PATHWAY_TRACE", None)
+    env.pop("PATHWAY_FAULT_PLAN", None)
+    rc = subprocess.run(
+        [
+            sys.executable, SUPERVISOR, "--processes", "2", "--",
+            scenario, os.path.join(d, "pstorage"),
+            os.path.join(d, "out"), str(n_rows),
+        ],
+        env=env, timeout=timeout,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    ).returncode
+    if rc != 0:
+        return None
+    try:
+        with open(os.path.join(d, "out.r0.json")) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def run_smoke(
+    n_rows: int = N_ROWS,
+    n_clients: int = N_CLIENTS,
+    n_per_client: int = N_PER_CLIENT,
+    timeout: float = 420.0,
+) -> dict:
+    from pathway_tpu.io.http import HttpError, KeepAliveSession
+
+    problems: list[str] = []
+    statuses: dict[tuple[int, int], int] = {}
+    transport_errors: list[str] = []
+    lock = threading.Lock()
+    world_seen = {"grown": False, "shrunk": False}
+
+    public_port = _free_port()
+    cluster_port = _free_port()
+
+    with tempfile.TemporaryDirectory(prefix="pw_rescale_smoke_") as tmp:
+        baseline = _run_baseline(tmp, n_rows, timeout / 2)
+        if baseline is None:
+            return {
+                "ok": False,
+                "problems": ["fixed-world baseline run failed"],
+            }
+        if baseline != expected_counts(n_rows):
+            return {
+                "ok": False,
+                "problems": ["fixed-world baseline output incorrect"],
+            }
+
+        d = os.path.join(tmp, "live")
+        os.makedirs(d, exist_ok=True)
+        scenario = os.path.join(d, "scenario.py")
+        with open(scenario, "w") as f:
+            f.write(SCENARIO % {"repo": REPO, "port": public_port})
+        ctl = os.path.join(d, "ctl")
+        out_path = os.path.join(d, "out.r0.json")
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PATHWAY_MESH_HEARTBEAT_S": "0.25",
+            "PATHWAY_MESH_PEER_TIMEOUT_S": "3",
+            "PATHWAY_MESH_OP_TIMEOUT_S": "60",
+            "PATHWAY_MESH_GRACE_S": "5",
+            # parked requests must survive full rank respawns (jax
+            # import included) twice without expiring
+            "PATHWAY_REST_TIMEOUT_S": "120",
+            "PATHWAY_CLUSTER_SCRAPE_S": "0.5",
+        }
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        env.pop("PATHWAY_TRACE", None)
+        env.pop("PATHWAY_FAULT_PLAN", None)
+        sup = subprocess.Popen(
+            [
+                sys.executable, SUPERVISOR,
+                "--processes", "2",
+                "--serve-frontend", str(public_port),
+                "--cluster-metrics", str(cluster_port),
+                "--rescale-ctl", ctl,
+                "--", scenario, os.path.join(d, "pstorage"),
+                os.path.join(d, "out"), str(n_rows),
+            ],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        )
+        stop_clients = threading.Event()
+        try:
+            # frontend is up ~immediately; early requests simply park
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{public_port}/healthz",
+                        timeout=2,
+                    ).close()
+                    break
+                except urllib.error.HTTPError:
+                    break  # 503 recovering = frontend is up
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("frontend never came up")
+                    time.sleep(0.25)
+
+            def client(ci: int) -> None:
+                session = KeepAliveSession(
+                    f"http://127.0.0.1:{public_port}",
+                    timeout=150.0, retries=3,
+                )
+                for i in range(n_per_client):
+                    if stop_clients.is_set():
+                        return
+                    try:
+                        res = session.post("/", {"value": ci * 1000 + i})
+                        status = 200
+                        if res != (ci * 1000 + i) * 3:
+                            with lock:
+                                problems.append(
+                                    f"wrong answer ({ci},{i}): {res!r}"
+                                )
+                    except HttpError as e:
+                        status = e.code
+                    except Exception as exc:
+                        with lock:
+                            transport_errors.append(f"({ci},{i}): {exc!r}")
+                        continue
+                    with lock:
+                        statuses[(ci, i)] = status
+                    time.sleep(0.2)
+
+            threads = [
+                threading.Thread(target=client, args=(ci,), daemon=True)
+                for ci in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+
+            # -- the 2 -> 4 -> 2 sequence, gated on the observatory ----
+            if not _wait_world(cluster_port, 2, 60):
+                problems.append("/metrics/cluster never showed world 2")
+            time.sleep(2.0)  # let cuts commit under load
+            with open(ctl, "w") as f:
+                f.write("4")
+            if _wait_world(cluster_port, 4, 90):
+                world_seen["grown"] = True
+            else:
+                problems.append(
+                    "/metrics/cluster never showed the grown world (4)"
+                )
+            time.sleep(3.0)  # run wide under load for a few scrapes
+            with open(ctl, "w") as f:
+                f.write("2")
+            if _wait_world(cluster_port, 2, 90):
+                world_seen["shrunk"] = True
+            else:
+                problems.append(
+                    "/metrics/cluster never showed the shrunk world (2)"
+                )
+
+            # wordcount must complete across both transitions
+            deadline = time.monotonic() + timeout / 2
+            want = expected_counts(n_rows)
+            got = None
+            while time.monotonic() < deadline:
+                try:
+                    with open(out_path) as f:
+                        got = json.load(f)
+                except (FileNotFoundError, json.JSONDecodeError):
+                    got = None
+                if got == want:
+                    break
+                time.sleep(1.0)
+
+            for t in threads:
+                t.join(timeout=timeout / 2)
+                if t.is_alive():
+                    problems.append("client thread hung past the budget")
+            fe_metrics = _metrics_kv(
+                _fetch(f"http://127.0.0.1:{public_port}/metrics")
+            )
+            health = json.loads(
+                _fetch(f"http://127.0.0.1:{public_port}/healthz")
+            )
+        finally:
+            stop_clients.set()
+            sup.send_signal(signal.SIGTERM)
+            try:
+                _, sup_err = sup.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                _, sup_err = sup.communicate()
+
+        # -- assertions -----------------------------------------------------
+        if transport_errors:
+            problems.append(
+                f"DROPPED CONNECTIONS: {len(transport_errors)} "
+                f"(first: {transport_errors[:3]})"
+            )
+        bad = {
+            k: v for k, v in statuses.items() if v not in (200, 503, 504)
+        }
+        if bad:
+            problems.append(f"non-terminal-contract statuses: {bad}")
+        ok200 = sum(1 for v in statuses.values() if v == 200)
+        if ok200 == 0:
+            problems.append("no request succeeded at all")
+        adm = fe_metrics.get("serve_frontend_requests_total", 0)
+        resp = fe_metrics.get("serve_frontend_responses_total", 0)
+        expired = fe_metrics.get("serve_deadline_expired_total", 0)
+        fe_timeouts = fe_metrics.get("serve_frontend_timeouts_total", 0)
+        if adm != resp + expired + fe_timeouts:
+            problems.append(
+                f"conservation violated: admitted={adm} != "
+                f"responses={resp} + expired={expired} + "
+                f"timeouts={fe_timeouts}"
+            )
+        if fe_metrics.get("serve_backend_losses_total", 0) < 2:
+            problems.append(
+                "frontend observed fewer than 2 backend losses — a "
+                "rescale never reaped the backend (supervisor stderr "
+                f"tail: {sup_err.decode()[-400:]})"
+            )
+        if health.get("rescales_seen", 0) < 2:
+            problems.append(
+                "frontend /healthz reports fewer than 2 rescale "
+                f"handoffs: {health}"
+            )
+        if got != want:
+            missing = (
+                sorted(set(want) - set(got or {}), key=int)[:5]
+                if got is not None
+                else "ALL"
+            )
+            problems.append(
+                "wordcount output incomplete/incorrect across the "
+                f"rescales (missing e.g. {missing})"
+            )
+        elif got != baseline:
+            problems.append(
+                "wordcount output differs from the fixed-world run"
+            )
+
+    summary = {
+        "ok": not problems,
+        "requests": n_clients * n_per_client,
+        "responses_200": ok200,
+        "statuses": {
+            str(s): sum(1 for v in statuses.values() if v == s)
+            for s in sorted(set(statuses.values()))
+        },
+        "grown_observed": world_seen["grown"],
+        "shrunk_observed": world_seen["shrunk"],
+        "backend_losses": fe_metrics.get("serve_backend_losses_total", 0),
+        "parked": fe_metrics.get("serve_parked_total", 0),
+        "replayed": fe_metrics.get("serve_replayed_total", 0),
+        "rescales_seen": health.get("rescales_seen", 0),
+        "observed_rescale_s": health.get("observed_rescale_s"),
+        "wordcount_rows": n_rows,
+        "bit_identical": got == baseline,
+    }
+    if problems:
+        summary["problems"] = problems
+    return summary
+
+
+def main() -> int:
+    summary = run_smoke()
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,656 @@
+"""Elastic mesh (ISSUE 11): deterministic re-sharding, the rescale
+model checker, the autoscaler policy, and the satellite surfaces
+(frontend rescaling state, cluster world gauge).
+
+The heavy end-to-end proofs live elsewhere: ``scripts/fault_matrix.py
+--rescale`` (kill-during-rescale grid, bit-identical resumes across
+world sizes), ``scripts/rescale_smoke.py`` (2→4→2 under live load,
+CI lane 10) and ``python -m pathway_tpu.analysis --mesh --rescale``
+(exhaustive crash-interleaving verification). This file pins the tier-1
+surface: the pure transitions, the re-shard readers, and the policy.
+"""
+
+from __future__ import annotations
+
+import os
+import types
+
+import pytest
+
+import pathway_tpu.analysis.meshcheck as mc
+import pathway_tpu.parallel.protocol as proto
+from pathway_tpu.engine.stream import MultisetState, TableState
+from pathway_tpu.parallel.procgroup import shard_hash, stable_shard
+from pathway_tpu.persistence import reshard
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the partition property (satellite: deterministic re-sharding)
+# ---------------------------------------------------------------------------
+
+SAMPLE_KEYS = (
+    [i for i in range(40)]
+    + [f"key-{i}" for i in range(20)]
+    + [(i, f"v{i}") for i in range(20)]
+    + [(i,) for i in range(20)]
+)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4])
+@pytest.mark.parametrize("m", [1, 2, 3, 4])
+def test_reshard_is_a_partition_and_roundtrips(n, m):
+    """Re-partitioning a committed store's keys from N to M shards via
+    the stable blake2b mint is a partition — every key lands in exactly
+    one new shard — and N→M→N round-trips bit-identical. Pinned for
+    N, M ∈ {1,2,3,4} in BOTH directions (the parametrization covers
+    (n, m) and (m, n))."""
+    # partition: each key kept by exactly one new rank
+    for k in SAMPLE_KEYS:
+        owners = [
+            r for r in range(m)
+            if proto.reshard_keep(shard_hash(k), r, m)
+        ]
+        assert len(owners) == 1
+        assert owners[0] == stable_shard(k, m)
+    assert reshard.partition_roundtrip(SAMPLE_KEYS, n, m)
+
+
+def test_shard_owner_is_the_stable_shard_modulus():
+    """stable_shard drives the shared shard_owner transition — the
+    exact function the model checker explores and the re-shard reader
+    re-buckets with."""
+    for k in SAMPLE_KEYS[:20]:
+        for world in (1, 2, 3, 5, 8):
+            assert stable_shard(k, world) == proto.shard_owner(
+                shard_hash(k), world
+            )
+    # frozen and raw forms hash identically (the mint freezes first),
+    # so one keep filter serves python stores and native dumps alike
+    from pathway_tpu.engine.stream import freeze_value
+
+    for k in SAMPLE_KEYS[:20]:
+        assert shard_hash(k) == shard_hash(freeze_value(k))
+
+
+def test_transitions_identity_pins():
+    """The checker's Transitions binds the exact protocol objects for
+    the new rescale decisions — no second copy to drift."""
+    t = mc.Transitions()
+    for name in ("shard_owner", "reshard_keep", "rescale_plan"):
+        assert name in mc.Transitions.NAMES
+        assert getattr(t, name) is proto.TRANSITIONS[name]
+        assert proto.TRANSITIONS[name] is getattr(proto, name)
+
+
+def test_rescale_plan_clamps():
+    assert proto.rescale_plan(2, 4) == 4
+    assert proto.rescale_plan(2, 0) == 2       # invalid target holds
+    assert proto.rescale_plan(2, None) == 2
+    assert proto.rescale_plan(2, 9999, 1, 8) == 8
+    assert proto.rescale_plan(4, 1, 2, 8) == 2  # floored at lo
+    assert proto.rescale_plan(4, -3) == 4
+
+
+def test_hello_accept_binds_world():
+    """A dead-WORLD straggler is rejected like a dead-epoch one: its
+    rank may be in range after a grow, but its slices were minted for
+    a different shard count."""
+    assert proto.hello_accept(0, 5, 4, 3, 5, 4)
+    assert not proto.hello_accept(0, 5, 4, 3, 5, 2)   # dead world
+    assert not proto.hello_accept(0, 5, 4, 3, 4, 4)   # dead epoch
+    assert proto.hello_accept(0, 5, 4, 3, 5)          # legacy: no world
+    assert not proto.hello_accept(0, 5, 4, 4, 5, 4)   # out of world
+
+
+# ---------------------------------------------------------------------------
+# re-shard readers over real state shapes
+# ---------------------------------------------------------------------------
+
+
+def _keep(rank, world):
+    return reshard.keep_fn(rank, world)
+
+
+def test_merge_and_filter_multiset_table_state():
+    a, b = MultisetState(), MultisetState()
+    a.apply_one("k1", ("r1",), 1)
+    b.apply_one("k2", ("r2",), 2)
+    merged = reshard.merge_values([a, b])
+    assert set(merged.data) == {"k1", "k2"}
+    # filter keeps exactly the new owner's keys
+    for world in (2, 3):
+        kept = [
+            set(reshard.filter_value(merged, _keep(r, world)).data)
+            for r in range(world)
+        ]
+        flat = [k for s in kept for k in s]
+        assert sorted(flat) == sorted(merged.data)  # partition
+    ta, tb = TableState(), TableState()
+    ta.rows["x"] = (1,)
+    tb.rows["y"] = (2,)
+    tm = reshard.merge_values([ta, tb])
+    assert set(tm.rows) == {"x", "y"}
+    assert reshard.merge_values([{"a": 1}, {"b": 2}]) == {"a": 1, "b": 2}
+    assert reshard.merge_values([{1, 2}, {3}]) == {1, 2, 3}
+
+
+def test_groupby_reshard_state_python_form():
+    from pathway_tpu.engine.nodes import GroupByNode
+
+    keys = [(i,) for i in range(30)]
+    states = []
+    for r in range(3):
+        groups = {
+            k: [k, None, [1], 1, f"out{k}"]
+            for k in keys
+            if stable_shard(k, 3) == r
+        }
+        states.append({"groups": groups})
+    self = types.SimpleNamespace(groups={})
+    for rank in range(2):
+        out = GroupByNode.reshard_state(self, states, _keep(rank, 2))
+        assert set(out["groups"]) == {
+            k for k in keys if stable_shard(k, 2) == rank
+        }
+
+
+def test_join_reshard_state_native_and_python():
+    from pathway_tpu.engine.nodes import JoinNode
+
+    jks = list(range(20))
+    native_states = [
+        {
+            "__native__": [
+                (jk, [("L", ("a",), 1)], [("R", ("b",), 1)])
+                for jk in jks
+                if stable_shard(jk, 2) == r
+            ]
+        }
+        for r in range(2)
+    ]
+    self = types.SimpleNamespace(left=MultisetState(), right=MultisetState())
+    self._replay_entries = lambda part: JoinNode._replay_entries(self, part)
+    out = JoinNode.reshard_state(self, native_states, _keep(1, 3))
+    assert set(e[0] for e in out["__native__"]) == {
+        jk for jk in jks if stable_shard(jk, 3) == 1
+    }
+    # mixed native + python merges on the python side
+    py_state = {"left": MultisetState(), "right": MultisetState()}
+    py_state["left"].apply_one(99, ("K", ("row",)), 1)
+    mixed = JoinNode.reshard_state(
+        self, [native_states[0], py_state],
+        _keep(stable_shard(99, 3), 3),
+    )
+    assert "__native__" not in mixed
+    assert 99 in mixed["left"].data
+
+
+def test_reshard_node_state_policies():
+    from pathway_tpu.engine.nodes import MemoizedRowwiseNode, Node
+
+    assert Node.RESHARD == "keyed"
+    assert MemoizedRowwiseNode.RESHARD == "union"
+
+    keyed = types.SimpleNamespace(RESHARD="keyed", RESHARD_ATTRS=None)
+    states = [{"live": {k: [("r",), 1] for k in range(10) if k % 2 == r}}
+              for r in range(2)]
+    out = reshard.reshard_node_state(keyed, states, 0, 3)
+    assert set(out["live"]) == {
+        k for k in range(10) if stable_shard(k, 3) == 0
+    }
+    union = types.SimpleNamespace(RESHARD="union", RESHARD_ATTRS=None)
+    out = reshard.reshard_node_state(union, states, 0, 3)
+    assert set(out["live"]) == set(range(10))
+    # refuse: non-empty un-re-shardable state names the node
+    refuse = types.SimpleNamespace(RESHARD="refuse", RESHARD_ATTRS=None)
+    with pytest.raises(RuntimeError, match="cannot rescale"):
+        reshard.reshard_node_state(refuse, [{"heap": [1]}], 0, 2)
+    assert reshard.reshard_node_state(
+        refuse, [{"heap": [], "watermark": 5}], 0, 2
+    ) is None
+
+
+def test_reshard_subject_states_hook_and_refusal():
+    snaps = [
+        (None, {"src": {"done": [1, 2]}}, None),
+        (None, {"src": {"done": [3]}}, None),
+        (None, {"solo": {"pos": 7}}, None),
+    ]
+
+    class Hooked:
+        def reshard_scan_state(self, states):
+            done = sorted(set().union(*(set(s["done"]) for s in states)))
+            return {"done": done}
+
+    out = reshard.reshard_subject_states(
+        ["src", "solo"], snaps, {"src": Hooked(), "solo": object()}
+    )
+    assert out["src"] == {"done": [1, 2, 3]}
+    assert out["solo"] == {"pos": 7}  # one claiming rank: pass-through
+    with pytest.raises(RuntimeError, match="reshard_scan_state"):
+        reshard.reshard_subject_states(
+            ["src"], snaps, {"src": object()}
+        )
+    # a 1->N grow: ONE old state, but the hook must still run so each
+    # new rank re-filters the full old coverage for its own shard
+    calls = []
+
+    class Spy(Hooked):
+        def reshard_scan_state(self, states):
+            calls.append(len(states))
+            return super().reshard_scan_state(states)
+
+    out = reshard.reshard_subject_states(
+        ["src"], [(None, {"src": {"done": [1, 2]}}, None)], {"src": Spy()}
+    )
+    assert calls == [1]
+    assert out["src"] == {"done": [1, 2]}
+
+
+def test_align_fingerprints_skips_exchange_nodes():
+    old = ["SourceNode", "ExchangeNode", "GroupByNode", "OutputNode"]
+    new = ["SourceNode", "GroupByNode", "OutputNode"]
+    mapping = reshard.align_fingerprints(old, new)
+    assert mapping == [0, 2, 3]
+    back = reshard.align_fingerprints(new, old)
+    assert back == [0, None, 1, 2]
+    with pytest.raises(RuntimeError, match="graph shape"):
+        reshard.align_fingerprints(old, ["SourceNode", "JoinNode"])
+
+
+def test_fs_subject_reshard_scan_state(tmp_path):
+    from pathway_tpu.internals.config import (
+        pop_config_overlay,
+        push_config_overlay,
+    )
+    from pathway_tpu.io.fs import _FsSubject
+
+    root = tmp_path / "data"
+    root.mkdir()
+    paths = []
+    for i in range(12):
+        p = root / f"f{i}.txt"
+        p.write_text("x")
+        paths.append(str(p))
+    states = []
+    for r in range(3):
+        mine = [p for p in paths if stable_shard(
+            os.path.relpath(p, str(root)), 3) == r]
+        states.append({
+            "seen": {p: 1.0 for p in mine},
+            "emitted": {p: [("k", ("row",))] for p in mine},
+        })
+    sub = _FsSubject(str(root), "plaintext", None, False, "static")
+    token = push_config_overlay(processes=2, process_id=1)
+    try:
+        out = sub.reshard_scan_state(states)
+    finally:
+        pop_config_overlay(token)
+    want = {
+        p for p in paths
+        if stable_shard(os.path.relpath(p, str(root)), 2) == 1
+    }
+    assert set(out["seen"]) == want
+    assert set(out["emitted"]) == want
+
+
+# ---------------------------------------------------------------------------
+# the rescale model checker
+# ---------------------------------------------------------------------------
+
+
+def test_meshcheck_rescale_grow_and_shrink_clean():
+    """The shipped rescale transition verifies clean over all crash
+    interleavings of the rescale window — grow and shrink — and the
+    verdict is not vacuous (rescale paths actually explored)."""
+    for world, target in ((2, 3), (3, 2)):
+        rep = mc.check(
+            mc.MeshCheckConfig(
+                world=world, rounds=2, fault_budget=1,
+                rescale_to=target, snap_every=1,
+            )
+        )
+        assert rep.complete
+        assert rep.ok, rep.render()
+        assert rep.rescales_explored > 0
+        assert rep.rollbacks_explored > 0
+        d = rep.to_dict()
+        assert d["rescale_to"] == target
+        assert d["rescales_explored"] == rep.rescales_explored
+
+
+def test_meshcheck_rescale_deterministic():
+    a = mc.check(mc.MeshCheckConfig(
+        world=2, rounds=2, fault_budget=1, rescale_to=3, snap_every=1))
+    b = mc.check(mc.MeshCheckConfig(
+        world=2, rounds=2, fault_budget=1, rescale_to=3, snap_every=1))
+    assert (a.states, a.transitions, a.terminals) == (
+        b.states, b.transitions, b.terminals,
+    )
+
+
+def test_meshcheck_reshard_mutant_caught_with_replayable_trace():
+    """The seeded re-shard mutant (drops one shard's committed entries
+    on a world change) is caught as a lost-delta exactly-once violation
+    with a minimal trace carrying the world transition — which
+    fault_matrix --from-trace replays as a real rescale cell."""
+    rep = mc.check(
+        mc.MeshCheckConfig(
+            world=2, rounds=2, fault_budget=1, rescale_to=3,
+            snap_every=1, mutate="drop_reshard_shard",
+        )
+    )
+    assert not rep.ok
+    [v] = rep.violations
+    assert v.kind == "exactly-once"
+    assert "lost" in v.detail
+    assert v.rescale == {"from": 2, "to": 3}
+    assert v.to_dict()["rescale"] == {"from": 2, "to": 3}
+    # the mutant only lives on the re-shard path: invisible without a
+    # world change
+    clean = mc.check(
+        mc.MeshCheckConfig(
+            world=2, rounds=2, fault_budget=1,
+            mutate="drop_reshard_shard",
+        )
+    )
+    assert clean.ok, clean.render()
+
+
+def test_meshcheck_dead_world_straggler_caught():
+    """A handshake that ignores epoch/world lets a pre-rescale
+    straggler back in — the checker must see it under a rescale."""
+    rep = mc.check(
+        mc.MeshCheckConfig(
+            world=2, rounds=1, fault_budget=1, rescale_to=3,
+            snap_every=1, mutate="accept_dead_epoch",
+        )
+    )
+    assert not rep.ok
+    assert rep.violations[0].kind == "dead-epoch-straggler"
+
+
+def test_meshcheck_base_model_unchanged():
+    """The variable-world refactor must not perturb the fixed-world
+    exploration: the canonical 3-rank config still exhausts cleanly
+    with rollback paths explored."""
+    rep = mc.check(mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1))
+    assert rep.complete and rep.ok, rep.render()
+    assert rep.rollbacks_explored > 0
+    assert rep.rescales_explored == 0
+
+
+def test_meshcheck_rescale_rejects_broadcast_topologies():
+    topo = (
+        mc.Exchange(0, "broadcast", ()),
+        mc.Exchange(1, "gather", (0,)),
+    )
+    with pytest.raises(ValueError, match="broadcast"):
+        mc.check(
+            mc.MeshCheckConfig(world=2, rounds=1, topology=topo,
+                               rescale_to=3)
+        )
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy
+# ---------------------------------------------------------------------------
+
+
+def _decide(**kw):
+    base = dict(
+        world=2, min_world=1, max_world=8,
+        pressure=0.0, grow_pressure=1.0,
+        efficiency=None, shrink_efficiency=0.35,
+        grow_streak=0, shrink_streak=0, hysteresis=2,
+        cooldown_remaining_s=0.0, budget_remaining=4,
+    )
+    base.update(kw)
+    return proto.autoscale_decide(**base)
+
+
+def test_autoscale_decide_grow_shrink_hold():
+    assert _decide() == ("hold", 2)
+    # pressure grows (doubling), but only past the hysteresis streak
+    assert _decide(pressure=5, grow_streak=1) == ("hold", 2)
+    assert _decide(pressure=5, grow_streak=2) == ("grow", 4)
+    assert _decide(pressure=5, grow_streak=2, world=8) == ("hold", 8)  # cap
+    # low efficiency shrinks (halving) only with zero pressure
+    assert _decide(efficiency=0.1, shrink_streak=2, world=4) == ("shrink", 2)
+    assert _decide(
+        efficiency=0.1, shrink_streak=2, world=4, pressure=1
+    ) == ("hold", 4)
+    assert _decide(efficiency=0.1, shrink_streak=1, world=4) == ("hold", 4)
+    assert _decide(efficiency=None, shrink_streak=9, world=4) == ("hold", 4)
+    assert _decide(efficiency=0.1, shrink_streak=2, world=1) == ("hold", 1)
+
+
+def test_autoscale_decide_cooldown_and_budget():
+    assert _decide(
+        pressure=5, grow_streak=9, cooldown_remaining_s=3.0
+    ) == ("hold", 2)
+    assert _decide(
+        pressure=5, grow_streak=9, budget_remaining=0
+    ) == ("hold", 2)
+
+
+def test_autoscaler_step_bookkeeping():
+    """The impure loop half: streaks accumulate, a rescale consumes
+    budget and starts the cooldown, streaks reset."""
+    from pathway_tpu.parallel.autoscale import (
+        Autoscaler,
+        AutoscaleConfig,
+        Observation,
+    )
+
+    class FakeSup:
+        processes = 2
+        rescales = []
+
+        def request_rescale(self, target, reason=""):
+            self.rescales.append(target)
+            self.processes = target
+            return True
+
+    clock = [0.0]
+    sup = FakeSup()
+    a = Autoscaler(
+        sup,
+        AutoscaleConfig(hysteresis=2, cooldown_s=10.0, budget=1),
+        clock=lambda: clock[0],
+    )
+    assert a.step(Observation(5.0, None)) == ("hold", 2)   # streak 1
+    assert a.step(Observation(5.0, None)) == ("grow", 4)   # streak 2
+    assert sup.rescales == [4]
+    assert a.budget_remaining == 0
+    assert a.grow_streak == 0
+    # budget exhausted: pressure can scream forever, the mesh holds
+    for _ in range(5):
+        assert a.step(Observation(50.0, None))[0] == "hold"
+    # cooldown alone also holds (fresh budget, inside the window)
+    a.budget_remaining = 1
+    clock[0] = 5.0
+    assert a.step(Observation(50.0, None))[0] == "hold"
+    clock[0] = 20.0  # past cooldown; streak re-accumulates then fires
+    assert a.step(Observation(50.0, None))[0] == "grow"
+
+
+def test_autoscale_config_from_env(monkeypatch):
+    from pathway_tpu.parallel.autoscale import AutoscaleConfig
+
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_MAX", "16")
+    monkeypatch.setenv("PATHWAY_AUTOSCALE_HYSTERESIS", "5")
+    c = AutoscaleConfig.from_env()
+    assert c.max_world == 16 and c.hysteresis == 5
+    assert "16" in c.describe()
+
+
+def test_autoscale_knobs_registered():
+    from pathway_tpu.analysis.knobs import KNOBS
+
+    for name in (
+        "PATHWAY_AUTOSCALE_MIN", "PATHWAY_AUTOSCALE_MAX",
+        "PATHWAY_AUTOSCALE_COOLDOWN_S", "PATHWAY_AUTOSCALE_INTERVAL_S",
+        "PATHWAY_AUTOSCALE_BUDGET", "PATHWAY_AUTOSCALE_GROW_PRESSURE",
+        "PATHWAY_AUTOSCALE_SHRINK_EFFICIENCY",
+        "PATHWAY_AUTOSCALE_HYSTERESIS",
+    ):
+        assert name in KNOBS, name
+
+
+def test_autoscale_module_loads_by_file_path():
+    """The supervisor loads autoscale.py by file path (stdlib-only):
+    the module must import without the package __init__s."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "parallel", "autoscale.py")
+    spec = importlib.util.spec_from_file_location("_t_autoscale", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod._proto.rescale_plan(2, 4) == 4
+    assert mod.AutoscaleConfig().min_world == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: frontend rescaling state, cluster world gauge
+# ---------------------------------------------------------------------------
+
+
+def test_serve_frontend_state_rescaling():
+    sfs = proto.serve_frontend_state
+    assert sfs(True, False, False) == "serving"
+    assert sfs(False, False, False) == "recovering"
+    assert sfs(False, False, True) == "rescaling"
+    assert sfs(True, False, True) == "serving"   # attached = serving
+    assert sfs(False, True, True) == "draining"  # draining wins
+    # rescaling parks like recovering, sheds past the budget
+    assert proto.serve_admit("rescaling", 0, 8, 0, 4) == "park"
+    assert proto.serve_admit("rescaling", 0, 8, 4, 4) == "shed"
+    assert "rescaling" in proto.SERVE_STATES
+
+
+def test_frontend_split_rescale_ewma():
+    """The rescale EWMA is tracked separately from the crash EWMA and
+    is what sizes Retry-After while a rescale is in flight."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "io", "http", "_frontend.py")
+    spec = importlib.util.spec_from_file_location("_t_frontend", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fe = mod.ServingFrontend.__new__(mod.ServingFrontend)
+    fe.observed_restart_s = 2.0
+    fe.observed_rescale_s = 8.0
+    fe._rescaling = True
+    assert fe._retry_after_s() == 8.0
+    fe._rescaling = False
+    assert fe._retry_after_s() == 2.0
+    fe.observed_restart_s = 0.0
+    assert fe._retry_after_s() == 8.0  # all we have observed
+
+
+def test_cluster_world_gauge_and_departed_stale():
+    from pathway_tpu.internals.cluster import ClusterMetricsAggregator
+
+    agg = ClusterMetricsAggregator(
+        9999, ClusterMetricsAggregator.default_endpoints(4)
+    )
+    for r in range(4):
+        st = agg._ranks[r]
+        st.samples = [("connector_rows_total", {}, 100.0 * (r + 1))]
+        st.stale = False
+    text = agg.render_cluster()
+    assert "cluster_world_size 4" in text
+    # shrink to 2: departed ranks retained, marked stale
+    agg.set_endpoints(
+        ClusterMetricsAggregator.default_endpoints(2), epoch=1
+    )
+    text = agg.render_cluster()
+    assert "cluster_world_size 2" in text
+    assert 'rank="3"' in text and 'stale="1"' in text
+    assert agg._ranks[3].departed
+    # departed totals are excluded from cross-rank derivations
+    assert 3 not in agg._per_rank("connector_rows_total")
+
+
+def test_discover_snapshot_world_from_legacy_marker(tmp_path):
+    """A legacy bare marker was only ever written by an N-rank mesh:
+    the single-process reader derives the true world from the
+    rank-scoped snapshot keys instead of assuming world 1 (which would
+    silently drop every other rank's shard)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.runtime import Runtime
+    from pathway_tpu.persistence import PersistenceManager
+
+    pm = PersistenceManager(
+        pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(str(tmp_path))
+        )
+    )
+    for r in range(3):
+        pm.save_operator_snapshot(
+            [], {}, [], key=f"operator_snapshot/r{r}/5"
+        )
+    rt = Runtime.__new__(Runtime)
+    rt.persistence = pm
+    assert Runtime._discover_snapshot_world(rt, 5) == 3
+    with pytest.raises(RuntimeError, match="no rank-scoped snapshot"):
+        Runtime._discover_snapshot_world(rt, 9)
+
+
+def test_marker_records_world(tmp_path):
+    """The snapshot_commit marker carries (tag, world) — one atomic
+    write — and legacy bare-int markers still read."""
+    import pathway_tpu.persistence as pers
+
+    pm = pers.PersistenceManager(
+        pers.Config(backend=pers.Backend.filesystem(str(tmp_path)))
+    )
+    pm.write_marker("snapshot_commit", (7, 4))
+    assert pm.read_marker("snapshot_commit") == (7, 4)
+    pm.write_marker("snapshot_commit", 9)  # legacy form
+    assert pm.read_marker("snapshot_commit") == 9
+
+
+def test_supervisor_request_rescale_arming():
+    """The supervisor's rescale arming clamps through rescale_plan and
+    ignores no-op targets; the control-file poll parses targets."""
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_t_sup_rescale", path)
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+    sup = sup_mod.MeshSupervisor(["true"], processes=2)
+    assert sup.request_rescale(4)
+    assert sup._pending_rescale == 4
+    sup._pending_rescale = None
+    assert not sup.request_rescale(2)   # no-op
+    assert not sup.request_rescale(0)   # invalid holds
+    assert sup._pending_rescale is None
+
+
+def test_supervisor_rescale_ctl_poll(tmp_path):
+    import importlib.util
+
+    path = os.path.join(REPO, "pathway_tpu", "parallel", "supervisor.py")
+    spec = importlib.util.spec_from_file_location("_t_sup_ctl", path)
+    sup_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sup_mod)
+    ctl = tmp_path / "ctl"
+    sup = sup_mod.MeshSupervisor(
+        ["true"], processes=2, rescale_ctl=str(ctl)
+    )
+    sup._poll_rescale_ctl()      # missing file: no-op
+    assert sup._pending_rescale is None
+    ctl.write_text("garbage")
+    sup._poll_rescale_ctl()      # unparsable: ignored until changed
+    assert sup._pending_rescale is None
+    ctl.write_text("3")
+    sup._poll_rescale_ctl()
+    assert sup._pending_rescale == 3
+    sup._pending_rescale = None
+    sup._poll_rescale_ctl()      # unchanged content: not re-armed
+    assert sup._pending_rescale is None
