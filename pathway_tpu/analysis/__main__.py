@@ -185,6 +185,55 @@ def _analyze_mesh(args) -> int:
         else _env_int("PATHWAY_MESHCHECK_FAULTS", 1)
     )
     cap = _env_int("PATHWAY_MESHCHECK_MAX_STATES", 200_000)
+    sink_kw = (
+        {
+            "sink": True,
+            "fault_phases": meshcheck.SINK_FAULT_PHASES,
+        }
+        if args.sink
+        else {}
+    )
+    if args.sink and not args.rescale:
+        # transactional-egress verification (ISSUE 12): the sink model
+        # over all crash interleavings — fixed world AND one rescale
+        # window (staged output is (tag, world)-scoped; pending
+        # partitions of the dead world must be re-owned through
+        # shard_owner), mirroring the fault grid's rescale cell
+        reports = []
+        for target in (None, world + 1):
+            reports.append(
+                meshcheck.check(
+                    meshcheck.MeshCheckConfig(
+                        world=world,
+                        rounds=rounds,
+                        fault_budget=faults,
+                        max_states=cap,
+                        mutate=args.mesh_mutant,
+                        rescale_to=target,
+                        **(
+                            {"snap_every": 1}
+                            if target is not None
+                            else {}
+                        ),
+                        **sink_kw,
+                    )
+                )
+            )
+        if args.json:
+            print(json.dumps([r.to_dict() for r in reports], indent=2))
+        else:
+            for r in reports:
+                print(r.render())
+        if any(r.violations for r in reports):
+            return 2
+        if not all(r.complete for r in reports):
+            print(
+                "state space NOT exhausted "
+                "(PATHWAY_MESHCHECK_MAX_STATES); verdict inconclusive",
+                file=sys.stderr,
+            )
+            return 3
+        return 0
     if args.rescale:
         # elastic-mesh verification (ISSUE 11): model-check the rescale
         # transition over all crash interleavings of the rescale window
@@ -205,6 +254,7 @@ def _analyze_mesh(args) -> int:
                     mutate=args.mesh_mutant,
                     rescale_to=target,
                     snap_every=1,
+                    **sink_kw,
                 )
             )
             reports.append(report)
@@ -424,6 +474,15 @@ def main(argv=None) -> int:
              "(skip_quiesce | accept_dead_epoch | "
              "drop_rollback_retraction | drop_reshard_shard) — the "
              "checker must catch it",
+    )
+    parser.add_argument(
+        "--sink", action="store_true",
+        help="with --mesh: model the transactional-egress plane "
+             "(ISSUE 12) — final-hop deliveries stage, pre-commit at "
+             "the cut, finalize after the marker; audits no-lost/"
+             "no-duplicated committed output over all crash "
+             "interleavings INCLUDING a rescale window (mutant: "
+             "--mesh-mutant finalize_before_marker)",
     )
     parser.add_argument(
         "--rescale", action="store_true",

@@ -132,6 +132,20 @@ KNOBS: dict[str, Knob] = {
            "Keep consuming live data after replay finishes."),
         _k("PATHWAY_PERSISTENT_STORAGE", "str", None,
            "Directory for persistent UDF caches (udfs/caches.py)."),
+        # -- transactional egress (io/txn.py; ISSUE 12) -------------------
+        _k("PATHWAY_SINK_TXN", "bool", True,
+           "Epoch-aligned two-phase-commit sinks: under OPERATOR_"
+           "PERSISTING, staged sink output finalizes only when the "
+           "snapshot_commit marker lands (exactly-once committed "
+           "egress across rollback/rescale). 0 reverts to finalize-"
+           "per-commit-timestamp (still torn-write-proof)."),
+        _k("PATHWAY_SINK_FSYNC", "bool", True,
+           "fsync staged segments, finalized files and their "
+           "directories at every sink rename point. 0 trades "
+           "power-loss durability for test speed."),
+        _k("PATHWAY_SINK_STAGE_DIR", "str", None,
+           "Root for transactional sinks' staging/segment areas "
+           "(default: '<output>.pw-txn' next to each output file)."),
         # -- NativeBatch fused chain --------------------------------------
         _k("PATHWAY_NO_NB_JOIN", "bool", False,
            "Force joins onto the tuple path (fused-vs-tuple parity "

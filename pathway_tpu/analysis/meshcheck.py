@@ -100,6 +100,13 @@ KILLED_EXIT_CODE = 137  # SIGKILL from the supervisor's reap
 
 FAULT_POINT = "mesh.rank_kill"  # the injection point traces replay through
 FAULT_PHASES = ("wave_send", "post_snapshot", "restore")
+# the sink model's extra kill slot: a rank dying AFTER the marker moved
+# but BEFORE its local finalize — the window sink_recover's "finalize"
+# verdict exists for. Crashes here replay through the engine's own
+# ``sink.finalize`` fault point (internals/faults.py), not a
+# mesh.rank_kill phase.
+SINK_FINALIZE_PHASE = "sink_finalize"
+SINK_FAULT_PHASES = FAULT_PHASES + (SINK_FINALIZE_PHASE,)
 
 
 # -- the shared transition table -------------------------------------------
@@ -132,6 +139,11 @@ class Transitions:
         "shard_owner",
         "reshard_keep",
         "rescale_plan",
+        # transactional egress (ISSUE 12): when a staged sink unit may
+        # become externally visible, and the recovery verdict over
+        # pending units — the exact functions io/txn.py's sinks drive
+        "sink_may_finalize",
+        "sink_recover",
     )
 
     def __init__(self, overrides: dict | None = None, *, model_flags=()):
@@ -172,6 +184,18 @@ def _mutant_drop_reshard_shard(h, rank, world):
     return h % world == rank and h % world != 0
 
 
+def _mutant_finalize_before_marker(unit_tag, marker_tag):
+    """Broken 2PC egress (ISSUE 12): staged sink output finalizes at
+    PRE-COMMIT, before the ``snapshot_commit`` marker lands — the
+    classic premature-commit bug. A crash between the pre-commit and
+    the marker rolls the engine back; the re-emitted suffix then stages
+    and finalizes AGAIN, duplicating every row of the uncommitted cut
+    in the external output. Invisible fault-free (everything finalizes
+    exactly once when nothing crashes), which is why the sink model
+    checker must find the crash interleaving that exposes it."""
+    return True
+
+
 def get_transitions(mutate: str | None = None) -> Transitions:
     if mutate is None:
         return Transitions()
@@ -183,15 +207,20 @@ def get_transitions(mutate: str | None = None) -> Transitions:
         return Transitions(model_flags=("drop_rollback_retraction",))
     if mutate == "drop_reshard_shard":
         return Transitions({"reshard_keep": _mutant_drop_reshard_shard})
+    if mutate == "finalize_before_marker":
+        return Transitions(
+            {"sink_may_finalize": _mutant_finalize_before_marker}
+        )
     raise ValueError(
         f"unknown mutant {mutate!r}; known: skip_quiesce, "
-        "accept_dead_epoch, drop_rollback_retraction, drop_reshard_shard"
+        "accept_dead_epoch, drop_rollback_retraction, "
+        "drop_reshard_shard, finalize_before_marker"
     )
 
 
 MUTANT_NAMES = (
     "skip_quiesce", "accept_dead_epoch", "drop_rollback_retraction",
-    "drop_reshard_shard",
+    "drop_reshard_shard", "finalize_before_marker",
 )
 
 
@@ -358,6 +387,15 @@ class MeshCheckConfig:
     # exchanges are rejected under rescale (their legs expand at build
     # world); hash/gather topologies — the canonical shape — rescale.
     rescale_to: int | None = None
+    # transactional egress (ISSUE 12): model the sink as a two-phase-
+    # commit external store — final-hop deliveries STAGE (invisible)
+    # instead of landing directly, pre-commit checks / post-marker
+    # finalization / restore recovery drive the shared
+    # sink_may_finalize / sink_recover transitions, and the terminal
+    # audit proves every delta became externally visible exactly once
+    # across rollbacks AND rescales. Composes with rescale_to: pending
+    # partitions of a dead world are re-owned through shard_owner.
+    sink: bool = False
     # partial-order reduction strength. Per-rank macro-steps pairwise
     # commute (disjoint rank state, append-only per-link sends, disjoint
     # sink keys), so "persistent" explores only the lowest-ranked rank's
@@ -406,6 +444,11 @@ class StoreState(NamedTuple):
     sink: tuple          # sorted ((token_id, count), ...) — final-hop
     #                      deliveries, keyed by token only (the dest is
     #                      world-dependent across a rescale)
+    # transactional egress (cfg.sink; ISSUE 12): staged-but-not-
+    # finalized units ((stager_rank, epoch, unit_tag, tid), ...) and
+    # the externally visible finalized output ((tid, count), ...)
+    pending: tuple = ()
+    final: tuple = ()
 
 
 class SupState(NamedTuple):
@@ -458,10 +501,19 @@ def _initial_state(cfg: MeshCheckConfig, model=None, preseed: int = 0) -> State:
         for rnd in range(min(preseed, cfg.rounds)):
             for tok in model.rounds_tokens[rnd]:
                 sink[tok.tid] = 1
-        store = StoreState(
-            (preseed, cfg.world), tuple(sorted(snaps.items())),
-            tuple(sorted(sink.items())),
-        )
+        if cfg.sink:
+            # sink-model preseed: the previous run FINALIZED the
+            # committed rounds' output (its cuts landed cleanly);
+            # nothing is pending
+            store = StoreState(
+                (preseed, cfg.world), tuple(sorted(snaps.items())),
+                (), (), tuple(sorted(sink.items())),
+            )
+        else:
+            store = StoreState(
+                (preseed, cfg.world), tuple(sorted(snaps.items())),
+                tuple(sorted(sink.items())),
+            )
     return State(
         ranks, links, store, SupState(0, 0, "watch"), cfg.fault_budget,
         (), cfg.rescale_to,
@@ -515,18 +567,33 @@ class Violation:
 
     def fault_plan(self) -> dict | None:
         """The trace's crash choices as a replayable PATHWAY_FAULT_PLAN
-        (one phase-scoped, rank-scoped, hit-exact rule per crash)."""
-        rules = [
-            {
-                "point": FAULT_POINT,
-                "phase": step["phase"],
-                "rank": step["rank"],
-                "hits": [step["hit"]],
-                "action": "crash",
-            }
-            for step in self.trace
-            if step.get("action") == "crash"
-        ]
+        (one phase-scoped, rank-scoped, hit-exact rule per crash). Sink
+        finalize-window crashes replay through the engine's own
+        ``sink.finalize`` point (it has no phases — the point itself IS
+        the slot)."""
+        rules = []
+        for step in self.trace:
+            if step.get("action") != "crash":
+                continue
+            if step["phase"] == SINK_FINALIZE_PHASE:
+                rules.append(
+                    {
+                        "point": "sink.finalize",
+                        "rank": step["rank"],
+                        "hits": [step["hit"]],
+                        "action": "crash",
+                    }
+                )
+            else:
+                rules.append(
+                    {
+                        "point": FAULT_POINT,
+                        "phase": step["phase"],
+                        "rank": step["rank"],
+                        "hits": [step["hit"]],
+                        "action": "crash",
+                    }
+                )
         return {"seed": 7, "rules": rules} if rules else None
 
     def to_dict(self) -> dict:
@@ -564,6 +631,7 @@ class MeshCheckReport:
             "fault_budget": self.config.fault_budget,
             "mutate": self.config.mutate,
             "rescale_to": self.config.rescale_to,
+            "sink": self.config.sink,
             "states": self.states,
             "transitions": self.transitions,
             "terminals": self.terminals,
@@ -588,6 +656,7 @@ class MeshCheckReport:
                 if c.rescale_to is not None
                 else ""
             )
+            + (", txn-sink model" if c.sink else "")
             + (f", mutant {c.mutate!r}" if c.mutate else ""),
             f"  explored {self.states} states / {self.transitions} "
             f"transitions ({self.terminals} terminal(s), "
@@ -639,6 +708,7 @@ class MeshModel:
             )
         self.masks, self.umasks = _reach_masks(cfg.topology)
         self.xi = {i: i for i in range(len(cfg.topology))}
+        self.sink_mode = cfg.sink
         self.rounds_tokens = make_workload(
             cfg.topology, cfg.world, cfg.rounds, cfg.tokens_per_commit
         )
@@ -746,6 +816,24 @@ class MeshModel:
                 continue
             if op == "snap_fp":
                 return state if progressed else None
+            if op == "sink_fin":
+                # fault slot FIRST: the marker has moved but this
+                # rank's staged units are still pending — dying here is
+                # the window recovery's "finalize" verdict heals
+                rs, hit = _fhit(rs, SINK_FINALIZE_PHASE)
+                if self._fault_matches(state, r, SINK_FINALIZE_PHASE):
+                    state = _set_rank(
+                        state, r,
+                        rs._replace(pc=("sink_fin_fp", rs.pc[1])),
+                    )
+                else:
+                    state = self._do_sink_finalize(
+                        _set_rank(state, r, rs), r
+                    )
+                progressed = True
+                continue
+            if op == "sink_fin_fp":
+                return state if progressed else None
             if op == "closing":
                 state = self._do_close(state, r)
                 return state
@@ -761,7 +849,10 @@ class MeshModel:
             # nothing committed: fresh start (connectors from scratch).
             # rollback-or-retract: sink entries from dead epochs that the
             # (empty) cut does not cover are retracted
-            state = self._reconcile_sink(state, r, cut=0)
+            if self.sink_mode:
+                state = self._sink_recover_model(state, r, None)
+            else:
+                state = self._reconcile_sink(state, r, cut=0)
             return _set_rank(
                 state, r,
                 rs._replace(
@@ -809,7 +900,13 @@ class MeshModel:
                     self.tok_by_tid[tid].hops[h][1][1], r, world
                 )
             )
-        state = self._reconcile_sink(state, r, cut=tag)
+        if self.sink_mode:
+            # 2PC egress recovery: one shared sink_recover verdict per
+            # pending unit this rank claims through the shard mint —
+            # finalize what the cut covers, discard the rest
+            state = self._sink_recover_model(state, r, tag)
+        else:
+            state = self._reconcile_sink(state, r, cut=tag)
         rs = state.ranks[r]._replace(
             pc=("restore_fp",), srcpos=srcpos, applied=applied,
             committed=(),
@@ -851,6 +948,100 @@ class MeshModel:
         return state._replace(
             store=state.store._replace(sink=tuple(sorted(sink)))
         )
+
+    # -- transactional egress (cfg.sink; ISSUE 12) -------------------------
+
+    def _sink_recover_model(
+        self, state: State, r: int, marker_tag: int | None
+    ) -> State:
+        """Restore-time recovery of the 2PC sink store: this rank
+        claims the pending partitions the shard mint assigns to it at
+        the CURRENT world (after a rescale, a dead rank's partition is
+        re-owned by exactly one new rank) and takes the shared
+        ``sink_recover`` verdict per unit — finalize what the committed
+        cut covers (the crash landed between the marker and the owner's
+        local finalize), discard the rest (the restored engine will
+        re-emit it; keeping it would duplicate)."""
+        world = len(state.ranks)
+        pending = []
+        final = dict(state.store.final)
+        for unit in state.store.pending:
+            stager, _epoch, unit_tag, tid = unit
+            if self.t.shard_owner(stager, world) != r:
+                pending.append(unit)
+                continue
+            if self.t.sink_recover(unit_tag, marker_tag) == "finalize":
+                final[tid] = final.get(tid, 0) + 1
+            # else: discard — drop the unit entirely
+        return state._replace(
+            store=state.store._replace(
+                pending=tuple(sorted(pending)),
+                final=tuple(sorted(final.items())),
+            )
+        )
+
+    def _sink_precommit_check(self, state: State, r: int) -> State:
+        """The pre-commit step drives ``sink_may_finalize`` over this
+        rank's pending units against the CURRENT marker. The shipped
+        transition always answers False here (the marker has not moved
+        for this cut yet), making this a no-op; the
+        ``finalize_before_marker`` mutant answers True — premature
+        finalization, which a crash at the post_snapshot slot then
+        turns into duplicated external output."""
+        marker = state.store.marker
+        marker_tag = marker[0] if marker is not None else None
+        rs = state.ranks[r]
+        pending = []
+        final = dict(state.store.final)
+        changed = False
+        for unit in state.store.pending:
+            stager, epoch, unit_tag, tid = unit
+            if (
+                stager == r
+                and epoch == rs.epoch
+                and self.t.sink_may_finalize(unit_tag, marker_tag)
+            ):
+                final[tid] = final.get(tid, 0) + 1
+                changed = True
+            else:
+                pending.append(unit)
+        if not changed:
+            return state
+        return state._replace(
+            store=state.store._replace(
+                pending=tuple(sorted(pending)),
+                final=tuple(sorted(final.items())),
+            )
+        )
+
+    def _do_sink_finalize(self, state: State, r: int) -> State:
+        """Post-marker finalization: the marker landed at the barrier's
+        tag — this rank's pending units at-or-below it become
+        externally visible (shared ``sink_may_finalize`` decision). A
+        rank killed before this step leaves its units pending; the next
+        recovery's ``sink_recover`` verdict finalizes them, which the
+        terminal audit depends on."""
+        rs = state.ranks[r]
+        _op, tag = rs.pc
+        pending = []
+        final = dict(state.store.final)
+        for unit in state.store.pending:
+            stager, epoch, unit_tag, tid = unit
+            if (
+                stager == r
+                and epoch == rs.epoch
+                and self.t.sink_may_finalize(unit_tag, tag)
+            ):
+                final[tid] = final.get(tid, 0) + 1
+            else:
+                pending.append(unit)
+        state = state._replace(
+            store=state.store._replace(
+                pending=tuple(sorted(pending)),
+                final=tuple(sorted(final.items())),
+            )
+        )
+        return _set_rank(state, r, rs._replace(pc=("round",)))
 
     # -- commit execution (the wave walk) ---------------------------------
 
@@ -953,6 +1144,8 @@ class MeshModel:
             )
         if op == "restore_fp":
             return _set_rank(state, r, rs._replace(pc=("round",)))
+        if op == "sink_fin_fp":
+            return self._do_sink_finalize(state, r)
         raise AssertionError(f"not at a fault point: {rs.pc!r}")
 
     def _ship_wave(self, state: State, r: int) -> State:
@@ -1072,6 +1265,7 @@ class MeshModel:
                     delivered[x].append((tok, hop))
         applied = set(rs.applied)
         sink = dict(state.store.sink)
+        staged = list(state.store.pending)
         new_remaining = remaining - set(wave)
         wbits_left = self.t.wave_bits(new_remaining, self.xi)
         E = len(self.topology)
@@ -1083,7 +1277,16 @@ class MeshModel:
                     # rescale restore re-buckets it
                     applied.add((tok.tid, hop))
                 if hop + 1 >= len(tok.hops):
-                    sink[tok.tid] = sink.get(tok.tid, 0) + 1
+                    if self.sink_mode:
+                        # 2PC egress: the final-hop delivery STAGES the
+                        # unit (invisible), keyed by (stager rank,
+                        # epoch, the first cut tag that can commit it)
+                        # — finalization waits for the marker
+                        staged.append(
+                            (r, rs.epoch, tok.rnd + 1, tok.tid)
+                        )
+                    else:
+                        sink[tok.tid] = sink.get(tok.tid, 0) + 1
                     continue
                 nx = tok.hops[hop + 1][0]
                 # cascade feeder: may this local step run before the
@@ -1116,7 +1319,10 @@ class MeshModel:
             ),
         )
         state = state._replace(
-            store=state.store._replace(sink=tuple(sorted(sink.items())))
+            store=state.store._replace(
+                sink=tuple(sorted(sink.items())),
+                pending=tuple(sorted(staged)),
+            )
         )
         return _set_rank(state, r, rs)
 
@@ -1130,6 +1336,11 @@ class MeshModel:
         state = state._replace(
             store=state.store._replace(snaps=tuple(sorted(snaps.items())))
         )
+        if self.sink_mode:
+            # the sink pre-commit drives sink_may_finalize against the
+            # CURRENT marker — a no-op under the shipped transition,
+            # premature finalization under finalize_before_marker
+            state = self._sink_precommit_check(state, r)
         # kill slot: rank-local snapshot durable, marker not yet moved
         rs, hit = _fhit(rs, "post_snapshot")
         if self._fault_matches(state, r, "post_snapshot"):
@@ -1184,6 +1395,31 @@ class MeshModel:
         total = sum(counts)
         if total == 0:
             # alldone: every rank's input is exhausted
+            if self.sink_mode:
+                # clean-shutdown 2PC cut (mirrors runtime._txn_final_
+                # cut): one FINAL snapshot + marker covering the tail,
+                # then everything pending finalizes through the shared
+                # predicate — the tail never commits outside a marker
+                world = len(state.ranks)
+                snaps = dict(state.store.snaps)
+                for r, rs in enumerate(state.ranks):
+                    snaps[(r, rnd)] = (rs.applied, rnd)
+                pending = []
+                final = dict(state.store.final)
+                for unit in state.store.pending:
+                    _stager, _epoch, unit_tag, tid = unit
+                    if self.t.sink_may_finalize(unit_tag, rnd):
+                        final[tid] = final.get(tid, 0) + 1
+                    else:
+                        pending.append(unit)
+                state = state._replace(
+                    store=state.store._replace(
+                        marker=(rnd, world),
+                        snaps=tuple(sorted(snaps.items())),
+                        pending=tuple(sorted(pending)),
+                        final=tuple(sorted(final.items())),
+                    )
+                )
             for r, rs in enumerate(state.ranks):
                 state = _set_rank(state, r, rs._replace(pc=("closing",)))
             return state
@@ -1211,7 +1447,17 @@ class MeshModel:
             )
         )
         for r, rs in enumerate(state.ranks):
-            state = _set_rank(state, r, rs._replace(pc=("round",)))
+            if self.sink_mode:
+                # 2PC egress phase 2: each rank finalizes its own
+                # staged units AFTER the marker moved — a separate
+                # per-rank step, so the kill window between the marker
+                # and a rank's local finalize is explorable (recovery
+                # must then finalize the pending remainder)
+                state = _set_rank(
+                    state, r, rs._replace(pc=("sink_fin", tag))
+                )
+            else:
+                state = _set_rank(state, r, rs._replace(pc=("round",)))
         return state
 
     # -- detection ----------------------------------------------------------
@@ -1468,19 +1714,40 @@ class MeshModel:
         whole shards here)."""
         if state.sup.status != "done":
             return
-        sink = dict(state.store.sink)
-        missing = sorted(k for k in self.expected if k not in sink)
-        dupes = sorted(
-            k for k, c in sink.items() if c != 1 and k in self.expected
-        )
-        if missing or dupes:
-            raise _PropertyViolation(
-                "exactly-once",
-                f"clean run violated exactly-once: "
-                f"{len(missing)} lost delta(s) "
-                f"(e.g. {missing[:3]}), {len(dupes)} duplicated "
-                f"(e.g. {[(k, sink[k]) for k in dupes[:3]]})",
+        if self.sink_mode:
+            # transactional-egress audit: every delta became externally
+            # VISIBLE exactly once (staged-only does not count — a unit
+            # left pending forever is lost output)
+            final = dict(state.store.final)
+            missing = sorted(k for k in self.expected if k not in final)
+            dupes = sorted(
+                k
+                for k, c in final.items()
+                if c != 1 and k in self.expected
             )
+            if missing or dupes:
+                raise _PropertyViolation(
+                    "exactly-once",
+                    "committed egress violated exactly-once: "
+                    f"{len(missing)} delta(s) never finalized "
+                    f"(e.g. {missing[:3]}), {len(dupes)} finalized "
+                    "more than once "
+                    f"(e.g. {[(k, final[k]) for k in dupes[:3]]})",
+                )
+        else:
+            sink = dict(state.store.sink)
+            missing = sorted(k for k in self.expected if k not in sink)
+            dupes = sorted(
+                k for k, c in sink.items() if c != 1 and k in self.expected
+            )
+            if missing or dupes:
+                raise _PropertyViolation(
+                    "exactly-once",
+                    f"clean run violated exactly-once: "
+                    f"{len(missing)} lost delta(s) "
+                    f"(e.g. {missing[:3]}), {len(dupes)} duplicated "
+                    f"(e.g. {[(k, sink[k]) for k in dupes[:3]]})",
+                )
         counts: dict = {}
         for rs in state.ranks:
             for entry in rs.applied:
@@ -1530,11 +1797,12 @@ def _successors(model: MeshModel, state: State) -> list[tuple[dict, Any]]:
             per_rank.append(acts)
             continue
         pc0 = rs.pc[0]
-        if pc0 in ("wave_fp", "snap_fp", "restore_fp"):
+        if pc0 in ("wave_fp", "snap_fp", "restore_fp", "sink_fin_fp"):
             phase = {
                 "wave_fp": "wave_send",
                 "snap_fp": "post_snapshot",
                 "restore_fp": "restore",
+                "sink_fin_fp": SINK_FINALIZE_PHASE,
             }[pc0]
             hit = dict(rs.fhits)[phase]
             crashed = _set_rank(

@@ -2020,6 +2020,10 @@ class OutputNode(Node):
         on_end=None,          # fn()
         dict_cols=None,       # tuple of col names: on_change receives a
                               # {col: val} dict + bool diff (pw.io.subscribe)
+        envelope=False,       # on_batch receives a DeliveryEnvelope
+                              # (epoch, commit_ts, seq) instead of the bare
+                              # time — the dedup handle for external
+                              # systems (io/txn.py; ISSUE 12)
     ):
         super().__init__(scope, [input_node])
         self._on_change = on_change
@@ -2028,6 +2032,19 @@ class OutputNode(Node):
         self._on_end = on_end
         self._dict_cols = tuple(dict_cols) if dict_cols is not None else None
         self._seen_time = False
+        self._envelope = bool(envelope)
+        # per-node delivery sequence: strictly monotone within an epoch
+        # (a rollback respawns the process, resetting it — the envelope's
+        # epoch disambiguates), so (epoch, seq) identifies a delivery
+        self._seq = 0
+        self._epoch: int | None = None
+
+    def _mesh_epoch(self) -> int:
+        if self._epoch is None:
+            # one shared parse (runtime.mesh_epoch): procgroup epoch
+            # when a mesh formed, else the supervisor-stamped env
+            self._epoch = self.scope.runtime.mesh_epoch()
+        return self._epoch
 
     def process(self, time, batches):
         deltas = consolidate(batches[0])
@@ -2039,7 +2056,18 @@ class OutputNode(Node):
             # OpenMetrics output_lag_ms histogram)
             self.scope.runtime.note_output_emit(self, time, len(deltas))
             if self._on_batch is not None:
-                self._on_batch(time, deltas)
+                self._seq += 1
+                if self._envelope:
+                    from pathway_tpu.io.txn import DeliveryEnvelope
+
+                    self._on_batch(
+                        DeliveryEnvelope(
+                            self._mesh_epoch(), time, self._seq
+                        ),
+                        deltas,
+                    )
+                else:
+                    self._on_batch(time, deltas)
             if self._on_change is not None:
                 # stable partition: retractions first, then insertions,
                 # each in producer order (deterministic — node outputs are

@@ -156,6 +156,12 @@ class Runtime:
         # standalone cluster metrics aggregator (unsupervised rank 0
         # with PATHWAY_CLUSTER_METRICS_PORT set; internals/cluster.py)
         self._cluster_agg = None
+        # transactional egress (io/txn.py; ISSUE 12): whether the 2PC
+        # sinks are epoch-aligned this run (OPERATOR_PERSISTING +
+        # PATHWAY_SINK_TXN), and the last BSP round number (the final
+        # clean-shutdown cut tags past it)
+        self._txn_operator = False
+        self._bsp_round_no = 0
 
     # -- multi-process plane ----------------------------------------------
     @property
@@ -664,6 +670,11 @@ class Runtime:
                     break
                 while self.pending_times:
                     self._step_time(self._min_pending())
+        # clean-shutdown 2PC cut: the closure flush above pushed the
+        # stream's tail into the sinks' staging — commit it through one
+        # final snapshot + marker + finalize before on_end fires, so the
+        # tail never finalizes outside a marker (io/txn.py; ISSUE 12)
+        self._txn_final_cut()
         for node in self.scope.nodes:
             node.on_end()
         if self.recorder is not None:
@@ -782,6 +793,152 @@ class Runtime:
             remote = pg.bcast0(("tsync", tag))
             rec.resample_clock_offset(remote - _time.perf_counter_ns())
 
+    # -- transactional egress (io/txn.py; ISSUE 12) -------------------------
+    # The 2PC sink lifecycle the runtime drives: arm at run start,
+    # recover at restore (before any new data flows), precommit inside
+    # every snapshot cut BEFORE the marker moves, finalize after the
+    # marker (and, on a mesh, the snapshot barrier) landed, and one
+    # FINAL cut at clean shutdown so the tail of the stream commits
+    # through the same two phases instead of bypassing them.
+
+    def mesh_epoch(self) -> int:
+        """The mesh recovery epoch this process runs at: the formed
+        procgroup's epoch, else the PATHWAY_MESH_EPOCH env the
+        supervisor stamps into respawns (0 outside supervised meshes).
+        ONE parse, shared by the delivery-envelope mint
+        (engine/nodes.py OutputNode) and the txn-sink arming."""
+        pg = self._procgroup
+        if pg is not None:
+            return pg.epoch
+        import os as _os
+
+        try:
+            return int(_os.environ.get("PATHWAY_MESH_EPOCH", "0") or 0)
+        except ValueError:
+            return 0
+
+    def _arm_txn_sinks(self, operator_mode: bool) -> None:
+        sinks = self.scope.txn_sinks
+        if not sinks:
+            self._txn_operator = False
+            return
+        from pathway_tpu.internals.config import get_pathway_config
+        from pathway_tpu.io.txn import txn_enabled
+
+        c = get_pathway_config()
+        if self._lane_emulated:
+            # emulated thread-ranks share ONE sink object per program
+            # (the write() call built it once); only the rank-0 runtime
+            # may arm/drive it, and it sees a world of 1 — exactly like
+            # the shared connector subjects. Non-zero thread-ranks keep
+            # _txn_operator (the final-cut branch is COLLECTIVE — every
+            # rank must join its snapshot round) but never drive sinks.
+            if c.process_id != 0:
+                self._txn_operator = operator_mode and txn_enabled()
+                self._txn_driver = False
+                return
+            txn = operator_mode and txn_enabled()
+            lineage = self._txn_lineage_local() if txn else None
+            for sink in sinks:
+                sink.arm(
+                    stats=self.stats, txn=txn, rank=0, world=1, epoch=0,
+                    lineage=lineage,
+                )
+            self._txn_operator = txn
+            self._txn_driver = True
+            return
+        pg = self._procgroup
+        epoch = self.mesh_epoch()
+        txn = operator_mode and txn_enabled()
+        world = 1 if self.local_only else max(1, c.processes)
+        rank = 0 if self.local_only else c.process_id
+        lineage = None
+        if txn:
+            if pg is not None:
+                # one lineage id per persistence store, agreed by the
+                # mesh: rank 0 reads-or-mints the marker, peers adopt it
+                lineage = pg.bcast0(
+                    ("sinklin",),
+                    self._txn_lineage_local() if pg.rank == 0 else None,
+                )
+            else:
+                lineage = self._txn_lineage_local()
+        for sink in sinks:
+            sink.arm(
+                stats=self.stats, txn=txn, rank=rank, world=world,
+                epoch=epoch, lineage=lineage,
+            )
+        self._txn_operator = txn
+        self._txn_driver = True
+
+    def _txn_lineage_local(self) -> str:
+        """The persistence store's egress lineage id: minted once on the
+        store's first run, restored thereafter. Scopes the Delta txn
+        dedup record — snapshot tags restart at 1 whenever the
+        persistence directory is cleared, and an unscoped dedup would
+        let a kept lake's old txn actions mask (and silently drop) the
+        new lineage's first cuts."""
+        import uuid as _uuid
+
+        lin = self.persistence.read_marker("sink_lineage")
+        if lin is None:
+            lin = _uuid.uuid4().hex[:16]
+            self.persistence.write_marker("sink_lineage", lin)
+        return lin
+
+    def _txn_precommit(self, tag: int) -> None:
+        if not getattr(self, "_txn_driver", True):
+            return
+        for sink in self.scope.txn_sinks:
+            sink.precommit(tag)
+
+    def _txn_finalize(self, tag: int) -> None:
+        if not getattr(self, "_txn_driver", True):
+            return
+        for sink in self.scope.txn_sinks:
+            sink.finalize(tag)
+
+    def _txn_recover(self, marker_tag, world: int) -> None:
+        if not getattr(self, "_txn_driver", True):
+            return
+        for sink in self.scope.txn_sinks:
+            sink.recover(marker_tag, world)
+
+    def _txn_final_cut(self) -> None:
+        """Clean-shutdown half of the 2PC egress: one FINAL snapshot cut
+        (snapshot + marker + finalize) covering the stream's tail, taken
+        after input closure flushed every buffered row through the graph
+        but before ``on_end`` fires. Without it the tail would have to
+        finalize outside any marker — exactly the window the protocol
+        exists to close. Collective on a mesh: every rank takes the same
+        branch (the sink list and mode flags are lowering-deterministic),
+        so the snapshot collectives line up."""
+        if not self._txn_operator or not self.scope.txn_sinks:
+            return
+        pg = self._procgroup
+        if pg is not None:
+            self._save_operator_snapshot_distributed(
+                pg, self._bsp_round_no + 1
+            )
+            return
+        tag = getattr(self, "_snap_tag_base", 0) + 1
+        self._snap_tag_base = tag
+        self.persistence.save_operator_snapshot(
+            [node.state_dict() for node in self.scope.nodes],
+            dict(self._operator_subject_states),
+            [node.name() for node in self.scope.nodes],
+            key=f"operator_snapshot/r0/{tag}",
+        )
+        self._txn_precommit(tag)
+        self.persistence.write_marker("snapshot_commit", (tag, 1))
+        prev = getattr(self, "_snap_prev_tag", None)
+        self.persistence.prune_operator_snapshots(
+            "operator_snapshot/r0/",
+            {tag} if prev is None else {tag, prev},
+        )
+        self._snap_prev_tag = tag
+        self._txn_finalize(tag)
+
     def _inject_static(self) -> None:
         t = self._next_time()
         if self.static_data:
@@ -803,6 +960,9 @@ class Runtime:
 
     # -- run modes --------------------------------------------------------
     def run_static(self) -> None:
+        # static runs have no snapshot cuts: txn sinks finalize per
+        # commit timestamp (from-scratch semantics), counters attached
+        self._arm_txn_sinks(False)
         if self.distributed:
             # static rows are the PROGRAM's data, identical in every
             # process: rank 0 injects, exchanges shard the work. Every
@@ -859,6 +1019,15 @@ class Runtime:
                 # NOTHING) and flip /healthz to recovering BEFORE the
                 # trace flush so the park marks land in the partial
                 self._park_serving_for_rollback()
+                # egress plane: discard the dying epoch's un-pre-
+                # committed staged output (recovery would discard it
+                # anyway; this reclaims it early and counts the abort)
+                if self.scope.txn_sinks:
+                    from pathway_tpu.io._connector import (
+                        abort_sinks_for_rollback,
+                    )
+
+                    abort_sinks_for_rollback(self.scope.txn_sinks)
                 # flush this rank's trace partial with the rollback mark
                 # before the supervised exit discards the process
                 self._abort_trace(exc)
@@ -1019,6 +1188,13 @@ class Runtime:
         from pathway_tpu.io._connector import run_connector_thread
 
         self._start_monitoring()
+        # arm BEFORE static injection: rows staged by it live in the
+        # current incarnation's open staging and survive the recovery
+        # scan below (dead incarnations' open staging does not)
+        self._arm_txn_sinks(
+            self.persistence is not None
+            and self.persistence.mode == "OPERATOR_PERSISTING"
+        )
         self._inject_static()
         while self.pending_times:
             t = self._min_pending()
@@ -1064,9 +1240,22 @@ class Runtime:
                     self._restore_conn_state(
                         conn, subject_states.get(conn.name)
                     )
+                # sink recovery AFTER the engine cut is restored: pending
+                # staged egress at-or-below the cut finalizes, the rest
+                # is discarded (the restored engine re-emits it)
+                self._txn_recover(tag, 1)
                 snap = None
             else:
                 snap = self.persistence.load_operator_snapshot()
+                if snap is None:
+                    # genuine from-scratch start: stale staging AND
+                    # stale finalized output are discarded (everything
+                    # will be re-emitted). A legacy flat snapshot
+                    # (marker-less store from an older build) instead
+                    # keeps the sink's durable state, matching the
+                    # operator-persistence contract that restores never
+                    # re-notify sinks.
+                    self._txn_recover(None, 1)
             if snap is not None:
                 node_states, subject_states, fingerprint = snap
                 current = [node.name() for node in self.scope.nodes]
@@ -1238,6 +1427,9 @@ class Runtime:
                         fingerprint,
                         key=f"operator_snapshot/r0/{tag}",
                     )
+                    # 2PC egress, phase 1: freeze the staged sink set
+                    # under this cut's tag BEFORE the marker moves
+                    self._txn_precommit(tag)
                     self.persistence.write_marker(
                         "snapshot_commit", (tag, 1)
                     )
@@ -1247,6 +1439,9 @@ class Runtime:
                         {tag} if prev is None else {tag, prev},
                     )
                     self._snap_prev_tag = tag
+                    # phase 2: the marker is durable — staged output
+                    # at-or-below the tag becomes externally visible
+                    self._txn_finalize(tag)
             if self.error and self.terminate_on_error:
                 raise self.error
         # late notices (final flush failures, demotions) still deserve
@@ -1472,6 +1667,9 @@ class Runtime:
             # retention window)
             self._snap_prev_tag = tag
         if tag is None:
+            # from-scratch start: discard stale staged egress (and stale
+            # finalized output — everything will be re-emitted)
+            self._txn_recover(None, pg.world)
             return
         # kill slot: rank dies mid-restore, after the marker tag was
         # agreed — peers abort, and the NEXT rollback must still find
@@ -1481,6 +1679,10 @@ class Runtime:
         _faults.fault_point("mesh.rank_kill", phase="restore")
         if snap_world != pg.world:
             self._restore_resharded(pg, live, tag, snap_world)
+            # sink recovery at the NEW world: pending staged partitions
+            # of the dead world are re-owned through the shared
+            # shard_owner mint, finalized at-or-below the cut
+            self._txn_recover(tag, pg.world)
             return
         snap = self.persistence.load_operator_snapshot(
             key=f"operator_snapshot/r{pg.rank}/{tag}"
@@ -1498,6 +1700,7 @@ class Runtime:
                     "graph shape — clear the persistence directory or "
                     "revert the pipeline"
                 )
+            self._txn_recover(None, pg.world)
             return
         node_states, subject_states, _fp = snap
         for node, state in zip(self.scope.nodes, node_states):
@@ -1506,6 +1709,10 @@ class Runtime:
         self._operator_subject_states.update(subject_states)
         for conn in live:
             self._restore_conn_state(conn, subject_states.get(conn.name))
+        # sink recovery AFTER the engine cut is restored: pending staged
+        # egress at-or-below the cut finalizes (the crash landed between
+        # the marker and the owner's local finalize), the rest discards
+        self._txn_recover(tag, pg.world)
         # the committed cut this epoch resumed from (OpenMetrics gauge)
         self.stats.on_mesh_epoch_committed(pg.epoch)
         if self.recorder is not None:
@@ -1631,9 +1838,14 @@ class Runtime:
             [node.name() for node in self.scope.nodes],
             key=f"operator_snapshot/r{pg.rank}/{tag}",
         )
+        # 2PC egress, phase 1: every rank freezes its staged sink set
+        # under this cut's tag BEFORE acking — when the marker moves,
+        # the egress it commits is already durable and immutable
+        self._txn_precommit(tag)
         # kill slot: rank-local snapshot durable, commit marker not yet
         # moved — the cut must NOT count as committed, and recovery must
-        # roll back to the previous marker tag
+        # roll back to the previous marker tag (staged egress of this
+        # cut is then discarded, never finalized)
         _faults.fault_point("mesh.rank_kill", phase="post_snapshot")
         pg.gather0(("snapack", tag), True)
         if pg.rank == 0:
@@ -1644,6 +1856,12 @@ class Runtime:
                 "snapshot_commit", (tag, pg.world)
             )
         pg.barrier(("snapbar", tag))
+        # phase 2: the marker is durable and every rank knows it —
+        # staged egress at-or-below the tag becomes externally visible
+        # (a rank dying before its local finalize is healed by the
+        # next recovery scan: sink_recover finalizes what the marker
+        # covers)
+        self._txn_finalize(tag)
         self.stats.on_mesh_epoch_committed(pg.epoch)
         # re-sample cross-rank clock offsets at every commit so long
         # traced runs don't drift out of alignment (per-segment offsets)
@@ -1678,6 +1896,14 @@ class Runtime:
 
         pg = self.procgroup
         self._start_monitoring(printer=pg.rank == 0)
+        # arm BEFORE static injection (rows it stages live in the
+        # current incarnation's open staging, surviving the recovery
+        # scan); the arm decision is lowering-deterministic, so every
+        # rank takes the same 2PC collective windows
+        self._arm_txn_sinks(
+            self.persistence is not None
+            and self.persistence.mode == "OPERATOR_PERSISTING"
+        )
 
         # program-embedded static rows are identical in every process:
         # rank 0 injects them once, exchanges shard the work; every rank
@@ -1749,6 +1975,7 @@ class Runtime:
         round_no = 0
         while True:
             round_no += 1
+            self._bsp_round_no = round_no
             self._cadence_flush(live)
             # once every LOCAL connector has finished, this rank only
             # relays peers' rounds — the long drain pause would charge

@@ -31,6 +31,11 @@ class Scope:
         # multi-process runs: exchange boundaries the lockstep scheduler
         # must step at every global timestamp (engine/runtime.py)
         self.exchange_nodes: list[N.ExchangeNode] = []
+        # transactional egress (io/txn.py; ISSUE 12): 2PC sinks the
+        # runtime drives precommit/finalize/recover on around its
+        # snapshot lifecycle. Registered on EVERY rank (the collective
+        # windows must agree), even where callbacks are nulled.
+        self.txn_sinks: list = []
 
     def register(self, node: N.Node) -> int:
         self.nodes.append(node)
@@ -352,17 +357,40 @@ class Scope:
     # outputs gather to rank 0 in multi-process runs: one process owns the
     # external side effects (files, subscribers), mirroring the reference's
     # single-writer guidance for fs sinks
-    def output(self, table: EngineTable, **callbacks) -> None:
-        table = self._exchange(table, mode="gather")
-        if self._world() > 1:
-            from pathway_tpu.internals.config import get_pathway_config
+    def output(
+        self,
+        table: EngineTable,
+        *,
+        txn_sink=None,
+        partitioned: bool = False,
+        **callbacks,
+    ) -> None:
+        if partitioned:
+            # per-rank partitioned egress (ISSUE 12; ROADMAP item 3):
+            # NO gather leg — every rank runs the sink callbacks over
+            # its own shard and commits its own output partition. Only
+            # meaningful for sinks whose finalization makes the union
+            # exactly-once (the transactional Delta writer: each rank
+            # commits its own data files, rank 0 appends the log).
+            pass
+        else:
+            table = self._exchange(table, mode="gather")
+            if self._world() > 1:
+                from pathway_tpu.internals.config import get_pathway_config
 
-            if get_pathway_config().process_id != 0:
-                # rows gather to rank 0; other ranks keep the node (graph
-                # shape must match) but must not run side effects — an
-                # on_end here would e.g. truncate the file rank 0 wrote
-                callbacks = {k: None for k in callbacks}
-        N.OutputNode(self, table.node, **callbacks)
+                if get_pathway_config().process_id != 0:
+                    # rows gather to rank 0; other ranks keep the node
+                    # (graph shape must match) but must not run side
+                    # effects — an on_end here would e.g. truncate the
+                    # file rank 0 wrote
+                    callbacks = {k: None for k in callbacks}
+        node = N.OutputNode(self, table.node, **callbacks)
+        if txn_sink is not None:
+            # registered on every rank — the runtime's 2PC windows are
+            # collective, and non-writer ranks' verbs no-op on their
+            # empty staging areas
+            node._txn_sink = txn_sink
+            self.txn_sinks.append(txn_sink)
 
     def capture(self, table: EngineTable) -> N.CaptureNode:
         return N.CaptureNode(self, self._exchange(table, mode="gather").node)

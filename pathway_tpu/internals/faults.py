@@ -36,6 +36,18 @@ Injection points threaded through the hot paths:
                                     frontend at backend loss
     serve.replay                    per parked request replayed into the
                                     first window of epoch+1
+    sink.stage                      per staged egress segment (a
+                                    transactional sink sealing one
+                                    commit's rows into its staging area,
+                                    io/txn.py — crash here = staged
+                                    output the next recovery discards)
+    sink.finalize                   per staged unit becoming externally
+                                    visible (marker landed; crash here =
+                                    marker moved but the unit still
+                                    pending — recovery must FINALIZE it)
+    sink.recover                    per sink recovery scan at restore
+                                    (crash here = recovery repeats —
+                                    double recovery must be idempotent)
     mesh.slow                       straggler injection slots on the wave
                                     path (never crashes — pair with the
                                     ``delay`` action): the runtime hits it
@@ -108,6 +120,9 @@ POINTS = (
     "serve.park",
     "serve.replay",
     "mesh.slow",
+    "sink.stage",
+    "sink.finalize",
+    "sink.recover",
 )
 
 _ACTIONS = ("raise", "crash", "delay")

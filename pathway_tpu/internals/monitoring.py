@@ -249,6 +249,19 @@ class ProberStats:
     # event-time lag watermarks: output label -> freshness histogram
     # (commit→emit ms against the connector's flush-time ingest stamp)
     lag: dict = field(default_factory=dict)
+    # transactional egress (ISSUE 12): per-sink 2PC counters — segments
+    # staged (sealed, invisible), finalized (externally visible after
+    # the snapshot_commit marker landed), aborted (discarded at
+    # recovery / epoch abort: no committed cut claimed them) and
+    # recovered (finalized by a restore-time recovery scan: the crash
+    # landed between the marker and the owner's local finalize) — plus
+    # the per-sink epoch lag gauge: how many committed cuts the
+    # external output trails the staged set by (0 = egress is current)
+    sink_staged: dict = field(default_factory=dict)    # name -> units
+    sink_finalized: dict = field(default_factory=dict)
+    sink_aborted: dict = field(default_factory=dict)
+    sink_recovered: dict = field(default_factory=dict)
+    sink_lag: dict = field(default_factory=dict)       # name -> gauge
 
     def on_node_step(
         self, label: str, self_s: float, rows: int, nb: bool
@@ -371,6 +384,23 @@ class ProberStats:
         self.outputs_emitted += n_rows
         self.last_output_ts = time.time()
 
+    # -- transactional egress (io/txn.py; ISSUE 12) ------------------------
+
+    def on_sink_staged(self, name: str, n: int = 1) -> None:
+        self.sink_staged[name] = self.sink_staged.get(name, 0) + n
+
+    def on_sink_finalized(self, name: str, n: int = 1) -> None:
+        self.sink_finalized[name] = self.sink_finalized.get(name, 0) + n
+
+    def on_sink_aborted(self, name: str, n: int = 1) -> None:
+        self.sink_aborted[name] = self.sink_aborted.get(name, 0) + n
+
+    def on_sink_recovered(self, name: str, n: int = 1) -> None:
+        self.sink_recovered[name] = self.sink_recovered.get(name, 0) + n
+
+    def on_sink_epoch_lag(self, name: str, lag: int) -> None:
+        self.sink_lag[name] = lag
+
     def input_latency_ms(self) -> float:
         if not self.connectors:
             return 0.0
@@ -460,6 +490,28 @@ class ProberStats:
         lines.append(
             f"mesh_last_committed_epoch {self.mesh_last_committed_epoch}"
         )
+        # transactional egress families (bounded cardinality: one label
+        # value per sink in the program). The cluster aggregator relabels
+        # these per rank, so /metrics/cluster shows the whole mesh's
+        # staged/finalized balance in one view.
+        for metric, store in (
+            ("sink_staged_total", self.sink_staged),
+            ("sink_finalized_total", self.sink_finalized),
+            ("sink_aborted_total", self.sink_aborted),
+            ("sink_recovered_total", self.sink_recovered),
+        ):
+            if store:
+                lines.append(f"# TYPE {metric} counter")
+                for name in sorted(store):
+                    lines.append(
+                        f'{metric}{{sink="{name}"}} {store[name]}'
+                    )
+        if self.sink_lag:
+            lines.append("# TYPE sink_epoch_lag gauge")
+            for name in sorted(self.sink_lag):
+                lines.append(
+                    f'sink_epoch_lag{{sink="{name}"}} {self.sink_lag[name]}'
+                )
         if self.nodes:
             for metric, idx, fmt in (
                 ("node_self_seconds_total", 0, "{:.6f}"),
@@ -683,6 +735,19 @@ def render_dashboard(stats: ProberStats, graveyard=None):
         )
         pipe.add_row(
             "mesh committed epoch", str(stats.mesh_last_committed_epoch)
+        )
+    # transactional egress (ISSUE 12): one row per sink — the 2PC
+    # balance (staged vs finalized) plus the epoch-lag gauge, so a
+    # glance says whether committed output is keeping up with cuts
+    for name in sorted(
+        set(stats.sink_staged) | set(stats.sink_finalized)
+        | set(stats.sink_lag)
+    ):
+        pipe.add_row(
+            f"sink {name} staged/final/lag",
+            f"{stats.sink_staged.get(name, 0)}"
+            f"/{stats.sink_finalized.get(name, 0)}"
+            f"/{stats.sink_lag.get(name, 0)}",
         )
     for sm in stats.serve:
         pipe.add_row(

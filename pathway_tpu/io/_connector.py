@@ -164,6 +164,35 @@ def close_subjects_for_rollback(conns, deadline_s: float = 1.0) -> None:
         t.join(max(0.0, deadline - _time.monotonic()))
 
 
+def abort_sinks_for_rollback(sinks, deadline_s: float = 1.0) -> None:
+    """Best-effort ``TransactionalSink.abort_for_rollback()`` fan-out
+    before a mesh rollback exit — the egress sibling of
+    :func:`close_subjects_for_rollback`: the dying epoch's
+    un-pre-committed staged output is discarded. Recovery would discard
+    it anyway (no committed cut claims it); doing it here reclaims the
+    disk early and makes the abort observable on
+    ``sink_aborted_total``. Same bounded-daemon-thread contract: a sink
+    wedged in teardown must not stall the rollback."""
+    threads: list[threading.Thread] = []
+    for sink in sinks:
+        abort = getattr(sink, "abort_for_rollback", None)
+        if abort is None:
+            continue
+
+        def _abort(fn=abort):
+            try:
+                fn()
+            except Exception:
+                pass  # the rank is exiting; failures here are moot
+
+        t = threading.Thread(target=_abort, daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = _time.monotonic() + deadline_s
+    for t in threads:
+        t.join(max(0.0, deadline - _time.monotonic()))
+
+
 def _report_permanent(conn, failure: Exception) -> None:
     """Record a permanent connector failure and route it to the runtime
     (single door shared by the supervisor epilogue and the last-resort

@@ -15,6 +15,7 @@ def subscribe(
     on_time_end: Callable | None = None,
     *,
     on_batch: Callable | None = None,
+    with_envelope: bool = False,
     name: str | None = None,
     sort_by=None,
 ) -> None:
@@ -26,18 +27,44 @@ def subscribe(
     per-row ``on_change`` (which expands every C-owned batch row-wise
     through a Python callback; the Plan Doctor's ``sink.row-expanding``
     diagnostic names exactly that de-optimization).
+
+    ``with_envelope=True`` (ISSUE 12) changes the ``on_batch``
+    signature to ``on_batch(envelope, changes)`` where ``envelope`` is
+    a :class:`~pathway_tpu.io.txn.DeliveryEnvelope` ``(epoch,
+    commit_ts, seq)`` — delivery metadata for the remaining
+    at-least-once surface: ``commit_ts`` is the plain ``time`` of the
+    unenveloped form (monotone across restarts), ``seq`` strictly
+    monotone per subscription within one process incarnation, and an
+    epoch bump or ``seq`` reset marks a redelivery window (see the
+    ``DeliveryEnvelope`` docstring for the exact guarantees and what
+    still needs consumer-side keys).
     """
     cols = tuple(table.column_names())
 
     def lower(ctx):
         batch_cb = None
         if on_batch is not None:
+            if with_envelope:
 
-            def batch_cb(time, deltas):
-                on_batch(
-                    time,
-                    [(k, dict(zip(cols, row)), d) for k, row, d in deltas],
-                )
+                def batch_cb(env, deltas):
+                    on_batch(
+                        env,
+                        [
+                            (k, dict(zip(cols, row)), d)
+                            for k, row, d in deltas
+                        ],
+                    )
+
+            else:
+
+                def batch_cb(time, deltas):
+                    on_batch(
+                        time,
+                        [
+                            (k, dict(zip(cols, row)), d)
+                            for k, row, d in deltas
+                        ],
+                    )
 
         # dict_cols pushes the row-dict building into the OutputNode's C
         # delivery loop instead of a per-change Python wrapper
@@ -48,6 +75,7 @@ def subscribe(
             on_time_end=on_time_end,
             on_end=on_end,
             dict_cols=cols if on_change is not None else None,
+            envelope=with_envelope and on_batch is not None,
         )
 
     G.add_operator([table], [], lower, "subscribe", is_output=True)

@@ -60,10 +60,17 @@ class _LocalStore:
             return None
 
     def write(self, rel: str, data: bytes) -> None:
+        # write-temp + atomic rename: object-store PUTs are atomic, and
+        # the transactional writer's manifests (the durable pre-commit
+        # record) must never be observable half-written on local disk
         path = os.path.join(self.root, rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "wb") as f:
+        tmp = path + f".tmp-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def write_exclusive(self, rel: str, data: bytes) -> None:
         """Create-if-absent (Delta log commits must be mutually
@@ -97,6 +104,37 @@ class _LocalStore:
             for f in names
             if f.endswith(".json") and f.split(".")[0].isdigit()
         )
+
+    def list(self, prefix: str) -> list[str]:
+        """Relative keys under ``prefix`` (the staging/manifest scans of
+        the transactional writer). Walks only the prefix's subtree — a
+        whole-lake walk would put an O(committed parts) scan on every
+        snapshot cut's finalize."""
+        base = os.path.join(self.root, prefix)
+        if os.path.isdir(base):
+            roots = [base]
+        else:
+            # partial-name prefix: walk the containing directory
+            parent = os.path.dirname(base)
+            if not os.path.isdir(parent):
+                return []
+            roots = [parent]
+        out = []
+        for root in roots:
+            for dirpath, _dirs, files in os.walk(root):
+                for f in files:
+                    rel = os.path.relpath(
+                        os.path.join(dirpath, f), self.root
+                    ).replace(os.sep, "/")
+                    if rel.startswith(prefix):
+                        out.append(rel)
+        return sorted(out)
+
+    def delete(self, rel: str) -> None:
+        try:
+            os.unlink(os.path.join(self.root, rel))
+        except FileNotFoundError:
+            pass
 
 
 class _S3Store:
@@ -142,6 +180,16 @@ class _S3Store:
             if name.endswith(".json") and name.split(".")[0].isdigit():
                 out.append(int(name.split(".")[0]))
         return sorted(out)
+
+    def list(self, prefix: str) -> list[str]:
+        strip = len(self.prefix) + 1 if self.prefix else 0
+        return sorted(
+            obj.key[strip:]
+            for obj in self.client.list_objects(prefix=self._key(prefix))
+        )
+
+    def delete(self, rel: str) -> None:
+        self.client.delete_object(self._key(rel))
 
 
 def _make_store(uri, s3_connection_settings=None):
@@ -278,43 +326,68 @@ def read(
     )
 
 
-def write(
-    table,
-    uri,
-    *,
-    min_commit_frequency: int | None = 60_000,
-    s3_connection_settings=None,
-    name: str | None = None,
-    **kwargs,
-) -> None:
-    """Write the table's change stream into a Delta Lake — local path or
-    ``s3://bucket/prefix`` (reference: io/deltalake/__init__.py:170 —
-    output rows carry ``time`` and ``diff`` columns; one parquet part +
-    log version per commit window, rate-limited by
-    min_commit_frequency)."""
-    store = _make_store(uri, s3_connection_settings)
-    cols = table.column_names()
-    schema_dtypes = table._schema_cls._dtypes()
-    dtypes = [schema_dtypes.get(c) for c in cols]
-    state: dict[str, Any] = {
-        "buf": [], "version": None, "last_commit": 0.0,
-    }
+class TxnDeltaSink:
+    """Transactional Delta writer (io/txn.py protocol; ISSUE 12) — and
+    ROADMAP item 3's per-rank partitioned distributed output, shipped
+    robustness-first: each rank writes its OWN parquet data files (no
+    gather-to-rank-0 leg), and one rank appends the log version through
+    the existing ``write_exclusive`` conditional-PUT path.
 
-    def _next_version() -> int:
-        if state["version"] is None:
-            existing = store.list_log_versions()
-            state["version"] = (max(existing) + 1) if existing else 0
-            if state["version"] == 0:
-                try:
-                    _write_version(0, _bootstrap_actions())
-                except FileExistsError:
-                    pass  # a concurrent writer bootstrapped the table
-                state["version"] = 1
-        v = state["version"]
-        state["version"] += 1
-        return v
+    Epoch-aligned two-phase commit (OPERATOR_PERSISTING runs):
 
-    def _bootstrap_actions() -> list[dict]:
+    * **stage** — each rank's buffered rows flush into staged parquet
+      parts under ``_pw_txn/stage/r{rank}/`` (rate-limited by
+      ``min_commit_frequency`` *within* the epoch — the satellite fix:
+      wall-clock autocommit no longer commits log versions the engine's
+      epochs know nothing about);
+    * **pre-commit** — at the snapshot cut every rank writes ONE
+      durable manifest ``_pw_txn/manifest/r{rank}/{tag}.json`` naming
+      its staged parts, so the set the marker commits is frozen;
+    * **finalize** — after the marker lands, the log-owner rank
+      (``shard_owner(0, world)``) folds ALL ranks' manifests at each
+      covered tag into one log version carrying a Delta ``txn`` action
+      ``{appId, version=tag}`` — the idempotence record: a re-run of
+      finalize (or a recovery) skips tags the log already committed;
+    * **recover** — pending manifests at-or-below the committed cut are
+      (re-)committed to the log; manifests above it are discarded with
+      their parts, as are orphaned staged parts of dead incarnations.
+      Manifest partitions are claimed through the shared
+      ``shard_owner`` mint, so after an N→M rescale the pending
+      partitions of dead ranks are re-owned deterministically.
+
+    Without OPERATOR_PERSISTING the writer behaves exactly as before
+    (one part + one log version per rate-limited commit window) —
+    documented at-least-once, since there is no engine cut to align
+    with."""
+
+    TXN_APP_ID = "pathway_tpu-txn"
+
+    def __init__(self, store, cols, dtypes, min_commit_frequency):
+        self.store = store
+        self.cols = list(cols)
+        self.dtypes = list(dtypes)
+        self.min_commit_frequency = min_commit_frequency
+        self.name = "deltalake"
+        self._buf: list[tuple] = []
+        self._version: int | None = None
+        self._last_commit = 0.0
+        self._txn = False
+        self._rank = 0
+        self._world = 1
+        self._epoch = 0
+        self._stats = None
+        self._open_parts: list[dict] = []  # staged, not yet manifested
+        self._staged_tag = -1
+        self._finalized_tag = -1
+        self._committed_txn: set[int] | None = None
+        self._log_paths: set[str] = set()
+        self._scanned_upto = -1
+        self._incarnation = uuid.uuid4().hex[:12]
+        self._app_id = self.TXN_APP_ID
+
+    # -- log machinery (shared by both modes) ------------------------------
+
+    def _bootstrap_actions(self) -> list[dict]:
         fields = [
             {
                 "name": c,
@@ -322,7 +395,7 @@ def write(
                 "nullable": True,
                 "metadata": {},
             }
-            for c, d in zip(cols, dtypes)
+            for c, d in zip(self.cols, self.dtypes)
         ] + [
             {"name": "time", "type": "long", "nullable": False, "metadata": {}},
             {"name": "diff", "type": "long", "nullable": False, "metadata": {}},
@@ -343,79 +416,467 @@ def write(
             },
         ]
 
-    def _write_version(v: int, actions: list[dict]) -> None:
-        # The Delta protocol requires mutually-exclusive version creation:
-        # two writers must never both claim version N. The store's
-        # write_exclusive raises FileExistsError if a concurrent writer —
-        # a second pipeline or an external delta-rs client — committed N
-        # first (local: atomic os.link; S3: conditional PUT).
+    def _write_version(self, v: int, actions: list[dict]) -> None:
+        # The Delta protocol requires mutually-exclusive version
+        # creation: two writers must never both claim version N. The
+        # store's write_exclusive raises FileExistsError if a concurrent
+        # writer — a peer rank, a second pipeline or an external
+        # delta-rs client — committed N first (local: atomic os.link;
+        # S3: conditional PUT).
         data = "".join(_json.dumps(a) + "\n" for a in actions).encode()
-        store.write_exclusive(
+        self.store.write_exclusive(
             os.path.join("_delta_log", f"{v:020d}.json"), data
         )
 
-    def _commit(actions: list[dict]) -> None:
+    def _next_version(self) -> int:
+        if self._version is None:
+            existing = self.store.list_log_versions()
+            self._version = (max(existing) + 1) if existing else 0
+            if self._version == 0:
+                try:
+                    self._write_version(0, self._bootstrap_actions())
+                except FileExistsError:
+                    pass  # a concurrent writer bootstrapped the table
+                self._version = 1
+        v = self._version
+        self._version += 1
+        return v
+
+    def _commit(self, actions: list[dict]) -> None:
         while True:
-            v = _next_version()
+            v = self._next_version()
             try:
-                _write_version(v, actions)
+                self._write_version(v, actions)
                 return
             except FileExistsError:
-                state["version"] = None  # lost the race: re-list and retry
+                self._version = None  # lost the race: re-list and retry
 
-    def _flush(force: bool = False):
-        if not state["buf"]:
-            return
-        if (
-            not force
-            and min_commit_frequency is not None
-            and (time.monotonic() - state["last_commit"]) * 1000.0
-            < min_commit_frequency
-        ):
-            return
+    def _read_log_actions(self, v: int) -> list[dict]:
+        data = self.store.read(
+            os.path.join("_delta_log", f"{v:020d}.json")
+        )
+        if data is None:
+            return []
+        return [
+            _json.loads(line)
+            for line in data.decode().splitlines()
+            if line.strip()
+        ]
+
+    def _scan_log(self, refresh: bool = False) -> set[int]:
+        """Incremental pass over the log: the tags whose egress it
+        already committed (the Delta ``txn`` action is the durable
+        dedup record that makes finalize and recovery idempotent) AND
+        every data path it references (committed parts live at their
+        staged paths — object stores have no rename, the log reference
+        IS the finalization — so the recovery orphan sweep must never
+        touch them). The log is append-only, so refreshes read only
+        versions newer than the last scan — a long-lived lake's
+        restore does not re-fetch its whole history."""
+        if self._committed_txn is None:
+            self._committed_txn = set()
+            self._scanned_upto = -1
+        elif not refresh:
+            return self._committed_txn
+        for v in self.store.list_log_versions():
+            if v <= self._scanned_upto:
+                continue
+            for action in self._read_log_actions(v):
+                txn = action.get("txn")
+                if txn and txn.get("appId") == self._app_id:
+                    self._committed_txn.add(int(txn.get("version", -1)))
+                add = action.get("add")
+                if add is not None:
+                    self._log_paths.add(add["path"])
+            self._scanned_upto = max(self._scanned_upto, v)
+        return self._committed_txn
+
+    def _committed_txn_versions(self, refresh: bool = False) -> set[int]:
+        return self._scan_log(refresh)
+
+    # -- encoding ----------------------------------------------------------
+
+    def _rows_to_parquet(self, rows: list[tuple]) -> bytes:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        rows = state["buf"]
-        state["buf"] = []
-        state["last_commit"] = time.monotonic()
         arrays = {
-            c: [r[j] for r in rows] for j, c in enumerate(cols)
+            c: [r[j] for r in rows] for j, c in enumerate(self.cols)
         }
-        arrays["time"] = [r[len(cols)] for r in rows]
-        arrays["diff"] = [r[len(cols) + 1] for r in rows]
-        part = f"part-{uuid.uuid4().hex}.parquet"
+        arrays["time"] = [r[len(self.cols)] for r in rows]
+        arrays["diff"] = [r[len(self.cols) + 1] for r in rows]
         buf = _io.BytesIO()
         pq.write_table(pa.table(arrays), buf)
-        data = buf.getvalue()
-        store.write(part, data)
-        _commit(
-            [
+        return buf.getvalue()
+
+    @staticmethod
+    def _add_action(path: str, size: int) -> dict:
+        return {
+            "add": {
+                "path": path,
+                "partitionValues": {},
+                "size": size,
+                "modificationTime": int(time.time() * 1000),
+                "dataChange": True,
+            }
+        }
+
+    # -- engine callbacks --------------------------------------------------
+
+    def on_batch(self, time_, deltas) -> None:
+        for _k, row, d in deltas:
+            self._buf.append(tuple(row) + (time_, d))
+
+    def on_time_end(self, time_) -> None:
+        if self._txn:
+            self._stage_part()
+        else:
+            self._flush()
+
+    def on_end(self) -> None:
+        if not self._txn:
+            self._flush(force=True)
+        # txn mode: the runtime's final cut already pre-committed and
+        # finalized the tail before on_end fires
+
+    # -- plain (non-epoch-aligned) path ------------------------------------
+
+    def _flush(self, force: bool = False) -> None:
+        if not self._buf:
+            return
+        if (
+            not force
+            and self.min_commit_frequency is not None
+            and (time.monotonic() - self._last_commit) * 1000.0
+            < self.min_commit_frequency
+        ):
+            return
+        rows, self._buf = self._buf, []
+        self._last_commit = time.monotonic()
+        part = f"part-{uuid.uuid4().hex}.parquet"
+        data = self._rows_to_parquet(rows)
+        self.store.write(part, data)
+        self._commit([self._add_action(part, len(data))])
+
+    # -- the 2PC verbs -----------------------------------------------------
+
+    def arm(
+        self, *, stats=None, txn=False, rank=0, world=1, epoch=0,
+        lineage=None,
+    ):
+        from pathway_tpu.io.txn import txn_enabled
+
+        self._stats = stats
+        self._txn = txn and txn_enabled()
+        self._rank = rank
+        self._world = world
+        self._epoch = epoch
+        # the txn dedup appId is scoped to the PERSISTENCE LINEAGE
+        # (a marker minted on the store's first run): snapshot tags
+        # restart at 1 whenever the persistence directory is cleared,
+        # and an unscoped appId would let a kept lake's OLD txn actions
+        # mask the new lineage's first tags — finalize would then skip
+        # the commit but still delete the manifests, silently losing
+        # every row of the new run's first cuts
+        if lineage:
+            new_id = f"{self.TXN_APP_ID}-{lineage}"
+            if new_id != self._app_id:
+                self._app_id = new_id
+                # any cached log scan keyed the old appId
+                self._committed_txn = None
+                self._scanned_upto = -1
+
+    def _stage_dir(self, rank: int) -> str:
+        return f"_pw_txn/stage/r{rank}"
+
+    def _manifest_dir(self, rank: int) -> str:
+        return f"_pw_txn/manifest/r{rank}"
+
+    def _stage_part(self, force: bool = False) -> None:
+        """Flush buffered rows into ONE staged parquet part — invisible
+        to readers (no log reference) until a finalized log version
+        adds it. Rate-limited within the epoch by
+        min_commit_frequency; pre-commit always forces."""
+        if not self._buf:
+            return
+        if (
+            not force
+            and self.min_commit_frequency is not None
+            and (time.monotonic() - self._last_commit) * 1000.0
+            < self.min_commit_frequency
+        ):
+            return
+        from pathway_tpu.internals import faults as _faults
+
+        _faults.fault_point("sink.stage")
+        rows, self._buf = self._buf, []
+        self._last_commit = time.monotonic()
+        path = (
+            f"{self._stage_dir(self._rank)}/"
+            f"part-{self._incarnation}-{uuid.uuid4().hex}.parquet"
+        )
+        data = self._rows_to_parquet(rows)
+        self.store.write(path, data)
+        self._open_parts.append({"path": path, "size": len(data)})
+        if self._stats is not None:
+            self._stats.on_sink_staged(self.name)
+            self._note_lag()
+
+    def precommit(self, tag: int) -> None:
+        if not self._txn:
+            return
+        self._stage_part(force=True)
+        self._staged_tag = max(self._staged_tag, tag)
+        if not self._open_parts:
+            return
+        manifest = {
+            "tag": tag,
+            "rank": self._rank,
+            "parts": self._open_parts,
+        }
+        self.store.write(
+            f"{self._manifest_dir(self._rank)}/{tag:020d}.json",
+            _json.dumps(manifest).encode(),
+        )
+        self._open_parts = []
+        self._note_lag()
+
+    def _log_owner(self) -> bool:
+        from pathway_tpu.io.txn import SHARD_OWNER
+
+        return SHARD_OWNER(0, self._world) == self._rank
+
+    def _pending_manifests(self) -> dict[int, list[dict]]:
+        """tag -> [manifest, ...] across ALL rank partitions. A
+        manifest that fails to parse is a torn pre-commit leftover from
+        a store without atomic writes — its cut can never have
+        committed (the marker moves only after precommit completed), so
+        skipping it is the discard verdict, not data loss; it must not
+        turn every later recovery into a crash loop."""
+        out: dict[int, list[dict]] = {}
+        for key in self.store.list("_pw_txn/manifest/"):
+            if ".tmp-" in key:
+                continue
+            raw = self.store.read(key)
+            if raw is None:
+                continue
+            try:
+                m = _json.loads(raw.decode())
+                tag = int(m["tag"])
+            except (ValueError, KeyError, UnicodeDecodeError):
+                continue
+            m["_key"] = key
+            out.setdefault(tag, []).append(m)
+        return out
+
+    def _commit_tag(self, tag: int, manifests: list[dict]) -> None:
+        from pathway_tpu.internals import faults as _faults
+
+        _faults.fault_point("sink.finalize")
+        adds = [
+            self._add_action(p["path"], p["size"])
+            for m in sorted(manifests, key=lambda m: m["rank"])
+            for p in m["parts"]
+        ]
+        self._commit(
+            adds
+            + [
                 {
-                    "add": {
-                        "path": part,
-                        "partitionValues": {},
-                        "size": len(data),
-                        "modificationTime": int(time.time() * 1000),
-                        "dataChange": True,
+                    "txn": {
+                        "appId": self._app_id,
+                        "version": tag,
+                        "lastUpdated": int(time.time() * 1000),
                     }
                 }
-            ],
+            ]
         )
+        self._committed_txn_versions().add(tag)
+        # the just-committed parts are log-referenced data now — the
+        # recovery orphan sweep must never see them as orphans
+        self._log_paths.update(a["add"]["path"] for a in adds)
+        if self._stats is not None:
+            self._stats.on_sink_finalized(self.name, len(adds))
 
-    def on_change(key, row, time_, diff):
-        state["buf"].append(tuple(row) + (time_, diff))
+    def finalize(self, tag: int) -> None:
+        """The marker landed at ``tag``: the log owner folds every
+        covered pending manifest set into one log version per tag,
+        through the shared ``sink_may_finalize`` transition."""
+        if not self._txn:
+            return
+        self._finalized_tag = max(self._finalized_tag, tag)
+        if not self._log_owner():
+            self._note_lag()
+            return
+        from pathway_tpu.io.txn import SINK_MAY_FINALIZE
 
-    def on_time_end(time_):
-        _flush()
+        committed = self._committed_txn_versions()
+        for u, manifests in sorted(self._pending_manifests().items()):
+            if not SINK_MAY_FINALIZE(u, tag):
+                continue
+            if u not in committed:
+                self._commit_tag(u, manifests)
+            for m in manifests:
+                self.store.delete(m["_key"])
+        self._note_lag()
 
-    def on_end():
-        _flush(force=True)
+    def recover(self, marker_tag, world: int) -> None:
+        """Restore-time scan: one shared ``sink_recover`` verdict per
+        pending manifest — (re-)commit everything the cut covers,
+        discard the rest with its parts. Partition claims route through
+        ``shard_owner``, so a dead world's pending partitions are
+        re-owned after a rescale; the log's ``txn`` actions make double
+        recovery idempotent.
+
+        Scan ORDER is load-bearing: manifests are read BEFORE the log.
+        A committed part's lifecycle is manifest → log commit → manifest
+        delete, so a sweeper that misses the manifest (deleted) is
+        guaranteed to see the commit in its LATER log scan — reading
+        the log first would open a window where a peer's concurrent
+        recovery commit makes a committed part look orphaned."""
+        from pathway_tpu.internals import faults as _faults
+        from pathway_tpu.io.txn import SHARD_OWNER, SINK_RECOVER
+
+        self._world = world
+        _faults.fault_point("sink.recover")
+        pending = self._pending_manifests()
+        committed = self._committed_txn_versions(refresh=True)
+        recovered = aborted = 0
+        if marker_tag is not None and self._open_parts:
+            # pre-restore staging under a committed marker: the only
+            # rows staged before recovery are re-injected static rows,
+            # which the restored cut already committed — keeping them
+            # would re-commit them at the next cut, once per restart
+            for p in self._open_parts:
+                self.store.delete(p["path"])
+                aborted += 1
+            self._open_parts = []
+        for u, manifests in sorted(pending.items()):
+            verdict = SINK_RECOVER(u, marker_tag)
+            if verdict == "finalize":
+                # the whole tag's manifest set commits as one version:
+                # the log owner claims it (every other rank leaves the
+                # manifests for the owner's scan)
+                if self._log_owner():
+                    if u not in committed:
+                        self._commit_tag(u, manifests)
+                        recovered += sum(len(m["parts"]) for m in manifests)
+                    for m in manifests:
+                        self.store.delete(m["_key"])
+                continue
+            # discard: per-partition, claimed through the shard mint
+            for m in manifests:
+                if SHARD_OWNER(int(m["rank"]), world) != self._rank:
+                    continue
+                for p in m["parts"]:
+                    self.store.delete(p["path"])
+                    aborted += 1
+                self.store.delete(m["_key"])
+        # orphaned staged parts (un-manifested leftovers of dead
+        # incarnations). Each rank sweeps only partitions with NO live
+        # writer it could race: its OWN partition (it knows its own
+        # incarnation token) and dead partitions beyond the current
+        # world (claimed through the shard mint; a rank id >= world has
+        # no process). Live peer partitions are left to their own
+        # ranks' recoveries. Parts referenced by a pending manifest or
+        # by the log are never orphans — safe under the manifest-then-
+        # log scan order above.
+        needed = frozenset(
+            pp["path"]
+            for u, ms in pending.items()
+            if SINK_RECOVER(u, marker_tag) == "finalize"
+            for m in ms
+            for pp in m["parts"]
+        )
+        for key in self.store.list("_pw_txn/stage/"):
+            if key in needed or key in self._log_paths:
+                continue
+            try:
+                p = int(key.split("/r", 1)[1].split("/", 1)[0])
+            except (IndexError, ValueError):
+                continue
+            if p == self._rank:
+                if (
+                    f"-{self._incarnation}-" in key
+                    and marker_tag is None
+                ):
+                    continue  # live from-scratch staging (static rows)
+            elif p < world or SHARD_OWNER(p, world) != self._rank:
+                continue  # a live peer's partition, or not our claim
+            self.store.delete(key)
+            aborted += 1
+        if self._stats is not None:
+            if recovered:
+                self._stats.on_sink_recovered(self.name, recovered)
+            if aborted:
+                self._stats.on_sink_aborted(self.name, aborted)
+        if marker_tag is not None:
+            self._staged_tag = max(self._staged_tag, marker_tag)
+            self._finalized_tag = max(self._finalized_tag, marker_tag)
+        self._note_lag()
+
+    def abort_for_rollback(self) -> None:
+        n = len(self._open_parts)
+        for p in self._open_parts:
+            try:
+                self.store.delete(p["path"])
+            except Exception:
+                pass
+        self._open_parts = []
+        self._buf = []
+        if n and self._stats is not None:
+            self._stats.on_sink_aborted(self.name, n)
+
+    def _note_lag(self) -> None:
+        if self._stats is not None and self._txn:
+            self._stats.on_sink_epoch_lag(
+                self.name,
+                max(0, self._staged_tag - self._finalized_tag),
+            )
+
+
+def write(
+    table,
+    uri,
+    *,
+    min_commit_frequency: int | None = 60_000,
+    s3_connection_settings=None,
+    name: str | None = None,
+    **kwargs,
+) -> None:
+    """Write the table's change stream into a Delta Lake — local path or
+    ``s3://bucket/prefix`` (reference: io/deltalake/__init__.py:170 —
+    output rows carry ``time`` and ``diff`` columns). Multi-rank runs
+    write PARTITIONED: each rank commits its own parquet data files and
+    one rank appends the log version (no gather leg). Under
+    ``OPERATOR_PERSISTING`` the writer is a transactional sink: log
+    commits are tied to the engine's epoch commit markers
+    (``min_commit_frequency`` then rate-limits staged part writes
+    *within* an epoch only), so committed lake contents are
+    bit-identical across rollback and rescale (io/txn.py; ISSUE 12)."""
+    store = _make_store(uri, s3_connection_settings)
+    cols = table.column_names()
+    schema_dtypes = table._schema_cls._dtypes()
+    dtypes = [schema_dtypes.get(c) for c in cols]
+    sink = TxnDeltaSink(store, cols, dtypes, min_commit_frequency)
+    # per-output metrics label (two lakes in one program must not merge
+    # their 2PC counters under one name)
+    base = os.path.basename(str(os.fspath(uri)).rstrip("/"))
+    sink.name = name or f"deltalake:{base or uri}"
 
     def lower(ctx):
+        # per-rank partitioned egress (no gather exchange) — except in
+        # the emulated-rank CI lane, where thread-ranks share one
+        # process and a single writer must own the side effects
+        partitioned = not getattr(
+            ctx.scope.runtime, "_lane_emulated", False
+        )
         ctx.scope.output(
-            ctx.engine_table(table), on_change=on_change,
-            on_time_end=on_time_end, on_end=on_end,
+            ctx.engine_table(table),
+            on_batch=sink.on_batch,
+            on_time_end=sink.on_time_end,
+            on_end=sink.on_end,
+            txn_sink=sink,
+            partitioned=partitioned,
         )
 
     G.add_operator([table], [], lower, "deltalake_write", is_output=True)

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import csv as _csv
 import glob as _glob
-import io as _io
 import json as _json
 import os
 import time
@@ -284,37 +283,27 @@ def read(
 
 
 def write(table: Table, filename: str, *, format: str = "csv", name: str | None = None, **kwargs) -> None:
+    """Write the table's change stream to ``filename`` through the
+    transactional egress plane (io/txn.py; ISSUE 12): rows are STAGED
+    per commit timestamp and become visible only by atomic rename — a
+    crash mid-write can never leave a partial file visible. Under
+    ``OPERATOR_PERSISTING`` the sink is epoch-aligned: staged output
+    finalizes only when the engine's ``snapshot_commit`` marker lands,
+    so the committed file is bit-identical across any rollback or
+    rescale; without it, segments finalize at every commit timestamp
+    (the documented at-least-once boundary)."""
+    from pathway_tpu.io.txn import TxnFileSink
+
     cols = table.column_names()
-    state = {"file": None, "writer": None}
-
-    def ensure_open():
-        if state["file"] is None:
-            os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
-            state["file"] = open(filename, "w", newline="")
-            if format == "csv":
-                state["writer"] = _csv.writer(state["file"])
-                state["writer"].writerow(cols + ["time", "diff"])
-        return state["file"]
-
-    def on_change(key, row, time_, diff):
-        f = ensure_open()
-        if format == "csv":
-            state["writer"].writerow(list(row) + [time_, diff])
-        else:
-            payload = dict(zip(cols, row))
-            payload["time"] = time_
-            payload["diff"] = diff
-            f.write(_json.dumps(payload, default=str) + "\n")
-        f.flush()
-
-    def on_end():
-        if state["file"] is None:
-            ensure_open()
-        state["file"].close()
+    sink = TxnFileSink(filename, format=format, cols=cols)
 
     def lower(ctx):
         ctx.scope.output(
-            ctx.engine_table(table), on_change=on_change, on_end=on_end
+            ctx.engine_table(table),
+            on_batch=sink.on_batch,
+            on_time_end=sink.on_time_end,
+            on_end=sink.on_end,
+            txn_sink=sink,
         )
 
     G.add_operator([table], [], lower, f"fs_write_{format}", is_output=True)

@@ -439,6 +439,43 @@ def breaker_decide(
     return "open"
 
 
+# -- transactional egress (io/txn.py; ISSUE 12) -----------------------------
+# Two-phase-commit sinks: each rank STAGES output during a wave,
+# PRE-COMMITS the staged set at the snapshot cut (tagging it with the
+# cut's tag), and FINALIZES — makes it externally visible — only once
+# the ``snapshot_commit`` marker has landed at-or-past that tag. On
+# restore, recovery scans pending staged units and takes the
+# :func:`sink_recover` verdict per unit: finalize everything the
+# committed cut covers, discard the rest. The runtime sinks
+# (io/txn.py, io/deltalake.py) and the sink model checker
+# (``analysis/meshcheck.py --mesh --sink``) drive the SAME functions,
+# so "committed egress is bit-identical no matter where a rank died"
+# is checked against the code that actually runs.
+
+
+def sink_may_finalize(unit_tag: int, marker_tag: int | None) -> bool:
+    """Whether a staged egress unit pre-committed under ``unit_tag`` may
+    become externally visible: ONLY once the ``snapshot_commit`` marker
+    has durably landed at-or-past its tag. Finalizing earlier is the
+    classic 2PC bug — a crash before the marker moves rolls the engine
+    back and re-emits the unit's rows, which then finalize AGAIN
+    (duplicated output; the ``finalize_before_marker`` mutant breaks
+    exactly this predicate and the sink model checker must catch it)."""
+    return marker_tag is not None and unit_tag <= marker_tag
+
+
+def sink_recover(unit_tag: int, marker_tag: int | None) -> str:
+    """Recovery verdict for a pending staged unit found after a crash
+    (or a rescale): ``"finalize"`` when the committed cut covers it —
+    the crash happened after the marker moved but before the owning
+    rank finished its local finalize — else ``"discard"``: the cut does
+    not claim the unit, the restored engine will re-emit its rows, and
+    keeping it would duplicate them. Total over both inputs, so every
+    pending unit gets exactly one of the two verdicts (no unit is ever
+    left pending forever)."""
+    return "finalize" if sink_may_finalize(unit_tag, marker_tag) else "discard"
+
+
 # -- autoscaler policy (parallel/autoscale.py; ISSUE 11) --------------------
 
 def autoscale_decide(
@@ -518,6 +555,8 @@ TRANSITIONS: dict[str, object] = {
     "shard_owner": shard_owner,
     "reshard_keep": reshard_keep,
     "rescale_plan": rescale_plan,
+    "sink_may_finalize": sink_may_finalize,
+    "sink_recover": sink_recover,
     "autoscale_decide": autoscale_decide,
     "serve_frontend_state": serve_frontend_state,
     "serve_admit": serve_admit,

@@ -123,4 +123,16 @@ echo "=== lane 10: elastic-mesh rescale smoke (2->4->2 under load) ==="
 # pathway_tpu.analysis --mesh --rescale` (mutant drop_reshard_shard).
 env -u PATHWAY_LANE_PROCESSES python scripts/rescale_smoke.py
 
+echo "=== lane 11: transactional-egress chaos smoke (sink 2PC) ==="
+# real-fork 2-rank mesh writing jsonlines + Delta through the epoch-
+# aligned two-phase-commit sinks, killed at every sink phase
+# (sink.stage / sink.finalize / sink.recover) and once mid-rescale
+# (2->3 re-shard restore): victims die 27, survivors detect + exit 28,
+# and after a clean resume the COMMITTED output is bit-identical to a
+# fault-free baseline (zero lost, zero duplicated rows). The protocol
+# is model-checked by `python -m pathway_tpu.analysis --mesh --sink`
+# (mutant: finalize_before_marker); the full grid:
+# `python scripts/fault_matrix.py --sink`.
+env -u PATHWAY_LANE_PROCESSES python scripts/sink_chaos_smoke.py
+
 echo "=== all lanes green ==="

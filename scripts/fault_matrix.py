@@ -809,6 +809,281 @@ def run_rescale_cell(
 
 
 # ---------------------------------------------------------------------------
+# sink grid: transactional-egress kill cells (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+# The sink scenario: a stable-shard-partitioned source feeds a sharded
+# group-by whose committed output egresses through ONE transactional
+# sink per cell — the fs/jsonlines writer (epoch-aligned staged
+# segments + atomic rename, gathered to rank 0) or the partitioned
+# Delta writer (each rank commits its own staged parquet parts, rank 0
+# appends the log version with a txn dedup action). Unique keys make
+# the audit structural: the committed output must contain every key
+# EXACTLY once (c == 1, diff == 1) no matter where a rank died.
+SINK_SCENARIO = r'''
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.parallel.procgroup import stable_shard
+
+pdir, out_base, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+fmt = {fmt!r}
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+
+
+class Src(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True  # keys sharded by the stable mint
+
+    def __init__(self):
+        super().__init__()
+        self.done = set()
+
+    def run(self):
+        import time
+
+        emitted = 0
+        for k in range(n_rows):
+            if stable_shard(k, P) != rank or k in self.done:
+                continue
+            self.next(k=k, v=k * 7)
+            self.done.add(k)
+            emitted += 1
+            if emitted % 4 == 0:
+                self.commit()
+                # spread commits over several BSP rounds so multiple
+                # snapshot cuts commit and every sink phase is reachable
+                time.sleep(0.05)
+
+    def snapshot_state(self):
+        return dict(done=sorted(self.done))
+
+    def seek(self, state):
+        self.done = set(state["done"])
+
+    def reshard_scan_state(self, states):
+        done = set()
+        for st in states:
+            done |= set(st.get("done", ()))
+        return dict(done=sorted(done))
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="sink_battery"
+)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+if fmt == "fs":
+    pw.io.jsonlines.write(counts, out_base + ".jsonl")
+else:
+    pw.io.deltalake.write(
+        counts, out_base + ".lake", min_commit_frequency=None
+    )
+
+pw.run(
+    monitoring_level=pw.MonitoringLevel.NONE,
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="OPERATOR_PERSISTING",
+        snapshot_interval_ms=0,
+    ),
+)
+'''
+
+# (point, victim, hit, fmt): which sink phase dies, on which rank.
+# fs stages/finalizes on rank 0 only (gather sink); the Delta writer
+# stages on every rank and log-commits on rank 0. sink.recover fires on
+# every rank at restore, so both victims are reachable there.
+SINK_CELLS = [
+    ("sink.stage", 0, 2, "fs"),
+    ("sink.finalize", 0, 1, "fs"),
+    ("sink.recover", 1, 1, "fs"),
+    ("sink.stage", 1, 2, "delta"),
+    ("sink.finalize", 0, 1, "delta"),
+    ("sink.recover", 0, 1, "delta"),
+    # kill-during-rescale: a committed world-2 cut restored RE-SHARDED
+    # into world 3 with the victim killed mid-sink-recovery — pending
+    # staged partitions of the dead world must be re-owned through the
+    # shared shard_owner and still commit exactly once
+    ("rescale+sink.recover", 1, 1, "fs"),
+    ("rescale+sink.recover", 1, 1, "delta"),
+]
+
+
+def _sink_rows_fs(path: str) -> list[tuple]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            d.pop("time", None)
+            out.append((d.get("k"), d.get("c"), d.get("s"), d.get("diff")))
+    return sorted(out)
+
+
+def _sink_rows_delta(lake: str) -> list[tuple]:
+    import io as _io
+
+    import pyarrow.parquet as pq
+
+    log = os.path.join(lake, "_delta_log")
+    out = []
+    try:
+        versions = sorted(os.listdir(log))
+    except FileNotFoundError:
+        return []
+    for name in versions:
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(log, name)) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                action = json.loads(line)
+                add = action.get("add")
+                if add is None:
+                    continue
+                with open(os.path.join(lake, add["path"]), "rb") as pf:
+                    table = pq.read_table(
+                        _io.BytesIO(pf.read()), use_threads=False
+                    )
+                ks = table.column("k").to_pylist()
+                cs = table.column("c").to_pylist()
+                ss = table.column("s").to_pylist()
+                ds = table.column("diff").to_pylist()
+                out.extend(zip(ks, cs, ss, ds))
+    return sorted(out)
+
+
+def _expected_sink_rows(n_rows: int) -> list[tuple]:
+    return sorted((k, 1, k * 7, 1) for k in range(n_rows))
+
+
+def run_sink_cell(
+    point: str,
+    victim: int = 0,
+    hit: int = 1,
+    fmt: str = "fs",
+    n_rows: int = 32,
+    timeout: float = 240,
+    world: int = 2,
+) -> CellResult:
+    """One transactional-egress kill-and-resume cycle: the victim dies
+    at the sink phase, every survivor detects + exits 28, and after a
+    clean resume the COMMITTED output (the finalized jsonlines file /
+    the rows the Delta log references) must contain every key exactly
+    once — zero lost, zero duplicated rows, exactly what a fault-free
+    run commits. ``rescale+...`` cells restore the committed world-2
+    cut re-sharded into world 3 and kill there instead."""
+    rescale = point.startswith("rescale+")
+    kill_point = point.split("+", 1)[1] if rescale else point
+    final_world = 3 if rescale else world
+    tmpdir = tempfile.TemporaryDirectory(prefix="pw_sink_fault_")
+    tmp = tmpdir.name
+    script = os.path.join(tmp, "sink_scenario.py")
+    with open(script, "w") as f:
+        f.write(SINK_SCENARIO.format(repo=REPO, fmt=fmt))
+    mode = f"{fmt}-r{victim}" + (f"/{world}->{final_world}" if rescale else "")
+
+    def fail(detail):
+        return CellResult(point, mode, hit, False, detail)
+
+    needs_seed = rescale or kill_point == "sink.recover"
+    if needs_seed:
+        # seed a committed cut + a crash so the NEXT start actually
+        # restores (and its sink recovery scan is reachable)
+        res = _run_mesh_ranks(
+            script, tmp, n_rows, _mesh_plan("post_snapshot", 2), 1,
+            timeout, None, world,
+        )
+        if res[1][0] != CRASH_EXIT_CODE:
+            return fail(
+                f"seed run: victim exit {res[1][0]} (wanted "
+                f"{CRASH_EXIT_CODE}); stderr: {res[1][1]}"
+            )
+    plan = {
+        "seed": 7,
+        "rules": [
+            {"point": kill_point, "hits": [hit], "action": "crash"}
+        ],
+    }
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, plan, victim, timeout, None, final_world
+    )
+    if res[victim][0] != CRASH_EXIT_CODE:
+        return fail(
+            f"kill phase: victim exit {res[victim][0]} (wanted "
+            f"{CRASH_EXIT_CODE}); stderr: {res[victim][1]}"
+        )
+    for survivor in range(final_world):
+        if survivor == victim:
+            continue
+        if res[survivor][0] != MESH_RESTART_EXIT_CODE:
+            return fail(
+                f"survivor rank {survivor} exit {res[survivor][0]} "
+                f"(wanted {MESH_RESTART_EXIT_CODE}); stderr: "
+                f"{res[survivor][1]}"
+            )
+    res = _run_mesh_ranks(
+        script, tmp, n_rows, None, victim, timeout, None, final_world
+    )
+    if [rc for rc, _ in res] != [0] * final_world:
+        return fail(
+            f"resume phase: exits {[rc for rc, _ in res]}; stderr: "
+            f"{[e[-400:] for _, e in res]}"
+        )
+    out_base = os.path.join(tmp, "out")
+    got = (
+        _sink_rows_fs(out_base + ".jsonl")
+        if fmt == "fs"
+        else _sink_rows_delta(out_base + ".lake")
+    )
+    want = _expected_sink_rows(n_rows)
+    if got != want:
+        gset = {r[0] for r in got}
+        missing = sorted(k for k in range(n_rows) if k not in gset)
+        from collections import Counter
+
+        dupes = sorted(
+            k for k, c in Counter(r[0] for r in got).items() if c > 1
+        )
+        return fail(
+            f"committed output violated exactly-once: rows={len(got)} "
+            f"(want {len(want)}) missing={missing[:5]} dupes={dupes[:5]}"
+        )
+    return CellResult(
+        point, mode, hit, True,
+        "committed output bit-identical (zero lost, zero duplicated)",
+    )
+
+
+def run_sink_cells(timeout: float, n_rows: int = 32) -> list[CellResult]:
+    results = []
+    for point, victim, hit, fmt in SINK_CELLS:
+        res = run_sink_cell(
+            point, victim=victim, hit=hit, fmt=fmt, n_rows=n_rows,
+            timeout=timeout,
+        )
+        results.append(res)
+        status = "PASS" if res.ok else "FAIL"
+        print(
+            f"{status}  {res.point:<32} mode={res.mode:<14} "
+            f"hit={res.hit}  {res.detail}"
+        )
+    return results
+
+
+# ---------------------------------------------------------------------------
 # straggler cell: mesh.slow delay injection (ISSUE 10)
 # ---------------------------------------------------------------------------
 
@@ -1084,6 +1359,14 @@ def main(argv=None) -> int:
         "lanes replay)",
     )
     ap.add_argument(
+        "--sink", action="store_true",
+        help="run the transactional-egress grid (ISSUE 12): kill phase "
+        "(sink.stage / sink.finalize / sink.recover) × victim × "
+        "{fs, delta} plus a kill-during-rescale cell — after resume "
+        "the committed output must be bit-identical to a fault-free "
+        "run (zero lost, zero duplicated rows)",
+    )
+    ap.add_argument(
         "--rescale", action="store_true",
         help="run the kill-during-rescale grid (ISSUE 11): a committed "
         "world-N cut restored RE-SHARDED into world M, with the victim "
@@ -1114,6 +1397,12 @@ def main(argv=None) -> int:
         results.append(res)
         status = "PASS" if res.ok else "FAIL"
         print(f"{status}  {res.point:<32} mode={res.mode:<9} {res.detail}")
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.sink:
+        results.extend(run_sink_cells(max(args.timeout, 240)))
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Transactional-egress chaos smoke (ci_lanes lane 11; ISSUE 12).
+
+A real-fork 2-rank mesh streams a partitioned source through a sharded
+group-by into BOTH transactional sinks — the epoch-aligned jsonlines
+writer (staged segments + atomic rename, gathered to rank 0) and the
+partitioned Delta writer (each rank commits its own staged parquet
+parts; rank 0 appends the log version with a txn dedup action) — and
+is then killed at EVERY sink phase (``sink.stage`` / ``sink.finalize``
+/ ``sink.recover``) plus once DURING a 2→3 rescale's re-shard restore.
+
+Contract, per cell: the victim dies 27, every survivor detects the
+loss and exits 28, a clean resume exits 0 everywhere, and the
+COMMITTED output — the finalized jsonlines file, the rows the Delta
+log references — is bit-identical to a fault-free baseline run (zero
+lost, zero duplicated rows; wall-clock ``time`` columns excluded).
+
+The protocol itself is model-checked by ``python -m
+pathway_tpu.analysis --mesh --sink`` (mutant: ``--mesh-mutant
+finalize_before_marker``); the full grid runs via ``python
+scripts/fault_matrix.py --sink``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_fault_matrix():
+    path = os.path.join(REPO, "scripts", "fault_matrix.py")
+    spec = importlib.util.spec_from_file_location("_pw_fault_matrix", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolve cls.__module__ through sys.modules on 3.10 —
+    # a spec-loaded module must register itself first (the same fix
+    # parallel/autoscale.py needed for its file-path loads)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# one cell per sink phase (formats alternated so both sinks see kills)
+# plus the kill-during-rescale cell — the full phase × victim × format
+# product lives in `fault_matrix.py --sink`
+SMOKE_CELLS = [
+    ("sink.stage", 0, 2, "fs"),
+    ("sink.stage", 1, 2, "delta"),
+    ("sink.finalize", 0, 1, "delta"),
+    ("sink.recover", 1, 1, "fs"),
+    ("rescale+sink.recover", 1, 1, "delta"),
+]
+
+
+def _baseline(fm, fmt: str, n_rows: int, timeout: float) -> list[tuple]:
+    """One fault-free 2-rank run; returns the committed rows (time
+    excluded) and asserts the run exits clean."""
+    tmpdir = tempfile.TemporaryDirectory(prefix="pw_sink_smoke_base_")
+    tmp = tmpdir.name
+    script = os.path.join(tmp, "sink_scenario.py")
+    with open(script, "w") as f:
+        f.write(fm.SINK_SCENARIO.format(repo=REPO, fmt=fmt))
+    res = fm._run_mesh_ranks(
+        script, tmp, n_rows, None, 0, timeout, None, 2
+    )
+    codes = [rc for rc, _ in res]
+    if codes != [0, 0]:
+        raise SystemExit(
+            f"fault-free baseline ({fmt}) failed: exits {codes}; "
+            f"stderr: {[e[-400:] for _, e in res]}"
+        )
+    out_base = os.path.join(tmp, "out")
+    rows = (
+        fm._sink_rows_fs(out_base + ".jsonl")
+        if fmt == "fs"
+        else fm._sink_rows_delta(out_base + ".lake")
+    )
+    return rows
+
+
+def main() -> int:
+    fm = _load_fault_matrix()
+    n_rows = 32
+    timeout = 240.0
+    failures = 0
+
+    # fault-free baselines: what "bit-identical" means for each format
+    expected = fm._expected_sink_rows(n_rows)
+    for fmt in ("fs", "delta"):
+        rows = _baseline(fm, fmt, n_rows, timeout)
+        ok = rows == expected
+        print(
+            f"{'PASS' if ok else 'FAIL'}  baseline/{fmt:<5} "
+            f"{len(rows)} committed rows"
+        )
+        if not ok:
+            failures += 1
+
+    for point, victim, hit, fmt in SMOKE_CELLS:
+        res = fm.run_sink_cell(
+            point, victim=victim, hit=hit, fmt=fmt, n_rows=n_rows,
+            timeout=timeout,
+        )
+        status = "PASS" if res.ok else "FAIL"
+        print(
+            f"{status}  {res.point:<24} mode={res.mode:<14} "
+            f"hit={res.hit}  {res.detail}"
+        )
+        if not res.ok:
+            failures += 1
+
+    print()
+    total = len(SMOKE_CELLS) + 2
+    print(f"{total - failures}/{total} sink chaos cells green")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -222,6 +222,12 @@ _PROBER_CALLS = {
     "on_mesh_rank_restart": (),
     "on_mesh_rollback": (),
     "on_mesh_epoch_committed": (4,),
+    # transactional egress (ISSUE 12): 2PC sink counters + epoch lag
+    "on_sink_staged": ("sink_a",),
+    "on_sink_finalized": ("sink_a", 2),
+    "on_sink_aborted": ("sink_a", 1),
+    "on_sink_recovered": ("sink_a", 1),
+    "on_sink_epoch_lag": ("sink_a", 3),
 }
 # state consumed by the dashboard/main loop, not an OpenMetrics family
 _PROBER_EXEMPT = {"on_connector_finished"}

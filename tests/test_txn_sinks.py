@@ -1,0 +1,680 @@
+"""Transactional egress (ISSUE 12): two-phase-commit sinks — protocol
+units, identity pins, recovery edge cases (double recovery, finalize-vs-
+prune, dead-world re-ownership), envelope-seq monotonicity, the sink
+model checker (clean + finalize_before_marker mutant), and a real
+kill-and-resume cycle over the epoch-aligned fs sink."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pathway_tpu.analysis import meshcheck as mc
+from pathway_tpu.io import txn
+from pathway_tpu.parallel import protocol as proto
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- shared-transition units + identity pins --------------------------------
+
+
+def test_sink_transitions_units():
+    assert proto.sink_may_finalize(3, 3) is True
+    assert proto.sink_may_finalize(3, 5) is True
+    assert proto.sink_may_finalize(3, 2) is False
+    assert proto.sink_may_finalize(1, None) is False
+    assert proto.sink_recover(2, 2) == "finalize"
+    assert proto.sink_recover(3, 2) == "discard"
+    assert proto.sink_recover(1, None) == "discard"
+    # total: every unit gets exactly one verdict
+    for unit in range(5):
+        for marker in (None, 0, 1, 2, 3, 4):
+            assert proto.sink_recover(unit, marker) in (
+                "finalize", "discard",
+            )
+
+
+def test_sink_transition_identity_pins():
+    """The runtime sinks and the model checker must drive the SAME
+    transition objects — the anti-drift pin (like NBDecision and the
+    wave protocol)."""
+    t = mc.get_transitions()
+    assert txn.SINK_MAY_FINALIZE is proto.sink_may_finalize
+    assert txn.SINK_RECOVER is proto.sink_recover
+    assert txn.SHARD_OWNER is proto.shard_owner
+    assert t.sink_may_finalize is proto.sink_may_finalize
+    assert t.sink_recover is proto.sink_recover
+    assert (
+        proto.TRANSITIONS["sink_may_finalize"] is proto.sink_may_finalize
+    )
+    assert proto.TRANSITIONS["sink_recover"] is proto.sink_recover
+
+
+# -- TxnFileSink unit battery ------------------------------------------------
+
+
+def _mk_sink(tmp_path, fmt="jsonlines", txn_mode=True, rank=0, world=1):
+    sink = txn.TxnFileSink(
+        str(tmp_path / "out.jsonl"), format=fmt, cols=["k", "v"]
+    )
+    sink.arm(txn=txn_mode, rank=rank, world=world, epoch=0)
+    return sink
+
+
+def _feed(sink, time, rows):
+    sink.on_batch(time, [(None, r, 1) for r in rows])
+    sink.on_time_end(time)
+
+
+def _rows(path):
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                d = json.loads(line)
+                d.pop("time")
+                out.append((d["k"], d["v"], d["diff"]))
+    return out
+
+
+def test_txn_sink_stage_invisible_until_marker(tmp_path):
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    # staged only: nothing visible
+    assert not os.path.exists(sink.filename)
+    sink.precommit(1)
+    assert not os.path.exists(sink.filename)
+    sink.finalize(1)
+    assert _rows(sink.filename) == [(1, "a", 1)]
+    # a later cut appends, atomically
+    _feed(sink, 12, [(2, "b")])
+    sink.precommit(2)
+    sink.finalize(2)
+    assert _rows(sink.filename) == [(1, "a", 1), (2, "b", 1)]
+
+
+def test_txn_sink_double_recovery_idempotent(tmp_path):
+    """Crash mid-recovery = recovery runs again: the second scan finds
+    nothing pending and republishes the identical file."""
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    sink.precommit(1)
+    _feed(sink, 12, [(2, "b")])
+    sink.precommit(2)
+    # marker landed at 2 but the owner died before finalizing: a fresh
+    # incarnation recovers
+    s2 = _mk_sink(tmp_path)
+    s2.recover(2, world=1)
+    first = _rows(s2.filename)
+    assert sorted(first) == [(1, "a", 1), (2, "b", 1)]
+    s3 = _mk_sink(tmp_path)
+    s3.recover(2, world=1)
+    assert _rows(s3.filename) == first
+
+
+def test_txn_sink_recover_finalizes_at_or_below_cut_only(tmp_path):
+    """The finalize-vs-prune shape: pending units from EARLIER cuts
+    (still present thanks to the two-tag retention window) finalize,
+    the uncommitted suffix is discarded."""
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    sink.precommit(1)       # pending t1 (crash before finalize)
+    _feed(sink, 12, [(2, "b")])
+    sink.precommit(2)       # pending t2
+    _feed(sink, 14, [(3, "c")])
+    sink.precommit(3)       # pending t3 — beyond the committed cut
+    s2 = _mk_sink(tmp_path)
+    s2.recover(2, world=1)  # marker landed at 2
+    assert sorted(_rows(s2.filename)) == [(1, "a", 1), (2, "b", 1)]
+    # the discarded suffix is GONE: a later recovery cannot resurrect it
+    s3 = _mk_sink(tmp_path)
+    s3.recover(3, world=1)
+    assert sorted(_rows(s3.filename)) == [(1, "a", 1), (2, "b", 1)]
+
+
+def test_txn_sink_recover_none_discards_everything(tmp_path):
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    sink.precommit(1)
+    sink.finalize(1)
+    assert _rows(sink.filename)
+    s2 = _mk_sink(tmp_path)
+    s2.recover(None, world=1)
+    # nothing committed: the restored engine re-emits everything
+    assert _rows(s2.filename) == []
+
+
+def test_txn_sink_dead_world_pending_recovered_across_rescale(tmp_path):
+    """A gather sink's pending partition (rank 0 staged it, world 2
+    died) is recovered by the new world's owner of partition 0 after a
+    2→3 rescale — and the other new ranks' recovery scans neither
+    double-apply nor clobber it."""
+    s = _mk_sink(tmp_path, rank=0, world=2)
+    _feed(s, 10, [(1, "a")])
+    s.precommit(1)  # marker landed at 1, world reaped before finalize
+    # world-3 recovery, every rank scans (owner of partition 0 first
+    # or last — order must not matter for the committed content)
+    for rank in (2, 0, 1):
+        s2 = txn.TxnFileSink(
+            str(tmp_path / "out.jsonl"), format="jsonlines",
+            cols=["k", "v"],
+        )
+        s2.arm(txn=True, rank=rank, world=3, epoch=1)
+        s2.recover(1, world=3)
+    assert sorted(_rows(tmp_path / "out.jsonl")) == [(1, "a", 1)]
+    # partition claims form a partition of the ranks: exactly one owner
+    for p in (0, 1, 2):
+        assert len(
+            [r for r in range(3) if proto.shard_owner(p, 3) == r]
+        ) == 1
+
+
+def test_delta_dead_world_partitions_reowned_after_rescale(tmp_path):
+    """The partitioned Delta sink: BOTH world-2 ranks staged parts +
+    manifests, the world died after the marker landed — world-3
+    recovery must commit every partition's rows to the log exactly
+    once, and discard-claims for uncommitted tags must be re-owned
+    through shard_owner (a dead rank's pending partition is cleaned by
+    exactly one new rank)."""
+    from pathway_tpu.io.deltalake import TxnDeltaSink, _LocalStore
+
+    store = _LocalStore(str(tmp_path / "lake"))
+
+    def mk(rank, world, epoch):
+        s = TxnDeltaSink(store, ["k"], [None], None)
+        s.arm(txn=True, rank=rank, world=world, epoch=epoch)
+        return s
+
+    for rank in (0, 1):
+        s = mk(rank, 2, 0)
+        s.on_batch(10 + rank, [(None, (rank,), 1)])
+        s.precommit(1)                 # covered by the marker
+        s.on_batch(20 + rank, [(None, (100 + rank,), 1)])
+        s.precommit(2)                 # NOT covered — must be discarded
+    # world-3 recovery at marker tag 1
+    for rank in (1, 2, 0):
+        mk(rank, 3, 1).recover(1, world=3)
+    import io as _io
+
+    import pyarrow.parquet as pq
+
+    rows = []
+    for v in store.list_log_versions():
+        for line in (store.read(
+            os.path.join("_delta_log", f"{v:020d}.json")
+        ) or b"").decode().splitlines():
+            if not line.strip():
+                continue
+            action = json.loads(line)
+            if "add" in action:
+                blob = store.read(action["add"]["path"])
+                assert blob is not None, "log references a deleted part"
+                t = pq.read_table(_io.BytesIO(blob), use_threads=False)
+                rows.extend(t.column("k").to_pylist())
+    assert sorted(rows) == [0, 1]      # tag-1 rows exactly once
+    # the uncommitted tag-2 staging is fully discarded
+    assert store.list("_pw_txn/manifest/") == []
+    # double recovery is a no-op (txn actions dedup the log)
+    mk(0, 3, 2).recover(1, world=3)
+    versions_before = store.list_log_versions()
+    mk(0, 3, 3).recover(1, world=3)
+    assert store.list_log_versions() == versions_before
+
+
+def test_txn_sink_abort_discards_open_staging_only(tmp_path):
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    sink.precommit(1)           # frozen under t1
+    _feed(sink, 12, [(2, "b")])  # open staging
+    sink.abort_for_rollback()
+    s2 = _mk_sink(tmp_path)
+    s2.recover(1, world=1)
+    # the pre-committed unit survived the abort; the open one did not
+    assert sorted(_rows(s2.filename)) == [(1, "a", 1)]
+
+
+def test_txn_sink_early_finalize_blocked_by_shared_transition(tmp_path):
+    """finalize(tag) walks pending units through sink_may_finalize —
+    a unit pre-committed ABOVE the marker must not become visible."""
+    sink = _mk_sink(tmp_path)
+    _feed(sink, 10, [(1, "a")])
+    sink.precommit(5)
+    sink.finalize(3)  # marker only at 3: nothing becomes visible
+    assert (
+        not os.path.exists(sink.filename)
+        or _rows(sink.filename) == []
+    )
+    sink.finalize(5)
+    assert _rows(sink.filename) == [(1, "a", 1)]
+
+
+def test_non_txn_mode_finalizes_per_commit_and_is_atomic(tmp_path):
+    sink = _mk_sink(tmp_path, txn_mode=False)
+    _feed(sink, 10, [(1, "a")])
+    assert _rows(sink.filename) == [(1, "a", 1)]
+    _feed(sink, 12, [(2, "b")])
+    assert len(_rows(sink.filename)) == 2
+    sink.on_end()
+    # staging root cleaned after a from-scratch run
+    assert not os.path.exists(sink.root)
+
+
+def test_csv_header_regenerated(tmp_path):
+    sink = txn.TxnFileSink(
+        str(tmp_path / "out.csv"), format="csv", cols=["k", "v"]
+    )
+    sink.arm(txn=False, rank=0, world=1, epoch=0)
+    sink.on_end()
+    with open(sink.filename) as f:
+        assert f.read().strip() == "k,v,time,diff"
+
+
+def test_write_atomic_replaces_never_appends(tmp_path):
+    p = str(tmp_path / "f.txt")
+    txn.write_atomic(p, b"one")
+    txn.write_atomic(p, b"two")
+    with open(p, "rb") as f:
+        assert f.read() == b"two"
+    assert not os.path.exists(p + ".pw-tmp")
+
+
+def test_txn_sink_pre_restore_static_staging_not_duplicated(tmp_path):
+    """Static rows re-inject before the restore window on every
+    incarnation; under a committed marker the re-staged copy must be
+    DISCARDED at recovery (the cut already committed them) — including
+    across a mesh epoch bump, where the segment names differ."""
+    s1 = _mk_sink(tmp_path)  # epoch 0
+    _feed(s1, 10, [(42, "static")])
+    s1.precommit(1)
+    s1.finalize(1)
+    assert _rows(s1.filename) == [(42, "static", 1)]
+    # restart at epoch 1: static re-injects and stages BEFORE recover
+    s2 = txn.TxnFileSink(
+        str(tmp_path / "out.jsonl"), format="jsonlines", cols=["k", "v"]
+    )
+    s2.arm(txn=True, rank=0, world=1, epoch=1)
+    _feed(s2, 20, [(42, "static")])  # pre-restore staging
+    s2.recover(1, world=1)
+    s2.precommit(2)
+    s2.finalize(2)
+    assert _rows(s2.filename) == [(42, "static", 1)], (
+        "re-staged static rows must not duplicate across restarts"
+    )
+    # from-scratch starts (no marker) KEEP pre-recover staging — it is
+    # the only copy
+    s3 = txn.TxnFileSink(
+        str(tmp_path / "fresh.jsonl"), format="jsonlines", cols=["k", "v"]
+    )
+    s3.arm(txn=True, rank=0, world=1, epoch=0)
+    _feed(s3, 10, [(7, "x")])
+    s3.recover(None, world=1)
+    s3.precommit(1)
+    s3.finalize(1)
+    assert _rows(s3.filename) == [(7, "x", 1)]
+
+
+def test_delta_pre_restore_static_staging_not_recommitted(tmp_path):
+    """The Delta flavor of the static dedup: parts staged before the
+    restore window under a committed marker are deleted and dropped
+    from the open set, so the next cut cannot re-commit their rows."""
+    from pathway_tpu.io.deltalake import TxnDeltaSink, _LocalStore
+
+    store = _LocalStore(str(tmp_path / "lake"))
+
+    def mk(epoch):
+        s = TxnDeltaSink(store, ["k"], [None], None)
+        s.arm(txn=True, rank=0, world=1, epoch=epoch)
+        return s
+
+    s1 = mk(0)
+    s1.on_batch(10, [(None, (42,), 1)])
+    s1.precommit(1)
+    s1.finalize(1)
+    s2 = mk(1)
+    s2.on_batch(20, [(None, (42,), 1)])
+    s2.on_time_end(20)  # staged pre-restore
+    s2.recover(1, world=1)
+    s2.precommit(2)
+    s2.finalize(2)
+    import io as _io
+
+    import pyarrow.parquet as pq
+
+    rows = []
+    for v in store.list_log_versions():
+        for line in (store.read(
+            os.path.join("_delta_log", f"{v:020d}.json")
+        ) or b"").decode().splitlines():
+            if line.strip() and "add" in json.loads(line):
+                add = json.loads(line)["add"]
+                blob = store.read(add["path"])
+                assert blob is not None, "log references a deleted part"
+                t = pq.read_table(_io.BytesIO(blob), use_threads=False)
+                rows.extend(t.column("k").to_pylist())
+    assert rows == [42], f"static rows re-committed: {rows}"
+
+
+def test_delta_sweep_spares_live_peer_partitions(tmp_path):
+    """The recovery orphan sweep must never delete a LIVE peer rank's
+    staged parts (it cannot know the peer's incarnation token) — only
+    its own partition and dead partitions beyond the current world."""
+    from pathway_tpu.io.deltalake import TxnDeltaSink, _LocalStore
+
+    store = _LocalStore(str(tmp_path / "lake"))
+    # rank 1 (live at world 2) staged a part; rank 4 (dead: >= world,
+    # shard_owner(4, 2) == 0 so rank 0 claims it) left one behind
+    store.write("_pw_txn/stage/r1/part-peerinc-live.parquet", b"live")
+    store.write("_pw_txn/stage/r4/part-deadinc-old.parquet", b"dead")
+    s0 = TxnDeltaSink(store, ["k"], [None], None)
+    s0.arm(txn=True, rank=0, world=2, epoch=0)
+    s0.recover(None, world=2)
+    keys = store.list("_pw_txn/stage/")
+    assert "_pw_txn/stage/r1/part-peerinc-live.parquet" in keys, (
+        "a live peer's staged part was swept"
+    )
+    assert "_pw_txn/stage/r4/part-deadinc-old.parquet" not in keys, (
+        "dead-partition garbage survived (shard_owner(4,2)=0 claims it)"
+    )
+
+
+def test_delta_fresh_lineage_not_masked_by_stale_lake(tmp_path):
+    """A kept lake whose log carries txn actions from a PREVIOUS
+    persistence lineage must not mask a fresh lineage's first tags
+    (which restart at 1): the appId is lineage-scoped, so the new
+    run's cuts commit instead of being dedup-skipped (which deleted
+    the manifests and silently lost every row of the first cuts)."""
+    from pathway_tpu.io.deltalake import TxnDeltaSink, _LocalStore
+
+    store = _LocalStore(str(tmp_path / "lake"))
+    # lineage A commits tag 1
+    a = TxnDeltaSink(store, ["k"], [None], None)
+    a.arm(txn=True, rank=0, world=1, epoch=0, lineage="aaaa")
+    a.on_batch(10, [(None, (1,), 1)])
+    a.precommit(1)
+    a.finalize(1)
+    # persistence cleared, lake kept: lineage B restarts tags at 1
+    b = TxnDeltaSink(store, ["k"], [None], None)
+    b.arm(txn=True, rank=0, world=1, epoch=0, lineage="bbbb")
+    b.recover(None, world=1)
+    b.on_batch(20, [(None, (2,), 1)])
+    b.precommit(1)
+    b.finalize(1)
+    import io as _io
+
+    import pyarrow.parquet as pq
+
+    rows = []
+    for v in store.list_log_versions():
+        for line in (store.read(
+            os.path.join("_delta_log", f"{v:020d}.json")
+        ) or b"").decode().splitlines():
+            if line.strip() and "add" in json.loads(line):
+                blob = store.read(json.loads(line)["add"]["path"])
+                assert blob is not None
+                t = pq.read_table(_io.BytesIO(blob), use_threads=False)
+                rows.extend(t.column("k").to_pylist())
+    assert sorted(rows) == [1, 2], (
+        f"fresh lineage's first cut was masked by the stale lake: {rows}"
+    )
+
+
+# -- delivery envelope -------------------------------------------------------
+
+
+def test_envelope_seq_monotone_on_batch():
+    import pathway_tpu as pw
+
+    rows = "\n".join(["k | v"] + [f"{i} | {i * 2}" for i in range(6)])
+    t = pw.debug.table_from_markdown(rows)
+    envs = []
+    pw.io.subscribe(
+        t,
+        on_batch=lambda env, changes: envs.append((env, len(changes))),
+        with_envelope=True,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert envs
+    seqs = [e.seq for e, _ in envs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert all(e.epoch == 0 for e, _ in envs)
+    assert all(e.commit_ts > 0 for e, _ in envs)
+    # the envelope is the documented NamedTuple shape
+    e = envs[0][0]
+    assert e == txn.DeliveryEnvelope(e.epoch, e.commit_ts, e.seq)
+
+
+# -- sink model checker ------------------------------------------------------
+
+
+def test_meshcheck_sink_model_clean_and_deterministic():
+    cfg = mc.MeshCheckConfig(
+        world=3, rounds=2, fault_budget=1, sink=True,
+        fault_phases=mc.SINK_FAULT_PHASES,
+    )
+    r1 = mc.check(cfg)
+    r2 = mc.check(cfg)
+    assert r1.ok, [v.detail for v in r1.violations]
+    assert r1.complete
+    assert (r1.states, r1.transitions) == (r2.states, r2.transitions)
+    # the sink model must actually explore MORE than the plain model
+    # (the post-marker finalize step adds the kill window)
+    plain = mc.check(
+        mc.MeshCheckConfig(world=3, rounds=2, fault_budget=1)
+    )
+    assert plain.states == 689  # canonical pin unchanged
+    assert r1.states > plain.states
+
+
+def test_meshcheck_sink_mutant_finalize_before_marker_caught():
+    r = mc.check(
+        mc.MeshCheckConfig(
+            world=3, rounds=2, fault_budget=1, sink=True,
+            fault_phases=mc.SINK_FAULT_PHASES,
+            mutate="finalize_before_marker",
+        )
+    )
+    assert not r.ok
+    v = r.violations[0]
+    assert v.kind == "exactly-once"
+    assert "finalized more than once" in v.detail
+    plan = v.fault_plan()
+    assert plan is not None and plan["rules"], (
+        "the mutant trace must carry a replayable crash"
+    )
+    # the trace replays through real injection points
+    for rule in plan["rules"]:
+        assert rule["point"] in ("mesh.rank_kill", "sink.finalize")
+        assert rule["action"] == "crash"
+
+
+def test_meshcheck_sink_mutant_invisible_fault_free():
+    """finalize_before_marker is a pure 2PC bug: with no crash budget
+    everything still finalizes exactly once — the checker needs the
+    crash interleaving, which is the point of exploring them all."""
+    r = mc.check(
+        mc.MeshCheckConfig(
+            world=3, rounds=2, fault_budget=0, sink=True,
+            mutate="finalize_before_marker",
+        )
+    )
+    assert r.ok
+
+
+def test_meshcheck_sink_recovery_branch_load_bearing():
+    """A recovery that always discards must LOSE the units killed
+    between the marker and their owner's finalize — proving the model
+    actually reaches the sink_recover 'finalize' branch."""
+    broken = mc.Transitions(
+        {"sink_recover": lambda unit_tag, marker_tag: "discard"}
+    )
+    orig = mc.get_transitions
+    mc.get_transitions = lambda mutate=None: broken
+    try:
+        r = mc.check(
+            mc.MeshCheckConfig(
+                world=3, rounds=2, fault_budget=1, sink=True,
+                fault_phases=mc.SINK_FAULT_PHASES,
+            )
+        )
+    finally:
+        mc.get_transitions = orig
+    assert not r.ok
+    assert "never finalized" in r.violations[0].detail
+    # and the trace names the sink-finalize kill window explicitly
+    plan = r.violations[0].fault_plan()
+    assert any(
+        rule["point"] == "sink.finalize" for rule in plan["rules"]
+    )
+
+
+def test_meshcheck_sink_rescale_window_clean():
+    for target in (4, 2):
+        r = mc.check(
+            mc.MeshCheckConfig(
+                world=3, rounds=2, fault_budget=1, sink=True,
+                fault_phases=mc.SINK_FAULT_PHASES,
+                rescale_to=target, snap_every=1,
+            )
+        )
+        assert r.ok, (target, [v.detail for v in r.violations])
+        assert r.rescales_explored > 0
+
+
+def test_sink_cli_smoke():
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "pathway_tpu.analysis", "--mesh",
+         "--sink", "--processes", "2", "--json"],
+        capture_output=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-800:]
+    reports = json.loads(proc.stdout)
+    assert len(reports) == 2  # fixed world + rescale window
+    assert all(r["sink"] for r in reports)
+    assert reports[1]["rescale_to"] == 3
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_sink_metrics_render_and_drive():
+    from pathway_tpu.internals.monitoring import ProberStats
+
+    stats = ProberStats()
+    sink = txn.TxnFileSink("/tmp/does-not-matter", cols=["k"])
+    sink._stats = stats
+    sink._txn = True
+    sink._staged_tag, sink._finalized_tag = 5, 3
+    sink._note_lag()
+    stats.on_sink_staged(sink.name)
+    stats.on_sink_finalized(sink.name, 2)
+    stats.on_sink_aborted(sink.name)
+    stats.on_sink_recovered(sink.name)
+    text = stats.render_openmetrics()
+    for family in (
+        "sink_staged_total", "sink_finalized_total",
+        "sink_aborted_total", "sink_recovered_total", "sink_epoch_lag",
+    ):
+        assert family in text, family
+    assert 'sink_epoch_lag{sink="' in text
+    assert "} 2" in text  # epoch lag 5-3
+
+
+# -- real kill-and-resume over the epoch-aligned fs sink --------------------
+
+_E2E = r'''
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+pdir, out, n_rows = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+class Src(pw.io.python.ConnectorSubject):
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+    def run(self):
+        import time
+        while self.pos < n_rows:
+            i = self.pos
+            self.next(k=i, v=i * 7)
+            self.pos = i + 1
+            if self.pos % 4 == 0:
+                self.commit()
+                time.sleep(0.05)
+    def snapshot_state(self):
+        return dict(pos=self.pos)
+    def seek(self, state):
+        self.pos = state["pos"]
+
+class S(pw.Schema):
+    k: int
+    v: int
+
+rows = pw.io.python.read(Src(), schema=S, autocommit_duration_ms=25, name="src")
+pw.io.jsonlines.write(rows, out)
+pw.run(
+    monitoring_level=pw.MonitoringLevel.NONE,
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="OPERATOR_PERSISTING",
+        snapshot_interval_ms=0,
+    ),
+)
+'''
+
+
+@pytest.mark.parametrize("point,hit", [
+    ("sink.stage", 2),
+    ("sink.finalize", 2),
+    ("sink.recover", 1),
+])
+def test_e2e_kill_and_resume_exactly_once(tmp_path, point, hit):
+    """Single-process operator mode: kill at each sink phase, resume,
+    and the committed jsonlines output must hold every row exactly once
+    (time column excluded — wall-clock timestamps differ per run)."""
+    script = tmp_path / "scen.py"
+    script.write_text(_E2E.format(repo=REPO))
+    pdir = str(tmp_path / "pstorage")
+    out = str(tmp_path / "out.jsonl")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PATHWAY_FAULT_PLAN", None)
+    env.pop("PATHWAY_LANE_PROCESSES", None)
+    n = 24
+
+    def run(plan):
+        e = dict(env)
+        if plan is not None:
+            e["PATHWAY_FAULT_PLAN"] = json.dumps(plan)
+        return subprocess.run(
+            [sys.executable, str(script), pdir, out, str(n)],
+            capture_output=True, timeout=120, env=e,
+        )
+
+    if point == "sink.recover":
+        # recovery only runs when a committed cut exists: seed one
+        seed = run({"seed": 7, "rules": [
+            {"point": "sink.stage", "hits": [3], "action": "crash"}
+        ]})
+        assert seed.returncode == 27, seed.stderr.decode()[-500:]
+    plan = {"seed": 7, "rules": [
+        {"point": point, "hits": [hit], "action": "crash"}
+    ]}
+    proc = run(plan)
+    assert proc.returncode == 27, (
+        f"kill at {point} never fired: rc={proc.returncode} "
+        + proc.stderr.decode()[-500:]
+    )
+    proc = run(None)
+    assert proc.returncode == 0, proc.stderr.decode()[-800:]
+    got = sorted(
+        (d["k"], d["v"], d["diff"])
+        for d in map(json.loads, open(out).read().splitlines())
+    )
+    assert got == sorted((k, k * 7, 1) for k in range(n)), got
