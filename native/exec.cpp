@@ -5051,12 +5051,77 @@ fallback:
     Py_RETURN_NONE;
 }
 
+/* ---- cross-frame wire intern cache (ISSUE 13) -----------------------
+ * deltas_decode's per-call interning only sees recurrence WITHIN one
+ * frame (retract+insert pairs: ~2x). The gather stream's vocabulary —
+ * group keys, group-key strings — recurs commit after commit, so a
+ * receiver thread that keeps ONE cache across its link's frames turns
+ * nearly every Pointer/str mint into a dict hit. Owned by a capsule
+ * (one per procgroup receiver thread); bounded: at capacity the cache
+ * epoch-resets (decref all, start over) instead of growing. Touched
+ * only with the GIL held (deltas_decode runs GIL-held; the capsule
+ * destructor is invoked by CPython under the GIL). */
+
+struct WireU128H {
+    size_t operator()(unsigned __int128 v) const
+    {
+        return (size_t)(((uint64_t)v ^ (uint64_t)(v >> 64)) *
+                        0x9E3779B97F4A7C15ull);
+    }
+};
+
+struct InternCache {
+    std::unordered_map<unsigned __int128, PyObject *, WireU128H> keys;
+    std::unordered_map<std::string, PyObject *> strs;
+    size_t cap;
+
+    void clear_refs()
+    {
+        for (auto &kv : keys)
+            Py_DECREF(kv.second);
+        for (auto &kv : strs)
+            Py_DECREF(kv.second);
+        keys.clear();
+        strs.clear();
+    }
+};
+
+static void intern_cache_destroy(PyObject *capsule)
+{
+    auto *c = (InternCache *)PyCapsule_GetPointer(capsule, "pw_intern");
+    if (c != nullptr) {
+        c->clear_refs();
+        delete c;
+    }
+}
+
+PyObject *intern_new(PyObject *, PyObject *args)
+{
+    long cap = 65536;
+    if (!PyArg_ParseTuple(args, "|l", &cap))
+        return nullptr;
+    auto *c = new InternCache();
+    c->cap = cap > 0 ? (size_t)cap : 65536;
+    c->keys.reserve(std::min(c->cap, (size_t)4096));
+    c->strs.reserve(std::min(c->cap, (size_t)4096));
+    return PyCapsule_New(c, "pw_intern", intern_cache_destroy);
+}
+
 PyObject *deltas_decode(PyObject *, PyObject *args)
 {
     Py_buffer buf;
     PyObject *ptr_type;
-    if (!PyArg_ParseTuple(args, "y*O", &buf, &ptr_type))
+    PyObject *intern_obj = nullptr;
+    InternCache *xc = nullptr;
+    if (!PyArg_ParseTuple(args, "y*O|O", &buf, &ptr_type, &intern_obj))
         return nullptr;
+    if (intern_obj != nullptr && intern_obj != Py_None) {
+        xc = (InternCache *)PyCapsule_GetPointer(intern_obj, "pw_intern");
+        if (xc == nullptr) {
+            PyBuffer_Release(&buf);
+            return nullptr;
+        }
+    }
     const char *p = (const char *)buf.buf;
     const char *end = p + buf.len;
     uint32_t ver = 0, n = 0, width = 0;
@@ -5111,32 +5176,154 @@ PyObject *deltas_decode(PyObject *, PyObject *args)
         PyBuffer_Release(&buf);
         return nullptr;
     }
-    for (uint32_t i = 0; i < n; i++) {
-        unsigned __int128 k;
-        memcpy(&k, keys_p + (size_t)i * 16, 16);
-        int32_t diff;
-        memcpy(&diff, diffs_p + (size_t)i * 4, 4);
-        PyObject *key = pointer_from_u128(ptr_type, k);
-        if (key == nullptr)
-            goto fail;
-        PyObject *row = PyTuple_New((Py_ssize_t)width);
-        if (row == nullptr) {
-            Py_DECREF(key);
-            goto fail;
+    {
+        /* wire interning (ISSUE 13): retraction-bearing gather streams
+         * (materialized groupby/capture output to rank 0) repeat a
+         * small vocabulary — every update ships a retract+insert pair
+         * for the same key, and the same group keys/strings recur
+         * commit after commit. Minting a fresh Pointer (a Python int
+         * subclass constructed via its type object) and a fresh
+         * PyUnicode per row made deltas_decode the receiver's hottest
+         * leg (~0.5M deltas/s, half of it Pointer.__new__). A per-call
+         * cache keyed by the raw 16-byte key / arena slice reuses the
+         * object for every recurrence — per-CALL, not global, so an
+         * unbounded vocabulary cannot pin memory past its frame. Cache
+         * entries hold one strong ref each, released below. */
+        std::unordered_map<unsigned __int128, PyObject *, WireU128H>
+            local_k;
+        std::unordered_map<std::string, PyObject *> local_s;
+        /* an attached cross-frame cache (capsule arg — one per
+         * procgroup receiver thread) replaces the per-call maps: the
+         * gather vocabulary recurs commit after commit, which a
+         * per-frame cache cannot see */
+        auto &kcache = xc != nullptr ? xc->keys : local_k;
+        auto &scache = xc != nullptr ? xc->strs : local_s;
+        /* insertion cap: a high-cardinality stream (distinct keys per
+         * row — nothing recurs) must not grow 400k-entry maps it never
+         * hits; past the cap the CROSS-FRAME cache epoch-resets (the
+         * vocabulary changed) while the per-call cache just stops
+         * inserting */
+        const size_t CACHE_CAP = xc != nullptr ? xc->cap : (1u << 16);
+        if (xc == nullptr) {
+            kcache.reserve(std::min((size_t)n, CACHE_CAP));
+            scache.reserve(std::min((size_t)n, CACHE_CAP));
         }
-        for (uint32_t c = 0; c < width; c++) {
-            PyObject *v = nb_cell_to_py(cols[c], (Py_ssize_t)i);
-            if (v == nullptr) {
+        /* adaptive (per-call mode only): a high-cardinality stream
+         * never hits — probe a prefix and drop the caches when the
+         * recurrence isn't there. The cross-frame cache skips the
+         * probe: its whole point is recurrence ACROSS frames that the
+         * prefix cannot see. */
+        const uint32_t PROBE_ROWS = 4096;
+        bool interning = xc != nullptr || n > 64;
+        uint64_t khits = 0;
+        bool failed = false;
+        for (uint32_t i = 0; i < n && !failed; i++) {
+            unsigned __int128 k;
+            memcpy(&k, keys_p + (size_t)i * 16, 16);
+            int32_t diff;
+            memcpy(&diff, diffs_p + (size_t)i * 4, 4);
+            if (xc == nullptr && interning && i == PROBE_ROWS &&
+                khits < PROBE_ROWS / 8) {
+                /* no recurrence in the probe window: stop paying */
+                interning = false;
+                for (auto &kv : kcache)
+                    Py_DECREF(kv.second);
+                for (auto &kv : scache)
+                    Py_DECREF(kv.second);
+                kcache.clear();
+                scache.clear();
+            }
+            PyObject *key;
+            auto kit = interning ? kcache.find(k) : kcache.end();
+            if (kit != kcache.end()) {
+                key = kit->second;
+                khits++;
+                Py_INCREF(key);
+            } else {
+                key = pointer_from_u128(ptr_type, k);
+                if (key == nullptr) {
+                    failed = true;
+                    break;
+                }
+                if (interning) {
+                    if (kcache.size() >= CACHE_CAP && xc != nullptr)
+                        xc->clear_refs(); /* epoch reset */
+                    if (kcache.size() < CACHE_CAP) {
+                        Py_INCREF(key); /* the cache's ref */
+                        kcache.emplace(k, key);
+                    }
+                }
+            }
+            PyObject *row = PyTuple_New((Py_ssize_t)width);
+            if (row == nullptr) {
+                Py_DECREF(key);
+                failed = true;
+                break;
+            }
+            for (uint32_t c = 0; c < width; c++) {
+                const NbCol &col = cols[c];
+                PyObject *v;
+                if (col.tag[(size_t)i] == NB_STR) {
+                    std::string sv(
+                        col.arena.data() + (size_t)col.word[(size_t)i],
+                        (size_t)col.len[(size_t)i]);
+                    auto sit = interning ? scache.find(sv) : scache.end();
+                    if (sit != scache.end()) {
+                        v = sit->second;
+                        Py_INCREF(v);
+                    } else {
+                        v = PyUnicode_FromStringAndSize(
+                            sv.data(), (Py_ssize_t)sv.size());
+                        if (v != nullptr && interning) {
+                            if (scache.size() >= CACHE_CAP &&
+                                xc != nullptr)
+                                xc->clear_refs();
+                            if (scache.size() < CACHE_CAP) {
+                                Py_INCREF(v); /* the cache's ref */
+                                scache.emplace(std::move(sv), v);
+                            }
+                        }
+                    }
+                } else {
+                    v = nb_cell_to_py(col, (Py_ssize_t)i);
+                }
+                if (v == nullptr) {
+                    Py_DECREF(key);
+                    Py_DECREF(row);
+                    failed = true;
+                    break;
+                }
+                PyTuple_SET_ITEM(row, (Py_ssize_t)c, v);
+            }
+            if (failed)
+                break;
+            /* direct 3-tuple build: Py_BuildValue("(NNi)") re-parses
+             * its format string per row — measurable at 400k rows */
+            PyObject *d = PyLong_FromLong((long)diff);
+            PyObject *t = d ? PyTuple_New(3) : nullptr;
+            if (t == nullptr) {
+                Py_XDECREF(d);
                 Py_DECREF(key);
                 Py_DECREF(row);
-                goto fail;
+                failed = true;
+                break;
             }
-            PyTuple_SET_ITEM(row, (Py_ssize_t)c, v);
+            PyTuple_SET_ITEM(t, 0, key);
+            PyTuple_SET_ITEM(t, 1, row);
+            PyTuple_SET_ITEM(t, 2, d);
+            PyList_SET_ITEM(out, (Py_ssize_t)i, t);
         }
-        PyObject *t = Py_BuildValue("(NNi)", key, row, (int)diff);
-        if (t == nullptr)
+        if (xc == nullptr) {
+            /* per-call mode: release the maps' refs; a cross-frame
+             * cache keeps its entries for the link's next frame (the
+             * capsule destructor releases them) */
+            for (auto &kv : kcache)
+                Py_DECREF(kv.second);
+            for (auto &kv : scache)
+                Py_DECREF(kv.second);
+        }
+        if (failed)
             goto fail;
-        PyList_SET_ITEM(out, (Py_ssize_t)i, t);
     }
     PyBuffer_Release(&buf);
     return out;
@@ -5745,6 +5932,46 @@ PyObject *trace_ring_drain(PyObject *, PyObject *)
     return out;
 }
 
+/* ---- wire_entropy: auto-codec compressibility probe (ISSUE 13) ------
+ * Sampled Shannon entropy (bits/byte) of a buffer: the fast-wire auto
+ * mode skips the compressor on blobs whose byte distribution says they
+ * will not shrink (random floats, pre-compressed payloads) — the probe
+ * must cost microseconds where the codec would cost milliseconds.
+ * Samples up to 64 KiB at an even stride, GIL-free. */
+
+PyObject *wire_entropy(PyObject *, PyObject *args)
+{
+    Py_buffer buf;
+    if (!PyArg_ParseTuple(args, "y*", &buf))
+        return nullptr;
+    double bits = 0.0;
+    Py_BEGIN_ALLOW_THREADS;
+    {
+        const unsigned char *p = (const unsigned char *)buf.buf;
+        const size_t n = (size_t)buf.len;
+        const size_t max_sample = 64 * 1024;
+        const size_t stride = n > max_sample ? n / max_sample : 1;
+        uint64_t hist[256] = {0};
+        uint64_t total = 0;
+        for (size_t i = 0; i < n; i += stride) {
+            hist[p[i]]++;
+            total++;
+        }
+        if (total > 1) {
+            const double inv = 1.0 / (double)total;
+            for (int b = 0; b < 256; b++) {
+                if (hist[b]) {
+                    const double f = (double)hist[b] * inv;
+                    bits -= f * std::log2(f);
+                }
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&buf);
+    return PyFloat_FromDouble(bits);
+}
+
 PyMethodDef methods[] = {
     {"wp_new", wp_new, METH_VARARGS,
      "wp_new(cache_size) -> wordpiece memo capsule"},
@@ -5797,6 +6024,9 @@ PyMethodDef methods[] = {
      "(stable_shard-parity columnar partition, GIL-free)"},
     {"nb_encode", nb_encode, METH_VARARGS,
      "nb_encode(nb) -> bytes (exchange v2 typed columnar buffer)"},
+    {"wire_entropy", wire_entropy, METH_VARARGS,
+     "wire_entropy(buffer) -> sampled Shannon entropy in bits/byte "
+     "(fast-wire auto-codec compressibility probe, GIL-free)"},
     {"nb_decode", nb_decode, METH_VARARGS,
      "nb_decode(buffer, ptr_type) -> NativeBatch"},
     {"nb_concat", nb_concat, METH_VARARGS,
@@ -5805,7 +6035,13 @@ PyMethodDef methods[] = {
      "deltas_encode(deltas) -> bytes | None (typed columnar buffer for "
      "retraction-bearing slices; None = non-scalar cells, pickle instead)"},
     {"deltas_decode", deltas_decode, METH_VARARGS,
-     "deltas_decode(buffer, ptr_type) -> [(key, row, diff), ...]"},
+     "deltas_decode(buffer, ptr_type[, intern]) -> [(key, row, diff), "
+     "...]; intern = intern_new() capsule for cross-frame key/string "
+     "reuse (one per receiver link)"},
+    {"intern_new", intern_new, METH_VARARGS,
+     "intern_new([capacity]) -> wire intern-cache capsule "
+     "(cross-frame Pointer/str reuse for deltas_decode; epoch-resets "
+     "at capacity)"},
     {"nb_project", nb_project, METH_VARARGS,
      "nb_project(nb, idxs) -> NativeBatch — columnar column projection"},
     {"capture_apply_nb", capture_apply_nb, METH_VARARGS,

@@ -185,6 +185,16 @@ def _analyze_mesh(args) -> int:
         else _env_int("PATHWAY_MESHCHECK_FAULTS", 1)
     )
     cap = _env_int("PATHWAY_MESHCHECK_MAX_STATES", 200_000)
+    # gather-tree topology (ISSUE 13): --mesh-tree overrides, else the
+    # LIVE env (falling back to "auto") — the checker must explore the
+    # topology the real engine would drive, on every doctor path
+    tree_kw = {
+        "tree_knob": (
+            args.mesh_tree
+            if args.mesh_tree is not None
+            else os.environ.get("PATHWAY_MESH_TREE_FANOUT", "auto")
+        )
+    }
     sink_kw = (
         {
             "sink": True,
@@ -216,6 +226,7 @@ def _analyze_mesh(args) -> int:
                             else {}
                         ),
                         **sink_kw,
+                        **tree_kw,
                     )
                 )
             )
@@ -255,6 +266,7 @@ def _analyze_mesh(args) -> int:
                     rescale_to=target,
                     snap_every=1,
                     **sink_kw,
+                    **tree_kw,
                 )
             )
             reports.append(report)
@@ -284,6 +296,7 @@ def _analyze_mesh(args) -> int:
             fault_budget=faults,
             max_states=cap,
             mutate=args.mesh_mutant,
+            tree_knob=args.mesh_tree,
         )
     else:
         report = meshcheck.check(
@@ -293,6 +306,7 @@ def _analyze_mesh(args) -> int:
                 fault_budget=faults,
                 max_states=cap,
                 mutate=args.mesh_mutant,
+                **tree_kw,
             )
         )
     if args.json:
@@ -472,8 +486,14 @@ def main(argv=None) -> int:
         "--mesh-mutant", default=None,
         help="check a deliberately broken protocol variant "
              "(skip_quiesce | accept_dead_epoch | "
-             "drop_rollback_retraction | drop_reshard_shard) — the "
-             "checker must catch it",
+             "drop_rollback_retraction | drop_reshard_shard | "
+             "drop_relay) — the checker must catch it",
+    )
+    parser.add_argument(
+        "--mesh-tree", default=None,
+        help="gather-tree topology to explore (PATHWAY_MESH_TREE_FANOUT "
+             "syntax: auto | off | fanout>=2; default: the live env, "
+             "falling back to auto — tree at world >= 4)",
     )
     parser.add_argument(
         "--sink", action="store_true",

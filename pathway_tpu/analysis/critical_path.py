@@ -70,6 +70,12 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
     waves: dict[tuple, dict[int, dict]] = {}
     mesh_tid0: dict[int, list[dict]] = defaultdict(list)
     decode_s: dict[int, float] = defaultdict(float)
+    # decompress sub-legs (ISSUE 13): the codec's share of each rank's
+    # decode leg, plus the byte ratio over compressed segments — the
+    # trace-side answer to "did compression help"
+    decomp_s: dict[int, float] = defaultdict(float)
+    codec_wire = 0
+    codec_raw = 0
     for e in events:
         if e.get("ph") != "X":
             continue
@@ -86,7 +92,14 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
             }
         elif cat == "mesh":
             name = str(e.get("name", ""))
-            if name.startswith("decode"):
+            if name.startswith("decompress"):
+                # nested inside a decode span on the receiver track:
+                # split out so the decode leg reads codec-vs-merge
+                decomp_s[pid] += e.get("dur", 0.0) / 1e6
+                args = e.get("args") or {}
+                codec_wire += int(args.get("bytes") or 0)
+                codec_raw += int(args.get("raw") or 0)
+            elif name.startswith("decode"):
                 # receiver-thread decodes overlap the engine track:
                 # accounted per rank, not on the wave's critical path
                 decode_s[pid] += e.get("dur", 0.0) / 1e6
@@ -241,12 +254,41 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
                 f"rank {upstream} at {share:.1%} of wave wall"
             )
 
+    # codec verdict suffix (ISSUE 13): join the byte ratio onto the
+    # straggler verdict so "compression helped/hurt" is readable from
+    # one line of --critical-path output
+    codec = None
+    if codec_wire > 0:
+        ratio = codec_raw / codec_wire
+        codec = {
+            "raw_bytes": codec_raw,
+            "wire_bytes": codec_wire,
+            "ratio": round(ratio, 3),
+            "decompress_s": round(sum(decomp_s.values()), 6),
+        }
+        if waves:
+            verdict += (
+                f"; codec ratio {ratio:.2f}x "
+                f"({codec_raw - codec_wire} wire bytes saved, "
+                f"{sum(decomp_s.values()):.4f}s decompress)"
+            )
+    elif waves:
+        verdict += "; compression off (no compressed segments in trace)"
+
     speedup = 1.0
     if wall_total > 0 and balance_save > 0:
         speedup = wall_total / max(1e-12, wall_total - balance_save)
 
     for rank, d in decode_s.items():
-        legs[rank]["decode_s"] = round(d, 6)
+        dz = decomp_s.get(rank, 0.0)
+        # the decode span wraps its decompress sub-span: report the
+        # merge/typed-decode share and the codec share separately
+        legs[rank]["decode_s"] = round(max(0.0, d - dz), 6)
+        if dz:
+            legs[rank]["decompress_s"] = round(dz, 6)
+    for rank, dz in decomp_s.items():
+        if rank not in decode_s:
+            legs[rank]["decompress_s"] = round(dz, 6)
     return {
         "path": path,
         "valid": not problems,
@@ -266,6 +308,7 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
             )
         ],
         "straggler": straggler,
+        "codec": codec,
         "verdict": verdict,
         "speedup_if_balanced": round(speedup, 3),
         "top_waves": wave_rows[:top_waves],
@@ -296,7 +339,19 @@ def render_critical_path(report: dict) -> str:
                     if "decode_s" in d
                     else ""
                 )
+                + (
+                    f" decompress={d['decompress_s']:.4f}"
+                    if "decompress_s" in d
+                    else ""
+                )
             )
+    c = report.get("codec")
+    if c:
+        lines.append(
+            f"  codec: {c['raw_bytes']} raw -> {c['wire_bytes']} wire "
+            f"bytes ({c['ratio']:.2f}x), "
+            f"{c['decompress_s']:.4f}s decompress"
+        )
     if report["wait_matrix"]:
         lines.append("  recv-wait matrix (rank waits on upstream):")
         for cell in report["wait_matrix"][:8]:

@@ -231,8 +231,39 @@ KNOBS: dict[str, Knob] = {
            "Hard deadline on every mesh collective (0 disables).",
            lo=0, hi=86400),
         _k("PATHWAY_MESH_MAX_FRAME_MB", "int", 256,
-           "Receiver-side cap on a single exchange frame.", lo=1,
-           hi=65536),
+           "Receiver-side cap on a single exchange frame, per ORIGIN "
+           "rank: on tree-gather meshes the effective cap scales by "
+           "the largest subtree span, since a relayed frame "
+           "legitimately aggregates its whole subtree's slices.",
+           lo=1, hi=65536),
+        # -- fast wire (ISSUE 13) -----------------------------------------
+        _k("PATHWAY_MESH_COMPRESSION", "enum", "auto",
+           "Per-blob compression of exchange frames, negotiated at the "
+           "mesh handshake: off | zlib (stdlib, always available) | "
+           "lz4 | zstd (used when importable) | auto (best common "
+           "codec, with an entropy probe skipping incompressible "
+           "blobs). CRC is verified over the wire image before any "
+           "decompression.",
+           choices=("off", "zlib", "lz4", "zstd", "auto")),
+        _k("PATHWAY_MESH_COMPRESS_MIN_BYTES", "int", 512,
+           "Blobs below this size skip the codec entirely (tiny frames "
+           "cost more to compress than to ship).", lo=0,
+           hi=1_000_000_000),
+        _k("PATHWAY_MESH_TREE_FANOUT", "str", "auto",
+           "Gather-leg topology of the exchange wave engine: 'auto' "
+           "(k=2 reduction tree at world >= 4), 'off' (flat, every "
+           "sender ships straight to rank 0), or an integer fanout "
+           ">= 2."),
+        _k("PATHWAY_MESH_SEND_QUEUE", "int", None,
+           "Bounded per-peer sender-thread queue (frames): exchange "
+           "sends are encoded+compressed and drained off the engine "
+           "loop so the native executor keeps applying while frames "
+           "ship; a full queue blocks the producer (backpressure). "
+           "0 = synchronous sends on the engine thread. Default: "
+           "adaptive — 8 when the host has at least 2 cores per local "
+           "rank (the threads have somewhere to run), else 0 (on a "
+           "saturated host the per-frame GIL handoff would sit on "
+           "every wave's critical path).", lo=0, hi=4096),
         _k("PATHWAY_MESH_SUPERVISED", "bool", False,
            "Exit MESH_RESTART_EXIT_CODE on mesh failure so the "
            "supervisor can roll the epoch back."),

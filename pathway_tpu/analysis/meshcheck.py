@@ -144,6 +144,13 @@ class Transitions:
         # pending units — the exact functions io/txn.py's sinks drive
         "sink_may_finalize",
         "sink_recover",
+        # fast wire (ISSUE 13): the gather-tree topology resolution and
+        # the interior-rank relay decision — the exact functions the
+        # wave engine drives (wave_send_targets/wave_recv_sources take
+        # the resolved fanout; tree_relay folds children's slices into
+        # the parent frame)
+        "tree_fanout",
+        "tree_relay",
     )
 
     def __init__(self, overrides: dict | None = None, *, model_flags=()):
@@ -196,9 +203,21 @@ def _mutant_finalize_before_marker(unit_tag, marker_tag):
     return True
 
 
+def _mutant_drop_relay(own_entries, relayed_entries):
+    """Broken tree relay (ISSUE 13): an interior rank of the gather
+    tree forwards only its OWN slices, silently dropping everything its
+    children shipped through it — whole subtrees' deltas vanish before
+    rank 0 ever sees them. Invisible on flat topologies (there is no
+    relay) and on worlds too small to have interior ranks, which is why
+    the checker must explore the tree transition itself."""
+    return list(own_entries)
+
+
 def get_transitions(mutate: str | None = None) -> Transitions:
     if mutate is None:
         return Transitions()
+    if mutate == "drop_relay":
+        return Transitions({"tree_relay": _mutant_drop_relay})
     if mutate == "skip_quiesce":
         return Transitions({"wave_partition": _mutant_skip_quiesce})
     if mutate == "accept_dead_epoch":
@@ -214,13 +233,13 @@ def get_transitions(mutate: str | None = None) -> Transitions:
     raise ValueError(
         f"unknown mutant {mutate!r}; known: skip_quiesce, "
         "accept_dead_epoch, drop_rollback_retraction, "
-        "drop_reshard_shard, finalize_before_marker"
+        "drop_reshard_shard, finalize_before_marker, drop_relay"
     )
 
 
 MUTANT_NAMES = (
     "skip_quiesce", "accept_dead_epoch", "drop_rollback_retraction",
-    "drop_reshard_shard", "finalize_before_marker",
+    "drop_reshard_shard", "finalize_before_marker", "drop_relay",
 )
 
 
@@ -396,6 +415,14 @@ class MeshCheckConfig:
     # across rollbacks AND rescales. Composes with rescale_to: pending
     # partitions of a dead world are re-owned through shard_owner.
     sink: bool = False
+    # fast wire (ISSUE 13): the raw PATHWAY_MESH_TREE_FANOUT knob value
+    # the model resolves per CURRENT world through the shared
+    # protocol.tree_fanout transition — the default "auto" matches the
+    # engine's default, so a 4-rank doctor pass explores exactly the
+    # tree topology a 4-rank run drives (and a rescale across the
+    # world-4 boundary flips the topology in the model exactly when it
+    # flips in the engine).
+    tree_knob: str | None = "auto"
     # partial-order reduction strength. Per-rank macro-steps pairwise
     # commute (disjoint rank state, append-only per-link sends, disjoint
     # sink keys), so "persistent" explores only the lowest-ranked rank's
@@ -1161,7 +1188,28 @@ class MeshModel:
         )
         contrib = contrib_mask if wave_no == 1 else None
         world = len(state.ranks)
-        targets = self.t.wave_send_targets(world, r, gather_only, contrib)
+        fanout = self.t.tree_fanout(world, self.cfg.tree_knob)
+        targets = self.t.wave_send_targets(
+            world, r, gather_only, contrib, fanout
+        )
+        expect = tuple(
+            self.t.wave_recv_sources(
+                world, r, gather_only, contrib, fanout
+            )
+        )
+        if gather_only and fanout >= 2 and world > 2:
+            # tree-gather wave (ISSUE 13): recv-before-send — the
+            # parent frame (own + relayed slices, protocol.tree_relay)
+            # ships in _finish_wave once every child has been heard;
+            # tree edges form a DAG toward rank 0, so the inverted
+            # order cannot deadlock
+            rs = rs._replace(
+                pc=(
+                    "wave_recv", plan, idx, remaining, pending, wave_no,
+                    expect, (),
+                )
+            )
+            return _set_rank(state, r, rs)
         pend = dict(pending)
         links = state.links
         for peer in targets:
@@ -1178,9 +1226,6 @@ class MeshModel:
                 links, r, peer,
                 Frame("xw", rs.epoch, t, wave_no, tuple(slices)),
             )
-        expect = tuple(
-            self.t.wave_recv_sources(world, r, gather_only, contrib)
-        )
         rs = rs._replace(
             pc=(
                 "wave_recv", plan, idx, remaining, pending, wave_no,
@@ -1228,6 +1273,63 @@ class MeshModel:
         )
         return _set_rank(state, r, rs)
 
+    def _relay_tree_wave(self, state: State, r: int) -> State:
+        """Interior/leaf rank of a tree-gather wave, children all heard:
+        fold own + relayed slices into ONE frame to the tree parent
+        (the shared ``tree_relay`` transition — the ``drop_relay``
+        mutant breaks it here) and move to the next wave. Nothing
+        delivers locally: every token of a gather wave is in transit to
+        rank 0."""
+        rs = state.ranks[r]
+        world = len(state.ranks)
+        (_op, plan, idx, remaining, pending, wave_no, _expect, got) = rs.pc
+        t, _xm, contrib_mask = plan[idx]
+        wave = self._wave_of(remaining)
+        wave_set = set(wave)
+        fanout = self.t.tree_fanout(world, self.cfg.tree_knob)
+        contrib = contrib_mask if wave_no == 1 else None
+        pend = {x: list(v) for x, v in pending}
+        own = []
+        for x in sorted(wave):
+            toks = tuple(
+                tok
+                for tok, hop in pend.pop(x, ())
+                if self.hop_dest(tok.hops[hop][1], world) == 0
+            )
+            if toks:
+                own.append((x, toks))
+        relayed = []
+        for frame in got:
+            for x, toks in frame.slices:
+                if x not in wave_set:
+                    raise _PropertyViolation(
+                        "wave-desync",
+                        f"rank {r} relayed exchange {x} outside wave "
+                        f"{sorted(wave)}",
+                    )
+                if toks:
+                    relayed.append((x, toks))
+        links = state.links
+        for peer in self.t.wave_send_targets(
+            world, r, True, contrib, fanout
+        ):
+            links = _push_frame(
+                links, r, peer,
+                Frame(
+                    "xw", rs.epoch, t, wave_no,
+                    tuple(self.t.tree_relay(own, relayed)),
+                ),
+            )
+        new_remaining = remaining - wave_set
+        rs = rs._replace(
+            pc=(
+                "wave_send", plan, idx, new_remaining,
+                tuple(sorted((x, tuple(v)) for x, v in pend.items() if v)),
+                wave_no + 1,
+            )
+        )
+        return _set_rank(state._replace(links=links), r, rs)
+
     def _finish_wave(self, state: State, r: int) -> State:
         """All expected frames arrived: deliver this wave's tokens
         (apply at hash dests, sink at final hops), run the cascade
@@ -1236,6 +1338,12 @@ class MeshModel:
         world = len(state.ranks)
         (_op, plan, idx, remaining, pending, wave_no, _expect, got) = rs.pc
         wave = self._wave_of(remaining)
+        if r != 0 and all(
+            self.topology[x].mode == "gather" for x in wave
+        ) and self.t.tree_fanout(world, self.cfg.tree_knob) >= 2 \
+                and world > 2:
+            # tree-gather wave on a non-root rank: relay, don't deliver
+            return self._relay_tree_wave(state, r)
         pend = {x: list(v) for x, v in pending}
         # delivered[x] = tokens this rank received/kept for wave member x
         delivered: dict[int, list] = {x: [] for x in wave}
@@ -2032,20 +2140,28 @@ def check_runtime_mesh(
     fault_budget: int = 1,
     max_states: int | None = None,
     mutate: str | None = None,
+    tree_knob: str | None = None,
 ) -> MeshCheckReport:
     """The Plan Doctor's distributed-safety pass: model-check the
     *actual lowered plan's* exchange topology at ``processes`` ranks,
     so a user gets a deadlock/divergence/exactly-once verdict before
-    ever launching a real N-rank mesh."""
+    ever launching a real N-rank mesh. ``tree_knob`` defaults to the
+    live PATHWAY_MESH_TREE_FANOUT environment, so the doctor explores
+    the gather topology (flat or tree) the real run would drive."""
+    import os as _os
+
     topology = topology_from_runtime(runtime)
     if not topology:
         topology = canonical_topology()
+    if tree_knob is None:
+        tree_knob = _os.environ.get("PATHWAY_MESH_TREE_FANOUT", "auto")
     cfg = MeshCheckConfig(
         world=processes,
         rounds=rounds,
         fault_budget=fault_budget,
         topology=topology,
         mutate=mutate,
+        tree_knob=tree_knob,
         **(
             {"max_states": max_states} if max_states is not None else {}
         ),

@@ -608,18 +608,22 @@ class ExchangeNode(Node):
         tag = ("xw", time, ("s", self.node_id))
         stats = self.scope.runtime.stats
         gather = self.mode == "gather"
-        enc_cache: dict = {}
+        # same framing + compression + accounting as the wave engine
+        # (send_exchange compresses per the link's negotiated codec and
+        # feeds the frame/byte/compression counters itself), so a plan
+        # that falls off the planned walk cannot silently lose the
+        # compression knob or go dark on the byte matrix (ISSUE 13).
+        # Topology stays flat here: the solo rendezvous is the generic
+        # fallback, the tree path belongs to the wave engine.
+        enc_cache = pg.make_enc_cache()
         for peer in range(pg.world):
             if peer == pg.rank or (gather and peer != 0):
                 continue
             ent = sends.get(peer)
-            stats.on_exchange_frame(
-                pg.send_exchange(
-                    peer, tag,
-                    [(self.node_id, ent)] if ent is not None else [],
-                    enc_cache,
-                ),
-                peer,
+            pg.send_exchange(
+                peer, tag,
+                [(self.node_id, ent)] if ent is not None else [],
+                enc_cache,
             )
         parts = []
         dl = pg.op_deadline()  # one deadline for the whole rendezvous

@@ -145,6 +145,9 @@ class Runtime:
         self.trace_summary: dict | None = None
         # multi-process (PATHWAY_PROCESSES>1): TCP mesh + lockstep state
         self._procgroup = None
+        # gather-tree fanout (ISSUE 13), resolved lazily against the
+        # mesh's world size through protocol.tree_fanout (None = not yet)
+        self._tree_fanout: int | None = None
         self._lockstep_seq = 0
         self._reach_masks: list[int] | None = None
         # rank bitmask of the current timestamp's frontier contributors
@@ -203,6 +206,14 @@ class Runtime:
                 # this incarnation exists because a supervisor rolled the
                 # mesh back: count the restart on the recovery path
                 self.stats.on_mesh_rank_restart()
+            # gather-tree topology gauge (ISSUE 13): depth 0 = flat
+            # (_procgroup is already assigned, so the shared resolver
+            # cannot re-enter this property)
+            self.stats.set_tree_depth(
+                _proto.tree_depth(
+                    self._procgroup.world, self._gather_tree_fanout()
+                )
+            )
         return self._procgroup
 
     def _exchange_reach_masks(self) -> list[int]:
@@ -541,13 +552,35 @@ class Runtime:
             remaining.difference_update(wave)
         return comms
 
+    def _gather_tree_fanout(self) -> int:
+        """Resolved PATHWAY_MESH_TREE_FANOUT for this mesh (0 = flat),
+        through the shared protocol transition the model checker
+        explores."""
+        f = self._tree_fanout
+        if f is None:
+            import os as _os
+
+            f = self._tree_fanout = _proto.tree_fanout(
+                self.procgroup.world,
+                _os.environ.get("PATHWAY_MESH_TREE_FANOUT"),
+            )
+        return f
+
     def _run_exchange_wave(self, time: int, seq, wave: list[int]) -> None:
         """One coalesced rendezvous: slice every wave exchange locally,
         ship ONE typed-columnar frame per peer carrying all their slices
         (presence header elides the empty ones), then merge received
         parts and deliver downstream in node-id order. Receiver threads
-        decode incoming frames concurrently, so peers' columnar decodes
-        overlap this rank's merges."""
+        decompress+decode incoming frames as they land and sender
+        threads drain outgoing frames (procgroup), so comms overlaps
+        this rank's merges and the next compute leg.
+
+        Pure-gather waves route over the k-ary reduction tree when
+        PATHWAY_MESH_TREE_FANOUT resolves one (auto at world >= 4):
+        each rank first receives its tree children's frames, folds the
+        relayed slices into its own parent frame (protocol.tree_relay),
+        and rank 0 — the only rank that delivers — ingests fanout
+        frames per wave instead of world-1."""
         pg = self.procgroup
         nodes = self.scope.nodes
         stats = self.stats
@@ -586,45 +619,94 @@ class Runtime:
         # wave_recv_sources mirror each other exactly — an asymmetry is
         # a deadlock, which is why the model checker owns the predicate)
         contrib = self._exchange_contrib if seq == 1 else None
+        fanout = self._gather_tree_fanout()
+        use_tree = gather_only and fanout >= 2 and pg.world > 2
         targets = _proto.wave_send_targets(
-            pg.world, pg.rank, gather_only, contrib
+            pg.world, pg.rank, gather_only, contrib, fanout
         )
-        stats.on_exchange_elided(pg.world - 1 - len(targets))
-        enc_cache: dict = {}  # broadcast sides: encode once, ship world-1x
-        for peer in targets:
-            entries = []
-            for nid, _own, sends in prepared:
-                ent = sends.get(peer)
-                if ent is not None:
-                    entries.append((nid, ent))
-            t_send0 = _time.perf_counter_ns() if rec is not None else 0
-            nbytes = pg.send_exchange(peer, tag, entries, enc_cache)
-            stats.on_exchange_frame(nbytes, peer)
-            if rec is not None:
-                rec.note_send(
-                    peer, t_send0, _time.perf_counter_ns(), nbytes
-                )
+        sources = _proto.wave_recv_sources(
+            pg.world, pg.rank, gather_only, contrib, fanout
+        )
+        if not use_tree:
+            # tree legs are topology, not emptiness — only flat waves
+            # count absent legs as elided
+            stats.on_exchange_elided(pg.world - 1 - len(targets))
+        enc_cache = pg.make_enc_cache()
         received: dict[int, list] = {nid: [] for nid, _o, _s in prepared}
+        relay: list = []
         wave_dl = pg.op_deadline()  # one deadline for the whole wave
-        for peer in _proto.wave_recv_sources(
-            pg.world, pg.rank, gather_only, contrib
-        ):
+
+        def _recv_from(peer: int, recv_tag=None) -> None:
             # always timed (not only under the recorder): per-peer
             # recv-wait feeds the cluster plane's straggler attribution
             # and the mesh_skew_seconds derivation on /metrics
             t_recv0 = _time.perf_counter_ns()
-            for nid, part in pg.recv(peer, tag, deadline=wave_dl):
+            for nid, part in pg.recv(
+                peer, tag if recv_tag is None else recv_tag,
+                deadline=wave_dl,
+            ):
                 if nid not in received:
                     raise RuntimeError(
                         f"rank {pg.rank}: exchange wave desync — peer "
                         f"{peer} sent node {nid} outside wave {wave} at "
                         f"time {time}"
                     )
-                received[nid].append(part)
+                if use_tree and pg.rank != 0:
+                    # interior tree rank: these slices are in transit to
+                    # rank 0 — fold them into our parent frame below
+                    relay.append((nid, part))
+                else:
+                    received[nid].append(part)
             t_recv1 = _time.perf_counter_ns()
             stats.on_exchange_recv_wait(peer, (t_recv1 - t_recv0) / 1e9)
             if rec is not None:
                 rec.note_recv_wait(peer, t_recv0, t_recv1)
+
+        if use_tree:
+            # tree gather: children first (their frames carry the
+            # subtree's slices), then ONE frame up to the parent with
+            # own + relayed slices — recv-before-send is deadlock-free
+            # here because tree edges form a DAG toward rank 0. Frames
+            # whose DESTINATION is an interior rank ride the relay tag
+            # ("xwr", ...): the receiver keeps their segments as wire
+            # bytes (procgroup.RawSegment) and forwards them verbatim —
+            # no decompress / typed decode / re-encode on the way up,
+            # so a slice inflates exactly once, at rank 0
+            relay_tag = ("xwr",) + tag[1:]
+            for peer in sources:
+                _recv_from(
+                    peer, relay_tag if pg.rank != 0 else tag
+                )
+            if targets:
+                own_entries = [
+                    (nid, ent)
+                    for nid, _own, sends in prepared
+                    if (ent := sends.get(0)) is not None
+                ]
+                parent = targets[0]
+                # route_dest=0: every tree-wave slice terminates at
+                # rank 0 and is relayed verbatim past the next hop, so
+                # compression must target rank 0's advertised codecs
+                pg.send_exchange(
+                    parent,
+                    tag if parent == 0 else relay_tag,
+                    _proto.tree_relay(own_entries, relay),
+                    enc_cache,
+                    route_dest=0,
+                )
+        else:
+            for peer in targets:
+                entries = []
+                for nid, _own, sends in prepared:
+                    ent = sends.get(peer)
+                    if ent is not None:
+                        entries.append((nid, ent))
+                # frame/byte/compression accounting + the recorder's
+                # send span land inside procgroup (sender threads ship
+                # asynchronously; the engine only enqueues)
+                pg.send_exchange(peer, tag, entries, enc_cache)
+            for peer in sources:
+                _recv_from(peer)
         for nid, own, _sends in prepared:
             node = nodes[nid]
             out = node.finish_exchange(own, received[nid])

@@ -63,8 +63,15 @@ PASSTHROUGH_FAMILIES = (
     "output_rows_total",
     "exchange_frames_total",
     "exchange_bytes_total",
+    # fast wire (ISSUE 13): frame bytes before/after the wire codec —
+    # the cross-rank compression-effectiveness story
+    "exchange_uncompressed_bytes_total",
+    "exchange_compressed_bytes_total",
     "exchange_peer_frames_total",
     "exchange_peer_bytes_total",
+    "exchange_peer_uncompressed_bytes_total",
+    "exchange_peer_compressed_bytes_total",
+    "mesh_tree_depth",
     "exchange_comms_seconds_total",
     "exchange_compute_seconds_total",
     "exchange_recv_wait_seconds_total",
@@ -475,7 +482,10 @@ class ClusterMetricsAggregator:
                 samples = by_family.get(name)
                 if samples:
                     kind = (
-                        "gauge" if name == "mesh_last_committed_epoch"
+                        "gauge"
+                        if name in (
+                            "mesh_last_committed_epoch", "mesh_tree_depth"
+                        )
                         else "counter"
                     )
                     lines.append(f"# TYPE {name} {kind}")

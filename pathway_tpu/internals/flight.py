@@ -224,6 +224,14 @@ class FlightRecorder:
         # called from procgroup receiver threads (append is GIL-atomic)
         self._note(("decode", peer, t0, t1, nbytes))
 
+    def note_decompress(self, peer, t0, t1, wire_bytes, raw_bytes) -> None:
+        # receiver-thread sub-span of a frame decode (ISSUE 13): the
+        # codec's share of the decode leg plus its byte ratio. The span
+        # is synthetic-contiguous (per-segment inflations interleave
+        # with segment decodes; duration is exact, placement starts at
+        # the first inflation).
+        self._note(("dzip", peer, t0, t1, wire_bytes, raw_bytes))
+
     def note_mark(self, name: str, **args: Any) -> None:
         self._note(("mark", name, _time.perf_counter_ns(), args))
 
@@ -418,11 +426,16 @@ class FlightRecorder:
                     }
                 )
             elif kind == "send":
+                # sender-thread track (ISSUE 13): sends drain off the
+                # engine loop, so their spans overlap node/wave spans —
+                # a dedicated per-peer track keeps every track's spans
+                # properly nested for the schema check
                 _, peer, t0, t1, nbytes = ev
+                tid = tid_named(300 + peer, f"send peer {peer}")
                 out.append(
                     {
                         "name": f"send→{peer}", "cat": "mesh", "ph": "X",
-                        "pid": pid, "tid": 0, "ts": self._us(t0),
+                        "pid": pid, "tid": tid, "ts": self._us(t0),
                         "dur": _dur_us(t0, t1),
                         "args": {"bytes": nbytes, "peer": peer},
                     }
@@ -447,6 +460,22 @@ class FlightRecorder:
                         "pid": pid, "tid": tid, "ts": self._us(t0),
                         "dur": _dur_us(t0, t1),
                         "args": {"bytes": nbytes, "peer": peer},
+                    }
+                )
+            elif kind == "dzip":
+                # decompress sub-span, nested inside its frame's decode
+                # span on the same receiver track (ISSUE 13)
+                _, peer, t0, t1, wire_b, raw_b = ev
+                tid = tid_named(200 + peer, f"recv peer {peer}")
+                out.append(
+                    {
+                        "name": f"decompress←{peer}", "cat": "mesh",
+                        "ph": "X", "pid": pid, "tid": tid,
+                        "ts": self._us(t0),
+                        "dur": _dur_us(t0, t1),
+                        "args": {
+                            "peer": peer, "bytes": wire_b, "raw": raw_b,
+                        },
                     }
                 )
             elif kind == "mark":

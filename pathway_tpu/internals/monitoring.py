@@ -213,6 +213,26 @@ class ProberStats:
     # wave accounting: completed exchange waves and their wall seconds
     exchange_waves: int = 0
     exchange_wave_s: float = 0.0
+    # fast wire (ISSUE 13): frame bytes before/after the per-blob codec
+    # (procgroup._frame_send feeds BOTH paths — wave engine and the
+    # generic topo-loop fallback — so a fallback run can never report a
+    # phantom compression state; when the link negotiates no codec the
+    # two totals advance in lockstep and the ratio reads an honest 1.0)
+    exchange_raw_bytes: int = 0
+    exchange_wire_bytes: int = 0
+    # peer -> [raw, wire]: per-link codec effectiveness for the cluster
+    # byte matrix (bounded: world-1 peers)
+    exchange_comp_peer: dict = field(default_factory=dict)
+    # frame accounting lock (ISSUE 13): several per-peer sender threads
+    # feed the frame/byte counters concurrently; unguarded `+=` could
+    # drop increments and make raw/wire diverge on an uncompressed
+    # link, breaking the honest-off raw==wire contract lane 12 asserts
+    _frame_lock: object = field(
+        default_factory=threading.Lock, repr=False
+    )
+    # gather-tree depth of the exchange topology (protocol.tree_depth;
+    # 0 = flat) — a gauge, set once per mesh join
+    mesh_tree_depth: int = 0
     # event-loop idle: seconds the main loop spent blocked on an empty
     # connector queue (per-rank comms/compute/idle on the cluster view)
     idle_s: float = 0.0
@@ -304,6 +324,12 @@ class ProberStats:
         self.mesh_last_committed_epoch = epoch
 
     def on_exchange_frame(self, nbytes: int, peer: int | None = None) -> None:
+        with self._frame_lock:
+            self._on_exchange_frame_locked(nbytes, peer)
+
+    def _on_exchange_frame_locked(
+        self, nbytes: int, peer: int | None
+    ) -> None:
         self.exchange_frames += 1
         self.exchange_bytes += nbytes
         if peer is not None:
@@ -312,6 +338,29 @@ class ProberStats:
                 slot = self.exchange_peer[peer] = [0, 0]
             slot[0] += 1
             slot[1] += nbytes
+
+    def on_exchange_compression(
+        self, peer: int, raw_bytes: int, wire_bytes: int
+    ) -> None:
+        """One exchange frame's byte accounting before/after the wire
+        codec (raw == wire when the link ships raw). Called from
+        several sender threads concurrently — lock-guarded so no
+        increment is lost and raw/wire can never diverge on an
+        uncompressed link."""
+        with self._frame_lock:
+            self.exchange_raw_bytes += raw_bytes
+            self.exchange_wire_bytes += wire_bytes
+            if peer is not None:
+                slot = self.exchange_comp_peer.get(peer)
+                if slot is None:
+                    slot = self.exchange_comp_peer[peer] = [0, 0]
+                slot[0] += raw_bytes
+                slot[1] += wire_bytes
+
+    def set_tree_depth(self, depth: int) -> None:
+        """Gauge: gather-tree depth of this mesh's exchange topology
+        (0 = flat)."""
+        self.mesh_tree_depth = depth
 
     def on_exchange_recv_wait(self, peer: int, seconds: float) -> None:
         """Seconds this rank blocked in a wave recv on `peer` — per-peer
@@ -440,6 +489,8 @@ class ProberStats:
         for metric, val in (
             ("exchange_frames_total", self.exchange_frames),
             ("exchange_bytes_total", self.exchange_bytes),
+            ("exchange_uncompressed_bytes_total", self.exchange_raw_bytes),
+            ("exchange_compressed_bytes_total", self.exchange_wire_bytes),
             ("exchange_empty_elided_total", self.exchange_empty_elided),
             ("exchange_fallbacks_total", self.exchange_fallbacks),
             ("nb_fallbacks_total", self.nb_fallbacks),
@@ -470,6 +521,21 @@ class ProberStats:
                         f'{metric}{{peer="{peer}"}} '
                         f"{self.exchange_peer[peer][idx]}"
                     )
+        if self.exchange_comp_peer:
+            # per-peer codec effectiveness (ISSUE 13), labeled like the
+            # byte matrix so the cluster aggregator relabels per rank
+            for metric, idx in (
+                ("exchange_peer_uncompressed_bytes_total", 0),
+                ("exchange_peer_compressed_bytes_total", 1),
+            ):
+                lines.append(f"# TYPE {metric} counter")
+                for peer in sorted(self.exchange_comp_peer):
+                    lines.append(
+                        f'{metric}{{peer="{peer}"}} '
+                        f"{self.exchange_comp_peer[peer][idx]}"
+                    )
+        lines.append("# TYPE mesh_tree_depth gauge")
+        lines.append(f"mesh_tree_depth {self.mesh_tree_depth}")
         if self.exchange_peer_wait:
             lines.append(
                 "# TYPE exchange_peer_recv_wait_seconds_total counter"
@@ -721,6 +787,17 @@ def render_dashboard(stats: ProberStats, graveyard=None):
             "comms/compute [s]",
             f"{stats.exchange_comms_s:.2f}/{stats.exchange_compute_s:.2f}",
         )
+        # wire codec line (ISSUE 13): raw vs shipped bytes and the
+        # resulting ratio — "compression helped/hurt" at a glance
+        if stats.exchange_wire_bytes:
+            ratio = stats.exchange_raw_bytes / stats.exchange_wire_bytes
+            pipe.add_row(
+                "exchange raw/wire bytes",
+                f"{stats.exchange_raw_bytes}/{stats.exchange_wire_bytes}"
+                f" ({ratio:.2f}x)",
+            )
+    if stats.mesh_tree_depth:
+        pipe.add_row("gather tree depth", str(stats.mesh_tree_depth))
     pipe.add_row("nb_fallbacks", str(stats.nb_fallbacks))
     if (
         stats.mesh_heartbeats_missed

@@ -36,6 +36,40 @@ fuzz battery in tests/test_native_exchange.py flips/truncates every
 structural region) poisons the link with a clean MeshPeerFailure
 instead of silently mis-routing a slice whose pickled node id decoded
 to a different integer.
+
+Fast wire (ISSUE 13) — the recv-wait attack, three layers deep:
+
+* **per-blob compression** — typed columnar blobs (dtype-tagged column
+  runs, string arenas) are ideal fast-compressor input. The handshake
+  advertises each side's available codecs (a bitmask carried in the
+  hello AND bound into its MAC, so a downgrade cannot be injected) and
+  each link settles on the best common one per
+  ``PATHWAY_MESH_COMPRESSION`` (off | zlib | lz4 | zstd | auto; stdlib
+  zlib is always available, lz4/zstd used when importable). Every v2
+  segment then ships raw or compressed per the segment table's codec
+  column: blobs under ``PATHWAY_MESH_COMPRESS_MIN_BYTES`` skip the
+  codec, as do blobs a compressor cannot shrink (and, under ``auto``,
+  blobs whose sampled byte entropy says they will not compress —
+  exec.cpp ``wire_entropy``). The frame CRC covers the WIRE image, so
+  corruption is detected before any decompressor touches the bytes
+  (CRC first, then codec errors — both poison the link cleanly), and
+  decompression runs on the receiver threads, off the engine loop,
+  where it shows up as a decode leg instead of recv-wait.
+* **sender threads** — every post-handshake frame to a peer is drained
+  by that peer's dedicated sender thread through a bounded queue
+  (``PATHWAY_MESH_SEND_QUEUE`` frames; a full queue blocks the producer
+  — backpressure, not unbounded buffering; 0 = synchronous legacy
+  sends). Exchange frames enqueue UNENCODED: encode + compress happen
+  on the sender thread, outside the engine loop and outside
+  ``_send_locks``, so the native executor keeps applying while frames
+  ship. Per-peer frame order is preserved (one queue per peer carries
+  control and data alike); heartbeats bypass the queue (they carry no
+  ordering constraint and must not sit behind a multi-MB frame).
+* **tree gathers** — the wave engine routes pure-gather waves over a
+  k-ary reduction tree (``protocol.tree_*``; ``PATHWAY_MESH_TREE_FANOUT``)
+  so rank 0 ingests ``fanout`` frames per wave instead of world-1; this
+  module only ships the frames it is handed — the topology decision
+  lives in parallel/protocol.py where the model checker explores it.
 The mesh links trusted peer processes
 of one pipeline (localhost by default, PATHWAY_HOSTS for multi-host);
 it is not an external protocol surface: the listener binds 127.0.0.1
@@ -144,6 +178,200 @@ def _max_frame_bytes() -> int:
     except ValueError:
         mb = 256.0
     return max(1, int(mb * 1024 * 1024))
+
+
+# -- wire codecs (ISSUE 13) -------------------------------------------------
+# Codec ids appear in the v2 segment table (0 = raw); codec BITS ride the
+# handshake hello as this rank's advertised set. zlib is stdlib and
+# always available; lz4/zstd are advertised only when importable, so a
+# mixed deployment degrades to the best common codec instead of a
+# decode error.
+
+CODEC_ID = {"zlib": 1, "lz4": 2, "zstd": 3}
+_ID_CODEC = {v: k for k, v in CODEC_ID.items()}
+_CODEC_BIT = {"zlib": 1, "lz4": 2, "zstd": 4}
+# negotiation preference, best first (measured ratio ~= equal on typed
+# columnar frames; zstd/lz4 win on encode+decode CPU)
+_CODEC_PREF = ("zstd", "lz4", "zlib")
+# auto mode: sampled byte entropy (bits/byte) above which a blob is
+# treated as incompressible (random/already-compressed payloads) and
+# shipped raw without paying the codec
+_ENTROPY_SKIP_BITS = 7.4
+
+_lz4_mod = None
+_zstd_mod = None
+
+
+def _codec_module(name: str):
+    """Resolve (and memoize) a non-stdlib codec's MODULE; None when the
+    package is not importable in this environment. Compressor /
+    decompressor objects are constructed per call: sender and receiver
+    threads of several peers (de)compress concurrently, and neither
+    python-zstandard contexts nor lz4 frame decompressors are safe to
+    share across simultaneous calls."""
+    global _lz4_mod, _zstd_mod
+    if name == "lz4":
+        if _lz4_mod is None:
+            try:
+                import lz4.frame as _lz4f  # type: ignore
+
+                _lz4_mod = _lz4f
+            except Exception:
+                _lz4_mod = False
+        return _lz4_mod or None
+    if name == "zstd":
+        if _zstd_mod is None:
+            try:
+                import zstandard as _zstd  # type: ignore
+
+                _zstd_mod = _zstd
+            except Exception:
+                _zstd_mod = False
+        return _zstd_mod or None
+    return None
+
+
+def codec_available(name: str) -> bool:
+    if name == "zlib":
+        return True
+    if name in ("lz4", "zstd"):
+        return _codec_module(name) is not None
+    return False
+
+
+def local_codec_mask(conf: str) -> int:
+    """Advertised-codec bitmask for this rank's handshake hello, from
+    the PATHWAY_MESH_COMPRESSION knob: ``off`` advertises nothing (the
+    link stays raw no matter what the peer offers), a forced codec
+    advertises only itself (unavailable forced codec = honest off, never
+    a silent substitute), ``auto`` advertises everything importable."""
+    conf = (conf or "auto").strip().lower()
+    if conf == "off":
+        return 0
+    names = _CODEC_PREF if conf == "auto" else (conf,)
+    mask = 0
+    for n in names:
+        if n in _CODEC_BIT and codec_available(n):
+            mask |= _CODEC_BIT[n]
+    return mask
+
+
+def negotiate_codec(local_mask: int, peer_mask: int) -> str | None:
+    """Best common codec of two advertised masks (None = ship raw)."""
+    common = local_mask & peer_mask
+    for name in _CODEC_PREF:
+        if common & _CODEC_BIT[name]:
+            return name
+    return None
+
+
+def _compress_blob(codec: str, blob) -> bytes:
+    if codec == "zlib":
+        # level 1: this is a wire codec on the latency path — typed
+        # columnar frames compress >2x even at the fastest setting
+        return zlib.compress(bytes(blob), 1)
+    if codec == "lz4":
+        return _codec_module("lz4").compress(bytes(blob))
+    if codec == "zstd":
+        # fresh context per call: contexts are not concurrency-safe
+        return _codec_module("zstd").ZstdCompressor().compress(
+            bytes(blob)
+        )
+    raise ValueError(f"unknown wire codec {codec!r}")
+
+
+def _decompress_blob(codec_id: int, blob, max_out: int) -> bytes:
+    """Inflate one v2 segment, output-bounded by the frame cap: the CRC
+    already rules out wire corruption, so an overrun here is a buggy or
+    hostile SENDER (zip bomb) — refuse the allocation, poison the link."""
+    name = _ID_CODEC.get(codec_id)
+    if name is None:
+        raise ValueError(f"unknown wire codec id {codec_id}")
+    if name == "zlib":
+        d = zlib.decompressobj()
+        out = d.decompress(bytes(blob), max_out)
+        if d.unconsumed_tail or not d.eof:
+            raise ValueError(
+                "compressed segment exceeds PATHWAY_MESH_MAX_FRAME_MB"
+            )
+        return out
+    if name == "lz4":
+        mod = _codec_module("lz4")
+        if mod is None:
+            raise ValueError("lz4 segment received but lz4 not importable")
+        # output-bounded like the other codecs: a hostile frame header
+        # declaring a huge content size must be refused, not allocated
+        d = mod.LZ4FrameDecompressor()
+        out = d.decompress(bytes(blob), max_length=max_out)
+        if not d.eof:
+            raise ValueError(
+                "compressed segment exceeds PATHWAY_MESH_MAX_FRAME_MB "
+                "or is truncated"
+            )
+    else:
+        mod = _codec_module("zstd")
+        if mod is None:
+            raise ValueError(
+                "zstd segment received but zstandard not importable"
+            )
+        out = mod.ZstdDecompressor().decompress(
+            bytes(blob), max_output_size=max_out
+        )
+    if len(out) > max_out:
+        raise ValueError(
+            "compressed segment exceeds PATHWAY_MESH_MAX_FRAME_MB"
+        )
+    return out
+
+
+class RawSegment:
+    """A received v2 segment kept as WIRE BYTES for tree relaying
+    (ISSUE 13): an interior rank of a gather tree forwards its
+    children's slices verbatim — no decompress, no typed decode, no
+    re-encode, no re-compress; the bytes inflate exactly once, at rank
+    0. Produced by ``_decode_exchange`` for frames tagged as relay
+    legs (``("xwr", ...)``) and consumed by ``_wire_form``."""
+
+    __slots__ = ("kind", "enc", "blob")
+
+    def __init__(self, kind: int, enc: int, blob: bytes):
+        self.kind = kind
+        self.enc = enc
+        self.blob = blob
+
+
+class _EncEntry:
+    """One encoded object in a wave's encode cache: the raw typed blob
+    plus its per-codec wire forms, computed once under the entry lock no
+    matter how many sender threads ship the same object (broadcast
+    sides ship to world-1 peers)."""
+
+    __slots__ = ("lock", "kind", "raw", "wire")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kind = None
+        self.raw = None
+        self.wire = {}  # codec name -> (enc_id, wire_bytes)
+
+
+class WaveEncodeCache:
+    """Per-wave encode/compress dedup, shared across the per-peer sender
+    threads. The caller (one exchange wave) owns its lifetime, which is
+    what keeps the id() keys valid — objects are alive for the wave."""
+
+    __slots__ = ("_lock", "_entries")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[int, _EncEntry] = {}
+
+    def entry(self, obj) -> _EncEntry:
+        with self._lock:
+            e = self._entries.get(id(obj))
+            if e is None:
+                e = self._entries[id(obj)] = _EncEntry()
+            return e
 
 
 def shard_hash(value: Any) -> int:
@@ -288,6 +516,19 @@ class ProcessGroup:
             )
         self.hosts = hosts
         self._max_frame = _max_frame_bytes()
+        # tree-gather relays (ISSUE 13) aggregate up to a whole
+        # subtree's slices into ONE frame: scale the per-frame sanity
+        # cap by the largest possible subtree span so a legitimate
+        # deep-tree frame is never mistaken for a corrupt length
+        # prefix. PATHWAY_MESH_MAX_FRAME_MB keeps its per-ORIGIN
+        # meaning; the scaled cap is still a finite bound.
+        if (
+            _proto.tree_fanout(
+                world, os.environ.get("PATHWAY_MESH_TREE_FANOUT")
+            )
+            >= 2
+        ):
+            self._max_frame *= max(1, world - 1)
         self._socks: dict[int, socket.socket] = {}
         self._send_locks: dict[int, threading.Lock] = {}
         self._queues: dict[int, "queue.Queue"] = {
@@ -295,9 +536,78 @@ class ProcessGroup:
         }
         self._recv_threads: list[threading.Thread] = []
         self._closed = False
+        # fast wire (ISSUE 13): advertised codec set + negotiated
+        # per-link codec, compression floor, and the per-peer sender
+        # threads (bounded queues; 0 = synchronous legacy sends)
+        self._codec_conf = (
+            os.environ.get("PATHWAY_MESH_COMPRESSION", "auto") or "auto"
+        ).strip().lower()
+        self._codec_mask = local_codec_mask(self._codec_conf)
+        self._codec_auto = self._codec_conf == "auto"
+        try:
+            self._compress_min = int(
+                os.environ.get("PATHWAY_MESH_COMPRESS_MIN_BYTES", "512")
+                or 512
+            )
+        except ValueError:
+            self._compress_min = 512
+        self._peer_codec: dict[int, str | None] = {}
+        # each peer's raw advertised mask too: tree-gather frames are
+        # relayed VERBATIM toward rank 0, so their segments must be
+        # compressed with a codec the route DESTINATION advertised, not
+        # merely the next hop (the mesh is a full graph — every rank
+        # holds rank 0's advert even when the wave topology is a tree)
+        self._peer_mask: dict[int, int] = {}
+        raw_q = os.environ.get("PATHWAY_MESH_SEND_QUEUE", "")
+        try:
+            self._sendq_cap = int(raw_q) if raw_q.strip() else -1
+        except ValueError:
+            self._sendq_cap = -1
+        if self._sendq_cap < 0:
+            # adaptive default: a dedicated sender thread per peer only
+            # pays when there are cores for it to run on — on a host
+            # whose local ranks already saturate the CPUs, the per-frame
+            # GIL handoff sits on every wave's critical path (measured
+            # ~18% at 2 ranks on a 1-core host), so starved topologies
+            # keep the synchronous inline send. Loopback meshes run all
+            # `world` ranks on this host; multi-host meshes count only
+            # the ranks sharing ours.
+            local_ranks = max(
+                1,
+                sum(
+                    1
+                    for h in hosts
+                    if h in ("127.0.0.1", "localhost", "::1")
+                    or h == hosts[rank]
+                ),
+            )
+            cores = os.cpu_count() or 1
+            self._sendq_cap = 8 if cores >= 2 * local_ranks else 0
+        self._sendqs: dict[int, "queue.Queue"] = {}
+        self._send_threads: list[threading.Thread] = []
+        # set AFTER close() enqueued every stop item: sender threads may
+        # exit on an idle timeout only once this is set, so a stop (and
+        # its goodbye) can never race past an exiting thread
+        self._send_stop = threading.Event()
+        # first sender-thread failure per peer: later send()s re-raise it
+        # synchronously instead of queueing into a dead link
+        self._send_errs: dict[int, str] = {}
         loopback_only = all(
             h in ("127.0.0.1", "localhost", "::1") for h in hosts
         )
+        self._loopback = loopback_only
+        # auto-mode engagement (ISSUE 13): `auto` means "compress when
+        # it cannot cost wall-clock" — engage when the codec runs off
+        # the engine's critical path (async sender threads armed: spare
+        # cores drain encode+compress+decompress in parallel) OR when
+        # the link is genuinely remote (bytes cross a real wire, worth
+        # CPU even inline). A starved loopback mesh (sync sends, every
+        # byte is a memcpy) ships raw: burning the cores the ranks
+        # share to shrink memcpys was measured as a straight efficiency
+        # loss. Forced codecs always engage; negotiation always
+        # advertises (capability is not policy — the receiver inflates
+        # whatever arrives, so per-link asymmetry is fine).
+        self._auto_engage = (not loopback_only) or self._sendq_cap > 0
         if not loopback_only and not os.environ.get("PATHWAY_MESH_SECRET"):
             raise RuntimeError(
                 "PATHWAY_HOSTS names non-loopback hosts but "
@@ -313,7 +623,14 @@ class ProcessGroup:
         )
         self._connect_mesh(first_port, timeout)
 
-    def _mac(self, role: bytes, nonces: bytes, prover: int, verifier: int) -> bytes:
+    def _mac(
+        self,
+        role: bytes,
+        nonces: bytes,
+        prover: int,
+        verifier: int,
+        codecs: bytes = b"",
+    ) -> bytes:
         """Keyed MAC for one direction of the handshake. Binds BOTH fresh
         nonces plus both rank ids (so a transcript cannot be replayed into
         another session or reflected back at its sender) AND the recovery
@@ -336,6 +653,10 @@ class ProcessGroup:
             role
             + self.epoch.to_bytes(8, "little")
             + self.world.to_bytes(8, "little")
+            # both advertised-codec masks (client||server) are MAC-bound
+            # too: a network middleman cannot strip the compression
+            # advert to force a downgrade (ISSUE 13)
+            + codecs
             + nonces
             + prover.to_bytes(8, "little")
             + verifier.to_bytes(8, "little"),
@@ -349,6 +670,8 @@ class ProcessGroup:
 
         import hmac as _hmac
 
+        acc_codec: dict[int, int] = {}
+
         def acceptor():
             while len(accepted) < expected_accepts:
                 s, _addr = self._listener.accept()
@@ -359,6 +682,12 @@ class ProcessGroup:
                         _LEN.unpack(_recv_exact(s, _LEN.size))[0]
                     )
                     peer_world = int(
+                        _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+                    )
+                    # the peer's advertised wire-codec set (ISSUE 13):
+                    # negotiation input only — acceptance never depends
+                    # on it (an empty set is a valid raw link)
+                    peer_codecs = int(
                         _LEN.unpack(_recv_exact(s, _LEN.size))[0]
                     )
                     nonce_c = _recv_exact(s, 16)
@@ -374,21 +703,33 @@ class ProcessGroup:
                         # world are bound into the MAC input)
                         raise EOFError
                     nonce_s = os.urandom(16)
-                    s.sendall(nonce_s)  # challenge only — no keyed output yet
+                    # challenge + our codec advert — no keyed output yet
+                    s.sendall(_LEN.pack(self._codec_mask) + nonce_s)
+                    codecs = (
+                        int(peer_codecs).to_bytes(8, "little")
+                        + self._codec_mask.to_bytes(8, "little")
+                    )
                     mac_c = _recv_exact(s, 16)
                     if not _hmac.compare_digest(
                         mac_c,
-                        self._mac(b"C", nonce_c + nonce_s, peer, self.rank),
+                        self._mac(
+                            b"C", nonce_c + nonce_s, peer, self.rank,
+                            codecs,
+                        ),
                     ):
                         raise EOFError
                     # peer is authenticated; now prove ourselves back
                     s.sendall(
-                        self._mac(b"S", nonce_c + nonce_s, self.rank, peer)
+                        self._mac(
+                            b"S", nonce_c + nonce_s, self.rank, peer,
+                            codecs,
+                        )
                     )
                     s.settimeout(None)
                 except (EOFError, OSError):
                     s.close()  # unauthenticated, stalled, or bogus peer
                     continue
+                acc_codec[peer] = peer_codecs
                 accepted[peer] = s
 
         at = threading.Thread(target=acceptor, daemon=True)
@@ -415,11 +756,21 @@ class ProcessGroup:
                     _LEN.pack(self.rank)
                     + _LEN.pack(self.epoch)
                     + _LEN.pack(self.world)
+                    + _LEN.pack(self._codec_mask)
                     + nonce_c
                 )
+                peer_codecs = int(
+                    _LEN.unpack(_recv_exact(s, _LEN.size))[0]
+                )
                 nonce_s = _recv_exact(s, 16)
+                codecs = (
+                    self._codec_mask.to_bytes(8, "little")
+                    + int(peer_codecs).to_bytes(8, "little")
+                )
                 s.sendall(
-                    self._mac(b"C", nonce_c + nonce_s, self.rank, peer)
+                    self._mac(
+                        b"C", nonce_c + nonce_s, self.rank, peer, codecs
+                    )
                 )
                 mac_s = _recv_exact(s, 16)
             except (EOFError, OSError) as exc:
@@ -430,7 +781,8 @@ class ProcessGroup:
                     f"mismatch? ours is epoch {self.epoch}): {exc!r}"
                 ) from exc
             if not _hmac.compare_digest(
-                mac_s, self._mac(b"S", nonce_c + nonce_s, peer, self.rank)
+                mac_s,
+                self._mac(b"S", nonce_c + nonce_s, peer, self.rank, codecs),
             ):
                 s.close()
                 raise ConnectionError(
@@ -439,6 +791,10 @@ class ProcessGroup:
                     "PATHWAY_MESH_EPOCH mismatch?)"
                 )
             s.settimeout(None)
+            self._peer_codec[peer] = negotiate_codec(
+                self._codec_mask, peer_codecs
+            )
+            self._peer_mask[peer] = int(peer_codecs)
             self._socks[peer] = s
         at.join(timeout)
         if len(accepted) != expected_accepts:
@@ -447,6 +803,11 @@ class ProcessGroup:
                 f"connections, got {len(accepted)}"
             )
         self._socks.update(accepted)
+        for peer, mask in acc_codec.items():
+            self._peer_codec[peer] = negotiate_codec(
+                self._codec_mask, mask
+            )
+            self._peer_mask[peer] = int(mask)
         for peer, s in self._socks.items():
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # deep buffers keep coalesced exchange frames from blocking
@@ -464,6 +825,18 @@ class ProcessGroup:
             )
             t.start()
             self._recv_threads.append(t)
+            if self._sendq_cap > 0:
+                # dedicated sender per peer (ISSUE 13): one bounded FIFO
+                # carries control and exchange frames alike, so per-peer
+                # order is preserved while encode/compress/sendall run
+                # off the engine loop
+                q = queue.Queue(maxsize=self._sendq_cap)
+                self._sendqs[peer] = q
+                st = threading.Thread(
+                    target=self._send_loop, args=(peer, q), daemon=True
+                )
+                st.start()
+                self._send_threads.append(st)
         if self._hb_interval > 0 and self.world > 1:
             self._hb_thread = threading.Thread(
                 target=self._hb_loop, daemon=True
@@ -510,6 +883,18 @@ class ProcessGroup:
         q = self._queues[peer]
         cap = self._max_frame
         last_seen = self._last_seen
+        # cross-frame wire intern cache (ISSUE 13): this link's gather
+        # vocabulary (group keys/strings) recurs commit after commit —
+        # one capsule per receiver thread turns nearly every Pointer/
+        # str mint in deltas_decode into a cache hit. Thread-local by
+        # construction (only this loop touches it), bounded (epoch-
+        # resets at capacity).
+        ex = self._pwexec()
+        intern = (
+            ex.intern_new()
+            if ex is not None and hasattr(ex, "intern_new")
+            else None
+        )
 
         def alive() -> None:
             # refreshed per received CHUNK, not per frame: a peer mid-way
@@ -548,22 +933,31 @@ class ProcessGroup:
                     continue
                 try:
                     if payload[:4] == _V2_MAGIC:
-                        # exchange v2: decode typed columnar buffers HERE,
-                        # on the receiver thread — merge work overlaps the
-                        # main loop's compute (the flight recorder gives
-                        # these their own per-peer trace track)
+                        # exchange v2: decompress + decode typed columnar
+                        # buffers HERE, on the receiver thread — the work
+                        # overlaps the main loop's compute and shows up
+                        # as a decode leg (with a decompress sub-span),
+                        # not recv-wait (the flight recorder gives these
+                        # their own per-peer trace track)
                         rec = self.recorder
                         t0 = (
                             _time.perf_counter_ns()
                             if rec is not None
                             else 0
                         )
-                        decoded = self._decode_exchange(payload)
+                        decoded, dz = self._decode_exchange(
+                            payload, intern
+                        )
                         if rec is not None:
                             rec.note_decode(
                                 peer, t0, _time.perf_counter_ns(),
                                 len(payload),
                             )
+                            if dz is not None:
+                                rec.note_decompress(
+                                    peer, dz[0], dz[0] + dz[1], dz[2],
+                                    dz[3],
+                                )
                     else:
                         decoded = pickle.loads(payload)
                 except Exception as exc:
@@ -589,6 +983,10 @@ class ProcessGroup:
 
     # -- primitives -------------------------------------------------------
     def _send_payload(self, peer: int, payload: bytes) -> None:
+        """Synchronous low-level frame write (length prefix + payload)
+        under the peer's socket-write lock — the heartbeat thread and
+        this peer's sender thread interleave on the lock, never
+        mid-frame."""
         try:
             with self._send_locks[peer]:
                 self._socks[peer].sendall(
@@ -602,64 +1000,267 @@ class ProcessGroup:
                 f"({exc!r}) — peer crashed or unreachable"
             ) from exc
 
+    def _send_loop(self, peer: int, q: "queue.Queue") -> None:
+        """Per-peer sender thread (ISSUE 13): drains the bounded queue
+        in FIFO order, so control and exchange frames to one peer can
+        never reorder. Exchange work items encode + compress HERE —
+        outside the engine loop and outside ``_send_locks`` — which is
+        the send half of the overlap: the native executor keeps
+        applying while frames drain. A failed send poisons the link
+        once (recorded for synchronous re-raise, and the peer's recv
+        queue is woken with the reason); the thread then keeps draining
+        and discarding so producers never block behind a dead peer."""
+        dead = False
+        while True:
+            try:
+                item = q.get(timeout=1.0)
+            except queue.Empty:
+                if self._send_stop.is_set():
+                    # close() may not have managed to queue a stop item
+                    # (jammed queue): exit on our own so the emulated
+                    # lane / test meshes never accumulate blocked
+                    # sender threads
+                    return
+                continue
+            kind = item[0]
+            if kind == "stop":
+                bye = item[1]
+                if not dead and bye is not None:
+                    # orderly goodbye, sequenced AFTER every queued frame
+                    lock = self._send_locks.get(peer)
+                    try:
+                        if lock is None or lock.acquire(timeout=0.5):
+                            try:
+                                self._socks[peer].sendall(bye)
+                            finally:
+                                if lock is not None:
+                                    lock.release()
+                    except OSError:
+                        pass
+                return
+            if dead:
+                continue
+            try:
+                if kind == "payload":
+                    self._send_payload(peer, item[1])
+                else:  # "xframe": (_, tag, entries, enc_cache, route)
+                    self._frame_send(
+                        peer, item[1], item[2], item[3], item[4]
+                    )
+            except Exception as exc:
+                # not only transport errors: an encode/compress failure
+                # (unpicklable cell, codec error) must ALSO poison the
+                # link — silently skipping a frame would desync the
+                # peer's tag stream, and a silently dead sender thread
+                # would turn the bounded queue into a misleading
+                # "peer not draining" timeout
+                dead = True
+                msg = (
+                    f"rank {self.rank}: sender thread for peer {peer} "
+                    f"failed: {exc}"
+                )
+                self._send_errs[peer] = msg
+                rq = self._queues.get(peer)
+                if rq is not None:
+                    # wake any recv blocked on this peer with the real
+                    # reason — a dead send side is a dead link
+                    rq.put(_MeshError(msg))
+                    rq.put(None)
+
+    def _dispatch(self, peer: int, item: tuple) -> None:
+        """Route one send item to the peer's sender thread (bounded
+        queue = backpressure, PATHWAY_MESH_OP_TIMEOUT_S caps the block)
+        or execute it inline when sender threads are off
+        (PATHWAY_MESH_SEND_QUEUE=0)."""
+        q = self._sendqs.get(peer)
+        if q is None:
+            if item[0] == "payload":
+                self._send_payload(peer, item[1])
+            else:
+                self._frame_send(
+                    peer, item[1], item[2], item[3], item[4]
+                )
+            return
+        err = self._send_errs.get(peer)
+        if err is not None:
+            raise MeshPeerFailure(err)
+        if self._op_timeout > 0:
+            try:
+                q.put(item, timeout=self._op_timeout)
+            except queue.Full:
+                raise MeshTimeout(
+                    f"rank {self.rank}: sender queue for peer {peer} "
+                    "stayed full for PATHWAY_MESH_OP_TIMEOUT_S="
+                    f"{self._op_timeout:g}s — peer not draining"
+                ) from None
+        else:
+            q.put(item)
+
     def send(self, peer: int, tag: Any, obj: Any) -> None:
         _faults.fault_point("mesh.send")
-        # serialize OUTSIDE the per-peer lock: pickling a large fallback
-        # frame must not serialize concurrent senders to the same peer
+        # serialize on the CALLER thread (snapshot semantics: callers
+        # mutate lockstep state right after send() returns) and OUTSIDE
+        # the per-peer lock; only the socket write is deferred
         payload = pickle.dumps((tag, obj), protocol=pickle.HIGHEST_PROTOCOL)
-        self._send_payload(peer, payload)
+        self._dispatch(peer, ("payload", payload))
 
     # -- exchange v2: coalesced typed-columnar frames ----------------------
     # One frame carries EVERY exchange node's slice for one (timestamp,
     # wave): native slices ride as nb_encode columnar buffers (kind 0),
-    # tuple-path/object-column slices as pickled segments (kind 1), empty
-    # slices are elided entirely — the pickled header doubles as the
-    # presence map. Layout:
-    #   b"PWX2" | u32 head_len | u32 crc32(head + blobs)
-    #   | pickle((tag, [(node_id, kind, size)...])) | blob_0 | blob_1 ...
+    # tuple-path/object-column slices as pickled segments (kind 1),
+    # retraction-bearing scalar slices as the deltas codec (kind 2),
+    # empty slices are elided entirely — the pickled header doubles as
+    # the presence map. Each segment ships raw (codec id 0) or
+    # compressed under the link's negotiated codec. Layout:
+    #   b"PWX2" | u32 head_len | u32 crc32(head + wire blobs)
+    #   | pickle((tag, [(node_id, kind, wire_size, codec_id)...]))
+    #   | blob_0 | blob_1 ...
+    # The CRC covers the WIRE image: corruption is rejected before any
+    # unpickle OR decompression (CRC first, then codec errors).
+    def make_enc_cache(self) -> WaveEncodeCache:
+        """Encode/compress dedup for one wave: an object shipped to
+        several peers (broadcast sides) encodes and compresses once.
+        Thread-safe — the per-peer sender threads share it; the caller
+        owns its lifetime (one wave), which keeps the id() keys valid."""
+        return WaveEncodeCache()
+
     def send_exchange(
-        self, peer: int, tag: Any, entries: list, enc_cache: dict | None = None
+        self,
+        peer: int,
+        tag: Any,
+        entries: list,
+        enc_cache=None,
+        route_dest: int | None = None,
     ) -> int:
         """entries: [(node_id, NativeBatch | delta-list), ...]; returns
-        bytes shipped (comms accounting). ``enc_cache`` (id(obj) ->
-        (kind, blob)) lets a wave that ships the SAME object to several
-        peers — broadcast sides — encode it once instead of world-1
-        times; the caller owns the cache's lifetime (one wave), which
-        keeps the id() keys valid."""
+        bytes shipped on the synchronous path, 0 when the frame was
+        handed to the peer's sender thread (frame/byte accounting then
+        lands on ``self.stats`` from that thread either way).
+        ``route_dest`` names the frame's FINAL rank when it differs
+        from ``peer`` (tree-gather relays): segments are then
+        compressed only with a codec the destination advertised, since
+        relays forward them verbatim."""
         _faults.fault_point("mesh.send")
+        q = self._sendqs.get(peer)
+        if q is None:
+            return self._frame_send(
+                peer, tag, entries, enc_cache, route_dest
+            )
+        self._dispatch(
+            peer, ("xframe", tag, entries, enc_cache, route_dest)
+        )
+        return 0
+
+    def _encode_obj(self, ex, obj) -> tuple[int, bytes]:
+        """One exchange object -> (segment kind, raw typed blob)."""
+        if ex is not None and is_native_batch(obj):
+            return 0, ex.nb_encode(obj)
+        # retraction-bearing slices: typed columnar delta codec when
+        # every cell is scalar, pickle for object columns
+        blob = (
+            ex.deltas_encode(obj)
+            if ex is not None and hasattr(ex, "deltas_encode")
+            else None
+        )
+        if blob is not None:
+            return 2, blob
+        return 1, pickle.dumps(list(obj), protocol=pickle.HIGHEST_PROTOCOL)
+
+    def _maybe_compress(self, codec: str | None, raw: bytes):
+        """(codec_id, wire_blob) for one raw segment: raw when the link
+        negotiated no codec, auto-mode is not engaged on this topology
+        (starved loopback — see ``_auto_engage``), the blob is under
+        the PATHWAY_MESH_COMPRESS_MIN_BYTES floor, the auto-mode
+        entropy probe says incompressible, or the codec failed to
+        shrink it."""
+        if codec is None or len(raw) < max(1, self._compress_min):
+            return 0, raw
+        if self._codec_auto and not self._auto_engage:
+            return 0, raw
+        if self._codec_auto and self._entropy_skip(raw):
+            return 0, raw
+        wire = _compress_blob(codec, raw)
+        if len(wire) >= len(raw):
+            return 0, raw
+        return CODEC_ID[codec], wire
+
+    def _entropy_skip(self, raw: bytes) -> bool:
+        """auto-mode probe: sampled byte entropy (exec.cpp wire_entropy,
+        GIL-free) above the skip threshold means random/pre-compressed
+        bytes — paying the codec would burn sender CPU for ratio ~1."""
         ex = self._pwexec()
+        if ex is not None and hasattr(ex, "wire_entropy"):
+            try:
+                return ex.wire_entropy(raw) > _ENTROPY_SKIP_BITS
+            except Exception:
+                return False
+        # portable fallback: fastest-level probe over a prefix
+        sample = bytes(raw[:4096])
+        return len(zlib.compress(sample, 1)) > 0.9 * len(sample)
+
+    def _wire_form(self, ex, obj, codec, cache):
+        """(kind, codec_id, wire_blob, raw_len) for one entry, through
+        the wave's encode cache when one is attached."""
+        if isinstance(obj, RawSegment):
+            # tree relay: forward the wire bytes untouched (already
+            # compressed or raw as the ORIGINAL sender decided; its
+            # rank accounted the raw->wire reduction once)
+            return obj.kind, obj.enc, obj.blob, len(obj.blob)
+        if isinstance(cache, WaveEncodeCache):
+            e = cache.entry(obj)
+            with e.lock:
+                if e.raw is None:
+                    e.kind, e.raw = self._encode_obj(ex, obj)
+                key = codec or ""
+                got = e.wire.get(key)
+                if got is None:
+                    got = e.wire[key] = self._maybe_compress(codec, e.raw)
+                return e.kind, got[0], got[1], len(e.raw)
+        if isinstance(cache, dict):  # legacy single-threaded cache
+            got = cache.get(id(obj))
+            if got is None:
+                got = cache[id(obj)] = self._encode_obj(ex, obj)
+            kind, raw = got
+        else:
+            kind, raw = self._encode_obj(ex, obj)
+        enc, wire = self._maybe_compress(codec, raw)
+        return kind, enc, wire, len(raw)
+
+    def _frame_send(
+        self,
+        peer: int,
+        tag: Any,
+        entries: list,
+        enc_cache=None,
+        route_dest: int | None = None,
+    ) -> int:
+        """Build one coalesced v2 frame (encode + compress) and ship it,
+        with frame/byte/compression accounting and the recorder's send
+        span — shared verbatim by the synchronous path and the sender
+        threads, so metrics cannot depend on which path ran."""
+        rec = self.recorder
+        t0 = _time.perf_counter_ns() if rec is not None else 0
+        ex = self._pwexec()
+        if route_dest is None or route_dest == peer:
+            codec = self._peer_codec.get(peer)
+        else:
+            # the frame's segments will be relayed verbatim to
+            # route_dest: only a codec the DESTINATION advertised may
+            # touch them (a mixed deployment must degrade per path,
+            # never hit a decode error at the root)
+            codec = negotiate_codec(
+                self._codec_mask, self._peer_mask.get(route_dest, 0)
+            )
         meta = []
         blobs = []
+        raw_total = 0
         for nid, obj in entries:
-            cached = (
-                enc_cache.get(id(obj)) if enc_cache is not None else None
+            kind, enc, wire, raw_len = self._wire_form(
+                ex, obj, codec, enc_cache
             )
-            if cached is not None:
-                kind, blob = cached
-            else:
-                if ex is not None and is_native_batch(obj):
-                    blob = ex.nb_encode(obj)
-                    kind = 0
-                else:
-                    # retraction-bearing slices: typed columnar delta
-                    # codec when every cell is scalar, pickle for object
-                    # columns
-                    blob = (
-                        ex.deltas_encode(obj)
-                        if ex is not None and hasattr(ex, "deltas_encode")
-                        else None
-                    )
-                    if blob is not None:
-                        kind = 2
-                    else:
-                        blob = pickle.dumps(
-                            list(obj), protocol=pickle.HIGHEST_PROTOCOL
-                        )
-                        kind = 1
-                if enc_cache is not None:
-                    enc_cache[id(obj)] = (kind, blob)
-            meta.append((nid, kind, len(blob)))
-            blobs.append(blob)
+            meta.append((nid, kind, len(wire), enc))
+            blobs.append(wire)
+            raw_total += raw_len
         head = pickle.dumps((tag, meta), protocol=pickle.HIGHEST_PROTOCOL)
         crc = zlib.crc32(head)
         for blob in blobs:
@@ -668,14 +1269,31 @@ class ProcessGroup:
             [_V2_MAGIC, _V2_HEAD.pack(len(head), crc), head, *blobs]
         )
         self._send_payload(peer, payload)
+        stats = self.stats
+        if stats is not None:
+            stats.on_exchange_frame(len(payload), peer)
+            # "uncompressed" = the frame's wire size had every segment
+            # shipped raw — same framing overhead, so ratio 1.0 means
+            # honestly off/ineffective, never framing noise
+            stats.on_exchange_compression(
+                peer,
+                raw_total + len(payload) - sum(len(b) for b in blobs),
+                len(payload),
+            )
+        if rec is not None:
+            rec.note_send(peer, t0, _time.perf_counter_ns(), len(payload))
         return len(payload)
 
-    def _decode_exchange(self, payload: bytes):
-        """(tag, [(node_id, part), ...]) from a v2 frame; parts arrive as
-        NativeBatch (columnar) or delta lists (pickled fallback). The
-        frame CRC is verified before ANY byte is unpickled: corruption
-        becomes a clean link error here (the receiver thread wraps this
-        in _MeshError), never a silently mis-routed slice."""
+    def _decode_exchange(self, payload: bytes, intern=None):
+        """((tag, [(node_id, part), ...]), dz) from a v2 frame; parts
+        arrive as NativeBatch (columnar) or delta lists (pickled
+        fallback); ``dz`` is ``(t0_ns, dur_ns, wire_bytes, raw_bytes)``
+        decompression accounting (None when every segment shipped raw).
+        The frame CRC is verified before ANY byte is unpickled OR
+        inflated: corruption becomes a clean link error here (the
+        receiver thread wraps this in _MeshError), never a silently
+        mis-routed slice — and codec errors can only mean a buggy
+        sender, not wire damage."""
         hlen, crc = _V2_HEAD.unpack_from(payload, 4)
         off = 4 + _V2_HEAD.size
         if zlib.crc32(payload[off:]) != crc:
@@ -689,7 +1307,20 @@ class ProcessGroup:
         ex = self._pwexec()
         items = []
         view = memoryview(payload)
-        for nid, kind, size in meta:
+        dz_t0 = dz_ns = dz_wire = dz_raw = 0
+        # relay legs of a gather tree (tag ("xwr", ...)): this rank
+        # forwards these segments to its tree parent verbatim — keep
+        # them as wire bytes (no decompress, no typed decode); they
+        # inflate exactly once, at rank 0
+        relay_leg = (
+            isinstance(tag, tuple) and bool(tag) and tag[0] == "xwr"
+        )
+        for entry in meta:
+            if len(entry) == 4:
+                nid, kind, size, enc = entry
+            else:  # pre-compression 3-tuple segment table (always raw)
+                nid, kind, size = entry
+                enc = 0
             if size < 0 or off + size > len(payload):
                 # the crc already rules out corruption; this guards a
                 # buggy sender whose (validly-checksummed) size table
@@ -699,6 +1330,18 @@ class ProcessGroup:
                 )
             blob = view[off:off + size]
             off += size
+            if relay_leg:
+                items.append((nid, RawSegment(kind, enc, bytes(blob))))
+                continue
+            if enc:
+                dt0 = _time.perf_counter_ns()
+                blob = _decompress_blob(enc, blob, self._max_frame)
+                dt1 = _time.perf_counter_ns()
+                if not dz_t0:
+                    dz_t0 = dt0
+                dz_ns += dt1 - dt0
+                dz_wire += size
+                dz_raw += len(blob)
             if kind == 0 or kind == 2:
                 if ex is None:  # no toolchain on this rank: cannot happen
                     raise ConnectionError(
@@ -710,12 +1353,13 @@ class ProcessGroup:
                         nid,
                         ex.nb_decode(blob, Pointer)
                         if kind == 0
-                        else ex.deltas_decode(blob, Pointer),
+                        else ex.deltas_decode(blob, Pointer, intern),
                     )
                 )
             else:
                 items.append((nid, pickle.loads(blob)))
-        return (tag, items)
+        dz = (dz_t0, dz_ns, dz_wire, dz_raw) if dz_ns else None
+        return (tag, items), dz
 
     @staticmethod
     def _pwexec():
@@ -911,11 +1555,30 @@ class ProcessGroup:
             return
         self._closed = True
         self._hb_stop.set()
+        bye = (
+            _LEN.pack(len(_BYE_MAGIC)) + _BYE_MAGIC if goodbye else None
+        )
+        # stop sender threads first: the stop item rides the SAME queue
+        # as queued frames, so an orderly goodbye is sequenced after
+        # every frame already enqueued (a bye overtaking queued data
+        # would make peers classify a healthy link as prematurely gone)
+        stopped: set[int] = set()
+        for peer, sq in self._sendqs.items():
+            try:
+                sq.put(("stop", bye), timeout=0.5 if goodbye else 0.0)
+                stopped.add(peer)
+            except queue.Full:
+                pass  # jammed link: socket shutdown below unblocks it
+        self._send_stop.set()
         if goodbye:
-            # orderly goodbye first: peers that still wait on us can then
-            # report MeshPeerGone (clean shutdown) instead of a crash
-            bye = _LEN.pack(len(_BYE_MAGIC)) + _BYE_MAGIC
+            for t in self._send_threads:
+                t.join(1.0)
+            # orderly goodbye for sync-mode peers (and any whose jammed
+            # sender queue never took the stop item): peers that still
+            # wait on us can then report MeshPeerGone instead of a crash
             for peer, s in self._socks.items():
+                if peer in stopped:
+                    continue
                 lock = self._send_locks.get(peer)
                 try:
                     if lock is None:

@@ -107,13 +107,113 @@ def wave_partition(
 
 # -- wave leg elision (engine/runtime.py _run_exchange_wave) ---------------
 
+def tree_fanout(world: int, knob: str | int | None) -> int:
+    """Resolve ``PATHWAY_MESH_TREE_FANOUT`` into the gather-tree fanout
+    for one mesh: ``0`` = flat (every sender ships straight to rank 0),
+    ``k >= 2`` = k-ary reduction tree (ISSUE 13). ``auto`` (the default)
+    turns the tree on at world >= 4 with fanout 2 — below that every
+    rank is already a direct child of rank 0, so a tree only adds relay
+    hops. The engine resolves its env knob and the model checker its
+    config through THIS function, so the explored topology is the
+    driven topology."""
+    if world <= 2:
+        return 0
+    if knob is None:
+        knob = "auto"
+    if isinstance(knob, str):
+        knob = knob.strip().lower() or "auto"
+        if knob in ("off", "flat", "0", "1", "false", "no"):
+            return 0
+        if knob == "auto":
+            return 2 if world >= 4 else 0
+        try:
+            knob = int(knob)
+        except ValueError:
+            return 2 if world >= 4 else 0
+    return int(knob) if knob >= 2 else 0
+
+
+def tree_parent(rank: int, fanout: int) -> int:
+    """Parent of ``rank`` in the heap-layout k-ary gather tree rooted at
+    rank 0 (rank 0 has no parent)."""
+    return (rank - 1) // fanout
+
+
+def tree_children(rank: int, world: int, fanout: int) -> list[int]:
+    """Children of ``rank`` in the heap-layout k-ary gather tree."""
+    lo = fanout * rank + 1
+    return [c for c in range(lo, min(lo + fanout, world))]
+
+
+def tree_depth(world: int, fanout: int) -> int:
+    """Depth of the gather tree (edges on the longest root-to-leaf
+    path); 0 = flat topology or a single rank. The TUI's tree-depth
+    gauge and the README docs read this."""
+    if fanout < 2 or world <= 1:
+        return 0
+    depth, r = 0, world - 1
+    while r > 0:
+        r = tree_parent(r, fanout)
+        depth += 1
+    return depth
+
+
+def tree_subtree_active(
+    rank: int, world: int, fanout: int, contrib: int | None
+) -> bool:
+    """Whether the subtree rooted at ``rank`` holds any wave-1
+    contributor: a non-contributor interior rank must still RELAY its
+    descendants' frames, so its send leg exists iff anything below it
+    (or it itself) contributes. ``contrib None`` = every rank may hold
+    routable rows."""
+    if contrib is None:
+        return True
+    if (contrib >> rank) & 1:
+        return True
+    return any(
+        tree_subtree_active(c, world, fanout, contrib)
+        for c in tree_children(rank, world, fanout)
+    )
+
+
+def tree_relay(own_entries: list, relayed_entries: list) -> list:
+    """The interior-rank relay decision of a tree-gather wave: the frame
+    shipped to the parent carries this rank's OWN slices plus every
+    slice received from its children, unchanged and in that order. A
+    relay that drops (or reorders per-child batches of) the received
+    slices loses deltas that no flat-topology check can see — the
+    ``drop_relay`` mutant breaks exactly this and the model checker must
+    catch it as a lost-delta exactly-once violation."""
+    return list(own_entries) + list(relayed_entries)
+
+
 def wave_send_targets(
-    world: int, rank: int, gather_only: bool, contrib: int | None
+    world: int,
+    rank: int,
+    gather_only: bool,
+    contrib: int | None,
+    fanout: int = 0,
 ) -> list[int]:
     """Peers this rank ships a wave frame to. Pure-gather waves route to
     rank 0 only (non-zero peers never receive); a rank outside the
     wave-1 contributor mask holds provably empty inputs, so ALL its send
-    legs vanish (no frame at all, not an empty frame)."""
+    legs vanish (no frame at all, not an empty frame).
+
+    ``fanout >= 2`` routes pure-gather waves over the k-ary reduction
+    tree instead (ISSUE 13): every non-root rank sends ONE frame to its
+    tree parent (after folding in its children's frames), so rank 0
+    ingests fanout frames per wave instead of world-1 — the gather legs
+    stop serializing on one receiver. A rank whose whole subtree is
+    outside the contributor mask has nothing to send OR relay, so its
+    leg vanishes exactly like the flat elision."""
+    if gather_only and fanout >= 2 and world > 2:
+        if rank == 0:
+            return []
+        return (
+            [tree_parent(rank, fanout)]
+            if tree_subtree_active(rank, world, fanout, contrib)
+            else []
+        )
     if contrib is not None and not (contrib >> rank) & 1:
         return []
     return [
@@ -124,12 +224,23 @@ def wave_send_targets(
 
 
 def wave_recv_sources(
-    world: int, rank: int, gather_only: bool, contrib: int | None
+    world: int,
+    rank: int,
+    gather_only: bool,
+    contrib: int | None,
+    fanout: int = 0,
 ) -> list[int]:
     """Peers this rank expects a wave frame FROM — the exact mirror of
     :func:`wave_send_targets` (every rank derives both sides from the
     same lockstep state, so a frame is expected iff it is sent; any
-    asymmetry here is a protocol deadlock)."""
+    asymmetry here is a protocol deadlock). On tree-gather waves a rank
+    receives from exactly its contributor-active tree children."""
+    if gather_only and fanout >= 2 and world > 2:
+        return [
+            c
+            for c in tree_children(rank, world, fanout)
+            if tree_subtree_active(c, world, fanout, contrib)
+        ]
     if gather_only and rank != 0:
         return []
     return [
@@ -545,6 +656,8 @@ TRANSITIONS: dict[str, object] = {
     "wave_partition": wave_partition,
     "wave_send_targets": wave_send_targets,
     "wave_recv_sources": wave_recv_sources,
+    "tree_fanout": tree_fanout,
+    "tree_relay": tree_relay,
     "lockstep_plan": lockstep_plan,
     "commit_time": commit_time,
     "commit_plan": commit_plan,

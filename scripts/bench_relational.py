@@ -317,6 +317,9 @@ if _st is not None:
         compute_s=round(_st.exchange_compute_s, 4),
         idle_s=round(_st.idle_s, 4),
         waves=_st.exchange_waves,
+        raw_bytes=_st.exchange_raw_bytes,
+        wire_bytes=_st.exchange_wire_bytes,
+        tree_depth=_st.mesh_tree_depth,
     )
 print(json.dumps({{"rank": rank, "elapsed_s": time.perf_counter() - t0,
                    "changes": out["n"], **_extra}}))
@@ -518,6 +521,18 @@ def _mesh_metric(
         # cluster view's mesh_skew_seconds gauge (internals/cluster.py)
         out["mesh_skew_seconds"] = round(max(waits) - min(waits), 4)
         out["per_rank_recv_wait_s"] = waits
+    # fast wire (ISSUE 13): frame bytes before/after the wire codec,
+    # summed over the mesh, plus the gather-tree depth — the ≥2x
+    # frame-byte-reduction acceptance lane reads straight off this
+    raw = sum(r.get("raw_bytes") or 0 for r in results)
+    wire = sum(r.get("wire_bytes") or 0 for r in results)
+    if wire:
+        out["frame_bytes_raw"] = raw
+        out["frame_bytes_wire"] = wire
+        out["compression_ratio"] = round(raw / wire, 3)
+    depth = max((r.get("tree_depth") or 0 for r in results), default=0)
+    if depth:
+        out["tree_depth"] = depth
     return out
 
 
@@ -628,8 +643,10 @@ def bench_scaling(
             for world in sorted(set(int(r) for r in ranks)):
                 metric = f"{name}_{world}rank_rows_per_s"
 
-                def once():
-                    res = _mesh_rank_once(prog, td, metric, world)
+                def once(metric=metric, world=world, extra_env=None):
+                    res = _mesh_rank_once(
+                        prog, td, metric, world, extra_env=extra_env
+                    )
                     if isinstance(res, dict):
                         return res
                     return _mesh_metric(
@@ -651,6 +668,40 @@ def bench_scaling(
                         med["value"] / (world * baseline), 4
                     )
                 emit(med)
+                if world == 2 and name == "wordcount":
+                    # fast-wire companion lane (ISSUE 13): the same
+                    # 2-rank wordcount with the codec FORCED on, so the
+                    # artifact records the real frame-byte reduction on
+                    # live frames (stdlib zlib — always available) next
+                    # to its wall-clock cost. The default lane above
+                    # rides `auto`, which on a starved loopback host
+                    # deliberately ships raw — compressing memcpys with
+                    # the cores the ranks share measures as a straight
+                    # efficiency loss; auto engages off-host or when
+                    # sender threads have spare cores to run on.
+                    metric_z = f"{name}_2rank_zlib_rows_per_s"
+                    zenv = {"PATHWAY_MESH_COMPRESSION": "zlib"}
+                    zruns = [
+                        once(metric=metric_z, extra_env=zenv)
+                        for _ in range(1 + 3)
+                    ][1:]
+                    bad = next(
+                        (r for r in zruns if "error" in r), None
+                    )
+                    if bad is not None:
+                        emit(bad)
+                        continue
+                    zmed = _median_of(
+                        zruns, [r["value"] for r in zruns]
+                    )
+                    zmed["metric"] = metric_z
+                    zmed["role"] = "compression_lane"
+                    if baseline:
+                        zmed["baseline_rows_per_s"] = baseline
+                        zmed["scaling_efficiency"] = round(
+                            zmed["value"] / (world * baseline), 4
+                        )
+                    emit(zmed)
 
 
 def bench_traced_overhead(
@@ -819,11 +870,15 @@ _TRACED_METRICS = {
 
 
 def _scaling_metric_names(ranks: list[int]) -> set[str]:
-    return {
+    names = {
         f"{name}_{world}rank_rows_per_s"
         for name in ("wordcount", "stream_join")
         for world in ranks
     }
+    if 2 in ranks:
+        # the fast-wire forced-zlib companion lane (ISSUE 13)
+        names.add("wordcount_2rank_zlib_rows_per_s")
+    return names
 
 
 def main_scaling_artifact(

@@ -135,4 +135,18 @@ echo "=== lane 11: transactional-egress chaos smoke (sink 2PC) ==="
 # `python scripts/fault_matrix.py --sink`.
 env -u PATHWAY_LANE_PROCESSES python scripts/sink_chaos_smoke.py
 
+echo "=== lane 12: fast-wire compression smoke (zlib 2-rank) ==="
+# real-fork 2-rank wordcount under PATHWAY_MESH_COMPRESSION=zlib vs
+# off: the live /metrics view must show exchange_uncompressed_bytes >
+# exchange_compressed_bytes (ratio > 1 on real typed columnar frames),
+# the off run must report the two totals EQUAL (honest off — the
+# generic fallback path shares the same framing, so a phantom
+# compression state is impossible by construction), and both runs'
+# outputs must be bit-identical. The codec corruption contract (CRC
+# first, then codec errors, never a partial decode) is pinned by the
+# wire fuzz battery in tests/test_native_exchange.py; the gather-tree
+# topology is model-checked by `python -m pathway_tpu.analysis --mesh
+# --processes 4` (mutant: --mesh-mutant drop_relay).
+env -u PATHWAY_LANE_PROCESSES python scripts/compress_smoke.py
+
 echo "=== all lanes green ==="

@@ -444,6 +444,54 @@ def test_critical_path_synthetic_straggler(tmp_path):
     assert "recv-wait matrix" in text and "rank 0 ← rank 1" in text
 
 
+def test_critical_path_splits_decompress_from_decode(tmp_path):
+    """Fast-wire sub-legs (ISSUE 13): decompress spans split out of the
+    decode leg, and the codec byte ratio lands in the report AND the
+    straggler verdict line — 'compression helped/hurt' is readable from
+    --critical-path output."""
+    path = _synthetic_trace(tmp_path)
+    doc = json.loads(open(path).read())
+    # rank 0's receiver track: one 1.2ms decode wrapping a 0.5ms
+    # decompress that inflated 2000 wire bytes to 9000
+    doc["traceEvents"].extend(
+        [
+            {
+                "name": "decode←1", "cat": "mesh", "ph": "X", "pid": 0,
+                "tid": 201, "ts": 3450.0, "dur": 1200.0,
+                "args": {"peer": 1, "bytes": 2600},
+            },
+            {
+                "name": "decompress←1", "cat": "mesh", "ph": "X",
+                "pid": 0, "tid": 201, "ts": 3460.0, "dur": 500.0,
+                "args": {"peer": 1, "bytes": 2000, "raw": 9000},
+            },
+        ]
+    )
+    open(path, "w").write(json.dumps(doc))
+    report = critical_path(path)
+    assert report["valid"], report["problems"]
+    legs = report["legs"]
+    assert legs[0]["decompress_s"] == pytest.approx(0.0005)
+    # decode leg excludes the codec share (1.2ms total - 0.5ms inflate)
+    assert legs[0]["decode_s"] == pytest.approx(0.0007)
+    codec = report["codec"]
+    assert codec["raw_bytes"] == 9000 and codec["wire_bytes"] == 2000
+    assert codec["ratio"] == pytest.approx(4.5)
+    assert "codec ratio 4.50x" in report["verdict"]
+    text = render_critical_path(report)
+    assert "decompress=0.0005" in text
+    assert "9000 raw -> 2000 wire" in text
+
+
+def test_critical_path_verdict_says_compression_off(tmp_path):
+    """A trace without compressed segments reads an explicit
+    'compression off' suffix — off must be distinguishable from
+    unmeasured."""
+    report = critical_path(_synthetic_trace(tmp_path))
+    assert report["codec"] is None
+    assert "compression off" in report["verdict"]
+
+
 def test_critical_path_single_rank_trace_is_not_an_error(tmp_path, monkeypatch):
     monkeypatch.delenv("PATHWAY_LANE_PROCESSES", raising=False)
     path = _run_traced(tmp_path, monkeypatch)

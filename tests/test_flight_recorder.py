@@ -217,6 +217,9 @@ _PROBER_CALLS = {
     # counters, and main-loop idle seconds
     "on_exchange_recv_wait": (1, 0.25),
     "on_exchange_wave": (0.5,),
+    # fast wire (ISSUE 13): per-frame bytes before/after the wire codec
+    # (exchange_{un,}compressed_bytes_total + the per-peer matrix)
+    "on_exchange_compression": (1, 4096, 1024),
     "on_idle": (0.3,),
     "on_mesh_heartbeat_missed": (),
     "on_mesh_rank_restart": (),

@@ -92,34 +92,162 @@ def test_supervisor_loads_protocol_by_file_path_outside_package():
 def test_wave_leg_predicates_mirror_exactly():
     """peer p receives from r iff r sends to p — exhaustively over
     world<=5, every rank pair, both gather modes, every contributor
-    mask. An asymmetry here is a guaranteed rendezvous deadlock, which
-    is why both sides live in one table."""
+    mask, AND every tree fanout (ISSUE 13: tree routes extend the
+    mirror property — gather waves route child -> parent instead of
+    all -> rank 0). An asymmetry here is a guaranteed rendezvous
+    deadlock, which is why both sides live in one table."""
     for world in (2, 3, 5):
         for gather_only in (False, True):
-            for contrib in [None] + list(range(1, 1 << world)):
-                sends = {
-                    r: set(
-                        proto.wave_send_targets(
-                            world, r, gather_only, contrib
+            for fanout in (0, 2, 3):
+                for contrib in [None] + list(range(1, 1 << world)):
+                    sends = {
+                        r: set(
+                            proto.wave_send_targets(
+                                world, r, gather_only, contrib, fanout
+                            )
                         )
-                    )
-                    for r in range(world)
-                }
-                recvs = {
-                    r: set(
-                        proto.wave_recv_sources(
-                            world, r, gather_only, contrib
+                        for r in range(world)
+                    }
+                    recvs = {
+                        r: set(
+                            proto.wave_recv_sources(
+                                world, r, gather_only, contrib, fanout
+                            )
                         )
-                    )
-                    for r in range(world)
-                }
-                for r in range(world):
-                    for p in range(world):
-                        if p == r:
-                            continue
-                        assert (p in sends[r]) == (r in recvs[p]), (
-                            world, gather_only, contrib, r, p,
-                        )
+                        for r in range(world)
+                    }
+                    for r in range(world):
+                        for p in range(world):
+                            if p == r:
+                                continue
+                            assert (p in sends[r]) == (r in recvs[p]), (
+                                world, gather_only, contrib, fanout,
+                                r, p,
+                            )
+
+
+def test_tree_fanout_resolution():
+    """protocol.tree_fanout is the ONE resolver of
+    PATHWAY_MESH_TREE_FANOUT (engine env + checker config drive it):
+    auto = fanout 2 at world >= 4, off/garbage degrade safely, small
+    worlds never tree (every rank is already rank 0's direct child)."""
+    assert proto.tree_fanout(4, "auto") == 2
+    assert proto.tree_fanout(8, None) == 2
+    assert proto.tree_fanout(3, "auto") == 0
+    assert proto.tree_fanout(2, "auto") == 0
+    assert proto.tree_fanout(8, "off") == 0
+    assert proto.tree_fanout(8, "0") == 0
+    assert proto.tree_fanout(8, "3") == 3
+    assert proto.tree_fanout(8, 4) == 4
+    assert proto.tree_fanout(8, "1") == 0  # fanout 1 is a chain: refuse
+    assert proto.tree_fanout(8, "garbage") == 2  # unparsable -> auto
+    assert proto.tree_fanout(2, "2") == 0  # world 2 is already flat
+
+
+def test_tree_topology_units():
+    # heap layout: parent/children are mutual inverses over any world
+    for world in (3, 4, 5, 8, 13):
+        for fanout in (2, 3):
+            for r in range(1, world):
+                p = proto.tree_parent(r, fanout)
+                assert 0 <= p < r
+                assert r in proto.tree_children(p, world, fanout)
+            # children partition 1..world-1
+            seen = []
+            for r in range(world):
+                seen.extend(proto.tree_children(r, world, fanout))
+            assert sorted(seen) == list(range(1, world))
+    assert proto.tree_depth(4, 2) == 2
+    assert proto.tree_depth(8, 2) == 3
+    assert proto.tree_depth(16, 2) == 4
+    assert proto.tree_depth(5, 4) == 1
+    assert proto.tree_depth(6, 4) == 2
+    assert proto.tree_depth(4, 0) == 0  # flat
+    assert proto.tree_depth(1, 2) == 0
+
+
+def test_tree_subtree_active_matches_descendant_set():
+    """A rank's send leg exists iff its subtree holds a contributor —
+    brute-force the descendant sets against the recursive predicate."""
+    for world in (4, 5, 7):
+        fanout = 2
+        desc = {r: {r} for r in range(world)}
+        for r in reversed(range(world)):
+            for c in proto.tree_children(r, world, fanout):
+                desc[r] |= desc[c]
+        for contrib in range(1, 1 << world):
+            for r in range(world):
+                expect = any((contrib >> d) & 1 for d in desc[r])
+                assert proto.tree_subtree_active(
+                    r, world, fanout, contrib
+                ) == expect, (world, contrib, r)
+
+
+def test_tree_relay_concatenates_own_then_relayed():
+    own = [(1, ("a",)), (2, ("b",))]
+    rel = [(1, ("c",))]
+    assert proto.tree_relay(own, rel) == own + rel
+    assert proto.tree_relay([], rel) == rel
+    assert proto.tree_relay(own, []) == own
+
+
+def test_tree_gather_checker_clean_and_deterministic_world4():
+    """The shipped tree transition verifies clean at world 4 (auto
+    resolves fanout 2 — exactly what a real 4-rank mesh drives), and
+    the exploration is deterministic."""
+    cfg = mc.MeshCheckConfig(world=4, rounds=2, fault_budget=1)
+    a = mc.check(cfg)
+    b = mc.check(cfg)
+    assert not a.violations, a.violations[:1]
+    assert a.complete
+    assert (a.states, a.transitions) == (b.states, b.transitions)
+    # the tree is actually in the model: forcing it off explores a
+    # DIFFERENT state space (flat gather legs)
+    flat = mc.check(
+        mc.MeshCheckConfig(
+            world=4, rounds=2, fault_budget=1, tree_knob="off"
+        )
+    )
+    assert not flat.violations
+    assert flat.states != a.states
+
+
+def test_drop_relay_mutant_caught_with_replayable_trace():
+    """The drop_relay mutant (interior ranks forward only their own
+    slices) must surface as lost deltas at world 4 — whole subtrees'
+    gather output vanishes — with a minimal trace whose fault plan
+    loads as real internals/faults.py rules."""
+    rep = mc.check(
+        mc.MeshCheckConfig(
+            world=4, rounds=2, fault_budget=1, mutate="drop_relay"
+        )
+    )
+    assert rep.violations, "drop_relay NOT caught"
+    v = rep.violations[0]
+    assert v.kind == "exactly-once", (v.kind, v.detail)
+    assert "lost" in v.detail
+    assert v.trace
+    plan = v.fault_plan()
+    if plan is not None:
+        _validate_fault_plan(plan)
+
+
+def test_drop_relay_invisible_without_interior_ranks():
+    """The mutant only bites where a relay exists: world 3 (auto = no
+    tree) and world 4 with the tree forced off must verify clean — the
+    bug class is unreachable on flat topologies, which is exactly why
+    the checker must explore the tree transition."""
+    for cfg in (
+        mc.MeshCheckConfig(
+            world=3, rounds=2, fault_budget=1, mutate="drop_relay"
+        ),
+        mc.MeshCheckConfig(
+            world=4, rounds=2, fault_budget=1, mutate="drop_relay",
+            tree_knob="off",
+        ),
+    ):
+        rep = mc.check(cfg)
+        assert not rep.violations, (cfg.world, cfg.tree_knob)
 
 
 def test_commit_plan_is_rank_major_stride2_sorted():
