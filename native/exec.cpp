@@ -34,7 +34,9 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <memory>
 #include <string>
@@ -420,6 +422,7 @@ enum TraceTag : uint16_t {
     T_NB_ENCODE = 4,  /* wire encode */
     T_NB_DECODE = 5,  /* wire decode (receiver threads) */
     T_NB_CONCAT = 6,  /* arena-rebased exchange merge */
+    T_ARROW_EXPORT = 7, /* columnar egress: Arrow record-batch export */
 };
 
 struct TraceEv {
@@ -3607,9 +3610,17 @@ PyObject *nb_iter(PyObject *self)
     return it;
 }
 
+PyObject *nb_width(PyObject *self, PyObject *)
+{
+    return PyLong_FromLong(
+        reinterpret_cast<NativeBatchObject *>(self)->width);
+}
+
 PyMethodDef nb_methods[] = {
     {"materialize", nb_materialize, METH_NOARGS,
      "materialize() -> [(key, row, 1), ...] (cached)"},
+    {"width", nb_width, METH_NOARGS,
+     "width() -> number of value columns (no materialization)"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -5461,6 +5472,485 @@ fail:
     return nullptr;
 }
 
+/* ==== columnar egress: Arrow C data interface export ===================
+ *
+ * The zero-copy capture/export path (ISSUE 14): a NativeBatch's C-owned
+ * typed column buffers are assembled into an Arrow record batch through
+ * the Arrow C data interface — the stable cross-library ABI pyarrow
+ * imports without copying (pa.RecordBatch._import_from_c). Buffers are
+ * DONATED: the export copies the column images into buffers owned by a
+ * refcounted holder that the consumer's release callbacks free, so the
+ * record batch outlives the NativeBatch and the engine never sees a
+ * dangling view. Assembly runs GIL-free (plain memcpy/bit-packing —
+ * scripts/lint_gil.py clean) and reports on the flight-recorder ring as
+ * an `arrow_export` native span.
+ *
+ * Column typing: a NativeBatch column exports when its non-null cells
+ * share ONE tag (int64 -> "l", float64 -> "g", bool -> "b", utf8 ->
+ * "u", all-null -> "n"); NB_NONE cells become Arrow nulls under a
+ * validity bitmap. A mixed-tag column (int cells next to str cells —
+ * only reachable through untyped object sources) makes the whole export
+ * return None and the caller falls back to the row-expanding path, the
+ * graceful degradation the egress counters make visible. */
+
+#ifndef ARROW_C_DATA_INTERFACE
+#define ARROW_C_DATA_INTERFACE
+
+#define ARROW_FLAG_NULLABLE 2
+
+struct ArrowSchema {
+    const char *format;
+    const char *name;
+    const char *metadata;
+    int64_t flags;
+    int64_t n_children;
+    struct ArrowSchema **children;
+    struct ArrowSchema *dictionary;
+    void (*release)(struct ArrowSchema *);
+    void *private_data;
+};
+
+struct ArrowArray {
+    int64_t length;
+    int64_t null_count;
+    int64_t offset;
+    int64_t n_buffers;
+    int64_t n_children;
+    const void **buffers;
+    struct ArrowArray **children;
+    struct ArrowArray *dictionary;
+    void (*release)(struct ArrowArray *);
+    void *private_data;
+};
+
+#endif /* ARROW_C_DATA_INTERFACE */
+
+/* Everything one export donates, freed only when BOTH the consumer's
+ * schema and array copies released (pyarrow may drop them on different
+ * threads at GC time — the refcount is atomic). Child structs live in
+ * reserved vectors so their addresses stay stable; buffers in deques
+ * for the same reason. */
+struct ArrowHolder {
+    std::deque<std::vector<uint8_t>> bufs;
+    std::deque<std::vector<const void *>> bufptrs;
+    std::deque<std::string> strs; /* column-name storage */
+    std::vector<ArrowSchema> schemas;      /* children */
+    std::vector<ArrowArray> arrays;        /* children */
+    std::vector<ArrowSchema *> schema_children;
+    std::vector<ArrowArray *> array_children;
+    std::atomic<int> refs{2}; /* schema shell + array shell */
+};
+
+void arrow_holder_unref(ArrowHolder *h)
+{
+    if (h != nullptr && h->refs.fetch_sub(1) == 1)
+        delete h;
+}
+
+/* child storage is holder-owned: releasing a child only marks it */
+void pw_arrow_child_schema_release(ArrowSchema *s) { s->release = nullptr; }
+void pw_arrow_child_array_release(ArrowArray *a) { a->release = nullptr; }
+
+void pw_arrow_schema_release(ArrowSchema *s)
+{
+    for (int64_t i = 0; i < s->n_children; i++) {
+        ArrowSchema *c = s->children[i];
+        if (c != nullptr && c->release != nullptr)
+            c->release(c);
+    }
+    s->release = nullptr;
+    arrow_holder_unref((ArrowHolder *)s->private_data);
+}
+
+void pw_arrow_array_release(ArrowArray *a)
+{
+    for (int64_t i = 0; i < a->n_children; i++) {
+        ArrowArray *c = a->children[i];
+        if (c != nullptr && c->release != nullptr)
+            c->release(c);
+    }
+    a->release = nullptr;
+    arrow_holder_unref((ArrowHolder *)a->private_data);
+}
+
+/* build one exported column (GIL-free: memcpy/bit ops only).
+ * `unified` is the column's single non-null tag (NB_NONE = all-null). */
+void arrow_build_col(ArrowHolder *h, const NbCol &col, size_t n,
+                     uint8_t unified, const char *name)
+{
+    auto add_buf = [&](size_t bytes) -> uint8_t * {
+        h->bufs.emplace_back(bytes > 0 ? bytes : 1);
+        return h->bufs.back().data();
+    };
+    int64_t nulls = 0;
+    for (size_t i = 0; i < n; i++)
+        if (col.tag[i] == NB_NONE)
+            nulls++;
+    const uint8_t *validity = nullptr;
+    if (nulls > 0 && unified != NB_NONE) {
+        uint8_t *vb = add_buf((n + 7) / 8);
+        memset(vb, 0, (n + 7) / 8);
+        for (size_t i = 0; i < n; i++)
+            if (col.tag[i] != NB_NONE)
+                vb[i >> 3] |= (uint8_t)(1u << (i & 7));
+        validity = vb;
+    }
+    const char *fmt;
+    h->bufptrs.emplace_back();
+    std::vector<const void *> &bp = h->bufptrs.back();
+    int64_t n_buffers;
+    switch (unified) {
+    case NB_NONE: /* all-null column -> Arrow null type */
+        fmt = "n";
+        n_buffers = 0;
+        nulls = (int64_t)n;
+        break;
+    case NB_BOOL: {
+        fmt = "b";
+        uint8_t *vals = add_buf((n + 7) / 8);
+        memset(vals, 0, (n + 7) / 8);
+        for (size_t i = 0; i < n; i++)
+            if (col.word[i])
+                vals[i >> 3] |= (uint8_t)(1u << (i & 7));
+        bp = {validity, vals};
+        n_buffers = 2;
+        break;
+    }
+    case NB_INT:
+    case NB_FLT: {
+        /* word already holds the int64 value or the double's bit
+         * image — one memcpy IS the Arrow values buffer */
+        fmt = unified == NB_INT ? "l" : "g";
+        uint8_t *vals = add_buf(n * 8);
+        if (n > 0)
+            memcpy(vals, col.word.data(), n * 8);
+        bp = {validity, vals};
+        n_buffers = 2;
+        break;
+    }
+    default: { /* NB_STR -> utf8 (int32 offsets + data) */
+        fmt = "u";
+        uint8_t *offs_b = add_buf((n + 1) * 4);
+        int32_t *offs = (int32_t *)offs_b;
+        size_t total = 0;
+        for (size_t i = 0; i < n; i++)
+            if (col.tag[i] == NB_STR)
+                total += col.len[i];
+        uint8_t *data = add_buf(total);
+        size_t pos = 0;
+        offs[0] = 0;
+        for (size_t i = 0; i < n; i++) {
+            if (col.tag[i] == NB_STR && col.len[i] > 0) {
+                memcpy(data + pos, col.arena.data() + (size_t)col.word[i],
+                       col.len[i]);
+                pos += col.len[i];
+            }
+            offs[i + 1] = (int32_t)pos;
+        }
+        bp = {validity, offs_b, data};
+        n_buffers = 3;
+        break;
+    }
+    }
+    h->strs.emplace_back(name);
+    ArrowSchema s;
+    s.format = fmt;
+    s.name = h->strs.back().c_str();
+    s.metadata = nullptr;
+    s.flags = ARROW_FLAG_NULLABLE;
+    s.n_children = 0;
+    s.children = nullptr;
+    s.dictionary = nullptr;
+    s.release = pw_arrow_child_schema_release;
+    s.private_data = nullptr;
+    h->schemas.push_back(s);
+    ArrowArray a;
+    a.length = (int64_t)n;
+    a.null_count = nulls;
+    a.offset = 0;
+    a.n_buffers = n_buffers;
+    a.n_children = 0;
+    a.buffers = bp.data();
+    a.children = nullptr;
+    a.dictionary = nullptr;
+    a.release = pw_arrow_child_array_release;
+    a.private_data = nullptr;
+    h->arrays.push_back(a);
+}
+
+/* one fixed-width extra column (key bytes / constant diff) */
+void arrow_build_fixed_col(ArrowHolder *h, const char *fmt,
+                           const char *name, const void *data,
+                           size_t bytes, size_t n)
+{
+    h->bufs.emplace_back(bytes > 0 ? bytes : 1);
+    if (bytes > 0)
+        memcpy(h->bufs.back().data(), data, bytes);
+    h->bufptrs.emplace_back(
+        std::vector<const void *>{nullptr, h->bufs.back().data()});
+    h->strs.emplace_back(name);
+    ArrowSchema s;
+    s.format = fmt;
+    s.name = h->strs.back().c_str();
+    s.metadata = nullptr;
+    s.flags = 0;
+    s.n_children = 0;
+    s.children = nullptr;
+    s.dictionary = nullptr;
+    s.release = pw_arrow_child_schema_release;
+    s.private_data = nullptr;
+    h->schemas.push_back(s);
+    ArrowArray a;
+    a.length = (int64_t)n;
+    a.null_count = 0;
+    a.offset = 0;
+    a.n_buffers = 2;
+    a.n_children = 0;
+    a.buffers = h->bufptrs.back().data();
+    a.children = nullptr;
+    a.dictionary = nullptr;
+    a.release = pw_arrow_child_array_release;
+    a.private_data = nullptr;
+    h->arrays.push_back(a);
+}
+
+/* nb_export_arrow(nb, names[, include_key, include_diff])
+ *   -> (schema_addr, array_addr) | None
+ *
+ * Donating export of one NativeBatch as an Arrow struct/record batch.
+ * The two addresses are malloc'd ArrowSchema/ArrowArray shells the
+ * caller hands to pa.RecordBatch._import_from_c (which MOVES the
+ * contents and marks the shells released) and then returns to
+ * arrow_shells_free. None = a column mixes value tags (caller falls
+ * back to the row path; counted, never an error). include_key adds a
+ * "_key" fixed_size_binary(16) column (the engine's 128-bit row keys,
+ * little-endian); include_diff a constant +1 "diff" int64 column (nb
+ * batches are insert-only net form by construction). */
+PyObject *nb_export_arrow(PyObject *, PyObject *args)
+{
+    PyObject *nb_obj, *names;
+    int include_key = 0, include_diff = 0;
+    if (!PyArg_ParseTuple(args, "O!O!|pp", &NativeBatchType, &nb_obj,
+                          &PyTuple_Type, &names, &include_key,
+                          &include_diff))
+        return nullptr;
+    auto *nb = reinterpret_cast<NativeBatchObject *>(nb_obj);
+    if (PyTuple_GET_SIZE(names) != (Py_ssize_t)nb->width) {
+        PyErr_SetString(PyExc_ValueError,
+                        "nb_export_arrow: names width mismatch");
+        return nullptr;
+    }
+    /* extract names with the GIL held — the region below is Py-free */
+    std::vector<std::string> colnames((size_t)nb->width);
+    for (Py_ssize_t j = 0; j < (Py_ssize_t)nb->width; j++) {
+        PyObject *s = PyTuple_GET_ITEM(names, j);
+        Py_ssize_t sl;
+        const char *sp = PyUnicode_AsUTF8AndSize(s, &sl);
+        if (sp == nullptr)
+            return nullptr;
+        colnames[(size_t)j].assign(sp, (size_t)sl);
+    }
+    const size_t n = (size_t)nb->n;
+    const int width = nb->width;
+    const int ncols = width + (include_key ? 1 : 0) + (include_diff ? 1 : 0);
+    auto *h = new ArrowHolder();
+    h->schemas.reserve((size_t)ncols);
+    h->arrays.reserve((size_t)ncols);
+    auto *top_s = (ArrowSchema *)malloc(sizeof(ArrowSchema));
+    auto *top_a = (ArrowArray *)malloc(sizeof(ArrowArray));
+    if (top_s == nullptr || top_a == nullptr) {
+        free(top_s);
+        free(top_a);
+        delete h;
+        return PyErr_NoMemory();
+    }
+    bool mixed = false;
+    Py_BEGIN_ALLOW_THREADS;
+    {
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
+        /* pass 1: unified tag per column (NB_NONE cells don't count).
+         * String columns also sum their data bytes: utf8 exports with
+         * int32 offsets, so a column past INT32_MAX data bytes takes
+         * the same not-exportable verdict as a mixed-tag column (row
+         * fallback) instead of silently wrapping the offsets. */
+        std::vector<uint8_t> unified((size_t)width, NB_NONE);
+        for (int c = 0; c < width && !mixed; c++) {
+            const NbCol &col = (*nb->cols)[(size_t)c];
+            uint8_t u = NB_NONE;
+            uint64_t str_bytes = 0;
+            for (size_t i = 0; i < n; i++) {
+                const uint8_t t = col.tag[i];
+                if (t == NB_NONE)
+                    continue;
+                if (t == NB_STR)
+                    str_bytes += col.len[i];
+                if (u == NB_NONE)
+                    u = t;
+                else if (u != t) {
+                    mixed = true;
+                    break;
+                }
+            }
+            if (str_bytes > (uint64_t)INT32_MAX)
+                mixed = true;
+            unified[(size_t)c] = u;
+        }
+        if (!mixed) {
+            for (int c = 0; c < width; c++)
+                arrow_build_col(h, (*nb->cols)[(size_t)c], n,
+                                unified[(size_t)c],
+                                colnames[(size_t)c].c_str());
+            if (include_key)
+                arrow_build_fixed_col(h, "w:16", "_key", nb->keys->data(),
+                                      n * 16, n);
+            if (include_diff) {
+                std::vector<int64_t> ones(n, 1);
+                arrow_build_fixed_col(h, "l", "diff", ones.data(), n * 8,
+                                      n);
+            }
+            h->schema_children.resize((size_t)ncols);
+            h->array_children.resize((size_t)ncols);
+            for (int c = 0; c < ncols; c++) {
+                h->schema_children[(size_t)c] = &h->schemas[(size_t)c];
+                h->array_children[(size_t)c] = &h->arrays[(size_t)c];
+            }
+            top_s->format = "+s";
+            top_s->name = "";
+            top_s->metadata = nullptr;
+            top_s->flags = 0;
+            top_s->n_children = ncols;
+            top_s->children = h->schema_children.data();
+            top_s->dictionary = nullptr;
+            top_s->release = pw_arrow_schema_release;
+            top_s->private_data = h;
+            h->bufptrs.emplace_back(std::vector<const void *>{nullptr});
+            top_a->length = (int64_t)n;
+            top_a->null_count = 0;
+            top_a->offset = 0;
+            top_a->n_buffers = 1;
+            top_a->n_children = ncols;
+            top_a->buffers = h->bufptrs.back().data();
+            top_a->children = h->array_children.data();
+            top_a->dictionary = nullptr;
+            top_a->release = pw_arrow_array_release;
+            top_a->private_data = h;
+        }
+        if (_tr0)
+            trace_note(T_ARROW_EXPORT, -1, _tr0, trace_now_ns(),
+                       (int64_t)n);
+    }
+    Py_END_ALLOW_THREADS;
+    if (mixed) {
+        delete h;
+        free(top_s);
+        free(top_a);
+        Py_RETURN_NONE;
+    }
+    return Py_BuildValue("(KK)", (unsigned long long)(uintptr_t)top_s,
+                         (unsigned long long)(uintptr_t)top_a);
+}
+
+/* arrow_shells_free(schema_addr, array_addr) — return the two malloc'd
+ * shells after the consumer imported (moved) them. A shell whose
+ * release survived (import never ran / failed) is released here so the
+ * donation can't leak. */
+PyObject *arrow_shells_free(PyObject *, PyObject *args)
+{
+    unsigned long long s_addr, a_addr;
+    if (!PyArg_ParseTuple(args, "KK", &s_addr, &a_addr))
+        return nullptr;
+    auto *s = (ArrowSchema *)(uintptr_t)s_addr;
+    auto *a = (ArrowArray *)(uintptr_t)a_addr;
+    if (a != nullptr) {
+        if (a->release != nullptr)
+            a->release(a);
+        free(a);
+    }
+    if (s != nullptr) {
+        if (s->release != nullptr)
+            s->release(s);
+        free(s);
+    }
+    Py_RETURN_NONE;
+}
+
+/* capture_collect_nb(chunks) -> NativeBatch
+ *
+ * The columnar capture collector (ISSUE 14): takes the CaptureNode's
+ * pending [(NativeBatch, time), ...] chunks and returns ONE C-owned
+ * NativeBatch of width+1 whose last column is each chunk's commit
+ * timestamp (NB_INT) — committed output stays typed column buffers end
+ * to end, ready for one nb_export_arrow, with zero per-row Python.
+ * Width must agree across chunks (they come from one node's output). */
+PyObject *capture_collect_nb(PyObject *, PyObject *args)
+{
+    PyObject *lst;
+    if (!PyArg_ParseTuple(args, "O!", &PyList_Type, &lst))
+        return nullptr;
+    Py_ssize_t k = PyList_GET_SIZE(lst);
+    if (k == 0) {
+        PyErr_SetString(PyExc_ValueError, "capture_collect_nb: empty");
+        return nullptr;
+    }
+    std::vector<NativeBatchObject *> srcs((size_t)k);
+    std::vector<int64_t> times((size_t)k);
+    for (Py_ssize_t j = 0; j < k; j++) {
+        PyObject *item = PyList_GET_ITEM(lst, j);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 2 ||
+            !PyObject_TypeCheck(PyTuple_GET_ITEM(item, 0),
+                                &NativeBatchType)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "capture_collect_nb: [(nb, time), ...]");
+            return nullptr;
+        }
+        long long t = PyLong_AsLongLong(PyTuple_GET_ITEM(item, 1));
+        if (t == -1 && PyErr_Occurred())
+            return nullptr;
+        srcs[(size_t)j] = reinterpret_cast<NativeBatchObject *>(
+            PyTuple_GET_ITEM(item, 0));
+        times[(size_t)j] = (int64_t)t;
+    }
+    const int width = srcs[0]->width;
+    for (Py_ssize_t j = 1; j < k; j++)
+        if (srcs[(size_t)j]->width != width) {
+            PyErr_SetString(PyExc_ValueError,
+                            "capture_collect_nb: width mismatch");
+            return nullptr;
+        }
+    NativeBatchObject *out = nb_alloc(width + 1, srcs[0]->ptr_type);
+    if (out == nullptr)
+        return nullptr;
+    /* pin the sources with the GIL held (same discipline as nb_concat:
+     * the caller's list could drop an item while this runs GIL-free) */
+    for (Py_ssize_t j = 0; j < k; j++)
+        Py_INCREF(srcs[(size_t)j]);
+    Py_BEGIN_ALLOW_THREADS;
+    {
+        const uint64_t _tr0 = trace_on() ? trace_now_ns() : 0;
+        NbCol &tc = (*out->cols)[(size_t)width];
+        for (Py_ssize_t j = 0; j < k; j++) {
+            NativeBatchObject *src = srcs[(size_t)j];
+            out->keys->insert(out->keys->end(), src->keys->begin(),
+                              src->keys->end());
+            for (int c = 0; c < width; c++)
+                nbcol_append((*out->cols)[(size_t)c],
+                             (*src->cols)[(size_t)c]);
+            const size_t nj = (size_t)src->n;
+            tc.tag.insert(tc.tag.end(), nj, NB_INT);
+            tc.word.insert(tc.word.end(), nj, times[(size_t)j]);
+            tc.len.insert(tc.len.end(), nj, 0);
+        }
+        out->n = (Py_ssize_t)out->keys->size();
+        if (_tr0)
+            trace_note(T_ARROW_EXPORT, -1, _tr0, trace_now_ns(),
+                       (int64_t)out->n);
+    }
+    Py_END_ALLOW_THREADS;
+    for (Py_ssize_t j = 0; j < k; j++)
+        Py_DECREF(srcs[(size_t)j]);
+    return reinterpret_cast<PyObject *>(out);
+}
+
 /* process_batch_nb(store, nb, g_idxs, arg_idxs, key_fn, error
  *                  [, time, out_type])
  *
@@ -6047,6 +6537,16 @@ PyMethodDef methods[] = {
     {"capture_apply_nb", capture_apply_nb, METH_VARARGS,
      "capture_apply_nb(rows_dict, updates, nb, time) — one-pass columnar "
      "capture expansion"},
+    {"capture_collect_nb", capture_collect_nb, METH_VARARGS,
+     "capture_collect_nb([(nb, time), ...]) -> NativeBatch — C-owned "
+     "columnar capture collector (width+1: appended int64 time column)"},
+    {"nb_export_arrow", nb_export_arrow, METH_VARARGS,
+     "nb_export_arrow(nb, names[, include_key, include_diff]) -> "
+     "(schema_addr, array_addr) | None — donating Arrow C-data-interface "
+     "export (GIL-free assembly; None = mixed-tag column, row fallback)"},
+    {"arrow_shells_free", arrow_shells_free, METH_VARARGS,
+     "arrow_shells_free(schema_addr, array_addr) — free the malloc'd "
+     "shells after import; releases un-imported donations"},
     {"parse_upserts_nb", parse_upserts_nb, METH_VARARGS,
      "parse_upserts_nb(msgs, start, cols, defaults, key_base, seq0, ptr) "
      "-> (NativeBatch, new_seq) | None"},

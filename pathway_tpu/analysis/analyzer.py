@@ -486,47 +486,90 @@ def _replay_pass(
 # -- pass 4: serving/egress sinks -----------------------------------------
 
 def _sink_pass(runtime, diags: list[Diagnostic]) -> None:
-    """Blame row-expanding serving sinks: an OutputNode delivering
-    through a per-row Python ``on_change`` callback expands every batch
-    row-wise at the egress — the CaptureNode-style de-optimization
-    (ROADMAP item 2) that throttles an otherwise-batched serving path.
-    The batched subscribe path (``on_batch=`` on ``pw.io.subscribe`` /
-    ``rest_connector``'s window fan-out) delivers one callback per
-    batch instead."""
+    """Egress verdicts keyed on the CONSUMER's declared capability
+    (ISSUE 14 satellite — the old pass blamed per-row ``on_change``
+    only, and would mis-blame an ``on_batch=`` subscriber even when its
+    batches arrive columnar). Three verdicts per egress node, shared
+    with the runtime counters through ``eligibility.sink_egress_
+    decision``:
+
+    * **fused** — input chain statically columnar AND the consumer is
+      Arrow-capable (``batch_format='arrow'`` subscribe, the txn
+      file/Delta sinks, CaptureNode's columnar export): no diagnostic,
+      ``capture_rows_expanded_total`` stays flat;
+    * **row-expanding** — input columnar but the consumer demands rows
+      (per-row ``on_change`` / rows-mode ``on_batch``): the sink IS the
+      de-optimization, ``sink.row-expanding`` fires with the consumer
+      blame;
+    * **degraded** — input chain not statically columnar: the sink is
+      not to blame (upstream fusion blame applies); a per-row
+      ``on_change`` still gets the batching hint at info severity."""
+    from pathway_tpu.analysis import eligibility as _elig
     from pathway_tpu.engine import nodes as N
 
     for node in runtime.scope.nodes:
-        if not isinstance(node, N.OutputNode):
+        if not isinstance(node, (N.OutputNode, N.CaptureNode)):
             continue
-        if node._on_change is None or node._on_batch is not None:
-            continue  # batched (or callback-free probe) egress
-        via = (
-            "the C delivery loop builds its row dicts, but the callback "
-            "still fires once per row"
-            if node._dict_cols is not None
-            else "each C-owned batch row expands through a Python "
-            "callback"
-        )
-        diags.append(
-            Diagnostic(
-                code="sink.row-expanding",
-                severity="info",
-                node=_node_label(node),
-                message=(
-                    f"per-row on_change sink: {via} — under load this "
-                    f"egress pays one Python call per change, the same "
-                    f"row expansion that throttles CaptureNode "
-                    f"materialization"
-                ),
-                hint=(
-                    "deliver batched: pass on_batch= to pw.io.subscribe "
-                    "(one callback per delivered batch/window) — the "
-                    "rest_connector response path already fans out this "
-                    "way"
-                ),
-                where=_where(node),
+        verdict = _elig.sink_egress_verdict(node)
+        if verdict == "fused":
+            continue  # fused egress: columnar to the edge
+        if verdict == "row-expanding":
+            blame = "; ".join(_elig.sink_consumer_columnar(node).reasons)
+            diags.append(
+                Diagnostic(
+                    code="sink.row-expanding",
+                    severity="info",
+                    node=_node_label(node),
+                    message=(
+                        f"columnar batches row-expand at this sink: "
+                        f"{blame} — every C-owned batch materializes "
+                        f"into Python rows at the egress, the expansion "
+                        f"that throttles value_incl_capture"
+                    ),
+                    hint=(
+                        "consume columnar: pw.io.subscribe(..., "
+                        "on_batch=, batch_format='arrow') delivers "
+                        "Arrow record batches straight off the column "
+                        "buffers; pw.io.fs/csv/jsonlines/deltalake "
+                        "writers already do (unset PATHWAY_NO_NB_CAPTURE "
+                        "if forced off)"
+                    ),
+                    where=_where(node),
+                )
             )
-        )
+            continue
+        if (
+            isinstance(node, N.OutputNode)
+            and node._on_change is not None
+            and node._on_batch is None
+        ):
+            via = (
+                "the C delivery loop builds its row dicts, but the "
+                "callback still fires once per row"
+                if node._dict_cols is not None
+                else "each delivered batch expands through a Python "
+                "callback"
+            )
+            diags.append(
+                Diagnostic(
+                    code="sink.row-expanding",
+                    severity="info",
+                    node=_node_label(node),
+                    message=(
+                        f"per-row on_change sink: {via} — under load "
+                        f"this egress pays one Python call per change "
+                        f"(input chain is not columnar here, so the "
+                        f"upstream fusion blame applies first)"
+                    ),
+                    hint=(
+                        "deliver batched: pass on_batch= to "
+                        "pw.io.subscribe (one callback per delivered "
+                        "batch/window) — the rest_connector response "
+                        "path already fans out this way"
+                    ),
+                    where=_where(node),
+                )
+            )
 
 
 # -- pass 5: distributed safety (the mesh verifier) -------------------------

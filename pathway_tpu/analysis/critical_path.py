@@ -74,6 +74,11 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
     # decode leg, plus the byte ratio over compressed segments — the
     # trace-side answer to "did compression help"
     decomp_s: dict[int, float] = defaultdict(float)
+    # egress leg (ISSUE 14): per-rank seconds in the GIL-free Arrow
+    # capture/export regions (exec.cpp T_ARROW_EXPORT) — the columnar
+    # sink cost, reported next to compute so "capture is now free" is
+    # auditable from the trace
+    egress_s: dict[int, float] = defaultdict(float)
     codec_wire = 0
     codec_raw = 0
     for e in events:
@@ -81,6 +86,9 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
             continue
         cat = e.get("cat")
         pid = e.get("pid", 0)
+        if cat == "native" and str(e.get("name", "")) == "arrow_export":
+            egress_s[pid] += e.get("dur", 0.0) / 1e6
+            continue
         if cat == "wave":
             args = e.get("args") or {}
             key = (args.get("t"), e.get("name"))
@@ -289,6 +297,9 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
     for rank, dz in decomp_s.items():
         if rank not in decode_s:
             legs[rank]["decompress_s"] = round(dz, 6)
+    for rank, s in egress_s.items():
+        if s > 0:
+            legs[rank]["egress_s"] = round(s, 6)
     return {
         "path": path,
         "valid": not problems,
@@ -342,6 +353,11 @@ def render_critical_path(report: dict) -> str:
                 + (
                     f" decompress={d['decompress_s']:.4f}"
                     if "decompress_s" in d
+                    else ""
+                )
+                + (
+                    f" egress={d['egress_s']:.4f}"
+                    if "egress_s" in d
                     else ""
                 )
             )

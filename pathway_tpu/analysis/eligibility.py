@@ -63,6 +63,13 @@ def nb_exchange_forced_off() -> bool:
     return env_flag("PATHWAY_NO_NB_EXCHANGE")
 
 
+def nb_capture_forced_off() -> bool:
+    """PATHWAY_NO_NB_CAPTURE=1 forces the row-expanding egress path
+    (capture/sinks materialize Python rows) — the parity knob for the
+    columnar-egress battery (ISSUE 14)."""
+    return env_flag("PATHWAY_NO_NB_CAPTURE")
+
+
 def nb_strict() -> bool:
     return env_flag("PATHWAY_NB_STRICT")
 
@@ -439,6 +446,135 @@ def expects_native_batch(node) -> bool:
         )
     node._expects_nb_cache = val
     return val
+
+
+def sink_consumer_columnar(node) -> NBDecision:
+    """Does this egress node's CONSUMER declare columnar (Arrow-batch)
+    capability? The sink half of the egress verdict (ISSUE 14): an
+    OutputNode delivering through ``on_batch_arrow`` (Arrow-mode
+    subscribe, the transactional file/Delta sinks) or a CaptureNode
+    (whose pending chunks export columnar on read) consumes NativeBatch
+    output without row expansion; a per-row ``on_change`` or a rows-mode
+    ``on_batch`` expands every C-owned batch back into Python rows.
+    Keyed on the consumer's *declared* capability, not on what happened
+    at runtime — the Plan Doctor's ``sink.row-expanding`` diagnostic and
+    the runtime's ``capture_rows_expanded_total`` counter must agree."""
+    from pathway_tpu.engine import nodes as N
+
+    reasons: list[str] = []
+    if isinstance(node, N.CaptureNode):
+        try:
+            from pathway_tpu.io._arrow import arrow_capable
+
+            if not arrow_capable():
+                reasons.append(
+                    "capture export needs pyarrow + the native toolchain"
+                )
+        except Exception:
+            reasons.append("columnar capture export unavailable")
+    elif isinstance(node, N.OutputNode):
+        if getattr(node, "_on_batch_arrow", None) is None:
+            if getattr(node, "_on_batch", None) is not None:
+                reasons.append(
+                    "rows-mode on_batch consumer (each delivered batch "
+                    "materializes into (key, row, diff) tuples)"
+                )
+            if getattr(node, "_on_change", None) is not None:
+                reasons.append(
+                    "per-row on_change consumer (one Python call per "
+                    "change)"
+                )
+            # no reasons = a callback-free probe (e.g. a neutered
+            # non-writer rank): the runtime never materializes its
+            # batches, so it cannot row-expand — verdict stays ok
+        elif getattr(node, "_on_change", None) is not None:
+            # rows are needed anyway for the per-row callback — the
+            # arrow leg would be pure extra work, so the node stays on
+            # the row path by construction
+            reasons.append(
+                "per-row on_change registered beside the Arrow consumer "
+                "(rows must materialize regardless)"
+            )
+        else:
+            # the Arrow consumer is declared, but can this process
+            # actually export? Without pyarrow/toolchain every delivery
+            # falls to the row path — claiming fused here would be
+            # exactly the plan-vs-counters drift this module prevents
+            try:
+                from pathway_tpu.io._arrow import arrow_capable
+
+                if not arrow_capable() and not nb_capture_forced_off():
+                    reasons.append(
+                        "arrow egress needs pyarrow + the native "
+                        "toolchain"
+                    )
+            except Exception:
+                reasons.append("columnar egress export unavailable")
+    else:
+        reasons.append("not an egress node")
+    if nb_capture_forced_off():
+        reasons.append("PATHWAY_NO_NB_CAPTURE forces the row path")
+    return NBDecision(not reasons, tuple(reasons))
+
+
+def sink_input_columnar(node) -> bool:
+    """Does the sink's input chain deliver columnar batches in the
+    steady state? (The chain half of the egress verdict.)"""
+    return bool(node.inputs) and expects_native_batch(node.inputs[0])
+
+
+def sink_egress_verdict(node) -> str:
+    """THE three-way egress verdict — ``"fused"`` (columnar chain +
+    columnar consumer: no row ever expands), ``"row-expanding"``
+    (columnar chain but a rows consumer: the sink IS the
+    de-optimization), ``"degraded"`` (tuple chain: upstream fusion
+    blame applies first). Shared by the analyzer's sink pass and the
+    flight recorder's node metadata (via :func:`sink_row_expands`), so
+    static verdict, traced verdict and the runtime's
+    ``capture_rows_expanded_total`` counter cannot drift."""
+    consumer = sink_consumer_columnar(node)
+    columnar_in = sink_input_columnar(node)
+    if consumer.ok and columnar_in:
+        return "fused"
+    if columnar_in:
+        return "row-expanding"
+    return "degraded"
+
+
+def sink_row_expands(node) -> bool:
+    """Does this egress pay avoidable PER-ROW Python work? True for a
+    per-row ``on_change`` callback (always), a rows consumer over a
+    statically-columnar chain (every C-owned batch materializes), and
+    a CaptureNode that cannot read out columnar (no door, forced off,
+    or tuple input — its readers expand). A batched rows consumer of
+    an already-tuple chain is NOT row-expanding: those rows were never
+    columnar and one callback per batch is the best possible shape."""
+    from pathway_tpu.engine import nodes as N
+
+    consumer = sink_consumer_columnar(node)
+    columnar_in = sink_input_columnar(node)
+    if isinstance(node, N.CaptureNode):
+        return not (consumer.ok and columnar_in)
+    return getattr(node, "_on_change", None) is not None or (
+        columnar_in and not consumer.ok
+    )
+
+
+def sink_egress_decision(node) -> NBDecision:
+    """:func:`sink_egress_verdict` as an ``NBDecision`` (ok = fused),
+    with the consumer/chain blame attached — the strict-mode-style
+    handle tests and tooling consume."""
+    consumer = sink_consumer_columnar(node)
+    if not node.inputs:
+        return NBDecision(False, ("egress node has no input",))
+    if not sink_input_columnar(node):
+        return NBDecision(
+            False,
+            ("input chain is not statically columnar (upstream blame "
+             "applies — the sink is not the de-optimization)",)
+            + consumer.reasons,
+        )
+    return consumer
 
 
 def strict_error(node, event: str, cause: Exception | None = None):

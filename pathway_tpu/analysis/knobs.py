@@ -152,6 +152,10 @@ KNOBS: dict[str, Knob] = {
            "batteries)."),
         _k("PATHWAY_NO_NB_EXCHANGE", "bool", False,
            "Force exchanges onto the pickled tuple path."),
+        _k("PATHWAY_NO_NB_CAPTURE", "bool", False,
+           "Force the row-expanding egress path (capture/sinks "
+           "materialize Python rows instead of Arrow record batches) — "
+           "the rows-vs-arrow parity knob."),
         _k("PATHWAY_NB_STRICT", "bool", False,
            "Raise NBStrictError (with fusion blame) when a fused-eligible "
            "node demotes or de-optimizes to the tuple path, instead of "

@@ -139,6 +139,12 @@ def measured_verdict(meta_entry: dict, agg_entry: dict) -> str:
     tuple_batches = agg_entry["batches"] - agg_entry["nb_batches"]
     if meta_entry.get("row_expanding"):
         return "row-expanding sink"
+    if meta_entry.get("sink"):
+        # the egress leg (ISSUE 14): keyed on the consumer's declared
+        # capability, same decision the runtime counters audit
+        if meta_entry.get("egress") == "columnar":
+            return "columnar egress (arrow)"
+        return "rows egress"
     if verdict == "fused" and tuple_batches == 0 and agg_entry["batches"]:
         return "fused"
     if verdict == "fused":

@@ -2028,10 +2028,23 @@ class OutputNode(Node):
                               # (epoch, commit_ts, seq) instead of the bare
                               # time — the dedup handle for external
                               # systems (io/txn.py; ISSUE 12)
+        on_batch_arrow=None,  # fn(time, pa.RecordBatch): the columnar
+                              # egress consumer (ISSUE 14) — NativeBatch
+                              # deliveries export as Arrow record batches
+                              # (zero row expansion); tuple deltas still
+                              # route through on_batch
+        arrow_cols=None,      # column names for the Arrow export schema
+        arrow_key=False,      # include the _key fixed_size_binary(16)
+                              # column in Arrow deliveries
     ):
         super().__init__(scope, [input_node])
         self._on_change = on_change
         self._on_batch = on_batch
+        self._on_batch_arrow = on_batch_arrow
+        self._arrow_cols = (
+            tuple(arrow_cols) if arrow_cols is not None else None
+        )
+        self._arrow_key = bool(arrow_key)
         self._on_time_end = on_time_end
         self._on_end = on_end
         self._dict_cols = tuple(dict_cols) if dict_cols is not None else None
@@ -2050,8 +2063,86 @@ class OutputNode(Node):
             self._epoch = self.scope.runtime.mesh_epoch()
         return self._epoch
 
+    def _export_arrow(self, nb):
+        """NativeBatch → pa.RecordBatch via the C-data-interface export
+        (None = this batch can't export; the caller row-expands it)."""
+        from pathway_tpu.io._arrow import nb_to_arrow
+
+        if _elig.nb_capture_forced_off() or self._arrow_cols is None:
+            return None
+        return nb_to_arrow(
+            nb, self._arrow_cols, include_key=self._arrow_key,
+            include_diff=True,
+        )
+
     def process(self, time, batches):
-        deltas = consolidate(batches[0])
+        raw = batches[0]
+        if (
+            self._on_batch_arrow is not None
+            and self._on_change is None
+            and is_native_batch(raw)
+            and len(raw)
+        ):
+            # columnar egress (ISSUE 14): the C-owned batch exports as
+            # an Arrow record batch — no per-row Python objects at the
+            # sink. Gated on on_change being absent: a per-row callback
+            # needs the rows materialized regardless, so the arrow leg
+            # would be pure extra work there.
+            rb = self._export_arrow(raw)
+            if rb is not None:
+                n = rb.num_rows
+                self._seen_time = True
+                self.scope.runtime.stats.on_output(n)
+                self.scope.runtime.stats.on_capture_arrow_batch(n)
+                self.scope.runtime.note_output_emit(self, time, n)
+                self._seq += 1
+                if self._envelope:
+                    from pathway_tpu.io.txn import DeliveryEnvelope
+
+                    self._on_batch_arrow(
+                        DeliveryEnvelope(
+                            self._mesh_epoch(), time, self._seq
+                        ),
+                        rb,
+                    )
+                else:
+                    self._on_batch_arrow(time, rb)
+                return []
+            if _elig.nb_strict() and not _elig.nb_capture_forced_off():
+                from pathway_tpu.io._arrow import arrow_capable
+
+                # strict only when the export had the means and THIS
+                # batch still couldn't go (mixed-tag column): a process
+                # without pyarrow/toolchain was never fused-eligible —
+                # the plan says rows there, so rows is not a demotion
+                if arrow_capable():
+                    raise _elig.strict_error(
+                        self, "columnar egress fell back to the row path"
+                    )
+        if (
+            is_native_batch(raw)
+            and len(raw)
+            and self._on_batch is None
+            and self._on_change is None
+            and self._on_batch_arrow is None
+        ):
+            # callback-free probe (e.g. a neutered non-writer rank):
+            # nothing needs rows — don't materialize (and cache) them
+            self._seen_time = True
+            self.scope.runtime.stats.on_output(len(raw))
+            self.scope.runtime.note_output_emit(self, time, len(raw))
+            return []
+        # terminal read-only delivery: an already-net-form batch needs no
+        # aliasing copy here (consolidate would clone it) — callbacks get
+        # a shared view they must not mutate (documented on subscribe)
+        deltas = (
+            raw if type(raw) is ConsolidatedList else consolidate(raw)
+        )
+        if deltas and is_native_batch(raw):
+            # an egress node materialized a C-owned columnar batch back
+            # into Python rows — the row expansion the egress counters
+            # (and the Plan Doctor's sink.row-expanding verdict) name
+            self.scope.runtime.stats.on_capture_rows_expanded(len(deltas))
         if deltas:
             self._seen_time = True
             self.scope.runtime.stats.on_output(len(deltas))
@@ -2131,7 +2222,9 @@ class CaptureNode(Node):
         except Exception:
             ex = None
         fp = get_fp()
+        expanded = 0
         for nb, time in self._pending:
+            expanded += len(nb)
             if ex is not None and hasattr(ex, "capture_apply_nb"):
                 ex.capture_apply_nb(self._state.rows, self._updates, nb, time)
             elif fp is not None and hasattr(fp, "capture_apply"):
@@ -2144,6 +2237,64 @@ class CaptureNode(Node):
                 for k, row, d in deltas:
                     self._updates.append((k, row, time, d))
         self._pending.clear()
+        if expanded:
+            # deferred row expansion finally happened — the egress
+            # counter the columnar readers (arrow_table) never move
+            self.scope.runtime.stats.on_capture_rows_expanded(expanded)
+
+    def arrow_table(self, cols=None):
+        """Committed capture as ONE Arrow table — zero row expansion
+        (exec.cpp capture_collect_nb → nb_export_arrow): value columns
+        (named ``cols`` or ``c0..cN``), plus ``time`` (commit
+        timestamp), ``diff`` (+1; pending chunks are insert-only net
+        form) and the 16-byte ``_key`` column. Returns None when any
+        part of the capture already lives in row form (tuple deltas
+        arrived, or a reader expanded it), when a column can't export,
+        or when pyarrow/toolchain are missing — the caller falls back
+        to ``state``/``updates``. Non-consuming: ``state`` stays
+        readable afterwards. The export is cached per (pending-length,
+        names), so re-reads neither redo the C merge nor re-increment
+        the ``capture_arrow_*`` counters the egress audit pins."""
+        if _elig.nb_capture_forced_off():
+            return None
+        if self._state.rows or self._updates or not self._pending:
+            return None
+        cache = getattr(self, "_arrow_cache", None)
+        if cache is not None and cache[0] == (
+            len(self._pending), tuple(cols) if cols is not None else None,
+        ):
+            return cache[1]
+        if not all(is_native_batch(nb) for nb, _t in self._pending):
+            return None
+        from pathway_tpu.io._arrow import get_pyarrow, nb_to_arrow
+        from pathway_tpu.native import get_pwexec
+
+        pa = get_pyarrow()
+        try:
+            ex = get_pwexec()
+        except Exception:
+            ex = None
+        if pa is None or ex is None or not hasattr(ex, "capture_collect_nb"):
+            return None
+        merged = ex.capture_collect_nb(self._pending)
+        w = merged.width() - 1  # last column = appended commit time
+        names = list(cols) if cols is not None else [f"c{i}" for i in range(w)]
+        if len(names) != w:
+            raise ValueError(
+                f"arrow_table: {len(names)} names for {w} columns"
+            )
+        rb = nb_to_arrow(
+            merged, names + ["time"], include_key=True, include_diff=True
+        )
+        if rb is None:
+            return None
+        self.scope.runtime.stats.on_capture_arrow_batch(rb.num_rows)
+        tbl = pa.Table.from_batches([rb])
+        self._arrow_cache = (
+            (len(self._pending), tuple(cols) if cols is not None else None),
+            tbl,
+        )
+        return tbl
 
     @property
     def state(self) -> TableState:

@@ -80,6 +80,12 @@ PASSTHROUGH_FAMILIES = (
     "exchange_wave_seconds_total",
     "exchange_fallbacks_total",
     "nb_fallbacks_total",
+    # columnar egress (ISSUE 14): which ranks deliver Arrow batches vs
+    # row-expand at their sinks (partitioned sinks write on every rank)
+    "capture_arrow_batches_total",
+    "capture_arrow_rows_total",
+    "capture_rows_expanded_total",
+    "sink_egress_seconds_total",
     "runtime_idle_seconds_total",
     "mesh_heartbeats_missed_total",
     "mesh_rank_restarts_total",

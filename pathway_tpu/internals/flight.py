@@ -55,6 +55,7 @@ NATIVE_TAGS = {
     4: "nb_encode",
     5: "nb_decode",
     6: "nb_concat",
+    7: "arrow_export",  # columnar egress: capture collect + Arrow export
 }
 
 TRACE_SCHEMA_VERSION = 1
@@ -332,12 +333,31 @@ class FlightRecorder:
             kind = type(node).__name__
             if kind in ("OutputNode", "CaptureNode"):
                 ent["sink"] = True
-                # a per-row on_change callback expands C-owned columns
-                # back into Python rows — the row-expanding sink the
-                # hot-path blame pass names (ROADMAP item 2)
-                if kind == "CaptureNode" or getattr(
-                    node, "_on_change", None
-                ) is not None:
+                # egress verdict keyed on the CONSUMER's declared
+                # capability (ISSUE 14): an Arrow-batch consumer (or a
+                # CaptureNode with the columnar export door) consumes
+                # NativeBatch output without row expansion. row_expanding
+                # marks the sinks that pay PER-ROW Python work they could
+                # avoid: a per-row on_change callback (always), a rows
+                # consumer over a statically-columnar chain (every
+                # C-owned batch materializes), or a doorless CaptureNode.
+                # A batched rows consumer of an already-tuple chain is
+                # NOT row-expanding — the rows were never columnar.
+                try:
+                    from pathway_tpu.analysis.eligibility import (
+                        sink_consumer_columnar,
+                        sink_row_expands,
+                    )
+
+                    ent["egress"] = (
+                        "columnar"
+                        if sink_consumer_columnar(node).ok
+                        else "rows"
+                    )
+                    if sink_row_expands(node):
+                        ent["row_expanding"] = True
+                except Exception:
+                    ent["egress"] = "rows"
                     ent["row_expanding"] = True
             meta[str(i)] = ent
         return meta

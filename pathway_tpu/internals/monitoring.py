@@ -282,6 +282,18 @@ class ProberStats:
     sink_aborted: dict = field(default_factory=dict)
     sink_recovered: dict = field(default_factory=dict)
     sink_lag: dict = field(default_factory=dict)       # name -> gauge
+    # columnar egress (ISSUE 14): rows delivered to sinks/subscribers as
+    # Arrow record batches straight off the C-owned column buffers vs
+    # rows a NativeBatch expanded back into Python objects at an egress
+    # node (OutputNode consolidate / CaptureNode flush). A fused egress
+    # verdict (analysis/eligibility.py sink_egress_decision) must
+    # correspond to rows_expanded staying flat in the steady state.
+    capture_arrow_batches: int = 0
+    capture_arrow_rows: int = 0
+    capture_rows_expanded: int = 0
+    # per-sink seconds spent encoding/staging egress output (the sink
+    # side of the egress leg --profile/--critical-path report)
+    sink_egress_s: dict = field(default_factory=dict)  # name -> seconds
 
     def on_node_step(
         self, label: str, self_s: float, rows: int, nb: bool
@@ -450,6 +462,21 @@ class ProberStats:
     def on_sink_epoch_lag(self, name: str, lag: int) -> None:
         self.sink_lag[name] = lag
 
+    # -- columnar egress (io/_arrow.py; ISSUE 14) --------------------------
+
+    def on_capture_arrow_batch(self, n_rows: int) -> None:
+        self.capture_arrow_batches += 1
+        self.capture_arrow_rows += n_rows
+
+    def on_capture_rows_expanded(self, n_rows: int) -> None:
+        self.capture_rows_expanded += n_rows
+
+    def on_sink_egress_seconds(self, name: str, seconds: float) -> None:
+        if seconds > 0:
+            self.sink_egress_s[name] = (
+                self.sink_egress_s.get(name, 0.0) + seconds
+            )
+
     def input_latency_ms(self) -> float:
         if not self.connectors:
             return 0.0
@@ -577,6 +604,23 @@ class ProberStats:
             for name in sorted(self.sink_lag):
                 lines.append(
                     f'sink_epoch_lag{{sink="{name}"}} {self.sink_lag[name]}'
+                )
+        # columnar egress (ISSUE 14): always rendered so the lakehouse
+        # smoke can assert `capture_arrow_batches_total > 0` AND the
+        # forced-row run can assert it stays 0
+        for metric, val in (
+            ("capture_arrow_batches_total", self.capture_arrow_batches),
+            ("capture_arrow_rows_total", self.capture_arrow_rows),
+            ("capture_rows_expanded_total", self.capture_rows_expanded),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {val}")
+        if self.sink_egress_s:
+            lines.append("# TYPE sink_egress_seconds_total counter")
+            for name in sorted(self.sink_egress_s):
+                lines.append(
+                    f'sink_egress_seconds_total{{sink="{name}"}} '
+                    f"{self.sink_egress_s[name]:.6f}"
                 )
         if self.nodes:
             for metric, idx, fmt in (
@@ -799,6 +843,14 @@ def render_dashboard(stats: ProberStats, graveyard=None):
     if stats.mesh_tree_depth:
         pipe.add_row("gather tree depth", str(stats.mesh_tree_depth))
     pipe.add_row("nb_fallbacks", str(stats.nb_fallbacks))
+    # columnar egress (ISSUE 14): arrow-delivered vs row-expanded at the
+    # sinks — "did the fused chain reach the edge" at a glance
+    if stats.capture_arrow_batches or stats.capture_rows_expanded:
+        pipe.add_row(
+            "egress arrow batches/rows | expanded",
+            f"{stats.capture_arrow_batches}/{stats.capture_arrow_rows}"
+            f" | {stats.capture_rows_expanded}",
+        )
     if (
         stats.mesh_heartbeats_missed
         or stats.mesh_rank_restarts

@@ -369,6 +369,11 @@ class TxnDeltaSink:
         self.min_commit_frequency = min_commit_frequency
         self.name = "deltalake"
         self._buf: list[tuple] = []
+        # columnar staging (ISSUE 14): Arrow record batches delivered by
+        # the fused chain, kept AS BATCHES until the part flush — the
+        # parquet part is written straight from the column buffers, no
+        # row round-trip
+        self._abuf: list[tuple] = []  # [(RecordBatch, time), ...]
         self._version: int | None = None
         self._last_commit = 0.0
         self._txn = False
@@ -509,6 +514,51 @@ class TxnDeltaSink:
         pq.write_table(pa.table(arrays), buf)
         return buf.getvalue()
 
+    def _batches_to_parquet(self, chunks: list[tuple]) -> list[bytes]:
+        """Columnar part images: each buffered record batch gains its
+        commit-time column and the column buffers go straight into
+        parquet — zero row materialization. Batches are grouped by
+        schema (an all-null column types differently across chunks)
+        and each group becomes one part."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        groups: dict[str, list] = {}
+        for rb, t in chunks:
+            n = rb.num_rows
+            arrays = [
+                rb.column(rb.schema.get_field_index(c)) for c in self.cols
+            ]
+            arrays.append(pa.array([t] * n, pa.int64()))
+            arrays.append(rb.column(rb.schema.get_field_index("diff")))
+            out = pa.RecordBatch.from_arrays(
+                arrays, names=self.cols + ["time", "diff"]
+            )
+            groups.setdefault(str(out.schema), []).append(out)
+        parts = []
+        for batches in groups.values():
+            buf = _io.BytesIO()
+            pq.write_table(pa.Table.from_batches(batches), buf)
+            parts.append(buf.getvalue())
+        return parts
+
+    def _drain_payloads(self) -> list[bytes]:
+        """One parquet part image per buffered representation (rows /
+        per-schema arrow groups), draining both buffers."""
+        out = []
+        if self._buf:
+            rows, self._buf = self._buf, []
+            out.append(self._rows_to_parquet(rows))
+        if self._abuf:
+            chunks, self._abuf = self._abuf, []
+            out.extend(self._batches_to_parquet(chunks))
+        return out
+
+    def _note_egress(self, seconds: float) -> None:
+        from pathway_tpu.io.txn import note_egress_seconds
+
+        note_egress_seconds(self._stats, self.name, seconds)
+
     @staticmethod
     def _add_action(path: str, size: int) -> dict:
         return {
@@ -524,8 +574,18 @@ class TxnDeltaSink:
     # -- engine callbacks --------------------------------------------------
 
     def on_batch(self, time_, deltas) -> None:
+        t0 = time.perf_counter()
         for _k, row, d in deltas:
             self._buf.append(tuple(row) + (time_, d))
+        self._note_egress(time.perf_counter() - t0)
+
+    def on_batch_arrow(self, time_, rb) -> None:
+        """Columnar delivery (ISSUE 14): buffer the record batch as-is;
+        the part flush writes its column buffers directly."""
+        t0 = time.perf_counter()
+        if rb is not None and rb.num_rows:
+            self._abuf.append((rb, time_))
+        self._note_egress(time.perf_counter() - t0)
 
     def on_time_end(self, time_) -> None:
         if self._txn:
@@ -542,7 +602,7 @@ class TxnDeltaSink:
     # -- plain (non-epoch-aligned) path ------------------------------------
 
     def _flush(self, force: bool = False) -> None:
-        if not self._buf:
+        if not (self._buf or self._abuf):
             return
         if (
             not force
@@ -551,12 +611,13 @@ class TxnDeltaSink:
             < self.min_commit_frequency
         ):
             return
-        rows, self._buf = self._buf, []
         self._last_commit = time.monotonic()
-        part = f"part-{uuid.uuid4().hex}.parquet"
-        data = self._rows_to_parquet(rows)
-        self.store.write(part, data)
-        self._commit([self._add_action(part, len(data))])
+        actions = []
+        for data in self._drain_payloads():
+            part = f"part-{uuid.uuid4().hex}.parquet"
+            self.store.write(part, data)
+            actions.append(self._add_action(part, len(data)))
+        self._commit(actions)
 
     # -- the 2PC verbs -----------------------------------------------------
 
@@ -593,11 +654,12 @@ class TxnDeltaSink:
         return f"_pw_txn/manifest/r{rank}"
 
     def _stage_part(self, force: bool = False) -> None:
-        """Flush buffered rows into ONE staged parquet part — invisible
+        """Flush buffered output into staged parquet parts — invisible
         to readers (no log reference) until a finalized log version
-        adds it. Rate-limited within the epoch by
+        adds them. Row and arrow buffers stage as separate parts (one
+        per representation/schema). Rate-limited within the epoch by
         min_commit_frequency; pre-commit always forces."""
-        if not self._buf:
+        if not (self._buf or self._abuf):
             return
         if (
             not force
@@ -609,17 +671,18 @@ class TxnDeltaSink:
         from pathway_tpu.internals import faults as _faults
 
         _faults.fault_point("sink.stage")
-        rows, self._buf = self._buf, []
         self._last_commit = time.monotonic()
-        path = (
-            f"{self._stage_dir(self._rank)}/"
-            f"part-{self._incarnation}-{uuid.uuid4().hex}.parquet"
-        )
-        data = self._rows_to_parquet(rows)
-        self.store.write(path, data)
-        self._open_parts.append({"path": path, "size": len(data)})
-        if self._stats is not None:
-            self._stats.on_sink_staged(self.name)
+        staged = 0
+        for data in self._drain_payloads():
+            path = (
+                f"{self._stage_dir(self._rank)}/"
+                f"part-{self._incarnation}-{uuid.uuid4().hex}.parquet"
+            )
+            self.store.write(path, data)
+            self._open_parts.append({"path": path, "size": len(data)})
+            staged += 1
+        if staged and self._stats is not None:
+            self._stats.on_sink_staged(self.name, staged)
             self._note_lag()
 
     def precommit(self, tag: int) -> None:
@@ -823,6 +886,7 @@ class TxnDeltaSink:
                 pass
         self._open_parts = []
         self._buf = []
+        self._abuf = []
         if n and self._stats is not None:
             self._stats.on_sink_aborted(self.name, n)
 
@@ -873,6 +937,8 @@ def write(
         ctx.scope.output(
             ctx.engine_table(table),
             on_batch=sink.on_batch,
+            on_batch_arrow=sink.on_batch_arrow,
+            arrow_cols=cols,
             on_time_end=sink.on_time_end,
             on_end=sink.on_end,
             txn_sink=sink,

@@ -298,9 +298,16 @@ def write(table: Table, filename: str, *, format: str = "csv", name: str | None 
     sink = TxnFileSink(filename, format=format, cols=cols)
 
     def lower(ctx):
+        # columnar egress (ISSUE 14): NativeBatch deliveries arrive as
+        # Arrow record batches (on_batch_arrow) and serialize straight
+        # off the columns; tuple deltas (retractions, object columns,
+        # PATHWAY_NO_NB_CAPTURE) keep the row path — both encode to
+        # bit-identical bytes
         ctx.scope.output(
             ctx.engine_table(table),
             on_batch=sink.on_batch,
+            on_batch_arrow=sink.on_batch_arrow,
+            arrow_cols=cols,
             on_time_end=sink.on_time_end,
             on_end=sink.on_end,
             txn_sink=sink,

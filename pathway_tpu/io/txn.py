@@ -52,6 +52,7 @@ import json as _json
 import os
 import re
 import shutil
+import time as _time
 from typing import NamedTuple
 
 from pathway_tpu.internals import faults as _faults
@@ -131,6 +132,13 @@ def _fsync_dir(path: str) -> None:
         pass
     finally:
         os.close(fd)
+
+
+def note_egress_seconds(stats, name: str, seconds: float) -> None:
+    """Per-sink egress-seconds accounting (ISSUE 14), shared by every
+    transactional sink so the guard/label policy cannot diverge."""
+    if stats is not None and hasattr(stats, "on_sink_egress_seconds"):
+        stats.on_sink_egress_seconds(name, seconds)
 
 
 def write_atomic(path: str, data: bytes) -> None:
@@ -313,14 +321,62 @@ class TxnFileSink(TransactionalSink):
             lines.append(_json.dumps(payload, default=str))
         return ("\n".join(lines) + "\n").encode() if lines else b""
 
+    def _encode_arrow(self, rb, time: int) -> bytes:
+        """Serialize one Arrow record batch (value columns + ``diff``)
+        straight off its columns — each column converts to a Python
+        list in one C pass (pyarrow ``to_pylist``), so no engine row
+        tuples ever exist; the byte output is IDENTICAL to
+        ``_encode`` over the equivalent deltas (the parity battery
+        pins it)."""
+        col_vals = [
+            rb.column(rb.schema.get_field_index(c)).to_pylist()
+            for c in self.cols
+        ]
+        diffs = rb.column(rb.schema.get_field_index("diff")).to_pylist()
+        if self.format == "csv":
+            out = _io.StringIO()
+            import csv as _csv
+
+            w = _csv.writer(out)
+            w.writerows(
+                list(vals) + [time, d]
+                for vals, d in zip(zip(*col_vals), diffs)
+            )
+            return out.getvalue().encode()
+        lines = []
+        for vals, d in zip(zip(*col_vals), diffs):
+            payload = dict(zip(self.cols, vals))
+            payload["time"] = time
+            payload["diff"] = d
+            lines.append(_json.dumps(payload, default=str))
+        return ("\n".join(lines) + "\n").encode() if lines else b""
+
+    def _note_egress(self, seconds: float) -> None:
+        note_egress_seconds(self._stats, self.name, seconds)
+
     # -- engine callbacks --------------------------------------------------
 
     def on_batch(self, time: int, deltas) -> None:
         self._ensure_started()
+        t0 = _time.perf_counter()
         data = self._encode(deltas, time)
         if data:
             self._buf.append(data)
             self._buf_time = time
+        self._note_egress(_time.perf_counter() - t0)
+
+    def on_batch_arrow(self, time: int, rb) -> None:
+        """Columnar staging (ISSUE 14): the OutputNode delivers the
+        fused chain's NativeBatch output as an Arrow record batch and
+        the sink serializes it column-wise — no row round-trip."""
+        self._ensure_started()
+        t0 = _time.perf_counter()
+        if rb is not None and rb.num_rows:
+            data = self._encode_arrow(rb, time)
+            if data:
+                self._buf.append(data)
+                self._buf_time = time
+        self._note_egress(_time.perf_counter() - t0)
 
     def on_time_end(self, time: int) -> None:
         self._seal(time)

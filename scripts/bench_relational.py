@@ -177,14 +177,23 @@ def _join_once(n_rows: int, n_keys: int, batch: int) -> dict:
     cap = GraphRunner().run_tables(out)[0]
     elapsed = time.perf_counter() - t0
     phases = read_phases()
-    # The columnar capture sink (this round) defers row materialization
-    # to first read, so `value` measures the streaming run itself — the
-    # number comparable to a production sink that stays columnar. For
-    # honest comparison against pre-columnar-capture artifacts (whose
-    # runs paid per-batch materialization inside the window),
-    # `value_incl_capture` re-includes the deferred expansion cost.
+    # Columnar egress (ISSUE 14): the capture's committed output reads
+    # out as Arrow record batches straight off the C-owned column
+    # buffers (CaptureNode.arrow_table -> exec.cpp capture_collect_nb +
+    # nb_export_arrow) — `value_incl_capture` now prices THAT, the cost
+    # a production columnar sink actually pays, instead of the
+    # per-row Python expansion the pre-columnar-egress artifacts
+    # measured (87.2k vs 258.6k in round 5, a 2.97x gap). The row path
+    # remains reachable via PATHWAY_NO_NB_CAPTURE=1 and is what
+    # `capture_mode: "rows"` marks when the arrow reader declines.
     t0 = time.perf_counter()
-    out_rows = len(cap.state.rows)
+    tbl = cap.arrow_table()
+    if tbl is not None:
+        out_rows = tbl.num_rows
+        capture_mode = "arrow"
+    else:
+        out_rows = len(cap.state.rows)
+        capture_mode = "rows"
     capture_s = time.perf_counter() - t0
     return {
         "metric": "stream_join_rows_per_s",
@@ -195,6 +204,7 @@ def _join_once(n_rows: int, n_keys: int, batch: int) -> dict:
         "n_rows": n_rows,
         "n_keys": n_keys,
         "out_rows": out_rows,
+        "capture_mode": capture_mode,
         "threads": int(os.environ.get("PATHWAY_THREADS", "1")),
         "host_cores": os.cpu_count() or 1,
         "gen_s": round(gen_s, 2),
@@ -278,10 +288,14 @@ def _wordcount_once(
     )
     out = {"n": 0}
 
-    def on_change(key, row, time_, diff):
-        out["n"] += 1
+    def on_batch(time_, changes):
+        # batched tuples egress (ISSUE 14): one callback per delivered
+        # batch, zero per-row Python — the per-row on_change subscriber
+        # this replaces paid ~125ns of call overhead per change
+        # (OutputNode#2 = 18% of wordcount self-time in the r5 trace)
+        out["n"] += len(changes)
 
-    pw.io.subscribe(counts, on_change=on_change)
+    pw.io.subscribe(counts, on_batch=on_batch, batch_format="tuples")
 
     reset_phases, read_phases = _phase_tracker()
     reset_phases()
@@ -320,6 +334,9 @@ if _st is not None:
         raw_bytes=_st.exchange_raw_bytes,
         wire_bytes=_st.exchange_wire_bytes,
         tree_depth=_st.mesh_tree_depth,
+        arrow_batches=_st.capture_arrow_batches,
+        arrow_rows=_st.capture_arrow_rows,
+        rows_expanded=_st.capture_rows_expanded,
     )
 print(json.dumps({{"rank": rank, "elapsed_s": time.perf_counter() - t0,
                    "changes": out["n"], **_extra}}))
@@ -362,7 +379,12 @@ counts = t.groupby(pw.this.data).reduce(
     word=pw.this.data, c=pw.reducers.count()
 )
 out = {{"n": 0}}
-pw.io.subscribe(counts, on_change=lambda key, row, time_, diff: out.__setitem__("n", out["n"] + 1))
+# batched tuples egress (ISSUE 14): counting via one callback per batch
+pw.io.subscribe(
+    counts,
+    on_batch=lambda time_, ch: out.__setitem__("n", out["n"] + len(ch)),
+    batch_format="tuples",
+)
 t0 = time.perf_counter()
 pw.run(monitoring_level=pw.MonitoringLevel.NONE)
 """ + _RANK_STATS_TAIL
@@ -419,7 +441,17 @@ joined = lt.join(rt_t, pw.left.j == pw.right.j).select(
     v=pw.left.v, w=pw.right.w
 )
 out = {{"n": 0}}
-pw.io.subscribe(joined, on_change=lambda key, row, time_, diff: out.__setitem__("n", out["n"] + 1))
+# columnar egress (ISSUE 14): the join's NativeBatch output gathers to
+# rank 0 COLUMNAR and exports as Arrow record batches at the sink —
+# capture is in-stream now, so the lane's value already prices it
+# (capture_arrow_rows > 0 / rows_expanded == 0 on the rank-0 line is
+# the fused-to-the-edge proof)
+pw.io.subscribe(
+    joined,
+    on_batch=lambda time_, rb: out.__setitem__("n", out["n"] + rb.num_rows),
+    batch_format="arrow",
+    include_key=False,
+)
 t0 = time.perf_counter()
 pw.run(monitoring_level=pw.MonitoringLevel.NONE)
 """ + _RANK_STATS_TAIL
@@ -533,6 +565,20 @@ def _mesh_metric(
     depth = max((r.get("tree_depth") or 0 for r in results), default=0)
     if depth:
         out["tree_depth"] = depth
+    # columnar egress (ISSUE 14): the scaling lanes' sinks deliver
+    # batched (tuples/arrow) IN-STREAM, so there is no deferred capture
+    # leg left outside `elapsed` — value_incl_capture equals value by
+    # construction and the egress counters prove which path ran
+    # (arrow_rows > 0 + rows_expanded == 0 = columnar to the edge;
+    # pre-ISSUE-14 lanes implicitly excluded capture entirely)
+    out["value_incl_capture"] = out["value"]
+    out["capture_materialize_s"] = 0.0
+    if any(r.get("arrow_batches") is not None for r in results):
+        out["egress"] = {
+            "arrow_batches": sum(r.get("arrow_batches") or 0 for r in results),
+            "arrow_rows": sum(r.get("arrow_rows") or 0 for r in results),
+            "rows_expanded": sum(r.get("rows_expanded") or 0 for r in results),
+        }
     return out
 
 

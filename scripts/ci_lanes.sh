@@ -149,4 +149,16 @@ echo "=== lane 12: fast-wire compression smoke (zlib 2-rank) ==="
 # --processes 4` (mutant: --mesh-mutant drop_relay).
 env -u PATHWAY_LANE_PROCESSES python scripts/compress_smoke.py
 
+echo "=== lane 13: columnar lakehouse smoke (2-rank join -> Delta) ==="
+# real-fork 2-rank source -> join -> per-rank partitioned Delta, run on
+# the default columnar egress AND with PATHWAY_NO_NB_CAPTURE=1 forcing
+# the row path: the columnar run must show capture_arrow_batches_total
+# > 0 on every rank's LIVE /metrics (via the cluster view) with ZERO
+# rows expanded, nb_fallbacks_total must be flat across the two runs
+# (the egress knob moves nothing upstream), and the committed lake
+# contents must be bit-identical. The rows-vs-arrow parity battery for
+# every sink/workload/rank combination is tests/test_columnar_egress.py
+# (runs in lanes 1/2); the export region's GIL discipline is lane 0.
+env -u PATHWAY_LANE_PROCESSES python scripts/lakehouse_smoke.py
+
 echo "=== all lanes green ==="

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""CI columnar-lakehouse smoke lane (scripts/ci_lanes.sh lane 13).
+
+Runs a REAL 2-process source → join → per-rank partitioned Delta
+pipeline over the loopback mesh twice — once on the default columnar
+egress and once with ``PATHWAY_NO_NB_CAPTURE=1`` forcing the
+row-expanding path — and asserts the columnar-to-the-edges contract
+(ISSUE 14) end to end:
+
+1. **columnar capture engaged**: on the default run every rank's
+   ``capture_arrow_batches_total`` is > 0 on the LIVE ``/metrics``
+   surface (scraped through the cluster aggregator's relabeled view
+   while the mesh runs) and ``capture_rows_expanded_total`` stays 0 —
+   the join's NativeBatch output reached the parquet writer as Arrow
+   record batches, with per-rank partitioned output (no gather leg);
+2. **no collateral de-optimization**: ``nb_fallbacks_total`` is flat
+   (identical between the two runs — forcing the egress knob must not
+   push fallbacks into the engine);
+3. **bit-identical lake**: the committed Delta contents of the two runs
+   agree row-for-row (modulo a dense-rank normalization of the
+   wall-clock ``time`` column), and the forced run's counters prove the
+   row path really ran (rows_expanded > 0, arrow == 0).
+
+The GIL discipline of the export region itself (exec.cpp
+``nb_export_arrow`` / ``capture_collect_nb``) is audited statically by
+lane 0 (``scripts/lint_gil.py``).
+
+Exit 0 = green; any assertion prints the reason and exits 1.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 2
+
+RANK_PROGRAM = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+P = int(os.environ.get("PATHWAY_PROCESSES", "1"))
+lake = sys.argv[1]
+
+class L(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    v: int
+
+class R(pw.Schema):
+    k: int = pw.column_definition(primary_key=True)
+    j: int
+    w: int
+
+n_rows, n_keys, batch = 3000, 40, 500
+mine = list(range(rank, n_rows, P))
+left_batches = [
+    [{{"k": i, "j": (i * 2654435761) % n_keys, "v": i}}
+     for i in mine[s:s + batch]]
+    for s in range(0, len(mine), batch)
+]
+right_rows = [{{"k": i, "j": i % n_keys, "w": i}} for i in range(n_keys * 2)]
+
+class LS(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True
+    def run(self):
+        for b in left_batches:
+            self.next_batch(b)
+            self.commit()
+            # pace commits so the capture counters are observable LIVE
+            time.sleep(0.08)
+
+class RS(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        self.next_batch(right_rows)
+        self.commit()
+
+lt = pw.io.python.read(LS(), schema=L, autocommit_duration_ms=None)
+rt = pw.io.python.read(RS(), schema=R, autocommit_duration_ms=None)
+joined = lt.join(rt, pw.left.j == pw.right.j).select(
+    v=pw.left.v, w=pw.right.w
+)
+# per-rank partitioned Delta egress: each rank commits its own parquet
+# parts straight from the joined NativeBatch's column buffers
+pw.io.deltalake.write(joined, lake, min_commit_frequency=None)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+from pathway_tpu.engine import runtime as _rt
+_st = _rt.LAST_RUN_STATS
+print(json.dumps({{
+    "rank": rank,
+    "arrow_batches": _st.capture_arrow_batches,
+    "arrow_rows": _st.capture_arrow_rows,
+    "rows_expanded": _st.capture_rows_expanded,
+    "nb_fallbacks": _st.nb_fallbacks,
+}}))
+"""
+
+
+def _free_port(n: int = 1) -> int:
+    for _ in range(50):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        held = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                held.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def fail(msg: str) -> None:
+    print(f"lakehouse_smoke: FAIL — {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def _get(url: str, timeout: float = 2.0) -> str | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except (OSError, urllib.error.URLError):
+        return None
+
+
+def _metric(body: str, name: str, rank: int) -> int | None:
+    for line in body.splitlines():
+        if line.startswith(f'{name}{{rank="{rank}"}}'):
+            try:
+                return int(float(line.split()[-1]))
+            except ValueError:
+                return None
+    return None
+
+
+def _run_mesh(td: str, prog: str, lake: str, forced: bool, watch: bool):
+    mesh_port = _free_port(WORLD)
+    cluster_port = _free_port()
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(WORLD),
+            PATHWAY_PROCESS_ID=str(rank),
+            PATHWAY_FIRST_PORT=str(mesh_port),
+            PATHWAY_CLUSTER_METRICS_PORT=str(cluster_port),
+            PATHWAY_CLUSTER_SCRAPE_S="0.3",
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        env.pop("PATHWAY_LANE_PROCESSES", None)
+        env.pop("PATHWAY_MESH_SUPERVISED", None)
+        env.pop("PATHWAY_NO_NB_CAPTURE", None)
+        if forced:
+            env["PATHWAY_NO_NB_CAPTURE"] = "1"
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, prog, lake], env=env, cwd=td,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+        )
+    live = None
+    url = f"http://127.0.0.1:{cluster_port}/metrics/cluster"
+    deadline = time.monotonic() + 240
+    while watch and time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        body = _get(url)
+        if body is not None:
+            if all(
+                (_metric(body, "capture_arrow_batches_total", r) or 0) > 0
+                for r in range(WORLD)
+            ):
+                live = body
+        time.sleep(0.15)
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+                    q.communicate()
+            fail(f"[forced={forced}] rank timeout")
+        if p.returncode != 0:
+            fail(
+                f"[forced={forced}] rank {rank} exited {p.returncode}: "
+                f"{err.decode()[-400:]}"
+            )
+        outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    return outs, live
+
+
+def _lake_rows(lake: str):
+    import pyarrow.parquet as pq
+
+    # committed = parts referenced by the _delta_log (staged orphans
+    # under _pw_txn must not count)
+    referenced = []
+    for v in sorted(glob.glob(os.path.join(lake, "_delta_log", "*.json"))):
+        with open(v) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                action = json.loads(line)
+                add = action.get("add")
+                if add:
+                    referenced.append(add["path"])
+    rows = []
+    for rel in referenced:
+        t = pq.read_table(os.path.join(lake, rel), use_threads=False)
+        rows.extend(t.to_pylist())
+    times = sorted({r["time"] for r in rows})
+    rank_of = {t_: i for i, t_ in enumerate(times)}
+    for r in rows:
+        r["time"] = rank_of[r["time"]]
+    return sorted(rows, key=lambda r: json.dumps(r, sort_keys=True))
+
+
+def main() -> int:
+    td = tempfile.mkdtemp(prefix="pw_lakehouse_smoke_")
+    prog = os.path.join(td, "lakehouse2.py")
+    with open(prog, "w") as f:
+        f.write(RANK_PROGRAM.format(repo=REPO))
+    lake_a = os.path.join(td, "lake_arrow")
+    lake_r = os.path.join(td, "lake_rows")
+
+    arrow, live = _run_mesh(td, prog, lake_a, forced=False, watch=True)
+    rows, _ = _run_mesh(td, prog, lake_r, forced=True, watch=False)
+
+    # 1. columnar capture engaged, observed LIVE on /metrics/cluster
+    if live is None:
+        fail(
+            "live /metrics never showed capture_arrow_batches_total > 0 "
+            "on every rank"
+        )
+    for r in arrow:
+        if r["arrow_batches"] <= 0 or r["arrow_rows"] <= 0:
+            fail(f"rank {r['rank']} delivered no arrow batches: {r}")
+        if r["rows_expanded"] != 0:
+            fail(
+                f"rank {r['rank']} row-expanded {r['rows_expanded']} "
+                "rows on the columnar run"
+            )
+    # 2. nb_fallbacks flat: the egress knob moved nothing upstream
+    a_fb = sorted((r["rank"], r["nb_fallbacks"]) for r in arrow)
+    r_fb = sorted((r["rank"], r["nb_fallbacks"]) for r in rows)
+    if a_fb != r_fb:
+        fail(f"nb_fallbacks not flat across runs: {a_fb} vs {r_fb}")
+    # forced run really took the row path
+    for r in rows:
+        if r["arrow_batches"] != 0:
+            fail(f"forced-row rank {r['rank']} still delivered arrow")
+        if r["rows_expanded"] <= 0:
+            fail(f"forced-row rank {r['rank']} expanded nothing: {r}")
+
+    # 3. committed lake contents bit-identical (times dense-ranked)
+    la, lr = _lake_rows(lake_a), _lake_rows(lake_r)
+    if not la:
+        fail("empty lake")
+    if la != lr:
+        fail(
+            f"lake contents differ: {len(la)} vs {len(lr)} rows "
+            f"(first diff: "
+            f"{next(((a, b) for a, b in zip(la, lr) if a != b), None)})"
+        )
+
+    total_rows = sum(r["arrow_rows"] for r in arrow)
+    print(
+        f"lakehouse_smoke: OK — 2-rank join -> partitioned Delta, "
+        f"{total_rows} rows delivered as "
+        f"{sum(r['arrow_batches'] for r in arrow)} arrow batches "
+        f"(0 expanded), live /metrics observed on every rank, "
+        f"nb_fallbacks flat, lake bit-identical to forced-row run "
+        f"({len(la)} committed rows)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

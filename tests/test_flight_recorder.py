@@ -231,6 +231,11 @@ _PROBER_CALLS = {
     "on_sink_aborted": ("sink_a", 1),
     "on_sink_recovered": ("sink_a", 1),
     "on_sink_epoch_lag": ("sink_a", 3),
+    # columnar egress (ISSUE 14): arrow-delivered vs row-expanded rows
+    # at the sinks + per-sink egress seconds
+    "on_capture_arrow_batch": (7,),
+    "on_capture_rows_expanded": (7,),
+    "on_sink_egress_seconds": ("sink_a", 0.05),
 }
 # state consumed by the dashboard/main loop, not an OpenMetrics family
 _PROBER_EXEMPT = {"on_connector_finished"}

@@ -187,6 +187,126 @@ def test_forced_tuple_env_matches_degraded_verdict(monkeypatch):
     assert pa.audit_runtime(runtime, report) == []
 
 
+# -- egress verdicts vs runtime counters (ISSUE 14 satellite) -------------
+
+
+def _egress_pipeline(consumer: str):
+    """stream_join variant whose OUTPUT chain is statically columnar,
+    terminated by the requested consumer kind."""
+    pw.internals.parse_graph.G.clear()
+
+    class L(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        j: int
+        v: int
+
+    class R(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        j: int
+        w: int
+
+    lrows = [{"k": i, "j": i % 9, "v": i} for i in range(180)]
+    rrows = [{"k": i, "j": i % 9, "w": i} for i in range(18)]
+
+    class LS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for s in range(0, len(lrows), 60):
+                self.next_batch(lrows[s : s + 60])
+                self.commit()
+
+    class RS(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            self.next_batch(rrows)
+            self.commit()
+
+    lt = pw.io.python.read(LS(), schema=L, autocommit_duration_ms=None)
+    rt = pw.io.python.read(RS(), schema=R, autocommit_duration_ms=None)
+    out = lt.join(rt, pw.left.j == pw.right.j).select(
+        v=pw.left.v, w=pw.right.w
+    )
+    if consumer == "arrow":
+        pw.io.subscribe(
+            out, on_batch=lambda *a: None, batch_format="arrow"
+        )
+    elif consumer == "rows_batch":
+        pw.io.subscribe(out, on_batch=lambda *a: None)
+    else:
+        pw.io.subscribe(out, on_change=lambda *a: None)
+    return out
+
+
+@needs_nb
+@pytest.mark.parametrize(
+    "consumer,expect",
+    [
+        ("arrow", "fused"),
+        ("rows_batch", "row-expanding"),
+        ("on_change", "row-expanding"),
+    ],
+)
+def test_egress_verdict_matches_runtime_counters(consumer, expect):
+    """The Plan Doctor's egress verdict must be corroborated by the
+    runtime's capture counters (the plan-vs-reality contract extended
+    to sinks): fused egress ⇔ arrow batches delivered + zero rows
+    expanded at the sink; row-expanding egress ⇔ the expansion counter
+    moves and ``sink.row-expanding`` names the consumer."""
+    pytest.importorskip("pyarrow")
+    out = _egress_pipeline(consumer)
+    runtime, report, cap = _lower_analyze_run(out)
+    sink_diags = [
+        d for d in report.diagnostics if d.code == "sink.row-expanding"
+    ]
+    # the scratch capture node added by the harness is itself an
+    # arrow-capable egress; only the subscriber's OutputNode may fire
+    if expect == "fused":
+        assert not sink_diags, [d.message for d in sink_diags]
+        assert runtime.stats.capture_arrow_batches > 0
+        assert runtime.stats.capture_rows_expanded == 0
+    else:
+        assert len(sink_diags) == 1, [d.message for d in sink_diags]
+        assert "arrow" in (sink_diags[0].hint or "")
+        assert runtime.stats.capture_rows_expanded > 0
+        assert runtime.stats.capture_arrow_batches == 0
+
+
+@needs_nb
+def test_egress_verdict_degraded_chain_not_blamed_on_sink():
+    """A tuple chain (groupby output) feeding a rows consumer: the sink
+    is NOT the de-optimization — no columnar batches exist to expand,
+    so the capture counters stay flat and the sink.row-expanding
+    message (per-row on_change hint) carries the upstream context."""
+    pytest.importorskip("pyarrow")
+    bp = pb.build_wordcount()
+    runtime, report, cap = _lower_analyze_run(bp.out)
+    assert runtime.stats.capture_rows_expanded == 0
+    assert runtime.stats.capture_arrow_batches == 0
+    sink_diags = [
+        d for d in report.diagnostics if d.code == "sink.row-expanding"
+    ]
+    assert len(sink_diags) == 1
+    assert "not columnar" in sink_diags[0].message
+
+
+@needs_nb
+def test_egress_forced_off_flips_fused_to_row_expanding(monkeypatch):
+    pytest.importorskip("pyarrow")
+    monkeypatch.setenv("PATHWAY_NO_NB_CAPTURE", "1")
+    out = _egress_pipeline("arrow")
+    runtime, report, cap = _lower_analyze_run(out)
+    sink_diags = [
+        d for d in report.diagnostics if d.code == "sink.row-expanding"
+    ]
+    assert sink_diags and any(
+        "NO_NB_CAPTURE" in d.message for d in sink_diags
+    )
+    assert runtime.stats.capture_arrow_batches == 0
+    assert runtime.stats.capture_rows_expanded > 0
+
+
 # -- 2-rank real-fork agreement ------------------------------------------
 
 _RANK_PROGRAM = """
