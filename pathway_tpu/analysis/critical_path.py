@@ -41,9 +41,11 @@ from bisect import bisect_right
 from collections import defaultdict
 
 from pathway_tpu.analysis.profile import (
+    aggregate_device_spans,
     aggregate_node_spans,
     load_trace,
     measured_verdict,
+    trace_platform,
     validate_trace,
 )
 
@@ -56,6 +58,39 @@ BALANCED_SHARE = 0.05
 def _peer_of(e: dict) -> int | None:
     peer = (e.get("args") or {}).get("peer")
     return int(peer) if peer is not None else None
+
+
+def _node_device_verdict(
+    per_rank_devices: dict, rank: int, nid, doc: dict
+) -> tuple[str, str] | None:
+    """(roofline verdict, site) of the dispatch site that spent the most
+    device time inside node `nid` on `rank` — through the same pure
+    ``roofline_verdict`` the live plane and --profile use. None when the
+    node issued no recorded dispatches."""
+    from pathway_tpu.internals.device import (
+        peak_bandwidth,
+        peak_flops,
+        roofline_verdict,
+    )
+
+    best = None
+    for (pid, site), a in per_rank_devices.items():
+        if pid != rank or nid not in a["nodes"]:
+            continue
+        if best is None or a["nodes"][nid] > best[1]["nodes"][nid]:
+            best = (site, a)
+    if best is None:
+        return None
+    site, a = best
+    plat = trace_platform(doc) or {}
+    return (
+        roofline_verdict(
+            a["wall_s"], a["device_s"], a["flops"], a["bytes_accessed"],
+            plat.get("peak_flops") or peak_flops(),
+            plat.get("peak_bandwidth") or peak_bandwidth(),
+        ),
+        site,
+    )
 
 
 def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
@@ -210,6 +245,14 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
         )
     wave_rows.sort(key=lambda r: r["skew_s"], reverse=True)
 
+    # device plane (ISSUE 15): per-rank device-busy leg (the
+    # block_until_ready-bounded share of each dispatch's wall) + the
+    # site aggregation the straggler verdict joins against
+    per_rank_devices = aggregate_device_spans(events, by_rank=True)
+    dev_busy: dict[int, float] = defaultdict(float)
+    for (pid, _site), a in per_rank_devices.items():
+        dev_busy[pid] += a["device_s"]
+
     # straggler verdict: the dominant (waiter -> upstream) cell, joined
     # with the upstream rank's hottest node (shared profile machinery)
     per_rank_nodes = aggregate_node_spans(events, by_rank=True)
@@ -239,6 +282,16 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
                 "verdict": measured_verdict(m, up_nodes[nid]),
                 **({"blame": m["blame"]} if m.get("blame") else {}),
             }
+            # host-vs-device verdict (ISSUE 15): when the straggler's
+            # hottest node issued device dispatches, say whether it is
+            # compute/bandwidth/host-bound — "needs a kernel" vs "needs
+            # the host path fixed" from one --critical-path line
+            dev_verdict = _node_device_verdict(
+                per_rank_devices, upstream, nid, doc
+            )
+            if dev_verdict is not None:
+                top_node["device_verdict"] = dev_verdict[0]
+                top_node["device_site"] = dev_verdict[1]
         straggler = {
             "rank": upstream,
             "waiter": waiter,
@@ -256,6 +309,11 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
                 f"rank {waiter} recv-wait {share:.0%} of wave wall, "
                 f"upstream: rank {upstream} {up}"
             )
+            if top_node and top_node.get("device_verdict"):
+                verdict += (
+                    f"; device: {top_node['device_verdict']} "
+                    f"({top_node['device_site']})"
+                )
         else:
             verdict = (
                 f"balanced: worst recv-wait cell is rank {waiter} on "
@@ -300,6 +358,12 @@ def critical_path(path: str, top_waves: int = TOP_WAVES_DEFAULT) -> dict:
     for rank, s in egress_s.items():
         if s > 0:
             legs[rank]["egress_s"] = round(s, 6)
+    # device leg (ISSUE 15): block_until_ready-bounded device-busy
+    # seconds per rank — read next to compute to see which ranks' wall
+    # is accelerator time vs host time
+    for rank, s in dev_busy.items():
+        if s > 0:
+            legs[rank]["device_s"] = round(s, 6)
     return {
         "path": path,
         "valid": not problems,
@@ -360,6 +424,11 @@ def render_critical_path(report: dict) -> str:
                     if "egress_s" in d
                     else ""
                 )
+                + (
+                    f" device={d['device_s']:.4f}"
+                    if "device_s" in d
+                    else ""
+                )
             )
     c = report.get("codec")
     if c:
@@ -379,9 +448,14 @@ def render_critical_path(report: dict) -> str:
     if s and s.get("upstream_node"):
         n = s["upstream_node"]
         prov = f"  [{n['provenance']}]" if n.get("provenance") else ""
+        dev = (
+            f"  device: {n['device_verdict']} ({n['device_site']})"
+            if n.get("device_verdict")
+            else ""
+        )
         lines.append(
             f"  straggler rank {s['rank']} hottest node: {n['label']} "
-            f"{n['self_s']:.4f}s ({n['verdict']}){prov}"
+            f"{n['self_s']:.4f}s ({n['verdict']}){dev}{prov}"
         )
         for b in n.get("blame", ()):
             lines.append(f"      blame: {b}")

@@ -110,6 +110,30 @@ KNOBS: dict[str, Knob] = {
            "long-running traced pipeline keeps the NEWEST events and "
            "the dump records that the head was capped.", lo=10_000,
            hi=100_000_000),
+        # -- device plane (internals/device.py; ISSUE 15) ------------------
+        _k("PATHWAY_DEVICE_TRACE", "bool", True,
+           "Device plane of the flight recorder: engine dispatch sites "
+           "(KNN scan, embedder forward, serving window) record timed "
+           "per-dispatch device spans, FLOPs and transfer bytes while "
+           "the profiling plane is armed. 0 opts out even on a traced "
+           "run — armed dispatches block_until_ready for attribution, "
+           "trading dispatch pipelining for visibility."),
+        _k("PATHWAY_DEVICE_COST_ANALYSIS", "bool", True,
+           "Prefer the compiled executable's own cost_analysis() for "
+           "per-dispatch FLOPs/bytes (cached once per shape bucket); 0 "
+           "uses only the analytical cost models."),
+        _k("PATHWAY_DEVICE_PEAK_FLOPS", "float", None,
+           "Override the MFU denominator (peak device FLOP/s). Default: "
+           "resolved from the device kind (TPU v4/v5/v5p/v6e table; "
+           "modest CPU fallback).", lo=1.0),
+        _k("PATHWAY_DEVICE_PEAK_GBPS", "float", None,
+           "Override the roofline ridge's peak HBM bandwidth (GB/s). "
+           "Default: resolved from the device kind.", lo=0.001),
+        _k("PATHWAY_DEVICE_HOST_BOUND_SHARE", "float", 0.35,
+           "Device-busy share of a dispatch site's wall time below "
+           "which its roofline verdict reads host-bound (the device "
+           "sat idle while the host assembled batches).", lo=0.0,
+           hi=1.0),
         _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
            "Abort the run on the first data error instead of poisoning "
            "rows to ERROR."),
@@ -181,6 +205,10 @@ KNOBS: dict[str, Knob] = {
            "Gateway dispatch workers draining closed batch windows into "
            "the dataflow (each window stays one atomic commit).", lo=1,
            hi=64),
+        _k("PATHWAY_SERVE_TIMING", "bool", False,
+           "Server-Timing response header on the gateway: per-request "
+           "queue/window/dispatch/egress milliseconds, so a "
+           "client-observed p50 decomposes without a trace file."),
         # -- serving through rollback (io/http/_frontend.py + breaker) ----
         _k("PATHWAY_SERVE_BROWNOUT", "bool", False,
            "Degraded-answer mode: with the dispatch circuit breaker open "

@@ -89,6 +89,17 @@ def validate_trace(doc: dict) -> list[str]:
                 problems.append(
                     f"event {i}: node span missing node/rows/rep args"
                 )
+        if e.get("cat") == "device":
+            # device dispatch spans (ISSUE 15): concurrent async
+            # dispatches legitimately overlap on a site's track — a
+            # sample stream like `native`, exempt from nesting — but
+            # every span must carry the dispatch id the correlation
+            # pin joins on
+            if "dispatch" not in (e.get("args") or {}):
+                problems.append(
+                    f"event {i}: device span missing dispatch arg"
+                )
+            continue
         if e.get("cat") == "native":
             continue  # sample stream, not a call stack (see docstring)
         stack = stacks[key]
@@ -130,6 +141,124 @@ def aggregate_node_spans(
         if args.get("rep") == "nb":
             a["nb_batches"] += 1
     return agg
+
+
+def aggregate_device_spans(events, by_rank: bool = False) -> dict:
+    """Per-dispatch-site aggregation of the trace's device spans
+    (ISSUE 15), shared by the profile and the wave critical-path
+    analyzer: key is the site name (or ``(pid, site)`` with
+    ``by_rank``) -> {dispatches, wall_s, device_s, flops,
+    bytes_accessed, transfer_bytes, nodes: {node id -> device_s}}.
+    ``device_s`` is the block_until_ready-bounded device share each
+    span's args carry; wall - device = host assembly time."""
+    agg: dict = {}
+    for e in events:
+        if e.get("cat") != "device" or e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        site = str(e.get("name", "?"))
+        key = (e.get("pid", 0), site) if by_rank else site
+        a = agg.setdefault(
+            key,
+            {
+                "dispatches": 0, "wall_s": 0.0, "device_s": 0.0,
+                "flops": 0.0, "bytes_accessed": 0.0,
+                "transfer_bytes": 0, "nodes": {},
+            },
+        )
+        dev_s = max(0.0, args.get("device_us", 0.0)) / 1e6
+        a["dispatches"] += 1
+        a["wall_s"] += e.get("dur", 0.0) / 1e6
+        a["device_s"] += dev_s
+        a["flops"] += max(0.0, args.get("flops", 0.0) or 0.0)
+        a["bytes_accessed"] += max(
+            0.0, args.get("bytes_accessed", 0.0) or 0.0
+        )
+        a["transfer_bytes"] += int(args.get("transfer_bytes", 0) or 0)
+        node = args.get("node")
+        if node is not None:
+            a["nodes"][node] = a["nodes"].get(node, 0.0) + dev_s
+    return agg
+
+
+def trace_platform(doc: dict) -> dict | None:
+    """The platform stamp of a trace (what hardware rank 0 measured):
+    single-rank dumps carry it at ``pathway.platform``, merged files per
+    rank under ``rank_meta`` — peak rates from here keep offline
+    roofline verdicts consistent with the recording host."""
+    pw = doc.get("pathway", {})
+    plat = pw.get("platform")
+    if plat:
+        return plat
+    meta = pw.get("rank_meta") or {}
+    for rank_key in sorted(meta):
+        plat = (meta[rank_key] or {}).get("platform")
+        if plat:
+            return plat
+    return None
+
+
+def device_report(doc: dict, sites: dict | None = None) -> dict | None:
+    """The --profile device section: per-site dispatch totals, MFU and
+    the roofline verdict (compute-bound / bandwidth-bound / host-bound),
+    computed through the SAME pure ``roofline_verdict`` the live plane
+    uses (internals/device.py — no drift). None when the trace carries
+    no device spans (a pure relational run). ``sites`` lets a caller
+    that already ran ``aggregate_device_spans`` skip the second
+    full-event pass (profile_trace needs the per-node seconds too)."""
+    from pathway_tpu.internals.device import (
+        mfu as _mfu,
+        peak_bandwidth,
+        peak_flops,
+        roofline_verdict,
+    )
+
+    if sites is None:
+        sites = aggregate_device_spans(doc.get("traceEvents", ()))
+    if not sites:
+        return None
+    plat = trace_platform(doc) or {}
+    pk_flops = plat.get("peak_flops") or peak_flops()
+    pk_bw = plat.get("peak_bandwidth") or peak_bandwidth()
+    rows = []
+    tot_flops = 0.0
+    tot_dev_s = 0.0
+    for site in sorted(
+        sites, key=lambda s: sites[s]["wall_s"], reverse=True
+    ):
+        a = sites[site]
+        verdict = roofline_verdict(
+            a["wall_s"], a["device_s"], a["flops"], a["bytes_accessed"],
+            pk_flops, pk_bw,
+        )
+        tot_flops += a["flops"]
+        tot_dev_s += a["device_s"]
+        rows.append(
+            {
+                "site": site,
+                "dispatches": a["dispatches"],
+                "wall_s": round(a["wall_s"], 6),
+                "device_s": round(a["device_s"], 6),
+                "device_share": round(
+                    a["device_s"] / a["wall_s"], 4
+                ) if a["wall_s"] > 0 else 0.0,
+                "flops": a["flops"],
+                "transfer_bytes": a["transfer_bytes"],
+                "mfu": round(
+                    _mfu(a["flops"], a["device_s"], pk_flops), 6
+                ),
+                "verdict": verdict,
+                "nodes": sorted(a["nodes"]),
+            }
+        )
+    return {
+        "backend": plat.get("backend"),
+        "device_kind": plat.get("device_kind"),
+        "peak_flops": pk_flops,
+        "peak_bandwidth": pk_bw,
+        "mfu": round(_mfu(tot_flops, tot_dev_s, pk_flops), 6),
+        "sites": rows,
+    }
 
 
 def measured_verdict(meta_entry: dict, agg_entry: dict) -> str:
@@ -189,10 +318,36 @@ def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
             lag = e.get("args", {}).get("lag_ms", 0.0)
             lag_max[name] = max(lag_max.get(name, 0.0), lag)
     total_self = sum(a["self_s"] for a in agg.values()) or 1e-12
+    # device plane (ISSUE 15): per-site roofline verdicts + the
+    # node -> dominant-site join, so a slow ExternalIndexNode says
+    # whether it needs a kernel or needs its host path fixed. The
+    # dominant site for a node is the one that spent the most device
+    # time INSIDE that node (per-node seconds from the span args) —
+    # not the site's whole-trace total, which would let a busy
+    # elsewhere site claim nodes it barely touched (and drift from
+    # --critical-path's _node_device_verdict, which already joins
+    # per-node)
+    per_site = aggregate_device_spans(doc.get("traceEvents", ()))
+    device = device_report(doc, sites=per_site)
+    node_device: dict = {}
+    if device is not None:
+        site_rows = {row["site"]: row for row in device["sites"]}
+        node_best: dict = {}  # nid -> (device_s inside nid, site)
+        for site, a in per_site.items():
+            for nid, dev_s in a["nodes"].items():
+                best = node_best.get(nid)
+                if best is None or dev_s > best[0]:
+                    node_best[nid] = (dev_s, site)
+        node_device = {
+            nid: site_rows[site]
+            for nid, (_s, site) in node_best.items()
+            if site in site_rows
+        }
     rows_out = []
     for nid, a in agg.items():
         m = meta.get(str(nid), {})
         measured = measured_verdict(m, a)
+        drow = node_device.get(nid)
         rows_out.append(
             {
                 "node": nid,
@@ -204,6 +359,14 @@ def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
                 "batches": a["batches"],
                 "nb_batches": a["nb_batches"],
                 "verdict": measured,
+                **(
+                    {
+                        "device_verdict": drow["verdict"],
+                        "device_site": drow["site"],
+                    }
+                    if drow is not None
+                    else {}
+                ),
                 **({"blame": m["blame"]} if m.get("blame") else {}),
             }
         )
@@ -219,6 +382,7 @@ def profile_trace(path: str, top_k: int = TOP_K_DEFAULT) -> dict:
         "wave_s": round(wave_s, 6),
         "native_s": {k: round(v, 6) for k, v in sorted(native_s.items())},
         "lag_max_ms": {k: round(v, 3) for k, v in sorted(lag_max.items())},
+        "device": device,
         "top": rows_out[:top_k],
     }
 
@@ -236,13 +400,34 @@ def render_profile(report: dict) -> str:
     lines.append("  top nodes by self-time:")
     for r in report["top"]:
         prov = f"  [{r['provenance']}]" if r.get("provenance") else ""
+        dev = (
+            f"  device: {r['device_verdict']} ({r['device_site']})"
+            if r.get("device_verdict")
+            else ""
+        )
         lines.append(
             f"    {r['share']:>6.1%}  {r['self_s']:>9.4f}s  "
             f"{r['label']:<24} rows={r['rows']:<9} "
-            f"nb={r['nb_batches']}/{r['batches']}  {r['verdict']}{prov}"
+            f"nb={r['nb_batches']}/{r['batches']}  {r['verdict']}"
+            f"{dev}{prov}"
         )
         for b in r.get("blame", ()):
             lines.append(f"            blame: {b}")
+    dev = report.get("device")
+    if dev:
+        lines.append(
+            f"  device dispatches ({dev.get('backend') or '?'} "
+            f"{dev.get('device_kind') or ''}, "
+            f"MFU {dev['mfu']:.4f} @ peak {dev['peak_flops']:.3g} "
+            "FLOP/s):"
+        )
+        for s in dev["sites"]:
+            lines.append(
+                f"    {s['site']:<18} n={s['dispatches']:<6} "
+                f"wall={s['wall_s']:.4f}s dev={s['device_s']:.4f}s "
+                f"({s['device_share']:.0%})  flops={s['flops']:.3g} "
+                f"mfu={s['mfu']:.4f}  {s['verdict']}"
+            )
     if report["native_s"]:
         native = "  ".join(
             f"{k}={v:.4f}s" for k, v in report["native_s"].items()

@@ -46,6 +46,11 @@ class ExternalIndexNode(Node):
     is the query key.
     """
 
+    # adapter.search()/add() issue the engine's device dispatches (KNN
+    # scan, rerank, embedder forward) — the device plane correlates its
+    # dispatch records to this node's span (engine/nodes.py)
+    device_node = True
+
     def __init__(
         self,
         scope,

@@ -56,6 +56,17 @@ def _split_deltas(deltas):
 class Node:
     """Base dataflow node: buffered inputs per timestamp, topo-ordered."""
 
+    # device plane (ISSUE 15): True on node classes whose process() is
+    # expected to issue JAX dispatches (ExternalIndexNode — KNN/top-k/
+    # rerank scans, embedder forwards through an index adapter). The
+    # flight recorder embeds it into node_meta so --profile joins each
+    # such node's roofline verdict (compute/bandwidth/host-bound) onto
+    # its span, and the trace-schema pin knows which node spans should
+    # have correlated device spans. Dispatches from other nodes (a UDF
+    # calling an encoder) still record — correlation comes from the
+    # runtime's step context, this flag only drives the verdict join.
+    device_node = False
+
     def __init__(self, scope, inputs: list["Node"]):
         self.scope = scope
         self.inputs = inputs

@@ -23,6 +23,7 @@ from typing import Any, Callable
 from pathway_tpu.engine.nodes import Node, SourceNode
 from pathway_tpu.engine.scope import Scope
 from pathway_tpu.engine.stream import Delta, is_native_batch
+from pathway_tpu.internals import device as _device
 from pathway_tpu.internals import faults as _faults
 
 # the mesh protocol's decisions (wave partition, quiesce guard, leg
@@ -421,8 +422,19 @@ class Runtime:
             except TypeError:
                 pass
         nb = bool(batches) and is_native_batch(batches[0])
+        # device-plane node context: dispatches issued inside process()
+        # (KNN scans, embedder forwards) stamp this node id into their
+        # records — the correlation key between the trace's device
+        # tracks and this node's span (internals/device.py; ISSUE 15)
+        dev = _device.PLANE.on
+        if dev:
+            _device.PLANE.set_node(nid, time)
         t0 = _time.perf_counter_ns()
-        self._process_node(node, time, batches)
+        try:
+            self._process_node(node, time, batches)
+        finally:
+            if dev:
+                _device.PLANE.clear_node()
         t1 = _time.perf_counter_ns()
         self.stats.on_node_step(
             self._node_label(nid), (t1 - t0) / 1e9, rows, nb
@@ -498,6 +510,11 @@ class Runtime:
             # keep the native ring from wrapping on long runs: pull its
             # buffered GIL-free timers after every step
             rec.drain_native()
+            if rec.dropped:
+                # ring pressure as a LIVE gauge (ISSUE 15 satellite) —
+                # previously only the shutdown dump said the trace was
+                # capped
+                self.stats.set_trace_dropped(rec.dropped)
 
     def _step_exchange_waves(self, time: int, xids: list[int]) -> float:
         """Step the timestamp's exchange boundaries as coalesced waves.
@@ -759,8 +776,16 @@ class Runtime:
         self._txn_final_cut()
         for node in self.scope.nodes:
             node.on_end()
+        # final HBM sample + trace-ring pressure before the recorder
+        # detaches: the shutdown scrape / merged trace must carry the
+        # run's peak, not whatever the last throttled poll saw
+        if _device.PLANE.stats is self.stats:
+            _device.PLANE.sample_memory()
         if self.recorder is not None:
+            self.stats.set_trace_dropped(self.recorder.dropped)
             self._finalize_trace()
+        if _device.PLANE.stats is self.stats:
+            _device.PLANE.disarm()
         if self._procgroup is not None:
             self._procgroup.close()
             self._procgroup = None
@@ -825,6 +850,8 @@ class Runtime:
         """Epoch-abort half: mark the rollback and flush this rank's
         partial so post-mortem traces survive the supervised exit (the
         supervisor's fallback merge picks the partials up)."""
+        if _device.PLANE.stats is self.stats:
+            _device.PLANE.disarm()
         rec, self.recorder = self.recorder, None
         if rec is None or rec.dumped:
             return
@@ -1066,6 +1093,14 @@ class Runtime:
     def run(self) -> None:
         if self.recorder is not None:
             self.recorder.arm_native_ring()
+        # device plane (ISSUE 15): armed alongside the profiling plane
+        # (PATHWAY_TRACE or a live /metrics endpoint) so engine dispatch
+        # sites (ops/knn, encoder, gateway) record per-dispatch device
+        # time, FLOPs and transfer bytes. Process-global like the native
+        # rings — the emulated-rank lane shares it (approximate there,
+        # exact on real meshes); local_only inner runtimes never arm.
+        if self._prof and not self.local_only:
+            _device.PLANE.arm(self.recorder, self.stats)
         try:
             if not self.connectors:
                 self.run_static()
@@ -1115,6 +1150,16 @@ class Runtime:
                 self._abort_trace(exc)
                 self._maybe_exit_for_rollback(exc)
             raise
+        finally:
+            # the plane is process-global: a NON-mesh failure (UDF
+            # exception, data error under terminate_on_error) must not
+            # leave it armed with the dead run's recorder/stats — later
+            # out-of-engine dispatches (a still-alive gateway worker, a
+            # notebook cell) would keep paying block_until_ready and
+            # write into a detached recorder. Idempotent with the
+            # _finish/_abort_trace disarms.
+            if _device.PLANE.stats is self.stats:
+                _device.PLANE.disarm()
 
     def _park_serving_for_rollback(self) -> None:
         """Serving half of the epoch abort (ISSUE 9): every gateway

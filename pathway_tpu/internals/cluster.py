@@ -86,6 +86,24 @@ PASSTHROUGH_FAMILIES = (
     "capture_arrow_rows_total",
     "capture_rows_expanded_total",
     "sink_egress_seconds_total",
+    # device plane (ISSUE 15): which ranks' accelerators are busy, at
+    # what MFU, and whether any rank's trace ring is dropping events
+    "device_dispatches_total",
+    "device_dispatch_seconds_total",
+    "device_wall_seconds_total",
+    "device_flops_total",
+    "device_transfer_bytes_total",
+    "device_mfu",
+    "device_hbm_live_bytes",
+    "device_hbm_peak_bytes",
+    "device_queue_depth",
+    "device_hbm_stats_available",
+    "device_peak_flops",
+    "device_site_dispatches_total",
+    "device_site_dispatch_seconds_total",
+    "device_site_wall_seconds_total",
+    "device_site_flops_total",
+    "trace_dropped_events_total",
     "runtime_idle_seconds_total",
     "mesh_heartbeats_missed_total",
     "mesh_rank_restarts_total",
@@ -490,7 +508,12 @@ class ClusterMetricsAggregator:
                     kind = (
                         "gauge"
                         if name in (
-                            "mesh_last_committed_epoch", "mesh_tree_depth"
+                            "mesh_last_committed_epoch", "mesh_tree_depth",
+                            "device_mfu", "device_hbm_live_bytes",
+                            "device_hbm_peak_bytes", "device_queue_depth",
+                            "device_hbm_stats_available",
+                            "device_peak_flops",
+                            "trace_dropped_events_total",
                         )
                         else "counter"
                     )

@@ -1,0 +1,471 @@
+"""Device plane of the flight recorder (ISSUE 15).
+
+The host-side observability stack (PRs 8/10: flight recorder, cluster
+observatory, critical-path analyzer) goes dark at every JAX dispatch:
+an ``ExternalIndexNode`` KNN scan or an embedder forward is one opaque
+slab of node self-time, with no way to tell whether a slow node needs a
+kernel (device-bound) or needs the host path fixed (device idle while
+the host assembles batches). This module is the missing plane: engine
+dispatch sites (ops/knn.py, ops/pallas_knn.py, models/encoder.py, the
+serving gateway's fused window dispatch) wrap every device launch in a
+**timed dispatch record** —
+
+* wall span of the whole dispatch (host assembly + enqueue + wait);
+* ``jax.block_until_ready``-bounded device time (enqueue-return to
+  results-ready — the device's share of the wall span);
+* compiled ``cost_analysis()`` FLOPs / bytes-accessed when obtainable
+  (cached per shape key; analytical cost models are the fallback, so a
+  backend without cost analysis still produces honest numbers);
+* host<->device transfer bytes and the dispatch-queue depth at launch;
+* the ENCLOSING ENGINE NODE (runtime step context), so device spans in
+  the merged Perfetto trace correlate to their node span by dispatch id.
+
+Records feed three consumers: the flight recorder's new per-rank
+**device tracks** (internals/flight.py ``note_dispatch``), the
+OpenMetrics ``device_*`` families + ``device_mfu`` /
+``device_hbm_{live,peak}_bytes`` gauges (internals/monitoring.py,
+aggregated into ``/metrics/cluster``), and the roofline verdicts of
+``--profile`` / ``--critical-path`` (analysis/profile.py consumes the
+same pure ``roofline_verdict`` below — no drift).
+
+Discipline matches PR 8: armed only while the runtime's profiling plane
+is on (``PATHWAY_TRACE`` or a live /metrics endpoint), ONE attribute
+check (``PLANE.on``) on every dispatch path when off, and the
+``block_until_ready`` sync happens only on armed runs (an armed run
+trades dispatch-pipelining for attribution — the documented cost).
+
+This module never imports jax at module scope: the relational plane
+(and the ASan/fork CI lanes, where importing jaxlib is fatal) must be
+able to load it for free.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time as _time
+from typing import Any
+
+# -- peak-rate tables --------------------------------------------------------
+# per-device-kind peak dense FLOP/s (bf16 MXU) and HBM bandwidth. Used as
+# the MFU denominator and the roofline ridge; PATHWAY_DEVICE_PEAK_FLOPS /
+# PATHWAY_DEVICE_PEAK_GBPS override for hardware the table has not met.
+# Substring-matched against jax's device_kind, most specific first.
+_PEAK_TABLE: tuple[tuple[str, float, float], ...] = (
+    # (device_kind substring, peak FLOP/s, peak HBM bytes/s)
+    ("v6", 918e12, 1638e9),   # TPU v6e (Trillium)
+    ("v5p", 459e12, 2765e9),
+    ("v5", 197e12, 819e9),    # v5e / "TPU v5 lite"
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+)
+# CPU / unknown backend: a deliberately modest single-chip estimate so
+# CPU-lane MFU numbers read as a sanity signal, not hardware truth
+_PEAK_FLOPS_FALLBACK = 2e11
+_PEAK_BW_FALLBACK = 50e9
+
+_HOST_BOUND_SHARE_DEFAULT = 0.35
+
+
+def _env_float(name: str) -> float | None:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw.strip() else None
+    except ValueError:
+        return None
+
+
+def _env_off(name: str) -> bool:
+    return str(os.environ.get(name, "1")).strip().lower() in (
+        "0", "false", "no",
+    )
+
+
+def device_kind() -> str:
+    """The local device's kind string — only when jax is ALREADY loaded
+    (this plane must never be the reason jax imports)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return ""
+    try:
+        devs = jax.local_devices()
+        return str(devs[0].device_kind) if devs else ""
+    except Exception:
+        return ""
+
+
+def peak_flops(kind: str | None = None) -> float:
+    """MFU denominator: PATHWAY_DEVICE_PEAK_FLOPS, else the device-kind
+    table, else the CPU fallback."""
+    override = _env_float("PATHWAY_DEVICE_PEAK_FLOPS")
+    if override is not None:
+        return override
+    kind = device_kind() if kind is None else kind
+    low = kind.lower()
+    for sub, fl, _bw in _PEAK_TABLE:
+        if sub in low:
+            return fl
+    return _PEAK_FLOPS_FALLBACK
+
+
+def peak_bandwidth(kind: str | None = None) -> float:
+    """Roofline ridge denominator (bytes/s): PATHWAY_DEVICE_PEAK_GBPS
+    (GB/s), else the device-kind table, else the CPU fallback."""
+    override = _env_float("PATHWAY_DEVICE_PEAK_GBPS")
+    if override is not None:
+        return override * 1e9
+    kind = device_kind() if kind is None else kind
+    low = kind.lower()
+    for sub, _fl, bw in _PEAK_TABLE:
+        if sub in low:
+            return bw
+    return _PEAK_BW_FALLBACK
+
+
+def host_bound_share() -> float:
+    """Device-busy share of a dispatch site's wall below which the site
+    reads host-bound (PATHWAY_DEVICE_HOST_BOUND_SHARE)."""
+    v = _env_float("PATHWAY_DEVICE_HOST_BOUND_SHARE")
+    if v is None or not (0.0 <= v <= 1.0):
+        return _HOST_BOUND_SHARE_DEFAULT
+    return v
+
+
+def roofline_verdict(
+    wall_s: float,
+    device_s: float,
+    flops: float,
+    bytes_accessed: float,
+    pk_flops: float | None = None,
+    pk_bw: float | None = None,
+    host_share: float | None = None,
+) -> str:
+    """The per-site/per-node verdict of the device plane, pure so the
+    offline analyzers (analysis/profile.py, analysis/critical_path.py)
+    and the live plane compute the SAME answer:
+
+    * ``host-bound`` — the device was idle for most of the dispatch wall
+      (the host was assembling batches / expanding rows): fixing this
+      node means fixing the host path, not writing a kernel;
+    * ``compute-bound`` — arithmetic intensity (FLOPs per HBM byte) at
+      or above the roofline ridge: the MXU is the limiter, a faster
+      kernel or lower precision is the lever;
+    * ``bandwidth-bound`` — intensity below the ridge: HBM traffic is
+      the limiter (fuse, cache, or shrink the working set).
+    """
+    share = host_bound_share() if host_share is None else host_share
+    if wall_s > 0 and device_s < share * wall_s:
+        return "host-bound"
+    if flops <= 0:
+        # no modeled device arithmetic at all: whatever time this site
+        # took was host work by definition
+        return "host-bound"
+    if bytes_accessed <= 0:
+        return "compute-bound"
+    pf = peak_flops() if pk_flops is None else pk_flops
+    pb = peak_bandwidth() if pk_bw is None else pk_bw
+    ridge = pf / max(pb, 1.0)
+    return (
+        "compute-bound"
+        if (flops / bytes_accessed) >= ridge
+        else "bandwidth-bound"
+    )
+
+
+def mfu(flops: float, device_s: float, pk_flops: float | None = None) -> float:
+    """Model FLOPs utilization of a dispatch set: achieved FLOP/s over
+    the device-kind peak."""
+    if device_s <= 0 or flops <= 0:
+        return 0.0
+    return (flops / device_s) / (peak_flops() if pk_flops is None else pk_flops)
+
+
+# -- device memory (absent-stat-safe) ----------------------------------------
+
+def memory_stats() -> dict | None:
+    """``jax.local_devices()[0].memory_stats()`` with every absence mode
+    folded to None: jax not imported, no devices, the backend has no
+    allocator stats (CPU), or the call raises. Callers must treat None
+    as "no HBM story on this backend", not an error."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        devs = jax.local_devices()
+        if not devs:
+            return None
+        ms = devs[0].memory_stats()
+        return ms if ms else None
+    except Exception:
+        return None
+
+
+def platform_info() -> dict | None:
+    """Trace metadata: what hardware this rank actually measured —
+    backend platform, device kind and the peak rates the MFU/roofline
+    numbers were computed against. None when jax never loaded in this
+    process (a pure relational run has no device story). Embedded into
+    the trace's ``rank_meta`` so a merged multi-rank file says per rank
+    what it ran on (ISSUE 15 satellite)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "?"
+    kind = device_kind()
+    return {
+        "backend": backend,
+        "device_kind": kind,
+        "peak_flops": peak_flops(kind),
+        "peak_bandwidth": peak_bandwidth(kind),
+    }
+
+
+# -- compiled cost analysis (cached per shape key) ---------------------------
+
+_COST_CACHE: dict = {}
+
+
+def compiled_cost(
+    key: tuple,
+    fn: Any,
+    args: tuple,
+    fallback: tuple[float, float],
+) -> tuple[float, float]:
+    """``(flops, bytes_accessed)`` for a jitted callable at one shape,
+    preferring the compiled executable's own ``cost_analysis()`` and
+    falling back to the caller's analytical model. Cached per ``key`` —
+    dispatch sites keep bounded shape sets by design (pow2 batch
+    buckets, capacity doublings), so the AOT lower+compile runs once
+    per shape, not per dispatch. ``fn=None`` skips the attempt entirely
+    (sites whose executables are too big to recompile for bookkeeping,
+    e.g. the 1M-row KNN scan).
+    """
+    hit = _COST_CACHE.get(key)
+    if hit is not None:
+        return hit
+    flops, nbytes = float(fallback[0]), float(fallback[1])
+    if fn is not None and not _env_off("PATHWAY_DEVICE_COST_ANALYSIS"):
+        try:
+            ca = fn.lower(*args).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            ca_flops = float(ca.get("flops", 0.0) or 0.0)
+            ca_bytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+            if ca_flops > 0:
+                flops = ca_flops
+            if ca_bytes > 0:
+                nbytes = ca_bytes
+        except Exception:
+            pass
+    _COST_CACHE[key] = (flops, nbytes)
+    return flops, nbytes
+
+
+def nbytes_of(*arrays: Any) -> int:
+    """Sum of ``nbytes`` over array-likes (None / scalar leaves are
+    free) — the transfer-bytes estimate dispatch sites report."""
+    total = 0
+    for a in arrays:
+        n = getattr(a, "nbytes", None)
+        if n is not None:
+            try:
+                total += int(n)
+            except (TypeError, ValueError):
+                pass
+    return total
+
+
+# -- the plane ---------------------------------------------------------------
+
+
+class _Dispatch:
+    """One in-flight dispatch record (``PLANE.begin`` ... ``end``)."""
+
+    __slots__ = (
+        "site", "seq", "node", "t_commit", "t0", "t_ret", "t_done",
+        "depth",
+    )
+
+    def __init__(self, site: str, seq: int, node, t_commit, t0: int,
+                 depth: int):
+        self.site = site
+        self.seq = seq
+        self.node = node
+        self.t_commit = t_commit
+        self.t0 = t0
+        self.t_ret = t0
+        self.t_done = t0
+        self.depth = depth
+
+
+class DevicePlane:
+    """Process-wide device-dispatch recorder.
+
+    Armed/disarmed by the runtime around each run (like the native
+    trace rings, the plane is process-global: under the emulated-rank
+    CI lane several thread-ranks share it and rank 0's recorder claims
+    the records — approximate there, exact on real multi-rank meshes).
+    ``on`` is the ONE attribute dispatch sites check when the plane is
+    off.
+    """
+
+    # memory_stats() walks the allocator — sample at most this often
+    _MEM_POLL_S = 0.5
+
+    def __init__(self):
+        self.on = False
+        self.recorder = None
+        self.stats = None
+        self._seq = 0
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._node_ctx = threading.local()
+        self._last_mem_poll = 0.0
+
+    # -- lifecycle (runtime) ----------------------------------------------
+    def arm(self, recorder, stats) -> None:
+        """Attach this run's flight recorder (may be None: metrics-only
+        runs still feed the gauges) and ProberStats. PATHWAY_DEVICE_TRACE=0
+        keeps the plane off even on an armed run — the opt-out for
+        pipelines where the per-dispatch ``block_until_ready`` sync
+        costs more than the visibility buys."""
+        if _env_off("PATHWAY_DEVICE_TRACE"):
+            return
+        self.recorder = recorder
+        self.stats = stats
+        if stats is not None:
+            stats.set_device_peak_flops(peak_flops())
+        self._last_mem_poll = 0.0
+        # a dispatch site that raised between begin() and end() in a
+        # PREVIOUS run left its record open — re-zero so queue-depth
+        # reporting starts honest for this run
+        with self._lock:
+            self._inflight = 0
+        self.on = True
+
+    def disarm(self) -> None:
+        self.on = False
+        self.recorder = None
+        self.stats = None
+
+    # -- engine-node context (runtime step loop) --------------------------
+    def set_node(self, nid: int, t_commit: int) -> None:
+        self._node_ctx.v = (nid, t_commit)
+
+    def clear_node(self) -> None:
+        self._node_ctx.v = None
+
+    def _current_node(self):
+        return getattr(self._node_ctx, "v", None)
+
+    # -- dispatch records --------------------------------------------------
+    def begin(self, site: str) -> _Dispatch | None:
+        """Open a dispatch record (None when the plane is off — sites
+        guard on ``PLANE.on`` first, so the off path is one attribute
+        check and no call at all)."""
+        if not self.on:
+            return None
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._inflight += 1
+            depth = self._inflight
+        ctx = self._current_node()
+        nid, t_commit = ctx if ctx is not None else (None, None)
+        return _Dispatch(site, seq, nid, t_commit,
+                         _time.perf_counter_ns(), depth)
+
+    def enqueued(self, d: _Dispatch | None) -> None:
+        """Mark the enqueue boundary explicitly (optional — ``end``
+        stamps it from its ``t_ret`` argument path otherwise)."""
+        if d is not None:
+            d.t_ret = _time.perf_counter_ns()
+
+    def end(
+        self,
+        d: _Dispatch | None,
+        outputs: Any = None,
+        *,
+        flops: float = 0.0,
+        bytes_accessed: float = 0.0,
+        transfer_bytes: int = 0,
+        block: bool = True,
+        cost_fn: Any = None,
+    ) -> None:
+        """Close a dispatch record: ``outputs`` (a jax array / pytree)
+        is blocked on so the device time is bounded, the record lands on
+        the flight recorder's device track and the OpenMetrics device
+        families. Host-only dispatch sites (the serving gateway's window
+        commit) pass ``outputs=None, block=False`` — wall-only records
+        whose device time is honestly zero. ``cost_fn`` (-> (flops,
+        bytes_accessed)) runs AFTER the wall span is stamped — the home
+        for ``compiled_cost``, whose first call per shape bucket pays an
+        AOT lower+compile that must not be charged into the record as
+        host time."""
+        if d is None:
+            return
+        if d.t_ret == d.t0:
+            d.t_ret = _time.perf_counter_ns()
+        if block and outputs is not None:
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    jax.block_until_ready(outputs)
+                except Exception:
+                    pass
+        d.t_done = _time.perf_counter_ns()
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+        if cost_fn is not None:
+            try:
+                flops, bytes_accessed = cost_fn()
+            except Exception:
+                pass
+        wall_s = max(0, d.t_done - d.t0) / 1e9
+        device_s = max(0, d.t_done - d.t_ret) / 1e9
+        stats = self.stats
+        if stats is not None:
+            stats.on_device_dispatch(
+                d.site, wall_s, device_s, flops, bytes_accessed,
+                transfer_bytes, d.depth,
+            )
+        rec = self.recorder
+        if rec is not None:
+            rec.note_dispatch(
+                d.site, d.seq, d.node, d.t_commit, d.t0, d.t_ret,
+                d.t_done, flops, bytes_accessed, transfer_bytes, d.depth,
+            )
+        self._sample_memory_throttled()
+
+    # -- HBM gauges --------------------------------------------------------
+    def _sample_memory_throttled(self) -> None:
+        now = _time.monotonic()
+        if now - self._last_mem_poll < self._MEM_POLL_S:
+            return
+        self._last_mem_poll = now
+        self.sample_memory()
+
+    def sample_memory(self) -> None:
+        """Pull ``memory_stats()`` into the HBM gauges; a backend with
+        no allocator stats (CPU) leaves the gauges at their absent-safe
+        zeros with ``available`` false."""
+        stats = self.stats
+        if stats is None:
+            return
+        ms = memory_stats()
+        if ms is None:
+            stats.set_device_memory(0, 0, available=False)
+            return
+        stats.set_device_memory(
+            int(ms.get("bytes_in_use", 0) or 0),
+            int(ms.get("peak_bytes_in_use", 0) or 0),
+            available=True,
+        )
+
+
+PLANE = DevicePlane()

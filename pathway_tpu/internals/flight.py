@@ -233,6 +233,23 @@ class FlightRecorder:
         # the first inflation).
         self._note(("dzip", peer, t0, t1, wire_bytes, raw_bytes))
 
+    def note_dispatch(
+        self, site, seq, node, t_commit, t0, t_ret, t_done,
+        flops, bytes_accessed, transfer_bytes, depth,
+    ) -> None:
+        # device plane (ISSUE 15; internals/device.py): one record per
+        # JAX dispatch an engine site issued — wall span [t0, t_done],
+        # enqueue boundary t_ret (device time = t_done - t_ret, bounded
+        # by block_until_ready), compiled-cost FLOPs/bytes, transfer
+        # bytes and the dispatch-queue depth at launch. `node` is the
+        # enclosing engine node (None for off-engine dispatches like the
+        # gateway's window commit) — the correlation key back to the
+        # node span on the engine track.
+        self._note(
+            ("disp", site, seq, node, t_commit, t0, t_ret, t_done,
+             flops, bytes_accessed, transfer_bytes, depth)
+        )
+
     def note_mark(self, name: str, **args: Any) -> None:
         self._note(("mark", name, _time.perf_counter_ns(), args))
 
@@ -330,6 +347,12 @@ class FlightRecorder:
                 blame = getattr(dec, "blame", ()) or ()
                 if blame:
                     ent["blame"] = list(blame)[:4]
+            if getattr(node, "device_node", False):
+                # this node's process() issues JAX dispatches (engine/
+                # nodes.py Node.device_node): the device plane's spans
+                # correlate to it, and --profile joins its roofline
+                # verdict here
+                ent["device"] = True
             kind = type(node).__name__
             if kind in ("OutputNode", "CaptureNode"):
                 ent["sink"] = True
@@ -399,6 +422,7 @@ class FlightRecorder:
                 )
             return tid
 
+        dispatch_tids: dict[str, int] = {}
         labels: dict[int, str] = {}
         if scope is not None:
             labels = {
@@ -495,6 +519,39 @@ class FlightRecorder:
                         "dur": _dur_us(t0, t1),
                         "args": {
                             "peer": peer, "bytes": wire_b, "raw": raw_b,
+                        },
+                    }
+                )
+            elif kind == "disp":
+                # device dispatch span (ISSUE 15): one track per
+                # dispatch SITE (tid 400+) so device work reads as its
+                # own lane under the engine track in Perfetto.
+                # Concurrent async dispatches legitimately overlap, so
+                # cat "device" is — like "native" — a sample stream,
+                # exempt from the nesting check (validate_trace).
+                (_, site, seq, node, t_commit, t0, t_ret, t_done,
+                 flops, bytes_acc, xfer, depth) = ev
+                sidx = dispatch_tids.setdefault(
+                    site, 400 + len(dispatch_tids)
+                )
+                tid = tid_named(sidx, f"device {site}")
+                out.append(
+                    {
+                        "name": site, "cat": "device", "ph": "X",
+                        "pid": pid, "tid": tid, "ts": self._us(t0),
+                        "dur": _dur_us(t0, t_done),
+                        "args": {
+                            "dispatch": seq,
+                            "node": node,
+                            "t": t_commit,
+                            # block_until_ready-bounded device share of
+                            # the wall span (µs); wall - device = host
+                            # assembly + enqueue
+                            "device_us": _dur_us(t_ret, t_done),
+                            "flops": flops,
+                            "bytes_accessed": bytes_acc,
+                            "transfer_bytes": xfer,
+                            "queue_depth": depth,
                         },
                     }
                 )
@@ -601,6 +658,12 @@ class FlightRecorder:
                 "trace keeps only the newest events (%d dropped)",
                 self.max_events, self.dropped,
             )
+        # device-plane platform stamp (ISSUE 15 satellite): which
+        # backend/device this rank measured, plus the peak rates its
+        # MFU/roofline numbers used — None when jax never loaded here
+        # (pure relational run; platform_info never imports jax itself)
+        from pathway_tpu.internals.device import platform_info
+
         return {
             "schema": TRACE_SCHEMA_VERSION,
             "rank": self.rank,
@@ -608,6 +671,7 @@ class FlightRecorder:
             "event_cap": self.max_events,
             "capped": capped,
             "dropped_events": self.dropped,
+            "platform": platform_info(),
             "clock_offset_ns": self.clock_offset_ns,
             "offset_segments": [
                 [s, o] for s, o in self._offset_segments
@@ -710,6 +774,8 @@ def merge_trace_files(
             # conversion — recorded here for post-mortems only
             "offset_segments": doc.get("offset_segments"),
             "wall_anchor_ns": doc.get("wall_anchor_ns"),
+            # what hardware this rank measured (device plane, ISSUE 15)
+            "platform": doc.get("platform"),
         }
     if not ranks:
         return None

@@ -294,6 +294,30 @@ class ProberStats:
     # per-sink seconds spent encoding/staging egress output (the sink
     # side of the egress leg --profile/--critical-path report)
     sink_egress_s: dict = field(default_factory=dict)  # name -> seconds
+    # device plane (ISSUE 15; internals/device.py): per-dispatch-site
+    # accounting — [dispatches, wall_s, device_s, flops, bytes_accessed,
+    # transfer_bytes]. device_s is the block_until_ready-bounded device
+    # share of each dispatch's wall span; wall - device = host assembly.
+    # Bounded cardinality: a handful of static site names (knn.search,
+    # encoder.forward, serve.window, ...).
+    device_sites: dict = field(default_factory=dict)
+    # dispatch-queue depth observed at the most recent launch (gauge)
+    device_queue_depth: int = 0
+    # MFU denominator this process resolved at arm time (device-kind
+    # table / PATHWAY_DEVICE_PEAK_FLOPS) — rendered so a scraped MFU is
+    # auditable against the peak it was computed from
+    device_peak_flops: float = 0.0
+    # HBM gauges from jax.local_devices()[0].memory_stats(), absent-safe:
+    # a backend without allocator stats (CPU) keeps available=False and
+    # the byte gauges at 0 — "no HBM story", not an error
+    device_hbm_live: int = 0
+    device_hbm_peak: int = 0
+    device_hbm_available: bool = False
+    # flight-recorder ring pressure (ISSUE 15 satellite): events the
+    # bounded in-memory log evicted (previously visible only in the
+    # dump's dropped_events field — now a live gauge, so a capped trace
+    # is observable before shutdown)
+    trace_dropped_events: int = 0
 
     def on_node_step(
         self, label: str, self_s: float, rows: int, nb: bool
@@ -477,6 +501,59 @@ class ProberStats:
                 self.sink_egress_s.get(name, 0.0) + seconds
             )
 
+    # -- device plane (internals/device.py; ISSUE 15) ----------------------
+
+    def on_device_dispatch(
+        self, site: str, wall_s: float, device_s: float, flops: float,
+        bytes_accessed: float, transfer_bytes: int, depth: int,
+    ) -> None:
+        """One closed dispatch record from the device plane. Records
+        arrive from several threads (gateway dispatch workers close
+        serve.window records while the engine thread closes knn/encoder
+        ones) — lock-guarded like the exchange-frame counters so no
+        increment is lost and the MFU gauge never reads torn totals."""
+        with self._frame_lock:
+            agg = self.device_sites.get(site)
+            if agg is None:
+                agg = self.device_sites[site] = [
+                    0, 0.0, 0.0, 0.0, 0.0, 0,
+                ]
+            agg[0] += 1
+            agg[1] += max(0.0, wall_s)
+            agg[2] += max(0.0, device_s)
+            agg[3] += max(0.0, flops)
+            agg[4] += max(0.0, bytes_accessed)
+            agg[5] += max(0, transfer_bytes)
+            self.device_queue_depth = depth
+
+    def set_device_peak_flops(self, v: float) -> None:
+        self.device_peak_flops = v
+
+    def set_device_memory(
+        self, live: int, peak: int, available: bool = True
+    ) -> None:
+        self.device_hbm_live = live
+        self.device_hbm_peak = max(self.device_hbm_peak, peak)
+        self.device_hbm_available = available
+
+    def set_trace_dropped(self, n: int) -> None:
+        self.trace_dropped_events = n
+
+    def device_totals(self) -> tuple:
+        """(dispatches, wall_s, device_s, flops, bytes_accessed,
+        transfer_bytes) summed over sites, plus the resulting MFU —
+        shared by the OpenMetrics render and the TUI dashboard."""
+        tot = [0, 0.0, 0.0, 0.0, 0.0, 0]
+        with self._frame_lock:
+            aggs = [list(a) for a in self.device_sites.values()]
+        for agg in aggs:
+            for i in range(6):
+                tot[i] += agg[i]
+        mfu = 0.0
+        if tot[2] > 0 and tot[3] > 0 and self.device_peak_flops > 0:
+            mfu = (tot[3] / tot[2]) / self.device_peak_flops
+        return (*tot, mfu)
+
     def input_latency_ms(self) -> float:
         if not self.connectors:
             return 0.0
@@ -622,6 +699,47 @@ class ProberStats:
                     f'sink_egress_seconds_total{{sink="{name}"}} '
                     f"{self.sink_egress_s[name]:.6f}"
                 )
+        # device plane (ISSUE 15): globals rendered ALWAYS — the smoke
+        # lane asserts device_dispatch_seconds_total > 0 on a traced
+        # embed+KNN run AND that a relational run honestly reads 0
+        (n_disp, wall_s, dev_s, flops, bytes_acc, xfer,
+         mfu) = self.device_totals()
+        for metric, val, fmt in (
+            ("device_dispatches_total", n_disp, "{}"),
+            ("device_dispatch_seconds_total", dev_s, "{:.6f}"),
+            ("device_wall_seconds_total", wall_s, "{:.6f}"),
+            ("device_flops_total", flops, "{:.6g}"),
+            ("device_transfer_bytes_total", xfer, "{}"),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} " + fmt.format(val))
+        for metric, val, fmt in (
+            ("device_mfu", mfu, "{:.6f}"),
+            ("device_queue_depth", self.device_queue_depth, "{}"),
+            ("device_hbm_live_bytes", self.device_hbm_live, "{}"),
+            ("device_hbm_peak_bytes", self.device_hbm_peak, "{}"),
+            ("device_hbm_stats_available",
+             int(self.device_hbm_available), "{}"),
+            ("device_peak_flops", self.device_peak_flops, "{:.6g}"),
+            ("trace_dropped_events_total", self.trace_dropped_events,
+             "{}"),
+        ):
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} " + fmt.format(val))
+        if self.device_sites:
+            # per-site breakdown (bounded: static site-name set)
+            for metric, idx, fmt in (
+                ("device_site_dispatches_total", 0, "{}"),
+                ("device_site_dispatch_seconds_total", 2, "{:.6f}"),
+                ("device_site_wall_seconds_total", 1, "{:.6f}"),
+                ("device_site_flops_total", 3, "{:.6g}"),
+            ):
+                lines.append(f"# TYPE {metric} counter")
+                for site in sorted(self.device_sites):
+                    lines.append(
+                        f'{metric}{{site="{site}"}} '
+                        + fmt.format(self.device_sites[site][idx])
+                    )
         if self.nodes:
             for metric, idx, fmt in (
                 ("node_self_seconds_total", 0, "{:.6f}"),
@@ -851,6 +969,21 @@ def render_dashboard(stats: ProberStats, graveyard=None):
             f"{stats.capture_arrow_batches}/{stats.capture_arrow_rows}"
             f" | {stats.capture_rows_expanded}",
         )
+    # device plane (ISSUE 15): dispatches, device-vs-wall seconds, MFU
+    # and the HBM gauges — "is the accelerator the limiter" at a glance
+    if stats.device_sites:
+        n_disp, wall_s, dev_s, _f, _b, _x, mfu = stats.device_totals()
+        pipe.add_row(
+            "device dispatches (dev/wall s)",
+            f"{n_disp} ({dev_s:.2f}/{wall_s:.2f})",
+        )
+        pipe.add_row("device MFU", f"{mfu:.3f}")
+        if stats.device_hbm_available:
+            pipe.add_row(
+                "device HBM live/peak [MB]",
+                f"{stats.device_hbm_live // 2**20}"
+                f"/{stats.device_hbm_peak // 2**20}",
+            )
     if (
         stats.mesh_heartbeats_missed
         or stats.mesh_rank_restarts

@@ -49,6 +49,7 @@ from typing import Any, Sequence
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import faults as _faults
+from pathway_tpu.internals.device import PLANE as _DEVICE
 from pathway_tpu.internals.api import Json, Pointer, ref_scalar
 from pathway_tpu.internals.monitoring import ServeMetrics
 from pathway_tpu.internals.parse_graph import G
@@ -284,7 +285,14 @@ class PathwayWebserver:
 class _PendingRequest:
     """One admitted request riding a batch window."""
 
-    __slots__ = ("key", "values", "future", "admitted_at", "evicted")
+    __slots__ = (
+        "key", "values", "future", "admitted_at", "evicted",
+        # Server-Timing stamps (PATHWAY_SERVE_TIMING=1; ISSUE 15
+        # satellite): window-close, dispatch-start and response-resolve
+        # perf_counter readings, so each response can decompose its own
+        # latency into queue/window/dispatch/egress without a trace file
+        "t_closed", "t_dispatch0", "t_resolved",
+    )
 
     def __init__(self, key, values, future):
         self.key = key
@@ -292,6 +300,9 @@ class _PendingRequest:
         self.future = future
         self.admitted_at = _time.perf_counter()
         self.evicted = False
+        self.t_closed = None
+        self.t_dispatch0 = None
+        self.t_resolved = None
 
 
 class RestServerSubject(ConnectorSubject):
@@ -397,6 +408,12 @@ class RestServerSubject(ConnectorSubject):
         self._frontend_mode = bool(
             os.environ.get("PATHWAY_SERVE_BACKEND_PORT")
         )
+        # Server-Timing response header (ISSUE 15 satellite): per-request
+        # queue/window/dispatch/egress ms, so a client-observed p50
+        # decomposes without a trace file
+        self._server_timing = str(
+            os.environ.get("PATHWAY_SERVE_TIMING", "0")
+        ).strip().lower() in ("1", "true", "yes")
         self.serve_metrics = ServeMetrics(route=route)
         # collecting window (event-loop thread only) + closed-window queue
         # drained by the dispatch workers
@@ -672,6 +689,10 @@ class RestServerSubject(ConnectorSubject):
         future: asyncio.Future = asyncio.get_event_loop().create_future()
         self._tasks[key] = future
         pending = _PendingRequest(key, values, future)
+        if self._server_timing:
+            # the response fan-in only sees the future — hang the
+            # pending off it so the resolve stamp lands per request
+            future._pw_pending = pending
         self._inflight += 1
         self._join_window(pending)
         try:
@@ -695,6 +716,13 @@ class RestServerSubject(ConnectorSubject):
         metrics.on_latency_ms(
             (_time.perf_counter() - pending.admitted_at) * 1000.0
         )
+        if self._server_timing:
+            return web.json_response(
+                result,
+                headers={
+                    "Server-Timing": _server_timing_header(pending)
+                },
+            )
         return web.json_response(result)
 
     def _retry_after_s(self) -> int:
@@ -729,6 +757,10 @@ class RestServerSubject(ConnectorSubject):
             self._window_timer.cancel()
             self._window_timer = None
         self._window = []
+        if self._server_timing:
+            now = _time.perf_counter()
+            for p in window:
+                p.t_closed = now
         self._windows_q.put(window)
 
     # -- dispatch workers (threads) ---------------------------------------
@@ -775,6 +807,18 @@ class RestServerSubject(ConnectorSubject):
             # not yet committed (the all-parked-window invariant: this
             # window must commit NOTHING at epoch+1 unless replayed)
             _faults.fault_point("serve.dispatch", phase="window")
+            # device plane (ISSUE 15): the gateway's fused window
+            # dispatch as a timed record — one commit = one downstream
+            # device dispatch. Host-only here (the JAX launch happens in
+            # the engine's step, where the index site records its own
+            # device-bounded span), so no output to block on: the record
+            # carries the window's wall span and the dispatch-queue
+            # depth, and its device time is honestly zero.
+            dev = _DEVICE.begin("serve.window") if _DEVICE.on else None
+            if self._server_timing:
+                now = _time.perf_counter()
+                for p in live:
+                    p.t_dispatch0 = now
             try:
                 for p in live:
                     if self.delete_completed_queries:
@@ -787,6 +831,10 @@ class RestServerSubject(ConnectorSubject):
                     self._remove(key, values)
                 self.commit()
             except BaseException:
+                if dev is not None:
+                    # close the record on the failure path too — an
+                    # abandoned record would leak dispatch-queue depth
+                    _DEVICE.end(dev, None, block=False)
                 if removals:
                     # the swapped-out retractions must not vanish with
                     # the failed dispatch — re-queue them for the next
@@ -798,6 +846,8 @@ class RestServerSubject(ConnectorSubject):
             # delivered — the frontend must replay (the rollback cut
             # discards this commit) without double-answering anyone
             _faults.fault_point("serve.dispatch", phase="committed")
+            if dev is not None:
+                _DEVICE.end(dev, None, block=False)
             if live:
                 self.serve_metrics.on_window(len(live))
 
@@ -813,11 +863,16 @@ class RestServerSubject(ConnectorSubject):
         # exactly the scenario it exists for
         self._breaker_record(True)
         loop = self.webserver._loop
+        t_resolved = _time.perf_counter() if self._server_timing else None
         futures = []
         for key, result in resolved:
             future = self._tasks.get(key)
             if future is not None:
                 futures.append((future, result))
+                if t_resolved is not None:
+                    p = getattr(future, "_pw_pending", None)
+                    if p is not None:
+                        p.t_resolved = t_resolved
             if self.delete_completed_queries:
                 values = self._live.pop(key, None)
                 if values is not None:
@@ -854,6 +909,34 @@ class RestServerSubject(ConnectorSubject):
     def _resolve(self, key: Pointer, value: Any) -> None:
         """Single-row compatibility shim over the batched fan-in."""
         self._resolve_batch([(key, value)])
+
+
+def _server_timing_header(p: _PendingRequest) -> str:
+    """RFC-style ``Server-Timing`` value decomposing one response's
+    latency (PATHWAY_SERVE_TIMING=1; ISSUE 15 satellite):
+
+    * ``queue``    — admission to window close (batch-window wait);
+    * ``window``   — window close to dispatch start (worker pickup);
+    * ``dispatch`` — the windowed commit through the dataflow to the
+      response batch resolving (the engine + device share);
+    * ``egress``   — future resolve to response serialization.
+
+    Missing stamps (a replayed/brownout path) collapse to 0 rather than
+    lying with negative durations."""
+    now = _time.perf_counter()
+    t_admit = p.admitted_at
+    t_closed = p.t_closed if p.t_closed is not None else t_admit
+    t_d0 = p.t_dispatch0 if p.t_dispatch0 is not None else t_closed
+    t_res = p.t_resolved if p.t_resolved is not None else now
+    legs = (
+        ("queue", t_closed - t_admit),
+        ("window", t_d0 - t_closed),
+        ("dispatch", t_res - t_d0),
+        ("egress", now - t_res),
+    )
+    return ", ".join(
+        f"{name};dur={max(0.0, s) * 1000.0:.2f}" for name, s in legs
+    )
 
 
 def _coercion_target(t) -> str:

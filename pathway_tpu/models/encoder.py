@@ -28,6 +28,11 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from pathway_tpu.internals.device import (
+    PLANE as _DEVICE,
+    compiled_cost,
+    nbytes_of,
+)
 from pathway_tpu.models.tokenizer import get_tokenizer
 
 
@@ -118,6 +123,25 @@ def forward_flops_per_token(cfg: EncoderConfig, seq_len: int) -> float:
     h, m = cfg.hidden, cfg.mlp
     per_layer = 8.0 * h * h + 4.0 * h * m + 4.0 * seq_len * h
     return cfg.layers * per_layer
+
+
+def forward_cost_model(
+    cfg: EncoderConfig, n: int, seq_len: int
+) -> tuple[float, float]:
+    """Analytical ``(flops, hbm_bytes_accessed)`` of one padded forward
+    batch — the device plane's fallback when the compiled executable's
+    ``cost_analysis()`` is unavailable. FLOPs: the per-token model above
+    times the padded token count. Bytes: one read of the f32 parameter
+    set (weights dominate HBM traffic at serving batch sizes) plus a
+    few bf16 activation passes per layer."""
+    flops = forward_flops_per_token(cfg, seq_len) * n * seq_len
+    h, m = cfg.hidden, cfg.mlp
+    params_b = 4.0 * (
+        cfg.vocab_size * h + cfg.max_len * h
+        + cfg.layers * (4.0 * h * h + 2.0 * h * m)
+    )
+    act_b = 2.0 * n * seq_len * h * cfg.layers * 4.0
+    return flops, params_b + act_b
 
 
 def _bucket(n: int, floor: int, cap: int) -> int:
@@ -254,15 +278,46 @@ class SentenceEncoder:
             (mask_p.cumsum(axis=1)[np.arange(len(lengths)), lengths - 1]
              == lengths).all()
         ) if mask_p.shape[1] else True
+        # device plane (ISSUE 15): one timed dispatch record per forward
+        # — FLOPs/bytes from the compiled executable's cost_analysis()
+        # (cached per (geometry, shape bucket); the analytical model is
+        # the fallback), transfer bytes from the actual wire arrays.
+        # One attribute check when off; an armed run blocks on the
+        # embeddings, trading the tokenize-ahead overlap for attribution.
+        dev = _DEVICE.begin("encoder.forward") if _DEVICE.on else None
         if contiguous and self.config.vocab_size <= 65536:
-            emb = self._forward_compact(
+            fn = self._forward_compact
+            args = (
                 self.params,
                 jnp.asarray(ids_p.astype(np.uint16)),
                 jnp.asarray(lengths),
             )
         else:
-            emb = self._forward(
-                self.params, jnp.asarray(ids_p), jnp.asarray(mask_p)
+            fn = self._forward
+            args = (self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
+        try:
+            emb = fn(*args)
+        except BaseException:
+            # close the record on the failure path (an abandoned record
+            # leaks dispatch-queue depth)
+            _DEVICE.end(dev, None, block=False)
+            raise
+        if dev is not None:
+            nb_, Lb = ids_p.shape
+            cfg = self.config
+            key = (
+                "encoder", cfg.hidden, cfg.layers, cfg.mlp,
+                cfg.vocab_size, nb_, Lb, fn is self._forward_compact,
+            )
+            # cost_fn runs after end() stamps the wall span: the first
+            # call per shape bucket pays an AOT lower+compile that must
+            # not read as host-assembly time in the dispatch record
+            _DEVICE.end(
+                dev, emb,
+                transfer_bytes=nbytes_of(args[1], args[2], emb),
+                cost_fn=lambda: compiled_cost(
+                    key, fn, args, forward_cost_model(cfg, nb_, Lb)
+                ),
             )
         return emb[:n]
 

@@ -23,7 +23,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from pathway_tpu.ops.topk import chunked_topk_scores
+from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
+from pathway_tpu.ops.topk import chunked_topk_scores, topk_scan_cost
 
 _MIN_CAPACITY = 128
 
@@ -176,10 +177,31 @@ class KnnShard:
                     self.slot_to_key[slot] = key
                 slots.append(slot)
             slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
-            self.vectors, self.valid, self.sq_norms = _write_slots(
-                self.vectors, self.valid, self.sq_norms,
-                slots_arr, jnp.asarray(vecs), jnp.ones((len(slots),), bool),
-                normalize=self.metric is Metric.COS,
+            dev = _DEVICE.begin("knn.write") if _DEVICE.on else None
+            try:
+                self.vectors, self.valid, self.sq_norms = _write_slots(
+                    self.vectors, self.valid, self.sq_norms,
+                    slots_arr, jnp.asarray(vecs),
+                    jnp.ones((len(slots),), bool),
+                    normalize=self.metric is Metric.COS,
+                )
+            except BaseException:
+                _DEVICE.end(dev, None, block=False)
+                raise
+            out_vectors = self.vectors
+        if dev is not None:
+            # end() OUTSIDE the lock, like the search side — its
+            # block_until_ready must not serialize update-while-serving
+            # (a racing writer may have re-donated out_vectors by now;
+            # blocking on an invalidated array is absorbed by end()).
+            # Scatter writes: touch the written rows + norms; FLOPs are
+            # the optional normalize + sq-norm reduction.
+            nrows, d = len(slots), self.dimension
+            _DEVICE.end(
+                dev, out_vectors,
+                flops=4.0 * nrows * d,
+                bytes_accessed=8.0 * nrows * d + 8.0 * nrows,
+                transfer_bytes=nbytes_of(vecs) + 4 * nrows,
             )
 
     def remove(self, keys: Sequence[Any]) -> None:
@@ -229,11 +251,32 @@ class KnnShard:
                 else np.pad(queries, pad)
             )
         fn = _search_fn(k_eff, self.metric.value, self.chunk, self.precision)
-        with self.lock:  # read+launch before the next donating update
-            vals, idx = fn(
-                jnp.asarray(queries), self.vectors, self.valid, self.sq_norms
+        # device plane (ISSUE 15): one timed dispatch record per scan —
+        # wall span, block_until_ready-bounded device time, the scan's
+        # cost model and host->device transfer bytes. One attribute
+        # check when the plane is off; end() blocks OUTSIDE the lock so
+        # attribution never serializes writers.
+        dev = _DEVICE.begin("knn.search") if _DEVICE.on else None
+        try:
+            with self.lock:  # read+launch before the next donating update
+                vals, idx = fn(
+                    jnp.asarray(queries), self.vectors, self.valid,
+                    self.sq_norms,
+                )
+                epoch = self.remove_epoch
+        except BaseException:
+            # close the record on the failure path too (the gateway
+            # site's rule): an abandoned record leaks queue depth
+            _DEVICE.end(dev, None, block=False)
+            raise
+        if dev is not None:
+            flops, acc = topk_scan_cost(
+                padded_n, self.capacity, self.dimension, k_eff
             )
-            epoch = self.remove_epoch
+            _DEVICE.end(
+                dev, (vals, idx), flops=flops, bytes_accessed=acc,
+                transfer_bytes=nbytes_of(queries, vals, idx),
+            )
         vals = np.asarray(vals)[:n]
         idx = np.asarray(idx)[:n]
         out: list[list[tuple[Any, float]]] = []

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pathway_tpu.internals.device import PLANE as _DEVICE
+
 NEG_INF = float("-inf")
 
 
@@ -68,9 +70,28 @@ def _knn_kernel(q_ref, db_ref, mask_ref, out_v_ref, out_i_ref, sv_ref, si_ref,
         out_i_ref[:] = si_ref[:]
 
 
-@functools.partial(
-    jax.jit, static_argnames=("k", "block", "interpret")
-)
+def pallas_knn_cost(
+    q: int, cap: int, d: int, k: int, block: int
+) -> tuple[float, float]:
+    """Analytical ``(flops, hbm_bytes_accessed)`` of the fused kernel —
+    the device plane's cost model for this dispatch site. FLOPs: the
+    per-block score matmul (2·q·block·d MACs per grid step = 2·q·cap·d
+    total) plus K selection sweeps over the [q, k+block] candidate tile
+    (~3 ops per candidate per step). Bytes: the database streams from
+    HBM once, the query tile re-reads per grid step (its BlockSpec maps
+    every step to the same [q, d] tile), and the running top-k lives in
+    VMEM scratch — only the final [q, k] pair lands back in HBM."""
+    nb = max(1, cap // block)
+    flops = 2.0 * q * cap * d + 3.0 * k * q * (k + block) * nb
+    bytes_accessed = (
+        4.0 * cap * d          # database blocks, streamed once
+        + 4.0 * q * d * nb     # query tile, re-fetched per grid step
+        + 4.0 * cap            # additive validity mask (f32)
+        + 8.0 * q * k          # (values, indices) result
+    )
+    return flops, bytes_accessed
+
+
 def pallas_topk_scores(
     queries: jax.Array,    # [Q, D] f32
     database: jax.Array,   # [cap, D] f32
@@ -80,7 +101,43 @@ def pallas_topk_scores(
     block: int = 1024,
     interpret: bool = False,
 ):
-    """Fused scored top-k: returns (values [Q, k], indices [Q, k])."""
+    """Fused scored top-k: returns (values [Q, k], indices [Q, k]).
+
+    Host wrapper over the jitted kernel so the device plane (ISSUE 15)
+    can record a timed dispatch per call — one attribute check when
+    tracing is off."""
+    if not _DEVICE.on:
+        return _pallas_topk_scores_jit(
+            queries, database, add_mask, k=k, block=block,
+            interpret=interpret,
+        )
+    dev = _DEVICE.begin("pallas.topk")
+    try:
+        out = _pallas_topk_scores_jit(
+            queries, database, add_mask, k=k, block=block,
+            interpret=interpret,
+        )
+    except BaseException:
+        _DEVICE.end(dev, None, block=False)
+        raise
+    q, d = queries.shape
+    flops, acc = pallas_knn_cost(q, database.shape[0], d, k, block)
+    _DEVICE.end(dev, out, flops=flops, bytes_accessed=acc)
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "block", "interpret")
+)
+def _pallas_topk_scores_jit(
+    queries: jax.Array,    # [Q, D] f32
+    database: jax.Array,   # [cap, D] f32
+    add_mask: jax.Array,   # [cap] f32 additive (0 valid, -inf invalid)
+    *,
+    k: int,
+    block: int = 1024,
+    interpret: bool = False,
+):
     q, d = queries.shape
     cap = database.shape[0]
     assert cap % block == 0, "capacity must be a multiple of block"

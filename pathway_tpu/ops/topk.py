@@ -130,6 +130,33 @@ def chunked_topk_scores(
     return vals, idx
 
 
+def topk_scan_cost(
+    q: int, cap: int, d: int, k: int
+) -> tuple[float, float]:
+    """Analytical ``(flops, hbm_bytes_accessed)`` of one chunked top-k
+    scan — the device plane's fallback cost model when the compiled
+    executable's own ``cost_analysis()`` is unavailable or too costly
+    to obtain (re-lowering the 1M-row scan just for bookkeeping would
+    compile a second executable; internals/device.py compiled_cost).
+
+    FLOPs: the [q, cap] score matmul dominates (2·q·cap·d MACs); the
+    per-block mask/compare/merge passes add ~3 ops per score. Bytes:
+    one full database read (the scan streams every block from HBM
+    exactly once), the query tile, validity mask + sq_norms, and the
+    [q, k] result pair — per-block score tiles live in VMEM and never
+    touch HBM, which is the point of the chunked design.
+    """
+    flops = 2.0 * q * cap * d + 3.0 * q * cap
+    bytes_accessed = (
+        4.0 * cap * d      # database blocks, streamed once
+        + 4.0 * q * d      # query tile
+        + cap              # validity mask (bool)
+        + 4.0 * cap        # sq_norms (l2 metric; ~free for dot)
+        + 8.0 * q * k      # merged (values, indices) result
+    )
+    return flops, bytes_accessed
+
+
 def _block_scores(queries, db_block, sq_norms_block, metric, precision="highest"):
     scores = jnp.dot(
         queries, db_block.T,

@@ -44,8 +44,26 @@ def run(
         monitoring_level=monitoring_level,
     )
     if profile is not None:
+        import os
+
         import jax
 
+        # fail loudly on a bad profile path: jax.profiler.trace silently
+        # produces nothing when the directory cannot be created (a file
+        # in the way, an unwritable parent) — the run would "succeed"
+        # with zero artifacts and no hint why (ISSUE 15 satellite)
+        profile = os.path.abspath(profile)
+        if os.path.exists(profile) and not os.path.isdir(profile):
+            raise NotADirectoryError(
+                f"profile={profile!r} exists and is not a directory — "
+                "pw.run(profile=...) needs a directory for the XLA "
+                "profiler's trace files"
+            )
+        os.makedirs(profile, exist_ok=True)  # raises on unwritable paths
+        if not os.access(profile, os.W_OK):
+            raise PermissionError(
+                f"profile directory {profile!r} is not writable"
+            )
         with jax.profiler.trace(profile):
             runner.run_outputs()
         return

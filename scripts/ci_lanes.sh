@@ -161,4 +161,18 @@ echo "=== lane 13: columnar lakehouse smoke (2-rank join -> Delta) ==="
 # (runs in lanes 1/2); the export region's GIL discipline is lane 0.
 env -u PATHWAY_LANE_PROCESSES python scripts/lakehouse_smoke.py
 
+echo "=== lane 14: device-trace smoke (embed+KNN device plane) ==="
+# real-fork embed+KNN pipeline (tiny SentenceEncoder forward in a
+# rowwise UDF -> BruteForceKnn ExternalIndexNode) under PATHWAY_TRACE
+# with the metrics server on: the LIVE /metrics must show nonzero
+# device_dispatch_seconds_total plus the device_mfu /
+# device_hbm_peak_bytes gauges, the trace must carry device tracks
+# (dispatch-id'd spans correlated to their enclosing node spans), and
+# `analysis --profile` must exit 0 naming the top dispatch site with
+# its roofline verdict (compute-bound / bandwidth-bound / host-bound).
+# The traced-vs-untraced overhead bar (<= 3%, interleaved pairs) is
+# re-measured with `--bench`; BENCH_full.json records the artifact
+# (device_trace_overhead) via `--update-artifact`.
+env -u PATHWAY_LANE_PROCESSES python scripts/device_trace_smoke.py
+
 echo "=== all lanes green ==="

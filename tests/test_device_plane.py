@@ -1,0 +1,655 @@
+"""Device observability plane battery (ISSUE 15): dispatch records from
+the KNN/encoder sites, trace-schema pin (device spans carry dispatch
+ids, land on their own tracks, correlate to node spans), MFU-gauge
+sanity against the encoder's FLOPs model on the CPU backend, the
+memory_stats-absent fallback, roofline verdict units, the --profile /
+--critical-path host-bound verdicts, the Server-Timing satellite, the
+run(profile=...) directory validation, and the trace-ring dropped-
+events gauge."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis.profile import (
+    aggregate_device_spans,
+    device_report,
+    profile_trace,
+    render_profile,
+    validate_trace,
+)
+from pathway_tpu.internals import device as device_mod
+from pathway_tpu.internals.device import (
+    PLANE,
+    memory_stats,
+    peak_bandwidth,
+    peak_flops,
+    roofline_verdict,
+)
+from pathway_tpu.internals.flight import FlightRecorder
+from pathway_tpu.internals.monitoring import ProberStats
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_plane():
+    """The plane is process-global — every test starts and ends
+    disarmed so records can never leak across tests (or from an
+    unrelated traced test running earlier in the session)."""
+    PLANE.disarm()
+    yield
+    PLANE.disarm()
+
+
+def _knn_round_trip(n=4, d=8, q=2):
+    from pathway_tpu.ops.knn import KnnShard
+
+    rng = np.random.RandomState(0)
+    shard = KnnShard(d)
+    shard.add([f"k{i}" for i in range(n)],
+              rng.rand(n, d).astype(np.float32))
+    return shard.search(rng.rand(q, d).astype(np.float32), 2)
+
+
+# -- off-path discipline --------------------------------------------------
+
+def test_plane_off_is_noop():
+    assert PLANE.on is False
+    assert PLANE.begin("knn.search") is None
+    PLANE.end(None)  # closing a None record is free and legal
+    stats = ProberStats()
+    hits = _knn_round_trip()
+    assert len(hits) == 2 and hits[0]
+    assert stats.device_sites == {}
+
+
+# -- dispatch records -----------------------------------------------------
+
+def test_knn_dispatch_records_land_on_metrics_and_trace(tmp_path):
+    stats = ProberStats()
+    rec = FlightRecorder(str(tmp_path / "t.json"))
+    PLANE.arm(rec, stats)
+    try:
+        _knn_round_trip()
+    finally:
+        PLANE.disarm()
+    assert "knn.search" in stats.device_sites
+    assert "knn.write" in stats.device_sites
+    n, wall_s, dev_s, flops, bytes_acc, xfer, mfu_v = (
+        stats.device_totals()
+    )
+    assert n >= 2 and wall_s > 0 and flops > 0 and xfer > 0
+    # device seconds are a SHARE of wall, never more
+    assert 0 <= dev_s <= wall_s
+    text = stats.render_openmetrics()
+    assert "device_dispatches_total " in text
+    assert 'device_site_flops_total{site="knn.search"}' in text
+    # trace side: device spans with dispatch ids on their own track
+    rec.dump(scope=None)
+    doc = json.load(open(rec.path))
+    assert validate_trace(doc) == [], validate_trace(doc)
+    devs = [
+        e for e in doc["traceEvents"] if e.get("cat") == "device"
+    ]
+    assert devs
+    sites = {e["name"] for e in devs}
+    assert {"knn.search", "knn.write"} <= sites
+    for e in devs:
+        assert e["tid"] >= 400  # own track, never the engine track
+        assert e["args"]["dispatch"] >= 1
+        assert e["args"]["device_us"] >= 0
+    # the platform stamp says what hardware produced the numbers
+    plat = doc["pathway"]["platform"]
+    assert plat and plat["backend"] == "cpu"
+    assert plat["peak_flops"] > 0 and plat["peak_bandwidth"] > 0
+
+
+def test_trace_schema_device_spans_correlate_to_node_spans(
+    tmp_path, monkeypatch
+):
+    """Full pipeline pin: an ExternalIndexNode-driven embed+KNN run
+    under PATHWAY_TRACE produces device spans that carry the enclosing
+    node id, and that node's span exists on the engine track with the
+    device flag in its metadata."""
+    from pathway_tpu.stdlib.indexing import BruteForceKnn
+
+    monkeypatch.setenv("PATHWAY_TRACE", str(tmp_path / "trace.json"))
+    monkeypatch.delenv("PATHWAY_LANE_PROCESSES", raising=False)
+    docs = pw.debug.table_from_markdown(
+        """
+        doc     | vec
+        apple   | 1.0,0.0,0.0
+        banana  | 0.9,0.1,0.0
+        carrot  | 0.0,1.0,0.0
+        """
+    ).select(
+        pw.this.doc,
+        vec=pw.apply_with_type(
+            lambda s: tuple(float(x) for x in s.split(",")),
+            tuple, pw.this.vec,
+        ),
+    )
+    queries = pw.debug.table_from_markdown(
+        """
+        qid | qvec
+        q1  | 1.0,0.05,0.0
+        """
+    ).select(
+        pw.this.qid,
+        qvec=pw.apply_with_type(
+            lambda s: tuple(float(x) for x in s.split(",")),
+            tuple, pw.this.qvec,
+        ),
+    )
+    index = BruteForceKnn(data_column=docs.vec, dimensions=3, metric="cos")
+    res = index.query(queries.qvec, number_of_matches=2)
+    pw.io.subscribe(
+        res.select(pw.this.qid, ids=pw.this._pw_index_reply),
+        on_change=lambda *a: None,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    doc = json.load(open(str(tmp_path / "trace.json")))
+    assert validate_trace(doc) == [], validate_trace(doc)
+    devs = [e for e in doc["traceEvents"] if e.get("cat") == "device"]
+    assert devs, "no device spans from the embed+KNN run"
+    node_spans = {
+        e["args"]["node"]
+        for e in doc["traceEvents"]
+        if e.get("cat") == "node"
+    }
+    for e in devs:
+        assert e["args"]["dispatch"] >= 1
+        nid = e["args"]["node"]
+        assert nid is not None, "engine dispatch without node context"
+        assert nid in node_spans, "correlated node span missing"
+    # the dispatching node is flagged device in the embedded metadata
+    meta = doc["pathway"]["nodes"]
+    dev_nodes = {int(k) for k, m in meta.items() if m.get("device")}
+    assert dev_nodes & {e["args"]["node"] for e in devs}
+    # and --profile joins a roofline verdict onto it
+    report = profile_trace(str(tmp_path / "trace.json"))
+    assert report["valid"], report["problems"]
+    assert report["device"] is not None
+    assert report["device"]["sites"]
+    top_site = report["device"]["sites"][0]
+    assert top_site["verdict"] in (
+        "compute-bound", "bandwidth-bound", "host-bound"
+    )
+    joined = [r for r in report["top"] if r.get("device_verdict")]
+    assert joined, "no node row carries a device verdict"
+    assert "device dispatches" in render_profile(report)
+
+
+# -- MFU sanity against the encoder FLOPs model ---------------------------
+
+def test_encoder_mfu_gauge_sane_vs_flops_model():
+    from pathway_tpu.models.encoder import (
+        EncoderConfig,
+        SentenceEncoder,
+        forward_flops_per_token,
+    )
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    texts = ["the quick brown fox"] * 12
+    enc.encode(texts)  # warm the jit cache outside the armed window
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        enc.encode(texts)
+    finally:
+        PLANE.disarm()
+    agg = stats.device_sites.get("encoder.forward")
+    assert agg is not None and agg[0] >= 1
+    # padded geometry: batch bucket 16, seq bucket 16 for these texts
+    n_tok = 16 * 16
+    model_flops = forward_flops_per_token(cfg, 16) * n_tok
+    measured = agg[3]
+    # cost_analysis (preferred) and the analytical model must agree to
+    # within a small factor — the model is pinned against XLA elsewhere
+    assert model_flops / 4 <= measured <= model_flops * 4, (
+        measured, model_flops,
+    )
+    *_tot, mfu_v = stats.device_totals()
+    assert 0 < mfu_v < 50  # positive and not absurd on CPU
+    assert "device_mfu" in stats.render_openmetrics()
+
+
+# -- memory_stats absent fallback -----------------------------------------
+
+def test_memory_stats_absent_fallback(monkeypatch):
+    # the real call on the CPU backend must already be absent-safe
+    assert memory_stats() is None or isinstance(memory_stats(), dict)
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        monkeypatch.setattr(device_mod, "memory_stats", lambda: None)
+        PLANE.sample_memory()
+    finally:
+        PLANE.disarm()
+    assert stats.device_hbm_available is False
+    assert stats.device_hbm_live == 0 and stats.device_hbm_peak == 0
+    text = stats.render_openmetrics()
+    assert "device_hbm_stats_available 0" in text
+    assert "device_hbm_peak_bytes 0" in text
+    # present stats populate the gauges (peak is monotone)
+    PLANE.arm(None, stats)
+    try:
+        monkeypatch.setattr(
+            device_mod, "memory_stats",
+            lambda: {"bytes_in_use": 100, "peak_bytes_in_use": 250},
+        )
+        PLANE.sample_memory()
+    finally:
+        PLANE.disarm()
+    assert stats.device_hbm_live == 100
+    assert stats.device_hbm_peak == 250
+    assert stats.device_hbm_available is True
+
+
+# -- roofline verdict units -----------------------------------------------
+
+def test_roofline_verdict_units():
+    pk_f, pk_b = 100e12, 1e12  # ridge at 100 FLOPs/byte
+    # device idle while the host assembles -> host-bound
+    assert roofline_verdict(1.0, 0.05, 1e12, 1e9, pk_f, pk_b) == (
+        "host-bound"
+    )
+    # busy device, intensity above the ridge -> compute-bound
+    assert roofline_verdict(1.0, 0.9, 1e12, 1e9, pk_f, pk_b) == (
+        "compute-bound"
+    )
+    # busy device, intensity below the ridge -> bandwidth-bound
+    assert roofline_verdict(1.0, 0.9, 1e10, 1e9, pk_f, pk_b) == (
+        "bandwidth-bound"
+    )
+    # no modeled arithmetic at all: host work by definition
+    assert roofline_verdict(1.0, 0.9, 0.0, 0.0, pk_f, pk_b) == (
+        "host-bound"
+    )
+    # the knob moves the host-bound threshold
+    assert roofline_verdict(
+        1.0, 0.5, 1e12, 1e9, pk_f, pk_b, host_share=0.6
+    ) == "host-bound"
+    assert peak_flops("TPU v5 lite") == pytest.approx(197e12)
+    assert peak_bandwidth("TPU v5p") == pytest.approx(2765e9)
+    assert peak_flops("cpu") > 0
+
+
+def test_peak_knob_overrides(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DEVICE_PEAK_FLOPS", "1e15")
+    monkeypatch.setenv("PATHWAY_DEVICE_PEAK_GBPS", "2000")
+    assert peak_flops("whatever") == pytest.approx(1e15)
+    assert peak_bandwidth("whatever") == pytest.approx(2e12)
+
+
+# -- --profile host-bound verdict on a synthetically starved dispatch ----
+
+def _synthetic_device_trace(tmp_path, device_us, flops=1e9,
+                            bytes_accessed=1e6):
+    """One node span enclosing one device dispatch whose device share
+    of the 10ms wall is `device_us`."""
+    doc = {
+        "traceEvents": [
+            {
+                "name": "ExternalIndexNode#3", "cat": "node", "ph": "X",
+                "pid": 0, "tid": 0, "ts": 1000.0, "dur": 11000.0,
+                "args": {"node": 3, "t": 1, "rows": 64, "rep": "tuple"},
+            },
+            {
+                "name": "knn.search", "cat": "device", "ph": "X",
+                "pid": 0, "tid": 400, "ts": 1100.0, "dur": 10000.0,
+                "args": {
+                    "dispatch": 1, "node": 3, "t": 1,
+                    "device_us": device_us, "flops": flops,
+                    "bytes_accessed": bytes_accessed,
+                    "transfer_bytes": 4096, "queue_depth": 1,
+                },
+            },
+        ],
+        "pathway": {
+            "schema": 1,
+            "nodes": {
+                "3": {
+                    "label": "ExternalIndexNode#3", "device": True,
+                },
+            },
+            "platform": {
+                "backend": "cpu", "device_kind": "cpu",
+                "peak_flops": 1e12, "peak_bandwidth": 1e11,
+            },
+        },
+    }
+    p = tmp_path / "dev.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_profile_emits_host_bound_on_starved_dispatch(tmp_path):
+    # 0.2ms of device time inside a 10ms dispatch wall: the host was
+    # assembling batches while the device idled
+    path = _synthetic_device_trace(tmp_path, device_us=200.0)
+    report = profile_trace(path)
+    assert report["valid"], report["problems"]
+    site = report["device"]["sites"][0]
+    assert site["site"] == "knn.search"
+    assert site["verdict"] == "host-bound"
+    assert report["top"][0]["device_verdict"] == "host-bound"
+    text = render_profile(report)
+    assert "host-bound" in text and "knn.search" in text
+
+
+def test_profile_emits_compute_bound_on_busy_dispatch(tmp_path):
+    # 9.8ms device-busy of a 10ms wall, intensity 1e4 FLOPs/byte vs a
+    # ridge of 10 -> compute-bound
+    path = _synthetic_device_trace(
+        tmp_path, device_us=9800.0, flops=1e10, bytes_accessed=1e6
+    )
+    report = profile_trace(path)
+    site = report["device"]["sites"][0]
+    assert site["verdict"] == "compute-bound"
+    # same trace through the shared aggregation helper
+    doc = json.load(open(path))
+    agg = aggregate_device_spans(doc["traceEvents"])
+    assert agg["knn.search"]["dispatches"] == 1
+    assert agg["knn.search"]["nodes"] == {3: pytest.approx(0.0098)}
+    dev = device_report(doc)
+    assert dev["peak_flops"] == pytest.approx(1e12)  # from the trace
+
+
+def test_device_span_missing_dispatch_arg_is_schema_problem(tmp_path):
+    doc = {
+        "traceEvents": [
+            {
+                "name": "knn.search", "cat": "device", "ph": "X",
+                "pid": 0, "tid": 400, "ts": 1.0, "dur": 5.0,
+                "args": {"node": 3},
+            },
+        ],
+        "pathway": {"schema": 1, "nodes": {}},
+    }
+    problems = validate_trace(doc)
+    assert any("device span missing dispatch" in p for p in problems)
+
+
+def test_critical_path_device_leg_and_verdict(tmp_path):
+    """The straggler's hottest node issued device dispatches: the
+    report grows a per-rank device leg and the verdict says whether the
+    straggler needs a kernel or a host-path fix."""
+    from pathway_tpu.analysis.critical_path import (
+        critical_path,
+        render_critical_path,
+    )
+
+    # canonical 2-rank straggler shape (rank 1 slow), with rank 1's
+    # pre-send work being a host-starved device dispatch
+    def mesh(pid, name, ts, dur, peer):
+        return {
+            "name": name, "cat": "mesh", "ph": "X", "pid": pid,
+            "tid": 0, "ts": ts, "dur": dur, "args": {"peer": peer},
+        }
+
+    events = [
+        {"name": "wave 1", "cat": "wave", "ph": "X", "pid": 0, "tid": 0,
+         "ts": 1000.0, "dur": 3600.0, "args": {"t": 100, "exchanges": 1}},
+        mesh(0, "send→1", 1050.0, 100.0, 1),
+        mesh(0, "recv-wait←1", 1200.0, 3200.0, 1),
+        {"name": "ExternalIndexNode#5", "cat": "node", "ph": "X",
+         "pid": 1, "tid": 0, "ts": 500.0, "dur": 400.0,
+         "args": {"node": 5, "t": 100, "rows": 900, "rep": "tuple"}},
+        {"name": "knn.search", "cat": "device", "ph": "X", "pid": 1,
+         "tid": 400, "ts": 520.0, "dur": 350.0,
+         "args": {"dispatch": 7, "node": 5, "t": 100,
+                  "device_us": 20.0, "flops": 1e8,
+                  "bytes_accessed": 1e6, "transfer_bytes": 512,
+                  "queue_depth": 1}},
+        {"name": "wave 1", "cat": "wave", "ph": "X", "pid": 1, "tid": 0,
+         "ts": 1000.0, "dur": 3500.0, "args": {"t": 100, "exchanges": 1}},
+        mesh(1, "send→0", 4000.0, 200.0, 0),
+        mesh(1, "recv-wait←0", 4250.0, 50.0, 0),
+    ]
+    events.sort(key=lambda e: e["ts"])
+    doc = {
+        "traceEvents": events,
+        "pathway": {
+            "schema": 1,
+            "merged_ranks": [0, 1],
+            "nodes": {
+                "5": {"label": "ExternalIndexNode#5", "device": True},
+            },
+        },
+    }
+    p = tmp_path / "cp.json"
+    p.write_text(json.dumps(doc))
+    report = critical_path(str(p))
+    assert report["valid"], report["problems"]
+    assert report["straggler"]["rank"] == 1
+    n = report["straggler"]["upstream_node"]
+    assert n["label"] == "ExternalIndexNode#5"
+    assert n["device_verdict"] == "host-bound"
+    assert n["device_site"] == "knn.search"
+    assert "device: host-bound (knn.search)" in report["verdict"]
+    assert report["legs"][1]["device_s"] == pytest.approx(20e-6)
+    text = render_critical_path(report)
+    assert "device=0.0000" in text or "device=" in text
+    assert "device: host-bound" in text
+
+
+# -- Server-Timing satellite ----------------------------------------------
+
+_PORT = [9420]
+
+
+def _next_port():
+    _PORT[0] += 1
+    return _PORT[0]
+
+
+def test_server_timing_header(monkeypatch):
+    monkeypatch.setenv("PATHWAY_SERVE_TIMING", "1")
+
+    class S(pw.Schema):
+        value: int
+
+    port = _next_port()
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=S, window_ms=20.0
+    )
+    writer(queries.select(result=pw.this.value * 3))
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"value": 7}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        assert json.loads(resp.read().decode()) == 21
+        st = resp.headers.get("Server-Timing")
+    assert st, "Server-Timing header missing under PATHWAY_SERVE_TIMING=1"
+    legs = {}
+    for part in st.split(","):
+        name, _, dur = part.strip().partition(";dur=")
+        legs[name] = float(dur)
+    assert set(legs) == {"queue", "window", "dispatch", "egress"}
+    assert all(v >= 0.0 for v in legs.values())
+    # the batch window was 20ms: the queue leg saw (some of) it, and
+    # the total decomposition is in the same ballpark as the request
+    assert sum(legs.values()) < 15_000
+
+
+def test_no_server_timing_header_by_default(monkeypatch):
+    monkeypatch.delenv("PATHWAY_SERVE_TIMING", raising=False)
+
+    class S(pw.Schema):
+        value: int
+
+    port = _next_port()
+    webserver = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+    queries, writer = pw.io.http.rest_connector(
+        webserver=webserver, schema=S
+    )
+    writer(queries.select(result=pw.this.value + 1))
+    t = threading.Thread(target=pw.run, daemon=True)
+    t.start()
+    time.sleep(1.0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"value": 1}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        assert json.loads(resp.read().decode()) == 2
+        assert resp.headers.get("Server-Timing") is None
+
+
+# -- run(profile=...) validation ------------------------------------------
+
+def test_run_profile_bad_path_fails_loudly(tmp_path):
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("in the way")
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int), [(1,)]
+    )
+    pw.io.subscribe(t, on_change=lambda *a: None)
+    with pytest.raises(NotADirectoryError):
+        pw.run(
+            profile=str(blocker),
+            monitoring_level=pw.MonitoringLevel.NONE,
+        )
+
+
+# -- trace-ring pressure gauge --------------------------------------------
+
+def test_trace_dropped_events_gauge_renders(tmp_path, monkeypatch):
+    stats = ProberStats()
+    assert "trace_dropped_events_total 0" in stats.render_openmetrics()
+    stats.set_trace_dropped(17)
+    assert "trace_dropped_events_total 17" in stats.render_openmetrics()
+    # end to end: a capped recorder's drops land on the runtime's stats
+    monkeypatch.setenv("PATHWAY_TRACE_MAX_EVENTS", "10000")
+    monkeypatch.setenv(
+        "PATHWAY_TRACE", str(tmp_path / "capped.json")
+    )
+    monkeypatch.delenv("PATHWAY_LANE_PROCESSES", raising=False)
+
+    class Source(pw.io.python.ConnectorSubject):
+        _deletions_enabled = False
+
+        def run(self):
+            for _ in range(4):
+                self.next_batch(
+                    [{"data": f"w{i}"} for i in range(4000)]
+                )
+                self.commit()
+
+    class S(pw.Schema):
+        data: str
+
+    tbl = pw.io.python.read(
+        Source(), schema=S, autocommit_duration_ms=None
+    )
+    pw.io.subscribe(
+        tbl.select(u=pw.this.data.str.upper()),
+        on_change=lambda *a: None,
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    from pathway_tpu.engine.runtime import LAST_RUN_STATS
+
+    doc = json.load(open(str(tmp_path / "capped.json")))
+    if doc["pathway"]["dropped_events"]:
+        assert LAST_RUN_STATS.trace_dropped_events > 0
+        assert "trace_dropped_events_total" in (
+            LAST_RUN_STATS.render_openmetrics()
+        )
+
+
+# -- dispatch-queue depth --------------------------------------------------
+
+def test_dispatch_queue_depth_tracks_inflight():
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        d1 = PLANE.begin("knn.search")
+        d2 = PLANE.begin("encoder.forward")
+        assert d2.depth == 2  # two dispatches in flight at launch
+        PLANE.end(d2, None, block=False)
+        PLANE.end(d1, None, block=False)
+    finally:
+        PLANE.disarm()
+    assert stats.device_queue_depth in (1, 2)
+    assert stats.device_sites["encoder.forward"][0] == 1
+
+
+# -- overhead (pair-measured; excluded from tier-1) ------------------------
+
+@pytest.mark.slow
+def test_device_plane_overhead_pair_measured_under_3pct():
+    """Traced-vs-untraced overhead of the device plane on the embed+KNN
+    hot loop, measured as INTERLEAVED pairs (sequential blocks read
+    ordering bias) — the same methodology as the PR 8 relational lanes.
+    The smoke lane records the same number into BENCH_full.json."""
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops.knn import KnnShard
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    shard = KnnShard(cfg.hidden, capacity=1024)
+    # a pass long enough (~150ms) that scheduler jitter is small
+    # against the 3% bar on a loaded CI host
+    texts = [
+        f"document number {i} about topic {i % 7}" for i in range(256)
+    ]
+    keys = [f"k{j}" for j in range(len(texts))]  # static key set: the
+    # shard must not grow between passes — a capacity doubling
+    # recompiles the scan and the compile lands in whichever arm runs
+    # first, which is ordering bias, not plane overhead
+
+    def one_pass():
+        emb = enc.encode(texts)
+        shard.add(keys, emb)
+        shard.search(emb[:16], 5)
+
+    stats = ProberStats()
+    # warm every jit cache AND the plane's one-time paths in BOTH arms
+    one_pass()
+    PLANE.arm(None, stats)
+    one_pass()
+    PLANE.disarm()
+
+    def timed(armed):
+        if armed:
+            PLANE.arm(None, stats)
+        t0 = time.perf_counter()
+        one_pass()
+        dt = time.perf_counter() - t0
+        if armed:
+            PLANE.disarm()
+        return dt
+
+    def measure(pairs):
+        # median of per-pair ratios, pair order alternating: each pair
+        # shares its moment's machine noise (scheduler, cache state),
+        # and alternating which arm runs first cancels slow drift —
+        # far more stable than comparing two independent medians
+        ratios = []
+        for i in range(pairs):
+            if i % 2 == 0:
+                on, off = timed(True), timed(False)
+            else:
+                off, on = timed(False), timed(True)
+            ratios.append(on / off)
+        return sorted(ratios)[len(ratios) // 2] - 1.0
+
+    overhead = measure(7)
+    if overhead > 0.03:  # one retry at double depth before failing
+        overhead = measure(15)
+    assert overhead <= 0.03, f"device-plane overhead {overhead:.2%}"
