@@ -357,6 +357,17 @@ void hnsw_add(void* h, int64_t key, const float* vec) {
     static_cast<HnswIndex*>(h)->add(key, vec);
 }
 
+// batched insert: one library crossing for n contiguous rows instead of
+// one per document — the graph build itself is still per-row, but the
+// ctypes + argument-marshalling overhead is amortized over the batch
+void hnsw_add_batch(void* h, const int64_t* keys, const float* vecs,
+                    int64_t n) {
+    auto* idx = static_cast<HnswIndex*>(h);
+    for (int64_t i = 0; i < n; ++i) {
+        idx->add(keys[i], vecs + i * idx->dim);
+    }
+}
+
 void hnsw_remove(void* h, int64_t key) {
     static_cast<HnswIndex*>(h)->remove(key);
 }

@@ -134,6 +134,30 @@ KNOBS: dict[str, Knob] = {
            "which its roofline verdict reads host-bound (the device "
            "sat idle while the host assembled batches).", lo=0.0,
            hi=1.0),
+        # -- fused ingest + pod-sharded index (ISSUE 16) -------------------
+        _k("PATHWAY_INGEST_DEPTH", "int", 2,
+           "Tokenize-ahead depth of the fused ingest chain "
+           "(ops/ingest.py): how many tokenized+padded batches the host "
+           "producer may stage ahead of the device. 1 degrades to "
+           "strict alternation; 2 is classic double buffering.",
+           lo=1, hi=64),
+        _k("PATHWAY_INGEST_STAGE_H2D", "bool", True,
+           "Start the next ingest batch's host-to-device token copies "
+           "from the producer thread (double-buffered H2D) so the copy "
+           "overlaps the previous batch's fused dispatch; 0 hands the "
+           "device numpy arrays and pays the transfer on dispatch."),
+        _k("PATHWAY_INDEX_SHARDS", "int", None,
+           "Back vector-index adapters with the pod-sharded HBM index "
+           "over an N-device data-parallel mesh (one corpus shard per "
+           "chip, queries broadcast, per-shard fused matmul+top-k, "
+           "merged over ICI). Unset/0/1 = single-chip shard; ignored "
+           "when fewer than N devices are visible.", lo=0, hi=4096),
+        _k("PATHWAY_INDEX_MERGE", "enum", "auto",
+           "Cross-shard top-k merge strategy for the sharded index: "
+           "'tree' = psum-style recursive-doubling ppermute merge "
+           "(pow2 axes; per-link traffic flat in pod size), 'gather' = "
+           "all_gather + one merge, 'auto' = tree when the axis is "
+           "pow2 else gather.", choices=("auto", "tree", "gather")),
         _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
            "Abort the run on the first data error instead of poisoning "
            "rows to ERROR."),

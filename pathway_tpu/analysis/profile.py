@@ -162,15 +162,23 @@ def aggregate_device_spans(events, by_rank: bool = False) -> dict:
             key,
             {
                 "dispatches": 0, "wall_s": 0.0, "device_s": 0.0,
-                "flops": 0.0, "bytes_accessed": 0.0,
+                "flops": 0.0, "flops_effective": 0.0,
+                "bytes_accessed": 0.0,
                 "transfer_bytes": 0, "nodes": {},
             },
         )
         dev_s = max(0.0, args.get("device_us", 0.0)) / 1e6
+        flops = max(0.0, args.get("flops", 0.0) or 0.0)
         a["dispatches"] += 1
         a["wall_s"] += e.get("dur", 0.0) / 1e6
         a["device_s"] += dev_s
-        a["flops"] += max(0.0, args.get("flops", 0.0) or 0.0)
+        a["flops"] += flops
+        # pre-ISSUE-16 traces carry no flops_effective — such spans
+        # read as fully effective, never as a schema error
+        eff = args.get("flops_effective")
+        a["flops_effective"] += (
+            flops if eff is None else max(0.0, min(float(eff), flops))
+        )
         a["bytes_accessed"] += max(
             0.0, args.get("bytes_accessed", 0.0) or 0.0
         )
@@ -222,16 +230,19 @@ def device_report(doc: dict, sites: dict | None = None) -> dict | None:
     pk_bw = plat.get("peak_bandwidth") or peak_bandwidth()
     rows = []
     tot_flops = 0.0
+    tot_flops_eff = 0.0
     tot_dev_s = 0.0
     for site in sorted(
         sites, key=lambda s: sites[s]["wall_s"], reverse=True
     ):
         a = sites[site]
+        flops_eff = a.get("flops_effective", a["flops"])
         verdict = roofline_verdict(
             a["wall_s"], a["device_s"], a["flops"], a["bytes_accessed"],
             pk_flops, pk_bw,
         )
         tot_flops += a["flops"]
+        tot_flops_eff += flops_eff
         tot_dev_s += a["device_s"]
         rows.append(
             {
@@ -243,8 +254,14 @@ def device_report(doc: dict, sites: dict | None = None) -> dict | None:
                     a["device_s"] / a["wall_s"], 4
                 ) if a["wall_s"] > 0 else 0.0,
                 "flops": a["flops"],
+                "flops_effective": flops_eff,
                 "transfer_bytes": a["transfer_bytes"],
+                # mfu is EFFECTIVE (real rows); mfu_padded is what the
+                # hardware executed, bucket padding included (ISSUE 16)
                 "mfu": round(
+                    _mfu(flops_eff, a["device_s"], pk_flops), 6
+                ),
+                "mfu_padded": round(
                     _mfu(a["flops"], a["device_s"], pk_flops), 6
                 ),
                 "verdict": verdict,
@@ -256,7 +273,8 @@ def device_report(doc: dict, sites: dict | None = None) -> dict | None:
         "device_kind": plat.get("device_kind"),
         "peak_flops": pk_flops,
         "peak_bandwidth": pk_bw,
-        "mfu": round(_mfu(tot_flops, tot_dev_s, pk_flops), 6),
+        "mfu": round(_mfu(tot_flops_eff, tot_dev_s, pk_flops), 6),
+        "mfu_padded": round(_mfu(tot_flops, tot_dev_s, pk_flops), 6),
         "sites": rows,
     }
 

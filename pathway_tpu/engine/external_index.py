@@ -25,7 +25,15 @@ from pathway_tpu.engine.stream import Delta, Key, Row, consolidate, negate
 
 
 class ExternalIndexAdapter(Protocol):
-    """Host adapter owning the actual index (KNN shard, BM25, HNSW...)."""
+    """Host adapter owning the actual index (KNN shard, BM25, HNSW...).
+
+    Adapters may additionally expose batch delta hooks — the operator
+    prefers them when present (one device dispatch / native crossing
+    per consolidated time-batch instead of one per row):
+
+    * ``add_batch(rows)`` with ``rows = [(key, data, filter_data)]``
+    * ``remove_batch(keys)``
+    """
 
     def add(self, key: Key, data: Any, filter_data: Any | None) -> None: ...
 
@@ -102,13 +110,28 @@ class ExternalIndexNode(Node):
         #    (+new, -old) within one consolidated batch, and add-then-remove
         #    would delete the live row.
         index_changed = bool(index_deltas)
-        for k, row, d in index_deltas:
-            if d < 0:
-                self.adapter.remove(k)
-        for k, row, d in index_deltas:
-            if d > 0:
-                data, fdata = self.index_fn(k, row)
-                self.adapter.add(k, data, fdata)
+        removes = [k for k, row, d in index_deltas if d < 0]
+        adds = [
+            (k, *self.index_fn(k, row)) for k, row, d in index_deltas if d > 0
+        ]
+        # batch the delta application when the adapter supports it: one
+        # device dispatch (or one native crossing) per consolidated batch
+        # instead of one per row — the fix for ann_recall's per-doc index
+        # build (ISSUE 16 satellite)
+        remove_batch = getattr(self.adapter, "remove_batch", None)
+        if removes:
+            if remove_batch is not None:
+                remove_batch(removes)
+            else:
+                for k in removes:
+                    self.adapter.remove(k)
+        add_batch = getattr(self.adapter, "add_batch", None)
+        if adds:
+            if add_batch is not None:
+                add_batch(adds)
+            else:
+                for k, data, fdata in adds:
+                    self.adapter.add(k, data, fdata)
 
         # 2. retractions of queries replay the memoized answer
         to_answer: list[tuple[Key, Row]] = []

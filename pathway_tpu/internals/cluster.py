@@ -92,8 +92,11 @@ PASSTHROUGH_FAMILIES = (
     "device_dispatch_seconds_total",
     "device_wall_seconds_total",
     "device_flops_total",
+    "device_flops_effective_total",
     "device_transfer_bytes_total",
+    "device_recompiles_total",
     "device_mfu",
+    "device_mfu_padded",
     "device_hbm_live_bytes",
     "device_hbm_peak_bytes",
     "device_queue_depth",
@@ -103,6 +106,8 @@ PASSTHROUGH_FAMILIES = (
     "device_site_dispatch_seconds_total",
     "device_site_wall_seconds_total",
     "device_site_flops_total",
+    "device_site_flops_effective_total",
+    "device_site_recompiles_total",
     "trace_dropped_events_total",
     "runtime_idle_seconds_total",
     "mesh_heartbeats_missed_total",
@@ -509,7 +514,8 @@ class ClusterMetricsAggregator:
                         "gauge"
                         if name in (
                             "mesh_last_committed_epoch", "mesh_tree_depth",
-                            "device_mfu", "device_hbm_live_bytes",
+                            "device_mfu", "device_mfu_padded",
+                            "device_hbm_live_bytes",
                             "device_hbm_peak_bytes", "device_queue_depth",
                             "device_hbm_stats_available",
                             "device_peak_flops",

@@ -176,7 +176,11 @@ def roofline_verdict(
 
 def mfu(flops: float, device_s: float, pk_flops: float | None = None) -> float:
     """Model FLOPs utilization of a dispatch set: achieved FLOP/s over
-    the device-kind peak."""
+    the device-kind peak. Callers pick which FLOPs they feed: padded
+    FLOPs (what the hardware executed, including bucket padding) or
+    effective FLOPs (only real rows/tokens — the honest utilization
+    number ISSUE 16 reports as ``device_mfu``, with the padded variant
+    kept alongside as ``device_mfu_padded``)."""
     if device_s <= 0 or flops <= 0:
         return 0.0
     return (flops / device_s) / (peak_flops() if pk_flops is None else pk_flops)
@@ -392,10 +396,12 @@ class DevicePlane:
         outputs: Any = None,
         *,
         flops: float = 0.0,
+        flops_effective: float | None = None,
         bytes_accessed: float = 0.0,
         transfer_bytes: int = 0,
         block: bool = True,
         cost_fn: Any = None,
+        effective_share: float | None = None,
     ) -> None:
         """Close a dispatch record: ``outputs`` (a jax array / pytree)
         is blocked on so the device time is bounded, the record lands on
@@ -406,7 +412,15 @@ class DevicePlane:
         bytes_accessed)) runs AFTER the wall span is stamped — the home
         for ``compiled_cost``, whose first call per shape bucket pays an
         AOT lower+compile that must not be charged into the record as
-        host time."""
+        host time.
+
+        MFU honesty (ISSUE 16): ``flops`` is what the hardware executed
+        — padded rows/tokens included. ``flops_effective`` is the share
+        of it spent on REAL rows; sites that pad batches to pow2
+        buckets pass it (or ``effective_share`` in [0, 1], applied
+        after ``cost_fn`` resolves the padded number) so bucket-padding
+        waste is visible instead of inflating the MFU gauge. Defaults
+        to ``flops`` — an unpadded site is 100% effective."""
         if d is None:
             return
         if d.t_ret == d.t0:
@@ -426,21 +440,39 @@ class DevicePlane:
                 flops, bytes_accessed = cost_fn()
             except Exception:
                 pass
+        if flops_effective is None:
+            flops_effective = (
+                flops * min(max(effective_share, 0.0), 1.0)
+                if effective_share is not None
+                else flops
+            )
+        flops_effective = min(flops_effective, flops)
         wall_s = max(0, d.t_done - d.t0) / 1e9
         device_s = max(0, d.t_done - d.t_ret) / 1e9
         stats = self.stats
         if stats is not None:
             stats.on_device_dispatch(
                 d.site, wall_s, device_s, flops, bytes_accessed,
-                transfer_bytes, d.depth,
+                transfer_bytes, d.depth, flops_effective,
             )
         rec = self.recorder
         if rec is not None:
             rec.note_dispatch(
                 d.site, d.seq, d.node, d.t_commit, d.t0, d.t_ret,
                 d.t_done, flops, bytes_accessed, transfer_bytes, d.depth,
+                flops_effective,
             )
         self._sample_memory_throttled()
+
+    def note_recompile(self, site: str) -> None:
+        """One fresh XLA compilation observed at a dispatch site (a new
+        shape bucket entered its compiled-fn cache). Feeds the
+        ``device_recompiles_total`` counter so a silent recompile storm
+        — a shape-bucket leak re-lowering every batch — shows on the
+        TUI/cluster view instead of only as mysterious wall time."""
+        stats = self.stats
+        if stats is not None:
+            stats.on_device_recompile(site)
 
     # -- HBM gauges --------------------------------------------------------
     def _sample_memory_throttled(self) -> None:

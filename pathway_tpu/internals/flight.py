@@ -236,6 +236,7 @@ class FlightRecorder:
     def note_dispatch(
         self, site, seq, node, t_commit, t0, t_ret, t_done,
         flops, bytes_accessed, transfer_bytes, depth,
+        flops_effective=None,
     ) -> None:
         # device plane (ISSUE 15; internals/device.py): one record per
         # JAX dispatch an engine site issued — wall span [t0, t_done],
@@ -244,10 +245,13 @@ class FlightRecorder:
         # bytes and the dispatch-queue depth at launch. `node` is the
         # enclosing engine node (None for off-engine dispatches like the
         # gateway's window commit) — the correlation key back to the
-        # node span on the engine track.
+        # node span on the engine track. flops_effective (ISSUE 16) is
+        # the real-row share of flops (None = fully effective) — the
+        # profile's effective-MFU column rides the trace with it.
         self._note(
             ("disp", site, seq, node, t_commit, t0, t_ret, t_done,
-             flops, bytes_accessed, transfer_bytes, depth)
+             flops, bytes_accessed, transfer_bytes, depth,
+             flops if flops_effective is None else flops_effective)
         )
 
     def note_mark(self, name: str, **args: Any) -> None:
@@ -530,7 +534,8 @@ class FlightRecorder:
                 # cat "device" is — like "native" — a sample stream,
                 # exempt from the nesting check (validate_trace).
                 (_, site, seq, node, t_commit, t0, t_ret, t_done,
-                 flops, bytes_acc, xfer, depth) = ev
+                 flops, bytes_acc, xfer, depth, *rest) = ev
+                flops_eff = rest[0] if rest else flops
                 sidx = dispatch_tids.setdefault(
                     site, 400 + len(dispatch_tids)
                 )
@@ -549,6 +554,7 @@ class FlightRecorder:
                             # assembly + enqueue
                             "device_us": _dur_us(t_ret, t_done),
                             "flops": flops,
+                            "flops_effective": flops_eff,
                             "bytes_accessed": bytes_acc,
                             "transfer_bytes": xfer,
                             "queue_depth": depth,

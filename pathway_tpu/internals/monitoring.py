@@ -296,11 +296,17 @@ class ProberStats:
     sink_egress_s: dict = field(default_factory=dict)  # name -> seconds
     # device plane (ISSUE 15; internals/device.py): per-dispatch-site
     # accounting — [dispatches, wall_s, device_s, flops, bytes_accessed,
-    # transfer_bytes]. device_s is the block_until_ready-bounded device
-    # share of each dispatch's wall span; wall - device = host assembly.
-    # Bounded cardinality: a handful of static site names (knn.search,
-    # encoder.forward, serve.window, ...).
+    # transfer_bytes, flops_effective]. device_s is the
+    # block_until_ready-bounded device share of each dispatch's wall
+    # span; wall - device = host assembly. flops_effective (ISSUE 16) is
+    # the real-row share of flops — padding waste is the gap between the
+    # two. Bounded cardinality: a handful of static site names
+    # (knn.search, encoder.forward, ingest.fused, serve.window, ...).
     device_sites: dict = field(default_factory=dict)
+    # fresh XLA compilations observed at dispatch sites (ISSUE 16): a
+    # new shape bucket entering a site's compiled-fn cache. A recompile
+    # storm (shape-bucket leak) shows here before it shows as wall time.
+    device_recompiles: dict = field(default_factory=dict)
     # dispatch-queue depth observed at the most recent launch (gauge)
     device_queue_depth: int = 0
     # MFU denominator this process resolved at arm time (device-kind
@@ -506,17 +512,22 @@ class ProberStats:
     def on_device_dispatch(
         self, site: str, wall_s: float, device_s: float, flops: float,
         bytes_accessed: float, transfer_bytes: int, depth: int,
+        flops_effective: float | None = None,
     ) -> None:
         """One closed dispatch record from the device plane. Records
         arrive from several threads (gateway dispatch workers close
         serve.window records while the engine thread closes knn/encoder
         ones) — lock-guarded like the exchange-frame counters so no
-        increment is lost and the MFU gauge never reads torn totals."""
+        increment is lost and the MFU gauge never reads torn totals.
+        ``flops_effective`` (ISSUE 16) defaults to ``flops`` — an
+        unpadded site is 100% effective."""
+        if flops_effective is None:
+            flops_effective = flops
         with self._frame_lock:
             agg = self.device_sites.get(site)
             if agg is None:
                 agg = self.device_sites[site] = [
-                    0, 0.0, 0.0, 0.0, 0.0, 0,
+                    0, 0.0, 0.0, 0.0, 0.0, 0, 0.0,
                 ]
             agg[0] += 1
             agg[1] += max(0.0, wall_s)
@@ -524,7 +535,16 @@ class ProberStats:
             agg[3] += max(0.0, flops)
             agg[4] += max(0.0, bytes_accessed)
             agg[5] += max(0, transfer_bytes)
+            agg[6] += max(0.0, min(flops_effective, flops))
             self.device_queue_depth = depth
+
+    def on_device_recompile(self, site: str) -> None:
+        """A dispatch site compiled a fresh executable (new shape
+        bucket). Bounded cardinality: the static site-name set."""
+        with self._frame_lock:
+            self.device_recompiles[site] = (
+                self.device_recompiles.get(site, 0) + 1
+            )
 
     def set_device_peak_flops(self, v: float) -> None:
         self.device_peak_flops = v
@@ -541,18 +561,25 @@ class ProberStats:
 
     def device_totals(self) -> tuple:
         """(dispatches, wall_s, device_s, flops, bytes_accessed,
-        transfer_bytes) summed over sites, plus the resulting MFU —
-        shared by the OpenMetrics render and the TUI dashboard."""
-        tot = [0, 0.0, 0.0, 0.0, 0.0, 0]
+        transfer_bytes, flops_effective) summed over sites, plus the
+        resulting effective MFU (real rows only — the honest number)
+        and padded MFU (what the hardware executed, bucket padding
+        included) — shared by the OpenMetrics render and the TUI
+        dashboard."""
+        tot = [0, 0.0, 0.0, 0.0, 0.0, 0, 0.0]
         with self._frame_lock:
             aggs = [list(a) for a in self.device_sites.values()]
         for agg in aggs:
-            for i in range(6):
-                tot[i] += agg[i]
-        mfu = 0.0
-        if tot[2] > 0 and tot[3] > 0 and self.device_peak_flops > 0:
-            mfu = (tot[3] / tot[2]) / self.device_peak_flops
-        return (*tot, mfu)
+            for i in range(7):
+                # pre-ISSUE-16 6-element rows (a restored snapshot)
+                # read as zero effective FLOPs, never as a crash
+                tot[i] += agg[i] if i < len(agg) else 0.0
+        mfu_eff = mfu_padded = 0.0
+        if tot[2] > 0 and self.device_peak_flops > 0:
+            denom = tot[2] * self.device_peak_flops
+            mfu_eff = tot[6] / denom
+            mfu_padded = tot[3] / denom
+        return (*tot, mfu_eff, mfu_padded)
 
     def input_latency_ms(self) -> float:
         if not self.connectors:
@@ -702,19 +729,26 @@ class ProberStats:
         # device plane (ISSUE 15): globals rendered ALWAYS — the smoke
         # lane asserts device_dispatch_seconds_total > 0 on a traced
         # embed+KNN run AND that a relational run honestly reads 0
-        (n_disp, wall_s, dev_s, flops, bytes_acc, xfer,
-         mfu) = self.device_totals()
+        (n_disp, wall_s, dev_s, flops, bytes_acc, xfer, flops_eff,
+         mfu, mfu_padded) = self.device_totals()
         for metric, val, fmt in (
             ("device_dispatches_total", n_disp, "{}"),
             ("device_dispatch_seconds_total", dev_s, "{:.6f}"),
             ("device_wall_seconds_total", wall_s, "{:.6f}"),
             ("device_flops_total", flops, "{:.6g}"),
+            ("device_flops_effective_total", flops_eff, "{:.6g}"),
             ("device_transfer_bytes_total", xfer, "{}"),
+            ("device_recompiles_total",
+             sum(self.device_recompiles.values()), "{}"),
         ):
             lines.append(f"# TYPE {metric} counter")
             lines.append(f"{metric} " + fmt.format(val))
         for metric, val, fmt in (
+            # device_mfu is EFFECTIVE (real rows); the padded variant —
+            # what the hardware executed, bucket padding included — is
+            # kept alongside so padding waste is auditable (ISSUE 16)
             ("device_mfu", mfu, "{:.6f}"),
+            ("device_mfu_padded", mfu_padded, "{:.6f}"),
             ("device_queue_depth", self.device_queue_depth, "{}"),
             ("device_hbm_live_bytes", self.device_hbm_live, "{}"),
             ("device_hbm_peak_bytes", self.device_hbm_peak, "{}"),
@@ -733,13 +767,22 @@ class ProberStats:
                 ("device_site_dispatch_seconds_total", 2, "{:.6f}"),
                 ("device_site_wall_seconds_total", 1, "{:.6f}"),
                 ("device_site_flops_total", 3, "{:.6g}"),
+                ("device_site_flops_effective_total", 6, "{:.6g}"),
             ):
                 lines.append(f"# TYPE {metric} counter")
                 for site in sorted(self.device_sites):
+                    agg = self.device_sites[site]
+                    val = agg[idx] if idx < len(agg) else 0.0
                     lines.append(
-                        f'{metric}{{site="{site}"}} '
-                        + fmt.format(self.device_sites[site][idx])
+                        f'{metric}{{site="{site}"}} ' + fmt.format(val)
                     )
+        if self.device_recompiles:
+            lines.append("# TYPE device_site_recompiles_total counter")
+            for site in sorted(self.device_recompiles):
+                lines.append(
+                    f'device_site_recompiles_total{{site="{site}"}} '
+                    f"{self.device_recompiles[site]}"
+                )
         if self.nodes:
             for metric, idx, fmt in (
                 ("node_self_seconds_total", 0, "{:.6f}"),
@@ -972,12 +1015,20 @@ def render_dashboard(stats: ProberStats, graveyard=None):
     # device plane (ISSUE 15): dispatches, device-vs-wall seconds, MFU
     # and the HBM gauges — "is the accelerator the limiter" at a glance
     if stats.device_sites:
-        n_disp, wall_s, dev_s, _f, _b, _x, mfu = stats.device_totals()
+        (n_disp, wall_s, dev_s, _f, _b, _x, _fe,
+         mfu, mfu_padded) = stats.device_totals()
         pipe.add_row(
             "device dispatches (dev/wall s)",
             f"{n_disp} ({dev_s:.2f}/{wall_s:.2f})",
         )
-        pipe.add_row("device MFU", f"{mfu:.3f}")
+        pipe.add_row(
+            "device MFU (eff/padded)", f"{mfu:.3f}/{mfu_padded:.3f}"
+        )
+        if stats.device_recompiles:
+            pipe.add_row(
+                "device recompiles",
+                str(sum(stats.device_recompiles.values())),
+            )
         if stats.device_hbm_available:
             pipe.add_row(
                 "device HBM live/peak [MB]",

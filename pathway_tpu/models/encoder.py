@@ -239,6 +239,13 @@ class SentenceEncoder:
                 ).astype(jnp.int32),
             )
         )
+        # shape-bucket → dispatch-fn cache (ISSUE 16): the (batch, seq,
+        # compact) bucket resolves its jitted callable ONCE; a key that
+        # was never seen is — by jit's own cache discipline — a fresh
+        # XLA compilation, counted on device_recompiles_total so a
+        # silent recompile storm (shape-bucket leak) is visible on the
+        # TUI/cluster view instead of only as wall time.
+        self._compiled: dict[tuple, Any] = {}
 
     @property
     def embed_dim(self) -> int:
@@ -285,15 +292,24 @@ class SentenceEncoder:
         # One attribute check when off; an armed run blocks on the
         # embeddings, trading the tokenize-ahead overlap for attribution.
         dev = _DEVICE.begin("encoder.forward") if _DEVICE.on else None
-        if contiguous and self.config.vocab_size <= 65536:
-            fn = self._forward_compact
+        compact = contiguous and self.config.vocab_size <= 65536
+        nb_, Lb = ids_p.shape
+        bucket = (nb_, Lb, compact)
+        fn = self._compiled.get(bucket)
+        if fn is None:
+            # first sighting of this shape bucket: jit will lower+compile
+            # a fresh executable on the call below — count it (ISSUE 16)
+            fn = self._compiled[bucket] = (
+                self._forward_compact if compact else self._forward
+            )
+            _DEVICE.note_recompile("encoder.forward")
+        if compact:
             args = (
                 self.params,
                 jnp.asarray(ids_p.astype(np.uint16)),
                 jnp.asarray(lengths),
             )
         else:
-            fn = self._forward
             args = (self.params, jnp.asarray(ids_p), jnp.asarray(mask_p))
         try:
             emb = fn(*args)
@@ -303,21 +319,24 @@ class SentenceEncoder:
             _DEVICE.end(dev, None, block=False)
             raise
         if dev is not None:
-            nb_, Lb = ids_p.shape
             cfg = self.config
             key = (
                 "encoder", cfg.hidden, cfg.layers, cfg.mlp,
-                cfg.vocab_size, nb_, Lb, fn is self._forward_compact,
+                cfg.vocab_size, nb_, Lb, compact,
             )
             # cost_fn runs after end() stamps the wall span: the first
             # call per shape bucket pays an AOT lower+compile that must
-            # not read as host-assembly time in the dispatch record
+            # not read as host-assembly time in the dispatch record.
+            # Effective share: real tokens over padded tokens — the
+            # bucket-padding waste the effective-MFU gauge exposes.
+            eff_tokens = float(np.sum(lengths[:n], dtype=np.int64))
             _DEVICE.end(
                 dev, emb,
                 transfer_bytes=nbytes_of(args[1], args[2], emb),
                 cost_fn=lambda: compiled_cost(
                     key, fn, args, forward_cost_model(cfg, nb_, Lb)
                 ),
+                effective_share=eff_tokens / float(nb_ * Lb),
             )
         return emb[:n]
 

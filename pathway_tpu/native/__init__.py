@@ -106,6 +106,13 @@ def get_lib() -> ctypes.CDLL | None:
         lib.hnsw_add.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
         ]
+        if hasattr(lib, "hnsw_add_batch"):
+            lib.hnsw_add_batch.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+            ]
         lib.hnsw_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.hnsw_len.restype = ctypes.c_int64
         lib.hnsw_len.argtypes = [ctypes.c_void_p]
@@ -288,6 +295,26 @@ class NativeHnsw:
         v = np.ascontiguousarray(vec, dtype=np.float32)
         self._lib.hnsw_add(
             self._h, key, v.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        )
+
+    def add_batch(self, keys, vecs) -> None:
+        """Insert n rows in ONE library crossing (ISSUE 16: the
+        one-doc-per-dispatch ann build was dominated by per-row call
+        overhead). Falls back to per-row adds on a stale library built
+        before the batch entry point existed."""
+        ks = np.ascontiguousarray(keys, dtype=np.int64)
+        vs = np.ascontiguousarray(vecs, dtype=np.float32)
+        if vs.ndim != 2 or vs.shape[0] != ks.shape[0]:
+            raise ValueError("keys/vectors shape mismatch")
+        if not hasattr(self._lib, "hnsw_add_batch"):
+            for k, v in zip(ks, vs):
+                self.add(int(k), v)
+            return
+        self._lib.hnsw_add_batch(
+            self._h,
+            ks.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ks.shape[0],
         )
 
     def remove(self, key: int) -> None:

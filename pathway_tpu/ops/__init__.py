@@ -8,7 +8,7 @@ matmul + top-k scoring on the MXU, and mergeable partial top-k results for
 mesh-sharded indexes (SURVEY §5 long-context mapping).
 """
 
-from pathway_tpu.ops.topk import masked_topk, merge_topk
+from pathway_tpu.ops.topk import masked_topk, merge_topk, tree_merge_topk
 from pathway_tpu.ops.knn import KnnShard, Metric
 from pathway_tpu.ops.query_engine import MicroBatcher, QueryEngine
 
@@ -19,4 +19,15 @@ __all__ = [
     "QueryEngine",
     "masked_topk",
     "merge_topk",
+    "tree_merge_topk",
 ]
+
+
+def __getattr__(name):
+    # IngestPipeline pulls in the encoder stack (flax) — lazy so the
+    # relational plane keeps importing pathway_tpu.ops for free
+    if name == "IngestPipeline":
+        from pathway_tpu.ops.ingest import IngestPipeline
+
+        return IngestPipeline
+    raise AttributeError(name)

@@ -103,6 +103,12 @@ class KnnShard:
         self.capacity = _next_pow2(capacity)
         self.key_to_slot: dict[Any, int] = {}
         self.slot_to_key: dict[int, Any] = {}
+        # insertion-sequence mint for the deterministic tie-break: equal
+        # scores order by when the key was (last) inserted, so results
+        # never depend on slot layout — the contract that makes sharded
+        # and single-chip indexes bit-identical (tests/test_sharded_parity)
+        self.key_seq: dict[Any, int] = {}
+        self._next_seq = 0
         self.free_slots: list[int] = list(range(self.capacity - 1, -1, -1))
         self.vectors = jnp.zeros((self.capacity, self.dimension), jnp.float32)
         self.valid = jnp.zeros((self.capacity,), bool)
@@ -159,6 +165,25 @@ class KnnShard:
             )
         return vecs
 
+    def _assign_slots(self, keys: Sequence[Any]) -> np.ndarray:
+        """Map keys to dense slots (upsert semantics), growing first.
+        Must be called under ``self.lock`` — shared by ``add`` and the
+        fused ingest chain (ops/ingest.py), which maps keys to slots
+        host-side while the encoder forward + slot-write run as one
+        jitted dispatch."""
+        self._grow_to(len(self.key_to_slot) + len(keys))
+        slots = []
+        for key in keys:
+            slot = self.key_to_slot.get(key)
+            if slot is None:
+                slot = self.free_slots.pop()
+                self.key_to_slot[key] = slot
+                self.slot_to_key[slot] = key
+                self.key_seq[key] = self._next_seq
+                self._next_seq += 1
+            slots.append(slot)
+        return np.asarray(slots, dtype=np.int32)
+
     def add(self, keys: Sequence[Any], vecs) -> None:
         """Upsert vectors; accepts numpy or device-resident jax arrays (the
         latter avoids a host round-trip when chaining from a jitted encoder).
@@ -167,16 +192,8 @@ class KnnShard:
         if len(keys) != vecs.shape[0]:
             raise ValueError("keys/vectors length mismatch")
         with self.lock:
-            self._grow_to(len(self.key_to_slot) + len(keys))
-            slots = []
-            for key in keys:
-                slot = self.key_to_slot.get(key)
-                if slot is None:
-                    slot = self.free_slots.pop()
-                    self.key_to_slot[key] = slot
-                    self.slot_to_key[slot] = key
-                slots.append(slot)
-            slots_arr = jnp.asarray(np.asarray(slots, dtype=np.int32))
+            slots = self._assign_slots(keys)
+            slots_arr = jnp.asarray(slots)
             dev = _DEVICE.begin("knn.write") if _DEVICE.on else None
             try:
                 self.vectors, self.valid, self.sq_norms = _write_slots(
@@ -212,6 +229,7 @@ class KnnShard:
                 if slot is None:
                     continue
                 del self.slot_to_key[slot]
+                self.key_seq.pop(key, None)
                 self.free_slots.append(slot)
                 slots.append(slot)
             if not slots:
@@ -264,6 +282,7 @@ class KnnShard:
                     self.sq_norms,
                 )
                 epoch = self.remove_epoch
+                live_rows = len(self.key_to_slot)
         except BaseException:
             # close the record on the failure path too (the gateway
             # site's rule): an abandoned record leaks queue depth
@@ -273,8 +292,15 @@ class KnnShard:
             flops, acc = topk_scan_cost(
                 padded_n, self.capacity, self.dimension, k_eff
             )
+            # effective FLOPs (ISSUE 16): only real queries against live
+            # rows count as useful work — query padding and the empty
+            # tail of the pow2 capacity buffer are visible padding waste
+            flops_eff, _ = topk_scan_cost(
+                n, live_rows, self.dimension, k_eff
+            )
             _DEVICE.end(
-                dev, (vals, idx), flops=flops, bytes_accessed=acc,
+                dev, (vals, idx), flops=flops,
+                flops_effective=flops_eff, bytes_accessed=acc,
                 transfer_bytes=nbytes_of(queries, vals, idx),
             )
         vals = np.asarray(vals)[:n]
@@ -295,7 +321,10 @@ class KnnShard:
                 if key is None:
                     continue
                 hits.append((key, float(vv)))
-                if len(hits) == k:
-                    break
-            out.append(hits)
+            # deterministic tie-break over ALL k_eff candidates before
+            # truncating: equal scores order by insertion sequence, so
+            # the result never depends on slot layout (which a sharded
+            # index lays out differently) — see ShardedKnnIndex.search
+            hits.sort(key=lambda t: (-t[1], self.key_seq.get(t[0], 0)))
+            out.append(hits[:k])
         return out

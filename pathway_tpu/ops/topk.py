@@ -43,6 +43,39 @@ def merge_topk(vals_a, idx_a, vals_b, idx_b, k: int):
     return top_vals, top_idx
 
 
+def tree_merge_topk(vals, idx, k: int, axis: str, axis_size: int):
+    """Global top-k across a pow2 mesh axis by recursive doubling —
+    the psum-style merge for the pod-sharded index (ISSUE 16, SURVEY
+    §5): log2(n) ``ppermute`` exchange+merge rounds over ICI instead of
+    one all_gather of every shard's partials. Each round ships 2·q·k
+    values per link (vs (n-1)·q·k for the gather at the root), so the
+    merge cost stays flat as the pod grows.
+
+    Must run inside ``shard_map`` over ``axis``; vals/idx are one
+    shard's partial top-k [q, k] (values desc). Ties at each merge are
+    broken lower-rank-first (the XOR pairing keeps rank order inside
+    every butterfly pair), matching the gather merge's shard-0-first
+    order. Returns the REPLICATED global top-k — the butterfly is an
+    all-reduce, every shard ends with the same answer.
+    """
+    me = jax.lax.axis_index(axis)
+    step = 1
+    while step < axis_size:
+        perm = [(i, i ^ step) for i in range(axis_size)]
+        other_vals = jax.lax.ppermute(vals, axis, perm)
+        other_idx = jax.lax.ppermute(idx, axis, perm)
+        # lower rank of the pair contributes first so top_k's stable
+        # positional tie-break resolves by shard order, like the gather
+        low = (me & step) == 0
+        a_vals = jnp.where(low, vals, other_vals)
+        a_idx = jnp.where(low, idx, other_idx)
+        b_vals = jnp.where(low, other_vals, vals)
+        b_idx = jnp.where(low, other_idx, idx)
+        vals, idx = merge_topk(a_vals, a_idx, b_vals, b_idx, k)
+        step *= 2
+    return vals, idx
+
+
 _SCORES_BUDGET_BYTES = 1 << 28  # 256 MB of f32 scores per block
 
 
@@ -145,6 +178,12 @@ def topk_scan_cost(
     exactly once), the query tile, validity mask + sq_norms, and the
     [q, k] result pair — per-block score tiles live in VMEM and never
     touch HBM, which is the point of the chunked design.
+
+    This counts PADDED work: `q` is the pow2-padded query batch, `cap`
+    the pow2 capacity including dead slots — what the hardware
+    executed. For the effective (real-rows) number ISSUE 16's honest
+    MFU reports, call it again with the real query count and live row
+    count; the dispatch sites pass both to the device plane.
     """
     flops = 2.0 * q * cap * d + 3.0 * q * cap
     bytes_accessed = (

@@ -1,4 +1,4 @@
-"""Mesh-sharded brute-force KNN index.
+"""Mesh-sharded brute-force KNN index (pod-sharded HBM index, ISSUE 16).
 
 Replaces the reference's broadcast-replicated external index
 (/root/reference/src/engine/dataflow/operators/external_index.rs:95-106 —
@@ -6,14 +6,32 @@ index diffs broadcast so every worker holds a FULL copy, bounded by host
 RAM) with the TPU-native design from SURVEY §5: each chip's HBM holds one
 shard of the padded vector store; queries are replicated to all shards
 (their natural state under jit), each shard computes a local fused
-matmul+top-k, and partial results are all-gathered over ICI and tree-merged
-into the global top-k. Index capacity now scales with the number of chips
-instead of being replicated per worker.
+matmul+top-k, and the partials are merged into the global top-k — either
+by all-gather + one merge, or by a psum-style recursive-doubling
+**tree merge** over ICI (``ops.topk.tree_merge_topk``,
+``PATHWAY_INDEX_MERGE``) whose per-link traffic stays flat as the pod
+grows. Index capacity scales with the number of chips instead of being
+replicated per worker.
+
+Delta routing (ISSUE 16): insert/delete deltas are routed to their
+OWNING shard by the same stable mint the mesh's exchange plane uses —
+``procgroup.shard_hash`` (blake2b-64) through ``protocol.shard_owner``
+— so every rank computes the same owner without coordination, rows
+spread evenly across shards (capacity actually scales ~linearly with
+the mesh), and a re-shard is a pure re-bucketing of the same digests.
+
+Write path: one donated, jitted batched slot-write per delta batch
+(the same ``_write_slots`` executable the single-chip shard uses), not
+one host `.at[].set` per row — writers hold the index lock against
+query launches exactly like ``ops.knn.KnnShard`` (donation invalidates
+the buffers a racing reader might still be holding).
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import threading
 from typing import Any, Sequence
 
 import numpy as np
@@ -22,9 +40,29 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pathway_tpu.ops.knn import Metric, _next_pow2
-from pathway_tpu.ops.topk import chunked_topk_scores
+from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
+from pathway_tpu.ops.knn import Metric, _write_slots
+from pathway_tpu.ops.topk import (
+    chunked_topk_scores,
+    topk_scan_cost,
+    tree_merge_topk,
+)
 from pathway_tpu.parallel._compat import compat_shard_map
+from pathway_tpu.parallel.procgroup import shard_hash
+from pathway_tpu.parallel.protocol import shard_owner
+
+
+def _merge_mode(n_shards: int) -> str:
+    """Resolve PATHWAY_INDEX_MERGE: 'tree' (recursive doubling over
+    ICI) needs a pow2 axis; 'auto' picks tree when the axis allows it,
+    'gather' is the all_gather + single-merge fallback."""
+    raw = str(os.environ.get("PATHWAY_INDEX_MERGE", "auto")).strip().lower()
+    pow2 = n_shards & (n_shards - 1) == 0
+    if raw == "tree":
+        return "tree" if pow2 else "gather"
+    if raw == "gather":
+        return "gather"
+    return "tree" if pow2 else "gather"
 
 
 def sharded_topk(
@@ -37,8 +75,9 @@ def sharded_topk(
     axis: str = "dp",
     sq_norms: jax.Array | None = None,
     metric: str = "dot",
-    chunk: int = 8192,
+    chunk: int | None = None,
     precision: str = "highest",
+    merge: str = "gather",
 ):
     """Global top-k over a row-sharded database. Returns replicated
     (values [q, k], global indices [q, k])."""
@@ -46,22 +85,38 @@ def sharded_topk(
     in_specs = [P(), P(axis, None), P(axis)]
     if use_sq:
         in_specs.append(P(axis))
+    n_shards = mesh.shape[axis]
 
     def local(q, db_l, valid_l, *rest):
         sq_l = rest[0] if use_sq else None
         # per-shard k is bounded by the shard's rows; the merged global
         # top-k can still honor the full k from other shards' partials
         # (up to the index's total capacity)
-        k_l = min(k, db_l.shape[0], chunk)
+        chunk_l = min(chunk or db_l.shape[0], db_l.shape[0])
+        k_l = min(k, db_l.shape[0], chunk_l)
         vals, idx = chunked_topk_scores(
             q, db_l, valid_l, k_l,
-            chunk=min(chunk, db_l.shape[0]), sq_norms=sq_l,
+            chunk=chunk_l, sq_norms=sq_l,
             metric=metric, precision=precision,
         )
         shard_i = jax.lax.axis_index(axis)
         idx = idx + shard_i * db_l.shape[0]
-        # partial top-k exchange + tree merge (the retrieval analog of ring
-        # attention's partial-result merge): [n_shards, q, k_l] -> [q, k_out]
+        if merge == "tree" and n_shards > 1:
+            # psum-style butterfly: log2(n) ppermute+merge rounds, each
+            # link carries 2·q·k_l instead of the gather's (n-1)·q·k_l
+            k_out = min(k, n_shards * k_l)
+            if k_out > k_l:
+                # widen the partial to the merged width first so every
+                # round merges equal shapes
+                pad = k_out - k_l
+                vals = jnp.pad(
+                    vals, ((0, 0), (0, pad)),
+                    constant_values=float("-inf"),
+                )
+                idx = jnp.pad(idx, ((0, 0), (0, pad)))
+            return tree_merge_topk(vals, idx, k_out, axis, n_shards)
+        # partial top-k exchange + flat merge (the retrieval analog of
+        # ring attention's partial-result merge): [n, q, k_l] -> [q, k]
         all_vals = jax.lax.all_gather(vals, axis)
         all_idx = jax.lax.all_gather(idx, axis)
         n, nq, _ = all_vals.shape
@@ -72,8 +127,9 @@ def sharded_topk(
         best_i = jnp.take_along_axis(ai, pos, axis=-1)
         return best_v, best_i
 
-    # all_gather makes the outputs replicated, but the vma checker can't see
-    # that through lax.top_k — the shared compat shim disables the check
+    # all_gather/ppermute make the outputs replicated, but the vma
+    # checker can't see that through lax.top_k — the shared compat shim
+    # disables the check
     smapped = compat_shard_map(
         local, mesh, in_specs=tuple(in_specs), out_specs=(P(), P())
     )
@@ -82,12 +138,20 @@ def sharded_topk(
 
 @functools.lru_cache(maxsize=None)
 def _sharded_search_fn(mesh: Mesh, axis: str, k: int, metric: str,
-                       chunk: int, precision: str, use_sq: bool):
+                       chunk: int | None, precision: str, merge: str):
     def fn(queries, database, valid, sq_norms):
+        # query prep is IDENTICAL to ops.knn._search_fn (same jnp ops,
+        # same f32) — the sharded-vs-single-chip parity battery pins
+        # scores bit-identical, so no host-side normalization variant
+        queries = queries.astype(jnp.float32)
+        if metric == "cos":
+            n = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+            queries = queries / jnp.maximum(n, 1e-30)
         return sharded_topk(
             queries, database, valid, k, mesh, axis=axis,
-            sq_norms=sq_norms if use_sq else None,
-            metric=metric, chunk=chunk, precision=precision,
+            sq_norms=sq_norms if metric == "l2sq" else None,
+            metric="l2sq" if metric == "l2sq" else "dot",
+            chunk=chunk, precision=precision, merge=merge,
         )
 
     return jax.jit(fn)
@@ -95,7 +159,16 @@ def _sharded_search_fn(mesh: Mesh, axis: str, k: int, metric: str,
 
 class ShardedKnnIndex:
     """Host-facing sharded index: same contract as ops.KnnShard, but the
-    vector store is laid out across a mesh axis, one HBM shard per chip."""
+    vector store is laid out across a mesh axis, one HBM shard per chip.
+
+    Slot layout: global slot = owner_shard * local_cap + local_slot; a
+    key's owner shard is minted from its stable blake2b digest
+    (``shard_owner(shard_hash(key), n_shards)``), so rows spread evenly
+    and capacity scales with the mesh. Ties in query results are broken
+    by insertion sequence (host-side, after the device merge) — the
+    deterministic contract the sharded-vs-single-chip parity battery
+    pins bit-identical.
+    """
 
     def __init__(
         self,
@@ -104,7 +177,7 @@ class ShardedKnnIndex:
         *,
         metric: Metric | str = Metric.COS,
         axis: str = "dp",
-        chunk: int = 8192,
+        chunk: int | None = None,  # None = whole shard in one block
         precision: str = "highest",
     ):
         self.dimension = int(dimension)
@@ -113,14 +186,24 @@ class ShardedKnnIndex:
         self.metric = Metric(metric)
         self.chunk = chunk
         self.precision = precision
-        self.n_shards = mesh.shape[axis]
+        self.n_shards = int(mesh.shape[axis])
         # per-shard capacity is a power of two; total = n_shards * local
         # (divides evenly over the mesh axis for any device count)
         self.local_cap = 128
         self.capacity = self.n_shards * self.local_cap
         self.key_to_slot: dict[Any, int] = {}
         self.slot_to_key: dict[int, Any] = {}
-        self.free_slots: list[int] = list(range(self.capacity - 1, -1, -1))
+        # insertion-sequence mint for the deterministic tie-break (a
+        # re-added key gets a fresh sequence — it is a new row)
+        self.key_seq: dict[Any, int] = {}
+        self._next_seq = 0
+        # per-shard free lists of GLOBAL slots (shard s owns
+        # [s*local_cap, (s+1)*local_cap)): delta routing fills the
+        # OWNING shard, not whichever slot a global list happens to pop
+        self.free_by_shard: list[list[int]] = [
+            list(range((s + 1) * self.local_cap - 1, s * self.local_cap - 1, -1))
+            for s in range(self.n_shards)
+        ]
         self._db_sharding = NamedSharding(mesh, P(axis, None))
         self._row_sharding = NamedSharding(mesh, P(axis))
         self._repl = NamedSharding(mesh, P())
@@ -134,86 +217,194 @@ class ShardedKnnIndex:
         self.sq_norms = jax.device_put(
             jnp.zeros((self.capacity,), jnp.float32), self._row_sharding
         )
+        # writers donate the buffer triple — same update-while-serving
+        # lock discipline as ops.knn.KnnShard
+        self.lock = threading.Lock()
+        self.remove_epoch = 0
+        self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
+        # batched slot-write with the shard layout pinned on the outputs
+        # (the scatter must not silently replicate the store); same body
+        # as the single-chip shard's donated writer
+        self._write = jax.jit(
+            _write_slots.__wrapped__,
+            static_argnames=("normalize",),
+            donate_argnums=(0, 1, 2),
+            out_shardings=(
+                self._db_sharding, self._row_sharding, self._row_sharding
+            ),
+        )
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
 
+    # -- routing -----------------------------------------------------------
+    def owner_shard(self, key) -> int:
+        """The shard that owns ``key`` — the mesh's stable mint
+        (blake2b digest mod world), so every rank agrees without
+        coordination and a re-shard is a pure re-bucketing."""
+        return shard_owner(shard_hash(key), self.n_shards)
+
+    def shard_fill(self) -> list[int]:
+        """Live rows per shard (capacity-scaling observability)."""
+        fill = [0] * self.n_shards
+        for slot in self.slot_to_key:
+            fill[slot // self.local_cap] += 1
+        return fill
+
     def _prepare(self, vecs) -> np.ndarray:
+        """Shape/dtype check only — cos normalization happens on device
+        inside the jitted write/search fns, with the SAME jnp ops as the
+        single-chip KnnShard (bit-identical parity contract)."""
         vecs = np.asarray(vecs, dtype=np.float32)
         if vecs.ndim == 1:
             vecs = vecs[None, :]
-        if self.metric is Metric.COS:
-            norms = np.linalg.norm(vecs, axis=-1, keepdims=True)
-            norms[norms == 0] = 1.0
-            vecs = vecs / norms
+        if vecs.shape[-1] != self.dimension:
+            raise ValueError(
+                f"vector dimension {vecs.shape[-1]} != index dimension "
+                f"{self.dimension}"
+            )
         return vecs
 
-    def _grow_to(self, n: int) -> None:
+    # -- mutation ----------------------------------------------------------
+    def _grow_to_local(self, local_needed: int) -> None:
+        """Double local capacity until every shard can hold its rows.
+        Global slot = shard * local_cap + local, so growth REMAPS every
+        live slot — host round-trip, rare by pow2 doubling."""
         local = self.local_cap
-        while self.n_shards * local < n:
+        while local < local_needed:
             local *= 2
-        new_cap = self.n_shards * local
-        if new_cap <= self.capacity:
+        if local <= self.local_cap:
             return
-        self.local_cap = local
+        old_local, old_cap = self.local_cap, self.capacity
+        new_cap = self.n_shards * local
         host_vec = np.asarray(self.vectors)
         host_valid = np.asarray(self.valid)
         host_sq = np.asarray(self.sq_norms)
-        pad = new_cap - self.capacity
+        new_vec = np.zeros((new_cap, self.dimension), np.float32)
+        new_valid = np.zeros((new_cap,), bool)
+        new_sq = np.zeros((new_cap,), np.float32)
+        new_epoch = np.full(new_cap, -1, np.int64)
+        for s in range(self.n_shards):
+            src = slice(s * old_local, (s + 1) * old_local)
+            dst = slice(s * local, s * local + old_local)
+            new_vec[dst] = host_vec[src]
+            new_valid[dst] = host_valid[src]
+            new_sq[dst] = host_sq[src]
+            new_epoch[dst] = self.slot_freed_epoch[src]
+        remap = {}
+        for old_slot, key in self.slot_to_key.items():
+            s, l = divmod(old_slot, old_local)
+            remap[s * local + l] = key
+        self.slot_to_key = remap
+        self.key_to_slot = {k: sl for sl, k in remap.items()}
+        for s in range(self.n_shards):
+            shifted = [
+                s * local + (sl - s * old_local)
+                for sl in self.free_by_shard[s]
+            ]
+            fresh = list(
+                range(s * local + local - 1, s * local + old_local - 1, -1)
+            )
+            self.free_by_shard[s] = fresh + shifted
+        self.local_cap = local
+        self.capacity = new_cap
+        self.slot_freed_epoch = new_epoch
         self.vectors = jax.device_put(
-            jnp.asarray(
-                np.concatenate(
-                    [host_vec, np.zeros((pad, self.dimension), np.float32)]
-                )
-            ),
-            self._db_sharding,
+            jnp.asarray(new_vec), self._db_sharding
         )
         self.valid = jax.device_put(
-            jnp.asarray(np.concatenate([host_valid, np.zeros(pad, bool)])),
-            self._row_sharding,
+            jnp.asarray(new_valid), self._row_sharding
         )
         self.sq_norms = jax.device_put(
-            jnp.asarray(np.concatenate([host_sq, np.zeros(pad, np.float32)])),
-            self._row_sharding,
+            jnp.asarray(new_sq), self._row_sharding
         )
-        self.free_slots = (
-            list(range(new_cap - 1, self.capacity - 1, -1)) + self.free_slots
-        )
-        self.capacity = new_cap
 
-    def add(self, keys: Sequence[Any], vecs) -> None:
-        vecs = self._prepare(vecs)
-        self._grow_to(len(self.key_to_slot) + len(keys))
+    def _assign_slots(self, keys: Sequence[Any]) -> np.ndarray:
+        """Route every key to a slot on its OWNING shard (upsert
+        semantics), growing all shards when any owner is full. Must be
+        called under ``self.lock``."""
+        # growth first: worst-case fill per shard after this batch
+        pending: dict[int, int] = {}
+        for key in keys:
+            if key not in self.key_to_slot:
+                s = self.owner_shard(key)
+                pending[s] = pending.get(s, 0) + 1
+        if pending:
+            need = max(
+                self.local_cap - len(self.free_by_shard[s]) + n
+                for s, n in pending.items()
+            )
+            self._grow_to_local(need)
         slots = []
         for key in keys:
             slot = self.key_to_slot.get(key)
             if slot is None:
-                slot = self.free_slots.pop()
+                s = self.owner_shard(key)
+                slot = self.free_by_shard[s].pop()
                 self.key_to_slot[key] = slot
                 self.slot_to_key[slot] = key
+                self.key_seq[key] = self._next_seq
+                self._next_seq += 1
             slots.append(slot)
-        sl = jnp.asarray(np.asarray(slots, np.int32))
-        vv = jnp.asarray(vecs)
-        self.vectors = self.vectors.at[sl].set(vv)
-        self.valid = self.valid.at[sl].set(True)
-        self.sq_norms = self.sq_norms.at[sl].set(jnp.sum(vv * vv, axis=-1))
+        return np.asarray(slots, np.int32)
+
+    def add(self, keys: Sequence[Any], vecs) -> None:
+        """Upsert a batch: one donated jitted slot-write per call (the
+        amortized-dispatch path ISSUE 16's ann-build fix rides)."""
+        vecs = self._prepare(vecs)
+        if len(keys) != vecs.shape[0]:
+            raise ValueError("keys/vectors length mismatch")
+        dev = _DEVICE.begin("knn.sharded_write") if _DEVICE.on else None
+        try:
+            with self.lock:
+                slots = self._assign_slots(keys)
+                self.vectors, self.valid, self.sq_norms = self._write(
+                    self.vectors, self.valid, self.sq_norms,
+                    jnp.asarray(slots), jnp.asarray(vecs),
+                    jnp.ones((len(slots),), bool),
+                    normalize=self.metric is Metric.COS,
+                )
+                out_vectors = self.vectors
+        except BaseException:
+            _DEVICE.end(dev, None, block=False)
+            raise
+        if dev is not None:
+            nrows, d = len(keys), self.dimension
+            _DEVICE.end(
+                dev, out_vectors,
+                flops=4.0 * nrows * d,
+                bytes_accessed=8.0 * nrows * d + 8.0 * nrows,
+                transfer_bytes=nbytes_of(vecs) + 4 * nrows,
+            )
+
+    # batch-adapter alias (engine/external_index.py batched delta path)
+    add_batch = add
 
     def remove(self, keys: Sequence[Any]) -> None:
-        slots = []
-        for key in keys:
-            slot = self.key_to_slot.pop(key, None)
-            if slot is None:
-                continue
-            del self.slot_to_key[slot]
-            self.free_slots.append(slot)
-            slots.append(slot)
-        if not slots:
-            return
-        sl = jnp.asarray(np.asarray(slots, np.int32))
-        self.vectors = self.vectors.at[sl].set(0.0)
-        self.valid = self.valid.at[sl].set(False)
-        self.sq_norms = self.sq_norms.at[sl].set(0.0)
+        with self.lock:
+            slots = []
+            for key in keys:
+                slot = self.key_to_slot.pop(key, None)
+                if slot is None:
+                    continue
+                del self.slot_to_key[slot]
+                self.key_seq.pop(key, None)
+                self.free_by_shard[slot // self.local_cap].append(slot)
+                slots.append(slot)
+            if not slots:
+                return
+            self.remove_epoch += 1
+            self.slot_freed_epoch[np.asarray(slots)] = self.remove_epoch
+            self.vectors, self.valid, self.sq_norms = self._write(
+                self.vectors, self.valid, self.sq_norms,
+                jnp.asarray(np.asarray(slots, np.int32)),
+                jnp.zeros((len(slots), self.dimension), jnp.float32),
+                jnp.zeros((len(slots),), bool),
+            )
 
+    remove_batch = remove
+
+    # -- search ------------------------------------------------------------
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
         queries = self._prepare(queries)
         n = queries.shape[0]
@@ -222,7 +413,9 @@ class ShardedKnnIndex:
         # per-shard partial k is capped inside sharded_topk; the merged
         # result honors up to min(k, total capacity) — a requested k above
         # one shard's capacity is no longer silently truncated
-        k_eff = min(k, self.n_shards * min(self.local_cap, self.chunk))
+        k_eff = min(
+            k, self.n_shards * min(self.local_cap, self.chunk or self.local_cap)
+        )
         padded_n = 1
         while padded_n < n:
             padded_n *= 2
@@ -231,12 +424,31 @@ class ShardedKnnIndex:
                 [queries, np.zeros((padded_n - n, self.dimension), np.float32)]
             )
         fn = _sharded_search_fn(
-            self.mesh, self.axis, k_eff,
-            "l2sq" if self.metric is Metric.L2SQ else "dot",
-            self.chunk, self.precision, self.metric is Metric.L2SQ,
+            self.mesh, self.axis, k_eff, self.metric.value,
+            self.chunk, self.precision, _merge_mode(self.n_shards),
         )
-        q_dev = jax.device_put(jnp.asarray(queries), self._repl)
-        vals, idx = fn(q_dev, self.vectors, self.valid, self.sq_norms)
+        dev = _DEVICE.begin("knn.sharded_search") if _DEVICE.on else None
+        try:
+            with self.lock:  # read+launch before the next donating write
+                q_dev = jax.device_put(jnp.asarray(queries), self._repl)
+                vals, idx = fn(q_dev, self.vectors, self.valid, self.sq_norms)
+                epoch = self.remove_epoch
+                live_rows = len(self.key_to_slot)
+        except BaseException:
+            _DEVICE.end(dev, None, block=False)
+            raise
+        if dev is not None:
+            flops, acc = topk_scan_cost(
+                padded_n, self.capacity, self.dimension, k_eff
+            )
+            flops_eff, _ = topk_scan_cost(
+                n, live_rows, self.dimension, k_eff
+            )
+            _DEVICE.end(
+                dev, (vals, idx), flops=flops,
+                flops_effective=flops_eff, bytes_accessed=acc,
+                transfer_bytes=nbytes_of(queries, vals, idx),
+            )
         vals = np.asarray(vals)[:n]
         idx = np.asarray(idx)[:n]
         out: list[list[tuple[Any, float]]] = []
@@ -245,11 +457,19 @@ class ShardedKnnIndex:
             for vv, slot in zip(vals[qi], idx[qi]):
                 if not np.isfinite(vv):
                     continue
-                key = self.slot_to_key.get(int(slot))
+                slot = int(slot)
+                if self.slot_freed_epoch[slot] > epoch:
+                    # freed (possibly reused) after our dispatch — the
+                    # mapping this hit scored against is gone
+                    continue
+                key = self.slot_to_key.get(slot)
                 if key is None:
                     continue
                 hits.append((key, float(vv)))
-                if len(hits) == k:
-                    break
-            out.append(hits)
+            # deterministic tie-break: equal scores order by insertion
+            # sequence — slot layout (which differs between shardings)
+            # never leaks into results. This is the contract the
+            # sharded-vs-single-chip parity battery pins bit-identical.
+            hits.sort(key=lambda t: (-t[1], self.key_seq.get(t[0], 0)))
+            out.append(hits[:k])
         return out

@@ -1,0 +1,398 @@
+#!/usr/bin/env python
+"""CI sharded-index smoke lane (scripts/ci_lanes.sh lane 15; ISSUE 16).
+
+Runs a REAL embed+KNN pipeline whose index adapter is backed by the
+pod-sharded HBM index (``PATHWAY_INDEX_SHARDS=8`` over the emulated
+8-device CPU mesh) while a fused tokenize→encode→index ingest burst
+(ops/ingest.py) runs inside the same traced process, then asserts the
+ISSUE 16 chain end to end:
+
+1. LIVE ``/metrics`` shows per-site device samples for the sharded
+   index (``device_site_dispatches_total{site="knn.sharded_search"}``
+   and the sharded write site) plus the effective-FLOPs family, with
+   ZERO ``nb_fallbacks_total`` — the sharded path must not knock any
+   relational operator off its native fast path;
+2. the trace carries device spans for both the sharded index sites and
+   the fused chain, and ``python -m pathway_tpu.analysis --profile``
+   exits 0 NAMING the fused chain (``ingest.fused``) with a roofline
+   verdict;
+3. capacity scales with the mesh: the 8-shard index absorbs 4x a single
+   chip's slot budget with zero per-shard growth and every shard
+   holding rows (stable-mint spread), and sharded query latency is
+   measured against the single-chip shard — the flat-within-20% bar is
+   the TPU-lane acceptance; the CPU emulation (8 shard_map programs on
+   one host) records the honest ratio and gates only on gross
+   regression.
+
+Exit 0 = green; any assertion prints the reason and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRICS_PORT = 20000
+
+PROGRAM = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import pathway_tpu as pw
+from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+enc = SentenceEncoder(EncoderConfig.tiny())
+DIM = enc.embed_dim
+DOCS = [f"document {{i}} about topic {{i % 13}}" for i in range(192)]
+
+class Docs(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        for s in range(0, len(DOCS), 24):
+            self.next_batch([{{"text": t}} for t in DOCS[s : s + 24]])
+            self.commit()
+            time.sleep(0.25)  # paced so the parent can scrape LIVE
+
+class DocSchema(pw.Schema):
+    text: str
+
+class Queries(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    def run(self):
+        # the fused ingest burst runs on the query connector's thread:
+        # it executes DURING pw.run, so its ingest.fused dispatches land
+        # on the armed device plane (same trace, same /metrics)
+        from pathway_tpu.ops.ingest import IngestPipeline
+        from pathway_tpu.ops.knn import KnnShard
+
+        shard = KnnShard(DIM, "cos", capacity=256)
+        pipe = IngestPipeline(enc, shard)
+        batches = (
+            ([f"burst{{s}}-{{j}}" for j in range(16)],
+             DOCS[s * 16 : s * 16 + 16])
+            for s in range(4)
+        )
+        pipe.run(batches)
+        assert len(shard) == 64
+        for i in range(8):
+            self.next_batch([{{"q": f"topic {{i % 13}}"}}])
+            self.commit()
+            time.sleep(0.25)
+
+class QSchema(pw.Schema):
+    q: str
+
+def embed(text):
+    return tuple(float(x) for x in enc.encode([text])[0])
+
+docs = pw.io.python.read(Docs(), schema=DocSchema,
+                         autocommit_duration_ms=None)
+docs = docs.select(pw.this.text, vec=pw.apply_with_type(embed, tuple,
+                                                        pw.this.text))
+queries = pw.io.python.read(Queries(), schema=QSchema,
+                            autocommit_duration_ms=None)
+queries = queries.select(pw.this.q, qvec=pw.apply_with_type(embed, tuple,
+                                                            pw.this.q))
+
+from pathway_tpu.stdlib.indexing import BruteForceKnn
+index = BruteForceKnn(data_column=docs.vec, dimensions=DIM, metric="cos")
+res = index.query_as_of_now(queries.qvec, number_of_matches=3)
+pw.io.subscribe(
+    res.select(pw.this.q, ids=pw.this._pw_index_reply),
+    on_change=lambda *a: None,
+)
+pw.run(monitoring_level=pw.MonitoringLevel.NONE, with_http_server=True)
+"""
+
+
+def fail(msg: str) -> None:
+    print(f"sharded_index_smoke: FAIL — {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def _scrape(port: int) -> str | None:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2
+        ) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def _metric(text: str, name: str) -> float | None:
+    m = re.search(rf"^{re.escape(name)} (\S+)$", text, re.M)
+    return float(m.group(1)) if m else None
+
+
+def _site_metric(text: str, family: str, site: str) -> float | None:
+    m = re.search(
+        rf'^{re.escape(family)}{{site="{re.escape(site)}"}} (\S+)$',
+        text, re.M,
+    )
+    return float(m.group(1)) if m else None
+
+
+def run_smoke() -> None:
+    td = tempfile.mkdtemp(prefix="pw_sharded_smoke_")
+    trace = os.path.join(td, "trace.json")
+    prog = os.path.join(td, "sharded_embed_knn.py")
+    with open(prog, "w") as f:
+        f.write(PROGRAM.format(repo=REPO))
+    env = dict(os.environ)
+    env.update(
+        PATHWAY_TRACE=trace,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        PATHWAY_INDEX_SHARDS="8",
+        XLA_FLAGS=(
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+    )
+    env.pop("PATHWAY_LANE_PROCESSES", None)
+    env.pop("PATHWAY_PROCESSES", None)
+    proc = subprocess.Popen(
+        [sys.executable, prog], env=env, cwd=td,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    # 1. live /metrics: per-site device samples from the SHARDED index
+    live_ok = False
+    live_text = ""
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and proc.poll() is None:
+        text = _scrape(METRICS_PORT)
+        if text:
+            live_text = text
+            n = _site_metric(
+                text, "device_site_dispatches_total", "knn.sharded_search"
+            )
+            if n is not None and n > 0:
+                live_ok = True
+                break
+        time.sleep(0.3)
+    try:
+        out, err = proc.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        fail("pipeline did not finish")
+    if proc.returncode != 0:
+        fail(
+            f"pipeline exited {proc.returncode}\n"
+            f"{err.decode(errors='replace')[-2000:]}"
+        )
+    if not live_ok:
+        fail(
+            "live /metrics never showed sharded-search dispatches\n"
+            f"last scrape:\n{live_text[-1500:]}"
+        )
+    writes = _site_metric(
+        live_text, "device_site_dispatches_total", "knn.sharded_write"
+    )
+    if not writes:
+        fail("no knn.sharded_write dispatches on /metrics")
+    eff = _site_metric(
+        live_text, "device_site_flops_effective_total", "knn.sharded_search"
+    )
+    flops = _site_metric(
+        live_text, "device_site_flops_total", "knn.sharded_search"
+    )
+    if eff is None or flops is None or not (0 < eff <= flops):
+        fail(
+            "effective-FLOPs family broken for the sharded site: "
+            f"eff={eff} flops={flops}"
+        )
+    nb_fb = _metric(live_text, "nb_fallbacks_total")
+    if nb_fb is None or nb_fb != 0:
+        fail(f"nb_fallbacks_total must be 0, got {nb_fb}")
+    print(
+        "sharded_index_smoke: live /metrics shows sharded sites "
+        f"(search eff/padded flops {eff:.0f}/{flops:.0f}, "
+        f"{writes:.0f} writes), nb_fallbacks 0"
+    )
+
+    # 2. trace has both the sharded sites and the fused chain; --profile
+    #    exits 0 naming ingest.fused with a verdict
+    if not os.path.exists(trace):
+        fail("trace file missing")
+    doc = json.load(open(trace))
+    from pathway_tpu.analysis.profile import profile_trace, validate_trace
+
+    problems = validate_trace(doc)
+    if problems:
+        fail(f"trace schema problems: {problems[:5]}")
+    sites = {
+        e["name"] for e in doc["traceEvents"] if e.get("cat") == "device"
+    }
+    for want in ("knn.sharded_search", "knn.sharded_write", "ingest.fused"):
+        if want not in sites:
+            fail(f"device site {want!r} missing from trace ({sites})")
+    from pathway_tpu.analysis.__main__ import main as cli_main
+
+    rc = cli_main(["--profile", trace])
+    if rc != 0:
+        fail(f"--profile exited {rc}")
+    report = profile_trace(trace)
+    dev = report.get("device")
+    if not dev or not dev["sites"]:
+        fail("--profile report has no device section")
+    fused = next(
+        (s for s in dev["sites"] if s["site"] == "ingest.fused"), None
+    )
+    if fused is None:
+        fail("--profile does not name the fused chain")
+    if fused["verdict"] not in (
+        "compute-bound", "bandwidth-bound", "host-bound"
+    ):
+        fail(f"bad fused-chain verdict: {fused['verdict']!r}")
+    if not (0 <= fused["mfu"] <= fused["mfu_padded"]):
+        fail(
+            f"fused-chain MFU accounting broken: "
+            f"{fused['mfu']} / {fused['mfu_padded']}"
+        )
+    print(
+        "sharded_index_smoke: --profile names ingest.fused "
+        f"({fused['dispatches']} dispatches, mfu {fused['mfu']:.4f} "
+        f"eff / {fused['mfu_padded']:.4f} padded) -> {fused['verdict']}"
+    )
+
+
+def measure_scaling(update_artifact: bool) -> None:
+    """Capacity scaling + latency flatness, in-process on the emulated
+    8-device mesh."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import jax
+
+    from pathway_tpu.ops.knn import KnnShard
+    from pathway_tpu.parallel import ShardedKnnIndex, make_mesh
+
+    if len(jax.devices()) < 8:
+        fail(f"emulated mesh has {len(jax.devices())} devices, need 8")
+    mesh = make_mesh(8, axes=("dp",), shape=(8,))
+    rng = np.random.default_rng(0)
+
+    # capacity scaling: the stable mint spreads 4x one chip's slot
+    # budget over the pod with ZERO per-shard growth and no empty shard
+    cap_idx = ShardedKnnIndex(32, mesh, metric="cos")
+    local0 = cap_idx.local_cap
+    n_cap = local0 * 4
+    cap_idx.add(
+        list(range(n_cap)),
+        rng.normal(size=(n_cap, 32)).astype(np.float32),
+    )
+    if cap_idx.local_cap != local0:
+        fail("balanced mint fill must not force per-shard growth")
+    fill = cap_idx.shard_fill()
+    if not all(f > 0 for f in fill):
+        fail(f"empty shard in {fill}")
+    print(
+        f"sharded_index_smoke: {n_cap} rows over 8 shards {fill}, "
+        f"local_cap still {cap_idx.local_cap}"
+    )
+
+    # latency flatness: a scan big enough that per-shard compute, not
+    # dispatch overhead, dominates (32k rows x 64 dims, 16 queries)
+    dim, n, nq = 64, 1 << 15, 16
+    db = rng.normal(size=(n, dim)).astype(np.float32)
+    q = rng.normal(size=(nq, dim)).astype(np.float32)
+    idx = ShardedKnnIndex(dim, mesh, metric="cos")
+    single = KnnShard(dim, "cos")
+    idx.add(list(range(n)), db)
+    single.add(list(range(n)), db)
+
+    def p50(fn, reps=11):
+        fn()  # warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[reps // 2]
+
+    t_single = p50(lambda: single.search(q, 10))
+    t_shard = p50(lambda: idx.search(q, 10))
+    ratio = t_shard / t_single
+    backend = jax.default_backend()
+    # the flat-within-20% bar is for REAL multi-device backends, where
+    # the 8 shards scan concurrently; the CPU emulation multiplexes 8
+    # shard programs onto one host (partition overhead never amortizes
+    # to 1.0), so it records the honest ratio and gates only gross
+    # regression
+    bar = 1.2 if backend != "cpu" else 3.0
+    print(
+        f"sharded_index_smoke: query p50 single={t_single * 1e3:.2f}ms "
+        f"sharded={t_shard * 1e3:.2f}ms ratio={ratio:.2f} bar={bar} "
+        f"(backend={backend}; flat-within-20% gates multi-device "
+        "backends)"
+    )
+    if ratio > bar:
+        fail(f"sharded query latency ratio {ratio:.2f} > {bar}")
+    if update_artifact:
+        path = os.path.join(REPO, "BENCH_full.json")
+        art = json.load(open(path))
+        entry = {
+            "metric": "sharded_knn_scaling",
+            "value": round(ratio, 3),
+            "unit": "sharded_over_single_query_p50_ratio",
+            "single_p50_ms": round(t_single * 1e3, 3),
+            "sharded_p50_ms": round(t_shard * 1e3, 3),
+            "shards": 8,
+            "rows": n,
+            "dim": dim,
+            "queries": nq,
+            "capacity_no_growth_rows": n_cap,
+            "shard_fill": fill,
+            "backend": backend,
+            "latency_bar": bar,
+            "method": (
+                "ShardedKnnIndex(8 emulated CPU devices) vs single-chip "
+                "KnnShard, same rows/queries; p50 of 11 reps; "
+                "flat-within-20% bar applies on real multi-device "
+                "backends, CPU emulation gates gross regression only"
+            ),
+        }
+        art = [
+            e for e in art
+            if not (
+                isinstance(e, dict)
+                and e.get("metric") == "sharded_knn_scaling"
+            )
+        ] + [entry]
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        print(
+            "sharded_index_smoke: BENCH_full.json sharded_knn_scaling "
+            "updated"
+        )
+
+
+def main() -> int:
+    update = "--update-artifact" in sys.argv
+    if "--scaling-only" not in sys.argv:
+        run_smoke()
+    measure_scaling(update)
+    print("sharded_index_smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

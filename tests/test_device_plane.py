@@ -79,10 +79,13 @@ def test_knn_dispatch_records_land_on_metrics_and_trace(tmp_path):
         PLANE.disarm()
     assert "knn.search" in stats.device_sites
     assert "knn.write" in stats.device_sites
-    n, wall_s, dev_s, flops, bytes_acc, xfer, mfu_v = (
+    n, wall_s, dev_s, flops, bytes_acc, xfer, flops_eff, mfu_v, mfu_pad = (
         stats.device_totals()
     )
     assert n >= 2 and wall_s > 0 and flops > 0 and xfer > 0
+    # effective FLOPs never exceed padded FLOPs (ISSUE 16)
+    assert 0 < flops_eff <= flops
+    assert 0 <= mfu_v <= mfu_pad
     # device seconds are a SHARE of wall, never more
     assert 0 <= dev_s <= wall_s
     text = stats.render_openmetrics()
@@ -214,9 +217,12 @@ def test_encoder_mfu_gauge_sane_vs_flops_model():
     assert model_flops / 4 <= measured <= model_flops * 4, (
         measured, model_flops,
     )
-    *_tot, mfu_v = stats.device_totals()
+    *_tot, mfu_v, mfu_pad = stats.device_totals()
     assert 0 < mfu_v < 50  # positive and not absurd on CPU
-    assert "device_mfu" in stats.render_openmetrics()
+    # 12 real rows in a 16-row bucket: effective strictly below padded
+    assert mfu_v < mfu_pad
+    text = stats.render_openmetrics()
+    assert "device_mfu" in text and "device_mfu_padded" in text
 
 
 # -- memory_stats absent fallback -----------------------------------------

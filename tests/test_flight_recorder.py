@@ -237,8 +237,14 @@ _PROBER_CALLS = {
     "on_capture_rows_expanded": (7,),
     "on_sink_egress_seconds": ("sink_a", 0.05),
     # device plane (ISSUE 15): per-dispatch-site device/wall seconds,
-    # FLOPs, transfer bytes and queue depth — the device_* families
-    "on_device_dispatch": ("knn.search", 0.5, 0.4, 1e9, 1e6, 4096, 2),
+    # FLOPs, transfer bytes and queue depth — the device_* families.
+    # Trailing arg (ISSUE 16): effective FLOPs (real rows only).
+    "on_device_dispatch": (
+        "knn.search", 0.5, 0.4, 1e9, 1e6, 4096, 2, 8e8,
+    ),
+    # shape-bucket churn visibility (ISSUE 16): fresh XLA compilations
+    # per dispatch site — device_recompiles_total
+    "on_device_recompile": ("encoder.forward",),
 }
 # state consumed by the dashboard/main loop, not an OpenMetrics family
 _PROBER_EXEMPT = {"on_connector_finished"}

@@ -1,0 +1,229 @@
+"""Fused tokenize→encode→index ingest chain battery (ISSUE 16).
+
+PR 15 verdicted the embed ingest path host-bound at 0.33 MFU; the fused
+chain (ops/ingest.py) is the fix. Pins: the fused chain's embeddings and
+index contents are BIT-identical to the unfused encode→add path; the
+``ingest.fused`` device site reports effective FLOPs strictly below
+padded FLOPs (tokenize padding is visible, not laundered into MFU); the
+per-bucket recompile counter counts new shape buckets exactly once; the
+tokenize-ahead pipelined driver produces the same index as the serial
+one; the PATHWAY_INGEST_* knobs take effect.
+"""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.internals.device import PLANE
+from pathway_tpu.internals.monitoring import ProberStats
+
+
+@pytest.fixture(autouse=True)
+def _disarmed_plane():
+    PLANE.disarm()
+    yield
+    PLANE.disarm()
+
+
+def _ids_and_close(got, want):
+    """The fused chain stores the encoder's already-normalized rows
+    directly; KnnShard.add re-normalizes (a last-ulp no-op on unit
+    vectors) — so ids match exactly and scores to f32 tolerance."""
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert [k for k, _ in g] == [k for k, _ in w]
+        np.testing.assert_allclose(
+            [s for _, s in g], [s for _, s in w], rtol=1e-5
+        )
+
+
+def _mk(metric="cos", capacity=128, **kw):
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops.ingest import IngestPipeline
+    from pathway_tpu.ops.knn import KnnShard
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    shard = KnnShard(cfg.hidden, metric, capacity=capacity)
+    return enc, shard, IngestPipeline(enc, shard, **kw)
+
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs",
+    "sphinx of black quartz judge my vow",
+    "how vexingly quick daft zebras jump",
+    "a live dataflow framework for tpu pods",
+]
+
+
+# -- correctness -----------------------------------------------------------
+
+def test_fused_chain_matches_unfused_encode_then_add():
+    enc, shard, pipe = _mk()
+    keys = [f"doc{i}" for i in range(len(TEXTS))]
+    emb = np.asarray(pipe.ingest(keys, TEXTS))
+    want = np.asarray(enc.encode(TEXTS))
+    # same params, same jitted forward geometry: bit-identical, not close
+    np.testing.assert_array_equal(emb, want)
+    assert len(shard) == len(keys)
+    # the index ends up in the same state the unfused path produces
+    from pathway_tpu.ops.knn import KnnShard
+
+    ref = KnnShard(enc.embed_dim, "cos", capacity=shard.capacity)
+    ref.add(keys, want)
+    got = shard.search(want[:2], 3)
+    exp = ref.search(want[:2], 3)
+    _ids_and_close(got, exp)
+    assert got[0][0][0] == "doc0"
+    assert got[0][0][1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_fused_upsert_overwrites_in_place():
+    enc, shard, pipe = _mk()
+    keys = ["a", "b", "c"]
+    pipe.ingest(keys, TEXTS[:3])
+    assert len(shard) == 3
+    # re-ingest the same keys with different texts: same slots, new rows
+    pipe.ingest(keys, TEXTS[2:5])
+    assert len(shard) == 3
+    want = np.asarray(enc.encode(TEXTS[2:5]))
+    got = shard.search(want[:1], 1)
+    assert got[0][0][0] == "a"
+    assert got[0][0][1] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_pipelined_run_matches_serial_ingest():
+    enc, shard, pipe = _mk()
+    docs = [f"document number {i} about topic {i % 7}" for i in range(37)]
+    keys = [f"k{i}" for i in range(len(docs))]
+    batches = [
+        (keys[i:i + 8], docs[i:i + 8]) for i in range(0, len(docs), 8)
+    ]
+    rows = pipe.run(iter(batches))
+    assert rows == len(docs)
+    assert len(shard) == len(docs)
+    # serial reference path
+    from pathway_tpu.ops.knn import KnnShard
+
+    ref = KnnShard(enc.embed_dim, "cos", capacity=shard.capacity)
+    for bk, bt in batches:
+        ref.add(bk, np.asarray(enc.encode(bt)))
+    q = np.asarray(enc.encode(docs[5:7]))
+    _ids_and_close(shard.search(q, 4), ref.search(q, 4))
+
+
+def test_run_surfaces_producer_errors():
+    _, _, pipe = _mk()
+
+    def bad_batches():
+        yield (["x"], ["fine text"])
+        raise RuntimeError("source exploded")
+
+    with pytest.raises(RuntimeError, match="source exploded"):
+        pipe.run(bad_batches())
+
+
+# -- contract guards -------------------------------------------------------
+
+def test_l2sq_index_rejected():
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops.ingest import IngestPipeline
+    from pathway_tpu.ops.knn import KnnShard
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    with pytest.raises(ValueError, match="cos/dot"):
+        IngestPipeline(enc, KnnShard(cfg.hidden, "l2sq"))
+
+
+def test_dimension_mismatch_rejected():
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops.ingest import IngestPipeline
+    from pathway_tpu.ops.knn import KnnShard
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    with pytest.raises(ValueError, match="dimension"):
+        IngestPipeline(enc, KnnShard(cfg.hidden + 1))
+
+
+# -- MFU honesty + recompile accounting ------------------------------------
+
+def test_fused_site_effective_flops_strictly_below_padded():
+    enc, shard, pipe = _mk()
+    keys = [f"doc{i}" for i in range(len(TEXTS))]
+    pipe.ingest(keys, TEXTS)  # warm the jit cache outside the window
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        pipe.ingest(keys, TEXTS)
+    finally:
+        PLANE.disarm()
+    agg = stats.device_sites.get("ingest.fused")
+    assert agg is not None and agg[0] == 1
+    flops, flops_eff = agg[3], agg[6]
+    # 5 real docs in a pow2 batch bucket with padded seq: the effective
+    # share is the real-token fraction, strictly below 1
+    assert 0 < flops_eff < flops
+    *_tot, mfu_v, mfu_pad = stats.device_totals()
+    assert 0 < mfu_v < mfu_pad
+    text = stats.render_openmetrics()
+    assert 'device_site_flops_effective_total{site="ingest.fused"}' in text
+    assert "device_mfu_padded" in text
+
+
+def test_recompile_counter_counts_new_buckets_once():
+    enc, shard, pipe = _mk()
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        keys = [f"doc{i}" for i in range(len(TEXTS))]
+        pipe.ingest(keys, TEXTS)        # new (batch, seq, cap) bucket
+        pipe.ingest(keys, TEXTS)        # same bucket: cached executable
+        # 20 docs land in a LARGER pow2 batch bucket: one more compile
+        pipe.ingest([f"n{i}" for i in range(20)], TEXTS * 4)
+    finally:
+        PLANE.disarm()
+    assert stats.device_recompiles.get("ingest.fused") == 2
+    text = stats.render_openmetrics()
+    assert "device_recompiles_total 2" in text
+    assert (
+        'device_site_recompiles_total{site="ingest.fused"} 2' in text
+    )
+
+
+def test_encoder_bucket_cache_notes_recompiles():
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+
+    enc = SentenceEncoder(EncoderConfig.tiny())
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        enc.encode(TEXTS)   # fresh (batch, seq) bucket
+        enc.encode(TEXTS)   # cached: no new note
+        enc.encode(TEXTS * 4)  # larger batch bucket
+    finally:
+        PLANE.disarm()
+    assert stats.device_recompiles.get("encoder.forward") == 2
+
+
+# -- knobs -----------------------------------------------------------------
+
+def test_ingest_depth_knob(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INGEST_DEPTH", "5")
+    _, _, pipe = _mk()
+    assert pipe.depth == 5
+    monkeypatch.setenv("PATHWAY_INGEST_DEPTH", "garbage")
+    _, _, pipe = _mk()
+    assert pipe.depth == 2  # malformed falls back to the default
+    _, _, pipe = _mk(depth=3)
+    assert pipe.depth == 3  # explicit argument beats the env
+
+
+def test_stage_h2d_knob_off_still_correct(monkeypatch):
+    monkeypatch.setenv("PATHWAY_INGEST_STAGE_H2D", "0")
+    enc, shard, pipe = _mk()
+    assert pipe.stage_h2d is False
+    keys = ["x", "y"]
+    emb = np.asarray(pipe.ingest(keys, TEXTS[:2]))
+    np.testing.assert_array_equal(emb, np.asarray(enc.encode(TEXTS[:2])))

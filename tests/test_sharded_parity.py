@@ -1,0 +1,201 @@
+"""Pod-sharded index parity battery (ISSUE 16), on the conftest-emulated
+8-device CPU mesh.
+
+The contract the sharded index pins: for any interleaving of inserts,
+deletes and queries, ``ShardedKnnIndex`` returns ids AND scores
+BIT-identical to a single-chip ``KnnShard`` fed the same operations —
+per-row scores don't depend on sharding (same f32 kernel per row), and
+equal scores are ordered by the insertion-sequence tie-break on both
+sides, so slot layout (which sharding changes) never leaks into
+results. Both cross-shard merge strategies (all-gather and the
+recursive-doubling tree) honor the same contract. Capacity scales with
+the mesh: rows spread across shards by the stable blake2b mint, and
+per-shard growth remaps live slots without losing a key.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pathway_tpu.ops.knn import KnnShard
+from pathway_tpu.parallel import ShardedKnnIndex, make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the virtual 8-device CPU mesh"
+)
+
+
+@pytest.fixture
+def mesh8():
+    return make_mesh(8, axes=("dp",), shape=(8,))
+
+
+def _pair(mesh, dim=16, metric="cos"):
+    return (
+        ShardedKnnIndex(dim, mesh, metric=metric),
+        KnnShard(dim, metric),
+    )
+
+
+def _assert_bit_identical(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        # exact tuple equality: ids AND float scores, no tolerance
+        assert g == w
+
+
+@pytest.mark.parametrize("merge", ["tree", "gather"])
+def test_bulk_parity_bit_identical(mesh8, merge, monkeypatch):
+    monkeypatch.setenv("PATHWAY_INDEX_MERGE", merge)
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(700, 16)).astype(np.float32)
+    queries = rng.normal(size=(6, 16)).astype(np.float32)
+    idx, ref = _pair(mesh8)
+    idx.add(list(range(700)), db)
+    ref.add(list(range(700)), db)
+    _assert_bit_identical(idx.search(queries, 10), ref.search(queries, 10))
+
+
+def test_insert_delete_query_interleavings(mesh8, monkeypatch):
+    monkeypatch.setenv("PATHWAY_INDEX_MERGE", "auto")
+    rng = np.random.default_rng(1)
+    dim = 8
+    idx, ref = _pair(mesh8, dim=dim)
+    q = rng.normal(size=(4, dim)).astype(np.float32)
+
+    def both(op, *args):
+        getattr(idx, op)(*args)
+        getattr(ref, op)(*args)
+
+    def check(k=5):
+        _assert_bit_identical(idx.search(q, k), ref.search(q, k))
+
+    a = rng.normal(size=(60, dim)).astype(np.float32)
+    both("add", [f"a{i}" for i in range(60)], a)
+    check()
+    both("remove", [f"a{i}" for i in range(0, 60, 3)])
+    check()
+    # re-add some removed keys with NEW vectors (fresh insertion seq)
+    b = rng.normal(size=(10, dim)).astype(np.float32)
+    both("add", [f"a{i * 3}" for i in range(10)], b)
+    check()
+    # upsert live keys in place
+    c = rng.normal(size=(5, dim)).astype(np.float32)
+    both("add", [f"a{i}" for i in range(1, 6)], c)
+    check()
+    both("remove", [f"a{i}" for i in range(60)])  # includes misses
+    assert len(idx) == len(ref) == 0
+    assert idx.search(q, 3) == ref.search(q, 3) == [[], [], [], []]
+
+
+def test_deterministic_tie_break_is_insertion_order(mesh8):
+    """Duplicate vectors score EXACTLY equal; both indexes must order
+    them by insertion sequence — not by slot (which sharding scrambles)."""
+    dim = 8
+    idx, ref = _pair(mesh8, dim=dim)
+    base = np.ones((1, dim), np.float32)
+    rng = np.random.default_rng(2)
+    # 12 exact duplicates interleaved with distinct rows, inserted in a
+    # deliberately shuffled key order
+    keys, vecs = [], []
+    for i in range(30):
+        if i % 3 == 0:
+            keys.append(f"dup{i}")
+            vecs.append(base[0])
+        else:
+            keys.append(f"uniq{i}")
+            vecs.append(rng.normal(size=dim).astype(np.float32))
+    vecs = np.stack(vecs)
+    idx.add(keys, vecs)
+    ref.add(keys, vecs)
+    got = idx.search(base, 30)
+    want = ref.search(base, 30)
+    _assert_bit_identical(got, want)
+    dup_hits = [k for k, s in got[0] if str(k).startswith("dup")]
+    # ties surface in insertion order regardless of owner shard
+    assert dup_hits[:10] == [f"dup{i}" for i in range(0, 30, 3)]
+
+
+def test_capacity_scales_across_shards_without_growth(mesh8):
+    """The mint spreads rows over all 8 shards: the pod holds 8x a
+    single chip's slots before any shard has to grow."""
+    idx = ShardedKnnIndex(8, mesh8, metric="cos")
+    local0 = idx.local_cap
+    n = local0 * 8 // 2  # half the pod's capacity — 4x one chip's
+    rng = np.random.default_rng(3)
+    idx.add(list(range(n)), rng.normal(size=(n, 8)).astype(np.float32))
+    assert idx.local_cap == local0, "balanced fill must not force growth"
+    fill = idx.shard_fill()
+    assert sum(fill) == n
+    assert all(f > 0 for f in fill), f"empty shard in {fill}"
+    assert max(fill) < 2 * (n // 8), f"mint skew too high: {fill}"
+
+
+def test_growth_remaps_slots_and_keeps_parity(mesh8):
+    rng = np.random.default_rng(4)
+    dim = 8
+    idx, ref = _pair(mesh8, dim=dim)
+    local0 = idx.local_cap
+    # enough rows that every shard must double at least once
+    n = local0 * 8 * 2
+    db = rng.normal(size=(n, dim)).astype(np.float32)
+    idx.add(list(range(n)), db)
+    ref.add(list(range(n)), db)
+    assert idx.local_cap > local0
+    assert len(idx) == n and idx.capacity % 8 == 0
+    q = rng.normal(size=(3, dim)).astype(np.float32)
+    _assert_bit_identical(idx.search(q, 10), ref.search(q, 10))
+    # the remap preserved every key→row mapping: each stored row is its
+    # own exact nearest neighbor
+    probe = [0, n // 2, n - 1]
+    hits = idx.search(db[probe], 1)
+    assert [h[0][0] for h in hits] == probe
+
+
+def test_k_beyond_live_rows_returns_everything(mesh8):
+    idx = ShardedKnnIndex(4, mesh8, metric="cos")
+    rng = np.random.default_rng(5)
+    idx.add(list(range(10)), rng.normal(size=(10, 4)).astype(np.float32))
+    hits = idx.search(rng.normal(size=(1, 4)).astype(np.float32), 50)
+    assert len(hits[0]) == 10
+
+
+def test_owner_shard_is_stable_mint(mesh8):
+    """Delta routing uses the SAME mint as the exchange plane: blake2b
+    digest mod world — world-independent, so a re-shard re-buckets."""
+    from pathway_tpu.parallel.procgroup import shard_hash
+    from pathway_tpu.parallel.protocol import shard_owner
+
+    idx = ShardedKnnIndex(4, mesh8, metric="cos")
+    for key in ["a", 17, ("t", 3)]:
+        assert idx.owner_shard(key) == shard_owner(shard_hash(key), 8)
+    rng = np.random.default_rng(6)
+    keys = [f"k{i}" for i in range(64)]
+    idx.add(keys, rng.normal(size=(64, 4)).astype(np.float32))
+    for key in keys:
+        slot = idx.key_to_slot[key]
+        assert slot // idx.local_cap == idx.owner_shard(key)
+
+
+def test_sharded_search_device_site_effective_flops(mesh8):
+    from pathway_tpu.internals.device import PLANE
+    from pathway_tpu.internals.monitoring import ProberStats
+
+    rng = np.random.default_rng(7)
+    idx = ShardedKnnIndex(8, mesh8, metric="cos")
+    idx.add(list(range(50)), rng.normal(size=(50, 8)).astype(np.float32))
+    q = rng.normal(size=(2, 8)).astype(np.float32)
+    idx.search(q, 3)  # warm outside the armed window
+    stats = ProberStats()
+    PLANE.arm(None, stats)
+    try:
+        idx.search(q, 3)
+        idx.add([999], rng.normal(size=(1, 8)).astype(np.float32))
+    finally:
+        PLANE.disarm()
+    agg = stats.device_sites.get("knn.sharded_search")
+    assert agg is not None and agg[0] == 1
+    # 50 live rows in a 1024-slot pod: effective far below padded
+    assert 0 < agg[6] < agg[3]
+    assert "knn.sharded_write" in stats.device_sites
