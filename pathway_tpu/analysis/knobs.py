@@ -158,6 +158,36 @@ KNOBS: dict[str, Knob] = {
            "(pow2 axes; per-link traffic flat in pod size), 'gather' = "
            "all_gather + one merge, 'auto' = tree when the axis is "
            "pow2 else gather.", choices=("auto", "tree", "gather")),
+        # -- device fault domain (ISSUE 17) --------------------------------
+        _k("PATHWAY_DEVICE_DISPATCH_TIMEOUT_S", "float", 0.0,
+           "Watchdog deadline (seconds) on supervised device dispatch "
+           "sites (KNN write/search, fused ingest): a dispatch that "
+           "exceeds it is abandoned and raises WatchdogTimeout (a "
+           "permanent fault, routed to epoch abort). 0 disables the "
+           "watchdog. Set well under PATHWAY_MESH_OP_TIMEOUT_S so a "
+           "hung chip surfaces as a node fault before the mesh "
+           "collective deadline declares the whole rank dead.",
+           lo=0.0, hi=86400.0),
+        _k("PATHWAY_DEVICE_RETRIES", "int", 2,
+           "Bounded retry budget for transient device dispatch "
+           "failures (supervised_dispatch / the fused-ingest producer): "
+           "transient errors retry with exponential backoff up to this "
+           "many times; OOM flips the serving breaker into brownout; "
+           "permanent faults abort the epoch immediately.",
+           lo=0, hi=64),
+        _k("PATHWAY_DEVICE_SNAPSHOT", "bool", True,
+           "Epoch-aligned incremental index snapshots: under "
+           "OPERATOR_PERSISTING, HBM index shards write per-epoch delta "
+           "segments (only slots touched since the last cut) through "
+           "the persistence store at the same marker the mesh commits; "
+           "restore rebuilds the HBM shard from segments instead of "
+           "re-embedding. 0 falls back to inline full-state snapshots."),
+        _k("PATHWAY_INDEX_SNAPSHOT_SEGMENTS", "int", 8,
+           "Segment-chain length at which an index snapshot compacts: "
+           "once an index's manifest references this many delta "
+           "segments, the next cut folds the chain into one full "
+           "segment (TxnDeltaSink-style folded-manifest compaction) so "
+           "restore cost stays bounded.", lo=1, hi=4096),
         _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
            "Abort the run on the first data error instead of poisoning "
            "rows to ERROR."),

@@ -99,6 +99,31 @@ class ExternalIndexNode(Node):
         self.live = state["live"]
         self.adapter.load_state(state["adapter"])
 
+    def reshard_state(self, states, keep):
+        """Honest N→M re-shard (ISSUE 17): the default ``RESHARD =
+        "keyed"`` policy would filter the adapter's state dict by row
+        key — silently wrong for an index snapshot (segment manifests
+        and corpus keys are not this rank's row keys). Answers/live ARE
+        keyed row maps; the adapter states wrap into a reshard envelope
+        the index restore resolves by folding every old rank's committed
+        entries and re-bucketing through the keep set (reshard runs
+        in-process, so the callable rides the returned state)."""
+        from pathway_tpu.persistence.reshard import filter_value, merge_values
+
+        return {
+            "answers": filter_value(
+                merge_values([s["answers"] for s in states]), keep
+            ),
+            "live": filter_value(
+                merge_values([s["live"] for s in states]), keep
+            ),
+            "adapter": {
+                "__index_reshard__": True,
+                "parts": [s["adapter"] for s in states],
+                "keep": keep,
+            },
+        }
+
     def process(self, time, batches):
         index_deltas = consolidate(batches[0])
         query_deltas = consolidate(batches[1])
@@ -156,6 +181,7 @@ class ExternalIndexNode(Node):
         if to_answer:
             qspecs = [self.query_fn(k, row) for k, row in to_answer]
             results = self.adapter.search(qspecs)
+            self._surface_filter_errors()
             for (k, row), res in zip(to_answer, results):
                 result_cols = (tuple(res[0]), tuple(res[1]))
                 self.answers[k] = (row, result_cols)
@@ -164,3 +190,16 @@ class ExternalIndexNode(Node):
                 out.append((k, row + result_cols, 1))
 
         return consolidate(out)
+
+    def _surface_filter_errors(self) -> None:
+        """Filter-predicate failures are data errors, not empty matches
+        (ISSUE 17 satellite): count every one in
+        ``index_filter_errors_total`` and surface the first through the
+        global error log (log_data_error dedups on (key, message))."""
+        log = getattr(self.adapter, "filter_errors", None)
+        if log is None or not log.count:
+            return
+        count, first = log.drain()
+        self.scope.runtime.stats.on_index_filter_error(count)
+        if first is not None:
+            self.scope.runtime.log_data_error(first[0], key=first[1])

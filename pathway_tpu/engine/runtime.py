@@ -1013,6 +1013,18 @@ class Runtime:
         for sink in self.scope.txn_sinks:
             sink.recover(marker_tag, world)
 
+    def _index_cut(self, tag: int, rank: int = 0, world: int = 1):
+        """Arm the device-index snapshot cut (ISSUE 17) around a node
+        state_dict/load_state pass: HBM-resident indexes write/read
+        their delta segments through the same persistence store, under
+        the same (tag, world) the snapshot marker commits — so index
+        segments become visible exactly when the mesh's cut does."""
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        return _isnap.cut(
+            self.persistence, tag, rank=rank, world=world, stats=self.stats
+        )
+
     def _txn_final_cut(self) -> None:
         """Clean-shutdown half of the 2PC egress: one FINAL snapshot cut
         (snapshot + marker + finalize) covering the stream's tail, taken
@@ -1032,8 +1044,10 @@ class Runtime:
             return
         tag = getattr(self, "_snap_tag_base", 0) + 1
         self._snap_tag_base = tag
+        with self._index_cut(tag):
+            node_states = [node.state_dict() for node in self.scope.nodes]
         self.persistence.save_operator_snapshot(
-            [node.state_dict() for node in self.scope.nodes],
+            node_states,
             dict(self._operator_subject_states),
             [node.name() for node in self.scope.nodes],
             key=f"operator_snapshot/r0/{tag}",
@@ -1359,9 +1373,12 @@ class Runtime:
                 node_states, subject_states = self._load_resharded_cut(
                     tag, snap_world, 0, 1, live
                 )
-                for node, st in zip(self.scope.nodes, node_states):
-                    if st:
-                        node.load_state(st)
+                # index restores read their segment chains through the
+                # same cut the marker committed (ISSUE 17)
+                with self._index_cut(tag):
+                    for node, st in zip(self.scope.nodes, node_states):
+                        if st:
+                            node.load_state(st)
                 self._operator_subject_states.update(subject_states)
                 for conn in live:
                     self._restore_conn_state(
@@ -1532,12 +1549,6 @@ class Runtime:
                     now - self._last_snapshot
                 ) * 1000.0 >= self.persistence.snapshot_interval_ms:
                     self._last_snapshot = now
-                    node_states = [
-                        node.state_dict() for node in self.scope.nodes
-                    ]
-                    fingerprint = [
-                        node.name() for node in self.scope.nodes
-                    ]
                     # rank-scoped form + commit marker (world 1) — the
                     # same keyspace the mesh writes, so a later GROW
                     # rescale re-shards this cut into an N-rank mesh
@@ -1548,6 +1559,16 @@ class Runtime:
                     # stores from older builds.
                     tag = getattr(self, "_snap_tag_base", 0) + 1
                     self._snap_tag_base = tag
+                    # index delta segments ride this cut (ISSUE 17):
+                    # written durably now, committed when the marker
+                    # below moves
+                    with self._index_cut(tag):
+                        node_states = [
+                            node.state_dict() for node in self.scope.nodes
+                        ]
+                    fingerprint = [
+                        node.name() for node in self.scope.nodes
+                    ]
                     self.persistence.save_operator_snapshot(
                         node_states,
                         dict(self._operator_subject_states),
@@ -1830,9 +1851,11 @@ class Runtime:
             self._txn_recover(None, pg.world)
             return
         node_states, subject_states, _fp = snap
-        for node, state in zip(self.scope.nodes, node_states):
-            if state:
-                node.load_state(state)
+        # index restores read their rank's segment chains at this cut
+        with self._index_cut(tag, rank=pg.rank, world=pg.world):
+            for node, state in zip(self.scope.nodes, node_states):
+                if state:
+                    node.load_state(state)
         self._operator_subject_states.update(subject_states)
         for conn in live:
             self._restore_conn_state(conn, subject_states.get(conn.name))
@@ -1940,9 +1963,12 @@ class Runtime:
                 f"rescale restore ({old_world}->{pg.world} ranks, tag "
                 f"{tag}) refused by a peer rank"
             )
-        for node, state in zip(self.scope.nodes, node_states):
-            if state:
-                node.load_state(state)
+        # index re-shard restores fold EVERY old rank's segment chains
+        # and re-bucket through the keep set (ISSUE 17)
+        with self._index_cut(tag, rank=pg.rank, world=pg.world):
+            for node, state in zip(self.scope.nodes, node_states):
+                if state:
+                    node.load_state(state)
         self._operator_subject_states.update(subject_states)
         for conn in live:
             self._restore_conn_state(conn, subject_states.get(conn.name))
@@ -1959,8 +1985,12 @@ class Runtime:
         and only then moves the commit marker — so the marker always
         names a tag for which every rank's snapshot exists durably."""
         tag = getattr(self, "_snap_tag_base", 0) + round_no
+        # index delta segments ride this rank's cut (ISSUE 17): durable
+        # before the ack, committed when rank 0 moves the marker
+        with self._index_cut(tag, rank=pg.rank, world=pg.world):
+            node_states = [node.state_dict() for node in self.scope.nodes]
         self.persistence.save_operator_snapshot(
-            [node.state_dict() for node in self.scope.nodes],
+            node_states,
             dict(self._operator_subject_states),
             [node.name() for node in self.scope.nodes],
             key=f"operator_snapshot/r{pg.rank}/{tag}",

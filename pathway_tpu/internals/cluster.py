@@ -108,6 +108,19 @@ PASSTHROUGH_FAMILIES = (
     "device_site_flops_total",
     "device_site_flops_effective_total",
     "device_site_recompiles_total",
+    # device fault domain (ISSUE 17): which rank is retrying, tripping
+    # its watchdog, refusing growth, or paying restore time — per rank
+    "device_dispatch_retries_total",
+    "device_dispatch_failures_total",
+    "device_watchdog_trips_total",
+    "device_oom_events_total",
+    "device_index_restore_seconds_total",
+    "device_index_snapshot_bytes_total",
+    "index_filter_errors_total",
+    "device_site_dispatch_retries_total",
+    "device_site_dispatch_failures_total",
+    "device_site_watchdog_trips_total",
+    "device_site_oom_events_total",
     "trace_dropped_events_total",
     "runtime_idle_seconds_total",
     "mesh_heartbeats_missed_total",

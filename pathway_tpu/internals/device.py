@@ -501,3 +501,201 @@ class DevicePlane:
 
 
 PLANE = DevicePlane()
+
+
+# -- dispatch supervision (ISSUE 17, device fault domain) --------------------
+# Before this, a device dispatch had exactly two outcomes: success, or an
+# exception that killed the whole pipelined run (with a hung dispatch only
+# dying by the 300s MeshTimeout backstop). Supervised sites route their
+# launch through :func:`supervised_dispatch`: failures are classified
+# (transient / oom / permanent) and the pure
+# ``protocol.device_dispatch_decide`` transition picks retry-with-backoff,
+# brownout, or epoch abort — the connector ``SupervisorPolicy`` semantics
+# (io/_connector.py) applied to the device plane. An optional watchdog
+# deadline (``PATHWAY_DEVICE_DISPATCH_TIMEOUT_S``; 0 = off, the default —
+# the hot path stays a plain call) bounds a hung dispatch well under the
+# mesh op timeout.
+
+_RETRY_BACKOFF_BASE_S = 0.05
+_RETRY_BACKOFF_CAP_S = 2.0
+
+# transient XLA/runtime failure markers: worth a bounded retry. OOM
+# markers are matched FIRST — RESOURCE_EXHAUSTED must never retry into
+# the same full allocator.
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory",
+                "oom ", "allocating ")
+_TRANSIENT_MARKERS = (
+    "unavailable", "deadline_exceeded", "deadline exceeded", "aborted",
+    "connection reset", "temporarily", "try again", "internal: failed",
+)
+# a failed dispatch may have consumed its donated input buffers — a
+# retry would compute on deleted arrays; classify as permanent so the
+# epoch rolls back to buffers the snapshot actually holds
+_PERMANENT_MARKERS = ("donated", "deleted", "invalid buffer")
+
+
+class DeviceOom(RuntimeError):
+    """HBM exhaustion (real RESOURCE_EXHAUSTED or injected
+    ``device.oom``): growth was refused, the index keeps serving at its
+    committed capacity and the serving breaker browns out."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A supervised dispatch exceeded PATHWAY_DEVICE_DISPATCH_TIMEOUT_S.
+    The hung launch thread is abandoned (XLA offers no cancel); the
+    caller's epoch aborts well under the mesh op timeout backstop."""
+
+
+def classify_device_error(exc: BaseException) -> str:
+    """``"transient"`` | ``"oom"`` | ``"permanent"`` — the input to the
+    pure ``device_dispatch_decide`` transition. Injected faults carry
+    their class explicitly (``device.oom`` point -> oom, ``retryable``
+    -> transient); real errors classify by message markers, permanent
+    winning on donation/deletion evidence (retrying on consumed buffers
+    can only corrupt)."""
+    from pathway_tpu.internals.faults import InjectedFault
+
+    if isinstance(exc, WatchdogTimeout):
+        return "permanent"
+    if isinstance(exc, InjectedFault):
+        if exc.point == "device.oom":
+            return "oom"
+        return "transient" if exc.retryable else "permanent"
+    if isinstance(exc, MemoryError):
+        return "oom"
+    low = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in low for m in _PERMANENT_MARKERS):
+        return "permanent"
+    if any(m in low for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in low for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "permanent"
+
+
+def dispatch_timeout_s() -> float:
+    """Watchdog deadline for supervised dispatches; 0 disables (the
+    default: unsupervised hangs still die by the mesh op timeout)."""
+    v = _env_float("PATHWAY_DEVICE_DISPATCH_TIMEOUT_S")
+    return v if v is not None and v > 0 else 0.0
+
+
+def dispatch_retries() -> int:
+    raw = os.environ.get("PATHWAY_DEVICE_RETRIES", "")
+    try:
+        v = int(raw) if raw.strip() else 2
+    except ValueError:
+        v = 2
+    return max(0, v)
+
+
+# serving-plane OOM listeners: the HTTP gateway registers a callback
+# that flips its breaker into brownout (answers `Degraded: true` from
+# the last committed index) the moment any device site reports OOM
+_OOM_LISTENERS: list = []
+_OOM_LOCK = threading.Lock()
+
+
+def on_oom(listener) -> None:
+    with _OOM_LOCK:
+        if listener not in _OOM_LISTENERS:
+            _OOM_LISTENERS.append(listener)
+
+
+def remove_oom_listener(listener) -> None:
+    with _OOM_LOCK:
+        if listener in _OOM_LISTENERS:
+            _OOM_LISTENERS.remove(listener)
+
+
+def notify_oom(site: str) -> None:
+    """Tick the oom counter and brown out every registered serving
+    gateway. Listener errors are swallowed — OOM handling must never
+    make the failure worse."""
+    stats = PLANE.stats
+    if stats is not None:
+        stats.on_device_oom(site)
+    with _OOM_LOCK:
+        listeners = list(_OOM_LISTENERS)
+    for listener in listeners:
+        try:
+            listener(site)
+        except Exception:
+            pass
+
+
+def _run_with_watchdog(site: str, thunk, timeout: float):
+    """Run the launch on a worker thread with a deadline. A trip
+    abandons the hung thread (daemon) — the record is the
+    ``device_watchdog_trips_total`` counter plus the raised
+    :class:`WatchdogTimeout`, which classifies permanent so the epoch
+    aborts instead of waiting out the 300s mesh backstop."""
+    box: list = []
+
+    def worker():
+        try:
+            box.append(("ok", thunk()))
+        except BaseException as e:  # noqa: BLE001 - re-raised on the caller
+            box.append(("err", e))
+
+    t = threading.Thread(
+        target=worker, name=f"device-dispatch:{site}", daemon=True
+    )
+    t.start()
+    t.join(timeout)
+    if not box:
+        stats = PLANE.stats
+        if stats is not None:
+            stats.on_device_watchdog_trip(site)
+        raise WatchdogTimeout(
+            f"device dispatch at {site} exceeded the "
+            f"{timeout:g}s watchdog deadline"
+        )
+    status, value = box[0]
+    if status == "err":
+        raise value
+    return value
+
+
+def supervised_dispatch(site: str, thunk):
+    """Run one device launch under supervision: the ``device.dispatch``
+    fault point fires first (with ``site=`` context), then the thunk;
+    classified failures take the ``device_dispatch_decide`` verdict —
+    bounded-backoff retry, OOM brownout, or abort. Idempotence contract:
+    the thunk must be safe to re-run (searches are; writes are upserts
+    whose donation failures classify permanent)."""
+    from pathway_tpu.internals import faults as _faults
+    from pathway_tpu.parallel import protocol as _proto
+
+    timeout = dispatch_timeout_s()
+    retries = dispatch_retries()
+    attempt = 0
+    while True:
+        try:
+            _faults.fault_point("device.dispatch", site=site)
+            if timeout > 0:
+                return _run_with_watchdog(site, thunk, timeout)
+            return thunk()
+        except BaseException as exc:  # noqa: BLE001 - classified below
+            kind = classify_device_error(exc)
+            verdict = _proto.device_dispatch_decide(kind, attempt, retries)
+            stats = PLANE.stats
+            if verdict[0] == "retry":
+                attempt = verdict[1]
+                if stats is not None:
+                    stats.on_device_dispatch_retry(site)
+                _time.sleep(min(
+                    _RETRY_BACKOFF_CAP_S,
+                    _RETRY_BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                ))
+                continue
+            if stats is not None:
+                stats.on_device_dispatch_failure(site)
+            if verdict[0] == "brownout":
+                notify_oom(site)
+                if isinstance(exc, DeviceOom):
+                    raise
+                raise DeviceOom(
+                    f"device dispatch at {site} hit HBM exhaustion: {exc!r}"
+                ) from exc
+            raise

@@ -48,6 +48,33 @@ Injection points threaded through the hot paths:
     sink.recover                    per sink recovery scan at restore
                                     (crash here = recovery repeats —
                                     double recovery must be idempotent)
+    device.dispatch                 per supervised device dispatch
+                                    (internals/device.py
+                                    supervised_dispatch — the KNN
+                                    search/write sites and the fused
+                                    ingest chain), with ``site=``
+                                    context; a retryable raise here
+                                    exercises the bounded-backoff retry
+                                    classifier, a delay longer than
+                                    PATHWAY_DEVICE_DISPATCH_TIMEOUT_S
+                                    trips the watchdog
+    device.h2d                      per host->device staging copy
+                                    (ops/ingest.py tokenize-ahead
+                                    producer)
+    device.oom                      HBM growth attempts
+                                    (KnnShard._grow_to /
+                                    ShardedKnnIndex._grow_to_local): a
+                                    raise here emulates allocator
+                                    RESOURCE_EXHAUSTED — growth refuses
+                                    and the serving breaker browns out
+    device.snapshot                 per index snapshot cut, phase-tagged
+                                    ``cut`` (before any segment write)
+                                    and ``post_segment`` (segment
+                                    durable, manifest not yet part of a
+                                    committed cut) — the --device grid
+                                    kills both sides of the boundary
+    device.restore                  per index restore-from-segments
+                                    (phase ``restore``)
     mesh.slow                       straggler injection slots on the wave
                                     path (never crashes — pair with the
                                     ``delay`` action): the runtime hits it
@@ -123,6 +150,11 @@ POINTS = (
     "sink.stage",
     "sink.finalize",
     "sink.recover",
+    "device.dispatch",
+    "device.h2d",
+    "device.oom",
+    "device.snapshot",
+    "device.restore",
 )
 
 _ACTIONS = ("raise", "crash", "delay")

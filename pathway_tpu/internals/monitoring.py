@@ -324,6 +324,21 @@ class ProberStats:
     # dump's dropped_events field — now a live gauge, so a capped trace
     # is observable before shutdown)
     trace_dropped_events: int = 0
+    # device fault domain (ISSUE 17): dispatch-supervision and index
+    # snapshot/restore accounting. Retries / failures / watchdog trips /
+    # OOM refusals are keyed by dispatch site (the bounded static set);
+    # restore seconds and snapshot bytes are running totals — snapshot
+    # bytes scaling with corpus size instead of the epoch delta is the
+    # regression the quiet-epoch test pins.
+    device_dispatch_retries: dict = field(default_factory=dict)
+    device_dispatch_failures: dict = field(default_factory=dict)
+    device_watchdog_trips: dict = field(default_factory=dict)
+    device_oom_events: dict = field(default_factory=dict)
+    device_index_restore_s: float = 0.0
+    device_index_snapshot_bytes: int = 0
+    # filter predicates that raised during index search (ISSUE 17
+    # satellite: previously swallowed, silently dropping matching rows)
+    index_filter_errors: int = 0
 
     def on_node_step(
         self, label: str, self_s: float, rows: int, nb: bool
@@ -559,6 +574,59 @@ class ProberStats:
     def set_trace_dropped(self, n: int) -> None:
         self.trace_dropped_events = n
 
+    # -- device fault domain (ISSUE 17) ------------------------------------
+
+    def on_device_dispatch_retry(self, site: str) -> None:
+        """A supervised dispatch classified transient and is retrying
+        with backoff (internals/device.supervised_dispatch)."""
+        with self._frame_lock:
+            self.device_dispatch_retries[site] = (
+                self.device_dispatch_retries.get(site, 0) + 1
+            )
+
+    def on_device_dispatch_failure(self, site: str) -> None:
+        """A supervised dispatch exhausted its verdict — permanent
+        failure, retry budget spent, or OOM brownout."""
+        with self._frame_lock:
+            self.device_dispatch_failures[site] = (
+                self.device_dispatch_failures.get(site, 0) + 1
+            )
+
+    def on_device_watchdog_trip(self, site: str) -> None:
+        """A dispatch exceeded PATHWAY_DEVICE_DISPATCH_TIMEOUT_S and was
+        abandoned by the watchdog."""
+        with self._frame_lock:
+            self.device_watchdog_trips[site] = (
+                self.device_watchdog_trips.get(site, 0) + 1
+            )
+
+    def on_device_oom(self, site: str) -> None:
+        """HBM growth refused (real RESOURCE_EXHAUSTED or injected
+        device.oom) — the index keeps serving at committed capacity and
+        the serving breaker browns out."""
+        with self._frame_lock:
+            self.device_oom_events[site] = (
+                self.device_oom_events.get(site, 0) + 1
+            )
+
+    def on_index_restore_seconds(self, seconds: float) -> None:
+        """One index restore-from-segments completed (the ≥10x-vs-
+        rebuild path the chaos smoke pins)."""
+        with self._frame_lock:
+            self.device_index_restore_s += max(0.0, seconds)
+
+    def on_index_snapshot_bytes(self, nbytes: int) -> None:
+        """One delta segment written at a snapshot cut — bytes scale
+        with the epoch's dirty set, not corpus size."""
+        with self._frame_lock:
+            self.device_index_snapshot_bytes += max(0, nbytes)
+
+    def on_index_filter_error(self, n: int = 1) -> None:
+        """Filter predicates that raised during index search; the first
+        message also lands in the global error log."""
+        with self._frame_lock:
+            self.index_filter_errors += n
+
     def device_totals(self) -> tuple:
         """(dispatches, wall_s, device_s, flops, bytes_accessed,
         transfer_bytes, flops_effective) summed over sites, plus the
@@ -783,6 +851,40 @@ class ProberStats:
                     f'device_site_recompiles_total{{site="{site}"}} '
                     f"{self.device_recompiles[site]}"
                 )
+        # device fault domain (ISSUE 17): supervision + index snapshot
+        # counters, rendered ALWAYS like the other device globals — a
+        # healthy run honestly reads 0 everywhere
+        for metric, val, fmt in (
+            ("device_dispatch_retries_total",
+             sum(self.device_dispatch_retries.values()), "{}"),
+            ("device_dispatch_failures_total",
+             sum(self.device_dispatch_failures.values()), "{}"),
+            ("device_watchdog_trips_total",
+             sum(self.device_watchdog_trips.values()), "{}"),
+            ("device_oom_events_total",
+             sum(self.device_oom_events.values()), "{}"),
+            ("device_index_restore_seconds_total",
+             self.device_index_restore_s, "{:.6f}"),
+            ("device_index_snapshot_bytes_total",
+             self.device_index_snapshot_bytes, "{}"),
+            ("index_filter_errors_total", self.index_filter_errors, "{}"),
+        ):
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} " + fmt.format(val))
+        for metric, per_site in (
+            ("device_site_dispatch_retries_total",
+             self.device_dispatch_retries),
+            ("device_site_dispatch_failures_total",
+             self.device_dispatch_failures),
+            ("device_site_watchdog_trips_total", self.device_watchdog_trips),
+            ("device_site_oom_events_total", self.device_oom_events),
+        ):
+            if per_site:
+                lines.append(f"# TYPE {metric} counter")
+                for site in sorted(per_site):
+                    lines.append(
+                        f'{metric}{{site="{site}"}} {per_site[site]}'
+                    )
         if self.nodes:
             for metric, idx, fmt in (
                 ("node_self_seconds_total", 0, "{:.6f}"),
@@ -1035,6 +1137,25 @@ def render_dashboard(stats: ProberStats, graveyard=None):
                 f"{stats.device_hbm_live // 2**20}"
                 f"/{stats.device_hbm_peak // 2**20}",
             )
+    # device fault domain (ISSUE 17): retries/failures/watchdog/OOM at
+    # a glance — shown whenever supervision recorded anything
+    retries = sum(stats.device_dispatch_retries.values())
+    failures = sum(stats.device_dispatch_failures.values())
+    trips = sum(stats.device_watchdog_trips.values())
+    ooms = sum(stats.device_oom_events.values())
+    if retries or failures or trips or ooms:
+        pipe.add_row(
+            "device retries/failures/watchdog/oom",
+            f"{retries}/{failures}/{trips}/{ooms}",
+        )
+    if stats.device_index_restore_s or stats.device_index_snapshot_bytes:
+        pipe.add_row(
+            "index snapshot bytes | restore s",
+            f"{stats.device_index_snapshot_bytes}"
+            f" | {stats.device_index_restore_s:.2f}",
+        )
+    if stats.index_filter_errors:
+        pipe.add_row("index filter errors", str(stats.index_filter_errors))
     if (
         stats.mesh_heartbeats_missed
         or stats.mesh_rank_restarts

@@ -432,6 +432,15 @@ class RestServerSubject(ConnectorSubject):
         self._recent_done: list[tuple[float, int]] = []
         self._dispatchers: list[threading.Thread] = []
         self._gateway_up = False
+        # device OOM -> serving brownout (ISSUE 17): an HBM-growth
+        # refusal on the index is not a per-request failure streak, it
+        # is an immediate capacity loss — trip the breaker open at once
+        # so requests answer Degraded from the last committed snapshot
+        # instead of piling onto a device that cannot grow
+        from pathway_tpu.internals import device as _devsup
+
+        self._oom_listener = lambda site: self._on_device_oom(site)
+        _devsup.on_oom(self._oom_listener)
         webserver._register_route(
             route, methods, self._handle, documentation, schema=schema
         )
@@ -510,6 +519,20 @@ class RestServerSubject(ConnectorSubject):
         if n:
             self.serve_metrics.on_windows_aborted(n)
         return n
+
+    def _on_device_oom(self, site: str) -> None:
+        """Flip the breaker straight to open on a device OOM: the
+        failure streak heuristic is for transient dispatch errors, but
+        refused HBM growth means every future write dispatch fails
+        until the operator intervenes or load drops."""
+        with self._breaker_lock:
+            self._breaker = "open"
+            self._breaker_failures = max(
+                self._breaker_failures, self.breaker_threshold
+            )
+            self._breaker_opened_at = _time.monotonic()
+        if self.serve_metrics.breaker_state != "open":
+            self.serve_metrics.set_breaker("open")
 
     # -- dispatch circuit breaker (protocol.breaker_decide) ----------------
     def _breaker_now(self) -> str:

@@ -40,7 +40,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pathway_tpu.internals import device as _devsup
 from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
+from pathway_tpu.internals.faults import fault_point
 from pathway_tpu.models.encoder import (
     SentenceEncoder,
     forward_cost_model,
@@ -151,6 +153,9 @@ class IngestPipeline:
         eff_tokens = float(np.sum(lengths[:n], dtype=np.int64))
         ids_dev: Any = ids_p
         lengths_dev: Any = lengths
+        # injectable H2D staging failure (ISSUE 17): fires per staged
+        # batch; run()'s producer supervision classifies and retries it
+        fault_point("device.h2d", site=self.site)
         if self.stage_h2d:
             # start the copies now (async): the device pulls the next
             # batch's tokens while it still computes the previous one
@@ -179,13 +184,19 @@ class IngestPipeline:
                 if bucket not in self._seen_buckets:
                     self._seen_buckets.add(bucket)
                     _DEVICE.note_recompile(self.site)
+                # supervised (ISSUE 17): injected faults raise before
+                # the launch (retry-safe); a real failure that consumed
+                # the donated index triple classifies permanent
                 emb, index.vectors, index.valid, index.sq_norms = (
-                    self._fused(
-                        self.encoder.params,
-                        jnp.asarray(ids_dev),
-                        jnp.asarray(lengths_dev),
-                        jnp.asarray(slots_full),
-                        index.vectors, index.valid, index.sq_norms,
+                    _devsup.supervised_dispatch(
+                        self.site,
+                        lambda: self._fused(
+                            self.encoder.params,
+                            jnp.asarray(ids_dev),
+                            jnp.asarray(lengths_dev),
+                            jnp.asarray(slots_full),
+                            index.vectors, index.valid, index.sq_norms,
+                        ),
                     )
                 )
                 out_vectors = index.vectors
@@ -227,9 +238,49 @@ class IngestPipeline:
         err: list[BaseException] = []
 
         def producer():
+            # SupervisorPolicy semantics (io/_connector.py) for the
+            # tokenize-ahead stage: a transient hiccup (tokenizer I/O,
+            # H2D copy) restarts the producer on the SAME batch with
+            # bounded backoff instead of killing the whole pipelined
+            # run; pulling from the batches iterator itself cannot be
+            # retried (a raised generator is dead), so those failures
+            # stay permanent
+            import time as _t
+
+            from pathway_tpu.parallel import protocol as _proto
+            from pathway_tpu.udfs.retries import is_retryable
+
+            it = iter(batches)
+            retries = _devsup.dispatch_retries()
             try:
-                for keys, texts in batches:
-                    staged_q.put(self._stage(keys, texts))
+                while True:
+                    try:
+                        keys, texts = next(it)
+                    except StopIteration:
+                        break
+                    attempt = 0
+                    while True:
+                        try:
+                            staged = self._stage(keys, texts)
+                            break
+                        except BaseException as e:
+                            kind = (
+                                "transient"
+                                if isinstance(e, Exception)
+                                and is_retryable(e)
+                                else "permanent"
+                            )
+                            verdict = _proto.device_dispatch_decide(
+                                kind, attempt, retries
+                            )
+                            if verdict[0] != "retry":
+                                raise
+                            attempt = verdict[1]
+                            stats = _DEVICE.stats
+                            if stats is not None:
+                                stats.on_device_dispatch_retry(self.site)
+                            _t.sleep(min(2.0, 0.05 * (2 ** (attempt - 1))))
+                    staged_q.put(staged)
             except BaseException as e:  # surface on the consumer side
                 err.append(e)
             finally:

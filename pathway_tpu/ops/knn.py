@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from pathway_tpu.internals import device as _devsup
 from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
 from pathway_tpu.ops.topk import chunked_topk_scores, topk_scan_cost
 
@@ -127,6 +128,18 @@ class KnnShard:
         # dispatch and drop hits whose slot was freed later.
         self.remove_epoch = 0
         self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
+        # device fault domain (ISSUE 17): per-epoch dirty tracking for
+        # delta snapshots plus the committed segment chain this index
+        # extends. _dirty/_dirty_removed are insertion-ordered key sets
+        # (dicts), mutually exclusive per key — a re-added key leaves
+        # the removed set, a removed key leaves the dirty set.
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        self.snapshot_name = _isnap.next_index_name("knn")
+        self._dirty: dict[Any, None] = {}
+        self._dirty_removed: dict[Any, None] = {}
+        self._segments: list[dict] = []
+        self._retired: list[list[str]] = []
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
@@ -137,13 +150,30 @@ class KnnShard:
         if new_cap <= self.capacity:
             return
         pad = new_cap - self.capacity
-        self.vectors = jnp.concatenate(
-            [self.vectors, jnp.zeros((pad, self.dimension), jnp.float32)]
-        )
-        self.valid = jnp.concatenate([self.valid, jnp.zeros((pad,), bool)])
-        self.sq_norms = jnp.concatenate(
-            [self.sq_norms, jnp.zeros((pad,), jnp.float32)]
-        )
+        # HBM growth is the OOM site: allocate the doubled buffers into
+        # locals and commit only on success, so a refused growth leaves
+        # the index serving at its committed capacity (the failing add
+        # aborts; the serving breaker browns out via notify_oom)
+        try:
+            from pathway_tpu.internals.faults import fault_point
+
+            fault_point("device.oom", site="knn.grow")
+            vectors = jnp.concatenate(
+                [self.vectors, jnp.zeros((pad, self.dimension), jnp.float32)]
+            )
+            valid = jnp.concatenate([self.valid, jnp.zeros((pad,), bool)])
+            sq_norms = jnp.concatenate(
+                [self.sq_norms, jnp.zeros((pad,), jnp.float32)]
+            )
+        except BaseException as exc:
+            if _devsup.classify_device_error(exc) == "oom":
+                _devsup.notify_oom("knn.grow")
+                raise _devsup.DeviceOom(
+                    f"knn index refused growth to {new_cap} slots "
+                    f"(HBM exhausted): {exc!r}"
+                ) from exc
+            raise
+        self.vectors, self.valid, self.sq_norms = vectors, valid, sq_norms
         self.free_slots = (
             list(range(new_cap - 1, self.capacity - 1, -1)) + self.free_slots
         )
@@ -182,6 +212,11 @@ class KnnShard:
                 self.key_seq[key] = self._next_seq
                 self._next_seq += 1
             slots.append(slot)
+            # every upserted key is dirty for the next snapshot cut;
+            # this also captures the fused ingest chain, which assigns
+            # slots here before the encoder+write dispatch
+            self._dirty[key] = None
+            self._dirty_removed.pop(key, None)
         return np.asarray(slots, dtype=np.int32)
 
     def add(self, keys: Sequence[Any], vecs) -> None:
@@ -195,12 +230,21 @@ class KnnShard:
             slots = self._assign_slots(keys)
             slots_arr = jnp.asarray(slots)
             dev = _DEVICE.begin("knn.write") if _DEVICE.on else None
-            try:
-                self.vectors, self.valid, self.sq_norms = _write_slots(
+
+            def _launch():
+                return _write_slots(
                     self.vectors, self.valid, self.sq_norms,
                     slots_arr, jnp.asarray(vecs),
                     jnp.ones((len(slots),), bool),
                     normalize=self.metric is Metric.COS,
+                )
+
+            try:
+                # supervised (ISSUE 17): injected faults raise before the
+                # launch so retry is safe; a real failure that consumed
+                # the donated buffers classifies permanent and aborts
+                self.vectors, self.valid, self.sq_norms = (
+                    _devsup.supervised_dispatch("knn.write", _launch)
                 )
             except BaseException:
                 _DEVICE.end(dev, None, block=False)
@@ -232,6 +276,8 @@ class KnnShard:
                 self.key_seq.pop(key, None)
                 self.free_slots.append(slot)
                 slots.append(slot)
+                self._dirty_removed[key] = None
+                self._dirty.pop(key, None)
             if not slots:
                 return
             self.remove_epoch += 1
@@ -243,6 +289,61 @@ class KnnShard:
                 jnp.zeros((len(slots), self.dimension), jnp.float32),
                 jnp.zeros((len(slots),), bool),
             )
+
+    # -- snapshot / restore (ISSUE 17) ------------------------------------
+    def snapshot_state(self, *, extra=None) -> dict:
+        """Node state for the current persistence cut: a delta-segment
+        manifest when a cut context is armed (persistence/index_snapshot),
+        an inline full state otherwise. ``extra`` is an optional
+        key->payload mapping that rides the segments (adapter metadata)."""
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        return _isnap.snapshot_index(self, extra=extra)
+
+    def load_state(self, state: dict) -> dict:
+        """Rebuild HBM buffers + host maps from a committed snapshot
+        (manifest chain or inline state) instead of re-embedding; returns
+        the folded per-key extra payloads."""
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        return _isnap.restore_index(self, state)
+
+    def _load_entries(self, entries: list) -> None:
+        """Replace the whole corpus with ``[(key, seq, vector), ...]``.
+        Caller holds ``self.lock``. Vectors are as-committed (already
+        normalized for cos), so the rewrite uses ``normalize=False`` —
+        scores and the ``key_seq`` tie-break come back bit-identical."""
+        n = len(entries)
+        self.capacity = _next_pow2(max(n, _MIN_CAPACITY))
+        self.key_to_slot = {}
+        self.slot_to_key = {}
+        self.key_seq = {}
+        # the old corpus (and its mint position) is gone; restore_index
+        # re-seats _next_seq from the snapshot so post-restore inserts
+        # mint the same sequences as the uninterrupted run
+        self._next_seq = 0
+        self.free_slots = list(range(self.capacity - 1, -1, -1))
+        self.remove_epoch = 0
+        self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
+        self.vectors = jnp.zeros((self.capacity, self.dimension), jnp.float32)
+        self.valid = jnp.zeros((self.capacity,), bool)
+        self.sq_norms = jnp.zeros((self.capacity,), jnp.float32)
+        if not n:
+            return
+        slots = np.empty((n,), np.int32)
+        rows = np.empty((n, self.dimension), np.float32)
+        for i, (key, seq, row) in enumerate(entries):
+            slot = self.free_slots.pop()
+            self.key_to_slot[key] = slot
+            self.slot_to_key[slot] = key
+            self.key_seq[key] = int(seq)
+            slots[i] = slot
+            rows[i] = row
+        self.vectors, self.valid, self.sq_norms = _write_slots(
+            self.vectors, self.valid, self.sq_norms,
+            jnp.asarray(slots), jnp.asarray(rows),
+            jnp.ones((n,), bool), normalize=False,
+        )
 
     # -- search -----------------------------------------------------------
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
@@ -277,9 +378,12 @@ class KnnShard:
         dev = _DEVICE.begin("knn.search") if _DEVICE.on else None
         try:
             with self.lock:  # read+launch before the next donating update
-                vals, idx = fn(
-                    jnp.asarray(queries), self.vectors, self.valid,
-                    self.sq_norms,
+                vals, idx = _devsup.supervised_dispatch(
+                    "knn.search",
+                    lambda: fn(
+                        jnp.asarray(queries), self.vectors, self.valid,
+                        self.sq_norms,
+                    ),
                 )
                 epoch = self.remove_epoch
                 live_rows = len(self.key_to_slot)

@@ -587,6 +587,72 @@ def sink_recover(unit_tag: int, marker_tag: int | None) -> str:
     return "finalize" if sink_may_finalize(unit_tag, marker_tag) else "discard"
 
 
+# -- device fault domain (persistence/index_snapshot.py,
+# internals/device.py; ISSUE 17) --------------------------------------------
+# The device plane's recovery decisions: when an epoch-aligned index cut
+# writes a delta segment vs folds vs skips, whether a restore may trust
+# a committed segment chain, and how a supervised dispatch reacts to a
+# classified failure. Pure and identity-pinned (tests/test_device_faults)
+# so the fault grid's --device cells and the live indexes run the SAME
+# policy — no second copy to drift.
+
+
+def index_cut_decide(dirty: int, segments: int, max_segments: int) -> str:
+    """One index snapshot cut: ``"skip"`` | ``"delta"`` | ``"fold"``.
+
+    ``dirty`` counts keys touched (upserted or removed) since the last
+    cut; ``segments`` is the committed chain length. A quiet epoch
+    (``dirty == 0``) writes NO segment — the manifest re-lists the
+    existing chain, O(1) metadata (the per-cut-bytes-scale-with-delta
+    acceptance bar; the ``always_write_base`` mutant — emitting a full
+    segment every cut — breaks exactly this). A chain that would exceed
+    ``max_segments`` folds into one base segment (``TxnDeltaSink``
+    compaction); ``max_segments <= 0`` disables folding."""
+    if dirty == 0:
+        return "skip"
+    if max_segments > 0 and segments + 1 > max_segments:
+        return "fold"
+    return "delta"
+
+
+def index_restore_verdict(has_manifest: bool, missing_segments: int) -> str:
+    """Restore-vs-rebuild verdict for an index state found in a
+    committed cut: ``"restore"`` (fold the segment chain back into HBM
+    — the ≥10x-faster-than-re-embed path), ``"rebuild"`` (no manifest:
+    inline/legacy state, load it directly), or ``"refuse"`` (the marker
+    names a manifest whose segments are missing — a broken chain; a
+    silent rebuild here would serve an index with holes, violating the
+    zero-lost-entries bar the --device grid pins)."""
+    if not has_manifest:
+        return "rebuild"
+    if missing_segments > 0:
+        return "refuse"
+    return "restore"
+
+
+def device_dispatch_decide(
+    kind: str, attempt: int, max_retries: int
+) -> tuple[str, ...]:
+    """Supervised-dispatch reaction to a classified failure
+    (``internals/device.py classify_device_error`` feeds ``kind``):
+
+    * ``("retry", next_attempt)`` — transient XLA/runtime errors retry
+      with bounded backoff while budget remains (the connector
+      ``SupervisorPolicy`` semantics applied to device sites);
+    * ``("brownout",)`` — HBM OOM: growth refuses, the serving breaker
+      opens and answers ``Degraded: true`` from the last committed
+      index instead of 500s;
+    * ``("abort",)`` — permanent (or budget-exhausted): the failure
+      routes to the epoch-abort path so the supervisor rolls the rank
+      back. Total over every (kind, attempt) — no dispatch failure is
+      ever left undecided."""
+    if kind == "oom":
+        return ("brownout",)
+    if kind == "transient" and attempt < max_retries:
+        return ("retry", attempt + 1)
+    return ("abort",)
+
+
 # -- autoscaler policy (parallel/autoscale.py; ISSUE 11) --------------------
 
 def autoscale_decide(
@@ -677,4 +743,7 @@ TRANSITIONS: dict[str, object] = {
     "serve_replay_split": serve_replay_split,
     "serve_retry_after": serve_retry_after,
     "breaker_decide": breaker_decide,
+    "index_cut_decide": index_cut_decide,
+    "index_restore_verdict": index_restore_verdict,
+    "device_dispatch_decide": device_dispatch_decide,
 }

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.internals import device as _devsup
 from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
 from pathway_tpu.ops.knn import Metric, _write_slots
 from pathway_tpu.ops.topk import (
@@ -222,6 +223,15 @@ class ShardedKnnIndex:
         self.lock = threading.Lock()
         self.remove_epoch = 0
         self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
+        # device fault domain (ISSUE 17): dirty tracking + segment chain,
+        # same semantics as ops.knn.KnnShard
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        self.snapshot_name = _isnap.next_index_name("sknn")
+        self._dirty: dict[Any, None] = {}
+        self._dirty_removed: dict[Any, None] = {}
+        self._segments: list[dict] = []
+        self._retired: list[list[str]] = []
         # batched slot-write with the shard layout pinned on the outputs
         # (the scatter must not silently replicate the store); same body
         # as the single-chip shard's donated writer
@@ -277,9 +287,24 @@ class ShardedKnnIndex:
             return
         old_local, old_cap = self.local_cap, self.capacity
         new_cap = self.n_shards * local
-        host_vec = np.asarray(self.vectors)
-        host_valid = np.asarray(self.valid)
-        host_sq = np.asarray(self.sq_norms)
+        # HBM growth is the OOM site (ISSUE 17): stage into locals,
+        # commit only on success — a refused growth leaves every shard
+        # serving at committed capacity while the failing add aborts
+        try:
+            from pathway_tpu.internals.faults import fault_point
+
+            fault_point("device.oom", site="knn.sharded_grow")
+            host_vec = np.asarray(self.vectors)
+            host_valid = np.asarray(self.valid)
+            host_sq = np.asarray(self.sq_norms)
+        except BaseException as exc:
+            if _devsup.classify_device_error(exc) == "oom":
+                _devsup.notify_oom("knn.sharded_grow")
+                raise _devsup.DeviceOom(
+                    f"sharded knn index refused growth to {new_cap} "
+                    f"global slots (HBM exhausted): {exc!r}"
+                ) from exc
+            raise
         new_vec = np.zeros((new_cap, self.dimension), np.float32)
         new_valid = np.zeros((new_cap,), bool)
         new_sq = np.zeros((new_cap,), np.float32)
@@ -295,8 +320,7 @@ class ShardedKnnIndex:
         for old_slot, key in self.slot_to_key.items():
             s, l = divmod(old_slot, old_local)
             remap[s * local + l] = key
-        self.slot_to_key = remap
-        self.key_to_slot = {k: sl for sl, k in remap.items()}
+        new_free = []
         for s in range(self.n_shards):
             shifted = [
                 s * local + (sl - s * old_local)
@@ -305,19 +329,30 @@ class ShardedKnnIndex:
             fresh = list(
                 range(s * local + local - 1, s * local + old_local - 1, -1)
             )
-            self.free_by_shard[s] = fresh + shifted
+            new_free.append(fresh + shifted)
+        try:
+            dev_vec = jax.device_put(jnp.asarray(new_vec), self._db_sharding)
+            dev_valid = jax.device_put(
+                jnp.asarray(new_valid), self._row_sharding
+            )
+            dev_sq = jax.device_put(jnp.asarray(new_sq), self._row_sharding)
+        except BaseException as exc:
+            if _devsup.classify_device_error(exc) == "oom":
+                _devsup.notify_oom("knn.sharded_grow")
+                raise _devsup.DeviceOom(
+                    f"sharded knn index refused growth to {new_cap} "
+                    f"global slots (HBM exhausted): {exc!r}"
+                ) from exc
+            raise
+        self.slot_to_key = remap
+        self.key_to_slot = {k: sl for sl, k in remap.items()}
+        self.free_by_shard = new_free
         self.local_cap = local
         self.capacity = new_cap
         self.slot_freed_epoch = new_epoch
-        self.vectors = jax.device_put(
-            jnp.asarray(new_vec), self._db_sharding
-        )
-        self.valid = jax.device_put(
-            jnp.asarray(new_valid), self._row_sharding
-        )
-        self.sq_norms = jax.device_put(
-            jnp.asarray(new_sq), self._row_sharding
-        )
+        self.vectors = dev_vec
+        self.valid = dev_valid
+        self.sq_norms = dev_sq
 
     def _assign_slots(self, keys: Sequence[Any]) -> np.ndarray:
         """Route every key to a slot on its OWNING shard (upsert
@@ -346,6 +381,9 @@ class ShardedKnnIndex:
                 self.key_seq[key] = self._next_seq
                 self._next_seq += 1
             slots.append(slot)
+            # upserted keys are dirty for the next snapshot cut
+            self._dirty[key] = None
+            self._dirty_removed.pop(key, None)
         return np.asarray(slots, np.int32)
 
     def add(self, keys: Sequence[Any], vecs) -> None:
@@ -358,11 +396,19 @@ class ShardedKnnIndex:
         try:
             with self.lock:
                 slots = self._assign_slots(keys)
-                self.vectors, self.valid, self.sq_norms = self._write(
-                    self.vectors, self.valid, self.sq_norms,
-                    jnp.asarray(slots), jnp.asarray(vecs),
-                    jnp.ones((len(slots),), bool),
-                    normalize=self.metric is Metric.COS,
+                # supervised dispatch (ISSUE 17): injected faults raise
+                # before the launch so retry is safe; donation failures
+                # classify permanent and abort the epoch
+                self.vectors, self.valid, self.sq_norms = (
+                    _devsup.supervised_dispatch(
+                        "knn.sharded_write",
+                        lambda: self._write(
+                            self.vectors, self.valid, self.sq_norms,
+                            jnp.asarray(slots), jnp.asarray(vecs),
+                            jnp.ones((len(slots),), bool),
+                            normalize=self.metric is Metric.COS,
+                        ),
+                    )
                 )
                 out_vectors = self.vectors
         except BaseException:
@@ -391,6 +437,8 @@ class ShardedKnnIndex:
                 self.key_seq.pop(key, None)
                 self.free_by_shard[slot // self.local_cap].append(slot)
                 slots.append(slot)
+                self._dirty_removed[key] = None
+                self._dirty.pop(key, None)
             if not slots:
                 return
             self.remove_epoch += 1
@@ -403,6 +451,80 @@ class ShardedKnnIndex:
             )
 
     remove_batch = remove
+
+    # -- snapshot / restore (ISSUE 17) --------------------------------------
+    def snapshot_state(self, *, extra=None) -> dict:
+        """Delta-segment manifest (cut context armed) or inline full
+        state — same contract as ``KnnShard.snapshot_state``."""
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        return _isnap.snapshot_index(self, extra=extra)
+
+    def load_state(self, state: dict) -> dict:
+        """Rebuild every HBM shard from a committed snapshot; returns
+        folded per-key extras. Restoring under a DIFFERENT mesh than the
+        one that cut the snapshot is the N→M re-shard: ``_load_entries``
+        re-buckets every entry through the CURRENT ``owner_shard`` mint,
+        so the same committed segments serve any shard count."""
+        from pathway_tpu.persistence import index_snapshot as _isnap
+
+        return _isnap.restore_index(self, state)
+
+    def _load_entries(self, entries: list) -> None:
+        """Replace the corpus with ``[(key, seq, vector), ...]``, routing
+        each key to its owning shard at the CURRENT ``n_shards``. Caller
+        holds ``self.lock``. Rows rewrite with ``normalize=False`` (the
+        bit-identical restore contract)."""
+        n = len(entries)
+        per = [0] * self.n_shards
+        owners = np.empty((n,), np.int64)
+        for i, (key, _seq, _row) in enumerate(entries):
+            s = self.owner_shard(key)
+            owners[i] = s
+            per[s] += 1
+        local = 128
+        peak = max(per) if per else 0
+        while local < peak:
+            local *= 2
+        self.local_cap = local
+        self.capacity = self.n_shards * local
+        self.key_to_slot = {}
+        self.slot_to_key = {}
+        self.key_seq = {}
+        # restore_index re-seats _next_seq from the snapshot afterwards
+        self._next_seq = 0
+        self.free_by_shard = [
+            list(range((s + 1) * local - 1, s * local - 1, -1))
+            for s in range(self.n_shards)
+        ]
+        self.remove_epoch = 0
+        self.slot_freed_epoch = np.full(self.capacity, -1, np.int64)
+        self.vectors = jax.device_put(
+            jnp.zeros((self.capacity, self.dimension), jnp.float32),
+            self._db_sharding,
+        )
+        self.valid = jax.device_put(
+            jnp.zeros((self.capacity,), bool), self._row_sharding
+        )
+        self.sq_norms = jax.device_put(
+            jnp.zeros((self.capacity,), jnp.float32), self._row_sharding
+        )
+        if not n:
+            return
+        slots = np.empty((n,), np.int32)
+        rows = np.empty((n, self.dimension), np.float32)
+        for i, (key, seq, row) in enumerate(entries):
+            slot = self.free_by_shard[int(owners[i])].pop()
+            self.key_to_slot[key] = slot
+            self.slot_to_key[slot] = key
+            self.key_seq[key] = int(seq)
+            slots[i] = slot
+            rows[i] = row
+        self.vectors, self.valid, self.sq_norms = self._write(
+            self.vectors, self.valid, self.sq_norms,
+            jnp.asarray(slots), jnp.asarray(rows),
+            jnp.ones((n,), bool), normalize=False,
+        )
 
     # -- search ------------------------------------------------------------
     def search(self, queries, k: int) -> list[list[tuple[Any, float]]]:
@@ -431,7 +553,12 @@ class ShardedKnnIndex:
         try:
             with self.lock:  # read+launch before the next donating write
                 q_dev = jax.device_put(jnp.asarray(queries), self._repl)
-                vals, idx = fn(q_dev, self.vectors, self.valid, self.sq_norms)
+                vals, idx = _devsup.supervised_dispatch(
+                    "knn.sharded_search",
+                    lambda: fn(
+                        q_dev, self.vectors, self.valid, self.sq_norms
+                    ),
+                )
                 epoch = self.remove_epoch
                 live_rows = len(self.key_to_slot)
         except BaseException:

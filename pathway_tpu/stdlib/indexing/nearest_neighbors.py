@@ -25,6 +25,35 @@ from pathway_tpu.stdlib.indexing._filters import compile_filter
 from pathway_tpu.stdlib.indexing.retrievers import InnerIndex, InnerIndexFactory
 
 
+class _FilterErrorLog:
+    """A filter predicate that raises is a data error, not an empty
+    match: swallowing it silently drops matching rows (ISSUE 17
+    satellite). Adapters count every failure here and retain the first
+    message; ``ExternalIndexNode`` drains the log after each search into
+    ``index_filter_errors_total`` and ``pw.global_error_log()``."""
+
+    __slots__ = ("count", "first")
+
+    def __init__(self):
+        self.count = 0
+        self.first: tuple[str, Any] | None = None
+
+    def note(self, exc: BaseException, key) -> None:
+        self.count += 1
+        if self.first is None:
+            self.first = (
+                f"index filter predicate raised {type(exc).__name__}: "
+                f"{exc} — matching row dropped from results",
+                key,
+            )
+
+    def drain(self) -> tuple[int, tuple[str, Any] | None]:
+        count, first = self.count, self.first
+        self.count = 0
+        self.first = None
+        return count, first
+
+
 class _HnswAdapter:
     """C++ HNSW ANN (native/hnsw.cpp — the usearch equivalent,
     usearch_integration.rs:20) behind the adapter contract."""
@@ -47,6 +76,7 @@ class _HnswAdapter:
         # itself is rebuilt on restore)
         self.vecs: dict[Any, Any] = {}
         self._next = 0
+        self.filter_errors = _FilterErrorLog()
 
     def _id(self, key) -> int:
         i = self.key_to_id.get(key)
@@ -117,7 +147,11 @@ class _HnswAdapter:
                         try:
                             if not pred(self.meta.get(key)):
                                 continue
-                        except Exception:
+                        except Exception as exc:
+                            # counted + surfaced by the index node — a
+                            # buggy filter must not silently starve
+                            # results (ISSUE 17 satellite)
+                            self.filter_errors.note(exc, key)
                             continue
                     hits.append((key, score))
                     if len(hits) == limit:
@@ -175,6 +209,7 @@ class _KnnAdapter:
 
             self.shard = KnnShard(dimension, metric, capacity=capacity)
         self.meta: dict[Any, Any] = {}
+        self.filter_errors = _FilterErrorLog()
 
     def add(self, key, data, filter_data) -> None:
         vec = np.asarray(data, dtype=np.float32)
@@ -203,14 +238,21 @@ class _KnnAdapter:
 
     # -- operator-snapshot hooks -------------------------------------------
     def snapshot_state(self):
-        keys = list(self.shard.key_to_slot)
-        vecs = np.asarray(self.shard.vectors)
-        rows = np.stack(
-            [vecs[self.shard.key_to_slot[k]] for k in keys]
-        ) if keys else np.zeros((0, self.shard.dimension), np.float32)
-        return {"keys": keys, "vectors": rows, "meta": dict(self.meta)}
+        """Delegate to the shard's epoch-aligned delta snapshot (ISSUE
+        17): per-key filter metadata rides the segments as ``extra``, so
+        a cut transfers only the epoch's dirty rows instead of pickling
+        the whole corpus + meta dict per cut (the old O(corpus) path)."""
+        return self.shard.snapshot_state(extra=self.meta)
 
     def load_state(self, state) -> None:
+        if (
+            state.get("__index_segments__")
+            or state.get("__index_inline__")
+            or state.get("__index_reshard__")
+        ):
+            self.meta = self.shard.load_state(state)
+            return
+        # legacy pre-ISSUE-17 adapter snapshot shape
         if state["keys"]:
             self.shard.add(state["keys"], state["vectors"])
         self.meta = dict(state["meta"])
@@ -248,7 +290,10 @@ class _KnnAdapter:
         meta = self.meta.get(key)
         try:
             return bool(pred(meta))
-        except Exception:
+        except Exception as exc:
+            # counted + surfaced by the index node — a buggy filter must
+            # not silently starve results (ISSUE 17 satellite)
+            self.filter_errors.note(exc, key)
             return False
 
 

@@ -192,4 +192,18 @@ echo "=== lane 15: sharded-index smoke (pod-sharded HBM KNN + fused ingest) ==="
 # BENCH_full.json records sharded_knn_scaling via `--update-artifact`.
 env -u PATHWAY_LANE_PROCESSES python scripts/sharded_index_smoke.py
 
+echo "=== lane 16: device fault-domain chaos smoke (snapshot/restore/reshard) ==="
+# real-fork embed+KNN index under epoch-aligned HBM snapshots, killed
+# mid-cut (device.snapshot cut/post_segment) and mid-recovery
+# (device.restore), plus a raise cell absorbed by the dispatch
+# supervision: victims die 27, a clean resume restores the committed
+# segment chain (NOT re-embedding) and answers bit-identically to a
+# fault-free twin with ZERO lost/duplicated entries; the 2->3 rescale
+# cell re-buckets through the shard mint; the timing cell pins the
+# restore >= 10x faster-than-rebuild bar. The full grid (kill/raise x
+# victim x {single-chip, sharded} x {rollback, rescale}) runs via
+# `python scripts/fault_matrix.py --device`; the cut/restore/dispatch
+# transitions are identity-pinned in tests/test_device_faults.py.
+env -u PATHWAY_LANE_PROCESSES python scripts/device_chaos_smoke.py --quick
+
 echo "=== all lanes green ==="

@@ -1240,6 +1240,54 @@ def run_serve_cells(timeout: float) -> list[CellResult]:
     return results
 
 
+# ---------------------------------------------------------------------------
+# device grid: kill/raise cells on the index fault domain (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def _load_device_chaos():
+    """scripts/device_chaos_smoke.py loaded by file path (same pattern
+    as the serve grid): its jax-heavy work happens in forked scenario
+    processes, so fault_matrix without --device stays import-light."""
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "device_chaos_smoke.py")
+    spec = importlib.util.spec_from_file_location("_pw_device_chaos", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_device_cells(timeout: float) -> list[CellResult]:
+    """The device grid: kill/raise phase × victim injection point ×
+    {single-chip, sharded} × {rollback, rescale 2→3}. Every crash cell
+    resumes from the committed epoch cut (segment-chain restore, or an
+    N→M re-shard through the mint) and must answer bit-identically to
+    the fault-free twin with zero lost/duplicated index entries; raise
+    cells must be absorbed by the dispatch supervision with no drift."""
+    chaos = _load_device_chaos()
+    results: list[CellResult] = []
+    for kind, recovery, point, phase, action, hit in chaos.DEVICE_CELLS:
+        summary = chaos.run_cell(
+            kind, recovery, point, phase, action=action, hit=hit,
+            timeout=timeout,
+        )
+        if summary["ok"]:
+            detail = f"entries={summary.get('entries')}"
+            if summary.get("restore_s") is not None:
+                detail += f" restore={summary['restore_s']:.3f}s"
+        else:
+            detail = "; ".join(summary.get("problems", ["?"]))[:300]
+        res = CellResult(
+            point + (f"#{phase}" if phase else ""),
+            f"{kind}/{recovery}", hit or 1, summary["ok"], detail,
+        )
+        results.append(res)
+        status = "PASS" if res.ok else "FAIL"
+        print(f"{status}  {res.point:<32} mode={res.mode:<16} {res.detail}")
+    return results
+
+
 def _run_scenario(script, mode, tmp, n_rows, plan, timeout):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("PATHWAY_FAULT_PLAN", None)
@@ -1367,6 +1415,14 @@ def main(argv=None) -> int:
         "run (zero lost, zero duplicated rows)",
     )
     ap.add_argument(
+        "--device", action="store_true",
+        help="run the device fault-domain grid (ISSUE 17): kill/raise "
+        "phase (device.snapshot cut/post_segment, device.restore, "
+        "device.dispatch) × {single-chip, sharded} index × {rollback, "
+        "rescale 2->3} — resumed queries must be bit-identical with "
+        "zero lost/duplicated index entries",
+    )
+    ap.add_argument(
         "--rescale", action="store_true",
         help="run the kill-during-rescale grid (ISSUE 11): a committed "
         "world-N cut restored RE-SHARDED into world M, with the victim "
@@ -1403,6 +1459,12 @@ def main(argv=None) -> int:
         return 1 if failed else 0
     if args.sink:
         results.extend(run_sink_cells(max(args.timeout, 240)))
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.device:
+        results.extend(run_device_cells(max(args.timeout, 240)))
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

@@ -245,6 +245,16 @@ _PROBER_CALLS = {
     # shape-bucket churn visibility (ISSUE 16): fresh XLA compilations
     # per dispatch site — device_recompiles_total
     "on_device_recompile": ("encoder.forward",),
+    # device fault domain (ISSUE 17): dispatch supervision verdicts,
+    # watchdog trips, HBM-growth OOM refusals, and the epoch-aligned
+    # index snapshot/restore accounting
+    "on_device_dispatch_retry": ("knn.search",),
+    "on_device_dispatch_failure": ("knn.search",),
+    "on_device_watchdog_trip": ("knn.search",),
+    "on_device_oom": ("knn.grow",),
+    "on_index_restore_seconds": (1.5,),
+    "on_index_snapshot_bytes": (4096,),
+    "on_index_filter_error": (2,),
 }
 # state consumed by the dashboard/main loop, not an OpenMetrics family
 _PROBER_EXEMPT = {"on_connector_finished"}
