@@ -1290,6 +1290,65 @@ PyObject *store_len(PyObject *, PyObject *arg)
     return PyLong_FromLongLong(n);
 }
 
+/* -- store_nbytes(store) ------------------------------------------------
+ * GIL-free byte probe for the memory accountant (internals/memory.py;
+ * ISSUE 19): container capacities + amortized node overhead + a flat
+ * per-owned-object charge. An ESTIMATE, not malloc truth — the
+ * accountant steps watermarks, it does not bill. The walk only reads
+ * pointers and container shapes (NULL-compares, no C-API, no
+ * refcounts), so it runs released like the shard apply phase and the
+ * lint_gil.py sweep covers the region like every other. */
+
+static const int64_t kNodeEst = 48; /* map node + bucket slot, amortized */
+static const int64_t kObjEst = 64;  /* flat charge per owned heap object */
+
+PyObject *store_nbytes(PyObject *, PyObject *arg)
+{
+    GroupStore *s = get_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    int64_t n = 0;
+    Py_BEGIN_ALLOW_THREADS
+    n += (int64_t)sizeof(GroupStore);
+    n += (int64_t)(s->codes.capacity() + s->kinds.capacity());
+    for (auto &sh : s->shards) {
+        n += (int64_t)sizeof(Shard);
+        n += (int64_t)sh.groups.bucket_count() * (int64_t)sizeof(void *);
+        for (auto &kv : sh.groups) {
+            const Group &g = kv.second;
+            n += kNodeEst + (int64_t)kv.first.capacity();
+            n += (int64_t)sizeof(Group);
+            if (g.gvals != nullptr)
+                n += kObjEst;
+            if (g.out_key != nullptr)
+                n += kObjEst;
+            n += (int64_t)(g.st.capacity() * sizeof(SState));
+            for (const auto &st : g.st)
+                n += (int64_t)st.mm.size() *
+                     (kNodeEst + (int64_t)sizeof(MVal) +
+                      (int64_t)sizeof(int64_t));
+            n += (int64_t)g.ms.bucket_count() * (int64_t)sizeof(void *);
+            for (const auto &me : g.ms) {
+                const MsEntry &e = me.second;
+                n += kNodeEst + (int64_t)me.first.capacity();
+                n += (int64_t)sizeof(MsEntry);
+                n += (int64_t)e.key_ord.capacity();
+                n += (int64_t)(e.vals.capacity() * sizeof(void *));
+                n += (int64_t)(e.mvals.capacity() * sizeof(MVal));
+                if (e.key != nullptr)
+                    n += kObjEst;
+                for (auto *v : e.vals)
+                    if (v != nullptr)
+                        n += kObjEst;
+                if (e.order_obj != nullptr)
+                    n += kObjEst;
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    return PyLong_FromLongLong(n);
+}
+
 /* -- process_batch(store, gvals_list, keys, valcols, diffs, key_fn,
  *                  error[, time, ordercol]) ----------------------------- */
 
@@ -2428,6 +2487,49 @@ PyObject *join_store_len(PyObject *, PyObject *arg)
     int64_t n = 0;
     for (auto &sh : s->shards)
         n += (int64_t)sh.groups.size();
+    return PyLong_FromLongLong(n);
+}
+
+/* -- join_store_nbytes(store) -------------------------------------------
+ * the join-side twin of store_nbytes (same estimate discipline, same
+ * GIL-free walk: pointer NULL-compares and container shapes only). */
+PyObject *join_store_nbytes(PyObject *, PyObject *arg)
+{
+    JoinStore *s = get_join_store(arg);
+    if (s == nullptr)
+        return nullptr;
+    int64_t n = 0;
+    Py_BEGIN_ALLOW_THREADS
+    n += (int64_t)sizeof(JoinStore);
+    for (auto &sh : s->shards) {
+        n += (int64_t)sizeof(JShard);
+        n += (int64_t)sh.groups.bucket_count() * (int64_t)sizeof(void *);
+        for (auto &kv : sh.groups) {
+            const JGroup &g = kv.second;
+            n += kNodeEst + (int64_t)kv.first.capacity();
+            n += (int64_t)sizeof(JGroup) + (int64_t)g.jk_cells.capacity();
+            if (g.jk != nullptr)
+                n += kObjEst;
+            const std::unordered_map<std::string, JEntry> *sides[2] = {
+                &g.left, &g.right};
+            for (const auto *side : sides) {
+                n += (int64_t)side->bucket_count() *
+                     (int64_t)sizeof(void *);
+                for (const auto &ev : *side) {
+                    const JEntry &e = ev.second;
+                    n += kNodeEst + (int64_t)ev.first.capacity();
+                    n += (int64_t)sizeof(JEntry);
+                    if (e.key != nullptr)
+                        n += kObjEst;
+                    if (e.row != nullptr)
+                        n += kObjEst;
+                    if (e.cells)
+                        n += (int64_t)e.cells->capacity();
+                }
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS
     return PyLong_FromLongLong(n);
 }
 
@@ -6475,6 +6577,8 @@ PyMethodDef methods[] = {
     {"store_new", store_new, METH_VARARGS,
      "store_new(n_shards, codes[, has_order]) -> capsule"},
     {"store_len", store_len, METH_O, "number of live groups"},
+    {"store_nbytes", store_nbytes, METH_O,
+     "estimated bytes held by a GroupStore (GIL-free walk)"},
     {"phase_stats", phase_stats, METH_NOARGS,
      "process-wide per-phase wall time (all group stores)"},
     {"phase_stats_reset", phase_stats_reset, METH_NOARGS,
@@ -6491,6 +6595,8 @@ PyMethodDef methods[] = {
     {"join_store_new", join_store_new, METH_VARARGS,
      "join_store_new(n_shards, jtype, id_mode, lwidth, rwidth) -> capsule"},
     {"join_store_len", join_store_len, METH_O, "number of live join keys"},
+    {"join_store_nbytes", join_store_nbytes, METH_O,
+     "estimated bytes held by a JoinStore (GIL-free walk)"},
     {"join_store_dump", join_store_dump, METH_O,
      "picklable [(jk, left_entries, right_entries)]"},
     {"join_store_load", join_store_load, METH_VARARGS,

@@ -356,6 +356,34 @@ def _analyze_serve(args) -> int:
     return 0
 
 
+def _analyze_pace(args) -> int:
+    """Pacing verifier (ISSUE 19): exhaustively model-check the memory
+    governor's pause/resume loop — the same mem_ladder / pace_decide /
+    pace_resume transitions of parallel/protocol.py the runtime's
+    governance pass and the connector self-pacing drive at runtime.
+    Proves a paced source can never deadlock against the drain that
+    unpauses it, across pressure spikes, crashes and rescale restores."""
+    from pathway_tpu.analysis import meshcheck
+
+    report = meshcheck.check_pacing(
+        meshcheck.PaceCheckConfig(
+            rows=args.pace_rows,
+            mutate=args.pace_mutant,
+        )
+    )
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    if report.violations:
+        return 2
+    if not report.complete:
+        print("state space NOT exhausted; verdict inconclusive",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
 def _analyze_profile(args) -> int:
     from pathway_tpu.analysis.profile import (
         profile_trace,
@@ -528,6 +556,23 @@ def main(argv=None) -> int:
              "(replay_committed_window) — the checker must catch it",
     )
     parser.add_argument(
+        "--pace", action="store_true",
+        help="exhaustively model-check the memory-governor pacing loop "
+             "(bounded-memory backpressure, ISSUE 19): a paced source "
+             "never deadlocks against the drain that unpauses it, and "
+             "every row is delivered exactly once across pressure "
+             "spikes, crash restores and rescales",
+    )
+    parser.add_argument(
+        "--pace-rows", type=int, default=4,
+        help="with --pace: symbolic source row count (default 4)",
+    )
+    parser.add_argument(
+        "--pace-mutant", default=None,
+        help="with --pace: check a deliberately broken governance "
+             "variant (never_resume) — the checker must catch it",
+    )
+    parser.add_argument(
         "--update-artifact", action="store_true",
         help="with --bench: annotate BENCH_full.json lines with "
              "plan_verdict",
@@ -565,13 +610,16 @@ def main(argv=None) -> int:
             return _analyze_critical_path(args)
         if args.serve:
             return _analyze_serve(args)
+        if args.pace:
+            return _analyze_pace(args)
         if args.mesh:
             return _analyze_mesh(args)
         if args.bench:
             return _analyze_bench(args)
         if not args.program:
             parser.error(
-                "a program path (or --bench/--mesh/--serve) is required"
+                "a program path (or --bench/--mesh/--serve/--pace) is "
+                "required"
             )
         return _analyze_program(args)
     except KnobError as e:

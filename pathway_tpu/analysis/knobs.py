@@ -404,6 +404,22 @@ KNOBS: dict[str, Knob] = {
         _k("PATHWAY_AUTOSCALE_HYSTERESIS", "int", 2,
            "Consecutive ticks a grow/shrink condition must hold before "
            "the autoscaler acts.", lo=1, hi=1000),
+        # -- memory governance / backpressure (internals/memory.py) ------
+        _k("PATHWAY_MEM_BUDGET_MB", "int", None,
+           "Host-plane memory budget in MiB for the accounted "
+           "components (connector backlog, exchange queues, native "
+           "stores, capture pending, txn staging). Unset/0 disables "
+           "the degradation ladder — legacy un-governed behavior.",
+           lo=0, hi=1_048_576),
+        _k("PATHWAY_MEM_HIGH", "float", 0.8,
+           "High watermark as a fraction of the budget: accounted "
+           "bytes at/above it step the ladder to pacing (pausable "
+           "sources stop reading).", lo=0.0, hi=1.0),
+        _k("PATHWAY_MEM_LOW", "float", 0.6,
+           "Low watermark as a fraction of the budget: the ladder "
+           "only releases back to ok (sources resume) once accounted "
+           "bytes drain below it — the hysteresis band that stops "
+           "pause/resume flapping.", lo=0.0, hi=1.0),
         # -- mesh verifier (analysis/meshcheck.py) ------------------------
         _k("PATHWAY_MESHCHECK_RANKS", "int", 3,
            "Default symbolic rank count of the mesh model checker "

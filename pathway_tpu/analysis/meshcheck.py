@@ -2681,3 +2681,455 @@ def check_serving(cfg: ServeCheckConfig | None = None) -> ServeCheckReport:
                     next_frontier.append((ns, trace + (step,)))
         frontier = next_frontier
     return report
+
+
+# ===========================================================================
+# Pacing checker (ISSUE 19): bounded-memory backpressure without deadlock
+# ===========================================================================
+#
+# The memory governor (internals/memory.py + engine/runtime.py
+# _service_memory) pauses pausable sources off the pure transitions
+# mem_ladder / pace_decide / pace_resume. The one catastrophic way to get
+# that wrong is a PAUSE/DRAIN DEADLOCK: pacing on a signal only the
+# paused subject itself can drain (the journal ledger, which shrinks at
+# subject commit boundaries a parked subject can never reach). The
+# engine avoids it by construction — the pacing signal is the
+# put-minus-drained queue depth, which the MAIN LOOP shrinks — and this
+# checker proves the construction: it drives the SAME transition objects
+# over every interleaving of {read, drain, governance sample, injected
+# mem.pressure sample, crash+restore, rescale restore} and verifies:
+#
+# * no dead end: every non-terminal state has a successor — in
+#   particular a paced source never blocks the drain that would unpause
+#   it (drain is enabled whenever anything is queued, paused or not);
+# * exactly-once: every row is delivered exactly once across pacing
+#   episodes, pressure injections, crash restores and rescale restores
+#   (undrained queued rows are re-read after a restore; drained rows are
+#   journal-covered and are not);
+# * the sticky ``abort`` rung always resolves into an epoch abort +
+#   restore, never a silent hang.
+#
+# The ``never_resume`` mutant (pace_resume that can never release) must
+# be caught with a minimal BFS trace whose pressure/crash steps render
+# as a replayable ``mem.pressure`` PATHWAY_FAULT_PLAN
+# (scripts/fault_matrix.py --from-trace replays it as a real cell).
+
+PACE_MUTANT_NAMES = ("never_resume",)
+
+PACE_FAULT_POINT = "mem.pressure"
+
+
+class PaceTransitions:
+    """The governance decisions the pacing model drives through —
+    default-binds the engine's own ``protocol.TRANSITIONS`` entries
+    (same-object identity pinned by tests/test_backpressure.py)."""
+
+    NAMES = ("mem_ladder", "pace_decide", "pace_resume")
+
+    def __init__(self, overrides: dict | None = None):
+        for name in self.NAMES:
+            setattr(self, name, _proto.TRANSITIONS[name])
+        for name, fn in (overrides or {}).items():
+            if name not in self.NAMES:
+                raise ValueError(f"unknown pace transition {name!r}")
+            setattr(self, name, fn)
+
+
+def _mutant_never_resume(ladder_state, backlog_rows=0, resume_rows=0):
+    """Broken release: the resume verdict is never granted, so a paced
+    source stays parked forever once the first pause engages — the
+    pause/drain liveness hole the checker must catch as a dead end."""
+    return False
+
+
+def get_pace_transitions(mutate: str | None = None) -> PaceTransitions:
+    if mutate is None:
+        return PaceTransitions()
+    if mutate == "never_resume":
+        return PaceTransitions({"pace_resume": _mutant_never_resume})
+    raise ValueError(
+        f"unknown pace mutant {mutate!r}; known: "
+        + ", ".join(PACE_MUTANT_NAMES)
+    )
+
+
+@dataclass
+class PaceCheckConfig:
+    # rows the modeled source must deliver; 1 queued row = 1 byte, so
+    # the watermark arithmetic below stays single-digit
+    rows: int = 4
+    low_bytes: int = 2
+    high_bytes: int = 3
+    budget_bytes: int = 5
+    abort_streak: int = 2
+    # one-shot budgets: injected mem.pressure samples, rank crashes and
+    # rescale restores the scheduler may spend
+    spike_budget: int = 1
+    crash_budget: int = 1
+    rescale_budget: int = 1
+    mutate: str | None = None
+    max_states: int = 200_000
+
+
+@dataclass
+class PaceViolation:
+    kind: str
+    detail: str
+    trace: list = field(default_factory=list)
+
+    def fault_plan(self) -> dict | None:
+        """Pressure/crash choices as a replayable PATHWAY_FAULT_PLAN:
+        every governance sample fires the ``mem.pressure`` point (phase
+        ``sample``), so the trace's sample ordinals are the hit indices
+        — a ``raise`` rule is the injected over-watermark sample, a
+        ``crash`` rule kills the rank at that sample."""
+        rules = [
+            {
+                "point": PACE_FAULT_POINT,
+                "phase": "sample",
+                "rank": 0,
+                "hits": [step["hit"]],
+                "action": step["action"],
+            }
+            for step in self.trace
+            if step.get("action") in ("raise", "crash")
+        ]
+        return {"seed": 7, "rules": rules} if rules else None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "detail": self.detail,
+            "pressure": True,
+            "rescale": any(s.get("rescale") for s in self.trace),
+            "trace": self.trace,
+            "fault_plan": self.fault_plan(),
+        }
+
+
+@dataclass
+class PaceCheckReport:
+    config: PaceCheckConfig
+    states: int = 0
+    transitions: int = 0
+    terminals: int = 0
+    pauses_explored: int = 0
+    restores_explored: int = 0
+    complete: bool = True
+    violations: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.complete and not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": "pathway_tpu.pacecheck/v1",
+            "rows": self.config.rows,
+            "mutate": self.config.mutate,
+            "states": self.states,
+            "transitions": self.transitions,
+            "terminals": self.terminals,
+            "pauses_explored": self.pauses_explored,
+            "restores_explored": self.restores_explored,
+            "complete": self.complete,
+            "ok": self.ok,
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+    def to_json(self, **kw) -> str:
+        kw.setdefault("indent", 2)
+        return json.dumps(self.to_dict(), **kw)
+
+    def render(self) -> str:
+        c = self.config
+        lines = [
+            f"pacing verifier: {c.rows} row(s), watermarks "
+            f"{c.low_bytes}/{c.high_bytes} of budget {c.budget_bytes}, "
+            f"spike/crash/rescale budgets {c.spike_budget}/"
+            f"{c.crash_budget}/{c.rescale_budget}"
+            + (f", mutant {c.mutate!r}" if c.mutate else ""),
+            f"  explored {self.states} states / {self.transitions} "
+            f"transitions ({self.terminals} terminal(s), "
+            f"{self.pauses_explored} pause(s), "
+            f"{self.restores_explored} restore(s))"
+            + ("" if self.complete else " — INCOMPLETE (state cap hit)"),
+        ]
+        if not self.violations:
+            lines.append(
+                "  every interleaving drains: a paced source never blocks "
+                "the wave that unpauses it, every row is delivered exactly "
+                "once across pressure spikes, crash restores and rescales, "
+                "and the abort rung always resolves into a restore"
+            )
+        for v in self.violations:
+            lines.append(f"  VIOLATION [{v.kind}] {v.detail}")
+            for step in v.trace:
+                lines.append(f"    - {step['label']}")
+            plan = v.fault_plan()
+            if plan:
+                lines.append(
+                    "    replay: PATHWAY_FAULT_PLAN='"
+                    + json.dumps(plan, separators=(",", ":"))
+                    + "'"
+                )
+        return "\n".join(lines)
+
+
+class _PaceState(NamedTuple):
+    unread: int          # rows the source has not read yet
+    queued: int          # put on the engine queue, not yet drained
+    delivered: int       # drained into the graph (each row exactly once)
+    paused: bool         # the pace gate is cleared
+    ladder: str          # cached ladder verdict of the last sample
+    over_streak: int     # consecutive over-budget samples (abort input)
+    spikes_left: int
+    crashes_left: int
+    rescales_left: int
+    sample_hits: int     # governance samples so far (= fault-point hits)
+
+
+class _PaceProperty(Exception):
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind
+        self.detail = detail
+
+
+class _PaceModel:
+    def __init__(self, cfg: PaceCheckConfig, t: PaceTransitions):
+        self.cfg = cfg
+        self.t = t
+
+    def initial(self) -> _PaceState:
+        return _PaceState(
+            unread=self.cfg.rows,
+            queued=0,
+            delivered=0,
+            paused=False,
+            ladder="ok",
+            over_streak=0,
+            spikes_left=self.cfg.spike_budget,
+            crashes_left=self.cfg.crash_budget,
+            rescales_left=self.cfg.rescale_budget,
+            sample_hits=0,
+        )
+
+    def _restore(self, s: _PaceState) -> _PaceState:
+        """Epoch restore semantics: a FRESH per-run accountant (ladder
+        back to "ok", gate released — paced state is re-derived from
+        real post-restore bytes, not carried over) and the undrained
+        queue is re-read (those rows never reached the journal; drained
+        rows are covered by the cut and are not replayed)."""
+        return s._replace(
+            unread=s.unread + s.queued,
+            queued=0,
+            paused=False,
+            ladder="ok",
+            over_streak=0,
+        )
+
+    def _sample(self, s: _PaceState, injected: bool) -> _PaceState:
+        total = s.queued  # 1 queued row accounts 1 byte in the model
+        if injected:
+            # a caught mem.pressure raise reads as a synthetic
+            # at-high-watermark sample (internals/memory.py sample())
+            total = max(total, self.cfg.high_bytes)
+        ladder = self.t.mem_ladder(
+            total,
+            self.cfg.low_bytes,
+            self.cfg.high_bytes,
+            self.cfg.budget_bytes,
+            prev=s.ladder,
+            over_streak=s.over_streak,
+            abort_streak=self.cfg.abort_streak,
+        )
+        over = s.over_streak + 1 if total >= self.cfg.budget_bytes else 0
+        paused = s.paused
+        if not paused:
+            if self.t.pace_decide(ladder, s.queued, 0):
+                paused = True
+        elif self.t.pace_resume(ladder, s.queued, 0):
+            paused = False
+        return s._replace(
+            ladder=ladder,
+            over_streak=over,
+            paused=paused,
+            spikes_left=s.spikes_left - (1 if injected else 0),
+            sample_hits=s.sample_hits + 1,
+        )
+
+    def successors(self, s: _PaceState):
+        """[(label_step, next_state)] — every scheduler choice. No-op
+        governance samples are elided (they revisit the same state), so
+        a dead end IS a state where nothing can ever change again."""
+        out = []
+        if s.ladder == "abort":
+            # the sticky last rung: the epoch is aborting — the only
+            # continuation is the restore that re-derives everything
+            # (a missing successor here would be the silent-hang bug)
+            out.append(
+                (
+                    {"label": "epoch ABORT -> restore (ladder reset, "
+                              "gate released, undrained rows re-read)"},
+                    self._restore(s),
+                )
+            )
+            return out
+        if s.unread > 0 and not s.paused:
+            out.append(
+                (
+                    {"label": f"read (queued {s.queued} -> {s.queued + 1})"},
+                    s._replace(unread=s.unread - 1, queued=s.queued + 1),
+                )
+            )
+        if s.queued > 0:
+            # THE invariant under test: the main loop's drain is enabled
+            # whether or not the source is paced — the pacing signal
+            # shrinks without the paused subject thread advancing
+            out.append(
+                (
+                    {
+                        "label": "drain (engine accepts; queued "
+                        f"{s.queued} -> {s.queued - 1}"
+                        + (", source paced)" if s.paused else ")")
+                    },
+                    s._replace(
+                        queued=s.queued - 1, delivered=s.delivered + 1
+                    ),
+                )
+            )
+        ns = self._sample(s, injected=False)
+        if (ns.ladder, ns.paused, ns.over_streak) != (
+            s.ladder, s.paused, s.over_streak
+        ):
+            out.append(
+                (
+                    {
+                        "label": f"sample #{ns.sample_hits}: total "
+                        f"{s.queued} -> ladder {ns.ladder}"
+                        + (
+                            ", PAUSE" if ns.paused and not s.paused
+                            else ", resume" if s.paused and not ns.paused
+                            else ""
+                        ),
+                        "hit": ns.sample_hits,
+                    },
+                    ns,
+                )
+            )
+        if s.spikes_left > 0:
+            ns = self._sample(s, injected=True)
+            out.append(
+                (
+                    {
+                        "label": f"sample #{ns.sample_hits} under INJECTED "
+                        f"mem.pressure -> ladder {ns.ladder}"
+                        + (", PAUSE" if ns.paused and not s.paused else ""),
+                        "hit": ns.sample_hits,
+                        "action": "raise",
+                    },
+                    ns,
+                )
+            )
+        if s.crashes_left > 0:
+            out.append(
+                (
+                    {
+                        "label": "CRASH rank at next sample -> restore "
+                        "(fresh accountant, undrained rows re-read)",
+                        "hit": s.sample_hits + 1,
+                        "action": "crash",
+                    },
+                    self._restore(s)._replace(
+                        crashes_left=s.crashes_left - 1
+                    ),
+                )
+            )
+        if s.rescales_left > 0:
+            out.append(
+                (
+                    {
+                        "label": "RESCALE restore (world changes; paced "
+                        "state re-derived from post-restore bytes)",
+                        "rescale": True,
+                    },
+                    self._restore(s)._replace(
+                        rescales_left=s.rescales_left - 1
+                    ),
+                )
+            )
+        return out
+
+    def is_terminal(self, s: _PaceState) -> bool:
+        return s.unread == 0 and s.queued == 0
+
+    def check_terminal(self, s: _PaceState) -> None:
+        if s.delivered != self.cfg.rows:
+            raise _PaceProperty(
+                "exactly-once",
+                f"terminal state delivered {s.delivered} of "
+                f"{self.cfg.rows} row(s) — pacing/restore interleavings "
+                "must neither drop nor duplicate rows",
+            )
+
+
+def check_pacing(cfg: PaceCheckConfig | None = None) -> PaceCheckReport:
+    """Exhaustively explore the source-pacing governance loop. BFS over
+    all interleavings (reads × drains × governance samples × injected
+    pressure × crash/rescale restores) with full-state memoization —
+    BFS so a violation's trace is minimal by construction.
+
+    A dead end (non-terminal state with no successors) is the
+    pause/drain deadlock class: with no-op samples elided, "no
+    successors" literally means nothing in the system can ever change
+    again — the signature of a gate nobody will release."""
+    cfg = cfg or PaceCheckConfig()
+    t = get_pace_transitions(cfg.mutate)
+    model = _PaceModel(cfg, t)
+    report = PaceCheckReport(config=cfg)
+    root = model.initial()
+    seen = {root}
+    frontier: list[tuple[_PaceState, tuple]] = [(root, ())]
+    while frontier:
+        next_frontier = []
+        for state, trace in frontier:
+            report.states += 1
+            if report.states > cfg.max_states:
+                report.complete = False
+                return report
+            try:
+                if model.is_terminal(state):
+                    report.terminals += 1
+                    model.check_terminal(state)
+                    continue
+                succs = model.successors(state)
+            except _PaceProperty as p:
+                report.violations.append(
+                    PaceViolation(p.kind, p.detail, list(trace))
+                )
+                return report
+            if not succs:
+                report.violations.append(
+                    PaceViolation(
+                        "pace-deadlock",
+                        "non-terminal state with no possible action — a "
+                        "paced source is parked with nothing left that "
+                        "could ever release it (unread "
+                        f"{state.unread}, queued {state.queued}, ladder "
+                        f"{state.ladder!r}, paused {state.paused})",
+                        list(trace),
+                    )
+                )
+                return report
+            for step, ns in succs:
+                report.transitions += 1
+                if ns.paused and not state.paused:
+                    report.pauses_explored += 1
+                if step.get("action") == "crash" or step.get("rescale"):
+                    report.restores_explored += 1
+                if ns not in seen:
+                    seen.add(ns)
+                    next_frontier.append((ns, trace + (step,)))
+        frontier = next_frontier
+    return report

@@ -64,6 +64,23 @@ class _Connector:
         self._stall_episodes = 0
         self._flush_failures = 0
         self._flush_dead = False
+        # source pacing (ISSUE 19): the driver blocks emit() on the gate
+        # while cleared; the runtime's pacing pass drives it through the
+        # pure protocol transitions pace_decide/pace_resume. rows/bytes
+        # counters are single-writer monotonic pairs: _put moves on the
+        # subject thread (io/_connector.py account_put), _drained on the
+        # main loop as entries are accepted — the difference is the
+        # ENGINE-DRAINABLE queued backlog the pacing signal reads.
+        self.pausable = True
+        self.pace_gate = threading.Event()
+        self.pace_gate.set()
+        self.paused = False
+        self._paused_since: float | None = None
+        self.paused_seconds = 0.0
+        self.rows_put = 0
+        self.bytes_put = 0
+        self.rows_drained = 0
+        self.bytes_drained = 0
 
 
 class Runtime:
@@ -166,6 +183,15 @@ class Runtime:
         # clean-shutdown cut tags past it)
         self._txn_operator = False
         self._bsp_round_no = 0
+        # memory governance (internals/memory.py; ISSUE 19): the
+        # accountant is created per run in _start_monitoring (never for
+        # local_only throwaway runtimes — an inner iterate body must not
+        # clobber the owning runtime's installed accountant) and stepped
+        # by the pacing pass in _service_connector_health
+        self.memory = None
+        self._mem_store_probe_t = 0.0
+        self._mem_store_bytes = 0
+        self._mem_abort_reported = False
 
     # -- multi-process plane ----------------------------------------------
     @property
@@ -738,6 +764,15 @@ class Runtime:
         # readiness: inputs are closed, the pipeline is flushing its tail
         # — /healthz flips to draining so load balancers rotate away
         self.stats.set_health_state("draining")
+        if self.memory is not None:
+            # release any still-paced readers (their threads may outlive
+            # the loop as daemons) and retire this run's accountant
+            from pathway_tpu.internals import memory as _memory
+
+            for conn in self.connectors:
+                conn.pace_gate.set()
+            if _memory.current() is self.memory:
+                _memory.install(None)
         # stop the live dashboard first: its loop removes the log handler
         # and releases stderr (running it past the run garbles later runs)
         stop = getattr(self, "_dashboard_stop", None)
@@ -1261,6 +1296,17 @@ class Runtime:
         from pathway_tpu.internals.config import get_pathway_config
 
         c = get_pathway_config()
+        if not self.local_only:
+            # memory governance (ISSUE 19): fresh accountant per run —
+            # a restore/rollback therefore starts the ladder at "ok" and
+            # re-derives any paced state from real post-restore bytes
+            from pathway_tpu.internals import memory as _memory
+
+            self.memory = _memory.MemoryAccountant()
+            _memory.install(self.memory)
+            self.stats.set_mem_pressure(
+                self.memory.state, 0, 0, self.memory.budget_bytes, {}
+            )
         cluster_port = (
             self._cluster_metrics_port() if not self.local_only else None
         )
@@ -1518,6 +1564,7 @@ class Runtime:
                     self._uncovered.add(conn.name)
                 if deltas:
                     saw_data = True
+                    self._account_drain(conn, deltas)
                     t = self._next_time()
                     self.stats.on_ingest(conn.name, len(deltas))
                     self._note_ingest(t, conn)
@@ -2174,6 +2221,7 @@ class Runtime:
                     self._uncovered.add(conn.name)
                 if deltas:
                     saw_data = True
+                    self._account_drain(conn, deltas)
                     commits.append((conn, deltas))
             alldone = self._bsp_inject_commits(
                 pg, commits, active == 0, ("r", round_no)
@@ -2331,6 +2379,14 @@ class Runtime:
             timeout = conn.watchdog_timeout
             if timeout is None or conn.finished:
                 continue
+            if conn.paused:
+                # a deliberately paced subject is parked in emit() by the
+                # governor, not stalled — REFRESH the heartbeat rather
+                # than merely skipping the check, or the idle seconds
+                # accumulated while paced would trip the watchdog the
+                # instant the source resumes (ISSUE 19 satellite)
+                conn.last_activity = now
+                continue
             idle = now - conn.last_activity
             if idle > timeout:
                 if not conn._stalled:
@@ -2347,6 +2403,165 @@ class Runtime:
                     )
             else:
                 conn._stalled = False
+        self._service_memory(conns)
+
+    # -- memory governance / backpressure (ISSUE 19) -----------------------
+    # internals/memory.py holds the accountant; parallel/protocol.py the
+    # pure ladder + pacing transitions; analysis/meshcheck.py check_pacing
+    # proves the pause/resume loop below can never deadlock against the
+    # drain that unpauses it.
+
+    def _account_drain(self, conn, deltas) -> None:
+        """Main-loop side of the backlog counter pair: the batch left the
+        engine queue and entered the graph. Estimated from the SAME batch
+        object the subject thread accounted at put time, so the put/drain
+        difference is an exact queue-depth signal."""
+        if self.memory is None or not self.memory.enabled:
+            return
+        from pathway_tpu.io._connector import _batch_nbytes
+
+        conn.rows_drained += len(deltas)
+        conn.bytes_drained += _batch_nbytes(deltas)
+
+    def _probe_state_bytes(self) -> None:
+        """Slow-cadence (~2s) byte probes: native store walks (GIL-free
+        C traversals, but O(state)), capture staging and txn heaps. The
+        cheap per-pass signals (backlog counters, exchange queue depths)
+        are read every health pass instead."""
+        from pathway_tpu.engine.nodes import CaptureNode
+
+        store = 0
+        cap = 0
+        for node in self.scope.nodes:
+            ex = getattr(node, "_exec", None)
+            if ex is not None:
+                st = getattr(node, "_store", None)
+                if st is not None:
+                    try:
+                        store += ex.store_nbytes(st)
+                    except Exception:
+                        pass
+                jst = getattr(node, "_jstore", None)
+                if jst is not None:
+                    try:
+                        store += ex.join_store_nbytes(jst)
+                    except Exception:
+                        pass
+            if isinstance(node, CaptureNode) and node._pending:
+                # columnar chunks buffered C-owned; flat per-row estimate
+                # (rows * 64) — exact expansion would defeat the point of
+                # deferring it
+                for chunk in node._pending:
+                    try:
+                        cap += len(chunk[0]) * 64
+                    except Exception:
+                        cap += 1024
+        txn = 0
+        for sink in self.scope.txn_sinks:
+            try:
+                txn += sink.heap_nbytes()
+            except Exception:
+                pass
+        acct = self.memory
+        acct.set_component("store", store)
+        acct.set_component("capture_pending", cap)
+        acct.set_component("txn_staging", txn)
+
+    def _service_memory(self, conns) -> None:
+        """One governance cadence: refresh component bytes, take an
+        accounting sample (the ``mem.pressure`` fault point), publish the
+        gauges, and drive each pausable connector's gate through the
+        BOUND pace transitions. Engine-drainable by construction: the
+        pacing signal is the put/drain counter difference, which the main
+        loop shrinks without the paused subject thread advancing."""
+        acct = self.memory
+        if acct is None or not acct.enabled:
+            return
+        backlog_bytes = 0
+        backlog_rows_total = 0
+        for conn in self.connectors:
+            backlog_bytes += max(0, conn.bytes_put - conn.bytes_drained)
+            backlog_rows_total += max(0, conn.rows_put - conn.rows_drained)
+        acct.set_component("connector_backlog", backlog_bytes)
+        pg = self._procgroup
+        if pg is not None:
+            try:
+                send_b, recv_b = pg.queued_exchange_bytes()
+                acct.set_component("exchange_send", send_b)
+                acct.set_component("exchange_recv", recv_b)
+            except Exception:
+                pass
+        now = _time.monotonic()
+        if now - self._mem_store_probe_t >= 2.0:
+            self._mem_store_probe_t = now
+            self._probe_state_bytes()
+        state = acct.sample()
+        self.stats.set_mem_pressure(
+            state,
+            acct.total_bytes,
+            acct.peak_bytes,
+            acct.budget_bytes,
+            acct.components(),
+            acct.pressure_injections,
+        )
+        for conn in conns:
+            if not conn.pausable:
+                continue
+            if conn.finished:
+                if conn.paused:
+                    # the source completed while paced (its final rows
+                    # were already queued before the gate cleared) —
+                    # close the episode so the gauges read honest
+                    conn.paused = False
+                    conn.pace_gate.set()
+                    since = conn._paused_since
+                    seconds = (
+                        0.0 if since is None else max(0.0, now - since)
+                    )
+                    conn._paused_since = None
+                    conn.paused_seconds += seconds
+                    self.stats.on_connector_resumed(conn.name, seconds)
+                continue
+            qrows = max(0, conn.rows_put - conn.rows_drained)
+            if not conn.paused:
+                if acct._pace_decide(state, qrows, 0):
+                    conn.paused = True
+                    conn._paused_since = now
+                    conn.pace_gate.clear()
+                    self.stats.on_connector_paused(conn.name)
+            else:
+                # charge the elapsed slice every pass so the
+                # paused-seconds counter moves WHILE the episode is open
+                since = conn._paused_since
+                seconds = 0.0 if since is None else max(0.0, now - since)
+                conn._paused_since = now
+                conn.paused_seconds += seconds
+                if acct._pace_resume(state, qrows, 0):
+                    conn.paused = False
+                    conn.pace_gate.set()
+                    conn._paused_since = None
+                    self.stats.on_connector_resumed(conn.name, seconds)
+                else:
+                    self.stats.on_connector_paced(conn.name, seconds)
+        if state == "abort" and not self._mem_abort_reported:
+            # the ladder's last rung: an epoch abort through the standard
+            # engine-error path (distributed ranks die and the mesh
+            # recovery machinery rolls back to the last committed cut).
+            # Paced readers are released first so their daemon threads
+            # don't spin on a gate nobody will ever open again.
+            self._mem_abort_reported = True
+            for conn in self.connectors:
+                conn.pace_gate.set()
+            self.report_error(
+                RuntimeError(
+                    "memory budget exhausted: accounted bytes "
+                    f"({acct.total_bytes}) held at/above the budget "
+                    f"({acct.budget_bytes}) for {acct.over_streak} "
+                    "consecutive samples with ingest already paced and "
+                    "serving browned out — aborting the epoch "
+                    "(PATHWAY_MEM_BUDGET_MB)"
+                )
+            )
 
     def _release_uncovered(self, conn) -> None:
         """A finishing connector must not block operator snapshots for

@@ -127,6 +127,17 @@ PASSTHROUGH_FAMILIES = (
     "mesh_rank_restarts_total",
     "mesh_rollbacks_total",
     "mesh_last_committed_epoch",
+    # backpressure plane (ISSUE 19): which rank is under memory
+    # pressure, how deep into its budget, and which connectors are
+    # paced — the engage/release story the backpressure lane watches
+    "mem_pressure_state",
+    "mem_total_bytes",
+    "mem_peak_bytes",
+    "mem_budget_bytes",
+    "mem_pressure_injections_total",
+    "mem_component_bytes",
+    "connector_paused",
+    "connector_paused_seconds_total",
 )
 
 

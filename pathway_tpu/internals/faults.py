@@ -84,6 +84,18 @@ Injection points threaded through the hot paths:
                                     every peer's recv-wait points at it)
                                     and ``phase="step"`` (once per engine
                                     timestamp step — a compute-side drag)
+    mem.pressure                    per memory-accountant sample
+                                    (internals/memory.py sample(), phase
+                                    ``sample``): a ``raise`` here is
+                                    CAUGHT by the accountant and read as
+                                    a synthetic over-high-watermark
+                                    sample — the ladder steps up at
+                                    exactly the listed hits, which is
+                                    how the pacing checker's traces and
+                                    the ``fault_matrix --pressure`` grid
+                                    replay pressure episodes
+                                    deterministically; ``crash`` kills
+                                    the rank mid-pressure as usual
 
 A *plan* is a schedule of rules. Each rule names a point, when it fires —
 explicit 1-based ``hits``, a modular ``every``, or a seeded probability
@@ -155,6 +167,7 @@ POINTS = (
     "device.oom",
     "device.snapshot",
     "device.restore",
+    "mem.pressure",
 )
 
 _ACTIONS = ("raise", "crash", "delay")

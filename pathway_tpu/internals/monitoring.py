@@ -38,6 +38,10 @@ class ConnectorStats:
     errors: int = 0
     stalls: int = 0
     degraded: int = 0
+    # source pacing (ISSUE 19): currently gated by the memory ladder, and
+    # cumulative seconds this connector's reader has spent paced
+    paused: bool = False
+    paused_seconds: float = 0.0
     # rolling (timestamp, n_rows) window for the last-minute column
     recent: list = field(default_factory=list)
 
@@ -339,6 +343,18 @@ class ProberStats:
     # filter predicates that raised during index search (ISSUE 17
     # satellite: previously swallowed, silently dropping matching rows)
     index_filter_errors: int = 0
+    # memory governance / backpressure (ISSUE 19; internals/memory.py):
+    # degradation-ladder state (ok/pacing/brownout/abort), accounted
+    # totals against the budget, and the per-component byte breakdown
+    # (bounded cardinality: memory.COMPONENTS). budget == 0 renders the
+    # gauges anyway so "governance off" is scrapeable, not invisible.
+    mem_state: str = "ok"
+    mem_total_bytes: int = 0
+    mem_peak_bytes: int = 0
+    mem_budget_bytes: int = 0
+    mem_components: dict = field(default_factory=dict)
+    # mem.pressure fault injections observed by the accountant (counter)
+    mem_pressure_injections: int = 0
 
     def on_node_step(
         self, label: str, self_s: float, rows: int, nb: bool
@@ -485,6 +501,43 @@ class ProberStats:
     def on_connector_degraded(self, name: str) -> None:
         st = self.connectors.setdefault(name, ConnectorStats(name=name))
         st.degraded += 1
+
+    # -- memory governance / backpressure (ISSUE 19) -----------------------
+
+    def set_mem_pressure(
+        self,
+        state: str,
+        total: int,
+        peak: int,
+        budget: int,
+        components: dict,
+        injections: int = 0,
+    ) -> None:
+        """Gauge snapshot from the memory accountant's latest sample
+        (engine/runtime.py _service_memory)."""
+        self.mem_state = state
+        self.mem_total_bytes = int(total)
+        self.mem_peak_bytes = int(peak)
+        self.mem_budget_bytes = int(budget)
+        self.mem_components = dict(components)
+        self.mem_pressure_injections = int(injections)
+
+    def on_connector_paused(self, name: str) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.paused = True
+
+    def on_connector_paced(self, name: str, seconds: float) -> None:
+        """Accrue paced wall seconds for a STILL-paused connector — the
+        governor charges each health pass's slice as it elapses, so the
+        counter is live while the pause is in progress (the smoke lane
+        watches it move on /metrics/cluster mid-episode)."""
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.paused_seconds += max(0.0, seconds)
+
+    def on_connector_resumed(self, name: str, seconds: float) -> None:
+        st = self.connectors.setdefault(name, ConnectorStats(name=name))
+        st.paused = False
+        st.paused_seconds += max(0.0, seconds)
 
     def on_output(self, n_rows: int) -> None:
         self.outputs_emitted += n_rows
@@ -683,6 +736,22 @@ class ProberStats:
                 lines.append(
                     f'{metric}{{connector="{st.name}"}} {getattr(st, attr)}'
                 )
+        # source pacing (ISSUE 19): seconds each connector's reader spent
+        # paced by the memory governor (a CURRENTLY paused connector's
+        # open episode is included so the smoke can observe engagement
+        # live), plus the live gate state as a 0/1 gauge
+        lines.append("# TYPE connector_paused_seconds_total counter")
+        for st in self.connectors.values():
+            lines.append(
+                f'connector_paused_seconds_total{{connector="{st.name}"}} '
+                f"{st.paused_seconds:.6f}"
+            )
+        lines.append("# TYPE connector_paused gauge")
+        for st in self.connectors.values():
+            lines.append(
+                f'connector_paused{{connector="{st.name}"}} '
+                f"{int(st.paused)}"
+            )
         lines.append("# TYPE output_rows_total counter")
         lines.append(f"output_rows_total {self.outputs_emitted}")
         for metric, val in (
@@ -885,6 +954,35 @@ class ProberStats:
                     lines.append(
                         f'{metric}{{site="{site}"}} {per_site[site]}'
                     )
+        # memory governance (ISSUE 19): rendered ALWAYS — budget 0 reads
+        # as "governance off", not as a missing family. State is encoded
+        # by its rung index on the protocol ladder (0 ok, 1 pacing,
+        # 2 brownout, 3 abort) so dashboards can alert on >= 1.
+        from pathway_tpu.parallel.protocol import MEM_LADDER
+
+        try:
+            mem_state_n = MEM_LADDER.index(self.mem_state)
+        except ValueError:
+            mem_state_n = 0
+        for metric, val in (
+            ("mem_pressure_state", mem_state_n),
+            ("mem_total_bytes", self.mem_total_bytes),
+            ("mem_peak_bytes", self.mem_peak_bytes),
+            ("mem_budget_bytes", self.mem_budget_bytes),
+        ):
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {val}")
+        lines.append("# TYPE mem_pressure_injections_total counter")
+        lines.append(
+            f"mem_pressure_injections_total {self.mem_pressure_injections}"
+        )
+        if self.mem_components:
+            lines.append("# TYPE mem_component_bytes gauge")
+            for comp in sorted(self.mem_components):
+                lines.append(
+                    f'mem_component_bytes{{component="{comp}"}} '
+                    f"{self.mem_components[comp]}"
+                )
         if self.nodes:
             for metric, idx, fmt in (
                 ("node_self_seconds_total", 0, "{:.6f}"),
@@ -1052,6 +1150,11 @@ def render_dashboard(stats: ProberStats, graveyard=None):
         health = "ok" if not issues else (
             f"r{st.restarts} e{st.errors} s{st.stalls} d{st.degraded}"
         )
+        if st.paused:
+            # memory governor has this source's reader gated (ISSUE 19)
+            health = f"paced {st.paused_seconds:.0f}s | {health}"
+        elif st.paused_seconds > 0:
+            health = f"paced∑{st.paused_seconds:.0f}s | {health}"
         conn.add_row(
             st.name,
             "finished" if st.finished else str(st.last_minibatch),
@@ -1137,6 +1240,17 @@ def render_dashboard(stats: ProberStats, graveyard=None):
                 f"{stats.device_hbm_live // 2**20}"
                 f"/{stats.device_hbm_peak // 2**20}",
             )
+    # memory governance (ISSUE 19): ladder state + accounted bytes vs the
+    # budget — "is backpressure engaged and how close to the ceiling" at
+    # a glance. Shown only when a budget is set (governance on).
+    if stats.mem_budget_bytes:
+        pipe.add_row(
+            "memory ladder",
+            f"{stats.mem_state} "
+            f"({stats.mem_total_bytes // 2**20}"
+            f"/{stats.mem_budget_bytes // 2**20} MB, "
+            f"peak {stats.mem_peak_bytes // 2**20})",
+        )
     # device fault domain (ISSUE 17): retries/failures/watchdog/OOM at
     # a glance — shown whenever supervision recorded anything
     retries = sum(stats.device_dispatch_retries.values())

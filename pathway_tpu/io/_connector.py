@@ -72,8 +72,44 @@ from pathway_tpu.internals import faults as _faults
 
 # uncommitted-row backlog above which a stateful subject's rows are
 # journaled without a scan state (degrading recovery to at-least-once)
-# rather than growing host memory without bound
+# rather than growing host memory without bound. With memory governance
+# enabled (PATHWAY_MEM_BUDGET_MB; internals/memory.py) a PAUSABLE
+# subject never reaches this degradation: the runtime's pacing pass
+# stops the reader at the byte watermarks first (ISSUE 19), so the cap
+# only fires for non-pausable subjects — and is error-logged + counted
+# when it does.
 _BACKLOG_CAP = 1_000_000
+
+
+def _governed() -> bool:
+    """Whether the memory-governance ladder is active for this runtime
+    (an accountant is installed AND a budget is configured)."""
+    from pathway_tpu.internals import memory as _memory
+
+    acct = _memory.current()
+    return acct is not None and acct.enabled
+
+
+def _batch_nbytes(batch) -> int:
+    """Cheap byte estimate for one forwarded batch: sample a few rows
+    (``internals/memory.py approx_nbytes``) and extrapolate — the
+    accountant steps watermarks off this, it does not bill."""
+    from pathway_tpu.internals import memory as _memory
+
+    try:
+        n = len(batch)
+    except TypeError:
+        return 1024
+    if n == 0:
+        return 0
+    sampled = 0
+    taken = 0
+    for row in batch:
+        sampled += _memory.approx_nbytes(row)
+        taken += 1
+        if taken >= 8:
+            break
+    return (sampled // max(1, taken)) * n
 
 
 class SupervisorPolicy:
@@ -301,6 +337,55 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
         getattr(conn, "watchdog_timeout", None) is not None
         or policy.heartbeat_timeout_s is not None
     )
+    # -- source pacing (ISSUE 19) -----------------------------------------
+    # Pausable subjects stop READING under memory pressure instead of
+    # degrading journal guarantees: the runtime's pacing pass
+    # (engine/runtime.py _service_connector_health) clears/sets the gate
+    # off the pure protocol transitions pace_decide/pace_resume, and
+    # emit() blocks on it BEFORE queueing the row. The REST gateway's
+    # _ephemeral subject is never paused (its rows are live requests the
+    # serving frontend already governs with admission + Retry-After);
+    # subjects may opt out explicitly with ``_pausable = False``.
+    pausable = not getattr(subject, "_ephemeral", False) and getattr(
+        subject, "_pausable", True
+    )
+    conn.pausable = pausable
+    gate = getattr(conn, "pace_gate", None)
+    if gate is None:
+        gate = conn.pace_gate = threading.Event()
+        gate.set()  # running; the pacing pass clears it to pause
+    governed = _governed()
+    # put-side self-pacing: the engine's pacing pass runs once per loop
+    # iteration, and one iteration can step for seconds — an unthrottled
+    # in-process source could queue tens of MB between two verdicts. So
+    # the SUBJECT thread also consults the same bound transitions on its
+    # own emit path: once its queued-but-undrained bytes cross the high
+    # watermark it parks until the main loop drains back under the low
+    # one (the transitions compare magnitudes and are unit-agnostic —
+    # bytes here, rows in the engine pass). Same deadlock-freedom
+    # argument: the signal shrinks on the main loop only.
+    _acct = None
+    if governed and pausable:
+        from pathway_tpu.internals import memory as _memory
+
+        _acct = _memory.current()
+
+    def account_put(batch) -> None:
+        # ENGINE-DRAINABLE backlog accounting (the pacing signal): rows/
+        # bytes put on the out queue, matched by rows/bytes_drained on
+        # the runtime side as the main loop accepts the entries. Two
+        # monotonic single-writer counters per axis — no lock, no race —
+        # and both sides estimate from the SAME batch object, so the
+        # difference is exactly the queued entries. The journal ledger
+        # is deliberately NOT a pacing input: it only drains at subject
+        # commit boundaries, and a paused subject can never reach one —
+        # pacing on it would be the self-deadlock check_pacing rules out.
+        if governed and batch:
+            conn.rows_put = getattr(conn, "rows_put", 0) + len(batch)
+            conn.bytes_put = (
+                getattr(conn, "bytes_put", 0) + _batch_nbytes(batch)
+            )
+
     # track the forwarded-but-unclaimed backlog whenever anyone needs it:
     # persistence (journal it at the next boundary) or the supervisor
     # (negate it before a non-upsert rescan). Kept at BATCH granularity —
@@ -421,9 +506,19 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                 # (journal replay + rescan re-emitting the same keys)
                 unjournaled.append(batch)
                 backlog_rows += len(batch)
-                if backlog_rows > _BACKLOG_CAP:
-                    # subject never commits: journal stateless (at-least-once
-                    # for this span) rather than grow host memory unboundedly
+                # Overload routes through pacing FIRST (ISSUE 19): with
+                # memory governance active, a pausable subject that has
+                # shown a commit boundary never takes the at-least-once
+                # escape — its ledger is bounded by its commit cadence
+                # and its byte pressure by the pacing watermarks. A
+                # subject that never commits is non-pausable in the only
+                # sense that matters here (pausing it could never
+                # resume), so the cap remains its bounded-memory escape
+                # — error-logged and counted, no longer silent.
+                paceable = pausable and governed and boundary_seq > 0
+                if backlog_rows > _BACKLOG_CAP and not paceable:
+                    # journal stateless (at-least-once for this span)
+                    # rather than grow host memory without bound
                     msg = (
                         f"connector {conn_name} emitted "
                         f"{backlog_rows} rows without a commit() "
@@ -435,13 +530,14 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                         warned_backlog = True
                         import logging
 
-                        logging.getLogger(__name__).warning(msg)
+                        logging.getLogger(__name__).error(msg)
                     if runtime is not None:
                         report = getattr(
                             runtime, "report_connector_degraded", None
                         )
                         if report is not None:
                             report(conn_name, msg)
+                    account_put(batch)
                     if persisting:
                         out_queue.put((conn, batch, None, ledger_rows()))
                     else:
@@ -449,12 +545,15 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                     unjournaled.clear()
                     backlog_rows = 0
                 else:
+                    account_put(batch)
                     out_queue.put((conn, batch, None, []))
             elif has_state:
                 # nothing journals and restart needs no ledger (no
                 # persistence + upsert-idempotent or unseekable subject)
+                account_put(batch)
                 out_queue.put((conn, batch, None, []))
             else:
+                account_put(batch)
                 out_queue.put((conn, batch, None, jrows_of(batch)))
 
     def commit_flush() -> None:
@@ -503,6 +602,7 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                         if track_backlog:
                             unjournaled.append(batch)
                             backlog_rows += len(batch)
+                        account_put(batch)
                         out_queue.put((conn, batch, None, []))
                     raise
                 last_published_state = state
@@ -510,8 +610,10 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                 unjournaled.clear()
                 backlog_rows = 0
                 forwarded_since_boundary = 0
+                account_put(batch)
                 out_queue.put((conn, batch, state, journal_rows))
             elif batch:
+                account_put(batch)
                 out_queue.put((conn, batch, None, jrows_of(batch)))
 
     def emit(message: Any) -> None:
@@ -521,6 +623,31 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
         # move only at explicit subject.commit() boundaries.
         if _fp:
             _fp("connector.read")
+        if pausable and not gate.is_set():
+            # paced (ISSUE 19): stop READING here, before the row is
+            # queued, until the runtime's pacing pass releases the gate
+            # off pace_resume. Heartbeats keep flowing so the paced wait
+            # is visibly alive; the watchdog additionally exempts paused
+            # connectors from the stall verdict (conn.paused).
+            while not gate.wait(0.2):
+                heartbeat()
+        if _acct is not None and _acct._pace_decide(
+            _acct.state,
+            conn.bytes_put - conn.bytes_drained,
+            _acct.high_bytes,
+        ):
+            # self-paced: own out-queue bytes crossed the high watermark
+            # (or the ladder already left "ok") — park before reading
+            # more, resume under the low watermark for hysteresis
+            while not _acct._pace_resume(
+                _acct.state,
+                conn.bytes_put - conn.bytes_drained,
+                _acct.low_bytes,
+            ):
+                if _memory.current() is not _acct:
+                    break  # run over — the accountant was retired
+                heartbeat()
+                _time.sleep(0.05)
         pending.append(message)
         if duration_ms is not None:
             now = _time.monotonic()
@@ -560,6 +687,7 @@ def _run_supervised(conn, out_queue: "queue.Queue") -> None:
                 ]
                 if comp:
                     _stamp(conn)
+                    account_put(comp)
                     out_queue.put((conn, comp, None, []))
                 # engine rolled back to the boundary: the ledger restarts
                 # empty, matching it

@@ -49,6 +49,7 @@ from typing import Any, Sequence
 
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import faults as _faults
+from pathway_tpu.internals import memory as _memory
 from pathway_tpu.internals.device import PLANE as _DEVICE
 from pathway_tpu.internals.api import Json, Pointer, ref_scalar
 from pathway_tpu.internals.monitoring import ServeMetrics
@@ -430,6 +431,13 @@ class RestServerSubject(ConnectorSubject):
         # rolling (t, n) response counts — the observed service rate that
         # sizes Retry-After when admission sheds
         self._recent_done: list[tuple[float, int]] = []
+        # EWMA of the response drain rate (responses/s) — the honest
+        # denominator for pace_retry_after when the memory ladder sheds
+        # (ISSUE 19): the 10 s rolling qps reads near-zero exactly when
+        # the governor has been throttling, which would tell clients to
+        # come back immediately into a pressured engine
+        self._done_rate_ewma = 0.0
+        self._done_rate_t: float | None = None
         self._dispatchers: list[threading.Thread] = []
         self._gateway_up = False
         # device OOM -> serving brownout (ISSUE 17): an HBM-growth
@@ -650,10 +658,21 @@ class RestServerSubject(ConnectorSubject):
         # failures / deadline breaches opened it — answer DEGRADED from
         # the last committed snapshot (no update-fold, no device
         # dispatch) instead of shedding when brownout is on; cooldown
-        # half-opens it so one probe window can close it again
-        if self.breaker_threshold > 0:
-            breaker = self._breaker_now()
-            if breaker == "open":
+        # half-opens it so one probe window can close it again.
+        # The memory-governance ladder (ISSUE 19) feeds the same path:
+        # at "brownout"/"abort" the runtime is shedding load to stay
+        # inside its budget, so serving answers degraded (or sheds with
+        # a drain-rate-honest Retry-After) instead of queuing new work
+        # into a pressured engine.
+        mem_state = _memory.ladder_state()
+        mem_degraded = mem_state in ("brownout", "abort")
+        if self.breaker_threshold > 0 or mem_degraded:
+            breaker = (
+                self._breaker_now()
+                if self.breaker_threshold > 0
+                else "closed"
+            )
+            if breaker == "open" or mem_degraded:
                 if self.brownout_enabled and self.brownout_answer is not None:
                     try:
                         result = await asyncio.get_event_loop()\
@@ -665,7 +684,9 @@ class RestServerSubject(ConnectorSubject):
                             {"error": f"brownout answer failed: {exc}"},
                             status=503,
                             headers={
-                                "Retry-After": str(self._retry_after_s())
+                                "Retry-After": str(
+                                    self._retry_after_s(mem_state)
+                                )
                             },
                         )
                     metrics.on_brownout()
@@ -674,11 +695,19 @@ class RestServerSubject(ConnectorSubject):
                     )
                 metrics.on_shed()
                 return web.json_response(
-                    {"error": "device dispatch degraded, retry later"},
+                    {"error": (
+                        "memory pressure, retry later"
+                        if mem_degraded
+                        else "device dispatch degraded, retry later"
+                    )},
                     status=503,
                     headers={
                         "Retry-After": str(
-                            _proto.serve_retry_after(self.breaker_cooldown_s)
+                            self._retry_after_s(mem_state)
+                            if mem_degraded
+                            else _proto.serve_retry_after(
+                                self.breaker_cooldown_s
+                            )
                         )
                     },
                 )
@@ -690,7 +719,7 @@ class RestServerSubject(ConnectorSubject):
             return web.json_response(
                 {"error": "overloaded, retry later"},
                 status=503,
-                headers={"Retry-After": str(self._retry_after_s())},
+                headers={"Retry-After": str(self._retry_after_s(mem_state))},
             )
         # the epoch-survivable frontend stamps its own request id so a
         # request REPLAYED into epoch+1 keys the same dataflow row — an
@@ -748,15 +777,28 @@ class RestServerSubject(ConnectorSubject):
             )
         return web.json_response(result)
 
-    def _retry_after_s(self) -> int:
+    def _retry_after_s(self, mem_state: str = "ok") -> int:
         """Seconds until the current backlog drains at the observed
-        service rate — the Retry-After a shed client should honor."""
+        service rate — the Retry-After a shed client should honor.
+        During a memory-ladder episode (``pacing``/``brownout``/
+        ``abort``) the horizon comes from the SAME ``pace_retry_after``
+        transition the pacing model checks: in-flight backlog over the
+        EWMA drain rate — honest exactly when the rolling qps reads
+        near-zero because the governor has been throttling."""
         now = _time.monotonic()
         with self._lock:  # _resolve_batch appends from the engine thread
             self._recent_done = [
                 (t, n) for t, n in self._recent_done if now - t <= 10.0
             ]
             qps = sum(n for _, n in self._recent_done) / 10.0
+            ewma = self._done_rate_ewma
+        if mem_state not in ("", "ok"):
+            return max(
+                1,
+                math.ceil(
+                    _proto.pace_retry_after(max(self._inflight, 1), ewma)
+                ),
+            )
         if qps <= 0:
             return 1
         return max(1, min(60, math.ceil(self._inflight / qps)))
@@ -902,8 +944,14 @@ class RestServerSubject(ConnectorSubject):
                     with self._removals_lock:
                         self._removals.append((key, values))
         with self._lock:  # _retry_after_s prunes from the event loop
-            self._recent_done.append((_time.monotonic(), len(resolved)))
+            now = _time.monotonic()
+            self._recent_done.append((now, len(resolved)))
             del self._recent_done[:-256]
+            if self._done_rate_t is not None:
+                dt_s = max(now - self._done_rate_t, 1e-3)
+                inst = len(resolved) / dt_s
+                self._done_rate_ewma += 0.3 * (inst - self._done_rate_ewma)
+            self._done_rate_t = now
         if loop is not None and futures:
             def _set():
                 for future, result in futures:

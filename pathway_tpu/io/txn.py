@@ -455,6 +455,13 @@ class TxnFileSink(TransactionalSink):
             self._stats.on_sink_staged(self.name)
             self._note_lag()
 
+    def heap_nbytes(self) -> int:
+        """Bytes of encoded-but-unsealed rows buffered in memory — the
+        memory accountant's ``txn_staging`` component (ISSUE 19).
+        Sealed/staged units live on DISK and are deliberately not
+        counted: the watermark ladder governs heap, not the lake."""
+        return sum(len(b) for b in self._buf)
+
     def precommit(self, tag: int) -> None:
         """Freeze the staged set under the cut's tag BEFORE the marker
         moves: one atomic directory rename (open -> t{tag}). Runs on

@@ -585,6 +585,11 @@ class ProcessGroup:
             self._sendq_cap = 8 if cores >= 2 * local_ranks else 0
         self._sendqs: dict[int, "queue.Queue"] = {}
         self._send_threads: list[threading.Thread] = []
+        # EWMA of encoded wire-frame size, feeding the memory
+        # accountant's exchange components (ISSUE 19): queued frames are
+        # un-encoded tuples, so queued bytes are estimated as
+        # items x EWMA rather than paying an encode ahead of the sender
+        self._frame_bytes_ewma = 4096.0
         # set AFTER close() enqueued every stop item: sender threads may
         # exit on an idle timeout only once this is set, so a stop (and
         # its goodbye) can never race past an exiting thread
@@ -1269,6 +1274,9 @@ class ProcessGroup:
             [_V2_MAGIC, _V2_HEAD.pack(len(head), crc), head, *blobs]
         )
         self._send_payload(peer, payload)
+        self._frame_bytes_ewma += 0.2 * (
+            len(payload) - self._frame_bytes_ewma
+        )
         stats = self.stats
         if stats is not None:
             stats.on_exchange_frame(len(payload), peer)
@@ -1544,6 +1552,19 @@ class ProcessGroup:
                 except queue.Empty:
                     break
         return n
+
+    def queued_exchange_bytes(self) -> tuple[int, int]:
+        """(send_bytes, recv_bytes) estimates for the memory accountant
+        (internals/memory.py; ISSUE 19): queued items x the EWMA wire-
+        frame size. Send items sit un-encoded in the per-peer sender
+        queues (exact bytes would cost an encode ahead of the sender
+        thread) and recv items are already-decoded frames, so both sides
+        use the same estimate — the watermark ladder needs a drainable
+        signal, not a bill."""
+        avg = self._frame_bytes_ewma
+        send_items = sum(q.qsize() for q in self._sendqs.values())
+        recv_items = sum(q.qsize() for q in self._queues.values())
+        return int(send_items * avg), int(recv_items * avg)
 
     def close(self, goodbye: bool = True) -> None:
         """``goodbye=False`` is the failure-path close (runtime epoch

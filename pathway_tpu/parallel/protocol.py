@@ -711,6 +711,131 @@ def autoscale_decide(
     return ("hold", world)
 
 
+# -- memory governance / backpressure (internals/memory.py,
+# engine/runtime.py _service_connector_health; ISSUE 19) --------------------
+# The host-plane degradation ladder and the source-pacing decisions it
+# drives. The memory accountant samples per-component bytes, steps the
+# ladder with ``mem_ladder``, and the runtime's connector-health pass
+# engages/releases connector pause gates with ``pace_decide`` /
+# ``pace_resume``. The pacing model checker
+# (``analysis/meshcheck.py check_pacing``) explores the SAME functions,
+# which is what makes "a paced source never blocks the wave that would
+# unpause it" a checked property instead of a comment.
+#
+# The deadlock-freedom invariant lives in the SIGNATURES: pause and
+# resume depend only on the ladder state (driven by total accounted
+# bytes, which the engine drains regardless of paused subject threads)
+# and on the engine-visible backlog — never on anything only the paused
+# subject thread itself could advance (e.g. reaching its next commit()
+# boundary). A resume condition gated on the subject's own progress is
+# exactly the pause/drain deadlock the checker exists to rule out.
+
+MEM_LADDER: tuple[str, ...] = ("ok", "pacing", "brownout", "abort")
+
+
+def mem_ladder(
+    total_bytes: int,
+    low_bytes: int,
+    high_bytes: int,
+    budget_bytes: int,
+    prev: str = "ok",
+    over_streak: int = 0,
+    abort_streak: int = 4,
+) -> str:
+    """One degradation-ladder step: ``"ok"`` | ``"pacing"`` |
+    ``"brownout"`` | ``"abort"``.
+
+    ``total_bytes`` is the accountant's summed component bytes;
+    ``low_bytes < high_bytes <= budget_bytes`` are the resolved
+    watermarks (``PATHWAY_MEM_BUDGET_MB`` scaled by ``PATHWAY_MEM_LOW``
+    / ``PATHWAY_MEM_HIGH``).
+    Semantics:
+
+    * ``budget_bytes <= 0`` — governance disabled, always ``"ok"``
+      (the legacy, un-governed behavior is preserved bit-for-bit);
+    * at/above the budget for ``abort_streak`` consecutive samples →
+      ``"abort"`` (epoch abort is the LAST resort: pacing + brownout
+      had their chance to shed load first); a shorter excursion above
+      the budget browns out serving immediately;
+    * at/above ``high_bytes`` → ``"pacing"`` (or stays ``"brownout"``
+      if already there — recovery walks DOWN the ladder one rung at a
+      time, never teleports);
+    * between the watermarks → hysteresis: a climbing system
+      (``prev == "ok"``) stays ``"ok"``, a draining one stays at its
+      rung until it crosses ``low_bytes`` — flapping pause/resume on a
+      noisy signal is worse than either steady state;
+    * ``"abort"`` is sticky: once the ladder decides the epoch must
+      roll back, only the post-restore reset (a fresh accountant)
+      clears it.
+
+    Total over every input — no sample is ever left undecided."""
+    if budget_bytes <= 0:
+        return "ok"
+    if prev == "abort":
+        return "abort"
+    if total_bytes >= budget_bytes:
+        return "abort" if over_streak + 1 >= abort_streak else "brownout"
+    if total_bytes >= high_bytes:
+        return "brownout" if prev == "brownout" else "pacing"
+    if total_bytes > low_bytes:
+        return "ok" if prev == "ok" else prev
+    return "ok"
+
+
+def pace_decide(ladder_state: str, backlog_rows: int = 0,
+                pause_rows: int = 0) -> bool:
+    """Whether a pausable connector subject should STOP reading: True
+    once the ladder leaves ``"ok"`` or (when a row-count pacing bound is
+    set) the subject's queued-but-undrained backlog — rows put on the
+    engine queue, not yet accepted by the main loop — reaches
+    ``pause_rows``. Pausing stops the reader at its next ``emit`` —
+    journal guarantees are untouched, which is the whole point: the
+    alternative (the ``_BACKLOG_CAP`` overflow path) silently weakens
+    delivery to at-least-once. Both inputs are engine-drainable: the
+    ladder drains as the accounted queues/stores drain, the queued
+    backlog drains as the main loop accepts entries — neither needs the
+    paused thread itself to advance (the journal ledger, which only a
+    subject commit can drain, is deliberately NOT an input here)."""
+    return ladder_state != "ok" or (
+        pause_rows > 0 and backlog_rows >= pause_rows
+    )
+
+
+def pace_resume(ladder_state: str, backlog_rows: int = 0,
+                resume_rows: int = 0) -> bool:
+    """Whether a paced subject may START reading again: only once the
+    ladder is back to ``"ok"`` AND (when row pacing is configured) the
+    backlog has drained to ``resume_rows`` — the release side of
+    ``pace_decide``'s hysteresis. The ``never_resume`` mutant pins the
+    liveness half: a pacing policy that can engage but not release
+    deadlocks the paced source, and ``check_pacing`` must surface that
+    as a minimal replayable trace, not a hung test."""
+    return ladder_state == "ok" and (
+        resume_rows <= 0 or backlog_rows <= resume_rows
+    )
+
+
+def pace_retry_after(
+    backlog: int,
+    drain_rate: float,
+    default_s: float = 1.0,
+    hi: float = 600.0,
+) -> float:
+    """Retry-After for 503s minted while the ladder is in
+    ``pacing``/``brownout``: the honest answer is "come back once the
+    backlog you are queueing behind has drained", i.e.
+    ``backlog / drain_rate`` with the observed EWMA drain rate — not
+    the instantaneous qps guess ``serve_retry_after`` uses for plain
+    overload. A dead drain (``drain_rate <= 0``) answers ``hi``:
+    claiming quick recovery while nothing drains is the dishonesty this
+    helper exists to remove. Clamped to ``[default_s, hi]``."""
+    if backlog <= 0:
+        return default_s
+    if drain_rate <= 0.0:
+        return hi
+    return max(default_s, min(hi, backlog / drain_rate))
+
+
 # -- the transition table ---------------------------------------------------
 # Single source of truth for the anti-drift pins: the engine modules
 # bind their protocol decisions FROM this table at import, and
@@ -746,4 +871,8 @@ TRANSITIONS: dict[str, object] = {
     "index_cut_decide": index_cut_decide,
     "index_restore_verdict": index_restore_verdict,
     "device_dispatch_decide": device_dispatch_decide,
+    "mem_ladder": mem_ladder,
+    "pace_decide": pace_decide,
+    "pace_resume": pace_resume,
+    "pace_retry_after": pace_retry_after,
 }

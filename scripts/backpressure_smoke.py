@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""CI backpressure lane (scripts/ci_lanes.sh lane 17; ISSUE 19
+acceptance cell).
+
+A REAL 2-process mesh: rank 0 runs an unpaced firehose source (fat
+8 KiB rows emitted as fast as the loop turns) while rank 1 — the sink
+rank the groupby hash-exchanges into — is throttled with a seeded
+``mesh.slow`` delay rule (no crash, no semantic change, just a slow
+consumer). Under ``PATHWAY_MEM_BUDGET_MB`` governance the accountant
+must pace the firehose at the watermarks instead of buffering the
+stream, and the lane pins the whole bounded-memory contract:
+
+1. **peak RSS stays under the budget** — every rank's ``ru_maxrss`` is
+   below ``PATHWAY_MEM_BUDGET_MB``, and the *accounted* peak stays in
+   the watermark band, far below the bytes the firehose produced
+   (the backlog never materialises in host memory);
+2. **bit-identical exactly-once** — the governed throttled run's
+   output equals an unthrottled ungoverned baseline of the same
+   pipeline, row for row, with ZERO drops and ZERO at-least-once
+   degradations on the pausable source (no ``at-least-once`` on any
+   rank's stderr);
+3. **pacing engage/release is observable LIVE on /metrics/cluster** —
+   while the mesh runs, the cluster view must show
+   ``mem_pressure_state`` leaving ``ok`` and
+   ``connector_paused{connector="firehose"}`` raised, and later the
+   release: paused back to 0 with ``connector_paused_seconds_total``
+   counting the closed episode.
+
+Exit 0 = green with a JSON summary line; any assertion prints the
+reason and exits 1. The pause/resume protocol itself is model-checked
+by ``python -m pathway_tpu.analysis --pace`` (mutant:
+``--pace-mutant never_resume``), and the crash grid runs via
+``python scripts/fault_matrix.py --pressure``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 2
+SLOW_RANK = 1
+DELAY_MS = 8
+N_ROWS = 2400
+PAD_BYTES = 8192
+BUDGET_MB = 384
+# fractions of the budget: the accounted watermark band sits a couple
+# of MiB up, far below the ~19 MiB the firehose produces — the run can
+# only fit by pacing, while the budget itself bounds whole-process RSS
+MEM_HIGH = "0.008"
+MEM_LOW = "0.004"
+
+RANK_PROGRAM = """
+import json, os, resource, sys, threading, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.internals import memory as _memory
+
+out_base, n_rows, pad_bytes = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rank = int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+out_path = f"{{out_base}}.r{{rank}}.json"
+meta_path = f"{{out_base}}.r{{rank}}.meta"
+PAD = "x" * pad_bytes
+
+
+class Firehose(pw.io.python.ConnectorSubject):
+    _deletions_enabled = False
+    _distributed_partitioned = True  # rank 0 owns the whole stream
+
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+
+    def run(self):
+        if rank != 0:
+            return
+        while self.pos < n_rows:
+            i = self.pos
+            self.next(k=i, v=i * 7, pad=PAD)
+            self.pos = i + 1
+            if self.pos % 16 == 0:
+                self.commit()
+
+    def snapshot_state(self):
+        return dict(pos=self.pos)
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+    pad: str
+
+
+rows = pw.io.python.read(
+    Firehose(), schema=S, autocommit_duration_ms=25, name="firehose"
+)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {{}}
+
+
+def on_change(key, row, time_, diff):
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+watch = dict(injections=0, peak=0, high=0, budget=0, paced=False)
+held = []  # first-seen accountant, kept past its uninstall in _finish
+stop = threading.Event()
+
+
+def _read(acct):
+    watch["injections"] = max(watch["injections"], acct.pressure_injections)
+    watch["peak"] = max(watch["peak"], acct.peak_bytes)
+    watch["high"] = acct.high_bytes
+    watch["budget"] = acct.budget_bytes
+    if acct.state != "ok":
+        watch["paced"] = True
+
+
+def _poll():
+    while not stop.is_set():
+        acct = _memory.current()
+        if acct is not None and acct.enabled:
+            if not held:
+                held.append(acct)
+            _read(acct)
+        time.sleep(0.002)
+
+
+poller = threading.Thread(target=_poll, daemon=True)
+poller.start()
+
+pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+stop.set()
+poller.join(timeout=2)
+if held:
+    # the run's LAST sample can land microseconds before the accountant
+    # is uninstalled — a final read off the held object cannot miss it
+    _read(held[0])
+watch["ru_maxrss_kb"] = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+tmp = meta_path + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(watch, f)
+os.replace(tmp, meta_path)
+"""
+
+
+def _free_port(n: int = 1) -> int:
+    for _ in range(50):
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        held = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + i))
+                held.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+    raise RuntimeError("no free port range found")
+
+
+def fail(msg: str) -> None:
+    print(f"backpressure_smoke: FAIL — {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def _get(url: str, timeout: float = 2.0) -> str | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except (OSError, urllib.error.URLError):
+        return None
+
+
+def _parse_samples(text: str) -> list[tuple[str, dict, float]]:
+    out = []
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        head, _, raw = line.rpartition(" ")
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        name, labels = head, {}
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            for part in rest.rstrip("}").split(","):
+                k, _, v = part.partition("=")
+                if k:
+                    labels[k.strip()] = v.strip().strip('"')
+        out.append((name, labels, value))
+    return out
+
+
+def _spawn(
+    td: str,
+    out_base: str,
+    *,
+    governed: bool,
+    plan: str | None,
+    cluster_port: int | None,
+) -> list[subprocess.Popen]:
+    prog = os.path.join(td, "firehose2.py")
+    if not os.path.exists(prog):
+        with open(prog, "w") as f:
+            f.write(RANK_PROGRAM.format(repo=REPO))
+    mesh_port = _free_port(WORLD)
+    procs = []
+    for rank in range(WORLD):
+        env = dict(os.environ)
+        env.update(
+            PATHWAY_PROCESSES=str(WORLD),
+            PATHWAY_PROCESS_ID=str(rank),
+            PATHWAY_FIRST_PORT=str(mesh_port),
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+        )
+        for knob in (
+            "PATHWAY_LANE_PROCESSES",
+            "PATHWAY_MESH_SUPERVISED",
+            "PATHWAY_FAULT_PLAN",
+            "PATHWAY_TRACE",
+            "PATHWAY_MEM_BUDGET_MB",
+            "PATHWAY_MEM_HIGH",
+            "PATHWAY_MEM_LOW",
+            "PATHWAY_CLUSTER_METRICS_PORT",
+        ):
+            env.pop(knob, None)
+        if governed:
+            env.update(
+                PATHWAY_MEM_BUDGET_MB=str(BUDGET_MB),
+                PATHWAY_MEM_HIGH=MEM_HIGH,
+                PATHWAY_MEM_LOW=MEM_LOW,
+            )
+        if plan is not None:
+            env["PATHWAY_FAULT_PLAN"] = plan
+        if cluster_port is not None:
+            env.update(
+                PATHWAY_CLUSTER_METRICS_PORT=str(cluster_port),
+                PATHWAY_CLUSTER_SCRAPE_S="0.2",
+            )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    prog,
+                    out_base,
+                    str(N_ROWS),
+                    str(PAD_BYTES),
+                ],
+                env=env,
+                cwd=td,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    return procs
+
+
+def _finish(procs: list[subprocess.Popen], timeout: float) -> list[str]:
+    errs = []
+    for rank, p in enumerate(procs):
+        try:
+            _out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                if q.poll() is None:
+                    q.kill()
+                    q.communicate()
+            fail(f"rank {rank} timed out")
+        errs.append(err.decode())
+        if p.returncode != 0:
+            fail(f"rank {rank} exited {p.returncode}: {errs[rank][-400:]}")
+    return errs
+
+
+def _merged_output(out_base: str) -> dict:
+    merged: dict = {}
+    for rank in range(WORLD):
+        path = f"{out_base}.r{rank}.json"
+        if os.path.exists(path):
+            with open(path) as f:
+                merged.update(json.load(f))
+    return merged
+
+
+def _metas(out_base: str) -> list[dict]:
+    metas = []
+    for rank in range(WORLD):
+        with open(f"{out_base}.r{rank}.meta") as f:
+            metas.append(json.load(f))
+    return metas
+
+
+def expected_counts(n_rows: int) -> dict:
+    return {str(k): [1, k * 7] for k in range(n_rows)}
+
+
+def main() -> int:
+    td = tempfile.mkdtemp(prefix="pw_backpressure_smoke_")
+
+    # -- unthrottled ungoverned baseline: the bit-identity reference --
+    base = os.path.join(td, "baseline")
+    errs = _finish(
+        _spawn(td, base, governed=False, plan=None, cluster_port=None),
+        timeout=300,
+    )
+    baseline = _merged_output(base)
+    if baseline != expected_counts(N_ROWS):
+        fail("unthrottled baseline output incorrect")
+
+    # -- governed + mesh.slow-throttled sink rank, watched live -------
+    cluster_port = _free_port()
+    plan = json.dumps(
+        {
+            "seed": 7,
+            "rules": [
+                {
+                    "point": "mesh.slow",
+                    "phase": "step",
+                    "rank": SLOW_RANK,
+                    "action": "delay",
+                    "delay_ms": DELAY_MS,
+                }
+            ],
+        }
+    )
+    gov = os.path.join(td, "governed")
+    procs = _spawn(td, gov, governed=True, plan=plan, cluster_port=cluster_port)
+
+    live = dict(engaged=False, paused_seen=False, released=False)
+    url = f"http://127.0.0.1:{cluster_port}/metrics/cluster"
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        body = _get(url, timeout=1.0)
+        if body is not None:
+            paused_now = False
+            episode_closed = False
+            for name, labels, value in _parse_samples(body):
+                if name == "mem_pressure_state" and value >= 1:
+                    live["engaged"] = True
+                elif name == "connector_paused" and value >= 1:
+                    live["paused_seen"] = True
+                    paused_now = True
+                elif name == "connector_paused_seconds_total" and value > 0:
+                    episode_closed = True
+            if live["paused_seen"] and episode_closed and not paused_now:
+                live["released"] = True
+        time.sleep(0.05)
+
+    errs = _finish(procs, timeout=600)
+    got = _merged_output(gov)
+    metas = _metas(gov)
+
+    problems: list[str] = []
+    # 1. bounded memory: whole-process RSS under the budget, accounted
+    # peak stuck in the watermark band — a fraction of the stream
+    budget_bytes = BUDGET_MB * 1024 * 1024
+    produced = N_ROWS * PAD_BYTES
+    for rank, meta in enumerate(metas):
+        if meta.get("budget", 0) != budget_bytes:
+            problems.append(f"rank {rank} ran ungoverned: {meta}")
+        if meta["ru_maxrss_kb"] * 1024 >= budget_bytes:
+            problems.append(
+                f"rank {rank} peak RSS {meta['ru_maxrss_kb']} KiB "
+                f"breached the {BUDGET_MB} MiB budget"
+            )
+    if not metas[0].get("paced"):
+        problems.append("rank 0's ladder never left ok — nothing paced")
+    if metas[0]["peak"] >= produced // 2:
+        problems.append(
+            f"rank 0 accounted peak {metas[0]['peak']}B buffered the "
+            f"stream ({produced}B produced) instead of pacing it"
+        )
+
+    # 2. bit-identical exactly-once, no degradations
+    if got != expected_counts(N_ROWS):
+        missing = sorted(
+            set(expected_counts(N_ROWS)) - set(got), key=int
+        )[:5]
+        problems.append(
+            f"governed output incomplete/incorrect (missing e.g. {missing})"
+        )
+    elif got != baseline:
+        problems.append("governed output differs from unthrottled baseline")
+    for rank, err in enumerate(errs):
+        if "at-least-once" in err:
+            problems.append(
+                f"rank {rank} degraded to at-least-once under pacing"
+            )
+
+    # 3. the live engage/release story on /metrics/cluster
+    if not live["engaged"]:
+        problems.append(
+            "/metrics/cluster never showed mem_pressure_state leave ok"
+        )
+    if not live["paused_seen"]:
+        problems.append(
+            "/metrics/cluster never showed connector_paused raised"
+        )
+    if not live["released"]:
+        problems.append(
+            "/metrics/cluster never showed the release (paused back to 0 "
+            "with a closed paused-seconds episode)"
+        )
+
+    summary = {
+        "ok": not problems,
+        "rows": N_ROWS,
+        "produced_bytes": produced,
+        "accounted_peak_bytes": metas[0]["peak"],
+        "budget_mb": BUDGET_MB,
+        "peak_rss_kb": [m["ru_maxrss_kb"] for m in metas],
+        "paced": metas[0].get("paced", False),
+        "live": live,
+        "bit_identical": got == baseline,
+    }
+    if problems:
+        summary["problems"] = problems
+        print(json.dumps(summary))
+        fail("; ".join(problems))
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -206,4 +206,23 @@ echo "=== lane 16: device fault-domain chaos smoke (snapshot/restore/reshard) ==
 # transitions are identity-pinned in tests/test_device_faults.py.
 env -u PATHWAY_LANE_PROCESSES python scripts/device_chaos_smoke.py --quick
 
+echo "=== lane 17: backpressure smoke (bounded-memory firehose + pacing) ==="
+# real-fork 2-rank firehose under PATHWAY_MEM_BUDGET_MB governance with
+# a mesh.slow-throttled sink rank: every rank's peak RSS stays under
+# the budget and the ACCOUNTED peak parks in the watermark band (a
+# fraction of the bytes the firehose produced — backlog paces, it
+# never buffers); output is bit-identical exactly-once vs an
+# unthrottled ungoverned baseline with zero drops and zero
+# at-least-once degradations on the pausable source; and the pacing
+# engage/release cycle is observed LIVE on /metrics/cluster
+# (mem_pressure_state leaves ok, connector_paused raises then clears
+# with a closed connector_paused_seconds_total episode). The
+# pause/drain protocol is model-checked by `python -m
+# pathway_tpu.analysis --pace` (mutant: `--pace-mutant never_resume`,
+# whose trace replays via `fault_matrix.py --from-trace`), and the
+# crash/raise/budget grid runs via `python scripts/fault_matrix.py
+# --pressure`; the ladder transitions are identity-pinned in
+# tests/test_backpressure.py.
+env -u PATHWAY_LANE_PROCESSES python scripts/backpressure_smoke.py
+
 echo "=== all lanes green ==="

@@ -66,11 +66,26 @@ exactly-once audit. ``--from-trace`` also replays rescale-model
 counterexamples (``analysis --mesh --rescale --json``) as real
 world-transition cells.
 
+The PRESSURE grid (``--pressure``; ISSUE 19) exercises the memory
+governance ladder: every cell is a governed run (``PATHWAY_MEM_BUDGET_MB``
+set, so the accountant installs and pacing is live) of the same stateful
+exactly-once scenario. ``raise`` rules at ``mem.pressure`` forge
+at-high-watermark samples — the ladder must step off ``ok`` (observed
+live from a side thread), the paced run must still complete, and the
+output must stay bit-identical. ``crash`` rules kill the process inside
+the sampler; resume must be exactly-once. The ``budget`` cell makes the
+pressure real instead of injected: a payload firehose against a slow
+sink under a 1 MB budget, asserting pacing engaged AND the accounted
+peak stayed under budget. ``--from-trace`` also replays pacing-model
+counterexamples (``analysis --pace --json``; violations carry
+``"pressure": true``) as pressure cells — crash steps become the kill
+phase, raise steps re-fire after resume.
+
 Usage:
     python scripts/fault_matrix.py [--rows 24] [--hits 2,4] [--timeout 120]
                                    [--mesh] [--mesh-no-nb] [--mesh-only]
                                    [--mesh-world N] [--from-trace FILE]
-                                   [--slow] [--rescale]
+                                   [--slow] [--rescale] [--pressure]
 """
 
 from __future__ import annotations
@@ -523,6 +538,39 @@ def run_trace_cells(path: str, timeout: float) -> list[CellResult]:
     for v in violations:
         plan = v.get("fault_plan")
         rescale = v.get("rescale")
+        if v.get("pressure"):
+            # a pacing-model trace (analysis --pace --json) replays as a
+            # governed pressure cell: crash steps become the kill phase,
+            # raise steps re-fire after resume (hit counters re-count
+            # from 0 in the restarted process, matching the model's
+            # per-incarnation sample numbering). A fault-free pacing
+            # counterexample still replays — as the plain governed run
+            # under the exactly-once audit.
+            rules = (plan or {}).get("rules") or []
+            crash = next(
+                (r for r in rules if r.get("action") == "crash"), None
+            )
+            raise_hits = [
+                int((r.get("hits") or [1])[0])
+                for r in rules
+                if r.get("action") == "raise"
+            ]
+            res = run_pressure_cell(
+                "inject",
+                crash_hit=(
+                    int((crash.get("hits") or [1])[0]) if crash else None
+                ),
+                raise_hits=raise_hits,
+                timeout=timeout,
+                label=f"trace[{v.get('kind', '?')}]/pressure",
+            )
+            results.append(res)
+            status = "PASS" if res.ok else "FAIL"
+            print(
+                f"{status}  {res.point:<32} mode={res.mode:<9} "
+                f"hit={res.hit}  {res.detail}"
+            )
+            continue
         if rescale:
             # a rescale-model trace replays as a real kill-and-resume
             # ACROSS the world transition: the crash rules (if any)
@@ -1288,6 +1336,285 @@ def run_device_cells(timeout: float) -> list[CellResult]:
     return results
 
 
+# ---------------------------------------------------------------------------
+# pressure grid: memory-governance ladder cells (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+# (mode, crash_hit, raise_hits): the governed-run grid. ``inject`` cells
+# forge pressure via mem.pressure rules; the ``budget`` cell makes it
+# real (payload firehose, slow sink, 1 MB budget).
+PRESSURE_CELLS = [
+    ("inject", None, (1,)),    # single spike: ladder engages, run completes
+    ("inject", None, (2, 3)),  # double spike mid-stream
+    ("inject", 1, ()),         # kill inside the sampler; clean resume
+    ("inject", 1, (1,)),       # the never_resume trace shape: crash, then
+                               # a spike lands after resume
+    ("budget", None, ()),      # real backlog under a real budget
+]
+
+# The governed scenario: the SAME stateful exactly-once audit as the
+# single-process grid, but run with a memory budget so the accountant
+# installs and the pacing pass is live. A side thread watches the
+# installed accountant while the run is up — ``pressure_injections`` and
+# ``peak_bytes`` are monotonic, so the poll cannot miss an episode — and
+# dumps what it saw to ``out.json.meta`` for the cell to audit.
+PRESSURE_SCENARIO = r'''
+import json, os, sys, threading, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import pathway_tpu as pw
+from pathway_tpu.internals import memory as _memory
+
+mode, pdir, out_path, n_rows = (
+    sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4])
+)
+meta_path = out_path + ".meta"
+
+# budget mode: every row drags a payload so real backlog bytes build;
+# inject mode keeps rows tiny so only forged samples can move the ladder
+PAD = "x" * (4096 if mode == "budget" else 8)
+
+
+class Src(pw.io.python.ConnectorSubject):
+    def __init__(self):
+        super().__init__()
+        self.pos = 0
+
+    def run(self):
+        while self.pos < n_rows:
+            i = self.pos
+            self.next(k=i, v=i * 7, pad=PAD)
+            self.pos = i + 1
+            if self.pos % 4 == 0:
+                self.commit()
+
+    def snapshot_state(self):
+        return dict(pos=self.pos)
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+class S(pw.Schema):
+    k: int
+    v: int
+    pad: str
+
+
+rows = pw.io.python.read(
+    Src(), schema=S, autocommit_duration_ms=25, name="pressure"
+)
+counts = rows.groupby(pw.this.k).reduce(
+    k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+)
+
+seen = {{}}
+
+
+def on_change(key, row, time_, diff):
+    if mode == "budget":
+        time.sleep(0.002)  # the slow consumer that makes backlog real
+    kk = str(row["k"])
+    if diff > 0:
+        seen[kk] = [row["c"], row["s"]]
+    elif seen.get(kk) == [row["c"], row["s"]]:
+        del seen[kk]
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(seen, f, sort_keys=True)
+    os.replace(tmp, out_path)
+
+
+pw.io.subscribe(counts, on_change=on_change)
+
+watch = dict(injections=0, peak=0, high=0, budget=0, paced=False)
+held = []  # first-seen accountant, kept past its uninstall in _finish
+stop = threading.Event()
+
+
+def _read(acct):
+    watch["injections"] = max(watch["injections"], acct.pressure_injections)
+    watch["peak"] = max(watch["peak"], acct.peak_bytes)
+    watch["high"] = acct.high_bytes
+    watch["budget"] = acct.budget_bytes
+    if acct.state != "ok":
+        watch["paced"] = True
+
+
+def _poll():
+    while not stop.is_set():
+        acct = _memory.current()
+        if acct is not None and acct.enabled:
+            if not held:
+                held.append(acct)
+            _read(acct)
+        time.sleep(0.002)
+
+
+poller = threading.Thread(target=_poll, daemon=True)
+poller.start()
+
+pw.run(
+    persistence_config=pw.persistence.Config(
+        backend=pw.persistence.Backend.filesystem(pdir),
+        persistence_mode="PERSISTING",
+        snapshot_interval_ms=0,
+    )
+)
+stop.set()
+poller.join(timeout=2)
+if held:
+    # the run's LAST sample can land microseconds before the accountant
+    # is uninstalled — a final read off the held object cannot miss it
+    # (injections and peak are monotonic)
+    _read(held[0])
+tmp = meta_path + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(watch, f)
+os.replace(tmp, meta_path)
+'''
+
+
+def _run_pressure_scenario(script, mode, tmp, n_rows, plan, timeout):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("PATHWAY_FAULT_PLAN", None)
+    # 1 MB for the real-backlog cell; 64 MB for inject cells so only the
+    # forged samples (total := high watermark) can move the ladder
+    env["PATHWAY_MEM_BUDGET_MB"] = "1" if mode == "budget" else "64"
+    if plan is not None:
+        env["PATHWAY_FAULT_PLAN"] = json.dumps(plan)
+    return subprocess.run(
+        [
+            sys.executable,
+            script,
+            mode,
+            os.path.join(tmp, "pstorage"),
+            os.path.join(tmp, "out.json"),
+            str(n_rows),
+        ],
+        capture_output=True,
+        timeout=timeout,
+        env=env,
+    )
+
+
+def run_pressure_cell(
+    mode: str = "inject",
+    crash_hit: int | None = None,
+    raise_hits: tuple[int, ...] | list[int] = (),
+    timeout: float = 120,
+    n_rows: int = 24,
+    label: str | None = None,
+) -> CellResult:
+    """One governed-run cell: optional kill inside the sampler, then a
+    (possibly spiked) run to completion under the strict exactly-once
+    audit, with the ladder's engagement audited from the side-thread
+    meta dump."""
+    kinds = [k for k, on in (
+        ("crash", crash_hit is not None), ("raise", bool(raise_hits)),
+    ) if on]
+    cell_mode = "+".join(kinds) if kinds else mode
+    point = label or f"mem.pressure#{mode}"
+    hit = crash_hit or (raise_hits[0] if raise_hits else 1)
+    if mode == "budget":
+        n_rows = max(n_rows, 300)
+
+    def fail(detail):
+        return CellResult(point, cell_mode, hit, False, detail)
+
+    with tempfile.TemporaryDirectory(prefix="pw_pressure_") as tmp:
+        script = os.path.join(tmp, "scenario.py")
+        with open(script, "w") as f:
+            f.write(PRESSURE_SCENARIO.format(repo=REPO))
+        if crash_hit is not None:
+            plan = {
+                "seed": 7,
+                "rules": [{
+                    "point": "mem.pressure", "phase": "sample", "rank": 0,
+                    "hits": [int(crash_hit)], "action": "crash",
+                }],
+            }
+            proc = _run_pressure_scenario(
+                script, mode, tmp, n_rows, plan, timeout
+            )
+            if proc.returncode != CRASH_EXIT_CODE:
+                return fail(
+                    f"kill phase: expected exit {CRASH_EXIT_CODE}, got "
+                    f"{proc.returncode}; stderr: {proc.stderr.decode()[-800:]}"
+                )
+        plan = None
+        if raise_hits:
+            plan = {
+                "seed": 7,
+                "rules": [{
+                    "point": "mem.pressure", "phase": "sample", "rank": 0,
+                    "hits": [int(h) for h in raise_hits], "action": "raise",
+                }],
+            }
+        proc = _run_pressure_scenario(script, mode, tmp, n_rows, plan, timeout)
+        if proc.returncode != 0:
+            return fail(
+                f"paced run: exit {proc.returncode}; stderr: "
+                f"{proc.stderr.decode()[-800:]}"
+            )
+        try:
+            with open(os.path.join(tmp, "out.json")) as f:
+                got = json.load(f)
+        except FileNotFoundError:
+            return fail("paced run wrote no output")
+        want = expected_counts(n_rows)
+        if got != want:
+            missing = sorted(set(want) - set(got), key=int)
+            dupes = sorted(k for k, v in got.items() if v[0] != 1)
+            return fail(
+                f"exactly-once violated under pressure: missing={missing} "
+                f"dup-counted={dupes}"
+            )
+        try:
+            with open(os.path.join(tmp, "out.json.meta")) as f:
+                meta = json.load(f)
+        except FileNotFoundError:
+            return fail("paced run wrote no accountant meta")
+        if meta.get("budget", 0) <= 0:
+            return fail("run was not governed (accountant never enabled)")
+        if raise_hits:
+            if meta.get("injections", 0) < 1:
+                return fail("mem.pressure raise rule never fired")
+            if meta.get("peak", 0) < meta.get("high", 1):
+                return fail(
+                    "forged sample did not lift peak to the high watermark: "
+                    f"peak={meta.get('peak')} high={meta.get('high')}"
+                )
+        if mode == "budget":
+            if not meta.get("paced"):
+                return fail("real backlog never moved the ladder off ok")
+            if meta.get("peak", 0) >= meta["budget"]:
+                return fail(
+                    f"accounted peak {meta.get('peak')} breached the "
+                    f"budget {meta['budget']}"
+                )
+        detail = (
+            f"exactly-once ok; injections={meta.get('injections')} "
+            f"peak={meta.get('peak')}B paced={meta.get('paced')}"
+        )
+        return CellResult(point, cell_mode, hit, True, detail)
+
+
+def run_pressure_cells(timeout: float) -> list[CellResult]:
+    results: list[CellResult] = []
+    for mode, crash_hit, raise_hits in PRESSURE_CELLS:
+        res = run_pressure_cell(
+            mode, crash_hit=crash_hit, raise_hits=raise_hits, timeout=timeout
+        )
+        results.append(res)
+        status = "PASS" if res.ok else "FAIL"
+        print(
+            f"{status}  {res.point:<32} mode={res.mode:<9} "
+            f"hit={res.hit}  {res.detail}"
+        )
+    return results
+
+
 def _run_scenario(script, mode, tmp, n_rows, plan, timeout):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env.pop("PATHWAY_FAULT_PLAN", None)
@@ -1423,6 +1750,14 @@ def main(argv=None) -> int:
         "zero lost/duplicated index entries",
     )
     ap.add_argument(
+        "--pressure", action="store_true",
+        help="run the memory-pressure grid (ISSUE 19): governed runs "
+        "(budget set, pacing live) × {forged mem.pressure spikes, kill "
+        "inside the sampler, real 1 MB-budget backlog} — the ladder "
+        "must engage, the paced run must complete, and the output must "
+        "stay bit-identical under the strict exactly-once audit",
+    )
+    ap.add_argument(
         "--rescale", action="store_true",
         help="run the kill-during-rescale grid (ISSUE 11): a committed "
         "world-N cut restored RE-SHARDED into world M, with the victim "
@@ -1465,6 +1800,12 @@ def main(argv=None) -> int:
         return 1 if failed else 0
     if args.device:
         results.extend(run_device_cells(max(args.timeout, 240)))
+        failed = [r for r in results if not r.ok]
+        print()
+        print(f"{len(results) - len(failed)}/{len(results)} cells green")
+        return 1 if failed else 0
+    if args.pressure:
+        results.extend(run_pressure_cells(max(args.timeout, 180)))
         failed = [r for r in results if not r.ok]
         print()
         print(f"{len(results) - len(failed)}/{len(results)} cells green")

@@ -1,0 +1,646 @@
+"""Bounded-memory streaming battery (ISSUE 19).
+
+The tentpole contract in unit/integration form: the memory accountant
+(internals/memory.py) and its watermark resolution; the pure degradation
+ladder + pacing transitions (parallel/protocol.py) and the anti-drift
+identity pins proving the accountant, the serving gateway, and the
+pacing model checker (analysis/meshcheck.py check_pacing) all drive the
+SAME table objects; synthetic ``mem.pressure`` samples; the checker
+clean on the real protocol and catching the seeded ``never_resume``
+mutant with a minimal replayable trace; governed in-process runs that
+pace a payload firehose inside the budget with exactly-once output; the
+watchdog's paced-subject exemption (both directions); the governed
+``_BACKLOG_CAP`` routing; and (slow) the fault-matrix pressure cells
+that replay kill-and-resume and 2->3 rescale under governance.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import meshcheck as mc
+from pathway_tpu.internals import faults
+from pathway_tpu.internals import memory as mem
+from pathway_tpu.internals.monitoring import ProberStats
+from pathway_tpu.parallel import protocol as proto
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+import fault_matrix  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    faults.reset()
+    mem.install(None)
+    yield
+    faults.reset()
+    mem.install(None)
+
+
+MB = 1024 * 1024
+
+
+# -- watermark resolution ----------------------------------------------------
+
+
+def test_resolve_watermarks_disabled_and_defaults():
+    assert mem.resolve_watermarks({}) == (0, 0, 0)
+    assert mem.resolve_watermarks({"PATHWAY_MEM_BUDGET_MB": "0"}) == (0, 0, 0)
+    assert mem.resolve_watermarks(
+        {"PATHWAY_MEM_BUDGET_MB": "nonsense"}
+    ) == (0, 0, 0)
+    low, high, budget = mem.resolve_watermarks(
+        {"PATHWAY_MEM_BUDGET_MB": "100"}
+    )
+    assert budget == 100 * MB
+    assert high == int(budget * 0.8)
+    assert low == int(budget * 0.6)
+
+
+def test_resolve_watermarks_inverted_band_clamped():
+    low, high, _ = mem.resolve_watermarks({
+        "PATHWAY_MEM_BUDGET_MB": "10",
+        "PATHWAY_MEM_LOW": "0.9",
+        "PATHWAY_MEM_HIGH": "0.5",
+    })
+    # an inverted hysteresis band would flap forever — low clamps to high
+    assert low == high == int(10 * MB * 0.5)
+
+
+def test_mem_knobs_registered():
+    from pathway_tpu.analysis.knobs import KNOBS
+
+    for name in (
+        "PATHWAY_MEM_BUDGET_MB", "PATHWAY_MEM_HIGH", "PATHWAY_MEM_LOW",
+    ):
+        assert name in KNOBS, name
+
+
+# -- the pure transitions ----------------------------------------------------
+
+
+def test_mem_ladder_semantics():
+    step = proto.mem_ladder
+    # disabled: always ok, regardless of totals
+    assert step(10**12, 0, 0, 0) == "ok"
+    # climbing: ok below low, hysteresis between the watermarks
+    assert step(10, 60, 80, 100, prev="ok") == "ok"
+    assert step(70, 60, 80, 100, prev="ok") == "ok"
+    assert step(85, 60, 80, 100, prev="ok") == "pacing"
+    # draining: a rung holds until the total crosses LOW, then releases
+    assert step(70, 60, 80, 100, prev="pacing") == "pacing"
+    assert step(59, 60, 80, 100, prev="pacing") == "ok"
+    # recovery walks down one rung at a time, never teleports
+    assert step(85, 60, 80, 100, prev="brownout") == "brownout"
+    # over budget: brownout now, abort only after the streak
+    assert step(101, 60, 80, 100, prev="pacing", over_streak=0) == "brownout"
+    assert step(
+        101, 60, 80, 100, prev="brownout", over_streak=3, abort_streak=4
+    ) == "abort"
+    # abort is sticky — only a post-restore reset clears it
+    assert step(0, 60, 80, 100, prev="abort") == "abort"
+    assert proto.MEM_LADDER == ("ok", "pacing", "brownout", "abort")
+
+
+def test_pace_decide_and_resume_semantics():
+    # ladder off ok pauses unconditionally
+    assert proto.pace_decide("pacing")
+    assert proto.pace_decide("brownout", 0, 0)
+    assert not proto.pace_decide("ok")
+    # row-bound pacing: backlog at/over the pause bound pauses
+    assert proto.pace_decide("ok", backlog_rows=10, pause_rows=10)
+    assert not proto.pace_decide("ok", backlog_rows=9, pause_rows=10)
+    # resume needs BOTH: ladder ok and backlog drained to the bound
+    assert proto.pace_resume("ok")
+    assert proto.pace_resume("ok", backlog_rows=3, resume_rows=5)
+    assert not proto.pace_resume("ok", backlog_rows=6, resume_rows=5)
+    assert not proto.pace_resume("pacing")
+    assert not proto.pace_resume("brownout", backlog_rows=0, resume_rows=5)
+
+
+def test_pace_retry_after_semantics():
+    # no backlog -> the default; dead drain -> the long clamp, never "now"
+    assert proto.pace_retry_after(0, 5.0) == 1.0
+    assert proto.pace_retry_after(10, 0.0) == 600.0
+    assert proto.pace_retry_after(10, 2.0) == 5.0
+    assert proto.pace_retry_after(1, 100.0) == 1.0   # clamped up
+    assert proto.pace_retry_after(10**9, 1.0) == 600.0  # clamped down
+
+
+# -- the accountant ----------------------------------------------------------
+
+
+def _acct(budget_mb=100, **extra):
+    env = {"PATHWAY_MEM_BUDGET_MB": str(budget_mb), **extra}
+    return mem.MemoryAccountant(environ=env)
+
+
+def test_accountant_rejects_unknown_component():
+    acct = _acct()
+    with pytest.raises(ValueError, match="unknown memory component"):
+        acct.set_component("gpu_scratch", 123)
+    for name in mem.COMPONENTS:
+        acct.set_component(name, 1)
+    assert acct.total() == len(mem.COMPONENTS)
+
+
+def test_accountant_sample_steps_ladder_with_hysteresis():
+    acct = _acct(budget_mb=100)
+    assert acct.enabled
+    assert acct.sample() == "ok"
+    acct.set_component("connector_backlog", 85 * MB)
+    assert acct.sample() == "pacing"
+    # drain into the hysteresis band: the rung holds
+    acct.set_component("connector_backlog", 70 * MB)
+    assert acct.sample() == "pacing"
+    # under low: releases
+    acct.set_component("connector_backlog", 10 * MB)
+    assert acct.sample() == "ok"
+    assert acct.peak_bytes == 85 * MB
+
+
+def test_accountant_abort_streak_and_reset():
+    acct = mem.MemoryAccountant(
+        environ={"PATHWAY_MEM_BUDGET_MB": "100"}, abort_streak=2
+    )
+    acct.set_component("store", 101 * MB)
+    assert acct.sample() == "brownout"
+    assert acct.sample() == "abort"
+    # sticky: even a drained total stays abort
+    acct.set_component("store", 0)
+    assert acct.sample() == "abort"
+    # the post-restore reset is the only exit
+    acct.reset()
+    assert acct.state == "ok"
+    assert acct.sample() == "ok"
+
+
+def test_accountant_disabled_never_leaves_ok():
+    acct = mem.MemoryAccountant(environ={})
+    assert not acct.enabled
+    acct.set_component("store", 10**15)
+    assert acct.sample() == "ok"
+
+
+def test_synthetic_pressure_sample_via_fault_plan():
+    """A mem.pressure ``raise`` rule is CAUGHT by the accountant and read
+    as an at-high-watermark sample — the deterministic pressure episode
+    the pacing checker's traces and fault_matrix --pressure replay."""
+    acct = _acct(budget_mb=100)
+    faults.install_plan({
+        "seed": 7,
+        "rules": [{
+            "point": "mem.pressure", "phase": "sample",
+            "hits": [2], "action": "raise",
+        }],
+    })
+    try:
+        assert acct.sample() == "ok"          # hit 1: clean
+        assert acct.sample() == "pacing"      # hit 2: synthetic pressure
+        assert acct.pressure_injections == 1
+        assert acct.peak_bytes >= acct.high_bytes
+    finally:
+        faults.clear_plan()
+    # the real total (0) is under the low watermark: the next clean
+    # sample releases the episode
+    assert acct.sample() == "ok"
+
+
+def test_ladder_state_reads_installed_accountant():
+    assert mem.ladder_state() == "ok"  # nothing installed
+    acct = _acct()
+    acct.state = "brownout"
+    mem.install(acct)
+    assert mem.ladder_state() == "brownout"
+    mem.install(None)
+    assert mem.ladder_state() == "ok"
+
+
+def test_mem_pressure_fault_point_registered():
+    assert "mem.pressure" in faults.POINTS
+
+
+# -- anti-drift identity pins ------------------------------------------------
+
+
+def test_engine_and_checker_bind_the_table_objects():
+    """The accountant, the serving gateway's Retry-After, and the pacing
+    model checker must all drive the SAME protocol objects — the
+    anti-drift pin that keeps model and engine from diverging."""
+    acct = _acct()
+    assert acct._ladder is proto.TRANSITIONS["mem_ladder"]
+    assert acct._pace_decide is proto.TRANSITIONS["pace_decide"]
+    assert acct._pace_resume is proto.TRANSITIONS["pace_resume"]
+    assert proto.TRANSITIONS["mem_ladder"] is proto.mem_ladder
+    assert proto.TRANSITIONS["pace_decide"] is proto.pace_decide
+    assert proto.TRANSITIONS["pace_resume"] is proto.pace_resume
+    assert proto.TRANSITIONS["pace_retry_after"] is proto.pace_retry_after
+    trans = mc.get_pace_transitions()
+    assert trans.mem_ladder is proto.mem_ladder
+    assert trans.pace_decide is proto.pace_decide
+    assert trans.pace_resume is proto.pace_resume
+
+
+def test_pace_mutants_are_named_and_unknown_rejected():
+    assert "never_resume" in mc.PACE_MUTANT_NAMES
+    mutant = mc.get_pace_transitions(mutate="never_resume")
+    assert mutant.pace_resume is not proto.pace_resume
+    with pytest.raises(ValueError):
+        mc.get_pace_transitions(mutate="definitely_not_a_mutant")
+
+
+# -- metrics / dashboard -----------------------------------------------------
+
+
+def test_metrics_render_mem_gauges_and_paused_counters():
+    stats = ProberStats()
+    stats.on_ingest("firehose", 1)
+    stats.set_mem_pressure(
+        "pacing", 42 * MB, 80 * MB, 100 * MB,
+        {"connector_backlog": 40 * MB, "store": 2 * MB}, 3,
+    )
+    stats.on_connector_paused("firehose")
+    stats.on_connector_paced("firehose", 1.5)
+    text = stats.render_openmetrics()
+    assert "mem_pressure_state 1" in text  # MEM_LADDER.index("pacing")
+    assert "mem_budget_bytes" in text
+    assert 'mem_component_bytes{component="connector_backlog"}' in text
+    assert "mem_pressure_injections_total 3" in text
+    assert 'connector_paused{connector="firehose"} 1' in text
+    assert "connector_paused_seconds_total" in text
+    from rich.console import Console
+
+    from pathway_tpu.internals.monitoring import render_dashboard
+
+    console = Console(record=True, width=120)
+    console.print(render_dashboard(stats))
+    dash = console.export_text()
+    assert "memory ladder" in dash
+    assert "pacing" in dash
+    stats.on_connector_resumed("firehose", 0.5)
+    text2 = stats.render_openmetrics()
+    assert 'connector_paused{connector="firehose"} 0' in text2
+
+
+# -- the pacing model checker ------------------------------------------------
+
+
+def test_pacing_checker_clean_on_real_protocol():
+    report = mc.check_pacing(mc.PaceCheckConfig())
+    assert report.ok, [v.kind for v in report.violations]
+    assert report.complete
+    assert report.states > 100
+    assert report.pauses_explored > 0  # pacing actually engaged in the model
+
+
+def test_pacing_checker_catches_never_resume_with_replayable_trace():
+    report = mc.check_pacing(
+        mc.PaceCheckConfig(mutate="never_resume")
+    )
+    assert not report.ok
+    v = report.violations[0]
+    assert v.kind == "pace-deadlock"
+    assert v.trace, "minimal trace must be non-empty"
+    d = v.to_dict()
+    assert d["pressure"] is True
+    plan = d["fault_plan"]
+    assert plan and plan["rules"], "trace must be replayable as a plan"
+    assert all(r["point"] == "mem.pressure" for r in plan["rules"])
+    assert all(r["phase"] == "sample" for r in plan["rules"])
+    # render() is the human side of the same trace
+    assert "pace-deadlock" in report.render()
+
+
+# -- governed in-process runs ------------------------------------------------
+
+
+class _S(pw.Schema):
+    k: int
+    v: int
+    pad: str
+
+
+class _Firehose(pw.io.python.ConnectorSubject):
+    """Unthrottled payload source: without pacing it queues its whole
+    payload volume ahead of a slow sink."""
+
+    def __init__(self, n, pad=4096):
+        super().__init__()
+        self.pos = 0
+        self.n = n
+        self.pad = "x" * pad
+
+    def run(self):
+        while self.pos < self.n:
+            i = self.pos
+            self.next(k=i, v=i * 7, pad=self.pad)
+            self.pos = i + 1
+            if self.pos % 8 == 0:
+                self.commit()
+
+    def snapshot_state(self):
+        return dict(pos=self.pos)
+
+    def seek(self, state):
+        self.pos = state["pos"]
+
+
+class _Watch:
+    """Side-thread view of the installed accountant (the object outlives
+    its uninstall, and injections/peak are monotonic, so nothing is
+    missed)."""
+
+    def __init__(self):
+        self.held = None
+        self.paced_seen = False
+        self.peak = 0
+        self.enabled_seen = None
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._poll, daemon=True)
+
+    def _read(self, acct):
+        self.peak = max(self.peak, acct.peak_bytes)
+        if acct.state != "ok":
+            self.paced_seen = True
+
+    def _poll(self):
+        while not self._stop.is_set():
+            acct = mem.current()
+            if acct is not None:
+                if self.held is None:
+                    self.held = acct
+                    self.enabled_seen = acct.enabled
+                self._read(acct)
+            time.sleep(0.002)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join(timeout=2)
+        if self.held is not None:
+            self._read(self.held)
+        return False
+
+
+def _paced_pipeline(n, sink_sleep_s):
+    src = _Firehose(n)
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=25, name="firehose"
+    )
+    counts = rows.groupby(pw.this.k).reduce(
+        k=pw.this.k, c=pw.reducers.count(), s=pw.reducers.sum(pw.this.v)
+    )
+    seen = {}
+
+    def on_change(key, row, time_, diff):
+        if sink_sleep_s:
+            time.sleep(sink_sleep_s)
+        if diff > 0:
+            seen[row["k"]] = (row["c"], row["s"])
+
+    pw.io.subscribe(counts, on_change=on_change)
+    return seen
+
+
+def test_governed_run_paces_firehose_inside_budget(monkeypatch):
+    """The end-to-end tentpole in-process: a 1 MB budget against ~1.3 MB
+    of payload traffic and a slow sink — pacing must engage, the
+    accounted peak must stay under budget, and delivery must remain
+    exactly-once (zero drops, zero degradations)."""
+    monkeypatch.setenv("PATHWAY_MEM_BUDGET_MB", "1")
+    n = 300
+    seen = _paced_pipeline(n, sink_sleep_s=0.002)
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    with _Watch() as watch:
+        pw.run()
+    assert watch.enabled_seen is True
+    assert watch.paced_seen, "pacing never engaged"
+    assert watch.peak < MB, f"accounted peak {watch.peak} breached budget"
+    assert seen == {k: (1, k * 7) for k in range(n)}
+    # governed pacing, NOT the at-least-once escape
+    assert not any("at-least-once" in m for m in log_rows)
+    # the accountant was retired with the run
+    assert mem.current() is None
+
+
+def test_ungoverned_run_is_legacy(monkeypatch):
+    monkeypatch.delenv("PATHWAY_MEM_BUDGET_MB", raising=False)
+    n = 60
+    # a small sink delay keeps the run up long enough for the watcher to
+    # observe the installed-but-disabled accountant deterministically
+    seen = _paced_pipeline(n, sink_sleep_s=0.002)
+    with _Watch() as watch:
+        pw.run()
+    # an accountant installs but stays disabled: ladder pinned at ok
+    assert watch.enabled_seen is False
+    assert not watch.paced_seen
+    assert seen == {k: (1, k * 7) for k in range(n)}
+
+
+# -- watchdog x pacing (both directions) -------------------------------------
+
+
+class _WatchedFirehose(_Firehose):
+    _watchdog_timeout_s = 0.2
+
+
+def test_watchdog_exempts_paced_subject(monkeypatch):
+    """A subject parked by the governor is NOT stalled: its paced waits
+    must never trip the watchdog even when the pause outlives the
+    watchdog window."""
+    monkeypatch.setenv("PATHWAY_MEM_BUDGET_MB", "1")
+    n = 300
+    src = _WatchedFirehose(n)
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=25, name="watched"
+    )
+    got = []
+    pw.io.subscribe(
+        rows,
+        on_change=lambda key, row, t, diff: (
+            time.sleep(0.002), got.append(row["k"]),
+        ),
+    )
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    with _Watch() as watch:
+        pw.run()
+    assert watch.paced_seen, "pacing never engaged — vacuous exemption test"
+    assert sorted(got) == list(range(n))
+    assert not any("connector-stall" in m for m in log_rows), log_rows
+
+
+class _SleepySrc(pw.io.python.ConnectorSubject):
+    _watchdog_timeout_s = 0.15
+
+    def run(self):
+        time.sleep(0.7)
+        self.next(k=1, v=7, pad="x")
+
+
+def test_watchdog_still_trips_for_genuine_stall_under_governance(
+    monkeypatch,
+):
+    """The exemption is scoped to PAUSED subjects: under an ample budget
+    (never paces) a genuinely silent subject must still be flagged."""
+    monkeypatch.setenv("PATHWAY_MEM_BUDGET_MB", "512")
+    src = _SleepySrc()
+    rows = pw.io.python.read(
+        src, schema=_S, autocommit_duration_ms=10, name="sleepy"
+    )
+    got = []
+    pw.io.subscribe(
+        rows, on_change=lambda key, row, t, diff: got.append(row["k"])
+    )
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, t, diff: log_rows.append(row["message"]),
+    )
+    pw.run()
+    assert got == [1]
+    assert any("connector-stall" in m for m in log_rows)
+
+
+# -- governed _BACKLOG_CAP routing -------------------------------------------
+
+
+class _NoCommitSrc(pw.io.python.ConnectorSubject):
+    """Never calls commit(): non-paceable in the only sense that matters
+    (pausing it could never resume) — the cap stays its escape."""
+
+    def __init__(self, n=10):
+        super().__init__()
+        self.n = n
+
+    def run(self):
+        for i in range(self.n):
+            self.next(k=i, v=i, pad="x")
+
+    def snapshot_state(self):
+        return {}
+
+
+class _BoundaryThenBurstSrc(pw.io.python.ConnectorSubject):
+    """Shows ONE commit boundary, then bursts far past the (tiny) cap:
+    a paceable subject whose overload must route through pacing, never
+    the at-least-once escape."""
+
+    def __init__(self, n=32):
+        super().__init__()
+        self.n = n
+
+    def run(self):
+        self.next(k=0, v=0, pad="x")
+        self.commit()
+        for i in range(1, self.n):
+            self.next(k=i, v=i, pad="x")
+
+    def snapshot_state(self):
+        return {}
+
+
+def test_backlog_cap_escape_only_for_never_committing_subjects(
+    monkeypatch, tmp_path,
+):
+    """Governed + committing: overload routes through pacing, never the
+    at-least-once escape. Governed + never-committing: the cap remains
+    the bounded-memory escape, error-logged and counted."""
+    monkeypatch.setenv("PATHWAY_MEM_BUDGET_MB", "64")
+    monkeypatch.setattr("pathway_tpu.io._connector._BACKLOG_CAP", 3)
+
+    def run_one(src, name):
+        pw.internals.parse_graph.G.clear()
+        rows = pw.io.python.read(
+            src, schema=_S, autocommit_duration_ms=0, name=name
+        )
+        pw.io.subscribe(rows, on_change=lambda *a: None)
+        log_rows = []
+        pw.io.subscribe(
+            pw.global_error_log(),
+            on_change=lambda key, row, t, diff: (
+                log_rows.append(row["message"])
+            ),
+        )
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                backend=pw.persistence.Backend.filesystem(
+                    str(tmp_path / name)
+                ),
+                snapshot_interval_ms=0,
+            )
+        )
+        return log_rows
+
+    # a subject with a proven boundary, far over the (tiny) cap: NO
+    # degradation — overload routes through pacing
+    log_rows = run_one(_BoundaryThenBurstSrc(32), "committing")
+    assert not any("at-least-once" in m for m in log_rows), log_rows
+    # a never-committing subject: the escape fires, loudly
+    log_rows = run_one(_NoCommitSrc(32), "nocommit")
+    assert any(
+        "degrades to at-least-once" in m for m in log_rows
+    ), log_rows
+
+
+# -- fault-matrix pressure cells (subprocess; slow) --------------------------
+
+
+@pytest.mark.slow
+def test_pressure_cell_kill_and_resume_under_injection():
+    """The never_resume-trace shape as a real cell: killed inside the
+    sampler, resumed, spiked after resume — exactly-once throughout."""
+    res = fault_matrix.run_pressure_cell(
+        "inject", crash_hit=1, raise_hits=(1,), timeout=180
+    )
+    assert res.ok, res.detail
+
+
+@pytest.mark.slow
+def test_pace_mutant_trace_replays_green_as_real_cell(tmp_path):
+    """The checker-to-matrix bridge: the never_resume counterexample's
+    JSON replays through fault_matrix --from-trace as a live governed
+    kill-and-resume cell and comes back green."""
+    report = mc.check_pacing(mc.PaceCheckConfig(mutate="never_resume"))
+    assert not report.ok
+    path = tmp_path / "pace_trace.json"
+    path.write_text(report.to_json())
+    results = fault_matrix.run_trace_cells(str(path), timeout=240)
+    assert results, "trace produced no replay cells"
+    assert all(r.ok for r in results), [r.detail for r in results]
+
+
+@pytest.mark.slow
+def test_governed_rescale_2_to_3_stays_exactly_once(monkeypatch):
+    """Pacing state is derived fresh per run, so a governed 2->3 rescale
+    (kill mid-re-shard) must restore and finish bit-identical — the
+    governance plumbing adds no new rescale state to lose."""
+    monkeypatch.setenv("PATHWAY_MEM_BUDGET_MB", "64")
+    res = fault_matrix.run_rescale_cell(
+        "grow", 2, 3, kill_phase="restore", victim=1, hit=1, timeout=300
+    )
+    assert res.ok, res.detail
+
+
+@pytest.mark.slow
+def test_pressure_budget_cell_real_backlog():
+    res = fault_matrix.run_pressure_cell("budget", timeout=180)
+    assert res.ok, res.detail

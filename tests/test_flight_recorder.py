@@ -205,6 +205,11 @@ _PROBER_CALLS = {
     "on_connector_error": ("conn_a",),
     "on_connector_stall": ("conn_a",),
     "on_connector_degraded": ("conn_a",),
+    # source pacing (ISSUE 19): gate engaged / live per-pass accrual /
+    # episode closed — connector_paused gauge + paused_seconds counter
+    "on_connector_paused": ("conn_a",),
+    "on_connector_paced": ("conn_a", 1.5),
+    "on_connector_resumed": ("conn_a", 0.5),
     "on_output": (3,),
     "on_output_lag": ("out_a", 5.0),
     "on_node_step": ("node_a", 0.25, 7, True),

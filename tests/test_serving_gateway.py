@@ -939,3 +939,67 @@ def test_serve_fault_points_registered():
 
     for p in ("serve.dispatch", "serve.park", "serve.replay"):
         assert p in POINTS, p
+
+
+def test_retry_after_honesty_under_memory_ladder():
+    """503s minted during a memory-ladder episode size Retry-After from
+    the SAME pace_retry_after transition the pacing model checks —
+    in-flight backlog over the EWMA drain rate — not the rolling-qps
+    guess that reads near-zero exactly when the governor throttles."""
+    import math
+
+    from pathway_tpu.parallel import protocol as proto
+
+    port = _next_port()
+    subject, _url = _gateway(port)
+
+    # a seeded drain rate: 2 responses/s with 7 in flight -> ceil(3.5)
+    subject._done_rate_ewma = 2.0
+    subject._inflight = 7
+    for state in ("pacing", "brownout", "abort"):
+        want = max(1, math.ceil(proto.pace_retry_after(7, 2.0)))
+        assert subject._retry_after_s(state) == want == 4
+    # drain rate unobserved -> the clamped long horizon, never "now"
+    subject._done_rate_ewma = 0.0
+    assert subject._retry_after_s("brownout") == 600
+    # nothing in flight -> floor of one pending unit at the seeded rate
+    subject._done_rate_ewma = 2.0
+    subject._inflight = 0
+    assert subject._retry_after_s("pacing") == max(
+        1, math.ceil(proto.pace_retry_after(1, 2.0))
+    )
+    # ladder ok -> the legacy rolling-qps path is untouched
+    assert subject._retry_after_s("ok") == 1
+    assert subject._retry_after_s() == 1
+
+
+def test_memory_brownout_sheds_503_then_recovers():
+    """The serving breaker consumes the memory signal: while the
+    installed accountant's ladder reads brownout/abort, requests shed
+    503 with a paced Retry-After; once the ladder steps back to ok the
+    same gateway serves 200s again."""
+    from pathway_tpu.internals import memory as _memory
+
+    port = _next_port()
+    subject, url = _gateway(port)
+    _start_run()
+    try:
+        assert _post(url, {"value": 5}) == 5 * 3
+
+        acct = _memory.MemoryAccountant(
+            environ={"PATHWAY_MEM_BUDGET_MB": "1"}
+        )
+        acct.state = "brownout"
+        _memory.install(acct)
+        shed_before = subject.serve_metrics.shed
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(url, {"value": 6})
+        assert e.value.code == 503
+        assert int(e.value.headers.get("Retry-After")) >= 1
+        assert "memory pressure" in e.value.read().decode()
+        assert subject.serve_metrics.shed == shed_before + 1
+
+        acct.state = "ok"
+        assert _post(url, {"value": 7}) == 7 * 3
+    finally:
+        _memory.install(None)
