@@ -432,7 +432,12 @@ def _analyze_critical_path(args) -> int:
 
 
 def _analyze_bench(args) -> int:
-    from pathway_tpu.analysis.bench import BENCH_METRIC_PLANS, bench_verdicts
+    from pathway_tpu.analysis.bench import (
+        BENCH_DEVICE_METRIC_CHAINS,
+        BENCH_METRIC_PLANS,
+        bench_verdicts,
+        device_chain_verdicts,
+    )
 
     verdicts = bench_verdicts()
     if args.json:
@@ -451,21 +456,60 @@ def _analyze_bench(args) -> int:
         except (OSError, json.JSONDecodeError):
             print(f"no artifact at {path}", file=sys.stderr)
             return 1
-        n = 0
+        chain_verdicts = device_chain_verdicts()
+        n = nd = 0
         for entry in artifact:
             if not isinstance(entry, dict):
                 continue
             plan = BENCH_METRIC_PLANS.get(entry.get("metric"))
-            if plan is None:
-                continue
-            name, world = plan
-            entry["plan_verdict"] = verdicts[f"{name}@{world}rank"]
-            n += 1
+            if plan is not None:
+                name, world = plan
+                entry["plan_verdict"] = verdicts[f"{name}@{world}rank"]
+                n += 1
+            chain = BENCH_DEVICE_METRIC_CHAINS.get(entry.get("metric"))
+            if chain is not None and chain in chain_verdicts:
+                entry["device_plan_verdict"] = (
+                    f"device-{chain_verdicts[chain]}"
+                )
+                nd += 1
         sys.path.insert(0, repo)
         from bench_util import write_artifact_atomic
 
         write_artifact_atomic(path, artifact)
-        print(f"annotated {n} metric line(s) in {path}")
+        print(
+            f"annotated {n} metric line(s) "
+            f"(+{nd} device lane(s)) in {path}"
+        )
+    return 0
+
+
+def _analyze_device_plan(args) -> int:
+    from pathway_tpu.analysis.device_plan import (
+        analyze_device_plan,
+        join_profile,
+    )
+
+    report = analyze_device_plan(
+        world=args.processes or 1, mutant=args.device_mutant
+    )
+    if args.profile:
+        try:
+            report = join_profile(report, args.profile)
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(
+                f"[ERROR  ] trace.unreadable {args.profile}\n"
+                f"      {exc}",
+                file=sys.stderr,
+            )
+            return 2
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render())
+    if report.errors():
+        return 2
+    if args.require_device_clean and not report.device_clean:
+        return 1
     return 0
 
 
@@ -573,6 +617,28 @@ def main(argv=None) -> int:
              "variant (never_resume) — the checker must catch it",
     )
     parser.add_argument(
+        "--device-plan", action="store_true",
+        help="Device Doctor: statically lower every registered device "
+             "dispatch chain (fused ingest, KNN scan/write, sharded "
+             "search/write, encoder forward, pallas kernel) with ZERO "
+             "execution and audit donation aliasing, host syncs, "
+             "retrace buckets, the per-chip HBM budget, and the "
+             "mesh/merge layout; combine with --profile TRACE_JSON to "
+             "join measured recompiles onto the static predictions "
+             "(drift verdict), --processes N for the declared world",
+    )
+    parser.add_argument(
+        "--require-device-clean", action="store_true",
+        help="with --device-plan: exit non-zero unless the device "
+             "verdict is 'device-clean' (CI gate)",
+    )
+    parser.add_argument(
+        "--device-mutant", default=None,
+        help="with --device-plan: analyze a deliberately broken chain "
+             "(undonated_write | host_sync | unbounded_buckets | "
+             "over_budget) — the doctor must catch it",
+    )
+    parser.add_argument(
         "--update-artifact", action="store_true",
         help="with --bench: annotate BENCH_full.json lines with "
              "plan_verdict",
@@ -604,6 +670,8 @@ def main(argv=None) -> int:
     from pathway_tpu.analysis.knobs import KnobError
 
     try:
+        if args.device_plan:
+            return _analyze_device_plan(args)
         if args.profile:
             return _analyze_profile(args)
         if args.critical_path:

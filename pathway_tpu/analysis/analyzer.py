@@ -84,6 +84,9 @@ class PlanReport:
     processes: int
     nodes: list[dict] = field(default_factory=list)
     diagnostics: list[Diagnostic] = field(default_factory=list)
+    # Device Doctor sub-report (analyze(device=True)): the
+    # pathway_tpu.analysis.device/v1 dict, None when the pass didn't run
+    device: dict | None = None
 
     @property
     def fully_fused(self) -> bool:
@@ -121,6 +124,7 @@ class PlanReport:
             },
             "nodes": self.nodes,
             "diagnostics": [d.to_dict() for d in self.diagnostics],
+            **({"device": self.device} if self.device is not None else {}),
         }
 
     def to_json(self, **kwargs) -> str:
@@ -707,12 +711,57 @@ def _knob_pass(diags: list[Diagnostic]) -> None:
         )
 
 
+# -- pass 6: device dispatch plane (the Device Doctor) ----------------------
+
+def _device_pass(
+    runtime, diags: list[Diagnostic], processes: int
+) -> dict | None:
+    """Statically lower every registered device chain reachable from the
+    plan (analysis/device_plan.py) — donation aliasing, host syncs,
+    retrace buckets, the per-chip HBM budget, and the mesh/merge layout
+    — with zero execution. Folds the Doctor's diagnostics into the plan
+    report and returns the structured device sub-report. The checks
+    consume the SAME jitted callables and bucket/cost models the runtime
+    dispatch sites use (internals/device.py), so the verdict cannot
+    drift from what actually compiles."""
+    import os
+
+    if os.environ.get(
+        "PATHWAY_DEVICE_DOCTOR", "1"
+    ).strip().lower() in ("0", "false", "no"):
+        return None
+    from pathway_tpu.analysis.device_plan import analyze_device_plan
+
+    reachable: set[str] = set()
+    for node in runtime.scope.nodes:
+        sites = getattr(node, "device_sites", None)
+        if callable(sites):
+            reachable.update(sites())
+    report = analyze_device_plan(world=processes)
+    if reachable:
+        # scope the plan-level blame to chains the plan actually reaches;
+        # the full sub-report still carries every chain's verdict
+        diags.extend(
+            d for d in report.diagnostics
+            if d.severity != "info" and (
+                d.node in reachable
+                or any(d.node.startswith(s.split(".")[0]) for s in reachable)
+            )
+        )
+    else:
+        diags.extend(d for d in report.diagnostics if d.severity == "error")
+    device = report.to_dict()
+    device["reachable_sites"] = sorted(reachable)
+    return device
+
+
 # -- entry points ---------------------------------------------------------
 
 def analyze_scope(
     runtime,
     processes: int | None = None,
     persistence: bool | None = None,
+    device: bool = False,
 ) -> PlanReport:
     """Run all passes over an already-lowered runtime. Purely static:
     reads construction-time node attributes only, so it is valid before,
@@ -730,6 +779,9 @@ def analyze_scope(
     _sink_pass(runtime, diags)
     if processes > 1:
         _mesh_pass(runtime, diags, processes)
+    device_report = (
+        _device_pass(runtime, diags, processes) if device else None
+    )
     _knob_pass(diags)
 
     has_nb_source = any(
@@ -749,6 +801,7 @@ def analyze_scope(
         processes=processes,
         nodes=entries,
         diagnostics=diags,
+        device=device_report,
     )
 
 
@@ -758,6 +811,7 @@ def analyze(
     processes: int | None = None,
     include_outputs: bool = True,
     persistence: bool | None = None,
+    device: bool = False,
 ) -> PlanReport:
     """Statically analyze the captured plan WITHOUT executing it.
 
@@ -800,7 +854,8 @@ def analyze(
         runtime = Runtime(validate_env=False)
         GraphRunner(graph)._lower(ops, runtime)
         return analyze_scope(
-            runtime, processes=world, persistence=persistence
+            runtime, processes=world, persistence=persistence,
+            device=device,
         )
     finally:
         if token is not None:

@@ -215,6 +215,31 @@ BENCH_METRIC_PLANS: dict[str, tuple[str, int]] = {
     "rag_colocated_qps": ("serving", 1),
 }
 
+# BENCH_full.json DEVICE metric name -> Device Doctor chain whose static
+# verdict annotates the line (ISSUE 20): the ingest lanes dispatch
+# through ingest.fused, the query/recall lanes through the KNN scan,
+# and the trace-overhead lane through the bare encoder forward
+BENCH_DEVICE_METRIC_CHAINS: dict[str, str] = {
+    "preflight_ingest": "ingest",
+    "embed_ingest_docs_per_s_per_chip": "ingest",
+    "embed_ingest_fused_docs_per_s_per_chip": "ingest",
+    "rag_query_p50_ms": "knn",
+    "rag_under_load_p50_ms": "knn",
+    "rag_qps_vs_clients": "knn",
+    "rag_latency_model": "knn",
+    "rag_colocated_qps": "knn",
+    "rag_update_while_serving_p50_ms": "knn",
+    "ann_recall_at_10": "knn",
+    "device_trace_overhead": "encoder",
+}
+
+
+def device_chain_verdicts() -> dict[str, str]:
+    """One Device Doctor run; per-chain verdict keyed by chain name."""
+    from pathway_tpu.analysis.device_plan import analyze_device_plan
+
+    return dict(analyze_device_plan().chains)
+
 
 def bench_verdicts() -> dict[str, str]:
     """Plan verdict for every (pipeline, world) the bench artifact

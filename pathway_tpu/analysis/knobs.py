@@ -134,6 +134,28 @@ KNOBS: dict[str, Knob] = {
            "which its roofline verdict reads host-bound (the device "
            "sat idle while the host assembled batches).", lo=0.0,
            hi=1.0),
+        _k("PATHWAY_DEVICE_COST_CACHE_CAP", "int", 512,
+           "Bound on the device plane's per-shape-bucket compiled-cost "
+           "cache (internals/device.py): oldest entries evict beyond "
+           "this many buckets, so a shape-diverse workload cannot grow "
+           "the cache without bound.", lo=1, hi=1_000_000),
+        # -- Device Doctor (analysis/device_plan.py; ISSUE 20) -------------
+        _k("PATHWAY_DEVICE_DOCTOR", "bool", True,
+           "Run the Device Doctor pass inside pw.analyze(device=True): "
+           "statically lower every registered dispatch chain (zero "
+           "execution) and audit donation aliasing, host syncs, retrace "
+           "buckets, HBM budget and mesh layout. 0 skips the pass."),
+        _k("PATHWAY_DEVICE_HBM_BYTES", "int", None,
+           "Override the per-chip HBM budget the Device Doctor's static "
+           "footprint check refuses layouts against. Default: the live "
+           "backend's memory_stats bytes_limit, else the device-kind "
+           "table (TPU v4/v5/v5p/v6e), else 8 GiB — set this on CPU/CI "
+           "to model a target TPU.", lo=1),
+        _k("PATHWAY_DEVICE_PLAN_MAX_BUCKETS", "int", 64,
+           "Retrace-audit threshold: a declared workload implying more "
+           "compiled shape buckets than this at one dispatch site gets "
+           "a retrace-storm warning (compile time and executable memory "
+           "scale with every bucket).", lo=1, hi=1_000_000),
         # -- fused ingest + pod-sharded index (ISSUE 16) -------------------
         _k("PATHWAY_INGEST_DEPTH", "int", 2,
            "Tokenize-ahead depth of the fused ingest chain "

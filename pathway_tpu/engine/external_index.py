@@ -59,6 +59,17 @@ class ExternalIndexNode(Node):
     # dispatch records to this node's span (engine/nodes.py)
     device_node = True
 
+    def device_sites(self) -> tuple:
+        """Registered device-site names reachable through this node's
+        adapter (ISSUE 20): the Device Doctor's reachability hook. An
+        adapter exposes ``device_sites`` as an attribute or zero-arg
+        callable (KnnShard / ShardedKnnIndex ship it); adapters without
+        one contribute no statically-analyzable dispatch chain."""
+        sites = getattr(self.adapter, "device_sites", None)
+        if callable(sites):
+            sites = sites()
+        return tuple(sites) if sites else ()
+
     def __init__(
         self,
         scope,

@@ -41,6 +41,7 @@ able to load it for free.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import sys
 import threading
@@ -234,6 +235,20 @@ def platform_info() -> dict | None:
 _COST_CACHE: dict = {}
 
 
+def _cost_cache_cap() -> int:
+    """PATHWAY_DEVICE_COST_CACHE_CAP: entry bound on the per-shape-key
+    compiled-cost cache. Well-behaved sites keep bounded shape sets by
+    design, but an adversarial shape stream (a bucket leak upstream of
+    the cost lookup) would otherwise grow the cache without limit —
+    eviction is insertion-ordered (oldest shape key first)."""
+    raw = os.environ.get("PATHWAY_DEVICE_COST_CACHE_CAP", "")
+    try:
+        v = int(raw) if raw.strip() else 512
+    except ValueError:
+        v = 512
+    return max(1, v)
+
+
 def compiled_cost(
     key: tuple,
     fn: Any,
@@ -245,9 +260,11 @@ def compiled_cost(
     falling back to the caller's analytical model. Cached per ``key`` —
     dispatch sites keep bounded shape sets by design (pow2 batch
     buckets, capacity doublings), so the AOT lower+compile runs once
-    per shape, not per dispatch. ``fn=None`` skips the attempt entirely
-    (sites whose executables are too big to recompile for bookkeeping,
-    e.g. the 1M-row KNN scan).
+    per shape, not per dispatch; the cache itself is bounded (ISSUE 20:
+    ``PATHWAY_DEVICE_COST_CACHE_CAP``, oldest-first eviction) so an
+    adversarial shape stream cannot grow it without limit. ``fn=None``
+    skips the attempt entirely (sites whose executables are too big to
+    recompile for bookkeeping, e.g. the 1M-row KNN scan).
     """
     hit = _COST_CACHE.get(key)
     if hit is not None:
@@ -266,6 +283,9 @@ def compiled_cost(
                 nbytes = ca_bytes
         except Exception:
             pass
+    cap = _cost_cache_cap()
+    while len(_COST_CACHE) >= cap:
+        _COST_CACHE.pop(next(iter(_COST_CACHE)))
     _COST_CACHE[key] = (flops, nbytes)
     return flops, nbytes
 
@@ -282,6 +302,223 @@ def nbytes_of(*arrays: Any) -> int:
             except (TypeError, ValueError):
                 pass
     return total
+
+
+# -- device-site registry (ISSUE 20) -----------------------------------------
+# Every dispatch site declares itself here at import time: its analytical
+# cost model, the dtypes its device buffers carry, which inputs it donates
+# and where the dispatch lives. The Device Doctor (analysis/device_plan.py)
+# walks THIS registry — not a parallel hand-maintained list — so a site
+# added in ops/ without a registration is registry drift, caught by
+# scripts/lint_gil.py pass 4.
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSite:
+    """One registered device-dispatch site.
+
+    ``cost_model`` is the SAME callable the runtime site feeds into its
+    dispatch records (``-> (flops, bytes_accessed)``) — the anti-drift
+    contract: analyzer predictions and runtime attribution compute from
+    one object. ``donates`` names the buffers the site's jitted callable
+    donates (empty for read-only / host-only sites)."""
+
+    name: str
+    cost_model: Any
+    dtypes: tuple
+    where: str = ""
+    donates: tuple = ()
+    description: str = ""
+
+
+_SITE_REGISTRY: dict[str, DeviceSite] = {}
+
+
+def device_site(
+    name: str,
+    *,
+    cost_model: Any,
+    dtypes: Any,
+    where: str = "",
+    donates: Any = (),
+    description: str = "",
+) -> DeviceSite:
+    """Register (or re-register — module reloads are idempotent) one
+    dispatch site. Keyword-only by design: lint_gil pass 4 checks every
+    registration names its ``cost_model=`` and ``dtypes=`` explicitly."""
+    site = DeviceSite(
+        name, cost_model, tuple(dtypes), where, tuple(donates), description
+    )
+    _SITE_REGISTRY[name] = site
+    return site
+
+
+def registered_sites() -> dict[str, DeviceSite]:
+    """Snapshot of the registry (name -> DeviceSite)."""
+    return dict(_SITE_REGISTRY)
+
+
+# -- shared shape-bucket models (ISSUE 20) -----------------------------------
+# The bucket functions the dispatch sites pad with ARE the functions the
+# retrace audit enumerates with (the eligibility.py discipline: predicates
+# the analyzer gates on are the objects the runtime consumes). Sites alias
+# these — tests pin the identities — so the predicted shape-bucket set and
+# the runtime's seen-bucket keys cannot drift.
+
+
+def batch_bucket(n: int, floor: int, cap: int) -> int:
+    """Pow2 batch bucket from ``floor``, capped — the encoder's batch
+    padding (models/encoder.py ``pad_batch``)."""
+    b = floor
+    while b < n and b < cap:
+        b *= 2
+    return min(b, cap)
+
+
+def seq_bucket(L: int, cap: int) -> int:
+    """Multiple-of-32 sequence bucket (floor 16), capped — the encoder's
+    sequence padding."""
+    if L <= 16:
+        return 16
+    return min(((L + 31) // 32) * 32, cap)
+
+
+def pow2_capacity(n: int, floor: int = 128) -> int:
+    """Pow2 index capacity from the 128-slot floor — KnnShard's growth
+    schedule (each distinct capacity is a fresh XLA executable)."""
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+def query_pad(n: int) -> int:
+    """Pow2 query-batch padding from 1 — the search sites' batch set."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def knn_search_bucket(
+    n: int, capacity: int, k: int, chunk: int | None
+) -> tuple:
+    """Compiled-shape key of one ``knn.search`` dispatch: (padded query
+    batch, capacity, effective k). Effective k mirrors the site's own
+    clamp — top_k per scored block cannot exceed the block width."""
+    k_eff = min(k, capacity, chunk or 8192)
+    return (query_pad(n), capacity, k_eff)
+
+
+def knn_write_bucket(nrows: int, capacity: int) -> tuple:
+    """Compiled-shape key of one ``knn.write`` slot-write dispatch. The
+    row count is data-driven (writes are not padded), so an unbounded
+    write-batch-size distribution IS an unbounded executable set — the
+    retrace audit flags exactly that."""
+    return (nrows, capacity)
+
+
+def pallas_bucket(
+    q: int, cap: int, d: int, k: int, block: int, interpret: bool = False
+) -> tuple:
+    """Compiled-shape key of one ``pallas.topk`` kernel launch (every
+    field is a static arg or an input dim of the pallas_call)."""
+    return (q, cap, d, k, block, bool(interpret))
+
+
+def sharded_search_bucket(
+    n: int, n_shards: int, local_cap: int, k: int, chunk: int | None
+) -> tuple:
+    """Compiled-shape key of one ``knn.sharded_search`` dispatch —
+    effective k mirrors ShardedKnnIndex.search's clamp (per-shard
+    partial k capped by shard rows, merged up to total capacity)."""
+    k_eff = min(k, n_shards * min(local_cap, chunk or local_cap))
+    return (query_pad(n), n_shards * local_cap, k_eff)
+
+
+def sharded_write_bucket(nrows: int, capacity: int) -> tuple:
+    """Compiled-shape key of one ``knn.sharded_write`` dispatch."""
+    return (nrows, capacity)
+
+
+def ingest_bucket(nb: int, Lb: int, capacity: int, ids_dtype: str) -> tuple:
+    """Compiled-shape key of one ``ingest.fused`` chain dispatch (batch
+    bucket x seq bucket x index capacity x wire dtype)."""
+    return (nb, Lb, capacity, ids_dtype)
+
+
+def encoder_bucket(nb: int, Lb: int, compact: bool) -> tuple:
+    """Compiled-shape key of one ``encoder.forward`` dispatch."""
+    return (nb, Lb, bool(compact))
+
+
+# -- static HBM budget (ISSUE 20) --------------------------------------------
+# Per-device-kind HBM capacity for the Device Doctor's static footprint
+# check; PATHWAY_DEVICE_HBM_BYTES overrides (the CPU/CI lever — model a
+# v5e budget on a devbox), allocator stats win when the backend has them.
+_HBM_TABLE: tuple[tuple[str, float], ...] = (
+    ("v6", 32e9),
+    ("v5p", 95e9),
+    ("v5", 16e9),
+    ("v4", 32e9),
+    ("v3", 32e9),
+    ("v2", 16e9),
+)
+_HBM_FALLBACK = 8 * 1024**3
+
+
+def device_hbm_bytes(kind: str | None = None) -> int:
+    """Per-chip HBM budget in bytes: ``PATHWAY_DEVICE_HBM_BYTES`` wins,
+    then the backend's own allocator limit, then the device-kind table,
+    then a deliberately small 8 GiB fallback (CPU/CI: the budget check
+    still means something on a host with no HBM story)."""
+    raw = os.environ.get("PATHWAY_DEVICE_HBM_BYTES", "")
+    if raw.strip():
+        try:
+            v = int(float(raw))
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    ms = memory_stats()
+    if ms is not None:
+        try:
+            lim = int(ms.get("bytes_limit", 0) or 0)
+        except (TypeError, ValueError):
+            lim = 0
+        if lim > 0:
+            return lim
+    kind = device_kind() if kind is None else kind
+    low = kind.lower()
+    for sub, b in _HBM_TABLE:
+        if sub in low:
+            return int(b)
+    return _HBM_FALLBACK
+
+
+def index_shard_bytes(capacity: int, dim: int, *, donated: bool = True) -> float:
+    """Steady-state HBM of one index shard's buffer triple: f32 vectors
+    [capacity, dim] + bool valid [capacity] + f32 sq_norms [capacity].
+    An UN-donated write keeps the old triple alive across the dispatch
+    — the doctor's donation audit bills exactly this doubling."""
+    steady = 4.0 * capacity * dim + 1.0 * capacity + 4.0 * capacity
+    return steady if donated else 2.0 * steady
+
+
+def ingest_staging_bytes(
+    nb: int, Lb: int, ids_itemsize: int = 2, *, depth: int = 2
+) -> float:
+    """H2D staging footprint of the tokenize-ahead ingest loop: ``depth``
+    in-flight batches of (ids [nb, Lb] at the wire itemsize + i32
+    lengths [nb])."""
+    per = float(nb) * float(Lb) * float(ids_itemsize) + 4.0 * nb
+    return float(depth) * per
+
+
+def snapshot_staging_bytes(capacity: int, dim: int) -> float:
+    """Worst-case staging of an epoch-aligned index snapshot cut: one
+    host-bound copy of the buffer triple in flight."""
+    return 4.0 * capacity * dim + 1.0 * capacity + 4.0 * capacity
 
 
 # -- the plane ---------------------------------------------------------------

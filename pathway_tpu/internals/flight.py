@@ -670,6 +670,16 @@ class FlightRecorder:
         # (pure relational run; platform_info never imports jax itself)
         from pathway_tpu.internals.device import platform_info
 
+        # per-site recompile counters (ISSUE 20): the Device Doctor's
+        # --profile join diffs these measured counts against its static
+        # shape-bucket predictions (predicted-vs-measured drift verdict)
+        recompiles: dict = {}
+        stats = getattr(
+            getattr(getattr(scope, "runtime", None), "stats", None),
+            "device_recompiles", None,
+        )
+        if stats:
+            recompiles = dict(stats)
         return {
             "schema": TRACE_SCHEMA_VERSION,
             "rank": self.rank,
@@ -678,6 +688,7 @@ class FlightRecorder:
             "capped": capped,
             "dropped_events": self.dropped,
             "platform": platform_info(),
+            "device_recompiles": recompiles,
             "clock_offset_ns": self.clock_offset_ns,
             "offset_segments": [
                 [s, o] for s, o in self._offset_segments

@@ -50,7 +50,7 @@ from typing import Any, Sequence
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import faults as _faults
 from pathway_tpu.internals import memory as _memory
-from pathway_tpu.internals.device import PLANE as _DEVICE
+from pathway_tpu.internals.device import PLANE as _DEVICE, device_site
 from pathway_tpu.internals.api import Json, Pointer, ref_scalar
 from pathway_tpu.internals.monitoring import ServeMetrics
 from pathway_tpu.internals.parse_graph import G
@@ -61,6 +61,19 @@ from pathway_tpu.io.python import ConnectorSubject, read as python_read
 # protocol decisions (parallel/protocol.py breaker_decide) shared with
 # the serving model checker — see ISSUE 9
 from pathway_tpu.parallel import protocol as _proto
+
+device_site(
+    "serve.window",
+    # host-only site: the window commit launches no device work itself
+    # (the downstream index site records its own device-bounded span),
+    # so the model is honestly zero — registered anyway because every
+    # begin() site must be in the registry (lint_gil pass 4)
+    cost_model=lambda *a: (0.0, 0.0),
+    dtypes=(),
+    where="pathway_tpu/io/http/_server.py:_dispatch_window",
+    description="serving gateway windowed commit (host-only record, "
+                "device time honestly zero)",
+)
 
 
 def _env_knob(name: str, default: float) -> float:

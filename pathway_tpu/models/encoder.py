@@ -30,8 +30,12 @@ import flax.linen as nn
 
 from pathway_tpu.internals.device import (
     PLANE as _DEVICE,
+    batch_bucket,
     compiled_cost,
+    device_site,
+    encoder_bucket,
     nbytes_of,
+    seq_bucket,
 )
 from pathway_tpu.models.tokenizer import get_tokenizer
 
@@ -125,6 +129,17 @@ def forward_flops_per_token(cfg: EncoderConfig, seq_len: int) -> float:
     return cfg.layers * per_layer
 
 
+def encoder_param_bytes(cfg: EncoderConfig) -> float:
+    """HBM bytes of the f32 parameter set (embedding tables + per-layer
+    attention/MLP weights) — shared by the forward cost model's traffic
+    estimate and the Device Doctor's static HBM budget (ISSUE 20)."""
+    h, m = cfg.hidden, cfg.mlp
+    return 4.0 * (
+        cfg.vocab_size * h + cfg.max_len * h
+        + cfg.layers * (4.0 * h * h + 2.0 * h * m)
+    )
+
+
 def forward_cost_model(
     cfg: EncoderConfig, n: int, seq_len: int
 ) -> tuple[float, float]:
@@ -135,30 +150,25 @@ def forward_cost_model(
     set (weights dominate HBM traffic at serving batch sizes) plus a
     few bf16 activation passes per layer."""
     flops = forward_flops_per_token(cfg, seq_len) * n * seq_len
-    h, m = cfg.hidden, cfg.mlp
-    params_b = 4.0 * (
-        cfg.vocab_size * h + cfg.max_len * h
-        + cfg.layers * (4.0 * h * h + 2.0 * h * m)
-    )
+    h = cfg.hidden
     act_b = 2.0 * n * seq_len * h * cfg.layers * 4.0
-    return flops, params_b + act_b
+    return flops, encoder_param_bytes(cfg) + act_b
 
 
-def _bucket(n: int, floor: int, cap: int) -> int:
-    b = floor
-    while b < n and b < cap:
-        b *= 2
-    return min(b, cap)
+# shared-bucket aliases (ISSUE 20): the padding the jit sees and the shape
+# set the Device Doctor's retrace audit enumerates are the SAME functions
+# (internals/device.py) — tests pin these identities so they cannot drift
+_bucket = batch_bucket
+_seq_bucket = seq_bucket
 
-
-def _seq_bucket(L: int, cap: int) -> int:
-    """Sequence buckets at multiples of 32 (floor 16): finer than pow2
-    doubling, so a ~90-token batch pads to 96 instead of 128 — ~25% less
-    padded device work per doc at a bounded shape count (<= cap/32
-    executables)."""
-    if L <= 16:
-        return 16
-    return min(((L + 31) // 32) * 32, cap)
+device_site(
+    "encoder.forward",
+    cost_model=forward_cost_model,
+    dtypes=("uint16", "int32", "float32", "bfloat16"),
+    where="pathway_tpu/models/encoder.py:SentenceEncoder.encode_tokens_device",
+    description="jitted sentence-encoder forward "
+                "(pow2 batch x multiple-of-32 seq buckets)",
+)
 
 
 def pad_batch(ids: np.ndarray, mask: np.ndarray, max_len: int, batch_cap: int):
@@ -294,7 +304,7 @@ class SentenceEncoder:
         dev = _DEVICE.begin("encoder.forward") if _DEVICE.on else None
         compact = contiguous and self.config.vocab_size <= 65536
         nb_, Lb = ids_p.shape
-        bucket = (nb_, Lb, compact)
+        bucket = encoder_bucket(nb_, Lb, compact)
         fn = self._compiled.get(bucket)
         if fn is None:
             # first sighting of this shape bucket: jit will lower+compile

@@ -41,7 +41,12 @@ import jax
 import jax.numpy as jnp
 
 from pathway_tpu.internals import device as _devsup
-from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
+from pathway_tpu.internals.device import (
+    PLANE as _DEVICE,
+    device_site,
+    ingest_bucket,
+    nbytes_of,
+)
 from pathway_tpu.internals.faults import fault_point
 from pathway_tpu.models.encoder import (
     SentenceEncoder,
@@ -49,6 +54,16 @@ from pathway_tpu.models.encoder import (
     pad_batch,
 )
 from pathway_tpu.ops.knn import KnnShard, Metric
+
+device_site(
+    "ingest.fused",
+    cost_model=forward_cost_model,
+    dtypes=("uint16", "int32", "float32", "bool"),
+    where="pathway_tpu/ops/ingest.py:IngestPipeline._dispatch",
+    donates=("vectors", "valid", "sq_norms"),
+    description="fused tokenize->encode->scatter-write chain "
+                "(index triple donated, in-place in HBM)",
+)
 
 
 def _env_int(name: str, default: int) -> int:
@@ -62,6 +77,40 @@ def _env_int(name: str, default: int) -> int:
 def _env_on(name: str, default: bool = True) -> bool:
     raw = str(os.environ.get(name, "1" if default else "0")).strip().lower()
     return raw not in ("0", "false", "no")
+
+
+# args 4..6 of the fused chain are the index buffer triple — donated so
+# the slot-write is in-place in HBM. Module-level so the Device Doctor's
+# donation audit checks the SAME argnums the pipeline jits with.
+FUSED_DONATE_ARGNUMS = (4, 5, 6)
+
+
+def make_fused(model):
+    """The un-jitted fused chain body: encoder forward → scatter
+    slot-write of the (already L2-normalized) embeddings into the index
+    triple. Module-level so the Device Doctor (analysis/device_plan.py)
+    lowers the SAME code object the pipeline dispatches — the anti-drift
+    contract; ``IngestPipeline`` jits exactly this with
+    ``donate_argnums=FUSED_DONATE_ARGNUMS``."""
+
+    def fused(params, ids, lengths, slots, vectors, valid, sq_norms):
+        mask = (
+            jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
+            < lengths[:, None]
+        ).astype(jnp.int32)
+        emb = model.apply({"params": params}, ids.astype(jnp.int32), mask)
+        # padded rows carry slot == capacity: out of bounds, dropped
+        # by the scatter — no separate masking pass
+        vectors = vectors.at[slots].set(emb, mode="drop")
+        valid = valid.at[slots].set(
+            jnp.ones(slots.shape, bool), mode="drop"
+        )
+        sq_norms = sq_norms.at[slots].set(
+            jnp.sum(emb * emb, axis=-1), mode="drop"
+        )
+        return emb, vectors, valid, sq_norms
+
+    return fused
 
 
 class IngestPipeline:
@@ -114,28 +163,11 @@ class IngestPipeline:
         self.rows_ingested = 0
         self.real_tokens = 0
         self.padded_tokens = 0
-        model = encoder.model
-
-        def fused(params, ids, lengths, slots, vectors, valid, sq_norms):
-            mask = (
-                jnp.arange(ids.shape[1], dtype=jnp.int32)[None, :]
-                < lengths[:, None]
-            ).astype(jnp.int32)
-            emb = model.apply({"params": params}, ids.astype(jnp.int32), mask)
-            # padded rows carry slot == capacity: out of bounds, dropped
-            # by the scatter — no separate masking pass
-            vectors = vectors.at[slots].set(emb, mode="drop")
-            valid = valid.at[slots].set(
-                jnp.ones(slots.shape, bool), mode="drop"
-            )
-            sq_norms = sq_norms.at[slots].set(
-                jnp.sum(emb * emb, axis=-1), mode="drop"
-            )
-            return emb, vectors, valid, sq_norms
-
         # donate the index triple: the slot-write is in-place in HBM —
         # the whole point of fusing encode and insert into one chain
-        self._fused = jax.jit(fused, donate_argnums=(4, 5, 6))
+        self._fused = jax.jit(
+            make_fused(encoder.model), donate_argnums=FUSED_DONATE_ARGNUMS
+        )
 
     # -- host stage --------------------------------------------------------
     def _stage(self, keys: Sequence[Any], texts: Sequence[str]):
@@ -180,7 +212,7 @@ class IngestPipeline:
                 # sentinel the scatter drops
                 slots_full = np.full((nb,), cap, np.int32)
                 slots_full[:n] = slots
-                bucket = (nb, Lb, cap, ids_dev.dtype.name)
+                bucket = ingest_bucket(nb, Lb, cap, ids_dev.dtype.name)
                 if bucket not in self._seen_buckets:
                     self._seen_buckets.add(bucket)
                     _DEVICE.note_recompile(self.site)

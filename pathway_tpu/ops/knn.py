@@ -24,7 +24,14 @@ import jax
 import jax.numpy as jnp
 
 from pathway_tpu.internals import device as _devsup
-from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
+from pathway_tpu.internals.device import (
+    PLANE as _DEVICE,
+    device_site,
+    knn_search_bucket,
+    knn_write_bucket,
+    nbytes_of,
+    pow2_capacity,
+)
 from pathway_tpu.ops.topk import chunked_topk_scores, topk_scan_cost
 
 _MIN_CAPACITY = 128
@@ -36,11 +43,37 @@ class Metric(enum.Enum):
     DOT = "dot"
 
 
-def _next_pow2(n: int) -> int:
-    p = _MIN_CAPACITY
-    while p < n:
-        p *= 2
-    return p
+# shared-bucket alias (ISSUE 20): the capacity schedule jit sees and the
+# shape set the Device Doctor enumerates are the SAME function — pinned
+# by tests so they cannot drift
+_next_pow2 = pow2_capacity
+
+
+def write_cost_model(nrows: int, d: int) -> tuple[float, float]:
+    """Analytical ``(flops, bytes_accessed)`` of one slot-write scatter:
+    the optional normalize + sq-norm reduction over the written rows,
+    touching the rows + norms in HBM. Shared by the ``knn.write`` /
+    ``knn.sharded_write`` dispatch records and the Device Doctor's
+    per-dispatch copy-cost blame (ISSUE 20)."""
+    return 4.0 * nrows * d, 8.0 * nrows * d + 8.0 * nrows
+
+
+device_site(
+    "knn.write",
+    cost_model=write_cost_model,
+    dtypes=("float32", "bool", "int32"),
+    where="pathway_tpu/ops/knn.py:KnnShard.add",
+    donates=("vectors", "valid", "sq_norms"),
+    description="donated in-place slot-write into the HBM buffer triple",
+)
+
+device_site(
+    "knn.search",
+    cost_model=topk_scan_cost,
+    dtypes=("float32", "bool", "int32"),
+    where="pathway_tpu/ops/knn.py:KnnShard.search",
+    description="fused matmul + top-k scan over the padded vector store",
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -140,6 +173,15 @@ class KnnShard:
         self._dirty_removed: dict[Any, None] = {}
         self._segments: list[dict] = []
         self._retired: list[list[str]] = []
+        # seen compiled-shape buckets (ISSUE 20): a write/search key not
+        # in this set is — by jit's cache discipline — a fresh XLA
+        # compilation, ticked on device_site_recompiles_total so the
+        # retrace audit's predictions pin against honest counters
+        self._seen_buckets: set = set()
+
+    # device sites reachable through this index as an external-index
+    # adapter (the Device Doctor's plan-reachability hook, ISSUE 20)
+    device_sites = ("knn.write", "knn.search")
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
@@ -229,6 +271,10 @@ class KnnShard:
         with self.lock:
             slots = self._assign_slots(keys)
             slots_arr = jnp.asarray(slots)
+            bucket = knn_write_bucket(len(slots), self.capacity)
+            if bucket not in self._seen_buckets:
+                self._seen_buckets.add(bucket)
+                _DEVICE.note_recompile("knn.write")
             dev = _DEVICE.begin("knn.write") if _DEVICE.on else None
 
             def _launch():
@@ -257,12 +303,12 @@ class KnnShard:
             # blocking on an invalidated array is absorbed by end()).
             # Scatter writes: touch the written rows + norms; FLOPs are
             # the optional normalize + sq-norm reduction.
-            nrows, d = len(slots), self.dimension
+            flops, acc = write_cost_model(len(slots), self.dimension)
             _DEVICE.end(
                 dev, out_vectors,
-                flops=4.0 * nrows * d,
-                bytes_accessed=8.0 * nrows * d + 8.0 * nrows,
-                transfer_bytes=nbytes_of(vecs) + 4 * nrows,
+                flops=flops,
+                bytes_accessed=acc,
+                transfer_bytes=nbytes_of(vecs) + 4 * len(slots),
             )
 
     def remove(self, keys: Sequence[Any]) -> None:
@@ -357,11 +403,14 @@ class KnnShard:
         n = queries.shape[0]
         if n == 0 or not self.key_to_slot:
             return [[] for _ in range(n)]
-        # top_k per scored block cannot exceed the block width
-        k_eff = min(k, self.capacity, self.chunk or 8192)
-        padded_n = 1
-        while padded_n < n:
-            padded_n *= 2
+        # shared bucket key (ISSUE 20): pow2 query padding and the k
+        # clamp (top_k per scored block cannot exceed the block width)
+        # come from the SAME function the retrace audit enumerates with
+        bucket = knn_search_bucket(n, self.capacity, k, self.chunk)
+        padded_n, _, k_eff = bucket
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            _DEVICE.note_recompile("knn.search")
         if padded_n != n:
             pad = [(0, padded_n - n), (0, 0)]
             queries = (

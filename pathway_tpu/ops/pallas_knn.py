@@ -22,7 +22,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from pathway_tpu.internals.device import PLANE as _DEVICE
+from pathway_tpu.internals.device import (
+    PLANE as _DEVICE,
+    device_site,
+    pallas_bucket,
+)
 
 NEG_INF = float("-inf")
 
@@ -92,6 +96,20 @@ def pallas_knn_cost(
     return flops, bytes_accessed
 
 
+device_site(
+    "pallas.topk",
+    cost_model=pallas_knn_cost,
+    dtypes=("float32", "int32"),
+    where="pathway_tpu/ops/pallas_knn.py:pallas_topk_scores",
+    description="fused Pallas matmul + running top-k over VMEM blocks",
+)
+
+# seen compiled-shape buckets (ISSUE 20): every static-arg/shape combo of
+# the pallas_call is one executable; a fresh key ticks
+# device_site_recompiles_total so the retrace audit pins honest counters
+_SEEN_BUCKETS: set = set()
+
+
 def pallas_topk_scores(
     queries: jax.Array,    # [Q, D] f32
     database: jax.Array,   # [cap, D] f32
@@ -106,6 +124,11 @@ def pallas_topk_scores(
     Host wrapper over the jitted kernel so the device plane (ISSUE 15)
     can record a timed dispatch per call — one attribute check when
     tracing is off."""
+    q, d = queries.shape
+    bucket = pallas_bucket(q, database.shape[0], d, k, block, interpret)
+    if bucket not in _SEEN_BUCKETS:
+        _SEEN_BUCKETS.add(bucket)
+        _DEVICE.note_recompile("pallas.topk")
     if not _DEVICE.on:
         return _pallas_topk_scores_jit(
             queries, database, add_mask, k=k, block=block,
@@ -120,7 +143,6 @@ def pallas_topk_scores(
     except BaseException:
         _DEVICE.end(dev, None, block=False)
         raise
-    q, d = queries.shape
     flops, acc = pallas_knn_cost(q, database.shape[0], d, k, block)
     _DEVICE.end(dev, out, flops=flops, bytes_accessed=acc)
     return out

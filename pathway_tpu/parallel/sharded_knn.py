@@ -41,8 +41,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pathway_tpu.internals import device as _devsup
-from pathway_tpu.internals.device import PLANE as _DEVICE, nbytes_of
-from pathway_tpu.ops.knn import Metric, _write_slots
+from pathway_tpu.internals.device import (
+    PLANE as _DEVICE,
+    device_site,
+    nbytes_of,
+    sharded_search_bucket,
+    sharded_write_bucket,
+)
+from pathway_tpu.ops.knn import Metric, _write_slots, write_cost_model
 from pathway_tpu.ops.topk import (
     chunked_topk_scores,
     topk_scan_cost,
@@ -64,6 +70,45 @@ def _merge_mode(n_shards: int) -> str:
     if raw == "gather":
         return "gather"
     return "tree" if pow2 else "gather"
+
+
+device_site(
+    "knn.sharded_write",
+    cost_model=write_cost_model,
+    dtypes=("float32", "bool", "int32"),
+    where="pathway_tpu/parallel/sharded_knn.py:ShardedKnnIndex.add",
+    donates=("vectors", "valid", "sq_norms"),
+    description="donated slot-write into the mesh-sharded buffer triple "
+                "(out_shardings pinned to the shard layout)",
+)
+
+device_site(
+    "knn.sharded_search",
+    cost_model=topk_scan_cost,
+    dtypes=("float32", "bool", "int32"),
+    where="pathway_tpu/parallel/sharded_knn.py:ShardedKnnIndex.search",
+    description="per-shard fused matmul+top-k with tree/gather merge "
+                "over the mesh axis",
+)
+
+
+def make_sharded_write(mesh: Mesh, axis: str):
+    """The donated, layout-pinned batched slot-write for one mesh:
+    returns ``(jitted_fn, out_shardings)``. Module-level so the Device
+    Doctor (analysis/device_plan.py) builds the SAME jit — donation
+    argnums, static args AND the out_shardings pin — that
+    ``ShardedKnnIndex`` dispatches; the mesh-layout check introspects
+    the returned shardings instead of guessing."""
+    db = NamedSharding(mesh, P(axis, None))
+    row = NamedSharding(mesh, P(axis))
+    out_shardings = (db, row, row)
+    fn = jax.jit(
+        _write_slots.__wrapped__,
+        static_argnames=("normalize",),
+        donate_argnums=(0, 1, 2),
+        out_shardings=out_shardings,
+    )
+    return fn, out_shardings
 
 
 def sharded_topk(
@@ -232,20 +277,25 @@ class ShardedKnnIndex:
         self._dirty_removed: dict[Any, None] = {}
         self._segments: list[dict] = []
         self._retired: list[list[str]] = []
+        # seen compiled-shape buckets (ISSUE 20): fresh write/search
+        # keys tick device_site_recompiles_total — the retrace audit's
+        # predictions pin against these counters
+        self._seen_buckets: set = set()
         # batched slot-write with the shard layout pinned on the outputs
         # (the scatter must not silently replicate the store); same body
-        # as the single-chip shard's donated writer
-        self._write = jax.jit(
-            _write_slots.__wrapped__,
-            static_argnames=("normalize",),
-            donate_argnums=(0, 1, 2),
-            out_shardings=(
-                self._db_sharding, self._row_sharding, self._row_sharding
-            ),
+        # as the single-chip shard's donated writer. The builder is the
+        # shared object the Device Doctor lowers (ISSUE 20); the
+        # shardings it pinned stay introspectable for the mesh check.
+        self._write, self._write_out_shardings = make_sharded_write(
+            mesh, axis
         )
 
     def __len__(self) -> int:
         return len(self.key_to_slot)
+
+    # device sites reachable through this index as an external-index
+    # adapter (the Device Doctor's plan-reachability hook, ISSUE 20)
+    device_sites = ("knn.sharded_write", "knn.sharded_search")
 
     # -- routing -----------------------------------------------------------
     def owner_shard(self, key) -> int:
@@ -396,6 +446,10 @@ class ShardedKnnIndex:
         try:
             with self.lock:
                 slots = self._assign_slots(keys)
+                bucket = sharded_write_bucket(len(slots), self.capacity)
+                if bucket not in self._seen_buckets:
+                    self._seen_buckets.add(bucket)
+                    _DEVICE.note_recompile("knn.sharded_write")
                 # supervised dispatch (ISSUE 17): injected faults raise
                 # before the launch so retry is safe; donation failures
                 # classify permanent and abort the epoch
@@ -415,12 +469,12 @@ class ShardedKnnIndex:
             _DEVICE.end(dev, None, block=False)
             raise
         if dev is not None:
-            nrows, d = len(keys), self.dimension
+            flops, acc = write_cost_model(len(keys), self.dimension)
             _DEVICE.end(
                 dev, out_vectors,
-                flops=4.0 * nrows * d,
-                bytes_accessed=8.0 * nrows * d + 8.0 * nrows,
-                transfer_bytes=nbytes_of(vecs) + 4 * nrows,
+                flops=flops,
+                bytes_accessed=acc,
+                transfer_bytes=nbytes_of(vecs) + 4 * len(keys),
             )
 
     # batch-adapter alias (engine/external_index.py batched delta path)
@@ -532,15 +586,17 @@ class ShardedKnnIndex:
         n = queries.shape[0]
         if n == 0 or not self.key_to_slot:
             return [[] for _ in range(n)]
-        # per-shard partial k is capped inside sharded_topk; the merged
-        # result honors up to min(k, total capacity) — a requested k above
-        # one shard's capacity is no longer silently truncated
-        k_eff = min(
-            k, self.n_shards * min(self.local_cap, self.chunk or self.local_cap)
+        # shared bucket key (ISSUE 20): pow2 query padding and the k
+        # clamp (per-shard partial k capped inside sharded_topk, merged
+        # up to min(k, total capacity)) come from the SAME function the
+        # retrace audit enumerates with
+        bucket = sharded_search_bucket(
+            n, self.n_shards, self.local_cap, k, self.chunk
         )
-        padded_n = 1
-        while padded_n < n:
-            padded_n *= 2
+        padded_n, _, k_eff = bucket
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            _DEVICE.note_recompile("knn.sharded_search")
         if padded_n != n:
             queries = np.concatenate(
                 [queries, np.zeros((padded_n - n, self.dimension), np.float32)]

@@ -211,6 +211,12 @@ class _KnnAdapter:
         self.meta: dict[Any, Any] = {}
         self.filter_errors = _FilterErrorLog()
 
+    def device_sites(self) -> tuple:
+        """Registered device-site names this adapter dispatches through
+        (ISSUE 20): the Device Doctor's reachability hook, forwarded
+        from the wrapped shard (knn.write/search or the sharded pair)."""
+        return tuple(getattr(self.shard, "device_sites", ()) or ())
+
     def add(self, key, data, filter_data) -> None:
         vec = np.asarray(data, dtype=np.float32)
         self.shard.add([key], vec[None, :] if vec.ndim == 1 else vec)

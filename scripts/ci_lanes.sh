@@ -225,4 +225,25 @@ echo "=== lane 17: backpressure smoke (bounded-memory firehose + pacing) ==="
 # tests/test_backpressure.py.
 env -u PATHWAY_LANE_PROCESSES python scripts/backpressure_smoke.py
 
+echo "=== lane 18: device doctor (static dispatch-plane analysis) ==="
+# zero-execution lowering of every registered device chain (fused
+# ingest, KNN scan/write, sharded search/write, encoder forward):
+# donation aliasing, host syncs, retrace buckets, HBM budget and mesh
+# layout must all verify device-clean on the shipped chains (exit 0
+# under --require-device-clean), and each seeded defect class must be
+# caught statically with exit 2: an un-donated index write, a mid-chain
+# .item() host sync, an unbounded shape-bucket pipeline, and an
+# over-budget shard layout. The predicted shape buckets/recompiles are
+# pinned against runtime device_recompiles_total in
+# tests/test_plan_vs_runtime.py (zero false "clean").
+env -u PATHWAY_LANE_PROCESSES python -m pathway_tpu.analysis \
+  --device-plan --require-device-clean
+for mutant in undonated_write host_sync unbounded_buckets over_budget; do
+  if env -u PATHWAY_LANE_PROCESSES python -m pathway_tpu.analysis \
+      --device-plan --device-mutant "$mutant" >/dev/null 2>&1; then
+    echo "device doctor FAILED to catch seeded mutant: $mutant" >&2
+    exit 1
+  fi
+done
+
 echo "=== all lanes green ==="

@@ -41,6 +41,17 @@ classes the fused-chain executor depends on:
    the lint names the write discipline, the sanitizer checks the
    dynamic schedule.
 
+4. **Device-site registry audit** (ISSUE 20) over the Python dispatch
+   sources: every ``device_site(...)`` registration must declare a
+   ``cost_model=`` and a ``dtypes=`` set (the Device Doctor and the
+   profiling plane both consume them), and every site string a dispatch
+   actually uses — ``_DEVICE.begin("x")`` / ``note_recompile("x")`` /
+   ``supervised_dispatch("x", ...)`` / a ``site = "x"`` /
+   ``device_sites = ("x", ...)`` class attribute — must round-trip
+   through a registration, and vice versa. A dispatch measuring under a
+   name the registry doesn't know (or a registered site nothing
+   dispatches) is registry drift the runtime would never notice.
+
 Exit code 0 = clean, 1 = findings (printed one per line, file:line).
 Wired into scripts/ci_lanes.sh (lane 0).
 """
@@ -460,11 +471,131 @@ def _race_pass(
                 note(ln, "mutating call", root)
 
 
+# -- pass 4: device-site registry audit (Python dispatch sources) ----------
+
+_SITE_NAME_RE = re.compile(r"^[a-z_]+\.[a-z_]+$")
+
+
+def _walk_py(root: str):
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def device_site_pass(pkg_root: str | None = None) -> list[str]:
+    """Cross-check device_site(...) registrations against the site
+    strings the dispatch code actually measures under. Pure AST walk —
+    nothing is imported, so a registry defect cannot hide behind an
+    import-time side effect."""
+    import ast
+
+    root = pkg_root or os.path.join(REPO, "pathway_tpu")
+    findings: list[str] = []
+    registered: dict[str, tuple[str, int]] = {}
+    used: dict[str, tuple[str, int]] = {}
+
+    def call_name(node: ast.Call) -> str:
+        f = node.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        return ""
+
+    def first_str(node: ast.Call) -> str | None:
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+        return None
+
+    for path in _walk_py(root):
+        rel = os.path.relpath(path, REPO)
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except SyntaxError as exc:
+            findings.append(f"{rel}:{exc.lineno}: unparseable: {exc.msg}")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fname = call_name(node)
+                site = first_str(node)
+                if fname == "device_site":
+                    if site is None:
+                        findings.append(
+                            f"{rel}:{node.lineno}: device_site() with a "
+                            f"non-literal name — the registry audit (and "
+                            f"the Doctor's reachability) need the string"
+                        )
+                        continue
+                    if site in registered:
+                        prel, pln = registered[site]
+                        findings.append(
+                            f"{rel}:{node.lineno}: device site {site!r} "
+                            f"registered twice (also {prel}:{pln})"
+                        )
+                    registered[site] = (rel, node.lineno)
+                    kwargs = {k.arg for k in node.keywords}
+                    for req in ("cost_model", "dtypes"):
+                        if req not in kwargs:
+                            findings.append(
+                                f"{rel}:{node.lineno}: device_site("
+                                f"{site!r}) registered without {req}= — "
+                                f"the profiling plane and the Device "
+                                f"Doctor both consume it"
+                            )
+                elif fname in (
+                    "begin", "note_recompile", "supervised_dispatch"
+                ):
+                    if site and _SITE_NAME_RE.match(site):
+                        used.setdefault(site, (rel, node.lineno))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    tname = tgt.id if isinstance(tgt, ast.Name) else (
+                        tgt.attr if isinstance(tgt, ast.Attribute) else ""
+                    )
+                    v = node.value
+                    if tname == "site" and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str) \
+                            and _SITE_NAME_RE.match(v.value):
+                        used.setdefault(v.value, (rel, node.lineno))
+                    elif tname == "device_sites" and isinstance(
+                        v, (ast.Tuple, ast.List)
+                    ):
+                        for el in v.elts:
+                            if isinstance(el, ast.Constant) \
+                                    and isinstance(el.value, str):
+                                used.setdefault(
+                                    el.value, (rel, node.lineno)
+                                )
+    for site, (rel, ln) in sorted(used.items()):
+        if site not in registered:
+            findings.append(
+                f"{rel}:{ln}: dispatch site string {site!r} is not in "
+                f"the device-site registry — register it via device_site("
+                f"{site!r}, cost_model=..., dtypes=...) next to the "
+                f"dispatch (internals/device.py)"
+            )
+    for site, (rel, ln) in sorted(registered.items()):
+        if site not in used:
+            findings.append(
+                f"{rel}:{ln}: registered device site {site!r} is never "
+                f"dispatched under (no begin/note_recompile/"
+                f"supervised_dispatch/site attribute uses the string) — "
+                f"dead registration or a renamed dispatch"
+            )
+    return findings
+
+
 def main(argv: list[str]) -> int:
     files = argv or DEFAULT_FILES
     all_findings: list[str] = []
     for path in files:
         all_findings.extend(lint_file(path))
+    if not argv:
+        all_findings.extend(device_site_pass())
     if all_findings:
         print(f"lint_gil: {len(all_findings)} finding(s)")
         for f in all_findings:

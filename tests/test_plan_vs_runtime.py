@@ -477,3 +477,144 @@ def test_fused_verdict_matches_zero_fallbacks_multirank(world):
         assert sum(r["x_nb_batches"] for r in outs) > 0
         assert sum(r["gb_nb_batches"] for r in outs) > 0
         assert sum(r["join_nb_batches"] for r in outs) > 0
+
+
+# -- device-plan predicted vs measured recompiles (ISSUE 20) ----------------
+# Zero false "device-clean": the Doctor's static shape-bucket set,
+# enumerated through the SAME bucket functions the dispatch sites pad
+# with, must agree EXACTLY with the runtime's device_recompiles_total /
+# device_site_recompiles_total counters when the runtime is driven with
+# the declared batches.
+
+def _jax_available() -> bool:
+    try:
+        import jax  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+needs_jax = pytest.mark.skipif(
+    not _jax_available(), reason="jax unavailable"
+)
+
+
+@needs_jax
+def test_device_plan_predicts_fused_ingest_recompiles_exactly():
+    import numpy as np  # noqa: F401
+
+    from pathway_tpu.analysis.device_plan import (
+        WorkloadSpec,
+        join_profile,
+        simulate_ingest_buckets,
+    )
+    from pathway_tpu.internals.device import PLANE
+    from pathway_tpu.internals.monitoring import ProberStats
+    from pathway_tpu.models.encoder import EncoderConfig, SentenceEncoder
+    from pathway_tpu.ops.ingest import IngestPipeline
+    from pathway_tpu.ops.knn import KnnShard
+
+    cfg = EncoderConfig.tiny()
+    enc = SentenceEncoder(cfg)
+    shard = KnnShard(cfg.hidden, capacity=128)
+    pipe = IngestPipeline(enc, shard, stage_h2d=False)
+    word = "retrieval"
+    batches = [
+        [" ".join([word] * 3)] * 4,          # small batch, short seqs
+        [" ".join([word] * 3)] * 4,          # same shape: no new bucket
+        [" ".join([word] * 20)] * 4,         # longer seq bucket
+        [" ".join([word] * 3)] * 12,         # bigger batch bucket
+    ]
+    # the declared workload: (rows, raw token length) per batch, read
+    # off the same tokenizer the pipeline stages with
+    declared = []
+    for texts in batches:
+        ids, _ = enc.tokenizer(list(texts))
+        declared.append((len(texts), ids.shape[1]))
+    spec = WorkloadSpec(
+        ingest_batches=tuple(declared),
+        batch_cap=enc.batch_size,
+        initial_capacity=shard.capacity,
+    )
+    predicted = simulate_ingest_buckets(spec, cfg)
+
+    stats = ProberStats()
+    PLANE.disarm()
+    PLANE.arm(None, stats)
+    try:
+        for i, texts in enumerate(batches):
+            pipe.ingest([f"k{i}-{j}" for j in range(len(texts))], texts)
+    finally:
+        PLANE.disarm()
+    measured = stats.device_recompiles.get("ingest.fused", 0)
+    assert measured == len(predicted), (
+        f"predicted buckets {sorted(predicted)} vs measured "
+        f"{measured} recompiles"
+    )
+    # the runtime's bucket keys ARE the predicted set (identity-shared
+    # bucket functions, not merely equal counts)
+    assert pipe._seen_buckets == predicted
+    # and the --profile drift join agrees: measured == predicted is ok
+    from pathway_tpu.analysis.device_plan import analyze_device_plan
+
+    joined = join_profile(
+        analyze_device_plan(workload=spec),
+        {"device_recompiles": dict(stats.device_recompiles)},
+    )
+    assert joined.predictions["ingest.fused"]["drift"] == "ok"
+    assert joined.verdict == "device-clean"
+
+
+@needs_jax
+def test_device_plan_predicts_knn_recompiles_exactly():
+    import numpy as np
+
+    from pathway_tpu.analysis.device_plan import (
+        WorkloadSpec,
+        simulate_knn_buckets,
+    )
+    from pathway_tpu.internals.device import PLANE
+    from pathway_tpu.internals.monitoring import ProberStats
+    from pathway_tpu.ops.knn import KnnShard
+
+    write_batches = (16, 16, 48, 96)   # 48 keeps cap, 96 grows it to 256
+    query_batches = (1, 3, 8)
+    ks = (5, 10)
+    spec = WorkloadSpec(
+        write_batches=write_batches,
+        query_batches=query_batches,
+        ks=ks,
+        initial_capacity=128,
+    )
+    pred_write, pred_search = simulate_knn_buckets(spec)
+
+    shard = KnnShard(8, capacity=128)
+    rng = np.random.default_rng(7)
+    stats = ProberStats()
+    PLANE.disarm()
+    PLANE.arm(None, stats)
+    try:
+        seq = 0
+        for b in write_batches:
+            shard.add(
+                [f"k{seq + j}" for j in range(b)],
+                rng.normal(size=(b, 8)).astype(np.float32),
+            )
+            seq += b
+        for q in query_batches:
+            for k in ks:
+                shard.search(
+                    rng.normal(size=(q, 8)).astype(np.float32), k=k
+                )
+    finally:
+        PLANE.disarm()
+    assert stats.device_recompiles.get("knn.write", 0) == len(pred_write)
+    assert stats.device_recompiles.get("knn.search", 0) == len(pred_search)
+    # the runtime's seen-bucket keys are the predicted sets themselves
+    assert shard._seen_buckets == pred_write | pred_search
+    # aggregate pin: device_recompiles_total (the sum the OpenMetrics
+    # endpoint renders) equals the Doctor's total prediction
+    assert sum(stats.device_recompiles.values()) == (
+        len(pred_write) + len(pred_search)
+    )
